@@ -1,0 +1,87 @@
+"""kubetorch-tpu: a TPU-native compute-dispatch and serving fabric.
+
+A ground-up rebuild of the capabilities of run-house/kubetorch (reference
+mounted at /root/reference) designed for TPU pods on GKE: ``kt.fn(train).to(
+kt.Compute(tpu="v5p-64"))`` provisions a TPU slice, syncs your working
+directory in ~1-2s, hot-reloads code without pod restarts, and exposes the
+function as an HTTP service with JAX-SPMD fan-out, device-mesh parallelism
+(DP/FSDP/TP/SP/EP/CP) as a launcher-level concern, log/metric/exception
+propagation, a P2P data store with ICI-collective tensor transfer, autoscaling
+and fault surfacing (TPU preemption / HBM OOM) as typed exceptions.
+
+Import is lazy: ``import kubetorch_tpu as kt`` never imports jax — device
+libraries load only in the worker processes that need them.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .exceptions import (  # noqa: F401
+    KubetorchError,
+    ImagePullError,
+    ResourceNotAvailableError,
+    TpuSliceUnavailableError,
+    ServiceHealthError,
+    ServiceTimeoutError,
+    PodContainerError,
+    VersionMismatchError,
+    ControllerRequestError,
+    SyncError,
+    SerializationError,
+    DataStoreError,
+    DebuggerError,
+    PodTerminatedError,
+    HbmOomError,
+    WorkerMembershipChanged,
+    WorkerCallError,
+)
+from .config import config, KTConfig  # noqa: F401
+
+_LAZY = {
+    # user-facing API (reference python_client/kubetorch/__init__.py surface)
+    "Compute": ".resources.compute",
+    "Image": ".resources.image",
+    "images": ".resources.images",
+    "Volume": ".resources.volume",
+    "Secret": ".resources.secret",
+    "Endpoint": ".resources.endpoint",
+    "fn": ".resources.fn",
+    "Fn": ".resources.fn",
+    "cls": ".resources.cls",
+    "Cls": ".resources.cls",
+    "app": ".resources.app",
+    "App": ".resources.app",
+    "compute": ".resources.decorators",
+    "distribute": ".resources.decorators",
+    "autoscale": ".resources.decorators",
+    "async_": ".resources.decorators",
+    "AutoscalingConfig": ".resources.autoscaling",
+    "put": ".data_store.commands",
+    "get": ".data_store.commands",
+    "ls": ".data_store.commands",
+    "rm": ".data_store.commands",
+    "BroadcastWindow": ".data_store.types",
+    "distributed": ".serving.distributed_env",
+    "MeshSpec": ".parallel.mesh",
+}
+
+
+def __getattr__(name: str):
+    mod_path = _LAZY.get(name)
+    if mod_path is None:
+        raise AttributeError(f"module 'kubetorch_tpu' has no attribute {name!r}")
+    import importlib
+    try:
+        mod = importlib.import_module(mod_path, __name__)
+    except ImportError as e:
+        # Module-__getattr__ convention: surface AttributeError so hasattr()
+        # and dir()-driven tooling keep working.
+        raise AttributeError(f"kubetorch_tpu.{name} unavailable: {e}") from e
+    val = getattr(mod, name)
+    globals()[name] = val
+    return val
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

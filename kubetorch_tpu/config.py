@@ -1,0 +1,126 @@
+"""Layered client configuration.
+
+Reference ``python_client/kubetorch/config.py`` (383 LoC): a YAML file at
+``~/.kt/config`` layered under ``KT_*`` environment-variable overrides, plus a
+cluster-wide ConfigMap merged in at Compute-construction time (SURVEY §5.6).
+Same three planes here:
+
+1. file: ``~/.kt/config`` (YAML)
+2. env:  ``KT_<UPPER_SNAKE>`` overrides
+3. cluster defaults: merged by ``Compute`` from the controller's
+   ``/controller/cluster-config`` endpoint when reachable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _env_bool(val: str) -> bool:
+    return val.strip().lower() in _TRUTHY
+
+
+@dataclass
+class KTConfig:
+    """Client-side configuration with file + env layering."""
+
+    username: Optional[str] = None
+    namespace: str = "default"
+    install_namespace: str = "kubetorch"
+    api_url: Optional[str] = None            # controller URL; None → port-forward / local
+    stream_logs: bool = True
+    stream_metrics: bool = False
+    serialization: str = "json"
+    launch_timeout: int = 900                # KT_LAUNCH_TIMEOUT, reference constants.py:79
+    server_port: int = 32300                 # reference provisioning/constants.py
+    controller_port: int = 8080
+    mds_port: int = 8081
+    data_store_url: Optional[str] = None
+    local_mode: bool = False                 # run pods as local subprocesses (no k8s)
+    tpu_default_runtime: str = "jax"
+    config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))
+
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls) -> "KTConfig":
+        cfg = cls()
+        path = cls._config_path()
+        if path.exists():
+            try:
+                import yaml
+                data = yaml.safe_load(path.read_text()) or {}
+                for f in fields(cls):
+                    if f.name in data:
+                        setattr(cfg, f.name, data[f.name])
+                cfg.extra.update({k: v for k, v in data.items()
+                                  if k not in {f.name for f in fields(cls)}})
+            except Exception as e:
+                import warnings
+                warnings.warn(f"Ignoring malformed kt config at {path}: {e}",
+                              stacklevel=2)
+        for f in fields(cls):
+            env_key = f"KT_{f.name.upper()}"
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                if f.type in ("bool", bool):
+                    setattr(cfg, f.name, _env_bool(raw))
+                elif f.type in ("int", int):
+                    try:
+                        setattr(cfg, f.name, int(raw))
+                    except ValueError:
+                        pass
+                elif f.name not in ("extra",):
+                    setattr(cfg, f.name, raw)
+        if cfg.username is None:
+            cfg.username = os.environ.get("USER") or os.environ.get("USERNAME") or "kt"
+        return cfg
+
+    @classmethod
+    def _config_path(cls) -> Path:
+        return Path(os.environ.get("KT_CONFIG_PATH", os.path.expanduser("~/.kt/config")))
+
+    def save(self) -> None:
+        import yaml
+        path = self._config_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        data = {f.name: getattr(self, f.name) for f in fields(self)
+                if f.name not in ("extra", "config_dir") and getattr(self, f.name) is not None}
+        data.update(self.extra)
+        path.write_text(yaml.safe_dump(data, sort_keys=True))
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if hasattr(self, key):
+            return getattr(self, key)
+        return self.extra.get(key, default)
+
+    def set(self, key: str, value: Any) -> None:
+        if hasattr(self, key) and key != "extra":
+            setattr(self, key, value)
+        else:
+            self.extra[key] = value
+
+
+_config_lock = threading.Lock()
+_config: Optional[KTConfig] = None
+
+
+def config() -> KTConfig:
+    """Process-wide config singleton (reference ``globals.py`` pattern)."""
+    global _config
+    with _config_lock:
+        if _config is None:
+            _config = KTConfig.load()
+        return _config
+
+
+def reset_config() -> None:
+    global _config
+    with _config_lock:
+        _config = None

@@ -1,0 +1,329 @@
+"""Typed exception taxonomy for kubetorch-tpu.
+
+The reference surfaces infrastructure failures as typed Python exceptions that
+the client can catch programmatically (reference:
+``python_client/kubetorch/resources/compute/utils.py:57-157`` for launch
+failures, ``serving/utils.py:111-264`` for pod-termination and membership
+faults, ``serving/http_client.py:87-194`` for cross-process rehydration).
+
+This module is the TPU-native re-design of that surface:
+
+- the launch taxonomy is kept (image pulls, quota, health, timeouts) because it
+  is Kubernetes-level, not accelerator-level;
+- the termination taxonomy adds first-class **TPU preemption** (GKE spot /
+  maintenance events) and **HBM OOM** flags, which replace the reference's
+  CUDA-centric OOMKilled-only view;
+- every exception is registered in :data:`EXCEPTION_REGISTRY` so the HTTP
+  client can rehydrate the *same type* on the caller's side, preserving
+  ``except kt.PodTerminatedError`` ergonomics across the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class KubetorchError(Exception):
+    """Base for every kubetorch-tpu exception."""
+
+
+# ---------------------------------------------------------------------------
+# Launch / provisioning failures (reference resources/compute/utils.py:57-157)
+# ---------------------------------------------------------------------------
+
+
+class ImagePullError(KubetorchError):
+    """Container image could not be pulled (bad tag, missing pull secret)."""
+
+
+class ResourceNotAvailableError(KubetorchError):
+    """Cluster cannot satisfy the resource request (quota, no TPU slice free)."""
+
+
+class TpuSliceUnavailableError(ResourceNotAvailableError):
+    """No TPU slice of the requested topology is schedulable.
+
+    TPU slices are atomic units (a v5p-64 is 8 hosts that must co-schedule);
+    this carries the topology so callers can programmatically fall back to a
+    smaller slice.
+    """
+
+    def __init__(self, message: str, accelerator: Optional[str] = None, topology: Optional[str] = None):
+        super().__init__(message)
+        self.accelerator = accelerator
+        self.topology = topology
+
+
+class ServiceHealthError(KubetorchError):
+    """Service came up but failed its health probe."""
+
+
+class ServiceTimeoutError(KubetorchError):
+    """Service did not become ready within the launch timeout."""
+
+
+class PodContainerError(KubetorchError):
+    """A container in the workload pod crashed or errored during launch."""
+
+
+class VersionMismatchError(KubetorchError):
+    """Client and in-cluster server versions are incompatible."""
+
+
+class ControllerRequestError(KubetorchError):
+    """The controller rejected or failed a request."""
+
+    def __init__(self, message: str, status_code: Optional[int] = None):
+        super().__init__(message)
+        self.status_code = status_code
+
+
+class SyncError(KubetorchError):
+    """Code/data synchronisation to or from the cluster failed.
+
+    Replaces the reference's ``RsyncError`` — this framework ships its own
+    content-hash delta-sync protocol rather than shelling out to rsync.
+    """
+
+
+class SerializationError(KubetorchError):
+    """Payload could not be (de)serialized, or format not in the allowlist."""
+
+
+class DataStoreError(KubetorchError):
+    """Data-store operation (put/get/ls/rm/broadcast) failed."""
+
+
+class DebuggerError(KubetorchError):
+    """Remote debugger attach/session failure."""
+
+
+# ---------------------------------------------------------------------------
+# Runtime faults (reference serving/utils.py:111-264)
+# ---------------------------------------------------------------------------
+
+
+class PodTerminatedError(KubetorchError):
+    """The pod serving the request was terminated mid-flight.
+
+    Reference parses OOMKilled/Evicted from container status
+    (``serving/utils.py:111-191``). The TPU rebuild adds ``preempted`` (GKE
+    spot reclaim / TPU maintenance — surfaced via the graceful-termination
+    signal) and ``hbm_oom`` (device out-of-memory from libtpu/XLA, which is a
+    *process* fault rather than a cgroup kill and therefore invisible to the
+    reference's design).
+    """
+
+    def __init__(
+        self,
+        message: str = "Pod was terminated while handling the request",
+        reason: Optional[str] = None,
+        pod_name: Optional[str] = None,
+        exit_code: Optional[int] = None,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.pod_name = pod_name
+        self.exit_code = exit_code
+
+    @property
+    def oom_killed(self) -> bool:
+        return self.reason == "OOMKilled"
+
+    @property
+    def evicted(self) -> bool:
+        return self.reason == "Evicted"
+
+    @property
+    def preempted(self) -> bool:
+        return self.reason in ("Preempted", "TPUMaintenance", "SpotReclaim")
+
+    @property
+    def hbm_oom(self) -> bool:
+        return self.reason == "HbmOom"
+
+
+class HbmOomError(PodTerminatedError):
+    """XLA failed to allocate on-device (HBM) memory.
+
+    Raised when a RESOURCE_EXHAUSTED from the TPU runtime is detected in a
+    worker process; carries the requested/available bytes when parseable so
+    clients can programmatically shrink batch size and retry.
+    """
+
+    def __init__(self, message: str, requested_bytes: Optional[int] = None, available_bytes: Optional[int] = None):
+        super().__init__(message, reason="HbmOom")
+        self.requested_bytes = requested_bytes
+        self.available_bytes = available_bytes
+
+
+class WorkerMembershipChanged(KubetorchError):
+    """The set of worker pods changed during a distributed call.
+
+    Mirrors reference ``serving/utils.py:193-264``: carries added/removed IPs
+    and criticality so the client can resize (``.distribute(workers=N-1)``)
+    and redeploy — the elastic-recovery recipe. On TPU an XLA-compiled mesh
+    cannot shrink in place, so this exception *is* the resize trigger.
+    """
+
+    def __init__(
+        self,
+        message: str = "Worker membership changed during execution",
+        added: Optional[List[str]] = None,
+        removed: Optional[List[str]] = None,
+        previous: Optional[List[str]] = None,
+        current: Optional[List[str]] = None,
+    ):
+        super().__init__(message)
+        self.added = added or []
+        self.removed = removed or []
+        self.previous = previous or []
+        self.current = current or []
+
+    @property
+    def is_critical(self) -> bool:
+        """Removed workers always invalidate an SPMD mesh; additions do not."""
+        return bool(self.removed)
+
+
+class WorkerCallError(KubetorchError):
+    """A fanned-out subcall to a worker pod failed; wraps the remote error."""
+
+    def __init__(self, message: str, worker: Optional[str] = None):
+        super().__init__(message)
+        self.worker = worker
+
+
+# ---------------------------------------------------------------------------
+# Cross-process rehydration (reference serving/http_client.py:87-194)
+# ---------------------------------------------------------------------------
+
+EXCEPTION_REGISTRY: Dict[str, type] = {
+    cls.__name__: cls
+    for cls in (
+        KubetorchError,
+        ImagePullError,
+        ResourceNotAvailableError,
+        TpuSliceUnavailableError,
+        ServiceHealthError,
+        ServiceTimeoutError,
+        PodContainerError,
+        VersionMismatchError,
+        ControllerRequestError,
+        SyncError,
+        SerializationError,
+        DataStoreError,
+        DebuggerError,
+        PodTerminatedError,
+        HbmOomError,
+        WorkerMembershipChanged,
+        WorkerCallError,
+    )
+}
+
+# Keyword-only attrs each registered type accepts beyond the message, used to
+# round-trip structured fields through :func:`package_exception`.
+_STRUCTURED_ATTRS: Dict[str, List[str]] = {
+    "TpuSliceUnavailableError": ["accelerator", "topology"],
+    "ControllerRequestError": ["status_code"],
+    "PodTerminatedError": ["reason", "pod_name", "exit_code"],
+    "HbmOomError": ["requested_bytes", "available_bytes"],
+    "WorkerMembershipChanged": ["added", "removed", "previous", "current"],
+    "WorkerCallError": ["worker"],
+}
+
+
+def package_exception(exc: BaseException) -> Dict[str, Any]:
+    """Flatten an exception into a JSON-safe dict for the wire.
+
+    Mirrors reference ``serving/http_server.py:1478-1530`` but also captures
+    the structured attrs of registered types so rehydration is lossless.
+    """
+    import traceback as _tb
+
+    name = type(exc).__name__
+    data: Dict[str, Any] = {
+        "error_type": name,
+        "module": type(exc).__module__,
+        "message": str(exc),
+        "traceback": "".join(_tb.format_exception(type(exc), exc, exc.__traceback__)),
+    }
+    attrs = {}
+    for attr in _STRUCTURED_ATTRS.get(name, []):
+        val = getattr(exc, attr, None)
+        if val is not None:
+            attrs[attr] = val
+    if attrs:
+        data["attrs"] = attrs
+    return data
+
+
+def rehydrate_exception(data: Dict[str, Any]) -> BaseException:
+    """Reconstruct an exception from :func:`package_exception` output.
+
+    Resolution order (reference ``http_client.py:87-194``): a registered
+    kubetorch type (with structured attrs), then a Python builtin, then a
+    dynamically created subclass of :class:`KubetorchError` whose ``__str__``
+    carries the remote traceback.
+    """
+    import builtins
+
+    name = data.get("error_type", "Exception")
+    message = data.get("message", "")
+    remote_tb = data.get("traceback", "")
+    attrs = data.get("attrs", {})
+
+    if name in EXCEPTION_REGISTRY:
+        cls = EXCEPTION_REGISTRY[name]
+        try:
+            exc = cls(message, **attrs)
+        except TypeError:
+            exc = cls(message)
+        exc.remote_traceback = remote_tb  # type: ignore[attr-defined]
+        return exc
+
+    builtin = getattr(builtins, name, None)
+    if isinstance(builtin, type) and issubclass(builtin, BaseException):
+        try:
+            exc = builtin(message)
+        except TypeError:
+            exc = Exception(f"{name}: {message}")
+        exc.remote_traceback = remote_tb  # type: ignore[attr-defined]
+        return exc
+
+    # Unknown remote type: synthesize a subclass carrying the traceback.
+    dynamic = type(name, (KubetorchError,), {
+        "__str__": lambda self: f"{message}\n\nRemote traceback:\n{remote_tb}",
+    })
+    exc = dynamic(message)
+    exc.remote_traceback = remote_tb  # type: ignore[attr-defined]
+    return exc
+
+
+def detect_hbm_oom(exc: BaseException) -> Optional[HbmOomError]:
+    """Map an XLA RESOURCE_EXHAUSTED error to :class:`HbmOomError`, else None.
+
+    XLA raises ``XlaRuntimeError: RESOURCE_EXHAUSTED: ... Attempting to
+    allocate X. ... available Y`` on HBM exhaustion. We match on the message
+    because the exception type lives in jaxlib and we must not import jax in
+    every process that handles errors.
+    """
+    import re
+
+    msg = str(exc)
+    if "RESOURCE_EXHAUSTED" not in msg and "Out of memory allocating" not in msg:
+        return None
+    req = avail = None
+    m = re.search(r"[Aa]llocat\w*\s+([\d.]+)\s*([KMGT]?i?B)", msg)
+    if m:
+        req = _parse_bytes(m.group(1), m.group(2))
+    m = re.search(r"available[:\s]+([\d.]+)\s*([KMGT]?i?B)", msg)
+    if m:
+        avail = _parse_bytes(m.group(1), m.group(2))
+    return HbmOomError(msg, requested_bytes=req, available_bytes=avail)
+
+
+def _parse_bytes(num: str, unit: str) -> int:
+    mult = {"B": 1, "KB": 10**3, "MB": 10**6, "GB": 10**9, "TB": 10**12,
+            "KIB": 2**10, "MIB": 2**20, "GIB": 2**30, "TIB": 2**40}
+    return int(float(num) * mult.get(unit.upper(), 1))

@@ -1,0 +1,1 @@
+"""User-facing resource API: Compute, Fn/Cls/App, Image, Volume, Secret."""

@@ -1,0 +1,189 @@
+"""Wire serialization for call bodies and results.
+
+Reference behavior (``serving/http_server.py:1768-1891``): ``json`` by
+default, ``pickle`` as base64 gated by a ``KT_ALLOWED_SERIALIZATION``
+allowlist, ``none`` passthrough. Format travels in the ``X-Serialization``
+header.
+
+TPU-native redesign: arrays are first-class. A ``json``-serialized body may
+embed numpy/JAX arrays — they are encoded as typed leaves
+(``{"__kt_array__": {dtype, shape, data_b64}}``) so a JAX pytree survives the
+wire without pickle. For bulk tensors the binary ``msgpack`` format packs raw
+array bytes without base64 inflation (the data-plane path; see
+``data_store``). Device arrays are pulled to host with ``np.asarray`` — the
+transfer daemon, not the RPC layer, owns device placement (SURVEY §2.9: TPUs
+have no CUDA-IPC equivalent, so host staging is the only cross-process path).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Iterable, Optional
+
+from .exceptions import SerializationError
+
+JSON = "json"
+PICKLE = "pickle"
+MSGPACK = "msgpack"
+NONE = "none"
+
+DEFAULT_ALLOWED = (JSON, MSGPACK, NONE)
+
+_ARRAY_KEY = "__kt_array__"
+_BYTES_KEY = "__kt_bytes__"
+
+
+def _is_array(obj: Any) -> bool:
+    # numpy arrays/scalars and anything exposing __array__ + dtype/shape
+    # (covers jax.Array without importing jax here).
+    t = type(obj)
+    mod = t.__module__
+    if mod.startswith("numpy"):
+        import numpy as np
+        return isinstance(obj, (np.ndarray, np.generic))
+    if mod.startswith(("jax", "jaxlib")):
+        return hasattr(obj, "dtype") and hasattr(obj, "shape")
+    return False
+
+
+def _encode_array(obj: Any) -> dict:
+    import numpy as np
+
+    arr = np.asarray(obj)  # device→host for jax.Array
+    return {
+        _ARRAY_KEY: {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode(),
+        }
+    }
+
+
+def _decode_array(spec: dict) -> Any:
+    import numpy as np
+
+    raw = base64.b64decode(spec["data"])
+    # bfloat16 has no numpy builtin; ml_dtypes ships with jax.
+    dtype = spec["dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    return np.frombuffer(raw, dtype=np_dtype).reshape(spec["shape"]).copy()
+
+
+def _jsonify(obj: Any) -> Any:
+    """Recursively convert a pytree-ish object to JSON-safe form."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return {_BYTES_KEY: base64.b64encode(obj).decode()}
+    if _is_array(obj):
+        return _encode_array(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(x) for x in obj]
+    if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, str):
+                raise SerializationError(
+                    f"JSON serialization requires string dict keys; got {type(k).__name__} "
+                    f"key {k!r}. Use serialization='msgpack' or 'pickle'."
+                )
+        return {k: _jsonify(v) for k, v in obj.items()}
+    raise SerializationError(
+        f"Object of type {type(obj).__name__} is not json-serializable; "
+        f"use serialization='pickle' (must be allowlisted server-side)."
+    )
+
+
+def _dejsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if _ARRAY_KEY in obj and len(obj) == 1:
+            return _decode_array(obj[_ARRAY_KEY])
+        if _BYTES_KEY in obj and len(obj) == 1:
+            return base64.b64decode(obj[_BYTES_KEY])
+        return {k: _dejsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dejsonify(x) for x in obj]
+    return obj
+
+
+def serialize(obj: Any, format: str = JSON) -> bytes:
+    """Serialize ``obj`` to bytes in the given wire format."""
+    if format == NONE:
+        if obj is None:
+            return b""
+        if isinstance(obj, bytes):
+            return obj
+        if isinstance(obj, str):
+            return obj.encode()
+        raise SerializationError("serialization='none' requires bytes/str/None")
+    if format == JSON:
+        return json.dumps(_jsonify(obj)).encode()
+    if format == PICKLE:
+        import cloudpickle
+        return base64.b64encode(cloudpickle.dumps(obj))
+    if format == MSGPACK:
+        return _msgpack_dumps(obj)
+    raise SerializationError(f"Unknown serialization format: {format!r}")
+
+
+def deserialize(data: bytes, format: str = JSON, allowed: Optional[Iterable[str]] = None) -> Any:
+    """Deserialize bytes; enforce the server-side allowlist when given.
+
+    ``allowed`` mirrors the reference's KT_ALLOWED_SERIALIZATION gate
+    (``http_server.py:1777``): pickle is rejected unless explicitly enabled
+    per-workload, because unpickling is code execution.
+    """
+    if allowed is not None and format not in allowed:
+        raise SerializationError(
+            f"Serialization format {format!r} not in server allowlist {sorted(allowed)}"
+        )
+    if format == NONE:
+        return data
+    if not data:
+        return None
+    if format == JSON:
+        return _dejsonify(json.loads(data.decode()))
+    if format == PICKLE:
+        import cloudpickle
+        return cloudpickle.loads(base64.b64decode(data))
+    if format == MSGPACK:
+        return _msgpack_loads(data)
+    raise SerializationError(f"Unknown serialization format: {format!r}")
+
+
+# -- msgpack binary path (efficient raw-bytes arrays, no b64) ---------------
+
+
+def _msgpack_default(obj: Any) -> Any:
+    if _is_array(obj):
+        import numpy as np
+        arr = np.asarray(obj)
+        return {"__arr__": True, "d": str(arr.dtype), "s": list(arr.shape), "b": arr.tobytes()}
+    if isinstance(obj, tuple):
+        return list(obj)
+    raise SerializationError(f"msgpack cannot encode {type(obj).__name__}")
+
+
+def _msgpack_hook(obj: dict) -> Any:
+    if obj.get("__arr__"):
+        import numpy as np
+        dtype = obj["d"]
+        if dtype == "bfloat16":
+            import ml_dtypes
+            dtype = ml_dtypes.bfloat16
+        return np.frombuffer(obj["b"], dtype=dtype).reshape(obj["s"]).copy()
+    return obj
+
+
+def _msgpack_dumps(obj: Any) -> bytes:
+    import msgpack
+    return msgpack.packb(obj, default=_msgpack_default, use_bin_type=True)
+
+
+def _msgpack_loads(data: bytes) -> Any:
+    import msgpack
+    return msgpack.unpackb(data, object_hook=_msgpack_hook, raw=False, strict_map_key=False)

@@ -1,0 +1,13 @@
+"""Shared utilities: k8s naming, process management, retries, ports."""
+
+from .naming import sanitize_k8s_name, validate_k8s_name, service_name_for
+from .procs import kill_process_tree, free_port, wait_for_port
+
+__all__ = [
+    "sanitize_k8s_name",
+    "validate_k8s_name",
+    "service_name_for",
+    "kill_process_tree",
+    "free_port",
+    "wait_for_port",
+]

@@ -1,0 +1,36 @@
+"""Kubernetes-safe naming (reference ``serving/utils.py:271`` validation and
+``resources/callables/module.py:140-151`` username-prefixed service naming)."""
+
+from __future__ import annotations
+
+import re
+
+_K8S_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_NAME_LEN = 63
+
+
+def validate_k8s_name(name: str) -> None:
+    if not name or len(name) > MAX_NAME_LEN or not _K8S_NAME_RE.match(name):
+        raise ValueError(
+            f"{name!r} is not a valid Kubernetes name (lowercase alphanumerics and '-', "
+            f"must start/end alphanumeric, <= {MAX_NAME_LEN} chars)"
+        )
+
+
+def sanitize_k8s_name(name: str) -> str:
+    name = name.lower().replace("_", "-").replace(".", "-").replace("/", "-")
+    name = re.sub(r"[^a-z0-9-]", "", name)
+    name = re.sub(r"-+", "-", name).strip("-")
+    return name[:MAX_NAME_LEN].strip("-") or "kt"
+
+
+def service_name_for(callable_name: str, username: str | None = None, name: str | None = None) -> str:
+    """Service name = explicit name, else ``{username}-{callable}`` sanitized."""
+    if name:
+        out = sanitize_k8s_name(name)
+    elif username:
+        out = sanitize_k8s_name(f"{username}-{callable_name}")
+    else:
+        out = sanitize_k8s_name(callable_name)
+    validate_k8s_name(out)
+    return out

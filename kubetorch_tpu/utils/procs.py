@@ -1,0 +1,46 @@
+"""Process and port helpers (reference ``serving/utils.py:752-786`` process-tree
+kill; port utilities used by the local backend and tests)."""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import time
+
+
+def kill_process_tree(pid: int, timeout: float = 5.0) -> None:
+    """Terminate a process and all descendants, escalating to SIGKILL.
+
+    Used on supervisor cleanup so frameworks that fork helpers (dataloaders,
+    compilation servers) don't leak (reference kills vLLM-style trees).
+    """
+    import psutil
+
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = parent.children(recursive=True) + [parent]
+    for p in procs:
+        with contextlib.suppress(psutil.NoSuchProcess):
+            p.terminate()
+    _, alive = psutil.wait_procs(procs, timeout=timeout)
+    for p in alive:
+        with contextlib.suppress(psutil.NoSuchProcess):
+            p.kill()
+
+
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for_port(host: str, port: int, timeout: float = 30.0, interval: float = 0.1) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with contextlib.suppress(OSError):
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        time.sleep(interval)
+    return False

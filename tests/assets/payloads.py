@@ -1,0 +1,53 @@
+"""Test payload callables (model: reference tests/utils.py — summer,
+SlowNumpyArray, memory consumers, sleep_forever)."""
+
+import os
+import time
+
+
+def summer(a, b):
+    return a + b
+
+
+def echo_env(*names):
+    return {n: os.environ.get(n) for n in names}
+
+
+def whoami():
+    return {"pid": os.getpid(),
+            "rank": os.environ.get("RANK"),
+            "world_size": os.environ.get("WORLD_SIZE"),
+            "local_rank": os.environ.get("LOCAL_RANK"),
+            "node_rank": os.environ.get("NODE_RANK")}
+
+
+def boomer(msg="kaboom"):
+    raise ValueError(msg)
+
+
+def sleeper(seconds):
+    time.sleep(seconds)
+    return seconds
+
+
+def jax_matmul(n=8):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n))
+    return float(jnp.sum(x @ x)), jax.device_count()
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def _private(self):  # must NOT be exposed remotely
+        return "hidden"

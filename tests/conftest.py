@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Test strategy follows SURVEY.md §4: in-process server tests, a LOCAL_IPS-style
+fake for multi-host discovery, and sharding tests on a virtual 8-device CPU
+mesh (``xla_force_host_platform_device_count``) — no cluster and no TPU
+required. The env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+# Virtual 8-device CPU mesh for all sharding/parallelism tests.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provide >= 8 virtual devices"
+    return devices
+
+
+@pytest.fixture()
+def tmp_project(tmp_path):
+    """A throwaway project dir with a marker so locate_working_dir resolves."""
+    (tmp_path / ".git").mkdir()
+    return tmp_path

@@ -1,0 +1,61 @@
+"""Exception packaging/rehydration across the wire (reference
+serving/http_client.py:87-194, http_server.py:1478-1530)."""
+
+import pytest
+
+from kubetorch_tpu import exceptions as exc
+
+
+def test_roundtrip_registered_type():
+    try:
+        raise exc.PodTerminatedError("pod died", reason="OOMKilled", pod_name="p-0", exit_code=137)
+    except exc.PodTerminatedError as e:
+        data = exc.package_exception(e)
+    out = exc.rehydrate_exception(data)
+    assert isinstance(out, exc.PodTerminatedError)
+    assert out.oom_killed and not out.evicted
+    assert out.pod_name == "p-0" and out.exit_code == 137
+    assert "pod died" in str(out)
+    assert "test_roundtrip_registered_type" in out.remote_traceback
+
+
+def test_tpu_preemption_flags():
+    e = exc.PodTerminatedError("preempted", reason="SpotReclaim")
+    assert e.preempted and not e.oom_killed
+    out = exc.rehydrate_exception(exc.package_exception(e))
+    assert out.preempted
+
+
+def test_membership_changed_roundtrip():
+    e = exc.WorkerMembershipChanged(added=["10.0.0.9"], removed=["10.0.0.3"],
+                                    previous=["10.0.0.3"], current=["10.0.0.9"])
+    out = exc.rehydrate_exception(exc.package_exception(e))
+    assert isinstance(out, exc.WorkerMembershipChanged)
+    assert out.removed == ["10.0.0.3"] and out.is_critical
+
+
+def test_builtin_rehydration():
+    data = exc.package_exception(ValueError("bad value"))
+    out = exc.rehydrate_exception(data)
+    assert isinstance(out, ValueError)
+    assert str(out) == "bad value"
+
+
+def test_unknown_type_dynamic_subclass():
+    data = {"error_type": "SomeUserError", "message": "boom", "traceback": "tb-here"}
+    out = exc.rehydrate_exception(data)
+    assert isinstance(out, exc.KubetorchError)
+    assert type(out).__name__ == "SomeUserError"
+    assert "tb-here" in str(out)
+
+
+def test_hbm_oom_detection():
+    e = RuntimeError(
+        "RESOURCE_EXHAUSTED: XLA:TPU compile permanent error. Ran out of memory in memory "
+        "space hbm. Attempting to allocate 8.52GiB. available 3.99GiB"
+    )
+    oom = exc.detect_hbm_oom(e)
+    assert oom is not None and oom.hbm_oom
+    assert oom.requested_bytes == int(8.52 * 2**30)
+    assert oom.available_bytes == int(3.99 * 2**30)
+    assert exc.detect_hbm_oom(RuntimeError("unrelated")) is None

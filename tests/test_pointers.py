@@ -1,0 +1,57 @@
+"""Pointer extraction + import fallback (reference resources/callables/utils.py)."""
+
+import sys
+import textwrap
+
+import pytest
+
+from kubetorch_tpu.resources import pointers as ptr
+
+
+def test_extract_from_installed_module():
+    import tests.assets.payloads as payloads
+    p = ptr.extract_pointers(payloads.summer)
+    assert p.cls_or_fn_name == "summer"
+    assert p.module_name.endswith("payloads")
+    assert p.file_path.endswith("payloads.py")
+
+
+def test_locate_working_dir(tmp_project):
+    sub = tmp_project / "pkg" / "sub"
+    sub.mkdir(parents=True)
+    f = sub / "mod.py"
+    f.write_text("x = 1\n")
+    assert ptr.locate_working_dir(str(f)) == str(tmp_project)
+
+
+def test_import_callable_roundtrip(tmp_project):
+    (tmp_project / "workmod.py").write_text(textwrap.dedent("""
+        def double(x):
+            return x * 2
+    """))
+    p = ptr.Pointers(project_root=str(tmp_project), module_name="workmod",
+                     file_path="workmod.py", cls_or_fn_name="double")
+    fn = ptr.import_callable(p)
+    assert fn(21) == 42
+    sys.modules.pop("workmod", None)
+
+
+def test_import_callable_missing_attr(tmp_project):
+    (tmp_project / "emptymod.py").write_text("pass\n")
+    p = ptr.Pointers(project_root=str(tmp_project), module_name="emptymod",
+                     file_path="emptymod.py", cls_or_fn_name="nope")
+    with pytest.raises(ImportError):
+        ptr.import_callable(p)
+    sys.modules.pop("emptymod", None)
+
+
+def test_reject_non_callable():
+    with pytest.raises(TypeError):
+        ptr.extract_pointers(42)
+
+
+def test_build_call_body():
+    body = ptr.build_call_body((1, 2), {"k": "v"})
+    assert body == {"args": [1, 2], "kwargs": {"k": "v"}}
+    body = ptr.build_call_body((), {}, debugger={"mode": "pdb", "port": 5678})
+    assert body["debugger"]["port"] == 5678

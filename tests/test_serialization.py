@@ -1,0 +1,61 @@
+"""Serialization round-trips including array-bearing pytrees (SURVEY §2.3
+serialization block; reference serving/http_server.py:1768-1891)."""
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu import serialization as ser
+from kubetorch_tpu.exceptions import SerializationError
+
+
+@pytest.mark.parametrize("fmt", [ser.JSON, ser.PICKLE, ser.MSGPACK])
+def test_roundtrip_scalars(fmt):
+    obj = {"a": 1, "b": [1.5, "x", None, True], "c": {"d": 2}}
+    out = ser.deserialize(ser.serialize(obj, fmt), fmt, allowed=[fmt])
+    assert out == obj
+
+
+@pytest.mark.parametrize("fmt", [ser.JSON, ser.MSGPACK])
+@pytest.mark.parametrize("dtype", ["float32", "int32", "float64", "bfloat16"])
+def test_roundtrip_arrays(fmt, dtype):
+    if dtype == "bfloat16":
+        import ml_dtypes
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4).astype(ml_dtypes.bfloat16)
+    else:
+        arr = np.arange(12, dtype=dtype).reshape(3, 4)
+    obj = {"w": arr, "nested": [arr, {"x": arr}]}
+    out = ser.deserialize(ser.serialize(obj, fmt), fmt)
+    np.testing.assert_array_equal(np.asarray(out["w"], dtype=np.float32),
+                                  np.asarray(arr, dtype=np.float32))
+    assert out["w"].dtype == arr.dtype
+    assert out["nested"][1]["x"].shape == (3, 4)
+
+
+def test_jax_array_roundtrip():
+    import jax.numpy as jnp
+    x = jnp.arange(8.0).reshape(2, 4)
+    out = ser.deserialize(ser.serialize({"x": x}, ser.JSON), ser.JSON)
+    np.testing.assert_array_equal(out["x"], np.asarray(x))
+
+
+def test_bytes_roundtrip_json():
+    obj = {"blob": b"\x00\x01binary"}
+    out = ser.deserialize(ser.serialize(obj, ser.JSON), ser.JSON)
+    assert out["blob"] == b"\x00\x01binary"
+
+
+def test_pickle_gated_by_allowlist():
+    data = ser.serialize({"x": 1}, ser.PICKLE)
+    with pytest.raises(SerializationError):
+        ser.deserialize(data, ser.PICKLE, allowed=ser.DEFAULT_ALLOWED)
+    assert ser.deserialize(data, ser.PICKLE, allowed=["pickle"]) == {"x": 1}
+
+
+def test_none_passthrough():
+    assert ser.deserialize(ser.serialize(b"raw", ser.NONE), ser.NONE) == b"raw"
+    assert ser.serialize(None, ser.NONE) == b""
+
+
+def test_unserializable_raises():
+    with pytest.raises(SerializationError):
+        ser.serialize({"f": lambda: 1}, ser.JSON)

@@ -1,0 +1,31 @@
+# kubetorch-tpu dev entry points.
+#
+# PALLAS_AXON_POOL_IPS= disables this image's TPU-relay hook for CPU-only
+# work (the hook dials the relay synchronously at interpreter startup of
+# every python process; see .claude/skills/verify/SKILL.md gotchas).
+
+PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: test test-fast bench smoke-tpu dryrun native clean
+
+test:
+	$(PY_CPU) python -m pytest tests/ -q
+
+test-fast:
+	$(PY_CPU) python -m pytest tests/ -q -x
+
+bench:
+	python bench.py
+
+dryrun:
+	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+smoke-tpu:
+	python scripts/tpu_smoke.py
+
+native:
+	$(MAKE) -C kubetorch_tpu/native
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

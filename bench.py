@@ -1,0 +1,116 @@
+"""Headline benchmark: Llama pretraining tokens/sec/chip.
+
+Runs a scaled Llama-3-architecture training step on whatever accelerator is
+present (the driver provides one real TPU chip) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numeric baselines (BASELINE.md — "published": {}),
+so ``vs_baseline`` reports achieved MFU divided by a 0.40 MFU target — i.e.
+1.0 means we hit 40% model-FLOPs utilization on the chip, the strong-baseline
+regime for this size class.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "v4": 275e12,
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,   # trillium
+    "v6e": 918e12,
+}
+MFU_TARGET = 0.40
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # ~0.5B-param Llama-3 architecture that fits one 16G-HBM chip with
+        # Adam state + remat. Sized via param_count below; batch tuned down
+        # on RESOURCE_EXHAUSTED.
+        cfg = LlamaConfig(vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
+                          n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
+                          attn_impl="flash", remat=True)
+        batch, seq, steps, warmup = 8, 2048, 10, 3
+    else:
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+        batch, seq, steps, warmup = 4, 64, 4, 1
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-4)
+    state = init_train_state(params, opt)
+    step_fn = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg), optimizer=opt)
+
+    def run(batch_size):
+        nonlocal state
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch_size, seq), 0, cfg.vocab_size)
+        b = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+        for _ in range(warmup):
+            state, m = step_fn(state, b)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step_fn(state, b)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+        return batch_size * seq * steps / dt
+
+    tokens_per_sec = None
+    while batch >= 1:
+        try:
+            tokens_per_sec = run(batch)
+            break
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" in str(e) and batch > 1:
+                batch //= 2
+                state = init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt)
+                continue
+            raise
+
+    n_chips = 1  # driver provides one chip; per-chip metric
+    tps_per_chip = tokens_per_sec / n_chips
+    model_flops = 6 * cfg.param_count() + 12 * cfg.n_layers * cfg.dim * seq
+    mfu = tps_per_chip * model_flops / peak_flops(dev) if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / MFU_TARGET, 4) if on_tpu else 0.0,
+        "detail": {
+            "params": cfg.param_count(),
+            "batch": batch,
+            "seq": seq,
+            "mfu": round(mfu, 4),
+            "device": getattr(dev, "device_kind", dev.platform),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,7 +75,9 @@ class KTConfig:
                     try:
                         setattr(cfg, f.name, int(raw))
                     except ValueError:
-                        pass
+                        import warnings
+                        warnings.warn(
+                            f"Ignoring non-integer {env_key}={raw!r}", stacklevel=2)
                 elif f.name not in ("extra",):
                     setattr(cfg, f.name, raw)
         if cfg.username is None:

@@ -1,0 +1,13 @@
+"""Model families: Llama (flagship), Mixtral-style MoE, ResNet, MLP.
+
+The reference ships no models (it is a dispatch fabric; models live in user
+code). This framework makes the headline workloads (BASELINE.md configs 1-5)
+first-class so `kt.fn(train).to(kt.Compute(tpu=...))` has batteries included,
+each designed mesh-first: params are plain pytrees annotated by
+``parallel.ShardingRules`` and every forward is jit/GSPMD-friendly (static
+shapes, scanned layers, no data-dependent Python control flow).
+"""
+
+from .llama import LlamaConfig, llama_init, llama_forward, llama_loss
+
+__all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss"]

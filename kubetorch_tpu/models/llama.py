@@ -1,0 +1,217 @@
+"""Llama-3-family decoder in functional JAX, designed for the MXU.
+
+TPU-first choices:
+- **Stacked layers + ``lax.scan``**: every layer's weights are one leaf with a
+  leading ``(L, ...)`` dim. Compile time is O(1) in depth and XLA pipelines
+  the scan body; per-layer Python loops would unroll L copies of HLO.
+- **bf16 everywhere on the matmul path** (MXU native), fp32 for norms/softmax
+  accumulation and the final logits cross-entropy.
+- **GQA** with explicit head-batched einsums — shapes stay static and large so
+  XLA tiles them onto the 128x128 systolic array.
+- **Rematerialization**: the scan body is wrapped in ``jax.checkpoint`` with a
+  dots-saveable policy, trading FLOPs for HBM (the usual bottleneck).
+- Attention dispatches to the Pallas flash kernel on TPU (``ops.attention``)
+  and a pure-XLA fallback elsewhere; context-parallel meshes use ring
+  attention (``parallel.ring_attention``) — both behind one flag.
+
+Benchmark target: BASELINE.md config 3 (Llama-3-8B pretraining).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: str = "auto"  # auto | xla | flash | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_1b(cls, **kw) -> "LlamaConfig":
+        d = dict(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        d = dict(vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 ffn_dim=128, max_seq_len=128)
+        d.update(kw)
+        return cls(**d)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6·params + attn)."""
+        p = self.param_count()
+        attn = 12 * self.n_layers * self.dim * self.max_seq_len  # rough, seq-dependent
+        return 6 * p + attn
+
+    def param_count(self) -> int:
+        d, f, L = self.dim, self.ffn_dim, self.n_layers
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        ffn = 3 * d * f
+        return self.vocab_size * d * 2 + L * (attn + ffn + 2 * d) + d
+
+
+def llama_init(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the param pytree. Layer weights are stacked on dim 0."""
+    d, L = cfg.dim, cfg.n_layers
+    hd, nh, nkv, f = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+    k = iter(jax.random.split(rng, 16))
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embed": init(next(k), (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": init(next(k), (L, d, nh * hd), d),
+            "wk": init(next(k), (L, d, nkv * hd), d),
+            "wv": init(next(k), (L, d, nkv * hd), d),
+            "wo": init(next(k), (L, nh * hd, d), nh * hd),
+            "ffn_norm": jnp.ones((L, d), jnp.float32),
+            "w_gate": init(next(k), (L, d, f), d),
+            "w_up": init(next(k), (L, d, f), d),
+            "w_down": init(next(k), (L, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": init(next(k), (d, cfg.vocab_size), d),
+    }
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * weight).astype(x.dtype)
+
+
+def rope_freqs(cfg: LlamaConfig, seq_len: int) -> jax.Array:
+    """(S, Hd/2) complex rotation table, fp32."""
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs) + 1j * jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
+    """x: (B, S, N, Hd). Rotate pairs in fp32, return in x.dtype."""
+    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, 2)
+    xc = lax.complex(xf[..., 0], xf[..., 1])
+    rotated = xc * freqs[None, :, None, :]
+    out = jnp.stack([jnp.real(rotated), jnp.imag(rotated)], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _xla_attention(q, k, v, scale: float) -> jax.Array:
+    """Reference attention: causal, fp32 softmax. q:(B,S,N,Hd) k,v:(B,S,NKV,Hd)."""
+    b, s, nh, hd = q.shape
+    nkv = k.shape[2]
+    group = nh // nkv
+    q = q.reshape(b, s, nkv, group, hd)
+    logits = jnp.einsum("bskgh,btkh->bkgst", q, k).astype(jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(causal[None, None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+    return out.reshape(b, s, nh, hd)
+
+
+def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
+    """Dispatch to the fastest attention for the current backend/mesh.
+
+    ``auto`` resolution: a live ``context`` mesh axis (installed via
+    ``parallel.mesh_context.use_mesh``) → ring attention; TPU backend → the
+    Pallas flash kernel; otherwise the XLA reference implementation.
+    """
+    from ..parallel.mesh_context import axis_size, current_mesh
+
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    impl = cfg.attn_impl
+    mesh = current_mesh()
+    if impl == "auto":
+        if axis_size(mesh, "context") > 1:
+            impl = "ring"
+        elif jax.default_backend() == "tpu":
+            impl = "flash"
+        else:
+            impl = "xla"
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention, ring_attention_sharded
+        if mesh is not None:
+            return ring_attention_sharded(q, k, v, mesh, causal=True, scale=scale)
+        # already inside a shard_map with a bound "context" axis
+        return ring_attention(q, k, v, axis_name="context", causal=True, scale=scale)
+    if impl == "flash":
+        from ..ops.attention import flash_attention
+        return flash_attention(q, k, v, causal=True, scale=scale)
+    return _xla_attention(q, k, v, scale)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, lw: Dict[str, jax.Array], freqs: jax.Array) -> jax.Array:
+    b, s, d = x.shape
+    h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
+    q = (h @ lw["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lw["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lw["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+    attn_out = attention(q, k, v, cfg).reshape(b, s, -1) @ lw["wo"]
+    x = x + attn_out
+    h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
+    return x + ffn
+
+
+def llama_forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) fp32."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs = rope_freqs(cfg, tokens.shape[1])
+
+    def body(carry, lw):
+        return _layer(cfg, carry, lw, freqs), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def llama_loss(params: Dict[str, Any], tokens: jax.Array, targets: jax.Array,
+               cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy, fp32 log-softmax, mean over all positions."""
+    logits = llama_forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def config_from_dict(d: Dict) -> LlamaConfig:
+    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
+    return LlamaConfig(**{k: v for k, v in d.items() if k in fields})

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism: the TPU-native feature the reference lacks.
+
+The reference is a *launcher* — TP/PP/SP/EP/CP are absent from its tree
+(SURVEY §2.4) because torch leaves model parallelism to user frameworks. On
+TPU, parallelism is a launcher-level concern: a device mesh + sharding rules
+compiled through jit/GSPMD. This package makes ``.distribute("jax",
+mesh={"data": N, "fsdp": M, "tensor": K, "context": C, "expert": E})``
+first-class.
+"""
+
+from .mesh import MeshSpec, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_CONTEXT, AXIS_EXPERT
+from .sharding import ShardingRules, LLAMA_RULES, named_sharding, shard_pytree
+
+__all__ = [
+    "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES",
+    "named_sharding", "shard_pytree",
+    "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR", "AXIS_CONTEXT", "AXIS_EXPERT",
+]

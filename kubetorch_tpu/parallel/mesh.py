@@ -1,0 +1,157 @@
+"""Device-mesh construction from a declarative spec.
+
+Design: the user (or ``Compute.distribute``) states logical axis sizes; we
+validate them against the device count, lay the axes out so the
+highest-traffic axis (tensor) maps to the innermost/fastest ICI dimension, and
+return a ``jax.sharding.Mesh``. Multi-slice TPU pods add a leading ``dcn``
+axis (data parallelism across slices rides DCN; everything else stays inside
+a slice on ICI) — the megascale recipe from the scaling book.
+
+Axis conventions (all optional, size-1 axes are dropped from PartitionSpecs
+automatically by GSPMD):
+
+- ``data``:    pure data parallelism (gradient psum only)
+- ``fsdp``:    data parallelism with parameter/optimizer sharding (ZeRO-3);
+               params all-gathered per layer, grads reduce-scattered
+- ``tensor``:  Megatron-style tensor parallelism within attention/FFN
+- ``context``: sequence/context parallelism (ring attention over ICI neighbors)
+- ``expert``:  expert parallelism for MoE (all-to-all token routing)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_DCN = "dcn"
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_CONTEXT = "context"
+AXIS_EXPERT = "expert"
+
+# Outer-to-inner order: dcn crosses slices (slowest fabric), tensor innermost
+# (most collective traffic per step → nearest-neighbor ICI links).
+CANONICAL_ORDER: Tuple[str, ...] = (
+    AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR,
+)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis name → size. ``-1`` on at most one axis means
+    "absorb all remaining devices" (like a reshape wildcard)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+    expert: int = 1
+    dcn: int = 1  # number of slices (multi-slice pods)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        unknown = set(d) - {a for a in CANONICAL_ORDER}
+        if unknown:
+            raise ValueError(f"Unknown mesh axes {sorted(unknown)}; valid: {CANONICAL_ORDER}")
+        return cls(**{k: int(v) for k, v in d.items()})
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in CANONICAL_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill a single ``-1`` wildcard and validate the product."""
+        sizes = self.axis_sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"Cannot absorb remainder: {n_devices} devices not divisible by {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh spec {sizes} wants {total} devices but {n_devices} are available")
+        return MeshSpec(**sizes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return CANONICAL_ORDER
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in CANONICAL_ORDER)
+
+
+@dataclass
+class DistributedConfig:
+    """The ``.distribute()`` payload that travels controller→pod as metadata.
+
+    Reference analog: ``Compute.distributed_config`` (``compute.py:1570-1604``)
+    which carried only {type, workers, procs}. Ours adds the mesh.
+    """
+
+    distribution_type: str = "jax"      # jax | pytorch | tensorflow | ray | spmd | local
+    workers: int = 1                    # pod replicas (hosts)
+    procs_per_worker: Optional[int] = None  # default: 1 per TPU host (megacore)
+    mesh: Optional[Dict[str, int]] = None
+    restart_procs: bool = False
+
+    def to_dict(self) -> Dict:
+        return {
+            "distribution_type": self.distribution_type,
+            "workers": self.workers,
+            "procs_per_worker": self.procs_per_worker,
+            "mesh": self.mesh,
+            "restart_procs": self.restart_procs,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DistributedConfig":
+        return cls(**{k: d.get(k) for k in (
+            "distribution_type", "workers", "procs_per_worker", "mesh", "restart_procs")
+            if d.get(k) is not None})
+
+
+def build_mesh(spec: MeshSpec | Dict[str, int] | None = None,
+               devices: Optional[Sequence] = None):
+    """Construct a ``jax.sharding.Mesh`` from a spec.
+
+    Devices are reshaped in canonical order so ``tensor`` varies fastest —
+    on a real slice JAX enumerates devices in torus order, putting tensor
+    neighbors one ICI hop apart. Uses ``jax.experimental.mesh_utils`` when the
+    topology is a real TPU slice for optimal physical layout, with a plain
+    reshape fallback (CPU meshes, odd shapes).
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec(data=len(devices))
+    if isinstance(spec, dict):
+        spec = MeshSpec.from_dict(spec)
+    spec = spec.resolve(len(devices))
+
+    shape = spec.shape
+    try:
+        if devices[0].platform == "tpu":
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        else:
+            raise ValueError  # fall through to reshape
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, spec.names)
+
+
+def best_mesh_for(n_devices: int, prefer: str = "fsdp") -> MeshSpec:
+    """A sensible default mesh when the user gives none: everything on one
+    axis (fsdp by default — params shard, no user model change needed)."""
+    return MeshSpec(**{prefer: n_devices})

@@ -1,0 +1,35 @@
+"""Ambient mesh for model code.
+
+Model forwards are pure functions; the mesh is launcher state. Rather than
+threading a Mesh through every model signature, the train-step builder (and
+anything else that jits over a mesh) installs it here, and mesh-aware ops
+(ring attention) pick it up at *trace* time — it is static w.r.t. jit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_state = threading.local()
+
+
+def current_mesh():
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def axis_size(mesh, name: str) -> int:
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.devices.shape[list(mesh.axis_names).index(name)]

@@ -1,0 +1,140 @@
+"""Ring attention: context-parallel causal attention over the ICI torus.
+
+The sequence axis is sharded over the ``context`` mesh axis. Each device holds
+a local q/k/v chunk; K/V chunks rotate around the ring via
+``jax.lax.ppermute`` (XLA lowers this to nearest-neighbor ICI transfers that
+overlap with the chunk attention compute), and each device merges incoming
+chunks into its local output with the online-softmax recurrence — attention
+over the full sequence without any device ever holding more than 1/C of it.
+
+The reference has no long-context support at all (SURVEY §5.7: no ring/
+Ulysses/context-parallel code in its tree) — sequence scaling was delegated
+to user frameworks. Here it is a mesh axis: ``.distribute("jax",
+mesh={"context": C})``.
+
+Two entry points:
+- :func:`ring_attention` — the per-shard function, for use inside an existing
+  ``shard_map`` (axis_name must be bound).
+- :func:`ring_attention_sharded` — GSPMD-compatible wrapper: takes globally
+  sharded arrays, applies ``shard_map`` over the context axis internally, so
+  model code under plain ``jit`` can call it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, scale, q_offset, kv_offset, causal):
+    """fp32 blockwise attention of a local q chunk vs one roving kv chunk.
+
+    Returns (m, l, unnormalized_acc) for online-softmax merging.
+    q: (B, Sq, N, Hd); k, v: (B, Sk, NKV, Hd); offsets are global positions.
+    """
+    b, sq, nh, hd = q.shape
+    sk, nkv = k.shape[1], k.shape[2]
+    group = nh // nkv
+    qg = q.reshape(b, sq, nkv, group, hd)
+    s = jnp.einsum("bskgh,btkh->bkgst", qg, k).astype(jnp.float32) * scale
+    if causal:
+        rows = q_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = kv_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((rows >= cols)[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                    # (b,k,g,s,1)
+    # guard fully-masked rows (future-only chunks): exp(NEG_INF - NEG_INF)=1
+    # would pollute l; clamp m so p underflows to 0 instead.
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)                    # (b,k,g,s,1)
+    acc = jnp.einsum("bkgst,btkh->bkgsh", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_safe, l, acc
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "context", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Per-shard ring attention. Shapes are LOCAL: (B, S/C, N, Hd).
+
+    Must run inside ``shard_map`` (or pmap) with ``axis_name`` bound.
+    """
+    b, sq, nh, hd = q.shape
+    nkv = k.shape[2]
+    group = nh // nkv
+    if scale is None:
+        scale = hd ** -0.5
+
+    ring = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    q_offset = my * sq
+
+    # perm: device d sends its current kv chunk to d+1 (ring shift).
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    m0 = jnp.full((b, nkv, group, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nkv, group, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, nkv, group, sq, hd), jnp.float32)
+
+    def body(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - step) % ring                 # origin device of k_cur
+        kv_offset = src * k_cur.shape[1]
+        m_c, l_c, acc_c = _chunk_attention(q, k_cur, v_cur, scale, q_offset,
+                                           kv_offset, causal)
+        m_new = jnp.maximum(m, m_c)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_c - m_new)
+        l_new = l * alpha + l_c * beta
+        acc_new = acc * alpha + acc_c * beta
+        # rotate kv for the next step (skipped result on the last step is
+        # harmless: scan's carry is simply unused afterwards)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = lax.scan(body, (m0, l0, acc0, k, v),
+                                    jnp.arange(ring))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).astype(q.dtype)              # (b, nkv, group, sq, hd)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, hd)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh, *,
+                           causal: bool = True, scale: Optional[float] = None,
+                           batch_axes=("dcn", "data", "fsdp"),
+                           context_axis: str = "context",
+                           head_axis: str = "tensor") -> jax.Array:
+    """GSPMD wrapper: q/k/v are (B, S, N, Hd) jit-level arrays sharded
+    batch×context×heads; runs the ring per context-shard via shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    live = {n_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape) if s_ > 1}
+    ba = tuple(a for a in batch_axes if a in live)
+    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    ha = head_axis if head_axis in live else None
+    spec = P(ba, context_axis if context_axis in live else None, ha, None)
+
+    if context_axis not in live:
+        # no context sharding: plain attention, let GSPMD handle the rest
+        from ..ops.attention import flash_attention
+        try:
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            from ..models.llama import _xla_attention
+            return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5)
+
+    fn = functools.partial(ring_attention, axis_name=context_axis,
+                           causal=causal, scale=scale)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map as _sm
+        shard_map = _sm
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,131 @@
+"""Sharding rules: logical param/activation names → PartitionSpecs.
+
+The GSPMD recipe (scaling book): annotate inputs/params with NamedSharding,
+let XLA insert the collectives. Rules are (regex, PartitionSpec-template)
+pairs matched against pytree paths, so one rule table covers a whole model
+family. Size-1 mesh axes are pruned automatically — the same table works for
+any mesh the user picks.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .mesh import AXIS_CONTEXT, AXIS_DATA, AXIS_DCN, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR
+
+
+@dataclass
+class ShardingRules:
+    """Ordered (path-regex → axis-name-tuple template) table."""
+
+    rules: List[Tuple[str, Tuple[Any, ...]]]
+
+    def spec_for(self, path: str, mesh) -> "Any":
+        """Resolve a pytree path to a PartitionSpec valid on ``mesh``.
+
+        Axes absent from the mesh or with size 1 are replaced by None; tuple
+        entries (multi-axis sharding like ``("data","fsdp")``) keep only live
+        axes.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        live = {name: size for name, size in zip(mesh.axis_names, mesh.devices.shape) if size > 1}
+
+        def prune(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, str):
+                return entry if entry in live else None
+            kept = tuple(a for a in entry if a in live)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+        for pattern, template in self.rules:
+            if re.search(pattern, path):
+                return P(*(prune(e) for e in template))
+        return P()  # replicated
+
+    def tree_specs(self, tree: Any, mesh) -> Any:
+        """PartitionSpec pytree matching ``tree``'s structure."""
+        import jax
+
+        def path_str(path) -> str:
+            parts = []
+            for p in path:
+                if hasattr(p, "key"):
+                    parts.append(str(p.key))
+                elif hasattr(p, "idx"):
+                    parts.append(str(p.idx))
+                elif hasattr(p, "name"):
+                    parts.append(str(p.name))
+            return "/".join(parts)
+
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: self.spec_for(path_str(path), mesh), tree)
+
+    def tree_shardings(self, tree: Any, mesh) -> Any:
+        import jax
+        from jax.sharding import NamedSharding
+
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), self.tree_specs(tree, mesh))
+
+
+def named_sharding(mesh, *axes):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_pytree(tree: Any, rules: ShardingRules, mesh) -> Any:
+    """Place a host pytree onto the mesh per the rules (initial sharding)."""
+    import jax
+
+    shardings = rules.tree_shardings(tree, mesh)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Rule tables
+# ---------------------------------------------------------------------------
+
+# Llama-family params (see models/llama.py param tree). Layer-stacked leaves
+# have a leading L (scan) dim that is never sharded. Layout follows the
+# scaling-book recipe: FSDP shards the d_model (reduction) dim, tensor shards
+# heads / ffn-hidden, so matmuls keep an unsharded contracting dim per device
+# and grads reduce-scatter over fsdp.
+BATCH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP)
+
+LLAMA_RULES = ShardingRules(rules=[
+    (r"embed$",        (AXIS_TENSOR, AXIS_FSDP)),            # (V, D)
+    (r"lm_head$",      (AXIS_FSDP, AXIS_TENSOR)),            # (D, V)
+    (r"w[qkv]$",       (None, AXIS_FSDP, AXIS_TENSOR)),      # (L, D, N*Hd)
+    (r"wo$",           (None, AXIS_TENSOR, AXIS_FSDP)),      # (L, N*Hd, D)
+    (r"w_(gate|up)$",  (None, AXIS_FSDP, AXIS_TENSOR)),      # (L, D, F)
+    (r"w_down$",       (None, AXIS_TENSOR, AXIS_FSDP)),      # (L, F, D)
+    (r"norm",          (None,)),                             # replicated norms
+])
+
+# MoE adds expert-stacked FFN weights: (L, E, D, F) — experts over the expert
+# axis, FFN dims as dense llama.
+MOE_RULES = ShardingRules(rules=[
+    (r"experts/w_(gate|up)$", (None, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
+    (r"experts/w_down$",      (None, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
+    (r"router",               (None,)),
+] + LLAMA_RULES.rules)
+
+# Activations: batch over (dcn, data, fsdp), sequence over context, vocab-dim
+# logits over tensor.
+ACT_RULES = ShardingRules(rules=[
+    (r"tokens|targets|mask", (BATCH_AXES, AXIS_CONTEXT)),
+    (r"logits",              (BATCH_AXES, AXIS_CONTEXT, AXIS_TENSOR)),
+])
+
+
+def batch_sharding(mesh):
+    """Sharding for a (B, S) token batch: batch over data-like axes, sequence
+    over the context axis. Delegates to ACT_RULES so the pruning logic lives
+    in exactly one place."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, ACT_RULES.spec_for("tokens", mesh))

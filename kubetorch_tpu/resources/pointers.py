@@ -59,7 +59,12 @@ def extract_pointers(obj: Any) -> Pointers:
     if not (inspect.isfunction(obj) or inspect.isclass(obj)):
         raise TypeError(f"Expected a function or class, got {type(obj).__name__}")
 
-    name = obj.__qualname__.split(".")[0] if inspect.isclass(obj) else obj.__name__
+    qualname = obj.__qualname__
+    if "." in qualname:
+        raise ValueError(
+            f"{qualname!r} is a nested class/function — only module-top-level "
+            "callables can be addressed remotely (the pod imports them by name)")
+    name = obj.__name__
     try:
         src_file = inspect.getfile(obj)
     except TypeError:

@@ -91,11 +91,21 @@ def _jsonify(obj: Any) -> Any:
                     f"JSON serialization requires string dict keys; got {type(k).__name__} "
                     f"key {k!r}. Use serialization='msgpack' or 'pickle'."
                 )
-        return {k: _jsonify(v) for k, v in obj.items()}
+        return {_escape_key(k): _jsonify(v) for k, v in obj.items()}
     raise SerializationError(
         f"Object of type {type(obj).__name__} is not json-serializable; "
         f"use serialization='pickle' (must be allowlisted server-side)."
     )
+
+
+def _escape_key(k: str) -> str:
+    """User keys that could collide with our typed-leaf sentinels get a '~'
+    prefix (stacked if already present), reversed on decode."""
+    return "~" + k if k.lstrip("~").startswith("__kt_") else k
+
+
+def _unescape_key(k: str) -> str:
+    return k[1:] if k.startswith("~") and k.lstrip("~").startswith("__kt_") else k
 
 
 def _dejsonify(obj: Any) -> Any:
@@ -104,7 +114,7 @@ def _dejsonify(obj: Any) -> Any:
             return _decode_array(obj[_ARRAY_KEY])
         if _BYTES_KEY in obj and len(obj) == 1:
             return base64.b64decode(obj[_BYTES_KEY])
-        return {k: _dejsonify(v) for k, v in obj.items()}
+        return {_unescape_key(k): _dejsonify(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_dejsonify(x) for x in obj]
     return obj
@@ -163,9 +173,21 @@ def _msgpack_default(obj: Any) -> Any:
         import numpy as np
         arr = np.asarray(obj)
         return {"__arr__": True, "d": str(arr.dtype), "s": list(arr.shape), "b": arr.tobytes()}
-    if isinstance(obj, tuple):
-        return list(obj)
     raise SerializationError(f"msgpack cannot encode {type(obj).__name__}")
+
+
+def _msgpack_escape(obj: Any) -> Any:
+    """Escape user dicts whose '__arr__' key would trip the decode hook."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(k, str) and k.lstrip("~") == "__arr__":
+                k = "~" + k
+            out[k] = _msgpack_escape(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_msgpack_escape(v) for v in obj]
+    return obj
 
 
 def _msgpack_hook(obj: dict) -> Any:
@@ -176,12 +198,14 @@ def _msgpack_hook(obj: dict) -> Any:
             import ml_dtypes
             dtype = ml_dtypes.bfloat16
         return np.frombuffer(obj["b"], dtype=dtype).reshape(obj["s"]).copy()
-    return obj
+    return {(k[1:] if isinstance(k, str) and k.startswith("~") and
+             k.lstrip("~") == "__arr__" else k): v for k, v in obj.items()}
 
 
 def _msgpack_dumps(obj: Any) -> bytes:
     import msgpack
-    return msgpack.packb(obj, default=_msgpack_default, use_bin_type=True)
+    return msgpack.packb(_msgpack_escape(obj), default=_msgpack_default,
+                         use_bin_type=True)
 
 
 def _msgpack_loads(data: bytes) -> Any:

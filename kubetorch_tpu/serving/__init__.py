@@ -1,0 +1,7 @@
+"""Pod runtime: HTTP server, execution supervisors, process pool, observability.
+
+The in-pod half of the fabric (reference layer L2, SURVEY §1): an aiohttp
+server that loads the user's callable from synced code, executes it in rank
+subprocesses via a supervisor hierarchy, fans out to peer pods for SPMD, and
+streams logs/metrics/exceptions back.
+"""

@@ -1,0 +1,108 @@
+"""Persistent WebSocket from pod to controller.
+
+Reference (``serving/http_server.py:206-501``): on startup the pod dials
+``/controller/ws/pods``, registers {pod_name, pod_ip, namespace,
+service_name}, receives workload metadata (applied as env), and thereafter
+handles push messages — ``reload`` (hot code swap, ack'd with
+``reload_ack``) and ``waiting`` (BYO pods registered before a workload
+exists). Auto-reconnects with exponential backoff.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import Optional
+
+import aiohttp
+
+from .discovery import my_pod_ip
+from .env_contract import KT_SERVICE_NAME, apply_metadata
+
+RECONNECT_BASE_S = 0.5
+RECONNECT_MAX_S = 30.0
+
+
+class ControllerWebSocket:
+    def __init__(self, url: str, state):
+        self.url = url
+        self.state = state
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._stopping = False
+        self.metadata_received = asyncio.Event()
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._session:
+            await self._session.close()
+
+    async def wait_for_metadata(self, timeout: float = 60.0) -> bool:
+        try:
+            await asyncio.wait_for(self.metadata_received.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def _run(self) -> None:
+        delay = RECONNECT_BASE_S
+        while not self._stopping:
+            try:
+                async with self._session.ws_connect(self.url, heartbeat=20) as ws:
+                    delay = RECONNECT_BASE_S
+                    await ws.send_json({
+                        "action": "register",
+                        "pod_name": self.state.pod_name,
+                        "pod_ip": my_pod_ip(),
+                        "namespace": self.state.namespace,
+                        "service_name": __import__("os").environ.get(KT_SERVICE_NAME, ""),
+                        "launch_id": self.state.launch_id,
+                    })
+                    async for msg in ws:
+                        if msg.type != aiohttp.WSMsgType.TEXT:
+                            break
+                        await self._handle(ws, json.loads(msg.data))
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            if self._stopping:
+                return
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, RECONNECT_MAX_S)
+
+    async def _handle(self, ws, msg: dict) -> None:
+        action = msg.get("action")
+        if action == "metadata":
+            apply_metadata(msg.get("metadata", {}))
+            if msg.get("launch_id"):
+                self.state.launch_id = msg["launch_id"]
+                __import__("os").environ["KT_LAUNCH_ID"] = msg["launch_id"]
+            self.metadata_received.set()
+            await ws.send_json({"action": "metadata_ack",
+                                "pod_name": self.state.pod_name})
+        elif action == "reload":
+            launch_id = msg.get("launch_id", uuid.uuid4().hex)
+            try:
+                await self.state.reload(msg.get("metadata", {}), launch_id)
+                await ws.send_json({"action": "reload_ack", "ok": True,
+                                    "launch_id": launch_id,
+                                    "pod_name": self.state.pod_name})
+            except BaseException as e:  # noqa: BLE001
+                await ws.send_json({"action": "reload_ack", "ok": False,
+                                    "error": str(e), "launch_id": launch_id,
+                                    "pod_name": self.state.pod_name})
+        elif action == "waiting":
+            # BYO pod: registered before any workload is deployed to it
+            self.metadata_received.set()

@@ -1,0 +1,57 @@
+"""Worker membership discovery.
+
+Reference (``serving/distributed_supervisor.py:90-174``): pod IPs come from
+the headless-service DNS record ``{svc}-headless.{ns}.svc.cluster.local``,
+with quorum wait (exponential backoff 100ms→2s) and a ``LOCAL_IPS`` env fake
+for running outside Kubernetes — the single hook that makes all distributed
+logic unit-testable with local processes (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Callable, List, Optional
+
+
+def discover_ips(service_name: str, namespace: str = "default") -> List[str]:
+    """Current worker IPs, sorted for stable rank assignment."""
+    fake = os.environ.get("LOCAL_IPS")
+    if fake:
+        return sorted(ip.strip() for ip in fake.split(",") if ip.strip())
+    host = f"{service_name}-headless.{namespace}.svc.cluster.local"
+    try:
+        infos = socket.getaddrinfo(host, None, family=socket.AF_INET,
+                                   type=socket.SOCK_STREAM)
+        return sorted({info[4][0] for info in infos})
+    except socket.gaierror:
+        return []
+
+
+def wait_for_quorum(service_name: str, namespace: str, expected: int,
+                    timeout: float = 300.0,
+                    discover: Optional[Callable[[], List[str]]] = None) -> List[str]:
+    """Block until ``expected`` workers are resolvable (backoff 100ms→2s)."""
+    discover = discover or (lambda: discover_ips(service_name, namespace))
+    deadline = time.monotonic() + timeout
+    delay = 0.1
+    ips = discover()
+    while len(ips) < expected:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"Quorum timeout: {len(ips)}/{expected} workers for "
+                f"{service_name!r} after {timeout}s (have: {ips})")
+        time.sleep(delay)
+        delay = min(delay * 2, 2.0)
+        ips = discover()
+    return ips
+
+
+def my_pod_ip() -> str:
+    if os.environ.get("POD_IP"):
+        return os.environ["POD_IP"]
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except socket.gaierror:
+        return "127.0.0.1"

@@ -1,0 +1,157 @@
+"""Supervisor hierarchy: owns rank subprocesses and routes calls.
+
+Reference (``serving/execution_supervisor.py`` + ``distributed_supervisor.py``):
+the base supervisor owns a ProcessPool and routes to subprocess 0; the
+distributed supervisor adds membership discovery, a monitor thread diffing
+pod-IP sets every few seconds, and ``WorkerMembershipChanged`` propagation
+into in-flight calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..exceptions import WorkerMembershipChanged
+from ..parallel.mesh import DistributedConfig
+from ..resources.pointers import Pointers
+from .discovery import discover_ips, my_pod_ip, wait_for_quorum
+from .env_contract import framework_for
+from .process_pool import ProcessPool
+
+MEMBERSHIP_POLL_S = 3.0
+
+
+class ExecutionSupervisor:
+    """Single-pod execution: one ProcessPool, calls go to rank 0."""
+
+    def __init__(self, pointers: Optional[Pointers], init_args: Optional[Dict],
+                 config: Optional[DistributedConfig] = None,
+                 service_name: str = "", namespace: str = "default"):
+        self.pointers = pointers
+        self.init_args = init_args
+        self.config = config or DistributedConfig(distribution_type="local")
+        self.service_name = service_name
+        self.namespace = namespace
+        self.pool: Optional[ProcessPool] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def num_procs(self) -> int:
+        if self.config.procs_per_worker:
+            return self.config.procs_per_worker
+        return framework_for(self.config.distribution_type).auto_nproc()
+
+    def setup(self) -> None:
+        self.pool = ProcessPool(
+            num_procs=self.num_procs(),
+            framework_name=self.config.distribution_type,
+            pointers=self.pointers, init_args=self.init_args,
+            node_rank=0, num_nodes=1, pod_ips=[my_pod_ip()],
+            base_env=self._base_env(),
+        )
+        self.pool.start()
+
+    def _base_env(self) -> Dict[str, str]:
+        env = {}
+        if self.config.mesh:
+            import json
+            env["KT_MESH"] = json.dumps(self.config.mesh)
+        return env
+
+    def cleanup(self) -> None:
+        if self.pool is not None:
+            self.pool.shutdown()
+            self.pool = None
+
+    @property
+    def healthy(self) -> bool:
+        return self.pool is not None and self.pool.healthy
+
+    # -- calls ---------------------------------------------------------------
+
+    async def call(self, method: Optional[str], args: list, kwargs: dict,
+                   timeout: Optional[float] = None, **_ignored) -> Any:
+        assert self.pool is not None, "supervisor not set up"
+        return await self.pool.call(0, method, args, kwargs, timeout)
+
+
+class DistributedSupervisor(ExecutionSupervisor):
+    """Adds worker membership: discovery, quorum, monitor, change events."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._known_ips: List[str] = []
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_monitor = threading.Event()
+        self._membership_events: List[WorkerMembershipChanged] = []
+        self._events_lock = threading.Lock()
+
+    def discover(self) -> List[str]:
+        return discover_ips(self.service_name, self.namespace)
+
+    def setup(self) -> None:
+        expected = max(self.config.workers, 1)
+        ips = wait_for_quorum(self.service_name, self.namespace, expected,
+                              discover=self.discover)
+        self._known_ips = ips
+        my_ip = my_pod_ip()
+        node_rank = ips.index(my_ip) if my_ip in ips else 0
+        self.pool = ProcessPool(
+            num_procs=self.num_procs(),
+            framework_name=self.config.distribution_type,
+            pointers=self.pointers, init_args=self.init_args,
+            node_rank=node_rank, num_nodes=len(ips), pod_ips=ips,
+            base_env=self._base_env(),
+        )
+        self.pool.start()
+        self._start_monitor()
+
+    def cleanup(self) -> None:
+        self._stop_monitor.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2)
+            self._monitor = None
+        super().cleanup()
+
+    # -- membership monitoring (reference :236-339) ---------------------------
+
+    def _start_monitor(self) -> None:
+        self._stop_monitor.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_monitor.wait(MEMBERSHIP_POLL_S):
+            current = self.discover()
+            if not current:
+                continue
+            previous = self._known_ips
+            if set(current) != set(previous):
+                event = WorkerMembershipChanged(
+                    added=sorted(set(current) - set(previous)),
+                    removed=sorted(set(previous) - set(current)),
+                    previous=previous, current=current,
+                )
+                self._known_ips = current
+                with self._events_lock:
+                    self._membership_events.append(event)
+                if self.pool is not None and event.is_critical:
+                    # fast-fail in-flight local work; the coordinator
+                    # propagates the typed error to the client for resize
+                    self.pool.cancel_pending(event)
+
+    def pop_membership_event(self) -> Optional[WorkerMembershipChanged]:
+        with self._events_lock:
+            return self._membership_events.pop(0) if self._membership_events else None
+
+    def check_membership(self) -> None:
+        event = self.pop_membership_event()
+        if event is not None and event.is_critical:
+            raise event
+
+    def pod_ips(self) -> List[str]:
+        return list(self._known_ips)

@@ -1,0 +1,431 @@
+"""The in-pod HTTP server (aiohttp).
+
+Re-design of the reference pod runtime (``serving/http_server.py``, 1971 LoC,
+FastAPI/uvicorn — neither exists in this image, and aiohttp's single-loop
+model suits the fan-out design anyway). Feature parity map:
+
+- pod identity from env/hostname (reference :146-204)
+- metadata application → env contract (reference :254)
+- callable/supervisor loading, config-hash keyed, lock-guarded (:878-1134)
+- ``TerminationCheckMiddleware`` racing requests vs SIGTERM, with typed
+  ``PodTerminatedError`` carrying OOMKilled/Evicted/**TPU-preemption** reasons
+  (:1184-1235 + serving/utils.py:111-191)
+- ``X-Request-ID`` propagation (:1237-1249)
+- routes: /health, /ready?launch_id, /metrics, /app/status,
+  POST /{fn}[/{method}] (:1645-1946)
+- serialization negotiation via ``X-Serialization`` with server-side
+  allowlist (:1768-1891)
+- exception packaging (:1478-1530)
+- hot reload: re-apply metadata → re-sync code → recreate supervisor → new
+  launch_id, no process restart (:352-410)
+
+Run: ``python -m kubetorch_tpu.serving.http_server --port 32300``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import hashlib
+import json
+import os
+import signal
+import socket
+import sys
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from .. import serialization as ser
+from ..exceptions import (KubetorchError, PodTerminatedError, SerializationError,
+                          package_exception)
+from ..parallel.mesh import DistributedConfig
+from ..resources.pointers import Pointers
+from .env_contract import (KT_ALLOWED_SERIALIZATION, KT_CALLABLE_TYPE,
+                           KT_CLS_OR_FN_NAME, KT_DISTRIBUTED_CONFIG,
+                           KT_FILE_PATH, KT_INIT_ARGS, KT_LAUNCH_ID,
+                           KT_MODULE_NAME, KT_NAMESPACE, KT_PROJECT_ROOT,
+                           KT_SERVICE_NAME, apply_metadata)
+from .supervisor_factory import supervisor_for
+
+DEFAULT_PORT = 32300
+request_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "kt_request_id", default="")
+
+RESERVED_ROUTES = {"health", "ready", "metrics", "app", "_kt"}
+
+
+class ServerState:
+    """All mutable pod-runtime state, attachable to a fresh app per test."""
+
+    def __init__(self):
+        self.pod_name = os.environ.get("POD_NAME", socket.gethostname())
+        self.namespace = os.environ.get(KT_NAMESPACE, "default")
+        self.launch_id: Optional[str] = os.environ.get(KT_LAUNCH_ID)
+        self.termination = asyncio.Event()
+        self.termination_reason: Optional[str] = None
+        self.supervisor = None
+        self._supervisor_key: Optional[str] = None
+        self._load_lock = asyncio.Lock()
+        self.started_at = time.time()
+        self.request_count = 0
+        self.last_activity = time.time()
+        self.log_capture = None
+        self.metrics_pusher = None
+        self.controller_ws = None
+        self.app_process = None
+
+    # -- metadata / supervisor ------------------------------------------------
+
+    def allowed_serialization(self):
+        raw = os.environ.get(KT_ALLOWED_SERIALIZATION)
+        if raw:
+            return [s.strip() for s in raw.split(",") if s.strip()]
+        return list(ser.DEFAULT_ALLOWED)
+
+    def pointers(self) -> Optional[Pointers]:
+        if not os.environ.get(KT_CLS_OR_FN_NAME):
+            return None
+        return Pointers(
+            project_root=os.environ.get(KT_PROJECT_ROOT, os.getcwd()),
+            module_name=os.environ.get(KT_MODULE_NAME, ""),
+            file_path=os.environ.get(KT_FILE_PATH, ""),
+            cls_or_fn_name=os.environ[KT_CLS_OR_FN_NAME],
+        )
+
+    def distributed_config(self) -> Optional[DistributedConfig]:
+        raw = os.environ.get(KT_DISTRIBUTED_CONFIG)
+        if not raw:
+            return None
+        return DistributedConfig.from_dict(json.loads(raw))
+
+    def init_args(self) -> Optional[Dict]:
+        raw = os.environ.get(KT_INIT_ARGS)
+        return json.loads(raw) if raw else None
+
+    def _config_key(self) -> str:
+        blob = json.dumps({
+            "ptr": os.environ.get(KT_CLS_OR_FN_NAME),
+            "mod": os.environ.get(KT_MODULE_NAME),
+            "dist": os.environ.get(KT_DISTRIBUTED_CONFIG),
+            "init": os.environ.get(KT_INIT_ARGS),
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    async def get_supervisor(self):
+        """Config-hash-keyed supervisor (reference load_supervisor :971)."""
+        key = self._config_key()
+        if self.supervisor is not None and key == self._supervisor_key:
+            return self.supervisor
+        async with self._load_lock:
+            if self.supervisor is not None and key == self._supervisor_key:
+                return self.supervisor
+            if self.supervisor is not None:
+                await asyncio.to_thread(self.supervisor.cleanup)
+            pointers = self.pointers()
+            if pointers is None:
+                raise KubetorchError(
+                    "No callable configured on this pod (missing metadata)")
+            sup = supervisor_for(
+                self.distributed_config(), pointers, self.init_args(),
+                service_name=os.environ.get(KT_SERVICE_NAME, ""),
+                namespace=self.namespace,
+                server_port=int(os.environ.get("KT_SERVER_PORT", DEFAULT_PORT)),
+                fn_name=pointers.cls_or_fn_name,
+            )
+            await asyncio.to_thread(sup.setup)
+            self.supervisor = sup
+            self._supervisor_key = key
+            return sup
+
+    async def reload(self, metadata: Dict[str, Any], launch_id: str) -> None:
+        """Hot reload (reference _handle_reload :352): metadata → code sync →
+        supervisor recreation → only then flip the launch_id."""
+        apply_metadata(metadata)
+        await self._sync_code()
+        async with self._load_lock:
+            if self.supervisor is not None:
+                await asyncio.to_thread(self.supervisor.cleanup)
+                self.supervisor = None
+                self._supervisor_key = None
+        # purge the user's modules so the fresh code is imported
+        root = os.environ.get(KT_PROJECT_ROOT)
+        if root:
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and f.startswith(root) and "site-packages" not in f:
+                    sys.modules.pop(name, None)
+        self.launch_id = launch_id
+        os.environ[KT_LAUNCH_ID] = launch_id
+
+    async def _sync_code(self) -> None:
+        """Pull latest code from the data store (reference rsync pull :1140)."""
+        store_url = os.environ.get("KT_DATA_STORE_URL")
+        service = os.environ.get(KT_SERVICE_NAME)
+        root = os.environ.get(KT_PROJECT_ROOT)
+        if not (store_url and service and root):
+            return
+        from ..data_store.sync import pull_tree
+        await asyncio.to_thread(pull_tree, store_url,
+                                f"__code__/{service}", root)
+
+    def terminate(self, reason: str) -> None:
+        self.termination_reason = reason
+        self.termination.set()
+
+
+# ---------------------------------------------------------------------------
+# Middleware
+# ---------------------------------------------------------------------------
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    rid = request.headers.get("X-Request-ID") or uuid.uuid4().hex[:16]
+    request_id_var.set(rid)
+    request["kt_request_id"] = rid
+    resp = await handler(request)
+    resp.headers["X-Request-ID"] = rid
+    return resp
+
+
+@web.middleware
+async def termination_middleware(request: web.Request, handler):
+    """Race the handler against pod termination (reference :1184-1235)."""
+    state: ServerState = request.app["state"]
+    if state.termination.is_set():
+        return _error_response(PodTerminatedError(
+            "Pod is terminating", reason=state.termination_reason,
+            pod_name=state.pod_name), status=503)
+    handler_task = asyncio.ensure_future(handler(request))
+    term_task = asyncio.ensure_future(state.termination.wait())
+    try:
+        done, _ = await asyncio.wait({handler_task, term_task},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if handler_task in done:
+            return handler_task.result()
+        handler_task.cancel()
+        return _error_response(PodTerminatedError(
+            "Pod was terminated while handling the request",
+            reason=state.termination_reason, pod_name=state.pod_name),
+            status=503)
+    finally:
+        term_task.cancel()
+
+
+def _error_response(exc: BaseException, status: int = 500) -> web.Response:
+    return web.json_response(package_exception(exc), status=status)
+
+
+# ---------------------------------------------------------------------------
+# Routes
+# ---------------------------------------------------------------------------
+
+
+async def health(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    sup = state.supervisor
+    return web.json_response({
+        "status": "ok",
+        "pod": state.pod_name,
+        "launch_id": state.launch_id,
+        "uptime_s": round(time.time() - state.started_at, 1),
+        "supervisor_healthy": bool(sup and sup.healthy),
+    })
+
+
+async def ready(request: web.Request) -> web.Response:
+    """Reload-completion barrier (reference :1670): ready only when the pod's
+    launch_id matches the client's freshly deployed one."""
+    state: ServerState = request.app["state"]
+    want = request.query.get("launch_id")
+    if want and want != state.launch_id:
+        return web.json_response(
+            {"ready": False, "launch_id": state.launch_id, "expected": want},
+            status=409)
+    return web.json_response({"ready": True, "launch_id": state.launch_id})
+
+async def metrics(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        from prometheus_client import generate_latest, REGISTRY
+        body = generate_latest(REGISTRY)
+    except Exception:
+        body = b""
+    extra = (
+        f"kubetorch_last_activity_timestamp {state.last_activity}\n"
+        f"kt_http_requests_total {state.request_count}\n"
+    ).encode()
+    return web.Response(body=body + extra, content_type="text/plain")
+
+
+async def app_status(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    proc = state.app_process
+    if proc is None:
+        return web.json_response({"running": False}, status=404)
+    running = proc.returncode is None
+    return web.json_response({"running": running, "returncode": proc.returncode})
+
+
+async def reload_route(request: web.Request) -> web.Response:
+    """HTTP reload path (controller WS push calls state.reload directly)."""
+    state: ServerState = request.app["state"]
+    try:
+        body = json.loads(await request.read())
+        await state.reload(body.get("metadata", {}),
+                           body.get("launch_id", uuid.uuid4().hex))
+        return web.json_response({"ok": True, "launch_id": state.launch_id})
+    except BaseException as e:  # noqa: BLE001
+        return _error_response(e)
+
+
+async def run_callable(request: web.Request) -> web.Response:
+    """POST /{fn}[/{method}] → supervisor (reference run_callable :1720)."""
+    state: ServerState = request.app["state"]
+    state.request_count += 1
+    state.last_activity = time.time()
+
+    fn_name = request.match_info["fn_name"]
+    method = request.match_info.get("method") or None
+    fmt = request.headers.get("X-Serialization", ser.JSON)
+    try:
+        raw = await request.read()
+        try:
+            body = ser.deserialize(raw, fmt, allowed=state.allowed_serialization()) or {}
+        except SerializationError as e:
+            return _error_response(e, status=415)
+
+        sup = await state.get_supervisor()
+        expected = sup.pointers.cls_or_fn_name if sup.pointers else None
+        if expected and fn_name != expected:
+            return _error_response(
+                KubetorchError(f"This service hosts {expected!r}, not {fn_name!r}"),
+                status=404)
+
+        args = body.get("args", [])
+        kwargs = body.get("kwargs", {})
+        is_subcall = request.query.get("distributed_subcall") == "true"
+        call_kwargs: Dict[str, Any] = {}
+        if is_subcall:
+            call_kwargs["subtree"] = body.get("_kt_subtree") or []
+        elif "_kt_workers" in body:
+            call_kwargs["workers"] = body.pop("_kt_workers")
+        if hasattr(sup, "server_port"):
+            call_kwargs.setdefault(
+                "headers", {"X-Request-ID": request["kt_request_id"],
+                            "X-Serialization": ser.JSON})
+
+        if body.get("debugger"):
+            from .pdb_ws import arm_debugger
+            arm_debugger(body["debugger"])
+
+        result = await sup.call(method, args, kwargs, **call_kwargs)
+        return web.Response(body=ser.serialize(result, fmt),
+                            headers={"X-Serialization": fmt},
+                            content_type="application/octet-stream"
+                            if fmt != ser.JSON else "application/json")
+    except PodTerminatedError as e:
+        return _error_response(e, status=503)
+    except BaseException as e:  # noqa: BLE001
+        return _error_response(e)
+
+
+# ---------------------------------------------------------------------------
+# App assembly / lifespan
+# ---------------------------------------------------------------------------
+
+
+def create_app(state: Optional[ServerState] = None) -> web.Application:
+    app = web.Application(middlewares=[request_id_middleware,
+                                       termination_middleware],
+                          client_max_size=1024 ** 3)
+    app["state"] = state or ServerState()
+    app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/app/status", app_status)
+    app.router.add_post("/_kt/reload", reload_route)
+    app.router.add_post("/{fn_name}", run_callable)
+    app.router.add_post("/{fn_name}/{method}", run_callable)
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+async def _on_startup(app: web.Application) -> None:
+    state: ServerState = app["state"]
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(
+                sig, lambda s=sig: state.terminate(_termination_reason()))
+        except (NotImplementedError, RuntimeError):
+            pass
+
+    # observability
+    from .log_capture import LogCapture
+    from .metrics_push import MetricsPusher
+    if os.environ.get("KT_LOG_SINK_URL"):
+        state.log_capture = LogCapture.start_global(
+            sink_url=os.environ["KT_LOG_SINK_URL"],
+            labels={"service": os.environ.get(KT_SERVICE_NAME, ""),
+                    "pod": state.pod_name, "namespace": state.namespace})
+    if os.environ.get("KT_METRICS_GATEWAY_URL"):
+        state.metrics_pusher = MetricsPusher(
+            gateway_url=os.environ["KT_METRICS_GATEWAY_URL"], state=state)
+        state.metrics_pusher.start()
+
+    # controller WebSocket (metadata + reload push)
+    ws_url = os.environ.get("KT_CONTROLLER_WS_URL")
+    if ws_url:
+        from .controller_ws import ControllerWebSocket
+        state.controller_ws = ControllerWebSocket(ws_url, state)
+        await state.controller_ws.start()
+
+
+def _termination_reason() -> str:
+    """Classify why we are being killed (reference serving/utils.py:111-191).
+
+    On GKE TPU slices, maintenance/preemption arrives as SIGTERM with a node
+    taint; we surface it as ``Preempted`` so clients can programmatically
+    resize/retry rather than treating it as a crash.
+    """
+    if os.environ.get("KT_PREEMPTIBLE") or os.path.exists(
+            "/var/run/kubetorch/preemption"):
+        return "Preempted"
+    return os.environ.get("KT_TERMINATION_REASON", "Terminated")
+
+
+async def _on_cleanup(app: web.Application) -> None:
+    state: ServerState = app["state"]
+    if state.controller_ws is not None:
+        await state.controller_ws.stop()
+    if state.supervisor is not None:
+        await asyncio.to_thread(state.supervisor.cleanup)
+    if state.metrics_pusher is not None:
+        state.metrics_pusher.stop()
+    if state.log_capture is not None:
+        state.log_capture.stop()
+    from .remote_worker_pool import RemoteWorkerPool
+    if RemoteWorkerPool._instance is not None:
+        await RemoteWorkerPool._instance.close()
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="kubetorch-tpu pod server")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("KT_SERVER_PORT", DEFAULT_PORT)))
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    web.run_app(create_app(), host=args.host, port=args.port,
+                handle_signals=False, print=lambda *_: None)
+
+
+if __name__ == "__main__":
+    main()

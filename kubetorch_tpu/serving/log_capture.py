@@ -1,0 +1,138 @@
+"""Log capture: intercept stdout/stderr/logging, batch, push to a sink.
+
+Reference (``serving/log_capture.py``): replaces sys.stdout/stderr with
+interceptors, batches 100 entries / 1s, pushes to Loki with labels
+{service, pod, namespace, level, request_id}, dual-writes to the original
+streams so ``kubectl logs`` still works.
+
+The sink here is pluggable: a Loki push endpoint when the charts deploy Loki,
+or the controller's ``/controller/logs`` ingestion route (our controller
+stores a ring buffer per service for `kt logs` without Loki).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+BATCH_SIZE = 100
+FLUSH_INTERVAL_S = 1.0
+
+
+class _StreamInterceptor:
+    def __init__(self, original, capture: "LogCapture", source: str):
+        self.original = original
+        self.capture = capture
+        self.source = source
+
+    def write(self, data: str):
+        self.original.write(data)
+        if data.strip():
+            self.capture.add(data.rstrip("\n"), source=self.source)
+        return len(data)
+
+    def flush(self):
+        self.original.flush()
+
+    def isatty(self):
+        return False
+
+    def fileno(self):
+        return self.original.fileno()
+
+
+class _LogHandler(logging.Handler):
+    def __init__(self, capture: "LogCapture"):
+        super().__init__()
+        self.capture = capture
+
+    def emit(self, record: logging.LogRecord):
+        try:
+            self.capture.add(self.format(record), source="logger",
+                             level=record.levelname)
+        except Exception:
+            pass
+
+
+class LogCapture:
+    _global: Optional["LogCapture"] = None
+
+    def __init__(self, sink_url: str, labels: Dict[str, str]):
+        self.sink_url = sink_url
+        self.labels = labels
+        self._buffer: List[Dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._originals = None
+        self._handler: Optional[_LogHandler] = None
+
+    @classmethod
+    def start_global(cls, sink_url: str, labels: Dict[str, str]) -> "LogCapture":
+        if cls._global is not None:
+            return cls._global
+        cap = cls(sink_url, labels)
+        cap.start()
+        cls._global = cap
+        return cap
+
+    def start(self) -> None:
+        self._originals = (sys.stdout, sys.stderr)
+        sys.stdout = _StreamInterceptor(sys.stdout, self, "stdout")
+        sys.stderr = _StreamInterceptor(sys.stderr, self, "stderr")
+        self._handler = _LogHandler(self)
+        logging.getLogger().addHandler(self._handler)
+        self._thread = threading.Thread(target=self._flush_loop, daemon=True)
+        self._thread.start()
+        atexit.register(self.stop)
+
+    def add(self, line: str, source: str = "stdout", level: str = "INFO") -> None:
+        from .http_server import request_id_var
+
+        entry = {
+            "ts": time.time(),
+            "line": line,
+            "source": source,
+            "level": level,
+            "request_id": request_id_var.get(""),
+            **self.labels,
+        }
+        flush_now = False
+        with self._lock:
+            self._buffer.append(entry)
+            flush_now = len(self._buffer) >= BATCH_SIZE
+        if flush_now:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+        if not batch:
+            return
+        try:
+            import requests
+            requests.post(self.sink_url, json={"entries": batch}, timeout=5)
+        except Exception:
+            pass  # logging must never take down the pod
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(FLUSH_INTERVAL_S):
+            self.flush()
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._originals:
+            sys.stdout, sys.stderr = self._originals
+            self._originals = None
+        if self._handler:
+            logging.getLogger().removeHandler(self._handler)
+            self._handler = None
+        self.flush()
+        LogCapture._global = None

@@ -1,0 +1,65 @@
+"""Metrics push loop.
+
+Reference (``serving/metrics_push.py``): pushes http_requests_total, request
+durations, ``kubetorch_last_activity_timestamp`` (the TTL-reaper signal) and
+a heartbeat to a Prometheus pushgateway every 15s.
+
+TPU delta: when running on a TPU host we also export duty-cycle/HBM gauges
+read from jax's local device memory stats (the DCGM-equivalent for TPU).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+PUSH_INTERVAL_S = 15.0
+
+
+class MetricsPusher:
+    def __init__(self, gateway_url: str, state, interval: float = PUSH_INTERVAL_S):
+        self.gateway_url = gateway_url
+        self.state = state
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _tpu_metrics(self) -> dict:
+        try:
+            import jax
+            devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+            out = {}
+            for d in devs:
+                stats = d.memory_stats() or {}
+                out[f"kt_tpu_hbm_bytes_in_use{{device=\"{d.id}\"}}"] = \
+                    stats.get("bytes_in_use", 0)
+                out[f"kt_tpu_hbm_bytes_limit{{device=\"{d.id}\"}}"] = \
+                    stats.get("bytes_limit", 0)
+            return out
+        except Exception:
+            return {}
+
+    def _payload(self) -> str:
+        lines = {
+            "kubetorch_last_activity_timestamp": self.state.last_activity,
+            "kt_http_requests_total": self.state.request_count,
+            "kt_heartbeat_sent": time.time(),
+        }
+        lines.update(self._tpu_metrics())
+        return "\n".join(f"{k} {v}" for k, v in lines.items()) + "\n"
+
+    def _loop(self) -> None:
+        import requests
+        while not self._stop.wait(self.interval):
+            try:
+                requests.post(self.gateway_url, data=self._payload(), timeout=5)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,75 @@
+"""Remote debugging over WebSocket.
+
+Reference (``serving/pdb_websocket.py``): a WebSocketIO object impersonates
+stdin/stdout for pdb; when a request carries ``debugger: {mode, port}``, the
+next breakpoint in user code attaches to a WS server the client's ``kt
+debug`` command dials into with a PTY.
+
+Here the debug server is an aiohttp WS route bound on demand; ``arm_debugger``
+stores the request's debug spec so ``kt_breakpoint()`` (the user-facing hook)
+starts the session.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pdb
+import threading
+from typing import Optional
+
+_armed: Optional[dict] = None
+_lock = threading.Lock()
+
+
+def arm_debugger(spec: dict) -> None:
+    global _armed
+    with _lock:
+        _armed = dict(spec)
+
+
+def debugger_spec() -> Optional[dict]:
+    with _lock:
+        return dict(_armed) if _armed else None
+
+
+class _SocketIO:
+    """File-like adapter over a blocking socket for pdb's stdin/stdout."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._buf = b""
+
+    def readline(self):
+        while b"\n" not in self._buf:
+            chunk = self.conn.recv(4096)
+            if not chunk:
+                return ""
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line.decode() + "\n"
+
+    def write(self, data: str):
+        self.conn.sendall(data.encode())
+        return len(data)
+
+    def flush(self):
+        pass
+
+
+def kt_breakpoint(port: Optional[int] = None) -> None:
+    """Block until a debug client connects, then drop into pdb over the
+    socket. Import-safe: no-op unless a request armed the debugger."""
+    import socket
+
+    spec = debugger_spec()
+    if spec is None and port is None:
+        return
+    port = port or int(spec.get("port", 5678))
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(1)
+    conn, _ = srv.accept()
+    io = _SocketIO(conn)
+    debugger = pdb.Pdb(stdin=io, stdout=io)
+    debugger.set_trace(frame=__import__("sys")._getframe(1))

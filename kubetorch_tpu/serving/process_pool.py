@@ -1,0 +1,113 @@
+"""Pool of rank subprocesses with an async request/response router.
+
+Reference (``serving/process_pool.py``): N ProcessWorkers + mp queues, a
+response-router thread matching req_ids to futures, ``call`` (one rank) and
+``call_all`` (every local rank in parallel), queue draining on restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import queue as queue_mod
+from typing import Any, Dict, List, Optional
+
+from ..exceptions import rehydrate_exception
+from ..resources.pointers import Pointers
+from .env_contract import RankInfo
+
+
+class ProcessPool:
+    def __init__(self, num_procs: int, framework_name: str,
+                 pointers: Optional[Pointers], init_args: Optional[Dict],
+                 node_rank: int = 0, num_nodes: int = 1,
+                 pod_ips: Optional[List[str]] = None,
+                 base_env: Optional[Dict[str, str]] = None):
+        from .process_worker import ProcessWorker
+
+        self.num_procs = num_procs
+        self.workers: List[ProcessWorker] = []
+        for local_rank in range(num_procs):
+            info = RankInfo(node_rank=node_rank, local_rank=local_rank,
+                            nproc_per_node=num_procs, num_nodes=num_nodes,
+                            pod_ips=pod_ips or ["127.0.0.1"])
+            self.workers.append(ProcessWorker(info, framework_name, pointers,
+                                              init_args, base_env))
+        self._futures: Dict[str, asyncio.Future] = {}
+        self._futures_lock = threading.Lock()
+        self._req_counter = itertools.count()
+        self._router_threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def start(self) -> None:
+        # NOTE: often called from a worker thread (asyncio.to_thread), where
+        # there is no event loop — the loop is captured on first call().
+        for w in self.workers:
+            w.start()
+        for w in self.workers:
+            t = threading.Thread(target=self._route_responses, args=(w,), daemon=True)
+            t.start()
+            self._router_threads.append(t)
+
+    def _route_responses(self, worker) -> None:
+        while not self._stopping.is_set():
+            try:
+                resp = worker.response_q.get(timeout=0.2)
+            except (queue_mod.Empty, OSError, ValueError, EOFError):
+                if not worker.alive and self._stopping.is_set():
+                    return
+                continue
+            req_id = resp.get("req_id")
+            with self._futures_lock:
+                fut = self._futures.pop(req_id, None)
+            if fut is not None and self._loop is not None and not fut.done():
+                self._loop.call_soon_threadsafe(self._resolve, fut, resp)
+
+    @staticmethod
+    def _resolve(fut: asyncio.Future, resp: Dict) -> None:
+        if fut.done():
+            return
+        if resp.get("ok"):
+            fut.set_result(resp.get("result"))
+        else:
+            fut.set_exception(rehydrate_exception(resp["error"]))
+
+    async def call(self, idx: int, method: Optional[str], args: list,
+                   kwargs: dict, timeout: Optional[float] = None) -> Any:
+        worker = self.workers[idx]
+        if not worker.alive:
+            raise RuntimeError(f"Rank subprocess {idx} is dead")
+        self._loop = asyncio.get_running_loop()
+        req_id = f"r{next(self._req_counter)}"
+        fut = self._loop.create_future()
+        with self._futures_lock:
+            self._futures[req_id] = fut
+        worker.submit({"req_id": req_id, "method": method,
+                       "args": args, "kwargs": kwargs})
+        return await asyncio.wait_for(fut, timeout)
+
+    async def call_all(self, method: Optional[str], args: list, kwargs: dict,
+                       timeout: Optional[float] = None) -> List[Any]:
+        tasks = [self.call(i, method, args, kwargs, timeout)
+                 for i in range(self.num_procs)]
+        return list(await asyncio.gather(*tasks))
+
+    def cancel_pending(self, exc: BaseException) -> None:
+        with self._futures_lock:
+            futs, self._futures = list(self._futures.values()), {}
+        for fut in futs:
+            if self._loop is not None and not fut.done():
+                self._loop.call_soon_threadsafe(
+                    lambda f=fut: (not f.done()) and f.set_exception(exc))
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self.cancel_pending(RuntimeError("ProcessPool shutting down"))
+        for w in self.workers:
+            w.shutdown()
+
+    @property
+    def healthy(self) -> bool:
+        return all(w.alive for w in self.workers)

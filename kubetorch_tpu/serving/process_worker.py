@@ -1,0 +1,169 @@
+"""Rank subprocess: loads the user callable and executes requests.
+
+Reference model (``serving/process_worker.py``): a spawned
+``multiprocessing.Process`` running an asyncio loop that polls a request
+queue and handles requests concurrently (async callables awaited, sync ones
+in a thread pool), with per-request distributed env vars and child-process
+cleanup on teardown.
+
+TPU-first deltas:
+- **spawn** start method is mandatory (fork would duplicate a libtpu handle;
+  TPU chips are exclusively owned per-process).
+- The framework env (JAX coordinator, TPU_WORKER_ID) is applied *before* the
+  callable module is imported, because importing user code typically imports
+  jax, which reads these at first device query.
+- HBM OOM from XLA is detected and repackaged as a typed ``HbmOomError``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ..exceptions import detect_hbm_oom, package_exception
+from ..resources.pointers import Pointers, import_callable
+from .env_contract import RankInfo, framework_for
+
+_SYNC_EXECUTOR_THREADS = 40  # matches the server's sync-callable concurrency
+
+
+def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
+                 env: Dict[str, str], pointers_dict: Optional[Dict],
+                 init_args: Optional[Dict], framework_name: str) -> None:
+    os.environ.update(env)
+    asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
+                             framework_name))
+
+
+async def _worker_loop(request_q, response_q, pointers_dict, init_args,
+                       framework_name) -> None:
+    loop = asyncio.get_running_loop()
+    executor = ThreadPoolExecutor(max_workers=_SYNC_EXECUTOR_THREADS)
+    target: Any = None
+    load_error: Optional[BaseException] = None
+
+    # Eager-load the callable at spawn (reference :236-247) so first-request
+    # latency excludes import cost, and failures surface in health checks.
+    if pointers_dict:
+        try:
+            target = _load_target(pointers_dict, init_args)
+        except BaseException as e:  # noqa: BLE001 — must report, not die
+            load_error = e
+
+    pending = set()
+
+    def poll():
+        try:
+            return request_q.get(timeout=0.2)
+        except queue_mod.Empty:
+            return None
+
+    while True:
+        item = await loop.run_in_executor(None, poll)
+        if item is None:
+            pending = {t for t in pending if not t.done()}
+            continue
+        if item.get("op") == "shutdown":
+            framework_for(framework_name).worker_cleanup()
+            break
+        task = asyncio.ensure_future(
+            _handle(item, target, load_error, response_q, executor))
+        pending.add(task)
+
+
+def _host_view(obj: Any) -> Any:
+    """Device arrays can't cross the mp.Queue (no cross-process device
+    handles on TPU — SURVEY §2.9); pull them to host numpy here."""
+    t = type(obj)
+    if t.__module__.startswith(("jax", "jaxlib")) and hasattr(obj, "dtype"):
+        import numpy as np
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _host_view(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        vals = [_host_view(v) for v in obj]
+        return type(obj)(*vals) if hasattr(obj, "_fields") else tuple(vals)
+    if isinstance(obj, list):
+        return [_host_view(v) for v in obj]
+    return obj
+
+
+def _load_target(pointers_dict: Dict, init_args: Optional[Dict]) -> Any:
+    obj = import_callable(Pointers.from_dict(pointers_dict))
+    if isinstance(obj, type):
+        args = (init_args or {}).get("args", [])
+        kwargs = (init_args or {}).get("kwargs", {})
+        return obj(*args, **kwargs)
+    return obj
+
+
+async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> None:
+    req_id = item.get("req_id")
+    try:
+        if load_error is not None:
+            raise load_error
+        if target is None:
+            raise RuntimeError("No callable loaded in worker")
+        method = item.get("method")
+        fn = getattr(target, method) if method else target
+        args = item.get("args", [])
+        kwargs = item.get("kwargs", {})
+        if asyncio.iscoroutinefunction(fn):
+            result = await fn(*args, **kwargs)
+        else:
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(executor, lambda: fn(*args, **kwargs))
+        response_q.put({"req_id": req_id, "ok": True, "result": _host_view(result)})
+    except BaseException as e:  # noqa: BLE001
+        oom = detect_hbm_oom(e)
+        payload = package_exception(oom if oom is not None else e)
+        response_q.put({"req_id": req_id, "ok": False, "error": payload})
+
+
+class ProcessWorker:
+    """Handle to one rank subprocess."""
+
+    def __init__(self, rank_info: RankInfo, framework_name: str,
+                 pointers: Optional[Pointers], init_args: Optional[Dict],
+                 base_env: Optional[Dict[str, str]] = None):
+        self.rank_info = rank_info
+        self.framework_name = framework_name
+        ctx = mp.get_context("spawn")
+        self.request_q: mp.Queue = ctx.Queue()
+        self.response_q: mp.Queue = ctx.Queue()
+        env = dict(base_env or {})
+        env.update(framework_for(framework_name).env(rank_info))
+        self.env = env
+        self.process = ctx.Process(
+            target=_worker_main,
+            args=(self.request_q, self.response_q, env,
+                  pointers.to_dict() if pointers else None, init_args,
+                  framework_name),
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self.process.start()
+
+    def submit(self, req: Dict) -> None:
+        self.request_q.put(req)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        try:
+            self.request_q.put({"op": "shutdown"})
+        except Exception:
+            pass
+        self.process.join(timeout)
+        if self.process.is_alive():
+            from ..utils.procs import kill_process_tree
+            kill_process_tree(self.process.pid)
+
+    @property
+    def alive(self) -> bool:
+        return self.process.is_alive()

@@ -1,0 +1,89 @@
+"""Async pod→pod fan-out client.
+
+Reference (``serving/remote_worker_pool.py``): a singleton subprocess with its
+own asyncio loop and a 2000-connection httpx pool, so huge fan-outs never
+block the server loop. Here the server *is* async (aiohttp) end to end, so a
+separate process buys nothing — we keep the big connection pool and the
+health-gated, fast-fail semantics, in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from ..exceptions import WorkerCallError, rehydrate_exception
+from .. import serialization as ser
+
+MAX_CONNECTIONS = 2000
+SUBCALL_PARAM = "distributed_subcall"
+
+
+class RemoteWorkerPool:
+    _instance: Optional["RemoteWorkerPool"] = None
+
+    def __init__(self, server_port: int = 32300):
+        self.server_port = server_port
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    @classmethod
+    def shared(cls, server_port: int = 32300) -> "RemoteWorkerPool":
+        if cls._instance is None or cls._instance.server_port != server_port:
+            cls._instance = cls(server_port)
+        return cls._instance
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            conn = aiohttp.TCPConnector(limit=MAX_CONNECTIONS)
+            self._session = aiohttp.ClientSession(connector=conn)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def check_health(self, ip: str, timeout: float = 2.0) -> bool:
+        try:
+            sess = await self.session()
+            async with sess.get(f"http://{ip}:{self.server_port}/health",
+                                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    async def call_worker(self, ip: str, fn_name: str, method: Optional[str],
+                          body: Dict[str, Any], headers: Dict[str, str],
+                          timeout: Optional[float] = None,
+                          subtree: Optional[List[str]] = None) -> Any:
+        """One subcall to a peer pod. ``subtree`` tells the peer which workers
+        it coordinates below itself (tree fan-out)."""
+        path = f"/{fn_name}" + (f"/{method}" if method else "")
+        params = {SUBCALL_PARAM: "true"}
+        payload = dict(body)
+        if subtree:
+            payload["_kt_subtree"] = subtree
+        sess = await self.session()
+        try:
+            async with sess.post(
+                f"http://{ip}:{self.server_port}{path}",
+                data=ser.serialize(payload, ser.JSON),
+                params=params,
+                headers={**headers, "Content-Type": "application/json"},
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                raw = await resp.read()
+                if resp.status != 200:
+                    try:
+                        err = json.loads(raw.decode())
+                        raise rehydrate_exception(err)
+                    except (ValueError, KeyError):
+                        raise WorkerCallError(
+                            f"Worker {ip} returned {resp.status}: {raw[:500]!r}",
+                            worker=ip)
+                fmt = resp.headers.get("X-Serialization", ser.JSON)
+                return ser.deserialize(raw, fmt)
+        except aiohttp.ClientError as e:
+            raise WorkerCallError(f"Worker {ip} unreachable: {e}", worker=ip) from e

@@ -1,0 +1,155 @@
+"""SPMD supervisor: the distributed execution engine.
+
+Semantics mirror the reference engine (``serving/spmd/spmd_supervisor.py``):
+
+- The pod that receives the client call becomes the **coordinator**: it
+  discovers worker IPs, sorts them, and moves itself to rank 0 so MASTER_ADDR
+  / JAX coordinator is always the coordinator itself (:133-141).
+- Fan-out is flat below :data:`TREE_THRESHOLD` workers and a tree with
+  :data:`TREE_FANOUT` children above it; a node's children coordinate their
+  own subtrees recursively (:68-101).
+- Worker selection: ``workers=[ips|indices] | "any" | "ready"`` (:220-261).
+- Local ranks and remote subcalls execute in parallel with fast-fail: the
+  first error (or a critical membership change) cancels everything (:366-545).
+- Results aggregate as a flat per-rank list ordered by global rank (:547-570).
+
+TPU-first deltas: the default framework is JAX (one proc/host), and a
+``mesh`` in the distributed config flows to every rank as ``KT_MESH`` so user
+code (or our train-step builder) can rebuild the identical device mesh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..exceptions import WorkerCallError, WorkerMembershipChanged
+from .discovery import my_pod_ip
+from .execution_supervisor import DistributedSupervisor
+from .remote_worker_pool import RemoteWorkerPool
+
+TREE_THRESHOLD = 100
+TREE_FANOUT = 50
+
+
+def tree_children(index: int, total: int, fanout: int = TREE_FANOUT) -> List[int]:
+    """Children of node ``index`` in the implicit fanout tree."""
+    lo = index * fanout + 1
+    return list(range(lo, min(lo + fanout, total)))
+
+
+def subtree_indices(index: int, total: int, fanout: int = TREE_FANOUT) -> List[int]:
+    """All indices in the subtree rooted at ``index`` (excluding the root)."""
+    out: List[int] = []
+    stack = tree_children(index, total, fanout)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        stack.extend(tree_children(node, total, fanout))
+    return sorted(out)
+
+
+class SPMDSupervisor(DistributedSupervisor):
+    """Coordinator/worker SPMD execution over the pod set."""
+
+    def __init__(self, *args, server_port: int = 32300, fn_name: str = "",
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.server_port = server_port
+        self.fn_name = fn_name
+
+    # -- worker selection (reference :220-261) --------------------------------
+
+    async def _select_ips(self, workers: Union[None, str, Sequence]) -> List[str]:
+        all_ips = self.pod_ips() or [my_pod_ip()]
+        my_ip = my_pod_ip()
+        if workers is None or workers == "all":
+            selected = list(all_ips)
+        elif workers == "any":
+            selected = [my_ip]
+        elif workers == "ready":
+            pool = RemoteWorkerPool.shared(self.server_port)
+            checks = await asyncio.gather(
+                *[pool.check_health(ip) for ip in all_ips])
+            selected = [ip for ip, ok in zip(all_ips, checks) if ok or ip == my_ip]
+        elif isinstance(workers, (list, tuple)):
+            if all(isinstance(w, int) for w in workers):
+                selected = [all_ips[w] for w in workers if 0 <= w < len(all_ips)]
+            else:
+                selected = [w for w in workers if w in all_ips] or list(workers)
+        else:
+            raise ValueError(f"Invalid workers spec: {workers!r}")
+        # coordinator always participates, at rank 0 (reference :133-141)
+        if my_ip in selected:
+            selected.remove(my_ip)
+        return [my_ip] + sorted(selected)
+
+    # -- the call (reference :103, :366-545) ----------------------------------
+
+    async def call(self, method: Optional[str], args: list, kwargs: dict,
+                   timeout: Optional[float] = None,
+                   workers: Union[None, str, Sequence] = None,
+                   subtree: Optional[List[str]] = None,
+                   headers: Optional[Dict[str, str]] = None) -> List[Any]:
+        assert self.pool is not None, "supervisor not set up"
+        if subtree is not None:
+            # we are an interior tree node: coordinate the given subtree
+            ips = [my_pod_ip()] + list(subtree)
+        else:
+            self.check_membership()
+            ips = await self._select_ips(workers)
+
+        n = len(ips)
+        my_index = 0  # we are always first in our (sub)tree
+
+        if n > TREE_THRESHOLD:
+            child_indexes = tree_children(my_index, n)
+            remote_targets = [
+                (ips[c], [ips[d] for d in subtree_indices(c, n)])
+                for c in child_indexes
+            ]
+        else:
+            remote_targets = [(ip, []) for ip in ips[1:]]
+
+        local_task = asyncio.ensure_future(
+            self.pool.call_all(method, args, kwargs, timeout))
+        pool = RemoteWorkerPool.shared(self.server_port)
+        body = {"args": args, "kwargs": kwargs}
+        hdrs = headers or {}
+        remote_tasks = [
+            asyncio.ensure_future(pool.call_worker(
+                ip, self.fn_name, method, body, hdrs, timeout,
+                subtree=sub or None))
+            for ip, sub in remote_targets
+        ]
+
+        all_tasks = [local_task, *remote_tasks]
+        try:
+            results = await self._gather_fast_fail(all_tasks, timeout)
+        except BaseException:
+            for t in all_tasks:
+                t.cancel()
+            raise
+
+        # order: local ranks, then each remote branch's ranks (reference :547)
+        flat: List[Any] = list(results[0])
+        for branch in results[1:]:
+            flat.extend(branch if isinstance(branch, list) else [branch])
+        return flat
+
+    async def _gather_fast_fail(self, tasks: List[asyncio.Task],
+                                timeout: Optional[float]) -> List[Any]:
+        """Wait for all tasks; first exception (or critical membership change,
+        checked every second) cancels the rest (reference :457-545)."""
+        pending = set(tasks)
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, timeout=1.0, return_when=asyncio.FIRST_EXCEPTION)
+            for t in done:
+                if t.exception() is not None:
+                    raise t.exception()
+            event = self.pop_membership_event()
+            if event is not None and event.is_critical:
+                raise event
+        return [t.result() for t in tasks]

@@ -1,0 +1,26 @@
+"""distribution_type → supervisor class (reference supervisor_factory.py:58)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ..parallel.mesh import DistributedConfig
+from ..resources.pointers import Pointers
+from .execution_supervisor import ExecutionSupervisor
+from .spmd_supervisor import SPMDSupervisor
+
+
+def supervisor_for(config: Optional[DistributedConfig], pointers: Optional[Pointers],
+                   init_args: Optional[Dict], service_name: str,
+                   namespace: str, server_port: int = 32300,
+                   fn_name: str = "") -> ExecutionSupervisor:
+    dist_type = (config.distribution_type if config else "local").lower()
+    if dist_type in ("local", "none") or config is None or config.workers <= 1 and dist_type == "local":
+        return ExecutionSupervisor(pointers, init_args, config, service_name, namespace)
+    if dist_type in ("jax", "pytorch", "torch", "tensorflow", "tf", "spmd"):
+        return SPMDSupervisor(pointers, init_args, config, service_name,
+                              namespace, server_port=server_port, fn_name=fn_name)
+    if dist_type == "ray":
+        from .ray_supervisor import RaySupervisor
+        return RaySupervisor(pointers, init_args, config, service_name, namespace)
+    raise ValueError(f"Unknown distribution type: {dist_type!r}")

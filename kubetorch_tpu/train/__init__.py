@@ -1,0 +1,5 @@
+"""Training-step construction: optimizer, sharded jit, grad accumulation."""
+
+from .train_step import TrainState, make_train_step, init_train_state
+
+__all__ = ["TrainState", "make_train_step", "init_train_state"]

@@ -1,0 +1,132 @@
+"""Sharded training step builder.
+
+GSPMD style: the step is a pure function jit-compiled once with NamedSharding
+constraints on params/opt-state/batch; XLA inserts all collectives
+(reduce-scatter over fsdp, psum over data, all-to-all for expert routing).
+Buffers are donated so params update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..parallel.sharding import ShardingRules, batch_sharding
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                      warmup_steps: int = 100, total_steps: int = 10000):
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=jnp.float32),
+    )
+
+
+def init_train_state(params: Any, optimizer=None) -> TrainState:
+    optimizer = optimizer or default_optimizer()
+    return TrainState(params=params, opt_state=optimizer.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
+                    rules: Optional[ShardingRules] = None,
+                    donate: bool = True) -> Callable:
+    """Build ``step(state, batch) -> (state, metrics)``, jit-sharded on ``mesh``.
+
+    ``loss_fn(params, tokens, targets) -> scalar``. When ``mesh`` is given the
+    returned step carries in/out shardings derived from ``rules`` so the first
+    call lays out HBM correctly; without a mesh it is a plain jit.
+    """
+    optimizer = optimizer or default_optimizer()
+    if mesh is not None and rules is None:
+        raise ValueError("make_train_step: a mesh requires sharding `rules`")
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch["tokens"], batch["targets"])
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        if mesh is not None:
+            # Pin the rule-defined layout: without this, GSPMD propagation is
+            # free to transpose the output sharding (and with donation that
+            # means a silent full reshuffle every step).
+            param_sh = rules.tree_shardings(new_params, mesh)
+            new_params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_params, param_sh)
+            new_opt = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_opt,
+                _opt_shardings(new_opt, new_params, param_sh, mesh))
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), "step": state.step}
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def shard_state(state: TrainState) -> TrainState:
+        """Place an (unsharded) TrainState onto the mesh per the rules."""
+        param_sh = rules.tree_shardings(state.params, mesh)
+        opt_sh = _opt_shardings(state.opt_state, state.params, param_sh, mesh)
+        return TrainState(
+            params=jax.tree_util.tree_map(jax.device_put, state.params, param_sh),
+            opt_state=jax.tree_util.tree_map(jax.device_put, state.opt_state, opt_sh),
+            step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        )
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def wrapper(state, batch):
+        # Install the ambient mesh for mesh-aware ops (ring attention) — read
+        # at trace time, so it only matters on the first (tracing) call.
+        from ..parallel.mesh_context import use_mesh
+        with use_mesh(mesh):
+            return jitted(state, batch)
+
+    wrapper.shard_state = shard_state  # type: ignore[attr-defined]
+    wrapper.batch_sharding = batch_sharding(mesh)  # type: ignore[attr-defined]
+    wrapper.jitted = jitted  # type: ignore[attr-defined]
+    return wrapper
+
+
+def _opt_shardings(opt_state: Any, params: Any, param_shardings: Any, mesh):
+    """Optimizer-state subtrees that mirror the param tree *structurally*
+    (adam mu/nu) inherit the param shardings wholesale; scalar leaves (counts,
+    schedule state) are replicated.
+
+    Matching must be by tree structure, not leaf shape: distinct params can
+    share a shape with different shardings (Llama wq/wo are both (L, D, D)
+    with transposed specs), and a shape-keyed match would silently pin the
+    wrong layout, forcing a reshard of the fp32 state every step.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    param_treedef = jax.tree_util.tree_structure(params)
+
+    def rec(node):
+        if jax.tree_util.tree_structure(node) == param_treedef:
+            return param_shardings
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            children = [rec(c) for c in node]
+            if hasattr(node, "_fields"):  # namedtuple (optax states)
+                return type(node)(*children)
+            return type(node)(children)
+        return replicated
+
+    return rec(opt_state)

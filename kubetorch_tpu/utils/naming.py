@@ -21,6 +21,9 @@ def sanitize_k8s_name(name: str) -> str:
     name = name.lower().replace("_", "-").replace(".", "-").replace("/", "-")
     name = re.sub(r"[^a-z0-9-]", "", name)
     name = re.sub(r"-+", "-", name).strip("-")
+    if name and name[0].isdigit():
+        # Service names are DNS-1035: must start alphabetic
+        name = "kt-" + name
     return name[:MAX_NAME_LEN].strip("-") or "kt"
 
 

@@ -1,0 +1,66 @@
+"""Real-TPU smoke: flash kernel fwd/bwd vs XLA attention, then a train step.
+
+Run detached (never timeout-kill a TPU-holding process — it wedges the axon
+relay): ``python scripts/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1``
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, dev.device_kind, flush=True)
+
+    from kubetorch_tpu.ops.attention import flash_attention
+    from kubetorch_tpu.models.llama import _xla_attention
+
+    b, s, n, nkv, hd = 2, 2048, 8, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, n, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.bfloat16)
+
+    t0 = time.time()
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
+    out.block_until_ready()
+    print(f"flash fwd compile+run {time.time()-t0:.1f}s", flush=True)
+
+    ref = jax.jit(lambda q, k, v: _xla_attention(q, k, v, hd ** -0.5))(q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"flash vs xla maxerr {err:.4f}", flush=True)
+    assert err < 0.05, err
+
+    t0 = time.time()
+    g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v)
+                                                 .astype(jnp.float32) ** 2),
+                         argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(g)
+    print(f"flash bwd compile+run {time.time()-t0:.1f}s", flush=True)
+    gr = jax.jit(jax.grad(lambda q, k, v: jnp.sum(_xla_attention(q, k, v, hd ** -0.5)
+                                                  .astype(jnp.float32) ** 2),
+                          argnums=(0, 1, 2)))(q, k, v)
+    for a, r, nm in zip(g, gr, "qkv"):
+        e = float(jnp.max(jnp.abs(a.astype(jnp.float32) - r.astype(jnp.float32))))
+        rel = e / (float(jnp.max(jnp.abs(r.astype(jnp.float32)))) + 1e-9)
+        print(f"d{nm} maxerr {e:.4f} rel {rel:.4f}", flush=True)
+        assert rel < 0.05, (nm, e, rel)
+
+    # timing: flash vs xla fwd
+    for name, fn in (("flash", jax.jit(lambda q, k, v: flash_attention(q, k, v))),
+                     ("xla  ", jax.jit(lambda q, k, v: _xla_attention(q, k, v, hd ** -0.5)))):
+        fn(q, k, v).block_until_ready()
+        t0 = time.time()
+        for _ in range(20):
+            o = fn(q, k, v)
+        o.block_until_ready()
+        print(f"{name} fwd 20 iters: {time.time()-t0:.3f}s", flush=True)
+
+    print("TPU SMOKE OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

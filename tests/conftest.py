@@ -8,11 +8,18 @@ required. The env vars must be set before jax is imported anywhere.
 
 import os
 
-# Virtual 8-device CPU mesh for all sharding/parallelism tests.
+# Virtual 8-device CPU mesh for all sharding/parallelism tests. This
+# environment preloads jax via sitecustomize (axon TPU tunnel) before conftest
+# runs, so setting env vars alone is too late — update the live config too.
+# The XLA flag is still read at first backend init, which hasn't happened yet.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

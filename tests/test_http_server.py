@@ -1,0 +1,191 @@
+"""In-process pod-runtime tests (model: reference tests/test_http_server.py —
+runs the server app with a test client, loading callables from tests/assets,
+no cluster)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubetorch_tpu import serialization as ser
+from kubetorch_tpu.serving.env_contract import (
+    KT_CLS_OR_FN_NAME, KT_FILE_PATH, KT_INIT_ARGS, KT_LAUNCH_ID,
+    KT_MODULE_NAME, KT_PROJECT_ROOT, METADATA_KEYS,
+)
+from kubetorch_tpu.serving.http_server import ServerState, create_app
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+@pytest.fixture(autouse=True)
+def clean_env():
+    saved = {k: os.environ.get(k) for k in METADATA_KEYS}
+    for k in METADATA_KEYS:
+        os.environ.pop(k, None)
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def set_fn_metadata(fn_name: str, init_args=None):
+    os.environ[KT_PROJECT_ROOT] = ASSETS
+    os.environ[KT_MODULE_NAME] = "payloads"
+    os.environ[KT_FILE_PATH] = "payloads.py"
+    os.environ[KT_CLS_OR_FN_NAME] = fn_name
+    os.environ[KT_LAUNCH_ID] = "launch-1"
+    if init_args:
+        os.environ[KT_INIT_ARGS] = json.dumps(init_args)
+
+
+def run_server_test(coro_fn):
+    async def runner():
+        state = ServerState()
+        app = create_app(state)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await coro_fn(client, state)
+        finally:
+            await client.close()
+    asyncio.run(runner())
+
+
+def test_health_and_ready():
+    async def body(client, state):
+        r = await client.get("/health")
+        assert r.status == 200
+        data = await r.json()
+        assert data["status"] == "ok" and data["launch_id"] is None
+
+        set_fn_metadata("summer")
+        state.launch_id = "launch-1"
+        r = await client.get("/ready", params={"launch_id": "launch-1"})
+        assert r.status == 200
+        r = await client.get("/ready", params={"launch_id": "other"})
+        assert r.status == 409
+    run_server_test(body)
+
+
+def test_call_function():
+    async def body(client, state):
+        set_fn_metadata("summer")
+        r = await client.post("/summer", json={"args": [2, 3], "kwargs": {}})
+        assert r.status == 200, await r.text()
+        assert json.loads(await r.read()) == 5
+    run_server_test(body)
+
+
+def test_call_wrong_name_404():
+    async def body(client, state):
+        set_fn_metadata("summer")
+        r = await client.post("/not_summer", json={"args": [], "kwargs": {}})
+        assert r.status == 404
+    run_server_test(body)
+
+
+def test_exception_propagation():
+    async def body(client, state):
+        set_fn_metadata("boomer")
+        r = await client.post("/boomer", json={"args": [], "kwargs": {"msg": "zap"}})
+        assert r.status == 500
+        err = await r.json()
+        assert err["error_type"] == "ValueError"
+        assert "zap" in err["message"]
+        assert "traceback" in err
+    run_server_test(body)
+
+
+def test_class_instance_methods():
+    async def body(client, state):
+        set_fn_metadata("Counter", init_args={"kwargs": {"start": 10}})
+        r = await client.post("/Counter/increment", json={"args": [5], "kwargs": {}})
+        assert r.status == 200, await r.text()
+        assert json.loads(await r.read()) == 15
+        # state persists in the worker process
+        r = await client.post("/Counter/get", json={"args": [], "kwargs": {}})
+        assert json.loads(await r.read()) == 15
+    run_server_test(body)
+
+
+def test_array_payload_roundtrip():
+    async def body(client, state):
+        set_fn_metadata("summer")
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        payload = ser.serialize({"args": [arr, arr], "kwargs": {}}, ser.JSON)
+        r = await client.post("/summer", data=payload,
+                              headers={"X-Serialization": "json"})
+        assert r.status == 200, await r.text()
+        out = ser.deserialize(await r.read(), ser.JSON)
+        np.testing.assert_array_equal(out, arr + arr)
+    run_server_test(body)
+
+
+def test_pickle_rejected_without_allowlist():
+    async def body(client, state):
+        set_fn_metadata("summer")
+        payload = ser.serialize({"args": [1, 2], "kwargs": {}}, ser.PICKLE)
+        r = await client.post("/summer", data=payload,
+                              headers={"X-Serialization": "pickle"})
+        assert r.status == 415
+    run_server_test(body)
+
+
+def test_termination_mid_request():
+    async def body(client, state):
+        set_fn_metadata("sleeper")
+        task = asyncio.ensure_future(
+            client.post("/sleeper", json={"args": [30], "kwargs": {}}))
+        await asyncio.sleep(1.0)
+        state.terminate("Preempted")
+        r = await task
+        assert r.status == 503
+        err = await r.json()
+        assert err["error_type"] == "PodTerminatedError"
+        assert err["attrs"]["reason"] == "Preempted"
+        # subsequent requests rejected immediately
+        r2 = await client.post("/sleeper", json={"args": [0], "kwargs": {}})
+        assert r2.status == 503
+    run_server_test(body)
+
+
+def test_request_id_propagation():
+    async def body(client, state):
+        set_fn_metadata("summer")
+        r = await client.post("/summer", json={"args": [1, 1], "kwargs": {}},
+                              headers={"X-Request-ID": "req-abc"})
+        assert r.headers["X-Request-ID"] == "req-abc"
+    run_server_test(body)
+
+
+def test_reload_swaps_callable(tmp_path):
+    async def body(client, state):
+        set_fn_metadata("summer")
+        r = await client.post("/summer", json={"args": [1, 2], "kwargs": {}})
+        assert json.loads(await r.read()) == 3
+        # hot-swap to a different callable, new launch_id
+        r = await client.post("/_kt/reload", json={
+            "metadata": {"KT_CLS_OR_FN_NAME": "whoami"},
+            "launch_id": "launch-2",
+        })
+        assert r.status == 200, await r.text()
+        r = await client.get("/ready", params={"launch_id": "launch-2"})
+        assert r.status == 200
+        r = await client.post("/whoami", json={"args": [], "kwargs": {}})
+        out = json.loads(await r.read())
+        assert out["world_size"] == "1"
+    run_server_test(body)
+
+
+def test_metrics_endpoint():
+    async def body(client, state):
+        r = await client.get("/metrics")
+        assert r.status == 200
+        text = await r.text()
+        assert "kubetorch_last_activity_timestamp" in text
+    run_server_test(body)

@@ -1,0 +1,162 @@
+"""Client-side controller access (reference ``globals.py``).
+
+``ControllerClient`` speaks the controller REST/WS protocol. When no
+``api_url`` is configured, a local controller (with the subprocess-pod
+backend) is auto-started once per client process — the zero-infra dev loop:
+``kt.fn(f).to(kt.Compute(cpus=1))`` works on a bare machine with no cluster,
+exactly like the reference's port-forward path makes a remote cluster feel
+local (reference ``globals.py:123-366``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as _requests
+
+from .config import config
+from .exceptions import ControllerRequestError
+from .utils.procs import free_port, kill_process_tree, wait_for_port
+
+
+class ControllerClient:
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self._session = _requests.Session()
+
+    # -- raw ------------------------------------------------------------------
+
+    def _request(self, method: str, path: str, timeout: float = 120.0,
+                 **kwargs) -> Any:
+        url = f"{self.base_url}{path}"
+        try:
+            resp = self._session.request(method, url, timeout=timeout, **kwargs)
+        except _requests.RequestException as e:
+            raise ControllerRequestError(f"Controller unreachable at {url}: {e}")
+        if resp.status_code >= 400:
+            raise ControllerRequestError(
+                f"{method} {path} → {resp.status_code}: {resp.text[:500]}",
+                status_code=resp.status_code)
+        return resp.json() if resp.content else None
+
+    # -- API ------------------------------------------------------------------
+
+    def deploy(self, namespace: str, name: str, manifest: Dict,
+               metadata: Dict, launch_id: str,
+               inactivity_ttl: Optional[int] = None,
+               expected_pods: Optional[int] = None,
+               timeout: float = 900.0) -> Dict:
+        return self._request("POST", "/controller/deploy", timeout=timeout, json={
+            "namespace": namespace, "name": name, "manifest": manifest,
+            "metadata": metadata, "launch_id": launch_id,
+            "inactivity_ttl": inactivity_ttl, "expected_pods": expected_pods,
+        })
+
+    def apply(self, namespace: str, name: str, manifest: Dict,
+              env: Optional[Dict] = None) -> Dict:
+        return self._request("POST", "/controller/apply", json={
+            "namespace": namespace, "name": name, "manifest": manifest,
+            "env": env or {}})
+
+    def register_workload(self, namespace: str, name: str, metadata: Dict,
+                          selector: Optional[Dict] = None,
+                          service_url: Optional[str] = None,
+                          launch_id: Optional[str] = None) -> Dict:
+        return self._request("POST", "/controller/workload", json={
+            "namespace": namespace, "name": name, "metadata": metadata,
+            "selector": selector, "service_url": service_url,
+            "launch_id": launch_id})
+
+    def get_workload(self, namespace: str, name: str) -> Dict:
+        return self._request("GET", f"/controller/workload/{namespace}/{name}")
+
+    def delete_workload(self, namespace: str, name: str) -> Dict:
+        return self._request("DELETE", f"/controller/workload/{namespace}/{name}")
+
+    def list_workloads(self, namespace: Optional[str] = None) -> List[Dict]:
+        params = {"namespace": namespace} if namespace else {}
+        return self._request("GET", "/controller/workloads",
+                             params=params)["workloads"]
+
+    def check_ready(self, namespace: str, name: str) -> Dict:
+        return self._request("GET", f"/controller/check-ready/{namespace}/{name}")
+
+    def cluster_config(self) -> Dict:
+        try:
+            return self._request("GET", "/controller/cluster-config",
+                                 timeout=5.0) or {}
+        except ControllerRequestError:
+            return {}
+
+    def logs(self, service: Optional[str] = None, namespace: str = "default",
+             request_id: Optional[str] = None, offset: int = 0) -> Dict:
+        params: Dict[str, Any] = {"namespace": namespace, "offset": offset}
+        if service:
+            params["service"] = service
+        if request_id:
+            params["request_id"] = request_id
+        return self._request("GET", "/controller/logs", params=params)
+
+    def events(self, service: Optional[str] = None) -> List[Dict]:
+        params = {"service": service} if service else {}
+        return self._request("GET", "/controller/events",
+                             params=params)["events"]
+
+    def version(self) -> str:
+        return self._request("GET", "/controller/version", timeout=5.0)["version"]
+
+
+# ---------------------------------------------------------------------------
+# Local controller lifecycle
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_local_proc: Optional[subprocess.Popen] = None
+_client: Optional[ControllerClient] = None
+
+
+def controller_client() -> ControllerClient:
+    """Singleton (reference ``globals.py:902``): configured api_url, else an
+    auto-started local controller."""
+    global _client, _local_proc
+    with _lock:
+        if _client is not None:
+            return _client
+        api = config().api_url
+        if api:
+            _client = ControllerClient(api)
+            return _client
+        port = free_port()
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = env.get("KT_LOCAL_CONTROLLER_TPU", "")
+        # the subprocess must find this package regardless of the user's cwd
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        _local_proc = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.controller.app",
+             "--host", "127.0.0.1", "--port", str(port), "--backend", "local"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        atexit.register(shutdown_local_controller)
+        if not wait_for_port("127.0.0.1", port, timeout=30):
+            kill_process_tree(_local_proc.pid)
+            _local_proc = None
+            raise ControllerRequestError("Local controller failed to start")
+        url = f"http://127.0.0.1:{port}"
+        config().api_url = url
+        _client = ControllerClient(url)
+        return _client
+
+
+def shutdown_local_controller() -> None:
+    global _local_proc, _client
+    with _lock:
+        if _local_proc is not None and _local_proc.poll() is None:
+            kill_process_tree(_local_proc.pid)
+        _local_proc = None
+        _client = None

@@ -1,0 +1,24 @@
+"""The kubetorch controller — rebuilt from scratch.
+
+The reference ships this only as a container image
+(``ghcr.io/run-house/kubetorch-controller``); its HTTP/WS protocol was
+recovered from the client code and design docs (SURVEY §2.7) and
+re-implemented here TPU-first:
+
+- ``POST /controller/deploy``   — apply manifest + upsert workload + push
+  metadata/reload to connected pods, await acks
+- ``POST /controller/apply``    — BYO manifest passthrough
+- ``POST /controller/workload`` — register-only (BYO compute)
+- ``GET|DELETE /controller/workload/{ns}/{name}``, ``GET /controller/workloads``
+- ``WS /controller/ws/pods``    — pod registry (single-process, in-memory,
+  like the reference's single-uvicorn-worker constraint)
+- ``GET /controller/check-ready/{ns}/{name}``
+- log ingestion + query (Loki-less path for `kt logs`)
+- TTL reaper driven by ``kubetorch_last_activity_timestamp``
+
+Backends: ``LocalBackend`` runs pods as host subprocesses on loopback alias
+IPs (the no-cluster dev/test path); ``KubernetesBackend`` applies manifests
+via kubectl and is the production path on GKE TPU node pools.
+"""
+
+from .app import create_controller_app, ControllerState

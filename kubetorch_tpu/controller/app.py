@@ -1,0 +1,443 @@
+"""Controller HTTP/WS application (see package docstring for the protocol)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web, WSMsgType
+
+from ..exceptions import package_exception
+from .backends import LocalBackend
+
+TTL_CHECK_INTERVAL_S = 30.0
+RELOAD_ACK_TIMEOUT_S = 60.0
+LOG_BUFFER_PER_SERVICE = 5000
+
+
+class PodConnection:
+    def __init__(self, ws: web.WebSocketResponse, info: Dict[str, Any]):
+        self.ws = ws
+        self.info = info
+        self.acks: Dict[str, asyncio.Future] = {}
+
+    @property
+    def pod_name(self) -> str:
+        return self.info.get("pod_name", "?")
+
+    @property
+    def service_key(self) -> str:
+        return f"{self.info.get('namespace', 'default')}/{self.info.get('service_name', '')}"
+
+
+class ControllerState:
+    def __init__(self, backend=None, base_url: str = ""):
+        self.backend = backend
+        self.base_url = base_url
+        self.workloads: Dict[str, Dict[str, Any]] = {}
+        self.pods: Dict[str, List[PodConnection]] = {}   # service_key → conns
+        self.logs: Dict[str, deque] = {}                 # service_key → entries
+        self.events: deque = deque(maxlen=2000)
+        self.cluster_config: Dict[str, Any] = {}
+        self._ttl_task: Optional[asyncio.Task] = None
+
+    # -- pod registry ---------------------------------------------------------
+
+    def register_pod(self, conn: PodConnection) -> None:
+        self.pods.setdefault(conn.service_key, []).append(conn)
+        self.record_event(conn.service_key, f"pod {conn.pod_name} connected")
+
+    def unregister_pod(self, conn: PodConnection) -> None:
+        conns = self.pods.get(conn.service_key, [])
+        if conn in conns:
+            conns.remove(conn)
+        self.record_event(conn.service_key, f"pod {conn.pod_name} disconnected")
+
+    def connections(self, namespace: str, name: str) -> List[PodConnection]:
+        return [c for c in self.pods.get(f"{namespace}/{name}", [])
+                if not c.ws.closed]
+
+    def record_event(self, service_key: str, message: str) -> None:
+        self.events.append({"ts": time.time(), "service": service_key,
+                            "message": message})
+
+    # -- reload push (SURVEY §7 hard-part 1) ----------------------------------
+
+    async def push_reload(self, namespace: str, name: str, metadata: Dict,
+                          launch_id: str) -> Dict[str, Any]:
+        conns = self.connections(namespace, name)
+        results: Dict[str, Any] = {}
+
+        async def one(conn: PodConnection):
+            fut = asyncio.get_running_loop().create_future()
+            conn.acks[launch_id] = fut
+            try:
+                await conn.ws.send_json({"action": "reload",
+                                         "metadata": metadata,
+                                         "launch_id": launch_id})
+                ack = await asyncio.wait_for(fut, RELOAD_ACK_TIMEOUT_S)
+                results[conn.pod_name] = ack
+            except asyncio.TimeoutError:
+                results[conn.pod_name] = {"ok": False, "error": "ack timeout"}
+            except Exception as e:  # noqa: BLE001
+                results[conn.pod_name] = {"ok": False, "error": str(e)}
+            finally:
+                conn.acks.pop(launch_id, None)
+
+        await asyncio.gather(*[one(c) for c in conns])
+        return results
+
+
+# ---------------------------------------------------------------------------
+# Route handlers
+# ---------------------------------------------------------------------------
+
+
+def _workload_key(ns: str, name: str) -> str:
+    return f"{ns}/{name}"
+
+
+async def deploy(request: web.Request) -> web.Response:
+    """Deploy: apply manifest, upsert workload, push metadata/reload."""
+    state: ControllerState = request.app["cstate"]
+    try:
+        body = await request.json()
+        namespace = body.get("namespace", "default")
+        name = body["name"]
+        manifest = body.get("manifest", {})
+        metadata = body.get("metadata", {})
+        launch_id = body.get("launch_id") or uuid.uuid4().hex
+
+        key = _workload_key(namespace, name)
+        existing = state.workloads.get(key)
+        record = {
+            "namespace": namespace, "name": name, "manifest": manifest,
+            "metadata": metadata, "launch_id": launch_id,
+            "created_at": existing["created_at"] if existing else time.time(),
+            "updated_at": time.time(),
+            "inactivity_ttl": body.get("inactivity_ttl"),
+            "expected_pods": body.get("expected_pods"),
+        }
+
+        env = {k: (v if isinstance(v, str) else json.dumps(v))
+               for k, v in metadata.items()}
+        env["KT_LAUNCH_ID"] = launch_id
+        apply_result = await asyncio.to_thread(
+            state.backend.apply, namespace, name, manifest, env)
+        record.update(apply_result)
+        state.workloads[key] = record
+        state.record_event(key, f"deployed launch_id={launch_id}")
+
+        # hot reload on already-connected pods
+        reload_results = await state.push_reload(namespace, name,
+                                                 {**metadata,
+                                                  "KT_LAUNCH_ID": launch_id},
+                                                 launch_id)
+        return web.json_response({
+            "ok": True, "launch_id": launch_id,
+            "service_url": record.get("service_url"),
+            "pod_ips": record.get("pod_ips", []),
+            "reloaded_pods": reload_results,
+        })
+    except KeyError as e:
+        return web.json_response({"error": f"missing field {e}"}, status=400)
+    except Exception as e:  # noqa: BLE001
+        return web.json_response(package_exception(e), status=500)
+
+
+async def apply_manifest(request: web.Request) -> web.Response:
+    """BYO manifest passthrough (reference POST /controller/apply)."""
+    state: ControllerState = request.app["cstate"]
+    try:
+        body = await request.json()
+        namespace = body.get("namespace", "default")
+        name = body.get("name") or body.get("manifest", {}).get(
+            "metadata", {}).get("name", "unnamed")
+        result = await asyncio.to_thread(
+            state.backend.apply, namespace, name, body.get("manifest", {}),
+            body.get("env", {}))
+        return web.json_response({"ok": True, **result})
+    except Exception as e:  # noqa: BLE001
+        return web.json_response(package_exception(e), status=500)
+
+
+async def register_workload(request: web.Request) -> web.Response:
+    """Register-only (BYO compute: pods exist already, reference :691)."""
+    state: ControllerState = request.app["cstate"]
+    body = await request.json()
+    namespace = body.get("namespace", "default")
+    name = body["name"]
+    launch_id = body.get("launch_id") or uuid.uuid4().hex
+    key = _workload_key(namespace, name)
+    state.workloads[key] = {
+        "namespace": namespace, "name": name, "manifest": None,
+        "metadata": body.get("metadata", {}), "launch_id": launch_id,
+        "created_at": time.time(), "updated_at": time.time(),
+        "selector": body.get("selector"),
+        "service_url": body.get("service_url"),
+    }
+    reload_results = await state.push_reload(
+        namespace, name, {**body.get("metadata", {}), "KT_LAUNCH_ID": launch_id},
+        launch_id)
+    return web.json_response({"ok": True, "launch_id": launch_id,
+                              "reloaded_pods": reload_results})
+
+
+async def get_workload(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    key = _workload_key(request.match_info["ns"], request.match_info["name"])
+    record = state.workloads.get(key)
+    if record is None:
+        return web.json_response({"error": "not found"}, status=404)
+    pods = state.connections(request.match_info["ns"], request.match_info["name"])
+    out = dict(record)
+    out["connected_pods"] = [c.pod_name for c in pods]
+    if state.backend is not None:
+        out["pod_ips"] = state.backend.pod_ips(
+            request.match_info["ns"], request.match_info["name"]) or \
+            out.get("pod_ips", [])
+    return web.json_response(out)
+
+
+async def delete_workload(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    key = _workload_key(ns, name)
+    record = state.workloads.pop(key, None)
+    deleted = await asyncio.to_thread(state.backend.delete, ns, name)
+    state.record_event(key, "deleted")
+    return web.json_response({"ok": True, "existed": record is not None or deleted})
+
+
+async def list_workloads(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    ns_filter = request.query.get("namespace")
+    out = []
+    for key, record in state.workloads.items():
+        if ns_filter and record["namespace"] != ns_filter:
+            continue
+        out.append({k: record[k] for k in
+                    ("namespace", "name", "launch_id", "created_at",
+                     "updated_at", "service_url") if k in record})
+    return web.json_response({"workloads": out})
+
+
+async def check_ready(request: web.Request) -> web.Response:
+    """Service readiness: every expected pod connected + acked launch."""
+    state: ControllerState = request.app["cstate"]
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    record = state.workloads.get(_workload_key(ns, name))
+    if record is None:
+        return web.json_response({"ready": False, "reason": "unknown workload"},
+                                 status=404)
+    # expected pod count comes from the deploy request (JobSet/Knative
+    # manifests don't carry spec.replicas); manifest replicas is the fallback
+    expected = record.get("expected_pods")
+    if expected is None:
+        expected = int(record.get("manifest", {}).get("spec", {})
+                       .get("replicas", 1)) if record.get("manifest") else 1
+    connected = len(state.connections(ns, name))
+    backend_ips = state.backend.pod_ips(ns, name) if state.backend else []
+    ready = connected >= expected or len(backend_ips) >= expected
+    return web.json_response({"ready": ready, "connected": connected,
+                              "expected": expected})
+
+
+async def cluster_config(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    return web.json_response(state.cluster_config)
+
+
+async def version(request: web.Request) -> web.Response:
+    from .. import __version__
+    return web.json_response({"version": __version__})
+
+
+# -- logs (Loki-less path) ---------------------------------------------------
+
+
+async def ingest_logs(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    body = await request.json()
+    for entry in body.get("entries", []):
+        key = f"{entry.get('namespace', 'default')}/{entry.get('service', '')}"
+        state.logs.setdefault(key, deque(maxlen=LOG_BUFFER_PER_SERVICE)).append(entry)
+    return web.json_response({"ok": True})
+
+
+async def query_logs(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    service = request.query.get("service")
+    namespace = request.query.get("namespace", "default")
+    request_id = request.query.get("request_id")
+    offset = int(request.query.get("offset", 0))
+    if service:
+        entries = list(state.logs.get(f"{namespace}/{service}", []))
+    else:
+        entries = [e for buf in state.logs.values() for e in buf]
+    if request_id:
+        entries = [e for e in entries if e.get("request_id") == request_id]
+    entries.sort(key=lambda e: e.get("ts", 0))
+    page = entries[offset:offset + 1000]
+    return web.json_response({"entries": page, "offset": offset + len(page)})
+
+
+async def list_events(request: web.Request) -> web.Response:
+    state: ControllerState = request.app["cstate"]
+    service = request.query.get("service")
+    events = [e for e in state.events
+              if not service or e["service"].endswith(f"/{service}")]
+    return web.json_response({"events": events[-500:]})
+
+
+# -- pod websocket -----------------------------------------------------------
+
+
+async def pods_ws(request: web.Request) -> web.WebSocketResponse:
+    state: ControllerState = request.app["cstate"]
+    ws = web.WebSocketResponse(heartbeat=20)
+    await ws.prepare(request)
+    conn: Optional[PodConnection] = None
+    try:
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                break
+            data = json.loads(msg.data)
+            action = data.get("action")
+            if action == "register":
+                conn = PodConnection(ws, data)
+                state.register_pod(conn)
+                record = state.workloads.get(conn.service_key)
+                if record is not None:
+                    await ws.send_json({
+                        "action": "metadata",
+                        "metadata": record.get("metadata", {}),
+                        "launch_id": record.get("launch_id"),
+                    })
+                else:
+                    await ws.send_json({"action": "waiting"})
+            elif action in ("reload_ack", "metadata_ack") and conn is not None:
+                launch_id = data.get("launch_id")
+                fut = conn.acks.get(launch_id) if launch_id else None
+                if fut is not None and not fut.done():
+                    fut.set_result(data)
+    finally:
+        if conn is not None:
+            state.unregister_pod(conn)
+    return ws
+
+
+# -- TTL reaper (reference: controller TTL task, SURVEY §2.7) -----------------
+
+
+async def _ttl_loop(state: ControllerState) -> None:
+    import aiohttp
+
+    while True:
+        await asyncio.sleep(TTL_CHECK_INTERVAL_S)
+        now = time.time()
+        for key, record in list(state.workloads.items()):
+            try:
+                ttl = record.get("inactivity_ttl")
+                if not ttl:
+                    continue
+                url = record.get("service_url")
+                if not url:
+                    continue
+                try:
+                    async with aiohttp.ClientSession() as sess:
+                        async with sess.get(
+                                f"{url}/metrics",
+                                timeout=aiohttp.ClientTimeout(total=5)) as r:
+                            text = await r.text()
+                    last = _parse_metric(text, "kubetorch_last_activity_timestamp")
+                except Exception:
+                    continue
+                if last and now - last > ttl:
+                    ns, name = record["namespace"], record["name"]
+                    state.record_event(key, f"TTL expired ({ttl}s); tearing down")
+                    # delete first; forget the record only once the backend
+                    # succeeded, so a transient failure retries next cycle
+                    await asyncio.to_thread(state.backend.delete, ns, name)
+                    state.workloads.pop(key, None)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the reaper must outlive any single workload's failure —
+                # it is what reclaims idle TPU slices
+                state.record_event(key, "TTL reap attempt failed; will retry")
+
+
+def _parse_metric(text: str, name: str) -> Optional[float]:
+    for line in text.splitlines():
+        if line.startswith(name):
+            try:
+                return float(line.split()[-1])
+            except ValueError:
+                return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+
+
+def create_controller_app(state: Optional[ControllerState] = None) -> web.Application:
+    app = web.Application(client_max_size=10 * 1024 ** 3)  # 10G, like nginx cfg
+    app["cstate"] = state or ControllerState()
+    r = app.router
+    r.add_post("/controller/deploy", deploy)
+    r.add_post("/controller/apply", apply_manifest)
+    r.add_post("/controller/workload", register_workload)
+    r.add_get("/controller/workloads", list_workloads)
+    r.add_get("/controller/workload/{ns}/{name}", get_workload)
+    r.add_delete("/controller/workload/{ns}/{name}", delete_workload)
+    r.add_get("/controller/check-ready/{ns}/{name}", check_ready)
+    r.add_get("/controller/cluster-config", cluster_config)
+    r.add_get("/controller/version", version)
+    r.add_post("/controller/logs", ingest_logs)
+    r.add_get("/controller/logs", query_logs)
+    r.add_get("/controller/events", list_events)
+    r.add_get("/controller/ws/pods", pods_ws)
+    app.on_startup.append(_startup)
+    app.on_cleanup.append(_cleanup)
+    return app
+
+
+async def _startup(app: web.Application) -> None:
+    state: ControllerState = app["cstate"]
+    state._ttl_task = asyncio.create_task(_ttl_loop(state))
+
+
+async def _cleanup(app: web.Application) -> None:
+    state: ControllerState = app["cstate"]
+    if state._ttl_task:
+        state._ttl_task.cancel()
+    if state.backend is not None:
+        await asyncio.to_thread(state.backend.shutdown)
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="kubetorch-tpu controller")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--backend", choices=["local", "kubernetes"], default="local")
+    args = p.parse_args(argv)
+
+    state = ControllerState(base_url=f"http://127.0.0.1:{args.port}")
+    if args.backend == "kubernetes":
+        from .backends import KubernetesBackend
+        state.backend = KubernetesBackend()
+    else:
+        state.backend = LocalBackend(controller_url=state.base_url)
+    web.run_app(create_controller_app(state), host=args.host, port=args.port,
+                print=lambda *_: None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Data store: content-addressed delta sync + KV tensor store.
+
+Reference (``data_store/``, ~7.4k LoC + closed-source store pod): rsyncd over
+a PVC for files, NCCL broadcast for GPU tensors, an MDS for discovery.
+
+TPU-native redesign:
+- **ktsync** (``sync.py`` + ``store_server.py``): rsync does not exist in the
+  runtime image, and the reference's rsyncd was an external native dep
+  (SURVEY §2.9). ktsync is our own protocol: blake2b content-addressed blobs,
+  manifest diff, only changed files cross the wire — same delta property that
+  makes the 1-2s iteration loop work, over plain HTTP (one port, no daemon
+  config, 10G bodies).
+- **Tensor KV** (``commands.py``): ``kt.put/get/ls/rm`` of JAX pytrees with
+  per-leaf keys enabling resharding on get (reference design.md:156-159);
+  device staging through host memory (TPUs have no CUDA-IPC equivalent),
+  ICI collectives for intra-slice broadcast.
+"""
+
+from .types import BroadcastWindow, Locale, Lifespan

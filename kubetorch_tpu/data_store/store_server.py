@@ -1,0 +1,246 @@
+"""ktsync store server: content-addressed blob store + tree manifests + KV.
+
+The rebuild of the reference's closed-source data-store pod
+(``ghcr.io/run-house/kubetorch-data-store``: rsyncd + MDS, SURVEY §2.7) as a
+single aiohttp service:
+
+- ``/blob/{hash}``                 GET/PUT content-addressed blobs (CAS)
+- ``/tree/{key}/diff|commit|manifest``  delta-sync protocol (see sync.py)
+- ``/kv/{key}``                    GET/PUT/DELETE raw values (tensor leaves)
+- ``/keys?prefix=``                listing for `kt ls`
+- ``/register``                    peer registry (MDS role): which pod holds
+                                   which locale="local" key, for P2P gets
+
+Run: ``python -m kubetorch_tpu.data_store.store_server --port 8873 --root DIR``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, Optional
+from urllib.parse import unquote
+
+from aiohttp import web
+
+MAX_BODY = 10 * 1024 ** 3
+
+
+class StoreState:
+    def __init__(self, root: str):
+        self.root = Path(root)
+        (self.root / "blobs").mkdir(parents=True, exist_ok=True)
+        (self.root / "trees").mkdir(parents=True, exist_ok=True)
+        (self.root / "kv").mkdir(parents=True, exist_ok=True)
+        self.peers: Dict[str, Dict] = {}   # key → {ip, port, ts} for P2P
+
+    def blob_path(self, h: str) -> Path:
+        if not h.isalnum():
+            raise web.HTTPBadRequest(text="bad hash")
+        return self.root / "blobs" / h[:2] / h
+
+    def tree_path(self, key: str) -> Path:
+        safe = key.replace("/", "%2F")
+        return self.root / "trees" / f"{safe}.json"
+
+    def kv_path(self, key: str) -> Path:
+        safe = key.replace("/", "%2F")
+        return self.root / "kv" / safe
+
+
+def _state(request: web.Request) -> StoreState:
+    return request.app["store"]
+
+
+# -- blobs -------------------------------------------------------------------
+
+
+async def put_blob(request: web.Request) -> web.Response:
+    st = _state(request)
+    h = request.match_info["hash"]
+    data = await request.read()
+    actual = hashlib.blake2b(data, digest_size=20).hexdigest()
+    if actual != h:
+        return web.json_response({"error": f"hash mismatch: {actual}"},
+                                 status=400)
+    path = st.blob_path(h)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+    return web.json_response({"ok": True, "size": len(data)})
+
+
+async def get_blob(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.blob_path(request.match_info["hash"])
+    if not path.is_file():
+        return web.json_response({"error": "no such blob"}, status=404)
+    return web.FileResponse(path)
+
+
+# -- trees -------------------------------------------------------------------
+
+
+async def tree_diff(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await request.json()
+    files: Dict[str, Dict] = body.get("files", {})
+    missing = sorted({info["hash"] for info in files.values()
+                      if not st.blob_path(info["hash"]).is_file()})
+    return web.json_response({"missing": missing})
+
+
+async def tree_commit(request: web.Request) -> web.Response:
+    st = _state(request)
+    key = unquote(request.match_info["key"])
+    body = await request.json()
+    files: Dict[str, Dict] = body.get("files", {})
+    still_missing = [info["hash"] for info in files.values()
+                     if not st.blob_path(info["hash"]).is_file()]
+    if still_missing:
+        return web.json_response(
+            {"error": "missing blobs", "missing": still_missing}, status=409)
+    path = st.tree_path(key)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps({"files": files, "committed_at": time.time()}))
+    os.replace(tmp, path)
+    return web.json_response({"ok": True, "files": len(files)})
+
+
+async def tree_manifest(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.tree_path(unquote(request.match_info["key"]))
+    if not path.is_file():
+        return web.json_response({"error": "no such tree"}, status=404)
+    return web.Response(body=path.read_bytes(), content_type="application/json")
+
+
+async def tree_delete(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.tree_path(unquote(request.match_info["key"]))
+    existed = path.is_file()
+    if existed:
+        path.unlink()
+    return web.json_response({"ok": True, "existed": existed})
+
+
+# -- KV (tensor leaves / small objects) --------------------------------------
+
+
+async def kv_put(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.kv_path(unquote(request.match_info["key"]))
+    data = await request.read()
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+    meta = {}
+    if "X-KT-Meta" in request.headers:
+        meta = json.loads(request.headers["X-KT-Meta"])
+        path.with_name(path.name + ".meta").write_text(json.dumps(meta))
+    return web.json_response({"ok": True, "size": len(data)})
+
+
+async def kv_get(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.kv_path(unquote(request.match_info["key"]))
+    if not path.is_file():
+        return web.json_response({"error": "no such key"}, status=404)
+    headers = {}
+    meta = path.with_name(path.name + ".meta")
+    if meta.is_file():
+        headers["X-KT-Meta"] = meta.read_text()
+    return web.FileResponse(path, headers=headers)
+
+
+async def kv_delete(request: web.Request) -> web.Response:
+    st = _state(request)
+    path = st.kv_path(unquote(request.match_info["key"]))
+    existed = path.is_file()
+    if existed:
+        path.unlink()
+        meta = path.with_name(path.name + ".meta")
+        if meta.is_file():
+            meta.unlink()
+    return web.json_response({"ok": True, "existed": existed})
+
+
+async def list_keys(request: web.Request) -> web.Response:
+    st = _state(request)
+    prefix = request.query.get("prefix", "")
+    out = []
+    for p in (st.root / "kv").iterdir():
+        if p.name.endswith((".tmp", ".meta")):
+            continue
+        key = p.name.replace("%2F", "/")
+        if key.startswith(prefix):
+            out.append({"key": key, "size": p.stat().st_size, "kind": "kv"})
+    for p in (st.root / "trees").glob("*.json"):
+        key = p.stem.replace("%2F", "/")
+        if key.startswith(prefix):
+            out.append({"key": key, "kind": "tree"})
+    return web.json_response({"keys": sorted(out, key=lambda x: x["key"])})
+
+
+# -- peer registry (MDS role) -------------------------------------------------
+
+
+async def register_peer(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await request.json()
+    st.peers[body["key"]] = {"ip": body["ip"], "port": body.get("port", 8873),
+                             "ts": time.time()}
+    return web.json_response({"ok": True})
+
+
+async def lookup_peer(request: web.Request) -> web.Response:
+    st = _state(request)
+    peer = st.peers.get(unquote(request.match_info["key"]))
+    if peer is None:
+        return web.json_response({"error": "no peer"}, status=404)
+    return web.json_response(peer)
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+def create_store_app(root: str) -> web.Application:
+    app = web.Application(client_max_size=MAX_BODY)
+    app["store"] = StoreState(root)
+    r = app.router
+    r.add_get("/health", health)
+    r.add_put("/blob/{hash}", put_blob)
+    r.add_get("/blob/{hash}", get_blob)
+    r.add_post("/tree/{key:.+}/diff", tree_diff)
+    r.add_post("/tree/{key:.+}/commit", tree_commit)
+    r.add_get("/tree/{key:.+}/manifest", tree_manifest)
+    r.add_delete("/tree/{key:.+}", tree_delete)
+    r.add_put("/kv/{key:.+}", kv_put)
+    r.add_get("/kv/{key:.+}", kv_get)
+    r.add_delete("/kv/{key:.+}", kv_delete)
+    r.add_get("/keys", list_keys)
+    r.add_post("/register", register_peer)
+    r.add_get("/peer/{key:.+}", lookup_peer)
+    return app
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="kubetorch-tpu data store")
+    p.add_argument("--port", type=int, default=8873)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--root", default=os.environ.get("KT_STORE_ROOT", "/data"))
+    args = p.parse_args(argv)
+    web.run_app(create_store_app(args.root), host=args.host, port=args.port,
+                print=lambda *_: None)
+
+
+if __name__ == "__main__":
+    main()

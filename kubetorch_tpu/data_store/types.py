@@ -1,0 +1,33 @@
+"""Data-store types (reference ``data_store/types.py``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List, Optional
+
+
+class Locale(str, Enum):
+    STORE = "store"     # data lives on the central store pod
+    LOCAL = "local"     # zero-copy: data stays put, peers fetch P2P
+
+
+class Lifespan(str, Enum):
+    CLUSTER = "cluster"    # survives the owning workload
+    RESOURCE = "resource"  # garbage-collected with the workload
+
+
+@dataclass
+class BroadcastWindow:
+    """Coordination window for N-party broadcast (reference types.py).
+
+    ``fanout`` defaults mirror the reference: 2 for tensor trees (each hop is
+    a full-bandwidth transfer), 50 for filesystem trees.
+    """
+
+    world_size: int
+    timeout: float = 600.0
+    ips: Optional[List[str]] = None
+    group_id: Optional[str] = None
+    fanout: int = 2
+    pack: bool = True

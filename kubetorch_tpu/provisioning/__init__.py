@@ -1,0 +1,7 @@
+"""Provisioning: TPU slice topology, manifest builders, autoscaling, queues."""
+
+from .tpu_topology import TpuSlice, parse_tpu_spec
+from .manifests import build_deployment_manifest, build_service_manifest
+
+__all__ = ["TpuSlice", "parse_tpu_spec", "build_deployment_manifest",
+           "build_service_manifest"]

@@ -1,0 +1,149 @@
+"""TPU slice topology resolution: ``tpu="v5p-64"`` → schedulable GKE shape.
+
+The TPU-native analog of the reference's GPU spec handling
+(``resources/compute/compute.py`` gpus/gpu_type/gpu_memory): a TPU request is
+not "N devices" but an *atomic slice* — a v5p-64 is 8 hosts × 4 chips wired
+in a 3D ICI torus that must co-schedule (SURVEY §7 hard-part 2). This module
+owns the accelerator table: chips/host, cores/chip, valid topologies, GKE
+machine types and the ``cloud.google.com/gke-tpu-*`` node selectors.
+
+Naming conventions follow Cloud TPU: v4/v5p sizes count *TensorCores*
+(2/chip); v5e/v6e sizes count chips.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    name: str                    # v4 | v5e | v5p | v6e
+    gke_accelerator: str         # node selector value
+    machine_type: str            # GKE TPU VM machine type prefix
+    chips_per_host: int
+    cores_per_chip: int
+    sizes_in_cores: bool         # True: vXp-N counts cores; False: chips
+    topology_3d: bool            # 3D ICI torus (v4/v5p) vs 2D (v5e/v6e)
+    hbm_gb_per_chip: int
+    peak_bf16_tflops: float
+
+
+GENERATIONS: Dict[str, TpuGeneration] = {
+    "v4": TpuGeneration("v4", "tpu-v4-podslice", "ct4p-hightpu-4t",
+                        4, 2, True, True, 32, 275),
+    "v5e": TpuGeneration("v5e", "tpu-v5-lite-podslice", "ct5lp-hightpu-4t",
+                         4, 1, False, False, 16, 197),
+    "v5p": TpuGeneration("v5p", "tpu-v5p-slice", "ct5p-hightpu-4t",
+                         4, 2, True, True, 95, 459),
+    "v6e": TpuGeneration("v6e", "tpu-v6e-slice", "ct6e-standard-4t",
+                         4, 1, False, False, 32, 918),
+}
+
+# Valid 2D topologies for v5e/v6e (chips): x*y grids
+_2D_TOPOLOGIES = {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8",
+                  64: "8x8", 128: "8x16", 256: "16x16"}
+
+
+@dataclass(frozen=True)
+class TpuSlice:
+    generation: TpuGeneration
+    chips: int
+    topology: str            # e.g. "2x4" or "2x2x4"
+    num_hosts: int
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.generation.chips_per_host, self.chips)
+
+    @property
+    def total_hbm_gb(self) -> int:
+        return self.chips * self.generation.hbm_gb_per_chip
+
+    @property
+    def peak_bf16_tflops(self) -> float:
+        return self.chips * self.generation.peak_bf16_tflops
+
+    def node_selectors(self) -> Dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.generation.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.topology,
+        }
+
+    def container_resources(self) -> Dict[str, str]:
+        return {"google.com/tpu": str(self.chips_per_host)}
+
+
+def _3d_topology(chips: int) -> str:
+    """Smallest-surface 3D torus factorization of ``chips`` (each dim ≥ 1,
+    dims multiples of the 4-chip host tray: prefer balanced cubes)."""
+    best: Optional[Tuple[int, int, int]] = None
+    for x in range(1, int(round(chips ** (1 / 3))) + 2):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, int(math.isqrt(rest)) + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            cand = (x, y, z)
+            if best is None or _surface(cand) < _surface(best):
+                best = cand
+    if best is None:
+        best = (1, 1, chips)
+    return "x".join(str(d) for d in best)
+
+
+def _surface(dims: Tuple[int, int, int]) -> int:
+    x, y, z = dims
+    return x * y + y * z + x * z
+
+
+def parse_tpu_spec(spec: str) -> TpuSlice:
+    """``"v5p-64"`` / ``"v5e-8"`` / ``"v5litepod-16"`` / ``"v6e-256"`` →
+    :class:`TpuSlice`. Also accepts explicit topology: ``"v5e:4x4"``."""
+    spec = spec.strip().lower().replace("v5litepod", "v5e").replace("v5lite", "v5e")
+
+    topo_match = re.fullmatch(r"(v\d+[ep]?):(\d+x\d+(?:x\d+)?)", spec)
+    if topo_match:
+        gen_name, topology = topo_match.groups()
+        gen = _generation(gen_name)
+        chips = math.prod(int(d) for d in topology.split("x"))
+        return _slice_for(gen, chips, topology)
+
+    m = re.fullmatch(r"(v\d+[ep]?)-(\d+)", spec)
+    if not m:
+        raise ValueError(
+            f"Unrecognized TPU spec {spec!r}; expected e.g. 'v5p-64', "
+            f"'v5e-8', or 'v5e:4x4'")
+    gen = _generation(m.group(1))
+    size = int(m.group(2))
+    chips = size // gen.cores_per_chip if gen.sizes_in_cores else size
+    if chips < 1:
+        raise ValueError(f"TPU spec {spec!r} resolves to zero chips")
+    return _slice_for(gen, chips, None)
+
+
+def _generation(name: str) -> TpuGeneration:
+    if name not in GENERATIONS:
+        raise ValueError(f"Unknown TPU generation {name!r}; "
+                         f"known: {sorted(GENERATIONS)}")
+    return GENERATIONS[name]
+
+
+def _slice_for(gen: TpuGeneration, chips: int, topology: Optional[str]) -> TpuSlice:
+    if topology is None:
+        if gen.topology_3d:
+            topology = _3d_topology(chips)
+        else:
+            if chips not in _2D_TOPOLOGIES:
+                raise ValueError(
+                    f"{gen.name} slice of {chips} chips is not a valid shape; "
+                    f"valid: {sorted(_2D_TOPOLOGIES)}")
+            topology = _2D_TOPOLOGIES[chips]
+    num_hosts = max(1, chips // gen.chips_per_host)
+    return TpuSlice(generation=gen, chips=chips, topology=topology,
+                    num_hosts=num_hosts)

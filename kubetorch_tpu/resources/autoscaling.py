@@ -1,0 +1,63 @@
+"""Autoscaling configuration → Knative annotations.
+
+Reference (``provisioning/autoscaling.py``): a validated bag of Knative KPA/
+HPA knobs emitted as ``autoscaling.knative.dev/*`` annotations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+VALID_METRICS = ("concurrency", "rps", "cpu", "memory")
+
+
+@dataclass
+class AutoscalingConfig:
+    target: Optional[int] = None
+    metric: str = "concurrency"
+    window: Optional[str] = None            # e.g. "60s"
+    min_scale: int = 0
+    max_scale: Optional[int] = None
+    initial_scale: Optional[int] = None
+    scale_down_delay: Optional[str] = None
+    scale_to_zero_retention: Optional[str] = None
+    container_concurrency: Optional[int] = None
+
+    def __post_init__(self):
+        if self.metric not in VALID_METRICS:
+            raise ValueError(f"metric must be one of {VALID_METRICS}")
+        if self.min_scale < 0:
+            raise ValueError("min_scale must be >= 0")
+        if self.max_scale is not None and self.max_scale < max(self.min_scale, 1):
+            raise ValueError("max_scale must be >= max(min_scale, 1)")
+        for name in ("window", "scale_down_delay", "scale_to_zero_retention"):
+            v = getattr(self, name)
+            if v is not None and not str(v).endswith(("s", "m", "h")):
+                raise ValueError(f"{name} must be a duration like '60s'")
+
+    @property
+    def autoscaler_class(self) -> str:
+        # cpu/memory need the HPA class; concurrency/rps use KPA
+        return "hpa.autoscaling.knative.dev" if self.metric in ("cpu", "memory") \
+            else "kpa.autoscaling.knative.dev"
+
+    def annotations(self) -> Dict[str, str]:
+        pre = "autoscaling.knative.dev"
+        out = {f"{pre}/class": self.autoscaler_class,
+               f"{pre}/metric": self.metric,
+               f"{pre}/min-scale": str(self.min_scale)}
+        if self.target is not None:
+            out[f"{pre}/target"] = str(self.target)
+        if self.window:
+            out[f"{pre}/window"] = self.window
+        if self.max_scale is not None:
+            out[f"{pre}/max-scale"] = str(self.max_scale)
+        if self.initial_scale is not None:
+            out[f"{pre}/initial-scale"] = str(self.initial_scale)
+        if self.scale_down_delay:
+            out[f"{pre}/scale-down-delay"] = self.scale_down_delay
+        if self.scale_to_zero_retention:
+            out[f"{pre}/scale-to-zero-pod-retention-period"] = \
+                self.scale_to_zero_retention
+        return out

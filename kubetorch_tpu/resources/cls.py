@@ -1,0 +1,40 @@
+"""Cls: remote class proxy — every public method becomes a remote call
+(reference ``resources/callables/cls/cls.py``: __getattr__ :54-68, server-side
+instantiation with init args)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Type
+
+from .module import Module, module_factory
+
+
+class Cls(Module):
+    callable_type = "cls"
+
+    def __getattr__(self, attr: str) -> Any:
+        # only called when normal lookup fails → remote method proxy
+        if attr.startswith("_"):
+            raise AttributeError(attr)
+
+        def remote_method(*args, workers=None, timeout=None, **kwargs):
+            if self.service_url is None:
+                raise RuntimeError(
+                    f"{self.pointers.cls_or_fn_name} is not deployed; call "
+                    f".to(kt.Compute(...)) first")
+            return self._http_client().call_method(
+                self.pointers.cls_or_fn_name, method=attr, args=args,
+                kwargs=kwargs, workers=workers, timeout=timeout)
+
+        remote_method.__name__ = attr
+        return remote_method
+
+
+def cls(klass: Type, name: Optional[str] = None, init_args: Optional[list] = None,
+        init_kwargs: Optional[dict] = None) -> Cls:
+    """``kt.cls(Model, init_kwargs={...})`` → remote stateful service; the
+    instance is constructed server-side in the rank subprocess."""
+    ia = None
+    if init_args or init_kwargs:
+        ia = {"args": list(init_args or []), "kwargs": init_kwargs or {}}
+    return module_factory(klass, name=name, init_args=ia, cls_type=Cls)

@@ -1,0 +1,210 @@
+"""Compute: declarative resource spec → running service.
+
+Reference (``resources/compute/compute.py``, 2798 LoC) with the accelerator
+model inverted: ``tpu="v5p-64"`` is the first-class spec (an atomic slice —
+replicas = slice hosts, co-scheduled), ``gpus=`` is accepted for API
+compatibility but routes to a plain device-count request.
+
+``.distribute()`` gains the ``mesh`` argument — on TPU, parallelism is a
+launcher concern (SURVEY §2.4: the reference has no TP/PP/SP/EP because torch
+delegates them to user code; JAX does not).
+"""
+
+from __future__ import annotations
+
+import copy
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from ..client import controller_client
+from ..config import config
+from ..exceptions import ServiceTimeoutError
+from ..parallel.mesh import DistributedConfig
+from ..provisioning.manifests import (build_deployment_manifest,
+                                      build_pod_template)
+from ..provisioning.tpu_topology import TpuSlice, parse_tpu_spec
+from .autoscaling import AutoscalingConfig
+from .image import Image
+
+
+class Compute:
+    def __init__(self,
+                 cpus: Optional[Union[int, str]] = None,
+                 memory: Optional[str] = None,
+                 tpu: Optional[str] = None,
+                 gpus: Optional[int] = None,
+                 image: Optional[Image] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 volumes: Optional[List] = None,
+                 secrets: Optional[List] = None,
+                 node_selector: Optional[Dict[str, str]] = None,
+                 tolerations: Optional[List[Dict]] = None,
+                 inactivity_ttl: Optional[int] = None,
+                 queue_name: Optional[str] = None,
+                 namespace: Optional[str] = None,
+                 selector: Optional[Dict[str, str]] = None,
+                 launch_timeout: Optional[int] = None,
+                 shm_size: Optional[str] = "8Gi"):
+        self.cpus = cpus
+        self.memory = memory
+        self.tpu_spec = tpu
+        self.tpu: Optional[TpuSlice] = parse_tpu_spec(tpu) if tpu else None
+        self.gpus = gpus
+        self.image = image or Image()
+        self.env = dict(env or {})
+        self.volumes = list(volumes or [])
+        self.secrets = list(secrets or [])
+        self.node_selector = dict(node_selector or {})
+        self.tolerations = tolerations
+        self.inactivity_ttl = inactivity_ttl
+        self.queue_name = queue_name
+        self.namespace = namespace or config().namespace
+        self.selector = selector            # BYO mode: no manifest, just route
+        self.launch_timeout = launch_timeout or config().launch_timeout
+        self.shm_size = shm_size
+        self.autoscaling: Optional[AutoscalingConfig] = None
+        self.distributed: Optional[DistributedConfig] = None
+        # merge cluster-wide defaults (reference compute.py:1963), routed
+        # through the same parsing the constructor kwargs get
+        for key, val in controller_defaults().items():
+            if key == "tpu":
+                if self.tpu is None and val:
+                    self.tpu_spec = val
+                    self.tpu = parse_tpu_spec(val)
+            elif getattr(self, key, None) in (None, {}, []):
+                setattr(self, key, val)
+
+    # -- fluent config --------------------------------------------------------
+
+    def distribute(self, distribution_type: str = "jax",
+                   workers: Optional[int] = None,
+                   procs_per_worker: Optional[int] = None,
+                   mesh: Optional[Dict[str, int]] = None,
+                   restart_procs: bool = False) -> "Compute":
+        """Declare the distribution strategy.
+
+        ``workers`` defaults to the TPU slice's host count — a v5p-64 is
+        8 hosts, so ``Compute(tpu="v5p-64").distribute("jax")`` is complete.
+        """
+        new = self.clone()
+        if workers is None:
+            workers = new.tpu.num_hosts if new.tpu is not None else 1
+        new.distributed = DistributedConfig(
+            distribution_type=distribution_type, workers=workers,
+            procs_per_worker=procs_per_worker, mesh=mesh,
+            restart_procs=restart_procs)
+        return new
+
+    def autoscale(self, **kwargs) -> "Compute":
+        new = self.clone()
+        new.autoscaling = AutoscalingConfig(**kwargs)
+        return new
+
+    def clone(self) -> "Compute":
+        return copy.deepcopy(self)
+
+    # -- derived --------------------------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        if self.distributed is not None:
+            return max(self.distributed.workers, 1)
+        if self.tpu is not None:
+            return self.tpu.num_hosts
+        return 1
+
+    def distributed_config_dict(self) -> Optional[Dict]:
+        return self.distributed.to_dict() if self.distributed else None
+
+    @property
+    def deployment_mode(self) -> str:
+        if self.selector is not None:
+            return "byo"
+        if self.autoscaling is not None:
+            return "knative"
+        if self.tpu is not None and self.tpu.num_hosts > 1:
+            return "jobset"
+        return "deployment"
+
+    # -- manifest -------------------------------------------------------------
+
+    def pod_spec(self, env: Dict[str, str], command: Optional[List[str]] = None,
+                 debug: bool = False) -> Dict[str, Any]:
+        merged_env = {**self.env, **env}
+        for secret in self.secrets:
+            merged_env.update(getattr(secret, "env_vars", lambda: {})())
+        return build_pod_template(
+            name="kt", image=self.image.base, env=merged_env,
+            cpus=self.cpus, memory=self.memory, tpu=self.tpu,
+            node_selector=self.node_selector, tolerations=self.tolerations,
+            volumes=[v.mount_spec() if hasattr(v, "mount_spec") else v
+                     for v in self.volumes],
+            shm_size=self.shm_size, launch_timeout=self.launch_timeout,
+            debug=debug, command=command)
+
+    def manifest(self, name: str, env: Dict[str, str],
+                 command: Optional[List[str]] = None) -> Dict[str, Any]:
+        pod_spec = self.pod_spec(env, command)
+        mode = self.deployment_mode
+        if mode == "knative":
+            from ..provisioning.manifests import build_knative_manifest
+            return build_knative_manifest(
+                name, self.namespace, pod_spec,
+                self.autoscaling.annotations(), username=config().username)
+        if mode == "jobset":
+            from ..provisioning.manifests import build_jobset_manifest
+            return build_jobset_manifest(name, self.namespace, self.tpu,
+                                         pod_spec, username=config().username)
+        return build_deployment_manifest(
+            name, self.namespace, self.replicas, pod_spec,
+            username=config().username, queue_name=self.queue_name,
+            annotations=({"kubetorch.com/inactivity-ttl": str(self.inactivity_ttl)}
+                         if self.inactivity_ttl else None))
+
+    # -- launch ---------------------------------------------------------------
+
+    def _launch(self, name: str, metadata: Dict[str, Any],
+                launch_id: Optional[str] = None) -> Dict[str, Any]:
+        """Deploy through the controller (reference ``_launch`` :2006)."""
+        launch_id = launch_id or uuid.uuid4().hex
+        client = controller_client()
+        if self.selector is not None:
+            return client.register_workload(
+                self.namespace, name, metadata, selector=self.selector,
+                launch_id=launch_id)
+        manifest = self.manifest(name, env={})
+        return client.deploy(self.namespace, name, manifest, metadata,
+                             launch_id, inactivity_ttl=self.inactivity_ttl,
+                             expected_pods=self.replicas,
+                             timeout=self.launch_timeout)
+
+    def _check_service_ready(self, name: str, timeout: Optional[float] = None) -> None:
+        import time as _time
+
+        client = controller_client()
+        deadline = _time.monotonic() + (timeout or self.launch_timeout)
+        delay = 0.25
+        while _time.monotonic() < deadline:
+            status = client.check_ready(self.namespace, name)
+            if status.get("ready"):
+                return
+            _time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+        raise ServiceTimeoutError(
+            f"Service {name!r} not ready after {timeout or self.launch_timeout}s")
+
+    def teardown(self, name: str) -> None:
+        controller_client().delete_workload(self.namespace, name)
+
+
+def controller_defaults() -> Dict[str, Any]:
+    """Cluster-wide Compute defaults from the controller ConfigMap
+    (reference ``service_manager.py:803``). Only consulted when a controller
+    is already configured — constructing a Compute must never auto-start one.
+    """
+    if not config().api_url:
+        return {}
+    try:
+        return controller_client().cluster_config().get("compute_defaults", {})
+    except Exception:
+        return {}

@@ -1,0 +1,34 @@
+"""Fn: remote function proxy (reference ``resources/callables/fn/fn.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .module import Module, module_factory
+
+
+class Fn(Module):
+    callable_type = "fn"
+
+    def __call__(self, *args, workers=None, timeout: Optional[float] = None,
+                 stream_logs: Optional[bool] = None,
+                 debugger: Optional[dict] = None, **kwargs) -> Any:
+        if self.service_url is None:
+            raise RuntimeError(
+                f"{self.pointers.cls_or_fn_name} is not deployed; call "
+                f".to(kt.Compute(...)) first")
+        return self._http_client().call_method(
+            self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
+            workers=workers, timeout=timeout, stream_logs=stream_logs,
+            debugger=debugger)
+
+    async def call_async(self, *args, workers=None,
+                         timeout: Optional[float] = None, **kwargs) -> Any:
+        return await self._http_client().call_method_async(
+            self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
+            workers=workers, timeout=timeout)
+
+
+def fn(function: Callable, name: Optional[str] = None) -> Fn:
+    """``kt.fn(train)`` → deployable remote function."""
+    return module_factory(function, name=name, cls_type=Fn)

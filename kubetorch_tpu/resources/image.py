@@ -1,0 +1,95 @@
+"""Image: a pseudo-Dockerfile whose instructions replay inside running pods.
+
+Reference (``resources/images/image.py``): the Image is not (only) a build
+recipe — its instruction list is diffed and replayed *inside live pods* by
+the image-setup cache, which is what makes `pip_install` changes land in
+seconds without a rebuild (SURVEY §2.5, §3.4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+DEFAULT_BASE = "python:3.12-slim"
+
+
+@dataclass
+class Instruction:
+    kind: str           # RUN | ENV | COPY | CMD | SYNC
+    value: str
+
+    def render(self) -> str:
+        return f"{self.kind} {self.value}"
+
+
+class Image:
+    def __init__(self, base: str = DEFAULT_BASE):
+        self.base = base
+        self.instructions: List[Instruction] = []
+        self.env_vars: Dict[str, str] = {}
+
+    # -- builders (chainable) -------------------------------------------------
+
+    @classmethod
+    def from_docker(cls, image: str) -> "Image":
+        return cls(base=image)
+
+    @classmethod
+    def from_dockerfile(cls, path: str) -> "Image":
+        img = cls()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.upper().startswith("FROM "):
+                    img.base = line.split(None, 1)[1]
+                else:
+                    kind, _, value = line.partition(" ")
+                    img.instructions.append(Instruction(kind.upper(), value))
+        return img
+
+    def pip_install(self, packages: List[str] | str) -> "Image":
+        if isinstance(packages, str):
+            packages = [packages]
+        self.instructions.append(
+            Instruction("RUN", "$KT_PIP_INSTALL_CMD " + " ".join(packages)))
+        return self
+
+    def run_bash(self, command: str) -> "Image":
+        self.instructions.append(Instruction("RUN", command))
+        return self
+
+    def set_env_vars(self, env: Dict[str, str]) -> "Image":
+        self.env_vars.update(env)
+        for k, v in env.items():
+            self.instructions.append(Instruction("ENV", f"{k}={v}"))
+        return self
+
+    def copy(self, src: str, dest: str) -> "Image":
+        self.instructions.append(Instruction("COPY", f"{src} {dest}"))
+        return self
+
+    def sync_package(self, package: str) -> "Image":
+        self.instructions.append(Instruction("SYNC", package))
+        return self
+
+    def rsync(self, src: str, dest: str) -> "Image":
+        # kept for API parity; sync is the native mechanism
+        self.instructions.append(Instruction("SYNC", f"{src} {dest}"))
+        return self
+
+    def cmd(self, command: str) -> "Image":
+        self.instructions.append(Instruction("CMD", command))
+        return self
+
+    # -- rendering ------------------------------------------------------------
+
+    def dockerfile(self) -> str:
+        lines = [f"FROM {self.base}"]
+        lines += [ins.render() for ins in self.instructions]
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"Image(base={self.base!r}, instructions={len(self.instructions)})"

@@ -1,0 +1,26 @@
+"""Builtin image presets (reference ``resources/images/images.py``)."""
+
+from .image import Image
+
+
+def debian() -> Image:
+    return Image.from_docker("debian:bookworm-slim").run_bash(
+        "apt-get update && apt-get install -y python3 python3-pip")
+
+
+def python(version: str = "3.12") -> Image:
+    return Image.from_docker(f"python:{version}-slim")
+
+
+def jax_tpu() -> Image:
+    """The TPU workhorse: libtpu-bundled JAX on a slim python base."""
+    return Image.from_docker("python:3.12-slim").pip_install(
+        ["jax[tpu]", "flax", "optax", "orbax-checkpoint"])
+
+
+def pytorch() -> Image:
+    return Image.from_docker("pytorch/pytorch:latest")
+
+
+def ray() -> Image:
+    return Image.from_docker("rayproject/ray:latest")

@@ -1,0 +1,167 @@
+"""Module: the deployable wrapper around a user callable.
+
+Reference (``resources/callables/module.py``): ``.to(compute)`` is the
+product's core verb — extract pointers, sync code, assemble metadata, launch
+through the controller, wait for health — and a second ``.to()`` with the
+same name is the 1-2s hot-reload loop (SURVEY §3.1/§3.4).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ..client import controller_client
+from ..config import config
+from ..exceptions import ServiceHealthError, ServiceTimeoutError
+from ..serving.http_client import HTTPClient
+from ..utils.naming import service_name_for
+from .compute import Compute
+from .pointers import Pointers, extract_pointers
+
+
+class Module:
+    callable_type = "fn"
+
+    def __init__(self, pointers: Pointers, name: Optional[str] = None,
+                 init_args: Optional[Dict] = None):
+        self.pointers = pointers
+        self.name = service_name_for(pointers.cls_or_fn_name,
+                                     username=config().username, name=name)
+        self.init_args = init_args
+        self.compute: Optional[Compute] = None
+        self.service_url: Optional[str] = None
+        self.launch_id: Optional[str] = None
+        self._client: Optional[HTTPClient] = None
+
+    # -- deploy ---------------------------------------------------------------
+
+    def to(self, compute: Compute, name: Optional[str] = None,
+           sync_code: bool = True) -> "Module":
+        """Deploy (or hot-reload) this callable onto the given compute."""
+        if name:
+            self.name = service_name_for(self.pointers.cls_or_fn_name,
+                                         username=config().username, name=name)
+        self.compute = compute
+        launch_id = uuid.uuid4().hex
+
+        if sync_code:
+            self._sync_code()
+
+        result = compute._launch(self.name, self._metadata(), launch_id)
+        self.launch_id = result.get("launch_id", launch_id)
+        self.service_url = result.get("service_url")
+        compute._check_service_ready(self.name)
+        self._wait_for_http_health()
+        return self
+
+    async def to_async(self, compute: Compute, **kwargs) -> "Module":
+        import asyncio
+        return await asyncio.to_thread(self.to, compute, **kwargs)
+
+    def _metadata(self) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {
+            "KT_PROJECT_ROOT": self._remote_root(),
+            "KT_MODULE_NAME": self.pointers.module_name,
+            "KT_FILE_PATH": self.pointers.file_path,
+            "KT_CLS_OR_FN_NAME": self.pointers.cls_or_fn_name,
+            "KT_CALLABLE_TYPE": self.callable_type,
+            "KT_SERVICE_NAME": self.name,
+        }
+        if self.init_args:
+            meta["KT_INIT_ARGS"] = self.init_args
+        if self.compute and self.compute.distributed is not None:
+            meta["KT_DISTRIBUTED_CONFIG"] = self.compute.distributed.to_dict()
+        if self.compute:
+            meta["KT_DOCKERFILE"] = self.compute.image.dockerfile()
+        ser_cfg = config().serialization
+        if ser_cfg and ser_cfg != "json":
+            meta["KT_ALLOWED_SERIALIZATION"] = f"json,msgpack,none,{ser_cfg}"
+        return meta
+
+    def _remote_root(self) -> str:
+        """Where the pod finds the synced project tree. Local backend pods
+        share this filesystem, so the local root is directly importable; real
+        pods pull from the data store to /kt/app."""
+        if config().api_url and "127.0.0.1" in config().api_url:
+            return self.pointers.project_root
+        if config().local_mode or not config().api_url:
+            return self.pointers.project_root
+        return "/kt/app"
+
+    def _sync_code(self) -> None:
+        """Ship the working dir to the data store (reference SURVEY §3.1
+        RSYNC step). No-op when pods share our filesystem (local backend) or
+        no data store is configured."""
+        store = config().data_store_url
+        if not store:
+            return
+        from ..data_store.sync import push_tree
+        push_tree(store, f"__code__/{self.name}", self.pointers.project_root)
+
+    # -- health ---------------------------------------------------------------
+
+    def _wait_for_http_health(self, timeout: Optional[float] = None) -> None:
+        """Poll /ready?launch_id until the deployed launch answers
+        (reference ``_wait_for_http_health`` :1424)."""
+        if self.service_url is None:
+            record = controller_client().get_workload(
+                self.compute.namespace, self.name)
+            self.service_url = record.get("service_url")
+        if self.service_url is None:
+            raise ServiceHealthError(f"No service URL for {self.name!r}")
+        client = self._http_client()
+        deadline = time.monotonic() + (timeout or
+                                       (self.compute.launch_timeout
+                                        if self.compute else 900))
+        delay = 0.2
+        while time.monotonic() < deadline:
+            if client.is_ready(self.launch_id):
+                return
+            time.sleep(delay)
+            delay = min(delay * 2, 3.0)
+        raise ServiceTimeoutError(
+            f"Service {self.name!r} at {self.service_url} never became ready "
+            f"for launch {self.launch_id}")
+
+    def _http_client(self) -> HTTPClient:
+        if self._client is None or self._client.base_url != self.service_url:
+            self._client = HTTPClient(self.service_url)
+        return self._client
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def from_name(cls, name: str, namespace: Optional[str] = None) -> "Module":
+        """Reattach to a deployed service (reference ``from_name`` :338)."""
+        record = controller_client().get_workload(
+            namespace or config().namespace, name)
+        meta = record.get("metadata", {})
+        pointers = Pointers(
+            project_root=meta.get("KT_PROJECT_ROOT", ""),
+            module_name=meta.get("KT_MODULE_NAME", ""),
+            file_path=meta.get("KT_FILE_PATH", ""),
+            cls_or_fn_name=meta.get("KT_CLS_OR_FN_NAME", ""),
+        )
+        mod = cls.__new__(cls)
+        Module.__init__(mod, pointers, name=name)
+        mod.name = name
+        mod.service_url = record.get("service_url")
+        mod.launch_id = record.get("launch_id")
+        return mod
+
+    def teardown(self) -> None:
+        controller_client().delete_workload(
+            self.compute.namespace if self.compute else config().namespace,
+            self.name)
+        self.service_url = None
+        self._client = None
+
+
+def module_factory(obj: Any, name: Optional[str] = None,
+                   init_args: Optional[Dict] = None,
+                   cls_type: type = Module) -> Module:
+    pointers = extract_pointers(obj)
+    return cls_type(pointers, name=name, init_args=init_args)

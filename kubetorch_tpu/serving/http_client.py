@@ -1,0 +1,159 @@
+"""Client-side HTTP caller for deployed services.
+
+Reference (``serving/http_client.py``, 1132 LoC): request preparation with
+serialization headers, sync/async call paths, WS log streaming filtered by
+X-Request-ID, and exception rehydration that reconstructs the remote error
+type on the caller's side.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+import requests as _requests
+
+from .. import serialization as ser
+from ..config import config
+from ..exceptions import ControllerRequestError, rehydrate_exception
+
+
+class CustomResponse:
+    """Wraps a response; raise_for_status rehydrates remote exceptions
+    (reference http_client.py:87-194)."""
+
+    def __init__(self, status: int, body: bytes, headers: Dict[str, str]):
+        self.status = status
+        self.body = body
+        self.headers = headers
+
+    def raise_for_status(self) -> None:
+        if self.status < 400:
+            return
+        try:
+            data = json.loads(self.body.decode())
+        except (ValueError, UnicodeDecodeError):
+            raise ControllerRequestError(
+                f"HTTP {self.status}: {self.body[:500]!r}", status_code=self.status)
+        if "error_type" in data:
+            raise rehydrate_exception(data)
+        raise ControllerRequestError(f"HTTP {self.status}: {data}",
+                                     status_code=self.status)
+
+    def result(self) -> Any:
+        self.raise_for_status()
+        fmt = self.headers.get("X-Serialization", ser.JSON)
+        return ser.deserialize(self.body, fmt)
+
+
+class HTTPClient:
+    """Caller for one deployed service."""
+
+    def __init__(self, base_url: str, serialization: Optional[str] = None,
+                 stream_logs: Optional[bool] = None):
+        self.base_url = base_url.rstrip("/")
+        self.serialization = serialization or config().serialization
+        self.stream_logs = (config().stream_logs if stream_logs is None
+                            else stream_logs)
+        self._session = _requests.Session()
+
+    # -- calls ----------------------------------------------------------------
+
+    def call_method(self, fn_name: str, method: Optional[str] = None,
+                    args: tuple = (), kwargs: Optional[dict] = None,
+                    workers=None, timeout: Optional[float] = None,
+                    debugger: Optional[dict] = None,
+                    stream_logs: Optional[bool] = None) -> Any:
+        body: Dict[str, Any] = {"args": list(args), "kwargs": kwargs or {}}
+        if workers is not None:
+            body["_kt_workers"] = workers
+        if debugger:
+            body["debugger"] = debugger
+        request_id = uuid.uuid4().hex[:16]
+        url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")
+
+        stop_streaming = None
+        if (self.stream_logs if stream_logs is None else stream_logs):
+            stop_streaming = self._start_log_stream(request_id)
+        try:
+            resp = self._session.post(
+                url,
+                data=ser.serialize(body, self.serialization),
+                headers={"X-Serialization": self.serialization,
+                         "X-Request-ID": request_id},
+                timeout=timeout,
+            )
+        finally:
+            if stop_streaming:
+                stop_streaming()
+        return CustomResponse(resp.status_code, resp.content,
+                              dict(resp.headers)).result()
+
+    async def call_method_async(self, fn_name: str, method: Optional[str] = None,
+                                args: tuple = (), kwargs: Optional[dict] = None,
+                                workers=None, timeout: Optional[float] = None) -> Any:
+        import aiohttp
+
+        body: Dict[str, Any] = {"args": list(args), "kwargs": kwargs or {}}
+        if workers is not None:
+            body["_kt_workers"] = workers
+        url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                url, data=ser.serialize(body, self.serialization),
+                headers={"X-Serialization": self.serialization,
+                         "X-Request-ID": uuid.uuid4().hex[:16]},
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                return CustomResponse(resp.status, await resp.read(),
+                                      dict(resp.headers)).result()
+
+    # -- health ---------------------------------------------------------------
+
+    def is_ready(self, launch_id: Optional[str] = None,
+                 timeout: float = 2.0) -> bool:
+        try:
+            params = {"launch_id": launch_id} if launch_id else {}
+            r = self._session.get(f"{self.base_url}/ready", params=params,
+                                  timeout=timeout)
+            return r.status_code == 200
+        except _requests.RequestException:
+            return False
+
+    # -- log streaming --------------------------------------------------------
+
+    def _start_log_stream(self, request_id: str):
+        """Poll the controller's log buffer for this request's lines and echo
+        them locally (reference streams from Loki over WS; our controller
+        exposes the same data over HTTP long-poll)."""
+        api = config().api_url
+        if not api:
+            return None
+        stop = threading.Event()
+
+        def pump():
+            seen = 0
+            while not stop.is_set():
+                try:
+                    r = _requests.get(
+                        f"{api}/controller/logs",
+                        params={"request_id": request_id, "offset": seen},
+                        timeout=5)
+                    if r.status_code == 200:
+                        data = r.json()
+                        for entry in data.get("entries", []):
+                            print(f"[remote] {entry['line']}")
+                        seen = data.get("offset", seen)
+                except _requests.RequestException:
+                    pass
+                stop.wait(0.5)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+
+        def stopper():
+            stop.set()
+
+        return stopper

@@ -150,15 +150,20 @@ class ServerState:
                 await asyncio.to_thread(self.supervisor.cleanup)
                 self.supervisor = None
                 self._supervisor_key = None
-        # purge the user's modules so the fresh code is imported
-        root = os.environ.get(KT_PROJECT_ROOT)
-        if root:
-            for name, mod in list(sys.modules.items()):
-                f = getattr(mod, "__file__", None)
-                if f and f.startswith(root) and "site-packages" not in f:
-                    sys.modules.pop(name, None)
-        self.launch_id = launch_id
-        os.environ[KT_LAUNCH_ID] = launch_id
+            # purge the user's modules under the same lock so a queued call
+            # can't rebuild a supervisor from the stale module cache. Never
+            # purge the runtime itself or __main__ (mp spawn needs it, and
+            # the user's project root may contain this package).
+            root = os.environ.get(KT_PROJECT_ROOT)
+            if root:
+                for name, mod in list(sys.modules.items()):
+                    if name == "__main__" or name.split(".")[0] == "kubetorch_tpu":
+                        continue
+                    f = getattr(mod, "__file__", None)
+                    if f and f.startswith(root) and "site-packages" not in f:
+                        sys.modules.pop(name, None)
+            self.launch_id = launch_id
+            os.environ[KT_LAUNCH_ID] = launch_id
 
     async def _sync_code(self) -> None:
         """Pull latest code from the data store (reference rsync pull :1140)."""

@@ -1,0 +1,117 @@
+"""Delta-sync protocol + store server (reference test_store.py model)."""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubetorch_tpu.data_store.sync import build_manifest, push_tree, pull_tree
+from kubetorch_tpu.exceptions import SyncError
+from kubetorch_tpu.utils.procs import free_port, kill_process_tree, wait_for_port
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    root = tmp_path_factory.mktemp("store")
+    port = free_port()
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+         "--host", "127.0.0.1", "--port", str(port), "--root", str(root)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    assert wait_for_port("127.0.0.1", port, timeout=30)
+    yield f"http://127.0.0.1:{port}"
+    kill_process_tree(proc.pid)
+
+
+@pytest.fixture
+def project(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "mod.py").write_text("x = 1\n")
+    (tmp_path / "main.py").write_text("print('hello')\n")
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.pyc").write_text("junk")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "HEAD").write_text("ref")
+    return tmp_path
+
+
+def test_manifest_excludes(project):
+    m = build_manifest(str(project))
+    assert set(m) == {"pkg/mod.py", "main.py"}
+    assert all("hash" in v and "size" in v for v in m.values())
+
+
+@pytest.mark.slow
+def test_push_pull_roundtrip(store, project, tmp_path_factory):
+    stats = push_tree(store, "code/svc1", str(project))
+    assert stats == {"files": 2, "uploaded": 2,
+                     "uploaded_bytes": stats["uploaded_bytes"]}
+
+    dest = tmp_path_factory.mktemp("dest")
+    out = pull_tree(store, "code/svc1", str(dest))
+    assert out["files"] == 2 and out["fetched"] == 2
+    assert (dest / "pkg" / "mod.py").read_text() == "x = 1\n"
+    assert (dest / "main.py").read_text() == "print('hello')\n"
+
+
+@pytest.mark.slow
+def test_delta_push_only_changed(store, project, tmp_path_factory):
+    push_tree(store, "code/svc2", str(project))
+    # no-op push: nothing uploaded
+    stats = push_tree(store, "code/svc2", str(project))
+    assert stats["uploaded"] == 0
+    # change one file
+    (project / "main.py").write_text("print('v2')\n")
+    stats = push_tree(store, "code/svc2", str(project))
+    assert stats["uploaded"] == 1
+
+    dest = tmp_path_factory.mktemp("dest2")
+    pull_tree(store, "code/svc2", str(dest))
+    # delta pull: only the changed file
+    (project / "pkg" / "mod.py").write_text("x = 3\n")
+    push_tree(store, "code/svc2", str(project))
+    out = pull_tree(store, "code/svc2", str(dest))
+    assert out["fetched"] == 1
+    assert (dest / "pkg" / "mod.py").read_text() == "x = 3\n"
+
+
+@pytest.mark.slow
+def test_pull_deletes_removed_files(store, project, tmp_path_factory):
+    push_tree(store, "code/svc3", str(project))
+    dest = tmp_path_factory.mktemp("dest3")
+    pull_tree(store, "code/svc3", str(dest))
+    assert (dest / "main.py").exists()
+    # user-created file must survive; synced-then-removed file must go
+    (dest / "user_scratch.txt").write_text("mine")
+    (project / "main.py").unlink()
+    push_tree(store, "code/svc3", str(project))
+    out = pull_tree(store, "code/svc3", str(dest))
+    assert out["deleted"] == 1
+    assert not (dest / "main.py").exists()
+    assert (dest / "user_scratch.txt").exists()
+
+
+@pytest.mark.slow
+def test_pull_missing_tree_raises(store, tmp_path):
+    with pytest.raises(SyncError, match="No tree"):
+        pull_tree(store, "code/nope", str(tmp_path / "x"))
+
+
+@pytest.mark.slow
+def test_kv_roundtrip(store):
+    import requests
+    r = requests.put(f"{store}/kv/ckpt/layer0.w", data=b"\x00\x01\x02",
+                     headers={"X-KT-Meta": '{"dtype": "float32"}'})
+    assert r.status_code == 200
+    r = requests.get(f"{store}/kv/ckpt/layer0.w")
+    assert r.content == b"\x00\x01\x02"
+    assert "float32" in r.headers["X-KT-Meta"]
+    r = requests.get(f"{store}/keys", params={"prefix": "ckpt/"})
+    assert [k["key"] for k in r.json()["keys"]] == ["ckpt/layer0.w"]
+    requests.delete(f"{store}/kv/ckpt/layer0.w")
+    assert requests.get(f"{store}/kv/ckpt/layer0.w").status_code == 404

@@ -1,0 +1,443 @@
+"""``kt`` CLI (reference ``cli.py``, 2933 LoC, typer → click here).
+
+Command surface parity (reference line refs in SURVEY §2.10): check, config,
+deploy, call, describe, list, apply, run, debug, ssh, teardown, logs,
+put/get/ls/rm, secrets, volumes, workload, port-forward, server start.
+Run as ``python -m kubetorch_tpu.cli`` (or install the ``kt`` entry point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+import click
+
+from .config import config as kt_config, reset_config
+
+
+@click.group()
+def cli():
+    """kubetorch-tpu: TPU-native compute dispatch."""
+
+
+# -- check -------------------------------------------------------------------
+
+
+@cli.command()
+def check():
+    """Doctor: verify client, controller, backend, and TPU visibility."""
+    cfg = kt_config()
+    click.echo(f"config file      : {cfg.config_dir}/config")
+    click.echo(f"namespace        : {cfg.namespace}")
+    click.echo(f"api_url          : {cfg.api_url or '(local controller)'}")
+    try:
+        from .client import controller_client
+        client = controller_client()
+        click.echo(f"controller       : OK ({client.base_url}, "
+                   f"v{client.version()})")
+    except Exception as e:
+        click.echo(f"controller       : UNREACHABLE ({e})")
+    try:
+        from .controller.backends import KubernetesBackend
+        k8s = KubernetesBackend.available()
+        click.echo(f"kubernetes       : {'available' if k8s else 'not configured'}")
+    except Exception:
+        click.echo("kubernetes       : not configured")
+    try:
+        import jax
+        devs = jax.devices()
+        click.echo(f"jax devices      : {devs}")
+    except Exception as e:
+        click.echo(f"jax devices      : ERROR ({e})")
+
+
+# -- config ------------------------------------------------------------------
+
+
+@cli.group("config")
+def config_group():
+    """Get/set client configuration."""
+
+
+@config_group.command("get")
+@click.argument("key", required=False)
+def config_get(key):
+    cfg = kt_config()
+    if key:
+        click.echo(cfg.get(key))
+    else:
+        from dataclasses import fields
+        for f in fields(cfg):
+            if f.name != "extra":
+                click.echo(f"{f.name}: {getattr(cfg, f.name)}")
+
+
+@config_group.command("set")
+@click.argument("key")
+@click.argument("value")
+def config_set(key, value):
+    cfg = kt_config()
+    cfg.set(key, value)
+    cfg.save()
+    click.echo(f"{key} = {value}")
+
+
+# -- deploy ------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("target")
+def deploy(target):
+    """Deploy all @kt.compute-decorated callables in a python file."""
+    os.environ["KT_CLI_DEPLOY_MODE"] = "1"
+    reset_config()
+    from .resources.decorators import clear_registry, collected_modules
+
+    clear_registry()
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("__kt_deploy__", target)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["__kt_deploy__"] = mod
+    spec.loader.exec_module(mod)
+    partials = collected_modules()
+    if not partials:
+        click.echo("No @kt.compute-decorated callables found.")
+        return
+    for pm in partials:
+        module, compute = pm.build()
+        click.echo(f"Deploying {module.name} ...")
+        module.to(compute)
+        click.echo(f"  → {module.service_url}")
+
+
+# -- call --------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("service")
+@click.argument("method", required=False)
+@click.option("--args", "args_json", default="[]", help="JSON args list")
+@click.option("--kwargs", "kwargs_json", default="{}", help="JSON kwargs")
+@click.option("--namespace", default=None)
+def call(service, method, args_json, kwargs_json, namespace):
+    """Invoke a deployed service: kt call my-svc [method] --args '[1,2]'."""
+    from .client import controller_client
+    from .serving.http_client import HTTPClient
+
+    record = controller_client().get_workload(
+        namespace or kt_config().namespace, service)
+    url = record.get("service_url")
+    fn_name = record.get("metadata", {}).get("KT_CLS_OR_FN_NAME", service)
+    out = HTTPClient(url).call_method(
+        fn_name, method=method, args=tuple(json.loads(args_json)),
+        kwargs=json.loads(kwargs_json))
+    click.echo(json.dumps(out, default=str))
+
+
+# -- list / describe / teardown / workload ------------------------------------
+
+
+@cli.command("list")
+@click.option("--namespace", default=None)
+def list_cmd(namespace):
+    """List deployed workloads."""
+    from .client import controller_client
+    rows = controller_client().list_workloads(namespace)
+    if not rows:
+        click.echo("(no workloads)")
+        return
+    for w in rows:
+        click.echo(f"{w['namespace']:12} {w['name']:32} "
+                   f"{w.get('service_url') or '-'}")
+
+
+@cli.command()
+@click.argument("service")
+@click.option("--namespace", default=None)
+def describe(service, namespace):
+    """Full workload record incl. connected pods."""
+    from .client import controller_client
+    record = controller_client().get_workload(
+        namespace or kt_config().namespace, service)
+    click.echo(json.dumps(record, indent=2, default=str))
+
+
+@cli.command()
+@click.argument("service", required=False)
+@click.option("--all", "all_", is_flag=True, help="tear down every workload")
+@click.option("--prefix", default=None, help="tear down by name prefix")
+@click.option("--namespace", default=None)
+def teardown(service, all_, prefix, namespace):
+    """Delete workload(s) and their pods."""
+    from .client import controller_client
+    client = controller_client()
+    ns = namespace or kt_config().namespace
+    if service:
+        client.delete_workload(ns, service)
+        click.echo(f"deleted {service}")
+        return
+    if not (all_ or prefix):
+        raise click.UsageError("pass SERVICE, --all, or --prefix")
+    for w in client.list_workloads(namespace):
+        if all_ or (prefix and w["name"].startswith(prefix)):
+            client.delete_workload(w["namespace"], w["name"])
+            click.echo(f"deleted {w['name']}")
+
+
+@cli.command()
+@click.argument("manifest_file")
+@click.option("--namespace", default=None)
+@click.option("--name", default=None)
+def apply(manifest_file, namespace, name):
+    """Apply a BYO manifest through the controller."""
+    import yaml
+    from .client import controller_client
+    with open(manifest_file) as f:
+        manifest = yaml.safe_load(f)
+    out = controller_client().apply(
+        namespace or kt_config().namespace,
+        name or manifest.get("metadata", {}).get("name", "unnamed"), manifest)
+    click.echo(json.dumps(out))
+
+
+# -- run (App) ---------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("command", nargs=-1, required=True)
+@click.option("--name", default=None)
+@click.option("--port", type=int, default=None)
+@click.option("--cpus", default=None)
+@click.option("--tpu", default=None)
+def run(command, name, port, cpus, tpu):
+    """Run an arbitrary server process: kt run python serve.py --port 8000."""
+    from .resources.app import app as app_factory
+    from .resources.compute import Compute
+
+    a = app_factory(" ".join(command), name=name, port=port)
+    a.to(Compute(cpus=cpus, tpu=tpu))
+    click.echo(f"{a.name} → {a.service_url}")
+
+
+# -- logs --------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("service")
+@click.option("--namespace", default=None)
+@click.option("--follow", "-f", is_flag=True)
+def logs(service, namespace, follow):
+    """Show (and follow) service logs from the controller buffer."""
+    import time as _t
+    from .client import controller_client
+    client = controller_client()
+    ns = namespace or kt_config().namespace
+    offset = 0
+    while True:
+        out = client.logs(service=service, namespace=ns, offset=offset)
+        for e in out.get("entries", []):
+            click.echo(f"[{e.get('pod', '?')}] {e['line']}")
+        offset = out.get("offset", offset)
+        if not follow:
+            break
+        _t.sleep(1)
+
+
+# -- data store ---------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("key")
+@click.argument("src")
+def put(key, src):
+    """Upload a file/dir to the data store."""
+    from .data_store import commands as ds
+    click.echo(json.dumps(ds.put(key, src)))
+
+
+@cli.command()
+@click.argument("key")
+@click.argument("dest", required=False)
+def get(key, dest):
+    """Download a key from the data store."""
+    from .data_store import commands as ds
+    out = ds.get(key, dest=dest)
+    click.echo(str(out) if not isinstance(out, bytes) else f"{len(out)} bytes")
+
+
+@cli.command()
+@click.argument("prefix", required=False, default="")
+def ls(prefix):
+    from .data_store import commands as ds
+    for k in ds.ls(prefix):
+        click.echo(f"{k.get('kind', '?'):5} {k['key']}")
+
+
+@cli.command()
+@click.argument("key")
+def rm(key):
+    from .data_store import commands as ds
+    click.echo("deleted" if ds.rm(key) else "not found")
+
+
+# -- secrets / volumes --------------------------------------------------------
+
+
+@cli.group()
+def secrets():
+    """Manage secrets."""
+
+
+@secrets.command("create")
+@click.argument("provider")
+@click.option("--name", default=None)
+def secrets_create(provider, name):
+    from .resources.secret import Secret
+    s = Secret.from_provider(provider, name=name)
+    s.save()
+    click.echo(f"created {s.name} ({sorted(s.values)})")
+
+
+@secrets.command("providers")
+def secrets_providers():
+    from .resources.secret import PROVIDERS
+    for p in sorted(PROVIDERS):
+        click.echo(p)
+
+
+@cli.group()
+def volumes():
+    """Manage volumes."""
+
+
+@volumes.command("create")
+@click.argument("name")
+@click.option("--size", default="10Gi")
+def volumes_create(name, size):
+    from .resources.volume import Volume
+    Volume(name, size=size).create()
+    click.echo(f"created {name} ({size})")
+
+
+# -- debug / ssh / events -----------------------------------------------------
+
+
+@cli.command()
+@click.argument("service")
+@click.option("--port", type=int, default=5678)
+def debug(service, port):
+    """Attach to a remote pdb session armed by a call with debugger=."""
+    import socket
+    from .client import controller_client
+    record = controller_client().get_workload(kt_config().namespace, service)
+    host = record["service_url"].split("//")[1].split(":")[0]
+    click.echo(f"connecting to {host}:{port} ... (Ctrl-D to detach)")
+    sock = socket.create_connection((host, port))
+    import threading
+
+    def pump_out():
+        while True:
+            data = sock.recv(4096)
+            if not data:
+                break
+            sys.stdout.write(data.decode(errors="replace"))
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        for line in sys.stdin:
+            sock.sendall(line.encode())
+    except KeyboardInterrupt:
+        pass
+    sock.close()
+
+
+@cli.command()
+@click.argument("service")
+@click.option("--namespace", default=None)
+def events(service, namespace):
+    """Controller events for a service."""
+    from .client import controller_client
+    for e in controller_client().events(service):
+        click.echo(f"{e['ts']:.0f} {e['service']}: {e['message']}")
+
+
+# -- server ------------------------------------------------------------------
+
+
+@cli.group()
+def server():
+    """Pod-side server management."""
+
+
+@server.command("start")
+@click.option("--port", type=int, default=32300)
+@click.option("--workload", default=None,
+              help="BYO: register under this workload name")
+def server_start(port, workload):
+    """Start the pod runtime (BYO compute bootstrap, reference cli.py:2846)."""
+    if workload:
+        os.environ.setdefault("KT_SERVICE_NAME", workload)
+    from .serving.http_server import main as server_main
+    server_main(["--port", str(port)])
+
+
+# -- store -------------------------------------------------------------------
+
+
+@cli.group()
+def store():
+    """Data-store server management."""
+
+
+@store.command("start")
+@click.option("--port", type=int, default=8873)
+@click.option("--root", default="./kt-store")
+def store_start(port, root):
+    from .data_store.store_server import main as store_main
+    store_main(["--port", str(port), "--root", root])
+
+
+@cli.group()
+def controller():
+    """Controller management."""
+
+
+@controller.command("start")
+@click.option("--port", type=int, default=8080)
+@click.option("--backend", type=click.Choice(["local", "kubernetes"]),
+              default="local")
+def controller_start(port, backend):
+    from .controller.app import main as controller_main
+    controller_main(["--port", str(port), "--backend", backend])
+
+
+@controller.command("stop")
+def controller_stop():
+    """Stop the local controller daemon and all its pods."""
+    from .client import shutdown_local_controller
+    shutdown_local_controller()
+    click.echo("local controller stopped")
+
+
+def main():
+    from .exceptions import KubetorchError
+
+    try:
+        cli(standalone_mode=False)
+    except click.ClickException as e:
+        e.show()
+        sys.exit(e.exit_code)
+    except click.exceptions.Abort:
+        sys.exit(130)
+    except KubetorchError as e:
+        click.echo(f"error: {e}", err=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,12 +10,10 @@ local (reference ``globals.py:123-366``).
 
 from __future__ import annotations
 
-import atexit
 import os
 import subprocess
 import sys
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 import requests as _requests
@@ -117,14 +115,38 @@ class ControllerClient:
 # ---------------------------------------------------------------------------
 
 _lock = threading.Lock()
-_local_proc: Optional[subprocess.Popen] = None
 _client: Optional[ControllerClient] = None
 
 
+def _state_file() -> str:
+    return os.path.join(config().config_dir, "local-controller.json")
+
+
+def _read_running_local() -> Optional[Dict]:
+    """The persisted local-controller daemon, if it still answers."""
+    import json
+
+    try:
+        with open(_state_file()) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return None
+    try:
+        r = _requests.get(f"{state['url']}/controller/version", timeout=2)
+        if r.status_code == 200:
+            return state
+    except _requests.RequestException:
+        pass
+    return None
+
+
 def controller_client() -> ControllerClient:
-    """Singleton (reference ``globals.py:902``): configured api_url, else an
-    auto-started local controller."""
-    global _client, _local_proc
+    """Singleton (reference ``globals.py:902``): configured api_url, else a
+    persistent local-controller daemon shared across CLI invocations and
+    sessions — deploy in one process, `kt list` in the next. The daemon
+    outlives clients (like the in-cluster controller does); stop it with
+    ``kt controller stop`` or :func:`shutdown_local_controller`."""
+    global _client
     with _lock:
         if _client is not None:
             return _client
@@ -132,31 +154,84 @@ def controller_client() -> ControllerClient:
         if api:
             _client = ControllerClient(api)
             return _client
-        port = free_port()
-        env = dict(os.environ)
-        env["PALLAS_AXON_POOL_IPS"] = env.get("KT_LOCAL_CONTROLLER_TPU", "")
-        # the subprocess must find this package regardless of the user's cwd
-        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
-        _local_proc = subprocess.Popen(
-            [sys.executable, "-m", "kubetorch_tpu.controller.app",
-             "--host", "127.0.0.1", "--port", str(port), "--backend", "local"],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        atexit.register(shutdown_local_controller)
-        if not wait_for_port("127.0.0.1", port, timeout=30):
-            kill_process_tree(_local_proc.pid)
-            _local_proc = None
-            raise ControllerRequestError("Local controller failed to start")
-        url = f"http://127.0.0.1:{port}"
-        config().api_url = url
-        _client = ControllerClient(url)
+        state = _read_running_local()
+        if state is None:
+            state = _spawn_local_daemon()
+        config().api_url = state["url"]
+        _client = ControllerClient(state["url"])
         return _client
 
 
+def _spawn_local_daemon() -> Dict:
+    """Spawn the daemon under an exclusive file lock so two first-use
+    processes can't race to create (and leak) duplicate controllers."""
+    import fcntl
+    import json
+
+    os.makedirs(config().config_dir, exist_ok=True)
+    lock_path = os.path.join(config().config_dir, "local-controller.lock")
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            # another process may have won the race while we waited
+            state = _read_running_local()
+            if state is not None:
+                return state
+            return _spawn_local_daemon_locked()
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
+def _spawn_local_daemon_locked() -> Dict:
+    import json
+
+    port = free_port()
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = env.get("KT_LOCAL_CONTROLLER_TPU", "")
+    # the subprocess must find this package regardless of the user's cwd
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.controller.app",
+         "--host", "127.0.0.1", "--port", str(port), "--backend", "local"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    if not wait_for_port("127.0.0.1", port, timeout=30):
+        kill_process_tree(proc.pid)
+        raise ControllerRequestError("Local controller failed to start")
+    state = {"url": f"http://127.0.0.1:{port}", "pid": proc.pid}
+    with open(_state_file(), "w") as f:
+        json.dump(state, f)
+    return state
+
+
 def shutdown_local_controller() -> None:
-    global _local_proc, _client
+    """Stop the local daemon and all its pods (used by tests and
+    ``kt controller stop``)."""
+    global _client
     with _lock:
-        if _local_proc is not None and _local_proc.poll() is None:
-            kill_process_tree(_local_proc.pid)
-        _local_proc = None
         _client = None
+        state = None
+        try:
+            import json
+            with open(_state_file()) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if state:
+            # never kill a reused PID: verify the process is actually our
+            # controller before signalling it
+            try:
+                import psutil
+                proc = psutil.Process(state["pid"])
+                if any("kubetorch_tpu.controller" in part
+                       for part in proc.cmdline()):
+                    kill_process_tree(state["pid"])
+            except Exception:
+                pass
+            try:
+                os.unlink(_state_file())
+            except OSError:
+                pass
+        if config().api_url and "127.0.0.1" in (config().api_url or ""):
+            config().api_url = None

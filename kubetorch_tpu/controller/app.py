@@ -41,6 +41,7 @@ class ControllerState:
         self.workloads: Dict[str, Dict[str, Any]] = {}
         self.pods: Dict[str, List[PodConnection]] = {}   # service_key → conns
         self.logs: Dict[str, deque] = {}                 # service_key → entries
+        self.log_seq: int = 0                            # monotonic cursor
         self.events: deque = deque(maxlen=2000)
         self.cluster_config: Dict[str, Any] = {}
         self._ttl_task: Optional[asyncio.Task] = None
@@ -265,25 +266,31 @@ async def ingest_logs(request: web.Request) -> web.Response:
     body = await request.json()
     for entry in body.get("entries", []):
         key = f"{entry.get('namespace', 'default')}/{entry.get('service', '')}"
+        state.log_seq += 1
+        entry["seq"] = state.log_seq
         state.logs.setdefault(key, deque(maxlen=LOG_BUFFER_PER_SERVICE)).append(entry)
     return web.json_response({"ok": True})
 
 
 async def query_logs(request: web.Request) -> web.Response:
+    """Cursor pagination by monotonic ``seq`` — immune to ring-buffer
+    eviction, which shifts positional offsets under a follower."""
     state: ControllerState = request.app["cstate"]
     service = request.query.get("service")
     namespace = request.query.get("namespace", "default")
     request_id = request.query.get("request_id")
-    offset = int(request.query.get("offset", 0))
+    since = int(request.query.get("since", request.query.get("offset", 0)))
     if service:
         entries = list(state.logs.get(f"{namespace}/{service}", []))
     else:
         entries = [e for buf in state.logs.values() for e in buf]
     if request_id:
         entries = [e for e in entries if e.get("request_id") == request_id]
-    entries.sort(key=lambda e: e.get("ts", 0))
-    page = entries[offset:offset + 1000]
-    return web.json_response({"entries": page, "offset": offset + len(page)})
+    entries = [e for e in entries if e.get("seq", 0) > since]
+    entries.sort(key=lambda e: e.get("seq", 0))
+    page = entries[:1000]
+    new_cursor = page[-1]["seq"] if page else since
+    return web.json_response({"entries": page, "offset": new_cursor})
 
 
 async def list_events(request: web.Request) -> web.Response:

@@ -47,9 +47,18 @@ class LocalBackend:
         block = self._ip_block
         return [f"127.77.{block}.{i + 1}" for i in range(n)]
 
+    # manifest kinds that are config objects, not runnable workloads
+    _OBJECT_KINDS = {"Secret", "PersistentVolumeClaim", "ConfigMap"}
+
     def apply(self, namespace: str, name: str, manifest: Dict,
               env: Dict[str, str]) -> Dict:
         key = f"{namespace}/{name}"
+        kind = manifest.get("kind", "Deployment")
+        if kind in self._OBJECT_KINDS:
+            # store config objects instead of spawning pods for them
+            self.objects = getattr(self, "objects", {})
+            self.objects[f"{kind}/{key}"] = manifest
+            return {"kind": kind, "stored": True}
         replicas = int(manifest.get("spec", {}).get("replicas", 1))
         ips = self._next_ips(key, replicas)
 
@@ -72,6 +81,7 @@ class LocalBackend:
             "KT_SERVER_PORT": str(self.server_port),
             "KT_CONTROLLER_WS_URL":
                 self.controller_url.replace("http", "ws", 1) + "/controller/ws/pods",
+            "KT_LOG_SINK_URL": self.controller_url + "/controller/logs",
             "KT_NAMESPACE": namespace,
             "KT_SERVICE_NAME": name,
         })

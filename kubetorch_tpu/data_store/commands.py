@@ -1,0 +1,250 @@
+"""``kt.put / kt.get / kt.ls / kt.rm`` — the data-store public API.
+
+Reference (``data_store/data_store_cmds.py``): put/get auto-detect payload
+kind — CUDA tensors routed to NCCL, paths to rsync. TPU redesign: JAX arrays
+and pytrees are staged through host memory (no cross-process device handles
+on TPU, SURVEY §2.9) and stored as **per-leaf keys** (``ckpt/layers/wq``),
+which is what makes *resharding on get* possible: each leaf is fetched once
+and ``jax.device_put`` with the target mesh's NamedSharding places exactly
+the shards this host needs.
+
+Directories ride the ktsync tree protocol; single files ride the KV store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import requests as _requests
+
+from ..config import config
+from ..exceptions import DataStoreError
+from .types import BroadcastWindow
+
+_INDEX_SUFFIX = ".__kt_index__"
+
+
+def _store_url(explicit: Optional[str] = None) -> str:
+    url = explicit or config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
+    if not url:
+        raise DataStoreError(
+            "No data store configured (set KT_DATA_STORE_URL or "
+            "config.data_store_url, or pass store_url=)")
+    return url.rstrip("/")
+
+
+def _is_arraylike(obj: Any) -> bool:
+    t = type(obj)
+    return (t.__module__.startswith(("jax", "jaxlib", "numpy"))
+            and hasattr(obj, "dtype") and hasattr(obj, "shape"))
+
+
+def _is_pytree_of_arrays(obj: Any) -> bool:
+    if _is_arraylike(obj):
+        return True
+    if isinstance(obj, dict) and obj:
+        return all(_is_pytree_of_arrays(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)) and obj:
+        return all(_is_pytree_of_arrays(v) for v in obj)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# put
+# ---------------------------------------------------------------------------
+
+
+def put(key: str, src: Any, store_url: Optional[str] = None,
+        broadcast: Optional[BroadcastWindow] = None) -> Dict:
+    """Store a directory, file, array, or array pytree under ``key``."""
+    url = _store_url(store_url)
+    if isinstance(src, (str, os.PathLike)):
+        path = os.fspath(src)
+        if os.path.isdir(path):
+            from .sync import push_tree
+            return push_tree(url, key, path)
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                return _kv_put(url, key, f.read(), {"kind": "file"})
+        raise DataStoreError(f"put: path {path!r} does not exist")
+    if _is_pytree_of_arrays(src):
+        return _put_pytree(url, key, src)
+    raise DataStoreError(
+        f"put: unsupported payload type {type(src).__name__}; expected a "
+        "path, an array, or a pytree of arrays")
+
+
+def _put_pytree(url: str, key: str, tree: Any) -> Dict:
+    import numpy as np
+
+    leaves: Dict[str, Any] = {}
+    _flatten(tree, "", leaves)
+    index = {"leaves": {}, "structure": _structure_of(tree)}
+    total = 0
+    sess = _requests.Session()
+    for path, arr in leaves.items():
+        host = np.asarray(arr)  # device → host staging
+        data = host.tobytes()
+        meta = {"dtype": str(host.dtype), "shape": list(host.shape),
+                "kind": "array"}
+        _kv_put(url, f"{key}/{path}", data, meta, sess)
+        index["leaves"][path] = meta
+        total += len(data)
+    _kv_put(url, f"{key}{_INDEX_SUFFIX}",
+            json.dumps(index).encode(), {"kind": "index"}, sess)
+    return {"leaves": len(leaves), "bytes": total}
+
+
+def _flatten(tree: Any, prefix: str, out: Dict[str, Any]) -> None:
+    if _is_arraylike(tree):
+        out[prefix or "value"] = tree
+        return
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            _flatten(v, f"{prefix}/{k}" if prefix else str(k), out)
+        return
+    if isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            _flatten(v, f"{prefix}/{i}" if prefix else str(i), out)
+        return
+    raise DataStoreError(f"Unsupported leaf {type(tree).__name__} in pytree")
+
+
+def _structure_of(tree: Any) -> Any:
+    if _is_arraylike(tree):
+        return "leaf"
+    if isinstance(tree, dict):
+        return {k: _structure_of(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_structure_of(v) for v in tree]
+    raise DataStoreError(f"Unsupported node {type(tree).__name__}")
+
+
+def _kv_put(url: str, key: str, data: bytes, meta: Dict,
+            sess: Optional[_requests.Session] = None) -> Dict:
+    sess = sess or _requests
+    r = sess.put(f"{url}/kv/{key}", data=data,
+                 headers={"X-KT-Meta": json.dumps(meta)}, timeout=600)
+    if r.status_code != 200:
+        raise DataStoreError(f"put {key!r} failed: {r.status_code} {r.text[:200]}")
+    return r.json()
+
+
+# ---------------------------------------------------------------------------
+# get
+# ---------------------------------------------------------------------------
+
+
+def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
+        sharding: Optional[Any] = None, mesh: Optional[Any] = None,
+        rules: Optional[Any] = None) -> Any:
+    """Fetch ``key``. Directories need ``dest``; arrays/pytrees are returned,
+    optionally placed onto devices:
+
+    - ``sharding=``  a single NamedSharding applied to every leaf, or
+    - ``mesh= + rules=``  a :class:`~kubetorch_tpu.parallel.sharding.
+      ShardingRules` table resolved per leaf path — the reshard-on-get path
+      (load a checkpoint onto a *different* mesh than it was saved from).
+    """
+    url = _store_url(store_url)
+    sess = _requests.Session()
+
+    r = sess.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
+    if r.status_code == 200:
+        index = json.loads(r.content)
+        return _get_pytree(url, key, index, sess, sharding, mesh, rules)
+
+    r = sess.get(f"{url}/kv/{key}", timeout=600)
+    if r.status_code == 200:
+        meta = json.loads(r.headers.get("X-KT-Meta", "{}"))
+        if meta.get("kind") == "array":
+            return _decode_array(r.content, meta, sharding)
+        if dest:
+            with open(dest, "wb") as f:
+                f.write(r.content)
+            return dest
+        return r.content
+
+    r = sess.get(f"{url}/tree/{key}/manifest", timeout=60)
+    if r.status_code == 200:
+        if not dest:
+            raise DataStoreError(f"get: {key!r} is a directory tree; pass dest=")
+        from .sync import pull_tree
+        return pull_tree(url, key, dest, session=sess)
+
+    raise DataStoreError(f"get: no such key {key!r}")
+
+
+def _get_pytree(url, key, index, sess, sharding, mesh, rules) -> Any:
+    leaves: Dict[str, Any] = {}
+    for path, meta in index["leaves"].items():
+        r = sess.get(f"{url}/kv/{key}/{path}", timeout=600)
+        if r.status_code != 200:
+            raise DataStoreError(f"get: missing leaf {key}/{path}")
+        leaf_sharding = sharding
+        if leaf_sharding is None and mesh is not None and rules is not None:
+            from jax.sharding import NamedSharding
+            leaf_sharding = NamedSharding(mesh, rules.spec_for(path, mesh))
+        leaves[path] = _decode_array(r.content, meta, leaf_sharding)
+    return _unflatten(index["structure"], "", leaves)
+
+
+def _decode_array(data: bytes, meta: Dict, sharding: Optional[Any]) -> Any:
+    import numpy as np
+
+    dtype = meta["dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    arr = np.frombuffer(data, dtype=np_dtype).reshape(meta["shape"]).copy()
+    if sharding is not None:
+        import jax
+        return jax.device_put(arr, sharding)
+    return arr
+
+
+def _unflatten(structure: Any, prefix: str, leaves: Dict[str, Any]) -> Any:
+    if structure == "leaf":
+        return leaves[prefix or "value"]
+    if isinstance(structure, dict):
+        return {k: _unflatten(v, f"{prefix}/{k}" if prefix else str(k), leaves)
+                for k, v in structure.items()}
+    if isinstance(structure, list):
+        return [_unflatten(v, f"{prefix}/{i}" if prefix else str(i), leaves)
+                for i, v in enumerate(structure)]
+    raise DataStoreError("corrupt pytree index")
+
+
+# ---------------------------------------------------------------------------
+# ls / rm
+# ---------------------------------------------------------------------------
+
+
+def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
+    url = _store_url(store_url)
+    r = _requests.get(f"{url}/keys", params={"prefix": prefix}, timeout=60)
+    if r.status_code != 200:
+        raise DataStoreError(f"ls failed: {r.status_code}")
+    # hide internal index keys
+    return [k for k in r.json()["keys"] if not k["key"].endswith(_INDEX_SUFFIX)]
+
+
+def rm(key: str, store_url: Optional[str] = None) -> bool:
+    url = _store_url(store_url)
+    existed = False
+    r = _requests.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
+    if r.status_code == 200:
+        index = json.loads(r.content)
+        for path in index["leaves"]:
+            _requests.delete(f"{url}/kv/{key}/{path}", timeout=60)
+        _requests.delete(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
+        existed = True
+    rd = _requests.delete(f"{url}/kv/{key}", timeout=60)
+    existed = existed or (rd.status_code == 200 and rd.json().get("existed"))
+    rt = _requests.delete(f"{url}/tree/{key}", timeout=60)
+    existed = existed or (rt.status_code == 200 and rt.json().get("existed"))
+    return existed

@@ -1,0 +1,76 @@
+"""MNIST-scale MLP — BASELINE config 1 (the smallest end-to-end workload)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MlpConfig:
+    in_dim: int = 784
+    hidden: tuple = (512, 256)
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(rng: jax.Array, cfg: MlpConfig) -> Dict:
+    dims = (cfg.in_dim, *cfg.hidden, cfg.out_dim)
+    keys = jax.random.split(rng, len(dims) - 1)
+    return {"layers": [
+        {"w": (jax.random.normal(k, (a, b)) / jnp.sqrt(a)).astype(cfg.dtype),
+         "b": jnp.zeros((b,), cfg.dtype)}
+        for k, a, b in zip(keys, dims[:-1], dims[1:])
+    ]}
+
+
+def mlp_forward(params: Dict, x: jax.Array, cfg: MlpConfig) -> jax.Array:
+    h = x.astype(cfg.dtype)
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        h = h @ layer["w"] + layer["b"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def mlp_loss(params: Dict, x: jax.Array, labels: jax.Array, cfg: MlpConfig) -> jax.Array:
+    logits = mlp_forward(params, x, cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def mnist_train(steps: int = 100, batch: int = 128, lr: float = 1e-3,
+                seed: int = 0) -> Dict:
+    """Self-contained training entry for ``kt.fn(mnist_train).to(...)`` —
+    synthetic data keeps it hermetic (no dataset download in pods)."""
+    import optax
+
+    cfg = MlpConfig()
+    rng = jax.random.PRNGKey(seed)
+    params = mlp_init(rng, cfg)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, g = jax.value_and_grad(mlp_loss)(params, x, y, cfg)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # learnable synthetic task: class-dependent cluster centers + noise
+    k_centers, k_x, k_y = jax.random.split(jax.random.PRNGKey(seed + 1), 3)
+    centers = jax.random.normal(k_centers, (cfg.out_dim, cfg.in_dim)) * 2.0
+    y_all = jax.random.randint(k_y, (batch * 8,), 0, cfg.out_dim)
+    x_all = centers[y_all] + jax.random.normal(k_x, (batch * 8, cfg.in_dim))
+
+    losses: List[float] = []
+    for i in range(steps):
+        lo = (i * batch) % (batch * 8)
+        x, y = x_all[lo:lo + batch], y_all[lo:lo + batch]
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    return {"first_loss": losses[0], "last_loss": losses[-1], "steps": steps}

@@ -1,0 +1,192 @@
+"""Mixtral-style sparse MoE decoder — BASELINE config 5 (expert parallelism).
+
+GShard/Mesh-TF dispatch formulation (the TPU-native shape): top-k routing is
+expressed as dense one-hot einsums with a capacity factor, so every tensor is
+static-shaped and GSPMD inserts the expert all-to-alls automatically when the
+expert-stacked FFN weights are sharded over the ``expert`` mesh axis
+(``parallel.sharding.MOE_RULES``). No ragged ops, no host gather — the
+dispatch/combine einsums run on the MXU.
+
+Attention/norms/embeddings reuse the Llama blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import (LlamaConfig, apply_rope, attention, rmsnorm, rope_freqs)
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: str = "auto"
+    router_aux_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MoeConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "MoeConfig":
+        d = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 ffn_dim=128, n_experts=4, experts_per_token=2, max_seq_len=128)
+        d.update(kw)
+        return cls(**d)
+
+    def _llama_view(self) -> LlamaConfig:
+        return LlamaConfig(vocab_size=self.vocab_size, dim=self.dim,
+                           n_layers=self.n_layers, n_heads=self.n_heads,
+                           n_kv_heads=self.n_kv_heads, ffn_dim=self.ffn_dim,
+                           max_seq_len=self.max_seq_len,
+                           rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+                           dtype=self.dtype, remat=self.remat,
+                           attn_impl=self.attn_impl)
+
+    def param_count(self) -> int:
+        d, f, L, E = self.dim, self.ffn_dim, self.n_layers, self.n_experts
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        ffn = 3 * d * f * E
+        router = d * E
+        return self.vocab_size * d * 2 + L * (attn + ffn + router + 2 * d) + d
+
+
+def moe_init(rng: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
+    d, L, E, f = cfg.dim, cfg.n_layers, cfg.n_experts, cfg.ffn_dim
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    k = iter(jax.random.split(rng, 16))
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embed": init(next(k), (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": init(next(k), (L, d, nh * hd), d),
+            "wk": init(next(k), (L, d, nkv * hd), d),
+            "wv": init(next(k), (L, d, nkv * hd), d),
+            "wo": init(next(k), (L, nh * hd, d), nh * hd),
+            "ffn_norm": jnp.ones((L, d), jnp.float32),
+            "router": init(next(k), (L, d, E), d).astype(jnp.float32),
+            "experts": {
+                "w_gate": init(next(k), (L, E, d, f), d),
+                "w_up": init(next(k), (L, E, d, f), d),
+                "w_down": init(next(k), (L, E, f, d), f),
+            },
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": init(next(k), (d, cfg.vocab_size), d),
+    }
+
+
+def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
+    """Top-k MoE with capacity-bounded one-hot dispatch.
+
+    x: (B, S, D) → (B, S, D), plus scalar aux loss for load balancing.
+    """
+    b, s, d = x.shape
+    E, K = cfg.n_experts, cfg.experts_per_token
+    capacity = max(1, int(cfg.capacity_factor * s * K / E))
+
+    logits = (x.astype(jnp.float32) @ lw["router"])          # (B, S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # aux load-balancing loss (Switch-style): E * Σ_e fraction_e * prob_e
+    # computed on top-1 assignments
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=(0, 1))
+    aux = E * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+
+    # top-k gates, renormalized (Mixtral renormalizes over selected experts)
+    gate_vals, gate_idx = lax.top_k(probs, K)                # (B, S, K)
+    gate_vals = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
+
+    # position of each (token, k) inside its expert's capacity buffer
+    expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (B,S,K,E)
+    flat = expert_onehot.reshape(b, s * K, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(b, s, K, E)
+    pos_in_expert = jnp.sum(pos_in_expert * expert_onehot, axis=-1)   # (B,S,K)
+    keep = pos_in_expert < capacity                                    # overflow drops
+
+    # dispatch (B,S,E,C) and combine (B,S,E,C) tensors
+    cap_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=x.dtype)  # (B,S,K,C)
+    disp = jnp.einsum("bske,bskc->bsec",
+                      (expert_onehot * keep[..., None]).astype(x.dtype),
+                      cap_onehot)                                     # (B,S,E,C)
+    comb = jnp.einsum("bsk,bske,bskc->bsec",
+                      gate_vals.astype(x.dtype),
+                      (expert_onehot * keep[..., None]).astype(x.dtype),
+                      cap_onehot)
+
+    # route tokens to expert buffers: (E, B, C, D)
+    expert_in = jnp.einsum("bsec,bsd->ebcd", disp, x)
+    # batched expert SwiGLU over the E axis (sharded over "expert")
+    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, lw["experts"]["w_gate"])) \
+        * jnp.einsum("ebcd,edf->ebcf", expert_in, lw["experts"]["w_up"])
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, lw["experts"]["w_down"])
+    out = jnp.einsum("bsec,ebcd->bsd", comb, expert_out)
+    return out, aux
+
+
+def _moe_layer(cfg: MoeConfig, carry, lw: Dict[str, jax.Array], freqs):
+    x, aux_sum = carry
+    b, s, d = x.shape
+    lcfg = cfg._llama_view()
+    h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
+    q = (h @ lw["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lw["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lw["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+    x = x + attention(q, k, v, lcfg).reshape(b, s, -1) @ lw["wo"]
+    h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    ffn_out, aux = moe_ffn(cfg, h, lw)
+    return (x + ffn_out, aux_sum + aux)
+
+
+def moe_forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig):
+    """tokens (B, S) → (logits (B, S, V) fp32, aux_loss scalar)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs = rope_freqs(cfg._llama_view(), tokens.shape[1])
+
+    def body(carry, lw):
+        return _moe_layer(cfg, carry, lw, freqs), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                           params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux / cfg.n_layers
+
+
+def moe_loss(params, tokens, targets, cfg: MoeConfig) -> jax.Array:
+    logits, aux = moe_forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.router_aux_weight * aux

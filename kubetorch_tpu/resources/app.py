@@ -1,0 +1,54 @@
+"""App: an arbitrary server process managed as a kubetorch service.
+
+Reference (``resources/compute/app.py``): ``kt run python serve.py`` — the
+user's command is appended to the image instructions as CMD; the pod runtime
+starts it as a child process and proxies health through ``/app/status``.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Dict, Optional
+
+from ..config import config
+from ..utils.naming import service_name_for
+from .compute import Compute
+from .module import Module
+from .pointers import Pointers
+
+
+class App(Module):
+    callable_type = "app"
+
+    def __init__(self, command: str, name: Optional[str] = None,
+                 port: Optional[int] = None, health_path: str = "/"):
+        # Apps have no importable callable; pointers carry only the name.
+        pointers = Pointers(project_root=".", module_name="", file_path="",
+                            cls_or_fn_name=name or "app")
+        base = name or shlex.split(command)[-1].split("/")[-1].split(".")[0]
+        super().__init__(pointers, name=base)
+        self.command = command
+        self.port = port
+        self.health_path = health_path
+
+    def _metadata(self) -> Dict:
+        meta = {
+            "KT_CALLABLE_TYPE": "app",
+            "KT_SERVICE_NAME": self.name,
+            "KT_APP_CMD": self.command,
+        }
+        if self.port:
+            meta["KT_APP_PORT"] = str(self.port)
+        if self.compute:
+            meta["KT_DOCKERFILE"] = self.compute.image.cmd(self.command).dockerfile()
+        return meta
+
+    def status(self) -> Dict:
+        import requests
+        r = requests.get(f"{self.service_url}/app/status", timeout=10)
+        return r.json()
+
+
+def app(command: str, name: Optional[str] = None, port: Optional[int] = None) -> App:
+    """``kt.app("python serve.py", port=8000)`` — deploy a server process."""
+    return App(command, name=name, port=port)

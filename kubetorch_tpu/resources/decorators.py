@@ -1,0 +1,101 @@
+"""Declarative decorators: ``@kt.compute / @kt.distribute / @kt.autoscale /
+@kt.async_``.
+
+Reference (``resources/compute/decorators.py``): decorators build a
+``PartialModule`` chain that ``kt deploy`` unwinds in CLI deploy mode — at
+import time in a normal run they are inert, so the same file works as a plain
+script and as a deployable unit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+DEPLOY_MODE_ENV = "KT_CLI_DEPLOY_MODE"
+
+_REGISTRY: list = []   # PartialModules collected during a `kt deploy` import
+
+
+class PartialModule:
+    """A callable tagged with deployment intent, unwound by `kt deploy`."""
+
+    def __init__(self, obj: Callable):
+        self.obj = obj
+        self.compute_kwargs: Dict[str, Any] = {}
+        self.distribute_kwargs: Optional[Dict[str, Any]] = None
+        self.autoscale_kwargs: Optional[Dict[str, Any]] = None
+        self.is_async = False
+        self.name: Optional[str] = None
+
+    def __call__(self, *args, **kwargs):
+        # undecorated behavior outside deploy mode
+        return self.obj(*args, **kwargs)
+
+    def build(self):
+        """Materialize Fn/Cls + Compute (called by `kt deploy`)."""
+        import inspect
+
+        from .cls import cls as cls_factory
+        from .compute import Compute
+        from .fn import fn as fn_factory
+
+        compute = Compute(**self.compute_kwargs)
+        if self.distribute_kwargs:
+            compute = compute.distribute(**self.distribute_kwargs)
+        if self.autoscale_kwargs:
+            compute = compute.autoscale(**self.autoscale_kwargs)
+        factory = cls_factory if inspect.isclass(self.obj) else fn_factory
+        module = factory(self.obj, name=self.name)
+        return module, compute
+
+
+def _as_partial(obj: Any) -> PartialModule:
+    if isinstance(obj, PartialModule):
+        return obj
+    pm = PartialModule(obj)
+    if os.environ.get(DEPLOY_MODE_ENV):
+        _REGISTRY.append(pm)
+    return pm
+
+
+def compute(**compute_kwargs) -> Callable:
+    """``@kt.compute(cpus=1, tpu="v5e-8")`` — attach a Compute spec."""
+    def deco(obj):
+        pm = _as_partial(obj)
+        name = compute_kwargs.pop("name", None)
+        if name:
+            pm.name = name
+        pm.compute_kwargs.update(compute_kwargs)
+        return pm
+    return deco
+
+
+def distribute(distribution_type: str = "jax", **kwargs) -> Callable:
+    def deco(obj):
+        pm = _as_partial(obj)
+        pm.distribute_kwargs = {"distribution_type": distribution_type, **kwargs}
+        return pm
+    return deco
+
+
+def autoscale(**kwargs) -> Callable:
+    def deco(obj):
+        pm = _as_partial(obj)
+        pm.autoscale_kwargs = kwargs
+        return pm
+    return deco
+
+
+def async_(obj: Any) -> PartialModule:
+    pm = _as_partial(obj)
+    pm.is_async = True
+    return pm
+
+
+def collected_modules() -> list:
+    return list(_REGISTRY)
+
+
+def clear_registry() -> None:
+    _REGISTRY.clear()

@@ -1,0 +1,28 @@
+"""Endpoint: custom routing for a service (reference
+``resources/compute/endpoint.py``): either a user-provided URL (no Service
+object created) or a custom pod selector (e.g. only the coordinator pod of a
+slice), rewritten through the controller proxy."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Endpoint:
+    def __init__(self, url: Optional[str] = None,
+                 selector: Optional[Dict[str, str]] = None,
+                 port: int = 32300):
+        if (url is None) == (selector is None):
+            raise ValueError("Endpoint needs exactly one of url= or selector=")
+        self.url = url
+        self.selector = selector
+        self.port = port
+
+    def to_service_config(self, name: str, namespace: str) -> Dict:
+        if self.url is not None:
+            return {"url": self.url}
+        return {"selector": self.selector, "port": self.port,
+                "name": name, "namespace": namespace}
+
+    def __repr__(self) -> str:
+        return f"Endpoint(url={self.url!r}, selector={self.selector!r})"

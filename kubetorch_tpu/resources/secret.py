@@ -1,0 +1,116 @@
+"""Secret: credentials delivered to pods as env vars or file mounts.
+
+Reference (``resources/secrets/``): K8s Secret CRUD via the controller, with
+provider presets (aws/gcp/anthropic/huggingface/wandb/...) that know each
+provider's default env vars and credential file paths.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..client import controller_client
+from ..config import config
+
+# provider → (env vars, default credentials path) — reference
+# resources/secrets/provider_secrets/providers.py:92
+PROVIDERS: Dict[str, Dict] = {
+    "aws": {"env": ["AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY"],
+            "path": "~/.aws/credentials"},
+    "gcp": {"env": ["GOOGLE_APPLICATION_CREDENTIALS"],
+            "path": "~/.config/gcloud/application_default_credentials.json"},
+    "azure": {"env": ["AZURE_CLIENT_ID", "AZURE_CLIENT_SECRET",
+                      "AZURE_TENANT_ID"], "path": None},
+    "anthropic": {"env": ["ANTHROPIC_API_KEY"], "path": None},
+    "openai": {"env": ["OPENAI_API_KEY"], "path": None},
+    "cohere": {"env": ["COHERE_API_KEY"], "path": None},
+    "github": {"env": ["GITHUB_TOKEN"], "path": "~/.config/gh/hosts.yml"},
+    "huggingface": {"env": ["HF_TOKEN", "HUGGING_FACE_HUB_TOKEN"],
+                    "path": "~/.cache/huggingface/token"},
+    "kubeconfig": {"env": [], "path": "~/.kube/config"},
+    "lambda": {"env": ["LAMBDA_API_KEY"], "path": "~/.lambda_cloud/lambda_keys"},
+    "langchain": {"env": ["LANGCHAIN_API_KEY"], "path": None},
+    "pinecone": {"env": ["PINECONE_API_KEY"], "path": None},
+    "ssh": {"env": [], "path": "~/.ssh/id_rsa"},
+    "wandb": {"env": ["WANDB_API_KEY"], "path": "~/.netrc"},
+}
+
+
+class Secret:
+    def __init__(self, name: str, values: Optional[Dict[str, str]] = None,
+                 file_path: Optional[str] = None,
+                 mount_path: Optional[str] = None,
+                 provider: Optional[str] = None):
+        self.name = name
+        self.values = dict(values or {})
+        self.file_path = file_path
+        self.mount_path = mount_path
+        self.provider = provider
+
+    # -- factories (reference secret_factory.py) ------------------------------
+
+    @classmethod
+    def from_provider(cls, provider: str, name: Optional[str] = None) -> "Secret":
+        spec = PROVIDERS.get(provider)
+        if spec is None:
+            raise ValueError(f"Unknown provider {provider!r}; "
+                             f"known: {sorted(PROVIDERS)}")
+        values = {k: os.environ[k] for k in spec["env"] if k in os.environ}
+        file_path = None
+        if spec["path"]:
+            p = Path(os.path.expanduser(spec["path"]))
+            if p.exists():
+                file_path = str(p)
+        if not values and not file_path:
+            raise ValueError(
+                f"No local credentials found for provider {provider!r} "
+                f"(looked for env {spec['env']} and {spec['path']})")
+        return cls(name or f"{provider}-secret", values=values,
+                   file_path=file_path, provider=provider,
+                   mount_path=spec["path"])
+
+    @classmethod
+    def from_env(cls, keys: List[str], name: str = "env-secret") -> "Secret":
+        missing = [k for k in keys if k not in os.environ]
+        if missing:
+            raise ValueError(f"Env vars not set: {missing}")
+        return cls(name, values={k: os.environ[k] for k in keys})
+
+    @classmethod
+    def from_path(cls, path: str, mount_path: Optional[str] = None,
+                  name: Optional[str] = None) -> "Secret":
+        p = Path(os.path.expanduser(path))
+        if not p.exists():
+            raise ValueError(f"No file at {path}")
+        return cls(name or f"file-{p.name}".lower().replace(".", "-"),
+                   file_path=str(p), mount_path=mount_path or path)
+
+    # -- pod delivery ---------------------------------------------------------
+
+    def env_vars(self) -> Dict[str, str]:
+        out = dict(self.values)
+        if self.file_path and self.mount_path:
+            content = Path(self.file_path).read_text()
+            # file secrets travel as env payload in local mode; the k8s
+            # backend materializes them as Secret volume mounts instead
+            out[f"KT_SECRET_FILE_{self.name.upper().replace('-', '_')}"] = content
+        return out
+
+    # -- cluster CRUD through the controller ----------------------------------
+
+    def save(self, namespace: Optional[str] = None) -> Dict:
+        data = dict(self.values)
+        if self.file_path:
+            data["__file__"] = Path(self.file_path).read_text()
+            data["__mount_path__"] = self.mount_path or ""
+        return controller_client().apply(
+            namespace or config().namespace, self.name,
+            manifest={"apiVersion": "v1", "kind": "Secret",
+                      "metadata": {"name": self.name},
+                      "stringData": data})
+
+    def __repr__(self) -> str:
+        return (f"Secret({self.name!r}, keys={sorted(self.values)}, "
+                f"file={self.file_path!r})")

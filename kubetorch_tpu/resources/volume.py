@@ -1,0 +1,58 @@
+"""Volume: persistent storage attached to compute.
+
+Reference (``resources/volumes/volume.py``): PVC create/delete/from_name,
+mount path, scratch-pod ssh. The local backend maps a Volume to a host
+directory under the store root so the same API works without a cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ..client import controller_client
+from ..config import config
+
+
+class Volume:
+    def __init__(self, name: str, size: str = "10Gi",
+                 mount_path: Optional[str] = None,
+                 storage_class: Optional[str] = None,
+                 access_mode: str = "ReadWriteOnce"):
+        self.name = name
+        self.size = size
+        self.mount_path = mount_path or f"/mnt/{name}"
+        self.storage_class = storage_class
+        self.access_mode = access_mode
+
+    def manifest(self, namespace: Optional[str] = None) -> Dict:
+        spec: Dict = {
+            "accessModes": [self.access_mode],
+            "resources": {"requests": {"storage": self.size}},
+        }
+        if self.storage_class:
+            spec["storageClassName"] = self.storage_class
+        return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                "metadata": {"name": self.name,
+                             "namespace": namespace or config().namespace},
+                "spec": spec}
+
+    def create(self, namespace: Optional[str] = None) -> Dict:
+        return controller_client().apply(
+            namespace or config().namespace, self.name, self.manifest(namespace))
+
+    @classmethod
+    def from_name(cls, name: str, mount_path: Optional[str] = None) -> "Volume":
+        return cls(name=name, mount_path=mount_path)
+
+    def delete(self, namespace: Optional[str] = None) -> Dict:
+        return controller_client().delete_workload(
+            namespace or config().namespace, self.name)
+
+    def mount_spec(self) -> Dict:
+        """Entry consumed by the pod-template builder."""
+        return {"name": self.name, "claim": self.name,
+                "mount_path": self.mount_path}
+
+    def __repr__(self) -> str:
+        return f"Volume({self.name!r}, {self.size}, mount={self.mount_path!r})"

@@ -1,0 +1,60 @@
+"""User-facing distributed introspection inside pods: ``kt.distributed``.
+
+Reference analog: ``kt.distributed.pod_ips`` (SURVEY §2.1). User code running
+in a rank subprocess reads its identity from the env contract; these helpers
+decode it, and ``initialize_jax`` is the one-liner that brings up
+``jax.distributed`` from the injected coordinates (usually automatic — jax
+reads the same env vars — but explicit init lets users pass options).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class distributed:
+    @staticmethod
+    def pod_ips() -> List[str]:
+        raw = os.environ.get("POD_IPS", "")
+        return [ip for ip in raw.split(",") if ip]
+
+    @staticmethod
+    def rank() -> int:
+        return int(os.environ.get("RANK", 0))
+
+    @staticmethod
+    def world_size() -> int:
+        return int(os.environ.get("WORLD_SIZE", 1))
+
+    @staticmethod
+    def local_rank() -> int:
+        return int(os.environ.get("LOCAL_RANK", 0))
+
+    @staticmethod
+    def node_rank() -> int:
+        return int(os.environ.get("NODE_RANK", 0))
+
+    @staticmethod
+    def mesh_spec() -> Optional[dict]:
+        import json
+        raw = os.environ.get("KT_MESH")
+        return json.loads(raw) if raw else None
+
+    @staticmethod
+    def initialize_jax(**kwargs) -> None:
+        """Explicit ``jax.distributed.initialize`` from the env contract."""
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", 1)),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", 0)), **kwargs)
+
+    @staticmethod
+    def mesh(devices=None):
+        """Build the mesh declared in ``.distribute(mesh=...)`` on this host's
+        view of the global device set."""
+        from ..parallel.mesh import build_mesh
+
+        spec = distributed.mesh_spec()
+        return build_mesh(spec, devices=devices)

@@ -145,6 +145,14 @@ class ServerState:
         supervisor recreation → only then flip the launch_id."""
         apply_metadata(metadata)
         await self._sync_code()
+        # replay changed dockerfile instructions (reference run_image_setup)
+        dockerfile = os.environ.get("KT_DOCKERFILE") or metadata.get("KT_DOCKERFILE")
+        if dockerfile:
+            from .image_setup import run_image_setup
+            await run_image_setup(dockerfile, state=self)
+        if os.environ.get("KT_APP_CMD") and not dockerfile:
+            from .image_setup import start_app_process
+            await start_app_process(self, os.environ["KT_APP_CMD"])
         async with self._load_lock:
             if self.supervisor is not None:
                 await asyncio.to_thread(self.supervisor.cleanup)

@@ -1,0 +1,111 @@
+"""Image-setup cache: replay pseudo-dockerfile instructions inside a live pod.
+
+Reference (``serving/http_server.py:510-831``): the new dockerfile is diffed
+line-by-line against the last-applied one and only instructions from the
+first mismatch onward are replayed — RUN via shell (with
+``$KT_PIP_INSTALL_CMD`` substitution), ENV into the process env, COPY a
+no-op (ktsync already placed files), CMD (re)starts the app process. A
+pip-freeze diff evicts changed modules from ``sys.modules`` so new package
+versions are importable without a pod restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_CACHED_DOCKERFILE: List[str] = []
+_PIP_INSTALL_CMD = os.environ.get("KT_PIP_INSTALL_CMD", f"{sys.executable} -m pip install")
+
+
+def _parse(dockerfile: str) -> List[Tuple[str, str]]:
+    out = []
+    for line in dockerfile.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or line.upper().startswith("FROM "):
+            continue
+        kind, _, value = line.partition(" ")
+        out.append((kind.upper(), value.strip()))
+    return out
+
+
+def first_mismatch(old: List[Tuple[str, str]], new: List[Tuple[str, str]]) -> int:
+    for i, (a, b) in enumerate(zip(old, new)):
+        if a != b:
+            return i
+    return min(len(old), len(new)) if len(old) != len(new) else len(new)
+
+
+async def run_image_setup(dockerfile: str, state=None) -> Dict:
+    """Apply only the changed suffix of the dockerfile. Returns stats."""
+    global _CACHED_DOCKERFILE
+
+    new = _parse(dockerfile)
+    old = _parse("\n".join(_CACHED_DOCKERFILE))
+    start = first_mismatch(old, new)
+    replayed = 0
+    pip_touched = False
+    for kind, value in new[start:]:
+        if kind == "RUN":
+            cmd = value.replace("$KT_PIP_INSTALL_CMD", _PIP_INSTALL_CMD)
+            pip_touched |= "pip install" in cmd
+            proc = await asyncio.create_subprocess_shell(
+                cmd, stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+            out, _ = await proc.communicate()
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"image setup RUN failed ({proc.returncode}): {cmd}\n"
+                    f"{out.decode()[-2000:]}")
+        elif kind == "ENV":
+            key, _, val = value.partition("=")
+            os.environ[key.strip()] = val.strip()
+        elif kind == "COPY":
+            pass  # ktsync already placed the files (reference: no-op verify)
+        elif kind == "SYNC":
+            pass  # handled by the code-sync step before setup
+        elif kind == "CMD":
+            if state is not None:
+                await start_app_process(state, value)
+        replayed += 1
+
+    if pip_touched:
+        _evict_reinstalled_modules()
+    _CACHED_DOCKERFILE = dockerfile.splitlines()
+    return {"instructions": len(new), "replayed": replayed}
+
+
+def _evict_reinstalled_modules() -> None:
+    """Drop site-packages modules from sys.modules so upgraded versions load
+    on next import (reference :775-815). User project modules are handled by
+    the reload purge; the runtime itself is never evicted."""
+    for name, mod in list(sys.modules.items()):
+        if name.split(".")[0] in ("kubetorch_tpu", "sys", "os", "builtins"):
+            continue
+        f = getattr(mod, "__file__", None)
+        if f and "site-packages" in f:
+            sys.modules.pop(name, None)
+
+
+async def start_app_process(state, command: str,
+                            wait_start_s: float = 2.0) -> None:
+    """(Re)start the App child process (reference CMD handling +
+    wait_for_app_start)."""
+    if getattr(state, "app_process", None) is not None and \
+            state.app_process.returncode is None:
+        state.app_process.terminate()
+        try:
+            await asyncio.wait_for(state.app_process.wait(), 10)
+        except asyncio.TimeoutError:
+            state.app_process.kill()
+    state.app_process = await asyncio.create_subprocess_exec(
+        *shlex.split(command))
+    await asyncio.sleep(wait_start_s)
+    if state.app_process.returncode is not None:
+        raise RuntimeError(
+            f"App process exited immediately (rc={state.app_process.returncode}): "
+            f"{command}")

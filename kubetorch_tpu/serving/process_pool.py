@@ -59,11 +59,23 @@ class ProcessPool:
                 if not worker.alive and self._stopping.is_set():
                     return
                 continue
+            if resp.get("op") == "log":
+                self._forward_log(resp, worker)
+                continue
             req_id = resp.get("req_id")
             with self._futures_lock:
                 fut = self._futures.pop(req_id, None)
             if fut is not None and self._loop is not None and not fut.done():
                 self._loop.call_soon_threadsafe(self._resolve, fut, resp)
+
+    @staticmethod
+    def _forward_log(resp: Dict, worker) -> None:
+        from .log_capture import LogCapture
+
+        cap = LogCapture._global
+        if cap is not None:
+            cap.add(resp.get("line", ""),
+                    source=f"rank{resp.get('rank', '?')}-{resp.get('source', 'stdout')}")
 
     @staticmethod
     def _resolve(fut: asyncio.Future, resp: Dict) -> None:

@@ -33,10 +33,42 @@ from .env_contract import RankInfo, framework_for
 _SYNC_EXECUTOR_THREADS = 40  # matches the server's sync-callable concurrency
 
 
+class _QueueTee:
+    """Mirror a worker's stream into the response queue so the server-side
+    LogCapture ships rank logs too (reference create_subprocess_log_capture,
+    serving/log_capture.py:416). Dual-writes so `kubectl logs` still works."""
+
+    def __init__(self, original, response_q, source: str):
+        self.original = original
+        self.response_q = response_q
+        self.source = source
+
+    def write(self, data: str):
+        self.original.write(data)
+        if data.strip():
+            try:
+                self.response_q.put({"op": "log", "line": data.rstrip("\n"),
+                                     "source": self.source,
+                                     "rank": os.environ.get("RANK", "0")})
+            except Exception:
+                pass
+        return len(data)
+
+    def flush(self):
+        self.original.flush()
+
+    def isatty(self):
+        return False
+
+
 def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
                  env: Dict[str, str], pointers_dict: Optional[Dict],
                  init_args: Optional[Dict], framework_name: str) -> None:
+    import sys as _sys
+
     os.environ.update(env)
+    _sys.stdout = _QueueTee(_sys.stdout, response_q, "stdout")
+    _sys.stderr = _QueueTee(_sys.stderr, response_q, "stderr")
     asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
                              framework_name))
 

@@ -1,0 +1,76 @@
+"""Ray distribution mode: head-only controller.
+
+Reference (``serving/ray_supervisor.py``): the rank-0 pod starts the Ray head
+(GCS), workers join via ``ray start --address``, user code runs only on the
+head (1 subprocess) and uses Ray's own scheduling for fan-out. DNS membership
+monitoring is off — Ray owns membership.
+
+TPU note: Ray mode is the CPU-side orchestration option; TPU workloads route
+through the SPMD/JAX path (SURVEY §2.9). Requires ``ray`` in the image.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import time
+from typing import Dict, Optional
+
+from ..utils.procs import wait_for_port
+from .discovery import my_pod_ip
+from .execution_supervisor import DistributedSupervisor
+
+GCS_PORT = 6379
+
+
+class RaySupervisor(DistributedSupervisor):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._ray_proc: Optional[subprocess.Popen] = None
+        self._is_head = False
+
+    def num_procs(self) -> int:
+        return 1  # user code runs on the head only
+
+    def setup(self) -> None:
+        if shutil.which("ray") is None:
+            raise RuntimeError(
+                "distribution_type='ray' requires ray in the image: "
+                "Image().pip_install(['ray'])")
+        ips = sorted(self.discover() or [my_pod_ip()])
+        head_ip = ips[0]
+        self._is_head = my_pod_ip() == head_ip or len(ips) == 1
+        if self._is_head:
+            self._ray_proc = subprocess.Popen(
+                ["ray", "start", "--head", "--port", str(GCS_PORT),
+                 "--disable-usage-stats", "--block"])
+            if not wait_for_port(head_ip, GCS_PORT, timeout=60):
+                raise RuntimeError("Ray GCS failed to start")
+            super().setup()  # one ProcessWorker for user code
+        else:
+            self._ray_proc = subprocess.Popen(
+                ["ray", "start", "--address", f"{head_ip}:{GCS_PORT}",
+                 "--disable-usage-stats", "--block"])
+            # workers host Ray worker processes only; no callable pool
+            self.pool = None
+        # Ray owns membership; no DNS monitor (reference :126-129)
+
+    def cleanup(self) -> None:
+        # User-code Ray state lives in the rank subprocess; its shutdown op
+        # (ProcessWorker) runs framework cleanup before the head dies.
+        super().cleanup()
+        if self._ray_proc is not None and self._ray_proc.poll() is None:
+            subprocess.run(["ray", "stop", "--force"], capture_output=True)
+            self._ray_proc.terminate()
+            self._ray_proc = None
+
+    @property
+    def healthy(self) -> bool:
+        if self._is_head:
+            return super().healthy
+        return self._ray_proc is not None and self._ray_proc.poll() is None
+
+    async def call(self, method, args, kwargs, **kw):
+        if not self._is_head:
+            raise RuntimeError("Ray calls must target the head pod")
+        return await super().call(method, args, kwargs, **kw)

@@ -1,0 +1,105 @@
+"""Checkpoint/resume on the data-store KV surface.
+
+Reference position (SURVEY §5.4): no training-checkpoint manager — the
+primitive is ``kt.put("ckpt", state_dict)`` with per-tensor keys enabling
+resharding, plus packed broadcast for trainer→inference weight sync.
+
+Here the same surface is wired for JAX: ``save_state`` stages the TrainState
+pytree to host and stores per-leaf keys; ``restore_state`` reshards onto the
+*current* mesh via the rules table, so a checkpoint written on a v5e-8 mesh
+restores onto a v5p-64 mesh unchanged. For purely local checkpoints (no
+store), Orbax handles the filesystem layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..data_store import commands as ds
+from .train_step import TrainState
+
+
+def save_state(key: str, state: TrainState, store_url: Optional[str] = None) -> dict:
+    tree = {"params": state.params, "opt_state": _jsonable_opt(state.opt_state),
+            "step": state.step}
+    return ds.put(key, tree, store_url=store_url)
+
+
+def restore_state(key: str, like: TrainState, store_url: Optional[str] = None,
+                  mesh: Optional[Any] = None, rules: Optional[Any] = None) -> TrainState:
+    """Restore into the structure of ``like`` (an initialized TrainState),
+    optionally resharding params/opt-state onto ``mesh`` per ``rules``."""
+    import jax
+
+    tree = ds.get(key, store_url=store_url, mesh=mesh, rules=rules)
+    saved: dict = tree["opt_state"]
+    flat_like, treedef = jax.tree_util.tree_flatten_with_path(like.opt_state)
+    if len(saved) != len(flat_like):
+        raise ValueError(
+            f"Checkpoint opt_state has {len(saved)} leaves, expected "
+            f"{len(flat_like)} — optimizer config changed?")
+    ordered = []
+    for path, _ in flat_like:
+        k = _path_key(path)
+        if k not in saved:
+            raise ValueError(f"Checkpoint opt_state missing leaf {k!r}")
+        ordered.append(saved[k])
+    opt_state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(like.opt_state), ordered)
+    step = tree["step"]
+    if hasattr(step, "item"):
+        import jax.numpy as jnp
+        step = jnp.asarray(step)
+    return TrainState(params=tree["params"], opt_state=opt_state, step=step)
+
+
+def _path_key(path) -> str:
+    """Leaf path → store key whose suffix matches sharding-rule regexes
+    ('0/mu/layers/wq' still ends in 'wq', so Adam mu/nu reshard like their
+    params instead of replicating)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _jsonable_opt(opt_state: Any) -> Any:
+    """Optimizer states are nested namedtuples; the store speaks dict/list
+    pytrees. Flatten to a path-keyed dict (structure is recovered from a
+    live TrainState at restore; paths preserve rule-matching suffixes)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(opt_state)[0]
+    return {_path_key(path): _as_array(leaf) for path, leaf in flat}
+
+
+def _as_array(x: Any) -> Any:
+    import numpy as np
+    return np.asarray(x)
+
+
+def local_save(path: str, state: TrainState) -> None:
+    """Filesystem checkpoint via Orbax (no data store involved)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, {"params": state.params, "opt_state": state.opt_state,
+                      "step": state.step}, force=True)
+
+
+def local_restore(path: str, like: Optional[TrainState] = None) -> TrainState:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path, item={"params": like.params,
+                                         "opt_state": like.opt_state,
+                                         "step": like.step} if like else None)
+    return TrainState(params=restored["params"], opt_state=restored["opt_state"],
+                      step=restored["step"])

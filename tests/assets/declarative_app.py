@@ -1,0 +1,9 @@
+"""Declarative deployment example (model: reference test_declarative.py)."""
+
+import kubetorch_tpu as kt
+
+
+@kt.compute(cpus=1)
+@kt.distribute("jax", workers=2, mesh={"fsdp": 2})
+def train(x):
+    return x * 2

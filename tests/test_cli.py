@@ -1,0 +1,55 @@
+"""CLI parsing/formatting with click's test runner (reference test_cli.py
+model — no cluster needed for parse-level tests)."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from kubetorch_tpu.cli import cli
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_help_lists_commands(runner):
+    r = runner.invoke(cli, ["--help"])
+    assert r.exit_code == 0
+    for cmd in ("check", "deploy", "call", "list", "teardown", "logs", "put",
+                "get", "ls", "rm", "secrets", "volumes", "run", "apply",
+                "describe", "server", "store", "controller", "debug"):
+        assert cmd in r.output, f"missing command {cmd}"
+
+
+def test_config_get_set(runner, tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_CONFIG_PATH", str(tmp_path / "config"))
+    from kubetorch_tpu.config import reset_config
+    reset_config()
+    r = runner.invoke(cli, ["config", "set", "namespace", "ml-team"])
+    assert r.exit_code == 0, r.output
+    reset_config()
+    r = runner.invoke(cli, ["config", "get", "namespace"])
+    assert "ml-team" in r.output
+    reset_config()
+
+
+def test_teardown_requires_target(runner):
+    r = runner.invoke(cli, ["teardown"])
+    assert r.exit_code != 0
+    assert "SERVICE, --all, or --prefix" in r.output
+
+
+def test_secrets_providers(runner):
+    r = runner.invoke(cli, ["secrets", "providers"])
+    assert r.exit_code == 0
+    assert "anthropic" in r.output and "huggingface" in r.output
+
+
+def test_deploy_no_decorators(runner, tmp_path):
+    f = tmp_path / "plain.py"
+    f.write_text("def f():\n    return 1\n")
+    r = runner.invoke(cli, ["deploy", str(f)])
+    assert r.exit_code == 0
+    assert "No @kt.compute-decorated callables" in r.output

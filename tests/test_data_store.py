@@ -115,3 +115,45 @@ def test_kv_roundtrip(store):
     assert [k["key"] for k in r.json()["keys"]] == ["ckpt/layer0.w"]
     requests.delete(f"{store}/kv/ckpt/layer0.w")
     assert requests.get(f"{store}/kv/ckpt/layer0.w").status_code == 404
+
+
+@pytest.mark.slow
+def test_pytree_put_get_roundtrip(store):
+    import numpy as np
+    from kubetorch_tpu.data_store import commands as ds
+
+    tree = {"layers": {"wq": np.arange(12, dtype=np.float32).reshape(3, 4),
+                       "scale": np.float32(2.5)},
+            "steps": [np.ones(2, dtype=np.int32), np.zeros(3, dtype=np.int32)]}
+    stats = ds.put("ckpt/run1", tree, store_url=store)
+    assert stats["leaves"] == 4
+
+    out = ds.get("ckpt/run1", store_url=store)
+    np.testing.assert_array_equal(out["layers"]["wq"], tree["layers"]["wq"])
+    np.testing.assert_array_equal(out["steps"][1], tree["steps"][1])
+
+    keys = [k["key"] for k in ds.ls("ckpt/run1", store_url=store)]
+    assert "ckpt/run1/layers/wq" in keys
+    assert ds.rm("ckpt/run1", store_url=store)
+    with pytest.raises(Exception):
+        ds.get("ckpt/run1", store_url=store)
+
+
+@pytest.mark.slow
+def test_pytree_reshard_on_get(store, cpu_mesh_devices):
+    """Save from host, load sharded onto a mesh — per-leaf resharding."""
+    import numpy as np
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import LLAMA_RULES
+
+    tree = {"layers": {"wq": np.zeros((2, 8, 16), np.float32)}}
+    ds.put("ckpt/shard", tree, store_url=store)
+    mesh = build_mesh({"fsdp": 4, "tensor": 2})
+    out = ds.get("ckpt/shard", store_url=store, mesh=mesh, rules=LLAMA_RULES)
+    wq = out["layers"]["wq"]
+    import jax
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    shard_shapes = {s.data.shape for s in wq.addressable_shards}
+    assert shard_shapes == {(2, 2, 8)}
+    ds.rm("ckpt/shard", store_url=store)

@@ -1,0 +1,157 @@
+"""TPU topology table + manifest builders + autoscaling + declarative API."""
+
+import pytest
+
+from kubetorch_tpu.provisioning.tpu_topology import parse_tpu_spec
+from kubetorch_tpu.resources.autoscaling import AutoscalingConfig
+
+
+class TestTpuTopology:
+    def test_v5p_64_is_8_hosts(self):
+        s = parse_tpu_spec("v5p-64")   # 64 cores → 32 chips → 8 hosts
+        assert s.chips == 32 and s.num_hosts == 8
+        assert s.generation.name == "v5p"
+        sel = s.node_selectors()
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+        assert s.container_resources() == {"google.com/tpu": "4"}
+
+    def test_v5e_sizes(self):
+        s4 = parse_tpu_spec("v5e-4")
+        assert s4.chips == 4 and s4.num_hosts == 1 and s4.topology == "2x2"
+        s8 = parse_tpu_spec("v5litepod-8")
+        assert s8.chips == 8 and s8.num_hosts == 2 and s8.topology == "2x4"
+        s256 = parse_tpu_spec("v5e-256")
+        assert s256.num_hosts == 64 and s256.topology == "16x16"
+
+    def test_explicit_topology(self):
+        s = parse_tpu_spec("v5e:4x4")
+        assert s.chips == 16 and s.topology == "4x4"
+
+    def test_invalid_specs(self):
+        with pytest.raises(ValueError, match="Unknown TPU generation"):
+            parse_tpu_spec("v99-8")
+        with pytest.raises(ValueError, match="not a valid shape"):
+            parse_tpu_spec("v5e-7")
+        with pytest.raises(ValueError, match="Unrecognized"):
+            parse_tpu_spec("8xv5e")
+
+    def test_hbm_and_flops(self):
+        s = parse_tpu_spec("v5e-8")
+        assert s.total_hbm_gb == 8 * 16
+        assert s.peak_bf16_tflops == 8 * 197
+
+
+class TestManifests:
+    def test_deployment_with_tpu(self):
+        from kubetorch_tpu.resources.compute import Compute
+
+        c = Compute(tpu="v5e-4", memory="8Gi")
+        m = c.manifest("svc", env={"K": "v"})
+        assert m["kind"] == "Deployment"   # single-host slice
+        pod = m["spec"]["template"]["spec"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+        ctr = pod["containers"][0]
+        assert ctr["resources"]["limits"]["google.com/tpu"] == "4"
+        assert {"name": "K", "value": "v"} in ctr["env"]
+        assert pod["tolerations"][0]["key"] == "google.com/tpu"
+
+    def test_multihost_tpu_is_jobset(self):
+        from kubetorch_tpu.resources.compute import Compute
+
+        c = Compute(tpu="v5p-128")
+        m = c.manifest("big", env={})
+        assert m["kind"] == "JobSet"
+        job = m["spec"]["replicatedJobs"][0]["template"]["spec"]
+        assert job["parallelism"] == c.tpu.num_hosts
+        assert "exclusive-topology" in str(m["metadata"]["annotations"])
+
+    def test_autoscale_is_knative(self):
+        from kubetorch_tpu.resources.compute import Compute
+
+        c = Compute(cpus=1).autoscale(target=10, min_scale=0, max_scale=5)
+        m = c.manifest("scaled", env={})
+        assert m["kind"] == "Service"
+        ann = m["spec"]["template"]["metadata"]["annotations"]
+        assert ann["autoscaling.knative.dev/target"] == "10"
+        assert ann["autoscaling.knative.dev/class"] == "kpa.autoscaling.knative.dev"
+
+    def test_kueue_label_and_suspend(self):
+        from kubetorch_tpu.resources.compute import Compute
+
+        c = Compute(cpus=1, queue_name="team-queue")
+        m = c.manifest("queued", env={})
+        assert m["metadata"]["labels"]["kueue.x-k8s.io/queue-name"] == "team-queue"
+        assert m["spec"]["paused"] is True
+
+
+class TestAutoscalingConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="metric"):
+            AutoscalingConfig(metric="bogus")
+        with pytest.raises(ValueError, match="max_scale"):
+            AutoscalingConfig(min_scale=5, max_scale=2)
+        with pytest.raises(ValueError, match="duration"):
+            AutoscalingConfig(window="60")
+
+    def test_hpa_class_for_cpu(self):
+        a = AutoscalingConfig(metric="cpu", target=70)
+        assert "hpa" in a.annotations()["autoscaling.knative.dev/class"]
+
+
+class TestDeclarative:
+    def test_decorator_chain_builds(self, monkeypatch):
+        import importlib
+        import sys
+
+        monkeypatch.setenv("KT_CLI_DEPLOY_MODE", "1")
+        from kubetorch_tpu.resources import decorators as deco
+
+        deco.clear_registry()
+        sys.modules.pop("tests.assets.declarative_app", None)
+        importlib.import_module("tests.assets.declarative_app")
+        mods = deco.collected_modules()
+        assert len(mods) == 1
+        pm = mods[0]
+        assert pm(5) == 10              # still a normal callable
+        module, compute = pm.build()
+        assert compute.distributed.mesh == {"fsdp": 2}
+        assert compute.replicas == 2
+        assert module.pointers.cls_or_fn_name == "train"
+        deco.clear_registry()
+        sys.modules.pop("tests.assets.declarative_app", None)
+
+
+class TestSecretsVolumes:
+    def test_secret_from_env(self, monkeypatch):
+        from kubetorch_tpu.resources.secret import Secret
+
+        monkeypatch.setenv("MY_TOKEN", "abc123")
+        s = Secret.from_env(["MY_TOKEN"], name="tok")
+        assert s.env_vars() == {"MY_TOKEN": "abc123"}
+        with pytest.raises(ValueError, match="not set"):
+            Secret.from_env(["NOPE_VAR_XYZ"])
+
+    def test_secret_unknown_provider(self):
+        from kubetorch_tpu.resources.secret import Secret
+        with pytest.raises(ValueError, match="Unknown provider"):
+            Secret.from_provider("doesnotexist")
+
+    def test_volume_manifest(self):
+        from kubetorch_tpu.resources.volume import Volume
+
+        v = Volume("scratch", size="50Gi", mount_path="/scratch")
+        m = v.manifest("ns1")
+        assert m["kind"] == "PersistentVolumeClaim"
+        assert m["spec"]["resources"]["requests"]["storage"] == "50Gi"
+        assert v.mount_spec() == {"name": "scratch", "claim": "scratch",
+                                  "mount_path": "/scratch"}
+
+    def test_endpoint_exclusive_args(self):
+        from kubetorch_tpu.resources.endpoint import Endpoint
+
+        with pytest.raises(ValueError):
+            Endpoint()
+        with pytest.raises(ValueError):
+            Endpoint(url="http://x", selector={"a": "b"})
+        e = Endpoint(selector={"role": "head"})
+        assert e.to_service_config("svc", "ns")["selector"] == {"role": "head"}

@@ -214,10 +214,12 @@ def apply(manifest_file, namespace, name):
 @click.option("--tpu", default=None)
 def run(command, name, port, cpus, tpu):
     """Run an arbitrary server process: kt run python serve.py --port 8000."""
+    import shlex
+
     from .resources.app import app as app_factory
     from .resources.compute import Compute
 
-    a = app_factory(" ".join(command), name=name, port=port)
+    a = app_factory(shlex.join(command), name=name, port=port)
     a.to(Compute(cpus=cpus, tpu=tpu))
     click.echo(f"{a.name} → {a.service_url}")
 

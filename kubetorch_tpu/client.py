@@ -220,18 +220,33 @@ def shutdown_local_controller() -> None:
             pass
         if state:
             # never kill a reused PID: verify the process is actually our
-            # controller before signalling it
+            # controller before signalling it — and only forget the state
+            # file once the daemon is provably gone, or a failed stop would
+            # orphan a live controller forever.
+            import psutil
+
+            daemon_gone = False
             try:
-                import psutil
                 proc = psutil.Process(state["pid"])
                 if any("kubetorch_tpu.controller" in part
                        for part in proc.cmdline()):
                     kill_process_tree(state["pid"])
+                    daemon_gone = not psutil.pid_exists(state["pid"])
+                else:
+                    daemon_gone = True   # PID reused: our daemon already died
+            except psutil.NoSuchProcess:
+                daemon_gone = True
             except Exception:
-                pass
-            try:
-                os.unlink(_state_file())
-            except OSError:
-                pass
+                daemon_gone = False
+            if daemon_gone:
+                try:
+                    os.unlink(_state_file())
+                except OSError:
+                    pass
+            else:
+                import warnings
+                warnings.warn(
+                    f"Local controller pid {state['pid']} could not be "
+                    f"confirmed stopped; keeping {_state_file()}")
         if config().api_url and "127.0.0.1" in (config().api_url or ""):
             config().api_url = None

@@ -58,8 +58,19 @@ def _is_pytree_of_arrays(obj: Any) -> bool:
 
 def put(key: str, src: Any, store_url: Optional[str] = None,
         broadcast: Optional[BroadcastWindow] = None) -> Dict:
-    """Store a directory, file, array, or array pytree under ``key``."""
+    """Store a directory, file, array, or array pytree under ``key``.
+
+    With ``broadcast=BroadcastWindow(world_size=N)`` the put joins the
+    store-side quorum barrier for the key's group after storing, blocking
+    until all N participants (this producer + N-1 ``get``-side joiners via
+    the same window) have arrived — the reference's coordinated
+    trainer→inference weight-sync pattern (SURVEY §3.3).
+    """
     url = _store_url(store_url)
+    if broadcast is not None:
+        result = put(key, src, store_url=url)
+        join_broadcast(key, broadcast, store_url=url, member="producer")
+        return result
     if isinstance(src, (str, os.PathLike)):
         path = os.fspath(src)
         if os.path.isdir(path):
@@ -217,6 +228,40 @@ def _unflatten(structure: Any, prefix: str, leaves: Dict[str, Any]) -> Any:
         return [_unflatten(v, f"{prefix}/{i}" if prefix else str(i), leaves)
                 for i, v in enumerate(structure)]
     raise DataStoreError("corrupt pytree index")
+
+
+def join_broadcast(key: str, window: BroadcastWindow,
+                   store_url: Optional[str] = None,
+                   member: Optional[str] = None) -> List[str]:
+    """Join the quorum barrier for ``key``; returns the member list once all
+    ``window.world_size`` participants have arrived."""
+    import socket
+    import uuid
+
+    url = _store_url(store_url)
+    member = member or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+    r = _requests.post(f"{url}/barrier", json={
+        "group": window.group_id or f"bcast/{key}",
+        "world_size": window.world_size,
+        "member": member,
+        "timeout": window.timeout,
+    }, timeout=window.timeout + 10)
+    if r.status_code == 408:
+        data = r.json()
+        raise DataStoreError(
+            f"Broadcast window for {key!r} timed out: "
+            f"{len(data['joined'])}/{data['world_size']} joined")
+    if r.status_code != 200:
+        raise DataStoreError(f"barrier join failed: {r.status_code}")
+    return r.json()["members"]
+
+
+def get_broadcast(key: str, window: BroadcastWindow,
+                  store_url: Optional[str] = None, **get_kwargs) -> Any:
+    """Consumer side of a coordinated broadcast: join the window, then fetch
+    (reshard kwargs pass through to :func:`get`)."""
+    join_broadcast(key, window, store_url=store_url)
+    return get(key, store_url=store_url, **get_kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,115 @@
+"""Host tensor staging: same-host cross-process array handoff without copies.
+
+The reference moves tensors between the app process and its per-node transfer
+daemon via CUDA IPC handles (``pod_data_server.py:138-290``). TPUs have no
+device-buffer handles, so the kt-native equivalent stages through a
+refcounted shared-memory arena (``native.ShmSegment``):
+
+    producer:  handle = stage_pytree("w0", params)     # one device→host copy
+    consumer:  params = load_staged(handle, sharding=…) # mmap + device_put
+
+The consumer's ``np.frombuffer`` view is zero-copy; ``jax.device_put`` with a
+NamedSharding uploads only this host's shards. Segments self-unlink when the
+last process releases them.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from .. import native
+from ..exceptions import DataStoreError
+
+
+def _leaf_meta(arr) -> Dict:
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "nbytes": arr.nbytes}
+
+
+def stage_array(name: str, arr: Any) -> Dict:
+    """Stage one array; returns a JSON-able handle."""
+    import numpy as np
+
+    host = np.asarray(arr)
+    seg = native.ShmSegment.create(name, max(host.nbytes, 1))
+    np.frombuffer(seg.view, dtype=np.uint8)[:host.nbytes] = \
+        np.frombuffer(host.tobytes(), dtype=np.uint8)
+    return {"name": name, **_leaf_meta(host), "_seg": seg}
+
+
+def stage_pytree(prefix: str, tree: Any) -> Dict:
+    """Stage every leaf under ``/{prefix}-{i}`` segments; returns a handle
+    dict that (minus the live segments) can travel as JSON to a peer process
+    on the same host. The explicit structure record makes reconstruction
+    exact (digit-keyed dicts and lists are not guessed apart)."""
+    from .commands import _flatten, _structure_of
+
+    leaves: Dict[str, Any] = {}
+    _flatten(tree, "", leaves)
+    handles = {}
+    for i, (path, arr) in enumerate(sorted(leaves.items())):
+        handles[path] = stage_array(f"/{prefix.strip('/')}-{i}", arr)
+    return {"prefix": prefix, "leaves": handles,
+            "structure": _structure_of(tree)}
+
+
+def handle_to_json(handle: Dict) -> str:
+    """Strip live segment objects for the wire; consumers re-attach by name."""
+    out = {"prefix": handle["prefix"], "structure": handle["structure"],
+           "leaves": {}}
+    for path, h in handle["leaves"].items():
+        out["leaves"][path] = {k: v for k, v in h.items() if k != "_seg"}
+    return json.dumps(out)
+
+
+def load_staged(handle_json: str, sharding: Optional[Any] = None,
+                mesh: Optional[Any] = None, rules: Optional[Any] = None) -> Any:
+    """Re-attach staged segments and rebuild the pytree (device_put'ing each
+    leaf when a sharding target is given)."""
+    import numpy as np
+
+    from .commands import _unflatten
+
+    handle = json.loads(handle_json)
+    leaves = {}
+    segs = []
+    device_leaves = []
+    try:
+        for path, meta in handle["leaves"].items():
+            seg = native.ShmSegment.attach(meta["name"])
+            segs.append(seg)
+            dtype = meta["dtype"]
+            if dtype == "bfloat16":
+                import ml_dtypes
+                dtype = ml_dtypes.bfloat16
+            arr = np.frombuffer(seg.view, dtype=dtype,
+                                count=int(np.prod(meta["shape"]) or 1))
+            arr = arr.reshape(meta["shape"])
+            leaf_sharding = sharding
+            if leaf_sharding is None and mesh is not None and rules is not None:
+                from jax.sharding import NamedSharding
+                leaf_sharding = NamedSharding(mesh, rules.spec_for(path, mesh))
+            if leaf_sharding is not None:
+                import jax
+                leaves[path] = jax.device_put(arr, leaf_sharding)
+                device_leaves.append(leaves[path])
+            else:
+                leaves[path] = arr.copy()   # detach from the segment lifetime
+        if device_leaves:
+            # device_put is async: the transfer still reads the mmap'd
+            # buffers — releasing (munmap) before completion would be a
+            # use-after-free. Block first.
+            import jax
+            jax.block_until_ready(device_leaves)
+    finally:
+        for seg in segs:
+            seg.release()
+    return _unflatten(handle["structure"], "", leaves)
+
+
+def release_handle(handle: Dict) -> None:
+    for h in handle["leaves"].values():
+        seg = h.get("_seg")
+        if seg is not None:
+            seg.release()

@@ -187,6 +187,45 @@ async def list_keys(request: web.Request) -> web.Response:
     return web.json_response({"keys": sorted(out, key=lambda x: x["key"])})
 
 
+# -- broadcast barriers (MDS quorum role, reference WS /ws/gpu-broadcast) -----
+
+
+async def barrier_join(request: web.Request) -> web.Response:
+    """Long-poll quorum barrier: returns once ``world_size`` distinct members
+    have joined ``group`` (or 408 on timeout). Used to coordinate N-party
+    weight broadcast: the producer puts, everyone joins, getters fetch."""
+    st = _state(request)
+    body = await request.json()
+    group = body["group"]
+    world_size = int(body["world_size"])
+    member = body["member"]
+    timeout = float(body.get("timeout", 600.0))
+
+    barriers = getattr(st, "barriers", None)
+    if barriers is None:
+        barriers = st.barriers = {}
+    entry = barriers.setdefault(group, {"members": set(),
+                                        "event": asyncio.Event(),
+                                        "world_size": world_size})
+    entry["members"].add(member)
+    if len(entry["members"]) >= entry["world_size"]:
+        entry["event"].set()
+    try:
+        await asyncio.wait_for(entry["event"].wait(), timeout)
+    except asyncio.TimeoutError:
+        return web.json_response(
+            {"error": "barrier timeout",
+             "joined": sorted(entry["members"]),
+             "world_size": entry["world_size"]}, status=408)
+    # last joiner garbage-collects the group after a grace period
+    if len(entry["members"]) >= entry["world_size"]:
+        async def _gc():
+            await asyncio.sleep(60)
+            barriers.pop(group, None)
+        asyncio.ensure_future(_gc())
+    return web.json_response({"ok": True, "members": sorted(entry["members"])})
+
+
 # -- peer registry (MDS role) -------------------------------------------------
 
 
@@ -227,6 +266,7 @@ def create_store_app(root: str) -> web.Application:
     r.add_get("/keys", list_keys)
     r.add_post("/register", register_peer)
     r.add_get("/peer/{key:.+}", lookup_peer)
+    r.add_post("/barrier", barrier_join)
     return app
 
 

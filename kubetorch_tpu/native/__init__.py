@@ -1,0 +1,146 @@
+"""ctypes bindings for the native runtime (``kt_native.cpp``).
+
+Auto-builds the shared library on first import when a toolchain is present;
+every entry point has a pure-Python fallback so the framework works (slower)
+without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libkt_native.so")
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_DIR, "kt_native.cpp")
+        if os.path.exists(src):
+            try:
+                subprocess.run(["make", "-C", _DIR], capture_output=True,
+                               timeout=120, check=True)
+            except Exception:
+                return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.kt_xxh64.restype = ctypes.c_uint64
+    lib.kt_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.kt_xxh64_file.restype = ctypes.c_uint64
+    lib.kt_xxh64_file.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_int)]
+    lib.kt_shm_create.restype = ctypes.c_void_p
+    lib.kt_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_int)]
+    lib.kt_shm_attach.restype = ctypes.c_void_p
+    lib.kt_shm_attach.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_int)]
+    lib.kt_shm_release.restype = ctypes.c_int64
+    lib.kt_shm_release.argtypes = [ctypes.c_char_p, ctypes.c_void_p]
+    lib.kt_shm_refcount.restype = ctypes.c_int64
+    lib.kt_shm_refcount.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        # fallback: stdlib hash of comparable speed class
+        import hashlib
+        return int.from_bytes(
+            hashlib.blake2b(data, digest_size=8, key=seed.to_bytes(8, "little")
+                            ).digest(), "little")
+    return lib.kt_xxh64(data, len(data), seed)
+
+
+def xxh64_file(path: str, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        with open(path, "rb") as f:
+            return xxh64(f.read(), seed)
+    err = ctypes.c_int(0)
+    h = lib.kt_xxh64_file(path.encode(), seed, ctypes.byref(err))
+    if err.value != 0:
+        raise OSError(err.value, os.strerror(err.value), path)
+    return h
+
+
+class ShmSegment:
+    """A refcounted shared-memory staging buffer.
+
+    Producer: ``seg = ShmSegment.create("/kt-w0", nbytes); seg.view[:] = ...``
+    Consumer (other process): ``seg = ShmSegment.attach("/kt-w0")`` then wrap
+    ``seg.view`` in ``np.frombuffer`` → ``jax.device_put`` — one host copy
+    total, zero pickling. The segment unlinks itself when the last holder
+    releases.
+    """
+
+    def __init__(self, name: str, ptr: int, size: int):
+        self.name = name
+        self._ptr = ptr
+        self.size = size
+        self._released = False
+
+    @classmethod
+    def create(cls, name: str, size: int) -> "ShmSegment":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("kt_native library unavailable (no toolchain?)")
+        err = ctypes.c_int(0)
+        ptr = lib.kt_shm_create(name.encode(), size, ctypes.byref(err))
+        if not ptr:
+            raise OSError(err.value, f"shm create failed: {os.strerror(err.value)}")
+        return cls(name, ptr, size)
+
+    @classmethod
+    def attach(cls, name: str, writable: bool = False) -> "ShmSegment":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("kt_native library unavailable (no toolchain?)")
+        err = ctypes.c_int(0)
+        size = ctypes.c_uint64(0)
+        ptr = lib.kt_shm_attach(name.encode(), int(writable),
+                                ctypes.byref(size), ctypes.byref(err))
+        if not ptr:
+            raise OSError(err.value, f"shm attach failed: {os.strerror(err.value)}")
+        return cls(name, ptr, size.value)
+
+    @property
+    def view(self) -> memoryview:
+        buf = (ctypes.c_char * self.size).from_address(self._ptr)
+        return memoryview(buf)
+
+    @property
+    def refcount(self) -> int:
+        lib = _load()
+        return lib.kt_shm_refcount(ctypes.c_void_p(self._ptr))
+
+    def release(self) -> int:
+        if self._released:
+            return -1
+        self._released = True
+        lib = _load()
+        return lib.kt_shm_release(self.name.encode(), ctypes.c_void_p(self._ptr))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

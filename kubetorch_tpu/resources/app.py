@@ -25,8 +25,7 @@ class App(Module):
         # Apps have no importable callable; pointers carry only the name.
         pointers = Pointers(project_root=".", module_name="", file_path="",
                             cls_or_fn_name=name or "app")
-        base = name or shlex.split(command)[-1].split("/")[-1].split(".")[0]
-        super().__init__(pointers, name=base)
+        super().__init__(pointers, name=name or _name_from_command(command))
         self.command = command
         self.port = port
         self.health_path = health_path
@@ -40,13 +39,37 @@ class App(Module):
         if self.port:
             meta["KT_APP_PORT"] = str(self.port)
         if self.compute:
-            meta["KT_DOCKERFILE"] = self.compute.image.cmd(self.command).dockerfile()
+            # never mutate the user's Image: redeploys would stack CMDs and
+            # replay/restart the app on every no-op .to()
+            import copy
+            image = copy.deepcopy(self.compute.image)
+            meta["KT_DOCKERFILE"] = image.cmd(self.command).dockerfile()
         return meta
 
     def status(self) -> Dict:
         import requests
         r = requests.get(f"{self.service_url}/app/status", timeout=10)
         return r.json()
+
+
+def _name_from_command(command: str) -> str:
+    """Service name from the most script-like token: first *.py/*.sh/*.js
+    basename, else the first non-flag token's basename, else 'app'.
+
+    "python serve.py --verbose" → serve; "python -m http.server 8000" →
+    http-server (never '--verbose' or '8000')."""
+    tokens = shlex.split(command)
+    for tok in tokens:
+        base = tok.rsplit("/", 1)[-1]
+        if base.endswith((".py", ".sh", ".js")):
+            return base.rsplit(".", 1)[0]
+    for i, tok in enumerate(tokens):
+        if tok == "-m" and i + 1 < len(tokens):
+            return tokens[i + 1]
+        if not tok.startswith("-") and tok not in ("python", "python3", "node",
+                                                   "bash", "sh", "uv", "uvx"):
+            return tok.rsplit("/", 1)[-1]
+    return "app"
 
 
 def app(command: str, name: Optional[str] = None, port: Optional[int] = None) -> App:

@@ -37,7 +37,7 @@ def first_mismatch(old: List[Tuple[str, str]], new: List[Tuple[str, str]]) -> in
     for i, (a, b) in enumerate(zip(old, new)):
         if a != b:
             return i
-    return min(len(old), len(new)) if len(old) != len(new) else len(new)
+    return min(len(old), len(new))
 
 
 async def run_image_setup(dockerfile: str, state=None) -> Dict:
@@ -48,11 +48,13 @@ async def run_image_setup(dockerfile: str, state=None) -> Dict:
     old = _parse("\n".join(_CACHED_DOCKERFILE))
     start = first_mismatch(old, new)
     replayed = 0
-    pip_touched = False
+    pip_touched = any("pip install" in v.replace("$KT_PIP_INSTALL_CMD",
+                                                 _PIP_INSTALL_CMD)
+                      for k, v in new[start:] if k == "RUN")
+    before = _installed_versions() if pip_touched else {}
     for kind, value in new[start:]:
         if kind == "RUN":
             cmd = value.replace("$KT_PIP_INSTALL_CMD", _PIP_INSTALL_CMD)
-            pip_touched |= "pip install" in cmd
             proc = await asyncio.create_subprocess_shell(
                 cmd, stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.STDOUT)
@@ -74,20 +76,50 @@ async def run_image_setup(dockerfile: str, state=None) -> Dict:
         replayed += 1
 
     if pip_touched:
-        _evict_reinstalled_modules()
+        _evict_changed_distributions(before)
     _CACHED_DOCKERFILE = dockerfile.splitlines()
     return {"instructions": len(new), "replayed": replayed}
 
 
-def _evict_reinstalled_modules() -> None:
-    """Drop site-packages modules from sys.modules so upgraded versions load
-    on next import (reference :775-815). User project modules are handled by
-    the reload purge; the runtime itself is never evicted."""
-    for name, mod in list(sys.modules.items()):
-        if name.split(".")[0] in ("kubetorch_tpu", "sys", "os", "builtins"):
+def _installed_versions() -> dict:
+    import importlib
+    import importlib.metadata as md
+
+    importlib.invalidate_caches()
+    out = {}
+    for dist in md.distributions():
+        try:
+            out[dist.metadata["Name"]] = dist.version
+        except Exception:
             continue
-        f = getattr(mod, "__file__", None)
-        if f and "site-packages" in f:
+    return out
+
+
+def _evict_changed_distributions(before: dict) -> None:
+    """Pip-freeze diff (reference :775-815): evict only the modules of
+    distributions whose version changed — never the whole of site-packages
+    (dropping live jax/aiohttp would break the running server and re-init
+    libtpu, which is single-client)."""
+    import importlib
+    import importlib.metadata as md
+
+    importlib.invalidate_caches()
+    after = _installed_versions()
+    changed = {name for name, ver in after.items()
+               if before.get(name) != ver}
+    if not changed:
+        return
+    evict_roots = set()
+    for dist_name in changed:
+        try:
+            dist = md.distribution(dist_name)
+            top = (dist.read_text("top_level.txt") or "").split()
+            evict_roots.update(top or [dist_name.replace("-", "_")])
+        except Exception:
+            evict_roots.add(dist_name.replace("-", "_"))
+    evict_roots.discard("kubetorch_tpu")
+    for name in list(sys.modules):
+        if name.split(".")[0] in evict_roots:
             sys.modules.pop(name, None)
 
 

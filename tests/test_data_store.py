@@ -157,3 +157,47 @@ def test_pytree_reshard_on_get(store, cpu_mesh_devices):
     shard_shapes = {s.data.shape for s in wq.addressable_shards}
     assert shard_shapes == {(2, 2, 8)}
     ds.rm("ckpt/shard", store_url=store)
+
+
+@pytest.mark.slow
+def test_coordinated_broadcast_window(store):
+    """Producer put(broadcast=) blocks until all consumers join; consumers
+    fetch after the quorum (reference SURVEY §3.3 weight-sync pattern)."""
+    import threading
+    import numpy as np
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.data_store.types import BroadcastWindow
+
+    win = lambda: BroadcastWindow(world_size=3, timeout=30)
+    results = {}
+
+    def producer():
+        results["put"] = ds.put("bcast/w", {"w": np.ones(4, np.float32)},
+                                store_url=store, broadcast=win())
+
+    def consumer(i):
+        results[f"get{i}"] = ds.get_broadcast("bcast/w", win(), store_url=store)
+
+    threads = [threading.Thread(target=producer),
+               threading.Thread(target=consumer, args=(1,)),
+               threading.Thread(target=consumer, args=(2,))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=40)
+    assert results["put"]["leaves"] == 1
+    np.testing.assert_array_equal(results["get1"]["w"], np.ones(4, np.float32))
+    np.testing.assert_array_equal(results["get2"]["w"], np.ones(4, np.float32))
+    ds.rm("bcast/w", store_url=store)
+
+
+@pytest.mark.slow
+def test_broadcast_window_timeout(store):
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.data_store.types import BroadcastWindow
+    from kubetorch_tpu.exceptions import DataStoreError
+
+    with pytest.raises(DataStoreError, match="timed out"):
+        ds.join_broadcast("bcast/lonely",
+                          BroadcastWindow(world_size=2, timeout=1.5),
+                          store_url=store)

@@ -172,6 +172,10 @@ def describe(service, namespace):
 @click.option("--namespace", default=None)
 def teardown(service, all_, prefix, namespace):
     """Delete workload(s) and their pods."""
+    if not (service or all_ or prefix):
+        # validate before touching the controller — a bare `kt teardown`
+        # must not spawn a local daemon just to print usage
+        raise click.UsageError("pass SERVICE, --all, or --prefix")
     from .client import controller_client
     client = controller_client()
     ns = namespace or kt_config().namespace
@@ -179,9 +183,7 @@ def teardown(service, all_, prefix, namespace):
         client.delete_workload(ns, service)
         click.echo(f"deleted {service}")
         return
-    if not (all_ or prefix):
-        raise click.UsageError("pass SERVICE, --all, or --prefix")
-    for w in client.list_workloads(namespace):
+    for w in client.list_workloads(ns):
         if all_ or (prefix and w["name"].startswith(prefix)):
             client.delete_workload(w["namespace"], w["name"])
             click.echo(f"deleted {w['name']}")

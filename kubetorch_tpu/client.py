@@ -118,6 +118,19 @@ _lock = threading.Lock()
 _client: Optional[ControllerClient] = None
 
 
+def _clear_client_singleton() -> None:
+    global _client
+    with _lock:
+        _client = None
+
+
+# reset_config() must also drop the derived client singleton, or a stale
+# client would silently survive a config swap
+from .config import on_reset as _on_reset  # noqa: E402
+
+_on_reset(_clear_client_singleton)
+
+
 def _state_file() -> str:
     return os.path.join(config().config_dir, "local-controller.json")
 

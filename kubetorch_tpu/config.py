@@ -111,6 +111,7 @@ class KTConfig:
 
 _config_lock = threading.Lock()
 _config: Optional[KTConfig] = None
+_reset_hooks: list = []
 
 
 def config() -> KTConfig:
@@ -122,7 +123,18 @@ def config() -> KTConfig:
         return _config
 
 
+def on_reset(hook) -> None:
+    """Register a callback fired by :func:`reset_config` — other singletons
+    derived from config state (e.g. the controller client) stay consistent."""
+    _reset_hooks.append(hook)
+
+
 def reset_config() -> None:
     global _config
     with _config_lock:
         _config = None
+    for hook in list(_reset_hooks):
+        try:
+            hook()
+        except Exception:
+            pass

@@ -301,6 +301,60 @@ async def list_events(request: web.Request) -> web.Response:
     return web.json_response({"events": events[-500:]})
 
 
+# -- service proxy (the reference's nginx-sidecar role) ----------------------
+
+
+async def proxy_service(request: web.Request) -> web.Response:
+    """Route ``/{ns}/{service}:{port}/{path}`` into the cluster (reference
+    nginx config: the single port-forward target for laptops). In local mode
+    this resolves against the backend's pod IPs."""
+    import aiohttp
+
+    state: ControllerState = request.app["cstate"]
+    ns = request.match_info["ns"]
+    svc_port = request.match_info["svc_port"]
+    path = request.match_info.get("path", "")
+    if ":" in svc_port:
+        service, port = svc_port.rsplit(":", 1)
+    else:
+        service, port = svc_port, "32300"
+
+    ips = state.backend.pod_ips(ns, service) if state.backend else []
+    record = state.workloads.get(_workload_key(ns, service), {})
+    if not ips and record.get("service_url"):
+        target = record["service_url"].rstrip("/")
+    elif ips:
+        target = f"http://{ips[0]}:{port}"
+    else:
+        target = f"http://{service}.{ns}.svc.cluster.local:{port}"
+
+    url = f"{target}/{path}"
+    body = await request.read()
+    # strip hop-by-hop headers: the body is re-framed (fully buffered), so
+    # forwarding Transfer-Encoding/Connection would corrupt upstream framing
+    _hop = {"host", "content-length", "connection", "keep-alive",
+            "transfer-encoding", "upgrade", "te", "trailers",
+            "proxy-authenticate", "proxy-authorization"}
+    headers = {k: v for k, v in request.headers.items()
+               if k.lower() not in _hop}
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.request(
+                    request.method, url, data=body or None, headers=headers,
+                    params=request.query,
+                    timeout=aiohttp.ClientTimeout(total=600)) as resp:
+                payload = await resp.read()
+                out_headers = {k: v for k, v in resp.headers.items()
+                               if k.lower() in ("content-type",
+                                                "x-serialization",
+                                                "x-request-id")}
+                return web.Response(body=payload, status=resp.status,
+                                    headers=out_headers)
+    except aiohttp.ClientError as e:
+        return web.json_response({"error": f"proxy to {url} failed: {e}"},
+                                 status=502)
+
+
 # -- pod websocket -----------------------------------------------------------
 
 
@@ -409,6 +463,7 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
     r.add_get("/controller/logs", query_logs)
     r.add_get("/controller/events", list_events)
     r.add_get("/controller/ws/pods", pods_ws)
+    r.add_route("*", "/{ns}/{svc_port}/{path:.*}", proxy_service)
     app.on_startup.append(_startup)
     app.on_cleanup.append(_cleanup)
     return app

@@ -1,0 +1,87 @@
+"""kubectl port-forward manager for laptop → cluster access.
+
+Reference (``globals.py:123-366``): a cached ``kubectl port-forward`` to the
+controller's nginx, with ``service_url()`` returning in-cluster DNS when
+running inside the cluster and ``http://localhost:<pf>`` outside; atexit
+cleanup. Same shape here, targeting the controller service (which proxies
+``/{ns}/{service}:{port}/{path}`` onward).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from ..utils.procs import free_port, kill_process_tree, wait_for_port
+
+_lock = threading.Lock()
+_handles: Dict[str, "PFHandle"] = {}
+
+
+class PFHandle:
+    def __init__(self, target: str, local_port: int, proc: subprocess.Popen):
+        self.target = target
+        self.local_port = local_port
+        self.proc = proc
+
+    @property
+    def url(self) -> str:
+        return f"http://localhost:{self.local_port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        if self.alive:
+            kill_process_tree(self.proc.pid)
+
+
+def in_cluster() -> bool:
+    return os.path.exists("/var/run/secrets/kubernetes.io/serviceaccount/token")
+
+
+def ensure_port_forward(service: str = "kubetorch-controller",
+                        namespace: str = "kubetorch",
+                        remote_port: int = 8080) -> PFHandle:
+    """Cached kubectl port-forward to a cluster service."""
+    key = f"{namespace}/{service}:{remote_port}"
+    with _lock:
+        handle = _handles.get(key)
+        if handle is not None and handle.alive:
+            return handle
+        if shutil.which("kubectl") is None:
+            raise RuntimeError("kubectl not found; cannot port-forward")
+        local = free_port()
+        proc = subprocess.Popen(
+            ["kubectl", "port-forward", f"svc/{service}",
+             f"{local}:{remote_port}", "-n", namespace],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        if not wait_for_port("127.0.0.1", local, timeout=15):
+            kill_process_tree(proc.pid)
+            raise RuntimeError(f"port-forward to {key} failed")
+        handle = PFHandle(key, local, proc)
+        _handles[key] = handle
+        atexit.register(close_all)
+        return handle
+
+
+def service_url(service: str, namespace: str = "default",
+                port: int = 32300) -> str:
+    """In-cluster DNS inside the cluster, controller-proxied URL outside
+    (reference ``service_url`` :302)."""
+    if in_cluster():
+        return f"http://{service}.{namespace}.svc.cluster.local:{port}"
+    pf = ensure_port_forward()
+    return f"{pf.url}/{namespace}/{service}:{port}"
+
+
+def close_all() -> None:
+    with _lock:
+        for handle in _handles.values():
+            handle.close()
+        _handles.clear()

@@ -1,0 +1,105 @@
+"""ActorMesh: single-controller actor programming over the pod fabric.
+
+Reference analog: the Monarch mode (``serving/monarch_supervisor.py``) — a
+Rust ``process_allocator`` daemon on every pod plus a hyperactor mesh. The
+TPU-native rebuild needs neither: the pod runtime already hosts a live class
+instance per pod (SPMD supervisor + ``Cls``), so an actor mesh is a *client
+view* — selective dispatch (one actor), multicast (a subset), broadcast
+(all), and async futures — over exactly the same pods. State lives per pod
+and survives across calls; on TPU each actor owns its host's chips.
+
+    mesh = kt.actors(MyActor, init_kwargs={...}).to(
+        kt.Compute(tpu="v5e-8").distribute("actor", workers=2))
+    mesh.act(0).step(x)                 # one actor
+    mesh.all().sync_weights(ckpt)       # broadcast
+    fut = mesh.act(1).rollout.remote()  # async future
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, List, Optional, Sequence, Type, Union
+
+from .cls import Cls
+from .module import module_factory
+
+
+class _ActorMethod:
+    def __init__(self, mesh: "ActorMesh", selector, name: str):
+        self.mesh = mesh
+        self.selector = selector
+        self.name = name
+
+    def __call__(self, *args, timeout: Optional[float] = None, **kwargs):
+        result = self.mesh._module._http_client().call_method(
+            self.mesh._module.pointers.cls_or_fn_name, method=self.name,
+            args=args, kwargs=kwargs, workers=self.selector, timeout=timeout)
+        if isinstance(self.selector, list) and len(self.selector) == 1 and \
+                isinstance(result, list) and len(result) == 1:
+            return result[0]
+        return result
+
+    def remote(self, *args, **kwargs) -> Future:
+        """Fire-and-collect future (the actor-model async call)."""
+        return self.mesh._executor.submit(self.__call__, *args, **kwargs)
+
+
+class _ActorHandle:
+    def __init__(self, mesh: "ActorMesh", selector):
+        self._mesh = mesh
+        self._selector = selector
+
+    def __getattr__(self, name: str) -> _ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self._mesh, self._selector, name)
+
+
+class ActorMesh:
+    def __init__(self, module: Cls):
+        self._module = module
+        self._executor = ThreadPoolExecutor(max_workers=64)
+
+    def to(self, compute) -> "ActorMesh":
+        if compute.distributed is None:
+            compute = compute.distribute("spmd", workers=1)
+        elif compute.distributed.distribution_type == "actor":
+            # actors ride the SPMD fabric; the supervisor type is the same
+            compute.distributed.distribution_type = "spmd"
+        self._module.to(compute)
+        return self
+
+    @property
+    def world_size(self) -> int:
+        c = self._module.compute
+        return c.replicas if c else 1
+
+    def act(self, index: int) -> _ActorHandle:
+        """Handle to one actor (pod ``index`` in sorted-IP order)."""
+        return _ActorHandle(self, [index])
+
+    def actors(self, indices: Sequence[int]) -> _ActorHandle:
+        return _ActorHandle(self, list(indices))
+
+    def all(self) -> _ActorHandle:
+        return _ActorHandle(self, "all")
+
+    def ready(self) -> _ActorHandle:
+        """Only actors whose pods pass health checks (elastic dispatch)."""
+        return _ActorHandle(self, "ready")
+
+    def teardown(self) -> None:
+        self._module.teardown()
+        self._executor.shutdown(wait=False)
+
+
+def actors(klass: Type, name: Optional[str] = None,
+           init_args: Optional[list] = None,
+           init_kwargs: Optional[dict] = None) -> ActorMesh:
+    """``kt.actors(Learner)`` — deployable actor mesh."""
+    ia = None
+    if init_args or init_kwargs:
+        ia = {"args": list(init_args or []), "kwargs": init_kwargs or {}}
+    module = module_factory(klass, name=name, init_args=ia, cls_type=Cls)
+    return ActorMesh(module)

@@ -75,8 +75,11 @@ class HTTPClient:
         url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")
 
         stop_streaming = None
+        stop_metrics = None
         if (self.stream_logs if stream_logs is None else stream_logs):
             stop_streaming = self._start_log_stream(request_id)
+        if config().stream_metrics:
+            stop_metrics = self._start_metric_stream()
         try:
             resp = self._session.post(
                 url,
@@ -88,6 +91,8 @@ class HTTPClient:
         finally:
             if stop_streaming:
                 stop_streaming()
+            if stop_metrics:
+                stop_metrics()
         return CustomResponse(resp.status_code, resp.content,
                               dict(resp.headers)).result()
 
@@ -121,6 +126,33 @@ class HTTPClient:
             return r.status_code == 200
         except _requests.RequestException:
             return False
+
+    # -- metric streaming -----------------------------------------------------
+
+    def _start_metric_stream(self, interval: float = 3.0):
+        """Poll the service's /metrics during a call and echo TPU HBM /
+        activity gauges (reference streams DCGM GPU util via PromQL,
+        ``http_client.py:758-795``; TPU gauges come from the pod's own
+        metrics endpoint)."""
+        stop = threading.Event()
+
+        def pump():
+            # module-level requests, NOT self._session: Session isn't
+            # thread-safe and the main thread's POST is in flight
+            while not stop.wait(interval):
+                try:
+                    r = _requests.get(f"{self.base_url}/metrics", timeout=3)
+                    if r.status_code != 200:
+                        continue
+                    gauges = [ln for ln in r.text.splitlines()
+                              if ln.startswith(("kt_tpu_hbm", "kt_http"))]
+                    if gauges:
+                        print("[metrics] " + "  ".join(gauges))
+                except _requests.RequestException:
+                    pass
+
+        threading.Thread(target=pump, daemon=True).start()
+        return stop.set
 
     # -- log streaming --------------------------------------------------------
 

@@ -295,6 +295,35 @@ async def reload_route(request: web.Request) -> web.Response:
         return _error_response(e)
 
 
+async def profile_route(request: web.Request) -> web.Response:
+    """POST /_kt/profile {duration_s} → capture a jax.profiler trace in the
+    rank-0 subprocess, return it as a tar.gz (TensorBoard-loadable)."""
+    state: ServerState = request.app["state"]
+    try:
+        body = json.loads(await request.read() or b"{}")
+        sup = await state.get_supervisor()
+        result = await sup.pool.profile(
+            duration_s=float(body.get("duration_s", 3.0)))
+        import io
+        import tarfile
+
+        def _tar() -> bytes:
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                tar.add(result["trace_dir"],
+                        arcname=os.path.basename(result["trace_dir"]))
+            return buf.getvalue()
+
+        # real traces are tens of MB — never compress on the event loop
+        # (stalled /health probes would make this pod look dead mid-profile)
+        payload = await asyncio.to_thread(_tar)
+        return web.Response(body=payload,
+                            content_type="application/gzip",
+                            headers={"X-KT-Trace-Dir": result["trace_dir"]})
+    except BaseException as e:  # noqa: BLE001
+        return _error_response(e)
+
+
 async def run_callable(request: web.Request) -> web.Response:
     """POST /{fn}[/{method}] → supervisor (reference run_callable :1720)."""
     state: ServerState = request.app["state"]
@@ -361,6 +390,7 @@ def create_app(state: Optional[ServerState] = None) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/app/status", app_status)
     app.router.add_post("/_kt/reload", reload_route)
+    app.router.add_post("/_kt/profile", profile_route)
     app.router.add_post("/{fn_name}", run_callable)
     app.router.add_post("/{fn_name}/{method}", run_callable)
     app.on_startup.append(_on_startup)

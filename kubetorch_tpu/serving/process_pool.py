@@ -86,8 +86,10 @@ class ProcessPool:
         else:
             fut.set_exception(rehydrate_exception(resp["error"]))
 
-    async def call(self, idx: int, method: Optional[str], args: list,
-                   kwargs: dict, timeout: Optional[float] = None) -> Any:
+    async def _submit(self, idx: int, payload: Dict,
+                      timeout: Optional[float]) -> Any:
+        """Shared request plumbing: liveness check, future registration,
+        queue submit, awaited response."""
         worker = self.workers[idx]
         if not worker.alive:
             raise RuntimeError(f"Rank subprocess {idx} is dead")
@@ -96,9 +98,20 @@ class ProcessPool:
         fut = self._loop.create_future()
         with self._futures_lock:
             self._futures[req_id] = fut
-        worker.submit({"req_id": req_id, "method": method,
-                       "args": args, "kwargs": kwargs})
+        worker.submit({"req_id": req_id, **payload})
         return await asyncio.wait_for(fut, timeout)
+
+    async def call(self, idx: int, method: Optional[str], args: list,
+                   kwargs: dict, timeout: Optional[float] = None) -> Any:
+        return await self._submit(idx, {"method": method, "args": args,
+                                        "kwargs": kwargs}, timeout)
+
+    async def profile(self, idx: int = 0, duration_s: float = 3.0,
+                      timeout: Optional[float] = None) -> Any:
+        """Capture a jax.profiler trace in rank subprocess ``idx``."""
+        return await self._submit(idx, {"op": "profile",
+                                        "duration_s": duration_s},
+                                  timeout or duration_s + 60)
 
     async def call_all(self, method: Optional[str], args: list, kwargs: dict,
                        timeout: Optional[float] = None) -> List[Any]:

@@ -104,8 +104,11 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
         if item.get("op") == "shutdown":
             framework_for(framework_name).worker_cleanup()
             break
-        task = asyncio.ensure_future(
-            _handle(item, target, load_error, response_q, executor))
+        if item.get("op") == "profile":
+            task = asyncio.ensure_future(_handle_profile(item, response_q))
+        else:
+            task = asyncio.ensure_future(
+                _handle(item, target, load_error, response_q, executor))
         pending.add(task)
 
 
@@ -133,6 +136,32 @@ def _load_target(pointers_dict: Dict, init_args: Optional[Dict]) -> Any:
         kwargs = (init_args or {}).get("kwargs", {})
         return obj(*args, **kwargs)
     return obj
+
+
+async def _handle_profile(item: Dict, response_q) -> None:
+    """Capture a jax.profiler trace in THIS process — the one that owns the
+    TPU chips (the profiling story replacing the reference's DCGM/OTel,
+    SURVEY §5.1). Produces a TensorBoard-loadable trace directory."""
+    req_id = item.get("req_id")
+    try:
+        import glob
+        import tempfile
+
+        import jax
+
+        duration = float(item.get("duration_s", 3.0))
+        outdir = item.get("outdir") or tempfile.mkdtemp(prefix="kt-profile-")
+        with jax.profiler.trace(outdir):
+            await asyncio.sleep(duration)
+        files = sorted(glob.glob(os.path.join(outdir, "**", "*"),
+                                 recursive=True))
+        response_q.put({"req_id": req_id, "ok": True,
+                        "result": {"trace_dir": outdir,
+                                   "files": [f for f in files
+                                             if os.path.isfile(f)]}})
+    except BaseException as e:  # noqa: BLE001
+        response_q.put({"req_id": req_id, "ok": False,
+                        "error": package_exception(e)})
 
 
 async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> None:

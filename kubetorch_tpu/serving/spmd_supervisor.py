@@ -62,28 +62,37 @@ class SPMDSupervisor(DistributedSupervisor):
     # -- worker selection (reference :220-261) --------------------------------
 
     async def _select_ips(self, workers: Union[None, str, Sequence]) -> List[str]:
+        """Resolve the worker spec to the EXACT set of pods that execute.
+
+        Selection is precise — the coordinator runs user code only when it is
+        in the selected set (actor dispatch to a single peer must not also
+        run locally); when present it is moved to the front so it owns rank-0
+        duties (reference :133-141).
+        """
         all_ips = self.pod_ips() or [my_pod_ip()]
         my_ip = my_pod_ip()
         if workers is None or workers == "all":
-            selected = list(all_ips)
+            selected = sorted(all_ips)
         elif workers == "any":
             selected = [my_ip]
         elif workers == "ready":
             pool = RemoteWorkerPool.shared(self.server_port)
             checks = await asyncio.gather(
                 *[pool.check_health(ip) for ip in all_ips])
-            selected = [ip for ip, ok in zip(all_ips, checks) if ok or ip == my_ip]
+            selected = sorted(ip for ip, ok in zip(all_ips, checks)
+                              if ok or ip == my_ip)
         elif isinstance(workers, (list, tuple)):
             if all(isinstance(w, int) for w in workers):
-                selected = [all_ips[w] for w in workers if 0 <= w < len(all_ips)]
+                ordered = sorted(all_ips)
+                selected = [ordered[w] for w in workers if 0 <= w < len(ordered)]
             else:
                 selected = [w for w in workers if w in all_ips] or list(workers)
         else:
             raise ValueError(f"Invalid workers spec: {workers!r}")
-        # coordinator always participates, at rank 0 (reference :133-141)
         if my_ip in selected:
             selected.remove(my_ip)
-        return [my_ip] + sorted(selected)
+            selected = [my_ip] + selected
+        return selected
 
     # -- the call (reference :103, :366-545) ----------------------------------
 
@@ -93,38 +102,48 @@ class SPMDSupervisor(DistributedSupervisor):
                    subtree: Optional[List[str]] = None,
                    headers: Optional[Dict[str, str]] = None) -> List[Any]:
         assert self.pool is not None, "supervisor not set up"
+        my_ip = my_pod_ip()
         if subtree is not None:
             # we are an interior tree node: coordinate the given subtree
-            ips = [my_pod_ip()] + list(subtree)
+            ips = [my_ip] + list(subtree)
         else:
             self.check_membership()
             ips = await self._select_ips(workers)
 
+        run_local = bool(ips) and ips[0] == my_ip
+        remote_ips = ips[1:] if run_local else list(ips)
         n = len(ips)
-        my_index = 0  # we are always first in our (sub)tree
 
         if n > TREE_THRESHOLD:
-            child_indexes = tree_children(my_index, n)
-            remote_targets = [
-                (ips[c], [ips[d] for d in subtree_indices(c, n)])
-                for c in child_indexes
-            ]
+            if run_local:
+                # implicit fanout tree over the selected set; node 0 is us
+                remote_targets = [
+                    (ips[c], [ips[d] for d in subtree_indices(c, n)])
+                    for c in tree_children(0, n)
+                ]
+            else:
+                # we coordinate but don't execute: delegate the tree to the
+                # first selected pod
+                remote_targets = [(remote_ips[0], remote_ips[1:])]
         else:
-            remote_targets = [(ip, []) for ip in ips[1:]]
+            remote_targets = [(ip, []) for ip in remote_ips]
 
-        local_task = asyncio.ensure_future(
-            self.pool.call_all(method, args, kwargs, timeout))
+        tasks: List[asyncio.Task] = []
+        local_task = None
+        if run_local:
+            local_task = asyncio.ensure_future(
+                self.pool.call_all(method, args, kwargs, timeout))
+            tasks.append(local_task)
         pool = RemoteWorkerPool.shared(self.server_port)
         body = {"args": args, "kwargs": kwargs}
         hdrs = headers or {}
-        remote_tasks = [
+        tasks += [
             asyncio.ensure_future(pool.call_worker(
                 ip, self.fn_name, method, body, hdrs, timeout,
                 subtree=sub or None))
             for ip, sub in remote_targets
         ]
-
-        all_tasks = [local_task, *remote_tasks]
+        all_tasks = tasks
         try:
             results = await self._gather_fast_fail(all_tasks, timeout)
         except BaseException:
@@ -132,9 +151,10 @@ class SPMDSupervisor(DistributedSupervisor):
                 t.cancel()
             raise
 
-        # order: local ranks, then each remote branch's ranks (reference :547)
-        flat: List[Any] = list(results[0])
-        for branch in results[1:]:
+        # order: local ranks (when selected), then each remote branch's ranks
+        # in selection order (reference :547)
+        flat: List[Any] = []
+        for branch in results:
             flat.extend(branch if isinstance(branch, list) else [branch])
         return flat
 

@@ -1,0 +1,41 @@
+"""Elastic recovery: shrink the world when workers die, grow it back later.
+
+The reference's ``fault_tolerance/dynamic_world_size.py`` recipe (SURVEY
+§5.3): membership changes surface as typed ``WorkerMembershipChanged`` /
+``PodTerminatedError``; the client resizes and redeploys. On TPU the
+XLA-compiled mesh can't shrink in place — the resize-and-redeploy loop IS the
+elasticity mechanism, and recompilation for the new world is cached by shape.
+"""
+
+import kubetorch_tpu as kt
+
+
+def train_epoch(epoch: int):
+    import os
+    return {"epoch": epoch, "world": os.environ.get("WORLD_SIZE"),
+            "rank": os.environ.get("RANK")}
+
+
+def main():
+    compute = kt.Compute(cpus=1).distribute("spmd", workers=4)
+    f = kt.fn(train_epoch)
+    f.to(compute)
+
+    epoch = 0
+    workers = 4
+    while epoch < 10:
+        try:
+            results = f(epoch)
+            print(f"epoch {epoch}: {len(results)} workers ok")
+            epoch += 1
+        except (kt.WorkerMembershipChanged, kt.WorkerCallError,
+                kt.PodTerminatedError) as e:
+            survivors = getattr(e, "current", None)
+            workers = len(survivors) if survivors else max(workers - 1, 1)
+            print(f"membership changed ({e}); resizing to {workers}")
+            f.to(compute.distribute("spmd", workers=workers))
+    f.teardown()
+
+
+if __name__ == "__main__":
+    main()

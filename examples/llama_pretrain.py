@@ -1,0 +1,63 @@
+"""BASELINE config 3: Llama-3-8B pretraining on a v5p-64 from one call.
+
+    kt.fn(train).to(kt.Compute(tpu="v5p-64").distribute("jax", mesh=...))
+
+The mesh is the whole parallelism story: fsdp×tensor inside the slice, no
+torchrun/NCCL/launcher scripts. ``train`` runs once per TPU host;
+jax.distributed wires itself from the injected env (SURVEY §2.4 JaxProcess
+contract) and GSPMD inserts every collective.
+"""
+
+import kubetorch_tpu as kt
+
+
+def train(num_steps: int = 100, batch_per_host: int = 8, seq_len: int = 8192):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.parallel.sharding import LLAMA_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    mesh = kt.distributed.mesh()          # the mesh declared in .distribute()
+
+    cfg = LlamaConfig.llama3_8b(max_seq_len=seq_len)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    state = init_train_state(params, opt)
+    step = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg),
+                           optimizer=opt, mesh=mesh, rules=LLAMA_RULES)
+    state = step.shard_state(state)
+
+    batch_global = batch_per_host * jax.process_count()
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch_global, seq_len), 0, cfg.vocab_size)
+    batch = {"tokens": jax.device_put(tokens, step.batch_sharding),
+             "targets": jax.device_put(jnp.roll(tokens, -1, 1),
+                                       step.batch_sharding)}
+    import time
+    losses = []
+    t0 = time.time()
+    for i in range(num_steps):
+        state, metrics = step(state, batch)
+        if i % 10 == 0:
+            losses.append(float(metrics["loss"]))
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+    tokens_per_sec = num_steps * batch_global * seq_len / dt
+    return {"losses": losses,
+            "tokens_per_sec": tokens_per_sec,
+            "tokens_per_sec_per_chip": tokens_per_sec / jax.device_count()}
+
+
+def main():
+    f = kt.fn(train)
+    f.to(kt.Compute(tpu="v5p-64", memory="400Gi").distribute(
+        "jax", mesh={"data": 1, "fsdp": 16, "tensor": 2}))
+    out = f(num_steps=100)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""BASELINE config 5: Mixtral 8×7B MoE with expert parallelism.
+
+Expert weights shard over the ``expert`` mesh axis; GSPMD inserts the token
+all-to-alls around the GShard dispatch einsums (models/moe.py). On
+multi-slice pods add ``dcn`` for cross-slice data parallelism.
+"""
+
+import kubetorch_tpu as kt
+
+
+def train(steps: int = 20, batch_per_host: int = 4, seq_len: int = 4096):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.moe import MoeConfig, moe_init, moe_loss
+    from kubetorch_tpu.parallel.sharding import MOE_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    mesh = kt.distributed.mesh()
+    cfg = MoeConfig.mixtral_8x7b(max_seq_len=seq_len)
+    state = init_train_state(moe_init(jax.random.PRNGKey(0), cfg),
+                             optax.adamw(1e-4))
+    opt = optax.adamw(1e-4)
+    step = make_train_step(lambda p, t, y: moe_loss(p, t, y, cfg),
+                           optimizer=opt, mesh=mesh, rules=MOE_RULES)
+    state = step.shard_state(state)
+
+    batch = batch_per_host * jax.process_count()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq_len),
+                                0, cfg.vocab_size)
+    b = {"tokens": jax.device_put(tokens, step.batch_sharding),
+         "targets": jax.device_put(jnp.roll(tokens, -1, 1), step.batch_sharding)}
+    t0 = time.time()
+    for _ in range(steps):
+        state, metrics = step(state, b)
+    jax.block_until_ready(metrics["loss"])
+    return {"loss": float(metrics["loss"]),
+            "tokens_per_sec": steps * batch * seq_len / (time.time() - t0)}
+
+
+def main():
+    f = kt.fn(train)
+    # two v5e-64 slices: experts inside each slice, data parallel across DCN
+    f.to(kt.Compute(tpu="v5e-64").distribute(
+        "jax", workers=32, mesh={"dcn": 2, "fsdp": 8, "expert": 8}))
+    print(f(steps=20))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""BASELINE config 1: MNIST MLP via one kt.fn call, no cluster required.
+
+    python examples/mnist_mlp.py
+"""
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.models.mlp import mnist_train
+
+
+def main():
+    train = kt.fn(mnist_train)
+    train.to(kt.Compute(cpus=1))
+    out = train(steps=200, batch=128, lr=1e-3)
+    print(f"loss {out['first_loss']:.3f} → {out['last_loss']:.3f} "
+          f"over {out['steps']} steps")
+    train.teardown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""BASELINE config 2: ResNet-50 data-parallel on a v5e-4.
+
+The reference version of this is torch DDP + torchrun env wiring; here data
+parallelism is just a mesh axis — batch sharded over ``data``, params
+replicated, gradient psum inserted by GSPMD.
+"""
+
+import kubetorch_tpu as kt
+
+
+def train(steps: int = 50, per_device_batch: int = 32):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.resnet import ResNet50, resnet_loss
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import batch_sharding
+
+    mesh = build_mesh({"data": jax.device_count()})
+    model = ResNet50(num_classes=1000)
+    batch = per_device_batch * jax.device_count()
+    images = jnp.ones((batch, 224, 224, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=False)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(variables["params"])
+
+    b_sharding = batch_sharding(mesh)
+    images = jax.device_put(images, b_sharding)
+
+    @jax.jit
+    def step(variables, opt_state, images, labels):
+        def loss_fn(params):
+            loss, new_state = resnet_loss(
+                model.apply, {**variables, "params": params}, images, labels)
+            return loss, new_state
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"])
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(variables["params"], updates)
+        return {**variables, "params": params, **new_state}, opt_state, loss
+
+    t0, loss = time.time(), None
+    for _ in range(steps):
+        variables, opt_state, loss = step(variables, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    return {"loss": float(loss), "images_per_sec": steps * batch / dt}
+
+
+def main():
+    f = kt.fn(train)
+    f.to(kt.Compute(tpu="v5e-4").distribute("jax"))
+    print(f(steps=50))
+
+
+if __name__ == "__main__":
+    main()

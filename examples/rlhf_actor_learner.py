@@ -1,0 +1,91 @@
+"""BASELINE config 4: async actor/learner RLHF pools on TPU.
+
+The Monarch/Ray-style pattern on the kt fabric: a **learner** actor owns a
+TPU slice and trains; N **rollout** actors own smaller slices and generate;
+weights flow learner → rollouts through the data store's coordinated
+broadcast window (per-leaf keys, reshard-on-get) — the reference's
+trainer→inference NCCL weight-sync pattern (SURVEY §3.3) without NCCL.
+
+    python examples/rlhf_actor_learner.py     # runs locally on CPU pods
+"""
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.data_store.types import BroadcastWindow
+
+
+class Learner:
+    def __init__(self, dim=64):
+        import jax
+        import jax.numpy as jnp
+
+        self.dim = dim
+        self.params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                              (dim, dim), jnp.float32)}
+        self.step_count = 0
+
+    def train_step(self, batch_reward: float):
+        import jax.numpy as jnp
+
+        # stand-in PPO update: scale by reward signal
+        self.params = {"w": self.params["w"] * (1.0 + 0.01 * batch_reward)}
+        self.step_count += 1
+        return {"step": self.step_count,
+                "w_norm": float(jnp.linalg.norm(self.params["w"]))}
+
+    def publish_weights(self, key: str, world_size: int):
+        kt.put(key, self.params,
+               broadcast=BroadcastWindow(world_size=world_size, timeout=120))
+        return key
+
+
+class Rollout:
+    def __init__(self):
+        self.params = None
+        self.version = -1
+
+    def sync_weights(self, key: str, world_size: int):
+        from kubetorch_tpu.data_store import commands as ds
+
+        self.params = ds.get_broadcast(
+            key, BroadcastWindow(world_size=world_size, timeout=120))
+        self.version += 1
+        return self.version
+
+    def generate(self, n: int = 4):
+        import jax
+        import jax.numpy as jnp
+
+        assert self.params is not None, "sync_weights first"
+        x = jax.random.normal(jax.random.PRNGKey(self.version), (n, self.params["w"].shape[0]))
+        y = x @ self.params["w"]
+        # fake reward: negative mean activation magnitude
+        return float(-jnp.mean(jnp.abs(y)))
+
+
+def main(rounds: int = 3, n_rollouts: int = 2):
+    learner = kt.actors(Learner, name="rlhf-learner")
+    learner.to(kt.Compute(cpus=1).distribute("actor", workers=1))
+    rollouts = kt.actors(Rollout, name="rlhf-rollouts")
+    rollouts.to(kt.Compute(cpus=1).distribute("actor", workers=n_rollouts))
+
+    try:
+        reward = 0.0
+        for r in range(rounds):
+            stats = learner.act(0).train_step(reward)
+            key = f"rlhf/weights-v{r}"
+            # async: learner publishes while rollouts join the window
+            pub = learner.act(0).publish_weights.remote(key, 1 + n_rollouts)
+            versions = rollouts.all().sync_weights(key, 1 + n_rollouts)
+            pub.result(timeout=120)
+            rewards = rollouts.all().generate(8)
+            reward = sum(rewards) / len(rewards)
+            print(f"round {r}: learner step {stats['step']} "
+                  f"w_norm {stats['w_norm']:.2f} "
+                  f"rollout versions {versions} reward {reward:.3f}")
+    finally:
+        learner.teardown()
+        rollouts.teardown()
+
+
+if __name__ == "__main__":
+    main()

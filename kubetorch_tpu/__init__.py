@@ -52,6 +52,8 @@ _LAZY = {
     "Cls": ".resources.cls",
     "app": ".resources.app",
     "App": ".resources.app",
+    "actors": ".resources.actors",
+    "ActorMesh": ".resources.actors",
     "compute": ".resources.decorators",
     "distribute": ".resources.decorators",
     "autoscale": ".resources.decorators",

@@ -170,12 +170,18 @@ def describe(service, namespace):
 @click.option("--all", "all_", is_flag=True, help="tear down every workload")
 @click.option("--prefix", default=None, help="tear down by name prefix")
 @click.option("--namespace", default=None)
-def teardown(service, all_, prefix, namespace):
+@click.option("--all-namespaces", "all_ns", is_flag=True,
+              help="bulk ops span every namespace (default: configured ns)")
+def teardown(service, all_, prefix, namespace, all_ns):
     """Delete workload(s) and their pods."""
     if not (service or all_ or prefix):
         # validate before touching the controller — a bare `kt teardown`
         # must not spawn a local daemon just to print usage
         raise click.UsageError("pass SERVICE, --all, or --prefix")
+    if service and all_ns:
+        raise click.UsageError(
+            "--all-namespaces only applies to bulk ops (--all/--prefix); "
+            "for one service pass --namespace")
     from .client import controller_client
     client = controller_client()
     ns = namespace or kt_config().namespace
@@ -183,7 +189,10 @@ def teardown(service, all_, prefix, namespace):
         client.delete_workload(ns, service)
         click.echo(f"deleted {service}")
         return
-    for w in client.list_workloads(ns):
+    # bulk ops scope to the resolved namespace unless --all-namespaces —
+    # explicit over implicit for a destructive command
+    scope = None if all_ns else ns
+    for w in client.list_workloads(scope):
         if all_ or (prefix and w["name"].startswith(prefix)):
             client.delete_workload(w["namespace"], w["name"])
             click.echo(f"deleted {w['name']}")
@@ -368,6 +377,91 @@ def events(service, namespace):
     from .client import controller_client
     for e in controller_client().events(service):
         click.echo(f"{e['ts']:.0f} {e['service']}: {e['message']}")
+
+
+@cli.command()
+@click.argument("service")
+@click.option("--namespace", default=None)
+@click.option("--command", "-c", default="/bin/bash")
+def ssh(service, namespace, command):
+    """Shell into a service pod (kubectl exec; reference cli.py:1757)."""
+    import shutil
+    import subprocess as sp
+
+    if shutil.which("kubectl") is None:
+        raise click.ClickException(
+            "kubectl not found — ssh requires a Kubernetes cluster "
+            "(local-backend pods are host subprocesses; see `kt describe`)")
+    ns = namespace or kt_config().namespace
+    out = sp.run(["kubectl", "get", "pods", "-n", ns, "-l",
+                  f"kubetorch.com/service={service}", "-o",
+                  "jsonpath={.items[0].metadata.name}"],
+                 capture_output=True, text=True)
+    pod = out.stdout.strip()
+    if not pod:
+        raise click.ClickException(f"no pods found for service {service!r}")
+    # sh -c so multi-word commands work: kt ssh svc -c "python -V"
+    sp.run(["kubectl", "exec", "-it", "-n", ns, pod, "--", "sh", "-c", command])
+
+
+@cli.command("port-forward")
+@click.argument("service", required=False, default="kubetorch-controller")
+@click.option("--namespace", default=None)
+@click.option("--port", type=int, default=8080)
+def port_forward_cmd(service, namespace, port):
+    """Port-forward to a cluster service (reference cli.py:1259)."""
+    from .provisioning.port_forward import ensure_port_forward
+
+    ns = namespace or ("kubetorch" if service == "kubetorch-controller"
+                       else kt_config().namespace)
+    try:
+        handle = ensure_port_forward(service=service, namespace=ns,
+                                     remote_port=port)
+    except RuntimeError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"{service} → {handle.url}  (Ctrl-C to stop)")
+    try:
+        handle.proc.wait()
+    except KeyboardInterrupt:
+        handle.close()
+
+
+@cli.command()
+def dashboard():
+    """Cluster overview: workloads, pods, recent events (reference :812)."""
+    from .client import controller_client
+
+    client = controller_client()
+    workloads = client.list_workloads()
+    click.echo(f"=== workloads ({len(workloads)}) ===")
+    for w in workloads:
+        record = client.get_workload(w["namespace"], w["name"])
+        pods = record.get("connected_pods", [])
+        click.echo(f"{w['namespace']:10} {w['name']:28} pods={len(pods)} "
+                   f"{w.get('service_url') or '-'}")
+    events = client.events()
+    click.echo(f"=== events (last {min(len(events), 10)}) ===")
+    for e in events[-10:]:
+        click.echo(f"{e['ts']:.0f} {e['service']}: {e['message']}")
+
+
+@cli.command()
+@click.option("--cpus", default="2")
+@click.option("--tpu", default=None)
+@click.option("--port", type=int, default=8888)
+def notebook(cpus, tpu, port):
+    """Remote Jupyter on managed compute (reference cli.py:2181) — deployed
+    as a kt App; requires jupyter in the image."""
+    from .resources.app import app as app_factory
+    from .resources.compute import Compute
+    from .resources.image import Image
+
+    image = Image().pip_install(["jupyterlab"])
+    nb = app_factory(
+        f"jupyter lab --ip 0.0.0.0 --port {port} --no-browser --allow-root",
+        name="kt-notebook", port=port)
+    nb.to(Compute(cpus=cpus, tpu=tpu, image=image))
+    click.echo(f"notebook service: {nb.service_url} (token in `kt logs kt-notebook`)")
 
 
 # -- server ------------------------------------------------------------------

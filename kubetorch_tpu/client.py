@@ -167,12 +167,49 @@ def controller_client() -> ControllerClient:
         if api:
             _client = ControllerClient(api)
             return _client
+        # an existing local daemon wins (no kubectl probe stall for local
+        # users); else a kubeconfig'd cluster running our controller →
+        # port-forward (reference globals.py:123-366); else spawn the daemon
         state = _read_running_local()
         if state is None:
+            pf_url = _try_cluster_port_forward()
+            if pf_url is not None:
+                config().api_url = pf_url
+                _client = ControllerClient(pf_url)
+                return _client
             state = _spawn_local_daemon()
         config().api_url = state["url"]
         _client = ControllerClient(state["url"])
         return _client
+
+
+def _try_cluster_port_forward() -> Optional[str]:
+    """Port-forward to an in-cluster controller when one exists.
+
+    Opt-out with KT_LOCAL_MODE=1. Cheap negative path: no kubectl → None.
+    """
+    if config().local_mode:
+        return None
+    import shutil
+
+    if shutil.which("kubectl") is None:
+        return None
+    try:
+        # short timeout: a hung API server (stale kubeconfig, VPN down) must
+        # not stall first use; the local daemon covers the fallback
+        probe = subprocess.run(
+            ["kubectl", "get", "svc", "kubetorch-controller",
+             "-n", config().install_namespace, "-o", "name"],
+            capture_output=True, timeout=3)
+        if probe.returncode != 0:
+            return None
+        from .provisioning.port_forward import ensure_port_forward
+        handle = ensure_port_forward(
+            service="kubetorch-controller",
+            namespace=config().install_namespace, remote_port=8080)
+        return handle.url
+    except Exception:
+        return None
 
 
 def _spawn_local_daemon() -> Dict:
@@ -244,7 +281,15 @@ def shutdown_local_controller() -> None:
                 if any("kubetorch_tpu.controller" in part
                        for part in proc.cmdline()):
                     kill_process_tree(state["pid"])
-                    daemon_gone = not psutil.pid_exists(state["pid"])
+                    try:
+                        # kill_process_tree returns right after the SIGKILL
+                        # escalation; give the kernel a moment to reap.
+                        # Zombie == dead for our purposes.
+                        psutil.wait_procs([proc], timeout=3)
+                        daemon_gone = (not proc.is_running() or
+                                       proc.status() == psutil.STATUS_ZOMBIE)
+                    except psutil.NoSuchProcess:
+                        daemon_gone = True
                 else:
                     daemon_gone = True   # PID reused: our daemon already died
             except psutil.NoSuchProcess:

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 from collections import deque
@@ -338,21 +339,34 @@ async def proxy_service(request: web.Request) -> web.Response:
     headers = {k: v for k, v in request.headers.items()
                if k.lower() not in _hop}
     try:
-        async with aiohttp.ClientSession() as sess:
-            async with sess.request(
-                    request.method, url, data=body or None, headers=headers,
-                    params=request.query,
-                    timeout=aiohttp.ClientTimeout(total=600)) as resp:
-                payload = await resp.read()
-                out_headers = {k: v for k, v in resp.headers.items()
-                               if k.lower() in ("content-type",
-                                                "x-serialization",
-                                                "x-request-id")}
-                return web.Response(body=payload, status=resp.status,
-                                    headers=out_headers)
+        sess = await _proxy_session(request.app)
+        async with sess.request(
+                request.method, url, data=body or None, headers=headers,
+                params=request.query,
+                timeout=aiohttp.ClientTimeout(total=600)) as resp:
+            payload = await resp.read()
+            out_headers = {k: v for k, v in resp.headers.items()
+                           if k.lower() in ("content-type",
+                                            "x-serialization",
+                                            "x-request-id")}
+            return web.Response(body=payload, status=resp.status,
+                                headers=out_headers)
     except aiohttp.ClientError as e:
         return web.json_response({"error": f"proxy to {url} failed: {e}"},
                                  status=502)
+
+
+async def _proxy_session(app: web.Application):
+    """Shared keep-alive session for the proxy hot path (per-request
+    sessions would churn sockets under load)."""
+    import aiohttp
+
+    sess = app.get("proxy_session")
+    if sess is None or sess.closed:
+        sess = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=500))
+        app["proxy_session"] = sess
+    return sess
 
 
 # -- pod websocket -----------------------------------------------------------
@@ -476,6 +490,9 @@ async def _startup(app: web.Application) -> None:
 
 async def _cleanup(app: web.Application) -> None:
     state: ControllerState = app["cstate"]
+    sess = app.get("proxy_session")
+    if sess is not None and not sess.closed:
+        await sess.close()
     if state._ttl_task:
         state._ttl_task.cancel()
     if state.backend is not None:
@@ -495,8 +512,41 @@ def main(argv: Optional[list] = None) -> None:
     if args.backend == "kubernetes":
         from .backends import KubernetesBackend
         state.backend = KubernetesBackend()
+        state.cluster_config["data_store_url"] = os.environ.get(
+            "KT_DATA_STORE_URL",
+            "http://kubetorch-data-store.kubetorch.svc.cluster.local:8873")
     else:
-        state.backend = LocalBackend(controller_url=state.base_url)
+        # zero-config data plane: the local controller owns a store server
+        # so kt.put/get and pod code-sync work out of the box
+        import subprocess
+        import sys as _sys
+
+        from ..utils.procs import free_port, wait_for_port
+
+        store_port = free_port()
+        from ..config import config as _kt_config
+        store_root = os.path.join(_kt_config().config_dir, "store")
+        os.makedirs(store_root, exist_ok=True)
+        store_log = open(os.path.join(_kt_config().config_dir, "store.log"), "ab")
+        store_proc = subprocess.Popen(
+            [_sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+             "--host", "127.0.0.1", "--port", str(store_port),
+             "--root", store_root],
+            stdout=store_log, stderr=store_log)
+        store_url = None
+        if wait_for_port("127.0.0.1", store_port, timeout=20):
+            store_url = f"http://127.0.0.1:{store_port}"
+            state.cluster_config["data_store_url"] = store_url
+        else:
+            # leave a breadcrumb: kt.put later fails with "No data store
+            # configured" and this explains why
+            msg = (f"local data store failed to start on :{store_port}; "
+                   f"see {store_log.name}")
+            state.cluster_config["data_store_error"] = msg
+            state.record_event("controller", msg)
+        state.backend = LocalBackend(controller_url=state.base_url,
+                                     store_url=store_url)
+        state.backend._store_proc = store_proc  # killed with the backend
     web.run_app(create_controller_app(state), host=args.host, port=args.port,
                 print=lambda *_: None)
 

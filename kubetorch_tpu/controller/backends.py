@@ -33,9 +33,11 @@ class PodHandle:
 class LocalBackend:
     """Run 'pods' as subprocesses on loopback alias IPs."""
 
-    def __init__(self, controller_url: str, server_port: int = 32300):
+    def __init__(self, controller_url: str, server_port: int = 32300,
+                 store_url: Optional[str] = None):
         self.controller_url = controller_url
         self.server_port = server_port
+        self.store_url = store_url
         self.services: Dict[str, List[PodHandle]] = {}
         self._ip_block = 0
 
@@ -85,6 +87,8 @@ class LocalBackend:
             "KT_NAMESPACE": namespace,
             "KT_SERVICE_NAME": name,
         })
+        if self.store_url:
+            pod_env.setdefault("KT_DATA_STORE_URL", self.store_url)
 
         handles = []
         for i, ip in enumerate(ips[:replicas]):
@@ -121,6 +125,9 @@ class LocalBackend:
         for key in list(self.services):
             ns, name = key.split("/", 1)
             self.delete(ns, name)
+        store_proc = getattr(self, "_store_proc", None)
+        if store_proc is not None and store_proc.poll() is None:
+            kill_process_tree(store_proc.pid)
 
 
 class KubernetesBackend:

@@ -28,6 +28,18 @@ _INDEX_SUFFIX = ".__kt_index__"
 
 def _store_url(explicit: Optional[str] = None) -> str:
     url = explicit or config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
+    if not url and config().api_url:
+        # discover through an ALREADY-CONFIGURED controller's cluster config
+        # (the local controller runs its own store; k8s clusters publish
+        # theirs). Never auto-spawn a controller here — a misconfigured pod
+        # must get the clear error below, not a fresh empty store.
+        try:
+            from ..client import controller_client
+            url = controller_client().cluster_config().get("data_store_url")
+            if url:
+                config().data_store_url = url
+        except Exception:
+            url = None
     if not url:
         raise DataStoreError(
             "No data store configured (set KT_DATA_STORE_URL or "

@@ -65,7 +65,9 @@ class ActorMesh:
         if compute.distributed is None:
             compute = compute.distribute("spmd", workers=1)
         elif compute.distributed.distribution_type == "actor":
-            # actors ride the SPMD fabric; the supervisor type is the same
+            # actors ride the SPMD fabric; never mutate the caller's Compute
+            # (the fluent convention is clone-on-change)
+            compute = compute.clone()
             compute.distributed.distribution_type = "spmd"
         self._module.to(compute)
         return self

@@ -174,15 +174,26 @@ class ServerState:
             os.environ[KT_LAUNCH_ID] = launch_id
 
     async def _sync_code(self) -> None:
-        """Pull latest code from the data store (reference rsync pull :1140)."""
+        """Pull latest code from the data store (reference rsync pull :1140).
+
+        No code tree in the store + a locally-present project root means the
+        client shares our filesystem (local backend) and never pushed —
+        nothing to sync. A missing tree with a missing root is a real error.
+        """
         store_url = os.environ.get("KT_DATA_STORE_URL")
         service = os.environ.get(KT_SERVICE_NAME)
         root = os.environ.get(KT_PROJECT_ROOT)
         if not (store_url and service and root):
             return
         from ..data_store.sync import pull_tree
-        await asyncio.to_thread(pull_tree, store_url,
-                                f"__code__/{service}", root)
+        from ..exceptions import SyncError
+        try:
+            await asyncio.to_thread(pull_tree, store_url,
+                                    f"__code__/{service}", root)
+        except SyncError as e:
+            if "No tree" in str(e) and os.path.isdir(root):
+                return
+            raise
 
     def terminate(self, reason: str) -> None:
         self.termination_reason = reason

@@ -2,9 +2,11 @@
 
 Semantics mirror the reference engine (``serving/spmd/spmd_supervisor.py``):
 
-- The pod that receives the client call becomes the **coordinator**: it
-  discovers worker IPs, sorts them, and moves itself to rank 0 so MASTER_ADDR
-  / JAX coordinator is always the coordinator itself (:133-141).
+- The pod that receives the client call becomes the **coordinator** of the
+  fan-out. Rank identity (MASTER_ADDR / JAX coordinator) is fixed at setup
+  from the sorted pod set — stable across calls regardless of which pod the
+  client hit, which is what a compiled TPU mesh requires (deviation from the
+  reference's per-call coordinator-first reordering, :133-141).
 - Fan-out is flat below :data:`TREE_THRESHOLD` workers and a tree with
   :data:`TREE_FANOUT` children above it; a node's children coordinate their
   own subtrees recursively (:68-101).
@@ -62,12 +64,13 @@ class SPMDSupervisor(DistributedSupervisor):
     # -- worker selection (reference :220-261) --------------------------------
 
     async def _select_ips(self, workers: Union[None, str, Sequence]) -> List[str]:
-        """Resolve the worker spec to the EXACT set of pods that execute.
+        """Resolve the worker spec to the EXACT set of pods that execute, in
+        the caller's order.
 
         Selection is precise — the coordinator runs user code only when it is
         in the selected set (actor dispatch to a single peer must not also
-        run locally); when present it is moved to the front so it owns rank-0
-        duties (reference :133-141).
+        run locally) — and order-preserving, so multicast results map back to
+        the requested indices.
         """
         all_ips = self.pod_ips() or [my_pod_ip()]
         my_ip = my_pod_ip()
@@ -84,14 +87,16 @@ class SPMDSupervisor(DistributedSupervisor):
         elif isinstance(workers, (list, tuple)):
             if all(isinstance(w, int) for w in workers):
                 ordered = sorted(all_ips)
-                selected = [ordered[w] for w in workers if 0 <= w < len(ordered)]
+                bad = [w for w in workers if not 0 <= w < len(ordered)]
+                if bad:
+                    raise ValueError(
+                        f"Worker indices {bad} out of range for "
+                        f"{len(ordered)} workers")
+                selected = [ordered[w] for w in workers]
             else:
                 selected = [w for w in workers if w in all_ips] or list(workers)
         else:
             raise ValueError(f"Invalid workers spec: {workers!r}")
-        if my_ip in selected:
-            selected.remove(my_ip)
-            selected = [my_ip] + selected
         return selected
 
     # -- the call (reference :103, :366-545) ----------------------------------
@@ -110,52 +115,64 @@ class SPMDSupervisor(DistributedSupervisor):
             self.check_membership()
             ips = await self._select_ips(workers)
 
-        run_local = bool(ips) and ips[0] == my_ip
-        remote_ips = ips[1:] if run_local else list(ips)
-        n = len(ips)
-
-        if n > TREE_THRESHOLD:
-            if run_local:
-                # implicit fanout tree over the selected set; node 0 is us
-                remote_targets = [
-                    (ips[c], [ips[d] for d in subtree_indices(c, n)])
-                    for c in tree_children(0, n)
-                ]
-            else:
-                # we coordinate but don't execute: delegate the tree to the
-                # first selected pod
-                remote_targets = [(remote_ips[0], remote_ips[1:])]
-        else:
-            remote_targets = [(ip, []) for ip in remote_ips]
-
-        tasks: List[asyncio.Task] = []
-        local_task = None
-        if run_local:
-            local_task = asyncio.ensure_future(
-                self.pool.call_all(method, args, kwargs, timeout))
-            tasks.append(local_task)
         pool = RemoteWorkerPool.shared(self.server_port)
         body = {"args": args, "kwargs": kwargs}
         hdrs = headers or {}
-        tasks += [
-            asyncio.ensure_future(pool.call_worker(
+        n = len(ips)
+
+        tree_order: Optional[List[str]] = None
+        if n > TREE_THRESHOLD:
+            # fanout tree: we execute iff selected; results come back in
+            # tree-traversal order and are re-mapped to selection order
+            # below when pod block sizes are uniform
+            run_local = my_ip in ips
+            others = [ip for ip in ips if ip != my_ip]
+            tree = [my_ip, *others] if run_local else others
+            targets = [(tree[c], [tree[d] for d in subtree_indices(c, len(tree))])
+                       for c in tree_children(0, len(tree))] if run_local else \
+                      [(others[0], others[1:])]
+            tree_order = []
+            if run_local:
+                tree_order.append(my_ip)
+            for ip, sub in targets:
+                tree_order.extend([ip, *sub])
+            tasks = []
+            if run_local:
+                tasks.append(asyncio.ensure_future(
+                    self.pool.call_all(method, args, kwargs, timeout)))
+            tasks += [asyncio.ensure_future(pool.call_worker(
                 ip, self.fn_name, method, body, hdrs, timeout,
-                subtree=sub or None))
-            for ip, sub in remote_targets
-        ]
-        all_tasks = tasks
+                subtree=sub or None)) for ip, sub in targets]
+        else:
+            # flat fan-out preserves the caller's selection order exactly —
+            # mesh.actors([1, 0]) must return [actor1, actor0]
+            tasks = [
+                asyncio.ensure_future(
+                    self.pool.call_all(method, args, kwargs, timeout))
+                if ip == my_ip else
+                asyncio.ensure_future(pool.call_worker(
+                    ip, self.fn_name, method, body, hdrs, timeout))
+                for ip in ips
+            ]
+
         try:
-            results = await self._gather_fast_fail(all_tasks, timeout)
+            results = await self._gather_fast_fail(tasks, timeout)
         except BaseException:
-            for t in all_tasks:
+            for t in tasks:
                 t.cancel()
             raise
 
-        # order: local ranks (when selected), then each remote branch's ranks
-        # in selection order (reference :547)
         flat: List[Any] = []
         for branch in results:
             flat.extend(branch if isinstance(branch, list) else [branch])
+        if tree_order is not None and len(tree_order) and \
+                len(flat) % len(tree_order) == 0:
+            # uniform ranks/pod: reorder per-pod blocks from tree-traversal
+            # order back to the caller's selection order
+            k = len(flat) // len(tree_order)
+            blocks = {ip: flat[i * k:(i + 1) * k]
+                      for i, ip in enumerate(tree_order)}
+            flat = [r for ip in ips for r in blocks.get(ip, [])]
         return flat
 
     async def _gather_fast_fail(self, tasks: List[asyncio.Task],

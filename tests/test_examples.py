@@ -1,0 +1,30 @@
+"""Examples smoke: the RLHF actor/learner recipe end-to-end on local pods —
+actors + coordinated broadcast + auto-started store in one flow
+(BASELINE config 4)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                                "examples"))
+
+
+@pytest.mark.slow
+def test_rlhf_actor_learner_example(capsys):
+    from kubetorch_tpu.client import shutdown_local_controller
+    from kubetorch_tpu.config import reset_config
+
+    reset_config()
+    import rlhf_actor_learner
+
+    try:
+        rlhf_actor_learner.main(rounds=2, n_rollouts=2)
+        out = capsys.readouterr().out
+        assert "round 0" in out and "round 1" in out
+        assert "rollout versions [0, 0]" in out
+        assert "rollout versions [1, 1]" in out
+    finally:
+        shutdown_local_controller()
+        reset_config()

@@ -43,7 +43,8 @@ def main() -> None:
     import jax.numpy as jnp
     import optax
 
-    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.models.llama import (LlamaConfig, llama_init,
+                                            llama_loss_chunked)
     from kubetorch_tpu.train import init_train_state, make_train_step
 
     dev = jax.devices()[0]
@@ -64,7 +65,10 @@ def main() -> None:
     params = llama_init(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
     state = init_train_state(params, opt)
-    step_fn = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg), optimizer=opt)
+    # chunked CE: never materializes the (B, S, V) fp32 logits tensor
+    step_fn = make_train_step(
+        lambda p, t, y: llama_loss_chunked(p, t, y, cfg, chunk=256),
+        optimizer=opt)
 
     def run(batch_size):
         nonlocal state

@@ -188,8 +188,12 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lw: Dict[str, jax.Array], freqs: jax.
     return x + ffn
 
 
-def llama_forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """tokens (B, S) int32 → logits (B, S, V) fp32."""
+def llama_hidden(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """The headless forward: tokens (B, S) → final hidden states (B, S, D).
+
+    Single source of truth for embed → scanned layers → final norm; both loss
+    variants ride on it so they can never diverge.
+    """
     x = params["embed"][tokens].astype(cfg.dtype)
     freqs = rope_freqs(cfg, tokens.shape[1])
 
@@ -199,7 +203,12 @@ def llama_forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -
     if cfg.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     x, _ = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+
+def llama_forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) fp32."""
+    x = llama_hidden(params, tokens, cfg)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
 
 
@@ -210,6 +219,47 @@ def llama_loss(params: Dict[str, Any], tokens: jax.Array, targets: jax.Array,
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
+                       targets: jax.Array, cfg: LlamaConfig,
+                       chunk: int = 256) -> jax.Array:
+    """Memory-efficient CE: never materializes the (B, S, V) fp32 logits.
+
+    The hidden states run the normal forward; the LM head + log-softmax are
+    applied per sequence-chunk inside a ``lax.map``, so peak memory is
+    (B, chunk, V) instead of (B, S, V) — at V=128k and S=8k that's the
+    difference between ~4 GB of fp32 logits per example and ~128 MB. The
+    backward recomputes each chunk's logits (standard remat trade: the LM
+    head matmul is cheap next to its HBM cost). Sequences that don't divide
+    the chunk are padded and masked, never degraded to tiny chunks.
+    """
+    x = llama_hidden(params, tokens, cfg)                 # (B, S, D)
+    b, s, d = x.shape
+    chunk = min(chunk, s)
+    pad = (-s) % chunk
+    mask = jnp.ones((b, s), jnp.float32)
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    total = s + pad
+    head = params["lm_head"].astype(cfg.dtype)
+
+    def chunk_loss(args):
+        h, t, m = args                                    # (B, C, D), (B, C)
+        logits = (h @ head).astype(jnp.float32)           # (B, C, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        return jnp.sum(ll * m)
+
+    chunk_loss = jax.checkpoint(chunk_loss)
+    n_chunks = total // chunk
+    h_chunks = x.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    t_chunks = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    m_chunks = mask.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    totals = lax.map(chunk_loss, (h_chunks, t_chunks, m_chunks))
+    return -jnp.sum(totals) / (b * s)
 
 
 def config_from_dict(d: Dict) -> LlamaConfig:

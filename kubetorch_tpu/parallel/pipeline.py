@@ -1,0 +1,149 @@
+"""Pipeline parallelism: GPipe over a ``pipe`` mesh axis via shard_map.
+
+TPU-first formulation: the model's layer-stacked params (every leaf is
+``(L, ...)`` for ``lax.scan``) shard their **layer dimension** over the
+``pipe`` axis — stage p holds layers ``[p·L/P, (p+1)·L/P)`` with no
+re-packing. Activations flow stage→stage with ``lax.ppermute`` (one ICI hop
+per microbatch per boundary); the GPipe schedule is a ``lax.scan`` over
+``M + P - 1`` timesteps, so the whole pipeline is one compiled program —
+no host round-trips between microbatches.
+
+Differentiable end-to-end (scan + ppermute transpose cleanly), so the same
+function trains; remat inside the stage body keeps bubble memory bounded.
+
+Neither the reference nor torch launchers can express this: it exists here
+because parallelism is a launcher-level concern on TPU (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
+          n_microbatches: int, in_specs, params_specs, out_specs=None):
+    """Build a pipelined ``f(stage_params, x) -> y`` over ``mesh[axis]``.
+
+    ``stage_fn(stage_params, x) -> y`` consumes one stage's params (the
+    layer-dim shard) and one microbatch activation, both local. ``x`` is
+    globally (M*mb, ...) — reshaped to microbatches internally. The result is
+    replicated across the pipe axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    smap = _shard_map()
+
+    def pipelined(stage_params, x):
+        M = n_microbatches
+
+        def per_device(local_params, x_local):
+            p = lax.axis_index(axis)
+            n_stages = lax.axis_size(axis)
+            xs = x_local.reshape(M, x_local.shape[0] // M, *x_local.shape[1:])
+
+            def timestep(carry, t):
+                recv, outputs = carry
+                mb = t - p                       # my microbatch at this tick
+                # stage 0 pulls fresh input; later stages consume the wire
+                fresh = lax.dynamic_index_in_dim(
+                    xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+                inp = jnp.where(p == 0, fresh, recv)
+                out = stage_fn(local_params, inp)
+                # rotate outputs one stage forward (ring; the wrap-around
+                # value into stage 0 is ignored by the `where` above)
+                send = lax.ppermute(
+                    out, axis,
+                    [(i, (i + 1) % n_stages) for i in range(n_stages)])
+                # last stage records finished microbatch `mb` when valid
+                valid = (p == n_stages - 1) & (mb >= 0) & (mb < M)
+                idx = jnp.clip(mb, 0, M - 1)
+                current = lax.dynamic_index_in_dim(outputs, idx, 0,
+                                                   keepdims=False)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(valid, out, current), idx, 0)
+                return (send, outputs), None
+
+            init = (jnp.zeros_like(xs[0]),
+                    jnp.zeros((M, *xs.shape[1:]), xs.dtype))
+            (_, outputs), _ = lax.scan(timestep, init,
+                                       jnp.arange(M + n_stages - 1))
+            # only the last stage holds real outputs; replicate via psum
+            outputs = lax.psum(
+                jnp.where(p == n_stages - 1, outputs,
+                          jnp.zeros_like(outputs)), axis)
+            return outputs.reshape(x_local.shape)
+
+        return smap(per_device, mesh=mesh,
+                    in_specs=(params_specs, in_specs),
+                    # NOT `or`: an empty PartitionSpec (replicated) is falsy
+                    out_specs=out_specs if out_specs is not None else in_specs,
+                    check_vma=False)(stage_params, x)
+
+    return pipelined
+
+
+# ---------------------------------------------------------------------------
+# Llama integration
+# ---------------------------------------------------------------------------
+
+
+def llama_forward_pipelined(params, tokens, cfg, mesh, *,
+                            n_microbatches: Optional[int] = None):
+    """Llama forward with layers pipelined over the mesh's ``pipe`` axis.
+
+    Embedding / final norm / LM head stay data-parallel (they are a tiny
+    fraction of FLOPs); only the layer stack is staged. Layer params must
+    already be sharded ``PartitionSpec("pipe", ...)`` on dim 0 — i.e. each
+    ``params["layers"]`` leaf placed with ``NamedSharding(mesh, P("pipe"))``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.llama import _layer, rmsnorm, rope_freqs
+
+    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pipe", 1)
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pipe={n_stages}")
+    M = n_microbatches or n_stages
+    if tokens.shape[0] % M:
+        raise ValueError(f"batch={tokens.shape[0]} not divisible by "
+                         f"microbatches={M}")
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs = rope_freqs(cfg, tokens.shape[1])
+
+    def stage_fn(local_layers, h):
+        def body(carry, lw):
+            return _layer(cfg, carry, lw, freqs), None
+        body = jax.checkpoint(body)
+        out, _ = lax.scan(body, h, local_layers)
+        return out
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda _: P("pipe"), params["layers"])
+    run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
+                in_specs=P(), params_specs=layer_specs, out_specs=P())
+    x = run(params["layers"], x)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def llama_loss_pipelined(params, tokens, targets, cfg, mesh, **kw):
+    logits = llama_forward_pipelined(params, tokens, cfg, mesh, **kw)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+

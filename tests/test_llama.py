@@ -131,3 +131,28 @@ def test_make_train_step_mesh_requires_rules(cpu_mesh_devices):
 
     with pytest.raises(ValueError, match="rules"):
         make_train_step(lambda p, t, y: 0.0, mesh=build_mesh({"fsdp": 8}))
+
+
+def test_chunked_loss_matches_full(params):
+    from kubetorch_tpu.models.llama import llama_loss_chunked
+
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, CFG.vocab_size)
+    targets = jnp.roll(tokens, -1, 1)
+    full = llama_loss(params, tokens, targets, CFG)
+    chunked = llama_loss_chunked(params, tokens, targets, CFG, chunk=8)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-5)
+    # odd sequence length pads + masks instead of degrading to chunk=1
+    odd_t = tokens[:, :27]
+    np.testing.assert_allclose(
+        float(llama_loss_chunked(params, odd_t, jnp.roll(odd_t, -1, 1), CFG, chunk=8)),
+        float(llama_loss(params, odd_t, jnp.roll(odd_t, -1, 1), CFG)), rtol=1e-5)
+    # gradients agree too
+    g_full = jax.grad(llama_loss)(params, tokens, targets, CFG)
+    g_chunk = jax.grad(lambda p, t, y: llama_loss_chunked(
+        p, t, y, CFG, chunk=8))(params, tokens, targets)
+    np.testing.assert_allclose(
+        np.asarray(g_chunk["lm_head"]), np.asarray(g_full["lm_head"]),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_chunk["layers"]["wq"]), np.asarray(g_full["layers"]["wq"]),
+        rtol=1e-4, atol=1e-5)

@@ -1,0 +1,142 @@
+"""Autoregressive generation with a static KV cache.
+
+TPU-first decode loop: everything is ``lax.scan`` over static shapes — the
+cache is a fixed (L, B, S_max, NKV, Hd) buffer, positions are masked, and one
+jit covers prefill + N decode steps (no per-token dispatch, no dynamic
+shapes). The cache layout matches the mesh rules: NKV shards over ``tensor``,
+batch over data axes, so multi-chip serving is the same NamedSharding story
+as training.
+
+This is what the RLHF rollout actors (BASELINE config 4) and autoscaled
+inference services run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
+
+NEG_INF = -1e30
+
+
+class KVCache(NamedTuple):
+    k: jax.Array   # (L, B, S_max, NKV, Hd)
+    v: jax.Array
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    dtype = dtype or cfg.dtype
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _cached_attention(q, cache_k, cache_v, q_pos, scale):
+    """q: (B, T, N, Hd) at absolute positions q_pos (T,); cache: (B, S, NKV, Hd).
+    Causal mask over absolute positions; unwritten cache slots masked out."""
+    b, t, nh, hd = q.shape
+    s, nkv = cache_k.shape[1], cache_k.shape[2]
+    group = nh // nkv
+    qg = q.reshape(b, t, nkv, group, hd)
+    logits = jnp.einsum("btkgh,bskh->bkgts", qg, cache_k).astype(jnp.float32) * scale
+    kv_pos = lax.broadcasted_iota(jnp.int32, (t, s), 1)
+    mask = kv_pos <= q_pos[:, None]                     # (T, S)
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cache_v.dtype)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, cache_v)
+    return out.reshape(b, t, nh, hd)
+
+
+def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
+    """One transformer layer over T new tokens, updating this layer's cache."""
+    b, t, d = x.shape
+    h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
+    q = (h @ lw["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ lw["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lw["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    freqs = freqs_full[q_pos]                            # (T, Hd/2)
+    q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+
+    layer_cache_k = lax.dynamic_update_slice_in_dim(
+        layer_cache_k, k.astype(layer_cache_k.dtype), q_pos[0], axis=1)
+    layer_cache_v = lax.dynamic_update_slice_in_dim(
+        layer_cache_v, v.astype(layer_cache_v.dtype), q_pos[0], axis=1)
+
+    attn = _cached_attention(q, layer_cache_k, layer_cache_v, q_pos,
+                             cfg.head_dim ** -0.5)
+    x = x + attn.reshape(b, t, -1) @ lw["wo"]
+    h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
+    return x + ffn, layer_cache_k, layer_cache_v
+
+
+def forward_with_cache(params, tokens, cache: KVCache, start_pos,
+                       cfg: LlamaConfig):
+    """Run T new tokens at absolute position ``start_pos``; returns logits
+    for the LAST position and the updated cache. Used for both prefill
+    (T = prompt length) and decode (T = 1)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs_full = rope_freqs(cfg, cache.k.shape[2])
+    q_pos = start_pos + jnp.arange(t)
+
+    def body(carry, layer_inputs):
+        h = carry
+        lw, ck, cv = layer_inputs
+        h, ck, cv = _layer_step(cfg, h, lw, ck, cv, q_pos, freqs_full)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                  "top_k"))
+def generate(params, prompt: jax.Array, cfg: LlamaConfig,
+             max_new_tokens: int = 64, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation.
+
+    prompt: (B, T_prompt) int32 → (B, T_prompt + max_new_tokens). One compile
+    per (shape, config); prefill and all decode steps inside.
+    """
+    b, t_prompt = prompt.shape
+    max_len = t_prompt + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k is not None:
+            kth = lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, tok, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, t_prompt + i, cfg)
+        nxt = sample(logits, sub)
+        return (cache, nxt, key), nxt
+
+    first = sample(logits, rng)
+    (_, _, _), toks = lax.scan(step, (cache, first, rng),
+                               jnp.arange(max_new_tokens - 1))
+    out = jnp.concatenate([prompt, first[:, None],
+                           toks.transpose(1, 0)], axis=1)
+    return out

@@ -42,7 +42,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    attn_impl: str = "auto"  # auto | xla | flash | ring
+    attn_impl: str = "auto"  # auto | xla | flash | ring | ulysses
 
     @property
     def head_dim(self) -> int:
@@ -168,6 +168,11 @@ def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
             return ring_attention_sharded(q, k, v, mesh, causal=True, scale=scale)
         # already inside a shard_map with a bound "context" axis
         return ring_attention(q, k, v, axis_name="context", causal=True, scale=scale)
+    if impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention, ulysses_attention_sharded
+        if mesh is not None:
+            return ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=scale)
+        return ulysses_attention(q, k, v, axis_name="context", causal=True, scale=scale)
     if impl == "flash":
         from ..ops.attention import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale)

@@ -1,0 +1,90 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The second context-parallel strategy (SURVEY §5.7) besides ring attention:
+instead of rotating K/V chunks around a ring, one ``all_to_all`` re-shards
+the activations from sequence-sharded to **head-sharded**, every device runs
+full-sequence attention for its head subset, and a second ``all_to_all``
+restores sequence sharding. Two collectives per attention — better than the
+ring when heads ≥ devices and sequence chunks are small enough that ring
+latency dominates; worse at very long sequences (full-S attention memory per
+device). Selectable per-config: ``attn_impl="ulysses"``.
+
+Shapes inside shard_map over axis C (= ulysses degree, mesh axis "context"):
+  local q: (B, S/C, N, Hd) ── all_to_all ──> (B, S, N/C, Hd)
+  full-seq attention on N/C heads (flash kernel when on TPU)
+  out: (B, S, N/C, Hd) ── all_to_all ──> (B, S/C, N, Hd)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _heads_to_seq(x: jax.Array, axis: str) -> jax.Array:
+    """(B, S, N/C, Hd) → (B, S/C, N, Hd)."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _seq_to_heads(x: jax.Array, axis: str) -> jax.Array:
+    """(B, S/C, N, Hd) → (B, S, N/C, Hd)."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str = "context", causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Per-shard Ulysses attention. Local shapes: (B, S/C, N, Hd); requires
+    C | N and C | NKV. Must run inside shard_map with ``axis_name`` bound."""
+    n, nkv = q.shape[2], k.shape[2]
+    c = lax.axis_size(axis_name)
+    if n % c or nkv % c:
+        raise ValueError(
+            f"ulysses degree {c} must divide n_heads={n} and n_kv_heads={nkv}")
+
+    qh = _seq_to_heads(q, axis_name)      # (B, S, N/C, Hd)
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+
+    from ..models.llama import _xla_attention
+
+    scale = scale or q.shape[-1] ** -0.5
+    if jax.default_backend() == "tpu":
+        try:
+            from ..ops.attention import flash_attention
+            out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        except Exception:
+            out = _xla_attention(qh, kh, vh, scale)
+    else:
+        out = _xla_attention(qh, kh, vh, scale)
+
+    return _heads_to_seq(out, axis_name)  # (B, S/C, N, Hd)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
+                              scale: Optional[float] = None,
+                              batch_axes=("dcn", "data", "fsdp"),
+                              context_axis: str = "context"):
+    """GSPMD wrapper mirroring ``ring_attention_sharded``: q/k/v are global
+    (B, S, N, Hd) arrays sequence-sharded over the context axis."""
+    from jax.sharding import PartitionSpec as P
+
+    live = {n_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape) if s_ > 1}
+    if context_axis not in live:
+        from ..models.llama import _xla_attention
+        return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5)
+    ba = tuple(a for a in batch_axes if a in live)
+    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    spec = P(ba, context_axis, None, None)
+
+    fn = functools.partial(ulysses_attention, axis_name=context_axis,
+                           causal=causal, scale=scale)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

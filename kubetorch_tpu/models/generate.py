@@ -134,7 +134,9 @@ def generate(params, prompt: jax.Array, cfg: LlamaConfig,
         nxt = sample(logits, sub)
         return (cache, nxt, key), nxt
 
-    first = sample(logits, rng)
+    # never reuse a consumed key: the first sample gets its own split
+    rng, first_key = jax.random.split(rng)
+    first = sample(logits, first_key)
     (_, _, _), toks = lax.scan(step, (cache, first, rng),
                                jnp.arange(max_new_tokens - 1))
     out = jnp.concatenate([prompt, first[:, None],

@@ -129,15 +129,16 @@ def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def _xla_attention(q, k, v, scale: float) -> jax.Array:
-    """Reference attention: causal, fp32 softmax. q:(B,S,N,Hd) k,v:(B,S,NKV,Hd)."""
+def _xla_attention(q, k, v, scale: float, causal: bool = True) -> jax.Array:
+    """Reference attention, fp32 softmax. q:(B,S,N,Hd) k,v:(B,S,NKV,Hd)."""
     b, s, nh, hd = q.shape
     nkv = k.shape[2]
     group = nh // nkv
     q = q.reshape(b, s, nkv, group, hd)
     logits = jnp.einsum("bskgh,btkh->bkgst", q, k).astype(jnp.float32) * scale
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(causal[None, None, None], logits, -jnp.inf)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
     return out.reshape(b, s, nh, hd)

@@ -58,9 +58,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             from ..ops.attention import flash_attention
             out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
         except Exception:
-            out = _xla_attention(qh, kh, vh, scale)
+            out = _xla_attention(qh, kh, vh, scale, causal=causal)
     else:
-        out = _xla_attention(qh, kh, vh, scale)
+        out = _xla_attention(qh, kh, vh, scale, causal=causal)
 
     return _heads_to_seq(out, axis_name)  # (B, S/C, N, Hd)
 
@@ -68,18 +68,28 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
                               scale: Optional[float] = None,
                               batch_axes=("dcn", "data", "fsdp"),
-                              context_axis: str = "context"):
+                              context_axis: str = "context",
+                              head_axis: str = "tensor"):
     """GSPMD wrapper mirroring ``ring_attention_sharded``: q/k/v are global
-    (B, S, N, Hd) arrays sequence-sharded over the context axis."""
+    (B, S, N, Hd) arrays sequence-sharded over the context axis; head
+    sharding over the tensor axis is preserved (no silent all-gather)."""
     from jax.sharding import PartitionSpec as P
 
     live = {n_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape) if s_ > 1}
     if context_axis not in live:
-        from ..models.llama import _xla_attention
-        return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5)
+        # no context sharding: same fallback ladder as the ring wrapper —
+        # flash first, XLA reference only if the kernel is unavailable
+        try:
+            from ..ops.attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            from ..models.llama import _xla_attention
+            return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5,
+                                  causal=causal)
     ba = tuple(a for a in batch_axes if a in live)
     ba = ba if len(ba) > 1 else (ba[0] if ba else None)
-    spec = P(ba, context_axis, None, None)
+    ha = head_axis if head_axis in live else None
+    spec = P(ba, context_axis, ha, None)
 
     fn = functools.partial(ulysses_attention, axis_name=context_axis,
                            causal=causal, scale=scale)

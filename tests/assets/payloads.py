@@ -51,3 +51,17 @@ class Counter:
 
     def _private(self):  # must NOT be exposed remotely
         return "hidden"
+
+
+def torch_allreduce():
+    """Proves the PyTorchEnv contract: torch.distributed gloo init from the
+    injected MASTER_ADDR/RANK/WORLD_SIZE env, one allreduce."""
+    import torch
+    import torch.distributed as dist
+
+    if not dist.is_initialized():
+        dist.init_process_group("gloo")
+    t = torch.tensor([float(dist.get_rank() + 1)])
+    dist.all_reduce(t)
+    return {"rank": dist.get_rank(), "world": dist.get_world_size(),
+            "sum": float(t.item())}

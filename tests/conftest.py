@@ -21,7 +21,79 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import hashlib  # noqa: E402
+import uuid  # noqa: E402
+
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Test levels (reference tests/conftest.py:27-135): --level keeps only tests
+# whose @pytest.mark.level matches. unit < minimal < release < tpu.
+# Default: everything except tpu (which needs the real chip).
+# ---------------------------------------------------------------------------
+
+LEVELS = ("unit", "minimal", "release", "tpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption("--level", default=None, choices=LEVELS,
+                     help="run only tests marked with this level")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers",
+                            "level(name): test tier (unit/minimal/release/tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    want = config.getoption("--level")
+    for item in items:
+        mark = item.get_closest_marker("level")
+        level = mark.args[0] if mark else "unit"
+        if want is not None:
+            if level != want:
+                item.add_marker(pytest.mark.skip(
+                    reason=f"level {level} != requested {want}"))
+        elif level == "tpu":
+            item.add_marker(pytest.mark.skip(
+                reason="tpu-level tests need --level tpu and a real chip"))
+
+
+# Session-hash service-name prefix (reference conftest.py:138-161): every
+# service deployed under this username is torn down at session end, so a
+# crashed run never leaks pods into the next.
+SESSION_HASH = "t-" + hashlib.sha1(uuid.uuid4().bytes).hexdigest()[:5]
+
+
+@pytest.fixture(scope="session", autouse=True)
+def session_isolation():
+    # force-set (saving any prior value): deploys MUST land under the sweep
+    # prefix or a crashed run leaks pods
+    prior = os.environ.get("KT_USERNAME")
+    os.environ["KT_USERNAME"] = SESSION_HASH
+    from kubetorch_tpu.client import (ControllerClient, _read_running_local,
+                                      shutdown_local_controller)
+
+    preexisting_daemon = _read_running_local() is not None
+    yield
+    try:
+        state = _read_running_local()
+        if state is not None:
+            client = ControllerClient(state["url"])
+            for w in client.list_workloads():
+                if w["name"].startswith(SESSION_HASH):
+                    client.delete_workload(w["namespace"], w["name"])
+            # only stop a daemon the session itself caused to exist — a
+            # developer's persistent `kt controller start` (and their
+            # workloads) must survive a pytest run
+            if not preexisting_daemon:
+                shutdown_local_controller()
+    except Exception:
+        pass
+    if prior is None:
+        os.environ.pop("KT_USERNAME", None)
+    else:
+        os.environ["KT_USERNAME"] = prior
 
 
 @pytest.fixture(scope="session")

@@ -8,6 +8,8 @@ from pathlib import Path
 
 import pytest
 
+pytestmark = pytest.mark.level("minimal")
+
 from kubetorch_tpu.data_store.sync import build_manifest, push_tree, pull_tree
 from kubetorch_tpu.exceptions import SyncError
 from kubetorch_tpu.utils.procs import free_port, kill_process_tree, wait_for_port

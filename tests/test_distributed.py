@@ -12,6 +12,8 @@ import sys
 import time
 
 import pytest
+
+pytestmark = pytest.mark.level("minimal")
 import requests
 
 from kubetorch_tpu.serving.spmd_supervisor import subtree_indices, tree_children

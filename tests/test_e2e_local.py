@@ -9,6 +9,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.level("minimal")
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "assets"))
 
 import kubetorch_tpu as kt

@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.level("release")
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)),
                                 "examples"))
 

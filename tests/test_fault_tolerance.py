@@ -9,6 +9,8 @@ import sys
 import time
 
 import pytest
+
+pytestmark = pytest.mark.level("minimal")
 import requests
 
 from kubetorch_tpu.utils.procs import free_port, kill_process_tree, wait_for_port

@@ -40,14 +40,23 @@ def peak_flops(device) -> float:
 
 def main() -> None:
     import jax
+
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError as e:
+        # accelerator backend unavailable (e.g. TPU relay down): report an
+        # honest CPU-labelled number rather than crashing with no JSON line
+        print(f"accelerator backend unavailable ({e}); falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+
     import jax.numpy as jnp
     import optax
 
     from kubetorch_tpu.models.llama import (LlamaConfig, llama_init,
                                             llama_loss_chunked)
     from kubetorch_tpu.train import init_train_state, make_train_step
-
-    dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:

@@ -1,0 +1,95 @@
+// Sanitizer harness for kt_native (SURVEY §5.2: the reference had no native
+// code to sanitize; ours does, so it gets ASAN/TSAN jobs).
+//
+//   make -C kubetorch_tpu/native sanitize   # builds+runs asan & tsan
+//
+// Exercises: xxh64 spec vectors, file hashing, shm create/attach/release
+// lifecycle, and concurrent refcounting from multiple threads (the TSAN
+// target for the atomic header ops).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+uint64_t kt_xxh64(const uint8_t*, uint64_t, uint64_t);
+uint64_t kt_xxh64_file(const char*, uint64_t, int*);
+void* kt_shm_create(const char*, uint64_t, int*);
+void* kt_shm_attach(const char*, int, uint64_t*, int*);
+int64_t kt_shm_release(const char*, void*);
+int64_t kt_shm_refcount(void*);
+}
+
+int main() {
+  // xxh64 spec vectors
+  assert(kt_xxh64(nullptr, 0, 0) == 0xEF46DB3751D8E999ULL);
+  assert(kt_xxh64(reinterpret_cast<const uint8_t*>("a"), 1, 0) ==
+         0xD24EC4F1A98C6E5BULL);
+  assert(kt_xxh64(reinterpret_cast<const uint8_t*>("abc"), 3, 0) ==
+         0x44BC2CF5AD770999ULL);
+
+  // file hashing (odd length: tail paths)
+  {
+    char path[] = "/tmp/kt_native_test_XXXXXX";
+    int fd = mkstemp(path);
+    assert(fd >= 0);
+    std::string data;
+    for (int i = 0; i < 513; ++i) data.push_back(char(i % 251));
+    assert(write(fd, data.data(), data.size()) == (ssize_t)data.size());
+    close(fd);
+    int err = -1;
+    uint64_t h = kt_xxh64_file(path, 0, &err);
+    assert(err == 0);
+    assert(h == kt_xxh64(reinterpret_cast<const uint8_t*>(data.data()),
+                         data.size(), 0));
+    unlink(path);
+  }
+
+  // shm lifecycle
+  {
+    const char* name = "/kt-native-sanity";
+    int err = -1;
+    void* p = kt_shm_create(name, 4096, &err);
+    assert(p != nullptr && err == 0);
+    std::memset(p, 0xAB, 4096);
+    assert(kt_shm_refcount(p) == 1);
+
+    uint64_t size = 0;
+    void* p2 = kt_shm_attach(name, 0, &size, &err);
+    assert(p2 != nullptr && size == 4096);
+    assert(static_cast<uint8_t*>(p2)[17] == 0xAB);
+    assert(kt_shm_refcount(p) == 2);
+
+    // concurrent attach/release churn: TSAN watches the atomic refcount
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 8; ++t) {
+      threads.emplace_back([&] {
+        for (int i = 0; i < 200; ++i) {
+          int e = -1;
+          uint64_t sz = 0;
+          void* q = kt_shm_attach(name, 0, &sz, &e);
+          assert(q != nullptr);
+          volatile uint8_t sink = static_cast<uint8_t*>(q)[0];
+          (void)sink;
+          kt_shm_release(name, q);
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+
+    assert(kt_shm_refcount(p) == 2);
+    assert(kt_shm_release(name, p2) == 1);
+    assert(kt_shm_release(name, p) == 0);
+    // segment unlinked: re-attach must fail
+    void* p3 = kt_shm_attach(name, 0, &size, &err);
+    assert(p3 == nullptr);
+  }
+
+  std::puts("kt_native sanitizer harness OK");
+  return 0;
+}

@@ -75,20 +75,32 @@ def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
     sharding over the tensor axis is preserved (no silent all-gather)."""
     from jax.sharding import PartitionSpec as P
 
-    live = {n_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape) if s_ > 1}
+    live = {n_: s_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape)
+            if s_ > 1}
     if context_axis not in live:
         # no context sharding: same fallback ladder as the ring wrapper —
-        # flash first, XLA reference only if the kernel is unavailable
-        try:
-            from ..ops.attention import flash_attention
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            from ..models.llama import _xla_attention
-            return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5,
-                                  causal=causal)
+        # flash only on TPU (off-TPU the kernel would silently run in the
+        # slow Pallas interpreter), XLA reference otherwise
+        if jax.default_backend() == "tpu":
+            try:
+                from ..ops.attention import flash_attention
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+            except Exception:
+                pass
+        from ..models.llama import _xla_attention
+        return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5,
+                              causal=causal)
     ba = tuple(a for a in batch_axes if a in live)
     ba = ba if len(ba) > 1 else (ba[0] if ba else None)
-    ha = head_axis if head_axis in live else None
+    # preserve head sharding over tensor only when the ulysses degree still
+    # divides the LOCAL head counts; otherwise replicate heads (the pre-TP
+    # behavior) instead of crashing GQA configs
+    c = live[context_axis]
+    t = live.get(head_axis, 1)
+    ha = head_axis if (head_axis in live and
+                       (q.shape[2] // t) % c == 0 and
+                       (k.shape[2] // t) % c == 0 and
+                       q.shape[2] % t == 0 and k.shape[2] % t == 0) else None
     spec = P(ba, context_axis, ha, None)
 
     fn = functools.partial(ulysses_attention, axis_name=context_axis,

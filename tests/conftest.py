@@ -73,7 +73,11 @@ def session_isolation():
     os.environ["KT_USERNAME"] = SESSION_HASH
     from kubetorch_tpu.client import (ControllerClient, _read_running_local,
                                       shutdown_local_controller)
+    from kubetorch_tpu.config import reset_config
 
+    # the config singleton may already be materialized with the old
+    # username; rebuild it so deploys land under the sweep prefix
+    reset_config()
     preexisting_daemon = _read_running_local() is not None
     yield
     try:

@@ -22,6 +22,10 @@ import payloads  # tests/assets
 
 @pytest.fixture(scope="module", autouse=True)
 def local_stack():
+    from kubetorch_tpu.client import _read_running_local
+
+    prior_user = os.environ.get("KT_USERNAME")
+    preexisting_daemon = _read_running_local() is not None
     reset_config()
     os.environ["KT_USERNAME"] = "t-e2e"
     reset_config()
@@ -33,8 +37,16 @@ def local_stack():
                 controller_client().delete_workload(w["namespace"], w["name"])
     except Exception:
         pass
-    shutdown_local_controller()
-    os.environ.pop("KT_USERNAME", None)
+    # never stop a daemon this module didn't cause to exist (a developer's
+    # persistent controller must survive a pytest run)
+    if not preexisting_daemon:
+        shutdown_local_controller()
+    # restore the session-level username (the session sweep prefix), not
+    # the raw shell value — later modules must keep deploying under it
+    if prior_user is None:
+        os.environ.pop("KT_USERNAME", None)
+    else:
+        os.environ["KT_USERNAME"] = prior_user
     reset_config()
 
 

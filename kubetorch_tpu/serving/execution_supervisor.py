@@ -10,6 +10,7 @@ into in-flight calls.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import threading
 import time
@@ -37,6 +38,8 @@ class ExecutionSupervisor:
         self.service_name = service_name
         self.namespace = namespace
         self.pool: Optional[ProcessPool] = None
+        self._served_calls = 0
+        self._restart_lock: Optional[asyncio.Lock] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -75,8 +78,38 @@ class ExecutionSupervisor:
 
     async def call(self, method: Optional[str], args: list, kwargs: dict,
                    timeout: Optional[float] = None, **_ignored) -> Any:
-        assert self.pool is not None, "supervisor not set up"
-        return await self.pool.call(0, method, args, kwargs, timeout)
+        async with self.restart_guard():
+            assert self.pool is not None, "supervisor not set up"
+            return await self.pool.call(0, method, args, kwargs, timeout)
+
+    def restart_guard(self):
+        """Context manager for ``.distribute(restart_procs=True)``: fresh
+        rank subprocesses for every call (reference spmd_supervisor.py:265)
+        — the hammer for user code that can't re-init in-process (singleton
+        frameworks, leaked device state).
+
+        Calls are SERIALIZED in this mode (fresh-proc-per-call implies it):
+        the lock prevents one request's cleanup() from killing the pool under
+        another's in-flight call. Restart-before-call runs before any pool
+        assertion, so a transient setup() failure is retried on the next call
+        instead of bricking the supervisor. NOTE: ranks (and the TPU chips
+        they hold) stay alive between calls — pair with ``inactivity_ttl`` to
+        release hosts when idle.
+        """
+        if not (self.config and self.config.restart_procs):
+            return contextlib.nullcontext()
+        return self._serialized_restart()
+
+    @contextlib.asynccontextmanager
+    async def _serialized_restart(self):
+        if self._restart_lock is None:
+            self._restart_lock = asyncio.Lock()
+        async with self._restart_lock:
+            if self._served_calls > 0 or self.pool is None:
+                await asyncio.to_thread(self.cleanup)
+                await asyncio.to_thread(self.setup)
+            self._served_calls += 1
+            yield
 
 
 class DistributedSupervisor(ExecutionSupervisor):

@@ -106,6 +106,12 @@ class SPMDSupervisor(DistributedSupervisor):
                    workers: Union[None, str, Sequence] = None,
                    subtree: Optional[List[str]] = None,
                    headers: Optional[Dict[str, str]] = None) -> List[Any]:
+        async with self.restart_guard():    # each pod restarts its own ranks
+            return await self._call_inner(method, args, kwargs, timeout,
+                                          workers, subtree, headers)
+
+    async def _call_inner(self, method, args, kwargs, timeout, workers,
+                          subtree, headers) -> List[Any]:
         assert self.pool is not None, "supervisor not set up"
         my_ip = my_pod_ip()
         if subtree is not None:

@@ -189,3 +189,21 @@ def test_metrics_endpoint():
         text = await r.text()
         assert "kubetorch_last_activity_timestamp" in text
     run_server_test(body)
+
+
+def test_restart_procs_fresh_worker_per_call():
+    """.distribute(restart_procs=True): each call lands in a fresh rank
+    subprocess (reference spmd_supervisor.py:265)."""
+    async def body(client, state):
+        set_fn_metadata("whoami")
+        os.environ["KT_DISTRIBUTED_CONFIG"] = json.dumps(
+            {"distribution_type": "local", "workers": 1,
+             "procs_per_worker": 1, "restart_procs": True})
+        r1 = await client.post("/whoami", json={"args": [], "kwargs": {}})
+        assert r1.status == 200, await r1.text()
+        pid1 = json.loads(await r1.read())["pid"]
+        r2 = await client.post("/whoami", json={"args": [], "kwargs": {}})
+        pid2 = json.loads(await r2.read())["pid"]
+        assert pid1 != pid2, "restart_procs must respawn the worker"
+        os.environ.pop("KT_DISTRIBUTED_CONFIG")
+    run_server_test(body)

@@ -1,0 +1,70 @@
+"""Image-setup cache: dockerfile-diff replay inside a live process
+(reference serving/http_server.py:510-831 — the mechanism behind the
+no-rebuild iteration loop)."""
+
+import asyncio
+import os
+
+import pytest
+
+from kubetorch_tpu.serving import image_setup
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache(tmp_path):
+    image_setup._CACHED_DOCKERFILE = []
+    marker_dir = tmp_path
+    yield marker_dir
+    image_setup._CACHED_DOCKERFILE = []
+
+
+def run(dockerfile):
+    return asyncio.run(image_setup.run_image_setup(dockerfile))
+
+
+def test_full_replay_then_noop(fresh_cache):
+    marker = fresh_cache / "a.txt"
+    df = f"FROM python:3.12\nRUN touch {marker}\nENV KT_TEST_IMG=one"
+    stats = run(df)
+    assert stats["replayed"] == 2
+    assert marker.exists()
+    assert os.environ["KT_TEST_IMG"] == "one"
+
+    # identical dockerfile → nothing replayed
+    marker.unlink()
+    stats = run(df)
+    assert stats["replayed"] == 0
+    assert not marker.exists()   # RUN did not re-execute
+    os.environ.pop("KT_TEST_IMG")
+
+
+def test_suffix_only_replay(fresh_cache):
+    m1, m2 = fresh_cache / "one", fresh_cache / "two"
+    run(f"FROM x\nRUN touch {m1}\n")
+    m1.unlink()
+    # appended instruction: only the new suffix runs
+    stats = run(f"FROM x\nRUN touch {m1}\nRUN touch {m2}")
+    assert stats["replayed"] == 1
+    assert m2.exists() and not m1.exists()
+
+
+def test_changed_line_replays_from_mismatch(fresh_cache):
+    m1, m2 = fresh_cache / "one", fresh_cache / "two"
+    run(f"FROM x\nRUN touch {m1}\nENV A=1")
+    m1.unlink()
+    # first line changed → everything from there replays
+    stats = run(f"FROM x\nRUN touch {m2}\nENV A=2")
+    assert stats["replayed"] == 2
+    assert m2.exists() and not m1.exists()
+    assert os.environ["A"] == "2"
+    os.environ.pop("A")
+
+
+def test_failed_run_raises_with_output(fresh_cache):
+    with pytest.raises(RuntimeError, match="image setup RUN failed"):
+        run("FROM x\nRUN exit 7")
+
+
+def test_copy_and_sync_are_noops(fresh_cache):
+    stats = run("FROM x\nCOPY src dest\nSYNC pkg")
+    assert stats["replayed"] == 2   # replayed as no-ops, no crash

@@ -1,0 +1,75 @@
+"""Load-balanced dispatch: one call → one pod, rotated.
+
+The third dispatch mode of the reference's CRD enum (``regular | spmd |
+load_balanced``, charts/.../kubetorchworkload-crd.yaml:80-86). In k8s the
+Service's ClusterIP already spreads *connections*; this supervisor spreads
+*calls* — deterministic round-robin with health skipping, which matters for
+long-lived clients holding keep-alive connections to one pod and for the
+local backend (whose service_url always points at pod 0).
+
+Unlike SPMD, the result is a single value (the chosen pod's), not a
+per-rank list.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+from ..exceptions import WorkerCallError
+from .discovery import my_pod_ip
+from .execution_supervisor import DistributedSupervisor
+from .remote_worker_pool import RemoteWorkerPool
+
+
+class LoadBalancedSupervisor(DistributedSupervisor):
+    def __init__(self, *args, server_port: int = 32300, fn_name: str = "",
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.server_port = server_port
+        self.fn_name = fn_name
+        self._rr = itertools.count()
+
+    async def _call_local(self, method, args, kwargs, timeout) -> Any:
+        # the restart guard wraps ONLY local execution: forwarded calls must
+        # not churn this pod's (unused) ranks or serialize behind its lock
+        async with self.restart_guard():
+            assert self.pool is not None, "supervisor not set up"
+            return await self.pool.call(0, method, args, kwargs, timeout)
+
+    async def call(self, method: Optional[str], args: list, kwargs: dict,
+                   timeout: Optional[float] = None,
+                   subtree: Optional[List[str]] = None,
+                   headers: Optional[Dict[str, str]] = None,
+                   **_ignored) -> Any:
+        if subtree is not None:
+            # we are the chosen pod for a forwarded call: run locally
+            return await self._call_local(method, args, kwargs, timeout)
+
+        ips = sorted(self.pod_ips() or [my_pod_ip()])
+        my_ip = my_pod_ip()
+        pool = RemoteWorkerPool.shared(self.server_port)
+        # try up to len(ips) pods starting at the round-robin cursor,
+        # skipping unhealthy ones (elastic by default)
+        start = next(self._rr)
+        last_err: Optional[BaseException] = None
+        for offset in range(len(ips)):
+            target = ips[(start + offset) % len(ips)]
+            if target == my_ip:
+                return await self._call_local(method, args, kwargs, timeout)
+            if not await pool.check_health(target):
+                continue
+            try:
+                return await pool.call_worker(
+                    target, self.fn_name, method,
+                    {"args": args, "kwargs": kwargs}, headers or {},
+                    timeout, subtree=[])
+            except WorkerCallError as e:
+                # failover ONLY on transport failure — an application
+                # exception from the peer must propagate, never re-run a
+                # (possibly non-idempotent) call on another pod
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        # no healthy peer: serve locally
+        return await self._call_local(method, args, kwargs, timeout)

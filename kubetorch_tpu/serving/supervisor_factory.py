@@ -20,6 +20,11 @@ def supervisor_for(config: Optional[DistributedConfig], pointers: Optional[Point
     if dist_type in ("jax", "pytorch", "torch", "tensorflow", "tf", "spmd"):
         return SPMDSupervisor(pointers, init_args, config, service_name,
                               namespace, server_port=server_port, fn_name=fn_name)
+    if dist_type == "load_balanced":
+        from .load_balanced_supervisor import LoadBalancedSupervisor
+        return LoadBalancedSupervisor(pointers, init_args, config, service_name,
+                                      namespace, server_port=server_port,
+                                      fn_name=fn_name)
     if dist_type == "ray":
         from .ray_supervisor import RaySupervisor
         return RaySupervisor(pointers, init_args, config, service_name, namespace)

@@ -48,11 +48,10 @@ def spawn_pod(ip: str, port: int, ips: list, fn_name: str = "whoami",
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
 
-@pytest.fixture
-def two_pods():
+def _pod_set(ips, dist_type="spmd"):
+    """Spawn a pod per ip on a shared port; yields (ips, port); tears down."""
     port = free_port()
-    ips = ["127.0.0.2", "127.0.0.3"]
-    procs = [spawn_pod(ip, port, ips) for ip in ips]
+    procs = [spawn_pod(ip, port, ips, dist_type=dist_type) for ip in ips]
     try:
         for ip in ips:
             assert wait_for_port(ip, port, timeout=30), f"pod {ip} never started"
@@ -65,6 +64,11 @@ def two_pods():
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.fixture
+def two_pods():
+    yield from _pod_set(["127.0.0.2", "127.0.0.3"])
 
 
 @pytest.mark.slow
@@ -121,3 +125,48 @@ def test_tree_topology_indices():
         assert not (seen & sub)
         seen |= sub
     assert seen == set(range(1, 200))
+
+
+@pytest.fixture
+def two_lb_pods():
+    yield from _pod_set(["127.0.0.51", "127.0.0.52"],
+                        dist_type="load_balanced")
+
+
+@pytest.mark.slow
+def test_load_balanced_round_robin(two_lb_pods):
+    """dispatch=load_balanced: each call lands on ONE pod, rotating — the
+    third CRD dispatch mode (reference crd.yaml:80-86)."""
+    ips, port = two_lb_pods
+    pids = set()
+    for _ in range(4):
+        r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                          json={"args": [], "kwargs": {}}, timeout=60)
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert isinstance(out, dict), "LB returns one pod's result, not a list"
+        pids.add(out["pid"])
+    assert len(pids) == 2, f"calls never rotated: {pids}"
+
+
+@pytest.mark.slow
+def test_load_balanced_skips_dead_pod(two_lb_pods):
+    from kubetorch_tpu.utils.procs import kill_process_tree
+    ips, port = two_lb_pods
+    import psutil
+    # find and kill pod 2's server — and prove we actually did, or the
+    # health-skip path goes untested
+    killed = False
+    for p in psutil.process_iter(["pid", "cmdline"]):
+        cmd = " ".join(p.info["cmdline"] or [])
+        if f"--host {ips[1]}" in cmd:
+            kill_process_tree(p.info["pid"])
+            killed = True
+    assert killed, "pod 2 server process not found"
+    import time as _t
+    _t.sleep(0.5)
+    # every call now lands on the survivor, no errors
+    for _ in range(3):
+        r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                          json={"args": [], "kwargs": {}}, timeout=60)
+        assert r.status_code == 200, r.text

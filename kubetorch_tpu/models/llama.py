@@ -180,18 +180,34 @@ def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
     return _xla_attention(q, k, v, scale)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, lw: Dict[str, jax.Array], freqs: jax.Array) -> jax.Array:
+def _layer(cfg: LlamaConfig, x: jax.Array, lw: Dict[str, jax.Array],
+           freqs: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+    """One decoder layer. With ``tp_axis`` set, the body is the Megatron
+    tensor-parallel variant for use inside ``shard_map``: ``lw`` leaves are
+    the LOCAL shards — wq/wk/wv/w_gate/w_up column-sharded (this device holds
+    ``n_heads/tp`` query heads, ``n_kv_heads/tp`` kv heads, ``ffn_dim/tp``
+    hidden units), wo/w_down row-sharded, norms replicated — and exactly two
+    ``psum``s run per layer (attention output, FFN output), explicit because
+    GSPMD cannot see inside shard_map. Head counts come from the local shapes
+    (equal to cfg's when unsharded), so one body serves both paths. GQA
+    grouping survives sharding: contiguous head blocks keep q-head
+    i ↔ kv-head i//group alignment per shard as long as tp | n_kv_heads.
+    """
     b, s, d = x.shape
+    hd = cfg.head_dim
+    nh = lw["wq"].shape[-1] // hd
+    nkv = lw["wk"].shape[-1] // hd
+    psum = (lambda y: lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
-    q = (h @ lw["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ lw["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ lw["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = (h @ lw["wq"]).reshape(b, s, nh, hd)
+    k = (h @ lw["wk"]).reshape(b, s, nkv, hd)
+    v = (h @ lw["wv"]).reshape(b, s, nkv, hd)
     q, k = apply_rope(q, freqs), apply_rope(k, freqs)
-    attn_out = attention(q, k, v, cfg).reshape(b, s, -1) @ lw["wo"]
-    x = x + attn_out
+    attn = attention(q, k, v, cfg).reshape(b, s, -1)
+    x = x + psum(attn @ lw["wo"])
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
-    return x + ffn
+    return x + psum(ffn)
 
 
 def llama_hidden(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:

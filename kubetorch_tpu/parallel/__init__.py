@@ -8,11 +8,28 @@ mesh={"data": N, "fsdp": M, "tensor": K, "context": C, "expert": E})``
 first-class.
 """
 
-from .mesh import MeshSpec, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_CONTEXT, AXIS_EXPERT
+from .mesh import (MeshSpec, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_PIPE,
+                   AXIS_TENSOR, AXIS_CONTEXT, AXIS_EXPERT)
 from .sharding import ShardingRules, LLAMA_RULES, named_sharding, shard_pytree
+
+# pipeline.py imports jax at module top; the server/controller processes
+# import this package (via .mesh) pre-spawn and must stay jax-free, so the
+# pipeline exports resolve lazily (PEP 562).
+_PIPELINE_EXPORTS = ("gpipe", "llama_forward_pipelined",
+                     "llama_loss_pipelined", "llama_pipeline_shardings",
+                     "llama_pipeline_specs", "PIPE_LLAMA_RULES")
 
 __all__ = [
     "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES",
     "named_sharding", "shard_pytree",
-    "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR", "AXIS_CONTEXT", "AXIS_EXPERT",
+    *_PIPELINE_EXPORTS,
+    "AXIS_DATA", "AXIS_FSDP", "AXIS_PIPE", "AXIS_TENSOR", "AXIS_CONTEXT",
+    "AXIS_EXPERT",
 ]
+
+
+def __getattr__(name):
+    if name in _PIPELINE_EXPORTS:
+        from . import pipeline
+        return getattr(pipeline, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -27,14 +27,18 @@ from typing import Dict, List, Optional, Sequence, Tuple
 AXIS_DCN = "dcn"
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
+AXIS_PIPE = "pipe"
 AXIS_TENSOR = "tensor"
 AXIS_CONTEXT = "context"
 AXIS_EXPERT = "expert"
 
 # Outer-to-inner order: dcn crosses slices (slowest fabric), tensor innermost
-# (most collective traffic per step → nearest-neighbor ICI links).
+# (most collective traffic per step → nearest-neighbor ICI links). Pipe sits
+# between the data-like axes and the per-stage axes: one ppermute per
+# microbatch per boundary is far less traffic than tensor's per-matmul psums.
 CANONICAL_ORDER: Tuple[str, ...] = (
-    AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR,
+    AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_EXPERT, AXIS_CONTEXT,
+    AXIS_TENSOR,
 )
 
 
@@ -45,6 +49,7 @@ class MeshSpec:
 
     data: int = 1
     fsdp: int = 1
+    pipe: int = 1
     tensor: int = 1
     context: int = 1
     expert: int = 1
@@ -149,6 +154,12 @@ def build_mesh(spec: MeshSpec | Dict[str, int] | None = None,
     except Exception:
         dev_array = np.asarray(list(devices)).reshape(shape)
     return Mesh(dev_array, spec.names)
+
+
+def live_axes(mesh) -> Dict[str, int]:
+    """Axis name → size for every mesh axis with size > 1 (the axes that
+    actually shard anything; size-1 axes are pruned from PartitionSpecs)."""
+    return {n: s for n, s in zip(mesh.axis_names, mesh.devices.shape) if s > 1}
 
 
 def best_mesh_for(n_devices: int, prefer: str = "fsdp") -> MeshSpec:

@@ -24,6 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .mesh import AXIS_PIPE, AXIS_TENSOR, live_axes as _live_axes
+from .sharding import BATCH_AXES as _BATCH_AXES, ShardingRules
+
 
 def _shard_map():
     sm = getattr(jax, "shard_map", None)
@@ -98,43 +101,100 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
 # Llama integration
 # ---------------------------------------------------------------------------
 
+# Inside a pipeline stage the batch-like axes (BATCH_AXES) act as pure data
+# parallelism: stage params are replicated over fsdp, not ZeRO-sharded
+# (gathering per-layer inside shard_map is a known gap, PARITY.md).
+# pipe/tensor are handled separately — they need manual collectives in the
+# stage body.
+
+# Llama layout on a pipe(+data/tensor) mesh: layer stack sharded on the layer
+# dim over pipe and on the Megatron dim over tensor; embed/head/final-norm
+# fall through to replicated (they run under GSPMD outside the shard_map).
+# Axis pruning for size-1/absent axes lives in ShardingRules.spec_for.
+PIPE_LLAMA_RULES = ShardingRules(rules=[
+    (r"layers/(wq|wk|wv|w_gate|w_up)$", (AXIS_PIPE, None, AXIS_TENSOR)),
+    (r"layers/(wo|w_down)$",            (AXIS_PIPE, AXIS_TENSOR, None)),
+    (r"layers/.*norm$",                 (AXIS_PIPE,)),
+])
+
+# The pipelined activation: batch dim over the data-like axes.
+_PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES,))])
+
+
+def llama_pipeline_specs(params, mesh):
+    """PartitionSpec pytree placing a llama param tree per ``PIPE_LLAMA_RULES``."""
+    return PIPE_LLAMA_RULES.tree_specs(params, mesh)
+
+
+def llama_pipeline_shardings(params, mesh):
+    """``NamedSharding`` pytree for ``llama_pipeline_specs`` (device_put-able)."""
+    return PIPE_LLAMA_RULES.tree_shardings(params, mesh)
+
 
 def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                             n_microbatches: Optional[int] = None):
-    """Llama forward with layers pipelined over the mesh's ``pipe`` axis.
+    """Llama forward with layers pipelined over the mesh's ``pipe`` axis,
+    composing with data parallelism (batch dim over ``data``/``fsdp``/``dcn``)
+    and Megatron tensor parallelism (``tensor`` axis) inside each stage.
 
-    Embedding / final norm / LM head stay data-parallel (they are a tiny
-    fraction of FLOPs); only the layer stack is staged. Layer params must
-    already be sharded ``PartitionSpec("pipe", ...)`` on dim 0 — i.e. each
-    ``params["layers"]`` leaf placed with ``NamedSharding(mesh, P("pipe"))``.
+    Embedding / final norm / LM head stay under GSPMD outside the shard_map
+    (they are a tiny fraction of FLOPs); only the layer stack is staged.
+    Layer params must already be placed per ``llama_pipeline_shardings`` —
+    layer dim over ``pipe``, Megatron dims over ``tensor``.
     """
-    from jax.sharding import PartitionSpec as P
+    import dataclasses as _dc
 
     from ..models.llama import _layer, rmsnorm, rope_freqs
 
-    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pipe", 1)
+    live = _live_axes(mesh)
+    n_stages = live.get("pipe", 1)
+    tp = live.get("tensor", 1)
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pipe={n_stages}")
+    if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
+        raise ValueError(f"tensor={tp} must divide n_kv_heads="
+                         f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
+    if cfg.attn_impl in ("ring", "ulysses") or "context" in live:
+        # context parallelism inside a pipeline stage is not built yet; a
+        # live context axis under "auto" would otherwise silently run fully
+        # redundant attention on every context-rank
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} with a context axis of "
+            f"{live.get('context', 1)} does not compose with the pipe axis "
+            "yet; use xla/flash and a context-free mesh for pipeline stages")
+    if cfg.attn_impl == "auto":
+        # resolve outside the shard_map: "auto" consults the mesh context,
+        # which must not route to ring/ulysses inside a stage
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        cfg = _dc.replace(cfg, attn_impl=impl)
+    dp = 1
+    for a in _BATCH_AXES:
+        dp *= live.get(a, 1)
     M = n_microbatches or n_stages
-    if tokens.shape[0] % M:
-        raise ValueError(f"batch={tokens.shape[0]} not divisible by "
-                         f"microbatches={M}")
+    local_batch = tokens.shape[0] // dp
+    if tokens.shape[0] % dp or local_batch % M:
+        raise ValueError(
+            f"batch={tokens.shape[0]} must divide over dp={dp} into local "
+            f"batches divisible by microbatches={M}")
 
     x = params["embed"][tokens].astype(cfg.dtype)
     freqs = rope_freqs(cfg, tokens.shape[1])
 
+    tp_axis = "tensor" if tp > 1 else None
+
     def stage_fn(local_layers, h):
         def body(carry, lw):
-            return _layer(cfg, carry, lw, freqs), None
+            return _layer(cfg, carry, lw, freqs, tp_axis=tp_axis), None
         body = jax.checkpoint(body)
         out, _ = lax.scan(body, h, local_layers)
         return out
 
-    layer_specs = jax.tree_util.tree_map(
-        lambda _: P("pipe"), params["layers"])
+    layer_specs = llama_pipeline_specs(params, mesh)["layers"]
+    act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
     run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
-                in_specs=P(), params_specs=layer_specs, out_specs=P())
+                in_specs=act_spec, params_specs=layer_specs,
+                out_specs=act_spec)
     x = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -115,7 +115,8 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh, *,
     batch×context×heads; runs the ring per context-shard via shard_map."""
     from jax.sharding import PartitionSpec as P
 
-    live = {n_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape) if s_ > 1}
+    from .mesh import live_axes
+    live = live_axes(mesh)
     ba = tuple(a for a in batch_axes if a in live)
     ba = ba if len(ba) > 1 else (ba[0] if ba else None)
     ha = head_axis if head_axis in live else None

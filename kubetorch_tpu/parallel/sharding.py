@@ -31,7 +31,8 @@ class ShardingRules:
         """
         from jax.sharding import PartitionSpec as P
 
-        live = {name: size for name, size in zip(mesh.axis_names, mesh.devices.shape) if size > 1}
+        from .mesh import live_axes
+        live = live_axes(mesh)
 
         def prune(entry):
             if entry is None:

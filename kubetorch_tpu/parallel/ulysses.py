@@ -75,8 +75,8 @@ def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
     sharding over the tensor axis is preserved (no silent all-gather)."""
     from jax.sharding import PartitionSpec as P
 
-    live = {n_: s_ for n_, s_ in zip(mesh.axis_names, mesh.devices.shape)
-            if s_ > 1}
+    from .mesh import live_axes
+    live = live_axes(mesh)
     if context_axis not in live:
         # no context sharding: same fallback ladder as the ring wrapper —
         # flash only on TPU (off-TPU the kernel would silently run in the

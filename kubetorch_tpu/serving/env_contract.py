@@ -116,6 +116,7 @@ class JaxEnv(FrameworkEnv):
 
     name = "jax"
     coordinator_port = 1234
+    default_cache_dir = "/tmp/kt_jax_cache"
 
     def env(self, info: RankInfo) -> Dict[str, str]:
         e = super().env(info)
@@ -127,6 +128,16 @@ class JaxEnv(FrameworkEnv):
             "TPU_WORKER_ID": str(info.rank),
             "TPU_WORKER_HOSTNAMES": ",".join(info.pod_ips),
         })
+        # Persistent XLA compilation cache: rank subprocesses are recreated on
+        # every hot reload / restart_procs, and without this each respawn pays
+        # the full jit compile again (tens of seconds for real models). The
+        # cache dir outlives subprocesses (same pod) and, when KT_JAX_CACHE_DIR
+        # points at a mounted volume, even pod restarts. Empty value disables;
+        # an explicit JAX_COMPILATION_CACHE_DIR in the pod env wins.
+        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+            cache_dir = os.environ.get("KT_JAX_CACHE_DIR", self.default_cache_dir)
+            if cache_dir:
+                e["JAX_COMPILATION_CACHE_DIR"] = cache_dir
         return e
 
     def auto_nproc(self) -> int:
@@ -201,3 +212,40 @@ FRAMEWORKS: Dict[str, type] = {
 def framework_for(name: Optional[str]) -> FrameworkEnv:
     cls = FRAMEWORKS.get((name or "spmd").lower(), FrameworkEnv)
     return cls()
+
+
+def sync_jax_runtime_config() -> None:
+    """Re-apply env-derived jax config that jax froze at import time.
+
+    jax reads ``JAX_COMPILATION_CACHE_DIR`` (and the persistent-cache knobs)
+    once, at import. A rank subprocess applies its env contract *after*
+    interpreter startup, and jax may already be imported by then (spawn
+    re-imports the parent's modules; some images preload jax site-wide). If
+    so, push the values into ``jax.config`` explicitly — a no-op when jax
+    isn't loaded yet, since import will pick the env vars up itself.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    mapping = {
+        "JAX_COMPILATION_CACHE_DIR": ("jax_compilation_cache_dir", str),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": (
+            "jax_persistent_cache_min_compile_time_secs", float),
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": (
+            "jax_persistent_cache_min_entry_size_bytes", int),
+    }
+    for env_key, (config_key, cast) in mapping.items():
+        value = os.environ.get(env_key)
+        if value:
+            try:
+                jax.config.update(config_key, cast(value))
+            except Exception as e:
+                # visible, not fatal: a failed sync means the worker falls
+                # back to cold compiles, which must not go unnoticed
+                import logging
+                logging.getLogger(__name__).warning(
+                    "failed to sync %s=%r into jax.config (%s): %s",
+                    env_key, value, config_key, e)

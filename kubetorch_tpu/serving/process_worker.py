@@ -69,6 +69,9 @@ def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
     os.environ.update(env)
     _sys.stdout = _QueueTee(_sys.stdout, response_q, "stdout")
     _sys.stderr = _QueueTee(_sys.stderr, response_q, "stderr")
+    # after the tees: a failed sync must reach the rank-log channel
+    from .env_contract import sync_jax_runtime_config
+    sync_jax_runtime_config()
     asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
                              framework_name))
 

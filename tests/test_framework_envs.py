@@ -14,6 +14,55 @@ from kubetorch_tpu.utils.procs import free_port, kill_process_tree, wait_for_por
 ASSETS = os.path.join(os.path.dirname(__file__), "assets")
 
 
+def _jax_env():
+    from kubetorch_tpu.serving.env_contract import JaxEnv, RankInfo
+
+    info = RankInfo(node_rank=1, local_rank=0, nproc_per_node=1, num_nodes=2,
+                    pod_ips=["10.0.0.1", "10.0.0.2"])
+    return JaxEnv().env(info)
+
+
+def test_jax_env_persistent_compilation_cache(monkeypatch):
+    """Rank subprocesses get a persistent XLA compile cache by default, so a
+    hot reload / restart_procs doesn't re-pay jit compilation."""
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("KT_JAX_CACHE_DIR", raising=False)
+    assert _jax_env()["JAX_COMPILATION_CACHE_DIR"] == "/tmp/kt_jax_cache"
+
+    # KT_JAX_CACHE_DIR overrides the default (e.g. a mounted volume)
+    monkeypatch.setenv("KT_JAX_CACHE_DIR", "/vol/cache")
+    assert _jax_env()["JAX_COMPILATION_CACHE_DIR"] == "/vol/cache"
+
+    # empty value disables
+    monkeypatch.setenv("KT_JAX_CACHE_DIR", "")
+    assert "JAX_COMPILATION_CACHE_DIR" not in _jax_env()
+
+    # explicit pod-level JAX_COMPILATION_CACHE_DIR wins (inherited, not set)
+    monkeypatch.delenv("KT_JAX_CACHE_DIR", raising=False)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/user/choice")
+    assert "JAX_COMPILATION_CACHE_DIR" not in _jax_env()
+
+
+def test_sync_jax_runtime_config_applies_to_imported_jax(monkeypatch):
+    """The worker-side sync path: jax already imported (spawn re-import or a
+    site-wide preload) must still honor the cache env vars at runtime."""
+    import jax
+
+    from kubetorch_tpu.serving.env_contract import sync_jax_runtime_config
+
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/kt_sync_probe")
+    monkeypatch.setenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    try:
+        sync_jax_runtime_config()
+        assert jax.config.jax_compilation_cache_dir == "/tmp/kt_sync_probe"
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.5
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", old_secs)
+
+
 @pytest.mark.level("minimal")
 @pytest.mark.slow
 def test_pytorch_gloo_allreduce_via_env_contract():

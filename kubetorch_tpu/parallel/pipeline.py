@@ -24,8 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .mesh import AXIS_PIPE, AXIS_TENSOR, live_axes as _live_axes
-from .sharding import BATCH_AXES as _BATCH_AXES, ShardingRules
+from .mesh import AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR, live_axes as _live_axes
+from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, ShardingRules)
 
 
 def _shard_map():
@@ -101,21 +101,21 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
 # Llama integration
 # ---------------------------------------------------------------------------
 
-# Inside a pipeline stage the batch-like axes (BATCH_AXES) act as pure data
-# parallelism: stage params are replicated over fsdp, not ZeRO-sharded
-# (gathering per-layer inside shard_map is a known gap, PARITY.md).
-# pipe/tensor are handled separately — they need manual collectives in the
-# stage body.
-
-# Llama layout on a pipe(+data/tensor) mesh: layer stack sharded on the layer
-# dim over pipe and on the Megatron dim over tensor; embed/head/final-norm
-# fall through to replicated (they run under GSPMD outside the shard_map).
-# Axis pruning for size-1/absent axes lives in ShardingRules.spec_for.
+# Llama layout on a pipe(+data/fsdp/tensor) mesh: layer stack sharded on the
+# layer dim over pipe, the Megatron dim over tensor, and the d_model dim over
+# fsdp (ZeRO-3: the stage body all-gathers one layer's weights at a time and
+# the gather's transpose reduce-scatters the grads — scaling-book FSDP+PP).
+# embed/lm_head shard like LLAMA_RULES and run under GSPMD outside the
+# shard_map. Axis pruning for size-1/absent axes lives in
+# ShardingRules.spec_for.
+# Layer-stack rules take precedence (matched first, `layers/` prefix);
+# embed/lm_head/final-norm fall through to the non-pipelined LLAMA_RULES so
+# the two paths can never place them differently.
 PIPE_LLAMA_RULES = ShardingRules(rules=[
-    (r"layers/(wq|wk|wv|w_gate|w_up)$", (AXIS_PIPE, None, AXIS_TENSOR)),
-    (r"layers/(wo|w_down)$",            (AXIS_PIPE, AXIS_TENSOR, None)),
+    (r"layers/(wq|wk|wv|w_gate|w_up)$", (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"layers/(wo|w_down)$",            (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
     (r"layers/.*norm$",                 (AXIS_PIPE,)),
-])
+] + LLAMA_RULES.rules)
 
 # The pipelined activation: batch dim over the data-like axes.
 _PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES,))])
@@ -134,8 +134,10 @@ def llama_pipeline_shardings(params, mesh):
 def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                             n_microbatches: Optional[int] = None):
     """Llama forward with layers pipelined over the mesh's ``pipe`` axis,
-    composing with data parallelism (batch dim over ``data``/``fsdp``/``dcn``)
-    and Megatron tensor parallelism (``tensor`` axis) inside each stage.
+    composing with data parallelism (batch dim over ``data``/``fsdp``/``dcn``),
+    ZeRO-3 parameter sharding (``fsdp`` axis: stage weights stored sharded,
+    one layer all-gathered at a time, grads reduce-scattered), and Megatron
+    tensor parallelism (``tensor`` axis) inside each stage.
 
     Embedding / final norm / LM head stay under GSPMD outside the shard_map
     (they are a tiny fraction of FLOPs); only the layer stack is staged.
@@ -152,9 +154,12 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pipe={n_stages}")
+    fsdp = live.get("fsdp", 1)
     if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
         raise ValueError(f"tensor={tp} must divide n_kv_heads="
                          f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
+    if fsdp > 1 and cfg.dim % fsdp:
+        raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
     if cfg.attn_impl in ("ring", "ulysses") or "context" in live:
         # context parallelism inside a pipeline stage is not built yet; a
         # live context axis under "auto" would otherwise silently run fully
@@ -182,15 +187,32 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
     freqs = rope_freqs(cfg, tokens.shape[1])
 
     tp_axis = "tensor" if tp > 1 else None
+    layer_specs = llama_pipeline_specs(params, mesh)["layers"]
+    # Gather dim per leaf, derived from the rule table itself (position of
+    # "fsdp" in the live spec, minus the scan-stripped pipe dim) so the
+    # layout has exactly one source of truth.
+    gather_dims = {k: list(spec).index("fsdp") - 1
+                   for k, spec in layer_specs.items()
+                   if fsdp > 1 and "fsdp" in spec}
+
+    def gather_layer(lw):
+        """ZeRO-3 inside the stage: materialize ONE layer's full weights
+        from their fsdp shards. Under the remat wrapper the gathered copies
+        are recomputed in backward, where the gather's transpose
+        reduce-scatters the weight grads back over fsdp."""
+        if not gather_dims:
+            return lw
+        return {k: (lax.all_gather(v, "fsdp", axis=gather_dims[k], tiled=True)
+                    if k in gather_dims else v)
+                for k, v in lw.items()}
 
     def stage_fn(local_layers, h):
         def body(carry, lw):
-            return _layer(cfg, carry, lw, freqs, tp_axis=tp_axis), None
+            return _layer(cfg, carry, gather_layer(lw), freqs,
+                          tp_axis=tp_axis), None
         body = jax.checkpoint(body)
         out, _ = lax.scan(body, h, local_layers)
         return out
-
-    layer_specs = llama_pipeline_specs(params, mesh)["layers"]
     act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
     run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
                 in_specs=act_spec, params_specs=layer_specs,

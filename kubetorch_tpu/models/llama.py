@@ -42,7 +42,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    attn_impl: str = "auto"  # auto | xla | flash | ring | ulysses
+    # auto | xla | flash | ring | ulysses; "ring_local" is pipeline-internal
+    # (already-inside-shard_map ring dispatch, set by llama_forward_pipelined)
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -163,6 +165,11 @@ def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
             impl = "flash"
         else:
             impl = "xla"
+    if impl == "ring_local":
+        # caller is already inside a shard_map with a bound "context" axis
+        # (e.g. a pipeline stage body); never wrap another shard_map
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis_name="context", causal=True, scale=scale)
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention, ring_attention_sharded
         if mesh is not None:
@@ -177,6 +184,9 @@ def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
     if impl == "flash":
         from ..ops.attention import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale)
+    if impl != "xla":
+        raise ValueError(f"unknown attn_impl {impl!r}; expected "
+                         "auto|xla|flash|ring|ulysses")
     return _xla_attention(q, k, v, scale)
 
 

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .mesh import AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR, live_axes as _live_axes
+from .mesh import (AXIS_CONTEXT, AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR,
+                   live_axes as _live_axes)
 from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, ShardingRules)
 
 
@@ -117,8 +118,9 @@ PIPE_LLAMA_RULES = ShardingRules(rules=[
     (r"layers/.*norm$",                 (AXIS_PIPE,)),
 ] + LLAMA_RULES.rules)
 
-# The pipelined activation: batch dim over the data-like axes.
-_PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES,))])
+# The pipelined activation: batch dim over the data-like axes, sequence dim
+# over the context axis (ring attention runs inside the stage body).
+_PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES, AXIS_CONTEXT))])
 
 
 def llama_pipeline_specs(params, mesh):
@@ -142,7 +144,8 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
     Embedding / final norm / LM head stay under GSPMD outside the shard_map
     (they are a tiny fraction of FLOPs); only the layer stack is staged.
     Layer params must already be placed per ``llama_pipeline_shardings`` —
-    layer dim over ``pipe``, Megatron dims over ``tensor``.
+    layer dim over ``pipe``, d_model dim over ``fsdp`` (ZeRO-3), Megatron
+    dims over ``tensor``.
     """
     import dataclasses as _dc
 
@@ -160,15 +163,25 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                          f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
     if fsdp > 1 and cfg.dim % fsdp:
         raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
-    if cfg.attn_impl in ("ring", "ulysses") or "context" in live:
-        # context parallelism inside a pipeline stage is not built yet; a
-        # live context axis under "auto" would otherwise silently run fully
-        # redundant attention on every context-rank
+    cp = live.get("context", 1)
+    if cfg.attn_impl == "ulysses":
         raise ValueError(
-            f"attn_impl={cfg.attn_impl!r} with a context axis of "
-            f"{live.get('context', 1)} does not compose with the pipe axis "
-            "yet; use xla/flash and a context-free mesh for pipeline stages")
-    if cfg.attn_impl == "auto":
+            "attn_impl='ulysses' does not compose with the pipe axis yet; "
+            "use ring (a live context axis) or xla/flash")
+    if cp > 1:
+        # Sequence is sharded over the context axis, so attention inside the
+        # stage MUST run the ring (whatever impl was requested — a local-
+        # chunk flash/xla would silently attend over 1/cp of the sequence).
+        # "ring_local" is the already-inside-shard_map dispatch.
+        if tokens.shape[1] % cp:
+            raise ValueError(f"seq_len={tokens.shape[1]} not divisible by "
+                             f"context={cp}")
+        cfg = _dc.replace(cfg, attn_impl="ring_local")
+    elif cfg.attn_impl == "ring":
+        raise ValueError(
+            "attn_impl='ring' in a pipeline needs a live context axis "
+            "(mesh context size > 1); use xla/flash otherwise")
+    elif cfg.attn_impl == "auto":
         # resolve outside the shard_map: "auto" consults the mesh context,
         # which must not route to ring/ulysses inside a stage
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
@@ -207,8 +220,17 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                 for k, v in lw.items()}
 
     def stage_fn(local_layers, h):
+        if cp > 1:
+            # RoPE positions are global: slice this context-rank's window of
+            # the (S, Hd/2) table for its local sequence chunk
+            s_local = h.shape[1]
+            fr = lax.dynamic_slice_in_dim(
+                freqs, lax.axis_index("context") * s_local, s_local, axis=0)
+        else:
+            fr = freqs
+
         def body(carry, lw):
-            return _layer(cfg, carry, gather_layer(lw), freqs,
+            return _layer(cfg, carry, gather_layer(lw), fr,
                           tp_axis=tp_axis), None
         body = jax.checkpoint(body)
         out, _ = lax.scan(body, h, local_layers)

@@ -99,9 +99,9 @@ def test_invalid_configs(pipe_mesh):
         llama_forward_pipelined(params, tokens, CFG, pipe_mesh,
                                 n_microbatches=3)
     with pytest.raises(ValueError, match="compose"):
-        ring = LlamaConfig.tiny(n_layers=4, attn_impl="ring",
-                                dtype=jnp.float32, remat=False)
-        llama_forward_pipelined(params, tokens, ring, pipe_mesh)
+        uly = LlamaConfig.tiny(n_layers=4, attn_impl="ulysses",
+                               dtype=jnp.float32, remat=False)
+        llama_forward_pipelined(params, tokens, uly, pipe_mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +210,74 @@ def test_zero3_pipeline_grads_match(zero3_mesh):
     np.testing.assert_allclose(np.asarray(g["lm_head"]),
                                np.asarray(g_ref["lm_head"]),
                                rtol=5e-4, atol=5e-4)
+
+
+@pytest.fixture(scope="module")
+def cp_mesh(cpu_mesh_devices):
+    from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(context=2, pipe=2, tensor=2),
+                      devices=jax.devices()[:8])
+
+
+def test_ring_attention_inside_pipeline_matches_sequential(cp_mesh):
+    """cp×pipe×tp: the sequence shards over the context axis and the stage
+    body runs ring attention (per-rank RoPE slice included)."""
+    from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
+
+    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
+                                dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg_auto)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg_auto.vocab_size)
+    ref = llama_forward(params, tokens, cfg_auto)
+    sharded = _composed_params(params, cp_mesh)
+    out = jax.jit(lambda p, t: llama_forward_pipelined(
+        p, t, cfg_auto, cp_mesh, n_microbatches=2))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_pipeline_grads_match(cp_mesh):
+    from kubetorch_tpu.models.llama import llama_loss
+    from kubetorch_tpu.parallel.pipeline import llama_loss_pipelined
+
+    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
+                                dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg_auto)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                cfg_auto.vocab_size)
+    targets = jnp.roll(tokens, -1, 1)
+    g_ref = jax.grad(llama_loss)(params, tokens, targets, cfg_auto)
+    sharded = _composed_params(params, cp_mesh)
+    g = jax.jit(jax.grad(lambda p, t, y: llama_loss_pipelined(
+        p, t, y, cfg_auto, cp_mesh, n_microbatches=2)))(
+        sharded, tokens, targets)
+    for k in ("wq", "wo", "w_down"):
+        np.testing.assert_allclose(np.asarray(g["layers"][k]),
+                                   np.asarray(g_ref["layers"][k]),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_cp_pipeline_validation(cp_mesh, pipe_mesh):
+    from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
+
+    # seq not divisible by context size
+    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
+                                dtype=jnp.float32, remat=False)
+    params = _composed_params(llama_init(jax.random.PRNGKey(0), cfg_auto),
+                              cp_mesh)
+    with pytest.raises(ValueError, match="seq_len"):
+        llama_forward_pipelined(params, jnp.zeros((8, 15), jnp.int32),
+                                cfg_auto, cp_mesh)
+    # explicit ring without a live context axis
+    ring = LlamaConfig.tiny(n_layers=4, attn_impl="ring",
+                            dtype=jnp.float32, remat=False)
+    params4 = _sharded_params(llama_init(jax.random.PRNGKey(0), ring),
+                              pipe_mesh)
+    with pytest.raises(ValueError, match="context"):
+        llama_forward_pipelined(params4, jnp.zeros((8, 16), jnp.int32),
+                                ring, pipe_mesh)
 
 
 def test_composed_tp_divisibility_validated(composed_mesh):

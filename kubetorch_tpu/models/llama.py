@@ -42,8 +42,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # auto | xla | flash | ring | ulysses; "ring_local" is pipeline-internal
-    # (already-inside-shard_map ring dispatch, set by llama_forward_pipelined)
+    # auto | xla | flash | ring | ulysses; "ring_local"/"ulysses_local" are
+    # pipeline-internal (already-inside-shard_map dispatch, set only by
+    # llama_forward_pipelined)
     attn_impl: str = "auto"
 
     @property
@@ -170,6 +171,9 @@ def attention(q, k, v, cfg: LlamaConfig) -> jax.Array:
         # (e.g. a pipeline stage body); never wrap another shard_map
         from ..parallel.ring_attention import ring_attention
         return ring_attention(q, k, v, axis_name="context", causal=True, scale=scale)
+    if impl == "ulysses_local":
+        from ..parallel.ulysses import ulysses_attention
+        return ulysses_attention(q, k, v, axis_name="context", causal=True, scale=scale)
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention, ring_attention_sharded
         if mesh is not None:

@@ -163,24 +163,32 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                          f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
     if fsdp > 1 and cfg.dim % fsdp:
         raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
+    if cfg.attn_impl not in ("auto", "xla", "flash", "ring", "ulysses"):
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}; expected "
+                         "auto|xla|flash|ring|ulysses")
     cp = live.get("context", 1)
-    if cfg.attn_impl == "ulysses":
-        raise ValueError(
-            "attn_impl='ulysses' does not compose with the pipe axis yet; "
-            "use ring (a live context axis) or xla/flash")
     if cp > 1:
         # Sequence is sharded over the context axis, so attention inside the
-        # stage MUST run the ring (whatever impl was requested — a local-
-        # chunk flash/xla would silently attend over 1/cp of the sequence).
-        # "ring_local" is the already-inside-shard_map dispatch.
+        # stage MUST be context-parallel (a local-chunk flash/xla would
+        # silently attend over 1/cp of the sequence): ulysses if requested,
+        # the ring otherwise. "*_local" = already-inside-shard_map dispatch.
         if tokens.shape[1] % cp:
             raise ValueError(f"seq_len={tokens.shape[1]} not divisible by "
                              f"context={cp}")
-        cfg = _dc.replace(cfg, attn_impl="ring_local")
-    elif cfg.attn_impl == "ring":
+        if cfg.attn_impl == "ulysses":
+            # ulysses scatters the LOCAL (post-tp) heads over the context axis
+            if (cfg.n_heads // tp) % cp or (cfg.n_kv_heads // tp) % cp:
+                raise ValueError(
+                    f"ulysses needs context={cp} to divide the per-tensor-"
+                    f"shard head counts {cfg.n_heads}/{tp} and "
+                    f"{cfg.n_kv_heads}/{tp}; use ring attention instead")
+            cfg = _dc.replace(cfg, attn_impl="ulysses_local")
+        else:
+            cfg = _dc.replace(cfg, attn_impl="ring_local")
+    elif cfg.attn_impl in ("ring", "ulysses"):
         raise ValueError(
-            "attn_impl='ring' in a pipeline needs a live context axis "
-            "(mesh context size > 1); use xla/flash otherwise")
+            f"attn_impl={cfg.attn_impl!r} in a pipeline needs a live "
+            "context axis (mesh context size > 1); use xla/flash otherwise")
     elif cfg.attn_impl == "auto":
         # resolve outside the shard_map: "auto" consults the mesh context,
         # which must not route to ring/ulysses inside a stage

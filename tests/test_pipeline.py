@@ -20,6 +20,8 @@ def pipe_mesh(cpu_mesh_devices):
 
 CFG = LlamaConfig.tiny(n_layers=4, attn_impl="xla", dtype=jnp.float32,
                        remat=False)
+CFG_AUTO = LlamaConfig.tiny(n_layers=4, attn_impl="auto", dtype=jnp.float32,
+                            remat=False)
 
 
 def _sharded_params(params, mesh):
@@ -98,7 +100,7 @@ def test_invalid_configs(pipe_mesh):
     with pytest.raises(ValueError, match="microbatches"):
         llama_forward_pipelined(params, tokens, CFG, pipe_mesh,
                                 n_microbatches=3)
-    with pytest.raises(ValueError, match="compose"):
+    with pytest.raises(ValueError, match="context"):
         uly = LlamaConfig.tiny(n_layers=4, attn_impl="ulysses",
                                dtype=jnp.float32, remat=False)
         llama_forward_pipelined(params, tokens, uly, pipe_mesh)
@@ -225,8 +227,7 @@ def test_ring_attention_inside_pipeline_matches_sequential(cp_mesh):
     body runs ring attention (per-rank RoPE slice included)."""
     from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
 
-    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
-                                dtype=jnp.float32, remat=False)
+    cfg_auto = CFG_AUTO
     params = llama_init(jax.random.PRNGKey(0), cfg_auto)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
                                 cfg_auto.vocab_size)
@@ -242,8 +243,7 @@ def test_ring_pipeline_grads_match(cp_mesh):
     from kubetorch_tpu.models.llama import llama_loss
     from kubetorch_tpu.parallel.pipeline import llama_loss_pipelined
 
-    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
-                                dtype=jnp.float32, remat=False)
+    cfg_auto = CFG_AUTO
     params = llama_init(jax.random.PRNGKey(0), cfg_auto)
     tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
                                 cfg_auto.vocab_size)
@@ -263,8 +263,7 @@ def test_cp_pipeline_validation(cp_mesh, pipe_mesh):
     from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
 
     # seq not divisible by context size
-    cfg_auto = LlamaConfig.tiny(n_layers=4, attn_impl="auto",
-                                dtype=jnp.float32, remat=False)
+    cfg_auto = CFG_AUTO
     params = _composed_params(llama_init(jax.random.PRNGKey(0), cfg_auto),
                               cp_mesh)
     with pytest.raises(ValueError, match="seq_len"):
@@ -278,6 +277,40 @@ def test_cp_pipeline_validation(cp_mesh, pipe_mesh):
     with pytest.raises(ValueError, match="context"):
         llama_forward_pipelined(params4, jnp.zeros((8, 16), jnp.int32),
                                 ring, pipe_mesh)
+
+
+def test_ulysses_inside_pipeline_matches_sequential(cpu_mesh_devices):
+    """data×cp×pipe with attn_impl='ulysses': the stage body head-scatters
+    via all-to-all instead of the ring."""
+    from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
+    from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
+
+    mesh = build_mesh(MeshSpec(data=2, context=2, pipe=2),
+                      devices=jax.devices()[:8])
+    cfg_u = LlamaConfig.tiny(n_layers=4, attn_impl="ulysses",
+                             dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg_u)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg_u.vocab_size)
+    ref = llama_forward(params, tokens, CFG)
+    sharded = _composed_params(params, mesh)
+    out = jax.jit(lambda p, t: llama_forward_pipelined(
+        p, t, cfg_u, mesh, n_microbatches=2))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_pipeline_tp_head_guard(cp_mesh):
+    """tp shrinks local head counts below the ulysses degree → clear error."""
+    from kubetorch_tpu.parallel.pipeline import llama_forward_pipelined
+
+    cfg_u = LlamaConfig.tiny(n_layers=4, attn_impl="ulysses",
+                             dtype=jnp.float32, remat=False)
+    params = _composed_params(llama_init(jax.random.PRNGKey(0), cfg_u),
+                              cp_mesh)
+    with pytest.raises(ValueError, match="ulysses"):
+        llama_forward_pipelined(params, jnp.zeros((8, 16), jnp.int32),
+                                cfg_u, cp_mesh)
 
 
 def test_composed_tp_divisibility_validated(composed_mesh):

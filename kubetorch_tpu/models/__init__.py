@@ -1,4 +1,4 @@
-"""Model families: Llama (flagship), Mixtral-style MoE, ResNet, MLP.
+"""Model families: Llama (flagship), Mixtral-style MoE, ViT, ResNet, MLP.
 
 The reference ships no models (it is a dispatch fabric; models live in user
 code). This framework makes the headline workloads (BASELINE.md configs 1-5)
@@ -9,5 +9,7 @@ shapes, scanned layers, no data-dependent Python control flow).
 """
 
 from .llama import LlamaConfig, llama_init, llama_forward, llama_loss
+from .vit import VitConfig, vit_init, vit_forward, vit_loss
 
-__all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss"]
+__all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
+           "VitConfig", "vit_init", "vit_forward", "vit_loss"]

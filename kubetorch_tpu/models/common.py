@@ -1,0 +1,14 @@
+"""Shared model-family helpers."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Type
+
+
+def config_from_dict(cls: Type, d: Dict[str, Any]):
+    """Build a config dataclass from a dict, ignoring unknown keys (wire
+    metadata can carry extra fields; each family's config takes what it
+    knows). One definition for every model family."""
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in fields})

@@ -299,5 +299,5 @@ def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
 
 
 def config_from_dict(d: Dict) -> LlamaConfig:
-    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
-    return LlamaConfig(**{k: v for k, v in d.items() if k in fields})
+    from .common import config_from_dict as _generic
+    return _generic(LlamaConfig, d)

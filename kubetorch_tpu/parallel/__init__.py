@@ -10,7 +10,8 @@ first-class.
 
 from .mesh import (MeshSpec, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_PIPE,
                    AXIS_TENSOR, AXIS_CONTEXT, AXIS_EXPERT)
-from .sharding import ShardingRules, LLAMA_RULES, named_sharding, shard_pytree
+from .sharding import (ShardingRules, LLAMA_RULES, MOE_RULES, VIT_RULES,
+                       named_sharding, shard_pytree)
 
 # pipeline.py imports jax at module top; the server/controller processes
 # import this package (via .mesh) pre-spawn and must stay jax-free, so the
@@ -20,8 +21,8 @@ _PIPELINE_EXPORTS = ("gpipe", "llama_forward_pipelined",
                      "llama_pipeline_specs", "PIPE_LLAMA_RULES")
 
 __all__ = [
-    "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES",
-    "named_sharding", "shard_pytree",
+    "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES", "MOE_RULES",
+    "VIT_RULES", "named_sharding", "shard_pytree",
     *_PIPELINE_EXPORTS,
     "AXIS_DATA", "AXIS_FSDP", "AXIS_PIPE", "AXIS_TENSOR", "AXIS_CONTEXT",
     "AXIS_EXPERT",

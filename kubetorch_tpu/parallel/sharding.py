@@ -115,6 +115,20 @@ MOE_RULES = ShardingRules(rules=[
     (r"router",               (None,)),
 ] + LLAMA_RULES.rules)
 
+# ViT encoder params (see models/vit.py): same Megatron layout as llama —
+# fsdp shards d_model (reduction) dims, tensor shards heads / mlp-hidden;
+# position embeddings and norms replicated.
+VIT_RULES = ShardingRules(rules=[
+    (r"patch_embed$",  (None, AXIS_FSDP)),            # (P²C, D)
+    (r"pos_embed$",    (None,)),                      # (N, D) replicated
+    (r"wqkv$",         (None, AXIS_FSDP, AXIS_TENSOR)),  # (L, D, 3D)
+    (r"wo$",           (None, AXIS_TENSOR, AXIS_FSDP)),  # (L, D, D)
+    (r"w_up$",         (None, AXIS_FSDP, AXIS_TENSOR)),  # (L, D, M)
+    (r"w_down$",       (None, AXIS_TENSOR, AXIS_FSDP)),  # (L, M, D)
+    (r"head$",         (AXIS_FSDP, AXIS_TENSOR)),     # (D, n_classes)
+    (r"ln|norm",       (None,)),
+])
+
 # Activations: batch over (dcn, data, fsdp), sequence over context, vocab-dim
 # logits over tensor.
 ACT_RULES = ShardingRules(rules=[

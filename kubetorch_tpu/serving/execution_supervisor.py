@@ -74,6 +74,12 @@ class ExecutionSupervisor:
     def healthy(self) -> bool:
         return self.pool is not None and self.pool.healthy
 
+    @property
+    def warming(self) -> bool:
+        """True while rank workers are inside their load+warmup window —
+        gates /ready so pods don't join the endpoint pool mid-compile."""
+        return self.pool is not None and self.pool.warming
+
     # -- calls ---------------------------------------------------------------
 
     async def call(self, method: Optional[str], args: list, kwargs: dict,

@@ -262,13 +262,20 @@ async def health(request: web.Request) -> web.Response:
 
 async def ready(request: web.Request) -> web.Response:
     """Reload-completion barrier (reference :1670): ready only when the pod's
-    launch_id matches the client's freshly deployed one."""
+    launch_id matches the client's freshly deployed one AND the rank workers
+    have finished their load+warmup window (``__kt_warmup__`` pays jit
+    compilation before the pod joins the endpoint pool)."""
     state: ServerState = request.app["state"]
     want = request.query.get("launch_id")
     if want and want != state.launch_id:
         return web.json_response(
             {"ready": False, "launch_id": state.launch_id, "expected": want},
             status=409)
+    sup = state.supervisor
+    if sup is not None and getattr(sup, "warming", False):
+        return web.json_response(
+            {"ready": False, "launch_id": state.launch_id,
+             "warming": True}, status=503)
     return web.json_response({"ready": True, "launch_id": state.launch_id})
 
 async def metrics(request: web.Request) -> web.Response:

@@ -62,6 +62,10 @@ class ProcessPool:
             if resp.get("op") == "log":
                 self._forward_log(resp, worker)
                 continue
+            if resp.get("op") == "state":
+                # load+warmup bracket: gates /ready and shutdown escalation
+                worker.in_warmup = resp.get("warmup") == "started"
+                continue
             req_id = resp.get("req_id")
             with self._futures_lock:
                 fut = self._futures.pop(req_id, None)
@@ -136,3 +140,8 @@ class ProcessPool:
     @property
     def healthy(self) -> bool:
         return all(w.alive for w in self.workers)
+
+    @property
+    def warming(self) -> bool:
+        """True while any live rank is still in its load+warmup window."""
+        return any(w.alive and w.in_warmup for w in self.workers)

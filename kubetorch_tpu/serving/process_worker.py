@@ -85,11 +85,19 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
 
     # Eager-load the callable at spawn (reference :236-247) so first-request
     # latency excludes import cost, and failures surface in health checks.
+    # The state ops bracket the load+warmup window: the parent ProcessPool
+    # marks the worker in_warmup and (a) /ready reports not-ready until done,
+    # (b) shutdown withholds its SIGKILL escalation — a jit compile in
+    # flight must never be force-killed (it can wedge the TPU runtime).
+    response_q.put({"op": "state", "warmup": "started"})
     if pointers_dict:
         try:
             target = _load_target(pointers_dict, init_args)
         except BaseException as e:  # noqa: BLE001 — must report, not die
             load_error = e
+        else:
+            await _run_warmup(target)
+    response_q.put({"op": "state", "warmup": "done"})
 
     pending = set()
 
@@ -130,6 +138,25 @@ def _host_view(obj: Any) -> Any:
     if isinstance(obj, list):
         return [_host_view(v) for v in obj]
     return obj
+
+
+async def _run_warmup(target: Any) -> None:
+    """Run the user's ``__kt_warmup__`` hook (method on a class instance, or
+    attribute attached to a function) right after the eager load — inference
+    pools pay jit compilation at deploy time, not on the first user request
+    (``/ready`` reports not-ready until the bracketing state ops complete).
+    A failed warmup is logged (the stream tee ships it to the supervisor's
+    rank logs) but never poisons the worker: requests may still succeed, and
+    if not they produce their own errors."""
+    hook = getattr(target, "__kt_warmup__", None)
+    if hook is None:
+        return
+    try:
+        result = hook()
+        if asyncio.iscoroutine(result):
+            await result
+    except BaseException:  # noqa: BLE001
+        print(f"[kt] __kt_warmup__ failed:\n{traceback.format_exc()}")
 
 
 def _load_target(pointers_dict: Dict, init_args: Optional[Dict]) -> Any:
@@ -204,6 +231,8 @@ class ProcessWorker:
         env = dict(base_env or {})
         env.update(framework_for(framework_name).env(rank_info))
         self.env = env
+        # flipped by ProcessPool._route_responses from the worker's state ops
+        self.in_warmup = True
         self.process = ctx.Process(
             target=_worker_main,
             args=(self.request_q, self.response_q, env,
@@ -219,13 +248,28 @@ class ProcessWorker:
         self.request_q.put(req)
 
     def shutdown(self, timeout: float = 5.0) -> None:
+        """Graceful stop; escalates to SIGKILL only when the worker is
+        neither exiting nor warming up. While ``in_warmup`` (set by the
+        pool's response router from the worker's state ops) the worker is
+        likely inside a jit compile — force-killing a process mid-compile
+        while it holds the TPU can wedge the runtime for every successor, so
+        warmup gets a long grace window (KT_WARMUP_SHUTDOWN_GRACE seconds,
+        default 600) before the last-resort kill."""
         try:
             self.request_q.put({"op": "shutdown"})
         except Exception:
             pass
         self.process.join(timeout)
+        grace = float(os.environ.get("KT_WARMUP_SHUTDOWN_GRACE", "600"))
+        waited = 0.0
+        while self.process.is_alive() and self.in_warmup and waited < grace:
+            self.process.join(10.0)
+            waited += 10.0
         if self.process.is_alive():
             from ..utils.procs import kill_process_tree
+            if self.in_warmup:
+                print(f"[kt] rank {self.rank_info.rank} still in warmup "
+                      f"after {grace:.0f}s grace; force-killing")
             kill_process_tree(self.process.pid)
 
     @property

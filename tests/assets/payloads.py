@@ -65,3 +65,17 @@ def torch_allreduce():
     dist.all_reduce(t)
     return {"rank": dist.get_rank(), "world": dist.get_world_size(),
             "sum": float(t.item())}
+
+
+class Warmable:
+    """Exercises the __kt_warmup__ hook: the worker must run it at eager
+    load, before the first request arrives."""
+
+    def __init__(self):
+        self.warmed = False
+
+    def __kt_warmup__(self):
+        self.warmed = True
+
+    def was_warmed(self):
+        return self.warmed

@@ -69,6 +69,14 @@ def test_health_and_ready():
         assert r.status == 200
         r = await client.get("/ready", params={"launch_id": "other"})
         assert r.status == 409
+
+        # rank workers still inside their load+warmup window → not ready
+        class _WarmingSup:
+            warming = True
+        state.supervisor = _WarmingSup()
+        r = await client.get("/ready", params={"launch_id": "launch-1"})
+        assert r.status == 503 and (await r.json())["warming"] is True
+        state.supervisor = None
     run_server_test(body)
 
 
@@ -110,6 +118,18 @@ def test_class_instance_methods():
         # state persists in the worker process
         r = await client.post("/Counter/get", json={"args": [], "kwargs": {}})
         assert json.loads(await r.read()) == 15
+    run_server_test(body)
+
+
+def test_warmup_hook_runs_at_load():
+    """__kt_warmup__ runs in the rank subprocess at eager load — the first
+    real request already sees the warmed state (inference warm pools)."""
+    async def body(client, state):
+        set_fn_metadata("Warmable")
+        r = await client.post("/Warmable/was_warmed",
+                              json={"args": [], "kwargs": {}})
+        assert r.status == 200, await r.text()
+        assert json.loads(await r.read()) is True
     run_server_test(body)
 
 

@@ -43,19 +43,53 @@ def init_train_state(params: Any, optimizer=None) -> TrainState:
 
 def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
                     rules: Optional[ShardingRules] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, accum_steps: int = 1) -> Callable:
     """Build ``step(state, batch) -> (state, metrics)``, jit-sharded on ``mesh``.
 
     ``loss_fn(params, tokens, targets) -> scalar``. When ``mesh`` is given the
     returned step carries in/out shardings derived from ``rules`` so the first
     call lays out HBM correctly; without a mesh it is a plain jit.
+
+    ``accum_steps > 1`` runs gradient accumulation: the batch's leading dim is
+    split into that many microbatches, fwd+bwd runs per microbatch inside a
+    ``lax.scan`` (peak activation memory is one microbatch's), grads are
+    averaged, and ONE optimizer update applies — numerically the full-batch
+    step for mean-reduced losses, at a fraction of the memory.
     """
     optimizer = optimizer or default_optimizer()
     if mesh is not None and rules is None:
         raise ValueError("make_train_step: a mesh requires sharding `rules`")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def loss_and_grads(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch["tokens"],
+                                               batch["targets"])
+        b = batch["tokens"].shape[0]
+        if b % accum_steps:
+            raise ValueError(f"batch={b} not divisible by "
+                             f"accum_steps={accum_steps}")
+        micro = {k: v.reshape(accum_steps, b // accum_steps, *v.shape[1:])
+                 for k, v in batch.items()}
+
+        def body(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb["tokens"],
+                                                      mb["targets"])
+            grad_sum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grad_sum, grads)
+            return (loss_sum + loss, grad_sum), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32), zeros), micro)
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, jax.tree_util.tree_map(
+            lambda g: (g * inv), grad_sum)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch["tokens"], batch["targets"])
+        loss, grads = loss_and_grads(state.params, batch)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)

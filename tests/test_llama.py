@@ -156,3 +156,50 @@ def test_chunked_loss_matches_full(params):
     np.testing.assert_allclose(
         np.asarray(g_chunk["layers"]["wq"]), np.asarray(g_full["layers"]["wq"]),
         rtol=1e-4, atol=1e-5)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=2 must produce the same update as the full-batch step
+    (mean-reduced CE: average of equal-size microbatch grads == full grad)."""
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    opt = optax.adam(1e-2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    loss = lambda p, t, y: llama_loss(p, t, y, cfg)  # noqa: E731
+
+    # separate inits: the step donates its input state's buffers
+    full = make_train_step(loss, optimizer=opt)(
+        init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt), batch)
+    accum = make_train_step(loss, optimizer=opt, accum_steps=2)(
+        init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt), batch)
+
+    np.testing.assert_allclose(float(accum[1]["loss"]), float(full[1]["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(accum[0].params["layers"]["wq"]),
+                               np.asarray(full[0].params["layers"]["wq"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_validation():
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg),
+                        accum_steps=0)
+    step = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg),
+                           optimizer=optax.adam(1e-3), accum_steps=3)
+    state = init_train_state(llama_init(jax.random.PRNGKey(0), cfg),
+                             optax.adam(1e-3))
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    with pytest.raises(ValueError, match="divisible"):
+        step(state, {"tokens": tokens, "targets": tokens})

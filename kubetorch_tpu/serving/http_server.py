@@ -68,6 +68,8 @@ class ServerState:
         self.termination_reason: Optional[str] = None
         self.supervisor = None
         self._supervisor_key: Optional[str] = None
+        self._prewarm_task: Optional[asyncio.Task] = None
+        self._prewarm_error: Optional[str] = None
         self._load_lock = asyncio.Lock()
         self.started_at = time.time()
         self.request_count = 0
@@ -116,10 +118,14 @@ class ServerState:
 
     async def get_supervisor(self):
         """Config-hash-keyed supervisor (reference load_supervisor :971)."""
-        key = self._config_key()
-        if self.supervisor is not None and key == self._supervisor_key:
+        if (self.supervisor is not None
+                and self._config_key() == self._supervisor_key):
             return self.supervisor
         async with self._load_lock:
+            # recompute INSIDE the lock: a reload may have changed the env
+            # while we waited, and building from new env under a stale key
+            # would force an immediate tear-down/rebuild of warming workers
+            key = self._config_key()
             if self.supervisor is not None and key == self._supervisor_key:
                 return self.supervisor
             if self.supervisor is not None:
@@ -172,6 +178,31 @@ class ServerState:
                         sys.modules.pop(name, None)
             self.launch_id = launch_id
             os.environ[KT_LAUNCH_ID] = launch_id
+        # open the load+warmup window NOW (readiness gates on it) instead of
+        # on the first request — otherwise the warmup hook defers to exactly
+        # the request it was supposed to pre-pay
+        self.prewarm_supervisor()
+
+    def prewarm_supervisor(self) -> None:
+        """Fire-and-forget supervisor creation so rank workers start their
+        eager load + ``__kt_warmup__`` immediately and ``/ready`` can observe
+        the warming window. A failure is recorded for ``/ready`` (a pod that
+        cannot build its supervisor must not join the endpoint pool) and the
+        same error resurfaces, typed, on the first direct call — which also
+        retries the build."""
+        if self.pointers() is None:
+            return
+
+        async def _go():
+            try:
+                await self.get_supervisor()
+                self._prewarm_error = None
+            except Exception as e:  # noqa: BLE001
+                self._prewarm_error = f"{type(e).__name__}: {e}"
+                print(f"[kt] supervisor prewarm failed (will retry on first "
+                      f"call): {e}")
+
+        self._prewarm_task = asyncio.create_task(_go())
 
     async def _sync_code(self) -> None:
         """Pull latest code from the data store (reference rsync pull :1140).
@@ -271,11 +302,25 @@ async def ready(request: web.Request) -> web.Response:
         return web.json_response(
             {"ready": False, "launch_id": state.launch_id, "expected": want},
             status=409)
-    sup = state.supervisor
-    if sup is not None and getattr(sup, "warming", False):
+    # the whole load+warmup window: supervisor being built (prewarm task in
+    # flight), rank workers still warming, or a rank that DIED during warmup
+    # (a pod that can never serve must not report ready)
+    task = state._prewarm_task
+    if task is not None and not task.done():
+        return web.json_response(
+            {"ready": False, "launch_id": state.launch_id, "warming": True},
+            status=503)
+    if state._prewarm_error is not None and state.supervisor is None:
         return web.json_response(
             {"ready": False, "launch_id": state.launch_id,
-             "warming": True}, status=503)
+             "error": state._prewarm_error}, status=503)
+    sup = state.supervisor
+    if sup is not None and (getattr(sup, "warming", False)
+                            or not getattr(sup, "healthy", True)):
+        return web.json_response(
+            {"ready": False, "launch_id": state.launch_id,
+             "warming": bool(getattr(sup, "warming", False)),
+             "healthy": bool(getattr(sup, "healthy", True))}, status=503)
     return web.json_response({"ready": True, "launch_id": state.launch_id})
 
 async def metrics(request: web.Request) -> web.Response:
@@ -447,6 +492,10 @@ async def _on_startup(app: web.Application) -> None:
         state.controller_ws = ControllerWebSocket(ws_url, state)
         await state.controller_ws.start()
 
+    # env-driven metadata (BYO pods, `kt server start`): open the load+warmup
+    # window now so /ready gates on it; WS-driven pods prewarm from reload()
+    state.prewarm_supervisor()
+
 
 def _termination_reason() -> str:
     """Classify why we are being killed (reference serving/utils.py:111-191).
@@ -465,6 +514,14 @@ async def _on_cleanup(app: web.Application) -> None:
     state: ServerState = app["state"]
     if state.controller_ws is not None:
         await state.controller_ws.stop()
+    # a prewarm in flight is building a supervisor (spawning TPU-holding
+    # workers): wait for it, so the cleanup below actually reaches that pool
+    # instead of orphaning mid-compile subprocesses
+    if state._prewarm_task is not None and not state._prewarm_task.done():
+        try:
+            await state._prewarm_task
+        except Exception:
+            pass
     if state.supervisor is not None:
         await asyncio.to_thread(state.supervisor.cleanup)
     if state.metrics_pusher is not None:

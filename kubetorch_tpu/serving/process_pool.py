@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import threading
+import time
 import queue as queue_mod
 from typing import Any, Dict, List, Optional
 
@@ -132,10 +134,37 @@ class ProcessPool:
                     lambda f=fut: (not f.done()) and f.set_exception(exc))
 
     def shutdown(self) -> None:
-        self._stopping.set()
+        """Stop every worker: shutdown ops go out to ALL workers first, one
+        shared join deadline covers them together (not per-worker serially),
+        and the response routers stay alive until the end so a worker's
+        ``warmup: done`` state op can still flip ``in_warmup`` mid-wait —
+        the flag that decides whether SIGKILL escalation is allowed (a jit
+        compile in flight must never be force-killed while it holds the
+        TPU). Workers still warming get one shared KT_WARMUP_SHUTDOWN_GRACE
+        window (default 600s) before the last-resort kill."""
         self.cancel_pending(RuntimeError("ProcessPool shutting down"))
         for w in self.workers:
-            w.shutdown()
+            w.request_shutdown()
+
+        def join_all(deadline: float) -> bool:
+            while any(w.alive for w in self.workers):
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.1)
+            return True
+
+        done = join_all(time.monotonic() + 5.0)
+        if not done and any(w.alive and w.in_warmup for w in self.workers):
+            grace = float(os.environ.get("KT_WARMUP_SHUTDOWN_GRACE", "600"))
+            deadline = time.monotonic() + grace
+            while (time.monotonic() < deadline
+                   and any(w.alive and w.in_warmup for w in self.workers)):
+                time.sleep(1.0)
+            # stragglers past warmup get the normal short window
+            join_all(time.monotonic() + 5.0)
+        self._stopping.set()
+        for w in self.workers:
+            w.force_kill_if_alive()
 
     @property
     def healthy(self) -> bool:

@@ -247,29 +247,23 @@ class ProcessWorker:
     def submit(self, req: Dict) -> None:
         self.request_q.put(req)
 
-    def shutdown(self, timeout: float = 5.0) -> None:
-        """Graceful stop; escalates to SIGKILL only when the worker is
-        neither exiting nor warming up. While ``in_warmup`` (set by the
-        pool's response router from the worker's state ops) the worker is
-        likely inside a jit compile — force-killing a process mid-compile
-        while it holds the TPU can wedge the runtime for every successor, so
-        warmup gets a long grace window (KT_WARMUP_SHUTDOWN_GRACE seconds,
-        default 600) before the last-resort kill."""
+    def request_shutdown(self) -> None:
+        """Enqueue the graceful-stop op (non-blocking). The worker handles it
+        after finishing any in-flight load/warmup."""
         try:
             self.request_q.put({"op": "shutdown"})
         except Exception:
             pass
-        self.process.join(timeout)
-        grace = float(os.environ.get("KT_WARMUP_SHUTDOWN_GRACE", "600"))
-        waited = 0.0
-        while self.process.is_alive() and self.in_warmup and waited < grace:
-            self.process.join(10.0)
-            waited += 10.0
+
+    def force_kill_if_alive(self) -> None:
+        """Last-resort SIGKILL. Callers (ProcessPool.shutdown) must have
+        already granted the warmup grace — a process killed mid-jit-compile
+        while holding the TPU can wedge the runtime for every successor."""
         if self.process.is_alive():
             from ..utils.procs import kill_process_tree
             if self.in_warmup:
-                print(f"[kt] rank {self.rank_info.rank} still in warmup "
-                      f"after {grace:.0f}s grace; force-killing")
+                print(f"[kt] rank {self.rank_info.rank} still in warmup at "
+                      "kill escalation; TPU runtime may need a reset")
             kill_process_tree(self.process.pid)
 
     @property

@@ -20,6 +20,15 @@ from kubetorch_tpu.config import reset_config
 import payloads  # tests/assets
 
 
+@pytest.fixture(autouse=True)
+def fresh_payloads_module():
+    """Other test files' reload paths purge user modules from sys.modules
+    (the server's module-eviction on hot reload); pointer extraction resolves
+    classes via sys.modules[cls.__module__], so re-register ours."""
+    sys.modules.setdefault("payloads", payloads)
+    yield
+
+
 @pytest.fixture(scope="module", autouse=True)
 def local_stack():
     from kubetorch_tpu.client import _read_running_local

@@ -133,6 +133,31 @@ def test_warmup_hook_runs_at_load():
     run_server_test(body)
 
 
+def test_reload_prewarms_before_ready():
+    """reload() opens the load+warmup window immediately: /ready flips to 200
+    only after the rank worker finished __kt_warmup__, so the first request
+    after readiness is already warm."""
+    async def body(client, state):
+        set_fn_metadata("Warmable")
+        await state.reload({}, launch_id="warm-1")
+        # poll /ready: must eventually be 200 with the prewarm task finished
+        import time as _t
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            r = await client.get("/ready", params={"launch_id": "warm-1"})
+            if r.status == 200:
+                break
+            assert r.status == 503  # warming window reported, never a 500
+            await asyncio.sleep(0.2)
+        assert r.status == 200, await r.text()
+        # the supervisor already exists (prewarmed) and the worker is warm
+        assert state.supervisor is not None
+        r = await client.post("/Warmable/was_warmed",
+                              json={"args": [], "kwargs": {}})
+        assert json.loads(await r.read()) is True
+    run_server_test(body)
+
+
 def test_array_payload_roundtrip():
     async def body(client, state):
         set_fn_metadata("summer")
@@ -194,7 +219,16 @@ def test_reload_swaps_callable(tmp_path):
             "launch_id": "launch-2",
         })
         assert r.status == 200, await r.text()
-        r = await client.get("/ready", params={"launch_id": "launch-2"})
+        # /ready flips to 200 once the prewarmed worker finishes its
+        # load+warmup window (503 while warming)
+        import time as _t
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            r = await client.get("/ready", params={"launch_id": "launch-2"})
+            if r.status == 200:
+                break
+            assert r.status == 503
+            await asyncio.sleep(0.2)
         assert r.status == 200
         r = await client.post("/whoami", json={"args": [], "kwargs": {}})
         out = json.loads(await r.read())

@@ -190,7 +190,14 @@ class ServerState:
         cannot build its supervisor must not join the endpoint pool) and the
         same error resurfaces, typed, on the first direct call — which also
         retries the build."""
+        # a new config supersedes any previous prewarm outcome — a stale
+        # error must not keep /ready at 503 for a config it doesn't describe
+        self._prewarm_error = None
         if self.pointers() is None:
+            # drop a finished task's handle; an in-flight one stays tracked
+            # so cleanup still awaits it
+            if self._prewarm_task is not None and self._prewarm_task.done():
+                self._prewarm_task = None
             return
 
         async def _go():
@@ -518,10 +525,9 @@ async def _on_cleanup(app: web.Application) -> None:
     # workers): wait for it, so the cleanup below actually reaches that pool
     # instead of orphaning mid-compile subprocesses
     if state._prewarm_task is not None and not state._prewarm_task.done():
-        try:
-            await state._prewarm_task
-        except Exception:
-            pass
+        # gather(return_exceptions) also absorbs CancelledError: even a
+        # cancelled shutdown must fall through to supervisor.cleanup()
+        await asyncio.gather(state._prewarm_task, return_exceptions=True)
     if state.supervisor is not None:
         await asyncio.to_thread(state.supervisor.cleanup)
     if state.metrics_pusher is not None:

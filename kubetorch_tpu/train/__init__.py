@@ -1,5 +1,23 @@
-"""Training-step construction: optimizer, sharded jit, grad accumulation."""
+"""Training loop toolkit: sharded train step, grad accumulation, checkpoint
+save/restore (sync + async, reshard-on-restore), host→device prefetch."""
 
 from .train_step import TrainState, make_train_step, init_train_state
 
-__all__ = ["TrainState", "make_train_step", "init_train_state"]
+# checkpoint pulls in the data-store client stack; keep it lazy (PEP 562) so
+# importing the train step stays light.
+_LAZY = {
+    "save_state": "checkpoint", "async_save_state": "checkpoint",
+    "restore_state": "checkpoint", "local_save": "checkpoint",
+    "local_restore": "checkpoint", "prefetch_to_device": "data",
+}
+
+__all__ = ["TrainState", "make_train_step", "init_train_state",
+           *sorted(_LAZY)]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -13,16 +13,34 @@ store), Orbax handles the filesystem layout.
 
 from __future__ import annotations
 
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
 
 from ..data_store import commands as ds
 from .train_step import TrainState
+
+# One IO thread: overlapping saves serialize instead of racing the store,
+# and a training loop can fire-and-forget every N steps.
+_CKPT_EXECUTOR = ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="kt-ckpt")
 
 
 def save_state(key: str, state: TrainState, store_url: Optional[str] = None) -> dict:
     tree = {"params": state.params, "opt_state": _jsonable_opt(state.opt_state),
             "step": state.step}
     return ds.put(key, tree, store_url=store_url)
+
+
+def async_save_state(key: str, state: TrainState,
+                     store_url: Optional[str] = None) -> "Future[dict]":
+    """Non-blocking checkpoint: the device→host snapshot happens NOW (so the
+    training loop may donate/overwrite the live state immediately), the store
+    IO happens on a background thread. Returns a Future — ``.result()``
+    confirms durability before e.g. preemption-exit."""
+    import jax
+
+    host_state = jax.tree_util.tree_map(lambda x: jax.device_get(x), state)
+    return _CKPT_EXECUTOR.submit(save_state, key, host_state, store_url)
 
 
 def restore_state(key: str, like: TrainState, store_url: Optional[str] = None,

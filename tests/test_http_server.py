@@ -43,6 +43,20 @@ def set_fn_metadata(fn_name: str, init_args=None):
         os.environ[KT_INIT_ARGS] = json.dumps(init_args)
 
 
+async def wait_ready(client, launch_id: str, timeout: float = 60.0):
+    """Poll /ready until 200 (503 = still in the load+warmup window)."""
+    import time as _t
+
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        r = await client.get("/ready", params={"launch_id": launch_id})
+        if r.status == 200:
+            return r
+        assert r.status == 503, await r.text()
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"/ready never reached 200 for {launch_id}")
+
+
 def run_server_test(coro_fn):
     async def runner():
         state = ServerState()
@@ -140,16 +154,7 @@ def test_reload_prewarms_before_ready():
     async def body(client, state):
         set_fn_metadata("Warmable")
         await state.reload({}, launch_id="warm-1")
-        # poll /ready: must eventually be 200 with the prewarm task finished
-        import time as _t
-        deadline = _t.time() + 60
-        while _t.time() < deadline:
-            r = await client.get("/ready", params={"launch_id": "warm-1"})
-            if r.status == 200:
-                break
-            assert r.status == 503  # warming window reported, never a 500
-            await asyncio.sleep(0.2)
-        assert r.status == 200, await r.text()
+        await wait_ready(client, "warm-1")
         # the supervisor already exists (prewarmed) and the worker is warm
         assert state.supervisor is not None
         r = await client.post("/Warmable/was_warmed",
@@ -221,15 +226,7 @@ def test_reload_swaps_callable(tmp_path):
         assert r.status == 200, await r.text()
         # /ready flips to 200 once the prewarmed worker finishes its
         # load+warmup window (503 while warming)
-        import time as _t
-        deadline = _t.time() + 60
-        while _t.time() < deadline:
-            r = await client.get("/ready", params={"launch_id": "launch-2"})
-            if r.status == 200:
-                break
-            assert r.status == 503
-            await asyncio.sleep(0.2)
-        assert r.status == 200
+        await wait_ready(client, "launch-2")
         r = await client.post("/whoami", json={"args": [], "kwargs": {}})
         out = json.loads(await r.read())
         assert out["world_size"] == "1"

@@ -38,44 +38,43 @@ def train(num_steps: int = 20, microbatches: int = 4):
     import optax
 
     from kubetorch_tpu.models.llama import LlamaConfig, llama_init
-    from kubetorch_tpu.parallel.pipeline import (llama_loss_pipelined,
-                                                 llama_pipeline_shardings)
+    from kubetorch_tpu.parallel.pipeline import (PIPE_LLAMA_RULES,
+                                                 llama_loss_pipelined)
+    from kubetorch_tpu.train import init_train_state, make_train_step
 
     mesh = kt.distributed.mesh()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    dp = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    # batch divides over every batch-like axis (incl. dcn on multi-slice)
+    dp = sizes.get("dcn", 1) * sizes.get("data", 1) * sizes.get("fsdp", 1)
 
     cfg = LlamaConfig.llama3_8b() if jax.default_backend() == "tpu" else \
         LlamaConfig.tiny(n_layers=4, attn_impl="xla", dtype=jnp.float32,
                          remat=False)
-    params = llama_init(jax.random.PRNGKey(0), cfg)
-    sharded = jax.tree_util.tree_map(
-        jax.device_put, params, llama_pipeline_shardings(params, mesh))
-
     opt = optax.adamw(3e-4)
-    opt_state = opt.init(sharded)
-
-    @jax.jit
-    def step(p, o, tokens, targets):
-        loss, grads = jax.value_and_grad(
-            lambda q: llama_loss_pipelined(q, tokens, targets, cfg, mesh,
-                                           n_microbatches=microbatches))(p)
-        updates, o = opt.update(grads, o, p)
-        return optax.apply_updates(p, updates), o, loss
+    # PIPE_LLAMA_RULES gives make_train_step the pipeline layout: donation,
+    # pinned output shardings, shard_state — no hand-rolled step needed
+    step = make_train_step(
+        lambda p, t, y: llama_loss_pipelined(p, t, y, cfg, mesh,
+                                             n_microbatches=microbatches),
+        optimizer=opt, mesh=mesh, rules=PIPE_LLAMA_RULES)
+    state = step.shard_state(
+        init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt))
 
     batch = microbatches * dp
     seq = min(cfg.max_seq_len, 4096 if jax.default_backend() == "tpu" else 32)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                                 cfg.vocab_size)
-    targets = jnp.roll(tokens, -1, 1)
+    data = {"tokens": jax.device_put(tokens, step.batch_sharding),
+            "targets": jax.device_put(jnp.roll(tokens, -1, 1),
+                                      step.batch_sharding)}
 
     losses = []
     t0 = time.time()
     for _ in range(num_steps):
-        sharded, opt_state, loss = step(sharded, opt_state, tokens, targets)
-    losses.append(float(loss))
+        state, metrics = step(state, data)
+        losses.append(float(metrics["loss"]))
     dt = time.time() - t0
-    return {"loss": losses[-1], "steps": num_steps,
+    return {"loss": losses[-1] if losses else None, "steps": num_steps,
             "tokens_per_sec": batch * seq * num_steps / dt,
             "mesh": {k: v for k, v in sizes.items() if v > 1}}
 

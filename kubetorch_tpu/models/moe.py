@@ -104,10 +104,21 @@ def moe_init(rng: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
     }
 
 
-def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
+def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
+            ep_axis=None, tp_axis=None):
     """Top-k MoE with capacity-bounded one-hot dispatch.
 
     x: (B, S, D) → (B, S, D), plus scalar aux loss for load balancing.
+
+    Outside shard_map (default) the einsums carry full expert-stacked
+    weights and GSPMD inserts the expert all-to-alls from ``MOE_RULES``.
+    Inside shard_map (pipeline stages) pass ``ep_axis``/``tp_axis``:
+    activations are replicated over the expert axis there, so each rank
+    computes the (cheap) routing for all tokens, slices the dispatch/combine
+    tensors down to its LOCAL experts, runs only those experts' FFNs (the
+    FLOPs), and one psum over (expert, tensor) reassembles the output — no
+    all-to-all needed in this layout. Expert counts come from the local
+    weight shapes so the same body serves both paths.
     """
     b, s, d = x.shape
     E, K = cfg.n_experts, cfg.experts_per_token
@@ -143,6 +154,14 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
                       (expert_onehot * keep[..., None]).astype(x.dtype),
                       cap_onehot)
 
+    if ep_axis is not None:
+        # slice dispatch/combine down to this rank's local experts BEFORE
+        # the expensive routing einsums
+        e_local = lw["experts"]["w_gate"].shape[0]
+        start = lax.axis_index(ep_axis) * e_local
+        disp = lax.dynamic_slice_in_dim(disp, start, e_local, axis=2)
+        comb = lax.dynamic_slice_in_dim(comb, start, e_local, axis=2)
+
     # route tokens to expert buffers: (E, B, C, D)
     expert_in = jnp.einsum("bsec,bsd->ebcd", disp, x)
     # batched expert SwiGLU over the E axis (sharded over "expert")
@@ -150,21 +169,32 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
         * jnp.einsum("ebcd,edf->ebcf", expert_in, lw["experts"]["w_up"])
     expert_out = jnp.einsum("ebcf,efd->ebcd", h, lw["experts"]["w_down"])
     out = jnp.einsum("bsec,ebcd->bsd", comb, expert_out)
+    reduce = tuple(a for a in (ep_axis, tp_axis) if a is not None)
+    if reduce:
+        out = lax.psum(out, reduce)
     return out, aux
 
 
-def _moe_layer(cfg: MoeConfig, carry, lw: Dict[str, jax.Array], freqs):
+def _moe_layer(cfg: MoeConfig, carry, lw: Dict[str, jax.Array], freqs,
+               tp_axis=None, ep_axis=None):
+    """One MoE decoder layer; with tp/ep axes set it is the shard_map-safe
+    variant (head counts from local shapes, explicit psums) mirroring
+    ``llama._layer``."""
     x, aux_sum = carry
     b, s, d = x.shape
+    hd = cfg.head_dim
+    nh = lw["wq"].shape[-1] // hd
+    nkv = lw["wk"].shape[-1] // hd
+    psum = (lambda y: lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
     lcfg = cfg._llama_view()
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
-    q = (h @ lw["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ lw["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ lw["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = (h @ lw["wq"]).reshape(b, s, nh, hd)
+    k = (h @ lw["wk"]).reshape(b, s, nkv, hd)
+    v = (h @ lw["wv"]).reshape(b, s, nkv, hd)
     q, k = apply_rope(q, freqs), apply_rope(k, freqs)
-    x = x + attention(q, k, v, lcfg).reshape(b, s, -1) @ lw["wo"]
+    x = x + psum(attention(q, k, v, lcfg).reshape(b, s, -1) @ lw["wo"])
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
-    ffn_out, aux = moe_ffn(cfg, h, lw)
+    ffn_out, aux = moe_ffn(cfg, h, lw, ep_axis=ep_axis, tp_axis=tp_axis)
     return (x + ffn_out, aux_sum + aux)
 
 

@@ -18,7 +18,10 @@ from .sharding import (ShardingRules, LLAMA_RULES, MOE_RULES, VIT_RULES,
 # pipeline exports resolve lazily (PEP 562).
 _PIPELINE_EXPORTS = ("gpipe", "llama_forward_pipelined",
                      "llama_loss_pipelined", "llama_pipeline_shardings",
-                     "llama_pipeline_specs", "PIPE_LLAMA_RULES")
+                     "llama_pipeline_specs", "PIPE_LLAMA_RULES",
+                     "moe_forward_pipelined", "moe_loss_pipelined",
+                     "moe_pipeline_shardings", "moe_pipeline_specs",
+                     "PIPE_MOE_RULES")
 
 __all__ = [
     "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES", "MOE_RULES",

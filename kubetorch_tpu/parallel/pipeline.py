@@ -24,8 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .mesh import (AXIS_CONTEXT, AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR,
-                   live_axes as _live_axes)
+from .mesh import (AXIS_CONTEXT, AXIS_EXPERT, AXIS_FSDP, AXIS_PIPE,
+                   AXIS_TENSOR, live_axes as _live_axes)
 from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, ShardingRules)
 
 
@@ -37,13 +37,20 @@ def _shard_map():
 
 
 def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
-          n_microbatches: int, in_specs, params_specs, out_specs=None):
+          n_microbatches: int, in_specs, params_specs, out_specs=None,
+          stage_aux: bool = False):
     """Build a pipelined ``f(stage_params, x) -> y`` over ``mesh[axis]``.
 
     ``stage_fn(stage_params, x) -> y`` consumes one stage's params (the
     layer-dim shard) and one microbatch activation, both local. ``x`` is
     globally (M*mb, ...) — reshaped to microbatches internally. The result is
     replicated across the pipe axis.
+
+    With ``stage_aux=True``, ``stage_fn`` returns ``(y, aux_scalar)`` and the
+    pipelined function returns ``(y, aux_sum)``: the fp32 scalar summed over
+    every REAL (stage, microbatch) execution — bubble ticks (a stage running
+    garbage before/after its window) are masked out — then psummed over the
+    pipe axis. Used for MoE router load-balancing losses.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -58,41 +65,63 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
             xs = x_local.reshape(M, x_local.shape[0] // M, *x_local.shape[1:])
 
             def timestep(carry, t):
-                recv, outputs = carry
+                recv, outputs, aux_acc = carry
                 mb = t - p                       # my microbatch at this tick
+                in_window = (mb >= 0) & (mb < M)
                 # stage 0 pulls fresh input; later stages consume the wire
                 fresh = lax.dynamic_index_in_dim(
                     xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
                 inp = jnp.where(p == 0, fresh, recv)
-                out = stage_fn(local_params, inp)
+                if stage_aux:
+                    out, aux = stage_fn(local_params, inp)
+                    # bubble ticks run garbage; only real executions count
+                    aux_acc = aux_acc + jnp.where(
+                        in_window, aux.astype(jnp.float32), 0.0)
+                else:
+                    out = stage_fn(local_params, inp)
                 # rotate outputs one stage forward (ring; the wrap-around
                 # value into stage 0 is ignored by the `where` above)
                 send = lax.ppermute(
                     out, axis,
                     [(i, (i + 1) % n_stages) for i in range(n_stages)])
                 # last stage records finished microbatch `mb` when valid
-                valid = (p == n_stages - 1) & (mb >= 0) & (mb < M)
+                valid = (p == n_stages - 1) & in_window
                 idx = jnp.clip(mb, 0, M - 1)
                 current = lax.dynamic_index_in_dim(outputs, idx, 0,
                                                    keepdims=False)
                 outputs = lax.dynamic_update_index_in_dim(
                     outputs, jnp.where(valid, out, current), idx, 0)
-                return (send, outputs), None
+                return (send, outputs, aux_acc), None
 
             init = (jnp.zeros_like(xs[0]),
-                    jnp.zeros((M, *xs.shape[1:]), xs.dtype))
-            (_, outputs), _ = lax.scan(timestep, init,
-                                       jnp.arange(M + n_stages - 1))
+                    jnp.zeros((M, *xs.shape[1:]), xs.dtype),
+                    jnp.zeros((), jnp.float32))
+            (_, outputs, aux_acc), _ = lax.scan(timestep, init,
+                                                jnp.arange(M + n_stages - 1))
             # only the last stage holds real outputs; replicate via psum
             outputs = lax.psum(
                 jnp.where(p == n_stages - 1, outputs,
                           jnp.zeros_like(outputs)), axis)
-            return outputs.reshape(x_local.shape)
+            outputs = outputs.reshape(x_local.shape)
+            if stage_aux:
+                # sum over stages (pipe), average over axes that see
+                # different data (batch shards, sequence shards); replicated
+                # axes (tensor/expert) compute identical aux already
+                aux = lax.psum(aux_acc, axis)
+                reduce_axes = tuple(a for a in (*_BATCH_AXES, AXIS_CONTEXT)
+                                    if a in _live_axes(mesh))
+                if reduce_axes:
+                    aux = lax.pmean(aux, reduce_axes)
+                return outputs, aux
+            return outputs
 
+        specs_out = out_specs if out_specs is not None else in_specs
+        if stage_aux:
+            specs_out = (specs_out, P())
         return smap(per_device, mesh=mesh,
                     in_specs=(params_specs, in_specs),
                     # NOT `or`: an empty PartitionSpec (replicated) is falsy
-                    out_specs=out_specs if out_specs is not None else in_specs,
+                    out_specs=specs_out,
                     check_vma=False)(stage_params, x)
 
     return pipelined
@@ -123,6 +152,98 @@ PIPE_LLAMA_RULES = ShardingRules(rules=[
 _PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES, AXIS_CONTEXT))])
 
 
+def _resolve_stage_attn(cfg, live, tp: int, seq_len: int):
+    """Resolve ``cfg.attn_impl`` for use INSIDE a pipeline stage's shard_map.
+
+    With a live context axis, attention MUST be context-parallel (a local-
+    chunk flash/xla would silently attend over 1/cp of the sequence): ulysses
+    when requested, the ring otherwise — via the ``*_local`` already-inside-
+    shard_map dispatches. Without one, ring/ulysses are rejected and "auto"
+    resolves to flash (TPU) / xla, since "auto" consults the ambient mesh
+    context which must not route to a nested shard_map. Works for any config
+    dataclass carrying attn_impl/n_heads/n_kv_heads (Llama, MoE, ...).
+    """
+    import dataclasses as _dc
+
+    if cfg.attn_impl not in ("auto", "xla", "flash", "ring", "ulysses"):
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}; expected "
+                         "auto|xla|flash|ring|ulysses")
+    cp = live.get("context", 1)
+    if cp > 1:
+        if seq_len % cp:
+            raise ValueError(f"seq_len={seq_len} not divisible by "
+                             f"context={cp}")
+        if cfg.attn_impl == "ulysses":
+            # ulysses scatters the LOCAL (post-tp) heads over the context axis
+            if (cfg.n_heads // tp) % cp or (cfg.n_kv_heads // tp) % cp:
+                raise ValueError(
+                    f"ulysses needs context={cp} to divide the per-tensor-"
+                    f"shard head counts {cfg.n_heads}/{tp} and "
+                    f"{cfg.n_kv_heads}/{tp}; use ring attention instead")
+            return _dc.replace(cfg, attn_impl="ulysses_local")
+        return _dc.replace(cfg, attn_impl="ring_local")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} in a pipeline needs a live "
+            "context axis (mesh context size > 1); use xla/flash otherwise")
+    if cfg.attn_impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        return _dc.replace(cfg, attn_impl=impl)
+    return cfg
+
+
+def _validate_pipe_batch(batch: int, live, n_microbatches: int) -> None:
+    dp = 1
+    for a in _BATCH_AXES:
+        dp *= live.get(a, 1)
+    local_batch = batch // dp
+    if batch % dp or local_batch % n_microbatches:
+        raise ValueError(
+            f"batch={batch} must divide over dp={dp} into local "
+            f"batches divisible by microbatches={n_microbatches}")
+
+
+def _make_zero3_gather(layer_specs, fsdp: int):
+    """Build the in-stage ZeRO-3 gather for one layer's (scan-stripped) param
+    tree: each fsdp-sharded leaf is all-gathered on the dim the rule table
+    puts "fsdp" at (minus the stripped pipe dim). Under the remat wrapper the
+    gathered copies are recomputed in backward, where the gather's transpose
+    reduce-scatters the weight grads back over fsdp. One implementation for
+    every pipelined model family."""
+
+    def path_key(path):
+        return tuple(str(getattr(p, "key", p)) for p in path)
+
+    gather_dims = {path_key(path): list(spec).index("fsdp") - 1
+                   for path, spec in
+                   jax.tree_util.tree_leaves_with_path(layer_specs)
+                   if fsdp > 1 and "fsdp" in spec}
+
+    def gather_layer(lw):
+        if not gather_dims:
+            return lw
+
+        def gather(path, leaf):
+            dim = gather_dims.get(path_key(path))
+            if dim is None:
+                return leaf
+            return lax.all_gather(leaf, "fsdp", axis=dim, tiled=True)
+
+        return jax.tree_util.tree_map_with_path(gather, lw)
+
+    return gather_layer
+
+
+def _local_freqs(freqs, h, cp: int):
+    """RoPE positions are global; slice this context-rank's window of the
+    (S, Hd/2) table for its local sequence chunk."""
+    if cp <= 1:
+        return freqs
+    s_local = h.shape[1]
+    return lax.dynamic_slice_in_dim(
+        freqs, lax.axis_index("context") * s_local, s_local, axis=0)
+
+
 def llama_pipeline_specs(params, mesh):
     """PartitionSpec pytree placing a llama param tree per ``PIPE_LLAMA_RULES``."""
     return PIPE_LLAMA_RULES.tree_specs(params, mesh)
@@ -147,8 +268,6 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
     layer dim over ``pipe``, d_model dim over ``fsdp`` (ZeRO-3), Megatron
     dims over ``tensor``.
     """
-    import dataclasses as _dc
-
     from ..models.llama import _layer, rmsnorm, rope_freqs
 
     live = _live_axes(mesh)
@@ -163,79 +282,20 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
                          f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
     if fsdp > 1 and cfg.dim % fsdp:
         raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
-    if cfg.attn_impl not in ("auto", "xla", "flash", "ring", "ulysses"):
-        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}; expected "
-                         "auto|xla|flash|ring|ulysses")
+    cfg = _resolve_stage_attn(cfg, live, tp, tokens.shape[1])
     cp = live.get("context", 1)
-    if cp > 1:
-        # Sequence is sharded over the context axis, so attention inside the
-        # stage MUST be context-parallel (a local-chunk flash/xla would
-        # silently attend over 1/cp of the sequence): ulysses if requested,
-        # the ring otherwise. "*_local" = already-inside-shard_map dispatch.
-        if tokens.shape[1] % cp:
-            raise ValueError(f"seq_len={tokens.shape[1]} not divisible by "
-                             f"context={cp}")
-        if cfg.attn_impl == "ulysses":
-            # ulysses scatters the LOCAL (post-tp) heads over the context axis
-            if (cfg.n_heads // tp) % cp or (cfg.n_kv_heads // tp) % cp:
-                raise ValueError(
-                    f"ulysses needs context={cp} to divide the per-tensor-"
-                    f"shard head counts {cfg.n_heads}/{tp} and "
-                    f"{cfg.n_kv_heads}/{tp}; use ring attention instead")
-            cfg = _dc.replace(cfg, attn_impl="ulysses_local")
-        else:
-            cfg = _dc.replace(cfg, attn_impl="ring_local")
-    elif cfg.attn_impl in ("ring", "ulysses"):
-        raise ValueError(
-            f"attn_impl={cfg.attn_impl!r} in a pipeline needs a live "
-            "context axis (mesh context size > 1); use xla/flash otherwise")
-    elif cfg.attn_impl == "auto":
-        # resolve outside the shard_map: "auto" consults the mesh context,
-        # which must not route to ring/ulysses inside a stage
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
-        cfg = _dc.replace(cfg, attn_impl=impl)
-    dp = 1
-    for a in _BATCH_AXES:
-        dp *= live.get(a, 1)
     M = n_microbatches or n_stages
-    local_batch = tokens.shape[0] // dp
-    if tokens.shape[0] % dp or local_batch % M:
-        raise ValueError(
-            f"batch={tokens.shape[0]} must divide over dp={dp} into local "
-            f"batches divisible by microbatches={M}")
+    _validate_pipe_batch(tokens.shape[0], live, M)
 
     x = params["embed"][tokens].astype(cfg.dtype)
     freqs = rope_freqs(cfg, tokens.shape[1])
 
     tp_axis = "tensor" if tp > 1 else None
     layer_specs = llama_pipeline_specs(params, mesh)["layers"]
-    # Gather dim per leaf, derived from the rule table itself (position of
-    # "fsdp" in the live spec, minus the scan-stripped pipe dim) so the
-    # layout has exactly one source of truth.
-    gather_dims = {k: list(spec).index("fsdp") - 1
-                   for k, spec in layer_specs.items()
-                   if fsdp > 1 and "fsdp" in spec}
-
-    def gather_layer(lw):
-        """ZeRO-3 inside the stage: materialize ONE layer's full weights
-        from their fsdp shards. Under the remat wrapper the gathered copies
-        are recomputed in backward, where the gather's transpose
-        reduce-scatters the weight grads back over fsdp."""
-        if not gather_dims:
-            return lw
-        return {k: (lax.all_gather(v, "fsdp", axis=gather_dims[k], tiled=True)
-                    if k in gather_dims else v)
-                for k, v in lw.items()}
+    gather_layer = _make_zero3_gather(layer_specs, fsdp)
 
     def stage_fn(local_layers, h):
-        if cp > 1:
-            # RoPE positions are global: slice this context-rank's window of
-            # the (S, Hd/2) table for its local sequence chunk
-            s_local = h.shape[1]
-            fr = lax.dynamic_slice_in_dim(
-                freqs, lax.axis_index("context") * s_local, s_local, axis=0)
-        else:
-            fr = freqs
+        fr = _local_freqs(freqs, h, cp)
 
         def body(carry, lw):
             return _layer(cfg, carry, gather_layer(lw), fr,
@@ -257,5 +317,115 @@ def llama_loss_pipelined(params, tokens, targets, cfg, mesh, **kw):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# MoE integration: expert parallelism inside pipeline stages
+# ---------------------------------------------------------------------------
+
+# MoE layer stack on a pipe(+data/fsdp/expert/tensor) mesh: attention weights
+# as in the llama table; expert-stacked FFN weights additionally shard their
+# expert dim over "expert" (the stage body slices dispatch/combine to local
+# experts and psums the output — activations are replicated over the expert
+# axis in this layout, so no all-to-all is needed); router replicated (fp32,
+# tiny, and every rank routes identically).
+PIPE_MOE_RULES = ShardingRules(rules=[
+    (r"layers/(wq|wk|wv)$",            (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"layers/wo$",                    (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"layers/experts/w_(gate|up)$",
+     (AXIS_PIPE, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
+    (r"layers/experts/w_down$",
+     (AXIS_PIPE, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
+    (r"layers/router$",                (AXIS_PIPE,)),
+    (r"layers/.*norm$",                (AXIS_PIPE,)),
+] + LLAMA_RULES.rules)
+
+
+def moe_pipeline_specs(params, mesh):
+    return PIPE_MOE_RULES.tree_specs(params, mesh)
+
+
+def moe_pipeline_shardings(params, mesh):
+    """``NamedSharding`` pytree for an MoE param tree on a pipe mesh."""
+    return PIPE_MOE_RULES.tree_shardings(params, mesh)
+
+
+def moe_forward_pipelined(params, tokens, cfg, mesh, *,
+                          n_microbatches: Optional[int] = None):
+    """MoE forward with layers pipelined over ``pipe``, experts sharded over
+    ``expert`` INSIDE each stage, composing with data/fsdp/tensor exactly as
+    :func:`llama_forward_pipelined`. Returns ``(logits, aux)`` where ``aux``
+    is the router load-balancing loss averaged over microbatches and layers
+    (bubble ticks masked by :func:`gpipe`'s ``stage_aux`` channel).
+
+    Note: ``aux`` is a product of batch means, so the microbatch average
+    differs from the sequential full-batch value at O(1/M) — the logits are
+    bit-comparable, the aux regularizer is statistically equivalent.
+    """
+    from ..models.llama import rmsnorm, rope_freqs
+    from ..models.moe import _moe_layer
+
+    live = _live_axes(mesh)
+    n_stages = live.get("pipe", 1)
+    tp = live.get("tensor", 1)
+    fsdp = live.get("fsdp", 1)
+    ep = live.get("expert", 1)
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pipe={n_stages}")
+    if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
+        raise ValueError(f"tensor={tp} must divide n_kv_heads="
+                         f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
+    if fsdp > 1 and cfg.dim % fsdp:
+        raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
+    if ep > 1 and cfg.n_experts % ep:
+        raise ValueError(f"expert={ep} must divide n_experts="
+                         f"{cfg.n_experts}")
+    if live.get("context", 1) > 1:
+        # in-stage MoE routing would assign expert capacity per local
+        # sequence chunk, silently diverging from the full-sequence GSPMD
+        # routing; sequence-chunked routing is round-2 work
+        raise ValueError(
+            "a context axis does not compose with MoE inside pipeline "
+            "stages yet; use ring/ulysses with the non-pipelined moe path")
+    cfg = _resolve_stage_attn(cfg, live, tp, tokens.shape[1])
+    cp = live.get("context", 1)
+    M = n_microbatches or n_stages
+    _validate_pipe_batch(tokens.shape[0], live, M)
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs = rope_freqs(cfg._llama_view(), tokens.shape[1])
+
+    tp_axis = "tensor" if tp > 1 else None
+    ep_axis = "expert" if ep > 1 else None
+    layer_specs = moe_pipeline_specs(params, mesh)["layers"]
+    gather_layer = _make_zero3_gather(layer_specs, fsdp)
+
+    def stage_fn(local_layers, h):
+        fr = _local_freqs(freqs, h, cp)
+
+        def body(carry, lw):
+            return _moe_layer(cfg, carry, gather_layer(lw), fr,
+                              tp_axis=tp_axis, ep_axis=ep_axis), None
+        body = jax.checkpoint(body)
+        (out, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                                 local_layers)
+        return out, aux
+
+    act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
+    run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
+                in_specs=act_spec, params_specs=layer_specs,
+                out_specs=act_spec, stage_aux=True)
+    x, aux = run(params["layers"], x)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux / (M * cfg.n_layers)
+
+
+def moe_loss_pipelined(params, tokens, targets, cfg, mesh, **kw):
+    logits, aux = moe_forward_pipelined(params, tokens, cfg, mesh, **kw)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.router_aux_weight * aux
 
 

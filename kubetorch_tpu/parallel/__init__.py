@@ -16,8 +16,10 @@ from .sharding import (ShardingRules, LLAMA_RULES, MOE_RULES, VIT_RULES,
 # pipeline.py imports jax at module top; the server/controller processes
 # import this package (via .mesh) pre-spawn and must stay jax-free, so the
 # pipeline exports resolve lazily (PEP 562).
-_PIPELINE_EXPORTS = ("gpipe", "llama_forward_pipelined",
-                     "llama_loss_pipelined", "llama_pipeline_shardings",
+_PIPELINE_EXPORTS = ("gpipe", "gpipe_interleaved",
+                     "llama_forward_pipelined",
+                     "llama_loss_pipelined", "llama_pipeline_place",
+                     "llama_pipeline_shardings",
                      "llama_pipeline_specs", "PIPE_LLAMA_RULES",
                      "moe_forward_pipelined", "moe_loss_pipelined",
                      "moe_pipeline_shardings", "moe_pipeline_specs",

@@ -127,6 +127,90 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
     return pipelined
 
 
+def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
+                      n_microbatches: int, n_virtual: int, in_specs,
+                      params_specs, out_specs=None):
+    """Interleaved (virtual-stage) pipeline schedule over ``mesh[axis]``.
+
+    Each device holds ``n_virtual`` layer CHUNKS instead of one contiguous
+    stage — global chunk ``c`` lives on device ``c mod P`` (local param
+    leaves carry a leading ``(V, 1, ...)`` chunk dim; the size-1 dim is the
+    sharded pipe dim of the host-side ``(V, P, ...)`` layout) — and every
+    activation loops the ring ``V`` times. Microbatches advance in blocks
+    of ``P``: at shifted time ``s = t - p`` device ``p`` runs virtual chunk
+    ``v = (s // P) mod V`` on microbatch ``(s // (P·V))·P + s % P``; the
+    ring wrap-around from the last device back to device 0 legitimately
+    carries loop ``v``'s output into loop ``v+1``. Total ticks =
+    ``M·V + P - 1``, so the bubble is ``P - 1`` ticks of 1/V-sized chunks —
+    V× smaller than GPipe at the same per-device layer count (Megatron's
+    interleaved schedule, expressed as one ``lax.scan``).
+
+    ``chunk_fn(chunk_params, x) -> y`` consumes ONE chunk's params (the V
+    dim already indexed out) and one microbatch activation. Requires
+    ``M % P == 0`` (microbatches advance in blocks of P).
+    """
+    smap = _shard_map()
+    P_size = _live_axes(mesh).get(axis, 1)
+    if n_microbatches % P_size:
+        raise ValueError(f"interleaved schedule needs microbatches="
+                         f"{n_microbatches} divisible by pipe={P_size}")
+
+    def pipelined(stage_params, x):
+        M, V = n_microbatches, n_virtual
+        ticks = M * V + P_size - 1
+
+        def per_device(local_params, x_local):
+            p = lax.axis_index(axis)
+            n_stages = lax.axis_size(axis)
+            xs = x_local.reshape(M, x_local.shape[0] // M, *x_local.shape[1:])
+            # (V, 1, ...) local leaves → (V, ...): drop the sharded pipe dim
+            chunks = jax.tree_util.tree_map(
+                lambda a: a.reshape(a.shape[0], *a.shape[2:]), local_params)
+
+            def timestep(carry, t):
+                recv, outputs = carry
+                s = t - p
+                k = s // n_stages                  # = block·V + v
+                v = k % V
+                mb = (k // V) * n_stages + s % n_stages
+                in_window = (s >= 0) & (s < M * V)
+                fresh = lax.dynamic_index_in_dim(
+                    xs, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False)
+                # device 0 at v==0 starts a fresh microbatch; everything
+                # else (incl. device 0 at v>0) consumes the wire
+                inp = jnp.where((p == 0) & (v == 0), fresh, recv)
+                chunk_params = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, jnp.clip(v, 0, V - 1), axis=0, keepdims=False),
+                    chunks)
+                out = chunk_fn(chunk_params, inp)
+                send = lax.ppermute(
+                    out, axis,
+                    [(i, (i + 1) % n_stages) for i in range(n_stages)])
+                valid = (p == n_stages - 1) & (v == V - 1) & in_window
+                idx = jnp.clip(mb, 0, M - 1)
+                current = lax.dynamic_index_in_dim(outputs, idx, 0,
+                                                   keepdims=False)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(valid, out, current), idx, 0)
+                return (send, outputs), None
+
+            init = (jnp.zeros_like(xs[0]),
+                    jnp.zeros((M, *xs.shape[1:]), xs.dtype))
+            (_, outputs), _ = lax.scan(timestep, init, jnp.arange(ticks))
+            outputs = lax.psum(
+                jnp.where(p == n_stages - 1, outputs,
+                          jnp.zeros_like(outputs)), axis)
+            return outputs.reshape(x_local.shape)
+
+        return smap(per_device, mesh=mesh,
+                    in_specs=(params_specs, in_specs),
+                    out_specs=out_specs if out_specs is not None else in_specs,
+                    check_vma=False)(stage_params, x)
+
+    return pipelined
+
+
 # ---------------------------------------------------------------------------
 # Llama integration
 # ---------------------------------------------------------------------------
@@ -192,6 +276,20 @@ def _resolve_stage_attn(cfg, live, tp: int, seq_len: int):
     return cfg
 
 
+def _validate_stage_divisibility(cfg, n_stages: int, tp: int, fsdp: int,
+                                 n_virtual: int = 1) -> None:
+    """Shared pipe/tensor/fsdp divisibility checks for pipelined models."""
+    if cfg.n_layers % (n_stages * n_virtual):
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}"
+            + (f" × virtual={n_virtual}" if n_virtual > 1 else ""))
+    if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
+        raise ValueError(f"tensor={tp} must divide n_kv_heads="
+                         f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
+    if fsdp > 1 and cfg.dim % fsdp:
+        raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
+
+
 def _validate_pipe_batch(batch: int, live, n_microbatches: int) -> None:
     dp = 1
     for a in _BATCH_AXES:
@@ -254,34 +352,78 @@ def llama_pipeline_shardings(params, mesh):
     return PIPE_LLAMA_RULES.tree_shardings(params, mesh)
 
 
+def _virtual_layer_specs(layer_specs, n_virtual: int):
+    """Spec for the interleaved ``(V, P, lpc, …)`` layer layout: the layer
+    dim's pipe sharding moves to dim 1 (chunk c on device c mod P), V and
+    lpc replicated, trailing dims keep their rule-table placement."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda spec: P(None, list(spec)[0], None, *list(spec)[1:]),
+        layer_specs)
+
+
+def llama_pipeline_place(params, mesh, n_virtual: int = 1):
+    """Place a llama param tree for the (optionally interleaved) pipeline.
+
+    ``n_virtual == 1``: device_put per ``llama_pipeline_shardings``.
+    ``n_virtual > 1``: each layer-stacked leaf is reshaped ``(L, …) →
+    (V, P, L/(P·V), …)`` so global chunk ``c`` lands on device ``c mod P``
+    (the strided layout the interleaved schedule needs), then device_put.
+    """
+    from jax.sharding import NamedSharding
+
+    if n_virtual == 1:
+        return jax.tree_util.tree_map(
+            jax.device_put, params, llama_pipeline_shardings(params, mesh))
+    p_size = _live_axes(mesh).get("pipe", 1)
+
+    def reshape(leaf):
+        if leaf.shape[0] % (p_size * n_virtual):
+            raise ValueError(
+                f"n_layers={leaf.shape[0]} not divisible by pipe={p_size} "
+                f"× virtual={n_virtual}")
+        lpc = leaf.shape[0] // (p_size * n_virtual)
+        return leaf.reshape(n_virtual, p_size, lpc, *leaf.shape[1:])
+
+    placed = dict(params)
+    specs = llama_pipeline_specs(params, mesh)
+    vspecs = _virtual_layer_specs(specs["layers"], n_virtual)
+    placed["layers"] = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(reshape(leaf),
+                                          NamedSharding(mesh, spec)),
+        params["layers"], vspecs)
+    for key in ("embed", "final_norm", "lm_head"):
+        placed[key] = jax.device_put(
+            params[key], NamedSharding(mesh, specs[key]))
+    return placed
+
+
 def llama_forward_pipelined(params, tokens, cfg, mesh, *,
-                            n_microbatches: Optional[int] = None):
+                            n_microbatches: Optional[int] = None,
+                            n_virtual: int = 1):
     """Llama forward with layers pipelined over the mesh's ``pipe`` axis,
     composing with data parallelism (batch dim over ``data``/``fsdp``/``dcn``),
     ZeRO-3 parameter sharding (``fsdp`` axis: stage weights stored sharded,
     one layer all-gathered at a time, grads reduce-scattered), and Megatron
     tensor parallelism (``tensor`` axis) inside each stage.
 
+    ``n_virtual > 1`` switches to the interleaved (virtual-stage) schedule:
+    each device holds V strided layer chunks and the bubble shrinks V×
+    (:func:`gpipe_interleaved`). Params must then be placed with
+    ``llama_pipeline_place(params, mesh, n_virtual)`` — layer leaves carry
+    the ``(V, P, lpc, …)`` layout.
+
     Embedding / final norm / LM head stay under GSPMD outside the shard_map
     (they are a tiny fraction of FLOPs); only the layer stack is staged.
-    Layer params must already be placed per ``llama_pipeline_shardings`` —
-    layer dim over ``pipe``, d_model dim over ``fsdp`` (ZeRO-3), Megatron
-    dims over ``tensor``.
     """
     from ..models.llama import _layer, rmsnorm, rope_freqs
 
     live = _live_axes(mesh)
     n_stages = live.get("pipe", 1)
     tp = live.get("tensor", 1)
-    if cfg.n_layers % n_stages:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
-                         f"pipe={n_stages}")
     fsdp = live.get("fsdp", 1)
-    if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
-        raise ValueError(f"tensor={tp} must divide n_kv_heads="
-                         f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
-    if fsdp > 1 and cfg.dim % fsdp:
-        raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
+    _validate_stage_divisibility(cfg, n_stages, tp, fsdp, n_virtual)
     cfg = _resolve_stage_attn(cfg, live, tp, tokens.shape[1])
     cp = live.get("context", 1)
     M = n_microbatches or n_stages
@@ -304,9 +446,16 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
         out, _ = lax.scan(body, h, local_layers)
         return out
     act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
-    run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
-                in_specs=act_spec, params_specs=layer_specs,
-                out_specs=act_spec)
+    if n_virtual > 1:
+        run = gpipe_interleaved(
+            stage_fn, mesh, axis="pipe", n_microbatches=M,
+            n_virtual=n_virtual, in_specs=act_spec,
+            params_specs=_virtual_layer_specs(layer_specs, n_virtual),
+            out_specs=act_spec)
+    else:
+        run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
+                    in_specs=act_spec, params_specs=layer_specs,
+                    out_specs=act_spec)
     x = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
@@ -370,14 +519,7 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
     tp = live.get("tensor", 1)
     fsdp = live.get("fsdp", 1)
     ep = live.get("expert", 1)
-    if cfg.n_layers % n_stages:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
-                         f"pipe={n_stages}")
-    if tp > 1 and (cfg.n_kv_heads % tp or cfg.ffn_dim % tp):
-        raise ValueError(f"tensor={tp} must divide n_kv_heads="
-                         f"{cfg.n_kv_heads} and ffn_dim={cfg.ffn_dim}")
-    if fsdp > 1 and cfg.dim % fsdp:
-        raise ValueError(f"fsdp={fsdp} must divide dim={cfg.dim}")
+    _validate_stage_divisibility(cfg, n_stages, tp, fsdp)
     if ep > 1 and cfg.n_experts % ep:
         raise ValueError(f"expert={ep} must divide n_experts="
                          f"{cfg.n_experts}")
@@ -389,7 +531,6 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
             "a context axis does not compose with MoE inside pipeline "
             "stages yet; use ring/ulysses with the non-pipelined moe path")
     cfg = _resolve_stage_attn(cfg, live, tp, tokens.shape[1])
-    cp = live.get("context", 1)
     M = n_microbatches or n_stages
     _validate_pipe_batch(tokens.shape[0], live, M)
 
@@ -402,10 +543,8 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
     gather_layer = _make_zero3_gather(layer_specs, fsdp)
 
     def stage_fn(local_layers, h):
-        fr = _local_freqs(freqs, h, cp)
-
         def body(carry, lw):
-            return _moe_layer(cfg, carry, gather_layer(lw), fr,
+            return _moe_layer(cfg, carry, gather_layer(lw), freqs,
                               tp_axis=tp_axis, ep_axis=ep_axis), None
         body = jax.checkpoint(body)
         (out, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),

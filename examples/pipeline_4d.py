@@ -14,6 +14,9 @@ What each axis does (`parallel/pipeline.py`):
   single compiled ``lax.scan`` — no host round-trips between microbatches.
 - ``tensor``: Megatron column/row sharding inside each stage with exactly
   two explicit psums per layer.
+- pass ``n_virtual=V`` (with params placed by ``llama_pipeline_place``) for
+  the interleaved schedule: V strided layer chunks per device, bubble V×
+  smaller.
 - ``context`` (swap for ``data`` at long seq_len): the sequence dim shards
   and the stage body runs ring attention over ICI neighbors (or ulysses
   all-to-all with ``attn_impl="ulysses"``).

@@ -79,3 +79,14 @@ class Warmable:
 
     def was_warmed(self):
         return self.warmed
+
+
+class WarmupCrasher:
+    """Worker suicide during warmup — the pod must never report ready."""
+
+    def __kt_warmup__(self):
+        import os
+        os._exit(41)
+
+    def ping(self):
+        return "alive"

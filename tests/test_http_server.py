@@ -258,3 +258,22 @@ def test_restart_procs_fresh_worker_per_call():
         assert pid1 != pid2, "restart_procs must respawn the worker"
         os.environ.pop("KT_DISTRIBUTED_CONFIG")
     run_server_test(body)
+
+
+def test_dead_rank_during_warmup_never_ready():
+    """A rank that dies inside __kt_warmup__ leaves the pod permanently
+    not-ready (503 with healthy=false) instead of joining the endpoint
+    pool as a pod that can never serve."""
+    async def body(client, state):
+        set_fn_metadata("WarmupCrasher")
+        await state.reload({}, launch_id="crash-1")
+        deadline = asyncio.get_event_loop().time() + 30
+        last = None
+        while asyncio.get_event_loop().time() < deadline:
+            r = await client.get("/ready", params={"launch_id": "crash-1"})
+            last = r.status, await r.json()
+            if r.status == 503 and last[1].get("healthy") is False:
+                break
+            await asyncio.sleep(0.2)
+        assert last[0] == 503 and last[1].get("healthy") is False, last
+    run_server_test(body)

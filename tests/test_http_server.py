@@ -43,18 +43,27 @@ def set_fn_metadata(fn_name: str, init_args=None):
         os.environ[KT_INIT_ARGS] = json.dumps(init_args)
 
 
-async def wait_ready(client, launch_id: str, timeout: float = 60.0):
-    """Poll /ready until 200 (503 = still in the load+warmup window)."""
+async def poll_ready(client, launch_id: str, until, timeout: float = 60.0,
+                     allowed=(200, 503)):
+    """Poll /ready until ``until(status, body)`` is true; only ``allowed``
+    interim statuses may appear. Returns the satisfying (status, body)."""
     import time as _t
 
     deadline = _t.time() + timeout
     while _t.time() < deadline:
         r = await client.get("/ready", params={"launch_id": launch_id})
-        if r.status == 200:
-            return r
-        assert r.status == 503, await r.text()
+        body = await r.json()
+        if until(r.status, body):
+            return r.status, body
+        assert r.status in allowed, (r.status, body)
         await asyncio.sleep(0.2)
-    raise AssertionError(f"/ready never reached 200 for {launch_id}")
+    raise AssertionError(f"/ready never satisfied condition for {launch_id}")
+
+
+async def wait_ready(client, launch_id: str, timeout: float = 60.0):
+    """Poll /ready until 200 (503 = still in the load+warmup window)."""
+    return await poll_ready(client, launch_id,
+                            lambda s, b: s == 200, timeout)
 
 
 def run_server_test(coro_fn):
@@ -267,13 +276,13 @@ def test_dead_rank_during_warmup_never_ready():
     async def body(client, state):
         set_fn_metadata("WarmupCrasher")
         await state.reload({}, launch_id="crash-1")
-        deadline = asyncio.get_event_loop().time() + 30
-        last = None
-        while asyncio.get_event_loop().time() < deadline:
+        await poll_ready(
+            client, "crash-1",
+            lambda s, b: s == 503 and b.get("healthy") is False,
+            timeout=30, allowed=(503,))
+        # and it STAYS not-ready: no later poll may ever return 200
+        for _ in range(10):
             r = await client.get("/ready", params={"launch_id": "crash-1"})
-            last = r.status, await r.json()
-            if r.status == 503 and last[1].get("healthy") is False:
-                break
-            await asyncio.sleep(0.2)
-        assert last[0] == 503 and last[1].get("healthy") is False, last
+            assert r.status == 503, await r.text()
+            await asyncio.sleep(0.1)
     run_server_test(body)

@@ -22,6 +22,7 @@ _PIPELINE_EXPORTS = ("gpipe", "gpipe_interleaved",
                      "llama_pipeline_shardings",
                      "llama_pipeline_specs", "PIPE_LLAMA_RULES",
                      "moe_forward_pipelined", "moe_loss_pipelined",
+                     "moe_pipeline_place",
                      "moe_pipeline_shardings", "moe_pipeline_specs",
                      "PIPE_MOE_RULES")
 

@@ -36,6 +36,19 @@ def _shard_map():
     return sm
 
 
+def _reduce_stage_aux(aux_acc, mesh, axis):
+    """Epilogue for the stage-aux channel (shared by both schedules): sum
+    over stages (pipe), average over axes that see different data (batch
+    shards, sequence shards); replicated axes (tensor/expert) compute
+    identical aux already."""
+    aux = lax.psum(aux_acc, axis)
+    reduce_axes = tuple(a for a in (*_BATCH_AXES, AXIS_CONTEXT)
+                        if a in _live_axes(mesh))
+    if reduce_axes:
+        aux = lax.pmean(aux, reduce_axes)
+    return aux
+
+
 def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
           n_microbatches: int, in_specs, params_specs, out_specs=None,
           stage_aux: bool = False):
@@ -104,15 +117,7 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
                           jnp.zeros_like(outputs)), axis)
             outputs = outputs.reshape(x_local.shape)
             if stage_aux:
-                # sum over stages (pipe), average over axes that see
-                # different data (batch shards, sequence shards); replicated
-                # axes (tensor/expert) compute identical aux already
-                aux = lax.psum(aux_acc, axis)
-                reduce_axes = tuple(a for a in (*_BATCH_AXES, AXIS_CONTEXT)
-                                    if a in _live_axes(mesh))
-                if reduce_axes:
-                    aux = lax.pmean(aux, reduce_axes)
-                return outputs, aux
+                return outputs, _reduce_stage_aux(aux_acc, mesh, axis)
             return outputs
 
         specs_out = out_specs if out_specs is not None else in_specs
@@ -129,7 +134,7 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
 
 def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
                       n_microbatches: int, n_virtual: int, in_specs,
-                      params_specs, out_specs=None):
+                      params_specs, out_specs=None, stage_aux: bool = False):
     """Interleaved (virtual-stage) pipeline schedule over ``mesh[axis]``.
 
     Each device holds ``n_virtual`` layer CHUNKS instead of one contiguous
@@ -147,8 +152,11 @@ def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
 
     ``chunk_fn(chunk_params, x) -> y`` consumes ONE chunk's params (the V
     dim already indexed out) and one microbatch activation. Requires
-    ``M % P == 0`` (microbatches advance in blocks of P).
+    ``M % P == 0`` (microbatches advance in blocks of P). ``stage_aux``
+    behaves as in :func:`gpipe` (per-chunk aux scalar, bubble-masked).
     """
+    from jax.sharding import PartitionSpec as P
+
     smap = _shard_map()
     P_size = _live_axes(mesh).get(axis, 1)
     if n_microbatches % P_size:
@@ -168,7 +176,7 @@ def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
                 lambda a: a.reshape(a.shape[0], *a.shape[2:]), local_params)
 
             def timestep(carry, t):
-                recv, outputs = carry
+                recv, outputs, aux_acc = carry
                 s = t - p
                 k = s // n_stages                  # = block·V + v
                 v = k % V
@@ -183,7 +191,12 @@ def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
                     lambda a: lax.dynamic_index_in_dim(
                         a, jnp.clip(v, 0, V - 1), axis=0, keepdims=False),
                     chunks)
-                out = chunk_fn(chunk_params, inp)
+                if stage_aux:
+                    out, aux = chunk_fn(chunk_params, inp)
+                    aux_acc = aux_acc + jnp.where(
+                        in_window, aux.astype(jnp.float32), 0.0)
+                else:
+                    out = chunk_fn(chunk_params, inp)
                 send = lax.ppermute(
                     out, axis,
                     [(i, (i + 1) % n_stages) for i in range(n_stages)])
@@ -193,19 +206,27 @@ def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
                                                    keepdims=False)
                 outputs = lax.dynamic_update_index_in_dim(
                     outputs, jnp.where(valid, out, current), idx, 0)
-                return (send, outputs), None
+                return (send, outputs, aux_acc), None
 
             init = (jnp.zeros_like(xs[0]),
-                    jnp.zeros((M, *xs.shape[1:]), xs.dtype))
-            (_, outputs), _ = lax.scan(timestep, init, jnp.arange(ticks))
+                    jnp.zeros((M, *xs.shape[1:]), xs.dtype),
+                    jnp.zeros((), jnp.float32))
+            (_, outputs, aux_acc), _ = lax.scan(timestep, init,
+                                                jnp.arange(ticks))
             outputs = lax.psum(
                 jnp.where(p == n_stages - 1, outputs,
                           jnp.zeros_like(outputs)), axis)
-            return outputs.reshape(x_local.shape)
+            outputs = outputs.reshape(x_local.shape)
+            if stage_aux:
+                return outputs, _reduce_stage_aux(aux_acc, mesh, axis)
+            return outputs
 
+        specs_out = out_specs if out_specs is not None else in_specs
+        if stage_aux:
+            specs_out = (specs_out, P())
         return smap(per_device, mesh=mesh,
                     in_specs=(params_specs, in_specs),
-                    out_specs=out_specs if out_specs is not None else in_specs,
+                    out_specs=specs_out,
                     check_vma=False)(stage_params, x)
 
     return pipelined
@@ -363,19 +384,22 @@ def _virtual_layer_specs(layer_specs, n_virtual: int):
         layer_specs)
 
 
-def llama_pipeline_place(params, mesh, n_virtual: int = 1):
-    """Place a llama param tree for the (optionally interleaved) pipeline.
+def _pipeline_place(params, mesh, specs, n_virtual: int):
+    """Place a param tree for the (optionally interleaved) pipeline.
 
-    ``n_virtual == 1``: device_put per ``llama_pipeline_shardings``.
-    ``n_virtual > 1``: each layer-stacked leaf is reshaped ``(L, …) →
+    ``n_virtual == 1``: device_put per ``specs``. ``n_virtual > 1``: each
+    layer-stacked leaf under ``params["layers"]`` is reshaped ``(L, …) →
     (V, P, L/(P·V), …)`` so global chunk ``c`` lands on device ``c mod P``
-    (the strided layout the interleaved schedule needs), then device_put.
+    (the strided layout the interleaved schedule needs), then device_put;
+    everything outside ``layers`` keeps its rule-table placement.
     """
     from jax.sharding import NamedSharding
 
     if n_virtual == 1:
         return jax.tree_util.tree_map(
-            jax.device_put, params, llama_pipeline_shardings(params, mesh))
+            lambda leaf, spec: jax.device_put(leaf,
+                                              NamedSharding(mesh, spec)),
+            params, specs)
     p_size = _live_axes(mesh).get("pipe", 1)
 
     def reshape(leaf):
@@ -387,16 +411,24 @@ def llama_pipeline_place(params, mesh, n_virtual: int = 1):
         return leaf.reshape(n_virtual, p_size, lpc, *leaf.shape[1:])
 
     placed = dict(params)
-    specs = llama_pipeline_specs(params, mesh)
     vspecs = _virtual_layer_specs(specs["layers"], n_virtual)
     placed["layers"] = jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(reshape(leaf),
                                           NamedSharding(mesh, spec)),
         params["layers"], vspecs)
-    for key in ("embed", "final_norm", "lm_head"):
-        placed[key] = jax.device_put(
-            params[key], NamedSharding(mesh, specs[key]))
+    for key in params:
+        if key != "layers":
+            placed[key] = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)),
+                params[key], specs[key])
     return placed
+
+
+def llama_pipeline_place(params, mesh, n_virtual: int = 1):
+    """Place a llama param tree for the (optionally interleaved) pipeline."""
+    return _pipeline_place(params, mesh, llama_pipeline_specs(params, mesh),
+                           n_virtual)
 
 
 def llama_forward_pipelined(params, tokens, cfg, mesh, *,
@@ -499,8 +531,15 @@ def moe_pipeline_shardings(params, mesh):
     return PIPE_MOE_RULES.tree_shardings(params, mesh)
 
 
+def moe_pipeline_place(params, mesh, n_virtual: int = 1):
+    """Place an MoE param tree for the (optionally interleaved) pipeline."""
+    return _pipeline_place(params, mesh, moe_pipeline_specs(params, mesh),
+                           n_virtual)
+
+
 def moe_forward_pipelined(params, tokens, cfg, mesh, *,
-                          n_microbatches: Optional[int] = None):
+                          n_microbatches: Optional[int] = None,
+                          n_virtual: int = 1):
     """MoE forward with layers pipelined over ``pipe``, experts sharded over
     ``expert`` INSIDE each stage, composing with data/fsdp/tensor exactly as
     :func:`llama_forward_pipelined`. Returns ``(logits, aux)`` where ``aux``
@@ -519,7 +558,7 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
     tp = live.get("tensor", 1)
     fsdp = live.get("fsdp", 1)
     ep = live.get("expert", 1)
-    _validate_stage_divisibility(cfg, n_stages, tp, fsdp)
+    _validate_stage_divisibility(cfg, n_stages, tp, fsdp, n_virtual)
     if ep > 1 and cfg.n_experts % ep:
         raise ValueError(f"expert={ep} must divide n_experts="
                          f"{cfg.n_experts}")
@@ -552,9 +591,16 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
         return out, aux
 
     act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
-    run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
-                in_specs=act_spec, params_specs=layer_specs,
-                out_specs=act_spec, stage_aux=True)
+    if n_virtual > 1:
+        run = gpipe_interleaved(
+            stage_fn, mesh, axis="pipe", n_microbatches=M,
+            n_virtual=n_virtual, in_specs=act_spec,
+            params_specs=_virtual_layer_specs(layer_specs, n_virtual),
+            out_specs=act_spec, stage_aux=True)
+    else:
+        run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
+                    in_specs=act_spec, params_specs=layer_specs,
+                    out_specs=act_spec, stage_aux=True)
     x, aux = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -41,6 +41,13 @@ class MoeConfig:
     remat: bool = True
     attn_impl: str = "auto"
     router_aux_weight: float = 0.01
+    # Opt-in for MoE inside pipeline stages WITH a context axis: routing and
+    # expert capacity are then computed per local sequence chunk (S/cp
+    # tokens) instead of the full sequence. Per-token top-k decisions are
+    # identical; only overflow-drop behavior differs (capacity pressure is
+    # per-chunk), so outputs match the full-sequence router exactly whenever
+    # no expert overflows. The standard sequence-parallel MoE trade.
+    context_chunked_routing: bool = False
 
     @property
     def head_dim(self) -> int:

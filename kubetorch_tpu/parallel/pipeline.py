@@ -257,6 +257,20 @@ PIPE_LLAMA_RULES = ShardingRules(rules=[
 _PIPE_ACT_RULES = ShardingRules(rules=[(r"^x$", (_BATCH_AXES, AXIS_CONTEXT))])
 
 
+def _build_pipeline_runner(stage_fn, mesh, M: int, n_virtual: int,
+                           act_spec, layer_specs, stage_aux: bool):
+    """Pick the schedule and wire the specs — shared by every model family."""
+    if n_virtual > 1:
+        return gpipe_interleaved(
+            stage_fn, mesh, axis="pipe", n_microbatches=M,
+            n_virtual=n_virtual, in_specs=act_spec,
+            params_specs=_virtual_layer_specs(layer_specs, n_virtual),
+            out_specs=act_spec, stage_aux=stage_aux)
+    return gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
+                 in_specs=act_spec, params_specs=layer_specs,
+                 out_specs=act_spec, stage_aux=stage_aux)
+
+
 def _resolve_stage_attn(cfg, live, tp: int, seq_len: int):
     """Resolve ``cfg.attn_impl`` for use INSIDE a pipeline stage's shard_map.
 
@@ -478,16 +492,8 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
         out, _ = lax.scan(body, h, local_layers)
         return out
     act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
-    if n_virtual > 1:
-        run = gpipe_interleaved(
-            stage_fn, mesh, axis="pipe", n_microbatches=M,
-            n_virtual=n_virtual, in_specs=act_spec,
-            params_specs=_virtual_layer_specs(layer_specs, n_virtual),
-            out_specs=act_spec)
-    else:
-        run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
-                    in_specs=act_spec, params_specs=layer_specs,
-                    out_specs=act_spec)
+    run = _build_pipeline_runner(stage_fn, mesh, M, n_virtual, act_spec,
+                                 layer_specs, stage_aux=False)
     x = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
@@ -562,13 +568,16 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
     if ep > 1 and cfg.n_experts % ep:
         raise ValueError(f"expert={ep} must divide n_experts="
                          f"{cfg.n_experts}")
-    if live.get("context", 1) > 1:
-        # in-stage MoE routing would assign expert capacity per local
-        # sequence chunk, silently diverging from the full-sequence GSPMD
-        # routing; sequence-chunked routing is round-2 work
+    cp = live.get("context", 1)
+    if cp > 1 and not cfg.context_chunked_routing:
+        # in-stage MoE routing assigns expert capacity per local sequence
+        # chunk, which diverges from full-sequence routing whenever an
+        # expert overflows — require the explicit opt-in
         raise ValueError(
-            "a context axis does not compose with MoE inside pipeline "
-            "stages yet; use ring/ulysses with the non-pipelined moe path")
+            "MoE inside pipeline stages with a context axis routes per "
+            "sequence chunk; opt in with "
+            "MoeConfig(context_chunked_routing=True) or use a context-free "
+            "mesh")
     cfg = _resolve_stage_attn(cfg, live, tp, tokens.shape[1])
     M = n_microbatches or n_stages
     _validate_pipe_batch(tokens.shape[0], live, M)
@@ -582,8 +591,10 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
     gather_layer = _make_zero3_gather(layer_specs, fsdp)
 
     def stage_fn(local_layers, h):
+        fr = _local_freqs(freqs, h, cp)
+
         def body(carry, lw):
-            return _moe_layer(cfg, carry, gather_layer(lw), freqs,
+            return _moe_layer(cfg, carry, gather_layer(lw), fr,
                               tp_axis=tp_axis, ep_axis=ep_axis), None
         body = jax.checkpoint(body)
         (out, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
@@ -591,16 +602,8 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
         return out, aux
 
     act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
-    if n_virtual > 1:
-        run = gpipe_interleaved(
-            stage_fn, mesh, axis="pipe", n_microbatches=M,
-            n_virtual=n_virtual, in_specs=act_spec,
-            params_specs=_virtual_layer_specs(layer_specs, n_virtual),
-            out_specs=act_spec, stage_aux=True)
-    else:
-        run = gpipe(stage_fn, mesh, axis="pipe", n_microbatches=M,
-                    in_specs=act_spec, params_specs=layer_specs,
-                    out_specs=act_spec, stage_aux=True)
+    run = _build_pipeline_runner(stage_fn, mesh, M, n_virtual, act_spec,
+                                 layer_specs, stage_aux=True)
     x, aux = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -533,3 +533,34 @@ def test_moe_interleaved_matches_sequential(cpu_mesh_devices):
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                rtol=4e-4, atol=4e-4)
     assert np.isfinite(float(aux)) and 0.2 < float(aux) < 5.0
+
+
+def test_moe_context_chunked_routing(cpu_mesh_devices):
+    """cp×ep×pipe MoE: with the context_chunked_routing opt-in the stage
+    runs ring attention + per-chunk routing; at no-overflow capacity the
+    chunk-local router is exactly the full-sequence router."""
+    from kubetorch_tpu.models.moe import MoeConfig, moe_forward, moe_init
+    from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
+    from kubetorch_tpu.parallel.pipeline import (moe_forward_pipelined,
+                                                 moe_pipeline_place)
+
+    kw = dict(attn_impl="xla", dtype=jnp.float32, remat=False, n_layers=4,
+              n_experts=4, capacity_factor=4.0)
+    cfg = MoeConfig.tiny(context_chunked_routing=True, **kw)
+    mesh = build_mesh(MeshSpec(context=2, expert=2, pipe=2),
+                      devices=jax.devices()[:8])
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    ref, _ = moe_forward(params, tokens, MoeConfig.tiny(**kw))
+    placed = moe_pipeline_place(params, mesh)
+    logits, aux = jax.jit(lambda p, t: moe_forward_pipelined(
+        p, t, cfg, mesh, n_microbatches=2))(placed, tokens)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=4e-4, atol=4e-4)
+    assert np.isfinite(float(aux))
+
+    # without the opt-in: clear error
+    with pytest.raises(ValueError, match="context_chunked_routing"):
+        moe_forward_pipelined(placed, tokens, MoeConfig.tiny(**kw), mesh,
+                              n_microbatches=2)

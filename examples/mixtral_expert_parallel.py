@@ -2,7 +2,11 @@
 
 Expert weights shard over the ``expert`` mesh axis; GSPMD inserts the token
 all-to-alls around the GShard dispatch einsums (models/moe.py). On
-multi-slice pods add ``dcn`` for cross-slice data parallelism.
+multi-slice pods add ``dcn`` for cross-slice data parallelism. To stack
+pipeline parallelism on top, use ``moe_loss_pipelined`` +
+``moe_pipeline_place`` (parallel/pipeline.py) — experts then dispatch
+in-stage with a local-expert slice + psum, optionally on the interleaved
+schedule (``n_virtual``).
 """
 
 import kubetorch_tpu as kt

@@ -257,20 +257,17 @@ def llama_loss(params: Dict[str, Any], tokens: jax.Array, targets: jax.Array,
     return -jnp.mean(ll)
 
 
-def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
-                       targets: jax.Array, cfg: LlamaConfig,
-                       chunk: int = 256) -> jax.Array:
-    """Memory-efficient CE: never materializes the (B, S, V) fp32 logits.
-
-    The hidden states run the normal forward; the LM head + log-softmax are
+def chunked_ce(x: jax.Array, targets: jax.Array, head: jax.Array,
+               chunk: int = 256) -> jax.Array:
+    """Memory-efficient CE over hidden states: the LM head + log-softmax are
     applied per sequence-chunk inside a ``lax.map``, so peak memory is
     (B, chunk, V) instead of (B, S, V) — at V=128k and S=8k that's the
     difference between ~4 GB of fp32 logits per example and ~128 MB. The
     backward recomputes each chunk's logits (standard remat trade: the LM
     head matmul is cheap next to its HBM cost). Sequences that don't divide
     the chunk are padded and masked, never degraded to tiny chunks.
+    Shared by the plain and pipelined loss paths.
     """
-    x = llama_hidden(params, tokens, cfg)                 # (B, S, D)
     b, s, d = x.shape
     chunk = min(chunk, s)
     pad = (-s) % chunk
@@ -280,7 +277,6 @@ def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
         targets = jnp.pad(targets, ((0, 0), (0, pad)))
         mask = jnp.pad(mask, ((0, 0), (0, pad)))
     total = s + pad
-    head = params["lm_head"].astype(cfg.dtype)
 
     def chunk_loss(args):
         h, t, m = args                                    # (B, C, D), (B, C)
@@ -296,6 +292,15 @@ def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
     m_chunks = mask.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
     totals = lax.map(chunk_loss, (h_chunks, t_chunks, m_chunks))
     return -jnp.sum(totals) / (b * s)
+
+
+def llama_loss_chunked(params: Dict[str, Any], tokens: jax.Array,
+                       targets: jax.Array, cfg: LlamaConfig,
+                       chunk: int = 256) -> jax.Array:
+    """Next-token CE without materializing (B, S, V) logits (see
+    :func:`chunked_ce`)."""
+    x = llama_hidden(params, tokens, cfg)                 # (B, S, D)
+    return chunked_ce(x, targets, params["lm_head"].astype(cfg.dtype), chunk)
 
 
 def config_from_dict(d: Dict) -> LlamaConfig:

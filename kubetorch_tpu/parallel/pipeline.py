@@ -445,9 +445,9 @@ def llama_pipeline_place(params, mesh, n_virtual: int = 1):
                            n_virtual)
 
 
-def llama_forward_pipelined(params, tokens, cfg, mesh, *,
-                            n_microbatches: Optional[int] = None,
-                            n_virtual: int = 1):
+def llama_hidden_pipelined(params, tokens, cfg, mesh, *,
+                           n_microbatches: Optional[int] = None,
+                           n_virtual: int = 1):
     """Llama forward with layers pipelined over the mesh's ``pipe`` axis,
     composing with data parallelism (batch dim over ``data``/``fsdp``/``dcn``),
     ZeRO-3 parameter sharding (``fsdp`` axis: stage weights stored sharded,
@@ -495,15 +495,25 @@ def llama_forward_pipelined(params, tokens, cfg, mesh, *,
     run = _build_pipeline_runner(stage_fn, mesh, M, n_virtual, act_spec,
                                  layer_specs, stage_aux=False)
     x = run(params["layers"], x)
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+
+def llama_forward_pipelined(params, tokens, cfg, mesh, **kw):
+    """Pipelined forward to logits (see :func:`llama_hidden_pipelined`)."""
+    x = llama_hidden_pipelined(params, tokens, cfg, mesh, **kw)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
 
 
-def llama_loss_pipelined(params, tokens, targets, cfg, mesh, **kw):
-    logits = llama_forward_pipelined(params, tokens, cfg, mesh, **kw)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+def llama_loss_pipelined(params, tokens, targets, cfg, mesh, *,
+                         chunk: int = 256, **kw):
+    """Pipelined next-token CE WITHOUT materializing the (B, S, V) fp32
+    logits: the pipelined hidden states feed the shared per-chunk LM-head
+    loss (``models.llama.chunked_ce``) — same HBM win as the non-pipelined
+    ``llama_loss_chunked``."""
+    from ..models.llama import chunked_ce
+
+    x = llama_hidden_pipelined(params, tokens, cfg, mesh, **kw)
+    return chunked_ce(x, targets, params["lm_head"].astype(cfg.dtype), chunk)
 
 
 # ---------------------------------------------------------------------------

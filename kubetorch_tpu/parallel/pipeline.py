@@ -553,18 +553,22 @@ def moe_pipeline_place(params, mesh, n_virtual: int = 1):
                            n_virtual)
 
 
-def moe_forward_pipelined(params, tokens, cfg, mesh, *,
-                          n_microbatches: Optional[int] = None,
-                          n_virtual: int = 1):
-    """MoE forward with layers pipelined over ``pipe``, experts sharded over
+def moe_hidden_pipelined(params, tokens, cfg, mesh, *,
+                         n_microbatches: Optional[int] = None,
+                         n_virtual: int = 1):
+    """MoE headless forward (final-normed hidden states + aux) with layers
+    pipelined over ``pipe``, experts sharded over
     ``expert`` INSIDE each stage, composing with data/fsdp/tensor exactly as
-    :func:`llama_forward_pipelined`. Returns ``(logits, aux)`` where ``aux``
-    is the router load-balancing loss averaged over microbatches and layers
-    (bubble ticks masked by :func:`gpipe`'s ``stage_aux`` channel).
+    :func:`llama_hidden_pipelined`. Returns ``(hidden, aux)``: the
+    final-normed (B, S, D) hidden states in ``cfg.dtype`` (the LM head is
+    applied by the forward/loss wrappers) and the router load-balancing
+    loss averaged over microbatches and layers (bubble ticks masked by
+    :func:`gpipe`'s ``stage_aux`` channel).
 
     Note: ``aux`` is a product of batch means, so the microbatch average
-    differs from the sequential full-batch value at O(1/M) — the logits are
-    bit-comparable, the aux regularizer is statistically equivalent.
+    differs from the sequential full-batch value at O(1/M) — the hidden
+    states are bit-comparable, the aux regularizer is statistically
+    equivalent.
     """
     from ..models.llama import rmsnorm, rope_freqs
     from ..models.moe import _moe_layer
@@ -616,14 +620,23 @@ def moe_forward_pipelined(params, tokens, cfg, mesh, *,
                                  layer_specs, stage_aux=True)
     x, aux = run(params["layers"], x)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    return logits, aux / (M * cfg.n_layers)
+    return x, aux / (M * cfg.n_layers)
 
 
-def moe_loss_pipelined(params, tokens, targets, cfg, mesh, **kw):
-    logits, aux = moe_forward_pipelined(params, tokens, cfg, mesh, **kw)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll) + cfg.router_aux_weight * aux
+def moe_forward_pipelined(params, tokens, cfg, mesh, **kw):
+    """Pipelined MoE forward to ``(logits, aux)``."""
+    x, aux = moe_hidden_pipelined(params, tokens, cfg, mesh, **kw)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32), aux
+
+
+def moe_loss_pipelined(params, tokens, targets, cfg, mesh, *,
+                       chunk: int = 256, **kw):
+    """Pipelined MoE next-token CE + router aux, with the per-chunk LM-head
+    loss (never materializes (B, S, V) fp32 logits)."""
+    from ..models.llama import chunked_ce
+
+    x, aux = moe_hidden_pipelined(params, tokens, cfg, mesh, **kw)
+    ce = chunked_ce(x, targets, params["lm_head"].astype(cfg.dtype), chunk)
+    return ce + cfg.router_aux_weight * aux
 
 

@@ -167,11 +167,16 @@ def vit_forward(params: Dict[str, Any], images: jax.Array,
     return (pooled @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
 
 
-def vit_loss(params: Dict[str, Any], images: jax.Array, labels: jax.Array,
-             cfg: VitConfig) -> jax.Array:
-    logits = vit_forward(params, images, cfg)
+def classification_ce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over (B, n_classes) fp32 logits — shared by the plain and
+    pipelined loss paths so they can never drift."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def vit_loss(params: Dict[str, Any], images: jax.Array, labels: jax.Array,
+             cfg: VitConfig) -> jax.Array:
+    return classification_ce(vit_forward(params, images, cfg), labels)
 
 
 def config_from_dict(d: Dict) -> VitConfig:

@@ -24,7 +24,10 @@ _PIPELINE_EXPORTS = ("gpipe", "gpipe_interleaved",
                      "moe_forward_pipelined", "moe_loss_pipelined",
                      "moe_pipeline_place",
                      "moe_pipeline_shardings", "moe_pipeline_specs",
-                     "PIPE_MOE_RULES")
+                     "PIPE_MOE_RULES",
+                     "vit_forward_pipelined", "vit_loss_pipelined",
+                     "vit_pipeline_place", "vit_pipeline_shardings",
+                     "vit_pipeline_specs", "PIPE_VIT_RULES")
 
 __all__ = [
     "MeshSpec", "build_mesh", "ShardingRules", "LLAMA_RULES", "MOE_RULES",

@@ -26,7 +26,8 @@ from jax import lax
 
 from .mesh import (AXIS_CONTEXT, AXIS_EXPERT, AXIS_FSDP, AXIS_PIPE,
                    AXIS_TENSOR, live_axes as _live_axes)
-from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, ShardingRules)
+from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, VIT_RULES,
+                       ShardingRules)
 
 
 def _shard_map():
@@ -638,5 +639,93 @@ def moe_loss_pipelined(params, tokens, targets, cfg, mesh, *,
     x, aux = moe_hidden_pipelined(params, tokens, cfg, mesh, **kw)
     ce = chunked_ce(x, targets, params["lm_head"].astype(cfg.dtype), chunk)
     return ce + cfg.router_aux_weight * aux
+
+
+# ---------------------------------------------------------------------------
+# ViT integration: the encoder family pipelines with the same machinery
+# ---------------------------------------------------------------------------
+
+# ViT encoder stack on a pipe(+data/fsdp/tensor) mesh: qkv/mlp matrices take
+# the Megatron layout, LayerNorm scale/bias replicated per stage;
+# patch_embed/pos_embed/head fall through to VIT_RULES so pipelined and
+# plain paths can't diverge.
+PIPE_VIT_RULES = ShardingRules(rules=[
+    (r"layers/(wqkv|w_up)$", (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"layers/(wo|w_down)$", (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"layers/ln",           (AXIS_PIPE,)),
+] + VIT_RULES.rules)
+
+
+def vit_pipeline_specs(params, mesh):
+    return PIPE_VIT_RULES.tree_specs(params, mesh)
+
+
+def vit_pipeline_shardings(params, mesh):
+    """``NamedSharding`` pytree for a ViT param tree on a pipe mesh."""
+    return PIPE_VIT_RULES.tree_shardings(params, mesh)
+
+
+def vit_pipeline_place(params, mesh, n_virtual: int = 1):
+    """Place a ViT param tree for the (optionally interleaved) pipeline."""
+    return _pipeline_place(params, mesh, vit_pipeline_specs(params, mesh),
+                           n_virtual)
+
+
+def vit_forward_pipelined(params, images, cfg, mesh, *,
+                          n_microbatches: Optional[int] = None,
+                          n_virtual: int = 1):
+    """ViT forward with encoder layers pipelined over ``pipe``, composing
+    with data/fsdp(ZeRO-3)/tensor exactly as the decoder families. No RoPE,
+    no causal mask, no context axis (images are short sequences); the wqkv
+    fused projection column-shards over tensor in blocks of 3·D/tp —
+    tensor-parallel ViT stages are not wired yet, so tp must be 1.
+    """
+    from ..models.vit import _encoder_layer, layernorm, patchify
+
+    live = _live_axes(mesh)
+    n_stages = live.get("pipe", 1)
+    if live.get("tensor", 1) > 1:
+        # the fused (D, 3D) wqkv would need an interleaved q/k/v column
+        # split per tensor shard; un-fused projections are round-2 work
+        raise ValueError("tensor parallelism inside ViT pipeline stages is "
+                         "not supported yet; use a tensor-free mesh")
+    if live.get("context", 1) > 1:
+        raise ValueError("a context axis does not apply to ViT (short "
+                         "sequences); use a context-free mesh")
+    fsdp = live.get("fsdp", 1)
+    # tp forced to 1 above, so the helper's n_kv_heads/ffn_dim checks (which
+    # VitConfig lacks) are short-circuited
+    _validate_stage_divisibility(cfg, n_stages, 1, fsdp, n_virtual)
+    M = n_microbatches or n_stages
+    _validate_pipe_batch(images.shape[0], live, M)
+
+    x = patchify(images.astype(cfg.dtype), cfg) @ params["patch_embed"]
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+
+    layer_specs = vit_pipeline_specs(params, mesh)["layers"]
+    gather_layer = _make_zero3_gather(layer_specs, fsdp)
+
+    def stage_fn(local_layers, h):
+        def body(carry, lw):
+            return _encoder_layer(cfg, carry, gather_layer(lw)), None
+        body = jax.checkpoint(body)
+        out, _ = lax.scan(body, h, local_layers)
+        return out
+
+    act_spec = _PIPE_ACT_RULES.spec_for("x", mesh)
+    run = _build_pipeline_runner(stage_fn, mesh, M, n_virtual, act_spec,
+                                 layer_specs, stage_aux=False)
+    x = run(params["layers"], x)
+    x = layernorm(x, params["final_ln_scale"], params["final_ln_bias"],
+                  cfg.norm_eps)
+    pooled = jnp.mean(x, axis=1)
+    return (pooled @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def vit_loss_pipelined(params, images, labels, cfg, mesh, **kw):
+    from ..models.vit import classification_ce
+
+    return classification_ce(
+        vit_forward_pipelined(params, images, cfg, mesh, **kw), labels)
 
 

@@ -5,7 +5,9 @@ cache is a fixed (L, B, S_max, NKV, Hd) buffer, positions are masked, and one
 jit covers prefill + N decode steps (no per-token dispatch, no dynamic
 shapes). The cache layout matches the mesh rules: NKV shards over ``tensor``,
 batch over data axes, so multi-chip serving is the same NamedSharding story
-as training.
+as training. Works for both decoder families: a layer carrying a ``router``
+leaf runs the MoE FFN (top-k dispatch per chunk of new tokens), dense
+otherwise — pass the matching ``LlamaConfig`` / ``MoeConfig``.
 
 This is what the RLHF rollout actors (BASELINE config 4) and autoscaled
 inference services run.
@@ -22,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
+from .moe import MoeConfig, moe_ffn
 
 NEG_INF = -1e30
 
@@ -31,7 +34,7 @@ class KVCache(NamedTuple):
     v: jax.Array
 
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+def init_cache(cfg: "LlamaConfig | MoeConfig", batch: int, max_len: int,
                dtype=None) -> KVCache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     dtype = dtype or cfg.dtype
@@ -73,12 +76,17 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
                              cfg.head_dim ** -0.5)
     x = x + attn.reshape(b, t, -1) @ lw["wo"]
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
-    ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
+    if "router" in lw:
+        # MoE layer (cfg is a MoeConfig): top-k dispatch over the T new
+        # tokens; at decode (T=1) each chosen expert holds one capacity slot
+        ffn, _ = moe_ffn(cfg, h, lw)
+    else:
+        ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
     return x + ffn, layer_cache_k, layer_cache_v
 
 
 def forward_with_cache(params, tokens, cache: KVCache, start_pos,
-                       cfg: LlamaConfig):
+                       cfg: "LlamaConfig | MoeConfig"):
     """Run T new tokens at absolute position ``start_pos``; returns logits
     for the LAST position and the updated cache. Used for both prefill
     (T = prompt length) and decode (T = 1)."""
@@ -101,7 +109,7 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
                                   "top_k"))
-def generate(params, prompt: jax.Array, cfg: LlamaConfig,
+def generate(params, prompt: jax.Array, cfg: "LlamaConfig | MoeConfig",
              max_new_tokens: int = 64, temperature: float = 0.0,
              top_k: Optional[int] = None,
              rng: Optional[jax.Array] = None) -> jax.Array:

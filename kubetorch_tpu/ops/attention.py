@@ -28,14 +28,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# TPU memory tiles are (8, 128) for fp32: a per-row statistic like the LSE
+# cannot be stored as a bare (..., S) array with (1, 1, block_q) blocks — the
+# last two block dims must tile onto (8, 128). Per-row stats are therefore
+# broadcast across a 128-lane trailing dim (same layout the stock XLA flash
+# kernels use) and lane 0 is read back inside the kernels.
+LANES = 128
+
 
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale: float, causal: bool, block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                need_lse: bool):
+    lse_ref, acc_ref, m_ref, l_ref = rest if need_lse else (None, *rest)
     qi = pl.program_id(2)   # q-block index
     kj = pl.program_id(3)   # k-block index (innermost, sequential)
     nk = pl.num_programs(3)
@@ -79,10 +88,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)          # fully-masked rows → 0 out
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0]))
+        if need_lse:
+            lse = m_ref[:] + jnp.log(l_safe)          # (BQ, 1)
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret, need_lse=True):
     b, n, s, hd = q.shape
     nkv = k.shape[1]
     block_q = min(block_q, s)
@@ -91,8 +102,17 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     grid = (b, n, s // block_q, s // block_k)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    out, lse = pl.pallas_call(
+                               block_q=block_q, block_k=block_k,
+                               need_lse=need_lse)
+    out_specs = [pl.BlockSpec((1, 1, block_q, hd), lambda b_, h, i, j: (b_, h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b, n, s, hd), q.dtype)]
+    if need_lse:
+        # lse only exists to seed the backward pass; the no-grad path skips
+        # writing it entirely (it is 128 lanes wide — see LANES)
+        out_specs.append(pl.BlockSpec((1, 1, block_q, LANES),
+                                      lambda b_, h, i, j: (b_, h, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, n, s, LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -100,14 +120,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, i, j: (b_, h * nkv // n, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, i, j: (b_, h * nkv // n, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, hd), lambda b_, h, i, j: (b_, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h, i, j: (b_, h, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, n, s, hd), q.dtype),
-            jax.ShapeDtypeStruct((b, n, s), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, hd), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),    # m
@@ -115,7 +129,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return (res[0], res[1]) if need_lse else (res[0], None)
 
 
 # ---------------------------------------------------------------------------
@@ -143,8 +157,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                  # (BQ, 1)
-        delta = delta_ref[0, 0][:, None]              # (BQ, 1)
+        lse = lse_ref[0, 0][:, :1]                    # (BQ, 1), lane 0
+        delta = delta_ref[0, 0][:, :1]                # (BQ, 1), lane 0
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -189,8 +203,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -219,8 +233,10 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
     block_q = min(block_q, s)
     block_k = min(block_k, s)
 
-    # delta = rowsum(dO * O) — the softmax-grad correction term.
+    # delta = rowsum(dO * O) — the softmax-grad correction term, broadcast to
+    # the lane-major stat layout (see LANES).
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -231,8 +247,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, i, j: (b_, h * nkv // n, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, i, j: (b_, h * nkv // n, j, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b_, h, i, j: (b_, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h, i, j: (b_, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h, i, j: (b_, h, i)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h, i, j: (b_, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b_, h, i, j: (b_, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -263,8 +279,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, j, i: (b_, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, j, i: (b_, h, j, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b_, h, j, i: (b_, qhead(h, i), qblock(i), 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h, j, i: (b_, qhead(h, i), qblock(i))),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h, j, i: (b_, qhead(h, i), qblock(i))),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h, j, i: (b_, qhead(h, i), qblock(i), 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h, j, i: (b_, qhead(h, i), qblock(i), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, hd), lambda b_, h, j, i: (b_, h, j, 0)),
@@ -290,7 +306,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                  need_lse=False)
     return out
 
 

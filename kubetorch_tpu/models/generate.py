@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
-from .moe import MoeConfig, moe_ffn
+from .moe import MoeConfig, moe_ffn, moe_ffn_decode
 
 NEG_INF = -1e30
 
@@ -77,9 +77,26 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
     x = x + attn.reshape(b, t, -1) @ lw["wo"]
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     if "router" in lw:
-        # MoE layer (cfg is a MoeConfig): top-k dispatch over the T new
-        # tokens; at decode (T=1) each chosen expert holds one capacity slot
-        ffn, _ = moe_ffn(cfg, h, lw)
+        # MoE layer (cfg is a MoeConfig). True decode steps (T == 1, where
+        # capacity slots can never overflow, so both formulations are exactly
+        # equal) gather just the K chosen experts' weights per token when
+        # that moves less weight traffic than streaming all E experts.
+        # Prefill (T > 1) always uses the capacity-buffer dispatch to keep
+        # its overflow-drop semantics identical to training. The gather is
+        # also mechanically disabled under an ambient mesh with a live
+        # ``expert`` axis: a data-dependent gather along the sharded E axis
+        # would force GSPMD to all-gather every expert's weights per step.
+        # All inputs are static at trace time ⇒ the choice is fixed per
+        # compile.
+        from ..parallel.mesh import AXIS_EXPERT
+        from ..parallel.mesh_context import axis_size, current_mesh
+
+        if (t == 1 and cfg.decode_gather_ffn
+                and axis_size(current_mesh(), AXIS_EXPERT) == 1
+                and b * cfg.experts_per_token <= cfg.n_experts):
+            ffn = moe_ffn_decode(cfg, h, lw)
+        else:
+            ffn, _ = moe_ffn(cfg, h, lw)
     else:
         ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
     return x + ffn, layer_cache_k, layer_cache_v

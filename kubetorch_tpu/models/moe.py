@@ -41,6 +41,14 @@ class MoeConfig:
     remat: bool = True
     attn_impl: str = "auto"
     router_aux_weight: float = 0.01
+    # Decode-time fast path: gather only the K selected experts' weights per
+    # token instead of streaming all E experts (see ``moe_ffn_decode``).
+    # Auto-disabled at trace time when the ambient mesh (mesh_context) has a
+    # live ``expert`` axis — a data-dependent gather along the sharded E axis
+    # makes GSPMD all-gather the full expert weights to every chip each step,
+    # far worse than the dispatch einsums. Set False to force the dispatch
+    # path for expert-sharded meshes installed outside ``use_mesh``.
+    decode_gather_ffn: bool = True
     # Opt-in for MoE inside pipeline stages WITH a context axis: routing and
     # expert capacity are then computed per local sequence chunk (S/cp
     # tokens) instead of the full sequence. Per-token top-k decisions are
@@ -111,6 +119,17 @@ def moe_init(rng: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
     }
 
 
+def _route(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
+    """Shared router: softmax over expert logits, top-k, renormalized gates
+    (Mixtral renormalizes over the selected experts). One definition so the
+    training dispatch and the decode gather can never desynchronize."""
+    logits = x.astype(jnp.float32) @ lw["router"]            # (B, S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, cfg.experts_per_token)
+    gate_vals = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
+    return probs, gate_vals, gate_idx
+
+
 def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
             ep_axis=None, tp_axis=None):
     """Top-k MoE with capacity-bounded one-hot dispatch.
@@ -131,18 +150,13 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     E, K = cfg.n_experts, cfg.experts_per_token
     capacity = max(1, int(cfg.capacity_factor * s * K / E))
 
-    logits = (x.astype(jnp.float32) @ lw["router"])          # (B, S, E)
-    probs = jax.nn.softmax(logits, axis=-1)
+    probs, gate_vals, gate_idx = _route(cfg, x, lw)
 
     # aux load-balancing loss (Switch-style): E * Σ_e fraction_e * prob_e
     # computed on top-1 assignments
     top1 = jnp.argmax(probs, axis=-1)
     frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=(0, 1))
     aux = E * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
-
-    # top-k gates, renormalized (Mixtral renormalizes over selected experts)
-    gate_vals, gate_idx = lax.top_k(probs, K)                # (B, S, K)
-    gate_vals = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
 
     # position of each (token, k) inside its expert's capacity buffer
     expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (B,S,K,E)
@@ -180,6 +194,35 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     if reduce:
         out = lax.psum(out, reduce)
     return out, aux
+
+
+def moe_ffn_decode(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
+    """Decode-specialized top-k MoE: gather the K chosen experts' weights per
+    token and run only those FFNs.
+
+    The training path (``moe_ffn``) streams all E experts' weights from HBM
+    every call — right when tokens cover most experts, pure waste at decode
+    (T=1, small B) where only B*K expert FFNs have any work. Here the weight
+    traffic is B*T*K expert matrices instead of E. No aux loss: nothing is
+    training.
+
+    Callers must gate on T == 1: with a single token per sequence the K
+    chosen experts can never overflow a capacity slot, so this is bit-
+    equivalent to the dispatch path; at T > 1 it would silently skip the
+    capacity-drop semantics. Keep ``cfg.decode_gather_ffn`` off for
+    expert-sharded serving (see its comment).
+
+    x: (B, T, D) → (B, T, D).
+    """
+    _, gate_vals, gate_idx = _route(cfg, x, lw)              # (B, T, K)
+
+    wg = lw["experts"]["w_gate"][gate_idx]                   # (B, T, K, D, F)
+    wu = lw["experts"]["w_up"][gate_idx]
+    wd = lw["experts"]["w_down"][gate_idx]                   # (B, T, K, F, D)
+    h = jax.nn.silu(jnp.einsum("btd,btkdf->btkf", x, wg)) \
+        * jnp.einsum("btd,btkdf->btkf", x, wu)
+    out = jnp.einsum("btkf,btkfd->btkd", h, wd)
+    return jnp.einsum("btk,btkd->btd", gate_vals.astype(x.dtype), out)
 
 
 def _moe_layer(cfg: MoeConfig, carry, lw: Dict[str, jax.Array], freqs,

@@ -119,3 +119,46 @@ def test_moe_greedy_generation():
     assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
     out2 = generate(params, prompt, cfg, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_moe_ffn_decode_matches_dispatch():
+    """The gather-K decode FFN equals the capacity-buffer dispatch whenever
+    nothing overflows (T=1 ⇒ each chosen expert has a free slot)."""
+    from kubetorch_tpu.models.moe import moe_ffn, moe_ffn_decode, moe_init
+
+    cfg = _moe_cfg()
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    lw = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(3), (3, 1, cfg.dim), jnp.float32)
+    dense, _ = moe_ffn(cfg, x, lw)
+    gathered = moe_ffn_decode(cfg, x, lw)
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_expert_mesh_disables_gather_decode(monkeypatch):
+    """Under an ambient mesh with a live expert axis the decode step must use
+    the dispatch path (a gather along the sharded E axis would all-gather
+    every expert's weights per step)."""
+    from kubetorch_tpu.models import generate as gen_mod
+    from kubetorch_tpu.models.moe import moe_init
+    from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
+    from kubetorch_tpu.parallel.mesh_context import use_mesh
+
+    cfg = _moe_cfg()
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    calls = []
+    real = gen_mod.moe_ffn_decode
+    monkeypatch.setattr(gen_mod, "moe_ffn_decode",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+
+    def decode_once():
+        cache = init_cache(cfg, 1, 4)
+        return forward_with_cache(params, jnp.zeros((1, 1), jnp.int32),
+                                  cache, 0, cfg)[0]
+
+    with use_mesh(build_mesh(MeshSpec(expert=2), devices=jax.devices()[:2])):
+        decode_once()
+    assert not calls, "gather path must be disabled under an expert mesh"
+    decode_once()
+    assert calls, "gather path should be active without an expert mesh"

@@ -15,6 +15,7 @@ inference services run.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, NamedTuple, Optional
@@ -57,7 +58,39 @@ def _cached_attention(q, cache_k, cache_v, q_pos, scale):
     return out.reshape(b, t, nh, hd)
 
 
-def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
+# Read ONCE at import: the gate runs at trace time inside jitted generate(),
+# and jit's cache key never sees the env var — a post-compile flip would be
+# silently ignored. Import-time freezing makes the semantics honest: the flag
+# is per-process (restart to change), matching how serving processes are
+# configured. 1 forces the flash prefill on (interpret mode off-TPU — how
+# tests cover the branch), 0 forces it off.
+_FLASH_PREFILL_FLAG = os.environ.get("KT_FLASH_PREFILL", "auto")
+
+
+def _flash_prefill_wanted(cfg, t: int) -> bool:
+    """Route a from-zero prefill through the Pallas flash kernel?
+
+    The cached-attention einsum materializes a (T, S_max) logits tile per
+    head — the HBM wall for long prompts. A prefill starting at position 0
+    attends only within its own T tokens (every cache slot beyond them is
+    unwritten and masked), so it is exactly causal self-attention and the
+    flash kernel applies. Gated to configs that allow the flash kernel
+    (``attn_impl`` auto/flash — an explicit "xla" is a deliberate opt-out,
+    e.g. an unsupported head_dim), to T a multiple of the 128-lane tile
+    (serving pads prompts), and to the TPU backend.
+    """
+    if _FLASH_PREFILL_FLAG == "0":
+        return False
+    if cfg.attn_impl not in ("auto", "flash"):
+        return False
+    shape_ok = t >= 128 and t % 128 == 0
+    if _FLASH_PREFILL_FLAG == "1":
+        return shape_ok
+    return shape_ok and jax.default_backend() == "tpu"
+
+
+def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
+                flash_prefill: bool = False):
     """One transformer layer over T new tokens, updating this layer's cache."""
     b, t, d = x.shape
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
@@ -72,8 +105,13 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
     layer_cache_v = lax.dynamic_update_slice_in_dim(
         layer_cache_v, v.astype(layer_cache_v.dtype), q_pos[0], axis=1)
 
-    attn = _cached_attention(q, layer_cache_k, layer_cache_v, q_pos,
-                             cfg.head_dim ** -0.5)
+    if flash_prefill:
+        from ..ops.attention import flash_attention
+        attn = flash_attention(q, k, v, causal=True,
+                               scale=cfg.head_dim ** -0.5)
+    else:
+        attn = _cached_attention(q, layer_cache_k, layer_cache_v, q_pos,
+                                 cfg.head_dim ** -0.5)
     x = x + attn.reshape(b, t, -1) @ lw["wo"]
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     if "router" in lw:
@@ -86,14 +124,17 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full):
         # also mechanically disabled under an ambient mesh with a live
         # ``expert`` axis: a data-dependent gather along the sharded E axis
         # would force GSPMD to all-gather every expert's weights per step.
-        # All inputs are static at trace time ⇒ the choice is fixed per
-        # compile.
+        # Traffic headroom: the gather writes B*K expert-matrix copies and
+        # re-reads them in the einsum (~2x beyond the read), so it must beat
+        # the dispatch path's single stream of all E experts with margin —
+        # hence 2*B*K <= E, not B*K <= E. All inputs are static at trace
+        # time ⇒ the choice is fixed per compile.
         from ..parallel.mesh import AXIS_EXPERT
         from ..parallel.mesh_context import axis_size, current_mesh
 
         if (t == 1 and cfg.decode_gather_ffn
                 and axis_size(current_mesh(), AXIS_EXPERT) == 1
-                and b * cfg.experts_per_token <= cfg.n_experts):
+                and 2 * b * cfg.experts_per_token <= cfg.n_experts):
             ffn = moe_ffn_decode(cfg, h, lw)
         else:
             ffn, _ = moe_ffn(cfg, h, lw)
@@ -111,11 +152,15 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
     x = params["embed"][tokens].astype(cfg.dtype)
     freqs_full = rope_freqs(cfg, cache.k.shape[2])
     q_pos = start_pos + jnp.arange(t)
+    # static decision: only a from-zero prefill is pure causal self-attention
+    flash_prefill = (isinstance(start_pos, int) and start_pos == 0
+                     and _flash_prefill_wanted(cfg, t))
 
     def body(carry, layer_inputs):
         h = carry
         lw, ck, cv = layer_inputs
-        h, ck, cv = _layer_step(cfg, h, lw, ck, cv, q_pos, freqs_full)
+        h, ck, cv = _layer_step(cfg, h, lw, ck, cv, q_pos, freqs_full,
+                                flash_prefill=flash_prefill)
         return h, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))

@@ -162,3 +162,45 @@ def test_expert_mesh_disables_gather_decode(monkeypatch):
     assert not calls, "gather path must be disabled under an expert mesh"
     decode_once()
     assert calls, "gather path should be active without an expert mesh"
+
+
+def test_flash_prefill_matches_einsum_prefill(monkeypatch):
+    """The flash-kernel prefill branch (T % 128 == 0, start_pos=0) produces
+    the same logits as the cached-attention einsum — and actually runs."""
+    from kubetorch_tpu.models import generate as gen_mod
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.ops import attention as attn_mod
+
+    cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=96, max_seq_len=256,
+                      attn_impl="flash", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0,
+                                cfg.vocab_size)
+
+    monkeypatch.setattr(gen_mod, "_FLASH_PREFILL_FLAG", "0")
+    ref, ref_cache = forward_with_cache(params, tokens,
+                                        init_cache(cfg, 2, 160), 0, cfg)
+
+    calls = []
+    real = attn_mod.flash_attention
+    monkeypatch.setattr(attn_mod, "flash_attention",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    monkeypatch.setattr(gen_mod, "_FLASH_PREFILL_FLAG", "1")
+    out, out_cache = forward_with_cache(params, tokens,
+                                        init_cache(cfg, 2, 160), 0, cfg)
+    assert calls, "flash prefill branch did not engage"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out_cache.k), np.asarray(ref_cache.k),
+                               rtol=1e-5, atol=1e-5)
+
+    # an explicit attn_impl="xla" is a deliberate flash opt-out: honored even
+    # under the force flag
+    calls.clear()
+    xla_cfg = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=96, max_seq_len=256,
+                          attn_impl="xla", dtype=jnp.float32, remat=False)
+    forward_with_cache(llama_init(jax.random.PRNGKey(0), xla_cfg), tokens,
+                       init_cache(xla_cfg, 2, 160), 0, xla_cfg)
+    assert not calls, "attn_impl='xla' must opt out of flash prefill"

@@ -32,6 +32,7 @@ EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
                 "node_modules", ".venv", "venv", ".ktsync"}
 EXCLUDE_SUFFIXES = (".pyc", ".pyo", ".so.tmp")
 MANIFEST_FILE = ".ktsync-manifest.json"
+HASH_CACHE_FILE = os.path.join(".ktsync", "hash-cache.json")
 MAX_FILE_SIZE = 10 * 1024 ** 3  # parity with the reference's 10G nginx cap
 
 
@@ -47,10 +48,20 @@ def file_hash(path: str, chunk: int = 1 << 20) -> str:
 
 
 def build_manifest(root: str) -> Dict[str, Dict]:
-    """{relpath: {hash, size, mode}} for every syncable file under root."""
+    """{relpath: {hash, size, mode}} for every syncable file under root.
+
+    Hashes are memoized in ``.ktsync/hash-cache.json`` keyed by
+    (size, mtime_ns): the warm push — the 1-2s iteration loop's hot path —
+    re-hashes only files whose stat changed instead of the whole tree. A
+    missing or corrupt cache only costs re-hashing. Same quick-check
+    semantics as rsync: an edit that preserves both size and mtime_ns is
+    treated as unchanged.
+    """
     rootp = Path(root)
     if not rootp.is_dir():
         raise SyncError(f"Sync root {root!r} is not a directory")
+    cache = _load_hash_cache(root)
+    new_cache: Dict[str, Dict] = {}
     out: Dict[str, Dict] = {}
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in EXCLUDE_DIRS]
@@ -65,9 +76,43 @@ def build_manifest(root: str) -> Dict[str, Dict]:
             if not stat.S_ISREG(st.st_mode) or st.st_size > MAX_FILE_SIZE:
                 continue
             rel = os.path.relpath(fpath, root)
-            out[rel] = {"hash": file_hash(fpath), "size": st.st_size,
+            cached = cache.get(rel)
+            if (cached and cached.get("size") == st.st_size
+                    and cached.get("mtime_ns") == st.st_mtime_ns):
+                digest = cached["hash"]
+            else:
+                digest = file_hash(fpath)
+            new_cache[rel] = {"hash": digest, "size": st.st_size,
+                              "mtime_ns": st.st_mtime_ns}
+            out[rel] = {"hash": digest, "size": st.st_size,
                         "mode": st.st_mode & 0o777}
+    _save_hash_cache(root, new_cache)
     return out
+
+
+def _load_hash_cache(root: str) -> Dict[str, Dict]:
+    path = os.path.join(root, HASH_CACHE_FILE)
+    try:
+        cache = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return {}
+    # anything but the expected dict-of-dicts shape (truncation, another
+    # tool's file) degrades to re-hashing, never to a crash
+    if not isinstance(cache, dict):
+        return {}
+    return {k: v for k, v in cache.items()
+            if isinstance(v, dict) and "hash" in v}
+
+
+def _save_hash_cache(root: str, cache: Dict[str, Dict]) -> None:
+    path = os.path.join(root, HASH_CACHE_FILE)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        Path(tmp).write_text(json.dumps(cache))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # read-only tree: every push just re-hashes
 
 
 def push_tree(store_url: str, key: str, root: str,

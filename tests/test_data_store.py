@@ -260,3 +260,32 @@ def test_prefetch_to_device_orders_and_shards(cpu_mesh_devices):
 
     with pytest.raises(ValueError, match="size"):
         list(prefetch_to_device(iter([]), size=0))
+
+
+def test_manifest_hash_cache(project, monkeypatch):
+    """Warm manifest builds reuse cached hashes (stat-keyed); edits and
+    cache corruption re-hash."""
+    from kubetorch_tpu.data_store import sync as sync_mod
+
+    calls = []
+    real = sync_mod.file_hash
+    monkeypatch.setattr(sync_mod, "file_hash",
+                        lambda p, **k: calls.append(p) or real(p, **k))
+
+    first = build_manifest(str(project))
+    assert len(calls) == 2
+    calls.clear()
+    assert build_manifest(str(project)) == first          # warm: zero hashing
+    assert calls == []
+
+    (project / "main.py").write_text("print('bye')\n")    # edit → one re-hash
+    m = build_manifest(str(project))
+    assert [os.path.basename(p) for p in calls] == ["main.py"]
+    assert m["main.py"]["hash"] != first["main.py"]["hash"]
+    assert m["pkg/mod.py"] == first["pkg/mod.py"]
+
+    for corrupt in ("not json", '"oops"', '{"main.py": "zzz"}'):
+        (project / ".ktsync" / "hash-cache.json").write_text(corrupt)
+        calls.clear()
+        assert build_manifest(str(project)) == m          # corrupt cache: rebuilt
+        assert len(calls) == 2

@@ -552,4 +552,9 @@ def main(argv: Optional[list] = None) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # delegate to the canonical module: running via ``-m`` makes this
+    # file ``__main__``, and module-level singletons must not be split
+    # from the copies the rest of the package imports
+    from kubetorch_tpu.controller.app import main as _canonical_main
+
+    _canonical_main()

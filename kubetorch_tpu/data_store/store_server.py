@@ -283,4 +283,9 @@ def main(argv=None) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # delegate to the canonical module: running via ``-m`` makes this
+    # file ``__main__``, and module-level singletons must not be split
+    # from the copies the rest of the package imports
+    from kubetorch_tpu.data_store.store_server import main as _canonical_main
+
+    _canonical_main()

@@ -233,10 +233,12 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
     block_q = min(block_q, s)
     block_k = min(block_k, s)
 
-    # delta = rowsum(dO * O) — the softmax-grad correction term, broadcast to
-    # the lane-major stat layout (see LANES).
+    # delta = rowsum(dO * O) — the softmax-grad correction term. Both stats
+    # are broadcast on the fly into the 128-lane layout (see LANES) here;
+    # the residual itself is stored narrow.
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -313,7 +315,9 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    # keep only lane 0 as the residual — holding the full 128-lane stat from
+    # forward to backward would be a 128x HBM blow-up per un-remat'd layer
+    return out, (q, k, v, out, lse[..., 0])
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, dout):

@@ -9,7 +9,9 @@ type on the caller's side.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 import uuid
 from typing import Any, Dict, Optional
 
@@ -164,10 +166,19 @@ class HTTPClient:
         if not api:
             return None
         stop = threading.Event()
+        # Keep draining after the call returns: the pod batches log pushes
+        # (~1s) and the controller ingest adds latency, so the lines printed
+        # at the end of a request land AFTER its response (the reference's
+        # LoggingConfig grace-period behavior, globals.py:61-102).
+        grace = float(os.environ.get("KT_LOG_STREAM_GRACE", "3.0"))
 
         def pump():
             seen = 0
-            while not stop.is_set():
+            stopped_at = None
+            while True:
+                if stop.is_set() and stopped_at is None:
+                    stopped_at = time.monotonic()
+                got = 0
                 try:
                     r = _requests.get(
                         f"{api}/controller/logs",
@@ -177,15 +188,29 @@ class HTTPClient:
                         data = r.json()
                         for entry in data.get("entries", []):
                             print(f"[remote] {entry['line']}")
+                            got += 1
                         seen = data.get("offset", seen)
                 except _requests.RequestException:
                     pass
-                stop.wait(0.5)
+                if stopped_at is not None:
+                    elapsed = time.monotonic() - stopped_at
+                    # drain until quiet: once the pod's ~1s flush interval has
+                    # passed and a fetch comes back empty, everything the
+                    # request produced has been echoed; grace bounds it
+                    if elapsed >= grace or (got == 0 and elapsed >= 1.25):
+                        return
+                    time.sleep(0.25)    # Event.wait would return instantly now
+                else:
+                    stop.wait(0.5)
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
 
         def stopper():
             stop.set()
+            # bounded join: without it a process exiting right after its call
+            # would kill the daemon pump before the trailing lines (batched
+            # ~1s in the pod) ever arrive — the drain must actually happen
+            t.join(grace + 2.0)
 
         return stopper

@@ -552,4 +552,12 @@ def main(argv: Optional[list] = None) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # Re-import under the canonical name: ``python -m ...http_server`` makes
+    # this file ``__main__``, and building the app from that duplicate module
+    # would split every module-level singleton — request_id_var above, the
+    # ServerState caches — from the copies the rest of the package imports
+    # (symptom: rank logs lose their request-id labels because the middleware
+    # sets one ContextVar and ProcessPool._submit reads another).
+    from kubetorch_tpu.serving.http_server import main as _canonical_main
+
+    _canonical_main()

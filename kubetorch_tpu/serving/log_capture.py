@@ -91,7 +91,11 @@ class LogCapture:
         self._thread.start()
         atexit.register(self.stop)
 
-    def add(self, line: str, source: str = "stdout", level: str = "INFO") -> None:
+    def add(self, line: str, source: str = "stdout", level: str = "INFO",
+            request_id: Optional[str] = None) -> None:
+        """``request_id=None`` → this process's contextvar (server-side
+        interception); an explicit value (may be "") is authoritative —
+        rank-subprocess logs arrive with their own binding."""
         from .http_server import request_id_var
 
         entry = {
@@ -99,7 +103,8 @@ class LogCapture:
             "line": line,
             "source": source,
             "level": level,
-            "request_id": request_id_var.get(""),
+            "request_id": (request_id if request_id is not None
+                           else request_id_var.get("")),
             **self.labels,
         }
         flush_now = False

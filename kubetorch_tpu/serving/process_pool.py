@@ -81,7 +81,8 @@ class ProcessPool:
         cap = LogCapture._global
         if cap is not None:
             cap.add(resp.get("line", ""),
-                    source=f"rank{resp.get('rank', '?')}-{resp.get('source', 'stdout')}")
+                    source=f"rank{resp.get('rank', '?')}-{resp.get('source', 'stdout')}",
+                    request_id=resp.get("request_id", ""))
 
     @staticmethod
     def _resolve(fut: asyncio.Future, resp: Dict) -> None:
@@ -104,7 +105,11 @@ class ProcessPool:
         fut = self._loop.create_future()
         with self._futures_lock:
             self._futures[req_id] = fut
-        worker.submit({"req_id": req_id, **payload})
+        # carry the HTTP request id across the process boundary so the
+        # worker's prints stay correlated to this call in the log stream
+        from .http_server import request_id_var
+        worker.submit({"req_id": req_id,
+                       "request_id": request_id_var.get(""), **payload})
         return await asyncio.wait_for(fut, timeout)
 
     async def call(self, idx: int, method: Optional[str], args: list,

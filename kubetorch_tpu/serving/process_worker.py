@@ -18,6 +18,7 @@ TPU-first deltas:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import multiprocessing as mp
 import os
 import queue as queue_mod
@@ -31,6 +32,14 @@ from ..resources.pointers import Pointers, import_callable
 from .env_contract import RankInfo, framework_for
 
 _SYNC_EXECUTOR_THREADS = 40  # matches the server's sync-callable concurrency
+
+
+# The HTTP X-Request-ID travels server → worker in the request item and is
+# re-bound here per handled request, so rank prints stay correlated to the
+# originating call even across the process boundary (the reference threads
+# the same label through its subprocess LogCapture queue).
+_rank_request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "kt_rank_request_id", default="")
 
 
 class _QueueTee:
@@ -49,7 +58,8 @@ class _QueueTee:
             try:
                 self.response_q.put({"op": "log", "line": data.rstrip("\n"),
                                      "source": self.source,
-                                     "rank": os.environ.get("RANK", "0")})
+                                     "rank": os.environ.get("RANK", "0"),
+                                     "request_id": _rank_request_id.get("")})
             except Exception:
                 pass
         return len(data)
@@ -196,6 +206,7 @@ async def _handle_profile(item: Dict, response_q) -> None:
 
 async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> None:
     req_id = item.get("req_id")
+    _rank_request_id.set(item.get("request_id", ""))
     try:
         if load_error is not None:
             raise load_error
@@ -209,7 +220,12 @@ async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> 
             result = await fn(*args, **kwargs)
         else:
             loop = asyncio.get_running_loop()
-            result = await loop.run_in_executor(executor, lambda: fn(*args, **kwargs))
+            # copy_context: run_in_executor does not propagate contextvars,
+            # and sync user code printing from the executor thread must keep
+            # its request-id binding
+            ctx = contextvars.copy_context()
+            result = await loop.run_in_executor(
+                executor, lambda: ctx.run(lambda: fn(*args, **kwargs)))
         response_q.put({"req_id": req_id, "ok": True, "result": _host_view(result)})
     except BaseException as e:  # noqa: BLE001
         oom = detect_hbm_oom(e)

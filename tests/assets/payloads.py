@@ -90,3 +90,8 @@ class WarmupCrasher:
 
     def ping(self):
         return "alive"
+
+
+def shouter(msg):
+    print(f"SHOUT:{msg}")
+    return msg.upper()

@@ -1,0 +1,140 @@
+"""Controller TTL reaper (reference: controller background TTL task polling
+``kubetorch_last_activity_timestamp`` and deleting expired workloads —
+SURVEY §2.7; reference test model: tests/test_autodown.py).
+
+Exercises the real ``_ttl_loop`` against a live aiohttp metrics stub: idle
+workloads are torn down through the backend, active / no-TTL / unreachable
+ones are left alone, and a failing backend retries instead of dropping the
+record.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from kubetorch_tpu.controller import app as controller_app
+from kubetorch_tpu.controller.app import ControllerState, _ttl_loop
+
+pytestmark = pytest.mark.level("unit")
+
+
+class FakeBackend:
+    def __init__(self, fail_times: int = 0):
+        self.deleted = []
+        self.fail_times = fail_times
+
+    def delete(self, namespace, name):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("backend transient failure")
+        self.deleted.append((namespace, name))
+        return True
+
+
+async def _metrics_server(last_activity):
+    """Serve /metrics with a controllable activity timestamp."""
+    from aiohttp import web
+
+    async def metrics(request):
+        if last_activity["ts"] is None:
+            return web.Response(status=500, text="no metrics")
+        return web.Response(
+            text=f"kubetorch_last_activity_timestamp {last_activity['ts']}\n")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _workload(name, url, ttl):
+    return {"namespace": "default", "name": name, "service_url": url,
+            "inactivity_ttl": ttl}
+
+
+async def _run_loop_until(state, predicate, timeout=10.0):
+    task = asyncio.create_task(_ttl_loop(state))
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if predicate():
+                return True
+            await asyncio.sleep(0.05)
+        return False
+    finally:
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+
+def test_idle_workload_reaped(monkeypatch):
+    monkeypatch.setattr(controller_app, "TTL_CHECK_INTERVAL_S", 0.05)
+
+    async def body():
+        last = {"ts": time.time() - 3600}
+        runner, url = await _metrics_server(last)
+        try:
+            backend = FakeBackend()
+            state = ControllerState(backend=backend)
+            state.workloads["default/idle"] = _workload("idle", url, ttl=1)
+            state.workloads["default/no-ttl"] = _workload("no-ttl", url, ttl=None)
+            assert await _run_loop_until(
+                state, lambda: ("default", "idle") in backend.deleted)
+            assert "default/idle" not in state.workloads
+            assert "default/no-ttl" in state.workloads   # no TTL → never reaped
+            assert any("TTL expired" in e["message"] for e in state.events)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(body())
+
+
+def test_active_workload_survives(monkeypatch):
+    monkeypatch.setattr(controller_app, "TTL_CHECK_INTERVAL_S", 0.05)
+
+    async def body():
+        last = {"ts": time.time() + 3600}    # activity fresher than any check
+        runner, url = await _metrics_server(last)
+        try:
+            backend = FakeBackend()
+            state = ControllerState(backend=backend)
+            state.workloads["default/busy"] = _workload("busy", url, ttl=1)
+            # unreachable metrics must not be treated as idle
+            state.workloads["default/dark"] = _workload(
+                "dark", "http://127.0.0.1:1", ttl=1)
+            assert not await _run_loop_until(
+                state, lambda: backend.deleted, timeout=1.0)
+            assert set(state.workloads) == {"default/busy", "default/dark"}
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(body())
+
+
+def test_backend_failure_retries(monkeypatch):
+    """A transient backend failure keeps the record so the next cycle
+    retries the teardown instead of leaking the workload."""
+    monkeypatch.setattr(controller_app, "TTL_CHECK_INTERVAL_S", 0.05)
+
+    async def body():
+        last = {"ts": time.time() - 3600}
+        runner, url = await _metrics_server(last)
+        try:
+            backend = FakeBackend(fail_times=2)
+            state = ControllerState(backend=backend)
+            state.workloads["default/flaky"] = _workload("flaky", url, ttl=1)
+            assert await _run_loop_until(
+                state, lambda: ("default", "flaky") in backend.deleted)
+            assert "default/flaky" not in state.workloads
+            assert any("will retry" in e["message"] for e in state.events)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(body())

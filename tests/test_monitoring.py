@@ -1,0 +1,119 @@
+"""Monitoring e2e (reference: tests/test_monitoring.py, 467 LoC — log
+streaming from remote calls, request-id correlation, metric surface).
+
+Local-stack version: a deployed fn prints; the pod's LogCapture pushes to the
+controller's log buffer; the client (a) queries the buffer by service and
+request id and (b) live-streams the lines during the call.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.level("minimal")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "assets"))
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.client import controller_client, shutdown_local_controller
+from kubetorch_tpu.config import reset_config
+
+import payloads  # tests/assets
+
+
+@pytest.fixture(scope="module", autouse=True)
+def local_stack():
+    from kubetorch_tpu.client import _read_running_local
+
+    prior_user = os.environ.get("KT_USERNAME")
+    preexisting_daemon = _read_running_local() is not None
+    reset_config()
+    os.environ["KT_USERNAME"] = "t-mon"
+    reset_config()
+    yield
+    try:
+        for w in controller_client().list_workloads():
+            if w["name"].startswith("t-mon"):
+                controller_client().delete_workload(w["namespace"], w["name"])
+    except Exception:
+        pass
+    if not preexisting_daemon:
+        shutdown_local_controller()
+    if prior_user is None:
+        os.environ.pop("KT_USERNAME", None)
+    else:
+        os.environ["KT_USERNAME"] = prior_user
+    reset_config()
+
+
+@pytest.fixture(scope="module")
+def remote_shouter():
+    sys.modules.setdefault("payloads", payloads)
+    f = kt.fn(payloads.shouter)
+    f.to(kt.Compute(cpus=1))
+    return f
+
+
+def _poll_logs(match, service=None, timeout=20.0, **params):
+    cc = controller_client()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        entries = cc.logs(service=service, **params).get("entries", [])
+        hits = [e for e in entries if match in e.get("line", "")]
+        if hits:
+            return hits
+        time.sleep(0.5)
+    return []
+
+
+@pytest.mark.slow
+def test_remote_print_lands_in_controller_buffer(remote_shouter):
+    assert remote_shouter("alpha") == "ALPHA"
+    hits = _poll_logs("SHOUT:alpha", service=remote_shouter.name)
+    assert hits, "remote stdout never reached the controller log buffer"
+    entry = hits[0]
+    # labeled like the reference's Loki schema: service/pod/level/request_id
+    assert entry.get("service") == remote_shouter.name
+    assert entry.get("request_id"), "log line lost its request-id label"
+
+
+@pytest.mark.slow
+def test_request_id_filtering_isolates_calls(remote_shouter):
+    remote_shouter("beta")
+    remote_shouter("gamma")
+    beta = _poll_logs("SHOUT:beta", service=remote_shouter.name)
+    gamma = _poll_logs("SHOUT:gamma", service=remote_shouter.name)
+    assert beta and gamma
+    rid = beta[0]["request_id"]
+    assert rid != gamma[0]["request_id"]
+    cc = controller_client()
+    only = cc.logs(request_id=rid).get("entries", [])
+    lines = [e["line"] for e in only]
+    assert any("SHOUT:beta" in l for l in lines)
+    assert not any("SHOUT:gamma" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_client_streams_logs_during_call(remote_shouter, capsys, monkeypatch):
+    """With api_url configured, the HTTP client live-echoes the remote lines
+    locally (reference: WS Loki streaming filtered by X-Request-ID)."""
+    cc = controller_client()
+    monkeypatch.setenv("KT_API_URL", cc.base_url)
+    monkeypatch.setenv("KT_STREAM_LOGS", "1")
+    reset_config()
+    try:
+        remote_shouter("delta")
+        deadline = time.time() + 20
+        streamed = ""
+        while time.time() < deadline:
+            streamed += capsys.readouterr().out
+            if "SHOUT:delta" in streamed:
+                break
+            time.sleep(0.5)
+        assert "SHOUT:delta" in streamed, "no live-streamed remote log line"
+    finally:
+        monkeypatch.delenv("KT_API_URL", raising=False)
+        monkeypatch.delenv("KT_STREAM_LOGS", raising=False)
+        reset_config()

@@ -473,13 +473,18 @@ def server():
 
 
 @server.command("start")
-@click.option("--port", type=int, default=32300)
+@click.option("--port", type=int, default=None)
 @click.option("--workload", default=None,
               help="BYO: register under this workload name")
 def server_start(port, workload):
     """Start the pod runtime (BYO compute bootstrap, reference cli.py:2846)."""
+    from .constants import DEFAULT_SERVER_PORT
     if workload:
         os.environ.setdefault("KT_SERVICE_NAME", workload)
+    port = port or int(os.environ.get("KT_SERVER_PORT") or DEFAULT_SERVER_PORT)
+    # the WS registration reads KT_SERVER_PORT to advertise a routable URL —
+    # a --port flag alone must not leave it pointing at the default
+    os.environ["KT_SERVER_PORT"] = str(port)
     from .serving.http_server import main as server_main
     server_main(["--port", str(port)])
 

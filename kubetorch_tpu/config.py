@@ -19,6 +19,8 @@ from dataclasses import dataclass, field, fields
 from pathlib import Path
 from typing import Any, Dict, Optional
 
+from .constants import DEFAULT_SERVER_PORT
+
 _TRUTHY = ("1", "true", "yes", "on")
 
 
@@ -38,7 +40,7 @@ class KTConfig:
     stream_metrics: bool = False
     serialization: str = "json"
     launch_timeout: int = 900                # KT_LAUNCH_TIMEOUT, reference constants.py:79
-    server_port: int = 32300                 # reference provisioning/constants.py
+    server_port: int = DEFAULT_SERVER_PORT   # reference provisioning/constants.py
     controller_port: int = 8080
     mds_port: int = 8081
     data_store_url: Optional[str] = None

@@ -1,0 +1,5 @@
+"""Shared wire-level constants (reference: provisioning/constants.py —
+ports, labels, timeouts). One definition so the pod server, controller, CLI,
+and client config can never drift apart."""
+
+DEFAULT_SERVER_PORT = 32300

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional
 
 from aiohttp import web, WSMsgType
 
+from ..constants import DEFAULT_SERVER_PORT
 from ..exceptions import package_exception
 from .backends import LocalBackend
 
@@ -62,6 +63,23 @@ class ControllerState:
     def connections(self, namespace: str, name: str) -> List[PodConnection]:
         return [c for c in self.pods.get(f"{namespace}/{name}", [])
                 if not c.ws.closed]
+
+    def resolve_service_url(self, namespace: str, name: str) -> Optional[str]:
+        """Manifest-declared URL, else one derived from a live pod
+        registration (BYO: no manifest ever declared one — reference:
+        controller creates a Service from the selector). Derived per-read so
+        a late-registering or restarted pod is never shadowed by a stale
+        stored URL."""
+        record = self.workloads.get(f"{namespace}/{name}", {})
+        url = record.get("service_url")
+        if url:
+            return url
+        conns = self.connections(namespace, name)
+        if not conns:
+            return None
+        info = conns[0].info
+        port = info.get("server_port", DEFAULT_SERVER_PORT)
+        return f"http://{info.get('pod_ip')}:{port}"
 
     def record_event(self, service_key: str, message: str) -> None:
         self.events.append({"ts": time.time(), "service": service_key,
@@ -186,7 +204,9 @@ async def register_workload(request: web.Request) -> web.Response:
         namespace, name, {**body.get("metadata", {}), "KT_LAUNCH_ID": launch_id},
         launch_id)
     return web.json_response({"ok": True, "launch_id": launch_id,
-                              "reloaded_pods": reload_results})
+                              "reloaded_pods": reload_results,
+                              "service_url": state.resolve_service_url(
+                                  namespace, name)})
 
 
 async def get_workload(request: web.Request) -> web.Response:
@@ -198,6 +218,8 @@ async def get_workload(request: web.Request) -> web.Response:
     pods = state.connections(request.match_info["ns"], request.match_info["name"])
     out = dict(record)
     out["connected_pods"] = [c.pod_name for c in pods]
+    out["service_url"] = state.resolve_service_url(
+        request.match_info["ns"], request.match_info["name"])
     if state.backend is not None:
         out["pod_ips"] = state.backend.pod_ips(
             request.match_info["ns"], request.match_info["name"]) or \
@@ -318,12 +340,12 @@ async def proxy_service(request: web.Request) -> web.Response:
     if ":" in svc_port:
         service, port = svc_port.rsplit(":", 1)
     else:
-        service, port = svc_port, "32300"
+        service, port = svc_port, str(DEFAULT_SERVER_PORT)
 
     ips = state.backend.pod_ips(ns, service) if state.backend else []
-    record = state.workloads.get(_workload_key(ns, service), {})
-    if not ips and record.get("service_url"):
-        target = record["service_url"].rstrip("/")
+    resolved = state.resolve_service_url(ns, service)
+    if not ips and resolved:
+        target = resolved.rstrip("/")
     elif ips:
         target = f"http://{ips[0]}:{port}"
     else:
@@ -420,7 +442,8 @@ async def _ttl_loop(state: ControllerState) -> None:
                 ttl = record.get("inactivity_ttl")
                 if not ttl:
                     continue
-                url = record.get("service_url")
+                url = state.resolve_service_url(record["namespace"],
+                                                record["name"])
                 if not url:
                     continue
                 try:

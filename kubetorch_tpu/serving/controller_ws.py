@@ -12,11 +12,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import uuid
 from typing import Optional
 
 import aiohttp
 
+from ..constants import DEFAULT_SERVER_PORT
 from .discovery import my_pod_ip
 from .env_contract import KT_SERVICE_NAME, apply_metadata
 
@@ -66,8 +68,15 @@ class ControllerWebSocket:
                         "pod_name": self.state.pod_name,
                         "pod_ip": my_pod_ip(),
                         "namespace": self.state.namespace,
-                        "service_name": __import__("os").environ.get(KT_SERVICE_NAME, ""),
+                        "service_name": os.environ.get(KT_SERVICE_NAME, ""),
                         "launch_id": self.state.launch_id,
+                        # lets the controller derive a routable service_url for
+                        # BYO pods, where no manifest ever declared one
+                        # `or`: an empty KT_SERVER_PORT must not make int()
+                        # raise inside this try block, where the reconnect
+                        # loop would silently swallow it forever
+                        "server_port": int(os.environ.get("KT_SERVER_PORT")
+                                           or DEFAULT_SERVER_PORT),
                     })
                     async for msg in ws:
                         if msg.type != aiohttp.WSMsgType.TEXT:

@@ -8,6 +8,7 @@ type on the caller's side.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -48,6 +49,22 @@ class CustomResponse:
         self.raise_for_status()
         fmt = self.headers.get("X-Serialization", ser.JSON)
         return ser.deserialize(self.body, fmt)
+
+
+# Live log-stream pump threads: daemon threads die with the interpreter, so
+# a one-shot script exiting right after its call would lose the trailing log
+# lines the grace drain exists to deliver — the atexit hook joins them first.
+_LIVE_PUMPS: list = []
+
+
+def _drain_pumps_at_exit() -> None:
+    grace = float(os.environ.get("KT_LOG_STREAM_GRACE", "3.0"))
+    deadline = time.monotonic() + max(6.0, grace + 2.0)
+    for t in list(_LIVE_PUMPS):
+        t.join(max(0.0, deadline - time.monotonic()))
+
+
+atexit.register(_drain_pumps_at_exit)
 
 
 class HTTPClient:
@@ -203,14 +220,24 @@ class HTTPClient:
                 else:
                     stop.wait(0.5)
 
-        t = threading.Thread(target=pump, daemon=True)
+        def run_pump():
+            try:
+                pump()
+            finally:
+                try:
+                    _LIVE_PUMPS.remove(t)
+                except ValueError:
+                    pass
+
+        t = threading.Thread(target=run_pump, daemon=True)
+        _LIVE_PUMPS.append(t)
         t.start()
 
         def stopper():
+            # no join here: that would charge every streamed call the ~1.25s
+            # quiet-drain minimum. The pump drains in the background; the
+            # atexit hook below joins survivors so a one-shot script still
+            # sees the trailing lines (batched ~1s in the pod) before exit.
             stop.set()
-            # bounded join: without it a process exiting right after its call
-            # would kill the daemon pump before the trailing lines (batched
-            # ~1s in the pod) ever arrive — the drain must actually happen
-            t.join(grace + 2.0)
 
         return stopper

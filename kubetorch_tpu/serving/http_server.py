@@ -50,7 +50,9 @@ from .env_contract import (KT_ALLOWED_SERIALIZATION, KT_CALLABLE_TYPE,
                            KT_SERVICE_NAME, apply_metadata)
 from .supervisor_factory import supervisor_for
 
-DEFAULT_PORT = 32300
+from ..constants import DEFAULT_SERVER_PORT
+
+DEFAULT_PORT = DEFAULT_SERVER_PORT
 request_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
     "kt_request_id", default="")
 

@@ -85,13 +85,24 @@ def main() -> None:
         b = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
         for _ in range(warmup):
             state, m = step_fn(state, b)
-        jax.block_until_ready(m["loss"])
-        t0 = time.perf_counter()
+        float(m["loss"])  # host fetch: hard sync even where block_until_ready
+        t0 = time.perf_counter()  # is unreliable (axon relay)
         for _ in range(steps):
             state, m = step_fn(state, b)
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])
         dt = time.perf_counter() - t0
-        return batch_size * seq * steps / dt
+        tps = batch_size * seq * steps / dt
+        # Sanity: an impossible rate (> chip peak / ~1 flop/token) means the
+        # timing was an async-dispatch artifact; re-measure with a per-step
+        # host sync, which cannot overlap execution with the timer.
+        if on_tpu and tps * 6 * cfg.param_count() > peak_flops(dev):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = step_fn(state, b)
+                float(m["loss"])
+            dt = time.perf_counter() - t0
+            tps = batch_size * seq * steps / dt
+        return tps
 
     tokens_per_sec = None
     while batch >= 1:

@@ -88,6 +88,12 @@ class FrameworkEnv:
 
     name = "spmd"
     needs_restart_between_calls = False
+    # Whether rank identity may be rebound per request (worker-subset calls,
+    # reference spmd_supervisor.py:345-364 assembles env per call). True for
+    # frameworks whose collectives initialize inside the request (pytorch
+    # gloo/NCCL process groups, TF strategies, generic SPMD). False when
+    # identity is physically fixed at process spawn.
+    per_call_identity = True
 
     def env(self, info: RankInfo) -> Dict[str, str]:
         return {
@@ -117,6 +123,11 @@ class JaxEnv(FrameworkEnv):
     name = "jax"
     coordinator_port = 1234
     default_cache_dir = "/tmp/kt_jax_cache"
+    # TPU chips are exclusively owned from spawn and jax.distributed
+    # initializes once per process — the compiled mesh's identity cannot be
+    # rebound per request. Worker-subset calls keep deployment-wide identity
+    # (use shard_map sub-meshes inside the program to address chip subsets).
+    per_call_identity = False
 
     def env(self, info: RankInfo) -> Dict[str, str]:
         e = super().env(info)

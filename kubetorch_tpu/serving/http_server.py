@@ -50,9 +50,7 @@ from .env_contract import (KT_ALLOWED_SERIALIZATION, KT_CALLABLE_TYPE,
                            KT_SERVICE_NAME, apply_metadata)
 from .supervisor_factory import supervisor_for
 
-from ..constants import DEFAULT_SERVER_PORT
-
-DEFAULT_PORT = DEFAULT_SERVER_PORT
+from ..constants import server_port
 request_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
     "kt_request_id", default="")
 
@@ -140,7 +138,7 @@ class ServerState:
                 self.distributed_config(), pointers, self.init_args(),
                 service_name=os.environ.get(KT_SERVICE_NAME, ""),
                 namespace=self.namespace,
-                server_port=int(os.environ.get("KT_SERVER_PORT", DEFAULT_PORT)),
+                server_port=server_port(),
                 fn_name=pointers.cls_or_fn_name,
             )
             await asyncio.to_thread(sup.setup)
@@ -396,6 +394,24 @@ async def profile_route(request: web.Request) -> web.Response:
         return _error_response(e)
 
 
+async def serve_cached_data(request: web.Request) -> web.Response:
+    """P2P broadcast parent role (reference PodDataServer TCP serving,
+    pod_data_server.py:668-745 — TPU redesign per SURVEY §2.9: host-staged
+    bytes over the pod's existing HTTP server instead of a CUDA-IPC daemon):
+    serve a data-store key this pod already fetched, so later joiners in the
+    fan-out pull from us instead of the central store."""
+    from ..data_store.peer_cache import cache_get
+
+    key = request.match_info["key"]
+    entry = await asyncio.to_thread(cache_get, key)
+    if entry is None:
+        return web.json_response({"error": "not cached"}, status=404)
+    data, meta = entry
+    import json as _json
+    return web.Response(body=data, content_type="application/octet-stream",
+                        headers={"X-KT-Meta": _json.dumps(meta)})
+
+
 async def run_callable(request: web.Request) -> web.Response:
     """POST /{fn}[/{method}] → supervisor (reference run_callable :1720)."""
     state: ServerState = request.app["state"]
@@ -425,6 +441,8 @@ async def run_callable(request: web.Request) -> web.Response:
         call_kwargs: Dict[str, Any] = {}
         if is_subcall:
             call_kwargs["subtree"] = body.get("_kt_subtree") or []
+            if body.get("_kt_sel_ips"):
+                call_kwargs["sel_ips"] = body["_kt_sel_ips"]
         elif "_kt_workers" in body:
             call_kwargs["workers"] = body.pop("_kt_workers")
         if hasattr(sup, "server_port"):
@@ -463,6 +481,7 @@ def create_app(state: Optional[ServerState] = None) -> web.Application:
     app.router.add_get("/app/status", app_status)
     app.router.add_post("/_kt/reload", reload_route)
     app.router.add_post("/_kt/profile", profile_route)
+    app.router.add_get("/_kt/data/{key:.+}", serve_cached_data)
     app.router.add_post("/{fn_name}", run_callable)
     app.router.add_post("/{fn_name}/{method}", run_callable)
     app.on_startup.append(_on_startup)
@@ -545,10 +564,14 @@ def main(argv: Optional[list] = None) -> None:
     import argparse
 
     p = argparse.ArgumentParser(description="kubetorch-tpu pod server")
-    p.add_argument("--port", type=int,
-                   default=int(os.environ.get("KT_SERVER_PORT", DEFAULT_PORT)))
+    p.add_argument("--port", type=int, default=server_port())
     p.add_argument("--host", default="0.0.0.0")
     args = p.parse_args(argv)
+    # Advertise the BOUND port to everything that derives URLs from env —
+    # the controller-WS registration and the supervisor's peer subcalls —
+    # regardless of how the server was launched (CLI, -m, embedder). A
+    # --port flag alone must not leave them pointing at the default.
+    os.environ["KT_SERVER_PORT"] = str(args.port)
     web.run_app(create_app(), host=args.host, port=args.port,
                 handle_signals=False, print=lambda *_: None)
 

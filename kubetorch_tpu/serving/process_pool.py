@@ -29,6 +29,7 @@ class ProcessPool:
         from .process_worker import ProcessWorker
 
         self.num_procs = num_procs
+        self.framework_name = framework_name
         self.workers: List[ProcessWorker] = []
         for local_rank in range(num_procs):
             info = RankInfo(node_rank=node_rank, local_rank=local_rank,
@@ -113,9 +114,31 @@ class ProcessPool:
         return await asyncio.wait_for(fut, timeout)
 
     async def call(self, idx: int, method: Optional[str], args: list,
-                   kwargs: dict, timeout: Optional[float] = None) -> Any:
-        return await self._submit(idx, {"method": method, "args": args,
-                                        "kwargs": kwargs}, timeout)
+                   kwargs: dict, timeout: Optional[float] = None,
+                   dist_env: Optional[Dict[str, str]] = None) -> Any:
+        payload: Dict[str, Any] = {"method": method, "args": args,
+                                   "kwargs": kwargs}
+        if dist_env:
+            payload["dist_env"] = dist_env
+        return await self._submit(idx, payload, timeout)
+
+    def subset_env(self, local_rank: int, sel_ips: List[str],
+                   sel_node_rank: int) -> Optional[Dict[str, str]]:
+        """Selection-relative rank env for a worker-subset call (reference
+        per-call env assembly, spmd_supervisor.py:345-364): WORLD_SIZE/RANK/
+        MASTER_ADDR reflect the *selected* pods, so e.g. ``workers=[2, 5]``
+        behaves as a clean 2-node world for frameworks that initialize their
+        collectives inside the request. ``None`` when the framework's identity
+        is fixed at spawn (JAX/TPU)."""
+        from .env_contract import framework_for
+
+        fw = framework_for(self.framework_name)
+        if not fw.per_call_identity:
+            return None
+        info = RankInfo(node_rank=sel_node_rank, local_rank=local_rank,
+                        nproc_per_node=self.num_procs,
+                        num_nodes=len(sel_ips), pod_ips=list(sel_ips))
+        return fw.env(info)
 
     async def profile(self, idx: int = 0, duration_s: float = 3.0,
                       timeout: Optional[float] = None) -> Any:
@@ -125,8 +148,13 @@ class ProcessPool:
                                   timeout or duration_s + 60)
 
     async def call_all(self, method: Optional[str], args: list, kwargs: dict,
-                       timeout: Optional[float] = None) -> List[Any]:
-        tasks = [self.call(i, method, args, kwargs, timeout)
+                       timeout: Optional[float] = None,
+                       subset: Optional[tuple] = None) -> List[Any]:
+        """``subset=(sel_ips, sel_node_rank)`` rebinds rank identity to the
+        selected pod set for this request (see :meth:`subset_env`)."""
+        tasks = [self.call(i, method, args, kwargs, timeout,
+                           dist_env=(self.subset_env(i, *subset)
+                                     if subset else None))
                  for i in range(self.num_procs)]
         return list(await asyncio.gather(*tasks))
 

@@ -73,7 +73,8 @@ class _QueueTee:
 
 def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
                  env: Dict[str, str], pointers_dict: Optional[Dict],
-                 init_args: Optional[Dict], framework_name: str) -> None:
+                 init_args: Optional[Dict], framework_name: str,
+                 identity_env: Optional[Dict[str, str]] = None) -> None:
     import sys as _sys
 
     os.environ.update(env)
@@ -83,11 +84,11 @@ def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
     from .env_contract import sync_jax_runtime_config
     sync_jax_runtime_config()
     asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
-                             framework_name))
+                             framework_name, identity_env))
 
 
 async def _worker_loop(request_q, response_q, pointers_dict, init_args,
-                       framework_name) -> None:
+                       framework_name, identity_env=None) -> None:
     loop = asyncio.get_running_loop()
     executor = ThreadPoolExecutor(max_workers=_SYNC_EXECUTOR_THREADS)
     target: Any = None
@@ -129,7 +130,8 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
             task = asyncio.ensure_future(_handle_profile(item, response_q))
         else:
             task = asyncio.ensure_future(
-                _handle(item, target, load_error, response_q, executor))
+                _handle(item, target, load_error, response_q, executor,
+                        identity_env))
         pending.add(task)
 
 
@@ -204,7 +206,8 @@ async def _handle_profile(item: Dict, response_q) -> None:
                         "error": package_exception(e)})
 
 
-async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> None:
+async def _handle(item: Dict, target: Any, load_error, response_q, executor,
+                  identity_env: Optional[Dict[str, str]] = None) -> None:
     req_id = item.get("req_id")
     _rank_request_id.set(item.get("request_id", ""))
     try:
@@ -212,6 +215,15 @@ async def _handle(item: Dict, target: Any, load_error, response_q, executor) -> 
             raise load_error
         if target is None:
             raise RuntimeError("No callable loaded in worker")
+        # Per-call rank identity: a worker-subset call carries dist_env with
+        # selection-relative WORLD_SIZE/RANK/...; a full-set call carries
+        # none and must restore the spawn identity (a previous subset call's
+        # values would otherwise leak into it). Process-global by nature,
+        # like the reference's per-request env writes — overlapping calls
+        # with different selections are a caller error there too.
+        dist_env = item.get("dist_env") or identity_env
+        if dist_env:
+            os.environ.update(dist_env)
         method = item.get("method")
         fn = getattr(target, method) if method else target
         args = item.get("args", [])
@@ -244,16 +256,23 @@ class ProcessWorker:
         ctx = mp.get_context("spawn")
         self.request_q: mp.Queue = ctx.Queue()
         self.response_q: mp.Queue = ctx.Queue()
+        fw = framework_for(framework_name)
+        fw_env = fw.env(rank_info)
         env = dict(base_env or {})
-        env.update(framework_for(framework_name).env(rank_info))
+        env.update(fw_env)
         self.env = env
+        # Spawn-time identity, re-applied on every full-set call for
+        # frameworks with per-call identity so a subset call's rebinding
+        # never leaks into the next request. None for spawn-fixed identity
+        # (JAX/TPU): those workers never touch env per request.
+        identity_env = fw_env if fw.per_call_identity else None
         # flipped by ProcessPool._route_responses from the worker's state ops
         self.in_warmup = True
         self.process = ctx.Process(
             target=_worker_main,
             args=(self.request_q, self.response_q, env,
                   pointers.to_dict() if pointers else None, init_args,
-                  framework_name),
+                  framework_name, identity_env),
             daemon=True,
         )
 

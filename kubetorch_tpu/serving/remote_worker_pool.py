@@ -57,14 +57,20 @@ class RemoteWorkerPool:
     async def call_worker(self, ip: str, fn_name: str, method: Optional[str],
                           body: Dict[str, Any], headers: Dict[str, str],
                           timeout: Optional[float] = None,
-                          subtree: Optional[List[str]] = None) -> Any:
+                          subtree: Optional[List[str]] = None,
+                          sel_ips: Optional[List[str]] = None) -> Any:
         """One subcall to a peer pod. ``subtree`` tells the peer which workers
-        it coordinates below itself (tree fan-out)."""
+        it coordinates below itself (tree fan-out); ``sel_ips`` carries the
+        ordered worker selection so the peer rebinds its rank identity
+        relative to the subset (each pod derives its node rank by indexing
+        itself in the list)."""
         path = f"/{fn_name}" + (f"/{method}" if method else "")
         params = {SUBCALL_PARAM: "true"}
         payload = dict(body)
         if subtree:
             payload["_kt_subtree"] = subtree
+        if sel_ips:
+            payload["_kt_sel_ips"] = sel_ips
         sess = await self.session()
         try:
             async with sess.post(

@@ -105,26 +105,34 @@ class SPMDSupervisor(DistributedSupervisor):
                    timeout: Optional[float] = None,
                    workers: Union[None, str, Sequence] = None,
                    subtree: Optional[List[str]] = None,
+                   sel_ips: Optional[List[str]] = None,
                    headers: Optional[Dict[str, str]] = None) -> List[Any]:
         async with self.restart_guard():    # each pod restarts its own ranks
             return await self._call_inner(method, args, kwargs, timeout,
-                                          workers, subtree, headers)
+                                          workers, subtree, sel_ips, headers)
 
     async def _call_inner(self, method, args, kwargs, timeout, workers,
-                          subtree, headers) -> List[Any]:
+                          subtree, sel_ips, headers) -> List[Any]:
         assert self.pool is not None, "supervisor not set up"
         my_ip = my_pod_ip()
         if subtree is not None:
-            # we are an interior tree node: coordinate the given subtree
+            # we are an interior tree node: coordinate the given subtree;
+            # sel_ips (the coordinator's ordered selection) flows down as-is
             ips = [my_ip] + list(subtree)
+            sel = list(sel_ips) if sel_ips else None
         else:
             self.check_membership()
             ips = await self._select_ips(workers)
+            # Subset (or reordered) selection: rank identity rebinds to the
+            # selection for per-call-identity frameworks (reference assembles
+            # env per call, :345-364). Full default set → no override.
+            sel = None if ips == sorted(self.pod_ips() or [my_ip]) else list(ips)
 
         pool = RemoteWorkerPool.shared(self.server_port)
         body = {"args": args, "kwargs": kwargs}
         hdrs = headers or {}
         n = len(ips)
+        local_subset = (sel, sel.index(my_ip)) if sel and my_ip in sel else None
 
         tree_order: Optional[List[str]] = None
         if n > TREE_THRESHOLD:
@@ -145,19 +153,22 @@ class SPMDSupervisor(DistributedSupervisor):
             tasks = []
             if run_local:
                 tasks.append(asyncio.ensure_future(
-                    self.pool.call_all(method, args, kwargs, timeout)))
+                    self.pool.call_all(method, args, kwargs, timeout,
+                                       subset=local_subset)))
             tasks += [asyncio.ensure_future(pool.call_worker(
                 ip, self.fn_name, method, body, hdrs, timeout,
-                subtree=sub or None)) for ip, sub in targets]
+                subtree=sub or None, sel_ips=sel)) for ip, sub in targets]
         else:
             # flat fan-out preserves the caller's selection order exactly —
             # mesh.actors([1, 0]) must return [actor1, actor0]
             tasks = [
                 asyncio.ensure_future(
-                    self.pool.call_all(method, args, kwargs, timeout))
+                    self.pool.call_all(method, args, kwargs, timeout,
+                                       subset=local_subset))
                 if ip == my_ip else
                 asyncio.ensure_future(pool.call_worker(
-                    ip, self.fn_name, method, body, hdrs, timeout))
+                    ip, self.fn_name, method, body, hdrs, timeout,
+                    sel_ips=sel))
                 for ip in ips
             ]
 

@@ -18,7 +18,8 @@ def whoami():
             "rank": os.environ.get("RANK"),
             "world_size": os.environ.get("WORLD_SIZE"),
             "local_rank": os.environ.get("LOCAL_RANK"),
-            "node_rank": os.environ.get("NODE_RANK")}
+            "node_rank": os.environ.get("NODE_RANK"),
+            "pod_ips": os.environ.get("POD_IPS")}
 
 
 def boomer(msg="kaboom"):

@@ -100,6 +100,56 @@ def test_spmd_worker_subset_any(two_pods):
 
 
 @pytest.mark.slow
+def test_spmd_worker_subset_rank_rebinding(two_pods):
+    """A subset call behaves as a clean smaller world: WORLD_SIZE/RANK/POD_IPS
+    rebind to the selection (reference per-call env assembly,
+    spmd_supervisor.py:345-364)."""
+    ips, port = two_pods
+    r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                      json={"args": [], "kwargs": {}, "_kt_workers": [1]},
+                      timeout=60)
+    assert r.status_code == 200, r.text
+    results = r.json()
+    assert len(results) == 1
+    assert results[0]["world_size"] == "1"
+    assert results[0]["rank"] == "0"
+    assert results[0]["node_rank"] == "0"
+    assert results[0]["pod_ips"] == ips[1]  # only the selected pod
+
+
+@pytest.mark.slow
+def test_spmd_worker_selection_order_sets_ranks(two_pods):
+    """workers=[1, 0]: results come back in selection order and node ranks
+    follow the selection, not the sorted pod set."""
+    ips, port = two_pods
+    r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                      json={"args": [], "kwargs": {}, "_kt_workers": [1, 0]},
+                      timeout=60)
+    assert r.status_code == 200, r.text
+    first, second = r.json()
+    assert first["node_rank"] == "0" and second["node_rank"] == "1"
+    assert first["pod_ips"] == second["pod_ips"] == f"{ips[1]},{ips[0]}"
+
+
+@pytest.mark.slow
+def test_spmd_full_call_after_subset_restores_identity(two_pods):
+    """A full-set call after a subset call must NOT inherit the subset's rank
+    env: workers rebind to their spawn identity when no selection is sent."""
+    ips, port = two_pods
+    r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                      json={"args": [], "kwargs": {}, "_kt_workers": [1]},
+                      timeout=60)
+    assert r.status_code == 200 and r.json()[0]["world_size"] == "1"
+    r = requests.post(f"http://{ips[0]}:{port}/whoami",
+                      json={"args": [], "kwargs": {}}, timeout=60)
+    assert r.status_code == 200, r.text
+    results = r.json()
+    assert [x["world_size"] for x in results] == ["2", "2"]
+    assert sorted(int(x["node_rank"]) for x in results) == [0, 1]
+    assert all(x["pod_ips"] == ",".join(sorted(ips)) for x in results)
+
+
+@pytest.mark.slow
 def test_spmd_exception_fast_fail(two_pods):
     ips, port = two_pods
     # boomer isn't the configured callable → 404 from the fn-name guard;

@@ -478,15 +478,13 @@ def server():
               help="BYO: register under this workload name")
 def server_start(port, workload):
     """Start the pod runtime (BYO compute bootstrap, reference cli.py:2846)."""
-    from .constants import DEFAULT_SERVER_PORT
+    from .constants import server_port as parse_port
     if workload:
         os.environ.setdefault("KT_SERVICE_NAME", workload)
-    port = port or int(os.environ.get("KT_SERVER_PORT") or DEFAULT_SERVER_PORT)
-    # the WS registration reads KT_SERVER_PORT to advertise a routable URL —
-    # a --port flag alone must not leave it pointing at the default
-    os.environ["KT_SERVER_PORT"] = str(port)
+    # http_server.main advertises the bound port via KT_SERVER_PORT itself.
+    # `is not None`: an explicit --port 0 means bind-ephemeral, not default.
     from .serving.http_server import main as server_main
-    server_main(["--port", str(port)])
+    server_main(["--port", str(port if port is not None else parse_port())])
 
 
 # -- store -------------------------------------------------------------------

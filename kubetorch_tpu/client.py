@@ -136,8 +136,14 @@ def _state_file() -> str:
 
 
 def _read_running_local() -> Optional[Dict]:
-    """The persisted local-controller daemon, if it still answers."""
+    """The persisted local-controller daemon, if it still answers AND was
+    built from the sources currently on disk. A daemon running stale code
+    (package edited since it started) is stopped and forgotten so the caller
+    spawns a fresh one — the local analog of the reference's
+    client↔controller version-mismatch check."""
     import json
+
+    from .utils import code_fingerprint
 
     try:
         with open(_state_file()) as f:
@@ -147,10 +153,56 @@ def _read_running_local() -> Optional[Dict]:
     try:
         r = _requests.get(f"{state['url']}/controller/version", timeout=2)
         if r.status_code == 200:
+            try:
+                remote_fp = r.json().get("code_fingerprint")
+            except ValueError:
+                remote_fp = None
+            if remote_fp == code_fingerprint():
+                return state
+            if _kill_daemon_process(state):
+                try:
+                    os.unlink(_state_file())
+                except OSError:
+                    pass
+                return None
+            # kill failed: reusing the stale daemon beats orphaning a live
+            # controller (state file must survive so `kt controller stop`
+            # can still find it) or spawning a duplicate next to it
+            import warnings
+            warnings.warn(
+                f"Local controller pid {state['pid']} runs stale code but "
+                "could not be stopped; reusing it. Run `kt controller stop`.")
             return state
     except _requests.RequestException:
         pass
     return None
+
+
+def _kill_daemon_process(state: Dict) -> bool:
+    """Verify-and-kill the persisted daemon; True when it is provably gone.
+
+    Never kill a reused PID: confirm the process is actually our controller
+    before signalling it."""
+    import psutil
+
+    try:
+        proc = psutil.Process(state["pid"])
+        if not any("kubetorch_tpu.controller" in part
+                   for part in proc.cmdline()):
+            return True          # PID reused: our daemon already died
+        kill_process_tree(state["pid"])
+        try:
+            # kill_process_tree returns right after the SIGKILL escalation;
+            # give the kernel a moment to reap. Zombie == dead for us.
+            psutil.wait_procs([proc], timeout=3)
+            return (not proc.is_running()
+                    or proc.status() == psutil.STATUS_ZOMBIE)
+        except psutil.NoSuchProcess:
+            return True
+    except psutil.NoSuchProcess:
+        return True
+    except Exception:
+        return False
 
 
 def controller_client() -> ControllerClient:
@@ -269,33 +321,9 @@ def shutdown_local_controller() -> None:
         except (OSError, ValueError):
             pass
         if state:
-            # never kill a reused PID: verify the process is actually our
-            # controller before signalling it — and only forget the state
-            # file once the daemon is provably gone, or a failed stop would
-            # orphan a live controller forever.
-            import psutil
-
-            daemon_gone = False
-            try:
-                proc = psutil.Process(state["pid"])
-                if any("kubetorch_tpu.controller" in part
-                       for part in proc.cmdline()):
-                    kill_process_tree(state["pid"])
-                    try:
-                        # kill_process_tree returns right after the SIGKILL
-                        # escalation; give the kernel a moment to reap.
-                        # Zombie == dead for our purposes.
-                        psutil.wait_procs([proc], timeout=3)
-                        daemon_gone = (not proc.is_running() or
-                                       proc.status() == psutil.STATUS_ZOMBIE)
-                    except psutil.NoSuchProcess:
-                        daemon_gone = True
-                else:
-                    daemon_gone = True   # PID reused: our daemon already died
-            except psutil.NoSuchProcess:
-                daemon_gone = True
-            except Exception:
-                daemon_gone = False
+            # only forget the state file once the daemon is provably gone,
+            # or a failed stop would orphan a live controller forever
+            daemon_gone = _kill_daemon_process(state)
             if daemon_gone:
                 try:
                     os.unlink(_state_file())

@@ -3,3 +3,24 @@ ports, labels, timeouts). One definition so the pod server, controller, CLI,
 and client config can never drift apart."""
 
 DEFAULT_SERVER_PORT = 32300
+
+
+def server_port(value: "str | int | None" = None) -> int:
+    """The ONE tolerant KT_SERVER_PORT parse, shared by the pod server, the
+    controller WebSocket registration, and the CLI. Empty or malformed values
+    (e.g. ``KT_SERVER_PORT=""`` from a BYO manifest, or ``"auto"``) warn and
+    fall back to the default instead of crashing the pod at startup or
+    silently looping in the WS reconnect."""
+    import logging
+    import os
+
+    raw = os.environ.get("KT_SERVER_PORT") if value is None else value
+    if raw is None or raw == "":
+        return DEFAULT_SERVER_PORT
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        logging.getLogger(__name__).warning(
+            "invalid KT_SERVER_PORT=%r; using default %d",
+            raw, DEFAULT_SERVER_PORT)
+        return DEFAULT_SERVER_PORT

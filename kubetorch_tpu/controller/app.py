@@ -74,12 +74,14 @@ class ControllerState:
         url = record.get("service_url")
         if url:
             return url
-        conns = self.connections(namespace, name)
-        if not conns:
-            return None
-        info = conns[0].info
-        port = info.get("server_port", DEFAULT_SERVER_PORT)
-        return f"http://{info.get('pod_ip')}:{port}"
+        # first connection with a resolvable IP — a registration without one
+        # must not become the literal "http://None:..." or mask later pods
+        for conn in self.connections(namespace, name):
+            info = conn.info
+            if info.get("pod_ip"):
+                port = info.get("server_port", DEFAULT_SERVER_PORT)
+                return f"http://{info['pod_ip']}:{port}"
+        return None
 
     def record_event(self, service_key: str, message: str) -> None:
         self.events.append({"ts": time.time(), "service": service_key,
@@ -213,9 +215,16 @@ async def get_workload(request: web.Request) -> web.Response:
     state: ControllerState = request.app["cstate"]
     key = _workload_key(request.match_info["ns"], request.match_info["name"])
     record = state.workloads.get(key)
-    if record is None:
-        return web.json_response({"error": "not found"}, status=404)
     pods = state.connections(request.match_info["ns"], request.match_info["name"])
+    if record is None:
+        if not pods:
+            return web.json_response({"error": "not found"}, status=404)
+        # BYO pods register over WS before any workload is deployed to them
+        # (the "waiting" state, reference design.md:254-280) — observable so
+        # clients/tests can await registration before calling .to()
+        record = {"name": request.match_info["name"],
+                  "namespace": request.match_info["ns"], "status": "waiting",
+                  "manifest": None, "selector": None}
     out = dict(record)
     out["connected_pods"] = [c.pod_name for c in pods]
     out["service_url"] = state.resolve_service_url(
@@ -278,7 +287,9 @@ async def cluster_config(request: web.Request) -> web.Response:
 
 async def version(request: web.Request) -> web.Response:
     from .. import __version__
-    return web.json_response({"version": __version__})
+    from ..utils import code_fingerprint
+    return web.json_response({"version": __version__,
+                              "code_fingerprint": code_fingerprint()})
 
 
 # -- logs (Loki-less path) ---------------------------------------------------
@@ -570,6 +581,11 @@ def main(argv: Optional[list] = None) -> None:
         state.backend = LocalBackend(controller_url=state.base_url,
                                      store_url=store_url)
         state.backend._store_proc = store_proc  # killed with the backend
+    # Freeze the code fingerprint NOW, while it still describes the sources
+    # this process actually loaded — computed lazily at the first /version
+    # request it could already reflect newer on-disk edits and mask staleness.
+    from ..utils import code_fingerprint
+    code_fingerprint()
     web.run_app(create_controller_app(state), host=args.host, port=args.port,
                 print=lambda *_: None)
 

@@ -18,7 +18,7 @@ from typing import Optional
 
 import aiohttp
 
-from ..constants import DEFAULT_SERVER_PORT
+from ..constants import server_port
 from .discovery import my_pod_ip
 from .env_contract import KT_SERVICE_NAME, apply_metadata
 
@@ -58,6 +58,10 @@ class ControllerWebSocket:
             return False
 
     async def _run(self) -> None:
+        # Parse the port OUTSIDE the reconnect try: a malformed value must
+        # warn once (shared tolerant parse), not turn into a silent
+        # retry-forever loop that never registers.
+        port = server_port()
         delay = RECONNECT_BASE_S
         while not self._stopping:
             try:
@@ -72,11 +76,7 @@ class ControllerWebSocket:
                         "launch_id": self.state.launch_id,
                         # lets the controller derive a routable service_url for
                         # BYO pods, where no manifest ever declared one
-                        # `or`: an empty KT_SERVER_PORT must not make int()
-                        # raise inside this try block, where the reconnect
-                        # loop would silently swallow it forever
-                        "server_port": int(os.environ.get("KT_SERVER_PORT")
-                                           or DEFAULT_SERVER_PORT),
+                        "server_port": port,
                     })
                     async for msg in ws:
                         if msg.type != aiohttp.WSMsgType.TEXT:

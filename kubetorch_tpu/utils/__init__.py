@@ -1,5 +1,9 @@
 """Shared utilities: k8s naming, process management, retries, ports."""
 
+import functools
+import hashlib
+import os
+
 from .naming import sanitize_k8s_name, validate_k8s_name, service_name_for
 from .procs import kill_process_tree, free_port, wait_for_port
 
@@ -10,4 +14,31 @@ __all__ = [
     "kill_process_tree",
     "free_port",
     "wait_for_port",
+    "code_fingerprint",
 ]
+
+
+@functools.lru_cache(maxsize=1)
+def code_fingerprint() -> str:
+    """Fingerprint of this package's source tree (path + mtime + size).
+
+    Frozen per process on first call: a long-lived local-controller daemon
+    reports the fingerprint of the code it loaded, while a fresh client
+    computes the code currently on disk — a mismatch means the daemon is
+    stale (sources edited since it started) and must be replaced. The local
+    analog of the reference's client↔controller version-mismatch check
+    (resources/compute/utils.py VersionMismatchError)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.blake2b(digest_size=8)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith((".py", ".so", ".cpp")):
+                p = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                h.update(f"{os.path.relpath(p, root)}:"
+                         f"{st.st_mtime_ns}:{st.st_size}".encode())
+    return h.hexdigest()

@@ -78,25 +78,34 @@ def byo_pod():
          "--port", str(port), "--workload", name],
         env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    assert wait_for_port("127.0.0.1", port, timeout=60)
-    yield name, port
-    kill_process_tree(proc.pid)
+    try:
+        assert wait_for_port("127.0.0.1", port, timeout=60)
+        _wait_for_registration(cc, name)
+        yield name, port
+    finally:
+        # also covers failures BEFORE yield — a fixture that dies waiting
+        # must not leak its pod subprocess into later tests
+        kill_process_tree(proc.pid)
+
+
+def _wait_for_registration(cc, name, timeout=30):
+    """Block until the pod's WS registration lands — a .to() that races it
+    reaches zero pods and derives no service URL."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if cc.get_workload("default", name).get("connected_pods"):
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise AssertionError(f"BYO pod {name!r} never registered over WS")
 
 
 @pytest.mark.slow
 def test_byo_selector_deploy_and_call(byo_pod):
     name, port = byo_pod
     cc = controller_client()
-
-    # wait for the pod's WS registration to land ("waiting" state)
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        try:
-            if cc.get_workload("default", name).get("connected_pods"):
-                break
-        except Exception:
-            pass
-        time.sleep(0.5)
 
     f = kt.fn(payloads.summer)
     assert f.name == name, "pod must be registered under the fn's service name"

@@ -156,13 +156,172 @@ def _kv_put(url: str, key: str, data: bytes, meta: Dict,
 
 
 # ---------------------------------------------------------------------------
-# get
+# get — with P2P fan-out (the reference's rolling-participation broadcast)
 # ---------------------------------------------------------------------------
+
+
+class _RoutedFetcher:
+    """Fetch subkeys of one top-level key through the store-coordinated
+    fan-out (reference tree broadcast, data_store_client.py:376-688):
+
+    - ask the store ``/route`` once: either the store itself (root) or a peer
+      pod that already completed this key;
+    - pull each subkey from the assigned parent's ``/_kt/data/`` cache,
+      falling back to the store on any miss and reporting unreachable
+      parents (``/route/failed``, reference report_unreachable);
+    - cache every fetched subkey locally and report ``/route/complete`` so
+      THIS pod becomes a parent for later joiners — rolling participation,
+      O(1) store load for N-pod weight sync.
+
+    Peer mode is automatic inside pods (POD_IP set: the pod server serves
+    the cache) and off for laptops, which can't reach pod IPs; ``peer=``
+    overrides.
+    """
+
+    def __init__(self, store_url: str, key: str, sess, peer: Optional[bool]):
+        self.store_url = store_url
+        self.key = key
+        self.sess = sess
+        self.enabled = (bool(os.environ.get("POD_IP"))
+                        if peer is None else bool(peer))
+        self.peer_url: Optional[str] = None
+        self._resolved = False
+        self._fetched = False
+        self._deadline: Optional[float] = None
+
+    def head(self, subkey: str) -> bool:
+        """Cheap existence probe against the STORE only (metadata-sized, like
+        the reference's MDS lookup): decides the key's kind without pulling
+        bulk bytes or touching peer wait windows."""
+        try:
+            r = self.sess.head(f"{self.store_url}/kv/{subkey}", timeout=30)
+            return r.status_code == 200
+        except _requests.RequestException:
+            return False
+
+    def _self_url(self) -> Optional[str]:
+        ip = os.environ.get("POD_IP")
+        if not ip:
+            return None
+        from ..constants import server_port
+        return f"http://{ip}:{server_port()}"
+
+    def _resolve(self) -> None:
+        if self._resolved or not self.enabled:
+            return
+        self._resolved = True
+        try:
+            r = self.sess.post(f"{self.store_url}/route",
+                               json={"key": self.key,
+                                     "self_url": self._self_url()},
+                               timeout=10)
+            if r.status_code == 200 and r.json().get("source") == "peer":
+                self.peer_url = r.json()["url"]
+        except _requests.RequestException:
+            self.peer_url = None
+
+    def fetch(self, subkey: str, timeout: float = 600):
+        """GET one subkey; returns the response (store-shaped: 200 + body +
+        X-KT-Meta). Order: pod-local cache (another rank worker may already
+        hold it — zero network), then the assigned peer, then the store.
+
+        Parents are assigned eagerly, possibly before they finish their own
+        fetch (the reference's rolling join: the child "blocks until parent
+        done"). A 404 from the parent therefore means *not yet* — poll until
+        the deadline, then fall back. The ``KT_PEER_WAIT_S`` (default 60s)
+        budget is ONE deadline shared by every fetch of this get(): a parent
+        that stops producing costs at most one window total, not one per
+        leaf, after which it is reported failed and everything goes to the
+        store. Connection errors evict the parent immediately."""
+        import time as _time
+
+        if self.enabled:
+            from .peer_cache import cache_get
+            hit = cache_get(subkey)
+            if hit is not None:
+                self._fetched = True
+                return _CachedResponse(*hit)
+        self._resolve()
+        if self.peer_url is not None:
+            if self._deadline is None:
+                self._deadline = _time.monotonic() + float(
+                    os.environ.get("KT_PEER_WAIT_S", "60"))
+            while True:
+                try:
+                    r = self.sess.get(f"{self.peer_url}/_kt/data/{subkey}",
+                                      timeout=timeout)
+                except _requests.RequestException:
+                    self._report_failed()
+                    self.peer_url = None
+                    break
+                if r.status_code == 200:
+                    self._cache(subkey, r)
+                    return r
+                if r.status_code != 404:
+                    break            # parent errored; store covers this one
+                if _time.monotonic() >= self._deadline:
+                    # the parent's window is spent: evict it so later
+                    # joiners aren't routed to a cache that never fills
+                    self._report_failed()
+                    self.peer_url = None
+                    break
+                _time.sleep(0.25)
+        r = self.sess.get(f"{self.store_url}/kv/{subkey}", timeout=timeout)
+        if r.status_code == 200:
+            self._cache(subkey, r)
+        return r
+
+    def _cache(self, subkey: str, r) -> None:
+        if not self.enabled or self._self_url() is None:
+            return
+        from .peer_cache import cache_put
+        meta = {}
+        if "X-KT-Meta" in r.headers:
+            try:
+                meta = json.loads(r.headers["X-KT-Meta"])
+            except ValueError:
+                meta = {}
+        try:
+            cache_put(subkey, r.content, meta)
+            self._fetched = True
+        except OSError:
+            pass                    # cache full/unwritable: still a getter
+
+    def _report_failed(self) -> None:
+        try:
+            self.sess.post(f"{self.store_url}/route/failed",
+                           json={"key": self.key, "url": self.peer_url},
+                           timeout=10)
+        except _requests.RequestException:
+            pass
+
+    def complete(self) -> None:
+        """Become a parent for later joiners (only once we hold data)."""
+        self_url = self._self_url()
+        if not (self.enabled and self._fetched and self_url):
+            return
+        try:
+            self.sess.post(f"{self.store_url}/route/complete",
+                           json={"key": self.key, "url": self_url},
+                           timeout=10)
+        except _requests.RequestException:
+            pass
+
+
+class _CachedResponse:
+    """Store-response shim for pod-local cache hits (same .status_code /
+    .content / .headers surface the fetch() callers read)."""
+
+    status_code = 200
+
+    def __init__(self, content: bytes, meta: Dict):
+        self.content = content
+        self.headers = {"X-KT-Meta": json.dumps(meta)} if meta else {}
 
 
 def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         sharding: Optional[Any] = None, mesh: Optional[Any] = None,
-        rules: Optional[Any] = None) -> Any:
+        rules: Optional[Any] = None, peer: Optional[bool] = None) -> Any:
     """Fetch ``key``. Directories need ``dest``; arrays/pytrees are returned,
     optionally placed onto devices:
 
@@ -170,25 +329,28 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
     - ``mesh= + rules=``  a :class:`~kubetorch_tpu.parallel.sharding.
       ShardingRules` table resolved per leaf path — the reshard-on-get path
       (load a checkpoint onto a *different* mesh than it was saved from).
+
+    Inside pods, bulk fetches ride the P2P fan-out (see
+    :class:`_RoutedFetcher`); ``peer=False`` forces direct store reads,
+    ``peer=True`` forces routing. The key's KIND is decided by cheap HEAD
+    probes against the store first, so a file or directory get never burns a
+    peer wait window polling for a pytree index that cannot exist.
     """
     url = _store_url(store_url)
     sess = _requests.Session()
+    fetcher = _RoutedFetcher(url, key, sess, peer)
 
-    r = sess.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
-    if r.status_code == 200:
+    if fetcher.head(f"{key}{_INDEX_SUFFIX}"):
+        r = fetcher.fetch(f"{key}{_INDEX_SUFFIX}", timeout=60)
         index = json.loads(r.content)
-        return _get_pytree(url, key, index, sess, sharding, mesh, rules)
+        tree = _get_pytree(key, index, fetcher, sharding, mesh, rules)
+        fetcher.complete()
+        return tree
 
-    r = sess.get(f"{url}/kv/{key}", timeout=600)
-    if r.status_code == 200:
-        meta = json.loads(r.headers.get("X-KT-Meta", "{}"))
-        if meta.get("kind") == "array":
-            return _decode_array(r.content, meta, sharding)
-        if dest:
-            with open(dest, "wb") as f:
-                f.write(r.content)
-            return dest
-        return r.content
+    if fetcher.head(key):
+        r = fetcher.fetch(key)
+        if r.status_code == 200:
+            return _finish_raw(r, dest, sharding, fetcher)
 
     r = sess.get(f"{url}/tree/{key}/manifest", timeout=60)
     if r.status_code == 200:
@@ -197,13 +359,39 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         from .sync import pull_tree
         return pull_tree(url, key, dest, session=sess)
 
+    # The store has nothing, but peers may (key evicted from the store after
+    # the first wave fetched it — the rolling-broadcast tail): probe the
+    # fan-out for the index, then the raw key, sharing one wait window.
+    if fetcher.enabled:
+        r = fetcher.fetch(f"{key}{_INDEX_SUFFIX}", timeout=60)
+        if r.status_code == 200:
+            index = json.loads(r.content)
+            tree = _get_pytree(key, index, fetcher, sharding, mesh, rules)
+            fetcher.complete()
+            return tree
+        r = fetcher.fetch(key)
+        if r.status_code == 200:
+            return _finish_raw(r, dest, sharding, fetcher)
+
     raise DataStoreError(f"get: no such key {key!r}")
 
 
-def _get_pytree(url, key, index, sess, sharding, mesh, rules) -> Any:
+def _finish_raw(r, dest, sharding, fetcher: "_RoutedFetcher") -> Any:
+    meta = json.loads(r.headers.get("X-KT-Meta", "{}"))
+    fetcher.complete()
+    if meta.get("kind") == "array":
+        return _decode_array(r.content, meta, sharding)
+    if dest:
+        with open(dest, "wb") as f:
+            f.write(r.content)
+        return dest
+    return r.content
+
+
+def _get_pytree(key, index, fetcher: _RoutedFetcher, sharding, mesh, rules) -> Any:
     leaves: Dict[str, Any] = {}
     for path, meta in index["leaves"].items():
-        r = sess.get(f"{url}/kv/{key}/{path}", timeout=600)
+        r = fetcher.fetch(f"{key}/{path}")
         if r.status_code != 200:
             raise DataStoreError(f"get: missing leaf {key}/{path}")
         leaf_sharding = sharding

@@ -1,0 +1,83 @@
+"""Pod-local content cache backing the P2P broadcast fan-out.
+
+The reference's tree broadcast (``data_store/design.md`` rolling-participation
+fan-out, ``data_store_client.py:376-688``) lets N pods fetch a key with O(1)
+load on the central store: each pod that completes a fetch re-serves it to
+later joiners. TPU redesign: instead of a per-node daemon with CUDA-IPC
+handles (impossible on TPU, SURVEY §2.9), the pod's existing HTTP server
+serves ``/_kt/data/{key}`` straight from this cache — host-staged bytes, any
+process in the pod (rank workers included) can populate or read it because it
+is plain files on the pod's filesystem.
+
+Entries are content-named by key hash; writes are atomic (tmp + rename) so a
+concurrent reader never sees a torn entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+DEFAULT_CACHE_DIR = "/tmp/kt-data-cache"
+
+
+def cache_dir() -> Path:
+    d = Path(os.environ.get("KT_DATA_CACHE_DIR", DEFAULT_CACHE_DIR))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _entry_paths(key: str) -> Tuple[Path, Path]:
+    h = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
+    base = cache_dir() / h
+    return base.with_suffix(".bin"), base.with_suffix(".json")
+
+
+def cache_put(key: str, data: bytes, meta: Optional[Dict] = None) -> None:
+    # tmp names carry pid + a fresh uuid: concurrent writers of the SAME key
+    # (N rank workers sharing the pod cache) must each write their own tmp
+    # file, or interleaved writes would publish a torn entry via the rename
+    import uuid
+
+    data_path, meta_path = _entry_paths(key)
+    nonce = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    tmp = data_path.with_suffix(f".{nonce}.tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, data_path)
+    mtmp = meta_path.with_suffix(f".{nonce}.mtmp")
+    mtmp.write_text(json.dumps({"key": key, "meta": meta or {},
+                                "cached_at": time.time()}))
+    os.replace(mtmp, meta_path)
+
+
+def cache_get(key: str) -> Optional[Tuple[bytes, Dict]]:
+    data_path, meta_path = _entry_paths(key)
+    if not data_path.is_file() or not meta_path.is_file():
+        return None
+    try:
+        entry = json.loads(meta_path.read_text())
+        if entry.get("key") != key:      # hash collision paranoia
+            return None
+        return data_path.read_bytes(), entry.get("meta", {})
+    except (OSError, ValueError):
+        return None
+
+
+def cache_evict(key: str) -> None:
+    for p in _entry_paths(key):
+        try:
+            p.unlink()
+        except OSError:
+            pass
+
+
+def cache_clear() -> None:
+    for p in cache_dir().iterdir():
+        try:
+            p.unlink()
+        except OSError:
+            pass

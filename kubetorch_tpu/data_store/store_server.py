@@ -226,6 +226,94 @@ async def barrier_join(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "members": sorted(entry["members"])})
 
 
+# -- P2P fan-out routing (MDS broadcast-coordination role) --------------------
+#
+# The reference's rolling-participation tree broadcast (design.md, client
+# :376-688): N pods fetching one key produce O(1) store load. Each getter
+# asks /route for a source; the store answers "store" (tree root) or a peer
+# assigned EAGERLY in arrival order (fanout-capped), which may still be
+# fetching — the child polls the parent's cache until it fills (the
+# reference's "block until parent done" rolling join). Pods also register
+# on completion so late joiners fan out from finished holders, and
+# /route/failed evicts unreachable parents so their children re-route.
+
+ROUTE_FANOUT = 50          # children per parent (reference FS fanout)
+ROUTE_STALE_S = 3600.0     # forget members after an hour
+
+
+class _RouteGroup:
+    def __init__(self):
+        self.members: Dict[str, Dict] = {}   # url → {ts, children}
+
+
+def _route_groups(st: StoreState) -> Dict[str, _RouteGroup]:
+    groups = getattr(st, "route_groups", None)
+    if groups is None:
+        groups = st.route_groups = {}
+    return groups
+
+
+def _gc_route_groups(groups: Dict[str, _RouteGroup]) -> None:
+    """Drop groups whose members have all gone stale — per-iteration weight
+    -sync keys ('weights/step-0001', ...) must not accumulate forever in a
+    long-lived store. O(total members) per call; route traffic is control
+    -plane-rare, so sweeping on every route/complete is cheap."""
+    now = time.time()
+    for key in [k for k, g in groups.items()
+                if all(now - m["ts"] > ROUTE_STALE_S
+                       for m in g.members.values()) or not g.members]:
+        del groups[key]
+
+
+async def route_get(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await request.json()
+    key = body["key"]
+    self_url = body.get("self_url")
+    groups = _route_groups(st)
+    _gc_route_groups(groups)
+    group = groups.setdefault(key, _RouteGroup())
+    now = time.time()
+    for url in [u for u, m in group.members.items()
+                if now - m["ts"] > ROUTE_STALE_S]:
+        del group.members[url]
+    # least-loaded member with a free child slot — assigned before the caller
+    # registers, so it can never be its own parent
+    candidates = [(m["children"], url) for url, m in group.members.items()
+                  if m["children"] < ROUTE_FANOUT and url != self_url]
+    if self_url and self_url not in group.members:
+        group.members[self_url] = {"children": 0, "ts": now}
+    if candidates:
+        _, url = min(candidates)
+        group.members[url]["children"] += 1
+        return web.json_response({"source": "peer", "url": url})
+    return web.json_response({"source": "store"})
+
+
+async def route_complete(request: web.Request) -> web.Response:
+    """A pod finished fetching ``key`` (it can now serve every subkey):
+    (re-)register it fresh so late joiners prefer finished holders."""
+    st = _state(request)
+    body = await request.json()
+    groups = _route_groups(st)
+    group = groups.setdefault(body["key"], _RouteGroup())
+    group.members.setdefault(body["url"], {"children": 0})["ts"] = time.time()
+    _gc_route_groups(groups)
+    return web.json_response({"ok": True, "members": len(group.members)})
+
+
+async def route_failed(request: web.Request) -> web.Response:
+    """A getter reports its assigned parent unreachable (reference
+    report_unreachable): evict so nobody else is routed there."""
+    st = _state(request)
+    body = await request.json()
+    group = _route_groups(st).get(body["key"])
+    evicted = False
+    if group is not None:
+        evicted = group.members.pop(body["url"], None) is not None
+    return web.json_response({"ok": True, "evicted": evicted})
+
+
 # -- peer registry (MDS role) -------------------------------------------------
 
 
@@ -267,6 +355,9 @@ def create_store_app(root: str) -> web.Application:
     r.add_post("/register", register_peer)
     r.add_get("/peer/{key:.+}", lookup_peer)
     r.add_post("/barrier", barrier_join)
+    r.add_post("/route", route_get)
+    r.add_post("/route/complete", route_complete)
+    r.add_post("/route/failed", route_failed)
     return app
 
 

@@ -289,3 +289,161 @@ def test_manifest_hash_cache(project, monkeypatch):
         calls.clear()
         assert build_manifest(str(project)) == m          # corrupt cache: rebuilt
         assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# P2P fan-out (the reference's rolling-participation tree broadcast,
+# data_store_client.py:376-688 / design.md)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_cache_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_DATA_CACHE_DIR", str(tmp_path / "cache"))
+    from kubetorch_tpu.data_store import peer_cache
+
+    assert peer_cache.cache_get("k1") is None
+    peer_cache.cache_put("k1", b"\x00\x01payload", {"kind": "array"})
+    data, meta = peer_cache.cache_get("k1")
+    assert data == b"\x00\x01payload" and meta == {"kind": "array"}
+    peer_cache.cache_evict("k1")
+    assert peer_cache.cache_get("k1") is None
+
+
+@pytest.mark.slow
+def test_route_eager_tree_assignment(store):
+    """Routing protocol: first member roots at the store; later members are
+    assigned the least-loaded registered member EAGERLY (before it
+    completes); failed parents are evicted."""
+    import requests
+
+    key = "route/proto"
+    r = requests.post(f"{store}/route", json={
+        "key": key, "self_url": "http://10.0.0.1:1"}, timeout=10).json()
+    assert r == {"source": "store"}
+    # B arrives while A is still fetching: assigned A (eager rolling join)
+    r = requests.post(f"{store}/route", json={
+        "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()
+    assert r == {"source": "peer", "url": "http://10.0.0.1:1"}
+    # C arrives: least-loaded member is B (0 children vs A's 1)
+    r = requests.post(f"{store}/route", json={
+        "key": key, "self_url": "http://10.0.0.3:1"}, timeout=10).json()
+    assert r == {"source": "peer", "url": "http://10.0.0.2:1"}
+    # a member is never its own parent
+    r = requests.post(f"{store}/route", json={
+        "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()
+    assert r["url"] != "http://10.0.0.2:1"
+    # B reported unreachable → evicted; D re-routes elsewhere
+    requests.post(f"{store}/route/failed", json={
+        "key": key, "url": "http://10.0.0.2:1"}, timeout=10)
+    r = requests.post(f"{store}/route", json={
+        "key": key, "self_url": "http://10.0.0.4:1"}, timeout=10).json()
+    assert r.get("url") != "http://10.0.0.2:1"
+
+
+def _spawn_cache_server(cache_dir, port):
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "KT_DATA_CACHE_DIR": str(cache_dir),
+                "POD_IP": "127.0.0.1", "LOCAL_IPS": "127.0.0.1"})
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.serving.http_server",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    assert wait_for_port("127.0.0.1", port, timeout=30)
+    return proc
+
+
+@pytest.mark.slow
+def test_p2p_get_serves_from_peer_after_store_loss(store, tmp_path, monkeypatch):
+    """Pod A fetches a pytree (becoming a parent); pod B's get is routed to
+    A and succeeds even after the key is deleted from the central store —
+    proof the bytes came from the peer, not the root."""
+    import numpy as np
+
+    from kubetorch_tpu.data_store import commands
+
+    key = "p2p/weights"
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones((4,), np.float32)}
+    commands.put(key, tree, store_url=store)
+
+    dir_a = tmp_path / "cache-a"
+    port_a = free_port()
+    proc_a = _spawn_cache_server(dir_a, port_a)
+    try:
+        # pod A: fetch through the fan-out → caches + registers as parent
+        monkeypatch.setenv("POD_IP", "127.0.0.1")
+        monkeypatch.setenv("KT_SERVER_PORT", str(port_a))
+        monkeypatch.setenv("KT_DATA_CACHE_DIR", str(dir_a))
+        got_a = commands.get(key, store_url=store, peer=True)
+        np.testing.assert_array_equal(got_a["w"], tree["w"])
+
+        # the store loses the key entirely
+        commands.rm(key, store_url=store)
+
+        # pod B (distinct self_url, own cache): routed to A, still succeeds
+        monkeypatch.setenv("KT_SERVER_PORT", str(free_port()))
+        monkeypatch.setenv("KT_DATA_CACHE_DIR", str(tmp_path / "cache-b"))
+        monkeypatch.setenv("KT_PEER_WAIT_S", "5")
+        got_b = commands.get(key, store_url=store, peer=True)
+        np.testing.assert_array_equal(got_b["w"], tree["w"])
+        np.testing.assert_array_equal(got_b["b"], tree["b"])
+    finally:
+        kill_process_tree(proc_a.pid)
+
+    # pod-local cache reuse (N rank workers sharing one pod cache): with the
+    # store empty AND pod A's server dead, a get against A's cache dir is
+    # served entirely from local disk
+    monkeypatch.setenv("KT_SERVER_PORT", str(port_a))
+    monkeypatch.setenv("KT_DATA_CACHE_DIR", str(dir_a))
+    got_local = commands.get(key, store_url=store, peer=True)
+    np.testing.assert_array_equal(got_local["w"], tree["w"])
+
+
+@pytest.mark.slow
+def test_p2p_rolling_join_waits_for_parent(store, tmp_path, monkeypatch):
+    """A child routed to a still-fetching parent polls until the parent's
+    cache fills (the reference's block-until-parent-done join) instead of
+    falling straight back to the store."""
+    import json as _json
+    import threading
+
+    import numpy as np
+    import requests
+
+    from kubetorch_tpu.data_store import commands, peer_cache
+
+    key = "p2p/rolling"
+    arr = np.full((8,), 7, dtype=np.int32)
+
+    dir_a = tmp_path / "cache-a"
+    port_a = free_port()
+    proc_a = _spawn_cache_server(dir_a, port_a)
+    try:
+        # register A as an (incomplete) member — it holds nothing yet
+        requests.post(f"{store}/route", json={
+            "key": key, "self_url": f"http://127.0.0.1:{port_a}"}, timeout=10)
+
+        monkeypatch.setenv("POD_IP", "127.0.0.1")
+        monkeypatch.setenv("KT_SERVER_PORT", str(free_port()))
+        monkeypatch.setenv("KT_DATA_CACHE_DIR", str(dir_a))
+        monkeypatch.setenv("KT_PEER_WAIT_S", "20")
+
+        def fill_parent_cache():
+            time.sleep(1.0)
+            meta = {"dtype": "int32", "shape": [8], "kind": "array"}
+            peer_cache.cache_put(f"{key}/value", arr.tobytes(), meta)
+            index = {"leaves": {"value": meta}, "structure": "leaf"}
+            peer_cache.cache_put(f"{key}.__kt_index__",
+                                 _json.dumps(index).encode(),
+                                 {"kind": "index"})
+
+        t = threading.Thread(target=fill_parent_cache)
+        t.start()
+        # the key is NOT in the store at all: only the rolling wait on A's
+        # cache can satisfy this get
+        got = commands.get(key, store_url=store, peer=True)
+        t.join()
+        np.testing.assert_array_equal(got, arr)
+    finally:
+        kill_process_tree(proc_a.pid)

@@ -143,11 +143,27 @@ async def deploy(request: web.Request) -> web.Response:
             "updated_at": time.time(),
             "inactivity_ttl": body.get("inactivity_ttl"),
             "expected_pods": body.get("expected_pods"),
+            "autoscaling": body.get("autoscaling"),
         }
+        if record["autoscaling"] and isinstance(state.backend, LocalBackend):
+            # the local analog of Knative's initial scale: boot with
+            # initial_scale when given (0 is a valid choice: deploy without
+            # spending a pod), else max(min_scale, expected_pods, 1) so a
+            # distributed autoscaled service boots its full world; the
+            # autoscaler loop owns replicas from here on. Deploy counts as a
+            # scale event so the boot-grace pin covers the fresh pods, and
+            # expected_pods tracks what we actually boot or readiness
+            # deadlocks.
+            a = record["autoscaling"]
+            initial = a.get("initial_scale")
+            if initial is None:
+                initial = max(int(a.get("min_scale") or 0),
+                              int(record.get("expected_pods") or 1), 1)
+            manifest.setdefault("spec", {})["replicas"] = int(initial)
+            record["expected_pods"] = int(initial)
+            record["_scaled_at"] = time.time()
 
-        env = {k: (v if isinstance(v, str) else json.dumps(v))
-               for k, v in metadata.items()}
-        env["KT_LAUNCH_ID"] = launch_id
+        env = _metadata_env(record)
         apply_result = await asyncio.to_thread(
             state.backend.apply, namespace, name, manifest, env)
         record.update(apply_result)
@@ -354,6 +370,23 @@ async def proxy_service(request: web.Request) -> web.Response:
         service, port = svc_port, str(DEFAULT_SERVER_PORT)
 
     ips = state.backend.pod_ips(ns, service) if state.backend else []
+    record = state.workloads.get(_workload_key(ns, service))
+    if (not ips and record is not None and record.get("autoscaling")
+            and state.backend is not None):
+        # scale-to-zero cold start (Knative activator role): hold the
+        # request, scale up, wait for a serving pod, then forward. The pin
+        # keeps the autoscaler from reaping the pod before the held
+        # request reaches it (it still looks idle until then).
+        try:
+            record["_coldstart_pin_until"] = time.time() + 30.0
+            await _scale_to(state, record,
+                            max(int(record["autoscaling"].get("min_scale")
+                                    or 0), 1), "cold start")
+            ips = await _wait_for_serving_pod(state, ns, service, record)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response(
+                {"error": f"cold start of {ns}/{service} failed: {e}"},
+                status=503)
     resolved = state.resolve_service_url(ns, service)
     if not ips and resolved:
         target = resolved.rstrip("/")
@@ -387,6 +420,35 @@ async def proxy_service(request: web.Request) -> web.Response:
     except aiohttp.ClientError as e:
         return web.json_response({"error": f"proxy to {url} failed: {e}"},
                                  status=502)
+
+
+async def _wait_for_serving_pod(state: ControllerState, ns: str, name: str,
+                                record: Optional[Dict] = None) -> List[str]:
+    """Poll until a cold-started pod is READY to serve (its rank workers
+    finished load+warmup), so the held request lands on a pod that can
+    actually answer it. The pin is refreshed every iteration: a slow model
+    load (minutes of jit warmup) must not let the autoscaler reap the pod
+    the activator is still waiting on."""
+    import aiohttp
+
+    port = getattr(state.backend, "server_port", DEFAULT_SERVER_PORT)
+    deadline = time.monotonic() + COLDSTART_TIMEOUT_S
+    async with aiohttp.ClientSession() as sess:
+        while time.monotonic() < deadline:
+            if record is not None:
+                record["_coldstart_pin_until"] = time.time() + max(
+                    15.0, 3 * AUTOSCALE_INTERVAL_S)
+            for ip in state.backend.pod_ips(ns, name):
+                try:
+                    async with sess.get(
+                            f"http://{ip}:{port}/ready",
+                            timeout=aiohttp.ClientTimeout(total=2)) as r:
+                        if r.status == 200:
+                            return [ip]
+                except aiohttp.ClientError:
+                    pass
+            await asyncio.sleep(0.25)
+    raise TimeoutError(f"no pod became ready within {COLDSTART_TIMEOUT_S}s")
 
 
 async def _proxy_session(app: web.Application):
@@ -437,6 +499,140 @@ async def pods_ws(request: web.Request) -> web.WebSocketResponse:
         if conn is not None:
             state.unregister_pod(conn)
     return ws
+
+
+# -- local autoscaler ---------------------------------------------------------
+#
+# The reference delegates autoscaling entirely to Knative (KPA/HPA via
+# annotations, §2.6) and so cannot autoscale without a cluster. The local
+# backend implements the same semantics natively: concurrency-targeted
+# scale-up, idle scale-down after scale_down_delay, scale-to-zero, and
+# request-triggered cold start (the activator role) in proxy_service. On
+# Kubernetes the knative manifest path is used instead and this loop idles.
+
+AUTOSCALE_INTERVAL_S = float(os.environ.get("KT_AUTOSCALE_INTERVAL_S", "5"))
+COLDSTART_TIMEOUT_S = float(os.environ.get("KT_COLDSTART_TIMEOUT_S", "120"))
+
+
+def _parse_duration_s(value, default: float = 60.0) -> float:
+    if value is None:
+        return default
+    s = str(value).strip()
+    try:
+        if s.endswith("h"):
+            return float(s[:-1]) * 3600
+        if s.endswith("m"):
+            return float(s[:-1]) * 60
+        if s.endswith("s"):
+            return float(s[:-1])
+        return float(s)
+    except ValueError:
+        return default
+
+
+def _metadata_env(record: Dict) -> Dict[str, str]:
+    env = {k: (v if isinstance(v, str) else json.dumps(v))
+           for k, v in record.get("metadata", {}).items()}
+    if record.get("launch_id"):
+        env["KT_LAUNCH_ID"] = record["launch_id"]
+    return env
+
+
+async def _scale_to(state: ControllerState, record: Dict, replicas: int,
+                    reason: str) -> None:
+    ns, name = record["namespace"], record["name"]
+    manifest = dict(record.get("manifest") or {})
+    manifest.setdefault("spec", {})["replicas"] = replicas
+    result = await asyncio.to_thread(
+        state.backend.apply, ns, name, manifest, _metadata_env(record))
+    record["manifest"] = manifest
+    record["_scaled_at"] = time.time()
+    # lets health checks distinguish "idle-scaled to zero" (healthy) from
+    # "pods never came up" (broken deploy)
+    record["scaled_to_zero"] = replicas == 0
+    record.update(result)
+    state.record_event(f"{ns}/{name}",
+                       f"autoscaled to {replicas} pods ({reason})")
+
+
+async def _autoscale_one(state: ControllerState, record: Dict,
+                         cfg: Dict) -> None:
+    import math
+
+    import aiohttp
+
+    ns, name = record["namespace"], record["name"]
+    ips = state.backend.pod_ips(ns, name)
+    port = getattr(state.backend, "server_port", DEFAULT_SERVER_PORT)
+    current = len(ips)
+    inflight = 0
+    last_activity = 0.0
+    async with aiohttp.ClientSession() as sess:
+        for ip in ips:
+            try:
+                async with sess.get(f"http://{ip}:{port}/metrics",
+                                    timeout=aiohttp.ClientTimeout(total=3)) as r:
+                    text = await r.text()
+                inflight += int(_parse_metric(text, "kt_inflight_requests") or 0)
+                last_activity = max(
+                    last_activity,
+                    _parse_metric(text, "kubetorch_last_activity_timestamp") or 0)
+            except Exception:
+                continue            # unreachable pod counts as zero load
+    target = max(int(cfg.get("target") or 1), 1)
+    min_s = max(int(cfg.get("min_scale") or 0), 0)
+    max_s = cfg.get("max_scale")
+
+    if inflight > 0:
+        # busy: scale-up only — never kill pods that may hold requests
+        desired = max(current, math.ceil(inflight / target), min_s, 1)
+    else:
+        now = time.time()
+        idle_for = now - last_activity if last_activity else 0.0
+        delay = _parse_duration_s(cfg.get("scale_down_delay")
+                                  or cfg.get("window"), default=60.0)
+        # never reap (a) pods younger than the delay — booting pods look
+        # idle until their first request — or (b) a cold start in flight:
+        # the activator holds a request the pod hasn't seen yet
+        pinned = (now - record.get("_scaled_at", 0) < delay
+                  or now < record.get("_coldstart_pin_until", 0))
+        if current == 0:
+            desired = min_s
+        elif idle_for > delay and not pinned:
+            desired = min_s
+            if desired == 0:
+                # going all the way to zero additionally needs the
+                # retention window (Knative scale-to-zero-pod-retention,
+                # default 30s): a pod must survive long enough for the
+                # deploy's health-wait and first request to find it
+                retention = _parse_duration_s(
+                    cfg.get("scale_to_zero_retention"), default=30.0)
+                if idle_for <= max(delay, retention):
+                    desired = current
+        else:
+            desired = current
+    if max_s is not None:
+        desired = min(desired, int(max_s))
+    if desired != current:
+        await _scale_to(state, record, desired,
+                        f"inflight={inflight} target={target}")
+
+
+async def _autoscale_loop(state: ControllerState) -> None:
+    if not isinstance(state.backend, LocalBackend):
+        return
+    while True:
+        await asyncio.sleep(AUTOSCALE_INTERVAL_S)
+        for key, record in list(state.workloads.items()):
+            cfg = record.get("autoscaling")
+            if not cfg:
+                continue
+            try:
+                await _autoscale_one(state, record, cfg)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                state.record_event(key, "autoscale pass failed; will retry")
 
 
 # -- TTL reaper (reference: controller TTL task, SURVEY §2.7) -----------------
@@ -520,6 +716,7 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
 async def _startup(app: web.Application) -> None:
     state: ControllerState = app["cstate"]
     state._ttl_task = asyncio.create_task(_ttl_loop(state))
+    state._autoscale_task = asyncio.create_task(_autoscale_loop(state))
 
 
 async def _cleanup(app: web.Application) -> None:
@@ -529,6 +726,8 @@ async def _cleanup(app: web.Application) -> None:
         await sess.close()
     if state._ttl_task:
         state._ttl_task.cancel()
+    if getattr(state, "_autoscale_task", None):
+        state._autoscale_task.cancel()
     if state.backend is not None:
         await asyncio.to_thread(state.backend.shutdown)
 

@@ -45,6 +45,13 @@ class LocalBackend:
         existing = [h.ip for h in self.services.get(service_key, [])]
         if len(existing) >= n:
             return existing[:n]
+        if existing:
+            # grow within the service's block so live pods keep their
+            # addresses — an autoscale-up must never restart busy pods
+            block = int(existing[0].split(".")[2])
+            top = max(int(ip.split(".")[3]) for ip in existing)
+            return existing + [f"127.77.{block}.{top + i + 1}"
+                               for i in range(n - len(existing))]
         self._ip_block += 1
         block = self._ip_block
         return [f"127.77.{block}.{i + 1}" for i in range(n)]
@@ -106,7 +113,10 @@ class LocalBackend:
         self.services[key] = handles
         for h in handles:
             wait_for_port(h.ip, self.server_port, timeout=30)
-        return {"service_url": f"http://{handles[0].ip}:{self.server_port}",
+        # replicas=0 (scale-to-zero) leaves no pods and no URL; the
+        # controller proxy cold-starts on the next request
+        return {"service_url": (f"http://{handles[0].ip}:{self.server_port}"
+                                if handles else None),
                 "pod_ips": [h.ip for h in handles]}
 
     def delete(self, namespace: str, name: str) -> bool:

@@ -13,6 +13,7 @@ delegates them to user code; JAX does not).
 from __future__ import annotations
 
 import copy
+import dataclasses
 import uuid
 from typing import Any, Dict, List, Optional, Union
 
@@ -173,9 +174,12 @@ class Compute:
                 self.namespace, name, metadata, selector=self.selector,
                 launch_id=launch_id)
         manifest = self.manifest(name, env={})
+        autoscaling = (dataclasses.asdict(self.autoscaling)
+                       if self.autoscaling is not None else None)
         return client.deploy(self.namespace, name, manifest, metadata,
                              launch_id, inactivity_ttl=self.inactivity_ttl,
                              expected_pods=self.replicas,
+                             autoscaling=autoscaling,
                              timeout=self.launch_timeout)
 
     def _check_service_ready(self, name: str, timeout: Optional[float] = None) -> None:

@@ -111,6 +111,8 @@ class Module:
                 self.compute.namespace, self.name)
             self.service_url = record.get("service_url")
         if self.service_url is None:
+            if self._scaled_to_zero():
+                return
             raise ServiceHealthError(f"No service URL for {self.name!r}")
         client = self._http_client()
         deadline = time.monotonic() + (timeout or
@@ -120,15 +122,51 @@ class Module:
         while time.monotonic() < deadline:
             if client.is_ready(self.launch_id):
                 return
+            if self._scaled_to_zero():
+                # an autoscaled service with no pods is healthy-by-design:
+                # launch completed, then the idle window elapsed; the first
+                # call cold-starts it through the controller proxy
+                return
             time.sleep(delay)
             delay = min(delay * 2, 3.0)
         raise ServiceTimeoutError(
             f"Service {self.name!r} at {self.service_url} never became ready "
             f"for launch {self.launch_id}")
 
+    def _scaled_to_zero(self) -> bool:
+        """True only for DELIBERATE zero-pod states — the autoscaler reaped
+        an idle service, or the deploy asked for initial_scale=0. Pods that
+        crashed at boot leave neither marker, so a broken deploy still
+        surfaces as the health-wait timeout it is."""
+        if self.compute is None or self.compute.autoscaling is None:
+            return False
+        try:
+            record = controller_client().get_workload(
+                self.compute.namespace, self.name)
+        except Exception:
+            return False
+        if record.get("pod_ips"):
+            return False
+        return (bool(record.get("scaled_to_zero"))
+                or record.get("expected_pods") == 0)
+
     def _http_client(self) -> HTTPClient:
-        if self._client is None or self._client.base_url != self.service_url:
-            self._client = HTTPClient(self.service_url)
+        from ..config import config as _config
+        from ..constants import DEFAULT_SERVER_PORT
+        ns = self.compute.namespace if self.compute else "default"
+        # the controller-proxy route doubles as the cold-start activator
+        # for scaled-to-zero services (nothing listens at service_url —
+        # which may itself be None after a scale-to-zero: then the proxy IS
+        # the base URL)
+        proxy = (f"{_config().api_url}/{ns}/{self.name}:"
+                 f"{DEFAULT_SERVER_PORT}" if _config().api_url else None)
+        base = self.service_url or proxy
+        if base is None:
+            raise ServiceHealthError(
+                f"No service URL for {self.name!r} and no controller "
+                "configured to route through")
+        if self._client is None or self._client.base_url != base.rstrip("/"):
+            self._client = HTTPClient(base, proxy_url=proxy)
         return self._client
 
     # -- lifecycle ------------------------------------------------------------

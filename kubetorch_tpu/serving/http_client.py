@@ -71,11 +71,16 @@ class HTTPClient:
     """Caller for one deployed service."""
 
     def __init__(self, base_url: str, serialization: Optional[str] = None,
-                 stream_logs: Optional[bool] = None):
+                 stream_logs: Optional[bool] = None,
+                 proxy_url: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.serialization = serialization or config().serialization
         self.stream_logs = (config().stream_logs if stream_logs is None
                             else stream_logs)
+        # Controller-proxy fallback: a scaled-to-zero service has no pod
+        # listening at base_url; the proxy cold-starts it (the Knative
+        # activator role) and forwards the held request.
+        self.proxy_url = proxy_url.rstrip("/") if proxy_url else None
         self._session = _requests.Session()
 
     # -- calls ----------------------------------------------------------------
@@ -100,13 +105,29 @@ class HTTPClient:
         if config().stream_metrics:
             stop_metrics = self._start_metric_stream()
         try:
-            resp = self._session.post(
-                url,
-                data=ser.serialize(body, self.serialization),
-                headers={"X-Serialization": self.serialization,
-                         "X-Request-ID": request_id},
-                timeout=timeout,
-            )
+            data = ser.serialize(body, self.serialization)
+            headers = {"X-Serialization": self.serialization,
+                       "X-Request-ID": request_id}
+            try:
+                resp = self._session.post(url, data=data, headers=headers,
+                                          timeout=timeout)
+            except _requests.exceptions.ConnectionError as e:
+                # Fall back ONLY when the connection was never established
+                # (scaled to zero / pod churn): the proxy cold-starts the
+                # service and holds the request until a pod is ready. A
+                # reset MID-request must not re-POST — the call may already
+                # be executing on the pod, and running it twice is worse
+                # than surfacing the error.
+                established = not any(
+                    marker in str(e) for marker in
+                    ("NewConnectionError", "Connection refused",
+                     "Name or service not known", "No route to host"))
+                if self.proxy_url is None or established:
+                    raise
+                resp = self._session.post(
+                    f"{self.proxy_url}/{fn_name}" +
+                    (f"/{method}" if method else ""),
+                    data=data, headers=headers, timeout=timeout)
         finally:
             if stop_streaming:
                 stop_streaming()

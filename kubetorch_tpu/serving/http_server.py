@@ -73,6 +73,7 @@ class ServerState:
         self._load_lock = asyncio.Lock()
         self.started_at = time.time()
         self.request_count = 0
+        self.inflight = 0          # concurrency signal for the autoscaler
         self.last_activity = time.time()
         self.log_capture = None
         self.metrics_pusher = None
@@ -340,6 +341,7 @@ async def metrics(request: web.Request) -> web.Response:
     extra = (
         f"kubetorch_last_activity_timestamp {state.last_activity}\n"
         f"kt_http_requests_total {state.request_count}\n"
+        f"kt_inflight_requests {state.inflight}\n"
     ).encode()
     return web.Response(body=body + extra, content_type="text/plain")
 
@@ -416,8 +418,17 @@ async def run_callable(request: web.Request) -> web.Response:
     """POST /{fn}[/{method}] → supervisor (reference run_callable :1720)."""
     state: ServerState = request.app["state"]
     state.request_count += 1
+    state.inflight += 1
     state.last_activity = time.time()
+    try:
+        return await _run_callable_inner(request, state)
+    finally:
+        state.inflight -= 1
+        state.last_activity = time.time()
 
+
+async def _run_callable_inner(request: web.Request,
+                              state: "ServerState") -> web.Response:
     fn_name = request.match_info["fn_name"]
     method = request.match_info.get("method") or None
     fmt = request.headers.get("X-Serialization", ser.JSON)

@@ -1,0 +1,129 @@
+"""Local autoscaler end-to-end (reference test_autoscale.py analog — but the
+reference needs Knative on a real cluster; our local backend implements the
+KPA semantics natively: concurrency-targeted scale-up, idle scale-down,
+scale-to-zero, and request-triggered cold start through the controller
+proxy's activator role)."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.level("minimal")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "assets"))
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.client import controller_client, shutdown_local_controller
+from kubetorch_tpu.config import reset_config
+
+import payloads  # tests/assets
+
+_ENV = {"KT_USERNAME": "t-scale", "KT_AUTOSCALE_INTERVAL_S": "1",
+        "KT_COLDSTART_TIMEOUT_S": "60"}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def autoscale_stack():
+    """Fresh local controller whose autoscaler ticks every second (the env
+    must be set before the daemon spawns — it inherits our environ)."""
+    prior = {k: os.environ.get(k) for k in _ENV}
+    shutdown_local_controller()
+    os.environ.update(_ENV)
+    reset_config()
+    yield
+    try:
+        for w in controller_client().list_workloads():
+            if w["name"].startswith("t-scale"):
+                controller_client().delete_workload(w["namespace"], w["name"])
+    except Exception:
+        pass
+    shutdown_local_controller()
+    for k, v in prior.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    reset_config()
+
+
+def _pod_count(name: str) -> int:
+    record = controller_client().get_workload("default", name)
+    return len(record.get("pod_ips") or [])
+
+
+def _wait_for_pods(name: str, predicate, timeout: float) -> int:
+    deadline = time.monotonic() + timeout
+    count = -1
+    while time.monotonic() < deadline:
+        count = _pod_count(name)
+        if predicate(count):
+            return count
+        time.sleep(0.5)
+    return count
+
+
+@pytest.mark.slow
+def test_concurrency_scale_up_then_idle_scale_down():
+    f = kt.fn(payloads.sleeper)
+    f.to(kt.Compute(cpus=1).autoscale(min_scale=1, max_scale=3, target=1,
+                                      scale_down_delay="2s"))
+    try:
+        assert _pod_count(f.name) == 1
+
+        results = []
+        threads = [threading.Thread(target=lambda: results.append(f(10)))
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        # 3 in-flight calls / target 1 → 3 pods (scale-up must not disturb
+        # the busy pod: the calls still complete)
+        grown = _wait_for_pods(f.name, lambda n: n >= 3, timeout=20)
+        assert grown == 3, f"never scaled up (pods={grown})"
+        for t in threads:
+            t.join(timeout=60)
+        assert results == [10, 10, 10]
+
+        # idle past scale_down_delay → back to min_scale
+        shrunk = _wait_for_pods(f.name, lambda n: n == 1, timeout=30)
+        assert shrunk == 1, f"never scaled down (pods={shrunk})"
+    finally:
+        f.teardown()
+
+
+@pytest.mark.slow
+def test_scale_to_zero_and_cold_start():
+    g = kt.fn(payloads.summer)
+    g.to(kt.Compute(cpus=1).autoscale(min_scale=0, max_scale=2, target=2,
+                                      scale_down_delay="2s",
+                                      scale_to_zero_retention="2s"))
+    try:
+        assert g(2, 3) == 5                       # warm path works
+        gone = _wait_for_pods(g.name, lambda n: n == 0, timeout=30)
+        assert gone == 0, f"never scaled to zero (pods={gone})"
+
+        # nothing is listening now: the call falls back to the controller
+        # proxy, which cold-starts a pod, waits for ready, and forwards
+        assert g(10, -4) == 6
+        assert _pod_count(g.name) >= 1
+    finally:
+        g.teardown()
+
+
+@pytest.mark.slow
+def test_initial_scale_zero_deploys_without_booting_a_pod():
+    """initial_scale=0: .to() completes without spending a pod boot; the
+    first call cold-starts through the proxy (which is also the client's
+    base URL — no service URL ever existed)."""
+    h = kt.fn(payloads.summer, name="t-scale-initzero")
+    h.to(kt.Compute(cpus=1).autoscale(min_scale=0, max_scale=1, target=1,
+                                      initial_scale=0, scale_down_delay="2s",
+                                      scale_to_zero_retention="2s"))
+    try:
+        assert _pod_count(h.name) == 0
+        assert h(4, 5) == 9
+        assert _pod_count(h.name) == 1
+    finally:
+        h.teardown()

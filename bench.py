@@ -9,11 +9,24 @@ The reference publishes no numeric baselines (BASELINE.md — "published": {}),
 so ``vs_baseline`` reports achieved MFU divided by a 0.40 MFU target — i.e.
 1.0 means we hit 40% model-FLOPs utilization on the chip, the strong-baseline
 regime for this size class.
+
+Structure: a launcher/worker split. The TPU relay in this environment is
+intermittently unavailable, and a failed jax backend init poisons the process
+(the backend is cached as failed), so each attempt runs in a FRESH worker
+subprocess. The launcher retries with backoff inside a total time budget and
+only then falls back to an honest CPU-labelled number. Only the worker writes
+to stdout, so the driver still sees exactly one JSON line.
+
+Env knobs: KT_BENCH_BUDGET_S (total retry budget, default 1500),
+KT_BENCH_WAIT_S (sleep between attempts, default 60),
+KT_BENCH_ATTEMPT_TIMEOUT_S (per-attempt cap, default 600).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -29,6 +42,9 @@ PEAK_BF16_FLOPS = {
 }
 MFU_TARGET = 0.40
 
+# worker exit code meaning "TPU not available right now; retry me"
+RC_TPU_UNAVAILABLE = 3
+
 
 def peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
@@ -38,18 +54,70 @@ def peak_flops(device) -> float:
     return 197e12
 
 
-def main() -> None:
+def main() -> int:
+    if os.environ.get("KT_BENCH_WORKER"):
+        return bench_worker(force_cpu=bool(os.environ.get("KT_BENCH_FORCE_CPU")))
+
+    budget = float(os.environ.get("KT_BENCH_BUDGET_S", "1500"))
+    wait = float(os.environ.get("KT_BENCH_WAIT_S", "60"))
+    attempt_cap = float(os.environ.get("KT_BENCH_ATTEMPT_TIMEOUT_S", "600"))
+    deadline = time.monotonic() + budget
+
+    attempt = 0
+    crashes = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 60 and attempt > 1:
+            break
+        timeout = min(attempt_cap, max(remaining, 120))
+        print(f"bench attempt {attempt} (timeout {timeout:.0f}s, "
+              f"{max(remaining, 0):.0f}s budget left)", file=sys.stderr)
+        env = {**os.environ, "KT_BENCH_WORKER": "1"}
+        try:
+            rc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                env=env, timeout=timeout).returncode
+        except subprocess.TimeoutExpired:
+            print(f"attempt {attempt}: timed out after {timeout:.0f}s",
+                  file=sys.stderr)
+            rc = RC_TPU_UNAVAILABLE
+        if rc == 0:
+            return 0
+        if rc != RC_TPU_UNAVAILABLE:
+            # worker crashed on-device; batch downsizing already happens
+            # inside the worker, so a second identical crash is
+            # deterministic — stop retrying and fall back
+            print(f"attempt {attempt}: worker rc={rc}", file=sys.stderr)
+            crashes += 1
+            if crashes >= 2:
+                break
+        if time.monotonic() + wait >= deadline:
+            break
+        time.sleep(wait)
+
+    print("TPU never became available within budget; CPU fallback",
+          file=sys.stderr)
+    env = {**os.environ, "KT_BENCH_WORKER": "1", "KT_BENCH_FORCE_CPU": "1"}
+    return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env).returncode
+
+
+def bench_worker(force_cpu: bool = False) -> int:
     import jax
 
-    try:
-        dev = jax.devices()[0]
-    except RuntimeError as e:
-        # accelerator backend unavailable (e.g. TPU relay down): report an
-        # honest CPU-labelled number rather than crashing with no JSON line
-        print(f"accelerator backend unavailable ({e}); falling back to CPU",
-              file=sys.stderr)
+    if force_cpu:
         jax.config.update("jax_platforms", "cpu")
         dev = jax.devices()[0]
+    else:
+        try:
+            dev = jax.devices()[0]
+        except RuntimeError as e:
+            print(f"accelerator backend unavailable ({e})", file=sys.stderr)
+            return RC_TPU_UNAVAILABLE
+        if dev.platform != "tpu":
+            print(f"no TPU in device list (got {dev.platform})",
+                  file=sys.stderr)
+            return RC_TPU_UNAVAILABLE
 
     import jax.numpy as jnp
     import optax
@@ -134,6 +202,7 @@ def main() -> None:
             "device": getattr(dev, "device_kind", dev.platform),
         },
     }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -18,7 +18,7 @@ class Cls(Module):
             raise AttributeError(attr)
 
         def remote_method(*args, workers=None, timeout=None, **kwargs):
-            if self.service_url is None:
+            if not self.is_deployed:
                 raise RuntimeError(
                     f"{self.pointers.cls_or_fn_name} is not deployed; call "
                     f".to(kt.Compute(...)) first")

@@ -13,7 +13,7 @@ class Fn(Module):
     def __call__(self, *args, workers=None, timeout: Optional[float] = None,
                  stream_logs: Optional[bool] = None,
                  debugger: Optional[dict] = None, **kwargs) -> Any:
-        if self.service_url is None:
+        if not self.is_deployed:
             raise RuntimeError(
                 f"{self.pointers.cls_or_fn_name} is not deployed; call "
                 f".to(kt.Compute(...)) first")

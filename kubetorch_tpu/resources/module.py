@@ -150,6 +150,16 @@ class Module:
         return (bool(record.get("scaled_to_zero"))
                 or record.get("expected_pods") == 0)
 
+    @property
+    def is_deployed(self) -> bool:
+        """True once this module has a route to the service: a pod URL, or a
+        completed launch whose calls go through the controller proxy (an
+        ``initial_scale=0`` / scaled-to-zero service never has a pod URL —
+        the proxy cold-starts it on first call). launch_id is only set after
+        ``_launch`` returns, so a deploy that raised mid-flight still reads
+        as not deployed."""
+        return self.service_url is not None or self.launch_id is not None
+
     def _http_client(self) -> HTTPClient:
         from ..config import config as _config
         from ..constants import DEFAULT_SERVER_PORT
@@ -195,6 +205,7 @@ class Module:
             self.compute.namespace if self.compute else config().namespace,
             self.name)
         self.service_url = None
+        self.launch_id = None
         self._client = None
 
 

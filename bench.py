@@ -102,6 +102,16 @@ def main() -> int:
                           env=env).returncode
 
 
+_T0 = time.monotonic()
+
+
+def _progress(msg: str) -> None:
+    # stderr heartbeat so a hung attempt shows WHERE it hung (stdout must
+    # stay one clean JSON line for the driver)
+    print(f"[bench-worker +{time.monotonic() - _T0:5.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def bench_worker(force_cpu: bool = False) -> int:
     import jax
 
@@ -110,6 +120,7 @@ def bench_worker(force_cpu: bool = False) -> int:
         dev = jax.devices()[0]
     else:
         try:
+            _progress("initializing accelerator backend (jax.devices())")
             dev = jax.devices()[0]
         except RuntimeError as e:
             print(f"accelerator backend unavailable ({e})", file=sys.stderr)
@@ -118,6 +129,7 @@ def bench_worker(force_cpu: bool = False) -> int:
             print(f"no TPU in device list (got {dev.platform})",
                   file=sys.stderr)
             return RC_TPU_UNAVAILABLE
+        _progress(f"backend up: {dev.device_kind}")
 
     import jax.numpy as jnp
     import optax
@@ -139,9 +151,11 @@ def bench_worker(force_cpu: bool = False) -> int:
         cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
         batch, seq, steps, warmup = 4, 64, 4, 1
 
+    _progress(f"init params ({cfg.param_count():,})")
     params = llama_init(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
     state = init_train_state(params, opt)
+    _progress("params initialized")
     # chunked CE: never materializes the (B, S, V) fp32 logits tensor
     step_fn = make_train_step(
         lambda p, t, y: llama_loss_chunked(p, t, y, cfg, chunk=256),
@@ -151,9 +165,14 @@ def bench_worker(force_cpu: bool = False) -> int:
         nonlocal state
         tokens = jax.random.randint(jax.random.PRNGKey(1), (batch_size, seq), 0, cfg.vocab_size)
         b = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
-        for _ in range(warmup):
+        _progress(f"warmup/compile start (batch={batch_size})")
+        for i in range(warmup):
             state, m = step_fn(state, b)
+            if i == 0:
+                float(m["loss"])
+                _progress("first step compiled + executed")
         float(m["loss"])  # host fetch: hard sync even where block_until_ready
+        _progress("warmup done; measuring")
         t0 = time.perf_counter()  # is unreliable (axon relay)
         for _ in range(steps):
             state, m = step_fn(state, b)

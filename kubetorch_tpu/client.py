@@ -35,6 +35,24 @@ class ControllerClient:
         url = f"{self.base_url}{path}"
         try:
             resp = self._session.request(method, url, timeout=timeout, **kwargs)
+        except _requests.ConnectionError as e:
+            # A daemon this process discovered/spawned died (e.g. kill -9).
+            # Its durable state revives under a fresh daemon, so re-resolve
+            # once and retry — a long-lived client process must not be
+            # permanently wedged on a dead local controller. User-configured
+            # URLs are never silently redirected.
+            new_url = _recover_daemon(self.base_url)
+            if new_url is None:
+                raise ControllerRequestError(
+                    f"Controller unreachable at {url}: {e}")
+            self.base_url = new_url
+            url = f"{self.base_url}{path}"
+            try:
+                resp = self._session.request(method, url, timeout=timeout,
+                                             **kwargs)
+            except _requests.RequestException as e2:
+                raise ControllerRequestError(
+                    f"Controller unreachable at {url}: {e2}")
         except _requests.RequestException as e:
             raise ControllerRequestError(f"Controller unreachable at {url}: {e}")
         if resp.status_code >= 400:
@@ -118,12 +136,17 @@ class ControllerClient:
 
 _lock = threading.Lock()
 _client: Optional[ControllerClient] = None
+# URL of the daemon this process discovered/spawned (as opposed to a
+# user-configured api_url): only these are safe to silently re-resolve when
+# they stop answering — see _recover_daemon.
+_daemon_url: Optional[str] = None
 
 
 def _clear_client_singleton() -> None:
-    global _client
+    global _client, _daemon_url
     with _lock:
         _client = None
+        _daemon_url = None
 
 
 # reset_config() must also drop the derived client singleton, or a stale
@@ -161,6 +184,37 @@ def _read_running_local() -> Optional[Dict]:
                 remote_fp = None
             if remote_fp == code_fingerprint():
                 return state
+            # Stale code, but the daemon may be running someone's workloads
+            # (another venv/checkout alternating with this one, or a long
+            # training service). Killing it would tear all of them down, so
+            # refuse and reuse unless explicitly overridden — the user can
+            # run `kt controller stop` (records persist and revive, but
+            # in-flight work on the pods dies).
+            if os.environ.get("KT_CONTROLLER_REPLACE", "") != "always":
+                try:
+                    listed = _requests.get(
+                        f"{state['url']}/controller/workloads",
+                        timeout=5).json().get("workloads", [])
+                    # persisted records with explicitly zero live pods (e.g.
+                    # restored after a daemon restart) are safe to hand over
+                    # — the replacement daemon revives them from the same
+                    # state dir. A missing pod_count (older daemon code that
+                    # predates the field) must count as active: unknown is
+                    # not safe-to-kill.
+                    active = [w for w in listed if w.get("pod_count", 1)]
+                except (_requests.RequestException, ValueError):
+                    active = None
+                if active or active is None:
+                    # a failed probe also lands here: never kill a daemon
+                    # whose workloads we could not enumerate
+                    import warnings
+                    n = len(active) if active else "unknown"
+                    warnings.warn(
+                        f"Local controller pid {state['pid']} runs stale code "
+                        f"but hosts {n} active workload(s); reusing "
+                        "it. Run `kt controller stop` to replace it (or set "
+                        "KT_CONTROLLER_REPLACE=always).")
+                    return state
             if _kill_daemon_process(state):
                 try:
                     os.unlink(_state_file())
@@ -232,9 +286,28 @@ def controller_client() -> ControllerClient:
                 _client = ControllerClient(pf_url)
                 return _client
             state = _spawn_local_daemon()
+        global _daemon_url
+        _daemon_url = state["url"]
         config().api_url = state["url"]
         _client = ControllerClient(state["url"])
         return _client
+
+
+def _recover_daemon(dead_url: str) -> Optional[str]:
+    """Called on a connection error to ``dead_url``. When that URL is the
+    local daemon this process resolved (never a user-configured one),
+    re-resolve — respawning the daemon if needed, which restores its durable
+    workload state — and return the replacement URL."""
+    global _client, _daemon_url
+    with _lock:
+        if dead_url != _daemon_url:
+            return None
+        if config().api_url == dead_url:
+            config().api_url = None
+        _client = None
+        _daemon_url = None
+    new_client = controller_client()
+    return new_client.base_url if new_client.base_url != dead_url else None
 
 
 def _try_cluster_port_forward() -> Optional[str]:
@@ -312,9 +385,10 @@ def _spawn_local_daemon_locked() -> Dict:
 def shutdown_local_controller() -> None:
     """Stop the local daemon and all its pods (used by tests and
     ``kt controller stop``)."""
-    global _client
+    global _client, _daemon_url
     with _lock:
         _client = None
+        _daemon_url = None
         state = None
         try:
             import json

@@ -37,7 +37,8 @@ class PodConnection:
 
 
 class ControllerState:
-    def __init__(self, backend=None, base_url: str = ""):
+    def __init__(self, backend=None, base_url: str = "",
+                 state_dir: Optional[str] = None):
         self.backend = backend
         self.base_url = base_url
         self.workloads: Dict[str, Dict[str, Any]] = {}
@@ -47,6 +48,54 @@ class ControllerState:
         self.events: deque = deque(maxlen=2000)
         self.cluster_config: Dict[str, Any] = {}
         self._ttl_task: Optional[asyncio.Task] = None
+        self._apply_locks: Dict[str, asyncio.Lock] = {}
+        self.persister = None
+        if state_dir:
+            from .persistence import DiskPersister
+            self.persister = DiskPersister(state_dir)
+
+    def apply_lock(self, service_key: str) -> asyncio.Lock:
+        """Per-service lock serializing ``backend.apply`` — a held cold-start
+        request and an autoscale tick (or two simultaneous cold starts) must
+        not double-spawn pods; LocalBackend.apply itself is not thread-safe."""
+        return self._apply_locks.setdefault(service_key, asyncio.Lock())
+
+    # -- durable state --------------------------------------------------------
+
+    def save_workload(self, record: Dict[str, Any]) -> None:
+        if self.persister is not None:
+            self.persister.save_workload(record)
+
+    def forget_workload(self, namespace: str, name: str) -> None:
+        if self.persister is not None:
+            self.persister.delete_workload(namespace, name)
+
+    def restore(self) -> None:
+        """Reload workloads/logs/events persisted by a previous controller
+        process. Local pods died with that process, so their addresses are
+        stale: drop them and let the proxy's revival path re-apply the
+        manifest on the next call."""
+        if self.persister is None:
+            return
+        for record in self.persister.load_workloads():
+            key = f"{record['namespace']}/{record['name']}"
+            if isinstance(self.backend, LocalBackend) and record.get("manifest"):
+                # controller-spawned pods died with the old process; BYO
+                # register-only records (no manifest) point at external pods
+                # that are still alive — keep their addresses
+                record.pop("pod_ips", None)
+                record.pop("service_url", None)
+                record["status"] = "restored"
+            self.workloads[key] = record
+        for service_key, entries in self.persister.load_logs():
+            buf = self.logs.setdefault(
+                service_key, deque(maxlen=LOG_BUFFER_PER_SERVICE))
+            for e in entries:
+                self.log_seq += 1
+                e["seq"] = self.log_seq
+                buf.append(e)
+        for event in self.persister.load_events():
+            self.events.append(event)
 
     # -- pod registry ---------------------------------------------------------
 
@@ -84,8 +133,11 @@ class ControllerState:
         return None
 
     def record_event(self, service_key: str, message: str) -> None:
-        self.events.append({"ts": time.time(), "service": service_key,
-                            "message": message})
+        event = {"ts": time.time(), "service": service_key,
+                 "message": message}
+        self.events.append(event)
+        if self.persister is not None:
+            self.persister.append_event(event)
 
     # -- reload push (SURVEY §7 hard-part 1) ----------------------------------
 
@@ -164,10 +216,12 @@ async def deploy(request: web.Request) -> web.Response:
             record["_scaled_at"] = time.time()
 
         env = _metadata_env(record)
-        apply_result = await asyncio.to_thread(
-            state.backend.apply, namespace, name, manifest, env)
-        record.update(apply_result)
-        state.workloads[key] = record
+        async with state.apply_lock(key):
+            apply_result = await asyncio.to_thread(
+                state.backend.apply, namespace, name, manifest, env)
+            record.update(apply_result)
+            state.workloads[key] = record
+        await asyncio.to_thread(state.save_workload, record)
         state.record_event(key, f"deployed launch_id={launch_id}")
 
         # hot reload on already-connected pods
@@ -218,6 +272,7 @@ async def register_workload(request: web.Request) -> web.Response:
         "selector": body.get("selector"),
         "service_url": body.get("service_url"),
     }
+    await asyncio.to_thread(state.save_workload, state.workloads[key])
     reload_results = await state.push_reload(
         namespace, name, {**body.get("metadata", {}), "KT_LAUNCH_ID": launch_id},
         launch_id)
@@ -258,6 +313,7 @@ async def delete_workload(request: web.Request) -> web.Response:
     key = _workload_key(ns, name)
     record = state.workloads.pop(key, None)
     deleted = await asyncio.to_thread(state.backend.delete, ns, name)
+    state.forget_workload(ns, name)
     state.record_event(key, "deleted")
     return web.json_response({"ok": True, "existed": record is not None or deleted})
 
@@ -271,7 +327,8 @@ async def list_workloads(request: web.Request) -> web.Response:
             continue
         out.append({k: record[k] for k in
                     ("namespace", "name", "launch_id", "created_at",
-                     "updated_at", "service_url") if k in record})
+                     "updated_at", "service_url", "status") if k in record}
+                   | {"pod_count": len(record.get("pod_ips") or [])})
     return web.json_response({"workloads": out})
 
 
@@ -314,11 +371,17 @@ async def version(request: web.Request) -> web.Response:
 async def ingest_logs(request: web.Request) -> web.Response:
     state: ControllerState = request.app["cstate"]
     body = await request.json()
+    by_service: Dict[str, List[Dict]] = {}
     for entry in body.get("entries", []):
         key = f"{entry.get('namespace', 'default')}/{entry.get('service', '')}"
         state.log_seq += 1
         entry["seq"] = state.log_seq
         state.logs.setdefault(key, deque(maxlen=LOG_BUFFER_PER_SERVICE)).append(entry)
+        by_service.setdefault(key, []).append(entry)
+    if state.persister is not None:
+        # non-blocking enqueue; the persister's writer thread owns the disk
+        for key, entries in by_service.items():
+            state.persister.append_logs(key, entries)
     return web.json_response({"ok": True})
 
 
@@ -371,17 +434,28 @@ async def proxy_service(request: web.Request) -> web.Response:
 
     ips = state.backend.pod_ips(ns, service) if state.backend else []
     record = state.workloads.get(_workload_key(ns, service))
-    if (not ips and record is not None and record.get("autoscaling")
-            and state.backend is not None):
-        # scale-to-zero cold start (Knative activator role): hold the
-        # request, scale up, wait for a serving pod, then forward. The pin
-        # keeps the autoscaler from reaping the pod before the held
-        # request reaches it (it still looks idle until then).
+    revivable = (record is not None and state.backend is not None
+                 and (record.get("autoscaling")
+                      or (record.get("manifest")
+                          and isinstance(state.backend, LocalBackend))))
+    if not ips and revivable:
+        # Two cases share this path: scale-to-zero cold start (the Knative
+        # activator role) and revival of a workload restored from disk after
+        # a controller restart — local pods died with the old process, so
+        # re-apply the manifest. Hold the request, scale up, wait for a
+        # serving pod, then forward. The pin keeps the autoscaler from
+        # reaping the pod before the held request reaches it (it still
+        # looks idle until then).
+        if record.get("autoscaling"):
+            replicas = max(int(record["autoscaling"].get("min_scale") or 0), 1)
+        else:
+            replicas = max(int(record.get("expected_pods")
+                               or (record.get("manifest") or {})
+                               .get("spec", {}).get("replicas", 1)), 1)
         try:
             record["_coldstart_pin_until"] = time.time() + 30.0
-            await _scale_to(state, record,
-                            max(int(record["autoscaling"].get("min_scale")
-                                    or 0), 1), "cold start")
+            await _scale_to(state, record, replicas, "cold start")
+            record.pop("status", None)   # no longer "restored"
             ips = await _wait_for_serving_pod(state, ns, service, record)
         except Exception as e:  # noqa: BLE001
             return web.json_response(
@@ -541,16 +615,18 @@ def _metadata_env(record: Dict) -> Dict[str, str]:
 async def _scale_to(state: ControllerState, record: Dict, replicas: int,
                     reason: str) -> None:
     ns, name = record["namespace"], record["name"]
-    manifest = dict(record.get("manifest") or {})
-    manifest.setdefault("spec", {})["replicas"] = replicas
-    result = await asyncio.to_thread(
-        state.backend.apply, ns, name, manifest, _metadata_env(record))
-    record["manifest"] = manifest
-    record["_scaled_at"] = time.time()
-    # lets health checks distinguish "idle-scaled to zero" (healthy) from
-    # "pods never came up" (broken deploy)
-    record["scaled_to_zero"] = replicas == 0
-    record.update(result)
+    async with state.apply_lock(f"{ns}/{name}"):
+        manifest = dict(record.get("manifest") or {})
+        manifest.setdefault("spec", {})["replicas"] = replicas
+        result = await asyncio.to_thread(
+            state.backend.apply, ns, name, manifest, _metadata_env(record))
+        record["manifest"] = manifest
+        record["_scaled_at"] = time.time()
+        # lets health checks distinguish "idle-scaled to zero" (healthy) from
+        # "pods never came up" (broken deploy)
+        record["scaled_to_zero"] = replicas == 0
+        record.update(result)
+    await asyncio.to_thread(state.save_workload, record)
     state.record_event(f"{ns}/{name}",
                        f"autoscaled to {replicas} pods ({reason})")
 
@@ -669,6 +745,7 @@ async def _ttl_loop(state: ControllerState) -> None:
                     # succeeded, so a transient failure retries next cycle
                     await asyncio.to_thread(state.backend.delete, ns, name)
                     state.workloads.pop(key, None)
+                    state.forget_workload(ns, name)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -715,6 +792,7 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
 
 async def _startup(app: web.Application) -> None:
     state: ControllerState = app["cstate"]
+    state.restore()
     state._ttl_task = asyncio.create_task(_ttl_loop(state))
     state._autoscale_task = asyncio.create_task(_autoscale_loop(state))
 
@@ -730,6 +808,8 @@ async def _cleanup(app: web.Application) -> None:
         state._autoscale_task.cancel()
     if state.backend is not None:
         await asyncio.to_thread(state.backend.shutdown)
+    if state.persister is not None:
+        await asyncio.to_thread(state.persister.close)
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -741,7 +821,15 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--backend", choices=["local", "kubernetes"], default="local")
     args = p.parse_args(argv)
 
-    state = ControllerState(base_url=f"http://127.0.0.1:{args.port}")
+    # Durable control-plane state (reference: KubetorchWorkload CRD + Loki —
+    # SURVEY §2.7): local daemon persists under ~/.kt by default so kill -9 →
+    # restart keeps every workload record and log line.
+    state_dir = os.environ.get("KT_CONTROLLER_STATE_DIR")
+    if state_dir is None and args.backend == "local":
+        from ..config import config as _cfg
+        state_dir = os.path.join(_cfg().config_dir, "controller-state")
+    state = ControllerState(base_url=f"http://127.0.0.1:{args.port}",
+                            state_dir=state_dir)
     if args.backend == "kubernetes":
         from .backends import KubernetesBackend
         state.backend = KubernetesBackend()

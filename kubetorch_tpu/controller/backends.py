@@ -30,6 +30,42 @@ class PodHandle:
         self.process = process
 
 
+# manifest kinds that are config objects, not runnable workloads
+OBJECT_KINDS = {"Secret", "PersistentVolumeClaim", "ConfigMap"}
+
+
+def controller_wiring(controller_url: str) -> Dict[str, str]:
+    """Env vars every pod needs to register with the controller and stream
+    logs, derived from the controller's base URL."""
+    return {
+        "KT_CONTROLLER_WS_URL":
+            controller_url.replace("http", "ws", 1) + "/controller/ws/pods",
+        "KT_LOG_SINK_URL": controller_url + "/controller/logs",
+    }
+
+
+# libc resolved at import time: the preexec hook runs between fork and exec
+# in a multithreaded parent, where `import ctypes`/CDLL could deadlock on
+# locks held by other threads at fork time. Only the pre-bound prctl call
+# may run there.
+try:
+    import ctypes as _ctypes
+    import signal as _signal
+
+    _LIBC = _ctypes.CDLL("libc.so.6", use_errno=True)
+    _LIBC.prctl  # resolve the symbol now
+except Exception:
+    _LIBC = None
+_PR_SET_PDEATHSIG = 1
+
+
+def _die_with_parent():
+    """PR_SET_PDEATHSIG: local pods are children of the controller daemon; if
+    the daemon is SIGKILLed (no cleanup runs), orphaned pods would squat the
+    per-service IP:port and wedge every revival after restart. Linux-only."""
+    _LIBC.prctl(_PR_SET_PDEATHSIG, _signal.SIGTERM)
+
+
 class LocalBackend:
     """Run 'pods' as subprocesses on loopback alias IPs."""
 
@@ -56,14 +92,11 @@ class LocalBackend:
         block = self._ip_block
         return [f"127.77.{block}.{i + 1}" for i in range(n)]
 
-    # manifest kinds that are config objects, not runnable workloads
-    _OBJECT_KINDS = {"Secret", "PersistentVolumeClaim", "ConfigMap"}
-
     def apply(self, namespace: str, name: str, manifest: Dict,
               env: Dict[str, str]) -> Dict:
         key = f"{namespace}/{name}"
         kind = manifest.get("kind", "Deployment")
-        if kind in self._OBJECT_KINDS:
+        if kind in OBJECT_KINDS:
             # store config objects instead of spawning pods for them
             self.objects = getattr(self, "objects", {})
             self.objects[f"{kind}/{key}"] = manifest
@@ -88,9 +121,7 @@ class LocalBackend:
             "PALLAS_AXON_POOL_IPS": pod_env.get("KT_POD_TPU", ""),
             "LOCAL_IPS": ",".join(ips[:replicas]),
             "KT_SERVER_PORT": str(self.server_port),
-            "KT_CONTROLLER_WS_URL":
-                self.controller_url.replace("http", "ws", 1) + "/controller/ws/pods",
-            "KT_LOG_SINK_URL": self.controller_url + "/controller/logs",
+            **controller_wiring(self.controller_url),
             "KT_NAMESPACE": namespace,
             "KT_SERVICE_NAME": name,
         })
@@ -108,7 +139,8 @@ class LocalBackend:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "kubetorch_tpu.serving.http_server",
                  "--host", ip, "--port", str(self.server_port)],
-                env=p_env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                env=p_env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                preexec_fn=_die_with_parent if _LIBC is not None else None)
             handles.append(PodHandle(f"{name}-{i}", ip, proc))
         self.services[key] = handles
         for h in handles:
@@ -141,20 +173,43 @@ class LocalBackend:
 
 
 class KubernetesBackend:
-    """kubectl-applied manifests. Requires cluster credentials."""
+    """kubectl-applied manifests. Requires cluster credentials (or a kubectl
+    shim — the test suite drives this path end-to-end with a recording fake,
+    ``tests/assets/fake_kubectl.py``).
+
+    Reference analog: the closed-source controller's K8s apply path
+    (``provisioning/service_manager.py:387-673``). Beyond applying the
+    workload manifest itself, a deploy also needs routable Services: a
+    ClusterIP Service fronting the pods and a headless Service for rank
+    discovery (reference ``createHeadlessService`` in the workload CRD).
+    Knative creates its own route, so only the headless Service is added
+    there."""
+
+    # kubectl resource names per manifest kind, for deletes
+    _KIND_RESOURCES = {
+        "Deployment": "deployment",
+        "JobSet": "jobsets.jobset.x-k8s.io",
+        "KnativeService": "services.serving.knative.dev",
+        "Secret": "secret",
+        "PersistentVolumeClaim": "pvc",
+        "ConfigMap": "configmap",
+    }
 
     def __init__(self, kubectl: Optional[str] = None):
-        self.kubectl = kubectl or shutil.which("kubectl")
+        self.kubectl = (kubectl or os.environ.get("KT_KUBECTL")
+                        or shutil.which("kubectl"))
         if self.kubectl is None:
             raise RuntimeError("kubectl not found; KubernetesBackend unavailable")
+        self.kinds: Dict[str, str] = {}  # "ns/name" -> applied manifest kind
 
     @staticmethod
     def available() -> bool:
-        if shutil.which("kubectl") is None:
+        kubectl = os.environ.get("KT_KUBECTL") or shutil.which("kubectl")
+        if kubectl is None:
             return False
         try:
             return subprocess.run(
-                ["kubectl", "auth", "can-i", "create", "deployments"],
+                [kubectl, "auth", "can-i", "create", "deployments"],
                 capture_output=True, timeout=10).returncode == 0
         except Exception:
             return False
@@ -166,23 +221,87 @@ class KubernetesBackend:
             raise RuntimeError(f"kubectl {' '.join(args)} failed: {res.stderr}")
         return res.stdout
 
+    @staticmethod
+    def _manifest_kind(manifest: Dict) -> str:
+        kind = manifest.get("kind", "Deployment")
+        if kind == "Service" and "knative" in manifest.get("apiVersion", ""):
+            return "KnativeService"
+        return kind
+
+    @classmethod
+    def _pod_specs(cls, manifest: Dict) -> List[Dict]:
+        """Locate the pod spec(s) inside a workload manifest (reference
+        ``navigate_path``-style kind polymorphism, compute/utils.py:18-54)."""
+        kind = cls._manifest_kind(manifest)
+        spec = manifest.get("spec", {})
+        if kind == "JobSet":
+            return [job.get("template", {}).get("spec", {})
+                       .get("template", {}).get("spec", {})
+                    for job in spec.get("replicatedJobs", [])]
+        # Deployment and Knative Service share spec.template.spec
+        return [spec.get("template", {}).get("spec", {})]
+
+    def _inject_env(self, manifest: Dict, env: Dict[str, str]) -> None:
+        """Merge workload metadata env + in-cluster wiring into every
+        container, without overriding explicitly-set manifest values. Pods
+        need KT_CONTROLLER_WS_URL / KT_LOG_SINK_URL to register and stream
+        logs — LocalBackend passes these through the subprocess environment;
+        here they ride the manifest."""
+        cluster_url = os.environ.get(
+            "KT_CLUSTER_CONTROLLER_URL",
+            "http://kubetorch-controller.kubetorch.svc.cluster.local:8080")
+        wired = {**controller_wiring(cluster_url), **env}
+        for pod_spec in self._pod_specs(manifest):
+            for container in pod_spec.get("containers", []):
+                have = {e["name"] for e in container.setdefault("env", [])}
+                container["env"].extend(
+                    {"name": k, "value": v} for k, v in sorted(wired.items())
+                    if k not in have)
+
     def apply(self, namespace: str, name: str, manifest: Dict,
               env: Dict[str, str]) -> Dict:
-        # env travels inside the manifest (built by provisioning.manifests);
-        # the separate arg exists for LocalBackend symmetry.
+        kind = self._manifest_kind(manifest)
+        if kind not in OBJECT_KINDS:
+            self._inject_env(manifest, env)
         self._run("apply", "-n", namespace, "-f", "-",
                   input_data=json.dumps(manifest))
+        self.kinds[f"{namespace}/{name}"] = kind
+        if kind in OBJECT_KINDS:
+            return {"kind": kind, "stored": True}
+
+        from ..provisioning.manifests import build_service_manifest
+        if kind != "KnativeService":  # Knative provisions its own route
+            self._run("apply", "-n", namespace, "-f", "-",
+                      input_data=json.dumps(
+                          build_service_manifest(name, namespace)))
+        self._run("apply", "-n", namespace, "-f", "-",
+                  input_data=json.dumps(
+                      build_service_manifest(name, namespace, headless=True)))
+        # best-effort: pods are usually still Pending right after apply, and
+        # a transient kubectl failure must not fail a deploy that succeeded
+        try:
+            pod_ips = self.pod_ips(namespace, name)
+        except RuntimeError:
+            pod_ips = []
         return {"service_url":
                 f"http://{name}.{namespace}.svc.cluster.local:32300",
-                "pod_ips": []}
+                "pod_ips": pod_ips}
 
     def delete(self, namespace: str, name: str) -> bool:
-        kind = "deployment"
+        kind = self.kinds.pop(f"{namespace}/{name}", None)
+        # unknown kind (e.g. controller restarted): sweep every kind we can
+        # create, config objects included — a post-restart delete must not
+        # silently leak a Secret/PVC/ConfigMap
+        resources = ([self._KIND_RESOURCES[kind]] if kind else
+                     list(self._KIND_RESOURCES.values()))
         try:
-            self._run("delete", kind, name, "-n", namespace,
-                      "--ignore-not-found")
-            self._run("delete", "service", name, "-n", namespace,
-                      "--ignore-not-found")
+            for resource in resources:
+                self._run("delete", resource, name, "-n", namespace,
+                          "--ignore-not-found")
+            if kind not in OBJECT_KINDS:
+                for svc in (name, f"{name}-headless"):
+                    self._run("delete", "service", svc, "-n", namespace,
+                              "--ignore-not-found")
             return True
         except RuntimeError:
             return False

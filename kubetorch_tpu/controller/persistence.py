@@ -1,0 +1,210 @@
+"""Controller state persistence.
+
+The reference controller keeps workload truth in the ``KubetorchWorkload``
+CRD (``charts/kubetorch/templates/crds/kubetorchworkload-crd.yaml:214-233``
+status fields) and log history in Loki, so a controller restart loses
+nothing. The round-1 rebuild kept both in process memory; this module is the
+durable replacement for the local/BYO controller:
+
+- workload records → one JSON file each under ``{root}/workloads/``
+  (atomic rename writes, so a kill -9 mid-write never corrupts a record)
+- log entries → append-only JSONL per service under ``{root}/logs/`` with
+  size-capped rotation (one previous generation kept)
+- events → single capped JSONL
+
+In cluster mode the equivalent is the K8s API itself: the controller mirrors
+records into KubetorchWorkload objects via the backend (see
+``KubernetesBackend.save_workload_record``), and logs ride to Loki
+(``deploy/metrics.yaml``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import tempfile
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+LOG_SPILL_MAX_BYTES = 20 * 1024 * 1024   # per service, per generation
+EVENTS_MAX_BYTES = 4 * 1024 * 1024
+
+
+def _safe_key(namespace: str, name: str) -> str:
+    return f"{namespace}__{name}".replace("/", "_")
+
+
+def _clean(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip runtime-only fields (underscore-prefixed: autoscaler pins,
+    timers) and anything not JSON-serializable."""
+    out = {}
+    for k, v in record.items():
+        if k.startswith("_"):
+            continue
+        try:
+            json.dumps(v)
+        except (TypeError, ValueError):
+            continue
+        out[k] = v
+    return out
+
+
+class DiskPersister:
+    """Log/event appends are funneled through one writer thread: callers
+    enqueue (non-blocking — the controller's event loop must never wait on
+    disk) and the thread serializes writes, so the append+rotate sequence
+    cannot race between concurrent log batches."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.workloads_dir = os.path.join(root, "workloads")
+        self.logs_dir = os.path.join(root, "logs")
+        os.makedirs(self.workloads_dir, exist_ok=True)
+        os.makedirs(self.logs_dir, exist_ok=True)
+        self._q: queue.Queue = queue.Queue()
+        self._writer = threading.Thread(target=self._drain, daemon=True,
+                                        name="kt-persist-writer")
+        self._writer.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                if kind == "logs":
+                    self._write_logs(*payload)
+                elif kind == "flush":
+                    payload.set()
+                else:
+                    self._write_event(payload)
+            except Exception:
+                pass   # best-effort durability must never kill the writer
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain queued appends and stop the writer (graceful shutdown)."""
+        self._q.put(None)
+        self._writer.join(timeout)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until every append enqueued so far has hit disk."""
+        done = threading.Event()
+        self._q.put(("flush", done))
+        done.wait(timeout)
+
+    # -- workloads ------------------------------------------------------------
+
+    def _workload_path(self, namespace: str, name: str) -> str:
+        return os.path.join(self.workloads_dir,
+                            _safe_key(namespace, name) + ".json")
+
+    def save_workload(self, record: Dict[str, Any]) -> None:
+        path = self._workload_path(record["namespace"], record["name"])
+        # self-heal: the state dir can vanish at runtime (tmp reaper, manual
+        # wipe); losing history is acceptable, wedging every deploy is not
+        os.makedirs(self.workloads_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.workloads_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(_clean(record), f, indent=1)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def delete_workload(self, namespace: str, name: str) -> None:
+        try:
+            os.unlink(self._workload_path(namespace, name))
+        except FileNotFoundError:
+            pass
+
+    def load_workloads(self) -> List[Dict[str, Any]]:
+        out = []
+        for fname in sorted(os.listdir(self.workloads_dir)):
+            if not fname.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.workloads_dir, fname)) as f:
+                    out.append(json.load(f))
+            except (json.JSONDecodeError, OSError):
+                continue
+        return out
+
+    # -- logs -----------------------------------------------------------------
+
+    def _log_path(self, service_key: str) -> str:
+        return os.path.join(self.logs_dir,
+                            service_key.replace("/", "__") + ".jsonl")
+
+    def append_logs(self, service_key: str, entries: List[Dict]) -> None:
+        self._q.put(("logs", (service_key, entries)))
+
+    def _write_logs(self, service_key: str, entries: List[Dict]) -> None:
+        path = self._log_path(service_key)
+        os.makedirs(self.logs_dir, exist_ok=True)
+        with open(path, "a") as f:
+            for e in entries:
+                f.write(json.dumps(_clean(e)) + "\n")
+        if os.path.getsize(path) > LOG_SPILL_MAX_BYTES:
+            os.replace(path, path + ".1")   # keep one previous generation
+
+    def load_logs(self, max_per_service: int = 5000) -> Iterator[
+            tuple]:
+        """Yield ``(service_key, entries)`` — the newest ``max_per_service``
+        entries per service, oldest first, spanning the rotation."""
+        for fname in sorted(os.listdir(self.logs_dir)):
+            if not fname.endswith(".jsonl"):
+                continue
+            service_key = fname[:-len(".jsonl")].replace("__", "/", 1)
+            path = os.path.join(self.logs_dir, fname)
+            lines: List[str] = []
+            for p in (path + ".1", path):
+                try:
+                    with open(p) as f:
+                        lines.extend(f.readlines())
+                except FileNotFoundError:
+                    continue
+            entries = []
+            for line in lines[-max_per_service:]:
+                try:
+                    entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+            if entries:
+                yield service_key, entries
+
+    # -- events ---------------------------------------------------------------
+
+    @property
+    def _events_path(self) -> str:
+        return os.path.join(self.root, "events.jsonl")
+
+    def append_event(self, event: Dict[str, Any]) -> None:
+        self._q.put(("event", event))
+
+    def _write_event(self, event: Dict[str, Any]) -> None:
+        path = self._events_path
+        os.makedirs(self.root, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(_clean(event)) + "\n")
+        if os.path.getsize(path) > EVENTS_MAX_BYTES:
+            os.replace(path, path + ".1")
+
+    def load_events(self, limit: int = 2000) -> List[Dict[str, Any]]:
+        lines: List[str] = []
+        for p in (self._events_path + ".1", self._events_path):
+            try:
+                with open(p) as f:
+                    lines.extend(f.readlines())
+            except FileNotFoundError:
+                continue
+        out = []
+        for line in lines[-limit:]:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out

@@ -229,10 +229,11 @@ class _RoutedFetcher:
         fetch (the reference's rolling join: the child "blocks until parent
         done"). A 404 from the parent therefore means *not yet* — poll until
         the deadline, then fall back. The ``KT_PEER_WAIT_S`` (default 60s)
-        budget is ONE deadline shared by every fetch of this get(): a parent
-        that stops producing costs at most one window total, not one per
-        leaf, after which it is reported failed and everything goes to the
-        store. Connection errors evict the parent immediately."""
+        budget is a NO-PROGRESS window: each successful peer fetch re-arms
+        it, so a healthy parent mid-download of a large multi-leaf get is
+        never evicted, while a parent that stops producing for one full
+        window is reported failed and everything goes to the store.
+        Connection errors evict the parent immediately."""
         import time as _time
 
         if self.enabled:
@@ -255,6 +256,11 @@ class _RoutedFetcher:
                     self.peer_url = None
                     break
                 if r.status_code == 200:
+                    # progress resets the window: a healthy parent slowly
+                    # serving a large multi-leaf checkpoint must not be
+                    # evicted mid-download; only a parent that stops
+                    # producing for a FULL window is reported failed
+                    self._deadline = None
                     self._cache(subkey, r)
                     return r
                 if r.status_code != 404:

@@ -23,6 +23,14 @@ from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 DEFAULT_CACHE_DIR = "/tmp/kt-data-cache"
+# Size cap: per-iteration weight-sync workloads (keys like weights/step-0001)
+# land one full checkpoint per step; without eviction the pod disk fills.
+DEFAULT_CACHE_MAX_BYTES = 4 * 1024 ** 3
+
+
+def _cache_max_bytes() -> int:
+    return int(os.environ.get("KT_DATA_CACHE_MAX_BYTES",
+                              DEFAULT_CACHE_MAX_BYTES))
 
 
 def cache_dir() -> Path:
@@ -52,6 +60,56 @@ def cache_put(key: str, data: bytes, meta: Optional[Dict] = None) -> None:
     mtmp.write_text(json.dumps({"key": key, "meta": meta or {},
                                 "cached_at": time.time()}))
     os.replace(mtmp, meta_path)
+    _sweep(keep=data_path)
+
+
+def _sweep(keep: Optional[Path] = None) -> None:
+    """LRU eviction down to the size cap. Oldest-written entries go first
+    (a new step's weights implicitly evict prior steps'); the entry just
+    written is never the victim. Leftover tmp files from crashed writers
+    older than an hour are reaped too."""
+    root = cache_dir()
+    entries = []   # (cached_at, data_path, meta_path, bytes)
+    total = 0
+    now = time.time()
+    for data_path in root.glob("*.bin"):
+        meta_path = data_path.with_suffix(".json")
+        try:
+            size = data_path.stat().st_size
+        except OSError:
+            continue
+        try:
+            cached_at = json.loads(meta_path.read_text()).get("cached_at", 0)
+        except (OSError, ValueError):
+            # orphaned .bin (writer died between the data and meta renames):
+            # still occupies disk, so it must count against the cap and be
+            # evictable; age by mtime
+            try:
+                cached_at = data_path.stat().st_mtime
+            except OSError:
+                continue
+        total += size
+        entries.append((cached_at, data_path, meta_path, size))
+    for tmp in list(root.glob("*.tmp")) + list(root.glob("*.mtmp")):
+        try:
+            if now - tmp.stat().st_mtime > 3600:
+                tmp.unlink()
+        except OSError:
+            pass
+    cap = _cache_max_bytes()
+    if total <= cap:
+        return
+    for cached_at, data_path, meta_path, size in sorted(entries):
+        if total <= cap:
+            break
+        if keep is not None and data_path == keep:
+            continue
+        for p in (data_path, meta_path):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        total -= size
 
 
 def cache_get(key: str) -> Optional[Tuple[bytes, Dict]]:

@@ -578,13 +578,37 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--port", type=int, default=server_port())
     p.add_argument("--host", default="0.0.0.0")
     args = p.parse_args(argv)
+    if args.port == 0:
+        # bind-ephemeral: resolve the real port BEFORE advertising, or the
+        # WS registration and peer subcalls would publish the unroutable :0
+        from ..utils.procs import free_port
+
+        args.port = free_port()
     # Advertise the BOUND port to everything that derives URLs from env —
     # the controller-WS registration and the supervisor's peer subcalls —
     # regardless of how the server was launched (CLI, -m, embedder). A
     # --port flag alone must not leave them pointing at the default.
     os.environ["KT_SERVER_PORT"] = str(args.port)
-    web.run_app(create_app(), host=args.host, port=args.port,
-                handle_signals=False, print=lambda *_: None)
+    asyncio.run(_serve(create_app(), args.host, args.port))
+
+
+async def _serve(app: web.Application, host: str, port: int) -> None:
+    """Run until SIGTERM/SIGINT, then drain and exit (k8s semantics: a pod
+    must vacate before the kubelet's SIGKILL; locally, an orphaned pod that
+    kept serving would squat its IP:port and wedge every revival after a
+    controller restart). ``web.run_app`` can't express this — the signal
+    handlers installed in ``_on_startup`` only set the termination flag, so
+    the serve loop below owns the actual shutdown."""
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()          # fires on_startup (installs handlers)
+    await web.TCPSite(runner, host, port).start()
+    state: ServerState = app["state"]
+    await state.termination.wait()
+    deadline = time.monotonic() + float(
+        os.environ.get("KT_TERMINATION_DRAIN_S", "25"))
+    while state.inflight > 0 and time.monotonic() < deadline:
+        await asyncio.sleep(0.25)
+    await runner.cleanup()        # fires on_cleanup (pools, WS, capture)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Recording kubectl shim for KubernetesBackend tests.
+
+Implements just enough of kubectl's CLI surface for the backend's apply /
+get / delete flow, persisting everything under ``$KT_KUBECTL_SHIM_DIR``:
+
+- ``apply -n NS -f -``    reads one JSON manifest from stdin, stores it in
+                          ``state.json`` keyed by kind/ns/name, and appends
+                          the full command+manifest to ``calls.jsonl``.
+- ``get pods -n NS -l kubetorch.com/service=NAME -o jsonpath=...``
+                          prints one fake pod IP per expected replica of the
+                          stored workload manifest (Deployment ``replicas``,
+                          JobSet ``parallelism``, Knative → 1).
+- ``delete RES NAME -n NS [--ignore-not-found]``
+                          removes the stored object, records the call.
+- ``auth can-i ...``      always "yes" (exit 0).
+
+No instruction in a recorded manifest is executed — this is a pure notebook.
+"""
+
+import json
+import os
+import sys
+
+
+def _dir() -> str:
+    d = os.environ.get("KT_KUBECTL_SHIM_DIR")
+    if not d:
+        sys.stderr.write("KT_KUBECTL_SHIM_DIR not set\n")
+        sys.exit(2)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load_state(d):
+    path = os.path.join(d, "state.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def _save_state(d, state):
+    with open(os.path.join(d, "state.json"), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def _record(d, entry):
+    with open(os.path.join(d, "calls.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def _flag(args, name, default=None):
+    if name in args:
+        return args[args.index(name) + 1]
+    return default
+
+
+def _expected_pods(manifest) -> int:
+    kind = manifest.get("kind")
+    spec = manifest.get("spec", {})
+    if kind == "Deployment":
+        return int(spec.get("replicas", 1))
+    if kind == "JobSet":
+        jobs = spec.get("replicatedJobs", [{}])
+        return int(jobs[0].get("template", {}).get("spec", {})
+                   .get("parallelism", 1))
+    return 1
+
+
+def main(argv):
+    d = _dir()
+    state = _load_state(d)
+    ns = _flag(argv, "-n", "default")
+
+    if argv[:1] == ["auth"]:
+        _record(d, {"cmd": argv})
+        print("yes")
+        return 0
+
+    if argv[:1] == ["apply"]:
+        manifest = json.load(sys.stdin)
+        kind = manifest.get("kind", "?")
+        name = manifest.get("metadata", {}).get("name", "?")
+        state[f"{kind}/{ns}/{name}"] = manifest
+        _save_state(d, state)
+        _record(d, {"cmd": argv, "manifest": manifest})
+        print(f"{kind.lower()}/{name} configured")
+        return 0
+
+    if argv[:2] == ["get", "pods"]:
+        _record(d, {"cmd": argv})
+        selector = _flag(argv, "-l", "")
+        service = selector.split("=", 1)[1] if "=" in selector else ""
+        ips = []
+        for kind in ("Deployment", "JobSet", "Service"):
+            manifest = state.get(f"{kind}/{ns}/{service}")
+            if manifest is not None and kind != "Service":
+                ips = [f"10.77.0.{i + 1}"
+                       for i in range(_expected_pods(manifest))]
+                break
+            if manifest is not None:  # Knative Service
+                ips = ["10.77.0.1"]
+                break
+        print(" ".join(ips))
+        return 0
+
+    if argv[:1] == ["delete"]:
+        resource, name = argv[1], argv[2]
+        _record(d, {"cmd": argv})
+        base = resource.split(".", 1)[0].rstrip("s").capitalize()
+        kind = {"Deployment": "Deployment", "Jobset": "JobSet",
+                "Service": "Service", "Pvc": "PersistentVolumeClaim",
+                "Secret": "Secret", "Configmap": "ConfigMap"}.get(base, base)
+        if resource.startswith("services.serving.knative"):
+            kind = "Service"
+        existed = state.pop(f"{kind}/{ns}/{name}", None) is not None
+        _save_state(d, state)
+        if not existed and "--ignore-not-found" not in argv:
+            sys.stderr.write(f"Error: {resource} {name!r} not found\n")
+            return 1
+        print(f"{resource}/{name} deleted")
+        return 0
+
+    sys.stderr.write(f"fake_kubectl: unhandled args {argv}\n")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

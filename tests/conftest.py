@@ -67,10 +67,22 @@ SESSION_HASH = "t-" + hashlib.sha1(uuid.uuid4().bytes).hexdigest()[:5]
 
 @pytest.fixture(scope="session", autouse=True)
 def session_isolation():
+    import shutil
+    import tempfile
+
     # force-set (saving any prior value): deploys MUST land under the sweep
     # prefix or a crashed run leaks pods
     prior = os.environ.get("KT_USERNAME")
     os.environ["KT_USERNAME"] = SESSION_HASH
+    # isolate controller durability: a daemon started by this session must
+    # not restore (or persist) workloads across test sessions
+    prior_state_dir = os.environ.get("KT_CONTROLLER_STATE_DIR")
+    state_dir = tempfile.mkdtemp(prefix="kt-test-state-")
+    os.environ["KT_CONTROLLER_STATE_DIR"] = state_dir
+    # a daemon left over from an older checkout must be replaced, not reused
+    # (the interactive default warns and reuses when it hosts workloads)
+    prior_replace = os.environ.get("KT_CONTROLLER_REPLACE")
+    os.environ["KT_CONTROLLER_REPLACE"] = "always"
     from kubetorch_tpu.client import (ControllerClient, _read_running_local,
                                       shutdown_local_controller)
     from kubetorch_tpu.config import reset_config
@@ -98,6 +110,15 @@ def session_isolation():
         os.environ.pop("KT_USERNAME", None)
     else:
         os.environ["KT_USERNAME"] = prior
+    if prior_state_dir is None:
+        os.environ.pop("KT_CONTROLLER_STATE_DIR", None)
+    else:
+        os.environ["KT_CONTROLLER_STATE_DIR"] = prior_state_dir
+    if prior_replace is None:
+        os.environ.pop("KT_CONTROLLER_REPLACE", None)
+    else:
+        os.environ["KT_CONTROLLER_REPLACE"] = prior_replace
+    shutil.rmtree(state_dir, ignore_errors=True)
 
 
 @pytest.fixture(scope="session")

@@ -68,12 +68,13 @@ class ControllerClient:
                inactivity_ttl: Optional[int] = None,
                expected_pods: Optional[int] = None,
                autoscaling: Optional[Dict] = None,
+               service_url: Optional[str] = None,
                timeout: float = 900.0) -> Dict:
         return self._request("POST", "/controller/deploy", timeout=timeout, json={
             "namespace": namespace, "name": name, "manifest": manifest,
             "metadata": metadata, "launch_id": launch_id,
             "inactivity_ttl": inactivity_ttl, "expected_pods": expected_pods,
-            "autoscaling": autoscaling,
+            "autoscaling": autoscaling, "service_url": service_url,
         })
 
     def apply(self, namespace: str, name: str, manifest: Dict,

@@ -220,6 +220,10 @@ async def deploy(request: web.Request) -> web.Response:
             apply_result = await asyncio.to_thread(
                 state.backend.apply, namespace, name, manifest, env)
             record.update(apply_result)
+            if body.get("service_url"):
+                # custom Endpoint(url=...): route calls to the user's own
+                # Service/Ingress instead of the backend-derived address
+                record["service_url"] = body["service_url"]
             state.workloads[key] = record
         await asyncio.to_thread(state.save_workload, record)
         state.record_event(key, f"deployed launch_id={launch_id}")
@@ -462,7 +466,17 @@ async def proxy_service(request: web.Request) -> web.Response:
                 {"error": f"cold start of {ns}/{service} failed: {e}"},
                 status=503)
     resolved = state.resolve_service_url(ns, service)
-    if not ips and resolved:
+    pod_ip = request.headers.get("X-KT-Pod-IP")
+    if pod_ip:
+        # pod-targeted routing (Compute.run_bash / pip_install fan out to
+        # EACH pod, not the service load-balancer); restrict to known pods
+        # so the proxy cannot be aimed at arbitrary addresses
+        if pod_ip not in ips:
+            return web.json_response(
+                {"error": f"pod {pod_ip} is not a pod of {ns}/{service}"},
+                status=404)
+        target = f"http://{pod_ip}:{port}"
+    elif not ips and resolved:
         target = resolved.rstrip("/")
     elif ips:
         target = f"http://{ips[0]}:{port}"

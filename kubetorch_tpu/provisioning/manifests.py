@@ -38,6 +38,8 @@ def _labels(name: str, username: Optional[str] = None,
 def build_pod_template(name: str, image: str, env: Dict[str, str],
                        cpus: Optional[str] = None, memory: Optional[str] = None,
                        tpu: Optional[TpuSlice] = None,
+                       gpus: Optional[int] = None,
+                       gpu_type: Optional[str] = None,
                        node_selector: Optional[Dict[str, str]] = None,
                        tolerations: Optional[List[Dict]] = None,
                        volumes: Optional[List[Dict]] = None,
@@ -53,10 +55,20 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
     if tpu is not None:
         resources["limits"].update(tpu.container_resources())
         resources["requests"].update(tpu.container_resources())
+    if gpus:
+        resources["limits"]["nvidia.com/gpu"] = str(gpus)
 
     selectors = dict(node_selector or {})
     if tpu is not None:
         selectors.update(tpu.node_selectors())
+    if gpu_type:
+        # reference _get_node_selector (compute.py:2217): "key: value"
+        # targets a custom label, bare values the GFD product label
+        if ":" in gpu_type:
+            key, value = gpu_type.split(":", 1)
+            selectors[key.strip()] = value.strip()
+        else:
+            selectors["nvidia.com/gpu.product"] = gpu_type
 
     container: Dict[str, Any] = {
         "name": "kt-server",

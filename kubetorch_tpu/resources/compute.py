@@ -34,6 +34,8 @@ class Compute:
                  memory: Optional[str] = None,
                  tpu: Optional[str] = None,
                  gpus: Optional[int] = None,
+                 gpu_type: Optional[str] = None,
+                 gpu_memory: Optional[str] = None,
                  image: Optional[Image] = None,
                  env: Optional[Dict[str, str]] = None,
                  volumes: Optional[List] = None,
@@ -51,6 +53,15 @@ class Compute:
         self.tpu_spec = tpu
         self.tpu: Optional[TpuSlice] = parse_tpu_spec(tpu) if tpu else None
         self.gpus = gpus
+        # GPU routing (reference compute.py:40-80): gpu_type → node selector
+        # ("nvidia.com/gpu.product" or an explicit "key: value"); gpu_memory
+        # → "gpu-memory" pod annotation (a whole GPU is still requested, the
+        # device plugin enforces the memory limit). On this framework the
+        # first-class accelerator is tpu=; these exist for API parity.
+        self.gpu_type = gpu_type
+        self.gpu_memory = gpu_memory
+        if (gpu_type or gpu_memory) and not gpus:
+            self.gpus = 1
         self.image = image or Image()
         self.env = dict(env or {})
         self.volumes = list(volumes or [])
@@ -65,6 +76,9 @@ class Compute:
         self.shm_size = shm_size
         self.autoscaling: Optional[AutoscalingConfig] = None
         self.distributed: Optional[DistributedConfig] = None
+        self.endpoint = None                # custom routing (from_manifest)
+        self._user_manifest: Optional[Dict] = None
+        self._pod_template_path: Optional[List[str]] = None
         # merge cluster-wide defaults (reference compute.py:1963), routed
         # through the same parsing the constructor kwargs get
         for key, val in controller_defaults().items():
@@ -74,6 +88,87 @@ class Compute:
                     self.tpu = parse_tpu_spec(val)
             elif getattr(self, key, None) in (None, {}, []):
                 setattr(self, key, val)
+
+    # -- BYO manifest ---------------------------------------------------------
+
+    @classmethod
+    def from_manifest(cls, manifest: Union[Dict, str],
+                      selector: Optional[Dict[str, str]] = None,
+                      endpoint=None,
+                      pod_template_path: Optional[Union[str, List[str]]] = None,
+                      image: Optional[Image] = None,
+                      namespace: Optional[str] = None) -> "Compute":
+        """Wrap a user-provided workload manifest (reference ``from_manifest``
+        compute.py:271): deploy kubetorch callables onto an existing K8s
+        shape instead of a generated one.
+
+        ``manifest`` is a dict or a path to a YAML file. ``selector``
+        defaults to the manifest's ``spec.selector.matchLabels``.
+        ``pod_template_path`` locates the pod template inside custom CRDs
+        (dot-string or key list, reference ``navigate_path``
+        compute/utils.py:18-54). ``endpoint`` (an :class:`Endpoint`) routes
+        calls to a user URL or a pod subset."""
+        if isinstance(manifest, str):
+            import yaml
+
+            with open(manifest) as f:
+                manifest = yaml.safe_load(f)
+        if "kind" not in manifest or "apiVersion" not in manifest:
+            raise ValueError("manifest needs 'kind' and 'apiVersion'")
+        new = cls(namespace=namespace or manifest.get("metadata", {})
+                  .get("namespace"))
+        new._user_manifest = copy.deepcopy(manifest)
+        new._pod_template_path = (
+            pod_template_path.split(".")
+            if isinstance(pod_template_path, str) else pod_template_path)
+        new.endpoint = endpoint
+        if image is not None:
+            new.image = image
+        new.selector = selector or (manifest.get("spec", {})
+                                    .get("selector", {}).get("matchLabels"))
+        return new
+
+    def _navigate_pod_template(self, manifest: Dict) -> Dict[str, Any]:
+        """Walk to the pod template inside ``manifest``, creating the path
+        (reference ``navigate_path`` compute/utils.py:18-54)."""
+        node = manifest
+        for key in (self._pod_template_path or ["spec", "template"]):
+            node = node.setdefault(key, {})
+        return node
+
+    def _merged_user_manifest(self, name: str,
+                              env: Dict[str, str]) -> Dict[str, Any]:
+        """The user's manifest with the kt runtime grafted into its pod
+        template (reference ``_build_and_merge_kubetorch_defaults``
+        compute.py:391-425): kt env + server command onto the first
+        container, kt labels onto template metadata — the user's image,
+        resources, and selectors are preserved."""
+        out = copy.deepcopy(self._user_manifest)
+        out.setdefault("metadata", {}).setdefault("name", name)
+        out["metadata"]["namespace"] = self.namespace
+        labels = out["metadata"].setdefault("labels", {})
+        labels.setdefault("kubetorch.com/service", name)
+
+        kt_pod = self.pod_spec(env)      # our canonical template
+        kt_container = kt_pod["spec"]["containers"][0]
+        template = self._navigate_pod_template(out)
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {}).update(
+            kt_pod.get("metadata", {}).get("labels", {}))
+        if self.gpu_memory:
+            tmeta.setdefault("annotations", {})["gpu-memory"] = self.gpu_memory
+        spec = template.setdefault("spec", {})
+        containers = spec.setdefault("containers", [])
+        if not containers:
+            containers.append(kt_container)
+        else:
+            c = containers[0]
+            have = {e["name"] for e in c.setdefault("env", [])}
+            c["env"].extend(e for e in kt_container.get("env", [])
+                            if e["name"] not in have)
+            c.setdefault("command", kt_container.get("command"))
+            c.setdefault("ports", kt_container.get("ports"))
+        return out
 
     # -- fluent config --------------------------------------------------------
 
@@ -119,6 +214,8 @@ class Compute:
 
     @property
     def deployment_mode(self) -> str:
+        if self._user_manifest is not None:
+            return "manifest"               # from_manifest: kt applies it
         if self.selector is not None:
             return "byo"
         if self.autoscaling is not None:
@@ -137,6 +234,7 @@ class Compute:
         return build_pod_template(
             name="kt", image=self.image.base, env=merged_env,
             cpus=self.cpus, memory=self.memory, tpu=self.tpu,
+            gpus=self.gpus, gpu_type=self.gpu_type,
             node_selector=self.node_selector, tolerations=self.tolerations,
             volumes=[v.mount_spec() if hasattr(v, "mount_spec") else v
                      for v in self.volumes],
@@ -145,8 +243,10 @@ class Compute:
 
     def manifest(self, name: str, env: Dict[str, str],
                  command: Optional[List[str]] = None) -> Dict[str, Any]:
-        pod_spec = self.pod_spec(env, command)
         mode = self.deployment_mode
+        if mode == "manifest":
+            return self._merged_user_manifest(name, env)
+        pod_spec = self.pod_spec(env, command)
         if mode == "knative":
             from ..provisioning.manifests import build_knative_manifest
             return build_knative_manifest(
@@ -156,11 +256,15 @@ class Compute:
             from ..provisioning.manifests import build_jobset_manifest
             return build_jobset_manifest(name, self.namespace, self.tpu,
                                          pod_spec, username=config().username)
+        annotations = {}
+        if self.inactivity_ttl:
+            annotations["kubetorch.com/inactivity-ttl"] = str(self.inactivity_ttl)
+        if self.gpu_memory:
+            annotations["gpu-memory"] = self.gpu_memory
         return build_deployment_manifest(
             name, self.namespace, self.replicas, pod_spec,
             username=config().username, queue_name=self.queue_name,
-            annotations=({"kubetorch.com/inactivity-ttl": str(self.inactivity_ttl)}
-                         if self.inactivity_ttl else None))
+            annotations=annotations or None)
 
     # -- launch ---------------------------------------------------------------
 
@@ -169,17 +273,23 @@ class Compute:
         """Deploy through the controller (reference ``_launch`` :2006)."""
         launch_id = launch_id or uuid.uuid4().hex
         client = controller_client()
-        if self.selector is not None:
+        if self._user_manifest is None and self.selector is not None:
             return client.register_workload(
                 self.namespace, name, metadata, selector=self.selector,
-                launch_id=launch_id)
+                launch_id=launch_id,
+                service_url=self.endpoint.url if self.endpoint else None)
         manifest = self.manifest(name, env={})
         autoscaling = (dataclasses.asdict(self.autoscaling)
                        if self.autoscaling is not None else None)
+        expected = self.replicas
+        if self._user_manifest is not None:
+            expected = int(manifest.get("spec", {}).get("replicas", 1))
         return client.deploy(self.namespace, name, manifest, metadata,
                              launch_id, inactivity_ttl=self.inactivity_ttl,
-                             expected_pods=self.replicas,
+                             expected_pods=expected,
                              autoscaling=autoscaling,
+                             service_url=(self.endpoint.url
+                                          if self.endpoint else None),
                              timeout=self.launch_timeout)
 
     def _check_service_ready(self, name: str, timeout: Optional[float] = None) -> None:

@@ -208,6 +208,106 @@ class Module:
         self.launch_id = None
         self._client = None
 
+    # -- pod ops (reference compute.py:2400-2493) ------------------------------
+
+    @property
+    def namespace(self) -> str:
+        return self.compute.namespace if self.compute else config().namespace
+
+    def pod_ips(self) -> list:
+        """Live pod addresses of this service, from the controller."""
+        record = controller_client().get_workload(self.namespace, self.name)
+        return record.get("pod_ips") or []
+
+    def _pod_exec_targets(self, node) -> list:
+        """Resolve ``node`` to (ip, base_url, headers) per target pod.
+        ``node``: None/"all" → every pod; int → pod index; str ip; list of
+        either. Local-backend pods are directly reachable; otherwise the
+        exec rides the controller proxy with pod-targeted routing."""
+        ips = self.pod_ips()
+        if not ips:
+            raise ServiceHealthError(f"{self.name!r} has no running pods")
+        if node in (None, "all"):
+            chosen = ips
+        else:
+            nodes = node if isinstance(node, list) else [node]
+            chosen = [ips[n] if isinstance(n, int) else n for n in nodes]
+            unknown = [ip for ip in chosen if ip not in ips]
+            if unknown:
+                raise ValueError(f"not pods of {self.name!r}: {unknown}")
+        from ..constants import DEFAULT_SERVER_PORT, server_port
+        out = []
+        for ip in chosen:
+            if config().api_url and "127.0.0.1" not in config().api_url:
+                base = (f"{config().api_url}/{self.namespace}/"
+                        f"{self.name}:{DEFAULT_SERVER_PORT}")
+                out.append((ip, base, {"X-KT-Pod-IP": ip}))
+            else:
+                out.append((ip, f"http://{ip}:{server_port()}", {}))
+        return out
+
+    def run_bash(self, commands, node=None, timeout: float = 600) -> list:
+        """Run shell command(s) on pod(s); returns ``[(rc, stdout, stderr)]``
+        per target pod (reference ``run_bash`` compute.py:2478; transport is
+        the pod server's ``/_kt/exec`` instead of ``kubectl exec``, so it
+        works identically on the local backend and through the controller
+        proxy)."""
+        import requests as _requests
+
+        cmds = commands if isinstance(commands, list) else [commands]
+        results = []
+        for ip, base, headers in self._pod_exec_targets(node):
+            for cmd in cmds:
+                r = _requests.post(f"{base}/_kt/exec",
+                                   json={"cmd": cmd, "timeout": timeout},
+                                   headers=headers, timeout=timeout + 30)
+                r.raise_for_status()
+                body = r.json()
+                results.append((body["rc"], body["stdout"], body["stderr"]))
+        return results
+
+    def pip_install(self, reqs, node=None,
+                    override_remote_version: bool = False) -> None:
+        """Pip-install packages onto the pod(s) (reference ``pip_install``
+        compute.py:2423): skips packages already importable remotely unless
+        ``override_remote_version`` pins the local version."""
+        reqs = [reqs] if isinstance(reqs, str) else reqs
+        for req in reqs:
+            target = req
+            mod_name = req.split("[")[0].replace("-", "_")
+            if not override_remote_version:
+                probe = self.run_bash(
+                    f"python3 -c \"import importlib.util,sys; "
+                    f"sys.exit(0 if importlib.util.find_spec('{mod_name}') "
+                    f"else 1)\"", node=node)
+                if all(rc == 0 for rc, _, _ in probe):
+                    continue
+            else:
+                try:
+                    from importlib.metadata import version as _v
+                    target = f"{req}=={_v(mod_name)}"
+                except Exception:
+                    pass
+            self.run_bash(f"python3 -m pip install {target}", node=node)
+
+    def ssh(self, pod_name: Optional[str] = None) -> None:
+        """Interactive shell into a pod (reference ``ssh`` compute.py:2400).
+        Cluster mode execs via kubectl; on the local backend pods are host
+        subprocesses, so this opens a shell in the service's synced root."""
+        import shutil
+        import subprocess
+
+        local = not config().api_url or "127.0.0.1" in config().api_url
+        if not local and shutil.which("kubectl"):
+            pod = pod_name or f"{self.name}-0"
+            subprocess.run(["kubectl", "exec", "-it", pod,
+                            "-n", self.namespace, "--", "/bin/bash"],
+                           check=True)
+            return
+        root = self.pointers.project_root or os.getcwd()
+        subprocess.run(["/bin/bash"], cwd=root,
+                       env={**os.environ, "KT_SERVICE_NAME": self.name})
+
 
 def module_factory(obj: Any, name: Optional[str] = None,
                    init_args: Optional[Dict] = None,

@@ -25,6 +25,7 @@ Run: ``python -m kubetorch_tpu.serving.http_server --port 32300``.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import contextvars
 import hashlib
 import json
@@ -414,6 +415,36 @@ async def serve_cached_data(request: web.Request) -> web.Response:
                         headers={"X-KT-Meta": _json.dumps(meta)})
 
 
+async def exec_route(request: web.Request) -> web.Response:
+    """POST /_kt/exec {"cmd": ..., "timeout": ...} → {rc, stdout, stderr}.
+
+    Backs ``Compute.run_bash``/``pip_install`` (reference pod ops,
+    compute.py:2400-2493). The reference reaches pods via ``kubectl exec``;
+    here the pod's own server runs the command, so the same surface works on
+    the local backend and through the controller's service proxy without
+    kubectl credentials. No privilege escalation: this server already
+    executes arbitrary user callables by design."""
+    body = await request.json()
+    cmd = body.get("cmd")
+    if not cmd:
+        return web.json_response({"error": "missing cmd"}, status=400)
+    timeout = float(body.get("timeout", 600))
+    try:
+        proc = await asyncio.create_subprocess_shell(
+            cmd, stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE)
+        out, err = await asyncio.wait_for(proc.communicate(), timeout)
+    except asyncio.TimeoutError:
+        with contextlib.suppress(ProcessLookupError):
+            proc.kill()
+        return web.json_response({"rc": -1, "stdout": "",
+                                  "stderr": f"timed out after {timeout}s"})
+    return web.json_response({
+        "rc": proc.returncode,
+        "stdout": out.decode(errors="replace"),
+        "stderr": err.decode(errors="replace"),
+    })
+
+
 async def run_callable(request: web.Request) -> web.Response:
     """POST /{fn}[/{method}] → supervisor (reference run_callable :1720)."""
     state: ServerState = request.app["state"]
@@ -492,6 +523,7 @@ def create_app(state: Optional[ServerState] = None) -> web.Application:
     app.router.add_get("/app/status", app_status)
     app.router.add_post("/_kt/reload", reload_route)
     app.router.add_post("/_kt/profile", profile_route)
+    app.router.add_post("/_kt/exec", exec_route)
     app.router.add_get("/_kt/data/{key:.+}", serve_cached_data)
     app.router.add_post("/{fn_name}", run_callable)
     app.router.add_post("/{fn_name}/{method}", run_callable)

@@ -13,13 +13,18 @@ regime for this size class.
 Structure: a launcher/worker split. The TPU relay in this environment is
 intermittently unavailable, and a failed jax backend init poisons the process
 (the backend is cached as failed), so each attempt runs in a FRESH worker
-subprocess. The launcher retries with backoff inside a total time budget and
-only then falls back to an honest CPU-labelled number. Only the worker writes
-to stdout, so the driver still sees exactly one JSON line.
+subprocess. The launcher probes first with a SHORT subprocess (<=90s) that
+only initializes the backend; a hanging relay costs one probe timeout, not a
+whole attempt cap. Only after the probe actually sees a TPU does the launcher
+commit to a long bench attempt. A probe that initializes fine but reports a
+CPU-only machine falls back immediately (no point burning the budget when
+there is no TPU configured at all, as opposed to a flaky relay). Only the
+worker writes to stdout, so the driver still sees exactly one JSON line.
 
 Env knobs: KT_BENCH_BUDGET_S (total retry budget, default 1500),
-KT_BENCH_WAIT_S (sleep between attempts, default 60),
-KT_BENCH_ATTEMPT_TIMEOUT_S (per-attempt cap, default 600).
+KT_BENCH_WAIT_S (sleep between probe attempts, default 45),
+KT_BENCH_PROBE_TIMEOUT_S (probe cap, default 90),
+KT_BENCH_ATTEMPT_TIMEOUT_S (per-bench-attempt cap, default 600).
 """
 
 from __future__ import annotations
@@ -42,8 +47,9 @@ PEAK_BF16_FLOPS = {
 }
 MFU_TARGET = 0.40
 
-# worker exit code meaning "TPU not available right now; retry me"
-RC_TPU_UNAVAILABLE = 3
+# worker exit codes
+RC_TPU_UNAVAILABLE = 3   # backend init failed / relay down; retry me
+RC_CPU_ONLY = 4          # backend initialized fine but no TPU configured
 
 
 def peak_flops(device) -> float:
@@ -54,12 +60,45 @@ def peak_flops(device) -> float:
     return 197e12
 
 
+def probe_worker() -> int:
+    """Cheap backend-init probe: exits 0 iff a TPU is actually reachable."""
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError as e:
+        print(f"probe: backend unavailable ({e})", file=sys.stderr)
+        return RC_TPU_UNAVAILABLE
+    if dev.platform != "tpu":
+        print(f"probe: backend up but CPU-only ({dev.platform})",
+              file=sys.stderr)
+        return RC_CPU_ONLY
+    print(f"probe: TPU up ({dev.device_kind})", file=sys.stderr)
+    return 0
+
+
+def _cpu_fallback(attempt_cap: float) -> int:
+    env = {**os.environ, "KT_BENCH_WORKER": "1", "KT_BENCH_FORCE_CPU": "1"}
+    try:
+        return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=attempt_cap).returncode
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip", "value": 0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "detail": {"error": "cpu fallback timed out"}}))
+        return 1
+
+
 def main() -> int:
-    if os.environ.get("KT_BENCH_WORKER"):
+    mode = os.environ.get("KT_BENCH_WORKER")
+    if mode == "probe":
+        return probe_worker()
+    if mode:
         return bench_worker(force_cpu=bool(os.environ.get("KT_BENCH_FORCE_CPU")))
 
     budget = float(os.environ.get("KT_BENCH_BUDGET_S", "1500"))
-    wait = float(os.environ.get("KT_BENCH_WAIT_S", "60"))
+    wait = float(os.environ.get("KT_BENCH_WAIT_S", "45"))
+    probe_cap = float(os.environ.get("KT_BENCH_PROBE_TIMEOUT_S", "90"))
     attempt_cap = float(os.environ.get("KT_BENCH_ATTEMPT_TIMEOUT_S", "600"))
     deadline = time.monotonic() + budget
 
@@ -68,38 +107,63 @@ def main() -> int:
     while True:
         attempt += 1
         remaining = deadline - time.monotonic()
-        if remaining <= 60 and attempt > 1:
+        if remaining <= 30 and attempt > 1:
             break
-        timeout = min(attempt_cap, max(remaining, 120))
-        print(f"bench attempt {attempt} (timeout {timeout:.0f}s, "
-              f"{max(remaining, 0):.0f}s budget left)", file=sys.stderr)
-        env = {**os.environ, "KT_BENCH_WORKER": "1"}
+        # phase 1: short probe — a hanging relay costs probe_cap, not
+        # attempt_cap, so the budget fits many more tries
         try:
-            rc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                env=env, timeout=timeout).returncode
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "KT_BENCH_WORKER": "probe"},
+                timeout=min(probe_cap, max(remaining, 30))).returncode
         except subprocess.TimeoutExpired:
-            print(f"attempt {attempt}: timed out after {timeout:.0f}s",
+            print(f"probe {attempt}: timed out after {probe_cap:.0f}s",
                   file=sys.stderr)
             rc = RC_TPU_UNAVAILABLE
-        if rc == 0:
-            return 0
-        if rc != RC_TPU_UNAVAILABLE:
-            # worker crashed on-device; batch downsizing already happens
-            # inside the worker, so a second identical crash is
-            # deterministic — stop retrying and fall back
-            print(f"attempt {attempt}: worker rc={rc}", file=sys.stderr)
+        if rc not in (0, RC_TPU_UNAVAILABLE, RC_CPU_ONLY):
+            # probe crashed outright (broken env, not a flaky relay) — a
+            # second identical crash is deterministic; don't burn the budget
+            print(f"probe {attempt}: crashed rc={rc}", file=sys.stderr)
             crashes += 1
             if crashes >= 2:
                 break
+        if rc == RC_CPU_ONLY:
+            # genuinely no TPU on this machine — don't burn the budget
+            print("no TPU configured on this machine; CPU fallback now",
+                  file=sys.stderr)
+            return _cpu_fallback(attempt_cap)
+        if rc == 0:
+            # phase 2: TPU is live — commit to a full bench attempt
+            remaining = deadline - time.monotonic()
+            timeout = min(attempt_cap, max(remaining, 180))
+            print(f"bench attempt {attempt} (timeout {timeout:.0f}s, "
+                  f"{max(remaining, 0):.0f}s budget left)", file=sys.stderr)
+            try:
+                rc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env={**os.environ, "KT_BENCH_WORKER": "1"},
+                    timeout=timeout).returncode
+            except subprocess.TimeoutExpired:
+                print(f"attempt {attempt}: timed out after {timeout:.0f}s",
+                      file=sys.stderr)
+                rc = RC_TPU_UNAVAILABLE
+            if rc == 0:
+                return 0
+            if rc not in (RC_TPU_UNAVAILABLE, RC_CPU_ONLY):
+                # worker crashed on-device; batch downsizing already happens
+                # inside the worker, so a second identical crash is
+                # deterministic — stop retrying and fall back
+                print(f"attempt {attempt}: worker rc={rc}", file=sys.stderr)
+                crashes += 1
+                if crashes >= 2:
+                    break
         if time.monotonic() + wait >= deadline:
             break
         time.sleep(wait)
 
     print("TPU never became available within budget; CPU fallback",
           file=sys.stderr)
-    env = {**os.environ, "KT_BENCH_WORKER": "1", "KT_BENCH_FORCE_CPU": "1"}
-    return subprocess.run([sys.executable, os.path.abspath(__file__)],
-                          env=env).returncode
+    return _cpu_fallback(attempt_cap)
 
 
 _T0 = time.monotonic()

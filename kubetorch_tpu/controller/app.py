@@ -62,13 +62,11 @@ class ControllerState:
 
     # -- durable state --------------------------------------------------------
 
-    def save_workload(self, record: Dict[str, Any]) -> None:
-        if self.persister is not None:
-            self.persister.save_workload(record)
-
     def forget_workload(self, namespace: str, name: str) -> None:
         if self.persister is not None:
-            self.persister.delete_workload(namespace, name)
+            # queued behind pending saves: a persist enqueued before this
+            # delete must not resurrect the record afterwards
+            self.persister.enqueue_workload_delete(namespace, name)
 
     def restore(self) -> None:
         """Reload workloads/logs/events persisted by a previous controller
@@ -131,6 +129,21 @@ class ControllerState:
                 port = info.get("server_port", DEFAULT_SERVER_PORT)
                 return f"http://{info['pod_ip']}:{port}"
         return None
+
+    async def persist_workload(self, record: Dict[str, Any]) -> None:
+        """Serialize ``record`` on the event loop, write via the persister's
+        single writer thread.
+
+        The live record is mutated by the loop (autoscale tick, cold-start
+        pin, pod registration); serializing it off-loop races json.dumps
+        against those mutations ("dictionary changed size during
+        iteration"). enqueue_workload dumps to a string immediately — the
+        string IS the snapshot — and the writer queue preserves enqueue
+        order, so two concurrent persists of the same record can't land
+        stale-last on disk.
+        """
+        if self.persister is not None:
+            self.persister.enqueue_workload(record)
 
     def record_event(self, service_key: str, message: str) -> None:
         event = {"ts": time.time(), "service": service_key,
@@ -225,7 +238,7 @@ async def deploy(request: web.Request) -> web.Response:
                 # Service/Ingress instead of the backend-derived address
                 record["service_url"] = body["service_url"]
             state.workloads[key] = record
-        await asyncio.to_thread(state.save_workload, record)
+        await state.persist_workload(record)
         state.record_event(key, f"deployed launch_id={launch_id}")
 
         # hot reload on already-connected pods
@@ -276,7 +289,7 @@ async def register_workload(request: web.Request) -> web.Response:
         "selector": body.get("selector"),
         "service_url": body.get("service_url"),
     }
-    await asyncio.to_thread(state.save_workload, state.workloads[key])
+    await state.persist_workload(state.workloads[key])
     reload_results = await state.push_reload(
         namespace, name, {**body.get("metadata", {}), "KT_LAUNCH_ID": launch_id},
         launch_id)
@@ -470,12 +483,19 @@ async def proxy_service(request: web.Request) -> web.Response:
     if pod_ip:
         # pod-targeted routing (Compute.run_bash / pip_install fan out to
         # EACH pod, not the service load-balancer); restrict to known pods
-        # so the proxy cannot be aimed at arbitrary addresses
+        # so the proxy cannot be aimed at arbitrary addresses, and pin the
+        # port to the pod's registered server port — honoring the URL port
+        # here would let any client probe arbitrary ports on pod IPs
         if pod_ip not in ips:
             return web.json_response(
                 {"error": f"pod {pod_ip} is not a pod of {ns}/{service}"},
                 status=404)
-        target = f"http://{pod_ip}:{port}"
+        pod_port = getattr(state.backend, "server_port", DEFAULT_SERVER_PORT)
+        for conn in state.connections(ns, service):
+            if conn.info.get("pod_ip") == pod_ip:
+                pod_port = conn.info.get("server_port", DEFAULT_SERVER_PORT)
+                break
+        target = f"http://{pod_ip}:{pod_port}"
     elif not ips and resolved:
         target = resolved.rstrip("/")
     elif ips:
@@ -640,7 +660,7 @@ async def _scale_to(state: ControllerState, record: Dict, replicas: int,
         # "pods never came up" (broken deploy)
         record["scaled_to_zero"] = replicas == 0
         record.update(result)
-    await asyncio.to_thread(state.save_workload, record)
+    await state.persist_workload(record)
     state.record_event(f"{ns}/{name}",
                        f"autoscaled to {replicas} pods ({reason})")
 

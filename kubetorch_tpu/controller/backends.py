@@ -34,6 +34,26 @@ class PodHandle:
 OBJECT_KINDS = {"Secret", "PersistentVolumeClaim", "ConfigMap"}
 
 
+def _manifest_kind(manifest: Dict) -> str:
+    kind = manifest.get("kind", "Deployment")
+    if kind == "Service" and "knative" in manifest.get("apiVersion", ""):
+        return "KnativeService"
+    return kind
+
+
+def _pod_specs(manifest: Dict) -> List[Dict]:
+    """Locate the pod spec(s) inside a workload manifest (reference
+    ``navigate_path``-style kind polymorphism, compute/utils.py:18-54)."""
+    kind = _manifest_kind(manifest)
+    spec = manifest.get("spec", {})
+    if kind == "JobSet":
+        return [job.get("template", {}).get("spec", {})
+                   .get("template", {}).get("spec", {})
+                for job in spec.get("replicatedJobs", [])]
+    # Deployment and Knative Service share spec.template.spec
+    return [spec.get("template", {}).get("spec", {})]
+
+
 def controller_wiring(controller_url: str) -> Dict[str, str]:
     """Env vars every pod needs to register with the controller and stream
     logs, derived from the controller's base URL."""
@@ -70,12 +90,67 @@ class LocalBackend:
     """Run 'pods' as subprocesses on loopback alias IPs."""
 
     def __init__(self, controller_url: str, server_port: int = 32300,
-                 store_url: Optional[str] = None):
+                 store_url: Optional[str] = None,
+                 secrets_dir: Optional[str] = None):
         self.controller_url = controller_url
         self.server_port = server_port
         self.store_url = store_url
         self.services: Dict[str, List[PodHandle]] = {}
+        self.objects: Dict[str, Dict] = {}   # "Kind/ns/name" → manifest
         self._ip_block = 0
+        if secrets_dir is None:
+            from ..config import config
+            secrets_dir = os.path.join(config().config_dir, "secrets")
+        # secret VALUES live only here, as 0600 files under a 0700 dir —
+        # never in the manifest, the workload record, or persisted controller
+        # state (the k8s backend's analog is a real K8s Secret object)
+        self.secrets_dir = secrets_dir
+
+    # -- secret store ---------------------------------------------------------
+
+    def _secret_dir(self, namespace: str, name: str) -> str:
+        return os.path.join(self.secrets_dir, f"{namespace}__{name}")
+
+    def _store_secret(self, namespace: str, name: str, manifest: Dict) -> List[str]:
+        data = manifest.get("stringData", {}) or {}
+        sdir = self._secret_dir(namespace, name)
+        # replace, don't merge: a re-save after credential rotation must not
+        # keep injecting keys the new Secret no longer carries
+        shutil.rmtree(sdir, ignore_errors=True)
+        os.makedirs(sdir, mode=0o700, exist_ok=True)
+        os.chmod(sdir, 0o700)
+        for key, value in data.items():
+            path = os.path.join(sdir, key)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
+        return sorted(data)
+
+    def _secret_env(self, namespace: str, manifest: Dict) -> Dict[str, str]:
+        """Resolve ``envFrom`` secretRefs in the pod template against the
+        local secret files — the subprocess-pod analog of kubelet injecting a
+        K8s Secret. File-type secrets surface as a PATH (local pods share the
+        host filesystem), not as env payload."""
+        env: Dict[str, str] = {}
+        for spec in _pod_specs(manifest):
+            for container in spec.get("containers", []):
+                for ref in container.get("envFrom", []):
+                    sname = (ref.get("secretRef") or {}).get("name")
+                    if not sname:
+                        continue
+                    sdir = self._secret_dir(namespace, sname)
+                    if not os.path.isdir(sdir):
+                        continue
+                    for key in os.listdir(sdir):
+                        if key.startswith("__"):
+                            continue
+                        with open(os.path.join(sdir, key)) as f:
+                            env[key] = f.read()
+                    if os.path.exists(os.path.join(sdir, "__file__")):
+                        env_key = ("KT_SECRET_FILE_"
+                                   + sname.upper().replace("-", "_"))
+                        env[env_key] = os.path.join(sdir, "__file__")
+        return env
 
     def _next_ips(self, service_key: str, n: int) -> List[str]:
         existing = [h.ip for h in self.services.get(service_key, [])]
@@ -98,7 +173,12 @@ class LocalBackend:
         kind = manifest.get("kind", "Deployment")
         if kind in OBJECT_KINDS:
             # store config objects instead of spawning pods for them
-            self.objects = getattr(self, "objects", {})
+            if kind == "Secret":
+                # values go to 0600 files; memory keeps key NAMES only
+                keys = self._store_secret(namespace, name, manifest)
+                manifest = {**{k: v for k, v in manifest.items()
+                               if k not in ("stringData", "data")},
+                            "keys": keys}
             self.objects[f"{kind}/{key}"] = manifest
             return {"kind": kind, "stored": True}
         replicas = int(manifest.get("spec", {}).get("replicas", 1))
@@ -116,6 +196,7 @@ class LocalBackend:
 
         pod_env = dict(os.environ)
         pod_env.pop("JAX_PLATFORMS", None)
+        pod_env.update(self._secret_env(namespace, manifest))
         pod_env.update(env)
         pod_env.update({
             "PALLAS_AXON_POOL_IPS": pod_env.get("KT_POD_TPU", ""),
@@ -157,7 +238,14 @@ class LocalBackend:
         for h in handles:
             if h.process.poll() is None:
                 kill_process_tree(h.process.pid)
-        return bool(handles)
+        removed_obj = any([   # list, not generator: pop EVERY kind
+            self.objects.pop(f"{kind}/{key}", None) is not None
+            for kind in OBJECT_KINDS])
+        sdir = self._secret_dir(namespace, name)
+        if os.path.isdir(sdir):
+            shutil.rmtree(sdir, ignore_errors=True)
+            removed_obj = True
+        return bool(handles) or removed_obj
 
     def pod_ips(self, namespace: str, name: str) -> List[str]:
         return [h.ip for h in self.services.get(f"{namespace}/{name}", [])
@@ -221,25 +309,8 @@ class KubernetesBackend:
             raise RuntimeError(f"kubectl {' '.join(args)} failed: {res.stderr}")
         return res.stdout
 
-    @staticmethod
-    def _manifest_kind(manifest: Dict) -> str:
-        kind = manifest.get("kind", "Deployment")
-        if kind == "Service" and "knative" in manifest.get("apiVersion", ""):
-            return "KnativeService"
-        return kind
-
-    @classmethod
-    def _pod_specs(cls, manifest: Dict) -> List[Dict]:
-        """Locate the pod spec(s) inside a workload manifest (reference
-        ``navigate_path``-style kind polymorphism, compute/utils.py:18-54)."""
-        kind = cls._manifest_kind(manifest)
-        spec = manifest.get("spec", {})
-        if kind == "JobSet":
-            return [job.get("template", {}).get("spec", {})
-                       .get("template", {}).get("spec", {})
-                    for job in spec.get("replicatedJobs", [])]
-        # Deployment and Knative Service share spec.template.spec
-        return [spec.get("template", {}).get("spec", {})]
+    _manifest_kind = staticmethod(_manifest_kind)
+    _pod_specs = staticmethod(_pod_specs)
 
     def _inject_env(self, manifest: Dict, env: Dict[str, str]) -> None:
         """Merge workload metadata env + in-cluster wiring into every
@@ -294,17 +365,27 @@ class KubernetesBackend:
         # silently leak a Secret/PVC/ConfigMap
         resources = ([self._KIND_RESOURCES[kind]] if kind else
                      list(self._KIND_RESOURCES.values()))
-        try:
-            for resource in resources:
-                self._run("delete", resource, name, "-n", namespace,
+        if kind not in OBJECT_KINDS:
+            resources += [f"service/{name}", f"service/{name}-headless"]
+        ok = True
+        for resource in resources:
+            args = (resource.split("/") if "/" in resource
+                    else [resource, name])
+            try:
+                self._run("delete", *args, "-n", namespace,
                           "--ignore-not-found")
-            if kind not in OBJECT_KINDS:
-                for svc in (name, f"{name}-headless"):
-                    self._run("delete", "service", svc, "-n", namespace,
-                              "--ignore-not-found")
-            return True
-        except RuntimeError:
-            return False
+            except RuntimeError as e:
+                # a cluster without the JobSet/Knative CRDs answers the
+                # sweep with "the server doesn't have a resource type" even
+                # under --ignore-not-found; that must not abort the sweep
+                # or the remaining kinds leak
+                msg = str(e).lower()
+                if ("doesn't have a resource type" in msg
+                        or "could not find the requested resource" in msg
+                        or "not found" in msg):
+                    continue
+                ok = False
+        return ok
 
     def pod_ips(self, namespace: str, name: str) -> List[str]:
         out = self._run("get", "pods", "-n", namespace, "-l",

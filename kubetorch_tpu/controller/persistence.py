@@ -37,7 +37,9 @@ def _safe_key(namespace: str, name: str) -> str:
 
 def _clean(record: Dict[str, Any]) -> Dict[str, Any]:
     """Strip runtime-only fields (underscore-prefixed: autoscaler pins,
-    timers) and anything not JSON-serializable."""
+    timers) and anything not JSON-serializable. Secret values never reach
+    disk: delivery is by-reference (envFrom/volume mounts), and this strips
+    any Secret manifest payload defensively should one arrive via deploy."""
     out = {}
     for k, v in record.items():
         if k.startswith("_"):
@@ -47,6 +49,10 @@ def _clean(record: Dict[str, Any]) -> Dict[str, Any]:
         except (TypeError, ValueError):
             continue
         out[k] = v
+    manifest = out.get("manifest")
+    if isinstance(manifest, dict) and manifest.get("kind") == "Secret":
+        out["manifest"] = {k: v for k, v in manifest.items()
+                           if k not in ("stringData", "data")}
     return out
 
 
@@ -78,6 +84,10 @@ class DiskPersister:
                     self._write_logs(*payload)
                 elif kind == "flush":
                     payload.set()
+                elif kind == "workload":
+                    self._write_workload_json(*payload)
+                elif kind == "workload_delete":
+                    self.delete_workload(*payload)
                 else:
                     self._write_event(payload)
             except Exception:
@@ -100,15 +110,36 @@ class DiskPersister:
         return os.path.join(self.workloads_dir,
                             _safe_key(namespace, name) + ".json")
 
+    def enqueue_workload(self, record: Dict[str, Any]) -> None:
+        """Queue a workload write behind the single writer thread.
+
+        Serializes on the CALLER's thread (one ``_clean`` + ``dumps`` — the
+        string is the snapshot, so loop-side mutations after enqueue can't
+        reach the writer) and queue order is write order, so concurrent
+        persists of the same record can't land stale-last."""
+        payload = json.dumps(_clean(record), indent=1)
+        self._q.put(("workload",
+                     (record["namespace"], record["name"], payload)))
+
+    def enqueue_workload_delete(self, namespace: str, name: str) -> None:
+        """Queue the unlink so a still-pending save can't resurrect the
+        record after a delete."""
+        self._q.put(("workload_delete", (namespace, name)))
+
     def save_workload(self, record: Dict[str, Any]) -> None:
-        path = self._workload_path(record["namespace"], record["name"])
+        self._write_workload_json(record["namespace"], record["name"],
+                                  json.dumps(_clean(record), indent=1))
+
+    def _write_workload_json(self, namespace: str, name: str,
+                             payload: str) -> None:
+        path = self._workload_path(namespace, name)
         # self-heal: the state dir can vanish at runtime (tmp reaper, manual
         # wipe); losing history is acceptable, wedging every deploy is not
         os.makedirs(self.workloads_dir, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=self.workloads_dir, suffix=".tmp")
         try:
             with os.fdopen(fd, "w") as f:
-                json.dump(_clean(record), f, indent=1)
+                f.write(payload)
             os.replace(tmp, path)
         except BaseException:
             if os.path.exists(tmp):
@@ -155,9 +186,16 @@ class DiskPersister:
             tuple]:
         """Yield ``(service_key, entries)`` — the newest ``max_per_service``
         entries per service, oldest first, spanning the rotation."""
-        for fname in sorted(os.listdir(self.logs_dir)):
-            if not fname.endswith(".jsonl"):
-                continue
+        # derive the service set from both generations: rotation renames the
+        # active file to .jsonl.1 leaving no .jsonl until the next append, so
+        # a restart in that window must still find the service
+        names = set()
+        for fname in os.listdir(self.logs_dir):
+            if fname.endswith(".jsonl"):
+                names.add(fname)
+            elif fname.endswith(".jsonl.1"):
+                names.add(fname[:-len(".1")])
+        for fname in sorted(names):
             service_key = fname[:-len(".jsonl")].replace("__", "/", 1)
             path = os.path.join(self.logs_dir, fname)
             lines: List[str] = []

@@ -16,6 +16,7 @@ Jinja pod template. TPU-first differences:
 from __future__ import annotations
 
 import copy
+import posixpath
 from typing import Any, Dict, List, Optional
 
 from .tpu_topology import TpuSlice
@@ -46,7 +47,8 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
                        shm_size: Optional[str] = "8Gi",
                        launch_timeout: int = 900,
                        debug: bool = False,
-                       command: Optional[List[str]] = None) -> Dict[str, Any]:
+                       command: Optional[List[str]] = None,
+                       secrets: Optional[List[Dict]] = None) -> Dict[str, Any]:
     resources: Dict[str, Dict[str, str]] = {"requests": {}, "limits": {}}
     if cpus:
         resources["requests"]["cpu"] = str(cpus)
@@ -103,6 +105,30 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
                             "persistentVolumeClaim": {"claimName": vol["claim"]}})
         container["volumeMounts"].append({"name": vol["name"],
                                           "mountPath": vol["mount_path"]})
+
+    # secrets ride as REFERENCES — envFrom + Secret volume mounts; values
+    # stay in the Secret object (reference kubernetes_secrets_client.py:
+    # inlining them in the manifest would leak plaintext into workload
+    # records and persisted controller state)
+    for sec in secrets or []:
+        sname = sec["name"] if isinstance(sec, dict) else sec
+        container.setdefault("envFrom", []).append(
+            {"secretRef": {"name": sname}})
+        mount = sec.get("mount_path") if isinstance(sec, dict) else None
+        if mount:
+            mount = ("/root" + mount[1:]) if mount.startswith("~") else mount
+            vol_name = f"secret-{sname}"[:63]
+            fname = posixpath.basename(mount)
+            pod_volumes.append({
+                "name": vol_name,
+                "secret": {"secretName": sname, "defaultMode": 0o600,
+                           "items": [{"key": "__file__", "path": fname}]}})
+            # subPath overlays ONLY the credential file — mounting the
+            # volume at dirname would mask the whole directory read-only
+            # (e.g. ~/.cache/huggingface would lose its hub/ cache)
+            container["volumeMounts"].append(
+                {"name": vol_name, "mountPath": mount, "subPath": fname,
+                 "readOnly": True})
 
     spec: Dict[str, Any] = {
         "containers": [container],

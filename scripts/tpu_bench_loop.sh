@@ -1,17 +1,33 @@
 #!/bin/bash
-# Retry bench.py until a REAL TPU result lands (the CPU fallback line does
-# not count); never kill a TPU-holding process (wedges the relay).
+# All-round TPU retry loop: short probe first (a hanging relay costs <=90s),
+# full bench attempt only after the probe actually sees the chip, then the
+# flash-kernel smoke. Artifacts land in /tmp for the builder to commit as
+# TPU_EVIDENCE.md when a run succeeds.
 cd /root/repo
-for i in $(seq 1 60); do
-  echo "=== attempt $i $(date +%H:%M:%S) ===" >> /tmp/bench_loop.log
-  if python bench.py > /tmp/bench_try.json 2>> /tmp/bench_loop.log; then
+LOG=/tmp/bench_loop.log
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date +%H:%M:%S) ===" >> "$LOG"
+  KT_BENCH_WORKER=probe timeout 90 python bench.py >> "$LOG" 2>&1
+  rc=$?
+  if [ "$rc" != "0" ]; then
+    echo "probe rc=$rc; sleeping" >> "$LOG"
+    sleep 150
+    continue
+  fi
+  echo "probe saw TPU; running full bench" >> "$LOG"
+  if KT_BENCH_WORKER=1 timeout 1200 python bench.py > /tmp/bench_try.json 2>> "$LOG"; then
     if grep -q '"device": "TPU' /tmp/bench_try.json; then
       cp /tmp/bench_try.json /tmp/bench_tpu.json
-      echo "SUCCESS on attempt $i" >> /tmp/bench_loop.log
+      echo "BENCH SUCCESS on attempt $i" >> "$LOG"
+      echo "running tpu_smoke" >> "$LOG"
+      timeout 1200 python scripts/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1
+      echo "smoke rc=$? — loop done" >> "$LOG"
       exit 0
     fi
-    echo "(cpu fallback line; TPU still down)" >> /tmp/bench_loop.log
+    echo "(cpu-labelled line; ignoring)" >> "$LOG"
+  else
+    echo "bench attempt failed rc=$?" >> "$LOG"
   fi
-  sleep 240
+  sleep 150
 done
-echo "gave up" >> /tmp/bench_loop.log
+echo "gave up" >> "$LOG"

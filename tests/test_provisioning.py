@@ -127,7 +127,8 @@ class TestSecretsVolumes:
 
         monkeypatch.setenv("MY_TOKEN", "abc123")
         s = Secret.from_env(["MY_TOKEN"], name="tok")
-        assert s.env_vars() == {"MY_TOKEN": "abc123"}
+        assert s.values == {"MY_TOKEN": "abc123"}
+        assert s.ref() == {"name": "tok", "mount_path": None}
         with pytest.raises(ValueError, match="not set"):
             Secret.from_env(["NOPE_VAR_XYZ"])
 

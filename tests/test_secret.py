@@ -1,0 +1,136 @@
+"""Secrets delivered by reference, never by value (round-2 VERDICT #2).
+
+Reference analog: ``resources/secrets/kubernetes_secrets_client.py`` — the
+controller owns real K8s Secret objects; pod templates reference them via
+``envFrom`` and Secret volume mounts. Local backend analog: 0600 files under
+``~/.kt/secrets``, resolved at pod spawn. The non-negotiable property tested
+end-to-end here: the pod sees the value, persisted controller state does not.
+"""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "assets"))
+
+import kubetorch_tpu as kt
+from kubetorch_tpu.resources.secret import Secret
+
+import payloads  # tests/assets
+
+SENTINEL = "s3kr1t-sauce-8f2a"
+
+
+class TestManifestRefs:
+    """Unit tier: secret references in the built manifests, no values."""
+
+    def test_pod_template_envfrom_and_mount(self):
+        from kubetorch_tpu.provisioning.manifests import build_pod_template
+
+        spec = build_pod_template(
+            "web", "python:3.11", {}, cpus="1",
+            secrets=[{"name": "tok", "mount_path": None},
+                     {"name": "aws-secret",
+                      "mount_path": "~/.aws/credentials"}])
+        container = spec["containers"][0]
+        assert {"secretRef": {"name": "tok"}} in container["envFrom"]
+        assert {"secretRef": {"name": "aws-secret"}} in container["envFrom"]
+        vol = next(v for v in spec["volumes"] if v["name"] == "secret-aws-secret")
+        assert vol["secret"]["secretName"] == "aws-secret"
+        assert vol["secret"]["items"] == [{"key": "__file__",
+                                           "path": "credentials"}]
+        mount = next(m for m in container["volumeMounts"]
+                     if m["name"] == "secret-aws-secret")
+        # subPath overlay: only the credential file, not the whole dir
+        assert mount["mountPath"] == "/root/.aws/credentials"
+        assert mount["subPath"] == "credentials"
+        assert mount["readOnly"] is True
+
+    def test_compute_manifest_carries_no_values(self, monkeypatch):
+        monkeypatch.setenv("TEST_API_TOKEN", SENTINEL)
+        s = Secret.from_env(["TEST_API_TOKEN"], name="test-api")
+        manifest = kt.Compute(cpus=1, secrets=[s]).manifest("svc", env={})
+        blob = json.dumps(manifest)
+        assert SENTINEL not in blob
+        assert '"secretRef": {"name": "test-api"}' in blob
+
+    def test_clean_strips_secret_manifest_payload(self):
+        from kubetorch_tpu.controller.persistence import _clean
+
+        record = {"namespace": "ns", "name": "tok",
+                  "manifest": {"kind": "Secret",
+                               "stringData": {"K": SENTINEL},
+                               "metadata": {"name": "tok"}}}
+        cleaned = _clean(record)
+        assert SENTINEL not in json.dumps(cleaned)
+        assert cleaned["manifest"]["metadata"]["name"] == "tok"
+
+
+class TestLocalSecretStore:
+    """LocalBackend: values land in 0600 files, pods resolve envFrom refs."""
+
+    def test_store_and_resolve(self, tmp_path):
+        from kubetorch_tpu.controller.backends import LocalBackend
+        from kubetorch_tpu.provisioning.manifests import (
+            build_deployment_manifest, build_pod_template)
+
+        be = LocalBackend("http://127.0.0.1:1", secrets_dir=str(tmp_path))
+        out = be.apply("ns1", "tok", {
+            "kind": "Secret", "metadata": {"name": "tok"},
+            "stringData": {"MY_TOKEN": SENTINEL, "__file__": "filedata",
+                           "__mount_path__": "~/.aws/credentials"}}, {})
+        assert out == {"kind": "Secret", "stored": True}
+        # values in 0600 files under a 0700 dir, not in memory
+        sdir = tmp_path / "ns1__tok"
+        assert stat.S_IMODE(os.stat(sdir).st_mode) == 0o700
+        assert stat.S_IMODE(os.stat(sdir / "MY_TOKEN").st_mode) == 0o600
+        assert (sdir / "MY_TOKEN").read_text() == SENTINEL
+        assert SENTINEL not in json.dumps(be.objects)
+        assert be.objects["Secret/ns1/tok"]["keys"] == [
+            "MY_TOKEN", "__file__", "__mount_path__"]
+
+        pod = build_pod_template("web", "img", {}, secrets=[
+            {"name": "tok", "mount_path": "~/.aws/credentials"}])
+        env = be._secret_env("ns1", build_deployment_manifest(
+            "web", "ns1", 1, pod))
+        assert env["MY_TOKEN"] == SENTINEL
+        assert env["KT_SECRET_FILE_TOK"] == str(sdir / "__file__")
+        assert (sdir / "__file__").read_text() == "filedata"
+
+        # delete removes the files
+        assert be.delete("ns1", "tok") is True
+        assert not sdir.exists()
+
+
+@pytest.mark.slow
+@pytest.mark.level("minimal")
+class TestSecretE2E:
+    def test_pod_sees_secret_state_does_not(self, monkeypatch):
+        """from_env → deploy → remote fn reads the env var; the controller
+        state dir never stores the value (VERDICT round 2 'done' bar)."""
+        monkeypatch.setenv("KT_E2E_SECRET", SENTINEL)
+        s = Secret.from_env(["KT_E2E_SECRET"], name="e2e-secret")
+        f = kt.fn(payloads.echo_env)
+        f.to(kt.Compute(cpus=1, secrets=[s]))
+        try:
+            result = f("KT_E2E_SECRET")
+            assert result["KT_E2E_SECRET"] == SENTINEL
+
+            state_dir = os.path.expanduser("~/.kt/controller-state")
+            hits = []
+            for root, _, files in os.walk(state_dir):
+                for fname in files:
+                    p = os.path.join(root, fname)
+                    try:
+                        with open(p, errors="ignore") as fh:
+                            if SENTINEL in fh.read():
+                                hits.append(p)
+                    except OSError:
+                        continue
+            assert not hits, f"secret value leaked into state: {hits}"
+        finally:
+            f.teardown()
+            s.delete()

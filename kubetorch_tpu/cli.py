@@ -321,6 +321,14 @@ def secrets_providers():
         click.echo(p)
 
 
+@secrets.command("delete")
+@click.argument("name")
+def secrets_delete(name):
+    from .resources.secret import Secret
+    result = Secret(name).delete()
+    click.echo("deleted" if result.get("existed") else "not found")
+
+
 @cli.group()
 def volumes():
     """Manage volumes."""
@@ -329,10 +337,39 @@ def volumes():
 @volumes.command("create")
 @click.argument("name")
 @click.option("--size", default="10Gi")
-def volumes_create(name, size):
+@click.option("--storage-class", default=None)
+@click.option("--access-mode", default="ReadWriteOnce")
+def volumes_create(name, size, storage_class, access_mode):
     from .resources.volume import Volume
-    Volume(name, size=size).create()
+    Volume(name, size=size, storage_class=storage_class,
+           access_mode=access_mode).create()
     click.echo(f"created {name} ({size})")
+
+
+@volumes.command("delete")
+@click.argument("name")
+@click.option("--no-wait", is_flag=True, default=False)
+def volumes_delete(name, no_wait):
+    from .resources.volume import Volume
+    result = Volume(name).delete(wait=not no_wait)
+    click.echo("deleted" if result.get("existed") else "not found")
+
+
+@volumes.command("ssh")
+@click.argument("name")
+@click.option("--image", default="alpine:latest")
+def volumes_ssh(name, image):
+    """Interactive scratch pod (or local shell) with the volume mounted."""
+    from .resources.volume import Volume
+    Volume.from_name(name).ssh(image=image)
+
+
+@volumes.command("storage-classes")
+def volumes_storage_classes():
+    from .resources.volume import Volume
+    for c in Volume.storage_classes():
+        default = " (default)" if c.get("default") else ""
+        click.echo(f"{c['name']}{default}  {c.get('provisioner', '')}")
 
 
 # -- debug / ssh / events -----------------------------------------------------

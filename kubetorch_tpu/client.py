@@ -106,6 +106,25 @@ class ControllerClient:
     def check_ready(self, namespace: str, name: str) -> Dict:
         return self._request("GET", f"/controller/check-ready/{namespace}/{name}")
 
+    # -- config objects (Secret / PVC / ConfigMap) ----------------------------
+
+    def get_object(self, kind: str, namespace: str, name: str) -> Optional[Dict]:
+        try:
+            return self._request(
+                "GET", f"/controller/object/{kind}/{namespace}/{name}")["object"]
+        except ControllerRequestError as e:
+            if e.status_code == 404:
+                return None
+            raise
+
+    def delete_object(self, kind: str, namespace: str, name: str) -> Dict:
+        return self._request(
+            "DELETE", f"/controller/object/{kind}/{namespace}/{name}")
+
+    def storage_classes(self) -> List[Dict]:
+        return self._request(
+            "GET", "/controller/storage-classes")["storage_classes"]
+
     def cluster_config(self) -> Dict:
         try:
             return self._request("GET", "/controller/cluster-config",

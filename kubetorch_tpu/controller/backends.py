@@ -54,6 +54,14 @@ def _pod_specs(manifest: Dict) -> List[Dict]:
     return [spec.get("template", {}).get("spec", {})]
 
 
+def default_local_volume_dir(namespace: str, name: str) -> str:
+    """Host directory backing a local-mode PVC — THE layout contract between
+    ``LocalBackend`` (provisioner), pod env injection, and client-side
+    ``Volume.ssh``; defined once so the three can't drift."""
+    from ..config import config
+    return os.path.join(config().config_dir, "volumes", f"{namespace}__{name}")
+
+
 def controller_wiring(controller_url: str) -> Dict[str, str]:
     """Env vars every pod needs to register with the controller and stream
     logs, derived from the controller's base URL."""
@@ -105,6 +113,50 @@ class LocalBackend:
         # never in the manifest, the workload record, or persisted controller
         # state (the k8s backend's analog is a real K8s Secret object)
         self.secrets_dir = secrets_dir
+        # local Volume analog: PVCs map to host directories; pods learn the
+        # mapping via KT_VOLUME_* env (a subprocess can't bind-mount)
+        self.volumes_dir = os.path.join(os.path.dirname(secrets_dir),
+                                        "volumes")
+
+    # -- config objects -------------------------------------------------------
+
+    def get_object(self, kind: str, namespace: str, name: str) -> Optional[Dict]:
+        return self.objects.get(f"{kind}/{namespace}/{name}")
+
+    def delete_object(self, kind: str, namespace: str, name: str) -> bool:
+        existed = self.objects.pop(f"{kind}/{namespace}/{name}", None) is not None
+        aux = {"Secret": self._secret_dir,
+               "PersistentVolumeClaim": self._volume_dir}.get(kind)
+        if aux is not None:
+            path = aux(namespace, name)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+                existed = True
+        return existed
+
+    def storage_classes(self) -> List[Dict]:
+        return [{"name": "local-dir", "default": True,
+                 "provisioner": "kubetorch.com/local-dir"}]
+
+    # -- volume store ---------------------------------------------------------
+
+    def _volume_dir(self, namespace: str, name: str) -> str:
+        return os.path.join(self.volumes_dir, f"{namespace}__{name}")
+
+    def _volume_env(self, namespace: str, manifest: Dict) -> Dict[str, str]:
+        """Resolve PVC claims in the pod template to host directories:
+        ``KT_VOLUME_<NAME>`` points at the backing dir (and is created on
+        first use, the local 'provisioner')."""
+        env: Dict[str, str] = {}
+        for spec in _pod_specs(manifest):
+            for vol in spec.get("volumes", []):
+                claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+                if not claim:
+                    continue
+                vdir = self._volume_dir(namespace, claim)
+                os.makedirs(vdir, exist_ok=True)
+                env["KT_VOLUME_" + claim.upper().replace("-", "_")] = vdir
+        return env
 
     # -- secret store ---------------------------------------------------------
 
@@ -132,12 +184,27 @@ class LocalBackend:
         K8s Secret. File-type secrets surface as a PATH (local pods share the
         host filesystem), not as env payload."""
         env: Dict[str, str] = {}
+        secret_names = set()
         for spec in _pod_specs(manifest):
             for container in spec.get("containers", []):
+                # per-key delivery (the canonical path): valueFrom refs
+                for entry in container.get("env", []):
+                    key_ref = ((entry.get("valueFrom") or {})
+                               .get("secretKeyRef") or {})
+                    if key_ref.get("name") and key_ref.get("key"):
+                        secret_names.add(key_ref["name"])
+                        path = os.path.join(
+                            self._secret_dir(namespace, key_ref["name"]),
+                            key_ref["key"])
+                        if os.path.exists(path):
+                            with open(path) as f:
+                                env[entry["name"]] = f.read()
+                # blanket envFrom (name-only refs): every non-dunder key
                 for ref in container.get("envFrom", []):
                     sname = (ref.get("secretRef") or {}).get("name")
                     if not sname:
                         continue
+                    secret_names.add(sname)
                     sdir = self._secret_dir(namespace, sname)
                     if not os.path.isdir(sdir):
                         continue
@@ -146,10 +213,17 @@ class LocalBackend:
                             continue
                         with open(os.path.join(sdir, key)) as f:
                             env[key] = f.read()
-                    if os.path.exists(os.path.join(sdir, "__file__")):
-                        env_key = ("KT_SECRET_FILE_"
-                                   + sname.upper().replace("-", "_"))
-                        env[env_key] = os.path.join(sdir, "__file__")
+                # file-mount payloads surface as a PATH (the volume-mount
+                # analog; local pods share the host filesystem)
+                for vol in spec.get("volumes", []):
+                    sname = (vol.get("secret") or {}).get("secretName")
+                    if sname:
+                        secret_names.add(sname)
+        for sname in secret_names:
+            fpath = os.path.join(self._secret_dir(namespace, sname),
+                                 "__file__")
+            if os.path.exists(fpath):
+                env["KT_SECRET_FILE_" + sname.upper().replace("-", "_")] = fpath
         return env
 
     def _next_ips(self, service_key: str, n: int) -> List[str]:
@@ -179,6 +253,8 @@ class LocalBackend:
                 manifest = {**{k: v for k, v in manifest.items()
                                if k not in ("stringData", "data")},
                             "keys": keys}
+            elif kind == "PersistentVolumeClaim":
+                os.makedirs(self._volume_dir(namespace, name), exist_ok=True)
             self.objects[f"{kind}/{key}"] = manifest
             return {"kind": kind, "stored": True}
         replicas = int(manifest.get("spec", {}).get("replicas", 1))
@@ -197,6 +273,7 @@ class LocalBackend:
         pod_env = dict(os.environ)
         pod_env.pop("JAX_PLATFORMS", None)
         pod_env.update(self._secret_env(namespace, manifest))
+        pod_env.update(self._volume_env(namespace, manifest))
         pod_env.update(env)
         pod_env.update({
             "PALLAS_AXON_POOL_IPS": pod_env.get("KT_POD_TPU", ""),
@@ -303,8 +380,12 @@ class KubernetesBackend:
             return False
 
     def _run(self, *args: str, input_data: Optional[str] = None) -> str:
-        res = subprocess.run([self.kubectl, *args], capture_output=True,
-                             text=True, input=input_data, timeout=120)
+        try:
+            res = subprocess.run([self.kubectl, *args], capture_output=True,
+                                 text=True, input=input_data, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeError(f"kubectl {' '.join(args)} timed out "
+                               f"after {e.timeout:.0f}s") from e
         if res.returncode != 0:
             raise RuntimeError(f"kubectl {' '.join(args)} failed: {res.stderr}")
         return res.stdout
@@ -392,6 +473,40 @@ class KubernetesBackend:
                         f"kubetorch.com/service={name}", "-o",
                         "jsonpath={.items[*].status.podIP}")
         return [ip for ip in out.split() if ip]
+
+    # -- config objects -------------------------------------------------------
+
+    def get_object(self, kind: str, namespace: str, name: str) -> Optional[Dict]:
+        resource = self._KIND_RESOURCES.get(kind, kind.lower())
+        try:
+            out = self._run("get", resource, name, "-n", namespace,
+                            "-o", "json")
+        except RuntimeError as e:
+            if "not found" in str(e).lower():
+                return None
+            raise
+        return json.loads(out)
+
+    def delete_object(self, kind: str, namespace: str, name: str) -> bool:
+        resource = self._KIND_RESOURCES.get(kind, kind.lower())
+        existed = self.get_object(kind, namespace, name) is not None
+        # --wait=false: an in-use PVC blocks on the pvc-protection finalizer
+        # until kubectl's timeout; the CLIENT owns the Terminating poll
+        # (Volume.delete wait=), the controller thread must return promptly
+        self._run("delete", resource, name, "-n", namespace,
+                  "--ignore-not-found", "--wait=false")
+        self.kinds.pop(f"{namespace}/{name}", None)
+        return existed
+
+    def storage_classes(self) -> List[Dict]:
+        items = json.loads(self._run("get", "storageclass", "-o",
+                                     "json")).get("items", [])
+        default_anno = "storageclass.kubernetes.io/is-default-class"
+        return [{"name": it["metadata"]["name"],
+                 "default": it["metadata"].get("annotations", {})
+                                          .get(default_anno) == "true",
+                 "provisioner": it.get("provisioner")}
+                for it in items]
 
     def shutdown(self) -> None:
         pass

@@ -106,14 +106,26 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
         container["volumeMounts"].append({"name": vol["name"],
                                           "mountPath": vol["mount_path"]})
 
-    # secrets ride as REFERENCES — envFrom + Secret volume mounts; values
-    # stay in the Secret object (reference kubernetes_secrets_client.py:
-    # inlining them in the manifest would leak plaintext into workload
-    # records and persisted controller state)
+    # secrets ride as REFERENCES — per-key valueFrom + Secret volume mounts;
+    # values stay in the Secret object (reference
+    # kubernetes_secrets_client.py: inlining them in the manifest would leak
+    # plaintext into workload records and persisted controller state).
+    # Per-key, not blanket envFrom: envFrom would also inject the __file__
+    # credential payload as an env var on Kubernetes.
     for sec in secrets or []:
         sname = sec["name"] if isinstance(sec, dict) else sec
-        container.setdefault("envFrom", []).append(
-            {"secretRef": {"name": sname}})
+        keys = sec.get("keys") if isinstance(sec, dict) else None
+        if keys:
+            container["env"].extend(
+                {"name": k, "valueFrom": {"secretKeyRef":
+                                          {"name": sname, "key": k}}}
+                for k in keys)
+        elif not (isinstance(sec, dict) and sec.get("mount_path")):
+            # name-only ref (e.g. a plain string): keys unknown, fall back
+            # to envFrom — safe because refs without a mount carry no
+            # __file__ payload
+            container.setdefault("envFrom", []).append(
+                {"secretRef": {"name": sname}})
         mount = sec.get("mount_path") if isinstance(sec, dict) else None
         if mount:
             mount = ("/root" + mount[1:]) if mount.startswith("~") else mount

@@ -100,10 +100,15 @@ class Secret:
         ref from its 0600 secret files at pod spawn. ``mount_path`` is
         advertised only when there is an actual file payload — a provider
         preset resolved from env vars alone must not emit a volume for a
-        ``__file__`` key that ``save()`` never writes.
+        ``__file__`` key that ``save()`` never writes. ``keys`` (env var
+        NAMES, not values) lets the pod template emit per-key
+        ``valueFrom.secretKeyRef`` entries instead of a blanket ``envFrom``
+        — envFrom would also inject the ``__file__`` credential payload as
+        an environment variable on Kubernetes.
         """
         return {"name": self.name,
-                "mount_path": self.mount_path if self.file_path else None}
+                "mount_path": self.mount_path if self.file_path else None,
+                "keys": sorted(self.values)}
 
     # -- cluster CRUD through the controller ----------------------------------
 
@@ -119,8 +124,12 @@ class Secret:
                       "stringData": data})
 
     def delete(self, namespace: Optional[str] = None) -> Dict:
-        return controller_client().delete_workload(
-            namespace or config().namespace, self.name)
+        return controller_client().delete_object(
+            "Secret", namespace or config().namespace, self.name)
+
+    def exists(self, namespace: Optional[str] = None) -> bool:
+        return controller_client().get_object(
+            "Secret", namespace or config().namespace, self.name) is not None
 
     def __repr__(self) -> str:
         return (f"Secret({self.name!r}, keys={sorted(self.values)}, "

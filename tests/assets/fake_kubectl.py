@@ -88,6 +88,36 @@ def main(argv):
         print(f"{kind.lower()}/{name} configured")
         return 0
 
+    if argv[:2] == ["get", "storageclass"]:
+        _record(d, {"cmd": argv})
+        print(json.dumps({"items": [
+            {"metadata": {"name": "standard-rwo",
+                          "annotations": {"storageclass.kubernetes.io/"
+                                          "is-default-class": "true"}},
+             "provisioner": "pd.csi.storage.gke.io"},
+            {"metadata": {"name": "filestore-rwx"},
+             "provisioner": "filestore.csi.storage.gke.io"},
+        ]}))
+        return 0
+
+    if (argv[:1] == ["get"] and len(argv) >= 3
+            and argv[1] not in ("pods",) and "-o" in argv
+            and _flag(argv, "-o") == "json"):
+        # get <resource> <name> -n NS -o json
+        resource, name = argv[1], argv[2]
+        _record(d, {"cmd": argv})
+        base = resource.split(".", 1)[0].rstrip("s").capitalize()
+        kind = {"Deployment": "Deployment", "Jobset": "JobSet",
+                "Service": "Service", "Pvc": "PersistentVolumeClaim",
+                "Secret": "Secret", "Configmap": "ConfigMap"}.get(base, base)
+        manifest = state.get(f"{kind}/{ns}/{name}")
+        if manifest is None:
+            sys.stderr.write(f'Error from server (NotFound): '
+                             f'{resource} "{name}" not found\n')
+            return 1
+        print(json.dumps(manifest))
+        return 0
+
     if argv[:2] == ["get", "pods"]:
         _record(d, {"cmd": argv})
         selector = _flag(argv, "-l", "")

@@ -1,0 +1,76 @@
+"""check-ready semantics (round-2 VERDICT weak #5 / next #7).
+
+Controller-managed workloads (record carries a manifest) are ready only when
+enough pods have CONNECTED over the WS registry — raw backend IPs prove the
+scheduler placed pods, not that their servers came up. Register-only/BYO
+records keep the backend-IP fallback: their pods run outside the controller
+and may never open a WS.
+"""
+
+import asyncio
+
+import pytest
+
+from kubetorch_tpu.controller.app import ControllerState, create_controller_app
+
+pytestmark = pytest.mark.level("unit")
+
+
+class StubBackend:
+    """Pods 'exist' (IPs) without any server behind them."""
+
+    def __init__(self, ips):
+        self.ips = ips
+
+    def apply(self, namespace, name, manifest, env):
+        return {"service_url": "http://stub:32300", "pod_ips": self.ips}
+
+    def pod_ips(self, namespace, name):
+        return self.ips
+
+    def delete(self, namespace, name):
+        return True
+
+    def shutdown(self):
+        pass
+
+
+async def _ready(client, name):
+    return await (await client.get(f"/controller/check-ready/default/{name}")).json()
+
+
+def test_managed_workload_requires_connected_pods():
+    async def body():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        state = ControllerState(backend=StubBackend(["10.0.0.1", "10.0.0.2"]))
+        async with TestClient(TestServer(create_controller_app(state))) as client:
+            resp = await client.post("/controller/deploy", json={
+                "namespace": "default", "name": "svc",
+                "manifest": {"kind": "Deployment", "spec": {"replicas": 2}},
+                "metadata": {}, "expected_pods": 2})
+            assert (await resp.json())["ok"]
+
+            # pods placed (backend IPs) but no server ever connected
+            status = await _ready(client, "svc")
+            assert not status["ready"] and status["connected"] == 0
+
+    asyncio.run(body())
+
+
+def test_byo_record_falls_back_to_backend_ips():
+    async def body():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        state = ControllerState(backend=StubBackend(["10.0.0.9"]))
+        async with TestClient(TestServer(create_controller_app(state))) as client:
+            resp = await client.post("/controller/workload", json={
+                "namespace": "default", "name": "byo",
+                "metadata": {}, "selector": {"app": "mine"}})
+            assert resp.status == 200
+
+            # register-only: no manifest, pods live outside the controller
+            status = await _ready(client, "byo")
+            assert status["ready"]
+
+    asyncio.run(body())

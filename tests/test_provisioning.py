@@ -128,7 +128,8 @@ class TestSecretsVolumes:
         monkeypatch.setenv("MY_TOKEN", "abc123")
         s = Secret.from_env(["MY_TOKEN"], name="tok")
         assert s.values == {"MY_TOKEN": "abc123"}
-        assert s.ref() == {"name": "tok", "mount_path": None}
+        assert s.ref() == {"name": "tok", "mount_path": None,
+                           "keys": ["MY_TOKEN"]}
         with pytest.raises(ValueError, match="not set"):
             Secret.from_env(["NOPE_VAR_XYZ"])
 

@@ -27,17 +27,26 @@ SENTINEL = "s3kr1t-sauce-8f2a"
 class TestManifestRefs:
     """Unit tier: secret references in the built manifests, no values."""
 
-    def test_pod_template_envfrom_and_mount(self):
+    def test_pod_template_env_refs_and_mount(self):
         from kubetorch_tpu.provisioning.manifests import build_pod_template
 
         spec = build_pod_template(
             "web", "python:3.11", {}, cpus="1",
-            secrets=[{"name": "tok", "mount_path": None},
+            secrets=[{"name": "tok", "mount_path": None, "keys": ["API_KEY"]},
+                     {"name": "plain-ref"},
                      {"name": "aws-secret",
-                      "mount_path": "~/.aws/credentials"}])
+                      "mount_path": "~/.aws/credentials",
+                      "keys": ["AWS_ACCESS_KEY_ID"]}])
         container = spec["containers"][0]
-        assert {"secretRef": {"name": "tok"}} in container["envFrom"]
-        assert {"secretRef": {"name": "aws-secret"}} in container["envFrom"]
+        # known keys → per-key valueFrom (a blanket envFrom would also
+        # inject the __file__ payload as env on Kubernetes)
+        assert {"name": "API_KEY", "valueFrom": {"secretKeyRef": {
+            "name": "tok", "key": "API_KEY"}}} in container["env"]
+        assert {"name": "AWS_ACCESS_KEY_ID", "valueFrom": {"secretKeyRef": {
+            "name": "aws-secret",
+            "key": "AWS_ACCESS_KEY_ID"}}} in container["env"]
+        # name-only ref without a mount: keys unknown → envFrom fallback
+        assert container["envFrom"] == [{"secretRef": {"name": "plain-ref"}}]
         vol = next(v for v in spec["volumes"] if v["name"] == "secret-aws-secret")
         assert vol["secret"]["secretName"] == "aws-secret"
         assert vol["secret"]["items"] == [{"key": "__file__",
@@ -55,7 +64,7 @@ class TestManifestRefs:
         manifest = kt.Compute(cpus=1, secrets=[s]).manifest("svc", env={})
         blob = json.dumps(manifest)
         assert SENTINEL not in blob
-        assert '"secretRef": {"name": "test-api"}' in blob
+        assert '"secretKeyRef": {"name": "test-api", "key": "TEST_API_TOKEN"}' in blob
 
     def test_clean_strips_secret_manifest_payload(self):
         from kubetorch_tpu.controller.persistence import _clean
@@ -93,7 +102,8 @@ class TestLocalSecretStore:
             "MY_TOKEN", "__file__", "__mount_path__"]
 
         pod = build_pod_template("web", "img", {}, secrets=[
-            {"name": "tok", "mount_path": "~/.aws/credentials"}])
+            {"name": "tok", "mount_path": "~/.aws/credentials",
+             "keys": ["MY_TOKEN"]}])
         env = be._secret_env("ns1", build_deployment_manifest(
             "web", "ns1", 1, pod))
         assert env["MY_TOKEN"] == SENTINEL

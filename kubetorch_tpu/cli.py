@@ -85,6 +85,20 @@ def config_set(key, value):
     click.echo(f"{key} = {value}")
 
 
+# -- cluster install ----------------------------------------------------------
+
+
+@cli.command()
+@click.option("--skip", multiple=True,
+              help="Skip manifests whose filename contains this substring "
+                   "(e.g. --skip loki --skip kueue).")
+def install(skip):
+    """Install the control plane + observability stack (deploy/*.yaml)."""
+    from .provisioning.installer import install_stack
+    for fname, kind, name in install_stack(skip=skip):
+        click.echo(f"applied {kind}/{name}  ({fname})")
+
+
 # -- deploy ------------------------------------------------------------------
 
 

@@ -456,6 +456,51 @@ async def version(request: web.Request) -> web.Response:
 # -- logs (Loki-less path) ---------------------------------------------------
 
 
+def _loki_url(state: "ControllerState") -> Optional[str]:
+    return (os.environ.get("KT_LOKI_URL")
+            or state.cluster_config.get("loki_url"))
+
+
+async def _forward_to_loki(app: web.Application,
+                           by_service: Dict[str, List[Dict]]) -> None:
+    """Best-effort push to Loki (deploy/loki.yaml): durable log history
+    beyond the in-memory ring buffer + disk rotation (reference ships logs
+    to the data-store Loki). Never blocks or fails the pod's log push."""
+    import aiohttp
+
+    state: ControllerState = app["cstate"]
+    url = _loki_url(state)
+    if not url:
+        return
+    try:
+        streams = []
+        for key, entries in by_service.items():
+            ns, svc = key.split("/", 1)
+            values = []
+            for e in entries:
+                try:
+                    ts_ns = int(float(e.get("ts", time.time())) * 1e9)
+                except (TypeError, ValueError):
+                    ts_ns = int(time.time() * 1e9)
+                values.append([str(ts_ns), json.dumps(
+                    {k: v for k, v in e.items() if k != "seq"})])
+            streams.append({"stream": {"namespace": ns, "service": svc,
+                                       "source": "kubetorch"},
+                            "values": values})
+        sess = await _proxy_session(app)
+        async with sess.post(url.rstrip("/") + "/loki/api/v1/push",
+                             json={"streams": streams},
+                             timeout=aiohttp.ClientTimeout(total=5)) as resp:
+            await resp.read()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# strong refs to in-flight Loki pushes: an unreferenced task can be GC'd
+# mid-flight (asyncio docs), silently dropping batches under load
+_LOKI_TASKS: set = set()
+
+
 async def ingest_logs(request: web.Request) -> web.Response:
     state: ControllerState = request.app["cstate"]
     body = await request.json()
@@ -470,6 +515,11 @@ async def ingest_logs(request: web.Request) -> web.Response:
         # non-blocking enqueue; the persister's writer thread owns the disk
         for key, entries in by_service.items():
             state.persister.append_logs(key, entries)
+    if by_service and _loki_url(state):
+        task = asyncio.get_running_loop().create_task(
+            _forward_to_loki(request.app, by_service))
+        _LOKI_TASKS.add(task)
+        task.add_done_callback(_LOKI_TASKS.discard)
     return web.json_response({"ok": True})
 
 
@@ -938,6 +988,10 @@ def main(argv: Optional[list] = None) -> None:
         state_dir = os.path.join(_cfg().config_dir, "controller-state")
     state = ControllerState(base_url=f"http://127.0.0.1:{args.port}",
                             state_dir=state_dir)
+    # clients must not guess the backend from the URL — a kubectl
+    # port-forward to an in-cluster controller also looks like 127.0.0.1
+    # (Volume.ssh picks scratch-pod vs local-shell off this)
+    state.cluster_config["backend"] = args.backend
     if args.backend == "kubernetes":
         from .backends import KubernetesBackend
         state.backend = KubernetesBackend()

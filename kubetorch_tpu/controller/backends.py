@@ -55,9 +55,11 @@ def _pod_specs(manifest: Dict) -> List[Dict]:
 
 
 def default_local_volume_dir(namespace: str, name: str) -> str:
-    """Host directory backing a local-mode PVC — THE layout contract between
-    ``LocalBackend`` (provisioner), pod env injection, and client-side
-    ``Volume.ssh``; defined once so the three can't drift."""
+    """Host directory backing a local-mode PVC under the DEFAULT layout
+    (``config_dir/volumes``) — the contract client-side ``Volume.ssh``
+    resolves against. ``LocalBackend.__init__`` defaults ``volumes_dir`` to
+    the same root; a backend constructed with a custom ``volumes_dir`` is
+    test-only and unreachable from a remote client anyway."""
     from ..config import config
     return os.path.join(config().config_dir, "volumes", f"{namespace}__{name}")
 
@@ -99,24 +101,26 @@ class LocalBackend:
 
     def __init__(self, controller_url: str, server_port: int = 32300,
                  store_url: Optional[str] = None,
-                 secrets_dir: Optional[str] = None):
+                 secrets_dir: Optional[str] = None,
+                 volumes_dir: Optional[str] = None):
+        from ..config import config
         self.controller_url = controller_url
         self.server_port = server_port
         self.store_url = store_url
         self.services: Dict[str, List[PodHandle]] = {}
         self.objects: Dict[str, Dict] = {}   # "Kind/ns/name" → manifest
         self._ip_block = 0
-        if secrets_dir is None:
-            from ..config import config
-            secrets_dir = os.path.join(config().config_dir, "secrets")
         # secret VALUES live only here, as 0600 files under a 0700 dir —
         # never in the manifest, the workload record, or persisted controller
         # state (the k8s backend's analog is a real K8s Secret object)
-        self.secrets_dir = secrets_dir
+        self.secrets_dir = secrets_dir or os.path.join(config().config_dir,
+                                                       "secrets")
         # local Volume analog: PVCs map to host directories; pods learn the
-        # mapping via KT_VOLUME_* env (a subprocess can't bind-mount)
-        self.volumes_dir = os.path.join(os.path.dirname(secrets_dir),
-                                        "volumes")
+        # mapping via KT_VOLUME_* env (a subprocess can't bind-mount). The
+        # default MUST match default_local_volume_dir — client-side
+        # Volume.ssh resolves through that contract
+        self.volumes_dir = volumes_dir or os.path.join(config().config_dir,
+                                                       "volumes")
 
     # -- config objects -------------------------------------------------------
 

@@ -1,0 +1,89 @@
+"""Control-plane installer: apply the ``deploy/`` stack to a cluster.
+
+Reference analog: the kubetorch helm chart (``charts/kubetorch``) — CRDs,
+controller, data-store, Kueue wiring, the Prometheus metrics stack and Loki.
+Here the same stack is plain YAML under ``deploy/``, applied doc-by-doc
+through kubectl so it works with any kubectl-compatible endpoint (including
+the recording fake in tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# apply order matters: CRDs and namespace before the things that use them,
+# observability last (it scrapes whatever exists)
+DEPLOY_ORDER = [
+    "kubetorchworkload-crd.yaml",
+    "controller.yaml",
+    "data-store.yaml",
+    "kueue-resources.yaml",
+    "metrics.yaml",
+    "loki.yaml",
+]
+
+NAMESPACE_DOC = {"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "kubetorch"}}
+
+
+def deploy_dir() -> str:
+    override = os.environ.get("KT_DEPLOY_DIR")
+    if override:
+        return override
+    # repo checkout layout: deploy/ beside the package
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "deploy")
+
+
+def _kubectl(kubectl: Optional[str]) -> str:
+    resolved = (kubectl or os.environ.get("KT_KUBECTL")
+                or shutil.which("kubectl"))
+    if resolved is None:
+        raise RuntimeError("kubectl not found; cannot install the stack")
+    return resolved
+
+
+def _apply_doc(kubectl: str, doc: Dict) -> None:
+    ns = doc.get("metadata", {}).get("namespace", "default")
+    res = subprocess.run([kubectl, "apply", "-n", ns, "-f", "-"],
+                         input=json.dumps(doc), text=True,
+                         capture_output=True, timeout=120)
+    if res.returncode != 0:
+        name = doc.get("metadata", {}).get("name", "?")
+        raise RuntimeError(f"apply {doc.get('kind')}/{name} failed: "
+                           f"{res.stderr.strip()}")
+
+
+def install_stack(kubectl: Optional[str] = None,
+                  skip: Sequence[str] = (),
+                  directory: Optional[str] = None) -> List[Tuple[str, str, str]]:
+    """Apply every manifest doc in ``deploy/`` in dependency order.
+
+    ``skip`` filters by filename substring (e.g. ``["loki"]``). Returns
+    ``(filename, kind, name)`` per applied doc.
+    """
+    import yaml
+
+    kc = _kubectl(kubectl)
+    root = directory or deploy_dir()
+    applied: List[Tuple[str, str, str]] = []
+    _apply_doc(kc, NAMESPACE_DOC)
+    applied.append(("<namespace>", "Namespace", "kubetorch"))
+    for fname in DEPLOY_ORDER:
+        if any(s in fname for s in skip):
+            continue
+        path = os.path.join(root, fname)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                _apply_doc(kc, doc)
+                applied.append((fname, doc.get("kind", "?"),
+                                doc.get("metadata", {}).get("name", "?")))
+    return applied

@@ -160,12 +160,25 @@ class Volume:
                 "--overrides",
                 _json.dumps(self.scratch_pod_manifest(image, pod_name))]
 
+    @staticmethod
+    def _controller_is_local() -> bool:
+        """Ask the controller which backend it runs — substring-matching
+        127.0.0.1 in api_url would also match a kubectl port-forward to a
+        REAL in-cluster controller and silently shell into an empty local
+        dir instead of the PVC."""
+        try:
+            backend = controller_client().cluster_config().get("backend")
+        except Exception:
+            backend = None
+        if backend:
+            return backend == "local"
+        return config().local_mode or not config().api_url
+
     def ssh(self, image: str = "alpine:latest",
             namespace: Optional[str] = None) -> None:
         """Interactive shell with this volume mounted: a scratch pod on k8s,
         or ``$SHELL`` in the backing host dir when the controller is local."""
-        api_url = config().api_url or ""
-        if "127.0.0.1" in api_url or config().local_mode:
+        if self._controller_is_local():
             from ..controller.backends import default_local_volume_dir
             vdir = default_local_volume_dir(
                 namespace or config().namespace, self.name)

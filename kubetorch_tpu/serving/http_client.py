@@ -169,27 +169,65 @@ class HTTPClient:
 
     # -- metric streaming -----------------------------------------------------
 
-    def _start_metric_stream(self, interval: float = 3.0):
+    @staticmethod
+    def _format_metrics(text: str) -> str:
+        """Compact one-liner from a pod's /metrics exposition: summed HBM
+        across devices, in-flight count, request counter."""
+        hbm_use = hbm_lim = 0.0
+        inflight = reqs = None
+        for ln in text.splitlines():
+            if not ln.startswith(("kt_", "kubetorch_")):
+                continue
+            try:
+                name, val = ln.rsplit(" ", 1)
+                v = float(val)
+            except ValueError:
+                continue
+            if name.startswith("kt_tpu_hbm_bytes_in_use"):
+                hbm_use += v
+            elif name.startswith("kt_tpu_hbm_bytes_limit"):
+                hbm_lim += v
+            elif name == "kt_inflight_requests":
+                inflight = int(v)
+            elif name == "kt_http_requests_total":
+                reqs = int(v)
+        parts = []
+        if hbm_lim:
+            parts.append(f"hbm={hbm_use / 2**30:.2f}/{hbm_lim / 2**30:.2f}GiB"
+                         f" ({100 * hbm_use / hbm_lim:.0f}%)")
+        if inflight is not None:
+            parts.append(f"inflight={inflight}")
+        if reqs is not None:
+            parts.append(f"reqs={reqs}")
+        return "  ".join(parts)
+
+    def _start_metric_stream(self, interval: Optional[float] = None):
         """Poll the service's /metrics during a call and echo TPU HBM /
-        activity gauges (reference streams DCGM GPU util via PromQL,
-        ``http_client.py:758-795``; TPU gauges come from the pod's own
-        metrics endpoint)."""
+        activity gauges alongside the streamed logs (reference streams DCGM
+        GPU util via PromQL, ``http_client.py:758-795``; TPU gauges come
+        from the pod's own metrics endpoint — falling back to the
+        controller-proxy route when the pod isn't directly reachable)."""
         stop = threading.Event()
+        if interval is None:
+            interval = float(os.environ.get("KT_METRIC_STREAM_INTERVAL", "3"))
 
         def pump():
             # module-level requests, NOT self._session: Session isn't
             # thread-safe and the main thread's POST is in flight
             while not stop.wait(interval):
-                try:
-                    r = _requests.get(f"{self.base_url}/metrics", timeout=3)
+                for url in (self.base_url, self.proxy_url):
+                    if not url:
+                        continue
+                    try:
+                        r = _requests.get(f"{url}/metrics", timeout=3)
+                    except _requests.RequestException:
+                        continue
                     if r.status_code != 200:
                         continue
-                    gauges = [ln for ln in r.text.splitlines()
-                              if ln.startswith(("kt_tpu_hbm", "kt_http"))]
-                    if gauges:
-                        print("[metrics] " + "  ".join(gauges))
-                except _requests.RequestException:
-                    pass
+                    line = self._format_metrics(r.text)
+                    if line:
+                        print(f"[metrics] {line}", flush=True)
+                    break
 
         threading.Thread(target=pump, daemon=True).start()
         return stop.set

@@ -339,11 +339,18 @@ async def metrics(request: web.Request) -> web.Response:
         body = generate_latest(REGISTRY)
     except Exception:
         body = b""
-    extra = (
-        f"kubetorch_last_activity_timestamp {state.last_activity}\n"
-        f"kt_http_requests_total {state.request_count}\n"
-        f"kt_inflight_requests {state.inflight}\n"
-    ).encode()
+    from .metrics_push import tpu_gauges
+    lines = {
+        "kubetorch_last_activity_timestamp": state.last_activity,
+        "kt_http_requests_total": state.request_count,
+        "kt_inflight_requests": state.inflight,
+        # HBM gauges on the SCRAPE endpoint too (not just the push loop):
+        # Prometheus (deploy/metrics.yaml) and live client streaming read
+        # the TPU signal from here. Off-loop: memory_stats() can stall on a
+        # busy chip and a 3s-interval scraper must not block /health.
+        **(await asyncio.to_thread(tpu_gauges)),
+    }
+    extra = ("".join(f"{k} {v}\n" for k, v in lines.items())).encode()
     return web.Response(body=body + extra, content_type="text/plain")
 
 

@@ -17,6 +17,34 @@ from typing import Optional
 PUSH_INTERVAL_S = 15.0
 
 
+def tpu_gauges() -> dict:
+    """Per-device HBM gauges from jax's memory stats — the TPU analog of the
+    DCGM exporter's GPU_UTIL/FB_USED signal. Shared by the push loop AND the
+    pod's ``/metrics`` scrape endpoint so Prometheus (deploy/metrics.yaml)
+    and live client streaming see the same series.
+
+    Reads stats only when the workload has ALREADY imported jax: an
+    external scraper must never be the thing that initializes the TPU
+    runtime (backend init takes tens of seconds and would also claim the
+    chips before user code configures them)."""
+    import sys
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+        devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+        out = {}
+        for d in devs:
+            stats = d.memory_stats() or {}
+            out[f"kt_tpu_hbm_bytes_in_use{{device=\"{d.id}\"}}"] = \
+                stats.get("bytes_in_use", 0)
+            out[f"kt_tpu_hbm_bytes_limit{{device=\"{d.id}\"}}"] = \
+                stats.get("bytes_limit", 0)
+        return out
+    except Exception:
+        return {}
+
+
 class MetricsPusher:
     def __init__(self, gateway_url: str, state, interval: float = PUSH_INTERVAL_S):
         self.gateway_url = gateway_url
@@ -29,28 +57,13 @@ class MetricsPusher:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def _tpu_metrics(self) -> dict:
-        try:
-            import jax
-            devs = [d for d in jax.local_devices() if d.platform == "tpu"]
-            out = {}
-            for d in devs:
-                stats = d.memory_stats() or {}
-                out[f"kt_tpu_hbm_bytes_in_use{{device=\"{d.id}\"}}"] = \
-                    stats.get("bytes_in_use", 0)
-                out[f"kt_tpu_hbm_bytes_limit{{device=\"{d.id}\"}}"] = \
-                    stats.get("bytes_limit", 0)
-            return out
-        except Exception:
-            return {}
-
     def _payload(self) -> str:
         lines = {
             "kubetorch_last_activity_timestamp": self.state.last_activity,
             "kt_http_requests_total": self.state.request_count,
             "kt_heartbeat_sent": time.time(),
         }
-        lines.update(self._tpu_metrics())
+        lines.update(tpu_gauges())
         return "\n".join(f"{k} {v}" for k, v in lines.items()) + "\n"
 
     def _loop(self) -> None:

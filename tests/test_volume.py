@@ -71,7 +71,8 @@ class TestLocalBackendVolumes:
             build_deployment_manifest, build_pod_template)
 
         be = LocalBackend("http://127.0.0.1:1",
-                          secrets_dir=str(tmp_path / "secrets"))
+                          secrets_dir=str(tmp_path / "secrets"),
+                          volumes_dir=str(tmp_path / "volumes"))
         out = be.apply("ns1", "scratch",
                        Volume("scratch").manifest("ns1"), {})
         assert out == {"kind": "PersistentVolumeClaim", "stored": True}

@@ -406,7 +406,15 @@ class KubernetesBackend:
         cluster_url = os.environ.get(
             "KT_CLUSTER_CONTROLLER_URL",
             "http://kubetorch-controller.kubetorch.svc.cluster.local:8080")
-        wired = {**controller_wiring(cluster_url), **env}
+        wired = {
+            **controller_wiring(cluster_url),
+            # bootstrap pods pull the framework tree from here; also the
+            # pod-side data plane (kt.put/get, code sync)
+            "KT_DATA_STORE_URL": os.environ.get(
+                "KT_DATA_STORE_URL",
+                "http://kubetorch-data-store.kubetorch.svc.cluster.local:8873"),
+            **env,
+        }
         for pod_spec in self._pod_specs(manifest):
             for container in pod_spec.get("containers", []):
                 have = {e["name"] for e in container.setdefault("env", [])}

@@ -1,0 +1,85 @@
+"""Pod bootstrap: make ANY python image serve as a kubetorch pod.
+
+Reference analog: ``provisioning/templates/kt_setup_template.sh.j2`` —
+raise rlimits, detect python, install the framework into the image at pod
+start, exec the server. TPU-first difference: instead of ``uv pip install
+kubetorch[server]`` from an index (cluster egress), the framework tree is
+pulled from the in-cluster data store over plain HTTP with nothing but the
+python stdlib (GET /tree/{key}/manifest, then GET /blob/{hash} per file) —
+the same CAS the 1-2s code-sync loop uses, so the wheel-less dev build that
+deployed the workload is byte-identical to what pods run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+FRAMEWORK_TREE_KEY = "__kt_framework__"
+
+# sh, not bash: slim/alpine images may lack bash. `exec` replaces the shell
+# so SIGTERM from the kubelet reaches the server directly.
+BOOTSTRAP_SCRIPT = r'''set -e
+ulimit -n 65535 2>/dev/null || true
+PY="$(command -v python3 || command -v python || true)"
+if [ -z "$PY" ]; then echo "kt-bootstrap: no python in image" >&2; exit 1; fi
+if ! "$PY" -c "import kubetorch_tpu" 2>/dev/null; then
+  if [ -z "$KT_DATA_STORE_URL" ]; then
+    echo "kt-bootstrap: kubetorch_tpu not in image and no KT_DATA_STORE_URL to fetch it from" >&2
+    exit 1
+  fi
+  echo "kt-bootstrap: fetching framework from $KT_DATA_STORE_URL"
+  "$PY" - <<'PYEOF'
+import json, os, urllib.request
+store = os.environ["KT_DATA_STORE_URL"].rstrip("/")
+key = os.environ.get("KT_FRAMEWORK_TREE_KEY", "__kt_framework__")
+dest = os.environ.get("KT_BOOTSTRAP_DIR", "/kt/framework")
+pkg_root = os.path.join(dest, "kubetorch_tpu")
+with urllib.request.urlopen(f"{store}/tree/{key}/manifest", timeout=60) as r:
+    files = json.load(r)["files"]
+for rel, info in sorted(files.items()):
+    target = os.path.join(pkg_root, rel)
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    with urllib.request.urlopen(f"{store}/blob/{info['hash']}", timeout=600) as r:
+        data = r.read()
+    with open(target, "wb") as f:
+        f.write(data)
+    os.chmod(target, info.get("mode", 0o644))
+print(f"kt-bootstrap: fetched {len(files)} files -> {pkg_root}", flush=True)
+PYEOF
+  export PYTHONPATH="${KT_BOOTSTRAP_DIR:-/kt/framework}${PYTHONPATH:+:$PYTHONPATH}"
+fi
+if ! "$PY" -c "import aiohttp, requests" 2>/dev/null; then
+  # bare image without the server deps: install them from the index
+  # (reference `uv pip install kubetorch[server]` does the same at pod
+  # start; clusters without egress should bake deps into the image)
+  echo "kt-bootstrap: installing server dependencies"
+  if command -v uv >/dev/null 2>&1; then
+    uv pip install --system aiohttp requests click pyyaml msgpack || \
+      "$PY" -m pip install --no-input aiohttp requests click pyyaml msgpack
+  else
+    "$PY" -m pip install --no-input aiohttp requests click pyyaml msgpack
+  fi
+fi
+exec "$PY" -m kubetorch_tpu.serving.http_server --port "${KT_SERVER_PORT:-32300}"
+'''
+
+
+def bootstrap_command() -> List[str]:
+    """The pod container command: a self-contained /bin/sh bootstrap."""
+    return ["/bin/sh", "-c", BOOTSTRAP_SCRIPT]
+
+
+def package_root() -> str:
+    """The kubetorch_tpu package directory (what pods need on PYTHONPATH's
+    first entry, under a dir literally named ``kubetorch_tpu``)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def push_framework(store_url: str,
+                   key: str = FRAMEWORK_TREE_KEY) -> Optional[Dict]:
+    """Delta-push the framework package tree to the data store so bootstrap
+    pods can pull it. Content-hashed: a warm push with no code changes is a
+    single round trip (the same property the code-sync loop relies on)."""
+    from ..data_store.sync import push_tree
+    return push_tree(store_url, key, package_root())

@@ -68,6 +68,8 @@ def install_stack(kubectl: Optional[str] = None,
     """
     import yaml
 
+    import warnings
+
     kc = _kubectl(kubectl)
     root = directory or deploy_dir()
     applied: List[Tuple[str, str, str]] = []
@@ -78,6 +80,8 @@ def install_stack(kubectl: Optional[str] = None,
             continue
         path = os.path.join(root, fname)
         if not os.path.exists(path):
+            warnings.warn(f"deploy manifest missing on disk: {path}",
+                          stacklevel=2)
             continue
         with open(path) as f:
             for doc in yaml.safe_load_all(f):
@@ -86,4 +90,11 @@ def install_stack(kubectl: Optional[str] = None,
                 _apply_doc(kc, doc)
                 applied.append((fname, doc.get("kind", "?"),
                                 doc.get("metadata", {}).get("name", "?")))
+    # a deploy/*.yaml not in DEPLOY_ORDER would otherwise no-op silently
+    unlisted = sorted(f for f in os.listdir(root)
+                      if f.endswith((".yaml", ".yml"))
+                      and f not in DEPLOY_ORDER)
+    if unlisted:
+        warnings.warn(f"deploy manifests not in DEPLOY_ORDER (NOT applied): "
+                      f"{unlisted}", stacklevel=2)
     return applied

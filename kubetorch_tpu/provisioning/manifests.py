@@ -48,7 +48,8 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
                        launch_timeout: int = 900,
                        debug: bool = False,
                        command: Optional[List[str]] = None,
-                       secrets: Optional[List[Dict]] = None) -> Dict[str, Any]:
+                       secrets: Optional[List[Dict]] = None,
+                       bootstrap: bool = True) -> Dict[str, Any]:
     resources: Dict[str, Dict[str, str]] = {"requests": {}, "limits": {}}
     if cpus:
         resources["requests"]["cpu"] = str(cpus)
@@ -72,12 +73,23 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
         else:
             selectors["nvidia.com/gpu.product"] = gpu_type
 
+    if command is None:
+        if bootstrap:
+            # self-contained bootstrap (reference kt_setup_template.sh.j2):
+            # an image that bundles the framework execs the server
+            # immediately; a bare python image pulls the framework tree
+            # from the data store first. One command for both, so ANY image
+            # with a shell works unmodified.
+            from .bootstrap import bootstrap_command
+            command = bootstrap_command()
+        else:
+            # shell-less images (distroless) that bundle the framework
+            command = ["python", "-m", "kubetorch_tpu.serving.http_server",
+                       "--port", str(SERVER_PORT)]
     container: Dict[str, Any] = {
         "name": "kt-server",
         "image": image,
-        "command": command or ["python", "-m",
-                               "kubetorch_tpu.serving.http_server",
-                               "--port", str(SERVER_PORT)],
+        "command": command,
         "ports": [{"containerPort": SERVER_PORT}],
         "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
         "resources": {k: v for k, v in resources.items() if v},

@@ -238,6 +238,7 @@ class Compute:
                      for v in self.volumes],
             shm_size=self.shm_size, launch_timeout=self.launch_timeout,
             debug=debug, command=command,
+            bootstrap=getattr(self.image, "bootstrap", True),
             # by reference only — values live in Secret objects (see
             # Secret.ref); inlining them here leaked plaintext into
             # persisted workload records (round-2 VERDICT weak #2)
@@ -287,6 +288,34 @@ class Compute:
         for secret in self.secrets:
             if hasattr(secret, "save"):
                 secret.save(self.namespace)
+        # seed the framework tree for bootstrap pods (cluster backend only:
+        # local pods import from this checkout). Content-hashed — a warm
+        # push with no framework changes is one round trip. Best-effort:
+        # images that bundle the framework never read it.
+        if client.cluster_config().get("backend") == "kubernetes":
+            # resolve like the data plane does (config field, else the
+            # controller's cluster config) — most clients never set the
+            # raw config field
+            from ..data_store.commands import _store_url
+            try:
+                store = _store_url()
+            except Exception:  # noqa: BLE001
+                store = None
+            if store:
+                try:
+                    from ..provisioning.bootstrap import push_framework
+                    push_framework(store)
+                except Exception as e:  # noqa: BLE001
+                    import warnings
+                    warnings.warn(
+                        f"framework push for bootstrap pods failed: {e}",
+                        stacklevel=2)
+            else:
+                import warnings
+                warnings.warn(
+                    "no data store resolvable: bare-image pods cannot "
+                    "bootstrap the framework (images bundling kubetorch_tpu "
+                    "are unaffected)", stacklevel=2)
         manifest = self.manifest(name, env={})
         autoscaling = (dataclasses.asdict(self.autoscaling)
                        if self.autoscaling is not None else None)

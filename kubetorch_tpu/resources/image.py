@@ -24,10 +24,13 @@ class Instruction:
 
 
 class Image:
-    def __init__(self, base: str = DEFAULT_BASE):
+    def __init__(self, base: str = DEFAULT_BASE, bootstrap: bool = True):
         self.base = base
         self.instructions: List[Instruction] = []
         self.env_vars: Dict[str, str] = {}
+        # bootstrap=False: exec the server directly (no /bin/sh) — for
+        # shell-less images (distroless) that bundle the framework
+        self.bootstrap = bootstrap
 
     # -- builders (chainable) -------------------------------------------------
 

@@ -1,0 +1,115 @@
+"""Pod bootstrap for arbitrary images (round-2 VERDICT next #4).
+
+Reference: ``provisioning/templates/kt_setup_template.sh.j2`` — any image
+becomes a kt pod at start. Here the framework tree rides the data store's
+CAS (stdlib-only HTTP pull), and the e2e test below REALLY runs the
+bootstrap: a subprocess with no access to this checkout pulls the framework
+from a live store and serves /health.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from kubetorch_tpu.provisioning.bootstrap import (
+    BOOTSTRAP_SCRIPT, bootstrap_command, package_root, push_framework)
+from kubetorch_tpu.utils.procs import free_port, wait_for_port
+
+pytestmark = pytest.mark.level("unit")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestScript:
+    def test_phases_present(self):
+        # rlimits → python detect → import probe → store pull → exec
+        assert "ulimit -n" in BOOTSTRAP_SCRIPT
+        assert "command -v python3" in BOOTSTRAP_SCRIPT
+        assert "import kubetorch_tpu" in BOOTSTRAP_SCRIPT
+        assert "/tree/" in BOOTSTRAP_SCRIPT and "/blob/" in BOOTSTRAP_SCRIPT
+        assert BOOTSTRAP_SCRIPT.strip().splitlines()[-1].startswith("exec ")
+
+    def test_pod_template_defaults_to_bootstrap(self):
+        from kubetorch_tpu.provisioning.manifests import build_pod_template
+
+        spec = build_pod_template("web", "python:3.11-slim", {})
+        assert spec["containers"][0]["command"] == bootstrap_command()
+        explicit = build_pod_template("web", "img", {}, command=["sleep", "1"])
+        assert explicit["containers"][0]["command"] == ["sleep", "1"]
+
+    def test_package_root_is_the_package(self):
+        assert os.path.basename(package_root()) == "kubetorch_tpu"
+        assert os.path.isfile(os.path.join(package_root(), "__init__.py"))
+
+
+@pytest.mark.slow
+@pytest.mark.level("minimal")
+class TestBootstrapE2E:
+    def test_bare_python_bootstraps_to_health(self, tmp_path):
+        """Simulated bare image: cwd outside the checkout, no PYTHONPATH →
+        the script must pull the framework from a live store and serve."""
+        store_port = free_port()
+        store = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+             "--host", "127.0.0.1", "--port", str(store_port),
+             "--root", str(tmp_path / "store")],
+            cwd=REPO_ROOT, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        pod = None
+        try:
+            assert wait_for_port("127.0.0.1", store_port, timeout=30)
+            store_url = f"http://127.0.0.1:{store_port}"
+            stats = push_framework(store_url)
+            assert stats["files"] > 50
+
+            server_port = free_port()
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("PYTHONPATH", "JAX_PLATFORMS")}
+            env.update({
+                "KT_DATA_STORE_URL": store_url,
+                "KT_BOOTSTRAP_DIR": str(tmp_path / "fw"),
+                "KT_SERVER_PORT": str(server_port),
+                # keep the spawned server off the TPU relay and quiet
+                "PALLAS_AXON_POOL_IPS": "",
+            })
+            # sanity: without the checkout, the import really fails
+            probe = subprocess.run(
+                [sys.executable, "-c", "import kubetorch_tpu"],
+                cwd=str(tmp_path), env=env, capture_output=True)
+            assert probe.returncode != 0, \
+                "framework importable outside the checkout; bare-image " \
+                "simulation is void"
+
+            pod = subprocess.Popen(
+                ["/bin/sh", "-c", BOOTSTRAP_SCRIPT], cwd=str(tmp_path),
+                env=env, start_new_session=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            assert wait_for_port("127.0.0.1", server_port, timeout=60), \
+                pod.stdout.read().decode(errors="replace")[-2000:]
+            r = requests.get(f"http://127.0.0.1:{server_port}/health",
+                             timeout=5)
+            assert r.status_code == 200
+            # the framework the pod imported is the PULLED copy
+            assert (tmp_path / "fw" / "kubetorch_tpu" / "__init__.py").exists()
+        finally:
+            # pod got its own session (start_new_session) → killpg reaches
+            # the exec'd server. store shares OUR process group — killpg
+            # there would SIGTERM the whole pytest run.
+            if pod is not None and pod.poll() is None:
+                try:
+                    os.killpg(os.getpgid(pod.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pod.terminate()
+            if store.poll() is None:
+                store.terminate()
+            for proc in (pod, store):
+                if proc is not None:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()

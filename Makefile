@@ -9,11 +9,14 @@ PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast bench smoke-tpu dryrun native clean
 
+# full matrix (everything but the real-chip tier) — the release gate
 test:
 	$(PY_CPU) python -m pytest tests/ -q
 
+# fast default tier (<3 min): skips the jit-heavy pipeline/parallel/model
+# release matrix; run before every commit
 test-fast:
-	$(PY_CPU) python -m pytest tests/ -q -x
+	$(PY_CPU) python -m pytest tests/ -q -x --level minimal
 
 bench:
 	python bench.py

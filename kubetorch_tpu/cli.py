@@ -392,7 +392,10 @@ def volumes_storage_classes():
 @cli.command()
 @click.argument("service")
 @click.option("--port", type=int, default=5678)
-def debug(service, port):
+@click.option("--token", default=None,
+              help="One-shot session token printed by the call that armed "
+                   "the breakpoint.")
+def debug(service, port, token):
     """Attach to a remote pdb session armed by a call with debugger=."""
     import socket
     from .client import controller_client
@@ -400,6 +403,8 @@ def debug(service, port):
     host = record["service_url"].split("//")[1].split(":")[0]
     click.echo(f"connecting to {host}:{port} ... (Ctrl-D to detach)")
     sock = socket.create_connection((host, port))
+    if token:
+        sock.sendall(token.encode() + b"\n")
     import threading
 
     def pump_out():

@@ -283,6 +283,22 @@ def _object_kind_or_none(request: web.Request):
     return kind if kind in OBJECT_KINDS else None
 
 
+async def store_tunnel(request: web.Request) -> web.Response:
+    """External data tunnel (reference ``websocket_tunnel.py:1-199``): route
+    data-store traffic through the controller so ``kt.put/get`` and code
+    push work from a laptop that can reach only the controller — no kubectl
+    port-forward. The store speaks plain HTTP (CAS blobs / trees / KV), so a
+    buffered HTTP relay is the whole tunnel; clients fall back to it when
+    the in-cluster store URL doesn't resolve (``commands._store_url``)."""
+    state: ControllerState = request.app["cstate"]
+    store = state.cluster_config.get("data_store_url")
+    if not store:
+        return web.json_response({"error": "no data store configured"},
+                                 status=503)
+    url = f"{store.rstrip('/')}/{request.match_info['path']}"
+    return await _relay(request, url, error_label="store tunnel")
+
+
 async def get_object(request: web.Request) -> web.Response:
     """Config-object read (Secret metadata / PVC / ConfigMap) — the
     reference's get_pvc/get_secret controller surface. Secret VALUES are
@@ -624,31 +640,52 @@ async def proxy_service(request: web.Request) -> web.Response:
     else:
         target = f"http://{service}.{ns}.svc.cluster.local:{port}"
 
-    url = f"{target}/{path}"
-    body = await request.read()
-    # strip hop-by-hop headers: the body is re-framed (fully buffered), so
-    # forwarding Transfer-Encoding/Connection would corrupt upstream framing
-    _hop = {"host", "content-length", "connection", "keep-alive",
-            "transfer-encoding", "upgrade", "te", "trailers",
-            "proxy-authenticate", "proxy-authorization"}
+    return await _relay(request, f"{target}/{path}", error_label="proxy")
+
+
+# strip hop-by-hop headers: the body is re-framed, so forwarding
+# Transfer-Encoding/Connection would corrupt upstream framing
+_HOP_HEADERS = {"host", "content-length", "connection", "keep-alive",
+                "transfer-encoding", "upgrade", "te", "trailers",
+                "proxy-authenticate", "proxy-authorization"}
+# response headers the relays pass through: serialization/meta headers the
+# clients parse (X-KT-Meta: store payload typing), plus tracing
+_RELAY_RESP_HEADERS = ("content-type", "x-serialization", "x-request-id",
+                      "x-kt-meta")
+
+
+async def _relay(request: web.Request, url: str,
+                 error_label: str) -> web.StreamResponse:
+    """The ONE buffered-header/streamed-body relay behind both the service
+    proxy and the store tunnel. Bodies STREAM in 1MiB chunks — a multi-GB
+    checkpoint riding the tunnel must not be held in controller memory
+    (roughly 2x the blob, an OOM of the whole control plane)."""
+    import aiohttp
+
     headers = {k: v for k, v in request.headers.items()
-               if k.lower() not in _hop}
+               if k.lower() not in _HOP_HEADERS}
+    sess = await _proxy_session(request.app)
     try:
-        sess = await _proxy_session(request.app)
-        async with sess.request(
-                request.method, url, data=body or None, headers=headers,
-                params=request.query,
-                timeout=aiohttp.ClientTimeout(total=600)) as resp:
-            payload = await resp.read()
-            out_headers = {k: v for k, v in resp.headers.items()
-                           if k.lower() in ("content-type",
-                                            "x-serialization",
-                                            "x-request-id")}
-            return web.Response(body=payload, status=resp.status,
-                                headers=out_headers)
-    except aiohttp.ClientError as e:
-        return web.json_response({"error": f"proxy to {url} failed: {e}"},
-                                 status=502)
+        upstream = await sess.request(
+            request.method, url,
+            data=request.content if request.can_read_body else None,
+            headers=headers, params=request.query,
+            timeout=aiohttp.ClientTimeout(total=600))
+    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        return web.json_response({"error": f"{error_label} to {url} "
+                                           f"failed: {e}"}, status=502)
+    try:
+        out = web.StreamResponse(status=upstream.status)
+        for k, v in upstream.headers.items():
+            if k.lower() in _RELAY_RESP_HEADERS:
+                out.headers[k] = v
+        await out.prepare(request)
+        async for chunk in upstream.content.iter_chunked(1 << 20):
+            await out.write(chunk)
+        await out.write_eof()
+        return out
+    finally:
+        upstream.release()
 
 
 async def _wait_for_serving_pod(state: ControllerState, ns: str, name: str,
@@ -936,6 +973,7 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
     r.add_get("/controller/object/{kind}/{ns}/{name}", get_object)
     r.add_delete("/controller/object/{kind}/{ns}/{name}", delete_object)
     r.add_get("/controller/storage-classes", storage_classes)
+    r.add_route("*", "/controller/store/{path:.*}", store_tunnel)
     r.add_get("/controller/cluster-config", cluster_config)
     r.add_get("/controller/version", version)
     r.add_post("/controller/logs", ingest_logs)

@@ -26,8 +26,49 @@ from .types import BroadcastWindow
 _INDEX_SUFFIX = ".__kt_index__"
 
 
+# per-process reachability verdicts: direct URL → (resolved URL, expiry).
+# A direct verdict is cached for the process lifetime; a TUNNEL verdict
+# expires so a store that was merely booting (deploy race) gets its direct
+# path back instead of bottlenecking the controller forever.
+_REACHABLE_CACHE: dict = {}
+_TUNNEL_VERDICT_TTL_S = 60.0
+
+
+def _tunnel_fallback(url: str) -> str:
+    """From OUTSIDE the cluster the store's service DNS doesn't resolve;
+    route through the controller's ``/controller/store`` relay instead
+    (reference ``websocket_tunnel.py`` role). In-cluster pods and local-mode
+    clients pass the direct probe and never pay the hop."""
+    import time as _time
+
+    cached = _REACHABLE_CACHE.get(url)
+    if cached and (cached[1] is None or _time.monotonic() < cached[1]):
+        return cached[0]
+    import requests as _requests
+    resolved, expires = url, None
+    try:
+        _requests.get(f"{url}/health", timeout=2).raise_for_status()
+    except _requests.RequestException:
+        api = config().api_url
+        if api:
+            tunnel = f"{api.rstrip('/')}/controller/store"
+            try:
+                r = _requests.get(f"{tunnel}/health", timeout=5)
+                if r.status_code == 200:
+                    resolved = tunnel
+                    expires = _time.monotonic() + _TUNNEL_VERDICT_TTL_S
+            except _requests.RequestException:
+                pass   # keep direct; its error is the truthful one
+    _REACHABLE_CACHE[url] = (resolved, expires)
+    return resolved
+
+
 def _store_url(explicit: Optional[str] = None) -> str:
-    url = explicit or config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
+    if explicit:
+        # the caller NAMED a store — never silently reroute their data to a
+        # different one just because a health probe blipped
+        return explicit.rstrip("/")
+    url = config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
     if not url and config().api_url:
         # discover through an ALREADY-CONFIGURED controller's cluster config
         # (the local controller runs its own store; k8s clusters publish
@@ -44,7 +85,7 @@ def _store_url(explicit: Optional[str] = None) -> str:
         raise DataStoreError(
             "No data store configured (set KT_DATA_STORE_URL or "
             "config.data_store_url, or pass store_url=)")
-    return url.rstrip("/")
+    return _tunnel_fallback(url.rstrip("/"))
 
 
 def _is_arraylike(obj: Any) -> bool:

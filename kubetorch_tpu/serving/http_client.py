@@ -94,6 +94,14 @@ class HTTPClient:
         if workers is not None:
             body["_kt_workers"] = workers
         if debugger:
+            debugger = dict(debugger)
+            if "token" not in debugger:
+                # one-shot session token: the pod-side breakpoint refuses
+                # connections that don't present it
+                debugger["token"] = uuid.uuid4().hex[:16]
+                print(f"[debug] breakpoint armed — attach with: kt debug "
+                      f"<service> --port {debugger.get('port', 5678)} "
+                      f"--token {debugger['token']}", flush=True)
             body["debugger"] = debugger
         request_id = uuid.uuid4().hex[:16]
         url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")

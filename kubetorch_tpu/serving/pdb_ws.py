@@ -32,6 +32,14 @@ def debugger_spec() -> Optional[dict]:
         return dict(_armed) if _armed else None
 
 
+def _disarm() -> None:
+    """One-shot: the armed spec (and its token) dies with the session — a
+    later connection can't replay it."""
+    global _armed
+    with _lock:
+        _armed = None
+
+
 class _SocketIO:
     """File-like adapter over a blocking socket for pdb's stdin/stdout."""
 
@@ -56,20 +64,60 @@ class _SocketIO:
         pass
 
 
-def kt_breakpoint(port: Optional[int] = None) -> None:
-    """Block until a debug client connects, then drop into pdb over the
-    socket. Import-safe: no-op unless a request armed the debugger."""
+def kt_breakpoint(port: Optional[int] = None,
+                  _accept_timeout: Optional[float] = None) -> None:
+    """Block until an AUTHORIZED debug client connects, then drop into pdb
+    over the socket. Import-safe: no-op unless a request armed the debugger.
+
+    Auth: when the armed spec carries a ``token`` (clients generate one per
+    call — reference ``pdb_websocket.py:175-323`` session handshake), the
+    first line a connection sends must match it; a wrong token gets the
+    connection closed and the breakpoint keeps waiting. The spec is
+    one-shot: consumed when the session starts.
+    """
     import socket
+    import sys
 
     spec = debugger_spec()
     if spec is None and port is None:
         return
+    spec = spec or {}
     port = port or int(spec.get("port", 5678))
+    token = spec.get("token")
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind(("0.0.0.0", port))
     srv.listen(1)
-    conn, _ = srv.accept()
-    io = _SocketIO(conn)
+    if _accept_timeout:
+        srv.settimeout(_accept_timeout)
+    try:
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                return
+            if token:
+                conn.settimeout(10.0)
+                io_probe = _SocketIO(conn)
+                try:
+                    offered = io_probe.readline().strip()
+                except (socket.timeout, OSError):
+                    offered = None
+                if offered != token:
+                    try:
+                        conn.sendall(b"unauthorized\n")
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                conn.settimeout(None)
+                io = io_probe
+            else:
+                io = _SocketIO(conn)
+            break
+    finally:
+        srv.close()
+    _disarm()
+    io.write("kt-debug: session started\n")
     debugger = pdb.Pdb(stdin=io, stdout=io)
-    debugger.set_trace(frame=__import__("sys")._getframe(1))
+    debugger.set_trace(frame=sys._getframe(1))

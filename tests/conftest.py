@@ -46,14 +46,18 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
+    """--level X runs every tier UP TO X (unit < minimal < release), the
+    reference's cumulative ordering: ``--level minimal`` is the fast default
+    (`make test-fast`, skips the jit-heavy release matrix), no flag runs
+    everything except tpu, ``--level tpu`` adds the real-chip tier."""
     want = config.getoption("--level")
     for item in items:
         mark = item.get_closest_marker("level")
         level = mark.args[0] if mark else "unit"
         if want is not None:
-            if level != want:
+            if LEVELS.index(level) > LEVELS.index(want):
                 item.add_marker(pytest.mark.skip(
-                    reason=f"level {level} != requested {want}"))
+                    reason=f"level {level} > requested {want}"))
         elif level == "tpu":
             item.add_marker(pytest.mark.skip(
                 reason="tpu-level tests need --level tpu and a real chip"))

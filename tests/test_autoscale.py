@@ -66,6 +66,7 @@ def _wait_for_pods(name: str, predicate, timeout: float) -> int:
 
 
 @pytest.mark.slow
+@pytest.mark.level("release")   # ~20s of real idle-window waiting
 def test_concurrency_scale_up_then_idle_scale_down():
     f = kt.fn(payloads.sleeper)
     f.to(kt.Compute(cpus=1).autoscale(min_scale=1, max_scale=3, target=1,
@@ -94,6 +95,7 @@ def test_concurrency_scale_up_then_idle_scale_down():
 
 
 @pytest.mark.slow
+@pytest.mark.level("release")   # ~25s of real idle-window waiting
 def test_scale_to_zero_and_cold_start():
     g = kt.fn(payloads.summer)
     g.to(kt.Compute(cpus=1).autoscale(min_scale=0, max_scale=2, target=2,

@@ -1,0 +1,98 @@
+"""Remote pdb session end-to-end (round-2 VERDICT next #8 / weak #4).
+
+Reference: ``serving/pdb_websocket.py:175-323``. The breakpoint blocks until
+an authorized client connects, a wrong token is refused, and the session
+actually drives pdb: prompt → next → continue → function completes.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubetorch_tpu.serving.pdb_ws import arm_debugger, debugger_spec, kt_breakpoint
+from kubetorch_tpu.utils.procs import free_port
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _recv_until(sock, marker: bytes, timeout: float = 10.0) -> bytes:
+    sock.settimeout(timeout)
+    buf = b""
+    deadline = time.monotonic() + timeout
+    while marker not in buf and time.monotonic() < deadline:
+        try:
+            chunk = sock.recv(4096)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def test_breakpoint_session_with_token():
+    port = free_port()
+    token = "s3ss10n-t0k3n"
+    state = {"after_break": None, "done": False}
+
+    def workload():
+        arm_debugger({"port": port, "token": token})
+        x = 20
+        kt_breakpoint(_accept_timeout=30)
+        x = x + 22          # the 'n' step executes this line
+        state["after_break"] = x
+        state["done"] = True
+
+    t = threading.Thread(target=workload, daemon=True)
+    t.start()
+
+    # wait for the listener
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            probe = socket.create_connection(("127.0.0.1", port), timeout=1)
+            break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        pytest.fail("breakpoint listener never came up")
+
+    # wrong token → refused, breakpoint keeps waiting
+    probe.sendall(b"wrong-token\n")
+    assert b"unauthorized" in _recv_until(probe, b"unauthorized", 10)
+    probe.close()
+    assert not state["done"]
+
+    # right token → pdb session
+    sess = socket.create_connection(("127.0.0.1", port), timeout=5)
+    sess.sendall(token.encode() + b"\n")
+    banner = _recv_until(sess, b"(Pdb)")
+    assert b"kt-debug: session started" in banner
+    assert b"(Pdb)" in banner
+
+    sess.sendall(b"p x\n")
+    out = _recv_until(sess, b"(Pdb)")
+    assert b"20" in out
+
+    sess.sendall(b"n\n")                 # step over `x = x + 22`
+    _recv_until(sess, b"(Pdb)")
+    sess.sendall(b"p x\n")
+    out = _recv_until(sess, b"(Pdb)")
+    assert b"42" in out
+
+    sess.sendall(b"c\n")                 # continue → workload finishes
+    t.join(timeout=10)
+    assert state["done"] and state["after_break"] == 42
+    # one-shot: the spec was consumed when the session started
+    assert debugger_spec() is None
+    sess.close()
+
+
+def test_breakpoint_noop_when_unarmed():
+    """Import-safe: kt_breakpoint in production code paths must be inert
+    unless a request armed it."""
+    t0 = time.monotonic()
+    kt_breakpoint()
+    assert time.monotonic() - t0 < 1.0

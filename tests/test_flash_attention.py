@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.ops.attention import flash_attention
 from kubetorch_tpu.models.llama import _xla_attention
 

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.models.llama import LlamaConfig, llama_forward, llama_init
 from kubetorch_tpu.models.generate import (KVCache, forward_with_cache,
                                            generate, init_cache)

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.models.llama import (
     LlamaConfig, llama_init, llama_forward, llama_loss, rope_freqs, apply_rope,
     _xla_attention,

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
 from kubetorch_tpu.parallel.sharding import LLAMA_RULES, batch_sharding
 

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.models.llama import LlamaConfig, llama_forward, llama_init
 from kubetorch_tpu.parallel.mesh import MeshSpec, build_mesh
 

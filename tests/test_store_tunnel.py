@@ -1,0 +1,134 @@
+"""External data tunnel (round-2 VERDICT next #5 / missing #51).
+
+Reference: ``data_store/websocket_tunnel.py`` — rsync from a laptop without
+kubectl. Here the store speaks plain HTTP, so the tunnel is the controller's
+``/controller/store`` relay; the client falls back to it when the in-cluster
+store URL is unreachable. The e2e below round-trips kt.put/get using ONLY
+the controller URL."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu.config import config, reset_config
+
+pytestmark = pytest.mark.level("unit")
+
+
+class _Stack:
+    """Store app + controller app on real TCP ports (plain requests reaches
+    them, unlike aiohttp TestClient)."""
+
+    def __init__(self, tmp):
+        self.tmp = tmp
+        self.loop = asyncio.new_event_loop()
+        self.store_url = None
+        self.controller_url = None
+        self._started = threading.Event()
+
+    def start(self):
+        def run():
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_until_complete(self._setup())
+            self._started.set()
+            self.loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert self._started.wait(15)
+        return self
+
+    async def _setup(self):
+        from aiohttp import web
+
+        from kubetorch_tpu.controller.app import (ControllerState,
+                                                  create_controller_app)
+        from kubetorch_tpu.data_store.store_server import create_store_app
+
+        store_runner = web.AppRunner(create_store_app(str(self.tmp / "store")))
+        await store_runner.setup()
+        store_site = web.TCPSite(store_runner, "127.0.0.1", 0)
+        await store_site.start()
+        sport = store_site._server.sockets[0].getsockname()[1]
+        self.store_url = f"http://127.0.0.1:{sport}"
+
+        state = ControllerState()
+        state.cluster_config["data_store_url"] = self.store_url
+        ctl_runner = web.AppRunner(create_controller_app(state))
+        await ctl_runner.setup()
+        ctl_site = web.TCPSite(ctl_runner, "127.0.0.1", 0)
+        await ctl_site.start()
+        cport = ctl_site._server.sockets[0].getsockname()[1]
+        self.controller_url = f"http://127.0.0.1:{cport}"
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    s = _Stack(tmp_path).start()
+    yield s
+    s.stop()
+
+
+def test_put_get_through_controller_only(stack, monkeypatch):
+    """Direct store URL unreachable (the laptop case) → put/get round-trip
+    rides the controller relay."""
+    from kubetorch_tpu.data_store import commands
+
+    monkeypatch.setenv("KT_API_URL", stack.controller_url)
+    # the in-cluster DNS name never resolves from outside
+    monkeypatch.setenv("KT_DATA_STORE_URL", "http://127.0.0.1:9")  # closed port
+    reset_config()
+    commands._REACHABLE_CACHE.clear()
+    try:
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        commands.put("tunnel-test/x", arr)
+        out = commands.get("tunnel-test/x")
+        np.testing.assert_array_equal(np.asarray(out), arr)
+
+        used, expires = commands._REACHABLE_CACHE["http://127.0.0.1:9"]
+        assert used == f"{stack.controller_url}/controller/store"
+        assert expires is not None   # tunnel verdicts expire (recovery path)
+    finally:
+        reset_config()
+        commands._REACHABLE_CACHE.clear()
+
+
+def test_direct_store_stays_direct(stack, monkeypatch):
+    """In-cluster/local clients pass the probe and never pay the hop."""
+    from kubetorch_tpu.data_store import commands
+
+    monkeypatch.setenv("KT_DATA_STORE_URL", stack.store_url)
+    monkeypatch.delenv("KT_API_URL", raising=False)
+    reset_config()
+    commands._REACHABLE_CACHE.clear()
+    try:
+        assert commands._store_url() == stack.store_url
+        # a caller-NAMED store is never rerouted, reachable or not
+        assert commands._store_url("http://127.0.0.1:9") == "http://127.0.0.1:9"
+    finally:
+        reset_config()
+        commands._REACHABLE_CACHE.clear()
+
+
+def test_tunnel_code_push(stack, monkeypatch, tmp_path):
+    """Code sync (the 1-2s loop) also works from outside: push_tree/pull_tree
+    against the relay URL."""
+    from kubetorch_tpu.data_store.sync import pull_tree, push_tree
+
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "main.py").write_text("print('hi')\n")
+    (src / "pkg").mkdir()
+    (src / "pkg" / "__init__.py").write_text("")
+
+    tunnel = f"{stack.controller_url}/controller/store"
+    stats = push_tree(tunnel, "__code__/tunnel-proj", str(src))
+    assert stats["files"] == 2
+
+    dest = tmp_path / "out"
+    pull_tree(tunnel, "__code__/tunnel-proj", str(dest))
+    assert (dest / "main.py").read_text() == "print('hi')\n"

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
 from kubetorch_tpu.models.llama import _xla_attention
 from kubetorch_tpu.parallel.mesh import build_mesh
 from kubetorch_tpu.parallel.ulysses import ulysses_attention_sharded

@@ -50,6 +50,12 @@ def _pod_specs(manifest: Dict) -> List[Dict]:
         return [job.get("template", {}).get("spec", {})
                    .get("template", {}).get("spec", {})
                 for job in spec.get("replicatedJobs", [])]
+    if kind == "RayCluster":
+        head = [spec.get("headGroupSpec", {}).get("template", {})
+                    .get("spec", {})]
+        workers = [g.get("template", {}).get("spec", {})
+                   for g in spec.get("workerGroupSpecs", [])]
+        return head + workers
     # Deployment and Knative Service share spec.template.spec
     return [spec.get("template", {}).get("spec", {})]
 
@@ -261,7 +267,14 @@ class LocalBackend:
                 os.makedirs(self._volume_dir(namespace, name), exist_ok=True)
             self.objects[f"{kind}/{key}"] = manifest
             return {"kind": kind, "stored": True}
-        replicas = int(manifest.get("spec", {}).get("replicas", 1))
+        if kind == "RayCluster":
+            # head + workers; the KubeRay group structure maps to N local
+            # subprocess pods like any other workload
+            replicas = 1 + sum(
+                int(g.get("replicas", 0)) for g in
+                manifest.get("spec", {}).get("workerGroupSpecs", []))
+        else:
+            replicas = int(manifest.get("spec", {}).get("replicas", 1))
         ips = self._next_ips(key, replicas)
 
         # slot-indexed reconciliation: pod i owns ips[i]; dead or surplus
@@ -359,6 +372,7 @@ class KubernetesBackend:
         "Deployment": "deployment",
         "JobSet": "jobsets.jobset.x-k8s.io",
         "KnativeService": "services.serving.knative.dev",
+        "RayCluster": "rayclusters.ray.io",
         "Secret": "secret",
         "PersistentVolumeClaim": "pvc",
         "ConfigMap": "configmap",

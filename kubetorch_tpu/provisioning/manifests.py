@@ -260,6 +260,48 @@ def build_jobset_manifest(name: str, namespace: str, tpu: TpuSlice,
     }
 
 
+def build_raycluster_manifest(name: str, namespace: str, replicas: int,
+                              pod_spec: Dict[str, Any],
+                              username: Optional[str] = None,
+                              annotations: Optional[Dict[str, str]] = None
+                              ) -> Dict[str, Any]:
+    """KubeRay RayCluster (reference ``build_raycluster_manifest``,
+    provisioning/utils.py:542): one head group + ``replicas - 1`` workers,
+    all running the kt pod server so the deploy/reload/log plane works
+    identically — the Ray supervisor inside the pods forms the Ray cluster
+    (``serving/ray_supervisor.py``), with head discovery via the headless
+    service like the SPMD path."""
+    labels = _labels(name, username)
+    head_spec = copy.deepcopy(pod_spec)
+    worker_spec = copy.deepcopy(pod_spec)
+    for spec, role in ((head_spec, "head"), (worker_spec, "worker")):
+        for container in spec.get("containers", []):
+            container.setdefault("env", []).append(
+                {"name": "KT_RAY_ROLE", "value": role})
+    return {
+        "apiVersion": "ray.io/v1",
+        "kind": "RayCluster",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels,
+                     "annotations": annotations or {}},
+        "spec": {
+            "headGroupSpec": {
+                "rayStartParams": {"dashboard-host": "0.0.0.0"},
+                "template": {"metadata": {"labels": labels},
+                             "spec": head_spec},
+            },
+            "workerGroupSpecs": [{
+                "groupName": "workers",
+                "replicas": max(0, replicas - 1),
+                "minReplicas": max(0, replicas - 1),
+                "maxReplicas": max(0, replicas - 1),
+                "rayStartParams": {},
+                "template": {"metadata": {"labels": labels},
+                             "spec": worker_spec},
+            }],
+        },
+    }
+
+
 def nested_merge(base: Dict, override: Dict) -> Dict:
     """Deep-merge override into base (reference provisioning/utils.py:200)."""
     out = copy.deepcopy(base)

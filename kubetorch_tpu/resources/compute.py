@@ -221,7 +221,13 @@ class Compute:
         if self.autoscaling is not None:
             return "knative"
         if self.tpu is not None and self.tpu.num_hosts > 1:
+            # checked BEFORE ray: a multi-host slice cannot give up JobSet's
+            # atomic co-scheduling/exclusive-topology placement — the Ray
+            # supervisor still forms its cluster inside the JobSet pods
             return "jobset"
+        if (self.distributed is not None
+                and self.distributed.distribution_type == "ray"):
+            return "raycluster"             # KubeRay provisions head+workers
         return "deployment"
 
     # -- manifest -------------------------------------------------------------
@@ -260,6 +266,11 @@ class Compute:
             from ..provisioning.manifests import build_jobset_manifest
             return build_jobset_manifest(name, self.namespace, self.tpu,
                                          pod_spec, username=config().username)
+        if mode == "raycluster":
+            from ..provisioning.manifests import build_raycluster_manifest
+            return build_raycluster_manifest(
+                name, self.namespace, self.replicas, pod_spec,
+                username=config().username)
         annotations = {}
         if self.inactivity_ttl:
             annotations["kubetorch.com/inactivity-ttl"] = str(self.inactivity_ttl)

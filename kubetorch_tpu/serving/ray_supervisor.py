@@ -33,13 +33,27 @@ class RaySupervisor(DistributedSupervisor):
         return 1  # user code runs on the head only
 
     def setup(self) -> None:
+        import os
+
         if shutil.which("ray") is None:
             raise RuntimeError(
                 "distribution_type='ray' requires ray in the image: "
                 "Image().pip_install(['ray'])")
         ips = sorted(self.discover() or [my_pod_ip()])
-        head_ip = ips[0]
-        self._is_head = my_pod_ip() == head_ip or len(ips) == 1
+        role = os.environ.get("KT_RAY_ROLE")
+        if role:
+            # KubeRay provisioning (build_raycluster_manifest) designates
+            # head/worker per group — runtime must honor it, not re-elect:
+            # the headGroupSpec pod is where KubeRay routes dashboard/GCS.
+            # Workers find the head by probing for the live GCS (its IP has
+            # no fixed rank in the discovered set).
+            self._is_head = role == "head"
+            head_ip = (my_pod_ip() if self._is_head
+                       else self._find_gcs(ips))
+        else:
+            # homogeneous pods (Deployment/JobSet path): elect by lowest IP
+            head_ip = ips[0]
+            self._is_head = my_pod_ip() == head_ip or len(ips) == 1
         if self._is_head:
             self._ray_proc = subprocess.Popen(
                 ["ray", "start", "--head", "--port", str(GCS_PORT),
@@ -54,6 +68,18 @@ class RaySupervisor(DistributedSupervisor):
             # workers host Ray worker processes only; no callable pool
             self.pool = None
         # Ray owns membership; no DNS monitor (reference :126-129)
+
+    @staticmethod
+    def _find_gcs(ips, timeout: float = 120.0) -> str:
+        """The head's GCS is the one answering :6379 — workers poll until it
+        comes up (head and workers start concurrently)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for ip in ips:
+                if wait_for_port(ip, GCS_PORT, timeout=0.5):
+                    return ip
+            time.sleep(1.0)
+        raise RuntimeError(f"no Ray GCS found on {ips} within {timeout}s")
 
     def cleanup(self) -> None:
         # User-code Ray state lives in the rank subprocess; its shutdown op

@@ -65,6 +65,10 @@ def _expected_pods(manifest) -> int:
         jobs = spec.get("replicatedJobs", [{}])
         return int(jobs[0].get("template", {}).get("spec", {})
                    .get("parallelism", 1))
+    if kind == "RayCluster":
+        workers = sum(int(g.get("replicas", 0))
+                      for g in spec.get("workerGroupSpecs", []))
+        return 1 + workers
     return 1
 
 
@@ -108,6 +112,7 @@ def main(argv):
         _record(d, {"cmd": argv})
         base = resource.split(".", 1)[0].rstrip("s").capitalize()
         kind = {"Deployment": "Deployment", "Jobset": "JobSet",
+                "Raycluster": "RayCluster",
                 "Service": "Service", "Pvc": "PersistentVolumeClaim",
                 "Secret": "Secret", "Configmap": "ConfigMap"}.get(base, base)
         manifest = state.get(f"{kind}/{ns}/{name}")
@@ -123,7 +128,7 @@ def main(argv):
         selector = _flag(argv, "-l", "")
         service = selector.split("=", 1)[1] if "=" in selector else ""
         ips = []
-        for kind in ("Deployment", "JobSet", "Service"):
+        for kind in ("Deployment", "JobSet", "RayCluster", "Service"):
             manifest = state.get(f"{kind}/{ns}/{service}")
             if manifest is not None and kind != "Service":
                 ips = [f"10.77.0.{i + 1}"
@@ -140,6 +145,7 @@ def main(argv):
         _record(d, {"cmd": argv})
         base = resource.split(".", 1)[0].rstrip("s").capitalize()
         kind = {"Deployment": "Deployment", "Jobset": "JobSet",
+                "Raycluster": "RayCluster",
                 "Service": "Service", "Pvc": "PersistentVolumeClaim",
                 "Secret": "Secret", "Configmap": "ConfigMap"}.get(base, base)
         if resource.startswith("services.serving.knative"):

@@ -181,3 +181,33 @@ async def _controller_deploy_flow(shim):
         resp = await client.delete("/controller/workload/default/svc-a")
         assert (await resp.json())["ok"]
         assert "Deployment/default/svc-a" not in _state(shim)
+
+
+def test_raycluster_round_trip(shim):
+    """A ray-distributed Compute deploys as a KubeRay RayCluster: head +
+    workers both run the kt server (env injected into every group), pod
+    count spans the groups, and teardown sweeps rayclusters.ray.io
+    (reference build_raycluster_manifest, provisioning/utils.py:542)."""
+    import kubetorch_tpu as kt
+
+    compute = kt.Compute(cpus=1).distribute("ray", workers=3)
+    assert compute.deployment_mode == "raycluster"
+    manifest = compute.manifest("rayjob", env={})
+    assert manifest["kind"] == "RayCluster"
+    assert manifest["spec"]["workerGroupSpecs"][0]["replicas"] == 2  # 3 - head
+
+    be = _backend()
+    out = be.apply("ns1", "rayjob", manifest, {"KT_SERVICE_NAME": "rayjob"})
+    assert len(out["pod_ips"]) == 3
+
+    stored = _state(shim)["RayCluster/ns1/rayjob"]
+    for group_spec in ([stored["spec"]["headGroupSpec"]["template"]["spec"]]
+                       + [g["template"]["spec"]
+                          for g in stored["spec"]["workerGroupSpecs"]]):
+        env_names = {e["name"] for e in group_spec["containers"][0]["env"]}
+        assert "KT_SERVICE_NAME" in env_names      # injected into EVERY group
+        assert "KT_CONTROLLER_WS_URL" in env_names
+        assert "KT_RAY_ROLE" in env_names
+
+    assert be.delete("ns1", "rayjob") is True
+    assert "RayCluster/ns1/rayjob" not in _state(shim)

@@ -28,6 +28,7 @@ import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 LOG_SPILL_MAX_BYTES = 20 * 1024 * 1024   # per service, per generation
+LOG_SPILL_GENERATIONS = 4                # retention ceiling = gens × max_bytes
 EVENTS_MAX_BYTES = 4 * 1024 * 1024
 
 
@@ -68,6 +69,19 @@ class DiskPersister:
         self.logs_dir = os.path.join(root, "logs")
         os.makedirs(self.workloads_dir, exist_ok=True)
         os.makedirs(self.logs_dir, exist_ok=True)
+        # Epoch boundary: seqs are process-local (restore() re-sequences),
+        # so entries persisted by a PREVIOUS controller process live in an
+        # incompatible seq space. A marker line appended to each existing
+        # log at startup lets read_service_logs serve only current-process
+        # entries — mixing spaces would hand followers duplicated
+        # pre-restart lines and then a poisoned (too-high) cursor.
+        for fname in os.listdir(self.logs_dir):
+            if fname.endswith(".jsonl"):
+                try:
+                    with open(os.path.join(self.logs_dir, fname), "a") as f:
+                        f.write(json.dumps({"__kt_epoch__": True}) + "\n")
+                except OSError:
+                    pass
         self._q: queue.Queue = queue.Queue()
         self._writer = threading.Thread(target=self._drain, daemon=True,
                                         name="kt-persist-writer")
@@ -173,6 +187,18 @@ class DiskPersister:
     def append_logs(self, service_key: str, entries: List[Dict]) -> None:
         self._q.put(("logs", (service_key, entries)))
 
+    def _generation_paths(self, service_key: str) -> List[str]:
+        """Existing spill files for a service, OLDEST first: .N … .1, then
+        the active file."""
+        path = self._log_path(service_key)
+        gens = []
+        for n in range(LOG_SPILL_GENERATIONS, 0, -1):
+            if os.path.exists(f"{path}.{n}"):
+                gens.append(f"{path}.{n}")
+        if os.path.exists(path):
+            gens.append(path)
+        return gens
+
     def _write_logs(self, service_key: str, entries: List[Dict]) -> None:
         path = self._log_path(service_key)
         os.makedirs(self.logs_dir, exist_ok=True)
@@ -180,26 +206,108 @@ class DiskPersister:
             for e in entries:
                 f.write(json.dumps(_clean(e)) + "\n")
         if os.path.getsize(path) > LOG_SPILL_MAX_BYTES:
-            os.replace(path, path + ".1")   # keep one previous generation
+            # shift .N-1→.N … .1→.2, active→.1: keeping several generations
+            # (not one — a single .1 was clobbered on every rotation, losing
+            # exactly the lines a slow follower needs). The oldest falls off
+            # the end: that, times LOG_SPILL_MAX_BYTES, is the explicit
+            # per-service retention ceiling; Loki (deploy/loki.yaml) is the
+            # unbounded-history story.
+            for n in range(LOG_SPILL_GENERATIONS - 1, 0, -1):
+                if os.path.exists(f"{path}.{n}"):
+                    os.replace(f"{path}.{n}", f"{path}.{n + 1}")
+            os.replace(path, path + ".1")
+
+    @staticmethod
+    def _tail_entry(path: str) -> Optional[Dict[str, Any]]:
+        """Last parseable line of a spill file, read from the tail only."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 8192))
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        for raw in reversed(lines):
+            try:
+                return json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+        return None
+
+    def read_service_logs(self, service_key: str, since: int = 0,
+                          limit: int = 2000) -> List[Dict[str, Any]]:
+        """Entries with ``seq > since`` for one service from disk, spanning
+        every spill generation, oldest first — the fallback when a slow
+        follower's cursor predates the in-memory ring buffer (a chatty
+        multi-rank job evicts 5000 lines in seconds).
+
+        Only entries written AFTER the last epoch marker count: earlier ones
+        came from a previous controller process whose seqs are meaningless
+        here (see ``__init__``). The marker is located FIRST (a raw string
+        scan, no json), so the skip/limit fast paths below can never leak a
+        past life into the page: generations wholly behind the marker are
+        never opened, generations whose tail seq already trails the cursor
+        are skipped unparsed (each can be 20MB), and collection stops at
+        ``limit`` — generations are chronological, so everything later is
+        only newer than what a page needs."""
+        paths = self._generation_paths(service_key)
+        marker_path, marker_line = -1, -1
+        for pi, p in enumerate(paths):
+            try:
+                with open(p) as f:
+                    for li, raw in enumerate(f):
+                        if '"__kt_epoch__"' in raw:
+                            marker_path, marker_line = pi, li
+            except OSError:
+                continue
+        out: List[Dict[str, Any]] = []
+        for pi, p in enumerate(paths):
+            if pi < marker_path:
+                continue
+            if pi > marker_path and not out:
+                tail = self._tail_entry(p)
+                if (tail is not None and "__kt_epoch__" not in tail
+                        and tail.get("seq", 0) <= since):
+                    continue
+            try:
+                with open(p) as f:
+                    for li, raw in enumerate(f):
+                        if pi == marker_path and li <= marker_line:
+                            continue
+                        try:
+                            e = json.loads(raw)
+                        except json.JSONDecodeError:
+                            continue
+                        if "__kt_epoch__" in e:
+                            continue
+                        if e.get("seq", 0) > since:
+                            out.append(e)
+            except OSError:
+                continue
+            if len(out) >= limit:
+                break
+        return out[:limit]
 
     def load_logs(self, max_per_service: int = 5000) -> Iterator[
             tuple]:
         """Yield ``(service_key, entries)`` — the newest ``max_per_service``
         entries per service, oldest first, spanning the rotation."""
-        # derive the service set from both generations: rotation renames the
+        # derive the service set from every generation: rotation renames the
         # active file to .jsonl.1 leaving no .jsonl until the next append, so
         # a restart in that window must still find the service
         names = set()
         for fname in os.listdir(self.logs_dir):
             if fname.endswith(".jsonl"):
                 names.add(fname)
-            elif fname.endswith(".jsonl.1"):
-                names.add(fname[:-len(".1")])
+            else:
+                stem, _, suffix = fname.rpartition(".")
+                if stem.endswith(".jsonl") and suffix.isdigit():
+                    names.add(stem)
         for fname in sorted(names):
             service_key = fname[:-len(".jsonl")].replace("__", "/", 1)
-            path = os.path.join(self.logs_dir, fname)
             lines: List[str] = []
-            for p in (path + ".1", path):
+            for p in self._generation_paths(service_key):
                 try:
                     with open(p) as f:
                         lines.extend(f.readlines())
@@ -208,9 +316,11 @@ class DiskPersister:
             entries = []
             for line in lines[-max_per_service:]:
                 try:
-                    entries.append(json.loads(line))
+                    e = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if "__kt_epoch__" not in e:   # markers aren't log lines
+                    entries.append(e)
             if entries:
                 yield service_key, entries
 

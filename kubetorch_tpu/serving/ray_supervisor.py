@@ -49,7 +49,7 @@ class RaySupervisor(DistributedSupervisor):
             # no fixed rank in the discovered set).
             self._is_head = role == "head"
             head_ip = (my_pod_ip() if self._is_head
-                       else self._find_gcs(ips))
+                       else self._find_gcs(self.discover))
         else:
             # homogeneous pods (Deployment/JobSet path): elect by lowest IP
             head_ip = ips[0]
@@ -70,11 +70,16 @@ class RaySupervisor(DistributedSupervisor):
         # Ray owns membership; no DNS monitor (reference :126-129)
 
     @staticmethod
-    def _find_gcs(ips, timeout: float = 120.0) -> str:
+    def _find_gcs(discover, timeout: float = 120.0) -> str:
         """The head's GCS is the one answering :6379 — workers poll until it
-        comes up (head and workers start concurrently)."""
+        comes up. Discovery RE-RUNS every iteration: head and workers start
+        concurrently, and a worker that resolved DNS before the head's
+        headless-service record was published would otherwise probe a stale
+        snapshot for the whole timeout."""
         deadline = time.monotonic() + timeout
+        ips = []
         while time.monotonic() < deadline:
+            ips = sorted(discover() or [])
             for ip in ips:
                 if wait_for_port(ip, GCS_PORT, timeout=0.5):
                     return ip

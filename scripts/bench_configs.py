@@ -1,0 +1,275 @@
+"""Record numbers for ALL five BASELINE.md configs on whatever device is
+present (round-2 VERDICT weak #9: configs 2-5 were examples without recorded
+numbers).
+
+On the one-chip TPU (or CPU fallback) the full-scale models of
+``examples/*.py`` don't fit, so each config runs a scaled model with the
+SAME parallelism structure the example declares — dp mesh for config 2, FSDP
+for config 3, actor/learner round-trips for config 4, expert-parallel MoE
+for config 5. Emits one JSON line per config; ``scripts/bench_configs.py
+--out BENCH_CONFIGS.md`` appends a dated markdown row per config.
+
+Run CPU (8 virtual devices):
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_configs.py
+Run TPU: plain ``python scripts/bench_configs.py`` (never timeout-kill it).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python scripts/bench_configs.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _device():
+    import jax
+    d = jax.devices()[0]
+    return getattr(d, "device_kind", d.platform), jax.device_count()
+
+
+def config1_mnist_mlp(steps=60):
+    """Config 1: MNIST MLP single-process."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.mlp import MlpConfig, mlp_init, mlp_loss
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg = MlpConfig(in_dim=784, hidden=(256, 256), out_dim=10)
+    state = init_train_state(mlp_init(jax.random.PRNGKey(0), cfg),
+                             optax.adam(1e-3))
+    step = make_train_step(lambda p, x, y: mlp_loss(p, x, y, cfg),
+                           optimizer=optax.adam(1e-3))
+    batch = 128
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 784))
+    y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 10)
+    b = {"tokens": x, "targets": y}
+    state, m = step(state, b)            # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return {"metric": "samples_per_sec", "value": steps * batch / dt}
+
+
+def config2_resnet_dp(steps=8):
+    """Config 2: ResNet data-parallel over the device mesh (the example's
+    structure at CI scale: smaller stage widths, 64px images)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubetorch_tpu.models.resnet import ResNet, ResNetBlock, resnet_loss
+    from kubetorch_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": jax.device_count()})
+    model = ResNet(stage_sizes=[1, 1, 1, 1], block_cls=ResNetBlock,
+                   num_filters=16, num_classes=100)
+    per_dev = 4
+    batch = per_dev * jax.device_count()
+    images = jax.random.normal(jax.random.PRNGKey(0), (batch, 64, 64, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 100)
+    # init with train=True so BatchNorm materializes batch_stats; the bench
+    # step then runs in inference-norm mode against those stats
+    variables = model.init(jax.random.PRNGKey(2), images[:2], train=True)
+    batch_stats = variables.get("batch_stats", {})
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(variables["params"])
+    sharding = NamedSharding(mesh, P("data"))   # shard dim 0, rank-agnostic
+    images = jax.device_put(images, sharding)
+    labels = jax.device_put(labels, sharding)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            return resnet_loss(model.apply,
+                               {"params": p, "batch_stats": batch_stats},
+                               images, labels, train=False)
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = variables["params"]
+    params, opt_state, loss = step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "images_per_sec", "value": steps * batch / dt,
+            "mesh": {"data": jax.device_count()}}
+
+
+def config3_llama_fsdp(steps=6):
+    """Config 3: Llama FSDP/SPMD (tiny config, the bench.py model at the
+    mesh-parallel structure of examples/llama_pretrain.py)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import LLAMA_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    mesh = build_mesh({"fsdp": jax.device_count()})
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    opt = optax.adamw(3e-4)
+    state = init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt)
+    step = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg),
+                           optimizer=opt, mesh=mesh, rules=LLAMA_RULES)
+    state = step.shard_state(state)
+    batch, seq = 8, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    b = {"tokens": jax.device_put(tokens, step.batch_sharding),
+         "targets": jax.device_put(jnp.roll(tokens, -1, 1),
+                                   step.batch_sharding)}
+    state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return {"metric": "tokens_per_sec", "value": steps * batch * seq / dt,
+            "mesh": {"fsdp": jax.device_count()}}
+
+
+def config4_rlhf_actor_learner(rounds=20):
+    """Config 4: PPO-style actor/learner round-trips IN-PROCESS (the pod
+    fabric is measured by the e2e suite; this records the compute loop:
+    rollout logits → advantage-weighted update)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.mlp import MlpConfig, mlp_forward, mlp_init
+
+    cfg = MlpConfig(in_dim=32, hidden=(64, 64), out_dim=8)
+    params = mlp_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(3e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def rollout(params, key):
+        obs = jax.random.normal(key, (64, 32))
+        logits = mlp_forward(params, obs, cfg)
+        actions = jnp.argmax(logits, -1)
+        reward = (actions == 3).astype(jnp.float32)  # toy objective
+        return obs, actions, reward
+
+    @jax.jit
+    def update(params, opt_state, obs, actions, reward):
+        def loss_fn(p):
+            logits = mlp_forward(p, obs, cfg)
+            logp = jax.nn.log_softmax(logits)
+            picked = jnp.take_along_axis(logp, actions[:, None], 1)[:, 0]
+            adv = reward - reward.mean()
+            return -(picked * adv).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    obs, actions, reward = rollout(params, key)
+    params, opt_state, loss = update(params, opt_state, obs, actions, reward)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(rounds):
+        key, sub = jax.random.split(key)
+        obs, actions, reward = rollout(params, sub)
+        params, opt_state, loss = update(params, opt_state, obs, actions,
+                                         reward)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "ppo_rounds_per_sec", "value": rounds / dt}
+
+
+def config5_moe_expert_parallel(steps=5):
+    """Config 5: MoE expert-parallel (tiny Mixtral-structure config on an
+    expert mesh axis, per examples/mixtral_expert_parallel.py)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.moe import MoeConfig, moe_init, moe_loss
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import MOE_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    n_dev = jax.device_count()
+    expert_axis = min(4, n_dev)
+    mesh = build_mesh({"fsdp": n_dev // expert_axis, "expert": expert_axis})
+    cfg = MoeConfig.tiny(n_experts=max(4, expert_axis))
+    opt = optax.adamw(1e-4)
+    state = init_train_state(moe_init(jax.random.PRNGKey(0), cfg), opt)
+    step = make_train_step(lambda p, t, y: moe_loss(p, t, y, cfg),
+                           optimizer=opt, mesh=mesh, rules=MOE_RULES)
+    state = step.shard_state(state)
+    batch, seq = 8, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    b = {"tokens": jax.device_put(tokens, step.batch_sharding),
+         "targets": jax.device_put(jnp.roll(tokens, -1, 1),
+                                   step.batch_sharding)}
+    state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return {"metric": "tokens_per_sec", "value": steps * batch * seq / dt,
+            "mesh": {"fsdp": n_dev // expert_axis, "expert": expert_axis}}
+
+
+CONFIGS = [
+    ("config1_mnist_mlp", config1_mnist_mlp),
+    ("config2_resnet_dp", config2_resnet_dp),
+    ("config3_llama_fsdp", config3_llama_fsdp),
+    ("config4_rlhf_actor_learner", config4_rlhf_actor_learner),
+    ("config5_moe_expert_parallel", config5_moe_expert_parallel),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="append markdown rows to this file")
+    args = ap.parse_args()
+
+    kind, n = _device()
+    rows = []
+    for name, fn in CONFIGS:
+        try:
+            r = fn()
+            r.update({"config": name, "device": kind, "n_devices": n})
+        except Exception as e:  # noqa: BLE001
+            r = {"config": name, "device": kind, "error": str(e)[:300]}
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+
+    if args.out:
+        stamp = time.strftime("%Y-%m-%d")
+        with open(args.out, "a") as f:
+            for r in rows:
+                f.write(f"| {stamp} | {r['config']} | {r['device']}×"
+                        f"{r.get('n_devices', '?')} | {r.get('metric', '—')} "
+                        f"| {round(r['value'], 1) if 'value' in r else r.get('error', '—')} "
+                        f"| {json.dumps(r.get('mesh')) if r.get('mesh') else '—'} |\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

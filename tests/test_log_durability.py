@@ -1,0 +1,128 @@
+"""Log durability under load (round-2 VERDICT weak #6).
+
+A chatty multi-rank job evicts the 5000-entry ring buffer in seconds; a slow
+follower's cursor must still be serviceable from the persister's spill
+files, across the rotation boundary.
+"""
+
+import asyncio
+
+import pytest
+
+from kubetorch_tpu.controller import persistence
+from kubetorch_tpu.controller.app import ControllerState, create_controller_app
+
+pytestmark = pytest.mark.level("unit")
+
+TOTAL = 8000          # > LOG_BUFFER_PER_SERVICE (5000), forces eviction
+BATCH = 250
+
+
+def test_slow_follower_reads_evicted_lines_from_disk(tmp_path, monkeypatch):
+    # small spill threshold so the run crosses several rotations; enough
+    # generations that the retention ceiling isn't hit mid-test
+    monkeypatch.setattr(persistence, "LOG_SPILL_MAX_BYTES", 64 * 1024)
+    monkeypatch.setattr(persistence, "LOG_SPILL_GENERATIONS", 16)
+
+    async def body():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        state = ControllerState(state_dir=str(tmp_path))
+        async with TestClient(TestServer(create_controller_app(state))) as c:
+            for start in range(0, TOTAL, BATCH):
+                r = await c.post("/controller/logs", json={"entries": [
+                    {"namespace": "ns", "service": "train",
+                     "line": f"rank0 step {i}", "ts": 1.0 + i}
+                    for i in range(start, start + BATCH)]})
+                assert r.status == 200
+
+            # the buffer only holds the newest 5000
+            assert len(state.logs["ns/train"]) == 5000
+
+            # a follower starting from 0 pages EVERYTHING back, in order
+            got, cursor = [], 0
+            while True:
+                resp = await (await c.get(
+                    "/controller/logs",
+                    params={"service": "train", "namespace": "ns",
+                            "since": cursor})).json()
+                if not resp["entries"]:
+                    break
+                got.extend(resp["entries"])
+                cursor = resp["offset"]
+            assert len(got) == TOTAL, f"lost {TOTAL - len(got)} lines"
+            seqs = [e["seq"] for e in got]
+            assert seqs == sorted(seqs) and len(set(seqs)) == TOTAL
+            assert got[0]["line"] == "rank0 step 0"      # pre-eviction line
+            assert got[-1]["line"] == f"rank0 step {TOTAL - 1}"
+
+            # rotation actually happened under this load
+            import os
+            spill = [f for f in os.listdir(tmp_path / "logs")
+                     if f.endswith(".jsonl.1")]
+            assert spill, "expected a rotated spill generation"
+
+            # a fresh follower near the head stays on the fast path
+            tail = await (await c.get(
+                "/controller/logs",
+                params={"service": "train", "namespace": "ns",
+                        "since": seqs[-10]})).json()
+            assert len(tail["entries"]) == 9
+
+        state.persister.close()
+
+    asyncio.run(body())
+
+
+def test_restart_does_not_mix_seq_spaces(tmp_path, monkeypatch):
+    """Spill files keep pre-restart seqs while restore() re-sequences from 1
+    — the disk fallback must serve only current-process entries or a
+    follower gets duplicated old lines and a poisoned cursor."""
+    monkeypatch.setattr(persistence, "LOG_SPILL_MAX_BYTES", 64 * 1024)
+    monkeypatch.setattr(persistence, "LOG_SPILL_GENERATIONS", 16)
+
+    async def ingest(client, start, n):
+        r = await client.post("/controller/logs", json={"entries": [
+            {"namespace": "ns", "service": "train",
+             "line": f"line {i}", "ts": 1.0 + i}
+            for i in range(start, start + n)]})
+        assert r.status == 200
+
+    async def body():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        # process A: 7000 lines (seqs 1..7000, most spilled to disk)
+        state_a = ControllerState(state_dir=str(tmp_path))
+        async with TestClient(TestServer(create_controller_app(state_a))) as c:
+            for start in range(0, 7000, 500):
+                await ingest(c, start, 500)
+        state_a.persister.close()
+
+        # process B: restore (re-sequenced buffer; idempotent vs the app
+        # startup hook's own restore), ingest 100 more
+        state_b = ControllerState(state_dir=str(tmp_path))
+        state_b.restore()
+        assert state_b.logs["ns/train"][0]["seq"] == 1   # re-sequenced
+        async with TestClient(TestServer(create_controller_app(state_b))) as c:
+            await ingest(c, 7000, 100)
+
+            got, cursor = [], 0
+            for _ in range(50):
+                resp = await (await c.get(
+                    "/controller/logs",
+                    params={"service": "train", "namespace": "ns",
+                            "since": cursor})).json()
+                if not resp["entries"]:
+                    break
+                got.extend(resp["entries"])
+                cursor = resp["offset"]
+            seqs = [e["seq"] for e in got]
+            # strictly increasing, no duplicates, and the follower reaches
+            # the newest line (cursor never poisoned by a stale high seq)
+            assert seqs == sorted(set(seqs))
+            assert got[-1]["line"] == "line 7099"
+            lines = [e["line"] for e in got]
+            assert len(lines) == len(set(lines)), "duplicated lines"
+        state_b.persister.close()
+
+    asyncio.run(body())

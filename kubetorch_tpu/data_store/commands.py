@@ -226,6 +226,7 @@ class _RoutedFetcher:
         self.enabled = (bool(os.environ.get("POD_IP"))
                         if peer is None else bool(peer))
         self.peer_url: Optional[str] = None
+        self.peer_blob_url: Optional[str] = None   # parent's ktblobd, if any
         self._resolved = False
         self._fetched = False
         self._deadline: Optional[float] = None
@@ -247,6 +248,16 @@ class _RoutedFetcher:
         from ..constants import server_port
         return f"http://{ip}:{server_port()}"
 
+    @staticmethod
+    def _self_blob_url() -> Optional[str]:
+        """This pod's ktblobd address (the pod server spawns the daemon and
+        exports KT_BLOBD_PORT for rank workers)."""
+        ip = os.environ.get("POD_IP")
+        port = os.environ.get("KT_BLOBD_PORT")
+        if ip and port:
+            return f"http://{ip}:{port}"
+        return None
+
     def _resolve(self) -> None:
         if self._resolved or not self.enabled:
             return
@@ -254,10 +265,12 @@ class _RoutedFetcher:
         try:
             r = self.sess.post(f"{self.store_url}/route",
                                json={"key": self.key,
-                                     "self_url": self._self_url()},
+                                     "self_url": self._self_url(),
+                                     "self_blob_url": self._self_blob_url()},
                                timeout=10)
             if r.status_code == 200 and r.json().get("source") == "peer":
                 self.peer_url = r.json()["url"]
+                self.peer_blob_url = r.json().get("blob_url")
         except _requests.RequestException:
             self.peer_url = None
 
@@ -290,8 +303,7 @@ class _RoutedFetcher:
                     os.environ.get("KT_PEER_WAIT_S", "60"))
             while True:
                 try:
-                    r = self.sess.get(f"{self.peer_url}/_kt/data/{subkey}",
-                                      timeout=timeout)
+                    r = self._fetch_from_peer(subkey, timeout)
                 except _requests.RequestException:
                     self._report_failed()
                     self.peer_url = None
@@ -317,6 +329,37 @@ class _RoutedFetcher:
         if r.status_code == 200:
             self._cache(subkey, r)
         return r
+
+    def _fetch_from_peer(self, subkey: str, timeout: float):
+        """One peer attempt. Prefers the parent's ktblobd (native
+        epoll+sendfile daemon — bulk bytes never ride the parent's Python
+        event loop); the parent's pod-server route is the fallback and the
+        compatibility path for pods without the native build. A blobd
+        connection error only disables the FAST PATH — the parent itself is
+        judged by its pod-server route."""
+        if self.peer_blob_url is not None:
+            from .peer_cache import entry_hash
+            h = entry_hash(subkey)
+            try:
+                rb = self.sess.get(f"{self.peer_blob_url}/blob/{h}.bin",
+                                   timeout=timeout)
+                if rb.status_code == 200:
+                    rm = self.sess.get(f"{self.peer_blob_url}/blob/{h}.json",
+                                       timeout=30)
+                    if rm.status_code == 200:
+                        entry = json.loads(rm.content)
+                        if entry.get("key") == subkey:   # collision paranoia
+                            return _CachedResponse(rb.content,
+                                                   entry.get("meta", {}))
+                elif rb.status_code == 404:
+                    # same "not yet" semantics as the pod route: the parent
+                    # may still be fetching — let the caller's poll window
+                    # decide; don't hammer the python route too
+                    return rb
+            except (_requests.RequestException, ValueError):
+                self.peer_blob_url = None   # fast path off; parent still ok
+        return self.sess.get(f"{self.peer_url}/_kt/data/{subkey}",
+                             timeout=timeout)
 
     def _cache(self, subkey: str, r) -> None:
         if not self.enabled or self._self_url() is None:
@@ -349,7 +392,8 @@ class _RoutedFetcher:
             return
         try:
             self.sess.post(f"{self.store_url}/route/complete",
-                           json={"key": self.key, "url": self_url},
+                           json={"key": self.key, "url": self_url,
+                                 "blob_url": self._self_blob_url()},
                            timeout=10)
         except _requests.RequestException:
             pass

@@ -39,9 +39,15 @@ def cache_dir() -> Path:
     return d
 
 
+def entry_hash(key: str) -> str:
+    """Content-addressed entry name — ALSO the wire name ktblobd serves
+    (``GET /blob/<hash>.bin``), so fetchers compute it client-side and the
+    native daemon never needs to hash."""
+    return hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
+
+
 def _entry_paths(key: str) -> Tuple[Path, Path]:
-    h = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
-    base = cache_dir() / h
+    base = cache_dir() / entry_hash(key)
     return base.with_suffix(".bin"), base.with_suffix(".json")
 
 

@@ -282,11 +282,17 @@ async def route_get(request: web.Request) -> web.Response:
     candidates = [(m["children"], url) for url, m in group.members.items()
                   if m["children"] < ROUTE_FANOUT and url != self_url]
     if self_url and self_url not in group.members:
-        group.members[self_url] = {"children": 0, "ts": now}
+        group.members[self_url] = {"children": 0, "ts": now,
+                                   # ktblobd address: children stream bulk
+                                   # bytes from the native daemon when the
+                                   # parent runs one
+                                   "blob_url": body.get("self_blob_url")}
     if candidates:
         _, url = min(candidates)
-        group.members[url]["children"] += 1
-        return web.json_response({"source": "peer", "url": url})
+        member = group.members[url]
+        member["children"] += 1
+        return web.json_response({"source": "peer", "url": url,
+                                  "blob_url": member.get("blob_url")})
     return web.json_response({"source": "store"})
 
 
@@ -297,7 +303,10 @@ async def route_complete(request: web.Request) -> web.Response:
     body = await request.json()
     groups = _route_groups(st)
     group = groups.setdefault(body["key"], _RouteGroup())
-    group.members.setdefault(body["url"], {"children": 0})["ts"] = time.time()
+    member = group.members.setdefault(body["url"], {"children": 0})
+    member["ts"] = time.time()
+    if body.get("blob_url"):
+        member["blob_url"] = body["blob_url"]
     _gc_route_groups(groups)
     return web.json_response({"ok": True, "members": len(group.members)})
 

@@ -144,3 +144,30 @@ class ShmSegment:
 
     def __exit__(self, *exc):
         self.release()
+
+
+# -- ktblobd (native bulk-transfer daemon) ------------------------------------
+
+BLOBD_PATH = os.path.join(_DIR, "ktblobd")
+
+
+def blobd_available() -> bool:
+    return os.path.isfile(BLOBD_PATH) and os.access(BLOBD_PATH, os.X_OK)
+
+
+def spawn_blobd(root: str, host: str = "0.0.0.0", port: int = 0):
+    """Start ktblobd over ``root`` and return ``(Popen, bound_port)``, or
+    ``(None, None)`` when the binary isn't built — callers degrade to the
+    pure-Python peer route. The daemon prints ``PORT <n>`` once bound."""
+    import subprocess
+
+    if not blobd_available():
+        return None, None
+    proc = subprocess.Popen(
+        [BLOBD_PATH, "--root", root, "--host", host, "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("PORT "):
+        proc.terminate()
+        return None, None
+    return proc, int(line.split()[1])

@@ -1,0 +1,314 @@
+// ktblobd — native bulk-transfer daemon for the P2P broadcast fan-out.
+//
+// Role (reference PodDataServer, pod_data_server.py:668-745: a per-pod
+// native TCP server feeding the tree broadcast): serve this pod's peer
+// cache (data_store/peer_cache.py entries, content-named "<hex32>.bin" +
+// "<hex32>.json") to child pods WITHOUT touching the Python event loop —
+// an epoll state machine with sendfile(2), so a parent fanning a multi-GB
+// checkpoint out to 50 children never copies payload bytes through
+// userspace and never competes with the pod's aiohttp request handling.
+//
+// Protocol: a minimal HTTP/1.1 GET subset with keep-alive —
+//   GET /healthz            -> 200 "ok"
+//   GET /blob/<name>        -> 200 + Content-Length + file bytes
+// <name> must match ^[0-9a-f]{1,64}\.(bin|json)$ — content-hash names
+// only; anything else (traversal, absolute paths, query strings) is 400.
+//
+// Usage: ktblobd --root DIR [--host IP] [--port N]
+// With --port 0 the kernel picks; the bound port is printed as
+// "PORT <n>\n" on stdout so the spawning pod server can advertise it.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+
+namespace {
+
+constexpr int kMaxEvents = 128;
+constexpr size_t kMaxReqBytes = 8192;
+
+struct Conn {
+  int fd = -1;
+  std::string req;        // accumulating request bytes
+  // response state
+  std::string head;       // header bytes still to send
+  size_t head_off = 0;
+  int file_fd = -1;
+  off_t file_off = 0;
+  off_t file_len = 0;
+  bool close_after = false;
+};
+
+std::string g_root;
+
+void set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+bool valid_blob_name(const std::string& name) {
+  // ^[0-9a-f]{1,64}\.(bin|json)$ — no separators, no dots beyond the one
+  // extension, so no traversal is expressible
+  size_t dot = name.rfind('.');
+  if (dot == std::string::npos || dot == 0 || dot > 64) return false;
+  std::string ext = name.substr(dot + 1);
+  if (ext != "bin" && ext != "json") return false;
+  for (size_t i = 0; i < dot; i++) {
+    char c = name[i];
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'))) return false;
+  }
+  return true;
+}
+
+void queue_simple(Conn& c, int status, const char* text) {
+  char buf[256];
+  int body_len = (int)strlen(text);
+  snprintf(buf, sizeof(buf),
+           "HTTP/1.1 %d %s\r\nContent-Length: %d\r\n"
+           "Content-Type: text/plain\r\nConnection: %s\r\n\r\n%s",
+           status, status == 200 ? "OK" : (status == 404 ? "Not Found"
+                                                         : "Bad Request"),
+           body_len, c.close_after ? "close" : "keep-alive", text);
+  c.head.assign(buf);
+  c.head_off = 0;
+}
+
+// returns false if the connection should be dropped immediately
+bool handle_request(Conn& c, const std::string& line) {
+  // request line: METHOD SP PATH SP VERSION
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  std::string method = line.substr(0, sp1);
+  std::string path = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  if (method != "GET" && method != "HEAD") {
+    c.close_after = true;
+    queue_simple(c, 400, "only GET\n");
+    return true;
+  }
+  if (path == "/healthz") {
+    queue_simple(c, 200, "ok\n");
+    return true;
+  }
+  const std::string prefix = "/blob/";
+  if (path.compare(0, prefix.size(), prefix) != 0) {
+    queue_simple(c, 400, "unknown path\n");
+    return true;
+  }
+  std::string name = path.substr(prefix.size());
+  if (!valid_blob_name(name)) {
+    queue_simple(c, 400, "bad blob name\n");
+    return true;
+  }
+  std::string full = g_root + "/" + name;
+  int fd = open(full.c_str(), O_RDONLY);
+  if (fd < 0) {
+    queue_simple(c, 404, "no such blob\n");
+    return true;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode)) {
+    close(fd);
+    queue_simple(c, 404, "no such blob\n");
+    return true;
+  }
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "HTTP/1.1 200 OK\r\nContent-Length: %lld\r\n"
+           "Content-Type: application/octet-stream\r\n"
+           "Connection: keep-alive\r\n\r\n",
+           (long long)st.st_size);
+  c.head.assign(buf);
+  c.head_off = 0;
+  if (method == "GET") {
+    c.file_fd = fd;
+    c.file_off = 0;
+    c.file_len = st.st_size;
+  } else {
+    close(fd);
+  }
+  return true;
+}
+
+// drive pending writes; returns: 0 = done (back to reading), 1 = would
+// block (wait for EPOLLOUT), -1 = drop connection
+int pump_out(Conn& c) {
+  while (c.head_off < c.head.size()) {
+    ssize_t n = send(c.fd, c.head.data() + c.head_off,
+                     c.head.size() - c.head_off, MSG_NOSIGNAL);
+    if (n > 0) { c.head_off += (size_t)n; continue; }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 1;
+    return -1;
+  }
+  while (c.file_fd >= 0 && c.file_off < c.file_len) {
+    ssize_t n = sendfile(c.fd, c.file_fd, &c.file_off,
+                         (size_t)(c.file_len - c.file_off));
+    if (n > 0) continue;
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 1;
+    return -1;
+  }
+  if (c.file_fd >= 0) { close(c.file_fd); c.file_fd = -1; }
+  c.head.clear();
+  c.head_off = 0;
+  if (c.close_after) return -1;
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* root = nullptr;
+  const char* host = "0.0.0.0";
+  int port = 0;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--root")) root = argv[i + 1];
+    else if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+    else if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+  }
+  if (!root) {
+    fprintf(stderr, "usage: ktblobd --root DIR [--host IP] [--port N]\n");
+    return 2;
+  }
+  g_root = root;
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    fprintf(stderr, "ktblobd: bad host %s\n", host);
+    return 2;
+  }
+  if (bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("ktblobd: bind");
+    return 2;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, (sockaddr*)&addr, &alen);
+  if (listen(srv, 256) != 0) {
+    perror("ktblobd: listen");
+    return 2;
+  }
+  set_nonblock(srv);
+  printf("PORT %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = srv;
+  epoll_ctl(ep, EPOLL_CTL_ADD, srv, &ev);
+
+  std::unordered_map<int, Conn> conns;
+  epoll_event events[kMaxEvents];
+
+  auto drop = [&](int fd) {
+    auto it = conns.find(fd);
+    if (it != conns.end()) {
+      if (it->second.file_fd >= 0) close(it->second.file_fd);
+      conns.erase(it);
+    }
+    epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+  };
+  auto want_out = [&](int fd, bool out) {
+    epoll_event e{};
+    e.events = EPOLLIN | (out ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+    e.data.fd = fd;
+    epoll_ctl(ep, EPOLL_CTL_MOD, fd, &e);
+  };
+  // serve every complete request already buffered in c.req (pipelining:
+  // a later request's bytes may arrive in the same read as an earlier
+  // one's, and EPOLLIN never re-fires for them). -1 drop, 1 wait EPOLLOUT,
+  // 0 idle.
+  auto serve_buffered = [&](Conn& c, int fd) -> int {
+    size_t end;
+    while (c.head.empty() && c.file_fd < 0 &&
+           (end = c.req.find("\r\n\r\n")) != std::string::npos) {
+      std::string line = c.req.substr(0, c.req.find("\r\n"));
+      c.req.erase(0, end + 4);
+      if (!handle_request(c, line)) return -1;
+      int st = pump_out(c);
+      if (st < 0) return -1;
+      if (st == 1) { want_out(fd, true); return 1; }
+    }
+    return 0;
+  };
+
+  for (;;) {
+    int n = epoll_wait(ep, events, kMaxEvents, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("ktblobd: epoll_wait");
+      return 1;
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      if (fd == srv) {
+        for (;;) {
+          int cl = accept(srv, nullptr, nullptr);
+          if (cl < 0) break;
+          set_nonblock(cl);
+          setsockopt(cl, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event e{};
+          e.events = EPOLLIN;
+          e.data.fd = cl;
+          epoll_ctl(ep, EPOLL_CTL_ADD, cl, &e);
+          conns[cl].fd = cl;
+        }
+        continue;
+      }
+      auto it = conns.find(fd);
+      if (it == conns.end()) continue;
+      Conn& c = it->second;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+        drop(fd);
+        continue;
+      }
+      bool dead = false;
+      if (events[i].events & EPOLLIN) {
+        char buf[4096];
+        for (;;) {
+          ssize_t r = recv(fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            c.req.append(buf, (size_t)r);
+            if (c.req.size() > kMaxReqBytes) { dead = true; break; }
+            continue;
+          }
+          if (r == 0) { dead = true; }
+          break;  // EAGAIN or closed
+        }
+        if (!dead && serve_buffered(c, fd) < 0) dead = true;
+      }
+      if (!dead && (events[i].events & EPOLLOUT)) {
+        int st = pump_out(c);
+        if (st < 0) {
+          dead = true;
+        } else if (st == 0) {
+          // response fully flushed — serve any request that was already
+          // buffered behind it before going back to read-only polling
+          int sb = serve_buffered(c, fd);
+          if (sb < 0) dead = true;
+          else if (sb == 0) want_out(fd, false);
+        }
+      }
+      if (dead) drop(fd);
+    }
+  }
+}

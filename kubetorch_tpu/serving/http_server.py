@@ -80,6 +80,7 @@ class ServerState:
         self.metrics_pusher = None
         self.controller_ws = None
         self.app_process = None
+        self.blobd_proc = None
 
     # -- metadata / supervisor ------------------------------------------------
 
@@ -563,6 +564,21 @@ async def _on_startup(app: web.Application) -> None:
             gateway_url=os.environ["KT_METRICS_GATEWAY_URL"], state=state)
         state.metrics_pusher.start()
 
+    # native bulk-transfer daemon (reference PodDataServer role): serves the
+    # peer cache over epoll+sendfile so fan-out bulk bytes never ride the
+    # Python event loop. Children learn the port via the store's /route
+    # registry; rank workers inherit KT_BLOBD_PORT for their registrations.
+    # Pod-only (POD_IP): without an advertisable address the fetchers can
+    # never route to it, and an unadvertised 0.0.0.0 listener is pure risk.
+    if os.environ.get("POD_IP"):
+        from ..native import spawn_blobd
+        from ..data_store.peer_cache import cache_dir
+        proc, port = spawn_blobd(str(cache_dir()),
+                                 host=os.environ["POD_IP"])
+        if port is not None:
+            state.blobd_proc = proc
+            os.environ["KT_BLOBD_PORT"] = str(port)
+
     # controller WebSocket (metadata + reload push)
     ws_url = os.environ.get("KT_CONTROLLER_WS_URL")
     if ws_url:
@@ -608,6 +624,8 @@ async def _on_cleanup(app: web.Application) -> None:
     from .remote_worker_pool import RemoteWorkerPool
     if RemoteWorkerPool._instance is not None:
         await RemoteWorkerPool._instance.close()
+    if state.blobd_proc is not None and state.blobd_proc.poll() is None:
+        state.blobd_proc.terminate()
 
 
 def main(argv: Optional[list] = None) -> None:

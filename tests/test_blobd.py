@@ -1,0 +1,165 @@
+"""ktblobd — native bulk-transfer daemon (round-2 VERDICT partial #56).
+
+Reference analog: the PodDataServer native TCP daemon feeding the tree
+broadcast (``pod_data_server.py:668-745``). Here: C++ epoll+sendfile over
+the peer cache (``native/ktblobd.cpp``), spawned by the pod server, used as
+the fast path by ``_RoutedFetcher`` with the pure-Python pod route as
+fallback.
+"""
+
+import concurrent.futures
+import json
+import os
+import socket
+import subprocess
+
+import pytest
+import requests
+
+from kubetorch_tpu.native import BLOBD_PATH, blobd_available, spawn_blobd
+
+pytestmark = pytest.mark.level("unit")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not blobd_available():
+        rc = subprocess.run(["make", "-C", os.path.dirname(BLOBD_PATH),
+                             "ktblobd"], capture_output=True)
+        assert rc.returncode == 0, rc.stderr.decode()
+    assert blobd_available()
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    proc, port = spawn_blobd(str(tmp_path), host="127.0.0.1")
+    assert port is not None
+    yield tmp_path, f"http://127.0.0.1:{port}"
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestDaemon:
+    def test_serves_blobs_and_meta(self, daemon):
+        root, url = daemon
+        payload = os.urandom(2 * 1024 * 1024)   # multi-chunk sendfile
+        (root / "aa11.bin").write_bytes(payload)
+        (root / "aa11.json").write_text(json.dumps({"key": "k1"}))
+        assert requests.get(f"{url}/healthz", timeout=5).status_code == 200
+        r = requests.get(f"{url}/blob/aa11.bin", timeout=10)
+        assert r.status_code == 200 and r.content == payload
+        assert int(r.headers["Content-Length"]) == len(payload)
+        assert requests.get(f"{url}/blob/aa11.json",
+                            timeout=5).json() == {"key": "k1"}
+
+    def test_rejects_non_hash_names(self, daemon):
+        root, url = daemon
+        (root / "secret.txt").write_text("nope")
+        for path in ("/blob/secret.txt", "/blob/..%2fsecret.txt",
+                     "/blob/AA11.bin", "/blob/aa11.exe", "/blob/.bin",
+                     "/etc/passwd"):
+            r = requests.get(f"{url}{path}", timeout=5)
+            assert r.status_code in (400, 404), path
+        assert requests.get(f"{url}/blob/dead.bin", timeout=5).status_code == 404
+
+    def test_keep_alive_and_concurrency(self, daemon):
+        root, url = daemon
+        blobs = {}
+        for i in range(8):
+            name = f"{i:02x}{i:02x}"
+            blobs[name] = os.urandom(256 * 1024)
+            (root / f"{name}.bin").write_bytes(blobs[name])
+
+        sess = requests.Session()      # keep-alive: one connection, many GETs
+        for name, payload in blobs.items():
+            assert sess.get(f"{url}/blob/{name}.bin",
+                            timeout=10).content == payload
+
+        def fetch(name):
+            return requests.get(f"{url}/blob/{name}.bin", timeout=10).content
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            results = list(ex.map(fetch, list(blobs) * 5))
+        expected = [blobs[n] for n in list(blobs) * 5]
+        assert results == expected
+
+    def test_raw_traversal_rejected(self, daemon):
+        """requests normalizes ../ away — send the raw bytes."""
+        root, url = daemon
+        (root.parent / "outside.bin").write_bytes(b"outside")
+        host, port = url.split("//")[1].split(":")
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(b"GET /blob/../outside.bin HTTP/1.1\r\n\r\n")
+        resp = s.recv(4096)
+        assert resp.startswith(b"HTTP/1.1 400"), resp[:50]
+        s.close()
+
+
+class TestFanOutIntegration:
+    def test_fetcher_prefers_blobd_and_falls_back(self, daemon, monkeypatch):
+        """A child routed to a parent with a blobd pulls bulk bytes from the
+        native daemon (meta verified against the key); killing the daemon
+        degrades to the parent's pod route semantics (here: store)."""
+        import threading
+
+        from kubetorch_tpu.data_store import commands, peer_cache
+
+        root, blob_url = daemon
+        monkeypatch.setenv("KT_DATA_CACHE_DIR", str(root))
+
+        # parent populates its cache exactly like a completed fetch would
+        peer_cache.cache_put("weights/step1", b"W" * 100_000,
+                             {"codec": "raw"})
+
+        fetcher = commands._RoutedFetcher.__new__(commands._RoutedFetcher)
+        fetcher.store_url = "http://127.0.0.1:9"   # store is unreachable
+        fetcher.key = "weights"
+        fetcher.sess = requests.Session()
+        fetcher.enabled = False     # skip local-cache shortcut + resolve
+        fetcher._resolved = True
+        fetcher._fetched = False
+        fetcher._deadline = None
+        fetcher.peer_url = "http://127.0.0.1:9"    # python route unreachable
+        fetcher.peer_blob_url = blob_url
+
+        r = fetcher._fetch_from_peer("weights/step1", timeout=10)
+        assert r.status_code == 200
+        assert r.content == b"W" * 100_000
+        assert json.loads(r.headers["X-KT-Meta"]) == {"codec": "raw"}
+
+        # missing subkey → 404 with the parent's "not yet" semantics
+        r = fetcher._fetch_from_peer("weights/step2", timeout=10)
+        assert r.status_code == 404
+
+        # blobd gone → fast path disables itself; the parent is then judged
+        # by its pod route (unreachable here → RequestException, the signal
+        # fetch() uses to evict the parent and go to the store)
+        daemon_proc_port = blob_url.rsplit(":", 1)[1]
+        del daemon_proc_port
+        fetcher.peer_blob_url = "http://127.0.0.1:9"
+        with pytest.raises(requests.RequestException):
+            fetcher._fetch_from_peer("weights/step1", timeout=3)
+        assert fetcher.peer_blob_url is None
+
+
+def test_pipelined_requests_after_large_response(daemon):
+    """Two GETs in one write, first response larger than the socket buffer
+    (forces the EPOLLOUT path): the second buffered request must still be
+    answered — the stall mode where EPOLLIN never re-fires for bytes
+    already read."""
+    root, url = daemon
+    big = os.urandom(4 * 1024 * 1024)
+    (root / "b16a.bin").write_bytes(big)
+    (root / "c27b.bin").write_bytes(b"tail-blob")
+    host, port = url.split("//")[1].split(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    s.sendall(b"GET /blob/b16a.bin HTTP/1.1\r\n\r\n"
+              b"GET /blob/c27b.bin HTTP/1.1\r\n\r\n")
+    buf = b""
+    s.settimeout(10)
+    while b"tail-blob" not in buf:
+        chunk = s.recv(1 << 16)
+        assert chunk, f"connection closed early after {len(buf)} bytes"
+        buf += chunk
+    assert big in buf
+    s.close()

@@ -323,11 +323,13 @@ def test_route_eager_tree_assignment(store):
     # B arrives while A is still fetching: assigned A (eager rolling join)
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()
-    assert r == {"source": "peer", "url": "http://10.0.0.1:1"}
+    assert r == {"source": "peer", "url": "http://10.0.0.1:1",
+                 "blob_url": None}
     # C arrives: least-loaded member is B (0 children vs A's 1)
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.3:1"}, timeout=10).json()
-    assert r == {"source": "peer", "url": "http://10.0.0.2:1"}
+    assert r == {"source": "peer", "url": "http://10.0.0.2:1",
+                 "blob_url": None}
     # a member is never its own parent
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()

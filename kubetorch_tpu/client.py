@@ -125,6 +125,12 @@ class ControllerClient:
         return self._request(
             "GET", "/controller/storage-classes")["storage_classes"]
 
+    def prom_query(self, query: str) -> Dict:
+        """PromQL against the cluster metrics stack, via the controller
+        (reference pod/resource-scope metric queries)."""
+        return self._request("GET", "/controller/metrics/query",
+                             params={"query": query})
+
     def cluster_config(self) -> Dict:
         try:
             return self._request("GET", "/controller/cluster-config",

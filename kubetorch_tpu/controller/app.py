@@ -302,6 +302,22 @@ async def store_tunnel(request: web.Request) -> web.Response:
     return await _relay(request, url, error_label="store tunnel")
 
 
+async def prom_query(request: web.Request) -> web.Response:
+    """PromQL passthrough to the metrics stack (reference
+    ``http_client.py:758-795`` streams pod/resource-scope PromQL — CPU,
+    memory, accelerator — during calls; deploy/metrics.yaml is the scrape
+    side). Clients that can only reach the controller query through here."""
+    state: ControllerState = request.app["cstate"]
+    prom = (os.environ.get("KT_PROMETHEUS_URL")
+            or state.cluster_config.get("prometheus_url"))
+    if not prom:
+        return web.json_response({"error": "no metrics stack configured "
+                                           "(deploy/metrics.yaml)"},
+                                 status=503)
+    return await _relay(request, f"{prom.rstrip('/')}/api/v1/query",
+                        error_label="prometheus")
+
+
 async def get_object(request: web.Request) -> web.Response:
     """Config-object read (Secret metadata / PVC / ConfigMap) — the
     reference's get_pvc/get_secret controller surface. Secret VALUES are
@@ -992,6 +1008,7 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
     r.add_delete("/controller/object/{kind}/{ns}/{name}", delete_object)
     r.add_get("/controller/storage-classes", storage_classes)
     r.add_route("*", "/controller/store/{path:.*}", store_tunnel)
+    r.add_get("/controller/metrics/query", prom_query)
     r.add_get("/controller/cluster-config", cluster_config)
     r.add_get("/controller/version", version)
     r.add_post("/controller/logs", ingest_logs)

@@ -341,21 +341,27 @@ class _RoutedFetcher:
             from .peer_cache import entry_hash
             h = entry_hash(subkey)
             try:
-                rb = self.sess.get(f"{self.peer_blob_url}/blob/{h}.bin",
-                                   timeout=timeout)
-                if rb.status_code == 200:
-                    rm = self.sess.get(f"{self.peer_blob_url}/blob/{h}.json",
-                                       timeout=30)
-                    if rm.status_code == 200:
-                        entry = json.loads(rm.content)
-                        if entry.get("key") == subkey:   # collision paranoia
+                # meta FIRST: it is tiny and lands last in cache_put's
+                # rename pair, so its presence proves the (possibly
+                # multi-GB) .bin is complete — probing .bin first would
+                # download the payload just to discard it when the entry
+                # turns out half-written
+                rm = self.sess.get(f"{self.peer_blob_url}/blob/{h}.json",
+                                   timeout=30)
+                if rm.status_code == 200:
+                    entry = json.loads(rm.content)
+                    if entry.get("key") == subkey:   # collision paranoia
+                        rb = self.sess.get(
+                            f"{self.peer_blob_url}/blob/{h}.bin",
+                            timeout=timeout)
+                        if rb.status_code == 200:
                             return _CachedResponse(rb.content,
                                                    entry.get("meta", {}))
-                elif rb.status_code == 404:
+                elif rm.status_code == 404:
                     # same "not yet" semantics as the pod route: the parent
                     # may still be fetching — let the caller's poll window
                     # decide; don't hammer the python route too
-                    return rb
+                    return rm
             except (_requests.RequestException, ValueError):
                 self.peer_blob_url = None   # fast path off; parent still ok
         return self.sess.get(f"{self.peer_url}/_kt/data/{subkey}",

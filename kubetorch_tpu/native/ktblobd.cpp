@@ -20,6 +20,7 @@
 
 #include <arpa/inet.h>
 #include <cerrno>
+#include <ctime>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -34,11 +35,16 @@
 #include <sys/stat.h>
 #include <unistd.h>
 #include <unordered_map>
+#include <vector>
 
 namespace {
 
 constexpr int kMaxEvents = 128;
 constexpr size_t kMaxReqBytes = 8192;
+// Half-open connections from abruptly-dead peers (node preemption sends no
+// FIN/RST) would otherwise accumulate until accept() hits the fd limit.
+constexpr time_t kIdleTimeoutS = 300;
+constexpr int kReapIntervalMs = 30000;
 
 struct Conn {
   int fd = -1;
@@ -50,6 +56,8 @@ struct Conn {
   off_t file_off = 0;
   off_t file_len = 0;
   bool close_after = false;
+  bool is_head = false;   // current request is HEAD: headers only
+  time_t last_active = 0;
 };
 
 std::string g_root;
@@ -78,12 +86,13 @@ void queue_simple(Conn& c, int status, const char* text) {
   int body_len = (int)strlen(text);
   snprintf(buf, sizeof(buf),
            "HTTP/1.1 %d %s\r\nContent-Length: %d\r\n"
-           "Content-Type: text/plain\r\nConnection: %s\r\n\r\n%s",
+           "Content-Type: text/plain\r\nConnection: %s\r\n\r\n",
            status, status == 200 ? "OK" : (status == 404 ? "Not Found"
                                                          : "Bad Request"),
-           body_len, c.close_after ? "close" : "keep-alive", text);
+           body_len, c.close_after ? "close" : "keep-alive");
   c.head.assign(buf);
-  c.head_off = 0;
+  if (!c.is_head) c.head.append(text);   // HEAD: headers only, or the stray
+  c.head_off = 0;                        // body desyncs keep-alive parsing
 }
 
 // returns false if the connection should be dropped immediately
@@ -94,8 +103,10 @@ bool handle_request(Conn& c, const std::string& line) {
   if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
   std::string method = line.substr(0, sp1);
   std::string path = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  c.is_head = method == "HEAD";
   if (method != "GET" && method != "HEAD") {
     c.close_after = true;
+    c.is_head = false;
     queue_simple(c, 400, "only GET\n");
     return true;
   }
@@ -133,7 +144,7 @@ bool handle_request(Conn& c, const std::string& line) {
            (long long)st.st_size);
   c.head.assign(buf);
   c.head_off = 0;
-  if (method == "GET") {
+  if (!c.is_head) {
     c.file_fd = fd;
     c.file_off = 0;
     c.file_len = st.st_size;
@@ -251,12 +262,22 @@ int main(int argc, char** argv) {
     return 0;
   };
 
+  time_t last_reap = time(nullptr);
   for (;;) {
-    int n = epoll_wait(ep, events, kMaxEvents, -1);
+    int n = epoll_wait(ep, events, kMaxEvents, kReapIntervalMs);
     if (n < 0) {
       if (errno == EINTR) continue;
       perror("ktblobd: epoll_wait");
       return 1;
+    }
+    time_t now = time(nullptr);
+    if (now - last_reap >= kReapIntervalMs / 1000) {
+      last_reap = now;
+      std::vector<int> idle;
+      for (auto& kv : conns)
+        if (now - kv.second.last_active > kIdleTimeoutS)
+          idle.push_back(kv.first);
+      for (int fd : idle) drop(fd);
     }
     for (int i = 0; i < n; i++) {
       int fd = events[i].data.fd;
@@ -271,12 +292,14 @@ int main(int argc, char** argv) {
           e.data.fd = cl;
           epoll_ctl(ep, EPOLL_CTL_ADD, cl, &e);
           conns[cl].fd = cl;
+          conns[cl].last_active = time(nullptr);
         }
         continue;
       }
       auto it = conns.find(fd);
       if (it == conns.end()) continue;
       Conn& c = it->second;
+      c.last_active = now;
       if (events[i].events & (EPOLLHUP | EPOLLERR)) {
         drop(fd);
         continue;

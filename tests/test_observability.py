@@ -176,6 +176,58 @@ class TestMetricStreamE2E:
             reset_config()
 
 
+class TestPromQueryPassthrough:
+    def test_query_relays_to_prometheus(self, monkeypatch):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubetorch_tpu.controller.app import (ControllerState,
+                                                  create_controller_app)
+
+        seen = {}
+
+        async def query(request):
+            seen["query"] = request.query.get("query")
+            return web.json_response({"status": "success",
+                                      "data": {"result": [{"value": [0, "2"]}]}})
+
+        async def body():
+            prom = web.Application()
+            prom.router.add_get("/api/v1/query", query)
+            async with TestClient(TestServer(prom)) as prom_client:
+                monkeypatch.setenv(
+                    "KT_PROMETHEUS_URL",
+                    str(prom_client.make_url("")).rstrip("/"))
+                state = ControllerState()
+                async with TestClient(
+                        TestServer(create_controller_app(state))) as ctl:
+                    r = await ctl.get("/controller/metrics/query",
+                                      params={"query": "up"})
+                    assert r.status == 200
+                    assert (await r.json())["status"] == "success"
+            assert seen["query"] == "up"
+
+        asyncio.run(body())
+
+    def test_query_without_stack_is_503(self, monkeypatch):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubetorch_tpu.controller.app import (ControllerState,
+                                                  create_controller_app)
+
+        monkeypatch.delenv("KT_PROMETHEUS_URL", raising=False)
+
+        async def body():
+            state = ControllerState()
+            async with TestClient(
+                    TestServer(create_controller_app(state))) as ctl:
+                r = await ctl.get("/controller/metrics/query",
+                                  params={"query": "up"})
+                assert r.status == 503
+
+        asyncio.run(body())
+
+
 class TestLokiForwarding:
     def test_controller_forwards_log_batches(self, monkeypatch):
         """POST /controller/logs fans out to Loki's push API when

@@ -148,7 +148,9 @@ class ShmSegment:
 
 # -- ktblobd (native bulk-transfer daemon) ------------------------------------
 
-BLOBD_PATH = os.path.join(_DIR, "ktblobd")
+# KT_BLOBD_BIN override: the sanitizer CI points this at the ASAN build
+# and re-runs the daemon's whole pytest surface against it
+BLOBD_PATH = os.environ.get("KT_BLOBD_BIN", os.path.join(_DIR, "ktblobd"))
 
 
 def blobd_available() -> bool:

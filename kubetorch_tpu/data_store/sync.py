@@ -30,7 +30,9 @@ from ..exceptions import SyncError
 
 EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
                 "node_modules", ".venv", "venv", ".ktsync"}
-EXCLUDE_SUFFIXES = (".pyc", ".pyo", ".so.tmp")
+# _asan/_tsan: CI-only sanitizer binaries built into the package dir — they
+# must not ride every cold code sync to the pods
+EXCLUDE_SUFFIXES = (".pyc", ".pyo", ".so.tmp", "_asan", "_tsan")
 MANIFEST_FILE = ".ktsync-manifest.json"
 HASH_CACHE_FILE = os.path.join(".ktsync", "hash-cache.json")
 MAX_FILE_SIZE = 10 * 1024 ** 3  # parity with the reference's 10G nginx cap

@@ -160,14 +160,19 @@ def blobd_available() -> bool:
 def spawn_blobd(root: str, host: str = "0.0.0.0", port: int = 0):
     """Start ktblobd over ``root`` and return ``(Popen, bound_port)``, or
     ``(None, None)`` when the binary isn't built — callers degrade to the
-    pure-Python peer route. The daemon prints ``PORT <n>`` once bound."""
+    pure-Python peer route. The daemon prints ``PORT <n>`` once bound.
+
+    Under a KT_BLOBD_BIN override (the sanitizer tier) stderr is inherited:
+    swallowing it would hide every ASAN/LSan report, defeating the tier."""
     import subprocess
 
     if not blobd_available():
         return None, None
+    stderr = (None if os.environ.get("KT_BLOBD_BIN")
+              else subprocess.DEVNULL)
     proc = subprocess.Popen(
         [BLOBD_PATH, "--root", root, "--host", host, "--port", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        stdout=subprocess.PIPE, stderr=stderr, text=True)
     line = proc.stdout.readline().strip()
     if not line.startswith("PORT "):
         proc.terminate()

@@ -195,6 +195,10 @@ int main(int argc, char** argv) {
   }
   g_root = root;
   signal(SIGPIPE, SIG_IGN);
+  // normal exit on SIGTERM (the pod server's shutdown signal): atexit
+  // handlers run, so LeakSanitizer reports under the ASAN tier instead of
+  // the process dying report-less
+  signal(SIGTERM, [](int) { exit(0); });
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

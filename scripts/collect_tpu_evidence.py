@@ -1,0 +1,72 @@
+"""Assemble TPU_EVIDENCE.md from the bench loop's artifacts.
+
+The all-round retry loop (``scripts/tpu_bench_loop.sh``) drops its outputs
+in /tmp when the relay finally yields the chip:
+
+- /tmp/bench_tpu.json   — the headline bench line (device=TPU*, mfu>0)
+- /tmp/tpu_smoke.log    — flash fwd/bwd vs XLA maxerr + step timings
+
+Run this (then commit TPU_EVIDENCE.md + BENCH_CONFIGS.md) as soon as they
+exist. Exits 1 while evidence is still missing.
+"""
+
+import json
+import os
+import sys
+import time
+
+BENCH = "/tmp/bench_tpu.json"
+SMOKE = "/tmp/tpu_smoke.log"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "TPU_EVIDENCE.md")
+
+
+def main() -> int:
+    if not os.path.exists(BENCH):
+        print(f"no {BENCH} yet — relay hasn't yielded a chip", file=sys.stderr)
+        return 1
+    try:
+        with open(BENCH) as f:
+            bench = json.loads(f.read().strip().splitlines()[-1])
+    except (OSError, ValueError, IndexError) as e:
+        # the loop may still be mid-write; poll again later
+        print(f"{BENCH} not readable yet ({e})", file=sys.stderr)
+        return 1
+    detail = bench.get("detail", {})
+    lines = [
+        "# Real-TPU execution evidence",
+        "",
+        f"Collected {time.strftime('%Y-%m-%d %H:%M:%S')} by "
+        "`scripts/collect_tpu_evidence.py` from the all-round retry loop "
+        "(`scripts/tpu_bench_loop.sh`).",
+        "",
+        "## Headline bench (bench.py)",
+        "",
+        "```json",
+        json.dumps(bench, indent=2),
+        "```",
+        "",
+        f"- device: **{detail.get('device', '?')}**",
+        f"- tokens/sec/chip: **{bench.get('value')}**",
+        f"- MFU: **{detail.get('mfu')}** (vs_baseline "
+        f"{bench.get('vs_baseline')} of the 0.40 target)",
+        f"- model: {detail.get('params', 0):,} params, "
+        f"batch={detail.get('batch')}, seq={detail.get('seq')}",
+        "",
+    ]
+    if os.path.exists(SMOKE):
+        with open(SMOKE) as f:
+            smoke = f.read()
+        lines += ["## Flash-kernel smoke (scripts/tpu_smoke.py)", "",
+                  "```", smoke.strip()[-4000:], "```", ""]
+    else:
+        lines += ["## Flash-kernel smoke", "",
+                  "_smoke log not captured in this window_", ""]
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

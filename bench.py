@@ -266,7 +266,13 @@ def bench_worker(force_cpu: bool = False) -> int:
         except Exception as e:
             if "RESOURCE_EXHAUSTED" in str(e) and batch > 1:
                 batch //= 2
-                state = init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt)
+                # release the failed attempt's arrays BEFORE re-initializing:
+                # `params` shares device buffers with `state`, and keeping
+                # them alive would give the halved-batch retry LESS free HBM
+                # than a fresh run at that batch size
+                state = params = None   # noqa: F841
+                params = llama_init(jax.random.PRNGKey(0), cfg)
+                state = init_train_state(params, opt)
                 continue
             raise
 

@@ -30,9 +30,11 @@ from ..exceptions import SyncError
 
 EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
                 "node_modules", ".venv", "venv", ".ktsync"}
-# _asan/_tsan: CI-only sanitizer binaries built into the package dir — they
-# must not ride every cold code sync to the pods
-EXCLUDE_SUFFIXES = (".pyc", ".pyo", ".so.tmp", "_asan", "_tsan")
+EXCLUDE_SUFFIXES = (".pyc", ".pyo", ".so.tmp")
+# CI-only sanitizer binaries built into the package dir — excluded by EXACT
+# name (a bare "_asan" suffix rule would silently drop user files like
+# tools/run_asan from every sync)
+EXCLUDE_NAMES = {"ktblobd_asan", "kt_native_asan", "kt_native_tsan"}
 MANIFEST_FILE = ".ktsync-manifest.json"
 HASH_CACHE_FILE = os.path.join(".ktsync", "hash-cache.json")
 MAX_FILE_SIZE = 10 * 1024 ** 3  # parity with the reference's 10G nginx cap
@@ -68,7 +70,8 @@ def build_manifest(root: str) -> Dict[str, Dict]:
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in EXCLUDE_DIRS]
         for fname in filenames:
-            if fname.endswith(EXCLUDE_SUFFIXES) or fname == MANIFEST_FILE:
+            if (fname.endswith(EXCLUDE_SUFFIXES) or fname == MANIFEST_FILE
+                    or fname in EXCLUDE_NAMES):
                 continue
             fpath = os.path.join(dirpath, fname)
             try:

@@ -168,7 +168,11 @@ def spawn_blobd(root: str, host: str = "0.0.0.0", port: int = 0):
 
     if not blobd_available():
         return None, None
-    stderr = (None if os.environ.get("KT_BLOBD_BIN")
+    # keyed on the RESOLVED path, not the live env var: BLOBD_PATH was
+    # snapshotted at import, and the two disagreeing would run the
+    # sanitizer binary with its reports swallowed (or the default one
+    # noisily)
+    stderr = (None if BLOBD_PATH != os.path.join(_DIR, "ktblobd")
               else subprocess.DEVNULL)
     proc = subprocess.Popen(
         [BLOBD_PATH, "--root", root, "--host", host, "--port", str(port)],

@@ -61,6 +61,7 @@ struct Conn {
 };
 
 std::string g_root;
+volatile sig_atomic_t g_stop = 0;
 
 void set_nonblock(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
@@ -195,10 +196,12 @@ int main(int argc, char** argv) {
   }
   g_root = root;
   signal(SIGPIPE, SIG_IGN);
-  // normal exit on SIGTERM (the pod server's shutdown signal): atexit
-  // handlers run, so LeakSanitizer reports under the ASAN tier instead of
-  // the process dying report-less
-  signal(SIGTERM, [](int) { exit(0); });
+  // SIGTERM (the pod server's shutdown signal) requests a NORMAL exit so
+  // atexit handlers — LeakSanitizer under the ASAN tier — actually run.
+  // Only a flag is set here: exit() in the handler could deadlock on the
+  // allocator lock the interrupted frame holds; the epoll loop (woken by
+  // EINTR) observes the flag and returns from main.
+  signal(SIGTERM, [](int) { g_stop = 1; });
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -268,7 +271,9 @@ int main(int argc, char** argv) {
 
   time_t last_reap = time(nullptr);
   for (;;) {
+    if (g_stop) return 0;
     int n = epoll_wait(ep, events, kMaxEvents, kReapIntervalMs);
+    if (g_stop) return 0;
     if (n < 0) {
       if (errno == EINTR) continue;
       perror("ktblobd: epoll_wait");

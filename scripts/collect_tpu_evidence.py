@@ -33,12 +33,23 @@ def main() -> int:
         print(f"{BENCH} not readable yet ({e})", file=sys.stderr)
         return 1
     detail = bench.get("detail", {})
+    # evidence must BE evidence: refuse CPU-labelled or mfu-less artifacts
+    # (a stale or hand-placed file must not masquerade as a TPU run)
+    if not str(detail.get("device", "")).startswith("TPU") \
+            or not detail.get("mfu"):
+        print(f"{BENCH} is not a TPU result "
+              f"(device={detail.get('device')!r}, mfu={detail.get('mfu')}) "
+              "— refusing to write evidence", file=sys.stderr)
+        return 1
+    # the artifact's OWN mtime, not collection time: the file may be old
+    ran_at = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(os.path.getmtime(BENCH)))
     lines = [
         "# Real-TPU execution evidence",
         "",
-        f"Collected {time.strftime('%Y-%m-%d %H:%M:%S')} by "
-        "`scripts/collect_tpu_evidence.py` from the all-round retry loop "
-        "(`scripts/tpu_bench_loop.sh`).",
+        f"Bench artifact written {ran_at} by the all-round retry loop "
+        "(`scripts/tpu_bench_loop.sh`); assembled by "
+        "`scripts/collect_tpu_evidence.py`.",
         "",
         "## Headline bench (bench.py)",
         "",

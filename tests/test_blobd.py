@@ -28,9 +28,9 @@ def built():
             # an override names a specific (e.g. sanitizer) binary — build
             # its make target rather than confusingly rebuilding the
             # default and failing the availability check anyway
-            pytest.fail(f"KT_BLOBD_BIN={BLOBD_PATH} does not exist; build "
-                        "it first (make blobd-asan-test builds+runs the "
-                        "sanitizer tier)")
+            pytest.fail(f"KT_BLOBD_BIN={BLOBD_PATH} is missing or not "
+                        "executable; build it first (make blobd-asan-test "
+                        "builds+runs the sanitizer tier)")
         rc = subprocess.run(["make", "-C", os.path.dirname(BLOBD_PATH),
                              "ktblobd"], capture_output=True)
         assert rc.returncode == 0, rc.stderr.decode()
@@ -43,7 +43,11 @@ def daemon(tmp_path):
     assert port is not None
     yield tmp_path, f"http://127.0.0.1:{port}"
     proc.terminate()
-    proc.wait(timeout=5)
+    rc = proc.wait(timeout=5)
+    # SIGTERM → clean return-from-main (rc 0). Under the sanitizer tier a
+    # LeakSanitizer report exits non-zero — it must FAIL the run, not just
+    # print to stderr.
+    assert rc == 0, f"ktblobd exited rc={rc} (sanitizer report?)"
 
 
 class TestDaemon:

@@ -62,6 +62,7 @@ struct Conn {
 
 std::string g_root;
 volatile sig_atomic_t g_stop = 0;
+int g_wake_fd = -1;   // self-pipe write end: SIGTERM wakes epoll_wait
 
 void set_nonblock(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
@@ -198,10 +199,28 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
   // SIGTERM (the pod server's shutdown signal) requests a NORMAL exit so
   // atexit handlers — LeakSanitizer under the ASAN tier — actually run.
-  // Only a flag is set here: exit() in the handler could deadlock on the
-  // allocator lock the interrupted frame holds; the epoll loop (woken by
-  // EINTR) observes the flag and returns from main.
-  signal(SIGTERM, [](int) { g_stop = 1; });
+  // Only flag + self-pipe write here (both async-signal-safe): exit() in
+  // the handler could deadlock on the allocator lock the interrupted frame
+  // holds, and the flag alone races the epoll_wait entry (a signal landing
+  // just before the block would wait out the whole 30s tick). The pipe's
+  // read end sits in the epoll set, so delivery wakes the loop
+  // deterministically.
+  int wake_pipe[2];
+  if (pipe(wake_pipe) == 0) {
+    set_nonblock(wake_pipe[0]);
+    set_nonblock(wake_pipe[1]);
+    g_wake_fd = wake_pipe[1];
+  } else {
+    wake_pipe[0] = -1;
+  }
+  signal(SIGTERM, [](int) {
+    g_stop = 1;
+    if (g_wake_fd >= 0) {
+      char b = 1;
+      ssize_t ignored = write(g_wake_fd, &b, 1);
+      (void)ignored;
+    }
+  });
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -232,6 +251,12 @@ int main(int argc, char** argv) {
   ev.events = EPOLLIN;
   ev.data.fd = srv;
   epoll_ctl(ep, EPOLL_CTL_ADD, srv, &ev);
+  if (wake_pipe[0] >= 0) {
+    epoll_event we{};
+    we.events = EPOLLIN;
+    we.data.fd = wake_pipe[0];
+    epoll_ctl(ep, EPOLL_CTL_ADD, wake_pipe[0], &we);
+  }
 
   std::unordered_map<int, Conn> conns;
   epoll_event events[kMaxEvents];

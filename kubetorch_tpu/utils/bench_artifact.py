@@ -1,0 +1,70 @@
+"""The ONE acceptance rule for cached TPU bench artifacts.
+
+``bench.py`` (emitting a cached measurement when the relay is down at
+driver time) and ``scripts/collect_tpu_evidence.py`` (assembling
+TPU_EVIDENCE.md) must agree on what counts as evidence; two copies of the
+check would drift.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+# the files whose behavior defines what the headline number MEANS — if any
+# changed since the artifact was captured, the measurement is of old code.
+# Deliberately NOT the git HEAD: unrelated commits (docs, controller fixes)
+# must not invalidate a real measurement of unchanged bench code.
+_BENCH_DEFINING_FILES = (
+    "bench.py",
+    "kubetorch_tpu/models/llama.py",
+    "kubetorch_tpu/ops/attention.py",
+    "kubetorch_tpu/train/__init__.py",
+)
+
+
+def bench_fingerprint() -> str:
+    """Content hash over the bench-defining sources."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    h = hashlib.blake2b(digest_size=8)
+    for rel in _BENCH_DEFINING_FILES:
+        path = os.path.join(root, rel)
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<missing>")
+    return h.hexdigest()
+
+
+def load_tpu_artifact(path: str,
+                      require_fingerprint: bool = True) -> Optional[Dict]:
+    """Parse + validate a bench artifact; None unless it is a genuine TPU
+    measurement (device TPU*, mfu>0) of the CURRENT bench code (fingerprint
+    match, unless ``require_fingerprint=False``). Adds ``measured_at`` from
+    the artifact's own mtime — it must not masquerade as fresh."""
+    try:
+        with open(path) as f:
+            result = json.loads(f.read().strip().splitlines()[-1])
+        mtime = os.path.getmtime(path)
+    except (OSError, ValueError, IndexError):
+        return None
+    if not isinstance(result, dict):
+        return None
+    detail = result.get("detail")
+    if not isinstance(detail, dict):
+        return None
+    if not str(detail.get("device", "")).startswith("TPU") \
+            or not detail.get("mfu"):
+        return None
+    if require_fingerprint \
+            and detail.get("bench_fingerprint") != bench_fingerprint():
+        return None
+    detail["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S",
+                                          time.localtime(mtime))
+    result["detail"] = detail
+    return result

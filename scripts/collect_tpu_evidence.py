@@ -22,34 +22,29 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 
 def main() -> int:
-    if not os.path.exists(BENCH):
-        print(f"no {BENCH} yet — relay hasn't yielded a chip", file=sys.stderr)
-        return 1
-    try:
-        with open(BENCH) as f:
-            bench = json.loads(f.read().strip().splitlines()[-1])
-    except (OSError, ValueError, IndexError) as e:
-        # the loop may still be mid-write; poll again later
-        print(f"{BENCH} not readable yet ({e})", file=sys.stderr)
-        return 1
-    detail = bench.get("detail", {})
-    # evidence must BE evidence: refuse CPU-labelled or mfu-less artifacts
-    # (a stale or hand-placed file must not masquerade as a TPU run)
-    if not str(detail.get("device", "")).startswith("TPU") \
-            or not detail.get("mfu"):
-        print(f"{BENCH} is not a TPU result "
-              f"(device={detail.get('device')!r}, mfu={detail.get('mfu')}) "
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from kubetorch_tpu.utils.bench_artifact import (bench_fingerprint,
+                                                    load_tpu_artifact)
+
+    # shared acceptance rule with bench.py's cached-result path; evidence
+    # of REAL TPU execution is still evidence even if bench code moved on
+    # since capture, so the fingerprint is reported rather than required
+    bench = load_tpu_artifact(BENCH, require_fingerprint=False)
+    if bench is None:
+        print(f"{BENCH} missing, unreadable, or not a genuine TPU result "
               "— refusing to write evidence", file=sys.stderr)
         return 1
-    # the artifact's OWN mtime, not collection time: the file may be old
-    ran_at = time.strftime("%Y-%m-%d %H:%M:%S",
-                           time.localtime(os.path.getmtime(BENCH)))
+    detail = bench.get("detail", {})
+    ran_at = detail.get("measured_at", "?")
+    current = detail.get("bench_fingerprint") == bench_fingerprint()
     lines = [
         "# Real-TPU execution evidence",
         "",
         f"Bench artifact written {ran_at} by the all-round retry loop "
         "(`scripts/tpu_bench_loop.sh`); assembled by "
-        "`scripts/collect_tpu_evidence.py`.",
+        "`scripts/collect_tpu_evidence.py`. Bench-code fingerprint "
+        f"{'matches the current tree' if current else 'PREDATES later bench edits'}.",
         "",
         "## Headline bench (bench.py)",
         "",

@@ -76,7 +76,10 @@ def probe_worker() -> int:
     return 0
 
 
-CACHED_TPU_RESULT = "/tmp/bench_tpu.json"
+CACHED_TPU_RESULT = "/tmp/bench_tpu.json"   # = bench_artifact.DEFAULT_ARTIFACT_PATH
+                                            # (literal fallback: the launcher
+                                            # must run even if the package
+                                            # doesn't import)
 
 
 def _cached_tpu_result() -> int:
@@ -87,10 +90,12 @@ def _cached_tpu_result() -> int:
     device, mfu>0, bench-code fingerprint match, mtime stamp) is shared
     with the evidence collector: utils/bench_artifact.py."""
     try:
-        from kubetorch_tpu.utils.bench_artifact import load_tpu_artifact
+        from kubetorch_tpu.utils.bench_artifact import (
+            DEFAULT_ARTIFACT_PATH, load_tpu_artifact)
     except ImportError:
         return 1
-    result = load_tpu_artifact(CACHED_TPU_RESULT)
+    result = load_tpu_artifact(os.environ.get("KT_BENCH_ARTIFACT",
+                                              DEFAULT_ARTIFACT_PATH))
     if result is None:
         return 1
     print(json.dumps(result))

@@ -14,12 +14,15 @@ import os
 import time
 from typing import Dict, Optional
 
+# where scripts/tpu_bench_loop.sh drops a successful TPU bench line — THE
+# path both the cached-emission fallback and the evidence collector read
+DEFAULT_ARTIFACT_PATH = "/tmp/bench_tpu.json"
+
 # the files whose behavior defines what the headline number MEANS — if any
 # changed since the artifact was captured, the measurement is of old code.
 # Deliberately NOT the git HEAD: unrelated commits (docs, controller fixes)
 # must not invalidate a real measurement of unchanged bench code.
 _BENCH_DEFINING_FILES = (
-    "bench.py",
     "kubetorch_tpu/models/llama.py",
     "kubetorch_tpu/ops/attention.py",
     "kubetorch_tpu/train/__init__.py",
@@ -27,10 +30,22 @@ _BENCH_DEFINING_FILES = (
 
 
 def bench_fingerprint() -> str:
-    """Content hash over the bench-defining sources."""
+    """Content hash over the bench-defining sources.
+
+    From ``bench.py`` only the WORKER half (``def bench_worker`` onward)
+    counts: the launcher's retry/probe/caching logic doesn't define what
+    the measurement means, and hashing it would invalidate genuine
+    artifacts on launcher-only edits."""
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     h = hashlib.blake2b(digest_size=8)
+    try:
+        with open(os.path.join(root, "bench.py"), "rb") as f:
+            src = f.read()
+        marker = src.find(b"def bench_worker")
+        h.update(src[marker:] if marker >= 0 else src)
+    except OSError:
+        h.update(b"<missing>")
     for rel in _BENCH_DEFINING_FILES:
         path = os.path.join(root, rel)
         try:

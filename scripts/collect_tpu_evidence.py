@@ -13,9 +13,7 @@ exist. Exits 1 while evidence is still missing.
 import json
 import os
 import sys
-import time
 
-BENCH = "/tmp/bench_tpu.json"
 SMOKE = "/tmp/tpu_smoke.log"
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "TPU_EVIDENCE.md")
@@ -24,16 +22,18 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from kubetorch_tpu.utils.bench_artifact import (bench_fingerprint,
+    from kubetorch_tpu.utils.bench_artifact import (DEFAULT_ARTIFACT_PATH,
+                                                    bench_fingerprint,
                                                     load_tpu_artifact)
-
     # shared acceptance rule with bench.py's cached-result path; evidence
     # of REAL TPU execution is still evidence even if bench code moved on
     # since capture, so the fingerprint is reported rather than required
-    bench = load_tpu_artifact(BENCH, require_fingerprint=False)
+    bench = load_tpu_artifact(DEFAULT_ARTIFACT_PATH,
+                              require_fingerprint=False)
     if bench is None:
-        print(f"{BENCH} missing, unreadable, or not a genuine TPU result "
-              "— refusing to write evidence", file=sys.stderr)
+        print(f"{DEFAULT_ARTIFACT_PATH} missing, unreadable, or not a "
+              "genuine TPU result — refusing to write evidence",
+              file=sys.stderr)
         return 1
     detail = bench.get("detail", {})
     ran_at = detail.get("measured_at", "?")

@@ -1,0 +1,107 @@
+"""Controller fan-out at connection scale (BASELINE: the reference sizes
+its controller for 1000+ connected pod websockets; reload pushes fan to
+every pod and gather acks).
+
+Reduced-scale version of that claim, run for real: N websocket 'pods'
+register concurrently, a deploy pushes metadata/reload to ALL of them, and
+every ack lands within the ack window. Exercises the registry, per-launch
+ack futures, and the fan-out gather under concurrency.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from kubetorch_tpu.controller.app import ControllerState, create_controller_app
+
+pytestmark = [pytest.mark.level("release"), pytest.mark.slow]
+
+N_PODS = 150
+
+
+class StubBackend:
+    def apply(self, namespace, name, manifest, env):
+        return {"service_url": "http://stub:32300", "pod_ips": []}
+
+    def pod_ips(self, namespace, name):
+        return []
+
+    def delete(self, namespace, name):
+        return True
+
+    def shutdown(self):
+        pass
+
+
+def test_reload_fans_out_to_150_connected_pods():
+    async def body():
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        state = ControllerState(backend=StubBackend())
+        server = TestServer(create_controller_app(state))
+        # the pods need their own UNCAPPED session: the default client
+        # connector tops out at 100 concurrent connections
+        async with TestClient(server) as c, aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)) as pod_sess:
+            # N pods register over real websockets and then ACK every
+            # reload the controller pushes
+            reloads_seen = [0] * N_PODS
+            ready = asyncio.Event()
+            registered = 0
+
+            async def pod(i):
+                nonlocal registered
+                async with pod_sess.ws_connect(
+                        server.make_url("/controller/ws/pods")) as ws:
+                    await ws.send_json({
+                        "action": "register", "pod_name": f"pod-{i}",
+                        "namespace": "default", "service_name": "big",
+                        "pod_ip": f"10.0.{i // 250}.{i % 250}"})
+                    first = json.loads((await ws.receive()).data)
+                    assert first["action"] in ("waiting", "metadata")
+                    registered += 1
+                    if registered == N_PODS:
+                        ready.set()
+                    while True:
+                        msg = await ws.receive()
+                        if msg.type != 1:        # TEXT
+                            break
+                        data = json.loads(msg.data)
+                        if data.get("action") == "reload":
+                            reloads_seen[i] += 1
+                            await ws.send_json({
+                                "action": "reload_ack",
+                                "launch_id": data["launch_id"],
+                                "ok": True, "pod": f"pod-{i}"})
+
+            pods = [asyncio.create_task(pod(i)) for i in range(N_PODS)]
+            await asyncio.wait_for(ready.wait(), timeout=60)
+            assert len(state.connections("default", "big")) == N_PODS
+
+            resp = await c.post("/controller/deploy", json={
+                "namespace": "default", "name": "big",
+                "manifest": {"kind": "Deployment", "spec": {"replicas": 1}},
+                "metadata": {"KT_CLS_OR_FN_NAME": "f"},
+                "expected_pods": N_PODS})
+            body_json = await resp.json()
+            assert resp.status == 200 and body_json["ok"]
+            # the deploy's reload fan-out reached EVERY connected pod and
+            # every ack was gathered (no timeouts)
+            acks = body_json["reloaded_pods"]
+            assert len(acks) == N_PODS
+            assert all(a.get("ok") for a in acks.values()), [
+                a for a in acks.values() if not a.get("ok")][:3]
+            assert sum(reloads_seen) == N_PODS
+
+            # with every pod connected, check-ready is satisfied at scale
+            ready_status = await (await c.get(
+                "/controller/check-ready/default/big")).json()
+            assert ready_status["ready"] and ready_status["connected"] == N_PODS
+
+            for t in pods:
+                t.cancel()
+            await asyncio.gather(*pods, return_exceptions=True)
+
+    asyncio.run(body())

@@ -76,10 +76,6 @@ def probe_worker() -> int:
     return 0
 
 
-CACHED_TPU_RESULT = "/tmp/bench_tpu.json"   # = bench_artifact.DEFAULT_ARTIFACT_PATH
-                                            # (literal fallback: the launcher
-                                            # must run even if the package
-                                            # doesn't import)
 
 
 def _cached_tpu_result() -> int:
@@ -94,8 +90,7 @@ def _cached_tpu_result() -> int:
             DEFAULT_ARTIFACT_PATH, load_tpu_artifact)
     except ImportError:
         return 1
-    result = load_tpu_artifact(os.environ.get("KT_BENCH_ARTIFACT",
-                                              DEFAULT_ARTIFACT_PATH))
+    result = load_tpu_artifact(DEFAULT_ARTIFACT_PATH)
     if result is None:
         return 1
     print(json.dumps(result))

@@ -45,6 +45,10 @@ _LAZY = {
     "images": ".resources.images",
     "Volume": ".resources.volume",
     "Secret": ".resources.secret",
+    "secret": ".resources.secret",
+    "MetricsConfig": ".config",
+    "LoggingConfig": ".config",
+    "DebugConfig": ".config",
     "Endpoint": ".resources.endpoint",
     "fn": ".resources.fn",
     "Fn": ".resources.fn",

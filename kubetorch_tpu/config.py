@@ -140,3 +140,49 @@ def reset_config() -> None:
             hook()
         except Exception:
             pass
+
+
+# -- per-call / per-service config objects ------------------------------------
+# Reference analogs: ``globals.py`` MetricsConfig / LoggingConfig /
+# DebugConfig (:40-127). Plain dataclasses a call can carry instead of loose
+# kwargs; each maps onto the mechanism that actually implements it here.
+
+@dataclass
+class MetricsConfig:
+    """Live metric streaming during a call (``[metrics]`` lines alongside
+    logs). ``scope="pod"`` polls the pod's own /metrics (HBM, inflight);
+    ``scope="resource"`` queries PromQL through the controller
+    (``/controller/metrics/query``, needs deploy/metrics.yaml)."""
+
+    interval: float = 3.0
+    scope: str = "pod"          # "pod" | "resource"
+
+
+@dataclass
+class LoggingConfig:
+    """Log streaming behavior for calls against a service.
+
+    ``grace_period`` keeps the stream draining after the call returns so
+    trailing lines land; ``None`` inherits ``KT_LOG_STREAM_GRACE``
+    (default 3s). The interpreter-exit drain is bounded by that env var
+    regardless — raise it too when a one-shot script needs a long tail."""
+
+    stream_logs: Optional[bool] = None   # None → global config.stream_logs
+    include_name: bool = True            # prefix lines with pod name
+    grace_period: Optional[float] = None  # None → KT_LOG_STREAM_GRACE
+
+
+@dataclass
+class DebugConfig:
+    """Remote pdb session spec. The session token is one-shot: generated
+    client-side when omitted, required by the pod's breakpoint socket."""
+
+    mode: str = "pdb"
+    port: int = 5678
+    token: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        out = {"mode": self.mode, "port": self.port}
+        if self.token:
+            out["token"] = self.token
+        return out

@@ -12,7 +12,11 @@ class Fn(Module):
 
     def __call__(self, *args, workers=None, timeout: Optional[float] = None,
                  stream_logs: Optional[bool] = None,
-                 debugger: Optional[dict] = None, **kwargs) -> Any:
+                 debugger=None, metrics=None, logging=None,
+                 **kwargs) -> Any:
+        """``debugger=kt.DebugConfig(...)``, ``metrics=kt.MetricsConfig(...)``
+        and ``logging=kt.LoggingConfig(...)`` carry per-call behavior
+        (reference globals.py config objects)."""
         if not self.is_deployed:
             raise RuntimeError(
                 f"{self.pointers.cls_or_fn_name} is not deployed; call "
@@ -20,7 +24,7 @@ class Fn(Module):
         return self._http_client().call_method(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout, stream_logs=stream_logs,
-            debugger=debugger)
+            debugger=debugger, metrics=metrics, logging=logging)
 
     async def call_async(self, *args, workers=None,
                          timeout: Optional[float] = None, **kwargs) -> Any:

@@ -176,7 +176,8 @@ class Module:
                 f"No service URL for {self.name!r} and no controller "
                 "configured to route through")
         if self._client is None or self._client.base_url != base.rstrip("/"):
-            self._client = HTTPClient(base, proxy_url=proxy)
+            self._client = HTTPClient(base, proxy_url=proxy,
+                                      service=self.name)
         return self._client
 
     # -- lifecycle ------------------------------------------------------------

@@ -134,3 +134,24 @@ class Secret:
     def __repr__(self) -> str:
         return (f"Secret({self.name!r}, keys={sorted(self.values)}, "
                 f"file={self.file_path!r})")
+
+
+def secret(provider: Optional[str] = None,
+           env: Optional[List[str]] = None,
+           path: Optional[str] = None,
+           name: Optional[str] = None,
+           values: Optional[Dict[str, str]] = None) -> Secret:
+    """Factory mirroring the reference's ``kt.secret(...)``
+    (``secret_factory.py:8``): provider preset, explicit env var names, a
+    credential file path, or literal values — exactly one source."""
+    sources = [s for s in (provider, env, path, values) if s]
+    if len(sources) != 1:
+        raise ValueError("pass exactly one of provider=, env=, path=, "
+                         "values=")
+    if provider:
+        return Secret.from_provider(provider, name=name)
+    if env:
+        return Secret.from_env(env, name=name or "env-secret")
+    if path:
+        return Secret.from_path(path, name=name)
+    return Secret(name or "literal-secret", values=values)

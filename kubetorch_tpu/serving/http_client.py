@@ -72,7 +72,8 @@ class HTTPClient:
 
     def __init__(self, base_url: str, serialization: Optional[str] = None,
                  stream_logs: Optional[bool] = None,
-                 proxy_url: Optional[str] = None):
+                 proxy_url: Optional[str] = None,
+                 service: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.serialization = serialization or config().serialization
         self.stream_logs = (config().stream_logs if stream_logs is None
@@ -81,6 +82,8 @@ class HTTPClient:
         # listening at base_url; the proxy cold-starts it (the Knative
         # activator role) and forwards the held request.
         self.proxy_url = proxy_url.rstrip("/") if proxy_url else None
+        self.service = service       # labels resource-scope PromQL queries
+        self._resource_scope_dead = False   # no metrics stack answered
         self._session = _requests.Session()
 
     # -- calls ----------------------------------------------------------------
@@ -88,8 +91,22 @@ class HTTPClient:
     def call_method(self, fn_name: str, method: Optional[str] = None,
                     args: tuple = (), kwargs: Optional[dict] = None,
                     workers=None, timeout: Optional[float] = None,
-                    debugger: Optional[dict] = None,
-                    stream_logs: Optional[bool] = None) -> Any:
+                    debugger=None,
+                    stream_logs: Optional[bool] = None,
+                    metrics=None, logging=None) -> Any:
+        """``debugger``/``metrics``/``logging`` accept the typed config
+        objects (``kt.DebugConfig`` / ``kt.MetricsConfig`` /
+        ``kt.LoggingConfig``, reference globals.py:40-127) or plain dicts
+        with the same fields."""
+        from ..config import LoggingConfig, MetricsConfig
+        if isinstance(metrics, dict):
+            metrics = MetricsConfig(**metrics)
+        if isinstance(logging, dict):
+            logging = LoggingConfig(**logging)
+        if logging is not None and stream_logs is None:
+            stream_logs = logging.stream_logs
+        if hasattr(debugger, "to_dict"):
+            debugger = debugger.to_dict()
         body: Dict[str, Any] = {"args": list(args), "kwargs": kwargs or {}}
         if workers is not None:
             body["_kt_workers"] = workers
@@ -109,9 +126,14 @@ class HTTPClient:
         stop_streaming = None
         stop_metrics = None
         if (self.stream_logs if stream_logs is None else stream_logs):
-            stop_streaming = self._start_log_stream(request_id)
-        if config().stream_metrics:
-            stop_metrics = self._start_metric_stream()
+            stop_streaming = self._start_log_stream(
+                request_id,
+                include_name=(logging.include_name if logging else True),
+                grace=(logging.grace_period if logging else None))
+        if metrics is not None or config().stream_metrics:
+            stop_metrics = self._start_metric_stream(
+                interval=(metrics.interval if metrics else None),
+                scope=(metrics.scope if metrics else "pod"))
         try:
             data = ser.serialize(body, self.serialization)
             headers = {"X-Serialization": self.serialization,
@@ -209,12 +231,44 @@ class HTTPClient:
             parts.append(f"reqs={reqs}")
         return "  ".join(parts)
 
-    def _start_metric_stream(self, interval: Optional[float] = None):
-        """Poll the service's /metrics during a call and echo TPU HBM /
-        activity gauges alongside the streamed logs (reference streams DCGM
-        GPU util via PromQL, ``http_client.py:758-795``; TPU gauges come
-        from the pod's own metrics endpoint — falling back to the
-        controller-proxy route when the pod isn't directly reachable)."""
+    def _resource_scope_line(self) -> Optional[str]:
+        """Service-aggregate gauges via PromQL through the controller
+        (reference ``scope="resource"`` queries, http_client.py:758-795).
+        Needs deploy/metrics.yaml; any failure returns None and the pump
+        falls back to pod scope."""
+        api = config().api_url
+        if not api or not self.service:
+            return None
+        parts = []
+        queries = {
+            "hbm_used": f'sum(kt_tpu_hbm_bytes_in_use{{service="{self.service}"}})',
+            "inflight": f'sum(kt_inflight_requests{{service="{self.service}"}})',
+        }
+        for label, q in queries.items():
+            try:
+                r = _requests.get(f"{api}/controller/metrics/query",
+                                  params={"query": q}, timeout=5)
+                results = r.json().get("data", {}).get("result", [])
+                if r.status_code == 200 and results:
+                    val = float(results[0]["value"][1])
+                    parts.append(
+                        f"{label}={val / 2**30:.2f}GiB"
+                        if label.startswith("hbm") else
+                        f"{label}={val:.0f}")
+            except (_requests.RequestException, ValueError, KeyError,
+                    IndexError):
+                return None
+        return "  ".join(parts) if parts else None
+
+    def _start_metric_stream(self, interval: Optional[float] = None,
+                             scope: str = "pod"):
+        """Poll metrics during a call and echo compact lines alongside the
+        streamed logs (reference streams DCGM GPU util via PromQL,
+        ``http_client.py:758-795``). ``scope="pod"``: the service's own
+        /metrics (TPU HBM gauges), via the controller proxy when the pod
+        isn't directly reachable. ``scope="resource"``: PromQL aggregates
+        across the service's pods, degrading to pod scope when no metrics
+        stack answers."""
         stop = threading.Event()
         if interval is None:
             interval = float(os.environ.get("KT_METRIC_STREAM_INTERVAL", "3"))
@@ -223,6 +277,14 @@ class HTTPClient:
             # module-level requests, NOT self._session: Session isn't
             # thread-safe and the main thread's POST is in flight
             while not stop.wait(interval):
+                if scope == "resource" and not self._resource_scope_dead:
+                    line = self._resource_scope_line()
+                    if line:
+                        print(f"[metrics] {line}", flush=True)
+                        continue
+                    # no metrics stack answered: stay on pod scope instead
+                    # of paying two 5s-timeout queries every tick
+                    self._resource_scope_dead = True
                 for url in (self.base_url, self.proxy_url):
                     if not url:
                         continue
@@ -242,7 +304,8 @@ class HTTPClient:
 
     # -- log streaming --------------------------------------------------------
 
-    def _start_log_stream(self, request_id: str):
+    def _start_log_stream(self, request_id: str, include_name: bool = True,
+                          grace: Optional[float] = None):
         """Poll the controller's log buffer for this request's lines and echo
         them locally (reference streams from Loki over WS; our controller
         exposes the same data over HTTP long-poll)."""
@@ -254,7 +317,8 @@ class HTTPClient:
         # (~1s) and the controller ingest adds latency, so the lines printed
         # at the end of a request land AFTER its response (the reference's
         # LoggingConfig grace-period behavior, globals.py:61-102).
-        grace = float(os.environ.get("KT_LOG_STREAM_GRACE", "3.0"))
+        if grace is None:
+            grace = float(os.environ.get("KT_LOG_STREAM_GRACE", "3.0"))
 
         def pump():
             seen = 0
@@ -271,7 +335,9 @@ class HTTPClient:
                     if r.status_code == 200:
                         data = r.json()
                         for entry in data.get("entries", []):
-                            print(f"[remote] {entry['line']}")
+                            tag = (entry.get("pod") or "remote"
+                                   if include_name else "remote")
+                            print(f"[{tag}] {entry['line']}")
                             got += 1
                         seen = data.get("offset", seen)
                 except _requests.RequestException:

@@ -158,21 +158,20 @@ class TestMetricStreamE2E:
 
         import payloads  # tests/assets
 
-        monkeypatch.setenv("KT_STREAM_METRICS", "1")
-        monkeypatch.setenv("KT_METRIC_STREAM_INTERVAL", "0.2")
         reset_config()
         try:
             f = kt.fn(payloads.sleeper)
             f.to(kt.Compute(cpus=1))
             try:
-                f(2.5)
+                # per-call typed config (reference MetricsConfig), no
+                # global flag needed
+                f(2.5, metrics=kt.MetricsConfig(interval=0.2))
             finally:
                 f.teardown()
             out = capsys.readouterr().out
             assert "[metrics]" in out
             assert "reqs=" in out or "inflight=" in out
         finally:
-            monkeypatch.delenv("KT_STREAM_METRICS")
             reset_config()
 
 

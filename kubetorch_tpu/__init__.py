@@ -19,6 +19,9 @@ __version__ = "0.1.0"
 
 from .exceptions import (  # noqa: F401
     KubetorchError,
+    StartupError,
+    SecretNotFound,
+    KubernetesCredentialsError,
     ImagePullError,
     ResourceNotAvailableError,
     TpuSliceUnavailableError,
@@ -69,6 +72,9 @@ _LAZY = {
     "rm": ".data_store.commands",
     "BroadcastWindow": ".data_store.types",
     "distributed": ".serving.distributed_env",
+    # user-facing breakpoint hook (reference serving/utils.deep_breakpoint)
+    "kt_breakpoint": ".serving.pdb_ws",
+    "deep_breakpoint": ".serving.pdb_ws",
     "MeshSpec": ".parallel.mesh",
 }
 

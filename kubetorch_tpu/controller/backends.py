@@ -233,7 +233,10 @@ class LocalBackend:
             fpath = os.path.join(self._secret_dir(namespace, sname),
                                  "__file__")
             if os.path.exists(fpath):
-                env["KT_SECRET_FILE_" + sname.upper().replace("-", "_")] = fpath
+                # env key carries the BASE secret's name: the payload rides
+                # a companion <name>-file object (Secret.save's split)
+                base = sname[:-5] if sname.endswith("-file") else sname
+                env["KT_SECRET_FILE_" + base.upper().replace("-", "_")] = fpath
         return env
 
     def _next_ips(self, service_key: str, n: int) -> List[str]:
@@ -379,10 +382,12 @@ class KubernetesBackend:
     }
 
     def __init__(self, kubectl: Optional[str] = None):
+        from ..exceptions import KubernetesCredentialsError
         self.kubectl = (kubectl or os.environ.get("KT_KUBECTL")
                         or shutil.which("kubectl"))
         if self.kubectl is None:
-            raise RuntimeError("kubectl not found; KubernetesBackend unavailable")
+            raise KubernetesCredentialsError(
+                "kubectl not found; KubernetesBackend unavailable")
         self.kinds: Dict[str, str] = {}  # "ns/name" -> applied manifest kind
 
     @staticmethod

@@ -54,12 +54,28 @@ class TpuSliceUnavailableError(ResourceNotAvailableError):
         self.topology = topology
 
 
-class ServiceHealthError(KubetorchError):
+class StartupError(KubetorchError):
+    """Deploy-time startup failure (reference ``serving/utils.py``
+    StartupError): base for the health/timeout variants so callers can
+    catch every way a ``.to()`` fails to produce a serving pod."""
+
+
+class ServiceHealthError(StartupError):
     """Service came up but failed its health probe."""
 
 
-class ServiceTimeoutError(KubetorchError):
+class ServiceTimeoutError(StartupError):
     """Service did not become ready within the launch timeout."""
+
+
+class SecretNotFound(KubetorchError):
+    """Named Secret does not exist in the cluster (reference
+    ``compute/utils.py`` SecretNotFound)."""
+
+
+class KubernetesCredentialsError(KubetorchError):
+    """kubectl missing or cluster credentials unusable (reference
+    ``provisioning/utils.py`` KubernetesCredentialsError)."""
 
 
 class PodContainerError(KubetorchError):
@@ -202,6 +218,9 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
     cls.__name__: cls
     for cls in (
         KubetorchError,
+        StartupError,
+        SecretNotFound,
+        KubernetesCredentialsError,
         ImagePullError,
         ResourceNotAvailableError,
         TpuSliceUnavailableError,

@@ -145,7 +145,11 @@ def build_pod_template(name: str, image: str, env: Dict[str, str],
             fname = posixpath.basename(mount)
             pod_volumes.append({
                 "name": vol_name,
-                "secret": {"secretName": sname, "defaultMode": 0o600,
+                # the file payload lives in a SEPARATE <name>-file Secret
+                # (Secret.save): the base object must stay safe to expand
+                # via blanket envFrom
+                "secret": {"secretName": f"{sname}-file",
+                           "defaultMode": 0o600,
                            "items": [{"key": "__file__", "path": fname}]}})
             # subPath overlays ONLY the credential file — mounting the
             # volume at dirname would mask the whole directory read-only

@@ -21,6 +21,14 @@ class Fn(Module):
             raise RuntimeError(
                 f"{self.pointers.cls_or_fn_name} is not deployed; call "
                 f".to(kt.Compute(...)) first")
+        # only the TYPED objects are client config here — a plain dict named
+        # `metrics`/`logging` belongs to the remote function's own kwargs
+        # (pre-existing user signatures must keep working)
+        from ..config import LoggingConfig, MetricsConfig
+        if metrics is not None and not isinstance(metrics, MetricsConfig):
+            kwargs["metrics"], metrics = metrics, None
+        if logging is not None and not isinstance(logging, LoggingConfig):
+            kwargs["logging"], logging = logging, None
         return self._http_client().call_method(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout, stream_logs=stream_logs,

@@ -42,12 +42,19 @@ class Secret:
     def __init__(self, name: str, values: Optional[Dict[str, str]] = None,
                  file_path: Optional[str] = None,
                  mount_path: Optional[str] = None,
-                 provider: Optional[str] = None):
+                 provider: Optional[str] = None,
+                 namespace: Optional[str] = None):
         self.name = name
         self.values = dict(values or {})
         self.file_path = file_path
         self.mount_path = mount_path
         self.provider = provider
+        # pinned by from_name(namespace=...): every later operation must
+        # target the namespace the binding was verified in
+        self.namespace = namespace
+
+    def _ns(self, namespace: Optional[str]) -> str:
+        return namespace or self.namespace or config().namespace
 
     # -- factories (reference secret_factory.py) ------------------------------
 
@@ -70,6 +77,23 @@ class Secret:
         return cls(name or f"{provider}-secret", values=values,
                    file_path=file_path, provider=provider,
                    mount_path=spec["path"])
+
+    @classmethod
+    def from_name(cls, name: str,
+                  namespace: Optional[str] = None) -> "Secret":
+        """Bind to an EXISTING cluster Secret by name — values stay in the
+        object (reads return metadata/key names only); raises
+        :class:`~kubetorch_tpu.exceptions.SecretNotFound` when absent."""
+        from ..exceptions import SecretNotFound
+
+        obj = controller_client().get_object(
+            "Secret", namespace or config().namespace, name)
+        if obj is None:
+            raise SecretNotFound(f"no Secret {name!r} in "
+                                 f"{namespace or config().namespace}")
+        # reads are value-stripped by design; a name-only ref delivers via
+        # envFrom on the pod template (keys unknown client-side)
+        return cls(name, namespace=namespace)
 
     @classmethod
     def from_env(cls, keys: List[str], name: str = "env-secret") -> "Secret":
@@ -113,23 +137,36 @@ class Secret:
     # -- cluster CRUD through the controller ----------------------------------
 
     def save(self, namespace: Optional[str] = None) -> Dict:
-        data = dict(self.values)
-        if self.file_path:
-            data["__file__"] = Path(self.file_path).read_text()
-            data["__mount_path__"] = self.mount_path or ""
-        return controller_client().apply(
-            namespace or config().namespace, self.name,
+        """Materialize the Secret object(s). File payloads go to a SEPARATE
+        ``<name>-file`` Secret: the env object may legitimately be expanded
+        with a blanket ``envFrom`` (name-only refs), and a ``__file__`` key
+        there would inject the whole credential file into pod env."""
+        ns = self._ns(namespace)
+        client = controller_client()
+        result = client.apply(
+            ns, self.name,
             manifest={"apiVersion": "v1", "kind": "Secret",
                       "metadata": {"name": self.name},
-                      "stringData": data})
+                      "stringData": dict(self.values)})
+        if self.file_path:
+            client.apply(
+                ns, f"{self.name}-file",
+                manifest={"apiVersion": "v1", "kind": "Secret",
+                          "metadata": {"name": f"{self.name}-file"},
+                          "stringData": {
+                              "__file__": Path(self.file_path).read_text(),
+                              "__mount_path__": self.mount_path or ""}})
+        return result
 
     def delete(self, namespace: Optional[str] = None) -> Dict:
-        return controller_client().delete_object(
-            "Secret", namespace or config().namespace, self.name)
+        ns = self._ns(namespace)
+        result = controller_client().delete_object("Secret", ns, self.name)
+        controller_client().delete_object("Secret", ns, f"{self.name}-file")
+        return result
 
     def exists(self, namespace: Optional[str] = None) -> bool:
         return controller_client().get_object(
-            "Secret", namespace or config().namespace, self.name) is not None
+            "Secret", self._ns(namespace), self.name) is not None
 
     def __repr__(self) -> str:
         return (f"Secret({self.name!r}, keys={sorted(self.values)}, "

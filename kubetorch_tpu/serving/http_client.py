@@ -248,6 +248,12 @@ class HTTPClient:
             try:
                 r = _requests.get(f"{api}/controller/metrics/query",
                                   params={"query": q}, timeout=5)
+                if r.status_code == 503:
+                    # the controller SAYS no metrics stack is configured —
+                    # the only signal worth latching on; transient errors
+                    # and not-yet-scraped pods must keep retrying
+                    self._resource_scope_dead = True
+                    return None
                 results = r.json().get("data", {}).get("result", [])
                 if r.status_code == 200 and results:
                     val = float(results[0]["value"][1])
@@ -278,13 +284,13 @@ class HTTPClient:
             # thread-safe and the main thread's POST is in flight
             while not stop.wait(interval):
                 if scope == "resource" and not self._resource_scope_dead:
+                    # _resource_scope_line latches _resource_scope_dead
+                    # itself — only on the controller's explicit "no stack
+                    # configured"; empty/transient results keep retrying
                     line = self._resource_scope_line()
                     if line:
                         print(f"[metrics] {line}", flush=True)
                         continue
-                    # no metrics stack answered: stay on pod scope instead
-                    # of paying two 5s-timeout queries every tick
-                    self._resource_scope_dead = True
                 for url in (self.base_url, self.proxy_url):
                     if not url:
                         continue

@@ -48,7 +48,7 @@ class TestManifestRefs:
         # name-only ref without a mount: keys unknown → envFrom fallback
         assert container["envFrom"] == [{"secretRef": {"name": "plain-ref"}}]
         vol = next(v for v in spec["volumes"] if v["name"] == "secret-aws-secret")
-        assert vol["secret"]["secretName"] == "aws-secret"
+        assert vol["secret"]["secretName"] == "aws-secret-file"
         assert vol["secret"]["items"] == [{"key": "__file__",
                                            "path": "credentials"}]
         mount = next(m for m in container["volumeMounts"]
@@ -78,6 +78,25 @@ class TestManifestRefs:
         assert cleaned["manifest"]["metadata"]["name"] == "tok"
 
 
+class TestFromName:
+    def test_binds_existing_and_raises_on_missing(self, monkeypatch):
+        from kubetorch_tpu.exceptions import SecretNotFound
+        from kubetorch_tpu.resources import secret as secret_mod
+
+        class StubClient:
+            def get_object(self, kind, ns, name):
+                if (kind, name) == ("Secret", "tok"):
+                    return {"metadata": {"name": "tok"}, "keys": ["A"]}
+                return None
+
+        monkeypatch.setattr(secret_mod, "controller_client",
+                            lambda: StubClient())
+        s = Secret.from_name("tok")
+        assert s.name == "tok" and s.values == {}
+        with pytest.raises(SecretNotFound, match="nope"):
+            Secret.from_name("nope")
+
+
 class TestLocalSecretStore:
     """LocalBackend: values land in 0600 files, pods resolve envFrom refs."""
 
@@ -89,17 +108,21 @@ class TestLocalSecretStore:
         be = LocalBackend("http://127.0.0.1:1", secrets_dir=str(tmp_path))
         out = be.apply("ns1", "tok", {
             "kind": "Secret", "metadata": {"name": "tok"},
-            "stringData": {"MY_TOKEN": SENTINEL, "__file__": "filedata",
-                           "__mount_path__": "~/.aws/credentials"}}, {})
+            "stringData": {"MY_TOKEN": SENTINEL}}, {})
         assert out == {"kind": "Secret", "stored": True}
+        # the file payload rides a companion <name>-file object
+        # (Secret.save's split: the base object stays envFrom-safe)
+        be.apply("ns1", "tok-file", {
+            "kind": "Secret", "metadata": {"name": "tok-file"},
+            "stringData": {"__file__": "filedata",
+                           "__mount_path__": "~/.aws/credentials"}}, {})
         # values in 0600 files under a 0700 dir, not in memory
         sdir = tmp_path / "ns1__tok"
         assert stat.S_IMODE(os.stat(sdir).st_mode) == 0o700
         assert stat.S_IMODE(os.stat(sdir / "MY_TOKEN").st_mode) == 0o600
         assert (sdir / "MY_TOKEN").read_text() == SENTINEL
         assert SENTINEL not in json.dumps(be.objects)
-        assert be.objects["Secret/ns1/tok"]["keys"] == [
-            "MY_TOKEN", "__file__", "__mount_path__"]
+        assert be.objects["Secret/ns1/tok"]["keys"] == ["MY_TOKEN"]
 
         pod = build_pod_template("web", "img", {}, secrets=[
             {"name": "tok", "mount_path": "~/.aws/credentials",
@@ -107,8 +130,9 @@ class TestLocalSecretStore:
         env = be._secret_env("ns1", build_deployment_manifest(
             "web", "ns1", 1, pod))
         assert env["MY_TOKEN"] == SENTINEL
-        assert env["KT_SECRET_FILE_TOK"] == str(sdir / "__file__")
-        assert (sdir / "__file__").read_text() == "filedata"
+        fdir = tmp_path / "ns1__tok-file"
+        assert env["KT_SECRET_FILE_TOK"] == str(fdir / "__file__")
+        assert (fdir / "__file__").read_text() == "filedata"
 
         # delete removes the files
         assert be.delete("ns1", "tok") is True

@@ -47,11 +47,42 @@ def check():
     except Exception:
         click.echo("kubernetes       : not configured")
     try:
-        import jax
-        devs = jax.devices()
-        click.echo(f"jax devices      : {devs}")
+        from .client import controller_client
+        store = controller_client().cluster_config().get("data_store_url")
+        if store:
+            import requests as _requests
+            r = _requests.get(f"{store}/health", timeout=3)
+            click.echo(f"data store       : "
+                       f"{'OK' if r.status_code == 200 else r.status_code} "
+                       f"({store})")
+        else:
+            click.echo("data store       : not configured")
     except Exception as e:
-        click.echo(f"jax devices      : ERROR ({e})")
+        click.echo(f"data store       : UNREACHABLE ({e})")
+    from .native import available as native_available, blobd_available
+    click.echo(f"native runtime   : "
+               f"lib={'OK' if native_available() else 'not built'}  "
+               f"blobd={'OK' if blobd_available() else 'not built'} "
+               f"(make -C kubetorch_tpu/native)")
+    # accelerator probe in a SUBPROCESS with a hard timeout: a wedged TPU
+    # relay hangs backend init, and a doctor that hangs diagnoses nothing
+    import subprocess as _subprocess
+    import sys as _sys
+    try:
+        probe = _subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; print([str(d) for d in jax.devices()])"],
+            capture_output=True, text=True, timeout=30)
+        if probe.returncode == 0:
+            click.echo(f"accelerators     : {probe.stdout.strip()}")
+        else:
+            err_lines = probe.stderr.strip().splitlines()
+            reason = (err_lines[-1][:120] if err_lines
+                      else f"probe exited rc={probe.returncode}")
+            click.echo(f"accelerators     : ERROR ({reason})")
+    except _subprocess.TimeoutExpired:
+        click.echo("accelerators     : TIMEOUT after 30s (TPU relay "
+                   "hung/unavailable; CPU work unaffected)")
 
 
 # -- config ------------------------------------------------------------------

@@ -93,7 +93,12 @@ class Secret:
                                  f"{namespace or config().namespace}")
         # reads are value-stripped by design; a name-only ref delivers via
         # envFrom on the pod template (keys unknown client-side)
-        return cls(name, namespace=namespace)
+        secret = cls(name, namespace=namespace)
+        # by-reference binding: this object holds NO values, so save() must
+        # never apply it — an empty stringData apply would WIPE the existing
+        # cluster secret (and the Compute attach flow saves automatically)
+        secret._by_reference = True
+        return secret
 
     @classmethod
     def from_env(cls, keys: List[str], name: str = "env-secret") -> "Secret":
@@ -141,6 +146,10 @@ class Secret:
         ``<name>-file`` Secret: the env object may legitimately be expanded
         with a blanket ``envFrom`` (name-only refs), and a ``__file__`` key
         there would inject the whole credential file into pod env."""
+        if getattr(self, "_by_reference", False):
+            # from_name binding: the cluster object is the source of truth;
+            # applying this value-less handle would erase it
+            return {"ok": True, "by_reference": True}
         ns = self._ns(namespace)
         client = controller_client()
         result = client.apply(

@@ -83,7 +83,8 @@ class HTTPClient:
         # activator role) and forwards the held request.
         self.proxy_url = proxy_url.rstrip("/") if proxy_url else None
         self.service = service       # labels resource-scope PromQL queries
-        self._resource_scope_dead = False   # no metrics stack answered
+        self._resource_scope_dead = False   # controller said: no stack
+        self._resource_scope_fails = 0      # consecutive-failure backoff
         self._session = _requests.Session()
 
     # -- calls ----------------------------------------------------------------
@@ -282,15 +283,26 @@ class HTTPClient:
         def pump():
             # module-level requests, NOT self._session: Session isn't
             # thread-safe and the main thread's POST is in flight
+            tick = 0
             while not stop.wait(interval):
+                tick += 1
                 if scope == "resource" and not self._resource_scope_dead:
-                    # _resource_scope_line latches _resource_scope_dead
-                    # itself — only on the controller's explicit "no stack
-                    # configured"; empty/transient results keep retrying
-                    line = self._resource_scope_line()
-                    if line:
-                        print(f"[metrics] {line}", flush=True)
-                        continue
+                    # exponential backoff on consecutive failures: a fresh
+                    # deploy's not-yet-scraped window recovers (unlike a
+                    # permanent latch), but a dead/stale controller can't
+                    # charge every tick two 5s query timeouts. The explicit
+                    # "no stack configured" 503 still latches immediately
+                    # (inside _resource_scope_line).
+                    if self._resource_scope_fails and (
+                            tick % min(2 ** self._resource_scope_fails, 32)):
+                        pass
+                    else:
+                        line = self._resource_scope_line()
+                        if line:
+                            self._resource_scope_fails = 0
+                            print(f"[metrics] {line}", flush=True)
+                            continue
+                        self._resource_scope_fails += 1
                 for url in (self.base_url, self.proxy_url):
                     if not url:
                         continue

@@ -93,6 +93,10 @@ class TestFromName:
                             lambda: StubClient())
         s = Secret.from_name("tok")
         assert s.name == "tok" and s.values == {}
+        # by-reference binding: save() must be a NO-OP — applying this
+        # value-less handle would wipe the existing cluster secret (and
+        # Compute attaches call save automatically)
+        assert s.save() == {"ok": True, "by_reference": True}
         with pytest.raises(SecretNotFound, match="nope"):
             Secret.from_name("nope")
 

@@ -1,0 +1,65 @@
+"""Autoscaled LLM inference service: deploy a generator class that compiles
+once (``__kt_warmup__`` holds ``/ready`` until the decode loop is jitted),
+scales on request concurrency, and scales to ZERO when idle — the first call
+after an idle window cold-starts through the controller proxy (the Knative
+activator role).
+
+Run: ``python examples/inference_service.py`` (local pods; on a cluster the
+same code with ``tpu="v5e-8"``).
+"""
+
+import kubetorch_tpu as kt
+
+
+class Generator:
+    """Stateful service: params + jitted decode live across calls."""
+
+    def __init__(self, seq_len: int = 128):
+        import jax
+
+        from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+
+        self.cfg = LlamaConfig.tiny(max_seq_len=seq_len, attn_impl="xla")
+        self.params = llama_init(jax.random.PRNGKey(0), self.cfg)
+        self.seq_len = seq_len
+
+    def __kt_warmup__(self):
+        # pay the jit compile before /ready admits traffic: the first
+        # routed request must not eat the compile latency
+        self.generate([1, 2, 3], max_new_tokens=4)
+
+    def generate(self, prompt_tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.8):
+        import jax
+        import jax.numpy as jnp
+
+        from kubetorch_tpu.models.generate import generate
+
+        prompt = jnp.asarray([prompt_tokens], dtype=jnp.int32)
+        out = generate(self.params, prompt, self.cfg,
+                       max_new_tokens=max_new_tokens,
+                       temperature=temperature,
+                       rng=jax.random.PRNGKey(0))
+        return out[0].tolist()
+
+
+def main():
+    svc = kt.cls(Generator, init_kwargs={"seq_len": 128})
+    svc.to(kt.Compute(cpus=1).autoscale(
+        min_scale=0,            # scale to zero when idle
+        max_scale=4,
+        target=2,               # concurrency target: pods added as load grows
+        scale_down_delay="30s"))
+    try:
+        tokens = svc.generate([1, 5, 9], max_new_tokens=16)
+        print(f"generated {len(tokens)} tokens: {tokens}")
+        # metrics stream alongside the call:
+        tokens = svc.generate([2, 4], max_new_tokens=16,
+                              metrics=kt.MetricsConfig(interval=1.0))
+        print(f"second call ok ({len(tokens)} tokens)")
+    finally:
+        svc.teardown()
+
+
+if __name__ == "__main__":
+    main()

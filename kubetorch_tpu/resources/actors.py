@@ -32,9 +32,12 @@ class _ActorMethod:
         self.name = name
 
     def __call__(self, *args, timeout: Optional[float] = None, **kwargs):
+        from .module import extract_call_config
+        call_cfg = extract_call_config(kwargs)
         result = self.mesh._module._http_client().call_method(
             self.mesh._module.pointers.cls_or_fn_name, method=self.name,
-            args=args, kwargs=kwargs, workers=self.selector, timeout=timeout)
+            args=args, kwargs=kwargs, workers=self.selector, timeout=timeout,
+            **call_cfg)
         if isinstance(self.selector, list) and len(self.selector) == 1 and \
                 isinstance(result, list) and len(result) == 1:
             return result[0]

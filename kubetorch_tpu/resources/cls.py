@@ -22,9 +22,11 @@ class Cls(Module):
                 raise RuntimeError(
                     f"{self.pointers.cls_or_fn_name} is not deployed; call "
                     f".to(kt.Compute(...)) first")
+            from .module import extract_call_config
+            call_cfg = extract_call_config(kwargs)
             return self._http_client().call_method(
                 self.pointers.cls_or_fn_name, method=attr, args=args,
-                kwargs=kwargs, workers=workers, timeout=timeout)
+                kwargs=kwargs, workers=workers, timeout=timeout, **call_cfg)
 
         remote_method.__name__ = attr
         return remote_method

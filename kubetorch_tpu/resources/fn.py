@@ -21,21 +21,36 @@ class Fn(Module):
             raise RuntimeError(
                 f"{self.pointers.cls_or_fn_name} is not deployed; call "
                 f".to(kt.Compute(...)) first")
-        # only the TYPED objects are client config here — a plain dict named
+        # only TYPED objects are client config — a plain dict named
         # `metrics`/`logging` belongs to the remote function's own kwargs
-        # (pre-existing user signatures must keep working)
+        # (pre-existing user signatures must keep working). Typed objects
+        # under ANY kwarg name route the same way (shared with Cls proxies).
         from ..config import LoggingConfig, MetricsConfig
+        from .module import extract_call_config
         if metrics is not None and not isinstance(metrics, MetricsConfig):
             kwargs["metrics"], metrics = metrics, None
         if logging is not None and not isinstance(logging, LoggingConfig):
             kwargs["logging"], logging = logging, None
+        call_cfg = extract_call_config(kwargs)
+        for slot, named in (("metrics", metrics), ("logging", logging),
+                            ("debugger", debugger)):
+            if named is not None and call_cfg[slot] is not None:
+                raise ValueError(f"two {slot} configs in one call — pass "
+                                 "exactly one")
         return self._http_client().call_method(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout, stream_logs=stream_logs,
-            debugger=debugger, metrics=metrics, logging=logging)
+            debugger=debugger or call_cfg["debugger"],
+            metrics=metrics or call_cfg["metrics"],
+            logging=logging or call_cfg["logging"])
 
     async def call_async(self, *args, workers=None,
                          timeout: Optional[float] = None, **kwargs) -> Any:
+        # typed config objects must not leak into the remote kwargs (they
+        # aren't serializable); the async path has no streaming pumps, so
+        # they are extracted and ignored rather than half-honored
+        from .module import extract_call_config
+        extract_call_config(kwargs)
         return await self._http_client().call_method_async(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout)

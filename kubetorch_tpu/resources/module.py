@@ -22,6 +22,30 @@ from .compute import Compute
 from .pointers import Pointers, extract_pointers
 
 
+def extract_call_config(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Pop TYPED per-call config objects (kt.MetricsConfig /
+    kt.LoggingConfig / kt.DebugConfig) out of a remote call's kwargs —
+    keyed by TYPE, not name, so they work on any proxy (Fn, Cls methods,
+    actors) without reserving kwarg names: a plain dict named ``metrics``
+    still reaches the remote function. Two configs of one type in a single
+    call is ambiguous and raises rather than silently dropping one."""
+    from ..config import DebugConfig, LoggingConfig, MetricsConfig
+
+    slot_for = {MetricsConfig: "metrics", LoggingConfig: "logging",
+                DebugConfig: "debugger"}
+    out: Dict[str, Any] = {"metrics": None, "logging": None, "debugger": None}
+    for key in list(kwargs):
+        for cfg_type, slot in slot_for.items():
+            if isinstance(kwargs[key], cfg_type):
+                if out[slot] is not None:
+                    raise ValueError(
+                        f"two {cfg_type.__name__} objects in one call "
+                        f"(kwarg {key!r}) — pass exactly one")
+                out[slot] = kwargs.pop(key)
+                break
+    return out
+
+
 class Module:
     callable_type = "fn"
 

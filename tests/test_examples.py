@@ -30,3 +30,23 @@ def test_rlhf_actor_learner_example(capsys):
     finally:
         shutdown_local_controller()
         reset_config()
+
+
+@pytest.mark.slow
+def test_inference_service_example(capsys):
+    """Autoscaled stateful generation service: warmup-gated readiness,
+    per-call metrics config, scale-to-zero annotations — the serving story
+    end-to-end on local pods."""
+    from kubetorch_tpu.client import shutdown_local_controller
+    from kubetorch_tpu.config import reset_config
+
+    import inference_service
+
+    try:
+        inference_service.main()
+        out = capsys.readouterr().out
+        assert "generated 19 tokens" in out     # 3 prompt + 16 new
+        assert "second call ok (18 tokens)" in out
+    finally:
+        shutdown_local_controller()
+        reset_config()

@@ -24,9 +24,11 @@ class Generator:
         self.seq_len = seq_len
 
     def __kt_warmup__(self):
-        # pay the jit compile before /ready admits traffic: the first
-        # routed request must not eat the compile latency
-        self.generate([1, 2, 3], max_new_tokens=4)
+        # pay the jit compile before /ready admits traffic. generate()
+        # compiles once per (prompt_len, max_new_tokens) shape — warm the
+        # SHAPE you will serve (here: the 3-token/16-new contract main()
+        # uses), or the first routed request recompiles anyway.
+        self.generate([1, 2, 3], max_new_tokens=16)
 
     def generate(self, prompt_tokens, max_new_tokens: int = 32,
                  temperature: float = 0.8):
@@ -54,7 +56,7 @@ def main():
         tokens = svc.generate([1, 5, 9], max_new_tokens=16)
         print(f"generated {len(tokens)} tokens: {tokens}")
         # metrics stream alongside the call:
-        tokens = svc.generate([2, 4], max_new_tokens=16,
+        tokens = svc.generate([2, 4, 6], max_new_tokens=16,
                               metrics=kt.MetricsConfig(interval=1.0))
         print(f"second call ok ({len(tokens)} tokens)")
     finally:

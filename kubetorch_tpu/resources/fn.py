@@ -16,7 +16,13 @@ class Fn(Module):
                  **kwargs) -> Any:
         """``debugger=kt.DebugConfig(...)``, ``metrics=kt.MetricsConfig(...)``
         and ``logging=kt.LoggingConfig(...)`` carry per-call behavior
-        (reference globals.py config objects)."""
+        (reference globals.py config objects).
+
+        Reserved client kwarg names: ``workers``, ``timeout``,
+        ``stream_logs``, ``debugger`` — a remote function's own parameter
+        with one of these names must be passed positionally. ``metrics``/
+        ``logging`` are NOT reserved: only typed config objects route to
+        the client; dicts with those names reach the remote function."""
         if not self.is_deployed:
             raise RuntimeError(
                 f"{self.pointers.cls_or_fn_name} is not deployed; call "
@@ -31,26 +37,25 @@ class Fn(Module):
             kwargs["metrics"], metrics = metrics, None
         if logging is not None and not isinstance(logging, LoggingConfig):
             kwargs["logging"], logging = logging, None
-        call_cfg = extract_call_config(kwargs)
-        for slot, named in (("metrics", metrics), ("logging", logging),
-                            ("debugger", debugger)):
-            if named is not None and call_cfg[slot] is not None:
-                raise ValueError(f"two {slot} configs in one call — pass "
-                                 "exactly one")
+        call_cfg = extract_call_config(kwargs, metrics=metrics,
+                                       logging=logging, debugger=debugger)
         return self._http_client().call_method(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout, stream_logs=stream_logs,
-            debugger=debugger or call_cfg["debugger"],
-            metrics=metrics or call_cfg["metrics"],
-            logging=logging or call_cfg["logging"])
+            **call_cfg)
 
     async def call_async(self, *args, workers=None,
                          timeout: Optional[float] = None, **kwargs) -> Any:
-        # typed config objects must not leak into the remote kwargs (they
-        # aren't serializable); the async path has no streaming pumps, so
-        # they are extracted and ignored rather than half-honored
+        # the async path has no streaming pumps/debug arming, so typed
+        # config objects can't be honored — extracted with a WARNING, not
+        # silently dropped (and not leaked into remote kwargs)
         from .module import extract_call_config
-        extract_call_config(kwargs)
+        dropped = {k: v for k, v in extract_call_config(kwargs).items() if v}
+        if dropped:
+            import warnings
+            warnings.warn(f"call_async ignores client call-config objects "
+                          f"({', '.join(sorted(dropped))}): streaming/debug "
+                          "pumps are sync-call features", stacklevel=2)
         return await self._http_client().call_method_async(
             self.pointers.cls_or_fn_name, args=args, kwargs=kwargs,
             workers=workers, timeout=timeout)

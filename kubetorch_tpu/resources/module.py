@@ -22,18 +22,24 @@ from .compute import Compute
 from .pointers import Pointers, extract_pointers
 
 
-def extract_call_config(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+def extract_call_config(kwargs: Dict[str, Any],
+                        **seeds: Any) -> Dict[str, Any]:
     """Pop TYPED per-call config objects (kt.MetricsConfig /
     kt.LoggingConfig / kt.DebugConfig) out of a remote call's kwargs —
     keyed by TYPE, not name, so they work on any proxy (Fn, Cls methods,
     actors) without reserving kwarg names: a plain dict named ``metrics``
-    still reaches the remote function. Two configs of one type in a single
-    call is ambiguous and raises rather than silently dropping one."""
+    still reaches the remote function. To send one of these types TO the
+    remote function (pickle serialization), pass it positionally.
+
+    ``seeds`` are configs already captured by a proxy's named params (Fn's
+    ``metrics=``/``logging=``/``debugger=``); a second config of the same
+    type is ambiguous and raises — never silently dropped."""
     from ..config import DebugConfig, LoggingConfig, MetricsConfig
 
     slot_for = {MetricsConfig: "metrics", LoggingConfig: "logging",
                 DebugConfig: "debugger"}
     out: Dict[str, Any] = {"metrics": None, "logging": None, "debugger": None}
+    out.update({k: v for k, v in seeds.items() if v is not None})
     for key in list(kwargs):
         for cfg_type, slot in slot_for.items():
             if isinstance(kwargs[key], cfg_type):

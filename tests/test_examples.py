@@ -42,11 +42,12 @@ def test_inference_service_example(capsys):
 
     import inference_service
 
+    reset_config()
     try:
         inference_service.main()
         out = capsys.readouterr().out
         assert "generated 19 tokens" in out     # 3 prompt + 16 new
-        assert "second call ok (18 tokens)" in out
+        assert "second call ok (19 tokens)" in out
     finally:
         shutdown_local_controller()
         reset_config()

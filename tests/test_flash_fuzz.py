@@ -1,0 +1,113 @@
+"""Flash-kernel property sweep: randomized shape/config matrix vs the XLA
+reference, plus numerical-stability probes (interpret mode on CPU — the same
+code path the TPU compiles).
+
+Complements the targeted cases in test_flash_attention.py with breadth:
+MQA/GQA ratios, non-power-of-two sequence lengths, head dims, both
+causalities, custom scales, bf16 inputs, and large-magnitude logits that
+punish a naive (non-online) softmax.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.level("release")  # jit-heavy matrix: full tier only
+
+from kubetorch_tpu.models.llama import _xla_attention
+from kubetorch_tpu.ops.attention import flash_attention
+
+CASES = [
+    # (batch, seq, heads, kv_heads, head_dim, causal)
+    (1, 32, 2, 1, 32, True),       # MQA, tiny
+    (3, 160, 4, 4, 32, True),      # MHA, seq not a block multiple
+    (2, 256, 8, 2, 64, True),      # GQA 4:1
+    (1, 224, 6, 3, 128, True),     # GQA 2:1, wide heads, odd seq
+    (2, 96, 4, 1, 64, False),      # non-causal MQA
+    (1, 128, 8, 8, 32, False),     # non-causal MHA
+]
+
+
+@pytest.mark.parametrize("b,s,n,nkv,hd,causal", CASES)
+def test_fuzz_forward(b, s, n, nkv, hd, causal):
+    ks = jax.random.split(jax.random.PRNGKey(s * n + nkv), 3)
+    q = jax.random.normal(ks[0], (b, s, n, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    if causal:
+        ref = _xla_attention(q, k, v, scale=hd ** -0.5)
+    else:
+        group = n // nkv
+        qg = q.reshape(b, s, nkv, group, hd)
+        logits = jnp.einsum("bskgh,btkh->bkgst", qg, k) * hd ** -0.5
+        ref = jnp.einsum("bkgst,btkh->bskgh",
+                         jax.nn.softmax(logits, -1), v).reshape(b, s, n, hd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_custom_scale():
+    b, s, n, nkv, hd = 1, 64, 4, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, s, n, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.float32)
+    out = flash_attention(q, k, v, scale=0.25, block_q=32, block_k=32)
+    ref = _xla_attention(q, k, v, scale=0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_bf16_inputs():
+    """The production dtype: bf16 in, accumulation must stay sane."""
+    b, s, n, nkv, hd = 2, 128, 4, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (b, s, n, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = _xla_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), scale=hd ** -0.5)
+    # bf16 has ~3 decimal digits; compare loosely but meaningfully
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_large_logit_stability():
+    """Scaled-up queries push logits to ±80: a non-online softmax overflows
+    to inf/nan here; the running-max rescale must not."""
+    b, s, n, nkv, hd = 1, 128, 2, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = 20.0 * jax.random.normal(ks[0], (b, s, n, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.float32)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    assert np.isfinite(np.asarray(out)).all()
+    ref = _xla_attention(q, k, v, scale=hd ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("b,s,n,nkv,hd,causal", CASES[:3])
+def test_fuzz_backward(b, s, n, nkv, hd, causal):
+    ks = jax.random.split(jax.random.PRNGKey(s + n), 3)
+    q = jax.random.normal(ks[0], (b, s, n, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, hd), jnp.float32)
+    scale = hd ** -0.5
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=64, block_k=64) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, scale) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, r, name in zip(g_flash, g_ref, "qkv"):
+        denom = np.abs(np.asarray(r)).max() + 1e-9
+        rel = np.abs(np.asarray(a) - np.asarray(r)).max() / denom
+        assert rel < 1e-3, f"d{name} rel err {rel:.2e} ({b},{s},{n},{nkv},{hd})"

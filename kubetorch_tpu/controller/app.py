@@ -311,9 +311,13 @@ async def prom_query(request: web.Request) -> web.Response:
     prom = (os.environ.get("KT_PROMETHEUS_URL")
             or state.cluster_config.get("prometheus_url"))
     if not prom:
+        # the dedicated header lets clients tell THIS sentinel apart from a
+        # 503 relayed from a transiently-unavailable Prometheus — only the
+        # former should disable resource-scope streaming for good
         return web.json_response({"error": "no metrics stack configured "
                                            "(deploy/metrics.yaml)"},
-                                 status=503)
+                                 status=503,
+                                 headers={"X-KT-Unconfigured": "metrics"})
     return await _relay(request, f"{prom.rstrip('/')}/api/v1/query",
                         error_label="prometheus")
 
@@ -337,9 +341,31 @@ async def get_object(request: web.Request) -> web.Response:
         return web.json_response({"error": f"{kind} {ns}/{name} not found"},
                                  status=404)
     if kind == "Secret":
-        obj = {k: v for k, v in obj.items()
-               if k not in ("data", "stringData")}
+        obj = _scrub_secret_object(obj)
     return web.json_response({"object": obj})
+
+
+def _scrub_secret_object(obj: dict) -> dict:
+    """Remove every field that can carry secret payload, not just the
+    top-level data/stringData: on the k8s backend the object comes back
+    from `kubectl get -o json` after a client-side apply, whose
+    `kubectl.kubernetes.io/last-applied-configuration` annotation embeds
+    the full original stringData, and managedFields can name the keys."""
+    obj = {k: v for k, v in obj.items() if k not in ("data", "stringData")}
+    meta = obj.get("metadata")
+    if isinstance(meta, dict):
+        meta = dict(meta)
+        meta.pop("managedFields", None)
+        ann = meta.get("annotations")
+        if isinstance(ann, dict):
+            ann = {k: v for k, v in ann.items()
+                   if k != "kubectl.kubernetes.io/last-applied-configuration"}
+            if ann:
+                meta["annotations"] = ann
+            else:
+                meta.pop("annotations", None)
+        obj["metadata"] = meta
+    return obj
 
 
 async def delete_object(request: web.Request) -> web.Response:
@@ -425,7 +451,11 @@ async def delete_workload(request: web.Request) -> web.Response:
     ns, name = request.match_info["ns"], request.match_info["name"]
     key = _workload_key(ns, name)
     record = state.workloads.pop(key, None)
-    deleted = await asyncio.to_thread(state.backend.delete, ns, name)
+    # the record's own manifest kind scopes the backend sweep: a workload
+    # delete must never destroy an independent same-name Secret/PVC, and
+    # the record is durable so this holds across controller restarts
+    kind = (((record or {}).get("manifest") or {}).get("kind"))
+    deleted = await asyncio.to_thread(state.backend.delete, ns, name, kind)
     state.forget_workload(ns, name)
     state.record_event(key, "deleted")
     return web.json_response({"ok": True, "existed": record is not None or deleted})
@@ -969,7 +999,9 @@ async def _ttl_loop(state: ControllerState) -> None:
                     state.record_event(key, f"TTL expired ({ttl}s); tearing down")
                     # delete first; forget the record only once the backend
                     # succeeded, so a transient failure retries next cycle
-                    await asyncio.to_thread(state.backend.delete, ns, name)
+                    await asyncio.to_thread(
+                        state.backend.delete, ns, name,
+                        (record.get("manifest") or {}).get("kind"))
                     state.workloads.pop(key, None)
                     state.forget_workload(ns, name)
             except asyncio.CancelledError:

@@ -115,6 +115,7 @@ class LocalBackend:
         self.store_url = store_url
         self.services: Dict[str, List[PodHandle]] = {}
         self.objects: Dict[str, Dict] = {}   # "Kind/ns/name" → manifest
+        self.kinds: Dict[str, str] = {}      # "ns/name" → applied kind
         self._ip_block = 0
         # secret VALUES live only here, as 0600 files under a 0700 dir —
         # never in the manifest, the workload record, or persisted controller
@@ -135,6 +136,8 @@ class LocalBackend:
 
     def delete_object(self, kind: str, namespace: str, name: str) -> bool:
         existed = self.objects.pop(f"{kind}/{namespace}/{name}", None) is not None
+        if self.kinds.get(f"{namespace}/{name}") == kind:
+            self.kinds.pop(f"{namespace}/{name}", None)
         aux = {"Secret": self._secret_dir,
                "PersistentVolumeClaim": self._volume_dir}.get(kind)
         if aux is not None:
@@ -258,6 +261,7 @@ class LocalBackend:
               env: Dict[str, str]) -> Dict:
         key = f"{namespace}/{name}"
         kind = manifest.get("kind", "Deployment")
+        self.kinds[key] = kind
         if kind in OBJECT_KINDS:
             # store config objects instead of spawning pods for them
             if kind == "Secret":
@@ -329,19 +333,25 @@ class LocalBackend:
                                 if handles else None),
                 "pod_ips": [h.ip for h in handles]}
 
-    def delete(self, namespace: str, name: str) -> bool:
+    def delete(self, namespace: str, name: str,
+               kind: Optional[str] = None) -> bool:
         key = f"{namespace}/{name}"
         handles = self.services.pop(key, [])
         for h in handles:
             if h.process.poll() is None:
                 kill_process_tree(h.process.pid)
-        removed_obj = any([   # list, not generator: pop EVERY kind
-            self.objects.pop(f"{kind}/{key}", None) is not None
-            for kind in OBJECT_KINDS])
-        sdir = self._secret_dir(namespace, name)
-        if os.path.isdir(sdir):
-            shutil.rmtree(sdir, ignore_errors=True)
-            removed_obj = True
+        # Only sweep the config object the deleted WORKLOAD itself was —
+        # an independent Secret/PVC that merely shares a name with a deleted
+        # service must keep its stored values. The controller passes the
+        # record's manifest kind (durable, so correct even after a restart);
+        # the in-memory kinds map is a fallback for direct backend use. A
+        # name-only delete with no known kind removes pods only — never a
+        # config object. delete_object owns the aux-dir cleanup per kind.
+        kind = kind or self.kinds.get(key)
+        if self.kinds.get(key) == kind:
+            self.kinds.pop(key, None)
+        removed_obj = (kind in OBJECT_KINDS
+                       and self.delete_object(kind, namespace, name))
         return bool(handles) or removed_obj
 
     def pod_ips(self, namespace: str, name: str) -> List[str]:
@@ -470,13 +480,21 @@ class KubernetesBackend:
                 f"http://{name}.{namespace}.svc.cluster.local:32300",
                 "pod_ips": pod_ips}
 
-    def delete(self, namespace: str, name: str) -> bool:
-        kind = self.kinds.pop(f"{namespace}/{name}", None)
-        # unknown kind (e.g. controller restarted): sweep every kind we can
-        # create, config objects included — a post-restart delete must not
-        # silently leak a Secret/PVC/ConfigMap
-        resources = ([self._KIND_RESOURCES[kind]] if kind else
-                     list(self._KIND_RESOURCES.values()))
+    def delete(self, namespace: str, name: str,
+               kind: Optional[str] = None) -> bool:
+        key = f"{namespace}/{name}"
+        kind = kind or self.kinds.get(key)
+        if self.kinds.get(key) == kind:
+            self.kinds.pop(key, None)
+        # Unknown kind (controller restarted AND no durable record): sweep
+        # only WORKLOAD kinds. Config objects are never destroyed on a
+        # name-only delete — an independent Secret/PVC may share the name,
+        # and their deletion routes through delete_object explicitly. A
+        # Secret/PVC deployed AS a workload always has a durable record
+        # whose manifest kind the controller passes in.
+        resources = ([self._KIND_RESOURCES.get(kind, kind.lower())] if kind
+                     else [r for k, r in self._KIND_RESOURCES.items()
+                           if k not in OBJECT_KINDS])
         if kind not in OBJECT_KINDS:
             resources += [f"service/{name}", f"service/{name}-headless"]
         ok = True

@@ -75,13 +75,36 @@ class DiskPersister:
         # log at startup lets read_service_logs serve only current-process
         # entries — mixing spaces would hand followers duplicated
         # pre-restart lines and then a poisoned (too-high) cursor.
-        for fname in os.listdir(self.logs_dir):
-            if fname.endswith(".jsonl"):
-                try:
-                    with open(os.path.join(self.logs_dir, fname), "a") as f:
-                        f.write(json.dumps({"__kt_epoch__": True}) + "\n")
-                except OSError:
-                    pass
+        #
+        # The marker's location is recorded HERE, once, as (generation,
+        # line_index) per service — read_service_logs must not rescan every
+        # spill generation (up to LOG_SPILL_GENERATIONS × 20MB) on each
+        # slow-follower query just to find it. Rotation shifts the cached
+        # generation (+1); falling off the retention window drops the entry,
+        # which is exactly the no-marker semantics: every retained line is
+        # then newer than the marker. Services are derived from ALL
+        # generations, not just active files — a restart in the rotation
+        # window (``.jsonl.1`` exists, ``.jsonl`` doesn't yet) still needs
+        # its boundary, written into a fresh active file.
+        self._epoch_markers: Dict[str, tuple] = {}
+        for fname in self._service_log_names():
+            path = os.path.join(self.logs_dir, fname)
+            service_key = fname[:-len(".jsonl")].replace("__", "/", 1)
+            try:
+                nlines = 0
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        for chunk in iter(lambda: f.read(1 << 20), b""):
+                            nlines += chunk.count(b"\n")
+                with open(path, "a") as f:
+                    f.write(json.dumps({"__kt_epoch__": True}) + "\n")
+                # a crash-truncated final line (no trailing newline) joins
+                # the marker onto itself; the substring filter still treats
+                # that joined line as the marker, and its index is nlines
+                # either way
+                self._epoch_markers[service_key] = (0, nlines)
+            except OSError:
+                pass
         self._q: queue.Queue = queue.Queue()
         self._writer = threading.Thread(target=self._drain, daemon=True,
                                         name="kt-persist-writer")
@@ -184,6 +207,21 @@ class DiskPersister:
         return os.path.join(self.logs_dir,
                             service_key.replace("/", "__") + ".jsonl")
 
+    def _service_log_names(self) -> set:
+        """Active-file names (``<ns>__<svc>.jsonl``) for every service with
+        any log generation on disk — rotation renames the active file to
+        ``.jsonl.1`` leaving no ``.jsonl`` until the next append, so the
+        spill suffixes count too."""
+        names = set()
+        for fname in os.listdir(self.logs_dir):
+            if fname.endswith(".jsonl"):
+                names.add(fname)
+            else:
+                stem, _, suffix = fname.rpartition(".")
+                if stem.endswith(".jsonl") and suffix.isdigit():
+                    names.add(stem)
+        return names
+
     def append_logs(self, service_key: str, entries: List[Dict]) -> None:
         self._q.put(("logs", (service_key, entries)))
 
@@ -216,6 +254,14 @@ class DiskPersister:
                 if os.path.exists(f"{path}.{n}"):
                     os.replace(f"{path}.{n}", f"{path}.{n + 1}")
             os.replace(path, path + ".1")
+            marker = self._epoch_markers.get(service_key)
+            if marker is not None:
+                gen, line = marker
+                if gen + 1 > LOG_SPILL_GENERATIONS:
+                    # fell off retention: every retained line is post-marker
+                    self._epoch_markers.pop(service_key, None)
+                else:
+                    self._epoch_markers[service_key] = (gen + 1, line)
 
     @staticmethod
     def _tail_entry(path: str) -> Optional[Dict[str, Any]]:
@@ -242,25 +288,40 @@ class DiskPersister:
         follower's cursor predates the in-memory ring buffer (a chatty
         multi-rank job evicts 5000 lines in seconds).
 
-        Only entries written AFTER the last epoch marker count: earlier ones
-        came from a previous controller process whose seqs are meaningless
-        here (see ``__init__``). The marker is located FIRST (a raw string
-        scan, no json), so the skip/limit fast paths below can never leak a
-        past life into the page: generations wholly behind the marker are
-        never opened, generations whose tail seq already trails the cursor
-        are skipped unparsed (each can be 20MB), and collection stops at
-        ``limit`` — generations are chronological, so everything later is
-        only newer than what a page needs."""
-        paths = self._generation_paths(service_key)
-        marker_path, marker_line = -1, -1
-        for pi, p in enumerate(paths):
-            try:
-                with open(p) as f:
-                    for li, raw in enumerate(f):
-                        if '"__kt_epoch__"' in raw:
-                            marker_path, marker_line = pi, li
-            except OSError:
-                continue
+        Only entries written AFTER this process's epoch marker count:
+        earlier ones came from a previous controller process whose seqs are
+        meaningless here (see ``__init__``). The marker's location is read
+        from the in-memory cache maintained at startup and on rotation — no
+        generation is ever opened just to find it — so the skip/limit fast
+        paths below can never leak a past life into the page: generations
+        wholly behind the marker are never opened, generations whose tail
+        seq already trails the cursor are skipped unparsed (each can be
+        20MB), and collection stops at ``limit`` — generations are
+        chronological, so everything later is only newer than what a page
+        needs."""
+        # Snapshot paths and the marker location coherently: the writer
+        # thread can rotate between listing generations and reading the
+        # cached marker, leaving the marker's target file absent from a
+        # stale paths list. Retry the pair a few times (rotation is a couple
+        # of renames — microseconds); if the marker still can't be located
+        # while the cache says one exists, fail CLOSED with an empty page —
+        # serving without the boundary could hand the follower a previous
+        # process's seqs, the exact poisoning the marker prevents.
+        base = self._log_path(service_key)
+        marker_path = marker_line = -1
+        paths: List[str] = []
+        for _ in range(5):
+            marker = self._epoch_markers.get(service_key)
+            paths = self._generation_paths(service_key)
+            if marker is None:
+                break
+            gen, line = marker
+            target = base if gen == 0 else f"{base}.{gen}"
+            if target in paths:
+                marker_path, marker_line = paths.index(target), line
+                break
+        else:
+            return []
         out: List[Dict[str, Any]] = []
         for pi, p in enumerate(paths):
             if pi < marker_path:
@@ -293,18 +354,7 @@ class DiskPersister:
             tuple]:
         """Yield ``(service_key, entries)`` — the newest ``max_per_service``
         entries per service, oldest first, spanning the rotation."""
-        # derive the service set from every generation: rotation renames the
-        # active file to .jsonl.1 leaving no .jsonl until the next append, so
-        # a restart in that window must still find the service
-        names = set()
-        for fname in os.listdir(self.logs_dir):
-            if fname.endswith(".jsonl"):
-                names.add(fname)
-            else:
-                stem, _, suffix = fname.rpartition(".")
-                if stem.endswith(".jsonl") and suffix.isdigit():
-                    names.add(stem)
-        for fname in sorted(names):
+        for fname in sorted(self._service_log_names()):
             service_key = fname[:-len(".jsonl")].replace("__", "/", 1)
             lines: List[str] = []
             for p in self._generation_paths(service_key):

@@ -339,6 +339,7 @@ int main(int argc, char** argv) {
         continue;
       }
       bool dead = false;
+      bool peer_fin = false;
       if (events[i].events & EPOLLIN) {
         char buf[4096];
         for (;;) {
@@ -348,10 +349,29 @@ int main(int argc, char** argv) {
             if (c.req.size() > kMaxReqBytes) { dead = true; break; }
             continue;
           }
-          if (r == 0) { dead = true; }
+          // FIN may ride the same EPOLLIN batch as the request bytes
+          // (send-then-shutdown(SHUT_WR) clients) — still serve what's
+          // buffered and only close after the response is flushed.
+          if (r == 0) { peer_fin = true; }
           break;  // EAGAIN or closed
         }
-        if (!dead && serve_buffered(c, fd) < 0) dead = true;
+        if (!dead) {
+          int sb = serve_buffered(c, fd);
+          if (sb < 0) dead = true;
+          else if (peer_fin) {
+            // sb==0 also covers "response from an EARLIER event still in
+            // flight" (serve_buffered skips while head/file are pending) —
+            // only a truly idle connection closes now; anything with output
+            // pending finishes flushing first via close_after.
+            if (c.head.empty() && c.file_fd < 0) {
+              dead = true;              // idle (or partial request that can
+                                        // never complete) — close now
+            } else {
+              c.close_after = true;     // pump_out drops the conn once the
+                                        // response is fully flushed
+            }
+          }
+        }
       }
       if (!dead && (events[i].events & EPOLLOUT)) {
         int st = pump_out(c);

@@ -250,10 +250,17 @@ class HTTPClient:
                 r = _requests.get(f"{api}/controller/metrics/query",
                                   params={"query": q}, timeout=5)
                 if r.status_code == 503:
-                    # the controller SAYS no metrics stack is configured —
-                    # the only signal worth latching on; transient errors
-                    # and not-yet-scraped pods must keep retrying
-                    self._resource_scope_dead = True
+                    # Latch ONLY the controller's own "no metrics stack
+                    # configured" sentinel (dedicated header; body match for
+                    # older controllers). The query route relays upstream
+                    # status codes, so a 503 from a transiently-overloaded
+                    # Prometheus must stay retryable — latching it would
+                    # disable resource-scope metrics for the client's
+                    # lifetime over a blip.
+                    if (r.headers.get("X-KT-Unconfigured") == "metrics"
+                            or "no metrics stack configured"
+                            in r.text[:200]):
+                        self._resource_scope_dead = True
                     return None
                 results = r.json().get("data", {}).get("result", [])
                 if r.status_code == 200 and results:

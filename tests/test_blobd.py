@@ -174,3 +174,55 @@ def test_pipelined_requests_after_large_response(daemon):
         buf += chunk
     assert big in buf
     s.close()
+
+
+def test_half_close_after_request_still_served(daemon):
+    """send-then-shutdown(SHUT_WR) client: the FIN can land in the same
+    EPOLLIN batch as the request bytes — the daemon must still serve the
+    buffered request and close only after flushing the response (advisor
+    round-3 finding: recv()==0 used to drop the request unanswered)."""
+    root, url = daemon
+    payload = os.urandom(2 * 1024 * 1024)   # large: exercises flush path
+    (root / "d38c.bin").write_bytes(payload)
+    host, port = url.split("//")[1].split(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    s.sendall(b"GET /blob/d38c.bin HTTP/1.1\r\n\r\n")
+    s.shutdown(socket.SHUT_WR)
+    buf = b""
+    s.settimeout(10)
+    while True:
+        chunk = s.recv(1 << 16)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    assert b"200" in buf.split(b"\r\n", 1)[0]
+    assert buf.endswith(payload)
+
+
+def test_half_close_mid_transfer_not_truncated(daemon):
+    """FIN arriving in its OWN EPOLLIN event while a response is still
+    flushing (client reads slowly): the transfer must complete, not be
+    truncated at the moment the FIN is noticed."""
+    import time
+
+    root, url = daemon
+    payload = os.urandom(8 * 1024 * 1024)
+    (root / "e49d.bin").write_bytes(payload)
+    host, port = url.split("//")[1].split(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 32 * 1024)
+    s.sendall(b"GET /blob/e49d.bin HTTP/1.1\r\n\r\n")
+    buf = b""
+    s.settimeout(10)
+    buf += s.recv(1 << 14)          # response started flowing
+    time.sleep(0.1)                 # daemon is now blocked on EPOLLOUT
+    s.shutdown(socket.SHUT_WR)      # FIN in its own EPOLLIN event
+    while True:
+        chunk = s.recv(1 << 16)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    assert buf.endswith(payload), (
+        f"truncated: got {len(buf)} bytes, want >= {len(payload)}")

@@ -28,7 +28,7 @@ class StubBackend:
     def pod_ips(self, namespace, name):
         return self.ips
 
-    def delete(self, namespace, name):
+    def delete(self, namespace, name, kind=None):
         return True
 
     def shutdown(self):

@@ -24,7 +24,7 @@ class FakeBackend:
         self.deleted = []
         self.fail_times = fail_times
 
-    def delete(self, namespace, name):
+    def delete(self, namespace, name, kind=None):
         if self.fail_times > 0:
             self.fail_times -= 1
             raise RuntimeError("backend transient failure")

@@ -126,3 +126,60 @@ def test_restart_does_not_mix_seq_spaces(tmp_path, monkeypatch):
         state_b.persister.close()
 
     asyncio.run(body())
+
+
+def test_marker_cache_survives_rotation(tmp_path, monkeypatch):
+    """The epoch marker's location is cached at startup and tracked through
+    rotations (advisor round-3: the per-query all-generation rescan made the
+    fallback O(full history)). After the marker's generation falls off the
+    retention window the cache entry drops — correct, since every retained
+    line is then post-marker."""
+    monkeypatch.setattr(persistence, "LOG_SPILL_MAX_BYTES", 2 * 1024)
+    monkeypatch.setattr(persistence, "LOG_SPILL_GENERATIONS", 2)
+
+    # process A writes a couple of lines
+    p_a = persistence.DiskPersister(str(tmp_path))
+    p_a.append_logs("ns/train", [{"seq": i, "line": f"old {i}"}
+                                 for i in range(3)])
+    p_a.close()
+
+    # process B: marker recorded at startup without a per-query scan
+    p_b = persistence.DiskPersister(str(tmp_path))
+    assert p_b._epoch_markers["ns/train"] == (0, 3)
+    # old-process entries never reach a follower
+    assert p_b.read_service_logs("ns/train", since=0) == []
+
+    # write enough to rotate twice: marker generation shifts, then falls off
+    big = "x" * 512
+    for batch in range(4):
+        p_b._write_logs("ns/train", [{"seq": 100 + batch * 10 + i,
+                                      "line": big} for i in range(10)])
+    assert ("ns/train" not in p_b._epoch_markers
+            or p_b._epoch_markers["ns/train"][0] >= 1)
+    # current-process entries still page back fine
+    out = p_b.read_service_logs("ns/train", since=0, limit=10_000)
+    assert out and all(e["seq"] >= 100 for e in out)
+    assert not any(e["line"].startswith("old") for e in out)
+    p_b.close()
+
+
+def test_restart_mid_rotation_gets_epoch_marker(tmp_path, monkeypatch):
+    """A restart in the rotation window (``.jsonl.1`` exists, no active
+    ``.jsonl`` yet) must still draw the epoch boundary — previously the
+    marker was only appended to active files, so the spilled generation's
+    stale-seq entries leaked into follower pages."""
+    monkeypatch.setattr(persistence, "LOG_SPILL_MAX_BYTES", 1)  # rotate every write
+    p_a = persistence.DiskPersister(str(tmp_path))
+    p_a.append_logs("ns/train", [{"seq": 7, "line": "stale"}])
+    p_a.flush()
+    p_a.close()
+    import os
+    logs = os.listdir(tmp_path / "logs")
+    assert any(f.endswith(".jsonl.1") for f in logs)
+    assert not any(f.endswith(".jsonl") for f in logs)
+
+    monkeypatch.setattr(persistence, "LOG_SPILL_MAX_BYTES", 20 * 2**20)
+    p_b = persistence.DiskPersister(str(tmp_path))
+    assert "ns/train" in p_b._epoch_markers
+    assert p_b.read_service_logs("ns/train", since=0) == []
+    p_b.close()

@@ -267,3 +267,65 @@ class TestLokiForwarding:
             assert "hello loki" in stream["values"][0][1]
 
         asyncio.run(body())
+
+
+class TestResourceScopeLatch:
+    """Only the controller's own 'no metrics stack configured' sentinel may
+    permanently disable resource-scope streaming; a 503 relayed from a
+    transiently-unavailable Prometheus must stay retryable (advisor
+    round-3 finding)."""
+
+    class _Resp:
+        def __init__(self, status, headers=None, body=""):
+            self.status_code = status
+            self.headers = headers or {}
+            self.text = body
+
+        def json(self):
+            import json as _json
+            return _json.loads(self.text)
+
+    def _client(self, monkeypatch, responses):
+        from kubetorch_tpu.config import reset_config
+        from kubetorch_tpu.serving import http_client as hc
+
+        monkeypatch.setenv("KT_API_URL", "http://controller.test")
+        reset_config()
+        calls = iter(responses)
+        monkeypatch.setattr(hc._requests, "get",
+                            lambda *a, **k: next(calls))
+        c = hc.HTTPClient("http://127.0.0.1:1", service="svc")
+        return c
+
+    def test_relayed_503_does_not_latch(self, monkeypatch):
+        from kubetorch_tpu.config import reset_config
+        try:
+            c = self._client(monkeypatch, [
+                self._Resp(503, body='{"error": "prometheus unreachable"}')])
+            assert c._resource_scope_line() is None
+            assert c._resource_scope_dead is False
+        finally:
+            reset_config()
+
+    def test_sentinel_header_latches(self, monkeypatch):
+        from kubetorch_tpu.config import reset_config
+        try:
+            c = self._client(monkeypatch, [
+                self._Resp(503, headers={"X-KT-Unconfigured": "metrics"},
+                           body='{"error": "no metrics stack configured"}')])
+            assert c._resource_scope_line() is None
+            assert c._resource_scope_dead is True
+        finally:
+            reset_config()
+
+    def test_sentinel_body_latches_without_header(self, monkeypatch):
+        """Older controllers without the header still latch via the body."""
+        from kubetorch_tpu.config import reset_config
+        try:
+            c = self._client(monkeypatch, [
+                self._Resp(503, body='{"error": "no metrics stack '
+                                     'configured (deploy/metrics.yaml)"}')])
+            assert c._resource_scope_line() is None
+            assert c._resource_scope_dead is True
+        finally:
+            reset_config()

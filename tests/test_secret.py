@@ -172,3 +172,106 @@ class TestSecretE2E:
         finally:
             f.teardown()
             s.delete()
+
+
+class TestControllerSecretScrub:
+    """The /controller/object read path must strip every field that can
+    carry secret payload — on the k8s backend the object comes from
+    ``kubectl get -o json`` after a client-side apply, whose
+    last-applied-configuration annotation embeds the full stringData."""
+
+    def test_scrub_drops_last_applied_annotation_and_managed_fields(self):
+        from kubetorch_tpu.controller.app import _scrub_secret_object
+
+        obj = {
+            "kind": "Secret",
+            "metadata": {
+                "name": "tok",
+                "labels": {"app": "x"},
+                "annotations": {
+                    "kubectl.kubernetes.io/last-applied-configuration":
+                        json.dumps({"stringData": {"MY_TOKEN": SENTINEL}}),
+                    "user/note": "keep-me",
+                },
+                "managedFields": [{"fieldsV1": {"f:stringData":
+                                                {"f:MY_TOKEN": {}}}}],
+            },
+            "data": {"MY_TOKEN": "c2VjcmV0"},
+            "stringData": {"MY_TOKEN": SENTINEL},
+        }
+        scrubbed = _scrub_secret_object(obj)
+        dumped = json.dumps(scrubbed)
+        assert SENTINEL not in dumped
+        assert "MY_TOKEN" not in dumped
+        # metadata that carries no payload survives
+        assert scrubbed["metadata"]["name"] == "tok"
+        assert scrubbed["metadata"]["labels"] == {"app": "x"}
+        assert scrubbed["metadata"]["annotations"] == {"user/note": "keep-me"}
+
+    def test_scrub_handles_missing_metadata(self):
+        from kubetorch_tpu.controller.app import _scrub_secret_object
+
+        assert _scrub_secret_object({"stringData": {"k": "v"}}) == {}
+
+
+class TestWorkloadDeleteScope:
+    """Deleting a workload must not wipe an independent Secret/PVC that
+    merely shares its name (advisor round-3 finding)."""
+
+    def test_same_name_secret_survives_workload_delete(self, tmp_path):
+        from kubetorch_tpu.controller.backends import LocalBackend
+
+        be = LocalBackend("http://127.0.0.1:1",
+                          secrets_dir=str(tmp_path / "sec"),
+                          volumes_dir=str(tmp_path / "vol"))
+        be.apply("ns1", "shared", {
+            "kind": "Secret", "metadata": {"name": "shared"},
+            "stringData": {"MY_TOKEN": SENTINEL}}, {})
+        sdir = tmp_path / "sec" / "ns1__shared"
+        assert (sdir / "MY_TOKEN").read_text() == SENTINEL
+
+        # a service later applied under the same ns/name (0 replicas: no
+        # pods to spawn in a unit test), then deleted — the independent
+        # Secret's object entry and files must be untouched
+        be.apply("ns1", "shared", {
+            "kind": "Deployment", "metadata": {"name": "shared"},
+            "spec": {"replicas": 0}}, {})
+        be.delete("ns1", "shared")
+        assert be.objects["Secret/ns1/shared"]["keys"] == ["MY_TOKEN"]
+        assert (sdir / "MY_TOKEN").read_text() == SENTINEL
+
+        # a Secret deployed AS the workload is swept by workload delete
+        be.apply("ns1", "shared2", {
+            "kind": "Secret", "metadata": {"name": "shared2"},
+            "stringData": {"T": "v"}}, {})
+        assert be.delete("ns1", "shared2") is True
+        assert "Secret/ns1/shared2" not in be.objects
+        assert not (tmp_path / "sec" / "ns1__shared2").exists()
+
+        # explicit object deletion still removes the files
+        assert be.delete_object("Secret", "ns1", "shared") is True
+        assert not sdir.exists()
+
+    def test_secret_applied_after_workload_survives_its_delete(self, tmp_path):
+        """Reverse apply order: the workload exists FIRST, then an
+        independent Secret lands under the same name. The controller passes
+        the record's manifest kind on delete, which must scope the sweep
+        regardless of which apply came last."""
+        from kubetorch_tpu.controller.backends import LocalBackend
+
+        be = LocalBackend("http://127.0.0.1:1",
+                          secrets_dir=str(tmp_path / "sec"),
+                          volumes_dir=str(tmp_path / "vol"))
+        be.apply("ns1", "shared", {
+            "kind": "Deployment", "metadata": {"name": "shared"},
+            "spec": {"replicas": 0}}, {})
+        be.apply("ns1", "shared", {
+            "kind": "Secret", "metadata": {"name": "shared"},
+            "stringData": {"MY_TOKEN": SENTINEL}}, {})
+        sdir = tmp_path / "sec" / "ns1__shared"
+
+        # the controller's delete_workload path: kind comes from the durable
+        # workload record, not the (single-slot, last-write-wins) kinds map
+        be.delete("ns1", "shared", kind="Deployment")
+        assert be.objects["Secret/ns1/shared"]["keys"] == ["MY_TOKEN"]
+        assert (sdir / "MY_TOKEN").read_text() == SENTINEL

@@ -390,6 +390,14 @@ def _spawn_local_daemon_locked() -> Dict:
 
     port = free_port()
     env = dict(os.environ)
+    # The daemon must not inherit pod identity or wiring: when a pod's
+    # worker runs client code (user driver imported remotely) and ends up
+    # respawning the daemon, the pod's service name / module pointers /
+    # store URL would otherwise contaminate the daemon's env — and
+    # LocalBackend seeds every future pod's env from it.
+    from .constants import POD_IDENTITY_ENV
+    for key in POD_IDENTITY_ENV:
+        env.pop(key, None)
     env["PALLAS_AXON_POOL_IPS"] = env.get("KT_LOCAL_CONTROLLER_TPU", "")
     # the subprocess must find this package regardless of the user's cwd
     pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -24,3 +24,19 @@ def server_port(value: "str | int | None" = None) -> int:
             "invalid KT_SERVER_PORT=%r; using default %d",
             raw, DEFAULT_SERVER_PORT)
         return DEFAULT_SERVER_PORT
+
+
+# Env vars that define ONE process's pod identity or wiring. They must never
+# leak from a spawning process into a daemon or a DIFFERENT pod: a controller
+# accidentally started from inside a pod (unguarded user driver code) would
+# otherwise stamp every future pod with the dead pod's service name, module
+# pointers, and — worst — a stale KT_DATA_STORE_URL, poisoning code sync
+# long after the original pod is gone.
+POD_IDENTITY_ENV = (
+    "POD_NAME", "POD_IP", "POD_IPS", "LOCAL_IPS",
+    "KT_POD_NAME", "KT_LAUNCH_ID", "KT_SERVICE_NAME", "KT_NAMESPACE",
+    "KT_MODULE_NAME", "KT_FILE_PATH", "KT_CLS_OR_FN_NAME",
+    "KT_CALLABLE_TYPE", "KT_PROJECT_ROOT", "KT_INIT_ARGS",
+    "KT_DISTRIBUTED_CONFIG", "KT_DOCKERFILE", "KT_APP_CMD",
+    "KT_DATA_STORE_URL", "KT_API_URL", "KT_SERVER_PORT",
+)

@@ -296,6 +296,15 @@ class LocalBackend:
 
         pod_env = dict(os.environ)
         pod_env.pop("JAX_PLATFORMS", None)
+        # Never inherit ANOTHER pod's identity/wiring: if this controller was
+        # itself started from a pod environment (unguarded user driver code
+        # importing kt inside a worker), os.environ carries that pod's
+        # service name, module pointers, and store URL — the overlay below
+        # must start from a clean slate or stale values (a dead store URL
+        # especially) poison every pod this backend ever spawns.
+        from ..constants import POD_IDENTITY_ENV
+        for stale in POD_IDENTITY_ENV:
+            pod_env.pop(stale, None)
         pod_env.update(self._secret_env(namespace, manifest))
         pod_env.update(self._volume_env(namespace, manifest))
         pod_env.update(env)
@@ -308,6 +317,10 @@ class LocalBackend:
             "KT_SERVICE_NAME": name,
         })
         if self.store_url:
+            # the POD_IDENTITY_ENV scrub above already dropped any stale
+            # inherited value, so setdefault resolves cleanly: an explicit
+            # per-service overlay (the ``env`` dict) wins, the backend's own
+            # store is the default
             pod_env.setdefault("KT_DATA_STORE_URL", self.store_url)
 
         handles = []

@@ -90,7 +90,8 @@ def _flash_prefill_wanted(cfg, t: int) -> bool:
 
 
 def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
-                flash_prefill: bool = False):
+                flash_prefill: bool = False, token_mask=None,
+                keep_capacity=None):
     """One transformer layer over T new tokens, updating this layer's cache."""
     b, t, d = x.shape
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
@@ -114,33 +115,44 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
                                  cfg.head_dim ** -0.5)
     x = x + attn.reshape(b, t, -1) @ lw["wo"]
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    return (x + ffn_block(cfg, h, lw, token_mask=token_mask,
+                          keep_capacity=keep_capacity),
+            layer_cache_k, layer_cache_v)
+
+
+def ffn_block(cfg, h: jax.Array, lw: Dict[str, jax.Array],
+              token_mask=None, keep_capacity=None) -> jax.Array:
+    """Post-norm FFN for a decode/prefill layer — dense SwiGLU, or the MoE
+    dispatch when the layer carries a ``router`` leaf. Shared by the scanned
+    ``generate`` path and the continuous-batching engine (``serve.engine``)
+    so their expert-routing semantics can never diverge.
+
+    MoE choice: true decode steps (T == 1, where capacity slots can never
+    overflow, so both formulations are exactly equal) gather just the K
+    chosen experts' weights per token when that moves less weight traffic
+    than streaming all E experts. Prefill (T > 1) always uses the
+    capacity-buffer dispatch to keep its overflow-drop semantics identical
+    to training. The gather is also mechanically disabled under an ambient
+    mesh with a live ``expert`` axis: a data-dependent gather along the
+    sharded E axis would force GSPMD to all-gather every expert's weights
+    per step. Traffic headroom: the gather writes B*K expert-matrix copies
+    and re-reads them in the einsum (~2x beyond the read), so it must beat
+    the dispatch path's single stream of all E experts with margin — hence
+    2*B*K <= E, not B*K <= E. All inputs are static at trace time ⇒ the
+    choice is fixed per compile."""
+    b, t = h.shape[0], h.shape[1]
     if "router" in lw:
-        # MoE layer (cfg is a MoeConfig). True decode steps (T == 1, where
-        # capacity slots can never overflow, so both formulations are exactly
-        # equal) gather just the K chosen experts' weights per token when
-        # that moves less weight traffic than streaming all E experts.
-        # Prefill (T > 1) always uses the capacity-buffer dispatch to keep
-        # its overflow-drop semantics identical to training. The gather is
-        # also mechanically disabled under an ambient mesh with a live
-        # ``expert`` axis: a data-dependent gather along the sharded E axis
-        # would force GSPMD to all-gather every expert's weights per step.
-        # Traffic headroom: the gather writes B*K expert-matrix copies and
-        # re-reads them in the einsum (~2x beyond the read), so it must beat
-        # the dispatch path's single stream of all E experts with margin —
-        # hence 2*B*K <= E, not B*K <= E. All inputs are static at trace
-        # time ⇒ the choice is fixed per compile.
         from ..parallel.mesh import AXIS_EXPERT
         from ..parallel.mesh_context import axis_size, current_mesh
 
         if (t == 1 and cfg.decode_gather_ffn
                 and axis_size(current_mesh(), AXIS_EXPERT) == 1
                 and 2 * b * cfg.experts_per_token <= cfg.n_experts):
-            ffn = moe_ffn_decode(cfg, h, lw)
-        else:
-            ffn, _ = moe_ffn(cfg, h, lw)
-    else:
-        ffn = (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
-    return x + ffn, layer_cache_k, layer_cache_v
+            return moe_ffn_decode(cfg, h, lw)
+        ffn, _ = moe_ffn(cfg, h, lw, token_mask=token_mask,
+                         keep_capacity=keep_capacity)
+        return ffn
+    return (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
 
 
 def forward_with_cache(params, tokens, cache: KVCache, start_pos,
@@ -169,6 +181,21 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
     return logits, KVCache(k=new_k, v=new_v)
 
 
+def sample_logits(logits: jax.Array, key: jax.Array, temperature: float,
+                  top_k: Optional[int]) -> jax.Array:
+    """Greedy (temperature 0) or temperature/top-k sampling over the last
+    axis. One definition shared by the scanned ``generate`` path and the
+    continuous-batching engine (``serve.engine``) so their sampling
+    semantics can never diverge."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
                                   "top_k"))
 def generate(params, prompt: jax.Array, cfg: "LlamaConfig | MoeConfig",
@@ -188,13 +215,7 @@ def generate(params, prompt: jax.Array, cfg: "LlamaConfig | MoeConfig",
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
 
     def sample(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_k is not None:
-            kth = lax.top_k(scaled, top_k)[0][..., -1:]
-            scaled = jnp.where(scaled < kth, NEG_INF, scaled)
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        return sample_logits(logits, key, temperature, top_k)
 
     def step(carry, i):
         cache, tok, key = carry

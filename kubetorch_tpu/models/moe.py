@@ -131,7 +131,8 @@ def _route(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
 
 
 def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
-            ep_axis=None, tp_axis=None):
+            ep_axis=None, tp_axis=None, token_mask=None,
+            keep_capacity=None):
     """Top-k MoE with capacity-bounded one-hot dispatch.
 
     x: (B, S, D) → (B, S, D), plus scalar aux loss for load balancing.
@@ -145,6 +146,16 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     FLOPs), and one psum over (expert, tensor) reassembles the output — no
     all-to-all needed in this layout. Expert counts come from the local
     weight shapes so the same body serves both paths.
+
+    ``token_mask`` (B, S) bool marks REAL tokens: masked-out (padding)
+    positions never claim an expert capacity slot and are excluded from the
+    aux statistics. ``keep_capacity`` (traced scalar) overrides the
+    overflow-drop THRESHOLD — the static buffer stays sized by the padded
+    S, but drops happen at the capacity the real length implies. Together
+    they make a right-padded batch route its real tokens bit-identically
+    to the unpadded one — the property bucketed serving prefill
+    (``serve.engine``) depends on. Without them every position is real and
+    the threshold is the buffer size (training, where shapes are exact).
     """
     b, s, d = x.shape
     E, K = cfg.n_experts, cfg.experts_per_token
@@ -155,15 +166,28 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     # aux load-balancing loss (Switch-style): E * Σ_e fraction_e * prob_e
     # computed on top-1 assignments
     top1 = jnp.argmax(probs, axis=-1)
-    frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=(0, 1))
-    aux = E * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+    if token_mask is None:
+        frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32),
+                        axis=(0, 1))
+        aux = E * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+    else:
+        m = token_mask.astype(jnp.float32)                        # (B, S)
+        denom = jnp.sum(m) + 1e-9
+        frac = jnp.einsum("bse,bs->e",
+                          jax.nn.one_hot(top1, E, dtype=jnp.float32),
+                          m) / denom
+        aux = E * jnp.sum(frac * (jnp.einsum("bse,bs->e", probs, m) / denom))
 
     # position of each (token, k) inside its expert's capacity buffer
     expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (B,S,K,E)
+    if token_mask is not None:
+        expert_onehot = expert_onehot * token_mask[:, :, None, None].astype(
+            jnp.int32)
     flat = expert_onehot.reshape(b, s * K, E)
     pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(b, s, K, E)
     pos_in_expert = jnp.sum(pos_in_expert * expert_onehot, axis=-1)   # (B,S,K)
-    keep = pos_in_expert < capacity                                    # overflow drops
+    keep = pos_in_expert < (capacity if keep_capacity is None
+                            else jnp.minimum(keep_capacity, capacity))
 
     # dispatch (B,S,E,C) and combine (B,S,E,C) tensors
     cap_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=x.dtype)  # (B,S,K,C)

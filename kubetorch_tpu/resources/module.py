@@ -60,6 +60,7 @@ class Module:
         self.pointers = pointers
         self.name = service_name_for(pointers.cls_or_fn_name,
                                      username=config().username, name=name)
+        self._explicit_name = name is not None
         self.init_args = init_args
         self.compute: Optional[Compute] = None
         self.service_url: Optional[str] = None
@@ -74,6 +75,35 @@ class Module:
         if name:
             self.name = service_name_for(self.pointers.cls_or_fn_name,
                                          username=config().username, name=name)
+            self._explicit_name = True
+        # Self-deploy guard: a pod worker importing the user's module runs
+        # its top level — an unguarded driver script would re-deploy THIS
+        # service from inside its own pod and then health-wait on itself
+        # forever (the warmup can't finish while the import is blocked).
+        # Deploying a DIFFERENT service from a pod is legitimate (nested
+        # pipelines); deploying yourself never is. Same discipline torch
+        # multiprocessing demands: guard driver code with
+        # ``if __name__ == "__main__":``. Matching uses what the POD knows:
+        # the recomputed name alone fails open whenever the in-pod username
+        # differs from the deployer's (config().username feeds the name),
+        # so the module pointers this pod was deployed FROM count too —
+        # unless the caller chose a different explicit name, which is the
+        # legitimate "replica of my own class" pattern.
+        if os.environ.get("POD_NAME") and os.environ.get("KT_SERVICE_NAME"):
+            same_name = os.environ.get("KT_SERVICE_NAME") == self.name
+            same_callable = (
+                not self._explicit_name
+                and os.environ.get("KT_CLS_OR_FN_NAME")
+                == self.pointers.cls_or_fn_name
+                and os.environ.get("KT_MODULE_NAME")
+                == self.pointers.module_name)
+            if same_name or same_callable:
+                raise RuntimeError(
+                    f"refusing to deploy service {self.name!r} from inside "
+                    f"pod {os.environ['POD_NAME']!r} of service "
+                    f"{os.environ['KT_SERVICE_NAME']!r} — this almost always "
+                    "means the module's top-level driver code ran on import; "
+                    "guard it with `if __name__ == \"__main__\":`")
         self.compute = compute
         launch_id = uuid.uuid4().hex
 

@@ -1,0 +1,19 @@
+"""Model serving: continuous-batching generation on TPU.
+
+The reference serves inference by deploying a user fn/cls behind Knative
+autoscaling (``resources/compute.py`` + the pod HTTP server) and leaves
+batching to the user. Here the serving story goes further: a TPU-native
+engine that keeps ONE compiled decode step hot over a fixed slot grid and
+admits/retires requests mid-flight (continuous batching), so concurrent
+callers share the chip instead of queueing whole generations behind each
+other. Deploy it like any stateful service::
+
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.serve import GenerationEngine
+
+    svc = kt.cls(GenerationEngine).to(kt.Compute(tpu="v5e-4"))
+"""
+
+from .engine import EngineStats, GenerationEngine, RequestHandle
+
+__all__ = ["GenerationEngine", "RequestHandle", "EngineStats"]

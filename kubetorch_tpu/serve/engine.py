@@ -1,0 +1,466 @@
+"""Continuous-batching generation engine.
+
+The scanned :func:`kubetorch_tpu.models.generate.generate` compiles one
+program per (batch, prompt-length, new-token-count) and runs each batch to
+completion — right for offline eval, wrong for serving, where requests
+arrive whenever they like and a finished sequence must hand its chip share
+to the next caller immediately.
+
+TPU-first design — everything the chip executes has a static shape:
+
+- **Slot grid.** The KV cache is one fixed ``(L, SLOTS, S_max, NKV, Hd)``
+  buffer. A request occupies a slot for its lifetime; admission and
+  retirement are host-side bookkeeping, never a recompile.
+- **One decode step for the whole grid.** Every step decodes ALL slots in a
+  single jitted call — per-slot absolute positions (a ``(SLOTS,)`` vector)
+  drive RoPE and the causal mask, so slots at different depths batch into
+  the same matmuls. Idle slots compute masked garbage; that cost is the
+  price of never changing shape, and it is what keeps the MXU busy when
+  the grid is full.
+- **Bucketed prefill.** Prompts are right-padded to a small set of bucket
+  lengths (one compile each) and run through the same layer math as
+  ``generate``'s prefill (flash kernel on TPU when shapes allow); the
+  resulting K/V rows are spliced into the slot with a donated
+  ``dynamic_update_slice`` — no host round-trip, no cache copy.
+- **Buffer donation everywhere.** The decode step and the slot-splice
+  donate the cache, so HBM holds exactly one grid regardless of step rate.
+
+Under an ambient mesh (``parallel.mesh_context.use_mesh``) the same jits
+run GSPMD-partitioned: NKV shards over ``tensor``, slots over data axes —
+multi-chip serving is the training sharding story, unchanged.
+
+Reference parity note: the reference has no engine analog (it serves
+user-written handlers; batching is the user's problem) — this subsystem is
+a deliberate beyond-parity capability on the serving side, sized for the
+RLHF rollout actors (BASELINE config 4) and autoscaled inference services.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
+                               rope_freqs, sample_logits)
+from ..models.llama import rmsnorm
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# device side
+# ---------------------------------------------------------------------------
+
+
+def _rope_slot(x: jax.Array, freqs: jax.Array) -> jax.Array:
+    """RoPE with a PER-SLOT rotation: x (B, N, Hd), freqs (B, Hd/2) complex.
+
+    ``models.llama.apply_rope`` broadcasts one (T, Hd/2) table over the
+    batch — decode slots sit at different absolute positions, so here the
+    table is indexed per slot instead."""
+    b, n, hd = x.shape
+    xf = x.astype(jnp.float32).reshape(b, n, hd // 2, 2)
+    xc = lax.complex(xf[..., 0], xf[..., 1])
+    rotated = xc * freqs[:, None, :]
+    out = jnp.stack([jnp.real(rotated), jnp.imag(rotated)], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
+    """One layer over one new token per slot.
+
+    x: (B, 1, D); ck/cv: (B, S, NKV, Hd); pos: (B,) absolute position of
+    each slot's new token (also its cache row); freqs: (B, Hd/2) complex.
+    """
+    b = x.shape[0]
+    hd = cfg.head_dim
+    nh, nkv = cfg.n_heads, cfg.n_kv_heads
+    h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
+    q = (h @ lw["wq"]).reshape(b, nh, hd)
+    k = (h @ lw["wk"]).reshape(b, nkv, hd)
+    v = (h @ lw["wv"]).reshape(b, nkv, hd)
+    q, k = _rope_slot(q, freqs), _rope_slot(k, freqs)
+
+    bi = jnp.arange(b)
+    ck = ck.at[bi, pos].set(k.astype(ck.dtype))
+    cv = cv.at[bi, pos].set(v.astype(cv.dtype))
+
+    group = nh // nkv
+    qg = q.reshape(b, nkv, group, hd)
+    logits = (jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
+              * (hd ** -0.5))
+    s = ck.shape[1]
+    mask = jnp.arange(s)[None, :] <= pos[:, None]          # (B, S)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+    attn = jnp.einsum("bkgs,bskh->bkgh", probs, cv).reshape(b, 1, nh * hd)
+    x = x + attn @ lw["wo"]
+    h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    return x + ffn_block(cfg, h, lw), ck, cv
+
+
+# sampling shared with models.generate so the two paths can't diverge
+_sample = sample_logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"),
+         donate_argnums=(1,))
+def _decode_step(params, cache: KVCache, pos, toks, rng, cfg,
+                 temperature: float = 0.0, top_k: Optional[int] = None):
+    """Advance EVERY slot one token. toks (B,) is each slot's current input
+    token; pos (B,) its absolute position. Returns (cache', next_tok)."""
+    x = params["embed"][toks[:, None]].astype(cfg.dtype)   # (B, 1, D)
+    freqs = rope_freqs(cfg, cache.k.shape[2])[pos]          # (B, Hd/2)
+
+    def body(carry, layer):
+        lw, ck, cv = layer
+        h, ck, cv = _decode_layer(cfg, carry, lw, ck, cv, pos, freqs)
+        return h, (ck, cv)
+
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    nxt = _sample(logits, rng, temperature, top_k)
+    return KVCache(nk, nv), nxt
+
+
+@partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"))
+def _prefill(params, tokens, true_len, rng, cfg, temperature: float = 0.0,
+             top_k: Optional[int] = None):
+    """Prompt pass at one bucket length. tokens (1, T_bucket) right-padded;
+    logits are taken at the REAL last position ``true_len - 1`` (padding
+    rows only pollute their own cache rows, which decode overwrites before
+    ever attending to them). Returns (first_token (1,), k, v) with k/v
+    (L, 1, T_bucket, NKV, Hd)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs_full = rope_freqs(cfg, t)
+    q_pos = jnp.arange(t)
+    from ..models.generate import _flash_prefill_wanted
+    flash = _flash_prefill_wanted(cfg, t)
+    cache = init_cache(cfg, b, t)
+    # Padding must not perturb MoE routing: masked tokens never claim a
+    # capacity slot, and the overflow-drop threshold is the REAL length's
+    # capacity (the static buffer stays bucket-sized) — so a bucketed
+    # prompt routes bit-identically to its unpadded solo run.
+    token_mask = (q_pos < true_len)[None, :]
+    kc = getattr(cfg, "capacity_factor", None)
+    keep_capacity = None
+    if kc is not None:
+        keep_capacity = jnp.maximum(1, jnp.floor(
+            kc * true_len * cfg.experts_per_token / cfg.n_experts
+        ).astype(jnp.int32))
+
+    def body(carry, layer):
+        lw, ck, cv = layer
+        h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
+                                flash_prefill=flash, token_mask=token_mask,
+                                keep_capacity=keep_capacity)
+        return h, (ck, cv)
+
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
+    logits = (h_last @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return _sample(logits, rng, temperature, top_k), nk, nv
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _splice_slot(cache: KVCache, slot, k_new, v_new) -> KVCache:
+    """Write a prefill's K/V rows into one slot of the grid cache, donated
+    (no second grid-sized buffer ever exists). k/v_new: (L, 1, T_b, ...)."""
+    start = (0, slot, 0, 0, 0)
+    return KVCache(
+        k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
+        v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start))
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
+    generated: int = 0
+    submitted_at: float = field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+
+
+class RequestHandle:
+    """Streaming view of one request: iterate tokens as they decode, or
+    block for the full completion. Tokens drained from the queue are kept on
+    the handle, so a ``result()`` that times out loses nothing — a retry
+    (or a later iteration) sees the full stream from the start. Single
+    consumer: share the handle's results, not the handle, across threads."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+        self._collected: List[int] = []
+        self._done = False
+
+    @property
+    def request_id(self) -> int:
+        return self._req.rid
+
+    def _pull(self, timeout: Optional[float]) -> bool:
+        """Move one queue item into ``_collected``; False once finished.
+        ``timeout=0`` means the item must already be queued."""
+        if self._done:
+            return False
+        try:
+            tok = (self._req.out.get_nowait() if timeout is not None
+                   and timeout <= 0 else self._req.out.get(timeout=timeout))
+        except queue.Empty:
+            raise TimeoutError(
+                f"request {self._req.rid} still decoding") from None
+        if tok is None:
+            self._done = True
+            return False
+        self._collected.append(tok)
+        return True
+
+    def __iter__(self):
+        i = 0
+        while True:
+            while i < len(self._collected):
+                yield self._collected[i]
+                i += 1
+            if not self._pull(None):
+                return
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """All generated tokens (prompt excluded), blocking to completion.
+        ``timeout=0`` requires the request to already be complete."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._done:
+            left = (None if deadline is None
+                    else deadline - time.monotonic())
+            self._pull(left)
+        return list(self._collected)
+
+    def time_to_first_token(self) -> Optional[float]:
+        if self._req.first_token_at is None:
+            return None
+        return self._req.first_token_at - self._req.submitted_at
+
+
+@dataclass
+class EngineStats:
+    slots: int
+    active: int
+    queued: int
+    admitted_total: int
+    finished_total: int
+    tokens_generated: int
+    decode_steps: int
+    tokens_per_sec: float
+
+
+class GenerationEngine:
+    """Continuous-batching decode over a fixed slot grid (module docstring
+    has the design). Drive it manually with :meth:`step` (deterministic —
+    how the tests use it) or start the background loop with :meth:`start`.
+
+    ``params``/``cfg`` are any decoder family ``models.generate`` handles:
+    Llama-dense or MoE (a ``router`` leaf switches the FFN). ``eos_id``
+    retires a slot early; ``max_len`` caps prompt+completion per request.
+    """
+
+    def __init__(self, params: Dict[str, Any], cfg, *, slots: int = 8,
+                 max_len: int = 1024, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
+                 seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self._buckets = sorted({min(b, self.max_len)
+                                for b in prefill_buckets} | {self.max_len})
+        self._cache = init_cache(cfg, self.slots, self.max_len)
+        self._pos = np.zeros(self.slots, np.int32)     # next write position
+        self._tok = np.zeros(self.slots, np.int32)     # next decode input
+        self._slot_req: List[Optional[_Request]] = [None] * self.slots
+        self._pending: "deque[_Request]" = deque()
+        self._rng = jax.random.PRNGKey(seed)
+        self._rid = itertools.count()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # start()/stop() are reached concurrently when the engine serves as
+        # a kt.cls (the pod runs sync methods on an executor): exactly one
+        # loop thread may ever exist — two would interleave _decode_step on
+        # the same donated cache
+        self._lifecycle = threading.Lock()
+        # stats
+        self._admitted = self._finished = 0
+        self._tokens = self._steps = 0
+        self._t0 = time.monotonic()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: int = 64) -> RequestHandle:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "always samples the first token)")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine's max_len ({self.max_len})")
+        req = _Request(next(self._rid), prompt, int(max_new_tokens))
+        with self._lock:
+            self._pending.append(req)
+        self._work.set()
+        return RequestHandle(req)
+
+    # -- engine loop --------------------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _admit(self) -> None:
+        free = self._free_slots()
+        while free:
+            with self._lock:
+                if not self._pending:
+                    return
+                req = self._pending.popleft()
+            slot = free.pop(0)
+            t = len(req.prompt)
+            bucket = next(b for b in self._buckets if b >= t)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t] = req.prompt
+            first, k_new, v_new = _prefill(
+                self.params, jnp.asarray(padded), jnp.int32(t),
+                self._next_key(), self.cfg, temperature=self.temperature,
+                top_k=self.top_k)
+            self._cache = _splice_slot(self._cache, jnp.int32(slot),
+                                       k_new, v_new)
+            first_tok = int(first[0])
+            self._slot_req[slot] = req
+            self._pos[slot] = t
+            self._tok[slot] = first_tok
+            self._admitted += 1
+            self._emit(slot, first_tok)
+
+    def _emit(self, slot: int, tok: int) -> None:
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+        req.out.put(tok)
+        req.generated += 1
+        self._tokens += 1
+        done = (req.generated >= req.max_new_tokens
+                or (self.eos_id is not None and tok == self.eos_id))
+        if done:
+            req.out.put(None)
+            self._slot_req[slot] = None
+            self._pos[slot] = 0
+            self._tok[slot] = 0
+            self._finished += 1
+
+    def step(self) -> int:
+        """Admit pending requests, then decode one token for every active
+        slot. Returns the remaining work — active slots plus queued
+        requests — so ``while eng.step(): ...`` runs the backlog dry even
+        when a step retires every active slot with the queue non-empty."""
+        self._admit()
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if active:
+            self._cache, nxt = _decode_step(
+                self.params, self._cache, jnp.asarray(self._pos),
+                jnp.asarray(self._tok), self._next_key(), self.cfg,
+                temperature=self.temperature, top_k=self.top_k)
+            nxt = np.asarray(nxt)
+            self._steps += 1
+            for slot in active:
+                # the token decoded this step consumed position _pos[slot];
+                # feed the new one back at the next position
+                self._pos[slot] += 1
+                self._tok[slot] = int(nxt[slot])
+                self._emit(slot, int(nxt[slot]))
+        with self._lock:
+            queued = len(self._pending)
+        return sum(r is not None for r in self._slot_req) + queued
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            n = self.step()
+            if n == 0 and not self._pending:
+                self._work.clear()
+                self._work.wait(timeout=0.5)
+
+    def start(self) -> "GenerationEngine":
+        with self._lifecycle:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._run, daemon=True,
+                                                name="kt-gen-engine")
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lifecycle:
+            self._stop.set()
+            self._work.set()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+        with self._lifecycle:
+            # only forget a thread that actually exited: clearing a live
+            # straggler would let the next start() run a second loop beside
+            # it on the same donated cache
+            if self._thread is thread and (thread is None
+                                           or not thread.is_alive()):
+                self._thread = None
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> EngineStats:
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        return EngineStats(
+            slots=self.slots,
+            active=sum(r is not None for r in self._slot_req),
+            queued=len(self._pending),
+            admitted_total=self._admitted,
+            finished_total=self._finished,
+            tokens_generated=self._tokens,
+            decode_steps=self._steps,
+            tokens_per_sec=self._tokens / dt)
+
+    # remote-service surface: a deployed engine (kt.cls) exposes a blocking
+    # generate() so callers don't need the handle/iterator machinery
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
+                 timeout: Optional[float] = 300.0) -> List[int]:
+        self.start()
+        return self.submit(prompt, max_new_tokens).result(timeout=timeout)

@@ -1,5 +1,6 @@
 """Pointer extraction + import fallback (reference resources/callables/utils.py)."""
 
+import os
 import sys
 import textwrap
 
@@ -55,3 +56,27 @@ def test_build_call_body():
     assert body == {"args": [1, 2], "kwargs": {"k": "v"}}
     body = ptr.build_call_body((), {}, debugger={"mode": "pdb", "port": 5678})
     assert body["debugger"]["port"] == 5678
+
+
+def test_self_deploy_from_pod_refused(monkeypatch):
+    """An unguarded driver script imported by its own pod worker must fail
+    fast instead of re-deploying itself and deadlocking on its own warmup."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "assets"))
+    import payloads
+
+    import kubetorch_tpu as kt
+
+    f = kt.fn(payloads.echo_env)
+    monkeypatch.setenv("POD_NAME", "kt-payload-0")
+    monkeypatch.setenv("KT_SERVICE_NAME", f.name)
+    with pytest.raises(RuntimeError, match="from inside pod"):
+        f.to(kt.Compute(cpus=1))
+
+    # username mismatch (k8s images default to 'kt') must NOT fail open:
+    # the pod's module pointers still identify the self-deploy
+    monkeypatch.setenv("KT_SERVICE_NAME", "alice-" + f.name)
+    monkeypatch.setenv("KT_CLS_OR_FN_NAME", f.pointers.cls_or_fn_name)
+    monkeypatch.setenv("KT_MODULE_NAME", f.pointers.module_name)
+    with pytest.raises(RuntimeError, match="from inside pod"):
+        f.to(kt.Compute(cpus=1))

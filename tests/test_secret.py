@@ -275,3 +275,48 @@ class TestWorkloadDeleteScope:
         be.delete("ns1", "shared", kind="Deployment")
         assert be.objects["Secret/ns1/shared"]["keys"] == ["MY_TOKEN"]
         assert (sdir / "MY_TOKEN").read_text() == SENTINEL
+
+
+class TestPodEnvHygiene:
+    """A controller accidentally started from a pod environment must not
+    stamp its own pod identity (service name, module pointers, stale store
+    URL) onto pods it spawns — LocalBackend scrubs POD_IDENTITY_ENV from the
+    inherited environ and its OWN store URL always wins."""
+
+    def test_spawned_pod_env_never_inherits_identity(self, tmp_path,
+                                                     monkeypatch):
+        from kubetorch_tpu.controller import backends as be_mod
+        from kubetorch_tpu.controller.backends import LocalBackend
+
+        monkeypatch.setenv("POD_NAME", "ghost-pod-0")
+        monkeypatch.setenv("KT_SERVICE_NAME", "ghost-svc")
+        monkeypatch.setenv("KT_MODULE_NAME", "ghost_module")
+        monkeypatch.setenv("KT_DATA_STORE_URL", "http://127.0.0.1:1")
+
+        captured = {}
+
+        class FakeProc:
+            pid = 4242
+
+            def poll(self):
+                return None
+
+        def fake_popen(cmd, env=None, **kw):
+            captured["env"] = env
+            return FakeProc()
+
+        monkeypatch.setattr(be_mod.subprocess, "Popen", fake_popen)
+        monkeypatch.setattr(be_mod, "wait_for_port",
+                            lambda *a, **k: True)
+        be = LocalBackend("http://127.0.0.1:9", store_url="http://127.0.0.1:2",
+                          secrets_dir=str(tmp_path / "s"),
+                          volumes_dir=str(tmp_path / "v"))
+        be.apply("ns1", "svc1", {"kind": "Deployment",
+                                 "spec": {"replicas": 1}},
+                 {"KT_MODULE_NAME": "real_module"})
+        env = captured["env"]
+        assert env["POD_NAME"] == "svc1-0"          # its own, not the ghost's
+        assert env["KT_SERVICE_NAME"] == "svc1"
+        assert env["KT_MODULE_NAME"] == "real_module"   # metadata overlay
+        # the backend's own store wins over anything inherited
+        assert env["KT_DATA_STORE_URL"] == "http://127.0.0.1:2"

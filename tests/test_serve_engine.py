@@ -1,0 +1,245 @@
+"""Continuous-batching engine (serve/engine.py).
+
+The engine is a serving redesign of the scanned generate() path — the
+non-negotiable property is EQUIVALENCE: whatever order requests are
+admitted, interleaved, and retired in, each one's greedy tokens must match
+a solo ``generate`` run of the same prompt. Reference analog: none (the
+reference leaves batching to user handlers) — this is the beyond-parity
+serving subsystem, so the contract is defined entirely by these tests.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.models.generate import generate
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.serve import GenerationEngine
+
+pytestmark = [pytest.mark.level("unit"), pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def dense():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _reference_tokens(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+class TestEquivalence:
+    def test_single_request_matches_generate(self, dense):
+        params, cfg = dense
+        prompt = [5, 17, 42, 99]
+        want = _reference_tokens(params, cfg, prompt, 8)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 16))
+        got = eng.submit(prompt, max_new_tokens=8)
+        while eng.step():
+            pass
+        assert got.result(timeout=0) == want
+
+    def test_concurrent_requests_each_match_solo_runs(self, dense):
+        """Three prompts of different lengths share the grid; interleaved
+        decode must not cross-contaminate slots."""
+        params, cfg = dense
+        prompts = [[7, 8, 9], [100, 200, 300, 400, 401], [1, 2]]
+        ns = [6, 9, 4]
+        want = [_reference_tokens(params, cfg, p, n)
+                for p, n in zip(prompts, ns)]
+        eng = GenerationEngine(params, cfg, slots=4, max_len=64,
+                               prefill_buckets=(8,))
+        handles = [eng.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, ns)]
+        while eng.step():
+            pass
+        for h, w in zip(handles, want):
+            assert h.result(timeout=0) == w
+
+    def test_mid_flight_admission(self, dense):
+        """A request admitted while another is mid-decode (the continuous
+        part of continuous batching) still matches its solo run — and the
+        early request's tokens are unchanged by the newcomer."""
+        params, cfg = dense
+        p1, p2 = [11, 12, 13, 14], [250, 251]
+        want1 = _reference_tokens(params, cfg, p1, 10)
+        want2 = _reference_tokens(params, cfg, p2, 5)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 8))
+        h1 = eng.submit(p1, max_new_tokens=10)
+        for _ in range(3):               # p1 decodes alone for a while
+            eng.step()
+        h2 = eng.submit(p2, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h1.result(timeout=0) == want1
+        assert h2.result(timeout=0) == want2
+
+    def test_slot_reuse_after_retirement(self, dense):
+        """A retired slot's stale cache rows must never leak into the next
+        occupant (rows are only ever read at positions the new request has
+        itself written)."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        pa, pb = [31, 32, 33], [77]
+        wa = _reference_tokens(params, cfg, pa, 12)
+        wb = _reference_tokens(params, cfg, pb, 12)
+        ha = eng.submit(pa, max_new_tokens=12)
+        while eng.step():
+            pass
+        hb = eng.submit(pb, max_new_tokens=12)   # reuses slot 0
+        while eng.step():
+            pass
+        assert ha.result(timeout=0) == wa
+        assert hb.result(timeout=0) == wb
+
+    def test_queueing_beyond_slots(self, dense):
+        """More requests than slots: the overflow waits in the queue and is
+        admitted as slots free up; everyone still matches solo."""
+        params, cfg = dense
+        prompts = [[i + 1, i + 2] for i in range(5)]
+        want = [_reference_tokens(params, cfg, p, 3) for p in prompts]
+        eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                               prefill_buckets=(4,))
+        handles = [eng.submit(p, max_new_tokens=3) for p in prompts]
+        assert eng.stats().queued == 5
+        while eng.step():
+            pass
+        for h, w in zip(handles, want):
+            assert h.result(timeout=0) == w
+        s = eng.stats()
+        assert s.finished_total == 5 and s.active == 0 and s.queued == 0
+
+
+class TestLifecycle:
+    def test_eos_retires_early(self, dense):
+        params, cfg = dense
+        prompt = [3, 4, 5]
+        solo = _reference_tokens(params, cfg, prompt, 12)
+        eos = solo[2]                     # stop at this token's 1st occurrence
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,), eos_id=eos)
+        h = eng.submit(prompt, max_new_tokens=12)
+        while eng.step():
+            pass
+        got = h.result(timeout=0)
+        stop = solo.index(eos) + 1        # ends WITH the eos token
+        assert got == solo[:stop] and len(got) < 12
+        assert eng.stats().finished_total == 1
+
+    def test_streaming_iteration(self, dense):
+        params, cfg = dense
+        prompt = [9, 10]
+        want = _reference_tokens(params, cfg, prompt, 5)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4,))
+        h = eng.submit(prompt, max_new_tokens=5)
+        streamed = []
+        while eng.step():
+            pass
+        for tok in h:
+            streamed.append(tok)
+        assert streamed == want
+        assert h.time_to_first_token() is not None
+
+    def test_background_thread_generate(self, dense):
+        """The deployed-service surface: start() + blocking generate()."""
+        params, cfg = dense
+        prompt = [21, 22, 23]
+        want = _reference_tokens(params, cfg, prompt, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                               prefill_buckets=(4,)).start()
+        try:
+            assert eng.generate(prompt, max_new_tokens=6, timeout=120) == want
+        finally:
+            eng.stop()
+
+    def test_submit_validates_length(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit([1] * 10, max_new_tokens=10)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit([], max_new_tokens=1)
+
+    def test_sampled_mode_runs(self, dense):
+        """Temperature>0: not bit-compared (different rng consumption than
+        generate), but tokens must be in-vocab and the count exact."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                               prefill_buckets=(4,), temperature=0.8,
+                               top_k=20, seed=7)
+        h = eng.submit([2, 3, 4], max_new_tokens=6)
+        while eng.step():
+            pass
+        got = h.result(timeout=0)
+        assert len(got) == 6
+        assert all(0 <= t < cfg.vocab_size for t in got)
+
+
+class TestMoE:
+    def test_moe_engine_matches_generate(self):
+        from kubetorch_tpu.models.moe import MoeConfig, moe_init
+
+        cfg = MoeConfig.tiny(dtype=jnp.float32, remat=False, attn_impl="xla")
+        params = moe_init(jax.random.PRNGKey(1), cfg)
+        prompt = [5, 6, 7]
+        want = _reference_tokens(params, cfg, prompt, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                               prefill_buckets=(4,))
+        h = eng.submit(prompt, max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+
+class TestHandleRetry:
+    def test_result_timeout_keeps_drained_tokens(self, dense):
+        """A result() that times out mid-decode must not eat the tokens it
+        already drained — a retry sees the full stream from the start."""
+        params, cfg = dense
+        prompt = [5, 17, 42, 99]
+        want = _reference_tokens(params, cfg, prompt, 8)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        h = eng.submit(prompt, max_new_tokens=8)
+        for _ in range(3):              # partial decode only
+            eng.step()
+        with pytest.raises(TimeoutError):
+            h.result(timeout=0.01)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want       # nothing lost
+        assert h.result(timeout=0) == want       # idempotent after done
+        assert list(h) == want                   # iteration agrees too
+
+    def test_max_new_tokens_validated(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1, 2], max_new_tokens=0)
+
+    def test_start_is_idempotent_single_loop(self, dense):
+        import threading
+
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=16)
+        try:
+            threads = [threading.Thread(target=eng.start) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            alive = [t for t in threading.enumerate()
+                     if t.name == "kt-gen-engine"]
+            assert len(alive) == 1
+        finally:
+            eng.stop()

@@ -51,3 +51,25 @@ def test_inference_service_example(capsys):
     finally:
         shutdown_local_controller()
         reset_config()
+
+
+@pytest.mark.slow
+def test_continuous_batching_service_example(capsys):
+    """Engine-backed serving end-to-end on local pods: four concurrent
+    callers share one decode loop; each gets a full completion and the
+    engine's stats confirm they batched."""
+    from kubetorch_tpu.client import shutdown_local_controller
+    from kubetorch_tpu.config import reset_config
+
+    import continuous_batching_service
+
+    reset_config()
+    try:
+        continuous_batching_service.main()
+        out = capsys.readouterr().out
+        for i in range(4):
+            assert f"request {i}: 12 tokens" in out
+        assert "'finished': 5" in out       # 4 calls + 1 warmup
+    finally:
+        shutdown_local_controller()
+        reset_config()

@@ -59,6 +59,36 @@ def main():
         o.block_until_ready()
         print(f"{name} fwd 20 iters: {time.time()-t0:.3f}s", flush=True)
 
+    # serving decode throughput: the continuous-batching engine with a full
+    # slot grid on the bench-sized model (~0.5B) — tokens/s/chip at decode
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve import GenerationEngine
+
+    cfg = LlamaConfig(vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
+                      n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
+                      attn_impl="flash", remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    slots = 8
+    eng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
+                           prefill_buckets=(128,))
+    prompts = np.random.randint(1, cfg.vocab_size, size=(slots, 128))
+    handles = [eng.submit(list(map(int, p)), max_new_tokens=512)
+               for p in prompts]
+    t0 = time.time()
+    eng.step()                      # admissions + first decode: compiles
+    print(f"engine prefill+decode compile {time.time()-t0:.1f}s", flush=True)
+    for _ in range(3):
+        eng.step()                  # warm
+    steps = 50
+    t0 = time.time()
+    for _ in range(steps):
+        eng.step()
+    dt = time.time() - t0
+    print(f"engine decode: {slots * steps / dt:.0f} tokens/s/chip "
+          f"(grid {slots}, {steps} steps, {dt:.2f}s)", flush=True)
+    for h in handles:               # sanity: streams actually flowed
+        assert h._collected, "no tokens streamed"
+
     print("TPU SMOKE OK", flush=True)
 
 

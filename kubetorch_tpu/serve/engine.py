@@ -52,7 +52,7 @@ import numpy as np
 from jax import lax
 
 from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
-                               rope_freqs, sample_logits)
+                               rope_freqs)
 from ..models.llama import rmsnorm
 
 NEG_INF = -1e30
@@ -110,16 +110,26 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
     return x + ffn_block(cfg, h, lw), ck, cv
 
 
-# sampling shared with models.generate so the two paths can't diverge
-_sample = sample_logits
+def _sample_slots(logits, key, temps, top_k: Optional[int]):
+    """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot.
+    Vectorized (a traced array, not a static) so requests with different
+    temperatures share one compiled step. Agrees with ``sample_logits``
+    slot-wise: argmax for temp 0, temperature/top-k categorical otherwise."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.where(temps > 0, temps, 1.0)[:, None]
+    if top_k is not None:
+        kth = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
-@partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"),
-         donate_argnums=(1,))
-def _decode_step(params, cache: KVCache, pos, toks, rng, cfg,
-                 temperature: float = 0.0, top_k: Optional[int] = None):
+@partial(jax.jit, static_argnames=("cfg", "top_k"), donate_argnums=(1,))
+def _decode_step(params, cache: KVCache, pos, toks, rng, temps, cfg,
+                 top_k: Optional[int] = None):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
-    token; pos (B,) its absolute position. Returns (cache', next_tok)."""
+    token; pos (B,) its absolute position; temps (B,) its sampling
+    temperature. Returns (cache', next_tok)."""
     x = params["embed"][toks[:, None]].astype(cfg.dtype)   # (B, 1, D)
     freqs = rope_freqs(cfg, cache.k.shape[2])[pos]          # (B, Hd/2)
 
@@ -131,12 +141,12 @@ def _decode_step(params, cache: KVCache, pos, toks, rng, cfg,
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    nxt = _sample(logits, rng, temperature, top_k)
+    nxt = _sample_slots(logits, rng, temps, top_k)
     return KVCache(nk, nv), nxt
 
 
-@partial(jax.jit, static_argnames=("cfg", "temperature", "top_k"))
-def _prefill(params, tokens, true_len, rng, cfg, temperature: float = 0.0,
+@partial(jax.jit, static_argnames=("cfg", "top_k"))
+def _prefill(params, tokens, true_len, rng, temps, cfg,
              top_k: Optional[int] = None):
     """Prompt pass at one bucket length. tokens (1, T_bucket) right-padded;
     logits are taken at the REAL last position ``true_len - 1`` (padding
@@ -155,12 +165,7 @@ def _prefill(params, tokens, true_len, rng, cfg, temperature: float = 0.0,
     # capacity (the static buffer stays bucket-sized) — so a bucketed
     # prompt routes bit-identically to its unpadded solo run.
     token_mask = (q_pos < true_len)[None, :]
-    kc = getattr(cfg, "capacity_factor", None)
-    keep_capacity = None
-    if kc is not None:
-        keep_capacity = jnp.maximum(1, jnp.floor(
-            kc * true_len * cfg.experts_per_token / cfg.n_experts
-        ).astype(jnp.int32))
+    keep_capacity = _moe_keep_capacity(cfg, true_len)
 
     def body(carry, layer):
         lw, ck, cv = layer
@@ -173,7 +178,58 @@ def _prefill(params, tokens, true_len, rng, cfg, temperature: float = 0.0,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    return _sample(logits, rng, temperature, top_k), nk, nv
+    return _sample_slots(logits, rng, temps, top_k), nk, nv
+
+
+def _moe_keep_capacity(cfg, true_len):
+    """Overflow-drop threshold for a prefill of ``true_len`` real tokens
+    (None for dense configs) — see ``moe_ffn``'s keep_capacity."""
+    kc = getattr(cfg, "capacity_factor", None)
+    if kc is None:
+        return None
+    return jnp.maximum(1, jnp.floor(
+        kc * true_len * cfg.experts_per_token / cfg.n_experts
+    ).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k"))
+def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, rng, temps,
+                    cfg, top_k: Optional[int] = None):
+    """Suffix prompt pass behind a cached prefix: tokens (1, T_bucket)
+    right-padded run at absolute positions ``P + i`` attending the prefix's
+    K/V rows (L, 1, P, NKV, Hd) plus themselves. Returns (first_token,
+    k, v) with k/v covering rows [0, P + T_bucket) — prefix included, ready
+    to splice into a slot.
+
+    Exact for dense models (same math as a from-zero prefill of
+    prefix+suffix). For MoE, expert capacity is per SEGMENT (the prefix
+    routed at registration, the suffix here), so overflow-drop pressure can
+    differ from a solo full-prompt run — the standard prefix-cache trade;
+    identical whenever no expert overflows."""
+    b, t = tokens.shape
+    p = prefix_k.shape[2]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    freqs_full = rope_freqs(cfg, p + t)
+    q_pos = p + jnp.arange(t)
+    token_mask = (jnp.arange(t) < true_len)[None, :]
+    keep_capacity = _moe_keep_capacity(cfg, true_len)
+    pad = jnp.zeros((prefix_k.shape[0], b, t) + prefix_k.shape[3:],
+                    prefix_k.dtype)
+    ck0 = jnp.concatenate([prefix_k, pad], axis=2)
+    cv0 = jnp.concatenate([prefix_v, pad], axis=2)
+
+    def body(carry, layer):
+        lw, ck, cv = layer
+        h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
+                                flash_prefill=False, token_mask=token_mask,
+                                keep_capacity=keep_capacity)
+        return h, (ck, cv)
+
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], ck0, cv0))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    h_last = x[jnp.arange(b), true_len - 1]
+    logits = (h_last @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -196,6 +252,9 @@ class _Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int
+    temperature: Optional[float] = None      # None → engine default
+    prefix_id: Optional[int] = None          # cached shared-prefix K/V
+    error: Optional[BaseException] = None    # admission failure, surfaced
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     generated: int = 0
     submitted_at: float = field(default_factory=time.monotonic)
@@ -231,6 +290,8 @@ class RequestHandle:
                 f"request {self._req.rid} still decoding") from None
         if tok is None:
             self._done = True
+            if self._req.error is not None:
+                raise self._req.error
             return False
         self._collected.append(tok)
         return True
@@ -252,6 +313,8 @@ class RequestHandle:
             left = (None if deadline is None
                     else deadline - time.monotonic())
             self._pull(left)
+        if self._req.error is not None:
+            raise self._req.error
         return list(self._collected)
 
     def time_to_first_token(self) -> Optional[float]:
@@ -301,6 +364,9 @@ class GenerationEngine:
         self._tok = np.zeros(self.slots, np.int32)     # next decode input
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: "deque[_Request]" = deque()
+        self._temps = np.zeros(self.slots, np.float32)
+        self._prefixes: Dict[int, tuple] = {}   # id → (k, v, tokens)
+        self._prefix_ids = itertools.count()
         self._rng = jax.random.PRNGKey(seed)
         self._rid = itertools.count()
         self._lock = threading.Lock()
@@ -319,28 +385,77 @@ class GenerationEngine:
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, prompt: Sequence[int],
-               max_new_tokens: int = 64) -> RequestHandle:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
+               temperature: Optional[float] = None,
+               prefix_id: Optional[int] = None) -> RequestHandle:
+        """Queue one request. ``temperature`` overrides the engine default
+        for THIS request only (0 = greedy) — per-slot temperatures share the
+        same compiled step. ``prefix_id`` (from :meth:`register_prefix`)
+        reuses a cached shared prefix's K/V: only the suffix is prefilled,
+        and generation continues as if prefix+prompt had been submitted."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples the first token)")
-        if len(prompt) + max_new_tokens > self.max_len:
+        prefix_len = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            prefix_len = self._prefixes[prefix_id][0].shape[2]
+        if prefix_len + len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the engine's max_len ({self.max_len})")
-        req = _Request(next(self._rid), prompt, int(max_new_tokens))
+                f"prefix ({prefix_len}) + prompt ({len(prompt)}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds the engine's "
+                f"max_len ({self.max_len})")
+        req = _Request(next(self._rid), prompt, int(max_new_tokens),
+                       temperature=temperature, prefix_id=prefix_id)
         with self._lock:
             self._pending.append(req)
         self._work.set()
         return RequestHandle(req)
 
+    def register_prefix(self, tokens: Sequence[int]) -> int:
+        """Prefill a shared prefix (system prompt, few-shot header) ONCE and
+        cache its K/V; subsequent :meth:`submit` calls with the returned id
+        skip recomputing it. Exact for dense models; for MoE, expert
+        capacity is per segment (see ``_prefill_suffix``)."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("empty prefix")
+        if len(tokens) >= self.max_len:
+            raise ValueError(f"prefix ({len(tokens)}) must leave room under "
+                             f"max_len ({self.max_len})")
+        t = len(tokens)
+        bucket = next(b for b in self._buckets if b >= t)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :t] = tokens
+        _, k_new, v_new = _prefill(
+            self.params, jnp.asarray(padded), jnp.int32(t), self._next_key(),
+            jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k)
+        # trim the padding rows on the host (registration is rare): the
+        # suffix prefill concatenates behind EXACTLY the real rows
+        k_np, v_np = np.asarray(k_new)[:, :, :t], np.asarray(v_new)[:, :, :t]
+        pid = next(self._prefix_ids)
+        self._prefixes[pid] = (jnp.asarray(k_np), jnp.asarray(v_np))
+        return pid
+
+    def unregister_prefix(self, prefix_id: int) -> bool:
+        """Free a cached prefix's K/V buffers. The caller owns prefix
+        lifetime — the engine never evicts on its own, and each live prefix
+        pins ~2·L·P·NKV·Hd device bytes. Requests already queued against
+        the id fail with a KeyError surfaced through their handle."""
+        return self._prefixes.pop(prefix_id, None) is not None
+
     # -- engine loop --------------------------------------------------------
 
     def _next_key(self) -> jax.Array:
-        self._rng, sub = jax.random.split(self._rng)
+        # under _lock: register_prefix runs on caller threads while the
+        # loop thread decodes — an unsynchronized split can hand two
+        # consumers the same key (correlated samples)
+        with self._lock:
+            self._rng, sub = jax.random.split(self._rng)
         return sub
 
     def _free_slots(self) -> List[int]:
@@ -354,22 +469,53 @@ class GenerationEngine:
                     return
                 req = self._pending.popleft()
             slot = free.pop(0)
-            t = len(req.prompt)
+            try:
+                self._admit_one(req, slot)
+            except Exception as e:   # noqa: BLE001 — per-request failure
+                # (unregistered prefix, bad state) fails THAT request via
+                # its handle; the loop thread must survive
+                req.error = e
+                req.out.put(None)
+                free.insert(0, slot)
+
+    def _admit_one(self, req: _Request, slot: int) -> None:
+        t = len(req.prompt)
+        temp = (self.temperature if req.temperature is None
+                else float(req.temperature))
+        temps = jnp.full((1,), temp, jnp.float32)
+        if req.prefix_id is not None:
+            pk, pv = self._prefixes[req.prefix_id]
+            p = pk.shape[2]
+            bucket = next((b for b in self._buckets if b >= t
+                           and p + b <= self.max_len), None)
+            if bucket is None:
+                # no bucket leaves room behind the prefix: pad the
+                # suffix to exactly what fits (still one compile per
+                # distinct size, bounded by max_len)
+                bucket = self.max_len - p
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t] = req.prompt
+            first, k_new, v_new = _prefill_suffix(
+                self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
+                self._next_key(), temps, self.cfg, top_k=self.top_k)
+            start = p + t
+        else:
             bucket = next(b for b in self._buckets if b >= t)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
             first, k_new, v_new = _prefill(
                 self.params, jnp.asarray(padded), jnp.int32(t),
-                self._next_key(), self.cfg, temperature=self.temperature,
-                top_k=self.top_k)
-            self._cache = _splice_slot(self._cache, jnp.int32(slot),
-                                       k_new, v_new)
-            first_tok = int(first[0])
-            self._slot_req[slot] = req
-            self._pos[slot] = t
-            self._tok[slot] = first_tok
-            self._admitted += 1
-            self._emit(slot, first_tok)
+                self._next_key(), temps, self.cfg, top_k=self.top_k)
+            start = t
+        self._cache = _splice_slot(self._cache, jnp.int32(slot),
+                                   k_new, v_new)
+        first_tok = int(first[0])
+        self._slot_req[slot] = req
+        self._pos[slot] = start
+        self._tok[slot] = first_tok
+        self._temps[slot] = temp
+        self._admitted += 1
+        self._emit(slot, first_tok)
 
     def _emit(self, slot: int, tok: int) -> None:
         req = self._slot_req[slot]
@@ -387,6 +533,7 @@ class GenerationEngine:
             self._slot_req[slot] = None
             self._pos[slot] = 0
             self._tok[slot] = 0
+            self._temps[slot] = 0.0
             self._finished += 1
 
     def step(self) -> int:
@@ -399,8 +546,8 @@ class GenerationEngine:
         if active:
             self._cache, nxt = _decode_step(
                 self.params, self._cache, jnp.asarray(self._pos),
-                jnp.asarray(self._tok), self._next_key(), self.cfg,
-                temperature=self.temperature, top_k=self.top_k)
+                jnp.asarray(self._tok), self._next_key(),
+                jnp.asarray(self._temps), self.cfg, top_k=self.top_k)
             nxt = np.asarray(nxt)
             self._steps += 1
             for slot in active:
@@ -461,6 +608,11 @@ class GenerationEngine:
     # remote-service surface: a deployed engine (kt.cls) exposes a blocking
     # generate() so callers don't need the handle/iterator machinery
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
-                 timeout: Optional[float] = 300.0) -> List[int]:
+                 timeout: Optional[float] = 300.0, *,
+                 temperature: Optional[float] = None,
+                 prefix_id: Optional[int] = None) -> List[int]:
+        # timeout keeps its historical positional slot; the newer knobs are
+        # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
-        return self.submit(prompt, max_new_tokens).result(timeout=timeout)
+        return self.submit(prompt, max_new_tokens, temperature=temperature,
+                           prefix_id=prefix_id).result(timeout=timeout)

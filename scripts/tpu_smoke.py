@@ -86,8 +86,8 @@ def main():
     dt = time.time() - t0
     print(f"engine decode: {slots * steps / dt:.0f} tokens/s/chip "
           f"(grid {slots}, {steps} steps, {dt:.2f}s)", flush=True)
-    for h in handles:               # sanity: streams actually flowed
-        assert h._collected, "no tokens streamed"
+    for h in handles:               # sanity: every slot actually decoded
+        assert h._req.generated > 0, "no tokens generated"
 
     print("TPU SMOKE OK", flush=True)
 

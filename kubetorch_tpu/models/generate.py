@@ -92,7 +92,12 @@ def _flash_prefill_wanted(cfg, t: int) -> bool:
 def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
                 flash_prefill: bool = False, token_mask=None,
                 keep_capacity=None):
-    """One transformer layer over T new tokens, updating this layer's cache."""
+    """One transformer layer over T new tokens, updating this layer's cache.
+    ``lw`` may carry int8-quantized leaves (``models.quant``) — dequantized
+    here, inside the scan body, so only the current layer materializes in
+    the compute dtype."""
+    from .quant import dequant_layer
+    lw = dequant_layer(lw, cfg.dtype)
     b, t, d = x.shape
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
     q = (h @ lw["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -177,7 +182,9 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    from .quant import dequant
+    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
+    logits = (x[:, -1] @ head).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)
 
 

@@ -201,18 +201,27 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
 
     if ep_axis is not None:
         # slice dispatch/combine down to this rank's local experts BEFORE
-        # the expensive routing einsums
-        e_local = lw["experts"]["w_gate"].shape[0]
+        # the expensive routing einsums (shape through a possibly-quantized
+        # leaf — shard_map training paths always pass plain arrays)
+        from .quant import QKEY as _QKEY
+        wg_leaf = lw["experts"]["w_gate"]
+        e_local = (wg_leaf[_QKEY] if isinstance(wg_leaf, dict)
+                   else wg_leaf).shape[0]
         start = lax.axis_index(ep_axis) * e_local
         disp = lax.dynamic_slice_in_dim(disp, start, e_local, axis=2)
         comb = lax.dynamic_slice_in_dim(comb, start, e_local, axis=2)
 
+    # serving may hand us an int8 expert bank (models.quant): convert at
+    # the einsums — the stream reads int8 from HBM either way
+    from .quant import dequant
+    experts = {k: dequant(v, x.dtype) for k, v in lw["experts"].items()}
+
     # route tokens to expert buffers: (E, B, C, D)
     expert_in = jnp.einsum("bsec,bsd->ebcd", disp, x)
     # batched expert SwiGLU over the E axis (sharded over "expert")
-    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, lw["experts"]["w_gate"])) \
-        * jnp.einsum("ebcd,edf->ebcf", expert_in, lw["experts"]["w_up"])
-    expert_out = jnp.einsum("ebcf,efd->ebcd", h, lw["experts"]["w_down"])
+    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, experts["w_gate"])) \
+        * jnp.einsum("ebcd,edf->ebcf", expert_in, experts["w_up"])
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, experts["w_down"])
     out = jnp.einsum("bsec,ebcd->bsd", comb, expert_out)
     reduce = tuple(a for a in (ep_axis, tp_axis) if a is not None)
     if reduce:
@@ -240,9 +249,21 @@ def moe_ffn_decode(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
     """
     _, gate_vals, gate_idx = _route(cfg, x, lw)              # (B, T, K)
 
-    wg = lw["experts"]["w_gate"][gate_idx]                   # (B, T, K, D, F)
-    wu = lw["experts"]["w_up"][gate_idx]
-    wd = lw["experts"]["w_down"][gate_idx]                   # (B, T, K, F, D)
+    def gather_expert(leaf):
+        """Gather the K chosen experts' matrices; for an int8 bank, gather
+        int8 + scales FIRST and dequantize only the gathered slices — a
+        full-bank dequant before the gather would materialize the bf16
+        bank every step and invert the quantization bandwidth win."""
+        from .quant import QKEY, is_quantized
+        if is_quantized(leaf):
+            q = leaf[QKEY][gate_idx]                         # (B,T,K,...)
+            s = leaf["scale"][gate_idx]
+            return (q.astype(jnp.float32) * s).astype(x.dtype)
+        return leaf[gate_idx]
+
+    wg = gather_expert(lw["experts"]["w_gate"])              # (B, T, K, D, F)
+    wu = gather_expert(lw["experts"]["w_up"])
+    wd = gather_expert(lw["experts"]["w_down"])              # (B, T, K, F, D)
     h = jax.nn.silu(jnp.einsum("btd,btkdf->btkf", x, wg)) \
         * jnp.einsum("btd,btkdf->btkf", x, wu)
     out = jnp.einsum("btkf,btkfd->btkd", h, wd)

@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is weight-bandwidth-bound: every step streams the full parameter set
+from HBM to produce one token per slot. Storing matmul weights as int8 with
+per-output-channel fp32 scales halves the bytes vs bf16 — the dequantize
+(``q.astype(bf16) * scale``) happens INSIDE the jitted step, per layer
+inside the scan body, so HBM traffic is the int8 buffer and the convert
+fuses into the dot's operand pipeline. Norms, routers, and the embedding
+stay full precision (tiny, or gather-indexed).
+
+Usage::
+
+    from kubetorch_tpu.serve import GenerationEngine
+    from kubetorch_tpu.models.quant import quantize_params
+
+    engine = GenerationEngine(quantize_params(params), cfg, ...)
+
+The engine (and the scanned ``generate`` path) dequantize transparently:
+a quantized leaf is the dict ``{"__kt_q8__": int8, "scale": f32}`` and
+``dequant`` is an identity on ordinary arrays. The semantics contract:
+running on ``quantize_params(p)`` is BIT-IDENTICAL to running on
+``dequantize_params(quantize_params(p))`` — quantization error is a
+property of the weights, never of where the dequant runs (asserted in
+tests/test_quant.py).
+
+Reference analog: none — the reference serves user handlers and leaves
+model-level optimization to user code; this is part of the beyond-parity
+serving stack (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+QKEY = "__kt_q8__"
+
+# leaves kept full-precision: norms are fp32 by design, the router's logits
+# are precision-sensitive, and the embedding is gather-indexed (quantizing
+# it saves HBM capacity but not decode bandwidth; keep exactness)
+_SKIP = ("attn_norm", "ffn_norm", "final_norm", "router", "embed")
+
+
+def _quantize_leaf(w: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel int8: scale over the contraction axis
+    (second-to-last), so each output column keeps its own dynamic range."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {QKEY: q, "scale": scale}
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and QKEY in leaf
+
+
+def dequant(leaf: Any, dtype=jnp.bfloat16) -> Any:
+    """In-graph dequantize; identity for ordinary arrays — every weight
+    use-site on the serving path routes through this."""
+    if is_quantized(leaf):
+        return (leaf[QKEY].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+    return leaf
+
+
+def dequant_layer(lw: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Dequantize one layer's weight dict. Called at the top of the layer
+    body — inside the scan, so only the current layer's weights materialize
+    in the compute dtype.
+
+    The ``experts`` subtree is left AS-IS: the MoE paths own its dequant —
+    the dispatch path converts the full bank right at its einsums, while
+    the decode gather path must gather int8 FIRST and dequantize only the
+    K selected experts' matrices, or the whole bank would materialize in
+    bf16 every step and invert the bandwidth win (``moe_ffn_decode``)."""
+    out = {}
+    for k, v in lw.items():
+        if k == "experts":
+            out[k] = v
+        elif isinstance(v, dict) and not is_quantized(v):
+            out[k] = dequant_layer(v, dtype)
+        else:
+            out[k] = dequant(v, dtype)
+    return out
+
+
+def _walk(tree: Any, fn, path=()) -> Any:
+    if isinstance(tree, dict) and not is_quantized(tree):
+        return {k: _walk(v, fn, path + (k,)) for k, v in tree.items()}
+    return fn(path, tree)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every matmul weight (wq/wk/wv/wo, FFN, experts, lm_head) to
+    int8 + per-channel scales; precision-sensitive leaves stay as-is."""
+
+    def visit(path, leaf):
+        name = path[-1] if path else ""
+        if name in _SKIP or getattr(leaf, "ndim", 0) < 2:
+            return leaf
+        return _quantize_leaf(leaf)
+
+    return _walk(params, visit)
+
+
+def dequantize_params(params: Dict[str, Any],
+                      dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Materialize the full-precision view (testing / migration)."""
+    return _walk(params, lambda _, leaf: dequant(leaf, dtype))
+
+
+def quantized_bytes(params: Dict[str, Any]) -> Dict[str, int]:
+    """{'quantized': n, 'full': m} byte footprint — the HBM story."""
+    sizes = {"quantized": 0, "full": 0}
+
+    def visit(path, leaf):
+        if is_quantized(leaf):
+            sizes["quantized"] += leaf[QKEY].size + 4 * leaf["scale"].size
+        else:
+            sizes["full"] += leaf.size * leaf.dtype.itemsize
+        return leaf
+
+    _walk(params, visit)
+    return sizes

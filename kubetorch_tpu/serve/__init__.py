@@ -14,6 +14,9 @@ other. Deploy it like any stateful service::
     svc = kt.cls(GenerationEngine).to(kt.Compute(tpu="v5e-4"))
 """
 
+from ..models.quant import (dequantize_params, quantize_params,
+                            quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
 
-__all__ = ["GenerationEngine", "RequestHandle", "EngineStats"]
+__all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
+           "quantize_params", "dequantize_params", "quantized_bytes"]

@@ -54,6 +54,7 @@ from jax import lax
 from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
                                rope_freqs)
 from ..models.llama import rmsnorm
+from ..models.quant import dequant, dequant_layer
 
 NEG_INF = -1e30
 
@@ -86,6 +87,7 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
     b = x.shape[0]
     hd = cfg.head_dim
     nh, nkv = cfg.n_heads, cfg.n_kv_heads
+    lw = dequant_layer(lw, cfg.dtype)    # int8 serving weights (models.quant)
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
     q = (h @ lw["wq"]).reshape(b, nh, hd)
     k = (h @ lw["wk"]).reshape(b, nkv, hd)
@@ -140,7 +142,8 @@ def _decode_step(params, cache: KVCache, pos, toks, rng, temps, cfg,
 
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
+    logits = (x[:, 0] @ head).astype(jnp.float32)
     nxt = _sample_slots(logits, rng, temps, top_k)
     return KVCache(nk, nv), nxt
 
@@ -177,7 +180,8 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
-    logits = (h_last @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
+    logits = (h_last @ head).astype(jnp.float32)
     return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
@@ -193,13 +197,18 @@ def _moe_keep_capacity(cfg, true_len):
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k"))
-def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, rng, temps,
-                    cfg, top_k: Optional[int] = None):
+def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
+                    rng, temps, cfg, top_k: Optional[int] = None):
     """Suffix prompt pass behind a cached prefix: tokens (1, T_bucket)
-    right-padded run at absolute positions ``P + i`` attending the prefix's
-    K/V rows (L, 1, P, NKV, Hd) plus themselves. Returns (first_token,
-    k, v) with k/v covering rows [0, P + T_bucket) — prefix included, ready
-    to splice into a slot.
+    right-padded run at absolute positions ``prefix_len + i`` attending the
+    prefix's REAL K/V rows plus themselves. The prefix stays padded to its
+    BUCKET (``prefix_k``: (L, 1, P_bucket, NKV, Hd); ``prefix_len`` is the
+    traced true length), so compiles are bounded by bucket pairs, never by
+    distinct prefix lengths. Suffix rows are written starting at
+    ``prefix_len`` — over the prefix's padding garbage — and the causal
+    mask (kv_pos <= q_pos) never admits an unwritten row. Returns
+    (first_token, k, v) with k/v covering rows [0, P_bucket + T_bucket),
+    ready to splice into a slot.
 
     Exact for dense models (same math as a from-zero prefill of
     prefix+suffix). For MoE, expert capacity is per SEGMENT (the prefix
@@ -207,10 +216,10 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, rng, temps,
     differ from a solo full-prompt run — the standard prefix-cache trade;
     identical whenever no expert overflows."""
     b, t = tokens.shape
-    p = prefix_k.shape[2]
+    p_bucket = prefix_k.shape[2]
     x = params["embed"][tokens].astype(cfg.dtype)
-    freqs_full = rope_freqs(cfg, p + t)
-    q_pos = p + jnp.arange(t)
+    freqs_full = rope_freqs(cfg, p_bucket + t)
+    q_pos = prefix_len + jnp.arange(t)
     token_mask = (jnp.arange(t) < true_len)[None, :]
     keep_capacity = _moe_keep_capacity(cfg, true_len)
     pad = jnp.zeros((prefix_k.shape[0], b, t) + prefix_k.shape[3:],
@@ -228,7 +237,8 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, rng, temps,
     x, (nk, nv) = lax.scan(body, x, (params["layers"], ck0, cv0))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
-    logits = (h_last @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
+    logits = (h_last @ head).astype(jnp.float32)
     return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
@@ -365,7 +375,8 @@ class GenerationEngine:
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: "deque[_Request]" = deque()
         self._temps = np.zeros(self.slots, np.float32)
-        self._prefixes: Dict[int, tuple] = {}   # id → (k, v, tokens)
+        # id → (k_bucketed, v_bucketed, true_len)
+        self._prefixes: Dict[int, tuple] = {}
         self._prefix_ids = itertools.count()
         self._rng = jax.random.PRNGKey(seed)
         self._rid = itertools.count()
@@ -399,15 +410,17 @@ class GenerationEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples the first token)")
-        prefix_len = 0
+        prefix_bucket = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
                 raise KeyError(f"unknown prefix_id {prefix_id}")
-            prefix_len = self._prefixes[prefix_id][0].shape[2]
-        if prefix_len + len(prompt) + max_new_tokens > self.max_len:
+            # validate against the BUCKETED length: the spliced rows span
+            # the bucket, so that is what must fit under max_len
+            prefix_bucket = self._prefixes[prefix_id][0].shape[2]
+        if prefix_bucket + len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prefix ({prefix_len}) + prompt ({len(prompt)}) + "
-                f"max_new_tokens ({max_new_tokens}) exceeds the engine's "
+                f"prefix bucket ({prefix_bucket}) + prompt ({len(prompt)}) "
+                f"+ max_new_tokens ({max_new_tokens}) exceeds the engine's "
                 f"max_len ({self.max_len})")
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
                        temperature=temperature, prefix_id=prefix_id)
@@ -434,11 +447,11 @@ class GenerationEngine:
         _, k_new, v_new = _prefill(
             self.params, jnp.asarray(padded), jnp.int32(t), self._next_key(),
             jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k)
-        # trim the padding rows on the host (registration is rare): the
-        # suffix prefill concatenates behind EXACTLY the real rows
-        k_np, v_np = np.asarray(k_new)[:, :, :t], np.asarray(v_new)[:, :, :t]
+        # keep the BUCKETED K/V: _prefill_suffix takes the true length as a
+        # traced scalar, so one compile covers every prefix sharing the
+        # bucket (padding rows are overwritten by the suffix / masked)
         pid = next(self._prefix_ids)
-        self._prefixes[pid] = (jnp.asarray(k_np), jnp.asarray(v_np))
+        self._prefixes[pid] = (k_new, v_new, t)
         return pid
 
     def unregister_prefix(self, prefix_id: int) -> bool:
@@ -484,21 +497,22 @@ class GenerationEngine:
                 else float(req.temperature))
         temps = jnp.full((1,), temp, jnp.float32)
         if req.prefix_id is not None:
-            pk, pv = self._prefixes[req.prefix_id]
-            p = pk.shape[2]
+            pk, pv, p_real = self._prefixes[req.prefix_id]
+            p_bucket = pk.shape[2]
             bucket = next((b for b in self._buckets if b >= t
-                           and p + b <= self.max_len), None)
+                           and p_bucket + b <= self.max_len), None)
             if bucket is None:
                 # no bucket leaves room behind the prefix: pad the
                 # suffix to exactly what fits (still one compile per
                 # distinct size, bounded by max_len)
-                bucket = self.max_len - p
+                bucket = self.max_len - p_bucket
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
             first, k_new, v_new = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
-                self._next_key(), temps, self.cfg, top_k=self.top_k)
-            start = p + t
+                jnp.int32(p_real), self._next_key(), temps, self.cfg,
+                top_k=self.top_k)
+            start = p_real + t
         else:
             bucket = next(b for b in self._buckets if b >= t)
             padded = np.zeros((1, bucket), np.int32)

@@ -89,6 +89,27 @@ def main():
     for h in handles:               # sanity: every slot actually decoded
         assert h._req.generated > 0, "no tokens generated"
 
+    # int8 weight-only decode: same grid, quantized weights — the
+    # bandwidth-bound decode should approach 2x (weights are half the
+    # HBM bytes); record the ratio
+    from kubetorch_tpu.serve import quantize_params
+
+    qeng = GenerationEngine(quantize_params(params), cfg, slots=slots,
+                            max_len=1024, prefill_buckets=(128,))
+    for p in prompts:
+        qeng.submit(list(map(int, p)), max_new_tokens=512)
+    t0 = time.time()
+    qeng.step()
+    print(f"int8 engine compile {time.time()-t0:.1f}s", flush=True)
+    for _ in range(3):
+        qeng.step()
+    t0 = time.time()
+    for _ in range(steps):
+        qeng.step()
+    qdt = time.time() - t0
+    print(f"int8 decode: {slots * steps / qdt:.0f} tokens/s/chip "
+          f"({qdt:.2f}s; speedup x{dt / qdt:.2f} vs bf16)", flush=True)
+
     print("TPU SMOKE OK", flush=True)
 
 

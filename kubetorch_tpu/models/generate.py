@@ -182,9 +182,8 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    from .quant import dequant
-    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
-    logits = (x[:, -1] @ head).astype(jnp.float32)
+    from .quant import head_weight
+    logits = (x[:, -1] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)
 
 

@@ -65,6 +65,17 @@ def dequant(leaf: Any, dtype=jnp.bfloat16) -> Any:
     return leaf
 
 
+def head_weight(params: Dict[str, Any], dtype=jnp.bfloat16):
+    """The lm_head in compute dtype, whether stored quantized or not — the
+    ONE definition of head handling shared by the scanned generate path,
+    the engine's decode/prefill jits, and speculative decoding (a change
+    here cannot silently break their bit-identical contract)."""
+    leaf = params["lm_head"]
+    if is_quantized(leaf):
+        return dequant(leaf, dtype)
+    return leaf.astype(dtype)
+
+
 def dequant_layer(lw: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str, Any]:
     """Dequantize one layer's weight dict. Called at the top of the layer
     body — inside the scan, so only the current layer's weights materialize

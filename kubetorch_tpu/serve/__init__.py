@@ -17,6 +17,8 @@ other. Deploy it like any stateful service::
 from ..models.quant import (dequantize_params, quantize_params,
                             quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
+from .speculative import SpecStats, speculative_generate
 
 __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
-           "quantize_params", "dequantize_params", "quantized_bytes"]
+           "quantize_params", "dequantize_params", "quantized_bytes",
+           "speculative_generate", "SpecStats"]

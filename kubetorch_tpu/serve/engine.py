@@ -54,7 +54,7 @@ from jax import lax
 from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
                                rope_freqs)
 from ..models.llama import rmsnorm
-from ..models.quant import dequant, dequant_layer
+from ..models.quant import dequant_layer, head_weight
 
 NEG_INF = -1e30
 
@@ -142,8 +142,7 @@ def _decode_step(params, cache: KVCache, pos, toks, rng, temps, cfg,
 
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
-    logits = (x[:, 0] @ head).astype(jnp.float32)
+    logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     nxt = _sample_slots(logits, rng, temps, top_k)
     return KVCache(nk, nv), nxt
 
@@ -180,8 +179,7 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
-    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
-    logits = (h_last @ head).astype(jnp.float32)
+    logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
@@ -237,8 +235,7 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     x, (nk, nv) = lax.scan(body, x, (params["layers"], ck0, cv0))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
-    head = dequant(params["lm_head"], cfg.dtype).astype(cfg.dtype)
-    logits = (h_last @ head).astype(jnp.float32)
+    logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
@@ -447,9 +444,19 @@ class GenerationEngine:
         _, k_new, v_new = _prefill(
             self.params, jnp.asarray(padded), jnp.int32(t), self._next_key(),
             jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k)
-        # keep the BUCKETED K/V: _prefill_suffix takes the true length as a
+        # Keep BUCKETED K/V: _prefill_suffix takes the true length as a
         # traced scalar, so one compile covers every prefix sharing the
-        # bucket (padding rows are overwritten by the suffix / masked)
+        # bucket (padding rows are overwritten by the suffix / masked).
+        # The STORAGE bucket must leave room for at least a 1-token suffix
+        # + 1 generated token under max_len — when the run bucket doesn't
+        # (e.g. the smallest bucket is most of max_len), trim to the exact
+        # length instead (a compile per distinct prefix length only in
+        # that degenerate config).
+        store = next((b for b in self._buckets
+                      if b >= t and b + 2 <= self.max_len), t)
+        if store != bucket:
+            k_new = k_new[:, :, :store]
+            v_new = v_new[:, :, :store]
         pid = next(self._prefix_ids)
         self._prefixes[pid] = (k_new, v_new, t)
         return pid

@@ -1,0 +1,203 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Decode spends one full weight-stream per token; a draft model K× smaller
+proposes ``k`` tokens autoregressively and the target scores all of them in
+ONE forward — so each target weight-stream yields 1..k+1 tokens. Greedy
+verification is EXACT: a proposal is accepted only while it equals the
+target's own argmax, so the emitted stream is bit-identical to plain greedy
+decode of the target (the oracle the tests assert). The win is the
+acceptance rate; the worst case costs one extra draft pass per token.
+
+TPU-first shapes: both models keep fixed ``max_len`` caches; every round
+runs two static-width jits — the draft ingests the previous round's
+accepted block (padded to ``k+1``) then proposes ``k`` single steps inside
+a ``lax.scan``; the target ingests block+proposals (padded to ``2k+1``)
+and returns per-position logits. Rows past the valid frontier hold stale
+garbage by design: every forward writes its rows BEFORE attending, and the
+causal mask never admits a row at a position not yet written — the same
+invariant the slot-grid engine relies on.
+
+Reference analog: none (serving optimization is user code there) — part of
+the beyond-parity serving stack, docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import (KVCache, _layer_step, init_cache, rope_freqs)
+from ..models.llama import rmsnorm
+from ..models.quant import head_weight
+
+
+@partial(jax.jit, static_argnames=("cfg", "logits"), donate_argnums=(1,))
+def _ingest(params, cache: KVCache, block, start, true_len, cfg,
+            logits: str = "all"):
+    """Run ``block`` (1, W) of tokens at absolute positions ``start + i``
+    through the model, writing their K/V rows (cache donated — the caller
+    never reuses the old one). ``logits`` picks what the head computes:
+    "all" → fp32 (1, W, V) for every position (the verify round needs
+    them; W ≤ 2k+1 so it's cheap), "last" → (1, V) at ``true_len - 1``
+    only (prompt prefill: a W×V tensor for a long prompt would be GBs),
+    "none" → None (the draft's prompt ingest only needs the cache).
+    Positions at and past ``true_len`` are padding — their logits are
+    garbage the caller must ignore, and their rows are either overwritten
+    by a later round before they can be attended, or masked off."""
+    b, w = block.shape
+    x = params["embed"][block].astype(cfg.dtype)
+    freqs_full = rope_freqs(cfg, cache.k.shape[2])
+    q_pos = start + jnp.arange(w)
+    token_mask = (jnp.arange(w) < true_len)[None, :]
+
+    def body(carry, layer):
+        lw, ck, cv = layer
+        h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
+                                token_mask=token_mask)
+        return h, (ck, cv)
+
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    if logits == "none":
+        return None, KVCache(nk, nv)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    if logits == "last":
+        h_last = x[jnp.arange(b), true_len - 1]
+        return ((h_last @ head_weight(params, cfg.dtype))
+                .astype(jnp.float32)), KVCache(nk, nv)
+    out = (x @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    return out, KVCache(nk, nv)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"), donate_argnums=(1,))
+def _draft_propose(params, cache: KVCache, block, start, true_len, cfg,
+                   k: int):
+    """Draft round: ingest the accepted block, then greedily propose ``k``
+    tokens with single-step decodes inside a scan. Returns (proposals (k,),
+    cache'). The proposal steps write rows ``start+true_len …
+    start+true_len+k-2`` (the k-th proposal is never ingested — the next
+    round's block carries whatever survives verification)."""
+    logits, cache = _ingest(params, cache, block, start, true_len, cfg)
+    first = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, tok = carry
+        lg, cache = _ingest(params, cache, tok[None, None],
+                            start + true_len + i, jnp.int32(1), cfg)
+        nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), rest = lax.scan(step, (cache, first), jnp.arange(k - 1))
+    return jnp.concatenate([first[None], rest]), cache
+
+
+@dataclass
+class SpecStats:
+    rounds: int = 0
+    proposed: int = 0
+    accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
+                         prompt, max_new_tokens: int = 64, k: int = 4,
+                         max_len: Optional[int] = None,
+                         prompt_buckets: Sequence[int] = (64, 256, 1024,
+                                                         4096),
+                         stats: Optional[SpecStats] = None) -> List[int]:
+    """Greedy speculative decoding; returns the generated tokens (prompt
+    excluded) — bit-identical to ``generate(target_params, …)`` greedy.
+
+    ``draft_cfg``/``target_cfg`` must share the vocabulary; ``k`` proposals
+    per round. Pass a ``SpecStats`` to read the acceptance rate (the
+    realized speedup is roughly ``(1 + accepted/rounds)`` target streams
+    amortized per token).
+
+    Compile behavior: each jit is keyed on the CACHE length and block
+    widths. A server should pin ``max_len`` (one compile set per model
+    pair) — the default derives it from the request and recompiles per
+    distinct prompt/new-token budget. Prompts pad to ``prompt_buckets``
+    so prompt-length variety alone never recompiles."""
+    prompt = [int(t) for t in prompt]
+    if not prompt:
+        raise ValueError("empty prompt")
+    p = len(prompt)
+    p_bucket = next((b for b in sorted(prompt_buckets) if b >= p), p)
+    # The cache must hold the FULL padded windows past the last valid row:
+    # dynamic_update_slice CLAMPS an out-of-bounds start, which would
+    # silently shift padding writes onto history rows and corrupt them —
+    # reserve the padded prompt AND prompt + new + (2k+1) verify rows.
+    total_cap = max(p_bucket, p + max_new_tokens + 2 * k + 1)
+    if max_len is None:
+        max_len = total_cap
+    if max_len < total_cap:
+        raise ValueError(
+            f"max_len {max_len} < max(prompt bucket, prompt + "
+            f"max_new_tokens + 2k+1) ({total_cap}) — the padded windows "
+            "must fit")
+
+    t_cache = init_cache(target_cfg, 1, max_len)
+    d_cache = init_cache(draft_cfg, 1, max_len)
+
+    # bucketed prompt prefill on both models; the draft skips the lm_head
+    # entirely and the target computes logits at the last position only
+    block = np.zeros((1, p_bucket), np.int32)
+    block[0, :p] = prompt
+    block = jnp.asarray(block)
+    t_last, t_cache = _ingest(target_params, t_cache, block,
+                              jnp.int32(0), jnp.int32(p), target_cfg,
+                              logits="last")
+    _, d_cache = _ingest(draft_params, d_cache, block,
+                         jnp.int32(0), jnp.int32(p), draft_cfg,
+                         logits="none")
+    first = int(jnp.argmax(t_last[0]))
+
+    out: List[int] = [first]
+    # pending = emitted tokens neither model has validly ingested yet;
+    # always 1..k+1 long, so the draft ingest width is statically k+1
+    pending: List[int] = [first]
+    n_valid = p                      # tokens both caches validly cover
+    W_D, W_T = k + 1, 2 * k + 1
+
+    while len(out) < max_new_tokens:
+        c = len(pending)
+        dblock = np.zeros((1, W_D), np.int32)
+        dblock[0, :c] = pending
+        proposals, d_cache = _draft_propose(
+            draft_params, d_cache, jnp.asarray(dblock), jnp.int32(n_valid),
+            jnp.int32(c), draft_cfg, k)
+        proposals = [int(t) for t in np.asarray(proposals)]
+
+        tblock = np.zeros((1, W_T), np.int32)
+        tblock[0, :c] = pending
+        tblock[0, c:c + k] = proposals
+        t_logits, t_cache = _ingest(
+            target_params, t_cache, jnp.asarray(tblock), jnp.int32(n_valid),
+            jnp.int32(c + k), target_cfg)
+        greedy = np.asarray(jnp.argmax(t_logits[0], axis=-1))
+
+        # greedy[c-1+i] is the target's own choice after pending+proposals
+        # [:i]; accept while the draft matched it
+        accepted = 0
+        while accepted < k and proposals[accepted] == int(greedy[c - 1 + accepted]):
+            accepted += 1
+        correction = int(greedy[c - 1 + accepted])
+
+        emitted = proposals[:accepted] + [correction]
+        out.extend(emitted)
+        n_valid += c                 # the old pending is now verified rows
+        pending = emitted
+        if stats is not None:
+            stats.rounds += 1
+            stats.proposed += k
+            stats.accepted += accepted
+
+    return out[:max_new_tokens]

@@ -366,3 +366,20 @@ class TestPrefixLifecycle:
         while eng.step():
             pass
         assert len(h_next.result(timeout=0)) == 2
+
+
+def test_prefix_in_oversized_bucket_config(dense):
+    """A short prefix must not eat a whole oversized bucket's worth of the
+    max_len budget: when the smallest bucket leaves no room for suffix +
+    generation, the stored K/V trims to the exact prefix length."""
+    params, cfg = dense
+    eng = GenerationEngine(params, cfg, slots=1, max_len=16,
+                           prefill_buckets=(16,))   # only bucket == max_len
+    prefix = [11, 12, 13]
+    want = _reference_tokens(params, cfg, prefix + [60], 4)
+    pid = eng.register_prefix(prefix)
+    assert eng._prefixes[pid][0].shape[2] == 3      # trimmed, not 16
+    h = eng.submit([60], max_new_tokens=4, prefix_id=pid)
+    while eng.step():
+        pass
+    assert h.result(timeout=0) == want

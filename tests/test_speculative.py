@@ -1,0 +1,79 @@
+"""Speculative decoding (serve/speculative.py).
+
+The oracle: greedy speculative output is BIT-IDENTICAL to plain greedy
+``generate`` of the target, whatever the draft proposes — acceptance only
+shortcuts identical outcomes. Any position-ledger or cache-invariant bug
+breaks this equality immediately, so it is the whole contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.models.generate import generate
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.serve import SpecStats, speculative_generate
+
+pytestmark = [pytest.mark.level("unit"), pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    target = llama_init(jax.random.PRNGKey(0), cfg)
+    # a smaller, differently-seeded draft: same vocab, fewer layers/dims
+    dcfg = LlamaConfig.tiny(dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                            ffn_dim=64, attn_impl="xla", dtype=jnp.float32,
+                            remat=False)
+    draft = llama_init(jax.random.PRNGKey(7), dcfg)
+    return target, cfg, draft, dcfg
+
+
+def _solo(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+class TestExactness:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_matches_target_greedy_for_any_draft(self, models, k):
+        target, cfg, draft, dcfg = models
+        prompt = [5, 17, 42, 99]
+        want = _solo(target, cfg, prompt, 12)
+        stats = SpecStats()
+        got = speculative_generate(target, cfg, draft, dcfg, prompt,
+                                   max_new_tokens=12, k=k, stats=stats)
+        assert got == want
+        assert stats.rounds >= 1 and 0 <= stats.acceptance_rate <= 1
+
+    def test_self_draft_accepts_everything(self, models):
+        """Draft == target: every proposal matches, rounds collapse to
+        ~max_new/(k+1) and acceptance is 100%."""
+        target, cfg, _, _ = models
+        prompt = [3, 4, 5]
+        want = _solo(target, cfg, prompt, 12)
+        stats = SpecStats()
+        got = speculative_generate(target, cfg, target, cfg, prompt,
+                                   max_new_tokens=12, k=3, stats=stats)
+        assert got == want
+        assert stats.acceptance_rate == 1.0
+        assert stats.rounds <= -(-12 // 4) + 1   # ceil(12/(k+1)) slack 1
+
+    def test_various_prompts_and_lengths(self, models):
+        target, cfg, draft, dcfg = models
+        for prompt, n in [([1], 7), ([9, 8, 7, 6, 5], 5), ([100] * 9, 10)]:
+            want = _solo(target, cfg, prompt, n)
+            got = speculative_generate(target, cfg, draft, dcfg, prompt,
+                                       max_new_tokens=n, k=3)
+            assert got == want, (prompt, n)
+
+    def test_validation(self, models):
+        target, cfg, draft, dcfg = models
+        with pytest.raises(ValueError, match="empty"):
+            speculative_generate(target, cfg, draft, dcfg, [], 4)
+        with pytest.raises(ValueError, match="max_len"):
+            speculative_generate(target, cfg, draft, dcfg, [1, 2], 8,
+                                 k=2, max_len=4)

@@ -9,7 +9,9 @@ shapes, scanned layers, no data-dependent Python control flow).
 """
 
 from .llama import LlamaConfig, llama_init, llama_forward, llama_loss
+from .lora import LoraConfig, lora_init, lora_loss, merge_lora
 from .vit import VitConfig, vit_init, vit_forward, vit_loss
 
 __all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
+           "LoraConfig", "lora_init", "lora_loss", "merge_lora",
            "VitConfig", "vit_init", "vit_forward", "vit_loss"]

@@ -1,0 +1,120 @@
+"""LoRA: low-rank adapter fine-tuning with a frozen base.
+
+Fine-tuning an 8B model with Adam costs ~4x the weights in optimizer state
+alone. LoRA trains only per-target low-rank factors ``W' = W + (α/r)·A·B``
+(A: (d_in, r), B: (r, d_out), B zero-initialized so step 0 is exactly the
+base model): gradients and moments exist ONLY for the adapters — the base
+stays frozen, sharded however it already is.
+
+TPU-first shape: adapters keep the stacked-layer leading ``(L, …)`` dim so
+the merge is one einsum per target and the merged tree drops straight into
+``lax.scan`` layer stacks. Training merges IN-GRAPH each step (cheap next
+to the fwd/bwd; XLA fuses the rank-r update) via ``lora_loss`` +
+``train.make_train_step`` with the ADAPTERS as the train state:
+
+    lcfg   = LoraConfig(rank=8, targets=("wq", "wv"))
+    adap   = lora_init(rng, params, lcfg)
+    loss   = lora_loss(params, cfg, lcfg)          # closes over frozen base
+    state  = init_train_state(adap, opt)           # optimizer sees adapters
+    step   = make_train_step(loss, optimizer=opt)
+
+Serving merges OFFLINE once (``merge_lora``), composing with the rest of
+the serving stack — the merged tree quantizes (``quantize_params``) and
+feeds the engine / speculative decoding unchanged.
+
+Reference analog: none (training technique; the reference is infra-only) —
+beyond-parity, like the serving stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .quant import is_quantized
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # layer-dict leaves to adapt; attention projections by default — present
+    # in both dense and MoE families (expert banks stay frozen)
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def lora_init(rng: jax.Array, params: Dict[str, Any],
+              lora_cfg: LoraConfig) -> Dict[str, Any]:
+    """Adapter pytree shaped off the base params: per target ``t`` of shape
+    (L, d_in, d_out), factors ``t__a`` (L, d_in, r) ~ N(0, 1/d_in) and
+    ``t__b`` (L, r, d_out) = 0 — so the merged model starts EXACTLY at the
+    base (asserted in tests)."""
+    layers = params["layers"]
+    out: Dict[str, jax.Array] = {}
+    keys = jax.random.split(rng, len(lora_cfg.targets))
+    for key, t in zip(keys, lora_cfg.targets):
+        if t not in layers:
+            raise KeyError(f"LoRA target {t!r} not in params['layers'] "
+                           f"(have {sorted(layers)})")
+        w = layers[t]
+        if is_quantized(w):
+            raise ValueError(
+                f"LoRA target {t!r} is int8-quantized — train on the "
+                "full-precision base and quantize AFTER merging")
+        l, d_in, d_out = w.shape
+        out[f"{t}__a"] = (jax.random.normal(key, (l, d_in, lora_cfg.rank),
+                                            jnp.float32)
+                          / jnp.sqrt(d_in)).astype(w.dtype)
+        out[f"{t}__b"] = jnp.zeros((l, lora_cfg.rank, d_out), w.dtype)
+    return {"layers": out}
+
+
+def merge_lora(params: Dict[str, Any], adapters: Dict[str, Any],
+               lora_cfg: LoraConfig) -> Dict[str, Any]:
+    """``W + (α/r)·A·B`` per target; every other leaf is SHARED with the
+    base tree (no copy). Works in-graph (training) and offline (serving)."""
+    merged_layers = dict(params["layers"])
+    for t in lora_cfg.targets:
+        a = adapters["layers"][f"{t}__a"]
+        b = adapters["layers"][f"{t}__b"]
+        w = params["layers"][t]
+        delta = jnp.einsum("lir,lro->lio", a.astype(jnp.float32),
+                           b.astype(jnp.float32)) * lora_cfg.scale
+        merged_layers[t] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return {**params, "layers": merged_layers}
+
+
+def lora_loss(base_params: Dict[str, Any], cfg,
+              lora_cfg: LoraConfig,
+              loss_fn: Callable | None = None) -> Callable:
+    """``fn(adapters, tokens, targets) -> scalar`` for
+    ``train.make_train_step``: merges in-graph, differentiates through the
+    merge — so grads/optimizer state exist only for the adapters and the
+    base rides along as a closed-over constant (donated nowhere, sharded
+    however it already is)."""
+    if loss_fn is None:
+        if hasattr(cfg, "n_experts"):
+            # MoE base: the dense chunked loss would run a SwiGLU over the
+            # expert bank and skip the router aux term entirely
+            from .moe import moe_loss
+            loss_fn = lambda p, t, y: moe_loss(p, t, y, cfg)  # noqa: E731
+        else:
+            from .llama import llama_loss_chunked
+            loss_fn = lambda p, t, y: llama_loss_chunked(p, t, y, cfg)  # noqa: E731
+
+    def fn(adapters, tokens, targets):
+        merged = merge_lora(base_params, adapters, lora_cfg)
+        return loss_fn(merged, tokens, targets)
+
+    return fn
+
+
+def adapter_count(adapters: Dict[str, Any]) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(adapters))

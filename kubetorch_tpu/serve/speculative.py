@@ -17,6 +17,11 @@ garbage by design: every forward writes its rows BEFORE attending, and the
 causal mask never admits a row at a position not yet written — the same
 invariant the slot-grid engine relies on.
 
+Dense decoders only: an MoE verify block routes up to 2k+1 tokens through
+one expert-capacity buffer, while the oracle decodes T=1 (which can never
+overflow) — the outputs would diverge, so MoE configs are refused up front
+(the slot-grid engine serves MoE exactly).
+
 Reference analog: none (serving optimization is user code there) — part of
 the beyond-parity serving stack, docs/serving.md.
 """
@@ -129,6 +134,17 @@ def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
     prompt = [int(t) for t in prompt]
     if not prompt:
         raise ValueError("empty prompt")
+    for name, c in (("target", target_cfg), ("draft", draft_cfg)):
+        if hasattr(c, "n_experts"):
+            # MoE verify blocks route 2k+1 tokens through one capacity
+            # buffer while the oracle decodes T=1 (which can never
+            # overflow) — the outputs would silently diverge from the
+            # bit-exactness this function promises. Refuse rather than
+            # mis-serve; the slot-grid engine serves MoE exactly.
+            raise ValueError(
+                f"speculative decoding supports dense decoders only; the "
+                f"{name} config is MoE (n_experts={c.n_experts}) — use "
+                "serve.GenerationEngine for MoE serving")
     p = len(prompt)
     p_bucket = next((b for b in sorted(prompt_buckets) if b >= p), p)
     # The cache must hold the FULL padded windows past the last valid row:

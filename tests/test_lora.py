@@ -1,0 +1,131 @@
+"""LoRA adapter fine-tuning (models/lora.py).
+
+Contracts: zero-init B makes step-0 merged == base exactly; training
+updates ONLY adapters (base frozen, optimizer state adapter-sized); the
+merged tree drops into the serving stack (engine, quantization).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubetorch_tpu.models.generate import generate
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.models.lora import (LoraConfig, adapter_count, lora_init,
+                                       lora_loss, merge_lora)
+from kubetorch_tpu.train import init_train_state, make_train_step
+
+pytestmark = [pytest.mark.level("unit"), pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+class TestLora:
+    def test_zero_init_merge_is_identity(self, base):
+        params, cfg = base
+        lcfg = LoraConfig(rank=4)
+        adap = lora_init(jax.random.PRNGKey(1), params, lcfg)
+        merged = merge_lora(params, adap, lcfg)
+        for t in lcfg.targets:
+            assert (np.asarray(merged["layers"][t])
+                    == np.asarray(params["layers"][t])).all()
+        # untargeted leaves are the SAME objects, not copies
+        assert merged["layers"]["w_gate"] is params["layers"]["w_gate"]
+        assert merged["embed"] is params["embed"]
+        out_m = np.asarray(generate(merged, jnp.asarray([[5, 6]], jnp.int32),
+                                    cfg, max_new_tokens=4))
+        out_b = np.asarray(generate(params, jnp.asarray([[5, 6]], jnp.int32),
+                                    cfg, max_new_tokens=4))
+        assert (out_m == out_b).all()
+
+    def test_training_moves_only_adapters(self, base):
+        params, cfg = base
+        lcfg = LoraConfig(rank=4, targets=("wq", "wv"))
+        adap = lora_init(jax.random.PRNGKey(1), params, lcfg)
+        n_base = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        assert adapter_count(adap) < n_base // 10
+
+        opt = optax.adam(1e-2)
+        step = make_train_step(lora_loss(params, cfg, lcfg), optimizer=opt)
+        state = init_train_state(adap, opt)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                  cfg.vocab_size)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+        base_before = jax.tree_util.tree_map(np.asarray, params)
+        losses = []
+        for _ in range(8):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] - 0.05, losses  # actually learning
+        # the frozen base never moved
+        for a, b in zip(jax.tree_util.tree_leaves(base_before),
+                        jax.tree_util.tree_leaves(params)):
+            assert (np.asarray(b) == a).all()
+        # optimizer state is adapter-sized (the LoRA memory win)
+        opt_leaves = sum(x.size for x in jax.tree_util.tree_leaves(
+            state.opt_state) if hasattr(x, "size"))
+        assert opt_leaves <= 2 * adapter_count(adap) + 16
+
+    def test_merged_adapters_change_output_and_serve(self, base):
+        params, cfg = base
+        lcfg = LoraConfig(rank=4, targets=("wq", "wv"))
+        adap = lora_init(jax.random.PRNGKey(1), params, lcfg)
+        # push B away from zero so the adapters actually do something
+        adap["layers"]["wq__b"] = jax.random.normal(
+            jax.random.PRNGKey(3), adap["layers"]["wq__b"].shape,
+            jnp.float32) * 0.1
+        merged = merge_lora(params, adap, lcfg)
+        out_m = np.asarray(generate(merged, jnp.asarray([[5, 6, 7]], jnp.int32),
+                                    cfg, max_new_tokens=6))
+        out_b = np.asarray(generate(params, jnp.asarray([[5, 6, 7]], jnp.int32),
+                                    cfg, max_new_tokens=6))
+        assert not (out_m == out_b).all()
+
+        # merged tree → engine → int8, the whole serving chain
+        from kubetorch_tpu.serve import GenerationEngine, quantize_params
+
+        eng = GenerationEngine(quantize_params(merged), cfg, slots=1,
+                               max_len=32, prefill_buckets=(4,))
+        h = eng.submit([5, 6, 7], max_new_tokens=4)
+        while eng.step():
+            pass
+        assert len(h.result(timeout=0)) == 4
+
+    def test_validation(self, base):
+        params, cfg = base
+        with pytest.raises(KeyError, match="nope"):
+            lora_init(jax.random.PRNGKey(0), params,
+                      LoraConfig(targets=("nope",)))
+        from kubetorch_tpu.models.quant import quantize_params as qp
+        with pytest.raises(ValueError, match="quantized"):
+            lora_init(jax.random.PRNGKey(0), qp(params), LoraConfig())
+
+
+def test_moe_base_trains_with_default_loss():
+    """A MoE base picks the MoE loss (router aux included) by default; the
+    attention-projection targets exist in MoE layer dicts too."""
+    from kubetorch_tpu.models.moe import MoeConfig, moe_init
+
+    cfg = MoeConfig.tiny(dtype=jnp.float32, remat=False, attn_impl="xla")
+    params = moe_init(jax.random.PRNGKey(0), cfg)
+    lcfg = LoraConfig(rank=2, targets=("wq", "wv"))
+    adap = lora_init(jax.random.PRNGKey(1), params, lcfg)
+    opt = optax.adam(1e-2)
+    step = make_train_step(lora_loss(params, cfg, lcfg), optimizer=opt)
+    state = init_train_state(adap, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.02, losses

@@ -77,3 +77,15 @@ class TestExactness:
         with pytest.raises(ValueError, match="max_len"):
             speculative_generate(target, cfg, draft, dcfg, [1, 2], 8,
                                  k=2, max_len=4)
+
+
+def test_moe_refused_with_clear_error(models):
+    from kubetorch_tpu.models.moe import MoeConfig, moe_init
+
+    target, cfg, _, _ = models
+    mcfg = MoeConfig.tiny(dtype=jnp.float32, remat=False, attn_impl="xla")
+    mo = moe_init(jax.random.PRNGKey(1), mcfg)
+    with pytest.raises(ValueError, match="dense decoders only"):
+        speculative_generate(mo, mcfg, target, cfg, [1, 2], 4)
+    with pytest.raises(ValueError, match="dense decoders only"):
+        speculative_generate(target, cfg, mo, mcfg, [1, 2], 4)

@@ -1,0 +1,90 @@
+"""LoRA fine-tune → merge → serve, as one remote workflow.
+
+The train→serve loop on a single deployed service: fine-tune low-rank
+adapters against a frozen base (optimizer state is adapter-sized — the
+reason an 8B fine-tune fits where full Adam doesn't), merge offline,
+quantize to int8, and serve the result from the same pod's
+continuous-batching engine.
+
+Run: ``python examples/lora_finetune.py`` (local pods; on a cluster the
+same code with ``tpu="v5e-8"`` — the base stays sharded however the mesh
+rules placed it, adapters are tiny and replicated).
+"""
+
+import kubetorch_tpu as kt
+
+
+class LoraWorkbench:
+    """Stateful service: base params live across calls; fine-tune and
+    serve without ever shipping weights through the client."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubetorch_tpu.models import LlamaConfig, llama_init
+
+        self.cfg = LlamaConfig.tiny(attn_impl="auto", dtype=jnp.float32,
+                                    remat=False)
+        self.base = llama_init(jax.random.PRNGKey(0), self.cfg)
+        self.engine = None
+
+    def finetune(self, steps: int = 8, rank: int = 4, lr: float = 1e-2):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from kubetorch_tpu.models import LoraConfig, lora_init, lora_loss
+        from kubetorch_tpu.train import init_train_state, make_train_step
+
+        lcfg = LoraConfig(rank=rank, targets=("wq", "wv"))
+        adapters = lora_init(jax.random.PRNGKey(1), self.base, lcfg)
+        opt = optax.adam(lr)
+        step = make_train_step(lora_loss(self.base, self.cfg, lcfg),
+                               optimizer=opt)
+        state = init_train_state(adapters, opt)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                  self.cfg.vocab_size)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+        losses = []
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(round(float(m["loss"]), 4))
+        self._adapters, self._lcfg = state.params, lcfg
+        return losses
+
+    def deploy_merged(self, slots: int = 4, quantize: bool = True):
+        """Merge the trained adapters and stand up the engine on them."""
+        from kubetorch_tpu.models import merge_lora
+        from kubetorch_tpu.serve import GenerationEngine, quantize_params
+
+        merged = merge_lora(self.base, self._adapters, self._lcfg)
+        if quantize:
+            merged = quantize_params(merged)
+        if self.engine is not None:
+            self.engine.stop()
+        self.engine = GenerationEngine(merged, self.cfg, slots=slots,
+                                       max_len=128,
+                                       prefill_buckets=(16,)).start()
+        return {"quantized": quantize, "slots": slots}
+
+    def generate(self, prompt, n: int = 16):
+        return self.engine.generate(prompt, max_new_tokens=n, timeout=240)
+
+
+def main():
+    svc = kt.cls(LoraWorkbench)
+    svc.to(kt.Compute(cpus=1))
+    try:
+        losses = svc.finetune(steps=8)
+        print(f"finetune: loss {losses[0]} -> {losses[-1]}")
+        assert losses[-1] < losses[0]
+        print("deploy:", svc.deploy_merged())
+        toks = svc.generate([5, 6, 7], 8)
+        print(f"serving merged+int8 model: {len(toks)} tokens {toks}")
+    finally:
+        svc.teardown()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,3 +73,22 @@ def test_continuous_batching_service_example(capsys):
     finally:
         shutdown_local_controller()
         reset_config()
+
+
+@pytest.mark.slow
+def test_lora_finetune_example(capsys):
+    """Fine-tune → merge → int8 → serve on one remote service."""
+    from kubetorch_tpu.client import shutdown_local_controller
+    from kubetorch_tpu.config import reset_config
+
+    import lora_finetune
+
+    reset_config()
+    try:
+        lora_finetune.main()
+        out = capsys.readouterr().out
+        assert "finetune: loss" in out
+        assert "serving merged+int8 model: 8 tokens" in out
+    finally:
+        shutdown_local_controller()
+        reset_config()

@@ -156,6 +156,20 @@ class LocalBackend:
     def _volume_dir(self, namespace: str, name: str) -> str:
         return os.path.join(self.volumes_dir, f"{namespace}__{name}")
 
+    @staticmethod
+    def _container_env(manifest: Dict) -> Dict[str, str]:
+        """Plain ``{name, value}`` container env from the manifest — the
+        kubelet-analog for ``Compute(env={...})``: the K8s backend gets
+        these injected by the kubelet, so subprocess pods must see them
+        too or user env silently works only on real clusters."""
+        env: Dict[str, str] = {}
+        for spec in _pod_specs(manifest):
+            for container in spec.get("containers", []):
+                for entry in container.get("env", []):
+                    if entry.get("name") and "value" in entry:
+                        env[entry["name"]] = str(entry["value"])
+        return env
+
     def _volume_env(self, namespace: str, manifest: Dict) -> Dict[str, str]:
         """Resolve PVC claims in the pod template to host directories:
         ``KT_VOLUME_<NAME>`` points at the backing dir (and is created on
@@ -305,6 +319,7 @@ class LocalBackend:
         from ..constants import POD_IDENTITY_ENV
         for stale in POD_IDENTITY_ENV:
             pod_env.pop(stale, None)
+        pod_env.update(self._container_env(manifest))
         pod_env.update(self._secret_env(namespace, manifest))
         pod_env.update(self._volume_env(namespace, manifest))
         pod_env.update(env)

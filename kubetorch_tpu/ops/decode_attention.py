@@ -1,0 +1,146 @@
+"""Flash-decode: fused single-token attention over a slot-grid KV cache.
+
+The engine's decode step attends one new token per slot against that slot's
+whole cache. The XLA einsum path materializes a (B, NKV, G, S) logits
+tensor per step and reads the full (B, S, NKV, Hd) cache even past each
+slot's frontier; at serving lengths the logits tile plus the masked tail
+are wasted HBM round-trips on the latency-critical op. This kernel streams
+K/V tiles through VMEM with an online softmax (the FlashAttention recipe
+with a query block of GQA group rows) and — the decode-specific part —
+**skips every tile beyond the slot's position outright**: ``pos`` rides in
+as a prefetched scalar and the K/V BlockSpec index maps clamp to the last
+in-range tile (Pallas elides the DMA when the block index repeats), so a
+slot 300 tokens into a 4096-row cache streams 8 tiles, not 32
+([pos // block_k] + 1 of them); ``pl.when`` skips the matching compute.
+
+Layout mirrors ``ops.attention``: (B, NKV, G, Hd) query block per grid
+step, K/V head-major, fp32 accumulators in VMEM scratch, the innermost
+grid axis sequential over K tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# query rows per block = GQA group size padded up to the fp32 sublane tile
+_MIN_ROWS = 8
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float, block_k: int):
+    b = pl.program_id(0)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos_b = pos_ref[b]
+    start = kj * block_k
+
+    # the whole tile is past this slot's frontier ⇒ nothing to read
+    @pl.when(start <= pos_b)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # (Gp, Hd)
+        k = k_ref[0, 0].astype(jnp.float32)           # (BK, Hd)
+        v = v_ref[0, 0]                               # keep cache dtype:
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = start + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        s = jnp.where(cols <= pos_b, s, NEG_INF)
+
+        m_prev = m_ref[:]                             # (Gp, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p rounds through the cache dtype before the PV dot (fp32 acc) —
+        # same rounding as the einsum reference and the flash fwd kernel
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                     pos: jax.Array, *, scale: Optional[float] = None,
+                     block_k: int = 512,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """One new token per slot against its cache rows ``<= pos``.
+
+    q: (B, NH, Hd); ck/cv: (B, S, NKV, Hd); pos: (B,) int32 — the row each
+    slot's new token occupies (already written). Returns (B, NH, Hd).
+    Bit-compatible with the masked-einsum reference in
+    ``serve.engine._decode_layer`` (asserted in tests/test_decode_kernel.py).
+    """
+    b, nh, hd = q.shape
+    s, nkv = ck.shape[1], ck.shape[2]
+    assert nh % nkv == 0, f"GQA requires n_kv | n_heads, got {nkv}, {nh}"
+    group = nh // nkv
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bk = min(block_k, s)
+    while s % bk:
+        bk //= 2
+
+    # group-major query rows, padded to the sublane tile
+    gp = max(_MIN_ROWS, group)
+    qg = q.reshape(b, nkv, group, hd)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    kt = ck.transpose(0, 2, 1, 3)                     # (B, NKV, S, Hd)
+    vt = cv.transpose(0, 2, 1, 3)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_k=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nkv, s // bk),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, hd), lambda b_, h, j, pos_: (b_, h, 0, 0)),
+                # the frontier skip lives HERE, not in the kernel body:
+                # Pallas elides a block DMA only when the index map returns
+                # the same block as the previous step, so past-frontier
+                # steps clamp to the last in-range tile (the kernel's
+                # pl.when then skips the compute too). pl.when alone would
+                # save FLOPs but still stream every tile from HBM.
+                pl.BlockSpec((1, 1, bk, hd),
+                             lambda b_, h, j, pos_: (
+                                 b_, h, jnp.minimum(j, pos_[b_] // bk), 0)),
+                pl.BlockSpec((1, 1, bk, hd),
+                             lambda b_, h, j, pos_: (
+                                 b_, h, jnp.minimum(j, pos_[b_] // bk), 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, hd),
+                                   lambda b_, h, j, pos_: (b_, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, hd), jnp.float32),    # acc
+                pltpu.VMEM((gp, 1), jnp.float32),     # m
+                pltpu.VMEM((gp, 1), jnp.float32),     # l
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), qg, kt, vt)
+    return out[:, :, :group].reshape(b, nh, hd)

@@ -38,6 +38,7 @@ RLHF rollout actors (BASELINE config 4) and autoscaled inference services.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -57,6 +58,21 @@ from ..models.llama import rmsnorm
 from ..models.quant import dequant_layer, head_weight
 
 NEG_INF = -1e30
+
+# Decode-attention dispatch, frozen at import like generate's flash flag
+# (the gate runs at trace time inside jits whose cache key never sees env):
+# "1" forces the Pallas flash-decode kernel on (interpret mode off-TPU —
+# how tests cover the branch), "0" forces the masked einsum, "auto" uses
+# the kernel on the TPU backend.
+_DECODE_KERNEL_FLAG = os.environ.get("KT_DECODE_KERNEL", "auto")
+
+
+def _decode_kernel_wanted() -> bool:
+    if _DECODE_KERNEL_FLAG == "1":
+        return True
+    if _DECODE_KERNEL_FLAG == "0":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 # ---------------------------------------------------------------------------
@@ -98,15 +114,23 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
     ck = ck.at[bi, pos].set(k.astype(ck.dtype))
     cv = cv.at[bi, pos].set(v.astype(cv.dtype))
 
-    group = nh // nkv
-    qg = q.reshape(b, nkv, group, hd)
-    logits = (jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
-              * (hd ** -0.5))
-    s = ck.shape[1]
-    mask = jnp.arange(s)[None, :] <= pos[:, None]          # (B, S)
-    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
-    attn = jnp.einsum("bkgs,bskh->bkgh", probs, cv).reshape(b, 1, nh * hd)
+    if _decode_kernel_wanted():
+        # fused flash-decode: streams K/V tiles, skips tiles past each
+        # slot's frontier entirely (ops/decode_attention.py)
+        from ..ops.decode_attention import decode_attention
+        attn = decode_attention(q, ck, cv, pos,
+                                scale=hd ** -0.5).reshape(b, 1, nh * hd)
+    else:
+        group = nh // nkv
+        qg = q.reshape(b, nkv, group, hd)
+        logits = (jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
+                  * (hd ** -0.5))
+        s = ck.shape[1]
+        mask = jnp.arange(s)[None, :] <= pos[:, None]      # (B, S)
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+        attn = jnp.einsum("bkgs,bskh->bkgh", probs,
+                          cv).reshape(b, 1, nh * hd)
     x = x + attn @ lw["wo"]
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     return x + ffn_block(cfg, h, lw), ck, cv

@@ -1,0 +1,107 @@
+"""Flash-decode kernel (ops/decode_attention.py) — interpret mode on CPU,
+the same code path the TPU runs compiled (mirrors test_flash_attention.py).
+
+Contracts: numerically equal to the masked-einsum reference for any
+per-slot position vector, and the ENGINE produces identical tokens with
+the kernel forced on (KT_DECODE_KERNEL=1 in a subprocess, since the flag
+freezes at import)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.ops.decode_attention import decode_attention
+
+pytestmark = pytest.mark.level("unit")
+
+
+def _einsum_ref(q, ck, cv, pos, scale):
+    b, nh, hd = q.shape
+    s, nkv = ck.shape[1], ck.shape[2]
+    g = nh // nkv
+    qg = q.reshape(b, nkv, g, hd)
+    logits = (jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
+              * scale)
+    mask = jnp.arange(s)[None, :] <= pos[:, None]
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(cv.dtype)
+    return jnp.einsum("bkgs,bskh->bkgh", probs, cv).reshape(b, nh, hd)
+
+
+class TestKernel:
+    @pytest.mark.parametrize("shape", [
+        (4, 256, 8, 4, 128),     # multi-tile, GQA group 2
+        (2, 512, 4, 1, 64),      # MQA, group 4
+        (3, 128, 6, 2, 128),     # odd batch, group 3 (padded rows)
+        (1, 64, 8, 8, 64),       # group 1 (pure MHA)
+    ])
+    def test_matches_einsum(self, shape):
+        b, s, nh, nkv, hd = shape
+        rng = np.random.default_rng(hash(shape) % 2**31)
+        q = jnp.asarray(rng.standard_normal((b, nh, hd)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
+        pos = jnp.asarray(rng.integers(0, s, b), jnp.int32)
+        got = decode_attention(q, ck, cv, pos, block_k=128)
+        want = _einsum_ref(q, ck, cv, pos, hd ** -0.5)
+        assert float(jnp.max(jnp.abs(got - want))) < 2e-5
+
+    def test_edge_positions(self):
+        """pos at row 0 (only the fresh token visible) and at the last row
+        (whole cache visible)."""
+        b, s, nh, nkv, hd = 2, 128, 4, 2, 64
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((b, nh, hd)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
+        pos = jnp.asarray([0, s - 1], jnp.int32)
+        got = decode_attention(q, ck, cv, pos, block_k=64)
+        want = _einsum_ref(q, ck, cv, pos, hd ** -0.5)
+        assert float(jnp.max(jnp.abs(got - want))) < 2e-5
+
+    def test_bf16_inputs(self):
+        b, s, nh, nkv, hd = 2, 256, 8, 4, 128
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((b, nh, hd)), jnp.bfloat16)
+        ck = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.bfloat16)
+        cv = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.bfloat16)
+        pos = jnp.asarray([100, 255], jnp.int32)
+        got = decode_attention(q, ck, cv, pos, block_k=128)
+        want = _einsum_ref(q.astype(jnp.float32), ck.astype(jnp.float32),
+                           cv.astype(jnp.float32), pos, hd ** -0.5)
+        assert float(jnp.max(jnp.abs(got.astype(jnp.float32) - want))) < 0.02
+
+
+@pytest.mark.slow
+def test_engine_tokens_identical_with_kernel_forced():
+    """The engine with KT_DECODE_KERNEL=1 (kernel, interpret mode) emits
+    exactly the tokens of the default einsum path — run in a subprocess
+    because the dispatch flag freezes at import."""
+    code = r"""
+import numpy as np, jax, jax.numpy as jnp
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.serve import GenerationEngine
+
+cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+params = llama_init(jax.random.PRNGKey(0), cfg)
+eng = GenerationEngine(params, cfg, slots=2, max_len=32, prefill_buckets=(4,))
+hs = [eng.submit(p, max_new_tokens=6) for p in ([5, 17, 42], [9, 8])]
+while eng.step():
+    pass
+print([h.result(timeout=0) for h in hs])
+"""
+    outs = {}
+    for flag in ("0", "1"):
+        env = {**os.environ, "KT_DECODE_KERNEL": flag,
+               "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs[flag] = r.stdout.strip().splitlines()[-1]
+    assert outs["0"] == outs["1"], outs

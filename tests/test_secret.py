@@ -320,3 +320,31 @@ class TestPodEnvHygiene:
         assert env["KT_MODULE_NAME"] == "real_module"   # metadata overlay
         # the backend's own store wins over anything inherited
         assert env["KT_DATA_STORE_URL"] == "http://127.0.0.1:2"
+
+
+def test_compute_env_reaches_local_pods(tmp_path, monkeypatch):
+    """Compute(env={...}) lands in the manifest's container env; the local
+    backend must inject it like the kubelet would — previously user env
+    silently worked only on real clusters."""
+    from kubetorch_tpu.controller import backends as be_mod
+    from kubetorch_tpu.controller.backends import LocalBackend
+    from kubetorch_tpu.provisioning.manifests import (
+        build_deployment_manifest, build_pod_template)
+
+    captured = {}
+
+    class FakeProc:
+        pid = 4243
+
+        def poll(self):
+            return None
+
+    monkeypatch.setattr(be_mod.subprocess, "Popen",
+                        lambda cmd, env=None, **kw: (captured.update(env=env),
+                                                     FakeProc())[1])
+    monkeypatch.setattr(be_mod, "wait_for_port", lambda *a, **k: True)
+    be = LocalBackend("http://127.0.0.1:9", secrets_dir=str(tmp_path / "s"),
+                      volumes_dir=str(tmp_path / "v"))
+    pod = build_pod_template("web", "img", {"MY_FLAG": "on"})
+    be.apply("ns1", "web", build_deployment_manifest("web", "ns1", 1, pod), {})
+    assert captured["env"]["MY_FLAG"] == "on"

@@ -118,7 +118,7 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
     else:
         attn = _cached_attention(q, layer_cache_k, layer_cache_v, q_pos,
                                  cfg.head_dim ** -0.5)
-    x = x + attn.reshape(b, t, -1) @ lw["wo"]
+    x = x + lora_proj(attn.reshape(b, t, -1), lw["wo"], lora, "wo")
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     return (x + ffn_block(cfg, h, lw, token_mask=token_mask,
                           keep_capacity=keep_capacity),

@@ -118,3 +118,35 @@ def lora_loss(base_params: Dict[str, Any], cfg,
 
 def adapter_count(adapters: Dict[str, Any]) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(adapters))
+
+
+def lora_delta(x: jax.Array, a: jax.Array, b: jax.Array,
+               scale: float) -> jax.Array:
+    """The rank-r activation-path contribution ``((x·A)·B)·scale`` — how
+    serving applies adapters WITHOUT merging (multi-LoRA: different slots
+    run different adapters through one compiled step).
+
+    x: (B, T, D). a/b either shared across the batch (2-D: (D, R)/(R, O) —
+    one request's prefill) or batched per slot (3-D: (B, D, R)/(B, R, O) —
+    the decode grid, adapters gathered per slot)."""
+    xf = x.astype(jnp.float32)
+    if a.ndim == 2:
+        h = xf @ a.astype(jnp.float32)
+        out = h @ b.astype(jnp.float32)
+    else:
+        h = jnp.einsum("btd,bdr->btr", xf, a.astype(jnp.float32))
+        out = jnp.einsum("btr,bro->bto", h, b.astype(jnp.float32))
+    return (out * scale).astype(x.dtype)
+
+
+def lora_proj(x: jax.Array, w: jax.Array, lora, target: str) -> jax.Array:
+    """``x @ W`` plus the adapter delta when ``lora`` carries this target.
+    ``lora``: None, or (adapters_by_target, scale) where adapters_by_target
+    maps target name → (a, b) in either ``lora_delta`` layout."""
+    y = x @ w
+    if lora is not None:
+        by_target, scale = lora
+        ab = by_target.get(target)
+        if ab is not None:
+            y = y + lora_delta(x, ab[0], ab[1], scale)
+    return y

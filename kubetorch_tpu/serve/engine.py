@@ -55,6 +55,7 @@ from jax import lax
 from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
                                rope_freqs)
 from ..models.llama import rmsnorm
+from ..models.lora import lora_proj
 from ..models.quant import dequant_layer, head_weight
 
 NEG_INF = -1e30
@@ -94,20 +95,22 @@ def _rope_slot(x: jax.Array, freqs: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
+def _decode_layer(cfg, x, lw, ck, cv, pos, freqs, lora=None):
     """One layer over one new token per slot.
 
     x: (B, 1, D); ck/cv: (B, S, NKV, Hd); pos: (B,) absolute position of
     each slot's new token (also its cache row); freqs: (B, Hd/2) complex.
+    ``lora``: per-slot adapters already gathered to (B, D, R)/(B, R, O)
+    per target (multi-LoRA serving — see ``GenerationEngine`` docs).
     """
     b = x.shape[0]
     hd = cfg.head_dim
     nh, nkv = cfg.n_heads, cfg.n_kv_heads
     lw = dequant_layer(lw, cfg.dtype)    # int8 serving weights (models.quant)
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
-    q = (h @ lw["wq"]).reshape(b, nh, hd)
-    k = (h @ lw["wk"]).reshape(b, nkv, hd)
-    v = (h @ lw["wv"]).reshape(b, nkv, hd)
+    q = lora_proj(h, lw["wq"], lora, "wq").reshape(b, nh, hd)
+    k = lora_proj(h, lw["wk"], lora, "wk").reshape(b, nkv, hd)
+    v = lora_proj(h, lw["wv"], lora, "wv").reshape(b, nkv, hd)
     q, k = _rope_slot(q, freqs), _rope_slot(k, freqs)
 
     bi = jnp.arange(b)
@@ -131,7 +134,7 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs):
         probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
         attn = jnp.einsum("bkgs,bskh->bkgh", probs,
                           cv).reshape(b, 1, nh * hd)
-    x = x + attn @ lw["wo"]
+    x = x + lora_proj(attn, lw["wo"], lora, "wo")
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     return x + ffn_block(cfg, h, lw), ck, cv
 
@@ -150,30 +153,41 @@ def _sample_slots(logits, key, temps, top_k: Optional[int]):
     return jnp.where(temps > 0, sampled, greedy)
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
+         donate_argnums=(1,))
 def _decode_step(params, cache: KVCache, pos, toks, rng, temps, cfg,
-                 top_k: Optional[int] = None):
+                 top_k: Optional[int] = None, banks=None, aidx=None,
+                 lora_scale: float = 1.0):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
-    temperature. Returns (cache', next_tok)."""
+    temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
+    (B,) select each slot's LoRA adapter (index 0 = the zero adapter =
+    base model). Returns (cache', next_tok)."""
     x = params["embed"][toks[:, None]].astype(cfg.dtype)   # (B, 1, D)
     freqs = rope_freqs(cfg, cache.k.shape[2])[pos]          # (B, Hd/2)
 
     def body(carry, layer):
-        lw, ck, cv = layer
-        h, ck, cv = _decode_layer(cfg, carry, lw, ck, cv, pos, freqs)
+        lw, ck, cv, bank_l = layer
+        lora = None
+        if banks:
+            lora = ({t: (a[aidx], b_[aidx]) for t, (a, b_) in bank_l.items()},
+                    lora_scale)
+        h, ck, cv = _decode_layer(cfg, carry, lw, ck, cv, pos, freqs,
+                                  lora=lora)
         return h, (ck, cv)
 
-    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v,
+                                     banks or {}))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     nxt = _sample_slots(logits, rng, temps, top_k)
     return KVCache(nk, nv), nxt
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k"))
+@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill(params, tokens, true_len, rng, temps, cfg,
-             top_k: Optional[int] = None):
+             top_k: Optional[int] = None, adapter=None,
+             lora_scale: float = 1.0):
     """Prompt pass at one bucket length. tokens (1, T_bucket) right-padded;
     logits are taken at the REAL last position ``true_len - 1`` (padding
     rows only pollute their own cache rows, which decode overwrites before
@@ -194,13 +208,15 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     keep_capacity = _moe_keep_capacity(cfg, true_len)
 
     def body(carry, layer):
-        lw, ck, cv = layer
+        lw, ck, cv, ad_l = layer
+        lora = (ad_l, lora_scale) if adapter else None
         h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
                                 flash_prefill=flash, token_mask=token_mask,
-                                keep_capacity=keep_capacity)
+                                keep_capacity=keep_capacity, lora=lora)
         return h, (ck, cv)
 
-    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v,
+                                     adapter or {}))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
@@ -218,9 +234,10 @@ def _moe_keep_capacity(cfg, true_len):
     ).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k"))
+@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
-                    rng, temps, cfg, top_k: Optional[int] = None):
+                    rng, temps, cfg, top_k: Optional[int] = None,
+                    adapter=None, lora_scale: float = 1.0):
     """Suffix prompt pass behind a cached prefix: tokens (1, T_bucket)
     right-padded run at absolute positions ``prefix_len + i`` attending the
     prefix's REAL K/V rows plus themselves. The prefix stays padded to its
@@ -250,13 +267,15 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     cv0 = jnp.concatenate([prefix_v, pad], axis=2)
 
     def body(carry, layer):
-        lw, ck, cv = layer
+        lw, ck, cv, ad_l = layer
+        lora = (ad_l, lora_scale) if adapter else None
         h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
                                 flash_prefill=False, token_mask=token_mask,
-                                keep_capacity=keep_capacity)
+                                keep_capacity=keep_capacity, lora=lora)
         return h, (ck, cv)
 
-    x, (nk, nv) = lax.scan(body, x, (params["layers"], ck0, cv0))
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], ck0, cv0,
+                                     adapter or {}))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .llama import LlamaConfig, apply_rope, rmsnorm, rope_freqs
+from .lora import lora_proj
 from .moe import MoeConfig, moe_ffn, moe_ffn_decode
 
 NEG_INF = -1e30
@@ -91,18 +92,25 @@ def _flash_prefill_wanted(cfg, t: int) -> bool:
 
 def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
                 flash_prefill: bool = False, token_mask=None,
-                keep_capacity=None):
+                keep_capacity=None, lora=None):
     """One transformer layer over T new tokens, updating this layer's cache.
     ``lw`` may carry int8-quantized leaves (``models.quant``) — dequantized
     here, inside the scan body, so only the current layer materializes in
-    the compute dtype."""
+    the compute dtype. ``lora``: None, or (adapters_by_target, scale) with
+    this LAYER's factors per target (``models.lora.lora_proj``) — the
+    unmerged activation-path adapters multi-LoRA serving runs; applied to
+    the same target set as the engine's ``_decode_layer`` (wq/wk/wv/wo) so
+    prefill and decode adapter semantics can never diverge."""
     from .quant import dequant_layer
     lw = dequant_layer(lw, cfg.dtype)
     b, t, d = x.shape
     h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
-    q = (h @ lw["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ lw["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ lw["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = lora_proj(h, lw["wq"], lora, "wq").reshape(b, t, cfg.n_heads,
+                                                   cfg.head_dim)
+    k = lora_proj(h, lw["wk"], lora, "wk").reshape(b, t, cfg.n_kv_heads,
+                                                   cfg.head_dim)
+    v = lora_proj(h, lw["wv"], lora, "wv").reshape(b, t, cfg.n_kv_heads,
+                                                   cfg.head_dim)
     freqs = freqs_full[q_pos]                            # (T, Hd/2)
     q, k = apply_rope(q, freqs), apply_rope(k, freqs)
 

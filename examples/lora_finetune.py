@@ -2,9 +2,14 @@
 
 The train→serve loop on a single deployed service: fine-tune low-rank
 adapters against a frozen base (optimizer state is adapter-sized — the
-reason an 8B fine-tune fits where full Adam doesn't), merge offline,
-quantize to int8, and serve the result from the same pod's
-continuous-batching engine.
+reason an 8B fine-tune fits where full Adam doesn't), then serve BOTH
+ways the engine supports:
+
+- **merged**: fold the adapters into the weights offline (optionally
+  int8-quantized) — one model, fastest steady-state;
+- **multi-LoRA**: keep the base frozen and register each adapter into the
+  engine's activation-path bank — many fine-tunes share one engine, one
+  compiled decode step, per-request ``adapter_id``.
 
 Run: ``python examples/lora_finetune.py`` (local pods; on a cluster the
 same code with ``tpu="v5e-8"`` — the base stays sharded however the mesh
@@ -29,7 +34,10 @@ class LoraWorkbench:
         self.base = llama_init(jax.random.PRNGKey(0), self.cfg)
         self.engine = None
 
-    def finetune(self, steps: int = 8, rank: int = 4, lr: float = 1e-2):
+    def finetune(self, steps: int = 8, rank: int = 4, lr: float = 1e-2,
+                 seed: int = 1):
+        """Train one adapter set; each distinct ``seed`` (its data stream)
+        is a separate fine-tune, kept under its own name."""
         import jax
         import jax.numpy as jnp
         import optax
@@ -38,12 +46,12 @@ class LoraWorkbench:
         from kubetorch_tpu.train import init_train_state, make_train_step
 
         lcfg = LoraConfig(rank=rank, targets=("wq", "wv"))
-        adapters = lora_init(jax.random.PRNGKey(1), self.base, lcfg)
+        adapters = lora_init(jax.random.PRNGKey(seed), self.base, lcfg)
         opt = optax.adam(lr)
         step = make_train_step(lora_loss(self.base, self.cfg, lcfg),
                                optimizer=opt)
         state = init_train_state(adapters, opt)
-        toks = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+        toks = jax.random.randint(jax.random.PRNGKey(seed + 1), (4, 32), 0,
                                   self.cfg.vocab_size)
         batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
         losses = []
@@ -51,6 +59,8 @@ class LoraWorkbench:
             state, m = step(state, batch)
             losses.append(round(float(m["loss"]), 4))
         self._adapters, self._lcfg = state.params, lcfg
+        self._trained = getattr(self, "_trained", {})
+        self._trained[seed] = state.params
         return losses
 
     def deploy_merged(self, slots: int = 4, quantize: bool = True):
@@ -68,20 +78,52 @@ class LoraWorkbench:
                                        prefill_buckets=(16,)).start()
         return {"quantized": quantize, "slots": slots}
 
-    def generate(self, prompt, n: int = 16):
-        return self.engine.generate(prompt, max_new_tokens=n, timeout=240)
+    def deploy_multi_lora(self, slots: int = 4):
+        """Serve the BASE model with every trained adapter registered into
+        one engine's activation-path bank: requests pick their fine-tune
+        per call (``adapter_id``), neighbors on the slot grid can run
+        different adapters — or none — through one compiled step."""
+        from kubetorch_tpu.serve import GenerationEngine
+
+        if self.engine is not None:
+            self.engine.stop()
+        self.engine = GenerationEngine(self.base, self.cfg, slots=slots,
+                                       max_len=128,
+                                       prefill_buckets=(16,)).start()
+        self._adapter_ids = {
+            seed: self.engine.register_adapter(adap, self._lcfg)
+            for seed, adap in self._trained.items()}
+        # JSON-serializable response: string keys
+        return {"adapters": {str(s): a for s, a in self._adapter_ids.items()},
+                "slots": slots}
+
+    def generate(self, prompt, n: int = 16, finetune_seed=None):
+        aid = (None if finetune_seed is None
+               else self._adapter_ids[finetune_seed])
+        return self.engine.generate(prompt, max_new_tokens=n, timeout=240,
+                                    adapter_id=aid)
 
 
 def main():
     svc = kt.cls(LoraWorkbench)
     svc.to(kt.Compute(cpus=1))
     try:
-        losses = svc.finetune(steps=8)
-        print(f"finetune: loss {losses[0]} -> {losses[-1]}")
+        losses = svc.finetune(steps=8, seed=1)
+        print(f"finetune #1: loss {losses[0]} -> {losses[-1]}")
         assert losses[-1] < losses[0]
-        print("deploy:", svc.deploy_merged())
+        print("deploy merged:", svc.deploy_merged())
         toks = svc.generate([5, 6, 7], 8)
         print(f"serving merged+int8 model: {len(toks)} tokens {toks}")
+
+        # second fine-tune, then both adapters live on ONE engine
+        losses2 = svc.finetune(steps=8, seed=2)
+        print(f"finetune #2: loss {losses2[0]} -> {losses2[-1]}")
+        print("deploy multi-lora:", svc.deploy_multi_lora())
+        t1 = svc.generate([5, 6, 7], 8, finetune_seed=1)
+        t2 = svc.generate([5, 6, 7], 8, finetune_seed=2)
+        tb = svc.generate([5, 6, 7], 8)
+        print(f"adapter1={t1}\nadapter2={t2}\nbase    ={tb}")
+        assert t1 != t2, "distinct fine-tunes should diverge"
     finally:
         svc.teardown()
 

@@ -304,6 +304,7 @@ class _Request:
     max_new_tokens: int
     temperature: Optional[float] = None      # None → engine default
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
+    adapter_id: Optional[int] = None         # registered LoRA adapter
     error: Optional[BaseException] = None    # admission failure, surfaced
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     generated: int = 0
@@ -418,6 +419,15 @@ class GenerationEngine:
         # id → (k_bucketed, v_bucketed, true_len)
         self._prefixes: Dict[int, tuple] = {}
         self._prefix_ids = itertools.count()
+        # multi-LoRA: stacked adapter banks, target → (A (L,N,D,R),
+        # B (L,N,R,O)); bank index 0 is the all-zero adapter (= base model),
+        # which idle and base-traffic slots point at
+        self._lora_cfg = None
+        self._banks: Optional[Dict[str, tuple]] = None
+        self._adapter_slots: Dict[int, int] = {}   # public id → bank index
+        self._free_bank: List[int] = []
+        self._adapter_ids = itertools.count(1)
+        self._aidx = np.zeros(self.slots, np.int32)
         self._rng = jax.random.PRNGKey(seed)
         self._rid = itertools.count()
         self._lock = threading.Lock()
@@ -434,16 +444,121 @@ class GenerationEngine:
         self._tokens = self._steps = 0
         self._t0 = time.monotonic()
 
+    # -- adapters -----------------------------------------------------------
+
+    def register_adapter(self, adapters: Dict[str, Any], lora_cfg) -> int:
+        """Install a LoRA adapter (``models.lora.lora_init`` layout:
+        ``layers`` dict of per-target stacked ``{t}__a`` (L, D, R) /
+        ``{t}__b`` (L, R, O) factors) for UNMERGED activation-path serving:
+        requests submitted with the returned id run ``x·W + s·(x·A)·B``
+        through one compiled step shared with every other adapter and the
+        base model — different slots, different adapters, no weight swap.
+
+        All adapters on one engine must share the first registration's
+        rank, targets, and scale (they stack into one bank per target).
+        Growing the bank (a registration with no free slot) changes the
+        decode step's shapes — one recompile; prefer registering the fleet
+        up front. Freed slots (:meth:`unregister_adapter`) are reused
+        without recompiling."""
+        layers = adapters.get("layers", adapters)
+        served = {"wq", "wk", "wv", "wo"}
+        extra = set(lora_cfg.targets) - served
+        if extra:
+            # training (lora_loss/merge_lora) adapts ANY layer leaf, but the
+            # serving path applies lora_proj only at the attention
+            # projections — banking other targets would silently drop them
+            raise ValueError(
+                f"activation-path serving supports targets {sorted(served)}; "
+                f"got {sorted(extra)} — serve those via merge_lora instead")
+        pairs = {}
+        for t in lora_cfg.targets:
+            try:
+                pairs[t] = (jnp.asarray(layers[f"{t}__a"]),
+                            jnp.asarray(layers[f"{t}__b"]))
+            except KeyError:
+                raise KeyError(
+                    f"adapter missing factors for target {t!r} "
+                    f"(have {sorted(layers)})") from None
+        with self._lock:
+            # config check under the lock: two racing first registrations
+            # must not both pass the None check and stack mismatched
+            # factors (the loser would serve with the winner's scale)
+            if self._lora_cfg is not None and (
+                    lora_cfg.rank != self._lora_cfg.rank
+                    or tuple(lora_cfg.targets) != tuple(self._lora_cfg.targets)
+                    or lora_cfg.scale != self._lora_cfg.scale):
+                raise ValueError(
+                    f"adapter config {lora_cfg} does not match the engine's "
+                    f"existing bank config {self._lora_cfg} (one bank per "
+                    "engine: rank/targets/scale must agree)")
+            self._lora_cfg = self._lora_cfg or lora_cfg
+            if self._banks is None:
+                self._banks = {
+                    t: (jnp.stack([jnp.zeros_like(a), a], axis=1),
+                        jnp.stack([jnp.zeros_like(b), b], axis=1))
+                    for t, (a, b) in pairs.items()}
+                idx = 1
+            elif self._free_bank:
+                idx = self._free_bank.pop()
+                self._banks = {
+                    t: (A.at[:, idx].set(pairs[t][0]),
+                        B.at[:, idx].set(pairs[t][1]))
+                    for t, (A, B) in self._banks.items()}
+            else:
+                idx = next(iter(self._banks.values()))[0].shape[1]
+                self._banks = {
+                    t: (jnp.concatenate([A, pairs[t][0][:, None]], axis=1),
+                        jnp.concatenate([B, pairs[t][1][:, None]], axis=1))
+                    for t, (A, B) in self._banks.items()}
+            aid = next(self._adapter_ids)
+            self._adapter_slots[aid] = idx
+        return aid
+
+    def unregister_adapter(self, adapter_id: int) -> bool:
+        """Free an adapter's bank slot (reused by the next registration —
+        no recompile). The slot's factors are zeroed and any request still
+        DECODING on it is repointed at bank index 0, so it falls back to
+        the base model mid-stream — never onto whatever tenant reuses the
+        slot next. Queued requests against the id fail at admission through
+        their handle."""
+        with self._lock:
+            idx = self._adapter_slots.pop(adapter_id, None)
+            if idx is None:
+                return False
+            self._banks = {t: (A.at[:, idx].set(0.0), B.at[:, idx].set(0.0))
+                           for t, (A, B) in self._banks.items()}
+            self._aidx[self._aidx == idx] = 0
+            self._free_bank.append(idx)
+        return True
+
+    def _resolve_adapter(self, adapter_id: Optional[int]):
+        """(per-layer-stacked adapter dict for prefill, bank index) — under
+        the lock so a concurrent unregister can't hand back a half-freed
+        slot."""
+        if adapter_id is None:
+            return None, 0
+        with self._lock:
+            if adapter_id not in self._adapter_slots:
+                raise KeyError(f"unknown adapter_id {adapter_id}")
+            idx = self._adapter_slots[adapter_id]
+            banks = self._banks
+        return {t: (A[:, idx], B[:, idx])
+                for t, (A, B) in banks.items()}, idx
+
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                temperature: Optional[float] = None,
-               prefix_id: Optional[int] = None) -> RequestHandle:
+               prefix_id: Optional[int] = None,
+               adapter_id: Optional[int] = None) -> RequestHandle:
         """Queue one request. ``temperature`` overrides the engine default
         for THIS request only (0 = greedy) — per-slot temperatures share the
         same compiled step. ``prefix_id`` (from :meth:`register_prefix`)
         reuses a cached shared prefix's K/V: only the suffix is prefilled,
-        and generation continues as if prefix+prompt had been submitted."""
+        and generation continues as if prefix+prompt had been submitted.
+        ``adapter_id`` (from :meth:`register_adapter`) runs THIS request
+        through its LoRA adapter — prefill and every decode step — while
+        neighboring slots run theirs (or the base model)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -462,18 +577,25 @@ class GenerationEngine:
                 f"prefix bucket ({prefix_bucket}) + prompt ({len(prompt)}) "
                 f"+ max_new_tokens ({max_new_tokens}) exceeds the engine's "
                 f"max_len ({self.max_len})")
+        if adapter_id is not None and adapter_id not in self._adapter_slots:
+            raise KeyError(f"unknown adapter_id {adapter_id}")
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
-                       temperature=temperature, prefix_id=prefix_id)
+                       temperature=temperature, prefix_id=prefix_id,
+                       adapter_id=adapter_id)
         with self._lock:
             self._pending.append(req)
         self._work.set()
         return RequestHandle(req)
 
-    def register_prefix(self, tokens: Sequence[int]) -> int:
+    def register_prefix(self, tokens: Sequence[int],
+                        adapter_id: Optional[int] = None) -> int:
         """Prefill a shared prefix (system prompt, few-shot header) ONCE and
         cache its K/V; subsequent :meth:`submit` calls with the returned id
         skip recomputing it. Exact for dense models; for MoE, expert
-        capacity is per segment (see ``_prefill_suffix``)."""
+        capacity is per segment (see ``_prefill_suffix``). ``adapter_id``
+        computes the prefix K/V through that adapter — pair it with
+        requests running the SAME adapter, or the cached rows won't match
+        what a solo run would have produced."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prefix")
@@ -481,12 +603,15 @@ class GenerationEngine:
             raise ValueError(f"prefix ({len(tokens)}) must leave room under "
                              f"max_len ({self.max_len})")
         t = len(tokens)
+        adapter, _ = self._resolve_adapter(adapter_id)
+        lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
+               if adapter is not None else {})
         bucket = next(b for b in self._buckets if b >= t)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :t] = tokens
         _, k_new, v_new = _prefill(
             self.params, jnp.asarray(padded), jnp.int32(t), self._next_key(),
-            jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k)
+            jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k, **lkw)
         # Keep BUCKETED K/V: _prefill_suffix takes the true length as a
         # traced scalar, so one compile covers every prefix sharing the
         # bucket (padding rows are overwritten by the suffix / masked).
@@ -546,6 +671,9 @@ class GenerationEngine:
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
         temps = jnp.full((1,), temp, jnp.float32)
+        adapter, aidx = self._resolve_adapter(req.adapter_id)
+        lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
+               if adapter is not None else {})
         if req.prefix_id is not None:
             pk, pv, p_real = self._prefixes[req.prefix_id]
             p_bucket = pk.shape[2]
@@ -561,7 +689,7 @@ class GenerationEngine:
             first, k_new, v_new = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
                 jnp.int32(p_real), self._next_key(), temps, self.cfg,
-                top_k=self.top_k)
+                top_k=self.top_k, **lkw)
             start = p_real + t
         else:
             bucket = next(b for b in self._buckets if b >= t)
@@ -569,7 +697,7 @@ class GenerationEngine:
             padded[0, :t] = req.prompt
             first, k_new, v_new = _prefill(
                 self.params, jnp.asarray(padded), jnp.int32(t),
-                self._next_key(), temps, self.cfg, top_k=self.top_k)
+                self._next_key(), temps, self.cfg, top_k=self.top_k, **lkw)
             start = t
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
@@ -578,6 +706,7 @@ class GenerationEngine:
         self._pos[slot] = start
         self._tok[slot] = first_tok
         self._temps[slot] = temp
+        self._aidx[slot] = aidx
         self._admitted += 1
         self._emit(slot, first_tok)
 
@@ -598,6 +727,7 @@ class GenerationEngine:
             self._pos[slot] = 0
             self._tok[slot] = 0
             self._temps[slot] = 0.0
+            self._aidx[slot] = 0
             self._finished += 1
 
     def step(self) -> int:
@@ -608,10 +738,17 @@ class GenerationEngine:
         self._admit()
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         if active:
+            with self._lock:
+                banks = self._banks
+            # once a bank exists every step pays the per-slot gather, base
+            # traffic included (aidx 0 = the zero adapter) — the price of
+            # one shared compiled step
+            lkw = ({"banks": banks, "aidx": jnp.asarray(self._aidx),
+                    "lora_scale": self._lora_cfg.scale} if banks else {})
             self._cache, nxt = _decode_step(
                 self.params, self._cache, jnp.asarray(self._pos),
                 jnp.asarray(self._tok), self._next_key(),
-                jnp.asarray(self._temps), self.cfg, top_k=self.top_k)
+                jnp.asarray(self._temps), self.cfg, top_k=self.top_k, **lkw)
             nxt = np.asarray(nxt)
             self._steps += 1
             for slot in active:
@@ -674,9 +811,11 @@ class GenerationEngine:
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
                  timeout: Optional[float] = 300.0, *,
                  temperature: Optional[float] = None,
-                 prefix_id: Optional[int] = None) -> List[int]:
+                 prefix_id: Optional[int] = None,
+                 adapter_id: Optional[int] = None) -> List[int]:
         # timeout keeps its historical positional slot; the newer knobs are
         # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
         return self.submit(prompt, max_new_tokens, temperature=temperature,
-                           prefix_id=prefix_id).result(timeout=timeout)
+                           prefix_id=prefix_id,
+                           adapter_id=adapter_id).result(timeout=timeout)

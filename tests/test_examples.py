@@ -77,7 +77,8 @@ def test_continuous_batching_service_example(capsys):
 
 @pytest.mark.slow
 def test_lora_finetune_example(capsys):
-    """Fine-tune → merge → int8 → serve on one remote service."""
+    """Fine-tune → merge → int8 → serve, then two adapters sharing one
+    multi-LoRA engine, on one remote service."""
     from kubetorch_tpu.client import shutdown_local_controller
     from kubetorch_tpu.config import reset_config
 
@@ -87,8 +88,10 @@ def test_lora_finetune_example(capsys):
     try:
         lora_finetune.main()
         out = capsys.readouterr().out
-        assert "finetune: loss" in out
+        assert "finetune #1: loss" in out
         assert "serving merged+int8 model: 8 tokens" in out
+        assert "deploy multi-lora:" in out and "'adapters'" in out
+        assert "adapter1=" in out and "adapter2=" in out
     finally:
         shutdown_local_controller()
         reset_config()

@@ -383,3 +383,208 @@ def test_prefix_in_oversized_bucket_config(dense):
     while eng.step():
         pass
     assert h.result(timeout=0) == want
+
+
+# ---------------------------------------------------------------------------
+# multi-LoRA serving
+# ---------------------------------------------------------------------------
+
+
+def _rand_adapters(seed, params, lcfg, scale=0.05):
+    """Non-trivial adapters: lora_init's B factors are zeros (identity), so
+    randomize them — each seed is a distinct adapter."""
+    from kubetorch_tpu.models.lora import lora_init
+    adap = lora_init(jax.random.PRNGKey(seed), params, lcfg)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 1000),
+                            len(adap["layers"]))
+    adap["layers"] = {
+        k: (v if k.endswith("__a")
+            else jax.random.normal(kk, v.shape, v.dtype) * scale)
+        for kk, (k, v) in zip(keys, sorted(adap["layers"].items()))}
+    return adap
+
+
+class TestMultiLora:
+    """Unmerged activation-path adapters: different slots run different
+    adapters through ONE compiled decode step. The contract mirrors
+    TestEquivalence — a slot's tokens must be bit-identical to the same
+    request run alone on an identically-configured engine."""
+
+    @pytest.fixture(scope="class")
+    def bank(self, dense):
+        from kubetorch_tpu.models.lora import LoraConfig
+        params, cfg = dense
+        lcfg = LoraConfig(rank=4)
+        return lcfg, _rand_adapters(7, params, lcfg), _rand_adapters(8, params, lcfg)
+
+    def _engine(self, dense, bank):
+        params, cfg = dense
+        lcfg, ad_a, ad_b = bank
+        eng = GenerationEngine(params, cfg, slots=4, max_len=64,
+                               prefill_buckets=(8,))
+        ida = eng.register_adapter(ad_a, lcfg)
+        idb = eng.register_adapter(ad_b, lcfg)
+        return eng, ida, idb
+
+    def test_slot_isolation(self, dense, bank):
+        """Adapter-A request beside an adapter-B neighbor == the same
+        A request alone on a fresh engine with identical banks."""
+        pa, na = [5, 17, 42], 6
+        pb, nb = [9, 9, 2, 30], 8
+        solo = {}
+        for which in ("a", "b"):
+            eng, ida, idb = self._engine(dense, bank)
+            h = (eng.submit(pa, max_new_tokens=na, adapter_id=ida)
+                 if which == "a"
+                 else eng.submit(pb, max_new_tokens=nb, adapter_id=idb))
+            while eng.step():
+                pass
+            solo[which] = h.result(timeout=0)
+        eng, ida, idb = self._engine(dense, bank)
+        ha = eng.submit(pa, max_new_tokens=na, adapter_id=ida)
+        hb = eng.submit(pb, max_new_tokens=nb, adapter_id=idb)
+        while eng.step():
+            pass
+        assert ha.result(timeout=0) == solo["a"]
+        assert hb.result(timeout=0) == solo["b"]
+        # the adapters genuinely differ (A's tokens aren't B's on a shared
+        # prompt would be a weaker check; assert the deltas did something)
+        base = GenerationEngine(dense[0], dense[1], slots=4, max_len=64,
+                                prefill_buckets=(8,))
+        hbase = base.submit(pa, max_new_tokens=na)
+        while base.step():
+            pass
+        assert hbase.result(timeout=0) != solo["a"]
+
+    def test_adapter_beside_base_traffic(self, dense, bank):
+        """A no-adapter request on an engine WITH banks (bank index 0 = the
+        zero adapter) is bit-identical to the plain engine: the gathered
+        zero factors contribute exactly 0.0."""
+        params, cfg = dense
+        prompt, n = [7, 8, 9], 6
+        want = _reference_tokens(params, cfg, prompt, n)
+        eng, ida, _ = self._engine(dense, bank)
+        h_base = eng.submit(prompt, max_new_tokens=n)
+        h_lora = eng.submit([4, 4], max_new_tokens=5, adapter_id=ida)
+        while eng.step():
+            pass
+        assert h_base.result(timeout=0) == want
+        assert len(h_lora.result(timeout=0)) == 5
+
+    def test_activation_path_matches_merged(self, dense, bank):
+        """The unmerged x·W + s·(x·A)·B path must agree with serving
+        merge_lora(base, A) weights — the oracle the adapters train
+        against."""
+        from kubetorch_tpu.models.lora import merge_lora
+        params, cfg = dense
+        lcfg, ad_a, _ = bank
+        prompt, n = [5, 17, 42, 99], 8
+        merged = merge_lora(params, ad_a, lcfg)
+        want = _reference_tokens(merged, cfg, prompt, n)
+        eng, ida, _ = self._engine(dense, bank)
+        h = eng.submit(prompt, max_new_tokens=n, adapter_id=ida)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_prefix_with_adapter(self, dense, bank):
+        """A prefix computed through adapter A + suffix/decode through A ==
+        the full prompt through A."""
+        params, cfg = dense
+        lcfg, ad_a, _ = bank
+        prefix, suffix, n = [11, 12, 13, 14], [60, 61], 5
+        eng, ida, _ = self._engine(dense, bank)
+        h_full = eng.submit(prefix + suffix, max_new_tokens=n, adapter_id=ida)
+        while eng.step():
+            pass
+        want = h_full.result(timeout=0)
+        eng2, ida2, _ = self._engine(dense, bank)
+        pid = eng2.register_prefix(prefix, adapter_id=ida2)
+        h = eng2.submit(suffix, max_new_tokens=n, prefix_id=pid,
+                        adapter_id=ida2)
+        while eng2.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_unregister_reuses_slot_and_fails_queued(self, dense, bank):
+        params, cfg = dense
+        lcfg, ad_a, ad_b = bank
+        eng, ida, idb = self._engine(dense, bank)
+        n_bank = eng._banks["wq"][0].shape[1]
+        assert eng.unregister_adapter(idb) is True
+        assert eng.unregister_adapter(idb) is False
+        # freed slot is reused: no bank growth
+        idc = eng.register_adapter(ad_b, lcfg)
+        assert eng._banks["wq"][0].shape[1] == n_bank
+        # a submit against the evicted id fails fast...
+        with pytest.raises(KeyError):
+            eng.submit([1, 2], max_new_tokens=2, adapter_id=idb)
+        # ...and one already queued fails cleanly through its handle
+        h = eng.submit([1, 2], max_new_tokens=2, adapter_id=idc)
+        eng.unregister_adapter(idc)
+        while eng.step():
+            pass
+        with pytest.raises(KeyError):
+            h.result(timeout=0)
+        # the loop survived
+        h2 = eng.submit([3], max_new_tokens=2, adapter_id=ida)
+        while eng.step():
+            pass
+        assert len(h2.result(timeout=0)) == 2
+
+    def test_config_mismatch_rejected(self, dense, bank):
+        from kubetorch_tpu.models.lora import LoraConfig
+        params, cfg = dense
+        lcfg, ad_a, _ = bank
+        eng, _, _ = self._engine(dense, bank)
+        bad = _rand_adapters(9, params, LoraConfig(rank=2))
+        with pytest.raises(ValueError, match="rank|config"):
+            eng.register_adapter(bad, LoraConfig(rank=2))
+
+    def test_late_registration_grows_bank(self, dense, bank):
+        """Registering after traffic ran (bank growth → one recompile)
+        still serves both old and new adapters correctly."""
+        params, cfg = dense
+        lcfg, ad_a, ad_b = bank
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        ida = eng.register_adapter(ad_a, lcfg)
+        h = eng.submit([5, 17, 42], max_new_tokens=4, adapter_id=ida)
+        while eng.step():
+            pass
+        first = h.result(timeout=0)
+        idb = eng.register_adapter(ad_b, lcfg)      # grows the bank
+        h2 = eng.submit([5, 17, 42], max_new_tokens=4, adapter_id=ida)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == first        # A unchanged by growth
+
+    def test_non_attention_targets_rejected(self, dense, bank):
+        """Training/merging adapt any leaf; the activation path serves only
+        the attention projections — banking w_gate would silently drop it."""
+        from kubetorch_tpu.models.lora import LoraConfig
+        params, cfg = dense
+        lcfg = LoraConfig(rank=4, targets=("wq", "w_gate"))
+        bad = _rand_adapters(11, params, lcfg)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="merge_lora"):
+            eng.register_adapter(bad, lcfg)
+
+    def test_unregister_repoints_inflight_to_base(self, dense, bank):
+        """Evicting an adapter mid-decode must repoint its slots at bank
+        index 0 (base model) — slot reuse by a new tenant must never leak
+        into the old request's remaining tokens."""
+        params, cfg = dense
+        lcfg, ad_a, ad_b = bank
+        eng, ida, idb = self._engine(dense, bank)
+        h = eng.submit([5, 17, 42], max_new_tokens=6, adapter_id=ida)
+        eng.step()                                   # admit + first decode
+        slot = next(i for i, r in enumerate(eng._slot_req) if r is not None)
+        assert eng._aidx[slot] == eng._adapter_slots[ida]
+        eng.unregister_adapter(ida)
+        assert eng._aidx[slot] == 0                  # base fallback
+        idc = eng.register_adapter(ad_b, lcfg)       # reuses the freed index
+        while eng.step():
+            pass
+        assert len(h.result(timeout=0)) == 6         # drained, no crash

@@ -92,7 +92,7 @@ def _flash_prefill_wanted(cfg, t: int) -> bool:
 
 def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
                 flash_prefill: bool = False, token_mask=None,
-                keep_capacity=None, lora=None):
+                keep_capacity=None, lora=None, moe_no_drop: bool = False):
     """One transformer layer over T new tokens, updating this layer's cache.
     ``lw`` may carry int8-quantized leaves (``models.quant``) — dequantized
     here, inside the scan body, so only the current layer materializes in
@@ -129,12 +129,14 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
     x = x + lora_proj(attn.reshape(b, t, -1), lw["wo"], lora, "wo")
     h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
     return (x + ffn_block(cfg, h, lw, token_mask=token_mask,
-                          keep_capacity=keep_capacity),
+                          keep_capacity=keep_capacity,
+                          moe_no_drop=moe_no_drop),
             layer_cache_k, layer_cache_v)
 
 
 def ffn_block(cfg, h: jax.Array, lw: Dict[str, jax.Array],
-              token_mask=None, keep_capacity=None) -> jax.Array:
+              token_mask=None, keep_capacity=None,
+              moe_no_drop: bool = False) -> jax.Array:
     """Post-norm FFN for a decode/prefill layer — dense SwiGLU, or the MoE
     dispatch when the layer carries a ``router`` leaf. Shared by the scanned
     ``generate`` path and the continuous-batching engine (``serve.engine``)
@@ -163,7 +165,7 @@ def ffn_block(cfg, h: jax.Array, lw: Dict[str, jax.Array],
                 and 2 * b * cfg.experts_per_token <= cfg.n_experts):
             return moe_ffn_decode(cfg, h, lw)
         ffn, _ = moe_ffn(cfg, h, lw, token_mask=token_mask,
-                         keep_capacity=keep_capacity)
+                         keep_capacity=keep_capacity, no_drop=moe_no_drop)
         return ffn
     return (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
 

@@ -132,7 +132,7 @@ def _route(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
 
 def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
             ep_axis=None, tp_axis=None, token_mask=None,
-            keep_capacity=None):
+            keep_capacity=None, no_drop: bool = False):
     """Top-k MoE with capacity-bounded one-hot dispatch.
 
     x: (B, S, D) → (B, S, D), plus scalar aux loss for load balancing.
@@ -156,10 +156,20 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     to the unpadded one — the property bucketed serving prefill
     (``serve.engine``) depends on. Without them every position is real and
     the threshold is the buffer size (training, where shapes are exact).
+
+    ``no_drop`` (static) sizes the buffer to ``s`` slots per expert — the
+    worst case, every token on one expert — so NO token can ever overflow:
+    each routes exactly as it would alone (T=1 can't drop). That is what
+    makes a multi-token verify window bit-match a sequence of single-step
+    decodes (``serve.speculative``). Quadratic in ``s``, so only for small
+    windows — never training. Overrides ``keep_capacity``.
     """
     b, s, d = x.shape
     E, K = cfg.n_experts, cfg.experts_per_token
-    capacity = max(1, int(cfg.capacity_factor * s * K / E))
+    if no_drop:
+        capacity, keep_capacity = s, None
+    else:
+        capacity = max(1, int(cfg.capacity_factor * s * K / E))
 
     probs, gate_vals, gate_idx = _route(cfg, x, lw)
 

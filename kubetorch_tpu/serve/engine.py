@@ -706,7 +706,15 @@ class GenerationEngine:
         self._pos[slot] = start
         self._tok[slot] = first_tok
         self._temps[slot] = temp
-        self._aidx[slot] = aidx
+        with self._lock:
+            # prefill ran outside the lock: if the adapter was evicted in
+            # that window (and its index possibly reused by a new tenant),
+            # pointing at the stale index would decode through the WRONG
+            # factors — re-check the mapping and fall back to base
+            if (req.adapter_id is not None
+                    and self._adapter_slots.get(req.adapter_id) != aidx):
+                aidx = 0
+            self._aidx[slot] = aidx
         self._admitted += 1
         self._emit(slot, first_tok)
 

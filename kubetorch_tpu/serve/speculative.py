@@ -17,10 +17,13 @@ garbage by design: every forward writes its rows BEFORE attending, and the
 causal mask never admits a row at a position not yet written — the same
 invariant the slot-grid engine relies on.
 
-Dense decoders only: an MoE verify block routes up to 2k+1 tokens through
-one expert-capacity buffer, while the oracle decodes T=1 (which can never
-overflow) — the outputs would diverge, so MoE configs are refused up front
-(the slot-grid engine serves MoE exactly).
+MoE decoders keep the same bit-exactness: the oracle decodes T=1, where a
+token's K chosen experts can never overflow a capacity slot — so draft and
+verify windows route with ``no_drop`` expert buffers (capacity = window
+width, ``models.moe.moe_ffn``), making every window token route exactly as
+it would alone. The prompt prefill instead mirrors the oracle's own
+prefill: real-length capacity threshold over the padded bucket (the same
+``keep_capacity`` contract bucketed engine prefill uses).
 
 Reference analog: none (serving optimization is user code there) — part of
 the beyond-parity serving stack, docs/serving.md.
@@ -42,9 +45,10 @@ from ..models.llama import rmsnorm
 from ..models.quant import head_weight
 
 
-@partial(jax.jit, static_argnames=("cfg", "logits"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "logits", "no_drop"),
+         donate_argnums=(1,))
 def _ingest(params, cache: KVCache, block, start, true_len, cfg,
-            logits: str = "all"):
+            logits: str = "all", keep_capacity=None, no_drop: bool = False):
     """Run ``block`` (1, W) of tokens at absolute positions ``start + i``
     through the model, writing their K/V rows (cache donated — the caller
     never reuses the old one). ``logits`` picks what the head computes:
@@ -54,7 +58,13 @@ def _ingest(params, cache: KVCache, block, start, true_len, cfg,
     "none" → None (the draft's prompt ingest only needs the cache).
     Positions at and past ``true_len`` are padding — their logits are
     garbage the caller must ignore, and their rows are either overwritten
-    by a later round before they can be attended, or masked off."""
+    by a later round before they can be attended, or masked off.
+
+    MoE routing semantics per window kind: mid-stream windows pass
+    ``no_drop=True`` (each token routes as if decoded alone — the T=1
+    oracle); the prompt window passes the real length's overflow
+    threshold as ``keep_capacity`` (the oracle's own prefill pressure).
+    Both are no-ops for dense configs."""
     b, w = block.shape
     x = params["embed"][block].astype(cfg.dtype)
     freqs_full = rope_freqs(cfg, cache.k.shape[2])
@@ -64,7 +74,9 @@ def _ingest(params, cache: KVCache, block, start, true_len, cfg,
     def body(carry, layer):
         lw, ck, cv = layer
         h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
-                                token_mask=token_mask)
+                                token_mask=token_mask,
+                                keep_capacity=keep_capacity,
+                                moe_no_drop=no_drop)
         return h, (ck, cv)
 
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
@@ -87,13 +99,15 @@ def _draft_propose(params, cache: KVCache, block, start, true_len, cfg,
     cache'). The proposal steps write rows ``start+true_len …
     start+true_len+k-2`` (the k-th proposal is never ingested — the next
     round's block carries whatever survives verification)."""
-    logits, cache = _ingest(params, cache, block, start, true_len, cfg)
+    logits, cache = _ingest(params, cache, block, start, true_len, cfg,
+                            no_drop=True)
     first = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
 
     def step(carry, i):
         cache, tok = carry
         lg, cache = _ingest(params, cache, tok[None, None],
-                            start + true_len + i, jnp.int32(1), cfg)
+                            start + true_len + i, jnp.int32(1), cfg,
+                            no_drop=True)
         nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
         return (cache, nxt), nxt
 
@@ -134,17 +148,6 @@ def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
     prompt = [int(t) for t in prompt]
     if not prompt:
         raise ValueError("empty prompt")
-    for name, c in (("target", target_cfg), ("draft", draft_cfg)):
-        if hasattr(c, "n_experts"):
-            # MoE verify blocks route 2k+1 tokens through one capacity
-            # buffer while the oracle decodes T=1 (which can never
-            # overflow) — the outputs would silently diverge from the
-            # bit-exactness this function promises. Refuse rather than
-            # mis-serve; the slot-grid engine serves MoE exactly.
-            raise ValueError(
-                f"speculative decoding supports dense decoders only; the "
-                f"{name} config is MoE (n_experts={c.n_experts}) — use "
-                "serve.GenerationEngine for MoE serving")
     p = len(prompt)
     p_bucket = next((b for b in sorted(prompt_buckets) if b >= p), p)
     # The cache must hold the FULL padded windows past the last valid row:
@@ -165,15 +168,18 @@ def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
 
     # bucketed prompt prefill on both models; the draft skips the lm_head
     # entirely and the target computes logits at the last position only
+    from .engine import _moe_keep_capacity
     block = np.zeros((1, p_bucket), np.int32)
     block[0, :p] = prompt
     block = jnp.asarray(block)
     t_last, t_cache = _ingest(target_params, t_cache, block,
                               jnp.int32(0), jnp.int32(p), target_cfg,
-                              logits="last")
+                              logits="last",
+                              keep_capacity=_moe_keep_capacity(target_cfg, p))
     _, d_cache = _ingest(draft_params, d_cache, block,
                          jnp.int32(0), jnp.int32(p), draft_cfg,
-                         logits="none")
+                         logits="none",
+                         keep_capacity=_moe_keep_capacity(draft_cfg, p))
     first = int(jnp.argmax(t_last[0]))
 
     out: List[int] = [first]
@@ -197,7 +203,7 @@ def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
         tblock[0, c:c + k] = proposals
         t_logits, t_cache = _ingest(
             target_params, t_cache, jnp.asarray(tblock), jnp.int32(n_valid),
-            jnp.int32(c + k), target_cfg)
+            jnp.int32(c + k), target_cfg, no_drop=True)
         greedy = np.asarray(jnp.argmax(t_logits[0], axis=-1))
 
         # greedy[c-1+i] is the target's own choice after pending+proposals

@@ -588,3 +588,34 @@ class TestMultiLora:
         while eng.step():
             pass
         assert len(h.result(timeout=0)) == 6         # drained, no crash
+
+    def test_eviction_during_prefill_falls_back_to_base(self, dense, bank,
+                                                        monkeypatch):
+        """The adapter can be evicted (and its bank index reused by a new
+        tenant) in the window between admission resolving the index and
+        the prefill finishing — the slot must then point at base (0),
+        never at the reusing tenant's factors."""
+        import kubetorch_tpu.serve.engine as eng_mod
+        params, cfg = dense
+        lcfg, ad_a, ad_b = bank
+        eng, ida, idb = self._engine(dense, bank)
+        orig = eng_mod._prefill
+        hit = {}
+
+        def racy_prefill(*a, **kw):
+            out = orig(*a, **kw)
+            if "adapter" in kw and not hit:   # only the adapter prefill
+                hit["idx"] = eng._adapter_slots[ida]
+                eng.unregister_adapter(ida)
+                hit["reused"] = eng.register_adapter(ad_b, lcfg)
+            return out
+
+        monkeypatch.setattr(eng_mod, "_prefill", racy_prefill)
+        h = eng.submit([5, 17, 42], max_new_tokens=4, adapter_id=ida)
+        eng.step()
+        slot = next(i for i, r in enumerate(eng._slot_req) if r is not None)
+        assert hit and eng._adapter_slots[hit["reused"]] == hit["idx"]
+        assert eng._aidx[slot] == 0            # base, not the new tenant
+        while eng.step():
+            pass
+        assert len(h.result(timeout=0)) == 4

@@ -79,13 +79,48 @@ class TestExactness:
                                  k=2, max_len=4)
 
 
-def test_moe_refused_with_clear_error(models):
-    from kubetorch_tpu.models.moe import MoeConfig, moe_init
+class TestMoeExactness:
+    """MoE targets hold the same bit-exactness bar: verify windows route
+    ``no_drop`` (every token as if decoded alone — the T=1 oracle), the
+    prompt prefill mirrors the oracle's real-length capacity pressure."""
 
-    target, cfg, _, _ = models
-    mcfg = MoeConfig.tiny(dtype=jnp.float32, remat=False, attn_impl="xla")
-    mo = moe_init(jax.random.PRNGKey(1), mcfg)
-    with pytest.raises(ValueError, match="dense decoders only"):
-        speculative_generate(mo, mcfg, target, cfg, [1, 2], 4)
-    with pytest.raises(ValueError, match="dense decoders only"):
-        speculative_generate(target, cfg, mo, mcfg, [1, 2], 4)
+    @pytest.fixture(scope="class")
+    def moe(self):
+        from kubetorch_tpu.models.moe import MoeConfig, moe_init
+        mcfg = MoeConfig.tiny(dtype=jnp.float32, remat=False,
+                              attn_impl="xla")
+        return moe_init(jax.random.PRNGKey(1), mcfg), mcfg
+
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_moe_target_dense_draft(self, models, moe, k):
+        _, _, draft, dcfg = models
+        mo, mcfg = moe
+        for prompt, n in [([5, 17, 42, 99], 10), ([7] * 9, 8)]:
+            want = _solo(mo, mcfg, prompt, n)
+            stats = SpecStats()
+            got = speculative_generate(mo, mcfg, draft, dcfg, prompt,
+                                       max_new_tokens=n, k=k, stats=stats)
+            assert got == want, (prompt, n, k)
+            assert stats.rounds >= 1
+
+    def test_moe_self_draft_accepts_everything(self, moe):
+        """MoE drafting for itself: proposals must equal the target's own
+        greedy choices — any draft/verify routing mismatch shows up as a
+        sub-1.0 acceptance rate before it even breaks exactness."""
+        mo, mcfg = moe
+        prompt = [3, 4, 5]
+        want = _solo(mo, mcfg, prompt, 10)
+        stats = SpecStats()
+        got = speculative_generate(mo, mcfg, mo, mcfg, prompt,
+                                   max_new_tokens=10, k=3, stats=stats)
+        assert got == want
+        assert stats.acceptance_rate == 1.0
+
+    def test_moe_draft_dense_target(self, models, moe):
+        target, cfg, _, _ = models
+        mo, mcfg = moe
+        prompt = [9, 8, 7]
+        want = _solo(target, cfg, prompt, 8)
+        got = speculative_generate(target, cfg, mo, mcfg, prompt,
+                                   max_new_tokens=8, k=3)
+        assert got == want

@@ -502,8 +502,23 @@ async def check_ready(request: web.Request) -> web.Response:
         # never open a WS; fall back to live backend IPs (selector-routed)
         backend_ips = state.backend.pod_ips(ns, name) if state.backend else []
         ready = connected >= expected or len(backend_ips) >= expected
-    return web.json_response({"ready": ready, "connected": connected,
-                              "expected": expected})
+    key = _workload_key(ns, name)
+    payload = {"ready": ready, "connected": connected, "expected": expected,
+               # live launch context for waiting clients: the k8s events the
+               # watcher routed here (ImagePullBackOff, FailedScheduling, …)
+               "events": [e["message"] for e in state.events
+                          if e["service"] == key
+                          and e["message"].startswith("[k8s]")][-10:]}
+    if ready:
+        # the launch made it: a fatal mark (e.g. one autoscale-up pod hit
+        # ImagePullBackOff after the service was already serving) must not
+        # fail clients of a ready service
+        record.pop("launch_failure", None)
+    else:
+        failure = record.get("launch_failure")
+        if failure:
+            payload["failure"] = failure
+    return web.json_response(payload)
 
 
 async def cluster_config(request: web.Request) -> web.Response:
@@ -967,6 +982,82 @@ async def _autoscale_loop(state: ControllerState) -> None:
                 state.record_event(key, "autoscale pass failed; will retry")
 
 
+# -- K8s event watcher (reference: chart eventWatcher + live launch events,
+#    http_client.py:576) --------------------------------------------------------
+
+K8S_EVENT_POLL_S = 2.0
+# Warning reasons that can never self-heal → typed launch failure the client
+# raises instead of waiting out its timeout. Scheduling/crash backoffs stay
+# surface-only: autoscalers add nodes and restarts can succeed.
+FATAL_EVENT_REASONS = {
+    "ErrImagePull": "ImagePullError",
+    "ImagePullBackOff": "ImagePullError",
+    "InvalidImageName": "ImagePullError",
+}
+
+
+async def _k8s_events_loop(state: ControllerState) -> None:
+    """Poll backend Pod events per active namespace, route each to its
+    workload's event ring by pod-name prefix, and mark unrecoverable ones
+    on the workload record for check-ready to surface."""
+    if not hasattr(state.backend, "pod_events"):
+        return
+    seen: Dict[str, int] = {}
+    while True:
+        await asyncio.sleep(K8S_EVENT_POLL_S)
+        namespaces = {r["namespace"] for r in state.workloads.values()}
+        for ns in namespaces:
+            try:
+                events = await asyncio.to_thread(state.backend.pod_events, ns)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — transient kubectl failure
+                continue
+            if len(seen) > 5000:   # bounded memory; worst case re-records
+                seen.clear()
+            for ev in events:
+                _ingest_k8s_event(state, ns, ev, seen)
+
+
+def _ingest_k8s_event(state: ControllerState, ns: str, ev: Dict,
+                      seen: Dict[str, int]) -> None:
+    uid, count = ev.get("uid", ""), int(ev.get("count") or 1)
+    if seen.get(uid, 0) >= count:
+        return
+    seen[uid] = count
+    pod = ev.get("pod", "")
+    # LONGEST matching workload name wins: with 'web' and 'web-api' both
+    # live, pod web-api-7c9d belongs to web-api, not web — first-match
+    # would misroute (and worse, fatally mark) the shorter name
+    best = None
+    for key, record in list(state.workloads.items()):
+        if record.get("namespace") != ns:
+            continue
+        name = record.get("name", "")
+        if pod == name or pod.startswith(name + "-"):
+            if best is None or len(name) > len(best[1].get("name", "")):
+                best = (key, record)
+    if best is None:
+        return
+    key, record = best
+    # K8s retains events ~1h and `seen` is process-local: an event stamped
+    # BEFORE this record's deploy is history from a previous launch (the
+    # controller restarted, or the cache was swept) — never re-surface it
+    ts = float(ev.get("ts") or 0.0)
+    if ts and ts < float(record.get("updated_at") or 0.0):
+        return
+    state.record_event(key, f"[k8s] {ev.get('type', 'Normal')} "
+                            f"{ev.get('reason', '')}: pod {pod}: "
+                            f"{ev.get('message', '')}")
+    etype = FATAL_EVENT_REASONS.get(ev.get("reason", ""))
+    if etype and ev.get("type") == "Warning":
+        record["launch_failure"] = {
+            "error_type": etype,
+            "message": (f"{ev.get('reason')}: {ev.get('message', '')} "
+                        f"(pod {pod})"),
+        }
+
+
 # -- TTL reaper (reference: controller TTL task, SURVEY §2.7) -----------------
 
 
@@ -1058,6 +1149,7 @@ async def _startup(app: web.Application) -> None:
     state.restore()
     state._ttl_task = asyncio.create_task(_ttl_loop(state))
     state._autoscale_task = asyncio.create_task(_autoscale_loop(state))
+    state._k8s_events_task = asyncio.create_task(_k8s_events_loop(state))
 
 
 async def _cleanup(app: web.Application) -> None:
@@ -1069,6 +1161,8 @@ async def _cleanup(app: web.Application) -> None:
         state._ttl_task.cancel()
     if getattr(state, "_autoscale_task", None):
         state._autoscale_task.cancel()
+    if getattr(state, "_k8s_events_task", None):
+        state._k8s_events_task.cancel()
     if state.backend is not None:
         await asyncio.to_thread(state.backend.shutdown)
     if state.persister is not None:

@@ -395,6 +395,23 @@ class LocalBackend:
             kill_process_tree(store_proc.pid)
 
 
+def _event_epoch(item: Dict) -> float:
+    """Event time as epoch seconds; 0.0 when the item carries none (then
+    the watcher treats it as fresh). K8s events stamp ``lastTimestamp``
+    (or ``eventTime`` for the events.k8s.io shape) in RFC3339 Z form."""
+    from datetime import datetime, timezone
+    raw = (item.get("lastTimestamp") or item.get("eventTime")
+           or item.get("firstTimestamp"))
+    if not raw:
+        return 0.0
+    try:
+        return datetime.fromisoformat(
+            str(raw).replace("Z", "+00:00")).astimezone(
+                timezone.utc).timestamp()
+    except ValueError:
+        return 0.0
+
+
 class KubernetesBackend:
     """kubectl-applied manifests. Requires cluster credentials (or a kubectl
     shim — the test suite drives this path end-to-end with a recording fake,
@@ -550,6 +567,38 @@ class KubernetesBackend:
                         f"kubetorch.com/service={name}", "-o",
                         "jsonpath={.items[*].status.podIP}")
         return [ip for ip in out.split() if ip]
+
+    def pod_events(self, namespace: str) -> List[Dict]:
+        """Recent Pod events in the namespace, normalized to
+        ``{uid, count, pod, type, reason, message}``.
+
+        Reference analog: the controller-side event watcher
+        (``charts/kubetorch/values.yaml`` eventWatcher) feeding the live
+        event stream ``.to()`` shows while waiting
+        (``python_client/kubetorch/serving/http_client.py:576``). The
+        controller's ``_k8s_events_loop`` polls this and routes events to
+        workloads by pod-name prefix."""
+        try:
+            out = self._run("get", "events", "-n", namespace, "-o", "json")
+            items = json.loads(out).get("items", [])
+        except (RuntimeError, ValueError):
+            return []
+        events: List[Dict] = []
+        for it in items:
+            obj = it.get("involvedObject", {})
+            if obj.get("kind") != "Pod":
+                continue
+            events.append({
+                "uid": (it.get("metadata", {}).get("uid")
+                        or f"{obj.get('name')}/{it.get('reason')}"),
+                "count": int(it.get("count") or 1),
+                "pod": obj.get("name", ""),
+                "type": it.get("type", "Normal"),
+                "reason": it.get("reason", ""),
+                "message": (it.get("message") or "").strip(),
+                "ts": _event_epoch(it),
+            })
+        return events
 
     # -- config objects -------------------------------------------------------
 

@@ -239,6 +239,21 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
     return out, aux
 
 
+def moe_prefill_keep_capacity(cfg, true_len):
+    """Overflow-drop threshold for a prefill of ``true_len`` REAL tokens
+    riding a longer padded bucket (None for dense configs): the value
+    ``moe_ffn``'s native ``capacity`` would take at the unpadded length, so
+    bucketed serving prefill (``serve.engine``) and speculative prompt
+    ingest (``serve.speculative``) route bit-identically to a solo unpadded
+    run. Pass as ``keep_capacity``; the static buffer stays bucket-sized."""
+    kc = getattr(cfg, "capacity_factor", None)
+    if kc is None:
+        return None
+    return jnp.maximum(1, jnp.floor(
+        kc * true_len * cfg.experts_per_token / cfg.n_experts
+    ).astype(jnp.int32))
+
+
 def moe_ffn_decode(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
     """Decode-specialized top-k MoE: gather the K chosen experts' weights per
     token and run only those FFNs.

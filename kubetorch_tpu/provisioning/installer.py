@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 # observability last (it scrapes whatever exists)
 DEPLOY_ORDER = [
     "kubetorchworkload-crd.yaml",
+    "knative-serving.yaml",   # CRDs + control plane autoscaled services need
     "controller.yaml",
     "data-store.yaml",
     "kueue-resources.yaml",

@@ -342,19 +342,40 @@ class Compute:
                              timeout=self.launch_timeout)
 
     def _check_service_ready(self, name: str, timeout: Optional[float] = None) -> None:
+        """Wait for the controller to report readiness, streaming the K8s
+        events it watched (ImagePullBackOff, FailedScheduling, …) as they
+        happen and failing FAST — typed, with the event text — when the
+        watcher marked the launch unrecoverable (reference live event
+        stream during ``.to()`` waits, ``http_client.py:576``)."""
+        import logging
         import time as _time
 
+        log = logging.getLogger("kubetorch")
         client = controller_client()
         deadline = _time.monotonic() + (timeout or self.launch_timeout)
         delay = 0.25
+        seen_events: Dict[str, None] = {}     # insertion-ordered
         while _time.monotonic() < deadline:
             status = client.check_ready(self.namespace, name)
+            for msg in status.get("events") or []:
+                if msg not in seen_events:
+                    seen_events[msg] = None
+                    log.info("%s: %s", name, msg)
             if status.get("ready"):
                 return
+            failure = status.get("failure")
+            if failure:
+                from .. import exceptions as _exc
+                cls = getattr(_exc, failure.get("error_type", ""),
+                              _exc.StartupError)
+                raise cls(f"launch of {name!r} failed: "
+                          f"{failure.get('message', '')}")
             _time.sleep(delay)
             delay = min(delay * 2, 5.0)
+        tail = "".join(f"\n  {m}" for m in list(seen_events)[-5:])
         raise ServiceTimeoutError(
-            f"Service {name!r} not ready after {timeout or self.launch_timeout}s")
+            f"Service {name!r} not ready after "
+            f"{timeout or self.launch_timeout}s{tail}")
 
     def teardown(self, name: str) -> None:
         controller_client().delete_workload(self.namespace, name)

@@ -56,6 +56,7 @@ from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
                                rope_freqs)
 from ..models.llama import rmsnorm
 from ..models.lora import lora_proj
+from ..models.moe import moe_prefill_keep_capacity as _moe_keep_capacity
 from ..models.quant import dequant_layer, head_weight
 
 NEG_INF = -1e30
@@ -223,15 +224,6 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     return _sample_slots(logits, rng, temps, top_k), nk, nv
 
 
-def _moe_keep_capacity(cfg, true_len):
-    """Overflow-drop threshold for a prefill of ``true_len`` real tokens
-    (None for dense configs) — see ``moe_ffn``'s keep_capacity."""
-    kc = getattr(cfg, "capacity_factor", None)
-    if kc is None:
-        return None
-    return jnp.maximum(1, jnp.floor(
-        kc * true_len * cfg.experts_per_token / cfg.n_experts
-    ).astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))

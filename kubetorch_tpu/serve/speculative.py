@@ -168,18 +168,18 @@ def speculative_generate(target_params, target_cfg, draft_params, draft_cfg,
 
     # bucketed prompt prefill on both models; the draft skips the lm_head
     # entirely and the target computes logits at the last position only
-    from .engine import _moe_keep_capacity
+    from ..models.moe import moe_prefill_keep_capacity
     block = np.zeros((1, p_bucket), np.int32)
     block[0, :p] = prompt
     block = jnp.asarray(block)
-    t_last, t_cache = _ingest(target_params, t_cache, block,
-                              jnp.int32(0), jnp.int32(p), target_cfg,
-                              logits="last",
-                              keep_capacity=_moe_keep_capacity(target_cfg, p))
-    _, d_cache = _ingest(draft_params, d_cache, block,
-                         jnp.int32(0), jnp.int32(p), draft_cfg,
-                         logits="none",
-                         keep_capacity=_moe_keep_capacity(draft_cfg, p))
+    t_last, t_cache = _ingest(
+        target_params, t_cache, block, jnp.int32(0), jnp.int32(p),
+        target_cfg, logits="last",
+        keep_capacity=moe_prefill_keep_capacity(target_cfg, p))
+    _, d_cache = _ingest(
+        draft_params, d_cache, block, jnp.int32(0), jnp.int32(p),
+        draft_cfg, logits="none",
+        keep_capacity=moe_prefill_keep_capacity(draft_cfg, p))
     first = int(jnp.argmax(t_last[0]))
 
     out: List[int] = [first]

@@ -18,7 +18,7 @@ from typing import Dict, Optional
 
 from ..utils.procs import wait_for_port
 from .discovery import my_pod_ip
-from .execution_supervisor import DistributedSupervisor
+from .execution_supervisor import DistributedSupervisor, ExecutionSupervisor
 
 GCS_PORT = 6379
 
@@ -60,7 +60,11 @@ class RaySupervisor(DistributedSupervisor):
                  "--disable-usage-stats", "--block"])
             if not wait_for_port(head_ip, GCS_PORT, timeout=60):
                 raise RuntimeError("Ray GCS failed to start")
-            super().setup()  # one ProcessWorker for user code
+            # ExecutionSupervisor (grandparent) setup ON PURPOSE: one local
+            # ProcessWorker for user code, no quorum wait and no DNS
+            # membership monitor — Ray owns membership (reference :126-129),
+            # and workers join the GCS on their own schedule
+            ExecutionSupervisor.setup(self)
         else:
             self._ray_proc = subprocess.Popen(
                 ["ray", "start", "--address", f"{head_ip}:{GCS_PORT}",

@@ -92,6 +92,20 @@ def main(argv):
         print(f"{kind.lower()}/{name} configured")
         return 0
 
+    if argv[:2] == ["get", "events"]:
+        # events "happen" by a test writing events.json into the shim dir
+        # (kubectl-style items); namespace filter applied like the real CLI
+        _record(d, {"cmd": argv})
+        path = os.path.join(d, "events.json")
+        items = []
+        if os.path.exists(path):
+            with open(path) as f:
+                items = json.load(f)
+        items = [it for it in items
+                 if it.get("metadata", {}).get("namespace", "default") == ns]
+        print(json.dumps({"items": items}))
+        return 0
+
     if argv[:2] == ["get", "storageclass"]:
         _record(d, {"cmd": argv})
         print(json.dumps({"items": [

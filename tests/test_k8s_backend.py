@@ -211,3 +211,50 @@ def test_raycluster_round_trip(shim):
 
     assert be.delete("ns1", "rayjob") is True
     assert "RayCluster/ns1/rayjob" not in _state(shim)
+
+
+def test_install_stack_vendored_knative_then_autoscaled_service(shim,
+                                                                monkeypatch):
+    """`kt install` must make autoscaled workloads schedulable on a bare
+    cluster (reference vendors charts/kubetorch/knative/serving.yaml): the
+    deploy/ bundle carries the Knative Serving CRDs + control plane +
+    networking layer, and the Knative Service manifest the backend emits
+    targets a group/version the freshly-installed CRDs register."""
+    from kubetorch_tpu.provisioning.installer import install_stack
+    from kubetorch_tpu.provisioning.manifests import build_knative_manifest
+
+    applied = install_stack(kubectl=SHIM)
+    knative = [(k, n) for f, k, n in applied if f == "knative-serving.yaml"]
+    kinds = {k for k, _ in knative}
+    names = {n for _, n in knative}
+    # CRDs for everything the serving controllers reconcile
+    for crd in ("services.serving.knative.dev",
+                "configurations.serving.knative.dev",
+                "revisions.serving.knative.dev",
+                "routes.serving.knative.dev",
+                "podautoscalers.autoscaling.internal.knative.dev",
+                "serverlessservices.networking.internal.knative.dev",
+                "ingresses.networking.internal.knative.dev"):
+        assert crd in names, f"missing CRD {crd}"
+    # the four-deployment control plane + kourier
+    assert {"controller", "autoscaler", "activator",
+            "webhook"} <= names
+    assert "net-kourier-controller" in names
+    assert "3scale-kourier-gateway" in names
+    assert "Deployment" in kinds and "CustomResourceDefinition" in kinds
+    # config selects kourier as the ingress implementation
+    state = _state(shim)
+    assert state["ConfigMap/knative-serving/config-network"]["data"][
+        "ingress-class"].startswith("kourier")
+
+    # round-trip: the workload manifest kt emits matches the installed CRD
+    crd = state["CustomResourceDefinition/default/services.serving.knative.dev"]
+    group = crd["spec"]["group"]
+    version = crd["spec"]["versions"][0]["name"]
+    pod = build_pod_template("scaler", "python:3.11", {}, cpus="1")
+    manifest = build_knative_manifest(
+        "scaler", "ns1", pod, {"autoscaling.knative.dev/target": "10"})
+    assert manifest["apiVersion"] == f"{group}/{version}"
+    assert manifest["kind"] == crd["spec"]["names"]["kind"]
+    _backend().apply("ns1", "scaler", manifest, {})
+    assert "Service/ns1/scaler" in _state(shim)

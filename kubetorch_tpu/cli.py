@@ -472,15 +472,17 @@ def events(service, namespace):
 @click.option("--command", "-c", default="/bin/bash")
 def ssh(service, namespace, command):
     """Shell into a service pod (kubectl exec; reference cli.py:1757)."""
-    import shutil
     import subprocess as sp
 
-    if shutil.which("kubectl") is None:
+    from .utils.kubectl import resolve_kubectl
+
+    kubectl = resolve_kubectl()
+    if kubectl is None:
         raise click.ClickException(
             "kubectl not found — ssh requires a Kubernetes cluster "
             "(local-backend pods are host subprocesses; see `kt describe`)")
     ns = namespace or kt_config().namespace
-    out = sp.run(["kubectl", "get", "pods", "-n", ns, "-l",
+    out = sp.run([kubectl, "get", "pods", "-n", ns, "-l",
                   f"kubetorch.com/service={service}", "-o",
                   "jsonpath={.items[0].metadata.name}"],
                  capture_output=True, text=True)
@@ -488,7 +490,7 @@ def ssh(service, namespace, command):
     if not pod:
         raise click.ClickException(f"no pods found for service {service!r}")
     # sh -c so multi-word commands work: kt ssh svc -c "python -V"
-    sp.run(["kubectl", "exec", "-it", "-n", ns, pod, "--", "sh", "-c", command])
+    sp.run([kubectl, "exec", "-it", "-n", ns, pod, "--", "sh", "-c", command])
 
 
 @cli.command("port-forward")

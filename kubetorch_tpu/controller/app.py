@@ -503,12 +503,17 @@ async def check_ready(request: web.Request) -> web.Response:
         backend_ips = state.backend.pod_ips(ns, name) if state.backend else []
         ready = connected >= expected or len(backend_ips) >= expected
     key = _workload_key(ns, name)
+    # live launch context for waiting clients: the k8s events the watcher
+    # routed here (ImagePullBackOff, FailedScheduling, …). Ring-scoped to
+    # THIS launch — the ring survives redeploys (and restarts, persisted),
+    # and replaying a previous launch's pull failures to the new launch's
+    # wait would send the user debugging an already-fixed image.
+    since = float(record.get("updated_at") or 0.0)
     payload = {"ready": ready, "connected": connected, "expected": expected,
-               # live launch context for waiting clients: the k8s events the
-               # watcher routed here (ImagePullBackOff, FailedScheduling, …)
                "events": [e["message"] for e in state.events
                           if e["service"] == key
-                          and e["message"].startswith("[k8s]")][-10:]}
+                          and e["message"].startswith("[k8s]")
+                          and float(e.get("ts") or 0.0) >= since][-10:]}
     if ready:
         # the launch made it: a fatal mark (e.g. one autoscale-up pod hit
         # ImagePullBackOff after the service was already serving) must not
@@ -1024,7 +1029,6 @@ def _ingest_k8s_event(state: ControllerState, ns: str, ev: Dict,
     uid, count = ev.get("uid", ""), int(ev.get("count") or 1)
     if seen.get(uid, 0) >= count:
         return
-    seen[uid] = count
     pod = ev.get("pod", "")
     # LONGEST matching workload name wins: with 'web' and 'web-api' both
     # live, pod web-api-7c9d belongs to web-api, not web — first-match
@@ -1038,14 +1042,20 @@ def _ingest_k8s_event(state: ControllerState, ns: str, ev: Dict,
             if best is None or len(name) > len(best[1].get("name", "")):
                 best = (key, record)
     if best is None:
+        # no record owns this pod YET (poll raced the deploy upsert, or the
+        # workload lives outside kt) — leave it unseen so a later poll can
+        # still route it once the record exists
         return
     key, record = best
     # K8s retains events ~1h and `seen` is process-local: an event stamped
     # BEFORE this record's deploy is history from a previous launch (the
-    # controller restarted, or the cache was swept) — never re-surface it
+    # controller restarted, or the cache was swept) — never re-surface it.
+    # lastTimestamp has whole-second resolution, so allow 1s of skew around
+    # the deploy instant rather than swallowing a deploy-second fatal event.
     ts = float(ev.get("ts") or 0.0)
-    if ts and ts < float(record.get("updated_at") or 0.0):
+    if ts and ts < float(record.get("updated_at") or 0.0) - 1.0:
         return
+    seen[uid] = count
     state.record_event(key, f"[k8s] {ev.get('type', 'Normal')} "
                             f"{ev.get('reason', '')}: pod {pod}: "
                             f"{ev.get('message', '')}")

@@ -438,8 +438,8 @@ class KubernetesBackend:
 
     def __init__(self, kubectl: Optional[str] = None):
         from ..exceptions import KubernetesCredentialsError
-        self.kubectl = (kubectl or os.environ.get("KT_KUBECTL")
-                        or shutil.which("kubectl"))
+        from ..utils.kubectl import resolve_kubectl
+        self.kubectl = resolve_kubectl(kubectl)
         if self.kubectl is None:
             raise KubernetesCredentialsError(
                 "kubectl not found; KubernetesBackend unavailable")
@@ -447,7 +447,8 @@ class KubernetesBackend:
 
     @staticmethod
     def available() -> bool:
-        kubectl = os.environ.get("KT_KUBECTL") or shutil.which("kubectl")
+        from ..utils.kubectl import resolve_kubectl
+        kubectl = resolve_kubectl()
         if kubectl is None:
             return False
         try:
@@ -579,7 +580,11 @@ class KubernetesBackend:
         controller's ``_k8s_events_loop`` polls this and routes events to
         workloads by pod-name prefix."""
         try:
-            out = self._run("get", "events", "-n", namespace, "-o", "json")
+            # server-side kind filter: a busy namespace carries thousands of
+            # non-Pod events the 2s poll would otherwise fetch+parse+discard
+            out = self._run("get", "events", "-n", namespace,
+                            "--field-selector", "involvedObject.kind=Pod",
+                            "-o", "json")
             items = json.loads(out).get("items", [])
         except (RuntimeError, ValueError):
             return []

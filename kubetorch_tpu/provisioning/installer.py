@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import subprocess
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -41,8 +40,8 @@ def deploy_dir() -> str:
 
 
 def _kubectl(kubectl: Optional[str]) -> str:
-    resolved = (kubectl or os.environ.get("KT_KUBECTL")
-                or shutil.which("kubectl"))
+    from ..utils.kubectl import resolve_kubectl
+    resolved = resolve_kubectl(kubectl)
     if resolved is None:
         raise RuntimeError("kubectl not found; cannot install the stack")
     return resolved

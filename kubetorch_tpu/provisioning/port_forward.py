@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import atexit
 import os
-import shutil
 import subprocess
 import threading
 from typing import Dict, Optional
@@ -54,11 +53,13 @@ def ensure_port_forward(service: str = "kubetorch-controller",
         handle = _handles.get(key)
         if handle is not None and handle.alive:
             return handle
-        if shutil.which("kubectl") is None:
+        from ..utils.kubectl import resolve_kubectl
+        kubectl = resolve_kubectl()
+        if kubectl is None:
             raise RuntimeError("kubectl not found; cannot port-forward")
         local = free_port()
         proc = subprocess.Popen(
-            ["kubectl", "port-forward", f"svc/{service}",
+            [kubectl, "port-forward", f"svc/{service}",
              f"{local}:{remote_port}", "-n", namespace],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         if not wait_for_port("127.0.0.1", local, timeout=15):

@@ -1,0 +1,24 @@
+"""One kubectl resolution for every touchpoint.
+
+``KT_KUBECTL`` (or an explicit argument) overrides PATH lookup — how the
+test suite substitutes its recording shim — but the override is VALIDATED:
+a stale env var pointing at a removed binary must surface as the caller's
+clean "kubectl not found" error, not a raw FileNotFoundError from Popen.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+def resolve_kubectl(explicit: Optional[str] = None) -> Optional[str]:
+    """Usable kubectl path, or None. Order: ``explicit`` arg,
+    ``KT_KUBECTL``, PATH. Explicit/env candidates are checked for
+    existence + execute permission (``shutil.which`` handles both bare
+    names and paths)."""
+    cand = explicit or os.environ.get("KT_KUBECTL")
+    if cand:
+        return shutil.which(cand)
+    return shutil.which("kubectl")

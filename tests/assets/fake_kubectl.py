@@ -137,21 +137,46 @@ def main(argv):
         print(json.dumps(manifest))
         return 0
 
+    if argv[:1] == ["exec"]:
+        # kubectl exec — pure recording (nothing is executed); tests assert
+        # on the recorded pod/ns/command
+        _record(d, {"cmd": argv})
+        print("fake-exec-ok")
+        return 0
+
+    if argv[:1] == ["port-forward"]:
+        # kubectl port-forward svc/NAME local:remote — actually listen on
+        # the local port (foreground, like the real CLI) so the manager's
+        # wait_for_port and callers' probes succeed
+        import socket
+        _record(d, {"cmd": argv})
+        local = int(argv[2].split(":")[0])
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", local))
+        srv.listen(8)
+        while True:
+            conn, _ = srv.accept()
+            conn.close()
+
     if argv[:2] == ["get", "pods"]:
         _record(d, {"cmd": argv})
         selector = _flag(argv, "-l", "")
         service = selector.split("=", 1)[1] if "=" in selector else ""
-        ips = []
+        names = []
         for kind in ("Deployment", "JobSet", "RayCluster", "Service"):
             manifest = state.get(f"{kind}/{ns}/{service}")
             if manifest is not None and kind != "Service":
-                ips = [f"10.77.0.{i + 1}"
-                       for i in range(_expected_pods(manifest))]
+                names = [f"{service}-{i}"
+                         for i in range(_expected_pods(manifest))]
                 break
             if manifest is not None:  # Knative Service
-                ips = ["10.77.0.1"]
+                names = [f"{service}-0"]
                 break
-        print(" ".join(ips))
+        if "metadata.name" in (_flag(argv, "-o") or ""):
+            print(names[0] if names else "", end="")
+            return 0
+        print(" ".join(f"10.77.0.{i + 1}" for i in range(len(names))))
         return 0
 
     if argv[:1] == ["delete"]:

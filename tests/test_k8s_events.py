@@ -68,8 +68,7 @@ class EventBackend:
         return []
 
     def pod_events(self, namespace):
-        return [e for e in self.events if e.pop("_ns", "ns1") == namespace
-                or True]
+        return [e for e in self.events if e.get("_ns", "ns1") == namespace]
 
     def delete(self, namespace, name, kind=None):
         return True

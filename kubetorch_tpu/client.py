@@ -343,15 +343,16 @@ def _try_cluster_port_forward() -> Optional[str]:
     """
     if config().local_mode:
         return None
-    import shutil
+    from .utils.kubectl import resolve_kubectl
 
-    if shutil.which("kubectl") is None:
+    kubectl = resolve_kubectl()
+    if kubectl is None:
         return None
     try:
         # short timeout: a hung API server (stale kubeconfig, VPN down) must
         # not stall first use; the local daemon covers the fallback
         probe = subprocess.run(
-            ["kubectl", "get", "svc", "kubetorch-controller",
+            [kubectl, "get", "svc", "kubetorch-controller",
              "-n", config().install_namespace, "-o", "name"],
             capture_output=True, timeout=3)
         if probe.returncode != 0:

@@ -1054,6 +1054,11 @@ def _ingest_k8s_event(state: ControllerState, ns: str, ev: Dict,
     # the deploy instant rather than swallowing a deploy-second fatal event.
     ts = float(ev.get("ts") or 0.0)
     if ts and ts < float(record.get("updated_at") or 0.0) - 1.0:
+        # safe to mark seen: updated_at only ever increases (deploy is its
+        # only writer), so a stale event can never turn fresh — skipping it
+        # permanently avoids re-matching an hour of namespace backlog every
+        # 2s poll; a RECURRING reason bumps count past this mark
+        seen[uid] = count
         return
     seen[uid] = count
     state.record_event(key, f"[k8s] {ev.get('type', 'Normal')} "

@@ -80,6 +80,123 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_q(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+                     acc_ref, m_ref, l_ref, *, scale: float, block_k: int):
+    """int8-KV variant: scales fold into the math instead of dequantizing
+    rows — ``ks`` multiplies the logits COLUMNS (s_j = (q·k_j)·scale·ks_j)
+    and ``vs`` folds into the probs before the PV dot (Σ (p_j·vs_j)·v_j),
+    so no (bk, 1) transposes and no fp row materialization; the HBM stream
+    is int8 tiles + one (1, bk) scale row each."""
+    b = pl.program_id(0)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos_b = pos_ref[b]
+    start = kj * block_k
+
+    @pl.when(start <= pos_b)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # (Gp, Hd)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BK, Hd) int8→f32
+        ks = ks_ref[0, 0]                              # (1, BK)
+        vs = vs_ref[0, 0]                              # (1, BK)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale * ks
+        cols = start + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        s = jnp.where(cols <= pos_b, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)            # int8→f32
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p * vs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention_quant(q: jax.Array, kq: jax.Array, ks: jax.Array,
+                           vq: jax.Array, vs: jax.Array, pos: jax.Array, *,
+                           scale: Optional[float] = None, block_k: int = 512,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Flash-decode over an int8 cache (``serve.kv_quant``): same frontier
+    tile-skipping as :func:`decode_attention`, HALF the HBM stream.
+
+    q: (B, NH, Hd); kq/vq: (B, S, NKV, Hd) int8; ks/vs: (B, S, NKV) fp32
+    per-row scales; pos: (B,). Bit-compatible with the fp32 fold-in einsum
+    reference (``serve.engine._decode_layer_quant``)."""
+    b, nh, hd = q.shape
+    s, nkv = kq.shape[1], kq.shape[2]
+    assert nh % nkv == 0, f"GQA requires n_kv | n_heads, got {nkv}, {nh}"
+    group = nh // nkv
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bk = min(block_k, s)
+    while s % bk:
+        bk //= 2
+
+    gp = max(_MIN_ROWS, group)
+    qg = q.reshape(b, nkv, group, hd)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    kt = kq.transpose(0, 2, 1, 3)                      # (B, NKV, S, Hd)
+    vt = vq.transpose(0, 2, 1, 3)
+    kst = ks.transpose(0, 2, 1)[:, :, None, :]         # (B, NKV, 1, S)
+    vst = vs.transpose(0, 2, 1)[:, :, None, :]
+
+    def val_spec():
+        return pl.BlockSpec((1, 1, bk, hd),
+                            lambda b_, h, j, pos_: (
+                                b_, h, jnp.minimum(j, pos_[b_] // bk), 0))
+
+    def scale_spec():
+        return pl.BlockSpec((1, 1, 1, bk),
+                            lambda b_, h, j, pos_: (
+                                b_, h, 0, jnp.minimum(j, pos_[b_] // bk)))
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_q, scale=scale, block_k=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nkv, s // bk),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, hd),
+                             lambda b_, h, j, pos_: (b_, h, 0, 0)),
+                val_spec(), scale_spec(), val_spec(), scale_spec(),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, hd),
+                                   lambda b_, h, j, pos_: (b_, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, hd), jnp.float32),
+                pltpu.VMEM((gp, 1), jnp.float32),
+                pltpu.VMEM((gp, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), qg, kt, kst, vt, vst)
+    return out[:, :, :group].reshape(b, nh, hd)
+
+
 def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
                      pos: jax.Array, *, scale: Optional[float] = None,
                      block_k: int = 512,

@@ -355,13 +355,15 @@ class Module:
         """Interactive shell into a pod (reference ``ssh`` compute.py:2400).
         Cluster mode execs via kubectl; on the local backend pods are host
         subprocesses, so this opens a shell in the service's synced root."""
-        import shutil
         import subprocess
 
+        from ..utils.kubectl import resolve_kubectl
+
         local = not config().api_url or "127.0.0.1" in config().api_url
-        if not local and shutil.which("kubectl"):
+        kubectl = None if local else resolve_kubectl()
+        if kubectl:
             pod = pod_name or f"{self.name}-0"
-            subprocess.run(["kubectl", "exec", "-it", pod,
+            subprocess.run([kubectl, "exec", "-it", pod,
                             "-n", self.namespace, "--", "/bin/bash"],
                            check=True)
             return

@@ -140,6 +140,56 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs, lora=None):
     return x + ffn_block(cfg, h, lw), ck, cv
 
 
+def _decode_layer_quant(cfg, x, lw, kq, ks, vq, vs, pos, freqs, lora=None):
+    """One layer over one new token per slot against an int8 cache
+    (``kv_quant``): identical projection/RoPE/FFN math to ``_decode_layer``,
+    but the new row is QUANTIZED before it is written and attention folds
+    the row scales in (logits columns ·ks, probs ·vs) instead of
+    materializing fp rows — the reference math the Pallas quant kernel is
+    bit-compatible with."""
+    from .kv_quant import quantize_rows
+    b = x.shape[0]
+    hd = cfg.head_dim
+    nh, nkv = cfg.n_heads, cfg.n_kv_heads
+    lw = dequant_layer(lw, cfg.dtype)
+    h = rmsnorm(x, lw["attn_norm"], cfg.norm_eps)
+    q = lora_proj(h, lw["wq"], lora, "wq").reshape(b, nh, hd)
+    k = lora_proj(h, lw["wk"], lora, "wk").reshape(b, nkv, hd)
+    v = lora_proj(h, lw["wv"], lora, "wv").reshape(b, nkv, hd)
+    q, k = _rope_slot(q, freqs), _rope_slot(k, freqs)
+
+    bi = jnp.arange(b)
+    k_row, ks_row = quantize_rows(k)
+    v_row, vs_row = quantize_rows(v)
+    kq = kq.at[bi, pos].set(k_row)
+    ks = ks.at[bi, pos].set(ks_row)
+    vq = vq.at[bi, pos].set(v_row)
+    vs = vs.at[bi, pos].set(vs_row)
+
+    if _decode_kernel_wanted():
+        from ..ops.decode_attention import decode_attention_quant
+        attn = decode_attention_quant(
+            q, kq, ks, vq, vs, pos,
+            scale=hd ** -0.5).reshape(b, 1, nh * hd).astype(x.dtype)
+    else:
+        group = nh // nkv
+        s = kq.shape[1]
+        qg = q.reshape(b, nkv, group, hd).astype(jnp.float32)
+        logits = jnp.einsum("bkgh,bskh->bkgs", qg,
+                            kq.astype(jnp.float32)) * (hd ** -0.5)
+        logits = logits * ks.transpose(0, 2, 1)[:, :, None, :]
+        mask = jnp.arange(s)[None, :] <= pos[:, None]
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = probs * vs.transpose(0, 2, 1)[:, :, None, :]
+        attn = jnp.einsum("bkgs,bskh->bkgh", probs,
+                          vq.astype(jnp.float32)).reshape(
+                              b, 1, nh * hd).astype(x.dtype)
+    x = x + lora_proj(attn, lw["wo"], lora, "wo")
+    h = rmsnorm(x, lw["ffn_norm"], cfg.norm_eps)
+    return x + ffn_block(cfg, h, lw), kq, ks, vq, vs
+
+
 def _sample_slots(logits, key, temps, top_k: Optional[int]):
     """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot.
     Vectorized (a traced array, not a static) so requests with different
@@ -156,33 +206,53 @@ def _sample_slots(logits, key, temps, top_k: Optional[int]):
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
          donate_argnums=(1,))
-def _decode_step(params, cache: KVCache, pos, toks, rng, temps, cfg,
+def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
                  lora_scale: float = 1.0):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
     temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
     (B,) select each slot's LoRA adapter (index 0 = the zero adapter =
-    base model). Returns (cache', next_tok)."""
+    base model). ``cache`` is a ``KVCache`` or an int8 ``QuantKVCache``
+    (``kv_quant``) — the pytree structure keys the jit, so each engine
+    compiles exactly one of the two bodies. Returns (cache', next_tok)."""
+    from .kv_quant import QuantKVCache
+    quant = isinstance(cache, QuantKVCache)
+    s_max = cache.kq.shape[2] if quant else cache.k.shape[2]
     x = params["embed"][toks[:, None]].astype(cfg.dtype)   # (B, 1, D)
-    freqs = rope_freqs(cfg, cache.k.shape[2])[pos]          # (B, Hd/2)
+    freqs = rope_freqs(cfg, s_max)[pos]                     # (B, Hd/2)
 
-    def body(carry, layer):
-        lw, ck, cv, bank_l = layer
-        lora = None
+    def make_lora(bank_l):
         if banks:
-            lora = ({t: (a[aidx], b_[aidx]) for t, (a, b_) in bank_l.items()},
-                    lora_scale)
-        h, ck, cv = _decode_layer(cfg, carry, lw, ck, cv, pos, freqs,
-                                  lora=lora)
-        return h, (ck, cv)
+            return ({t: (a[aidx], b_[aidx])
+                     for t, (a, b_) in bank_l.items()}, lora_scale)
+        return None
 
-    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v,
-                                     banks or {}))
+    if quant:
+        def body(carry, layer):
+            lw, kq, ks, vq, vs, bank_l = layer
+            h, kq, ks, vq, vs = _decode_layer_quant(
+                cfg, carry, lw, kq, ks, vq, vs, pos, freqs,
+                lora=make_lora(bank_l))
+            return h, (kq, ks, vq, vs)
+
+        x, leaves = lax.scan(body, x, (params["layers"], cache.kq, cache.ks,
+                                       cache.vq, cache.vs, banks or {}))
+        new_cache = QuantKVCache(*leaves)
+    else:
+        def body(carry, layer):
+            lw, ck, cv, bank_l = layer
+            h, ck, cv = _decode_layer(cfg, carry, lw, ck, cv, pos, freqs,
+                                      lora=make_lora(bank_l))
+            return h, (ck, cv)
+
+        x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v,
+                                         banks or {}))
+        new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     nxt = _sample_slots(logits, rng, temps, top_k)
-    return KVCache(nk, nv), nxt
+    return new_cache, nxt
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
@@ -275,9 +345,22 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _splice_slot(cache: KVCache, slot, k_new, v_new) -> KVCache:
+def _splice_slot(cache, slot, k_new, v_new):
     """Write a prefill's K/V rows into one slot of the grid cache, donated
-    (no second grid-sized buffer ever exists). k/v_new: (L, 1, T_b, ...)."""
+    (no second grid-sized buffer ever exists). k/v_new: (L, 1, T_b, ...) in
+    the model dtype; for an int8 ``QuantKVCache`` grid the rows quantize
+    HERE — prefill itself always runs full-precision math."""
+    from .kv_quant import QuantKVCache, quantize_rows
+    if isinstance(cache, QuantKVCache):
+        kq, ks = quantize_rows(k_new)
+        vq, vs = quantize_rows(v_new)
+        start = (0, slot, 0, 0, 0)
+        sstart = (0, slot, 0, 0)
+        return QuantKVCache(
+            kq=lax.dynamic_update_slice(cache.kq, kq, start),
+            ks=lax.dynamic_update_slice(cache.ks, ks, sstart),
+            vq=lax.dynamic_update_slice(cache.vq, vq, start),
+            vs=lax.dynamic_update_slice(cache.vs, vs, sstart))
     start = (0, slot, 0, 0, 0)
     return KVCache(
         k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
@@ -392,7 +475,7 @@ class GenerationEngine:
                  max_len: int = 1024, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
-                 seed: int = 0):
+                 quantize_kv: bool = False, seed: int = 0):
         self.params = params
         self.cfg = cfg
         self.slots = int(slots)
@@ -400,9 +483,17 @@ class GenerationEngine:
         self.eos_id = eos_id
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.quantize_kv = bool(quantize_kv)
         self._buckets = sorted({min(b, self.max_len)
                                 for b in prefill_buckets} | {self.max_len})
-        self._cache = init_cache(cfg, self.slots, self.max_len)
+        if self.quantize_kv:
+            # int8 grid (kv_quant): halves the decode HBM stream + cache
+            # footprint; prefill/prefix math stays full-precision, rows
+            # quantize at the splice
+            from .kv_quant import init_quant_cache
+            self._cache = init_quant_cache(cfg, self.slots, self.max_len)
+        else:
+            self._cache = init_cache(cfg, self.slots, self.max_len)
         self._pos = np.zeros(self.slots, np.int32)     # next write position
         self._tok = np.zeros(self.slots, np.int32)     # next decode input
         self._slot_req: List[Optional[_Request]] = [None] * self.slots

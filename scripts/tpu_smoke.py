@@ -110,6 +110,45 @@ def main():
     print(f"int8 decode: {slots * steps / qdt:.0f} tokens/s/chip "
           f"({qdt:.2f}s; speedup x{dt / qdt:.2f} vs bf16)", flush=True)
 
+    # int8 KV cache: quant flash-decode kernel correctness on REAL TPU
+    # (tests only run it in interpret mode), then decode throughput with
+    # the cache stream halved on top of int8 weights
+    from kubetorch_tpu.ops.decode_attention import (decode_attention,
+                                                    decode_attention_quant)
+    from kubetorch_tpu.serve.kv_quant import quantize_rows
+
+    s_kv = 1024
+    kc = jax.random.normal(ks[1], (slots, s_kv, 4, 128), jnp.bfloat16)
+    vc = jax.random.normal(ks[2], (slots, s_kv, 4, 128), jnp.bfloat16)
+    qd = jax.random.normal(ks[0], (slots, 12, 128), jnp.bfloat16)
+    pos = jnp.array([s_kv - 1] * slots, jnp.int32)
+    kq, kscale = quantize_rows(kc)
+    vq, vscale = quantize_rows(vc)
+    oq = jax.jit(lambda *a: decode_attention_quant(*a))(
+        qd, kq, kscale, vq, vscale, pos)
+    ofp = jax.jit(lambda *a: decode_attention(*a))(qd, kc, vc, pos)
+    qerr = float(jnp.max(jnp.abs(oq.astype(jnp.float32)
+                                 - ofp.astype(jnp.float32))))
+    print(f"quant decode kernel vs fp maxerr {qerr:.4f}", flush=True)
+    assert qerr < 0.08, qerr
+
+    kveng = GenerationEngine(quantize_params(params), cfg, slots=slots,
+                             max_len=1024, prefill_buckets=(128,),
+                             quantize_kv=True)
+    for p in prompts:
+        kveng.submit(list(map(int, p)), max_new_tokens=512)
+    t0 = time.time()
+    kveng.step()
+    print(f"int8+kv engine compile {time.time()-t0:.1f}s", flush=True)
+    for _ in range(3):
+        kveng.step()
+    t0 = time.time()
+    for _ in range(steps):
+        kveng.step()
+    kvdt = time.time() - t0
+    print(f"int8+int8kv decode: {slots * steps / kvdt:.0f} tokens/s/chip "
+          f"({kvdt:.2f}s; speedup x{dt / kvdt:.2f} vs bf16)", flush=True)
+
     print("TPU SMOKE OK", flush=True)
 
 

@@ -13,6 +13,15 @@ in-range tile (Pallas elides the DMA when the block index repeats), so a
 slot 300 tokens into a 4096-row cache streams 8 tiles, not 32
 ([pos // block_k] + 1 of them); ``pl.when`` skips the matching compute.
 
+Two cache layouts share ONE kernel body (``_make_decode_kernel``):
+
+- full-precision (B, S, NKV, Hd) rows — probs round through the cache
+  dtype before the PV dot, matching the einsum reference bitwise;
+- int8 rows + per-row fp32 scales (``serve.kv_quant``) — the scales fold
+  into the math (logits columns ·ks, probs ·vs; all fp32), so the HBM
+  stream is int8 tiles plus one (1, block_k) scale row per tile and no fp
+  rows ever materialize.
+
 Layout mirrors ``ops.attention``: (B, NKV, G, Hd) query block per grid
 step, K/V head-major, fp32 accumulators in VMEM scratch, the innermost
 grid axis sequential over K tiles.
@@ -34,182 +43,83 @@ NEG_INF = -1e30
 _MIN_ROWS = 8
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale: float, block_k: int):
-    b = pl.program_id(0)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+def _make_decode_kernel(quant: bool, *, scale: float, block_k: int):
+    """One online-softmax body for both cache layouts. ``quant`` is a
+    trace-time switch: it only changes which refs exist and where the
+    row scales fold in — the frontier skip, init/finalize, and softmax
+    scaffolding are shared so they can never drift apart."""
 
-    @pl.when(kj == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    def kernel(pos_ref, q_ref, *refs):
+        if quant:
+            k_ref, ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        else:
+            k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        b = pl.program_id(0)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
 
-    pos_b = pos_ref[b]
-    start = kj * block_k
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # the whole tile is past this slot's frontier ⇒ nothing to read
-    @pl.when(start <= pos_b)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)           # (Gp, Hd)
-        k = k_ref[0, 0].astype(jnp.float32)           # (BK, Hd)
-        v = v_ref[0, 0]                               # keep cache dtype:
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        cols = start + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], block_k), 1)
-        s = jnp.where(cols <= pos_b, s, NEG_INF)
+        pos_b = pos_ref[b]
+        start = kj * block_k
 
-        m_prev = m_ref[:]                             # (Gp, 1)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # p rounds through the cache dtype before the PV dot (fp32 acc) —
-        # same rounding as the einsum reference and the flash fwd kernel
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+        # the whole tile is past this slot's frontier ⇒ nothing to read
+        @pl.when(start <= pos_b)
+        def _compute():
+            q = q_ref[0, 0].astype(jnp.float32)       # (Gp, Hd)
+            k = k_ref[0, 0].astype(jnp.float32)       # (BK, Hd)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if quant:
+                s = s * ks_ref[0, 0]                  # (1, BK) logit columns
+            cols = start + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 1)
+            s = jnp.where(cols <= pos_b, s, NEG_INF)
 
-    @pl.when(kj == nk - 1)
-    def _finalize():
-        l = l_ref[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+            m_prev = m_ref[:]                         # (Gp, 1)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if quant:
+                # vs folds into the probs; int8 V dequantizes to fp32 —
+                # the whole PV dot runs fp32 (the quant einsum reference)
+                pv_lhs = p * vs_ref[0, 0]
+                v = v_ref[0, 0].astype(jnp.float32)
+            else:
+                # p rounds through the cache dtype before the PV dot
+                # (fp32 acc) — same rounding as the einsum reference and
+                # the flash fwd kernel
+                v = v_ref[0, 0]
+                pv_lhs = p.astype(v.dtype)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                pv_lhs, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:] = m_new
 
+        @pl.when(kj == nk - 1)
+        def _finalize():
+            l = l_ref[:]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
-def _decode_kernel_q(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
-                     acc_ref, m_ref, l_ref, *, scale: float, block_k: int):
-    """int8-KV variant: scales fold into the math instead of dequantizing
-    rows — ``ks`` multiplies the logits COLUMNS (s_j = (q·k_j)·scale·ks_j)
-    and ``vs`` folds into the probs before the PV dot (Σ (p_j·vs_j)·v_j),
-    so no (bk, 1) transposes and no fp row materialization; the HBM stream
-    is int8 tiles + one (1, bk) scale row each."""
-    b = pl.program_id(0)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(kj == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    pos_b = pos_ref[b]
-    start = kj * block_k
-
-    @pl.when(start <= pos_b)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)            # (Gp, Hd)
-        k = k_ref[0, 0].astype(jnp.float32)            # (BK, Hd) int8→f32
-        ks = ks_ref[0, 0]                              # (1, BK)
-        vs = vs_ref[0, 0]                              # (1, BK)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * scale * ks
-        cols = start + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], block_k), 1)
-        s = jnp.where(cols <= pos_b, s, NEG_INF)
-
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)            # int8→f32
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p * vs, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
-
-    @pl.when(kj == nk - 1)
-    def _finalize():
-        l = l_ref[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+    return kernel
 
 
-def decode_attention_quant(q: jax.Array, kq: jax.Array, ks: jax.Array,
-                           vq: jax.Array, vs: jax.Array, pos: jax.Array, *,
-                           scale: Optional[float] = None, block_k: int = 512,
-                           interpret: Optional[bool] = None) -> jax.Array:
-    """Flash-decode over an int8 cache (``serve.kv_quant``): same frontier
-    tile-skipping as :func:`decode_attention`, HALF the HBM stream.
-
-    q: (B, NH, Hd); kq/vq: (B, S, NKV, Hd) int8; ks/vs: (B, S, NKV) fp32
-    per-row scales; pos: (B,). Bit-compatible with the fp32 fold-in einsum
-    reference (``serve.engine._decode_layer_quant``)."""
+def _decode_call(quant: bool, q, values, scales, pos, *,
+                 scale: Optional[float], block_k: int,
+                 interpret: Optional[bool]):
+    """Shared wrapper: shape derivation, GQA padding, head-major
+    transposes, frontier-clamp BlockSpecs, scratch, and output slicing for
+    both layouts. ``values`` = (ck, cv) rows (B, S, NKV, Hd); ``scales`` =
+    (ks, vs) per-row scales (B, S, NKV) for the quant layout, else None."""
     b, nh, hd = q.shape
-    s, nkv = kq.shape[1], kq.shape[2]
-    assert nh % nkv == 0, f"GQA requires n_kv | n_heads, got {nkv}, {nh}"
-    group = nh // nkv
-    if scale is None:
-        scale = hd ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-
-    bk = min(block_k, s)
-    while s % bk:
-        bk //= 2
-
-    gp = max(_MIN_ROWS, group)
-    qg = q.reshape(b, nkv, group, hd)
-    if gp != group:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
-    kt = kq.transpose(0, 2, 1, 3)                      # (B, NKV, S, Hd)
-    vt = vq.transpose(0, 2, 1, 3)
-    kst = ks.transpose(0, 2, 1)[:, :, None, :]         # (B, NKV, 1, S)
-    vst = vs.transpose(0, 2, 1)[:, :, None, :]
-
-    def val_spec():
-        return pl.BlockSpec((1, 1, bk, hd),
-                            lambda b_, h, j, pos_: (
-                                b_, h, jnp.minimum(j, pos_[b_] // bk), 0))
-
-    def scale_spec():
-        return pl.BlockSpec((1, 1, 1, bk),
-                            lambda b_, h, j, pos_: (
-                                b_, h, 0, jnp.minimum(j, pos_[b_] // bk)))
-
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel_q, scale=scale, block_k=bk),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(b, nkv, s // bk),
-            in_specs=[
-                pl.BlockSpec((1, 1, gp, hd),
-                             lambda b_, h, j, pos_: (b_, h, 0, 0)),
-                val_spec(), scale_spec(), val_spec(), scale_spec(),
-            ],
-            out_specs=pl.BlockSpec((1, 1, gp, hd),
-                                   lambda b_, h, j, pos_: (b_, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((gp, hd), jnp.float32),
-                pltpu.VMEM((gp, 1), jnp.float32),
-                pltpu.VMEM((gp, 1), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
-        interpret=interpret,
-    )(pos.astype(jnp.int32), qg, kt, kst, vt, vst)
-    return out[:, :, :group].reshape(b, nh, hd)
-
-
-def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
-                     pos: jax.Array, *, scale: Optional[float] = None,
-                     block_k: int = 512,
-                     interpret: Optional[bool] = None) -> jax.Array:
-    """One new token per slot against its cache rows ``<= pos``.
-
-    q: (B, NH, Hd); ck/cv: (B, S, NKV, Hd); pos: (B,) int32 — the row each
-    slot's new token occupies (already written). Returns (B, NH, Hd).
-    Bit-compatible with the masked-einsum reference in
-    ``serve.engine._decode_layer`` (asserted in tests/test_decode_kernel.py).
-    """
-    b, nh, hd = q.shape
-    s, nkv = ck.shape[1], ck.shape[2]
+    s, nkv = values[0].shape[1], values[0].shape[2]
     assert nh % nkv == 0, f"GQA requires n_kv | n_heads, got {nkv}, {nh}"
     group = nh // nkv
     if scale is None:
@@ -226,29 +136,38 @@ def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
     qg = q.reshape(b, nkv, group, hd)
     if gp != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
-    kt = ck.transpose(0, 2, 1, 3)                     # (B, NKV, S, Hd)
-    vt = cv.transpose(0, 2, 1, 3)
+
+    # the frontier skip lives in the index maps, not the kernel body:
+    # Pallas elides a block DMA only when the index map returns the same
+    # block as the previous step, so past-frontier steps clamp to the last
+    # in-range tile (the kernel's pl.when then skips the compute too).
+    # pl.when alone would save FLOPs but still stream every tile from HBM.
+    def val_spec():
+        return pl.BlockSpec((1, 1, bk, hd),
+                            lambda b_, h, j, pos_: (
+                                b_, h, jnp.minimum(j, pos_[b_] // bk), 0))
+
+    def scale_spec():
+        return pl.BlockSpec((1, 1, 1, bk),
+                            lambda b_, h, j, pos_: (
+                                b_, h, 0, jnp.minimum(j, pos_[b_] // bk)))
+
+    q_spec = pl.BlockSpec((1, 1, gp, hd),
+                          lambda b_, h, j, pos_: (b_, h, 0, 0))
+    inputs, in_specs = [qg], [q_spec]
+    for i, val in enumerate(values):
+        inputs.append(val.transpose(0, 2, 1, 3))       # (B, NKV, S, Hd)
+        in_specs.append(val_spec())
+        if quant:
+            inputs.append(scales[i].transpose(0, 2, 1)[:, :, None, :])
+            in_specs.append(scale_spec())              # (B, NKV, 1, S)
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_k=bk),
+        _make_decode_kernel(quant, scale=scale, block_k=bk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nkv, s // bk),
-            in_specs=[
-                pl.BlockSpec((1, 1, gp, hd), lambda b_, h, j, pos_: (b_, h, 0, 0)),
-                # the frontier skip lives HERE, not in the kernel body:
-                # Pallas elides a block DMA only when the index map returns
-                # the same block as the previous step, so past-frontier
-                # steps clamp to the last in-range tile (the kernel's
-                # pl.when then skips the compute too). pl.when alone would
-                # save FLOPs but still stream every tile from HBM.
-                pl.BlockSpec((1, 1, bk, hd),
-                             lambda b_, h, j, pos_: (
-                                 b_, h, jnp.minimum(j, pos_[b_] // bk), 0)),
-                pl.BlockSpec((1, 1, bk, hd),
-                             lambda b_, h, j, pos_: (
-                                 b_, h, jnp.minimum(j, pos_[b_] // bk), 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, gp, hd),
                                    lambda b_, h, j, pos_: (b_, h, 0, 0)),
             scratch_shapes=[
@@ -259,5 +178,35 @@ def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
         interpret=interpret,
-    )(pos.astype(jnp.int32), qg, kt, vt)
+    )(pos.astype(jnp.int32), *inputs)
     return out[:, :, :group].reshape(b, nh, hd)
+
+
+def decode_attention(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                     pos: jax.Array, *, scale: Optional[float] = None,
+                     block_k: int = 512,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """One new token per slot against its cache rows ``<= pos``.
+
+    q: (B, NH, Hd); ck/cv: (B, S, NKV, Hd); pos: (B,) int32 — the row each
+    slot's new token occupies (already written). Returns (B, NH, Hd).
+    Bit-compatible with the masked-einsum reference in
+    ``serve.engine._decode_layer`` (asserted in tests/test_decode_kernel.py).
+    """
+    return _decode_call(False, q, (ck, cv), None, pos, scale=scale,
+                        block_k=block_k, interpret=interpret)
+
+
+def decode_attention_quant(q: jax.Array, kq: jax.Array, ks: jax.Array,
+                           vq: jax.Array, vs: jax.Array, pos: jax.Array, *,
+                           scale: Optional[float] = None, block_k: int = 512,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Flash-decode over an int8 cache (``serve.kv_quant``): same frontier
+    tile-skipping as :func:`decode_attention`, HALF the HBM stream.
+
+    q: (B, NH, Hd); kq/vq: (B, S, NKV, Hd) int8; ks/vs: (B, S, NKV) fp32
+    per-row scales; pos: (B,). Bit-compatible with the fp32 fold-in einsum
+    reference (``serve.engine._decode_layer_quant``), asserted in
+    tests/test_kv_quant.py."""
+    return _decode_call(True, q, (kq, vq), (ks, vs), pos, scale=scale,
+                        block_k=block_k, interpret=interpret)

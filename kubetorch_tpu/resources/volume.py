@@ -153,11 +153,13 @@ class Volume:
     def _ssh_cmd(self, image: str = "alpine:latest",
                  namespace: Optional[str] = None) -> List[str]:
         import json as _json
+
+        from ..utils.kubectl import resolve_kubectl
         ns = namespace or config().namespace
         pod_name = f"debug-{self.name}-{uuid.uuid4().hex[:6]}"
-        return ["kubectl", "run", pod_name, "--rm", "-it",
-                "--namespace", ns, "--image", image, "--restart=Never",
-                "--overrides",
+        return [resolve_kubectl() or "kubectl", "run", pod_name, "--rm",
+                "-it", "--namespace", ns, "--image", image,
+                "--restart=Never", "--overrides",
                 _json.dumps(self.scratch_pod_manifest(image, pod_name))]
 
     @staticmethod

@@ -18,9 +18,10 @@ from ..models.quant import (dequantize_params, quantize_params,
                             quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
 from .kv_quant import QuantKVCache, dequantize_rows, quantize_rows
+from .spec_engine import SpeculativeEngine
 from .speculative import SpecStats, speculative_generate
 
 __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
            "quantize_params", "dequantize_params", "quantized_bytes",
-           "speculative_generate", "SpecStats",
+           "speculative_generate", "SpecStats", "SpeculativeEngine",
            "QuantKVCache", "quantize_rows", "dequantize_rows"]

@@ -1,0 +1,309 @@
+"""Speculative continuous batching: the slot-grid engine with a draft.
+
+``speculative_generate`` (serve/speculative.py) speculates ONE request;
+``GenerationEngine`` batches many requests but decodes one token per slot
+per step. This engine does both at once: every round, a draft model
+proposes ``k`` tokens for EVERY active slot, and one target forward scores
+all slots' pending+proposal windows together — so each target
+weight-stream yields 1..k+1 tokens per slot, across the whole grid.
+
+The shapes stay static (the engine's contract): the draft ingests a
+(SLOTS, k+1) block of per-slot pending tokens, proposes via k-1 grid
+decode steps, and the target verifies a (SLOTS, 2k+1) block — per-slot
+true lengths ride as traced vectors, so mixed progress (a slot that
+accepted everything beside one that accepted nothing, idle slots at
+length 0) shares one compile. Rows past a slot's frontier hold stale
+garbage by design: every round writes its rows BEFORE attending and the
+per-slot causal mask never admits an unwritten row — the same position
+ledger the standalone implementation proves (speculative.py docstring).
+
+Greedy verification is EXACT per slot: each request's emitted stream is
+bit-identical to the target's own greedy decode of that prompt, whatever
+the draft proposes and whatever the neighbors do — the oracle
+``tests/test_spec_engine.py`` asserts, for dense AND MoE targets (MoE
+windows route drop-free like the standalone; the prefill mirrors the
+oracle's real-length capacity).
+
+Reference analog: none — beyond-parity serving, docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import KVCache, ffn_block, init_cache, rope_freqs
+from ..models.llama import rmsnorm
+from ..models.quant import dequant_layer, head_weight
+from .engine import (GenerationEngine, _decode_step, _prefill, _splice_slot)
+from .speculative import SpecStats
+
+NEG_INF = -1e30
+
+
+def _rope_grid(x: jax.Array, freqs: jax.Array) -> jax.Array:
+    """RoPE with per-(slot, offset) rotations: x (B, W, N, Hd), freqs
+    (B, W, Hd/2) complex — the grid generalization of ``_rope_slot``."""
+    b, w, n, hd = x.shape
+    xf = x.astype(jnp.float32).reshape(b, w, n, hd // 2, 2)
+    xc = lax.complex(xf[..., 0], xf[..., 1])
+    rotated = xc * freqs[:, :, None, :]
+    out = jnp.stack([jnp.real(rotated), jnp.imag(rotated)], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "s_eff"), donate_argnums=(1,))
+def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
+                 s_eff: Optional[int] = None):
+    """Run a (B, W) token window through the model, each slot at its own
+    absolute positions ``start[b] + i``, writing cache rows and returning
+    fp32 logits for EVERY window position (B, W, V).
+
+    ``true_len`` (B,) marks each slot's real tokens: padding (and wholly
+    idle slots at true_len 0) writes garbage rows past the frontier that a
+    later round overwrites before the mask can admit them, and never
+    claims MoE expert capacity (token_mask + no_drop routing — each real
+    token routes exactly as it would alone, the T=1 oracle).
+
+    ``s_eff`` (static) bounds the attended cache rows: the causal mask
+    never admits a row past ``max(start) + W``, so the caller passes that
+    frontier rounded up to a power-of-two bucket and the attention einsums
+    stream ``s_eff`` rows instead of all ``S_max`` — the frontier-skip the
+    flash-decode kernel gives the T=1 path, as a static slice here (one
+    compile per bucket, a handful over a request's lifetime).
+
+    The layer body is deliberately specialized (three position shapes live
+    in this codebase: (T,) scanned generate, (B,) slot decode, (B, W)
+    here) — divergence from ``generate``'s semantics is pinned by the
+    bit-exactness oracles in tests/test_spec_engine.py, which fail on ANY
+    drift in norm/RoPE/cache/MoE behavior."""
+    b, w = blocks.shape
+    s_max = cache.k.shape[2]
+    if s_eff is None:
+        s_eff = s_max
+    x = params["embed"][blocks].astype(cfg.dtype)
+    posm = start[:, None] + jnp.arange(w)[None, :]          # (B, W)
+    freqs_full = rope_freqs(cfg, s_max)
+    freqs = freqs_full[posm]                                 # (B, W, Hd/2)
+    token_mask = jnp.arange(w)[None, :] < true_len[:, None]  # (B, W)
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    group = nh // nkv
+    bi = jnp.arange(b)[:, None]
+
+    def body(carry, layer):
+        lw, ck, cv = layer
+        lw = dequant_layer(lw, cfg.dtype)
+        h = carry
+        hn = rmsnorm(h, lw["attn_norm"], cfg.norm_eps)
+        q = (hn @ lw["wq"]).reshape(b, w, nh, hd)
+        k = (hn @ lw["wk"]).reshape(b, w, nkv, hd)
+        v = (hn @ lw["wv"]).reshape(b, w, nkv, hd)
+        q, k = _rope_grid(q, freqs), _rope_grid(k, freqs)
+        ck = ck.at[bi, posm].set(k.astype(ck.dtype))
+        cv = cv.at[bi, posm].set(v.astype(cv.dtype))
+
+        ck_a = lax.slice_in_dim(ck, 0, s_eff, axis=1)
+        cv_a = lax.slice_in_dim(cv, 0, s_eff, axis=1)
+        qg = q.reshape(b, w, nkv, group, hd)
+        logits = jnp.einsum("bwkgh,bskh->bkgws", qg,
+                            ck_a).astype(jnp.float32) * (hd ** -0.5)
+        mask = (jnp.arange(s_eff)[None, None, :]
+                <= posm[:, :, None])                         # (B, W, S_eff)
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+        attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
+                          cv_a).reshape(b, w, nh * hd)
+        h = h + attn @ lw["wo"]
+        hn = rmsnorm(h, lw["ffn_norm"], cfg.norm_eps)
+        h = h + ffn_block(cfg, hn, lw, token_mask=token_mask,
+                          moe_no_drop=True)
+        return h, (ck, cv)
+
+    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    return logits, KVCache(nk, nv)
+
+
+class SpeculativeEngine(GenerationEngine):
+    """Continuous batching with per-slot speculative decoding (module
+    docstring has the design). Greedy-only — the exactness proof is the
+    argmax acceptance rule; sampled speculation needs rejection sampling
+    and is out of scope. Prefix caching, adapters, and int8 KV are the
+    plain engine's territory for now — refused loudly rather than served
+    approximately."""
+
+    def __init__(self, params: Dict[str, Any], cfg,
+                 draft_params: Dict[str, Any], draft_cfg, *, spec_k: int = 4,
+                 **kwargs):
+        if kwargs.get("temperature", 0.0) != 0.0:
+            raise ValueError("SpeculativeEngine is greedy-only "
+                             "(temperature=0); use GenerationEngine for "
+                             "sampled serving")
+        if kwargs.get("quantize_kv"):
+            raise ValueError("quantize_kv is not supported with "
+                             "speculation yet — use GenerationEngine")
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        super().__init__(params, cfg, **kwargs)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k = int(spec_k)
+        self._draft_cache = init_cache(draft_cfg, self.slots, self.max_len)
+        # per-slot ledgers: rows both caches validly cover, and the tokens
+        # emitted but not yet ingested (1..k+1 long while active).
+        # NB: self._pending is the BASE class's request queue — the token
+        # ledger gets its own name
+        self._spec_valid = np.zeros(self.slots, np.int32)
+        self._slot_pending: List[List[int]] = [[] for _ in range(self.slots)]
+        self.spec_stats = SpecStats()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
+               temperature: Optional[float] = None,
+               prefix_id: Optional[int] = None,
+               adapter_id: Optional[int] = None):
+        if temperature not in (None, 0.0):
+            raise ValueError("SpeculativeEngine is greedy-only")
+        if prefix_id is not None or adapter_id is not None:
+            raise ValueError("prefix/adapter serving is not supported with "
+                             "speculation yet — use GenerationEngine")
+        prompt = [int(t) for t in prompt]
+        # the verify window writes up to 2k+1 rows past the last emitted
+        # token — reserve that headroom so scatter rows stay in bounds
+        if (prompt and max_new_tokens >= 1
+                and len(prompt) + max_new_tokens + 2 * self.k + 1
+                > self.max_len):
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) + verify window ({2 * self.k + 1}) "
+                f"exceeds max_len ({self.max_len})")
+        return super().submit(prompt, max_new_tokens)
+
+    # -- admission ----------------------------------------------------------
+
+    def _admit_one(self, req, slot: int) -> None:
+        t = len(req.prompt)
+        temps = jnp.zeros((1,), jnp.float32)
+        bucket = next(b for b in self._buckets if b >= t)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :t] = req.prompt
+        block = jnp.asarray(padded)
+        first, k_new, v_new = _prefill(
+            self.params, block, jnp.int32(t), self._next_key(), temps,
+            self.cfg)
+        self._cache = _splice_slot(self._cache, jnp.int32(slot),
+                                   k_new, v_new)
+        # the draft prefills the same prompt into ITS grid (its first-token
+        # sample is discarded — the target owns every emitted token)
+        _, dk, dv = _prefill(self.draft_params, block, jnp.int32(t),
+                             self._next_key(), temps, self.draft_cfg)
+        self._draft_cache = _splice_slot(self._draft_cache, jnp.int32(slot),
+                                         dk, dv)
+        first_tok = int(first[0])
+        self._slot_req[slot] = req
+        self._spec_valid[slot] = t
+        self._slot_pending[slot] = [first_tok]
+        self._admitted += 1
+        self._emit(slot, first_tok)
+        if self._slot_req[slot] is None:      # retired on its first token
+            self._slot_pending[slot] = []
+            self._spec_valid[slot] = 0
+
+    # -- the speculative round ----------------------------------------------
+
+    def step(self) -> int:
+        self._admit()
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if active:
+            self._round(active)
+        with self._lock:
+            queued = len(self._pending)
+        return sum(r is not None for r in self._slot_req) + queued
+
+    def _round(self, active: List[int]) -> None:
+        b, k = self.slots, self.k
+        wd, wt = k + 1, 2 * k + 1
+        c = np.zeros(b, np.int32)
+        for i in active:
+            c[i] = len(self._slot_pending[i])
+        start = self._spec_valid.astype(np.int32).copy()
+        # static frontier bucket: no slot attends a row past its own
+        # start + W, so both window forwards stream s_eff rows, not S_max
+        # (a power-of-two bucket bounds compiles to a handful)
+        need = int(start[active].max()) + wt
+        s_eff = self.max_len
+        while s_eff // 2 >= need and s_eff > 1:
+            s_eff //= 2
+
+        # draft: ingest each slot's pending block, then k-1 grid decode
+        # steps propose greedily (temps 0 ⇒ argmax in _decode_step).
+        # Proposals stay ON DEVICE through the loop — each step only needs
+        # the previous token there, and a per-step host fetch would stall
+        # dispatch k-1 times per round
+        dblock = np.zeros((b, wd), np.int32)
+        for i in active:
+            dblock[i, :c[i]] = self._slot_pending[i]
+        dlog, self._draft_cache = _grid_ingest(
+            self.draft_params, self._draft_cache, jnp.asarray(dblock),
+            jnp.asarray(start), jnp.asarray(c), self.draft_cfg,
+            s_eff=s_eff)
+        last = np.clip(c - 1, 0, wd - 1)
+        tok = jnp.argmax(dlog[jnp.arange(b), last],
+                         axis=-1).astype(jnp.int32)
+        props = [tok]
+        zeros = jnp.zeros(b, jnp.float32)
+        for i in range(k - 1):
+            self._draft_cache, tok = _decode_step(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(start + c + i), tok, self._next_key(), zeros,
+                self.draft_cfg)
+            props.append(tok)
+        proposals = np.asarray(jnp.stack(props, axis=1))  # (B, k), one fetch
+
+        # target: one forward over pending+proposals for every slot
+        tblock = np.zeros((b, wt), np.int32)
+        tl = np.zeros(b, np.int32)
+        for i in active:
+            tblock[i, :c[i]] = self._slot_pending[i]
+            tblock[i, c[i]:c[i] + k] = proposals[i]
+            tl[i] = c[i] + k
+        tlog, self._cache = _grid_ingest(
+            self.params, self._cache, jnp.asarray(tblock),
+            jnp.asarray(start), jnp.asarray(tl), self.cfg, s_eff=s_eff)
+        greedy = np.asarray(jnp.argmax(tlog, axis=-1))   # (B, WT)
+        self._steps += 1
+
+        for i in active:
+            ci = int(c[i])
+            accepted = 0
+            while (accepted < k
+                   and proposals[i, accepted] == greedy[i, ci - 1 + accepted]):
+                accepted += 1
+            correction = int(greedy[i, ci - 1 + accepted])
+            emitted = [int(t) for t in proposals[i, :accepted]] + [correction]
+            sent = 0
+            for t in emitted:
+                self._emit(i, t)
+                sent += 1
+                if self._slot_req[i] is None:
+                    break
+            self.spec_stats.rounds += 1
+            self.spec_stats.proposed += k
+            # count only acceptances that were EMITTED: matches past a
+            # retirement point (budget/eos) are comparisons against the
+            # target's post-stream continuation, and counting them would
+            # flatter acceptance_rate for exactly the requests that end
+            self.spec_stats.accepted += min(accepted, sent)
+            self._spec_valid[i] = start[i] + ci
+            if self._slot_req[i] is None:
+                self._slot_pending[i] = []
+                self._spec_valid[i] = 0
+            else:
+                self._slot_pending[i] = emitted

@@ -18,10 +18,18 @@ for i in $(seq 1 200); do
   if KT_BENCH_WORKER=1 timeout 1200 python bench.py > /tmp/bench_try.json 2>> "$LOG"; then
     if grep -q '"device": "TPU' /tmp/bench_try.json; then
       cp /tmp/bench_try.json /tmp/bench_tpu.json
+      # ALSO land the artifacts in the repo: if the relay window opens
+      # after the builder's last turn, the driver's end-of-round commit of
+      # uncommitted work still captures the evidence
+      mkdir -p evidence
+      cp /tmp/bench_try.json evidence/bench_tpu.json
+      date -u +"%Y-%m-%dT%H:%M:%SZ" > evidence/captured_at.txt
       echo "BENCH SUCCESS on attempt $i" >> "$LOG"
       echo "running tpu_smoke" >> "$LOG"
       timeout 1200 python scripts/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1
-      echo "smoke rc=$? — loop done" >> "$LOG"
+      rc=$?
+      cp /tmp/tpu_smoke.log evidence/tpu_smoke.log 2>/dev/null
+      echo "smoke rc=$rc — loop done" >> "$LOG"
       exit 0
     fi
     echo "(cpu-labelled line; ignoring)" >> "$LOG"

@@ -1,0 +1,166 @@
+"""Speculative continuous batching (serve/spec_engine.py).
+
+The contract is the intersection of its parents': like the engine, every
+request's greedy tokens must match a solo ``generate`` run WHATEVER the
+slot neighbors do; like standalone speculation, that must hold for any
+draft, with acceptance only short-cutting identical outcomes. MoE targets
+hold the same bar (drop-free verify windows).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.models.generate import generate
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.serve.spec_engine import SpeculativeEngine
+
+pytestmark = [pytest.mark.level("unit"), pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    target = llama_init(jax.random.PRNGKey(0), cfg)
+    dcfg = LlamaConfig.tiny(dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                            ffn_dim=64, attn_impl="xla", dtype=jnp.float32,
+                            remat=False)
+    draft = llama_init(jax.random.PRNGKey(7), dcfg)
+    return target, cfg, draft, dcfg
+
+
+def _solo(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _drain(eng):
+    while eng.step():
+        pass
+
+
+class TestExactness:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_concurrent_requests_match_solo_generate(self, models, k):
+        target, cfg, draft, dcfg = models
+        prompts = [[5, 17, 42], [100, 200, 300, 400, 401], [1, 2]]
+        ns = [8, 11, 5]
+        want = [_solo(target, cfg, p, n) for p, n in zip(prompts, ns)]
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=k,
+                                slots=4, max_len=64, prefill_buckets=(8,))
+        handles = [eng.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, ns)]
+        _drain(eng)
+        got = [h.result(timeout=0) for h in handles]
+        assert got == want
+        assert eng.spec_stats.rounds >= 1
+
+    def test_self_draft_accepts_everything(self, models):
+        """Draft == target: 100% acceptance, and the whole grid advances
+        ~k+1 tokens per slot per round."""
+        target, cfg, _, _ = models
+        prompts = [[3, 4, 5], [9, 8, 7]]
+        want = [_solo(target, cfg, p, 12) for p in prompts]
+        eng = SpeculativeEngine(target, cfg, target, cfg, spec_k=3,
+                                slots=2, max_len=64, prefill_buckets=(8,))
+        handles = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        _drain(eng)
+        assert [h.result(timeout=0) for h in handles] == want
+        assert eng.spec_stats.acceptance_rate == 1.0
+        # 12 tokens = 1 (prefill) + rounds*(k+1=4): ceil(11/4)=3 per slot
+        assert eng.spec_stats.rounds <= 2 * 3 + 1
+
+    def test_mid_flight_admission(self, models):
+        """A request admitted while neighbors are mid-speculation must not
+        perturb them — and must itself be exact."""
+        target, cfg, draft, dcfg = models
+        pa, pb = [5, 17, 42, 99], [7, 7, 7]
+        want_a = _solo(target, cfg, pa, 10)
+        want_b = _solo(target, cfg, pb, 6)
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=3,
+                                slots=2, max_len=64, prefill_buckets=(8,))
+        ha = eng.submit(pa, max_new_tokens=10)
+        eng.step()
+        eng.step()
+        hb = eng.submit(pb, max_new_tokens=6)       # joins mid-flight
+        _drain(eng)
+        assert ha.result(timeout=0) == want_a
+        assert hb.result(timeout=0) == want_b
+
+    def test_slot_reuse_after_retirement(self, models):
+        target, cfg, draft, dcfg = models
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                slots=1, max_len=64, prefill_buckets=(8,))
+        for prompt, n in [([5, 17], 5), ([42, 43, 44], 7), ([1], 4)]:
+            want = _solo(target, cfg, prompt, n)
+            h = eng.submit(prompt, max_new_tokens=n)
+            _drain(eng)
+            assert h.result(timeout=0) == want, (prompt, n)
+
+    def test_eos_retires_early(self, models):
+        target, cfg, draft, dcfg = models
+        ref = _solo(target, cfg, [5, 17, 42], 12)
+        eos = ref[4]                                 # retire mid-stream
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=3,
+                                slots=2, max_len=64, prefill_buckets=(8,),
+                                eos_id=eos)
+        h = eng.submit([5, 17, 42], max_new_tokens=12)
+        _drain(eng)
+        got = h.result(timeout=0)
+        assert got == ref[:5]                        # up to AND incl. eos
+        # the slot is free again
+        h2 = eng.submit([9, 8], max_new_tokens=3)
+        _drain(eng)
+        assert len(h2.result(timeout=0)) == 3
+
+
+class TestMoeTarget:
+    def test_moe_target_exact(self, models):
+        from kubetorch_tpu.models.moe import MoeConfig, moe_init
+        _, _, draft, dcfg = models
+        mcfg = MoeConfig.tiny(dtype=jnp.float32, remat=False,
+                              attn_impl="xla")
+        mo = moe_init(jax.random.PRNGKey(1), mcfg)
+        prompts = [[5, 17, 42, 99], [7] * 6]
+        ns = [9, 7]
+        want = [_solo(mo, mcfg, p, n) for p, n in zip(prompts, ns)]
+        eng = SpeculativeEngine(mo, mcfg, draft, dcfg, spec_k=3,
+                                slots=2, max_len=64, prefill_buckets=(8,))
+        handles = [eng.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, ns)]
+        _drain(eng)
+        assert [h.result(timeout=0) for h in handles] == want
+
+
+class TestValidation:
+    def test_refusals(self, models):
+        target, cfg, draft, dcfg = models
+        with pytest.raises(ValueError, match="greedy-only"):
+            SpeculativeEngine(target, cfg, draft, dcfg, temperature=0.7,
+                              max_len=64)
+        with pytest.raises(ValueError, match="quantize_kv"):
+            SpeculativeEngine(target, cfg, draft, dcfg, quantize_kv=True,
+                              max_len=64)
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                slots=2, max_len=32, prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit([1, 2], max_new_tokens=3, temperature=0.5)
+        with pytest.raises(ValueError, match="prefix/adapter"):
+            eng.submit([1, 2], max_new_tokens=3, prefix_id=0)
+        with pytest.raises(ValueError, match="verify window"):
+            # 8 + 20 + 5 > 32: the verify window headroom must be reserved
+            eng.submit([1] * 8, max_new_tokens=20)
+
+    def test_background_loop(self, models):
+        target, cfg, draft, dcfg = models
+        want = _solo(target, cfg, [5, 6, 7], 8)
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                slots=2, max_len=64, prefill_buckets=(8,))
+        try:
+            got = eng.generate([5, 6, 7], 8, timeout=300)
+        finally:
+            eng.stop()
+        assert got == want

@@ -23,17 +23,30 @@ class BatchingGenerator:
     """Stateful service: the engine (params + slot cache + decode loop
     thread) lives across calls; every HTTP request becomes one slot."""
 
-    def __init__(self, slots: int = 8, max_len: int = 256):
+    def __init__(self, slots: int = 8, max_len: int = 256,
+                 speculative: bool = False, spec_k: int = 3):
         import jax
 
         from kubetorch_tpu.models.llama import LlamaConfig, llama_init
-        from kubetorch_tpu.serve import GenerationEngine
+        from kubetorch_tpu.serve import GenerationEngine, SpeculativeEngine
 
         cfg = LlamaConfig.tiny(max_seq_len=max_len, attn_impl="auto")
         params = llama_init(jax.random.PRNGKey(0), cfg)
-        self.engine = GenerationEngine(
-            params, cfg, slots=slots, max_len=max_len,
-            prefill_buckets=(16, 64, 128)).start()
+        if speculative:
+            # a 4x-smaller draft proposes spec_k tokens per round for EVERY
+            # slot; the target verifies the whole grid in one forward —
+            # same exactness contract, 1..k+1 tokens per target stream
+            dcfg = LlamaConfig.tiny(dim=32, n_layers=1, n_heads=2,
+                                    n_kv_heads=1, ffn_dim=64,
+                                    max_seq_len=max_len, attn_impl="auto")
+            draft = llama_init(jax.random.PRNGKey(7), dcfg)
+            self.engine = SpeculativeEngine(
+                params, cfg, draft, dcfg, spec_k=spec_k, slots=slots,
+                max_len=max_len, prefill_buckets=(16, 64, 128)).start()
+        else:
+            self.engine = GenerationEngine(
+                params, cfg, slots=slots, max_len=max_len,
+                prefill_buckets=(16, 64, 128)).start()
 
     def __kt_warmup__(self):
         # pay both compiles (bucketed prefill + the grid decode step)
@@ -46,9 +59,13 @@ class BatchingGenerator:
 
     def stats(self):
         s = self.engine.stats()
-        return {"active": s.active, "queued": s.queued,
-                "finished": s.finished_total,
-                "tokens_per_sec": round(s.tokens_per_sec, 1)}
+        out = {"active": s.active, "queued": s.queued,
+               "finished": s.finished_total,
+               "tokens_per_sec": round(s.tokens_per_sec, 1)}
+        spec = getattr(self.engine, "spec_stats", None)
+        if spec is not None:
+            out["acceptance_rate"] = round(spec.acceptance_rate, 3)
+        return out
 
 
 def main():
@@ -76,6 +93,20 @@ def main():
         print("engine:", svc.stats())
     finally:
         svc.teardown()
+
+    # same service, speculative: a draft model rides along and the grid
+    # emits 1..k+1 tokens per target forward — outputs stay bit-identical
+    spec = kt.cls(BatchingGenerator, name="spec-generator",
+                  init_kwargs={"slots": 4, "max_len": 256,
+                               "speculative": True})
+    spec.to(kt.Compute(cpus=1))
+    try:
+        toks = spec.generate([1, 2, 3], max_new_tokens=12)
+        stats = spec.stats()
+        print(f"speculative: {len(toks)} tokens, "
+              f"acceptance={stats['acceptance_rate']}")
+    finally:
+        spec.teardown()
 
 
 if __name__ == "__main__":

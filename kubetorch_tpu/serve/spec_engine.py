@@ -163,6 +163,18 @@ class SpeculativeEngine(GenerationEngine):
         self._slot_pending: List[List[int]] = [[] for _ in range(self.slots)]
         self.spec_stats = SpecStats()
 
+    # -- unsupported registrations refused at REGISTRATION time, before
+    # they commit device memory no request could ever use ------------------
+
+    def register_adapter(self, adapters, lora_cfg) -> int:
+        raise ValueError("adapter serving is not supported with "
+                         "speculation yet — use GenerationEngine")
+
+    def register_prefix(self, tokens: Sequence[int],
+                        adapter_id: Optional[int] = None) -> int:
+        raise ValueError("prefix caching is not supported with "
+                         "speculation yet — use GenerationEngine")
+
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,

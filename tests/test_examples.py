@@ -70,6 +70,7 @@ def test_continuous_batching_service_example(capsys):
         for i in range(4):
             assert f"request {i}: 12 tokens" in out
         assert "'finished': 5" in out       # 4 calls + 1 warmup
+        assert "speculative: 12 tokens, acceptance=" in out
     finally:
         shutdown_local_controller()
         reset_config()

@@ -153,6 +153,11 @@ class TestValidation:
         with pytest.raises(ValueError, match="verify window"):
             # 8 + 20 + 5 > 32: the verify window headroom must be reserved
             eng.submit([1] * 8, max_new_tokens=20)
+        # refused at REGISTRATION, before any device memory is committed
+        with pytest.raises(ValueError, match="GenerationEngine"):
+            eng.register_prefix([1, 2, 3])
+        with pytest.raises(ValueError, match="GenerationEngine"):
+            eng.register_adapter({"layers": {}}, None)
 
     def test_background_loop(self, models):
         target, cfg, draft, dcfg = models
@@ -164,3 +169,40 @@ class TestValidation:
         finally:
             eng.stop()
         assert got == want
+
+
+class TestFuzz:
+    def test_randomized_interleavings_match_solo(self, models):
+        """Random prompts/lengths/budgets/k, submissions staggered across
+        running rounds — every request must still equal its solo run.
+        Catches ledger bugs no hand-written interleaving thinks of."""
+        import random
+
+        target, cfg, draft, dcfg = models
+        rng = random.Random(0xC0FFEE)
+        for trial in range(3):
+            k = rng.choice([1, 2, 3, 4])
+            slots = rng.choice([1, 2, 3])
+            eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=k,
+                                    slots=slots, max_len=64,
+                                    prefill_buckets=(4, 8))
+            reqs = []
+            n_reqs = rng.randint(2, 5)
+            for _ in range(n_reqs):
+                prompt = [rng.randrange(cfg.vocab_size)
+                          for _ in range(rng.randint(1, 10))]
+                n = rng.randint(1, 12)
+                reqs.append((prompt, n))
+            handles = []
+            it = iter(reqs)
+            # stagger submissions between rounds
+            for prompt, n in [next(it)]:
+                handles.append(eng.submit(prompt, max_new_tokens=n))
+            for prompt, n in it:
+                eng.step()
+                handles.append(eng.submit(prompt, max_new_tokens=n))
+            _drain(eng)
+            for (prompt, n), h in zip(reqs, handles):
+                want = _solo(target, cfg, prompt, n)
+                assert h.result(timeout=0) == want, (trial, k, slots,
+                                                     prompt, n)

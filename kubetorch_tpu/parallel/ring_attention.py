@@ -133,9 +133,166 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh, *,
 
     fn = functools.partial(ring_attention, axis_name=context_axis,
                            causal=causal, scale=scale)
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map as _sm
-        shard_map = _sm
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return _shard_map()(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# context-parallel DECODE: one new token per slot against a cache whose
+# sequence axis is sharded over the context mesh axis (long-context serving)
+# ---------------------------------------------------------------------------
+
+
+def sp_decode_attention(q, ck, cv, pos, *, axis_name: str,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Per-shard body: decode attention over THIS shard's cache rows, then
+    one online-softmax combine across the context axis — the full-sequence
+    result without any device ever holding more than 1/C of the cache (and
+    without the all-gather GSPMD would insert around a dense einsum).
+
+    q (B, NH, Hd) replicated over ``axis_name``; ck/cv (B, S_local, NKV,
+    Hd) this shard's rows; pos (B,) GLOBAL frontier per slot. Rounding
+    matches the engine's einsum reference (probs cast to the cache dtype
+    before the PV dot); the split softmax itself combines in fp32."""
+    b, nh, hd = q.shape
+    s_local, nkv = ck.shape[1], ck.shape[2]
+    group = nh // nkv
+    if scale is None:
+        scale = hd ** -0.5
+    offset = lax.axis_index(axis_name) * s_local
+    qg = q.reshape(b, nkv, group, hd)
+    s = jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32) * scale
+    cols = offset + jnp.arange(s_local)
+    mask = cols[None, :] <= pos[:, None]                     # (B, S_local)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), -1e29)
+    p = jnp.exp(s - m)
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)                   # (b,k,g,1)
+    acc = jnp.einsum("bkgs,bskh->bkgh", p.astype(cv.dtype),
+                     cv).astype(jnp.float32)
+    m_g = lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)                                  # (b,k,g,1)
+    l_g = lax.psum(l * corr, axis_name)
+    acc_g = lax.psum(acc * corr, axis_name)
+    out = acc_g / jnp.where(l_g == 0.0, 1.0, l_g)
+    return out.reshape(b, nh, hd).astype(q.dtype)
+
+
+def sp_decode_attention_quant(q, kq, ks, vq, vs, pos, *, axis_name: str,
+                              scale: Optional[float] = None) -> jax.Array:
+    """Per-shard body over an int8 cache shard (``serve.kv_quant``): the
+    same split-softmax combine as :func:`sp_decode_attention` with the row
+    scales folded in (logits columns ·ks, probs ·vs; all fp32) — so the
+    int8 KV cache and context sharding COMPOSE: 1/(2C) of the fp cache
+    bytes per chip."""
+    b, nh, hd = q.shape
+    s_local, nkv = kq.shape[1], kq.shape[2]
+    group = nh // nkv
+    if scale is None:
+        scale = hd ** -0.5
+    offset = lax.axis_index(axis_name) * s_local
+    qg = q.reshape(b, nkv, group, hd).astype(jnp.float32)
+    s = jnp.einsum("bkgh,bskh->bkgs", qg,
+                   kq.astype(jnp.float32)) * scale
+    s = s * ks.transpose(0, 2, 1)[:, :, None, :]             # (B,NKV,1,S)
+    cols = offset + jnp.arange(s_local)
+    mask = cols[None, :] <= pos[:, None]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), -1e29)
+    p = jnp.exp(s - m)
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p * vs.transpose(0, 2, 1)[:, :, None, :]
+    acc = jnp.einsum("bkgs,bskh->bkgh", p, vq.astype(jnp.float32))
+    m_g = lax.pmax(m, axis_name)
+    corr = jnp.exp(m - m_g)
+    l_g = lax.psum(l * corr, axis_name)
+    acc_g = lax.psum(acc * corr, axis_name)
+    out = acc_g / jnp.where(l_g == 0.0, 1.0, l_g)
+    return out.reshape(b, nh, hd).astype(q.dtype)
+
+
+def _sp_decode_specs(mesh, batch_axes, context_axis, head_axis):
+    """(q_spec, kv_spec, scale_spec, pos_spec) for the decode shard_maps —
+    one builder so the fp and quant wrappers can't drift."""
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import live_axes
+    live = live_axes(mesh)
+    if context_axis not in live:
+        raise ValueError("sp decode requires a live "
+                         f"{context_axis!r} mesh axis (callers gate on it "
+                         "via sp_decode_supported)")
+    ba = tuple(a for a in batch_axes if a in live)
+    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    ha = head_axis if head_axis in live else None
+    return (P(ba, ha, None), P(ba, context_axis, ha, None),
+            P(ba, context_axis, ha), P(ba))
+
+
+def sp_decode_supported(mesh, b: int, s: int, nkv: int, nh: int, *,
+                        batch_axes=("dcn", "data", "fsdp"),
+                        context_axis: str = "context",
+                        head_axis: str = "tensor") -> bool:
+    """Can the sp decode path partition these shapes evenly? shard_map has
+    no GSPMD-style padding: every named dim must divide by its axis. When
+    this says no, callers fall back to the dense path and let GSPMD handle
+    layout (correct, just without the memory split)."""
+    import math
+
+    from .mesh import live_axes
+    live = live_axes(mesh)
+    if live.get(context_axis, 1) <= 1:
+        return False
+    if s % live[context_axis]:
+        return False
+    bprod = math.prod(live.get(a, 1) for a in batch_axes)
+    if b % bprod:
+        return False
+    hsz = live.get(head_axis, 1)
+    return nkv % hsz == 0 and nh % hsz == 0
+
+
+def _shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def sp_decode_attention_sharded(q, ck, cv, pos, mesh, *,
+                                scale: Optional[float] = None,
+                                batch_axes=("dcn", "data", "fsdp"),
+                                context_axis: str = "context",
+                                head_axis: str = "tensor") -> jax.Array:
+    """GSPMD wrapper for the engine's decode step: cache (B, S, NKV, Hd)
+    sharded batch×context×heads, q (B, NH, Hd) batch×heads, pos (B,)
+    batch. shard_map pins those layouts, so jit KEEPS the cache
+    context-sharded across steps instead of gathering it. Callers gate on
+    :func:`sp_decode_supported`."""
+    q_spec, kv_spec, _, pos_spec = _sp_decode_specs(
+        mesh, batch_axes, context_axis, head_axis)
+    fn = functools.partial(sp_decode_attention, axis_name=context_axis,
+                           scale=scale)
+    return _shard_map()(fn, mesh=mesh,
+                        in_specs=(q_spec, kv_spec, kv_spec, pos_spec),
+                        out_specs=q_spec, check_vma=False)(q, ck, cv, pos)
+
+
+def sp_decode_attention_quant_sharded(q, kq, ks, vq, vs, pos, mesh, *,
+                                      scale: Optional[float] = None,
+                                      batch_axes=("dcn", "data", "fsdp"),
+                                      context_axis: str = "context",
+                                      head_axis: str = "tensor") -> jax.Array:
+    """int8-cache variant of :func:`sp_decode_attention_sharded`: values
+    int8 (B, S, NKV, Hd) + per-row scales (B, S, NKV), both sharded over
+    batch×context×heads."""
+    q_spec, kv_spec, sc_spec, pos_spec = _sp_decode_specs(
+        mesh, batch_axes, context_axis, head_axis)
+    fn = functools.partial(sp_decode_attention_quant,
+                           axis_name=context_axis, scale=scale)
+    return _shard_map()(
+        fn, mesh=mesh,
+        in_specs=(q_spec, kv_spec, sc_spec, kv_spec, sc_spec, pos_spec),
+        out_specs=q_spec, check_vma=False)(q, kq, ks, vq, vs, pos)

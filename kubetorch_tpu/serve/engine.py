@@ -82,6 +82,59 @@ def _decode_kernel_wanted() -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _cache_shardings(cache):
+    """NamedSharding pytree for the grid cache under the ambient mesh, or
+    None off-mesh: slots over the batch axes, the SEQUENCE dim over
+    ``context`` (long-context serving: 1/C of the cache per chip), heads
+    over ``tensor``. Without the explicit constraint GSPMD is free to
+    replicate the scan-carried cache even though the attention shard_map
+    consumes it sharded — correct, but forfeiting the memory split."""
+    from ..parallel.mesh_context import current_mesh
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import live_axes
+    live = live_axes(mesh)
+    if not live:
+        return None
+    import math
+    ba_all = tuple(a for a in ("dcn", "data", "fsdp") if a in live)
+
+    def fit(axes, dim):
+        """Largest prefix of ``axes`` whose total size divides ``dim`` —
+        an explicit sharding must divide evenly (GSPMD pads on its own,
+        device_put does not)."""
+        while axes and dim % math.prod(live[a] for a in axes):
+            axes = axes[:-1]
+        return axes
+
+    def leaf_sharding(x):
+        # values (L, B, S, NKV, Hd); quant scales (L, B, S, NKV)
+        ba = fit(ba_all, x.shape[1])
+        ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+        ctx = "context" if ("context" in live
+                            and x.shape[2] % live["context"] == 0) else None
+        tp = "tensor" if ("tensor" in live
+                          and x.shape[3] % live["tensor"] == 0) else None
+        spec = (P(None, ba, ctx, tp, None) if x.ndim == 5
+                else P(None, ba, ctx, tp))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map(leaf_sharding, cache)
+
+
+def _constrain_cache(cache):
+    """In-jit layout pin (trace-time ambient mesh, like the MoE gate)."""
+    sh = _cache_shardings(cache)
+    if sh is None:
+        return cache
+    return jax.tree_util.tree_map(jax.lax.with_sharding_constraint,
+                                  cache, sh)
+
+
 def _rope_slot(x: jax.Array, freqs: jax.Array) -> jax.Array:
     """RoPE with a PER-SLOT rotation: x (B, N, Hd), freqs (B, Hd/2) complex.
 
@@ -118,7 +171,22 @@ def _decode_layer(cfg, x, lw, ck, cv, pos, freqs, lora=None):
     ck = ck.at[bi, pos].set(k.astype(ck.dtype))
     cv = cv.at[bi, pos].set(v.astype(cv.dtype))
 
-    if _decode_kernel_wanted():
+    from ..parallel.mesh_context import current_mesh
+    from ..parallel.ring_attention import (sp_decode_attention_sharded,
+                                           sp_decode_supported)
+    mesh = current_mesh()
+    if mesh is not None and sp_decode_supported(mesh, b, ck.shape[1],
+                                                nkv, nh):
+        # long-context serving: the cache's sequence axis is sharded over
+        # the context mesh axis; local attention + one online-softmax
+        # combine beats the all-gather GSPMD would otherwise insert (and
+        # the Pallas kernel, which needs all rows on one chip). Trace-time
+        # gate like the MoE gather (mesh fixed per engine — captured at
+        # construction and re-installed on whichever thread traces);
+        # shapes that don't divide the mesh fall back to the dense path.
+        attn = sp_decode_attention_sharded(
+            q, ck, cv, pos, mesh, scale=hd ** -0.5).reshape(b, 1, nh * hd)
+    elif _decode_kernel_wanted():
         # fused flash-decode: streams K/V tiles, skips tiles past each
         # slot's frontier entirely (ops/decode_attention.py)
         from ..ops.decode_attention import decode_attention
@@ -166,7 +234,18 @@ def _decode_layer_quant(cfg, x, lw, kq, ks, vq, vs, pos, freqs, lora=None):
     vq = vq.at[bi, pos].set(v_row)
     vs = vs.at[bi, pos].set(vs_row)
 
-    if _decode_kernel_wanted():
+    from ..parallel.mesh_context import current_mesh
+    from ..parallel.ring_attention import (
+        sp_decode_attention_quant_sharded, sp_decode_supported)
+    mesh = current_mesh()
+    if mesh is not None and sp_decode_supported(mesh, b, kq.shape[1],
+                                                nkv, nh):
+        # int8 cache × context sharding compose: 1/(2C) of the fp cache
+        # bytes per chip, scales folded into the per-shard combine
+        attn = sp_decode_attention_quant_sharded(
+            q, kq, ks, vq, vs, pos, mesh,
+            scale=hd ** -0.5).reshape(b, 1, nh * hd).astype(x.dtype)
+    elif _decode_kernel_wanted():
         from ..ops.decode_attention import decode_attention_quant
         attn = decode_attention_quant(
             q, kq, ks, vq, vs, pos,
@@ -252,7 +331,7 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
     nxt = _sample_slots(logits, rng, temps, top_k)
-    return new_cache, nxt
+    return _constrain_cache(new_cache), nxt
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
@@ -356,15 +435,15 @@ def _splice_slot(cache, slot, k_new, v_new):
         vq, vs = quantize_rows(v_new)
         start = (0, slot, 0, 0, 0)
         sstart = (0, slot, 0, 0)
-        return QuantKVCache(
+        return _constrain_cache(QuantKVCache(
             kq=lax.dynamic_update_slice(cache.kq, kq, start),
             ks=lax.dynamic_update_slice(cache.ks, ks, sstart),
             vq=lax.dynamic_update_slice(cache.vq, vq, start),
-            vs=lax.dynamic_update_slice(cache.vs, vs, sstart))
+            vs=lax.dynamic_update_slice(cache.vs, vs, sstart)))
     start = (0, slot, 0, 0, 0)
-    return KVCache(
+    return _constrain_cache(KVCache(
         k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
-        v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start))
+        v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start)))
 
 
 # ---------------------------------------------------------------------------
@@ -484,6 +563,13 @@ class GenerationEngine:
         self.temperature = float(temperature)
         self.top_k = top_k
         self.quantize_kv = bool(quantize_kv)
+        # the ambient mesh is THREAD-LOCAL trace state: capture it at
+        # construction and re-install it around every trace site, or an
+        # engine driven by its background loop thread (start()/generate(),
+        # the kt.cls deployment mode) would silently lose the mesh-aware
+        # dispatch (context-sharded decode, MoE gather gating)
+        from ..parallel.mesh_context import current_mesh
+        self._mesh = current_mesh()
         self._buckets = sorted({min(b, self.max_len)
                                 for b in prefill_buckets} | {self.max_len})
         if self.quantize_kv:
@@ -494,6 +580,11 @@ class GenerationEngine:
             self._cache = init_quant_cache(cfg, self.slots, self.max_len)
         else:
             self._cache = init_cache(cfg, self.slots, self.max_len)
+        shardings = _cache_shardings(self._cache)
+        if shardings is not None:
+            # grid lives sharded from step 0 (slots over data axes, the
+            # sequence dim over context, heads over tensor)
+            self._cache = jax.device_put(self._cache, shardings)
         self._pos = np.zeros(self.slots, np.int32)     # next write position
         self._tok = np.zeros(self.slots, np.int32)     # next decode input
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
@@ -685,6 +776,10 @@ class GenerationEngine:
         if len(tokens) >= self.max_len:
             raise ValueError(f"prefix ({len(tokens)}) must leave room under "
                              f"max_len ({self.max_len})")
+        with self._mesh_scope():
+            return self._register_prefix(tokens, adapter_id)
+
+    def _register_prefix(self, tokens, adapter_id) -> int:
         t = len(tokens)
         adapter, _ = self._resolve_adapter(adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
@@ -720,6 +815,14 @@ class GenerationEngine:
         return self._prefixes.pop(prefix_id, None) is not None
 
     # -- engine loop --------------------------------------------------------
+
+    def _mesh_scope(self):
+        """use_mesh(self._mesh) on the CURRENT thread (no-op off-mesh)."""
+        import contextlib
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        from ..parallel.mesh_context import use_mesh
+        return use_mesh(self._mesh)
 
     def _next_key(self) -> jax.Array:
         # under _lock: register_prefix runs on caller threads while the
@@ -826,6 +929,10 @@ class GenerationEngine:
         slot. Returns the remaining work — active slots plus queued
         requests — so ``while eng.step(): ...`` runs the backlog dry even
         when a step retires every active slot with the queue non-empty."""
+        with self._mesh_scope():
+            return self._step_once()
+
+    def _step_once(self) -> int:
         self._admit()
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         if active:

@@ -231,10 +231,12 @@ class SpeculativeEngine(GenerationEngine):
     # -- the speculative round ----------------------------------------------
 
     def step(self) -> int:
-        self._admit()
-        active = [i for i, r in enumerate(self._slot_req) if r is not None]
-        if active:
-            self._round(active)
+        with self._mesh_scope():
+            self._admit()
+            active = [i for i, r in enumerate(self._slot_req)
+                      if r is not None]
+            if active:
+                self._round(active)
         with self._lock:
             queued = len(self._pending)
         return sum(r is not None for r in self._slot_req) + queued

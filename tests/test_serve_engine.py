@@ -619,3 +619,153 @@ class TestMultiLora:
         while eng.step():
             pass
         assert len(h.result(timeout=0)) == 4
+
+
+class TestContextShardedServing:
+    """Long-context serving: the cache's sequence axis sharded over the
+    ``context`` mesh axis, decode via local attention + one online-softmax
+    combine (parallel/ring_attention.sp_decode_attention) — no chip ever
+    holds more than 1/C of the cache."""
+
+    def test_sp_decode_op_matches_einsum(self, cpu_mesh_devices):
+        """Direct op check: sharded decode == the unsharded masked-einsum
+        reference, across frontier positions including shard boundaries."""
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.ring_attention import (
+            sp_decode_attention_sharded)
+
+        b, nh, nkv, hd, s = 4, 4, 2, 32, 64
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (b, nh, hd), jnp.float32)
+        ck = jax.random.normal(jax.random.PRNGKey(1), (b, s, nkv, hd),
+                               jnp.float32)
+        cv = jax.random.normal(jax.random.PRNGKey(2), (b, s, nkv, hd),
+                               jnp.float32)
+        # frontiers: inside shard 0, exactly at a shard boundary, deep in
+        # the last shard, and row 0
+        pos = jnp.array([5, 15, 63, 0], jnp.int32)
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        got = jax.jit(lambda *a: sp_decode_attention_sharded(
+            *a, mesh, scale=hd ** -0.5))(q, ck, cv, pos)
+
+        group = nh // nkv
+        qg = q.reshape(b, nkv, group, hd)
+        logits = (jnp.einsum("bkgh,bskh->bkgs", qg, ck)
+                  .astype(jnp.float32) * (hd ** -0.5))
+        mask = jnp.arange(s)[None, :] <= pos[:, None]
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+        want = jnp.einsum("bkgs,bskh->bkgh", probs, cv).reshape(b, nh, hd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_engine_matches_under_context_sharded_mesh(self,
+                                                      cpu_mesh_devices):
+        """The engine on a data×context mesh emits the same greedy tokens
+        as the single-device run — the serving-side long-context story."""
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.mesh_context import use_mesh
+        from kubetorch_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        prompts = [[5, 17, 42], [9, 9, 9, 9]]
+        want = [_reference_tokens(params, cfg, p, 6) for p in prompts]
+
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        sharded = shard_pytree(params, LLAMA_RULES, mesh)
+        with use_mesh(mesh):
+            eng = GenerationEngine(sharded, cfg, slots=4, max_len=32,
+                                   prefill_buckets=(4,))
+            handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            while eng.step():
+                pass
+        for h, w in zip(handles, want):
+            assert h.result(timeout=0) == w
+
+    def test_background_loop_keeps_context_sharding(self, cpu_mesh_devices):
+        """The ambient mesh is THREAD-LOCAL: an engine built under
+        use_mesh but driven by its background loop thread (start()/
+        generate() — the kt.cls deployment mode) must still trace the
+        context-sharded decode path, not silently fall back."""
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.mesh_context import use_mesh
+        from kubetorch_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        want = _reference_tokens(params, cfg, [5, 17, 42], 6)
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        sharded = shard_pytree(params, LLAMA_RULES, mesh)
+        with use_mesh(mesh):
+            eng = GenerationEngine(sharded, cfg, slots=2, max_len=32,
+                                   prefill_buckets=(4,))
+        # OUTSIDE the mesh context, on the loop thread:
+        eng.start()
+        try:
+            got = eng.generate([5, 17, 42], 6)
+        finally:
+            eng.stop()
+        assert got == want
+        spec = str(eng._cache.k.sharding.spec)
+        assert "context" in spec, spec
+        # really 1/8 of the grid per chip
+        leaf = eng._cache.k
+        assert leaf.addressable_shards[0].data.nbytes * 8 == leaf.nbytes
+
+    def test_non_dividing_shapes_fall_back_densely(self, cpu_mesh_devices):
+        """max_len not divisible by the context axis: the sp path must
+        step aside (shard_map cannot pad) and serving stays exact through
+        the dense path."""
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.mesh_context import use_mesh
+        from kubetorch_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        want = _reference_tokens(params, cfg, [5, 17, 42], 6)
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        sharded = shard_pytree(params, LLAMA_RULES, mesh)
+        with use_mesh(mesh):
+            eng = GenerationEngine(sharded, cfg, slots=2, max_len=30,
+                                   prefill_buckets=(4,))   # 30 % 4 != 0
+            h = eng.submit([5, 17, 42], max_new_tokens=6)
+            while eng.step():
+                pass
+        assert h.result(timeout=0) == want
+
+    def test_quantized_context_sharded(self, cpu_mesh_devices):
+        """int8 KV cache × context sharding compose: the quant sp combine
+        serves exactly what the single-device quant engine serves."""
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.mesh_context import use_mesh
+        from kubetorch_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        solo = GenerationEngine(params, cfg, slots=2, max_len=32,
+                                prefill_buckets=(4,), quantize_kv=True)
+        hs = solo.submit([5, 17, 42], max_new_tokens=6)
+        while solo.step():
+            pass
+        want = hs.result(timeout=0)
+
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        sharded = shard_pytree(params, LLAMA_RULES, mesh)
+        with use_mesh(mesh):
+            eng = GenerationEngine(sharded, cfg, slots=2, max_len=32,
+                                   prefill_buckets=(4,), quantize_kv=True)
+            h = eng.submit([5, 17, 42], max_new_tokens=6)
+            while eng.step():
+                pass
+        assert h.result(timeout=0) == want
+        assert "context" in str(eng._cache.kq.sharding.spec)

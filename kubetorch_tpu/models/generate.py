@@ -90,9 +90,42 @@ def _flash_prefill_wanted(cfg, t: int) -> bool:
     return shape_ok and jax.default_backend() == "tpu"
 
 
+# A from-zero prefill routes through ring attention instead of one-chip
+# flash when the ambient mesh has a live context axis and the prompt is
+# long enough to be worth sequence-sharding — below this, chunk overheads
+# beat the parallelism and short buckets stay on the single-chip kernels.
+RING_PREFILL_MIN_T = 512
+
+
+def _sp_prefill_impl(cfg, b: int, t: int) -> Optional[str]:
+    """Which sequence-sharded strategy a long from-zero prefill should
+    take: "ring"/"ulysses", or None for the single-chip kernels.
+    Honors ``cfg.attn_impl`` — "ulysses" routes through its all-to-all,
+    an explicit "xla"/"flash" is a deliberate single-chip choice this
+    gate must not override; "auto"/"ring" pick ring (the ICI-native
+    default, matching ``llama.attention``'s auto resolution)."""
+    if t < RING_PREFILL_MIN_T:
+        return None
+    impl = {"auto": "ring", "ring": "ring",
+            "ulysses": "ulysses"}.get(cfg.attn_impl)
+    if impl is None:
+        return None
+    from ..parallel.mesh_context import current_mesh
+    from ..parallel.ring_attention import sp_decode_supported
+    mesh = current_mesh()
+    # batch_axes=(): prefill runs B=1 — replicate over the data axes and
+    # shard the SEQUENCE; the divisibility rules are shard_map's
+    if (mesh is None
+            or not sp_decode_supported(mesh, b, t, cfg.n_kv_heads,
+                                       cfg.n_heads, batch_axes=())):
+        return None
+    return impl
+
+
 def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
                 flash_prefill: bool = False, token_mask=None,
-                keep_capacity=None, lora=None, moe_no_drop: bool = False):
+                keep_capacity=None, lora=None, moe_no_drop: bool = False,
+                causal_prefill: bool = False):
     """One transformer layer over T new tokens, updating this layer's cache.
     ``lw`` may carry int8-quantized leaves (``models.quant``) — dequantized
     here, inside the scan body, so only the current layer materializes in
@@ -119,7 +152,22 @@ def _layer_step(cfg, x, lw, layer_cache_k, layer_cache_v, q_pos, freqs_full,
     layer_cache_v = lax.dynamic_update_slice_in_dim(
         layer_cache_v, v.astype(layer_cache_v.dtype), q_pos[0], axis=1)
 
-    if flash_prefill:
+    sp_impl = _sp_prefill_impl(cfg, b, t) if causal_prefill else None
+    if sp_impl is not None:
+        # long-prompt prefill on a context mesh: sequence-sharded
+        # attention — no chip holds the full (T, T) attention problem
+        from ..parallel.mesh_context import current_mesh
+        if sp_impl == "ulysses":
+            from ..parallel.ulysses import ulysses_attention_sharded
+            attn = ulysses_attention_sharded(
+                q, k, v, current_mesh(), causal=True,
+                scale=cfg.head_dim ** -0.5, batch_axes=())
+        else:
+            from ..parallel.ring_attention import ring_attention_sharded
+            attn = ring_attention_sharded(
+                q, k, v, current_mesh(), causal=True,
+                scale=cfg.head_dim ** -0.5, batch_axes=())
+    elif flash_prefill:
         from ..ops.attention import flash_attention
         attn = flash_attention(q, k, v, causal=True,
                                scale=cfg.head_dim ** -0.5)
@@ -180,14 +228,15 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
     freqs_full = rope_freqs(cfg, cache.k.shape[2])
     q_pos = start_pos + jnp.arange(t)
     # static decision: only a from-zero prefill is pure causal self-attention
-    flash_prefill = (isinstance(start_pos, int) and start_pos == 0
-                     and _flash_prefill_wanted(cfg, t))
+    causal_prefill = isinstance(start_pos, int) and start_pos == 0
+    flash_prefill = causal_prefill and _flash_prefill_wanted(cfg, t)
 
     def body(carry, layer_inputs):
         h = carry
         lw, ck, cv = layer_inputs
         h, ck, cv = _layer_step(cfg, h, lw, ck, cv, q_pos, freqs_full,
-                                flash_prefill=flash_prefill)
+                                flash_prefill=flash_prefill,
+                                causal_prefill=causal_prefill)
         return h, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))

@@ -162,6 +162,27 @@ def live_axes(mesh) -> Dict[str, int]:
     return {n: s for n, s in zip(mesh.axis_names, mesh.devices.shape) if s > 1}
 
 
+def normalize_batch_axes(live: Dict[str, int],
+                         batch_axes: Sequence[str] = ("dcn", "data", "fsdp")):
+    """Batch-dim PartitionSpec entry from the live axes: a tuple when
+    several batch axes shard it, the bare name for one, None for none —
+    the one normalization every shard_map spec builder and cache-sharding
+    site shares (drift here desynchronizes specs from stored layouts and
+    forces reshards)."""
+    ba = tuple(a for a in batch_axes if a in live)
+    return ba if len(ba) > 1 else (ba[0] if ba else None)
+
+
+def shard_map_fn():
+    """jax.shard_map across the JAX versions this image may carry (the
+    experimental path is the fallback)."""
+    import jax
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
 def best_mesh_for(n_devices: int, prefer: str = "fsdp") -> MeshSpec:
     """A sensible default mesh when the user gives none: everything on one
     axis (fsdp by default — params shard, no user model change needed)."""

@@ -115,10 +115,9 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh, *,
     batch×context×heads; runs the ring per context-shard via shard_map."""
     from jax.sharding import PartitionSpec as P
 
-    from .mesh import live_axes
+    from .mesh import live_axes, normalize_batch_axes
     live = live_axes(mesh)
-    ba = tuple(a for a in batch_axes if a in live)
-    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    ba = normalize_batch_axes(live, batch_axes)
     ha = head_axis if head_axis in live else None
     spec = P(ba, context_axis if context_axis in live else None, ha, None)
 
@@ -218,14 +217,13 @@ def _sp_decode_specs(mesh, batch_axes, context_axis, head_axis):
     one builder so the fp and quant wrappers can't drift."""
     from jax.sharding import PartitionSpec as P
 
-    from .mesh import live_axes
+    from .mesh import live_axes, normalize_batch_axes
     live = live_axes(mesh)
     if context_axis not in live:
         raise ValueError("sp decode requires a live "
                          f"{context_axis!r} mesh axis (callers gate on it "
                          "via sp_decode_supported)")
-    ba = tuple(a for a in batch_axes if a in live)
-    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    ba = normalize_batch_axes(live, batch_axes)
     ha = head_axis if head_axis in live else None
     return (P(ba, ha, None), P(ba, context_axis, ha, None),
             P(ba, context_axis, ha), P(ba))
@@ -255,10 +253,8 @@ def sp_decode_supported(mesh, b: int, s: int, nkv: int, nh: int, *,
 
 
 def _shard_map():
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        from jax.experimental.shard_map import shard_map as sm
-    return sm
+    from .mesh import shard_map_fn
+    return shard_map_fn()
 
 
 def sp_decode_attention_sharded(q, ck, cv, pos, mesh, *,

@@ -90,8 +90,8 @@ def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
         from ..models.llama import _xla_attention
         return _xla_attention(q, k, v, scale or q.shape[-1] ** -0.5,
                               causal=causal)
-    ba = tuple(a for a in batch_axes if a in live)
-    ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+    from .mesh import normalize_batch_axes
+    ba = normalize_batch_axes(live, batch_axes)
     # preserve head sharding over tensor only when the ulysses degree still
     # divides the LOCAL head counts; otherwise replicate heads (the pre-TP
     # behavior) instead of crashing GQA configs
@@ -105,8 +105,6 @@ def ulysses_attention_sharded(q, k, v, mesh, *, causal: bool = True,
 
     fn = functools.partial(ulysses_attention, axis_name=context_axis,
                            causal=causal, scale=scale)
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    from .mesh import shard_map_fn
+    return shard_map_fn()(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_vma=False)(q, k, v)

@@ -101,6 +101,8 @@ def _cache_shardings(cache):
     if not live:
         return None
     import math
+
+    from ..parallel.mesh import normalize_batch_axes
     ba_all = tuple(a for a in ("dcn", "data", "fsdp") if a in live)
 
     def fit(axes, dim):
@@ -113,8 +115,7 @@ def _cache_shardings(cache):
 
     def leaf_sharding(x):
         # values (L, B, S, NKV, Hd); quant scales (L, B, S, NKV)
-        ba = fit(ba_all, x.shape[1])
-        ba = ba if len(ba) > 1 else (ba[0] if ba else None)
+        ba = normalize_batch_axes(live, fit(ba_all, x.shape[1]))
         ctx = "context" if ("context" in live
                             and x.shape[2] % live["context"] == 0) else None
         tp = "tensor" if ("tensor" in live
@@ -362,7 +363,8 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
         lora = (ad_l, lora_scale) if adapter else None
         h, ck, cv = _layer_step(cfg, carry, lw, ck, cv, q_pos, freqs_full,
                                 flash_prefill=flash, token_mask=token_mask,
-                                keep_capacity=keep_capacity, lora=lora)
+                                keep_capacity=keep_capacity, lora=lora,
+                                causal_prefill=True)
         return h, (ck, cv)
 
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v,

@@ -769,3 +769,58 @@ class TestContextShardedServing:
                 pass
         assert h.result(timeout=0) == want
         assert "context" in str(eng._cache.kq.sharding.spec)
+
+    def test_long_prompt_ring_prefill(self, cpu_mesh_devices):
+        """Prompts at/above RING_PREFILL_MIN_T prefill via sequence-sharded
+        ring attention on a context mesh — no chip holds the full (T, T)
+        attention problem — and serving stays exact vs the single-device
+        engine. An explicit attn_impl="xla" is a single-chip choice the
+        gate must honor."""
+        from kubetorch_tpu.models import generate as gen_mod
+        from kubetorch_tpu.parallel.mesh import build_mesh
+        from kubetorch_tpu.parallel.mesh_context import use_mesh
+        from kubetorch_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+
+        cfg = LlamaConfig.tiny(attn_impl="auto", dtype=jnp.float32,
+                               remat=False)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        prompt = [int(x) for x in
+                  np.random.RandomState(3).randint(
+                      1, cfg.vocab_size, gen_mod.RING_PREFILL_MIN_T)]
+
+        solo = GenerationEngine(params, cfg, slots=1, max_len=520,
+                                prefill_buckets=(512,))
+        h = solo.submit(prompt, max_new_tokens=6)
+        while solo.step():
+            pass
+        want = h.result(timeout=0)
+
+        mesh = build_mesh({"data": 2, "context": 4},
+                          devices=cpu_mesh_devices[:8])
+        sharded = shard_pytree(params, LLAMA_RULES, mesh)
+        # spy at TRACE time: the ring path must actually engage, not
+        # silently fall back to the dense prefill
+        import kubetorch_tpu.parallel.ring_attention as ring_mod
+        traced = {}
+        orig = ring_mod.ring_attention_sharded
+
+        def spy(*a, **kw):
+            traced["ring"] = True
+            return orig(*a, **kw)
+
+        ring_mod.ring_attention_sharded = spy
+        try:
+            with use_mesh(mesh):
+                eng = GenerationEngine(sharded, cfg, slots=1, max_len=520,
+                                       prefill_buckets=(512,))
+                h = eng.submit(prompt, max_new_tokens=6)
+                while eng.step():
+                    pass
+        finally:
+            ring_mod.ring_attention_sharded = orig
+        assert traced.get("ring"), "ring prefill never traced"
+        assert h.result(timeout=0) == want
+        # explicit "xla" opts OUT of the sequence-sharded prefill
+        xcfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                                remat=False)
+        assert gen_mod._sp_prefill_impl(xcfg, 1, 512) is None

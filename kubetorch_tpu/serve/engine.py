@@ -1006,6 +1006,26 @@ class GenerationEngine:
             decode_steps=self._steps,
             tokens_per_sec=self._tokens / dt)
 
+    def __kt_metrics__(self) -> Dict[str, float]:
+        """Pod-scrape hook (``serving.process_worker`` — the
+        ``__kt_warmup__`` sibling): a deployed engine's live gauges land
+        on the pod's ``/metrics`` under ``kt_user_`` with no exporter
+        code. Cheap (host counters only); runs per 3s scrape."""
+        s = self.stats()
+        out = {"engine_slots": float(s.slots),
+               "engine_active": float(s.active),
+               "engine_queued": float(s.queued),
+               "engine_admitted_total": float(s.admitted_total),
+               "engine_finished_total": float(s.finished_total),
+               "engine_tokens_generated": float(s.tokens_generated),
+               "engine_decode_steps": float(s.decode_steps),
+               "engine_tokens_per_sec": float(s.tokens_per_sec)}
+        spec = getattr(self, "spec_stats", None)
+        if spec is not None:
+            out["engine_spec_rounds"] = float(spec.rounds)
+            out["engine_spec_acceptance_rate"] = float(spec.acceptance_rate)
+        return out
+
     # remote-service surface: a deployed engine (kt.cls) exposes a blocking
     # generate() so callers don't need the handle/iterator machinery
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,

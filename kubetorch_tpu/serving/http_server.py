@@ -30,6 +30,7 @@ import contextvars
 import hashlib
 import json
 import os
+import re
 import signal
 import socket
 import sys
@@ -351,6 +352,24 @@ async def metrics(request: web.Request) -> web.Response:
         # busy chip and a 3s-interval scraper must not block /health.
         **(await asyncio.to_thread(tpu_gauges)),
     }
+    # user gauges: rank 0's __kt_metrics__ hook (the __kt_warmup__ sibling)
+    # — serving state like the generation engine's tokens/s and slot
+    # occupancy, merged under kt_user_. Best-effort with a short cap: a
+    # stuck rank must not wedge the 3s scraper.
+    sup = state.supervisor
+    if (sup is not None and getattr(sup, "pool", None) is not None
+            and not getattr(sup, "warming", False)):
+        # warming gate: the worker loop doesn't poll its queue until the
+        # load+warmup window ends — submitting during it would stall every
+        # scrape for the full timeout AND backlog one stale op per scrape
+        try:
+            user = await asyncio.wait_for(sup.pool.user_metrics(0),
+                                          timeout=3.0)
+        except Exception:  # noqa: BLE001
+            user = {}
+        for k, v in (user or {}).items():
+            safe = re.sub(r"[^a-zA-Z0-9_]", "_", str(k))
+            lines[f"kt_user_{safe}"] = v
     extra = ("".join(f"{k} {v}\n" for k, v in lines.items())).encode()
     return web.Response(body=body + extra, content_type="text/plain")
 

@@ -111,7 +111,15 @@ class ProcessPool:
         from .http_server import request_id_var
         worker.submit({"req_id": req_id,
                        "request_id": request_id_var.get(""), **payload})
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            # a wedged worker never answers this req_id — drop the future
+            # or periodic submitters (the 3s user_metrics scrape) leak one
+            # registry entry per attempt for the pod's lifetime
+            with self._futures_lock:
+                self._futures.pop(req_id, None)
+            raise
 
     async def call(self, idx: int, method: Optional[str], args: list,
                    kwargs: dict, timeout: Optional[float] = None,
@@ -146,6 +154,12 @@ class ProcessPool:
         return await self._submit(idx, {"op": "profile",
                                         "duration_s": duration_s},
                                   timeout or duration_s + 60)
+
+    async def user_metrics(self, idx: int = 0,
+                           timeout: float = 5.0) -> Dict[str, float]:
+        """Rank ``idx``'s ``__kt_metrics__`` gauges ({} when undefined) —
+        merged into the pod /metrics scrape by the server."""
+        return await self._submit(idx, {"op": "user_metrics"}, timeout)
 
     async def call_all(self, method: Optional[str], args: list, kwargs: dict,
                        timeout: Optional[float] = None,

@@ -128,6 +128,9 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
             break
         if item.get("op") == "profile":
             task = asyncio.ensure_future(_handle_profile(item, response_q))
+        elif item.get("op") == "user_metrics":
+            task = asyncio.ensure_future(
+                _handle_user_metrics(item, target, response_q, executor))
         else:
             task = asyncio.ensure_future(
                 _handle(item, target, load_error, response_q, executor,
@@ -202,6 +205,35 @@ async def _handle_profile(item: Dict, response_q) -> None:
                                    "files": [f for f in files
                                              if os.path.isfile(f)]}})
     except BaseException as e:  # noqa: BLE001
+        response_q.put({"req_id": req_id, "ok": False,
+                        "error": package_exception(e)})
+
+
+async def _handle_user_metrics(item: Dict, target: Any, response_q,
+                               executor) -> None:
+    """Poll the user's ``__kt_metrics__`` hook (sibling of
+    ``__kt_warmup__``): a dict of numeric gauges the pod's ``/metrics``
+    scrape merges under a ``kt_user_`` prefix — how long-lived serving
+    state (the generation engine's tokens/s, acceptance rate, slot
+    occupancy) reaches Prometheus without the user writing an exporter.
+    Runs on every scrape (3s): keep the hook cheap. Absent hook → {}.
+    Sync hooks run in the executor like regular calls (``_handle``) — a
+    blocking hook must stall its scrape, never the worker loop that every
+    in-flight request's response rides on."""
+    req_id = item.get("req_id")
+    try:
+        hook = getattr(target, "__kt_metrics__", None)
+        result = {}
+        if hook is not None:
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(executor, hook)
+            if asyncio.iscoroutine(out):
+                out = await out
+            result = {str(k): float(v) for k, v in (out or {}).items()
+                      if isinstance(v, (int, float))}
+        response_q.put({"req_id": req_id, "ok": True, "result": result})
+    except BaseException as e:  # noqa: BLE001 — a broken hook must not
+        # poison the worker; the scrape just misses user gauges
         response_q.put({"req_id": req_id, "ok": False,
                         "error": package_exception(e)})
 

@@ -96,3 +96,19 @@ class WarmupCrasher:
 def shouter(msg):
     print(f"SHOUT:{msg}")
     return msg.upper()
+
+
+class Metered:
+    """Service exposing the __kt_metrics__ scrape hook."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def ping(self):
+        self.calls += 1
+        return self.calls
+
+    def __kt_metrics__(self):
+        return {"calls_total": self.calls,
+                "queue depth!": 1.5,      # name needs prometheus sanitizing
+                "not_a_number": "nope"}   # silently dropped

@@ -286,3 +286,36 @@ def test_dead_rank_during_warmup_never_ready():
             assert r.status == 503, await r.text()
             await asyncio.sleep(0.1)
     run_server_test(body)
+
+
+def test_user_metrics_hook_reaches_scrape():
+    """__kt_metrics__ (the __kt_warmup__ sibling): numeric gauges from the
+    user instance in the rank subprocess land on /metrics as sanitized
+    kt_user_ lines — serving state reaches Prometheus with no exporter."""
+    async def body(client, state):
+        set_fn_metadata("Metered")
+        os.environ["KT_CALLABLE_TYPE"] = "cls"
+        for _ in range(2):
+            r = await client.post("/Metered/ping",
+                                  json={"args": [], "kwargs": {}})
+            assert r.status == 200, await r.text()
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "kt_user_calls_total 2.0" in text, text
+        assert "kt_user_queue_depth_ 1.5" in text
+        assert "not_a_number" not in text
+    run_server_test(body)
+
+
+def test_metrics_scrape_without_hook_unchanged():
+    """A callable WITHOUT the hook: scrape stays clean (no kt_user_ lines,
+    no errors)."""
+    async def body(client, state):
+        set_fn_metadata("summer")
+        r = await client.post("/summer", json={"args": [2, 3], "kwargs": {}})
+        assert r.status == 200
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert r.status == 200
+        assert "kt_user_" not in text
+    run_server_test(body)

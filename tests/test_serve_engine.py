@@ -824,3 +824,34 @@ class TestContextShardedServing:
         xcfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
                                 remat=False)
         assert gen_mod._sp_prefill_impl(xcfg, 1, 512) is None
+
+
+def test_engine_kt_metrics_hook(dense):
+    """The engine's __kt_metrics__ gauges: numeric, complete, and live —
+    what a deployed engine exports through the pod scrape."""
+    params, cfg = dense
+    eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                           prefill_buckets=(4,))
+    h = eng.submit([1, 2], max_new_tokens=3)
+    while eng.step():
+        pass
+    m = eng.__kt_metrics__()
+    assert all(isinstance(v, float) for v in m.values())
+    assert m["engine_finished_total"] == 1.0
+    assert m["engine_tokens_generated"] == 3.0
+    assert m["engine_slots"] == 2.0
+    # speculative engines add acceptance gauges
+    from kubetorch_tpu.serve import SpeculativeEngine
+    dcfg = LlamaConfig.tiny(dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                            ffn_dim=64, attn_impl="xla", dtype=jnp.float32,
+                            remat=False)
+    draft = llama_init(jax.random.PRNGKey(7), dcfg)
+    spec = SpeculativeEngine(params, cfg, draft, dcfg, spec_k=2, slots=2,
+                             max_len=32, prefill_buckets=(4,))
+    h = spec.submit([1, 2], max_new_tokens=3)
+    while spec.step():
+        pass
+    sm = spec.__kt_metrics__()
+    assert "engine_spec_acceptance_rate" in sm
+    assert sm["engine_spec_rounds"] >= 1.0
+    assert h.result(timeout=0) is not None

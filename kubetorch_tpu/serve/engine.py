@@ -461,6 +461,7 @@ class _Request:
     temperature: Optional[float] = None      # None → engine default
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
     adapter_id: Optional[int] = None         # registered LoRA adapter
+    cancelled: bool = False                  # reaped at the next step
     error: Optional[BaseException] = None    # admission failure, surfaced
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     generated: int = 0
@@ -475,14 +476,21 @@ class RequestHandle:
     (or a later iteration) sees the full stream from the start. Single
     consumer: share the handle's results, not the handle, across threads."""
 
-    def __init__(self, req: _Request):
+    def __init__(self, req: _Request, engine: "GenerationEngine" = None):
         self._req = req
+        self._engine = engine
         self._collected: List[int] = []
         self._done = False
 
     @property
     def request_id(self) -> int:
         return self._req.rid
+
+    def cancel(self) -> bool:
+        """Abandon this request (``GenerationEngine.cancel``): the stream
+        ends cleanly with whatever tokens already decoded."""
+        return (self._engine.cancel(self._req.rid)
+                if self._engine is not None else False)
 
     def _pull(self, timeout: Optional[float]) -> bool:
         """Move one queue item into ``_collected``; False once finished.
@@ -604,6 +612,7 @@ class GenerationEngine:
         self._free_bank: List[int] = []
         self._adapter_ids = itertools.count(1)
         self._aidx = np.zeros(self.slots, np.int32)
+        self._admitting: Optional[_Request] = None   # cancel() window
         self._rng = jax.random.PRNGKey(seed)
         self._rid = itertools.count()
         self._lock = threading.Lock()
@@ -761,7 +770,7 @@ class GenerationEngine:
         with self._lock:
             self._pending.append(req)
         self._work.set()
-        return RequestHandle(req)
+        return RequestHandle(req, engine=self)
 
     def register_prefix(self, tokens: Sequence[int],
                         adapter_id: Optional[int] = None) -> int:
@@ -816,6 +825,69 @@ class GenerationEngine:
         the id fail with a KeyError surfaced through their handle."""
         return self._prefixes.pop(prefix_id, None) is not None
 
+    def cancel(self, request_id: int) -> bool:
+        """Abandon a request: a queued one never admits, an ACTIVE one
+        frees its slot at the next step boundary (the in-flight decode
+        step finishes — shapes are static, there is nothing to interrupt
+        mid-jit). A request caught MID-ADMISSION (popped from the queue,
+        prefill in flight) is flagged and reaped right after its
+        admission completes. The handle's stream ends cleanly with
+        whatever tokens already decoded. False if the id is unknown,
+        already finished, or already cancelled — the second of two racing
+        cancels always reads False, whatever state the request is in."""
+        with self._lock:
+            for i, req in enumerate(self._pending):
+                if req.rid == request_id:
+                    del self._pending[i]
+                    req.out.put(None)
+                    return True
+        # active slots are only mutated on the step path; flag the request
+        # and let the next step boundary retire it
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.rid == request_id:
+                if req.cancelled:
+                    return False
+                req.cancelled = True
+                self._work.set()
+                return True
+        # the admission window: _admit popped it, _admit_one's prefill is
+        # running — without this check a disconnect during a seconds-long
+        # first compile would be silently lost and the request would decode
+        # its full budget anyway
+        adm = self._admitting
+        if adm is not None and adm.rid == request_id and not adm.cancelled:
+            adm.cancelled = True
+            self._work.set()
+            return True
+        return False
+
+    def _retire_slot(self, slot: int) -> None:
+        """THE slot-retirement path (natural finish, eos, cancel): end the
+        handle's stream, free the grid slot, clear every ledger — one
+        definition so a new piece of per-slot state can't be cleared on
+        one path and leak on another. Step-thread only."""
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        req.out.put(None)
+        self._slot_req[slot] = None
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._temps[slot] = 0.0
+        self._aidx[slot] = 0
+        self._finished += 1
+        self._free_slot_ledgers(slot)
+
+    def _reap_cancelled(self) -> None:
+        """Step-boundary retirement for cancelled active slots (the only
+        thread that mutates slot state is the stepping thread)."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.cancelled:
+                self._retire_slot(slot)
+
+    def _free_slot_ledgers(self, slot: int) -> None:
+        """Subclass hook: extra per-slot state to clear on retirement."""
+
     # -- engine loop --------------------------------------------------------
 
     def _mesh_scope(self):
@@ -845,6 +917,10 @@ class GenerationEngine:
                     return
                 req = self._pending.popleft()
             slot = free.pop(0)
+            # visible to cancel() during the (possibly seconds-long)
+            # prefill below; the flag it may set is honored by the reap at
+            # the next step boundary once the slot is assigned
+            self._admitting = req
             try:
                 self._admit_one(req, slot)
             except Exception as e:   # noqa: BLE001 — per-request failure
@@ -853,6 +929,8 @@ class GenerationEngine:
                 req.error = e
                 req.out.put(None)
                 free.insert(0, slot)
+            finally:
+                self._admitting = None
 
     def _admit_one(self, req: _Request, slot: int) -> None:
         t = len(req.prompt)
@@ -918,13 +996,7 @@ class GenerationEngine:
         done = (req.generated >= req.max_new_tokens
                 or (self.eos_id is not None and tok == self.eos_id))
         if done:
-            req.out.put(None)
-            self._slot_req[slot] = None
-            self._pos[slot] = 0
-            self._tok[slot] = 0
-            self._temps[slot] = 0.0
-            self._aidx[slot] = 0
-            self._finished += 1
+            self._retire_slot(slot)
 
     def step(self) -> int:
         """Admit pending requests, then decode one token for every active
@@ -935,6 +1007,7 @@ class GenerationEngine:
             return self._step_once()
 
     def _step_once(self) -> int:
+        self._reap_cancelled()
         self._admit()
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         if active:

@@ -223,15 +223,19 @@ class SpeculativeEngine(GenerationEngine):
         self._spec_valid[slot] = t
         self._slot_pending[slot] = [first_tok]
         self._admitted += 1
+        # a retirement on this first token clears the ledgers through the
+        # shared _retire_slot → _free_slot_ledgers path
         self._emit(slot, first_tok)
-        if self._slot_req[slot] is None:      # retired on its first token
-            self._slot_pending[slot] = []
-            self._spec_valid[slot] = 0
 
     # -- the speculative round ----------------------------------------------
 
+    def _free_slot_ledgers(self, slot: int) -> None:
+        self._slot_pending[slot] = []
+        self._spec_valid[slot] = 0
+
     def step(self) -> int:
         with self._mesh_scope():
+            self._reap_cancelled()
             self._admit()
             active = [i for i, r in enumerate(self._slot_req)
                       if r is not None]
@@ -315,9 +319,8 @@ class SpeculativeEngine(GenerationEngine):
             # target's post-stream continuation, and counting them would
             # flatter acceptance_rate for exactly the requests that end
             self.spec_stats.accepted += min(accepted, sent)
-            self._spec_valid[i] = start[i] + ci
-            if self._slot_req[i] is None:
-                self._slot_pending[i] = []
-                self._spec_valid[i] = 0
-            else:
+            # a slot retired during emission had its ledgers cleared by
+            # _retire_slot → _free_slot_ledgers; only live slots advance
+            if self._slot_req[i] is not None:
+                self._spec_valid[i] = start[i] + ci
                 self._slot_pending[i] = emitted

@@ -855,3 +855,113 @@ def test_engine_kt_metrics_hook(dense):
     assert "engine_spec_acceptance_rate" in sm
     assert sm["engine_spec_rounds"] >= 1.0
     assert h.result(timeout=0) is not None
+
+
+class TestCancellation:
+    def test_cancel_queued_never_admits(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4,))
+        h1 = eng.submit([1, 2], max_new_tokens=8)
+        h2 = eng.submit([3, 4], max_new_tokens=8)      # queued behind h1
+        assert h2.cancel() is True
+        assert h2.cancel() is False                    # idempotent
+        while eng.step():
+            pass
+        assert len(h1.result(timeout=0)) == 8
+        assert h2.result(timeout=0) == []              # clean empty stream
+        assert eng.stats().admitted_total == 1
+
+    def test_cancel_active_frees_slot_mid_stream(self, dense):
+        """An active request stops at the next step boundary, keeps its
+        partial tokens, and its slot serves the next caller exactly."""
+        params, cfg = dense
+        want_next = _reference_tokens(params, cfg, [9, 8], 5)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        h = eng.submit([1, 2, 3], max_new_tokens=30)
+        for _ in range(3):
+            eng.step()
+        assert h.cancel() is True
+        while eng.step():
+            pass
+        got = h.result(timeout=0)
+        assert 1 <= len(got) < 30                      # partial stream
+        s = eng.stats()
+        assert s.active == 0 and s.finished_total == 1
+        # the freed slot serves the next request bit-exactly
+        h2 = eng.submit([9, 8], max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == want_next
+
+    def test_cancel_unknown_or_finished_is_noop(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4,))
+        h = eng.submit([1, 2], max_new_tokens=2)
+        while eng.step():
+            pass
+        assert len(h.result(timeout=0)) == 2
+        assert h.cancel() is False                     # already finished
+        assert eng.cancel(99999) is False              # unknown id
+
+    def test_cancel_speculative_slot(self, dense):
+        """Cancellation frees a SPECULATIVE slot's ledgers too — the next
+        occupant must not inherit pending tokens or a stale frontier."""
+        from kubetorch_tpu.serve import SpeculativeEngine
+        params, cfg = dense
+        dcfg = LlamaConfig.tiny(dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                                ffn_dim=64, attn_impl="xla",
+                                dtype=jnp.float32, remat=False)
+        draft = llama_init(jax.random.PRNGKey(7), dcfg)
+        eng = SpeculativeEngine(params, cfg, draft, dcfg, spec_k=2,
+                                slots=1, max_len=64, prefill_buckets=(4,))
+        want = _reference_tokens(params, cfg, [9, 8], 5)
+        h = eng.submit([1, 2, 3], max_new_tokens=30)
+        eng.step()
+        assert h.cancel() is True
+        while eng.step():
+            pass
+        assert eng._slot_pending[0] == [] and eng._spec_valid[0] == 0
+        h2 = eng.submit([9, 8], max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == want
+
+    def test_cancel_mid_admission_window(self, dense, monkeypatch):
+        """A cancel landing while _admit_one's prefill runs (popped from
+        the queue, slot not yet assigned) must take effect — the first
+        compile can last seconds and disconnects love that window."""
+        import kubetorch_tpu.serve.engine as eng_mod
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        orig = eng_mod._prefill
+        hit = {}
+
+        def racy_prefill(*a, **kw):
+            out = orig(*a, **kw)
+            if "cancelled" not in hit:      # cancel DURING the admission
+                hit["cancelled"] = eng.cancel(h.request_id)
+            return out
+
+        monkeypatch.setattr(eng_mod, "_prefill", racy_prefill)
+        h = eng.submit([1, 2, 3], max_new_tokens=30)
+        while eng.step():
+            pass
+        assert hit["cancelled"] is True
+        got = h.result(timeout=0)
+        assert len(got) < 30                 # never decoded its budget
+        assert eng.stats().active == 0
+
+    def test_double_cancel_active_reads_false(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4,))
+        h = eng.submit([1, 2], max_new_tokens=10)
+        eng.step()
+        assert h.cancel() is True
+        assert h.cancel() is False           # same contract as queued path
+        while eng.step():
+            pass

@@ -136,7 +136,11 @@ class SpeculativeEngine(GenerationEngine):
     argmax acceptance rule; sampled speculation needs rejection sampling
     and is out of scope. Prefix caching, adapters, and int8 KV are the
     plain engine's territory for now — refused loudly rather than served
-    approximately."""
+    approximately. Tensor/data meshes work GSPMD-sharded like the plain
+    engine; a CONTEXT axis is also correct here but the window forwards
+    have no per-shard combine yet, so the cache won't stay
+    sequence-sharded — context-sharded serving is the plain engine's
+    feature (``sp_decode_attention``)."""
 
     def __init__(self, params: Dict[str, Any], cfg,
                  draft_params: Dict[str, Any], draft_cfg, *, spec_k: int = 4,

@@ -16,20 +16,26 @@ def train_epoch(epoch: int):
             "rank": os.environ.get("RANK")}
 
 
-def main():
+def main(epochs: int = 10, max_resizes: int = 20):
     compute = kt.Compute(cpus=1).distribute("spmd", workers=4)
     f = kt.fn(train_epoch)
     f.to(compute)
 
     epoch = 0
     workers = 4
-    while epoch < 10:
+    resizes = 0
+    while epoch < epochs:
         try:
             results = f(epoch)
             print(f"epoch {epoch}: {len(results)} workers ok")
             epoch += 1
         except (kt.WorkerMembershipChanged, kt.WorkerCallError,
                 kt.PodTerminatedError) as e:
+            # bounded: a cluster where pods never come up must fail the
+            # run, not spin the resize loop forever
+            resizes += 1
+            if resizes > max_resizes:
+                raise
             survivors = getattr(e, "current", None)
             workers = len(survivors) if survivors else max(workers - 1, 1)
             print(f"membership changed ({e}); resizing to {workers}")

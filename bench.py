@@ -288,13 +288,23 @@ def bench_worker(force_cpu: bool = False) -> int:
             tps = batch_size * seq * steps / dt
         return tps
 
+    def _looks_oom(e: Exception) -> bool:
+        # The axon relay reports a compile-time HBM overflow as INTERNAL
+        # ("remote_compile ... tpu_compile_helper subprocess exit code 1")
+        # with the RESOURCE_EXHAUSTED allocation dump only on the helper's
+        # stderr — treat any remote-compile failure as a downsizing cue too
+        # (retries are bounded by the batch>=1 halving ladder).
+        s = str(e)
+        return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+                or "remote_compile" in s or "tpu_compile_helper" in s)
+
     tokens_per_sec = None
     while batch >= 1:
         try:
             tokens_per_sec = run(batch)
             break
         except Exception as e:
-            if "RESOURCE_EXHAUSTED" in str(e) and batch > 1:
+            if _looks_oom(e) and batch > 1:
                 batch //= 2
                 # release the failed attempt's arrays BEFORE re-initializing:
                 # `params` shares device buffers with `state`, and keeping

@@ -12,6 +12,14 @@ from .llama import LlamaConfig, llama_init, llama_forward, llama_loss
 from .lora import LoraConfig, lora_init, lora_loss, merge_lora
 from .vit import VitConfig, vit_init, vit_forward, vit_loss
 
+
+def load_hf(path: str, **config_overrides):
+    """HF checkpoint dir → ``(params, cfg)`` (lazy import: torch/transformers
+    only load when a checkpoint is actually converted)."""
+    from .convert_hf import load_hf as _load
+    return _load(path, **config_overrides)
+
+
 __all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
            "LoraConfig", "lora_init", "lora_loss", "merge_lora",
-           "VitConfig", "vit_init", "vit_forward", "vit_loss"]
+           "VitConfig", "vit_init", "vit_forward", "vit_loss", "load_hf"]

@@ -1,0 +1,343 @@
+"""HuggingFace checkpoint import: torch Llama/Mixtral weights → our pytrees.
+
+A user switching from the reference stack (torch models served through
+kubetorch) brings trained checkpoints with them; this module converts
+``LlamaForCausalLM`` / ``MixtralForCausalLM`` weights (a live module, a
+``state_dict``, or a ``from_pretrained`` directory) into the stacked-layer
+pytrees ``models.llama`` / ``models.moe`` run, so real checkpoints drive
+training, the serving engines, quantization, and LoRA unchanged.
+
+Two representation gaps are bridged here, both silently wrong if skipped:
+
+- **Layer stacking**: HF keeps per-layer tensors (``layers.{i}.*``); the
+  TPU forward scans one stacked ``(L, ...)`` leaf per weight (compile time
+  O(1) in depth — see models/llama.py). Conversion stacks along a new
+  leading dim and transposes torch's ``(out, in)`` to our ``(in, out)``.
+- **RoPE layout**: HF applies rotary position embeddings in half-split
+  layout (dim ``i`` pairs with ``i + head_dim/2`` — ``rotate_half``), while
+  this codebase rotates interleaved pairs ``(2i, 2i+1)`` in complex form
+  (``apply_rope``). The two are equivalent up to a fixed permutation of the
+  q/k projection OUTPUT dims, applied per head at conversion time; logits
+  then match bit-for-bit semantics (fp32 parity tested in
+  tests/test_convert_hf.py).
+
+Weights land in ``cfg.dtype`` (norms and the router stay fp32, matching
+``llama_init``/``moe_init``). Torch never touches device memory: tensors
+move through numpy fp32 on host, and jnp.asarray does the final cast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+from .moe import MoeConfig
+
+__all__ = [
+    "llama_config_from_hf",
+    "moe_config_from_hf",
+    "llama_params_from_hf",
+    "moe_params_from_hf",
+    "config_from_hf",
+    "params_from_hf",
+    "load_hf",
+]
+
+
+# ---------------------------------------------------------------------------
+# state-dict plumbing
+# ---------------------------------------------------------------------------
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor (any dtype/device, incl. bf16) or ndarray → fp32 ndarray."""
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32, copy=False)
+    # torch path — bf16 has no numpy dtype, so upcast on the torch side
+    return t.detach().to("cpu").float().numpy()
+
+
+def _state_dict(model_or_sd) -> Mapping[str, Any]:
+    sd = (model_or_sd if isinstance(model_or_sd, Mapping)
+          else model_or_sd.state_dict())
+    # strip an outer "model." so LlamaModel and LlamaForCausalLM both work
+    if not any(k.startswith("model.") for k in sd):
+        return {f"model.{k}" if not k.startswith("lm_head") else k: v
+                for k, v in sd.items()}
+    return sd
+
+
+def _hf_config(model_or_sd, hf_config):
+    if hf_config is not None:
+        return hf_config
+    cfg = getattr(model_or_sd, "config", None)
+    if cfg is None:
+        raise ValueError(
+            "pass hf_config= when converting a bare state_dict")
+    return cfg
+
+
+def _deinterleave_rope(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Permute q/k projection columns from HF half-split RoPE layout to the
+    interleaved layout ``apply_rope`` expects.
+
+    ``w`` is ``(in_dim, n_heads*head_dim)`` (already transposed). HF orders
+    each head's output dims ``[r_0..r_{hd/2-1}, s_0..s_{hd/2-1}]`` where
+    ``(r_i, s_i)`` is the pair rotated by angle ``theta_i``; interleaved
+    wants ``[r_0, s_0, r_1, s_1, ...]``.
+    """
+    d_in = w.shape[0]
+    w = w.reshape(d_in, n_heads, 2, head_dim // 2)
+    return w.transpose(0, 1, 3, 2).reshape(d_in, n_heads * head_dim)
+
+
+def _common_decoder(sd, hf, cfg, *, n_layers: int):
+    """Leaves shared by the dense and MoE decoders: embeddings, attention
+    projections (RoPE-permuted), norms, lm_head (tied or not)."""
+    nh = cfg.n_heads
+    nkv = cfg.n_kv_heads
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    def stack(fmt: str, transform=None):
+        leaves = []
+        for i in range(n_layers):
+            w = _to_numpy(sd[fmt.format(i=i)]).T          # (in, out)
+            leaves.append(transform(w) if transform else w)
+        return jnp.asarray(np.stack(leaves), dtype=dt)
+
+    def stack_norm(fmt: str):
+        return jnp.asarray(np.stack(
+            [_to_numpy(sd[fmt.format(i=i)]) for i in range(n_layers)]),
+            dtype=jnp.float32)
+
+    embed = _to_numpy(sd["model.embed_tokens.weight"])     # (V, D)
+    if getattr(hf, "tie_word_embeddings", False) or "lm_head.weight" not in sd:
+        lm_head = embed.T.copy()
+    else:
+        lm_head = _to_numpy(sd["lm_head.weight"]).T        # (D, V)
+
+    layers = {
+        "attn_norm": stack_norm("model.layers.{i}.input_layernorm.weight"),
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight",
+                    lambda w: _deinterleave_rope(w, nh, hd)),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight",
+                    lambda w: _deinterleave_rope(w, nkv, hd)),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+        "ffn_norm": stack_norm(
+            "model.layers.{i}.post_attention_layernorm.weight"),
+    }
+    return {
+        "embed": jnp.asarray(embed, dtype=dt),
+        "layers": layers,
+        "final_norm": jnp.asarray(_to_numpy(sd["model.norm.weight"]),
+                                  dtype=jnp.float32),
+        "lm_head": jnp.asarray(lm_head, dtype=dt),
+    }, stack
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def _check_head_dim(hf) -> None:
+    """Models with a decoupled head_dim (e.g. Mistral-Nemo: 5120 hidden, 32
+    heads, head_dim 128) can't convert — our configs derive
+    ``head_dim = dim // n_heads`` — and must fail HERE with a clear message,
+    not as a bare reshape ValueError deep in weight stacking."""
+    explicit = getattr(hf, "head_dim", None)
+    derived = hf.hidden_size // hf.num_attention_heads
+    if explicit is not None and explicit != derived:
+        raise NotImplementedError(
+            f"checkpoint has head_dim={explicit} decoupled from "
+            f"hidden_size/num_heads={derived}; this stack derives head_dim "
+            "from dim//n_heads and cannot represent it")
+
+
+def _rope_scaling_tuple(hf):
+    """HF ``rope_scaling`` dict → the hashable tuple ``rope_freqs`` applies
+    (Llama-3.1 NTK scaling), or None. Anything this stack can't reproduce
+    raises — converting anyway would yield silently wrong logits at every
+    position, the exact failure class this module exists to prevent."""
+    rs = getattr(hf, "rope_scaling", None)
+    if rs is None:
+        return None
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    if kind == "default":
+        return None
+    if kind == "llama3":
+        return (float(rs["factor"]), float(rs["low_freq_factor"]),
+                float(rs["high_freq_factor"]),
+                int(rs["original_max_position_embeddings"]))
+    raise NotImplementedError(
+        f"rope_scaling type {kind!r} is not implemented (supported: llama3 "
+        "NTK scaling); refusing to convert with wrong position embeddings")
+
+
+def llama_config_from_hf(hf, **overrides) -> LlamaConfig:
+    """HF ``LlamaConfig`` → ours. ``overrides`` win (e.g. dtype, attn_impl,
+    a smaller ``max_seq_len`` to bound cache/freq tables)."""
+    _check_head_dim(hf)
+    kw = dict(
+        vocab_size=hf.vocab_size,
+        dim=hf.hidden_size,
+        n_layers=hf.num_hidden_layers,
+        n_heads=hf.num_attention_heads,
+        n_kv_heads=getattr(hf, "num_key_value_heads", hf.num_attention_heads),
+        ffn_dim=hf.intermediate_size,
+        max_seq_len=hf.max_position_embeddings,
+        rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+        norm_eps=hf.rms_norm_eps,
+        rope_scaling=_rope_scaling_tuple(hf),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def llama_params_from_hf(model_or_sd, cfg: LlamaConfig,
+                         hf_config=None) -> Dict[str, Any]:
+    """HF Llama weights → the ``llama_init`` pytree (logits-parity tested)."""
+    hf = _hf_config(model_or_sd, hf_config)
+    sd = _state_dict(model_or_sd)
+    params, stack = _common_decoder(sd, hf, cfg, n_layers=cfg.n_layers)
+    params["layers"].update({
+        "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+        "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+        "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+    })
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Mixtral
+# ---------------------------------------------------------------------------
+
+
+def moe_config_from_hf(hf, **overrides) -> MoeConfig:
+    """HF ``MixtralConfig`` → ``MoeConfig``.
+
+    Note the capacity semantics gap: HF Mixtral routes drop-free; this
+    stack's training dispatch bounds each expert at
+    ``capacity_factor * S * K / E`` slots (GShard-style, static shapes for
+    XLA). Converted checkpoints are exact whenever no expert overflows —
+    crank ``capacity_factor`` (or serve via the engine's decode path, which
+    gathers instead of dispatching) when exactness at skewed routing
+    matters more than the padded buffer.
+    """
+    _check_head_dim(hf)
+    if _rope_scaling_tuple(hf) is not None:
+        raise NotImplementedError(
+            "rope_scaling on a MoE checkpoint is not supported (MoeConfig "
+            "has no rope_scaling field)")
+    kw = dict(
+        vocab_size=hf.vocab_size,
+        dim=hf.hidden_size,
+        n_layers=hf.num_hidden_layers,
+        n_heads=hf.num_attention_heads,
+        n_kv_heads=getattr(hf, "num_key_value_heads", hf.num_attention_heads),
+        ffn_dim=hf.intermediate_size,
+        n_experts=hf.num_local_experts,
+        experts_per_token=hf.num_experts_per_tok,
+        max_seq_len=hf.max_position_embeddings,
+        rope_theta=float(getattr(hf, "rope_theta", 1e6)),
+        norm_eps=hf.rms_norm_eps,
+    )
+    kw.update(overrides)
+    return MoeConfig(**kw)
+
+
+def moe_params_from_hf(model_or_sd, cfg: MoeConfig,
+                       hf_config=None) -> Dict[str, Any]:
+    """HF Mixtral weights → the ``moe_init`` pytree.
+
+    Expert FFNs stack to ``(L, E, in, out)``; HF's ``w1/w3/w2`` are our
+    ``w_gate/w_up/w_down``. The router stays fp32 (routing decisions are
+    taken in fp32 — see ``_route``).
+    """
+    hf = _hf_config(model_or_sd, hf_config)
+    sd = _state_dict(model_or_sd)
+    params, stack = _common_decoder(sd, hf, cfg, n_layers=cfg.n_layers)
+
+    def stack_experts(which: str):
+        per_layer = []
+        for i in range(cfg.n_layers):
+            per_layer.append(np.stack([
+                _to_numpy(sd[
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight"
+                ]).T
+                for e in range(cfg.n_experts)]))           # (E, in, out)
+        return jnp.asarray(np.stack(per_layer), dtype=cfg.dtype)
+
+    params["layers"].update({
+        "router": jnp.asarray(np.stack(
+            [_to_numpy(sd[f"model.layers.{i}.block_sparse_moe.gate.weight"]).T
+             for i in range(cfg.n_layers)]), dtype=jnp.float32),
+        "experts": {
+            "w_gate": stack_experts("w1"),
+            "w_up": stack_experts("w3"),
+            "w_down": stack_experts("w2"),
+        },
+    })
+    return params
+
+
+# ---------------------------------------------------------------------------
+# one-call front door
+# ---------------------------------------------------------------------------
+
+_ARCH_DENSE = {"LlamaForCausalLM", "LlamaModel", "MistralForCausalLM",
+               "MistralModel"}
+_ARCH_MOE = {"MixtralForCausalLM", "MixtralModel"}
+
+
+def _is_moe(hf) -> bool:
+    archs = set(getattr(hf, "architectures", None) or [])
+    if archs & _ARCH_MOE:
+        return True
+    if archs & _ARCH_DENSE:
+        return False
+    if archs:
+        # Unknown architectures must NOT fall through to the dense mapping:
+        # several (Qwen2, Gemma) reuse the Llama key names, so every lookup
+        # would succeed while their extra weights (qkv biases, logit caps)
+        # are silently dropped — wrong logits with no error.
+        raise NotImplementedError(
+            f"unsupported architecture(s) {sorted(archs)}; supported: "
+            f"{sorted(_ARCH_DENSE | _ARCH_MOE)}")
+    return hasattr(hf, "num_local_experts")
+
+
+def config_from_hf(hf, **overrides):
+    return (moe_config_from_hf(hf, **overrides) if _is_moe(hf)
+            else llama_config_from_hf(hf, **overrides))
+
+
+def params_from_hf(model_or_sd, cfg, hf_config=None):
+    return (moe_params_from_hf(model_or_sd, cfg, hf_config=hf_config)
+            if isinstance(cfg, MoeConfig)
+            else llama_params_from_hf(model_or_sd, cfg, hf_config=hf_config))
+
+
+def load_hf(path: str, **config_overrides):
+    """``from_pretrained`` directory → ``(params, cfg)`` ready for
+    ``llama_forward``/``moe_forward``, the serving engines, ``quantize_params``
+    and LoRA. Architecture is sniffed from the HF config (Llama/Mistral →
+    dense; Mixtral → MoE)."""
+    import transformers
+
+    hf = transformers.AutoConfig.from_pretrained(path)
+    cfg = config_from_hf(hf, **config_overrides)
+    # dtype="auto" keeps bf16 checkpoints bf16 on host — _to_numpy upcasts
+    # per-tensor, so an eager fp32 load would only double peak RAM
+    try:
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            path, dtype="auto")
+    except TypeError:   # transformers < 4.56 spells it torch_dtype
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            path, torch_dtype="auto")
+    return params_from_hf(model, cfg, hf_config=hf), cfg

@@ -46,6 +46,10 @@ class LlamaConfig:
     # pipeline-internal (already-inside-shard_map dispatch, set only by
     # llama_forward_pipelined)
     attn_impl: str = "auto"
+    # Llama-3.1 NTK frequency scaling as a hashable tuple (the config is a
+    # jit static arg): (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings). None = plain rope_theta.
+    rope_scaling: Optional[tuple] = None
 
     @property
     def head_dim(self) -> int:
@@ -118,6 +122,23 @@ def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
 def rope_freqs(cfg: LlamaConfig, seq_len: int) -> jax.Array:
     """(S, Hd/2) complex rotation table, fp32."""
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
+    if cfg.rope_scaling is not None:
+        # Llama-3.1 long-context NTK scaling: frequencies whose wavelength
+        # exceeds the ORIGINAL training context are slowed by ``factor``,
+        # short wavelengths are kept, and the band between interpolates —
+        # required for 3.1/3.2 checkpoints (convert_hf maps HF
+        # rope_scaling={"rope_type": "llama3", ...} here; plain-theta tables
+        # would produce silently wrong logits at every position).
+        factor, low_fac, high_fac, orig_ctx = cfg.rope_scaling
+        wavelen = 2.0 * jnp.pi / inv
+        low_wl = orig_ctx / low_fac       # longest wavelength kept ...
+        high_wl = orig_ctx / high_fac     # ... after the transition band
+        smooth = jnp.clip((orig_ctx / wavelen - low_fac)
+                          / (high_fac - low_fac), 0.0, 1.0)
+        inv = jnp.where(
+            wavelen < high_wl, inv,
+            jnp.where(wavelen > low_wl, inv / factor,
+                      (1.0 - smooth) * inv / factor + smooth * inv))
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)
     return jnp.cos(freqs) + 1j * jnp.sin(freqs)

@@ -4,7 +4,13 @@ Run detached (never timeout-kill a TPU-holding process — it wedges the axon
 relay): ``python scripts/tpu_smoke.py > /tmp/tpu_smoke.log 2>&1``
 """
 
+import os
+import sys
 import time
+
+# runnable as `python scripts/tpu_smoke.py` from anywhere — the script dir,
+# not the repo root, is what python puts on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -88,6 +94,24 @@ def main():
           f"(grid {slots}, {steps} steps, {dt:.2f}s)", flush=True)
     for h in handles:               # sanity: every slot actually decoded
         assert h._req.generated > 0, "no tokens generated"
+
+    # device-side decode throughput: the scanned generate() path keeps all
+    # decode steps inside ONE jit (lax.scan), so no per-step host sync —
+    # this is the chip's real decode rate, where the engine.step() number
+    # above pays one relay/host round-trip per step (~all of its time here
+    # under the axon tunnel; on a local TPU the gap shrinks to queue depth)
+    from kubetorch_tpu.models.generate import generate
+
+    gp = jnp.asarray(prompts[:, :128], jnp.int32)
+    new = 256
+    out = generate(params, gp, cfg, max_new_tokens=new)   # compiles
+    out.block_until_ready()
+    t0 = time.time()
+    out = generate(params, gp, cfg, max_new_tokens=new)
+    out.block_until_ready()
+    sdt = time.time() - t0
+    print(f"scanned decode: {slots * new / sdt:.0f} tokens/s/chip "
+          f"(batch {slots}, {new} steps on-device, {sdt:.2f}s)", flush=True)
 
     # int8 weight-only decode: same grid, quantized weights — the
     # bandwidth-bound decode should approach 2x (weights are half the

@@ -76,6 +76,9 @@ _LAZY = {
     "kt_breakpoint": ".serving.pdb_ws",
     "deep_breakpoint": ".serving.pdb_ws",
     "MeshSpec": ".parallel.mesh",
+    # module-valued: kt.models.load_hf / kt.models.LlamaConfig (the HF
+    # migration surface); resolved to the module itself by __getattr__
+    "models": ".models",
 }
 
 
@@ -90,7 +93,10 @@ def __getattr__(name: str):
         # Module-__getattr__ convention: surface AttributeError so hasattr()
         # and dir()-driven tooling keep working.
         raise AttributeError(f"kubetorch_tpu.{name} unavailable: {e}") from e
-    val = getattr(mod, name)
+    # module-valued entries (e.g. "models" → .models) resolve to the module
+    # itself; everything else to the module's same-named attribute
+    val = mod if mod_path.lstrip(".").split(".")[-1] == name \
+        and not hasattr(mod, name) else getattr(mod, name)
     globals()[name] = val
     return val
 

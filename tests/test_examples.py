@@ -99,6 +99,26 @@ def test_lora_finetune_example(capsys):
 
 
 @pytest.mark.slow
+def test_serve_hf_checkpoint_example(capsys):
+    """The migration journey: save_pretrained dir → load_hf → engine-backed
+    remote service returning real completions."""
+    from kubetorch_tpu.client import shutdown_local_controller
+    from kubetorch_tpu.config import reset_config
+
+    import serve_hf_checkpoint
+
+    reset_config()
+    try:
+        serve_hf_checkpoint.main()
+        out = capsys.readouterr().out
+        assert "served 8 tokens from a converted HF checkpoint" in out
+        assert "HF-SERVE-EXAMPLE OK" in out
+    finally:
+        shutdown_local_controller()
+        reset_config()
+
+
+@pytest.mark.slow
 def test_mnist_mlp_example(capsys):
     """BASELINE config 1 end-to-end on a local pod: one kt.fn call."""
     from kubetorch_tpu.client import shutdown_local_controller

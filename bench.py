@@ -242,8 +242,10 @@ def bench_worker(force_cpu: bool = False) -> int:
                           attn_impl="flash", remat=True)
         # start high and let the RESOURCE_EXHAUSTED handler halve: larger
         # batches amortize per-step overhead toward the 40% MFU target, and
-        # a failed try costs one re-init inside the 600s attempt budget
+        # a failed try costs one re-init inside the 600s attempt budget.
+        # KT_BENCH_BATCH pins the starting batch (tuning experiments).
         batch, seq, steps, warmup = 16, 2048, 10, 3
+        batch = int(os.environ.get("KT_BENCH_BATCH", batch))
     else:
         cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
         batch, seq, steps, warmup = 4, 64, 4, 1

@@ -246,11 +246,27 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
     return logits, KVCache(k=new_k, v=new_v)
 
 
+def nucleus_mask(scaled: jax.Array, top_ps: jax.Array) -> jax.Array:
+    """Top-p (nucleus) logit filter over the last axis: keep the smallest
+    prefix of the probability-sorted vocab whose cumulative mass reaches
+    ``top_ps`` (per row; 1.0 disables). The top-1 token always survives
+    (its preceding mass is 0), so greedy/degenerate rows stay samplable.
+    ``scaled`` is post-temperature logits; returns filtered logits."""
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sp, si = lax.top_k(probs, probs.shape[-1])          # descending sort
+    before = jnp.cumsum(sp, axis=-1) - sp               # mass strictly above
+    keep_sorted = before < top_ps[..., None]
+    rows = jnp.arange(scaled.shape[0])[:, None]
+    keep = jnp.zeros(scaled.shape, bool).at[rows, si].set(keep_sorted)
+    return jnp.where(keep, scaled, NEG_INF)
+
+
 def sample_logits(logits: jax.Array, key: jax.Array, temperature: float,
-                  top_k: Optional[int]) -> jax.Array:
-    """Greedy (temperature 0) or temperature/top-k sampling over the last
-    axis. One definition shared by the scanned ``generate`` path and the
-    continuous-batching engine (``serve.engine``) so their sampling
+                  top_k: Optional[int],
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Greedy (temperature 0) or temperature/top-k/top-p sampling over the
+    last axis. One definition shared by the scanned ``generate`` path and
+    the continuous-batching engine (``serve.engine``) so their sampling
     semantics can never diverge."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -258,15 +274,19 @@ def sample_logits(logits: jax.Array, key: jax.Array, temperature: float,
     if top_k is not None:
         kth = lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    if top_p is not None and top_p < 1.0:
+        scaled = nucleus_mask(scaled, jnp.full(scaled.shape[:-1], top_p,
+                                               jnp.float32))
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                  "top_k"))
+                                  "top_k", "top_p"))
 def generate(params, prompt: jax.Array, cfg: "LlamaConfig | MoeConfig",
              max_new_tokens: int = 64, temperature: float = 0.0,
              top_k: Optional[int] = None,
-             rng: Optional[jax.Array] = None) -> jax.Array:
+             rng: Optional[jax.Array] = None,
+             top_p: Optional[float] = None) -> jax.Array:
     """Greedy (temperature=0) or sampled generation.
 
     prompt: (B, T_prompt) int32 → (B, T_prompt + max_new_tokens). One compile
@@ -280,7 +300,7 @@ def generate(params, prompt: jax.Array, cfg: "LlamaConfig | MoeConfig",
     logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
 
     def sample(logits, key):
-        return sample_logits(logits, key, temperature, top_k)
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     def step(carry, i):
         cache, tok, key = carry

@@ -122,14 +122,16 @@ def rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
 def rope_freqs(cfg: LlamaConfig, seq_len: int) -> jax.Array:
     """(S, Hd/2) complex rotation table, fp32."""
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
-    if cfg.rope_scaling is not None:
+    # getattr: callers pass MoeConfig here too (no rope_scaling field)
+    rs = getattr(cfg, "rope_scaling", None)
+    if rs is not None:
         # Llama-3.1 long-context NTK scaling: frequencies whose wavelength
         # exceeds the ORIGINAL training context are slowed by ``factor``,
         # short wavelengths are kept, and the band between interpolates —
         # required for 3.1/3.2 checkpoints (convert_hf maps HF
         # rope_scaling={"rope_type": "llama3", ...} here; plain-theta tables
         # would produce silently wrong logits at every position).
-        factor, low_fac, high_fac, orig_ctx = cfg.rope_scaling
+        factor, low_fac, high_fac, orig_ctx = rs
         wavelen = 2.0 * jnp.pi / inv
         low_wl = orig_ctx / low_fac       # longest wavelength kept ...
         high_wl = orig_ctx / high_fac     # ... after the transition band

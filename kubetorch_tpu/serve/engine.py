@@ -270,16 +270,22 @@ def _decode_layer_quant(cfg, x, lw, kq, ks, vq, vs, pos, freqs, lora=None):
     return x + ffn_block(cfg, h, lw), kq, ks, vq, vs
 
 
-def _sample_slots(logits, key, temps, top_k: Optional[int]):
-    """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot.
-    Vectorized (a traced array, not a static) so requests with different
-    temperatures share one compiled step. Agrees with ``sample_logits``
-    slot-wise: argmax for temp 0, temperature/top-k categorical otherwise."""
+def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None):
+    """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot;
+    ``top_ps`` (B,) — nucleus mass per slot, 1.0 disables. Vectorized
+    (traced arrays, not statics) so requests with different temperatures /
+    top-p share one compiled step. ``top_ps=None`` (static) skips the
+    full-vocab sort entirely — engines never pay for nucleus sampling
+    until a request asks for it. Agrees with ``sample_logits`` slot-wise:
+    argmax for temp 0, temperature/top-k/top-p categorical otherwise."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.where(temps > 0, temps, 1.0)[:, None]
     if top_k is not None:
         kth = lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    if top_ps is not None:
+        from ..models.generate import nucleus_mask
+        scaled = nucleus_mask(scaled, top_ps)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
 
@@ -288,7 +294,7 @@ def _sample_slots(logits, key, temps, top_k: Optional[int]):
          donate_argnums=(1,))
 def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
-                 lora_scale: float = 1.0):
+                 lora_scale: float = 1.0, top_ps=None):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
     temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
@@ -331,14 +337,14 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    nxt = _sample_slots(logits, rng, temps, top_k)
+    nxt = _sample_slots(logits, rng, temps, top_k, top_ps)
     return _constrain_cache(new_cache), nxt
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill(params, tokens, true_len, rng, temps, cfg,
              top_k: Optional[int] = None, adapter=None,
-             lora_scale: float = 1.0):
+             lora_scale: float = 1.0, top_ps=None):
     """Prompt pass at one bucket length. tokens (1, T_bucket) right-padded;
     logits are taken at the REAL last position ``true_len - 1`` (padding
     rows only pollute their own cache rows, which decode overwrites before
@@ -372,7 +378,7 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    return _sample_slots(logits, rng, temps, top_k), nk, nv
+    return _sample_slots(logits, rng, temps, top_k, top_ps), nk, nv
 
 
 
@@ -380,7 +386,7 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
                     rng, temps, cfg, top_k: Optional[int] = None,
-                    adapter=None, lora_scale: float = 1.0):
+                    adapter=None, lora_scale: float = 1.0, top_ps=None):
     """Suffix prompt pass behind a cached prefix: tokens (1, T_bucket)
     right-padded run at absolute positions ``prefix_len + i`` attending the
     prefix's REAL K/V rows plus themselves. The prefix stays padded to its
@@ -422,7 +428,7 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    return _sample_slots(logits, rng, temps, top_k), nk, nv
+    return _sample_slots(logits, rng, temps, top_k, top_ps), nk, nv
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -453,17 +459,33 @@ def _splice_slot(cache, slot, k_new, v_new):
 # ---------------------------------------------------------------------------
 
 
+def _normalize_stop(stop) -> tuple:
+    """One token-id sequence or a list of them → tuple of non-empty int
+    tuples. An int-leading sequence is ONE stop sequence, not a list."""
+    if stop is None or len(stop) == 0:
+        return ()
+    # scalar-leading (python or numpy int) → ONE sequence; else a list of
+    # sequences (tokenizer pipelines hand numpy ids, not python ints)
+    seqs = [stop] if not hasattr(stop[0], "__len__") else list(stop)
+    if any(len(q) == 0 for q in seqs):
+        raise ValueError("empty stop sequence")
+    return tuple(tuple(int(t) for t in q) for q in seqs)
+
+
 @dataclass
 class _Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int
     temperature: Optional[float] = None      # None → engine default
+    top_p: Optional[float] = None            # None → engine default
+    stop: tuple = ()                         # stop token-id sequences
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
     adapter_id: Optional[int] = None         # registered LoRA adapter
     cancelled: bool = False                  # reaped at the next step
     error: Optional[BaseException] = None    # admission failure, surfaced
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
+    tail: list = field(default_factory=list)  # last max(len(stop)) tokens
     generated: int = 0
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
@@ -563,6 +585,7 @@ class GenerationEngine:
     def __init__(self, params: Dict[str, Any], cfg, *, slots: int = 8,
                  max_len: int = 1024, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
                  quantize_kv: bool = False, seed: int = 0):
         self.params = params
@@ -572,6 +595,9 @@ class GenerationEngine:
         self.eos_id = eos_id
         self.temperature = float(temperature)
         self.top_k = top_k
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self.top_p = None if top_p is None else float(top_p)
         self.quantize_kv = bool(quantize_kv)
         # the ambient mesh is THREAD-LOCAL trace state: capture it at
         # construction and re-install it around every trace site, or an
@@ -600,6 +626,11 @@ class GenerationEngine:
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: "deque[_Request]" = deque()
         self._temps = np.zeros(self.slots, np.float32)
+        self._top_ps = np.ones(self.slots, np.float32)
+        # sticky: flips on the first nucleus request so the common
+        # no-top-p engine never compiles (or pays for) the vocab sort;
+        # afterwards both step variants stay in the jit cache
+        self._nucleus = self.top_p is not None and self.top_p < 1.0
         # id → (k_bucketed, v_bucketed, true_len)
         self._prefixes: Dict[int, tuple] = {}
         self._prefix_ids = itertools.count()
@@ -735,7 +766,9 @@ class GenerationEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                temperature: Optional[float] = None,
                prefix_id: Optional[int] = None,
-               adapter_id: Optional[int] = None) -> RequestHandle:
+               adapter_id: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop: Optional[Sequence] = None) -> RequestHandle:
         """Queue one request. ``temperature`` overrides the engine default
         for THIS request only (0 = greedy) — per-slot temperatures share the
         same compiled step. ``prefix_id`` (from :meth:`register_prefix`)
@@ -743,7 +776,12 @@ class GenerationEngine:
         and generation continues as if prefix+prompt had been submitted.
         ``adapter_id`` (from :meth:`register_adapter`) runs THIS request
         through its LoRA adapter — prefill and every decode step — while
-        neighboring slots run theirs (or the base model)."""
+        neighboring slots run theirs (or the base model). ``top_p``
+        overrides the engine default for THIS request (nucleus sampling;
+        applies only when its temperature is > 0 — greedy slots ignore
+        it). ``stop`` is one token-id sequence or a list of them: the
+        request retires as soon as its generated tokens end with any stop
+        sequence (the matching tokens ARE emitted, mirroring eos_id)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -764,9 +802,12 @@ class GenerationEngine:
                 f"max_len ({self.max_len})")
         if adapter_id is not None and adapter_id not in self._adapter_slots:
             raise KeyError(f"unknown adapter_id {adapter_id}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
                        temperature=temperature, prefix_id=prefix_id,
-                       adapter_id=adapter_id)
+                       adapter_id=adapter_id, top_p=top_p,
+                       stop=_normalize_stop(stop))
         with self._lock:
             self._pending.append(req)
         self._work.set()
@@ -874,6 +915,7 @@ class GenerationEngine:
         self._pos[slot] = 0
         self._tok[slot] = 0
         self._temps[slot] = 0.0
+        self._top_ps[slot] = 1.0
         self._aidx[slot] = 0
         self._finished += 1
         self._free_slot_ledgers(slot)
@@ -937,6 +979,12 @@ class GenerationEngine:
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
         temps = jnp.full((1,), temp, jnp.float32)
+        tp = (self.top_p if req.top_p is None else float(req.top_p))
+        tp = 1.0 if tp is None else tp
+        if tp < 1.0:
+            self._nucleus = True
+        pkw = {"top_ps": jnp.full((1,), tp, jnp.float32)} \
+            if self._nucleus else {}
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})
@@ -955,7 +1003,7 @@ class GenerationEngine:
             first, k_new, v_new = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
                 jnp.int32(p_real), self._next_key(), temps, self.cfg,
-                top_k=self.top_k, **lkw)
+                top_k=self.top_k, **lkw, **pkw)
             start = p_real + t
         else:
             bucket = next(b for b in self._buckets if b >= t)
@@ -963,7 +1011,8 @@ class GenerationEngine:
             padded[0, :t] = req.prompt
             first, k_new, v_new = _prefill(
                 self.params, jnp.asarray(padded), jnp.int32(t),
-                self._next_key(), temps, self.cfg, top_k=self.top_k, **lkw)
+                self._next_key(), temps, self.cfg, top_k=self.top_k,
+                **lkw, **pkw)
             start = t
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
@@ -972,6 +1021,7 @@ class GenerationEngine:
         self._pos[slot] = start
         self._tok[slot] = first_tok
         self._temps[slot] = temp
+        self._top_ps[slot] = tp
         with self._lock:
             # prefill ran outside the lock: if the adapter was evicted in
             # that window (and its index possibly reused by a new tenant),
@@ -995,6 +1045,13 @@ class GenerationEngine:
         self._tokens += 1
         done = (req.generated >= req.max_new_tokens
                 or (self.eos_id is not None and tok == self.eos_id))
+        if req.stop and not done:
+            req.tail.append(tok)
+            maxlen = max(len(q) for q in req.stop)
+            del req.tail[:-maxlen]
+            done = any(len(q) <= len(req.tail)
+                       and req.tail[len(req.tail) - len(q):] == list(q)
+                       for q in req.stop)
         if done:
             self._retire_slot(slot)
 
@@ -1018,6 +1075,8 @@ class GenerationEngine:
             # one shared compiled step
             lkw = ({"banks": banks, "aidx": jnp.asarray(self._aidx),
                     "lora_scale": self._lora_cfg.scale} if banks else {})
+            if self._nucleus:
+                lkw["top_ps"] = jnp.asarray(self._top_ps)
             self._cache, nxt = _decode_step(
                 self.params, self._cache, jnp.asarray(self._pos),
                 jnp.asarray(self._tok), self._next_key(),
@@ -1105,10 +1164,12 @@ class GenerationEngine:
                  timeout: Optional[float] = 300.0, *,
                  temperature: Optional[float] = None,
                  prefix_id: Optional[int] = None,
-                 adapter_id: Optional[int] = None) -> List[int]:
+                 adapter_id: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 stop: Optional[Sequence] = None) -> List[int]:
         # timeout keeps its historical positional slot; the newer knobs are
         # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
         return self.submit(prompt, max_new_tokens, temperature=temperature,
-                           prefix_id=prefix_id,
-                           adapter_id=adapter_id).result(timeout=timeout)
+                           prefix_id=prefix_id, adapter_id=adapter_id,
+                           top_p=top_p, stop=stop).result(timeout=timeout)

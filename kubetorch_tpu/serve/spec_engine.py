@@ -149,6 +149,9 @@ class SpeculativeEngine(GenerationEngine):
             raise ValueError("SpeculativeEngine is greedy-only "
                              "(temperature=0); use GenerationEngine for "
                              "sampled serving")
+        if kwargs.get("top_p") is not None:
+            raise ValueError("top_p requires sampling — SpeculativeEngine "
+                             "is greedy-only; use GenerationEngine")
         if kwargs.get("quantize_kv"):
             raise ValueError("quantize_kv is not supported with "
                              "speculation yet — use GenerationEngine")
@@ -184,9 +187,14 @@ class SpeculativeEngine(GenerationEngine):
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                temperature: Optional[float] = None,
                prefix_id: Optional[int] = None,
-               adapter_id: Optional[int] = None):
+               adapter_id: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop: Optional[Sequence] = None):
         if temperature not in (None, 0.0):
             raise ValueError("SpeculativeEngine is greedy-only")
+        if top_p is not None:
+            raise ValueError("top_p requires sampling — SpeculativeEngine "
+                             "is greedy-only; use GenerationEngine")
         if prefix_id is not None or adapter_id is not None:
             raise ValueError("prefix/adapter serving is not supported with "
                              "speculation yet — use GenerationEngine")
@@ -200,7 +208,10 @@ class SpeculativeEngine(GenerationEngine):
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) + verify window ({2 * self.k + 1}) "
                 f"exceeds max_len ({self.max_len})")
-        return super().submit(prompt, max_new_tokens)
+        # stop sequences work unchanged: emission goes through the shared
+        # _emit suffix check, and speculation is exact-greedy so stopping
+        # early never changes the tokens that were already emitted
+        return super().submit(prompt, max_new_tokens, stop=stop)
 
     # -- admission ----------------------------------------------------------
 

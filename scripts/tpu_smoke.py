@@ -113,6 +113,22 @@ def main():
     print(f"scanned decode: {slots * new / sdt:.0f} tokens/s/chip "
           f"(batch {slots}, {new} steps on-device, {sdt:.2f}s)", flush=True)
 
+    # the bandwidth claims, measured where bandwidth is visible: decode is
+    # weight-bound, so int8 weights (half the HBM bytes) should approach 2x
+    # on the on-device scanned path — the engine.step() comparison below is
+    # relay-RTT-bound and can't show it
+    from kubetorch_tpu.serve import quantize_params as _qp
+
+    qparams = _qp(params)
+    out = generate(qparams, gp, cfg, max_new_tokens=new)
+    out.block_until_ready()
+    t0 = time.time()
+    out = generate(qparams, gp, cfg, max_new_tokens=new)
+    out.block_until_ready()
+    qsdt = time.time() - t0
+    print(f"scanned int8 decode: {slots * new / qsdt:.0f} tokens/s/chip "
+          f"({qsdt:.2f}s; speedup x{sdt / qsdt:.2f} vs bf16)", flush=True)
+
     # int8 weight-only decode: same grid, quantized weights — the
     # bandwidth-bound decode should approach 2x (weights are half the
     # HBM bytes); record the ratio

@@ -1,0 +1,245 @@
+"""Nucleus (top-p) sampling and stop sequences (serve/engine.py, generate).
+
+The correctness lever for top-p: as top_p → 0 the nucleus shrinks to the
+top-1 token, so a sampled run (any temperature) must reproduce the greedy
+run exactly — that pins the sort/cumsum/scatter mask with no statistical
+slack. Stop sequences: the stream must end exactly at the first suffix
+match, mirroring ``eos_id`` semantics (matching tokens emitted).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubetorch_tpu.models.generate import generate, nucleus_mask
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+from kubetorch_tpu.serve import GenerationEngine
+
+pytestmark = [pytest.mark.level("unit"), pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def dense():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _greedy(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def test_nucleus_mask_keeps_smallest_covering_prefix():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # p=0.6: top-1 (0.5) leaves mass-before 0.5 < 0.6 for token 1 too; token
+    # 2's preceding mass is 0.8 >= 0.6 → masked
+    masked = np.asarray(nucleus_mask(logits, jnp.asarray([0.6])))
+    assert np.isfinite(masked[0, :2]).all()
+    assert (masked[0, 2:] < -1e29).all()
+    # p→0 keeps exactly the argmax
+    masked = np.asarray(nucleus_mask(logits, jnp.asarray([1e-6])))
+    assert np.isfinite(masked[0, 0]) and (masked[0, 1:] < -1e29).all()
+    # p=1.0 keeps everything
+    masked = np.asarray(nucleus_mask(logits, jnp.asarray([1.0])))
+    assert np.isfinite(masked).all()
+
+
+def test_generate_top_p_tiny_equals_greedy(dense):
+    params, cfg = dense
+    prompt = [5, 17, 42, 99]
+    want = _greedy(params, cfg, prompt, 8)
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=8, temperature=1.0, top_p=1e-6,
+                   rng=jax.random.PRNGKey(7))
+    assert np.asarray(out)[0, len(prompt):].tolist() == want
+
+
+class TestEngineTopP:
+    def test_tiny_top_p_reproduces_greedy_per_slot(self, dense):
+        """One greedy slot and one hot-but-nucleus-collapsed slot share the
+        compiled step; both must match the greedy solo run."""
+        params, cfg = dense
+        p1, p2 = [7, 8, 9], [100, 200, 300]
+        w1, w2 = _greedy(params, cfg, p1, 6), _greedy(params, cfg, p2, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        h1 = eng.submit(p1, max_new_tokens=6)                    # greedy
+        h2 = eng.submit(p2, max_new_tokens=6, temperature=1.0,
+                        top_p=1e-6)                              # nucleus→top1
+        while eng.step():
+            pass
+        assert h1.result(timeout=0) == w1
+        assert h2.result(timeout=0) == w2
+
+    def test_engine_default_top_p(self, dense):
+        params, cfg = dense
+        prompt = [3, 4, 5]
+        want = _greedy(params, cfg, prompt, 5)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,), temperature=0.8,
+                               top_p=1e-6)
+        h = eng.submit(prompt, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_late_nucleus_request_on_warm_engine(self, dense):
+        """The sticky nucleus flag: an engine that has already compiled the
+        no-top-p step accepts a top_p request afterwards (second compiled
+        variant) and still decodes both correctly."""
+        params, cfg = dense
+        prompt = [9, 9, 2]
+        want = _greedy(params, cfg, prompt, 5)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        h0 = eng.submit(prompt, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h0.result(timeout=0) == want
+        h1 = eng.submit(prompt, max_new_tokens=5, temperature=1.0,
+                        top_p=1e-6)
+        while eng.step():
+            pass
+        assert h1.result(timeout=0) == want
+
+    def test_top_p_validation(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1, 2], max_new_tokens=2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1, 2], max_new_tokens=2, top_p=1.5)
+        # engine-level default is validated too (0.0 would mask ALL tokens)
+        with pytest.raises(ValueError, match="top_p"):
+            GenerationEngine(params, cfg, slots=1, max_len=32, top_p=0.0)
+
+    def test_top_p_one_does_not_arm_nucleus(self, dense):
+        """top_p=1.0 means 'disabled' — it must not compile in the
+        full-vocab sort path."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(8,), top_p=1.0)
+        assert eng._nucleus is False
+        eng2 = GenerationEngine(params, cfg, slots=1, max_len=32,
+                                prefill_buckets=(8,), top_p=0.9)
+        assert eng2._nucleus is True
+
+
+class TestStopSequences:
+    def test_single_stop_sequence_ends_stream_at_match(self, dense):
+        params, cfg = dense
+        prompt = [5, 17, 42, 99]
+        full = _greedy(params, cfg, prompt, 10)
+        stop = full[3:5]
+        # expected cut: the FIRST suffix match of the stop pair (weights/
+        # seed changes may surface it earlier than position 3)
+        first = next(i for i in range(len(full) - 1)
+                     if full[i:i + 2] == stop)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=10, stop=[stop])
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == full[:first + 2]  # stop tokens emitted
+
+    def test_single_token_stop_acts_like_eos(self, dense):
+        params, cfg = dense
+        prompt = [7, 8, 9]
+        full = _greedy(params, cfg, prompt, 8)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        # a flat list of ints is ONE stop sequence
+        h = eng.submit(prompt, max_new_tokens=8, stop=[full[2]])
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == full[:3]
+
+    def test_multiple_stop_sequences_first_match_wins(self, dense):
+        params, cfg = dense
+        prompt = [1, 2]
+        full = _greedy(params, cfg, prompt, 8)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=8,
+                       stop=[[12345], full[1:3], full[4:6]])
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == full[:3]
+
+    def test_no_match_runs_to_max_tokens(self, dense):
+        params, cfg = dense
+        prompt = [4, 4, 4]
+        full = _greedy(params, cfg, prompt, 6)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=6, stop=[[123456789]])
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == full
+
+    def test_stop_isolated_per_slot(self, dense):
+        """A stop sequence on one request must not clip its neighbor."""
+        params, cfg = dense
+        p1, p2 = [7, 8, 9], [100, 200, 300]
+        w1, w2 = _greedy(params, cfg, p1, 6), _greedy(params, cfg, p2, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        h1 = eng.submit(p1, max_new_tokens=6, stop=[w1[1:3]])
+        h2 = eng.submit(p2, max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h1.result(timeout=0) == w1[:3]
+        assert h2.result(timeout=0) == w2
+
+    def test_numpy_token_ids_accepted(self, dense):
+        """Tokenizer pipelines hand numpy ids; a flat numpy array is ONE
+        stop sequence, same as a flat list of python ints."""
+        params, cfg = dense
+        prompt = [7, 8, 9]
+        full = _greedy(params, cfg, prompt, 8)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=8,
+                       stop=np.asarray(full[2:4]))
+        while eng.step():
+            pass
+        first = next(i for i in range(len(full) - 1)
+                     if full[i:i + 2] == full[2:4])
+        assert h.result(timeout=0) == full[:first + 2]
+
+    def test_empty_stop_sequence_rejected(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="stop"):
+            eng.submit([1, 2], max_new_tokens=2, stop=[[]])
+
+
+def test_spec_engine_stop_and_top_p_refusal(dense):
+    from kubetorch_tpu.serve import SpeculativeEngine
+
+    params, cfg = dense
+    draft = llama_init(jax.random.PRNGKey(1), cfg)
+    full = None
+    eng = SpeculativeEngine(params, cfg, draft, cfg, spec_k=2, slots=2,
+                            max_len=64, prefill_buckets=(8,))
+    prompt = [5, 17, 42, 99]
+    h = eng.submit(prompt, max_new_tokens=10)
+    while eng.step():
+        pass
+    full = h.result(timeout=0)
+    h2 = eng.submit(prompt, max_new_tokens=10, stop=[full[3:5]])
+    while eng.step():
+        pass
+    assert h2.result(timeout=0) == full[:5]
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(prompt, max_new_tokens=4, top_p=0.5)
+    # the engine-wide kwarg is refused at construction, same as temperature
+    with pytest.raises(ValueError, match="top_p"):
+        SpeculativeEngine(params, cfg, draft, cfg, spec_k=2, slots=2,
+                          max_len=64, top_p=0.9)

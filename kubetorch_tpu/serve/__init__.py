@@ -24,4 +24,14 @@ from .speculative import SpecStats, speculative_generate
 __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
            "quantize_params", "dequantize_params", "quantized_bytes",
            "speculative_generate", "SpecStats", "SpeculativeEngine",
-           "QuantKVCache", "quantize_rows", "dequantize_rows"]
+           "QuantKVCache", "quantize_rows", "dequantize_rows",
+           "OpenAIApp", "build_openai_app"]
+
+
+def __getattr__(name):
+    # lazy: the OpenAI surface pulls in aiohttp, which pure-compute users
+    # of serve (engines in a training loop) never need
+    if name in ("OpenAIApp", "build_openai_app"):
+        from .openai_api import OpenAIApp, build_app
+        return {"OpenAIApp": OpenAIApp, "build_openai_app": build_app}[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,352 @@
+"""OpenAI-compatible HTTP surface over a ``GenerationEngine``.
+
+The lingua franca of LLM serving: ``/v1/completions``, ``/v1/chat/completions``
+(streaming and blocking), and ``/v1/models``, so off-the-shelf clients
+(openai-python, LangChain, curl scripts) talk to a kubetorch-tpu engine
+unchanged. The reference stack has no serving engine at all — this is the
+beyond-parity surface users coming from vLLM/TGI-on-kubetorch expect.
+
+Design:
+
+- **A thin aiohttp app around one engine.** The engine already owns
+  batching, sampling, stop handling, and streaming; the handlers only
+  translate JSON ↔ ``submit()``. Deployable three ways: mounted on the pod
+  server's extra-routes hook, standalone
+  (``python -m kubetorch_tpu.serve.openai_api --ckpt DIR``), or under
+  ``kt.app`` with that command.
+- **Tokenizer optional.** With a HF tokenizer (``AutoTokenizer`` or any
+  object with encode/decode), prompts and outputs are text and string
+  ``stop`` is honored by incremental decode + cut. Without one, prompts
+  must be token-id lists and outputs are ids — the hermetic test mode, and
+  the honest mode for callers that tokenize client-side.
+- **Streaming via SSE** (``data: {...}\\n\\n`` chunks, ``data: [DONE]``),
+  one chunk per decoded token. The engine's handle iterator is blocking, so
+  a worker thread pumps tokens into an asyncio queue.
+
+Wire-format compatibility is scoped to the fields the engine supports:
+``max_tokens``, ``temperature``, ``top_p``, ``stop``, ``stream``, ``seed``
+is ignored (engine RNG is per-process), ``n > 1``/``logprobs``/tool calls
+are rejected with an OpenAI-shaped error rather than half-implemented.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+__all__ = ["OpenAIApp", "build_app"]
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "param": None,
+                   "code": None}},
+        status=status)
+
+
+class _TextStopCutter:
+    """Incremental string-stop matching over a decoded stream: feed text
+    pieces, returns (emittable_text, done). Holds back a window of
+    ``max_stop - 1`` chars so a stop string split across tokens still
+    matches; on match, everything before the stop is emitted and the stop
+    itself is dropped (OpenAI semantics — unlike token-id stops, which
+    mirror eos and emit)."""
+
+    def __init__(self, stops: List[str]):
+        self.stops = [s for s in stops if s]
+        self.buf = ""
+        self.hold = max((len(s) for s in self.stops), default=1) - 1
+
+    def feed(self, piece: str):
+        if not self.stops:
+            return piece, False
+        self.buf += piece
+        cut = min((i for i in (self.buf.find(s) for s in self.stops)
+                   if i >= 0), default=-1)
+        if cut >= 0:
+            out, self.buf = self.buf[:cut], ""
+            return out, True
+        out = self.buf[:-self.hold] if self.hold else self.buf
+        self.buf = self.buf[len(out):]
+        return out, False
+
+    def flush(self) -> str:
+        out, self.buf = self.buf, ""
+        return out
+
+
+class OpenAIApp:
+    """``build()`` → aiohttp Application serving the OpenAI surface over
+    ``engine``. ``tokenizer`` is any HF-style object (``encode``/``decode``,
+    optionally ``apply_chat_template``); None = token-id mode."""
+
+    def __init__(self, engine, tokenizer=None,
+                 model_name: str = "kubetorch-tpu"):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self._req_ids = iter(range(1, 1 << 62))
+
+    # -- translation helpers ------------------------------------------------
+
+    def _encode_prompt(self, prompt) -> List[int]:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompts need a tokenizer; this deployment is "
+                    "token-id mode — send a list of token ids")
+            return list(self.tokenizer.encode(prompt))
+        if isinstance(prompt, list) and all(isinstance(t, int) for t in prompt):
+            return prompt
+        raise ValueError("prompt must be a string or a list of token ids")
+
+    def _split_stops(self, stop) -> (List[str], List[List[int]]):
+        """OpenAI ``stop`` (str or list of str; we also accept token-id
+        lists) → (text_stops, token_stops)."""
+        if stop is None:
+            return [], []
+        items = [stop] if isinstance(stop, str) else list(stop)
+        if len(items) > 4:
+            raise ValueError("at most 4 stop sequences")
+        text, toks = [], []
+        for s in items:
+            if isinstance(s, str):
+                text.append(s)
+            elif isinstance(s, list) and all(isinstance(t, int) for t in s):
+                toks.append(s)
+            else:
+                raise ValueError("stop entries must be strings or "
+                                 "token-id lists")
+        if text and self.tokenizer is None:
+            raise ValueError("string stop sequences need a tokenizer")
+        return text, toks
+
+    def _chat_prompt(self, messages) -> List[int]:
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("messages must be a non-empty list")
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m or "content" not in m:
+                raise ValueError("each message needs role and content")
+        if self.tokenizer is None:
+            raise ValueError("chat completions need a tokenizer")
+        apply = getattr(self.tokenizer, "apply_chat_template", None)
+        if apply is not None:
+            try:
+                return list(apply(messages, add_generation_prompt=True,
+                                  tokenize=True))
+            except Exception:
+                pass  # template-less tokenizer: fall through
+        text = "".join(f"<|{m['role']}|>{m['content']}\n" for m in messages)
+        return list(self.tokenizer.encode(text + "<|assistant|>"))
+
+    def _decode(self, ids: List[int]) -> str:
+        return self.tokenizer.decode(ids) if self.tokenizer else ""
+
+    def _submit(self, body: Dict[str, Any], prompt_ids: List[int]):
+        if body.get("n", 1) != 1:
+            raise ValueError("n > 1 is not supported")
+        if body.get("logprobs"):
+            raise ValueError("logprobs are not supported")
+        text_stops, tok_stops = self._split_stops(body.get("stop"))
+        temperature = float(body.get("temperature", 1.0))
+        top_p = body.get("top_p")
+        handle = self.engine.submit(
+            prompt_ids,
+            max_new_tokens=int(body.get("max_tokens", 16)),
+            temperature=temperature,
+            top_p=None if top_p is None else float(top_p),
+            stop=tok_stops or None)
+        return handle, _TextStopCutter(text_stops), tok_stops
+
+    # -- handlers -----------------------------------------------------------
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [
+            {"id": self.model_name, "object": "model",
+             "created": int(time.time()), "owned_by": "kubetorch-tpu"}]})
+
+    async def completions(self, request: web.Request) -> web.Response:
+        return await self._serve(request, chat=False)
+
+    async def chat_completions(self, request: web.Request) -> web.Response:
+        return await self._serve(request, chat=True)
+
+    async def _serve(self, request: web.Request, chat: bool) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "body must be JSON")
+        try:
+            prompt_ids = (self._chat_prompt(body.get("messages"))
+                          if chat else self._encode_prompt(body.get("prompt")))
+            handle, cutter, tok_stops = self._submit(body, prompt_ids)
+        except (ValueError, KeyError) as e:
+            return _error(400, str(e))
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{next(self._req_ids)}"
+        if body.get("stream"):
+            return await self._stream(request, handle, cutter, rid, chat,
+                                      tok_stops)
+        return await self._blocking(handle, cutter, rid, chat,
+                                    len(prompt_ids), tok_stops)
+
+    def _finished_by_stop(self, ids: List[int], tok_stops) -> bool:
+        if (self.engine.eos_id is not None and ids
+                and ids[-1] == self.engine.eos_id):
+            return True
+        return any(len(q) <= len(ids) and ids[len(ids) - len(q):] == list(q)
+                   for q in tok_stops)
+
+    async def _blocking(self, handle, cutter, rid, chat, n_prompt,
+                        tok_stops):
+        loop = asyncio.get_running_loop()
+        try:
+            ids = await loop.run_in_executor(None, handle.result)
+        except Exception as e:   # admission error surfaced via the handle
+            return _error(400, str(e))
+        text = None
+        finish = "stop" if self._finished_by_stop(ids, tok_stops) \
+            else "length"
+        if self.tokenizer is not None:
+            piece, matched = cutter.feed(self._decode(ids))
+            text = piece if matched else piece + cutter.flush()
+            if matched:
+                finish = "stop"
+        usage = {"prompt_tokens": n_prompt, "completion_tokens": len(ids),
+                 "total_tokens": n_prompt + len(ids)}
+        if chat:
+            choice = {"index": 0, "finish_reason": finish,
+                      "message": {"role": "assistant",
+                                  "content": text if text is not None
+                                  else None,
+                                  "token_ids": ids}}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "finish_reason": finish,
+                      "text": text if text is not None else "",
+                      "token_ids": ids}
+            obj = "text_completion"
+        return web.json_response(
+            {"id": rid, "object": obj, "created": int(time.time()),
+             "model": self.model_name, "choices": [choice], "usage": usage})
+
+    async def _stream(self, request, handle, cutter, rid, chat,
+                      tok_stops):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def pump():
+            try:
+                for tok in handle:
+                    loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+                loop.call_soon_threadsafe(q.put_nowait, ("end", None))
+            except Exception as e:  # pragma: no cover - admission errors
+                loop.call_soon_threadsafe(q.put_nowait, ("err", str(e)))
+
+        threading.Thread(target=pump, daemon=True,
+                         name="kt-openai-pump").start()
+
+        async def send(payload):
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        def chunk(piece, ids, finish=None):
+            delta_key = "delta" if chat else "text"
+            content = ({"content": piece} if chat else piece)
+            return {"id": rid,
+                    "object": ("chat.completion.chunk" if chat
+                               else "text_completion"),
+                    "created": int(time.time()), "model": self.model_name,
+                    "choices": [{"index": 0, delta_key: content,
+                                 "token_ids": ids,
+                                 "finish_reason": finish}]}
+
+        all_ids: List[int] = []
+        try:
+            while True:
+                kind, val = await q.get()
+                if kind == "err":
+                    await send(chunk("", [], "error"))
+                    break
+                if kind == "end":
+                    tail = cutter.flush() if self.tokenizer else ""
+                    if tail:
+                        await send(chunk(tail, []))
+                    finish = ("stop" if self._finished_by_stop(
+                        all_ids, tok_stops) else "length")
+                    await send(chunk("" if chat else "", [], finish))
+                    break
+                ids = [val]
+                all_ids.append(val)
+                if self.tokenizer is not None:
+                    piece, matched = cutter.feed(self._decode(ids))
+                    if piece:
+                        await send(chunk(piece, ids))
+                    if matched:
+                        # everything after the stop string is not ours to
+                        # emit: cancel the request (frees the slot at the
+                        # next step boundary) and close the stream now
+                        handle.cancel()
+                        await send(chunk("", [], "stop"))
+                        break
+                else:
+                    await send(chunk("", ids))
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            handle.cancel()     # client hung up: free the slot
+            raise
+        return resp
+
+    def build(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        return app
+
+
+def build_app(engine, tokenizer=None,
+              model_name: str = "kubetorch-tpu") -> web.Application:
+    return OpenAIApp(engine, tokenizer, model_name).build()
+
+
+def main(argv=None):
+    """Standalone server: HF checkpoint dir → engine → OpenAI API."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ckpt", required=True,
+                        help="HF save_pretrained directory")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--max-len", type=int, default=2048)
+    parser.add_argument("--int8", action="store_true")
+    parser.add_argument("--no-tokenizer", action="store_true",
+                        help="token-id mode (skip AutoTokenizer)")
+    args = parser.parse_args(argv)
+
+    from ..models.convert_hf import load_hf
+    from . import GenerationEngine, quantize_params
+
+    params, cfg = load_hf(args.ckpt, max_seq_len=args.max_len)
+    if args.int8:
+        params = quantize_params(params)
+    tokenizer = None
+    if not args.no_tokenizer:
+        import transformers
+        tokenizer = transformers.AutoTokenizer.from_pretrained(args.ckpt)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    engine = GenerationEngine(params, cfg, slots=args.slots,
+                              max_len=args.max_len, eos_id=eos).start()
+    web.run_app(build_app(engine, tokenizer), port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -237,9 +237,14 @@ def bench_worker(force_cpu: bool = False) -> int:
         # ~0.5B-param Llama-3 architecture that fits one 16G-HBM chip with
         # Adam state + remat. Sized via param_count below; batch tuned down
         # on RESOURCE_EXHAUSTED.
+        # remat off by default: measured on v5e, no-remat batch 4 (35,969
+        # tok/s, MFU 0.648) beats remat batch 8 (34,580, 0.623) — the
+        # recompute forward costs more than the smaller batch loses.
+        # KT_BENCH_REMAT=1 restores remat (bigger-HBM chips may prefer it).
         cfg = LlamaConfig(vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
                           n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
-                          attn_impl="flash", remat=True)
+                          attn_impl="flash",
+                          remat=os.environ.get("KT_BENCH_REMAT", "0") == "1")
         # start high and let the RESOURCE_EXHAUSTED handler halve: larger
         # batches amortize per-step overhead toward the 40% MFU target, and
         # a failed try costs one re-init inside the 600s attempt budget.

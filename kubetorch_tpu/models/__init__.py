@@ -20,6 +20,13 @@ def load_hf(path: str, **config_overrides):
     return _load(path, **config_overrides)
 
 
+def save_hf(params, cfg, path: str) -> None:
+    """Our pytree → HF ``save_pretrained`` dir (the reverse trip)."""
+    from .convert_hf import save_hf as _save
+    return _save(params, cfg, path)
+
+
 __all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
            "LoraConfig", "lora_init", "lora_loss", "merge_lora",
-           "VitConfig", "vit_init", "vit_forward", "vit_loss", "load_hf"]
+           "VitConfig", "vit_init", "vit_forward", "vit_loss", "load_hf",
+           "save_hf"]

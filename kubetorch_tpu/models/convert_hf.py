@@ -44,6 +44,7 @@ __all__ = [
     "config_from_hf",
     "params_from_hf",
     "load_hf",
+    "save_hf",
 ]
 
 
@@ -341,3 +342,108 @@ def load_hf(path: str, **config_overrides):
         model = transformers.AutoModelForCausalLM.from_pretrained(
             path, torch_dtype="auto")
     return params_from_hf(model, cfg, hf_config=hf), cfg
+
+
+# ---------------------------------------------------------------------------
+# export: our pytree → HF save_pretrained
+# ---------------------------------------------------------------------------
+
+
+def _interleave_to_half(w: np.ndarray, n_heads: int,
+                        head_dim: int) -> np.ndarray:
+    """Inverse of ``_deinterleave_rope``: interleaved RoPE pair columns
+    back to HF half-split order."""
+    d_in = w.shape[0]
+    w = w.reshape(d_in, n_heads, head_dim // 2, 2)
+    return w.transpose(0, 1, 3, 2).reshape(d_in, n_heads * head_dim)
+
+
+def _export_leaf(x):
+    import torch
+
+    from .quant import is_quantized
+    if isinstance(x, dict) and is_quantized(x):
+        raise ValueError(
+            "cannot export int8-quantized params — dequantize first "
+            "(serve.dequantize_params)")
+    # np.array (copy) rather than asarray: jax arrays export read-only
+    # views, which torch.from_numpy warns about and must not mutate
+    return torch.from_numpy(np.array(x, dtype=np.float32))
+
+
+def save_hf(params: Dict[str, Any], cfg, path: str) -> None:
+    """The reverse trip: our pytree → a HF ``save_pretrained`` directory
+    (Llama dense or Mixtral MoE), so a model fine-tuned or LoRA-merged here
+    goes straight back into the torch ecosystem. Weights export fp32
+    (norms/router already are; bf16 leaves upcast losslessly); load_hf →
+    save_hf → load_hf round-trips bit-exactly in fp32
+    (tests/test_convert_hf.py). Quantized pytrees refuse — dequantize
+    first; merge LoRA adapters first (``models.lora.merge_lora``)."""
+    import torch
+    import transformers
+
+    moe = isinstance(cfg, MoeConfig)
+    lay = params["layers"]
+    nh, nkv, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": _export_leaf(params["embed"]),
+        "model.norm.weight": _export_leaf(params["final_norm"]),
+        "lm_head.weight": _export_leaf(params["lm_head"]).T.contiguous(),
+    }
+    for i in range(L):
+        pre = f"model.layers.{i}"
+        wq = np.asarray(lay["wq"][i], np.float32)
+        wk = np.asarray(lay["wk"][i], np.float32)
+        sd[f"{pre}.input_layernorm.weight"] = _export_leaf(lay["attn_norm"][i])
+        sd[f"{pre}.self_attn.q_proj.weight"] = torch.from_numpy(
+            _interleave_to_half(wq, nh, hd).T.copy())
+        sd[f"{pre}.self_attn.k_proj.weight"] = torch.from_numpy(
+            _interleave_to_half(wk, nkv, hd).T.copy())
+        sd[f"{pre}.self_attn.v_proj.weight"] = \
+            _export_leaf(lay["wv"][i]).T.contiguous()
+        sd[f"{pre}.self_attn.o_proj.weight"] = \
+            _export_leaf(lay["wo"][i]).T.contiguous()
+        sd[f"{pre}.post_attention_layernorm.weight"] = \
+            _export_leaf(lay["ffn_norm"][i])
+        if moe:
+            sd[f"{pre}.block_sparse_moe.gate.weight"] = \
+                _export_leaf(lay["router"][i]).T.contiguous()
+            for e in range(cfg.n_experts):
+                ex = f"{pre}.block_sparse_moe.experts.{e}"
+                sd[f"{ex}.w1.weight"] = _export_leaf(
+                    lay["experts"]["w_gate"][i, e]).T.contiguous()
+                sd[f"{ex}.w3.weight"] = _export_leaf(
+                    lay["experts"]["w_up"][i, e]).T.contiguous()
+                sd[f"{ex}.w2.weight"] = _export_leaf(
+                    lay["experts"]["w_down"][i, e]).T.contiguous()
+        else:
+            sd[f"{pre}.mlp.gate_proj.weight"] = \
+                _export_leaf(lay["w_gate"][i]).T.contiguous()
+            sd[f"{pre}.mlp.up_proj.weight"] = \
+                _export_leaf(lay["w_up"][i]).T.contiguous()
+            sd[f"{pre}.mlp.down_proj.weight"] = \
+                _export_leaf(lay["w_down"][i]).T.contiguous()
+
+    common = dict(vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+                  num_hidden_layers=L, num_attention_heads=nh,
+                  num_key_value_heads=nkv, intermediate_size=cfg.ffn_dim,
+                  max_position_embeddings=cfg.max_seq_len,
+                  rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_eps,
+                  tie_word_embeddings=False)
+    if moe:
+        hf_cfg = transformers.MixtralConfig(
+            num_local_experts=cfg.n_experts,
+            num_experts_per_tok=cfg.experts_per_token,
+            sliding_window=None, **common)
+        model = transformers.MixtralForCausalLM(hf_cfg)
+    else:
+        rs = getattr(cfg, "rope_scaling", None)
+        if rs is not None:
+            common["rope_scaling"] = {
+                "rope_type": "llama3", "factor": rs[0],
+                "low_freq_factor": rs[1], "high_freq_factor": rs[2],
+                "original_max_position_embeddings": rs[3]}
+        hf_cfg = transformers.LlamaConfig(**common)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+    model.load_state_dict(sd, strict=True)
+    model.save_pretrained(path)

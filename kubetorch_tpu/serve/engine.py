@@ -287,7 +287,12 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None):
         from ..models.generate import nucleus_mask
         scaled = nucleus_mask(scaled, top_ps)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+    tok = jnp.where(temps > 0, sampled, greedy)
+    # raw-model (temperature-independent) logprob of the chosen token —
+    # the OpenAI ``logprobs`` number; one logsumexp against the matmuls
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
@@ -337,8 +342,8 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    nxt = _sample_slots(logits, rng, temps, top_k, top_ps)
-    return _constrain_cache(new_cache), nxt
+    nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    return _constrain_cache(new_cache), nxt, lps
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
@@ -378,7 +383,8 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    return _sample_slots(logits, rng, temps, top_k, top_ps), nk, nv
+    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    return first, nk, nv, lps
 
 
 
@@ -428,7 +434,8 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
-    return _sample_slots(logits, rng, temps, top_k, top_ps), nk, nv
+    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    return first, nk, nv, lps
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -486,6 +493,7 @@ class _Request:
     error: Optional[BaseException] = None    # admission failure, surfaced
     out: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     tail: list = field(default_factory=list)  # last max(len(stop)) tokens
+    logprobs: list = field(default_factory=list)  # raw-model lp per token
     generated: int = 0
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
@@ -507,6 +515,14 @@ class RequestHandle:
     @property
     def request_id(self) -> int:
         return self._req.rid
+
+    @property
+    def logprobs(self):
+        """Raw-model (temperature-independent) logprob per DRAINED token,
+        aligned with the tokens this handle has yielded so far (the full
+        completion after ``result()``). Entries are None on paths that
+        don't compute them (speculative verify)."""
+        return list(self._req.logprobs[:len(self._collected)])
 
     def cancel(self) -> bool:
         """Abandon this request (``GenerationEngine.cancel``): the stream
@@ -839,7 +855,7 @@ class GenerationEngine:
         bucket = next(b for b in self._buckets if b >= t)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :t] = tokens
-        _, k_new, v_new = _prefill(
+        _, k_new, v_new, _lp = _prefill(
             self.params, jnp.asarray(padded), jnp.int32(t), self._next_key(),
             jnp.zeros((1,), jnp.float32), self.cfg, top_k=self.top_k, **lkw)
         # Keep BUCKETED K/V: _prefill_suffix takes the true length as a
@@ -1000,7 +1016,7 @@ class GenerationEngine:
                 bucket = self.max_len - p_bucket
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
-            first, k_new, v_new = _prefill_suffix(
+            first, k_new, v_new, flp = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
                 jnp.int32(p_real), self._next_key(), temps, self.cfg,
                 top_k=self.top_k, **lkw, **pkw)
@@ -1009,7 +1025,7 @@ class GenerationEngine:
             bucket = next(b for b in self._buckets if b >= t)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
-            first, k_new, v_new = _prefill(
+            first, k_new, v_new, flp = _prefill(
                 self.params, jnp.asarray(padded), jnp.int32(t),
                 self._next_key(), temps, self.cfg, top_k=self.top_k,
                 **lkw, **pkw)
@@ -1032,14 +1048,19 @@ class GenerationEngine:
                 aidx = 0
             self._aidx[slot] = aidx
         self._admitted += 1
-        self._emit(slot, first_tok)
+        self._emit(slot, first_tok, float(flp[0]))
 
-    def _emit(self, slot: int, tok: int) -> None:
+    def _emit(self, slot: int, tok: int,
+              logprob: Optional[float] = None) -> None:
         req = self._slot_req[slot]
         if req is None:
             return
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
+        # appended before the queue put: a consumer that has seen token i
+        # can always read logprob i (None for paths that don't compute it,
+        # e.g. speculative verify)
+        req.logprobs.append(logprob)
         req.out.put(tok)
         req.generated += 1
         self._tokens += 1
@@ -1077,18 +1098,18 @@ class GenerationEngine:
                     "lora_scale": self._lora_cfg.scale} if banks else {})
             if self._nucleus:
                 lkw["top_ps"] = jnp.asarray(self._top_ps)
-            self._cache, nxt = _decode_step(
+            self._cache, nxt, lps = _decode_step(
                 self.params, self._cache, jnp.asarray(self._pos),
                 jnp.asarray(self._tok), self._next_key(),
                 jnp.asarray(self._temps), self.cfg, top_k=self.top_k, **lkw)
-            nxt = np.asarray(nxt)
+            nxt, lps = np.asarray(nxt), np.asarray(lps)
             self._steps += 1
             for slot in active:
                 # the token decoded this step consumed position _pos[slot];
                 # feed the new one back at the next position
                 self._pos[slot] += 1
                 self._tok[slot] = int(nxt[slot])
-                self._emit(slot, int(nxt[slot]))
+                self._emit(slot, int(nxt[slot]), float(lps[slot]))
         with self._lock:
             queued = len(self._pending)
         return sum(r is not None for r in self._slot_req) + queued

@@ -150,8 +150,11 @@ class OpenAIApp:
     def _submit(self, body: Dict[str, Any], prompt_ids: List[int]):
         if body.get("n", 1) != 1:
             raise ValueError("n > 1 is not supported")
-        if body.get("logprobs"):
-            raise ValueError("logprobs are not supported")
+        lp = body.get("logprobs")
+        if (isinstance(lp, int) and lp > 1) or body.get("top_logprobs"):
+            raise ValueError("only the chosen token's logprob is available "
+                             "(logprobs=1/true); top-k logprobs are not "
+                             "supported")
         text_stops, tok_stops = self._split_stops(body.get("stop"))
         temperature = float(body.get("temperature", 1.0))
         top_p = body.get("top_p")
@@ -188,11 +191,13 @@ class OpenAIApp:
         except (ValueError, KeyError) as e:
             return _error(400, str(e))
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{next(self._req_ids)}"
+        want_logprobs = bool(body.get("logprobs"))
         if body.get("stream"):
             return await self._stream(request, handle, cutter, rid, chat,
-                                      tok_stops)
+                                      tok_stops, want_logprobs)
         return await self._blocking(handle, cutter, rid, chat,
-                                    len(prompt_ids), tok_stops)
+                                    len(prompt_ids), tok_stops,
+                                    want_logprobs)
 
     def _finished_by_stop(self, ids: List[int], tok_stops) -> bool:
         if (self.engine.eos_id is not None and ids
@@ -202,7 +207,7 @@ class OpenAIApp:
                    for q in tok_stops)
 
     async def _blocking(self, handle, cutter, rid, chat, n_prompt,
-                        tok_stops):
+                        tok_stops, want_logprobs=False):
         loop = asyncio.get_running_loop()
         try:
             ids = await loop.run_in_executor(None, handle.result)
@@ -218,24 +223,36 @@ class OpenAIApp:
                 finish = "stop"
         usage = {"prompt_tokens": n_prompt, "completion_tokens": len(ids),
                  "total_tokens": n_prompt + len(ids)}
+        lps = handle.logprobs if want_logprobs else None
         if chat:
             choice = {"index": 0, "finish_reason": finish,
                       "message": {"role": "assistant",
                                   "content": text if text is not None
                                   else None,
                                   "token_ids": ids}}
+            if lps is not None:
+                choice["logprobs"] = {"content": [
+                    {"token": self._decode([t]) if self.tokenizer else str(t),
+                     "logprob": lp, "bytes": None}
+                    for t, lp in zip(ids, lps)]}
             obj = "chat.completion"
         else:
             choice = {"index": 0, "finish_reason": finish,
                       "text": text if text is not None else "",
                       "token_ids": ids}
+            if lps is not None:
+                choice["logprobs"] = {
+                    "tokens": [self._decode([t]) if self.tokenizer
+                               else str(t) for t in ids],
+                    "token_logprobs": lps,
+                    "top_logprobs": None, "text_offset": None}
             obj = "text_completion"
         return web.json_response(
             {"id": rid, "object": obj, "created": int(time.time()),
              "model": self.model_name, "choices": [choice], "usage": usage})
 
     async def _stream(self, request, handle, cutter, rid, chat,
-                      tok_stops):
+                      tok_stops, want_logprobs=False):
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache"})
@@ -246,7 +263,8 @@ class OpenAIApp:
         def pump():
             try:
                 for tok in handle:
-                    loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+                    lp = handle.logprobs[-1] if want_logprobs else None
+                    loop.call_soon_threadsafe(q.put_nowait, ("tok", (tok, lp)))
                 loop.call_soon_threadsafe(q.put_nowait, ("end", None))
             except Exception as e:  # pragma: no cover - admission errors
                 loop.call_soon_threadsafe(q.put_nowait, ("err", str(e)))
@@ -257,16 +275,18 @@ class OpenAIApp:
         async def send(payload):
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
-        def chunk(piece, ids, finish=None):
+        def chunk(piece, ids, finish=None, lp=None):
             delta_key = "delta" if chat else "text"
             content = ({"content": piece} if chat else piece)
+            c = {"index": 0, delta_key: content, "token_ids": ids,
+                 "finish_reason": finish}
+            if lp is not None:
+                c["logprob"] = lp
             return {"id": rid,
                     "object": ("chat.completion.chunk" if chat
                                else "text_completion"),
                     "created": int(time.time()), "model": self.model_name,
-                    "choices": [{"index": 0, delta_key: content,
-                                 "token_ids": ids,
-                                 "finish_reason": finish}]}
+                    "choices": [c]}
 
         all_ids: List[int] = []
         try:
@@ -283,12 +303,13 @@ class OpenAIApp:
                         all_ids, tok_stops) else "length")
                     await send(chunk("" if chat else "", [], finish))
                     break
+                val, lp = val
                 ids = [val]
                 all_ids.append(val)
                 if self.tokenizer is not None:
                     piece, matched = cutter.feed(self._decode(ids))
                     if piece:
-                        await send(chunk(piece, ids))
+                        await send(chunk(piece, ids, lp=lp))
                     if matched:
                         # everything after the stop string is not ours to
                         # emit: cancel the request (frees the slot at the
@@ -297,7 +318,7 @@ class OpenAIApp:
                         await send(chunk("", [], "stop"))
                         break
                 else:
-                    await send(chunk("", ids))
+                    await send(chunk("", ids, lp=lp))
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             handle.cancel()     # client hung up: free the slot

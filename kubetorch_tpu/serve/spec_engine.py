@@ -222,14 +222,14 @@ class SpeculativeEngine(GenerationEngine):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :t] = req.prompt
         block = jnp.asarray(padded)
-        first, k_new, v_new = _prefill(
+        first, k_new, v_new, _flp = _prefill(
             self.params, block, jnp.int32(t), self._next_key(), temps,
             self.cfg)
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
         # the draft prefills the same prompt into ITS grid (its first-token
         # sample is discarded — the target owns every emitted token)
-        _, dk, dv = _prefill(self.draft_params, block, jnp.int32(t),
+        _, dk, dv, _dlp = _prefill(self.draft_params, block, jnp.int32(t),
                              self._next_key(), temps, self.draft_cfg)
         self._draft_cache = _splice_slot(self._draft_cache, jnp.int32(slot),
                                          dk, dv)
@@ -293,7 +293,7 @@ class SpeculativeEngine(GenerationEngine):
         props = [tok]
         zeros = jnp.zeros(b, jnp.float32)
         for i in range(k - 1):
-            self._draft_cache, tok = _decode_step(
+            self._draft_cache, tok, _lp = _decode_step(
                 self.draft_params, self._draft_cache,
                 jnp.asarray(start + c + i), tok, self._next_key(), zeros,
                 self.draft_cfg)

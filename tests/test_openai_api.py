@@ -258,3 +258,48 @@ def test_text_stop_cutter_split_across_pieces():
     assert out1 + out2 + out3 == "abc"
     c2 = _TextStopCutter([])
     assert c2.feed("anything") == ("anything", False)
+
+
+def test_logprobs_blocking_and_streaming(dense):
+    params, cfg = dense
+    prompt = [5, 17, 42, 99]
+
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny", "prompt": prompt, "max_tokens": 5,
+            "temperature": 0, "logprobs": 1})
+        data = await r.json()
+        choice = data["choices"][0]
+        lp = choice["logprobs"]
+        assert len(lp["token_logprobs"]) == 5
+        assert all(isinstance(x, float) and x <= 0 for x in lp["token_logprobs"])
+        assert len(lp["tokens"]) == 5
+        # streaming carries per-chunk logprob
+        r = await client.post("/v1/completions", json={
+            "model": "tiny", "prompt": prompt, "max_tokens": 5,
+            "temperature": 0, "logprobs": True, "stream": True})
+        events = await _sse_events(r)
+        lps = [e["choices"][0]["logprob"] for e in events[:-1]
+               if e["choices"][0].get("token_ids")]
+        assert lps == lp["token_logprobs"]
+        # top-k logprobs refuse
+        r = await client.post("/v1/completions", json={
+            "model": "tiny", "prompt": prompt, "max_tokens": 2,
+            "logprobs": 5})
+        assert r.status == 400
+    run_api_test(dense, body)
+
+
+def test_chat_logprobs_content_format(dense):
+    tok = FakeTokenizer()
+
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "tiny", "max_tokens": 3, "temperature": 0,
+            "logprobs": True,
+            "messages": [{"role": "user", "content": "yo"}]})
+        data = await r.json()
+        content = data["choices"][0]["logprobs"]["content"]
+        assert len(content) == 3
+        assert all("token" in c and c["logprob"] <= 0 for c in content)
+    run_api_test(dense, body, tokenizer=tok)

@@ -243,3 +243,59 @@ def test_spec_engine_stop_and_top_p_refusal(dense):
     with pytest.raises(ValueError, match="top_p"):
         SpeculativeEngine(params, cfg, draft, cfg, spec_k=2, slots=2,
                           max_len=64, top_p=0.9)
+
+
+class TestLogprobs:
+    def test_greedy_logprobs_match_forward_oracle(self, dense):
+        """handle.logprobs[i] must equal log_softmax(logits) at the chosen
+        token, where logits come from an independent full forward over
+        prompt + completion."""
+        from kubetorch_tpu.models.llama import llama_forward
+
+        params, cfg = dense
+        prompt = [5, 17, 42, 99]
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=6)
+        while eng.step():
+            pass
+        toks = h.result(timeout=0)
+        lps = h.logprobs
+        assert len(lps) == len(toks) and all(lp is not None for lp in lps)
+        full = jnp.asarray([prompt + toks], jnp.int32)
+        logits = np.asarray(llama_forward(params, full, cfg))  # (1, T, V)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        for i, (t, lp) in enumerate(zip(toks, lps)):
+            want = logp[0, len(prompt) - 1 + i, t]
+            assert abs(lp - want) < 1e-4, (i, lp, want)
+
+    def test_streaming_alignment_mid_flight(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit([1, 2, 3], max_new_tokens=5)
+        seen = []
+        it = iter(h)
+        while eng.step():
+            pass
+        for tok in it:
+            seen.append(tok)
+            lps = h.logprobs
+            assert len(lps) == len(seen)      # never lags the stream
+        assert len(seen) == 5
+
+    def test_spec_engine_logprobs_are_none(self, dense):
+        from kubetorch_tpu.serve import SpeculativeEngine
+
+        params, cfg = dense
+        draft = llama_init(jax.random.PRNGKey(1), cfg)
+        eng = SpeculativeEngine(params, cfg, draft, cfg, spec_k=2, slots=1,
+                                max_len=64, prefill_buckets=(8,))
+        h = eng.submit([5, 17], max_new_tokens=4)
+        while eng.step():
+            pass
+        toks = h.result(timeout=0)
+        lps = h.logprobs
+        assert len(lps) == len(toks)
+        # speculative emissions (admission + verify) don't compute logprobs
+        assert all(lp is None for lp in lps)

@@ -299,7 +299,8 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None):
          donate_argnums=(1,))
 def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
-                 lora_scale: float = 1.0, top_ps=None):
+                 lora_scale: float = 1.0, top_ps=None,
+                 counts=None, fpen=None, ppen=None):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
     temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
@@ -342,14 +343,24 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    if counts is not None:
+        # OpenAI-style repetition control: subtract per-token penalties
+        # derived from each slot's seen-token counts (prompt + generated)
+        # BEFORE sampling — greedy slots with zero penalties see logits
+        # unchanged, so isolation holds bit-exactly
+        logits = logits - (fpen[:, None] * counts.astype(jnp.float32)
+                           + ppen[:, None] * (counts > 0))
     nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    if counts is not None:
+        counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(1)
+        return _constrain_cache(new_cache), nxt, lps, counts
     return _constrain_cache(new_cache), nxt, lps
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill(params, tokens, true_len, rng, temps, cfg,
              top_k: Optional[int] = None, adapter=None,
-             lora_scale: float = 1.0, top_ps=None):
+             lora_scale: float = 1.0, top_ps=None, pen_row=None):
     """Prompt pass at one bucket length. tokens (1, T_bucket) right-padded;
     logits are taken at the REAL last position ``true_len - 1`` (padding
     rows only pollute their own cache rows, which decode overwrites before
@@ -383,6 +394,8 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    if pen_row is not None:
+        logits = logits - pen_row[None, :]
     first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
     return first, nk, nv, lps
 
@@ -392,7 +405,8 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
 def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
                     rng, temps, cfg, top_k: Optional[int] = None,
-                    adapter=None, lora_scale: float = 1.0, top_ps=None):
+                    adapter=None, lora_scale: float = 1.0, top_ps=None,
+                    pen_row=None):
     """Suffix prompt pass behind a cached prefix: tokens (1, T_bucket)
     right-padded run at absolute positions ``prefix_len + i`` attending the
     prefix's REAL K/V rows plus themselves. The prefix stays padded to its
@@ -434,8 +448,18 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    if pen_row is not None:
+        logits = logits - pen_row[None, :]
     first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
     return first, nk, nv, lps
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_counts_row(counts, slot, row):
+    """Seed one slot's seen-token counts at admission (prompt + prefix +
+    first sampled token); stale rows from prior occupants never matter —
+    zero-penalty slots multiply them by 0."""
+    return counts.at[slot].set(row)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -486,6 +510,8 @@ class _Request:
     max_new_tokens: int
     temperature: Optional[float] = None      # None → engine default
     top_p: Optional[float] = None            # None → engine default
+    frequency_penalty: float = 0.0           # OpenAI-style repetition ctl
+    presence_penalty: float = 0.0
     stop: tuple = ()                         # stop token-id sequences
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
     adapter_id: Optional[int] = None         # registered LoRA adapter
@@ -643,6 +669,12 @@ class GenerationEngine:
         self._pending: "deque[_Request]" = deque()
         self._temps = np.zeros(self.slots, np.float32)
         self._top_ps = np.ones(self.slots, np.float32)
+        self._fpen = np.zeros(self.slots, np.float32)
+        self._ppen = np.zeros(self.slots, np.float32)
+        # (SLOTS, V) seen-token counts, allocated on the first penalized
+        # request (sticky, like _nucleus): V-sized buffers and the per-step
+        # scatter only exist once someone pays for them
+        self._counts = None
         # sticky: flips on the first nucleus request so the common
         # no-top-p engine never compiles (or pays for) the vocab sort;
         # afterwards both step variants stay in the jit cache
@@ -784,6 +816,8 @@ class GenerationEngine:
                prefix_id: Optional[int] = None,
                adapter_id: Optional[int] = None,
                top_p: Optional[float] = None,
+               frequency_penalty: float = 0.0,
+               presence_penalty: float = 0.0,
                stop: Optional[Sequence] = None) -> RequestHandle:
         """Queue one request. ``temperature`` overrides the engine default
         for THIS request only (0 = greedy) — per-slot temperatures share the
@@ -823,6 +857,8 @@ class GenerationEngine:
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
                        temperature=temperature, prefix_id=prefix_id,
                        adapter_id=adapter_id, top_p=top_p,
+                       frequency_penalty=float(frequency_penalty),
+                       presence_penalty=float(presence_penalty),
                        stop=_normalize_stop(stop))
         with self._lock:
             self._pending.append(req)
@@ -872,7 +908,7 @@ class GenerationEngine:
             k_new = k_new[:, :, :store]
             v_new = v_new[:, :, :store]
         pid = next(self._prefix_ids)
-        self._prefixes[pid] = (k_new, v_new, t)
+        self._prefixes[pid] = (k_new, v_new, t, tuple(tokens))
         return pid
 
     def unregister_prefix(self, prefix_id: int) -> bool:
@@ -932,6 +968,8 @@ class GenerationEngine:
         self._tok[slot] = 0
         self._temps[slot] = 0.0
         self._top_ps[slot] = 1.0
+        self._fpen[slot] = 0.0
+        self._ppen[slot] = 0.0
         self._aidx[slot] = 0
         self._finished += 1
         self._free_slot_ledgers(slot)
@@ -1001,11 +1039,28 @@ class GenerationEngine:
             self._nucleus = True
         pkw = {"top_ps": jnp.full((1,), tp, jnp.float32)} \
             if self._nucleus else {}
+        fp, pp = req.frequency_penalty, req.presence_penalty
+        if (fp or pp) and self._counts is None:
+            self._counts = jnp.zeros((self.slots, self.cfg.vocab_size),
+                                     jnp.int32)
+        row = None
+        if self._counts is not None:
+            seen = list(req.prompt)
+            if req.prefix_id is not None:
+                seen += list(self._prefixes[req.prefix_id][3])
+            row = np.zeros(self.cfg.vocab_size, np.int32)
+            np.add.at(row, np.asarray(seen, np.int64), 1)
+            if fp or pp:
+                # penalties apply to the FIRST sampled token too (the
+                # prompt is "text so far" — OpenAI semantics)
+                pkw["pen_row"] = jnp.asarray(
+                    fp * row.astype(np.float32)
+                    + pp * (row > 0).astype(np.float32))
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})
         if req.prefix_id is not None:
-            pk, pv, p_real = self._prefixes[req.prefix_id]
+            pk, pv, p_real, p_toks = self._prefixes[req.prefix_id]
             p_bucket = pk.shape[2]
             bucket = next((b for b in self._buckets if b >= t
                            and p_bucket + b <= self.max_len), None)
@@ -1038,6 +1093,12 @@ class GenerationEngine:
         self._tok[slot] = first_tok
         self._temps[slot] = temp
         self._top_ps[slot] = tp
+        self._fpen[slot] = fp
+        self._ppen[slot] = pp
+        if row is not None:
+            row[first_tok] += 1
+            self._counts = _set_counts_row(self._counts, jnp.int32(slot),
+                                           jnp.asarray(row))
         with self._lock:
             # prefill ran outside the lock: if the adapter was evicted in
             # that window (and its index possibly reused by a new tenant),
@@ -1098,10 +1159,18 @@ class GenerationEngine:
                     "lora_scale": self._lora_cfg.scale} if banks else {})
             if self._nucleus:
                 lkw["top_ps"] = jnp.asarray(self._top_ps)
-            self._cache, nxt, lps = _decode_step(
+            if self._counts is not None:
+                lkw.update(counts=self._counts,
+                           fpen=jnp.asarray(self._fpen),
+                           ppen=jnp.asarray(self._ppen))
+            out = _decode_step(
                 self.params, self._cache, jnp.asarray(self._pos),
                 jnp.asarray(self._tok), self._next_key(),
                 jnp.asarray(self._temps), self.cfg, top_k=self.top_k, **lkw)
+            if self._counts is not None:
+                self._cache, nxt, lps, self._counts = out
+            else:
+                self._cache, nxt, lps = out
             nxt, lps = np.asarray(nxt), np.asarray(lps)
             self._steps += 1
             for slot in active:
@@ -1187,10 +1256,14 @@ class GenerationEngine:
                  prefix_id: Optional[int] = None,
                  adapter_id: Optional[int] = None,
                  top_p: Optional[float] = None,
+                 frequency_penalty: float = 0.0,
+                 presence_penalty: float = 0.0,
                  stop: Optional[Sequence] = None) -> List[int]:
         # timeout keeps its historical positional slot; the newer knobs are
         # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
         return self.submit(prompt, max_new_tokens, temperature=temperature,
                            prefix_id=prefix_id, adapter_id=adapter_id,
-                           top_p=top_p, stop=stop).result(timeout=timeout)
+                           top_p=top_p, frequency_penalty=frequency_penalty,
+                           presence_penalty=presence_penalty,
+                           stop=stop).result(timeout=timeout)

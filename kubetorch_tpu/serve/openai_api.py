@@ -163,6 +163,8 @@ class OpenAIApp:
             max_new_tokens=int(body.get("max_tokens", 16)),
             temperature=temperature,
             top_p=None if top_p is None else float(top_p),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
             stop=tok_stops or None)
         return handle, _TextStopCutter(text_stops), tok_stops
 

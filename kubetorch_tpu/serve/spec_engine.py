@@ -189,12 +189,20 @@ class SpeculativeEngine(GenerationEngine):
                prefix_id: Optional[int] = None,
                adapter_id: Optional[int] = None,
                top_p: Optional[float] = None,
+               frequency_penalty: float = 0.0,
+               presence_penalty: float = 0.0,
                stop: Optional[Sequence] = None):
         if temperature not in (None, 0.0):
             raise ValueError("SpeculativeEngine is greedy-only")
         if top_p is not None:
             raise ValueError("top_p requires sampling — SpeculativeEngine "
                              "is greedy-only; use GenerationEngine")
+        if frequency_penalty or presence_penalty:
+            # penalties change even the greedy argmax, which would break
+            # the exact-verification acceptance rule (target argmax is
+            # computed penalty-free in the verify window)
+            raise ValueError("repetition penalties are not supported with "
+                             "speculation — use GenerationEngine")
         if prefix_id is not None or adapter_id is not None:
             raise ValueError("prefix/adapter serving is not supported with "
                              "speculation yet — use GenerationEngine")

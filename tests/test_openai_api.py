@@ -303,3 +303,17 @@ def test_chat_logprobs_content_format(dense):
         assert len(content) == 3
         assert all("token" in c and c["logprob"] <= 0 for c in content)
     run_api_test(dense, body, tokenizer=tok)
+
+
+def test_penalties_pass_through(dense):
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "tiny", "prompt": [5, 17, 42], "max_tokens": 8,
+            "temperature": 0, "presence_penalty": 1e9})
+        data = await r.json()
+        toks = data["choices"][0]["token_ids"]
+        seen = {5, 17, 42}
+        for t in toks:
+            assert t not in seen
+            seen.add(t)
+    run_api_test(dense, body)

@@ -299,3 +299,82 @@ class TestLogprobs:
         assert len(lps) == len(toks)
         # speculative emissions (admission + verify) don't compute logprobs
         assert all(lp is None for lp in lps)
+
+
+class TestRepetitionPenalties:
+    def test_huge_presence_penalty_never_repeats(self, dense):
+        """With an overwhelming presence penalty, greedy decode can never
+        emit a token it has already seen (prompt included)."""
+        params, cfg = dense
+        prompt = [5, 17, 42]
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=10, presence_penalty=1e9)
+        while eng.step():
+            pass
+        toks = h.result(timeout=0)
+        seen = set(prompt)
+        for t in toks:
+            assert t not in seen, (t, toks)
+            seen.add(t)
+
+    def test_zero_penalty_neighbor_is_bit_exact(self, dense):
+        """A penalized slot must not perturb its zero-penalty neighbor even
+        though the counts buffer is live for the whole grid."""
+        params, cfg = dense
+        p1, p2 = [7, 8, 9], [100, 200, 300]
+        w2 = _greedy(params, cfg, p2, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(8,))
+        h1 = eng.submit(p1, max_new_tokens=6, frequency_penalty=5.0)
+        h2 = eng.submit(p2, max_new_tokens=6)
+        while eng.step():
+            pass
+        h1.result(timeout=0)
+        assert h2.result(timeout=0) == w2
+
+    def test_first_token_respects_prompt_counts(self, dense):
+        """The prompt is 'text so far': the token a solo run would pick
+        first, if placed in the prompt, must be avoided under a huge
+        presence penalty — starting from the very first sample."""
+        params, cfg = dense
+        prompt = [4, 4, 4]
+        solo_first = _greedy(params, cfg, prompt, 1)[0]
+        prompt2 = prompt + [solo_first]
+        # make sure the construction is meaningful: the natural first
+        # token of prompt2 may differ; assert only the penalty guarantee
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt2, max_new_tokens=3, presence_penalty=1e9)
+        while eng.step():
+            pass
+        toks = h.result(timeout=0)
+        assert toks[0] not in set(prompt2)
+
+    def test_slot_reuse_clears_penalties(self, dense):
+        """After a penalized request retires, the next occupant of the same
+        slot with no penalties matches its solo run (stale counts rows are
+        neutralized by zero penalty vectors)."""
+        params, cfg = dense
+        prompt = [1, 2, 3]
+        want = _greedy(params, cfg, prompt, 5)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h1 = eng.submit([9, 9], max_new_tokens=4, frequency_penalty=3.0)
+        while eng.step():
+            pass
+        h1.result(timeout=0)
+        h2 = eng.submit(prompt, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == want
+
+    def test_spec_engine_refuses_penalties(self, dense):
+        from kubetorch_tpu.serve import SpeculativeEngine
+
+        params, cfg = dense
+        draft = llama_init(jax.random.PRNGKey(1), cfg)
+        eng = SpeculativeEngine(params, cfg, draft, cfg, spec_k=2, slots=1,
+                                max_len=64, prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="penalt"):
+            eng.submit([1, 2], max_new_tokens=2, presence_penalty=0.5)

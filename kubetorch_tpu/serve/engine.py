@@ -270,7 +270,8 @@ def _decode_layer_quant(cfg, x, lw, kq, ks, vq, vs, pos, freqs, lora=None):
     return x + ffn_block(cfg, h, lw), kq, ks, vq, vs
 
 
-def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None):
+def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None,
+                  lp_logits=None):
     """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot;
     ``top_ps`` (B,) — nucleus mass per slot, 1.0 disables. Vectorized
     (traced arrays, not statics) so requests with different temperatures /
@@ -289,14 +290,17 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None):
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     tok = jnp.where(temps > 0, sampled, greedy)
     # raw-model (temperature-independent) logprob of the chosen token —
-    # the OpenAI ``logprobs`` number; one logsumexp against the matmuls
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # the OpenAI ``logprobs`` number; one logsumexp against the matmuls.
+    # ``lp_logits`` lets penalty-adjusted callers pass the PRE-penalty
+    # logits here, keeping the score raw while the choice is steered.
+    logp = jax.nn.log_softmax(logits if lp_logits is None else lp_logits,
+                              axis=-1)
     lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
     return tok, lp
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
-         donate_argnums=(1,))
+         donate_argnums=(1,), donate_argnames=("counts",))
 def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
                  lora_scale: float = 1.0, top_ps=None,
@@ -343,14 +347,17 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    raw_logits = logits
     if counts is not None:
         # OpenAI-style repetition control: subtract per-token penalties
         # derived from each slot's seen-token counts (prompt + generated)
         # BEFORE sampling — greedy slots with zero penalties see logits
-        # unchanged, so isolation holds bit-exactly
+        # unchanged, so isolation holds bit-exactly. Reported logprobs
+        # stay RAW-model (penalties steer the choice, not the score).
         logits = logits - (fpen[:, None] * counts.astype(jnp.float32)
                            + ppen[:, None] * (counts > 0))
-    nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps,
+                             lp_logits=raw_logits)
     if counts is not None:
         counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(1)
         return _constrain_cache(new_cache), nxt, lps, counts
@@ -394,9 +401,11 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    raw_logits = logits
     if pen_row is not None:
         logits = logits - pen_row[None, :]
-    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps,
+                               lp_logits=raw_logits)
     return first, nk, nv, lps
 
 
@@ -448,9 +457,11 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
     logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    raw_logits = logits
     if pen_row is not None:
         logits = logits - pen_row[None, :]
-    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps)
+    first, lps = _sample_slots(logits, rng, temps, top_k, top_ps,
+                               lp_logits=raw_logits)
     return first, nk, nv, lps
 
 
@@ -1044,18 +1055,20 @@ class GenerationEngine:
             self._counts = jnp.zeros((self.slots, self.cfg.vocab_size),
                                      jnp.int32)
         row = None
-        if self._counts is not None:
+        if fp or pp:
+            # only penalized requests pay the V-sized row (zero-penalty
+            # neighbors neutralize any stale row by multiplying it by 0,
+            # so they need no seeding at all)
             seen = list(req.prompt)
             if req.prefix_id is not None:
                 seen += list(self._prefixes[req.prefix_id][3])
             row = np.zeros(self.cfg.vocab_size, np.int32)
             np.add.at(row, np.asarray(seen, np.int64), 1)
-            if fp or pp:
-                # penalties apply to the FIRST sampled token too (the
-                # prompt is "text so far" — OpenAI semantics)
-                pkw["pen_row"] = jnp.asarray(
-                    fp * row.astype(np.float32)
-                    + pp * (row > 0).astype(np.float32))
+            # penalties apply to the FIRST sampled token too (the prompt
+            # is "text so far" — OpenAI semantics)
+            pkw["pen_row"] = jnp.asarray(
+                fp * row.astype(np.float32)
+                + pp * (row > 0).astype(np.float32))
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})

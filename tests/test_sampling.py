@@ -378,3 +378,24 @@ class TestRepetitionPenalties:
                                 max_len=64, prefill_buckets=(8,))
         with pytest.raises(ValueError, match="penalt"):
             eng.submit([1, 2], max_new_tokens=2, presence_penalty=0.5)
+
+    def test_logprobs_stay_raw_under_penalties(self, dense):
+        """Penalties steer the CHOICE; the reported logprob is still the
+        raw model's score for whatever token was chosen."""
+        from kubetorch_tpu.models.llama import llama_forward
+
+        params, cfg = dense
+        prompt = [5, 17, 42]
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=5, presence_penalty=1e9)
+        while eng.step():
+            pass
+        toks = h.result(timeout=0)
+        lps = h.logprobs
+        full = jnp.asarray([prompt + toks], jnp.int32)
+        logits = np.asarray(llama_forward(params, full, cfg))
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        for i, (t, lp) in enumerate(zip(toks, lps)):
+            want = logp[0, len(prompt) - 1 + i, t]
+            assert abs(lp - want) < 1e-4, (i, lp, want)

@@ -42,6 +42,32 @@ from aiohttp import web
 __all__ = ["OpenAIApp", "build_app"]
 
 
+_POOLED_HIDDEN_JIT = None
+
+
+def _pooled_hidden(params, tokens, true_len, cfg):
+    """(1, T_bucket) right-padded → (D,) fp32 mean over the real tokens of
+    the final-norm hidden states. jit'd ONCE at module level (per bucket ×
+    cfg, like prefill) — rebuilding the jit per call would recompile."""
+    global _POOLED_HIDDEN_JIT
+    if _POOLED_HIDDEN_JIT is None:
+        import jax
+        from functools import partial as _partial
+
+        @_partial(jax.jit, static_argnames=("cfg",))
+        def run(params, tokens, true_len, cfg):
+            import jax.numpy as jnp
+
+            from ..models.llama import llama_hidden
+            h = llama_hidden(params, tokens, cfg).astype(jnp.float32)
+            mask = (jnp.arange(h.shape[1]) < true_len)[None, :, None]
+            return (jnp.sum(h * mask, axis=(0, 1))
+                    / jnp.maximum(true_len, 1).astype(jnp.float32))
+
+        _POOLED_HIDDEN_JIT = run
+    return _POOLED_HIDDEN_JIT(params, tokens, true_len, cfg)
+
+
 def _error(status: int, message: str, err_type: str = "invalid_request_error"):
     return web.json_response(
         {"error": {"message": message, "type": err_type, "param": None,
@@ -169,6 +195,60 @@ class OpenAIApp:
         return handle, _TextStopCutter(text_stops), tok_stops
 
     # -- handlers -----------------------------------------------------------
+
+    def _embed_ids(self, ids: List[int]):
+        """Mean-pooled final-norm hidden state for one input (dense models;
+        the engine's prefill buckets bound the compile count)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.llama import llama_hidden
+
+        eng = self.engine
+        if "router" in eng.params.get("layers", {}):
+            raise ValueError("embeddings are not supported for MoE models")
+        if len(ids) > eng.max_len:
+            raise ValueError(f"input ({len(ids)} tokens) exceeds max_len "
+                             f"({eng.max_len})")
+        bucket = next((b for b in eng._buckets if b >= len(ids)),
+                      eng.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(ids)] = ids
+        with eng._mesh_scope():
+            hidden = _pooled_hidden(eng.params, jnp.asarray(padded),
+                                    jnp.int32(len(ids)), eng.cfg)
+        return np.asarray(hidden).tolist()
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "body must be JSON")
+        raw = body.get("input")
+        if isinstance(raw, str):
+            items = [raw]
+        elif isinstance(raw, list) and raw \
+                and all(isinstance(t, int) for t in raw):
+            items = [raw]            # one token-id sequence
+        elif isinstance(raw, list) and raw:
+            items = raw
+        else:
+            return _error(400, "input must be a string, a token-id list, "
+                               "or a list of those")
+        loop = asyncio.get_running_loop()
+        data, total = [], 0
+        try:
+            for i, item in enumerate(items):
+                ids = self._encode_prompt(item)
+                total += len(ids)
+                emb = await loop.run_in_executor(None, self._embed_ids, ids)
+                data.append({"object": "embedding", "index": i,
+                             "embedding": emb})
+        except ValueError as e:
+            return _error(400, str(e))
+        return web.json_response(
+            {"object": "list", "data": data, "model": self.model_name,
+             "usage": {"prompt_tokens": total, "total_tokens": total}})
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response({"object": "list", "data": [
@@ -332,6 +412,7 @@ class OpenAIApp:
         app.router.add_get("/v1/models", self.models)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
         return app
 
 

@@ -317,3 +317,34 @@ def test_penalties_pass_through(dense):
             assert t not in seen
             seen.add(t)
     run_api_test(dense, body)
+
+
+def test_embeddings_endpoint(dense):
+    tok = FakeTokenizer()
+
+    async def body(client):
+        r = await client.post("/v1/embeddings", json={
+            "model": "tiny", "input": "hello"})
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "list" and len(data["data"]) == 1
+        e1 = data["data"][0]["embedding"]
+        assert len(e1) == 64          # cfg.dim
+        assert data["usage"]["prompt_tokens"] == 5
+        # determinism + batch indexing
+        r = await client.post("/v1/embeddings", json={
+            "model": "tiny", "input": ["hello", "world"]})
+        data = await r.json()
+        assert [d["index"] for d in data["data"]] == [0, 1]
+        assert data["data"][0]["embedding"] == e1
+        assert data["data"][1]["embedding"] != e1
+        # token-id mode (flat int list = ONE input)
+        r = await client.post("/v1/embeddings", json={
+            "model": "tiny", "input": [5, 17, 42]})
+        data = await r.json()
+        assert len(data["data"]) == 1 and len(data["data"][0]["embedding"]) == 64
+        # bad input
+        r = await client.post("/v1/embeddings", json={"model": "tiny",
+                                                      "input": None})
+        assert r.status == 400
+    run_api_test(dense, body, tokenizer=tok)

@@ -299,19 +299,14 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None,
     return tok, lp
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
-         donate_argnums=(1,), donate_argnames=("counts",))
-def _decode_step(params, cache, pos, toks, rng, temps, cfg,
-                 top_k: Optional[int] = None, banks=None, aidx=None,
-                 lora_scale: float = 1.0, top_ps=None,
-                 counts=None, fpen=None, ppen=None):
-    """Advance EVERY slot one token. toks (B,) is each slot's current input
-    token; pos (B,) its absolute position; temps (B,) its sampling
-    temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
-    (B,) select each slot's LoRA adapter (index 0 = the zero adapter =
-    base model). ``cache`` is a ``KVCache`` or an int8 ``QuantKVCache``
-    (``kv_quant``) — the pytree structure keys the jit, so each engine
-    compiles exactly one of the two bodies. Returns (cache', next_tok)."""
+def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
+                      top_k: Optional[int] = None, banks=None, aidx=None,
+                      lora_scale: float = 1.0, top_ps=None,
+                      counts=None, fpen=None, ppen=None):
+    """Single-step decode math shared by the jitted one-step
+    :func:`_decode_step` and the scanned K-step :func:`_decode_block`.
+    Always returns the 4-tuple (cache', next_tok, logprobs, counts') —
+    ``counts'`` is None when ``counts`` is."""
     from .kv_quant import QuantKVCache
     quant = isinstance(cache, QuantKVCache)
     s_max = cache.kq.shape[2] if quant else cache.k.shape[2]
@@ -360,8 +355,63 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                              lp_logits=raw_logits)
     if counts is not None:
         counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(1)
-        return _constrain_cache(new_cache), nxt, lps, counts
-    return _constrain_cache(new_cache), nxt, lps
+    return _constrain_cache(new_cache), nxt, lps, counts
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"),
+         donate_argnums=(1,), donate_argnames=("counts",))
+def _decode_step(params, cache, pos, toks, rng, temps, cfg,
+                 top_k: Optional[int] = None, banks=None, aidx=None,
+                 lora_scale: float = 1.0, top_ps=None,
+                 counts=None, fpen=None, ppen=None):
+    """Advance EVERY slot one token. toks (B,) is each slot's current input
+    token; pos (B,) its absolute position; temps (B,) its sampling
+    temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
+    (B,) select each slot's LoRA adapter (index 0 = the zero adapter =
+    base model). ``cache`` is a ``KVCache`` or an int8 ``QuantKVCache``
+    (``kv_quant``) — the pytree structure keys the jit, so each engine
+    compiles exactly one of the two bodies. Returns (cache', next_tok)."""
+    cache, nxt, lps, counts = _decode_step_impl(
+        params, cache, pos, toks, rng, temps, cfg, top_k=top_k, banks=banks,
+        aidx=aidx, lora_scale=lora_scale, top_ps=top_ps, counts=counts,
+        fpen=fpen, ppen=ppen)
+    if counts is not None:
+        return cache, nxt, lps, counts
+    return cache, nxt, lps
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale", "n_steps"),
+         donate_argnums=(1,), donate_argnames=("counts",))
+def _decode_block(params, cache, pos, toks, rng, temps, cfg, n_steps: int,
+                  top_k: Optional[int] = None, banks=None, aidx=None,
+                  lora_scale: float = 1.0, top_ps=None,
+                  counts=None, fpen=None, ppen=None):
+    """Advance every slot ``n_steps`` tokens in ONE dispatch: a ``lax.scan``
+    over :func:`_decode_step_impl`, so the host pays the dispatch/sync
+    overhead once per block instead of once per token — the difference
+    between ~dispatch-bound and ~HBM-bound serving decode (on the remote
+    relay each dispatch is tens of ms; the per-step math is ~2ms).
+
+    A slot that retires mid-block (eos/stop/budget) keeps computing garbage
+    for the rest of the block; the host discards those tokens at emit time.
+    Its overshoot cache writes at positions ≥ S_max are XLA scatter-drops
+    (out-of-bounds scatter indices are dropped, not clipped), and rows past
+    a retired frontier are never attended before being rewritten — so the
+    garbage is unobservable. Returns
+    (cache', final_pos, final_tok, toks (K, B), logprobs (K, B), counts')."""
+
+    def step_fn(carry, k):
+        cache, pos, toks, counts = carry
+        key = jax.random.fold_in(rng, k)
+        cache, nxt, lps, counts = _decode_step_impl(
+            params, cache, pos, toks, key, temps, cfg, top_k=top_k,
+            banks=banks, aidx=aidx, lora_scale=lora_scale, top_ps=top_ps,
+            counts=counts, fpen=fpen, ppen=ppen)
+        return (cache, pos + 1, nxt, counts), (nxt, lps)
+
+    (cache, pos, toks, counts), (toks_k, lps_k) = lax.scan(
+        step_fn, (cache, pos, toks, counts), jnp.arange(n_steps))
+    return cache, pos, toks, toks_k, lps_k, counts
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_k", "lora_scale"))
@@ -640,7 +690,8 @@ class GenerationEngine:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
-                 quantize_kv: bool = False, seed: int = 0):
+                 quantize_kv: bool = False, seed: int = 0,
+                 decode_block: int = 1):
         self.params = params
         self.cfg = cfg
         self.slots = int(slots)
@@ -652,6 +703,17 @@ class GenerationEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         self.top_p = None if top_p is None else float(top_p)
         self.quantize_kv = bool(quantize_kv)
+        if decode_block < 1:
+            raise ValueError(f"decode_block must be >= 1, got {decode_block}")
+        # K decode steps per dispatch (_decode_block): amortizes the
+        # per-dispatch host/relay overhead across K tokens. Admission,
+        # retirement, and cancellation stay host-side, honored at block
+        # boundaries — worst-case K-1 garbage steps per retiring slot and
+        # up to one block of extra latency on cancel and admission. Every
+        # dispatch runs the full K (one compiled variant, honored exactly
+        # as configured). 1 = the historical one-token step() (what the
+        # deterministic tests drive).
+        self.decode_block = int(decode_block)
         # the ambient mesh is THREAD-LOCAL trace state: capture it at
         # construction and re-install it around every trace site, or an
         # engine driven by its background loop thread (start()/generate(),
@@ -1151,10 +1213,11 @@ class GenerationEngine:
             self._retire_slot(slot)
 
     def step(self) -> int:
-        """Admit pending requests, then decode one token for every active
-        slot. Returns the remaining work — active slots plus queued
-        requests — so ``while eng.step(): ...`` runs the backlog dry even
-        when a step retires every active slot with the queue non-empty."""
+        """Admit pending requests, then decode one BLOCK of tokens
+        (``decode_block`` device steps, default 1) for every active slot.
+        Returns the remaining work — active slots plus queued requests — so
+        ``while eng.step(): ...`` runs the backlog dry even when a step
+        retires every active slot with the queue non-empty."""
         with self._mesh_scope():
             return self._step_once()
 
@@ -1176,22 +1239,51 @@ class GenerationEngine:
                 lkw.update(counts=self._counts,
                            fpen=jnp.asarray(self._fpen),
                            ppen=jnp.asarray(self._ppen))
-            out = _decode_step(
-                self.params, self._cache, jnp.asarray(self._pos),
-                jnp.asarray(self._tok), self._next_key(),
-                jnp.asarray(self._temps), self.cfg, top_k=self.top_k, **lkw)
-            if self._counts is not None:
-                self._cache, nxt, lps, self._counts = out
+            # always the FULL configured block — never a tail-sized one:
+            # n_steps is a static argname, so a variable tail would compile
+            # a fresh variant mid-serving (a multi-second stall for every
+            # concurrent stream) to save at most K-1 ~ms-scale garbage
+            # steps on the final dispatch of a draining backlog
+            k = self.decode_block
+            if k > 1:
+                (self._cache, _fp, _ft, toks_k, lps_k,
+                 counts) = _decode_block(
+                    self.params, self._cache, jnp.asarray(self._pos),
+                    jnp.asarray(self._tok), self._next_key(),
+                    jnp.asarray(self._temps), self.cfg, n_steps=k,
+                    top_k=self.top_k, **lkw)
+                if self._counts is not None:
+                    self._counts = counts
+                toks_k, lps_k = np.asarray(toks_k), np.asarray(lps_k)
+                self._steps += k
+                for i in range(k):
+                    for slot in active:
+                        # a slot retired at emit i' < i skips the rest of
+                        # its block (garbage past the stop point)
+                        if self._slot_req[slot] is None:
+                            continue
+                        self._pos[slot] += 1
+                        self._tok[slot] = int(toks_k[i, slot])
+                        self._emit(slot, int(toks_k[i, slot]),
+                                   float(lps_k[i, slot]))
             else:
-                self._cache, nxt, lps = out
-            nxt, lps = np.asarray(nxt), np.asarray(lps)
-            self._steps += 1
-            for slot in active:
-                # the token decoded this step consumed position _pos[slot];
-                # feed the new one back at the next position
-                self._pos[slot] += 1
-                self._tok[slot] = int(nxt[slot])
-                self._emit(slot, int(nxt[slot]), float(lps[slot]))
+                out = _decode_step(
+                    self.params, self._cache, jnp.asarray(self._pos),
+                    jnp.asarray(self._tok), self._next_key(),
+                    jnp.asarray(self._temps), self.cfg, top_k=self.top_k,
+                    **lkw)
+                if self._counts is not None:
+                    self._cache, nxt, lps, self._counts = out
+                else:
+                    self._cache, nxt, lps = out
+                nxt, lps = np.asarray(nxt), np.asarray(lps)
+                self._steps += 1
+                for slot in active:
+                    # the token decoded this step consumed position
+                    # _pos[slot]; feed the new one back at the next position
+                    self._pos[slot] += 1
+                    self._tok[slot] = int(nxt[slot])
+                    self._emit(slot, int(nxt[slot]), float(lps[slot]))
         with self._lock:
             queued = len(self._pending)
         return sum(r is not None for r in self._slot_req) + queued

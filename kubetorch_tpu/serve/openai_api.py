@@ -432,6 +432,9 @@ def main(argv=None):
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--max-len", type=int, default=2048)
     parser.add_argument("--int8", action="store_true")
+    parser.add_argument("--decode-block", type=int, default=8,
+                        help="device decode steps per dispatch (amortizes "
+                             "host/relay overhead; 1 = step-per-token)")
     parser.add_argument("--no-tokenizer", action="store_true",
                         help="token-id mode (skip AutoTokenizer)")
     args = parser.parse_args(argv)
@@ -448,7 +451,8 @@ def main(argv=None):
         tokenizer = transformers.AutoTokenizer.from_pretrained(args.ckpt)
     eos = getattr(tokenizer, "eos_token_id", None)
     engine = GenerationEngine(params, cfg, slots=args.slots,
-                              max_len=args.max_len, eos_id=eos).start()
+                              max_len=args.max_len, eos_id=eos,
+                              decode_block=args.decode_block).start()
     web.run_app(build_app(engine, tokenizer), port=args.port)
 
 

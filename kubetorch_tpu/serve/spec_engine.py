@@ -155,6 +155,10 @@ class SpeculativeEngine(GenerationEngine):
         if kwargs.get("quantize_kv"):
             raise ValueError("quantize_kv is not supported with "
                              "speculation yet — use GenerationEngine")
+        if kwargs.get("decode_block", 1) != 1:
+            raise ValueError("decode_block tunes GenerationEngine's plain "
+                             "decode loop; a speculation round already "
+                             "batches its device work — use spec_k")
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         super().__init__(params, cfg, **kwargs)

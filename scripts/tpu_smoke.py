@@ -95,6 +95,31 @@ def main():
     for h in handles:               # sanity: every slot actually decoded
         assert h._req.generated > 0, "no tokens generated"
 
+    # decode_block: K scanned steps per dispatch — the engine's answer to
+    # the per-step host/relay overhead the line above pays. Same model,
+    # same grid; throughput should approach the scanned-generate rate as
+    # K amortizes the round-trip.
+    for blk in (8, 32):
+        beng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
+                                prefill_buckets=(128,), decode_block=blk)
+        bh = [beng.submit(list(map(int, p)), max_new_tokens=512)
+              for p in prompts]
+        t0 = time.time()
+        beng.step()
+        print(f"block{blk} engine compile {time.time()-t0:.1f}s", flush=True)
+        beng.step()                 # warm
+        t0 = time.time()
+        bsteps = 0
+        while bsteps < 256:
+            beng.step()
+            bsteps += blk
+        bdt = time.time() - t0
+        print(f"engine decode block={blk}: "
+              f"{slots * bsteps / bdt:.0f} tokens/s/chip "
+              f"({bsteps} steps, {bdt:.2f}s)", flush=True)
+        for h in bh:
+            assert h._req.generated > 0, "no tokens generated"
+
     # device-side decode throughput: the scanned generate() path keeps all
     # decode steps inside ONE jit (lax.scan), so no per-step host sync —
     # this is the chip's real decode rate, where the engine.step() number

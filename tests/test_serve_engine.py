@@ -965,3 +965,98 @@ class TestCancellation:
         assert h.cancel() is False           # same contract as queued path
         while eng.step():
             pass
+
+
+class TestDecodeBlock:
+    """K decode steps per dispatch (``decode_block``): the host pays one
+    dispatch per K tokens while admission/retirement stay host-side at
+    block boundaries. The contract is bit-equivalence with the one-step
+    engine for everything deterministic — mid-block retirement (budget,
+    eos, stop sequences), penalties, int8 KV — since greedy decode is
+    RNG-independent and the block scan runs the same per-step math."""
+
+    def _run(self, eng, submits):
+        handles = [eng.submit(*a, **k) for a, k in submits]
+        while eng.step():
+            pass
+        return [h.result(timeout=0) for h in handles]
+
+    def test_block_matches_oracle_mid_block_retirement(self, dense):
+        """Budgets 3/8/5 against block=4: slots retire mid-block (the
+        garbage tail past each stop point must be discarded) and every
+        stream still matches its solo generate run."""
+        params, cfg = dense
+        prompts = [[7, 8, 9], [100, 200, 300, 400, 401], [1, 2]]
+        ns = [3, 8, 5]
+        want = [_reference_tokens(params, cfg, p, n)
+                for p, n in zip(prompts, ns)]
+        eng = GenerationEngine(params, cfg, slots=4, max_len=64,
+                               prefill_buckets=(8,), decode_block=4)
+        got = self._run(eng, [((p,), {"max_new_tokens": n})
+                              for p, n in zip(prompts, ns)])
+        assert got == want
+        # 8 tokens of budget after the prefill token = 7 needed decodes;
+        # every dispatch runs the FULL block (no tail-sized recompiles),
+        # so the engine pays two 4-step blocks and discards the overshoot
+        assert eng.stats().decode_steps == 8
+
+    def test_block_eos_and_stop_sequences(self, dense):
+        """eos and stop-sequence retirement land mid-block; the emitted
+        streams end exactly where the one-step engine's do."""
+        params, cfg = dense
+        prompt = [3, 4, 5]
+        solo = _reference_tokens(params, cfg, prompt, 12)
+        eos = solo[2]
+        stop_seq = solo[1:3]              # retires at token 3 of the solo run
+        for kwargs, want in (
+                ({"eos_id": eos}, solo[:solo.index(eos) + 1]),
+                ({}, None),               # stop= goes on the request below
+        ):
+            eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                                   prefill_buckets=(4,), decode_block=8,
+                                   **kwargs)
+            sub_kw = {"max_new_tokens": 12}
+            if not kwargs:
+                sub_kw["stop"] = [stop_seq]
+                want = solo[:3]
+            got = self._run(eng, [((prompt,), sub_kw)])[0]
+            assert got == want and len(got) < 12
+
+    def test_block_penalties_match_one_step(self, dense):
+        """Greedy + repetition penalties are deterministic: the block
+        engine's counts ledger (carried through the scan) must steer
+        exactly like the one-step engine's."""
+        params, cfg = dense
+        prompt = [5, 17, 42, 99]
+        runs = []
+        for block in (1, 4):
+            eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                                   prefill_buckets=(4,), decode_block=block)
+            runs.append(self._run(eng, [
+                ((prompt,), {"max_new_tokens": 10,
+                             "frequency_penalty": 0.8}),
+                (([1, 2],), {"max_new_tokens": 6,
+                             "presence_penalty": 1.1}),
+            ]))
+        assert runs[0] == runs[1]
+        # the penalties actually bit: the penalized stream differs from the
+        # unpenalized oracle
+        assert runs[0][0] != _reference_tokens(params, cfg, prompt, 10)
+
+    def test_block_quantized_kv_matches_one_step(self, dense):
+        params, cfg = dense
+        prompts = [[7, 8, 9], [1, 2]]
+        runs = []
+        for block in (1, 4):
+            eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                                   prefill_buckets=(4,), decode_block=block,
+                                   quantize_kv=True)
+            runs.append(self._run(eng, [((p,), {"max_new_tokens": 7})
+                                        for p in prompts]))
+        assert runs[0] == runs[1]
+
+    def test_spec_engine_refuses_decode_block(self, dense):
+        params, cfg = dense
+        from kubetorch_tpu.serve.spec_engine import SpeculativeEngine
+        with pytest.raises(ValueError, match="decode_block"):
+            SpeculativeEngine(params, cfg, params, cfg, decode_block=4)

@@ -575,6 +575,7 @@ class _Request:
     presence_penalty: float = 0.0
     stop: tuple = ()                         # stop token-id sequences
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
+    full_prompt: Optional[List[int]] = None  # pre-strip prompt (auto match)
     adapter_id: Optional[int] = None         # registered LoRA adapter
     cancelled: bool = False                  # reaped at the next step
     error: Optional[BaseException] = None    # admission failure, surfaced
@@ -691,7 +692,7 @@ class GenerationEngine:
                  top_p: Optional[float] = None,
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
                  quantize_kv: bool = False, seed: int = 0,
-                 decode_block: int = 1):
+                 decode_block: int = 1, auto_prefix: bool = False):
         self.params = params
         self.cfg = cfg
         self.slots = int(slots)
@@ -752,9 +753,15 @@ class GenerationEngine:
         # no-top-p engine never compiles (or pays for) the vocab sort;
         # afterwards both step variants stay in the jit cache
         self._nucleus = self.top_p is not None and self.top_p < 1.0
-        # id → (k_bucketed, v_bucketed, true_len)
+        # id → (k_bucketed, v_bucketed, true_len, tokens, adapter_id)
         self._prefixes: Dict[int, tuple] = {}
         self._prefix_ids = itertools.count()
+        # auto_prefix: submit() reuses the LONGEST registered prefix the
+        # prompt starts with (same adapter), no prefix_id needed — register
+        # the system prompts / few-shot headers once, every matching
+        # request skips recomputing them
+        self.auto_prefix = bool(auto_prefix)
+        self._prefix_hits = 0
         # multi-LoRA: stacked adapter banks, target → (A (L,N,D,R),
         # B (L,N,R,O)); bank index 0 is the all-zero adapter (= base model),
         # which idle and base-traffic slots point at
@@ -904,20 +911,41 @@ class GenerationEngine:
         applies only when its temperature is > 0 — greedy slots ignore
         it). ``stop`` is one token-id sequence or a list of them: the
         request retires as soon as its generated tokens end with any stop
-        sequence (the matching tokens ARE emitted, mirroring eos_id)."""
+        sequence (the matching tokens ARE emitted, mirroring eos_id).
+
+        With ``auto_prefix=True`` (engine ctor) and no explicit
+        ``prefix_id``, the longest registered prefix the prompt starts
+        with (same adapter) is reused automatically — pass the FULL
+        prompt; the engine strips the cached part itself."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples the first token)")
+        full_prompt = None
+        if prefix_id is None and self.auto_prefix:
+            prefix_id, stripped = self._match_prefix(prompt, adapter_id,
+                                                     int(max_new_tokens))
+            if prefix_id is not None:
+                full_prompt, prompt = prompt, stripped
         prefix_bucket = 0
         if prefix_id is not None:
-            if prefix_id not in self._prefixes:
-                raise KeyError(f"unknown prefix_id {prefix_id}")
-            # validate against the BUCKETED length: the spliced rows span
-            # the bucket, so that is what must fit under max_len
-            prefix_bucket = self._prefixes[prefix_id][0].shape[2]
+            # fetch ONCE: a concurrent unregister between an existence
+            # check and a later read must not blow up mid-validation
+            pref = self._prefixes.get(prefix_id)
+            if pref is None:
+                if full_prompt is not None:
+                    # the engine matched this prefix itself (auto_prefix)
+                    # and lost the race with an eviction — the caller never
+                    # asked for it, so serve the full prompt instead
+                    prompt, full_prompt, prefix_id = full_prompt, None, None
+                else:
+                    raise KeyError(f"unknown prefix_id {prefix_id}")
+            else:
+                # validate against the BUCKETED length: the spliced rows
+                # span the bucket, so that is what must fit under max_len
+                prefix_bucket = pref[0].shape[2]
         if prefix_bucket + len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prefix bucket ({prefix_bucket}) + prompt ({len(prompt)}) "
@@ -932,7 +960,7 @@ class GenerationEngine:
                        adapter_id=adapter_id, top_p=top_p,
                        frequency_penalty=float(frequency_penalty),
                        presence_penalty=float(presence_penalty),
-                       stop=_normalize_stop(stop))
+                       stop=_normalize_stop(stop), full_prompt=full_prompt)
         with self._lock:
             self._pending.append(req)
         self._work.set()
@@ -981,8 +1009,31 @@ class GenerationEngine:
             k_new = k_new[:, :, :store]
             v_new = v_new[:, :, :store]
         pid = next(self._prefix_ids)
-        self._prefixes[pid] = (k_new, v_new, t, tuple(tokens))
+        self._prefixes[pid] = (k_new, v_new, t, tuple(tokens), adapter_id)
         return pid
+
+    def _match_prefix(self, prompt: List[int], adapter_id: Optional[int],
+                      max_new_tokens: int):
+        """Longest registered prefix this prompt starts with (auto_prefix):
+        returns (prefix_id, suffix) or (None, prompt). Candidates must have
+        been computed through the SAME adapter (a prefix cached through
+        adapter A holds A's K/V — serving it to base traffic would splice
+        the wrong activations), leave a non-empty suffix, and fit the
+        bucket + suffix + budget under max_len."""
+        with self._lock:
+            items = list(self._prefixes.items())
+        best = None
+        for pid, (pk, _v, _t, toks, pad) in items:
+            n = len(toks)
+            if (pad == adapter_id and n < len(prompt)
+                    and (best is None or n > best[1])
+                    and pk.shape[2] + (len(prompt) - n)
+                    + max_new_tokens <= self.max_len
+                    and list(toks) == prompt[:n]):
+                best = (pid, n)
+        if best is None:
+            return None, prompt
+        return best[0], prompt[best[1]:]
 
     def unregister_prefix(self, prefix_id: int) -> bool:
         """Free a cached prefix's K/V buffers. The caller owns prefix
@@ -1102,6 +1153,20 @@ class GenerationEngine:
                 self._admitting = None
 
     def _admit_one(self, req: _Request, slot: int) -> None:
+        # fetch the prefix tuple ONCE — every later use reads this local,
+        # so an unregister racing admission can't fail a request that
+        # passed the check here
+        pref = (self._prefixes.get(req.prefix_id)
+                if req.prefix_id is not None else None)
+        if req.prefix_id is not None and pref is None:
+            if req.full_prompt is not None:
+                # an AUTO-matched prefix evicted between submit and
+                # admission: the user never asked for it, so fall back to
+                # prefilling the full prompt instead of failing the request
+                req.prompt, req.full_prompt = req.full_prompt, None
+                req.prefix_id = None
+            else:
+                raise KeyError(f"unknown prefix_id {req.prefix_id}")
         t = len(req.prompt)
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
@@ -1123,7 +1188,7 @@ class GenerationEngine:
             # so they need no seeding at all)
             seen = list(req.prompt)
             if req.prefix_id is not None:
-                seen += list(self._prefixes[req.prefix_id][3])
+                seen += list(pref[3])
             row = np.zeros(self.cfg.vocab_size, np.int32)
             np.add.at(row, np.asarray(seen, np.int64), 1)
             # penalties apply to the FIRST sampled token too (the prompt
@@ -1135,7 +1200,7 @@ class GenerationEngine:
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})
         if req.prefix_id is not None:
-            pk, pv, p_real, p_toks = self._prefixes[req.prefix_id]
+            pk, pv, p_real, p_toks, _pad = pref
             p_bucket = pk.shape[2]
             bucket = next((b for b in self._buckets if b >= t
                            and p_bucket + b <= self.max_len), None)
@@ -1151,6 +1216,7 @@ class GenerationEngine:
                 jnp.int32(p_real), self._next_key(), temps, self.cfg,
                 top_k=self.top_k, **lkw, **pkw)
             start = p_real + t
+            self._prefix_hits += 1
         else:
             bucket = next(b for b in self._buckets if b >= t)
             padded = np.zeros((1, bucket), np.int32)
@@ -1254,18 +1320,6 @@ class GenerationEngine:
                     top_k=self.top_k, **lkw)
                 if self._counts is not None:
                     self._counts = counts
-                toks_k, lps_k = np.asarray(toks_k), np.asarray(lps_k)
-                self._steps += k
-                for i in range(k):
-                    for slot in active:
-                        # a slot retired at emit i' < i skips the rest of
-                        # its block (garbage past the stop point)
-                        if self._slot_req[slot] is None:
-                            continue
-                        self._pos[slot] += 1
-                        self._tok[slot] = int(toks_k[i, slot])
-                        self._emit(slot, int(toks_k[i, slot]),
-                                   float(lps_k[i, slot]))
             else:
                 out = _decode_step(
                     self.params, self._cache, jnp.asarray(self._pos),
@@ -1276,14 +1330,21 @@ class GenerationEngine:
                     self._cache, nxt, lps, self._counts = out
                 else:
                     self._cache, nxt, lps = out
-                nxt, lps = np.asarray(nxt), np.asarray(lps)
-                self._steps += 1
+                toks_k, lps_k = nxt[None], lps[None]    # (1, B)
+            toks_k, lps_k = np.asarray(toks_k), np.asarray(lps_k)
+            self._steps += k
+            for i in range(k):
                 for slot in active:
-                    # the token decoded this step consumed position
-                    # _pos[slot]; feed the new one back at the next position
+                    # a slot retired at emit i' < i skips the rest of its
+                    # block (garbage past the stop point). Each emitted
+                    # token consumed position _pos[slot]; the next feeds
+                    # back one position later.
+                    if self._slot_req[slot] is None:
+                        continue
                     self._pos[slot] += 1
-                    self._tok[slot] = int(nxt[slot])
-                    self._emit(slot, int(nxt[slot]), float(lps[slot]))
+                    self._tok[slot] = int(toks_k[i, slot])
+                    self._emit(slot, int(toks_k[i, slot]),
+                               float(lps_k[i, slot]))
         with self._lock:
             queued = len(self._pending)
         return sum(r is not None for r in self._slot_req) + queued
@@ -1346,7 +1407,8 @@ class GenerationEngine:
                "engine_finished_total": float(s.finished_total),
                "engine_tokens_generated": float(s.tokens_generated),
                "engine_decode_steps": float(s.decode_steps),
-               "engine_tokens_per_sec": float(s.tokens_per_sec)}
+               "engine_tokens_per_sec": float(s.tokens_per_sec),
+               "engine_prefix_hits": float(self._prefix_hits)}
         spec = getattr(self, "spec_stats", None)
         if spec is not None:
             out["engine_spec_rounds"] = float(spec.rounds)

@@ -35,6 +35,7 @@ import asyncio
 import json
 import threading
 import time
+from functools import partial
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
@@ -407,12 +408,55 @@ class OpenAIApp:
             raise
         return resp
 
+    async def register_prefix(self, request: web.Request) -> web.Response:
+        """Operator surface for the engine's prefix cache (non-OpenAI
+        extension): POST {"text": "..."} or {"tokens": [...]} prefills the
+        prefix once and caches its K/V. With the engine's ``auto_prefix``
+        on, every subsequent completion whose prompt starts with it skips
+        recomputing those rows — register the system prompt here and the
+        standard OpenAI calls speed up with no client change."""
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "body must be JSON")
+        if "tokens" in body:
+            try:
+                ids = [int(t) for t in body["tokens"]]
+            except (TypeError, ValueError):
+                return _error(400, "tokens must be a list of ints")
+        elif "text" in body:
+            if self.tokenizer is None:
+                return _error(400, "no tokenizer loaded; pass token ids")
+            ids = self.tokenizer.encode(body["text"])
+        else:
+            return _error(400, "pass 'text' or 'tokens'")
+        adapter_id = body.get("adapter_id")   # adapter-keyed: LoRA traffic
+        try:                                  # only matches its own prefixes
+            # the prefill (and possibly its first compile) runs on-device
+            # for seconds — off the event loop, like completions/embeddings
+            loop = asyncio.get_running_loop()
+            pid = await loop.run_in_executor(
+                None, partial(self.engine.register_prefix, ids,
+                              adapter_id=adapter_id))
+        except (ValueError, KeyError) as e:
+            return _error(400, str(e))
+        return web.json_response({"prefix_id": pid, "n_tokens": len(ids)})
+
+    async def unregister_prefix(self, request: web.Request) -> web.Response:
+        pid = int(request.match_info["pid"])
+        if not self.engine.unregister_prefix(pid):
+            return _error(404, f"unknown prefix_id {pid}", "not_found")
+        return web.json_response({"deleted": pid})
+
     def build(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/v1/models", self.models)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/prefixes", self.register_prefix)
+        app.router.add_delete("/v1/prefixes/{pid:\\d+}",
+                              self.unregister_prefix)
         return app
 
 
@@ -435,6 +479,9 @@ def main(argv=None):
     parser.add_argument("--decode-block", type=int, default=8,
                         help="device decode steps per dispatch (amortizes "
                              "host/relay overhead; 1 = step-per-token)")
+    parser.add_argument("--auto-prefix", action="store_true",
+                        help="reuse registered prefixes (POST /v1/prefixes) "
+                             "for any prompt that starts with one")
     parser.add_argument("--no-tokenizer", action="store_true",
                         help="token-id mode (skip AutoTokenizer)")
     args = parser.parse_args(argv)
@@ -452,7 +499,8 @@ def main(argv=None):
     eos = getattr(tokenizer, "eos_token_id", None)
     engine = GenerationEngine(params, cfg, slots=args.slots,
                               max_len=args.max_len, eos_id=eos,
-                              decode_block=args.decode_block).start()
+                              decode_block=args.decode_block,
+                              auto_prefix=args.auto_prefix).start()
     web.run_app(build_app(engine, tokenizer), port=args.port)
 
 

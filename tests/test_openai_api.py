@@ -348,3 +348,42 @@ def test_embeddings_endpoint(dense):
                                                       "input": None})
         assert r.status == 400
     run_api_test(dense, body, tokenizer=tok)
+
+
+def test_prefix_routes_and_auto_prefix(dense):
+    """POST /v1/prefixes registers a cached prefix; with auto_prefix on,
+    a standard completion whose prompt starts with it reuses the cache
+    (engine counts a hit) and still matches the full-prompt oracle."""
+    params, cfg = dense
+    prefix = [5, 17, 42, 7, 9, 11]
+    suffix = [99, 100]
+    want = _greedy(params, cfg, prefix + suffix, 6)
+
+    async def body(client):
+        r = await client.post("/v1/prefixes", json={"tokens": prefix})
+        assert r.status == 200
+        pid = (await r.json())["prefix_id"]
+        r = await client.post("/v1/completions", json={
+            "prompt": prefix + suffix, "max_tokens": 6, "temperature": 0})
+        assert r.status == 200
+        body_ = await r.json()
+        assert body_["choices"][0]["token_ids"] == want
+        # delete, then an unknown delete 404s
+        r = await client.delete(f"/v1/prefixes/{pid}")
+        assert r.status == 200
+        r = await client.delete(f"/v1/prefixes/{pid}")
+        assert r.status == 404
+
+    run_api_test(dense, body, auto_prefix=True)
+
+
+def test_prefix_route_errors(dense):
+    async def body(client):
+        r = await client.post("/v1/prefixes", json={})
+        assert r.status == 400
+        r = await client.post("/v1/prefixes", json={"text": "hi"})
+        assert r.status == 400         # no tokenizer loaded
+        r = await client.post("/v1/prefixes", json={"tokens": []})
+        assert r.status == 400         # engine refuses an empty prefix
+
+    run_api_test(dense, body)

@@ -1060,3 +1060,95 @@ class TestDecodeBlock:
         from kubetorch_tpu.serve.spec_engine import SpeculativeEngine
         with pytest.raises(ValueError, match="decode_block"):
             SpeculativeEngine(params, cfg, params, cfg, decode_block=4)
+
+
+class TestAutoPrefix:
+    """auto_prefix=True: submit() reuses the longest registered prefix the
+    prompt starts with — full prompt in, cached K/V spliced, exact same
+    tokens out as a from-zero prefill of the whole prompt."""
+
+    def test_longest_match_reused_and_exact(self, dense):
+        params, cfg = dense
+        short = [5, 17]
+        long = [5, 17, 42, 7]
+        tail = [9, 11]
+        want = _reference_tokens(params, cfg, long + tail, 6)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 8), auto_prefix=True)
+        eng.register_prefix(short)
+        pid_long = eng.register_prefix(long)
+        h = eng.submit(long + tail, max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+        assert eng._prefix_hits == 1
+        # the LONGEST prefix was the one matched: its bucket (4) + suffix
+        # rows landed, which the slot frontier position reflects — and a
+        # prompt that extends only the short prefix still matches short
+        h2 = eng.submit([5, 17, 200], max_new_tokens=4)
+        while eng.step():
+            pass
+        want2 = _reference_tokens(params, cfg, [5, 17, 200], 4)
+        assert h2.result(timeout=0) == want2
+        assert eng._prefix_hits == 2
+        assert eng.unregister_prefix(pid_long)
+
+    def test_no_match_and_exact_equal_prompt_fall_back(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,), auto_prefix=True)
+        eng.register_prefix([5, 17, 42])
+        # prompt EQUAL to the prefix leaves no suffix to prefill → full
+        # prefill path, not a degenerate zero-length suffix
+        want = _reference_tokens(params, cfg, [5, 17, 42], 4)
+        h = eng.submit([5, 17, 42], max_new_tokens=4)
+        # unrelated prompt → no match
+        want2 = _reference_tokens(params, cfg, [9, 9], 3)
+        h2 = eng.submit([9, 9], max_new_tokens=3)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+        assert h2.result(timeout=0) == want2
+        assert eng._prefix_hits == 0
+
+    def test_adapter_mismatch_not_matched(self, dense):
+        """A prefix cached through adapter A must not serve base traffic:
+        the auto-match is adapter-keyed."""
+        from kubetorch_tpu.models.lora import LoraConfig
+        params, cfg = dense
+        lcfg = LoraConfig(rank=2, targets=("wq",))
+        ad = _rand_adapters(7, params, lcfg)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 8), auto_prefix=True)
+        aid = eng.register_adapter(ad, lcfg)
+        eng.register_prefix([5, 17, 42], adapter_id=aid)
+        want = _reference_tokens(params, cfg, [5, 17, 42, 9], 4)
+        h = eng.submit([5, 17, 42, 9], max_new_tokens=4)   # base traffic
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+        assert eng._prefix_hits == 0                       # no cross-use
+        # but a request ON adapter A does match it
+        ha = eng.submit([5, 17, 42, 9], max_new_tokens=4, adapter_id=aid)
+        while eng.step():
+            pass
+        assert eng._prefix_hits == 1
+        assert len(ha.result(timeout=0)) == 4
+
+    def test_eviction_between_submit_and_admission_falls_back(self, dense):
+        """An auto-matched prefix evicted while the request is queued must
+        not fail the request — admission restores the full prompt."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4, 8), auto_prefix=True)
+        pid = eng.register_prefix([5, 17, 42])
+        blocker = eng.submit([8, 8], max_new_tokens=3)     # occupies slot 0
+        h = eng.submit([5, 17, 42, 9], max_new_tokens=4)   # queued, matched
+        eng.unregister_prefix(pid)                          # evicted in-flight
+        while eng.step():
+            pass
+        want = _reference_tokens(params, cfg, [5, 17, 42, 9], 4)
+        assert blocker.result(timeout=0) == _reference_tokens(
+            params, cfg, [8, 8], 3)
+        assert h.result(timeout=0) == want
+        assert eng._prefix_hits == 0

@@ -692,7 +692,8 @@ class GenerationEngine:
                  top_p: Optional[float] = None,
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
                  quantize_kv: bool = False, seed: int = 0,
-                 decode_block: int = 1, auto_prefix: bool = False):
+                 decode_block: int = 1, auto_prefix: bool = False,
+                 prefill_chunk: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.slots = int(slots)
@@ -715,6 +716,23 @@ class GenerationEngine:
         # as configured). 1 = the historical one-token step() (what the
         # deterministic tests drive).
         self.decode_block = int(decode_block)
+        # chunked prefill: a prompt longer than this admits over multiple
+        # engine steps — one fixed-size chunk of prefill between decode
+        # blocks — so a long admission never stalls the active streams for
+        # more than one chunk. Chunk i extends the accumulated K/V through
+        # the prefix-suffix math (exact for dense models; MoE expert
+        # capacity becomes per-CHUNK, the standard chunked-prefill trade).
+        # None = one-shot admission (the historical behavior).
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        # (req, slot, k_acc, v_acc, consumed, frontier, adapter_kw, aidx,
+        #  prefix_tokens)
+        self._chunking: Optional[tuple] = None
+        # constant key for non-sampling (intermediate) prefill chunks
+        self._dummy_key = jax.random.PRNGKey(0)
         # the ambient mesh is THREAD-LOCAL trace state: capture it at
         # construction and re-install it around every trace site, or an
         # engine driven by its background loop thread (start()/generate(),
@@ -1076,6 +1094,13 @@ class GenerationEngine:
             adm.cancelled = True
             self._work.set()
             return True
+        # mid-chunked-admission: the next _chunk_step abandons it
+        ck = self._chunking
+        if (ck is not None and ck[0].rid == request_id
+                and not ck[0].cancelled):
+            ck[0].cancelled = True
+            self._work.set()
+            return True
         return False
 
     def _retire_slot(self, slot: int) -> None:
@@ -1127,9 +1152,15 @@ class GenerationEngine:
         return sub
 
     def _free_slots(self) -> List[int]:
-        return [i for i, r in enumerate(self._slot_req) if r is None]
+        busy = self._chunking[1] if self._chunking is not None else None
+        return [i for i, r in enumerate(self._slot_req)
+                if r is None and i != busy]
 
     def _admit(self) -> None:
+        if self._chunking is not None:
+            # one chunk of the in-progress long admission per engine step
+            # (decode blocks run in between — that's the point)
+            self._chunk_step()
         free = self._free_slots()
         while free:
             with self._lock:
@@ -1137,6 +1168,25 @@ class GenerationEngine:
                     return
                 req = self._pending.popleft()
             slot = free.pop(0)
+            if (self.prefill_chunk is not None and self._chunking is None
+                    and len(req.prompt) > self.prefill_chunk):
+                # long prompt: reserve the slot and prefill one chunk per
+                # step. One chunker at a time — a second long prompt
+                # arriving mid-chunk admits one-shot (correct, just pays
+                # the single stall this machinery exists to avoid).
+                # _admitting makes the request cancellable during the
+                # first chunk's (possibly compile-long) prefill; once
+                # _chunking is set, cancel() finds it there instead.
+                self._admitting = req
+                try:
+                    self._start_chunking(req, slot)
+                except Exception as e:   # noqa: BLE001
+                    req.error = e
+                    req.out.put(None)
+                    free.insert(0, slot)
+                finally:
+                    self._admitting = None
+                continue
             # visible to cancel() during the (possibly seconds-long)
             # prefill below; the flag it may set is honored by the reap at
             # the next step boundary once the slot is assigned
@@ -1152,22 +1202,111 @@ class GenerationEngine:
             finally:
                 self._admitting = None
 
-    def _admit_one(self, req: _Request, slot: int) -> None:
-        # fetch the prefix tuple ONCE — every later use reads this local,
-        # so an unregister racing admission can't fail a request that
-        # passed the check here
+    # -- chunked prefill ----------------------------------------------------
+
+    def _start_chunking(self, req: _Request, slot: int) -> None:
+        """First chunk of a long admission: seed the FIXED-capacity
+        accumulator (max_len rows — one compiled chunk-step shape for the
+        engine's lifetime, and the final splice is exactly cache-width)
+        from the request's cached prefix when it has one, else from a
+        plain prefill of the first chunk. Costs one extra slot's worth of
+        K/V while a chunked admission is in flight."""
+        pref = self._resolve_prefix(req)
+        adapter, aidx = self._resolve_adapter(req.adapter_id)
+        lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
+               if adapter is not None else {})
+        c = self.prefill_chunk
+        if req.prefix_id is not None:
+            # the registered prefix IS the seed; chunks run behind it
+            rows_k, rows_v, p_real = pref[0], pref[1], pref[2]
+            self._prefix_hits += 1
+            consumed, frontier = 0, int(p_real)
+        else:
+            toks = req.prompt[:c]                  # len(prompt) > c
+            padded = np.zeros((1, c), np.int32)
+            padded[0, :] = toks
+            # greedy dummy key: intermediate chunks never sample, and
+            # drawing real keys here would shift the engine's key stream
+            # vs one-shot admission (breaking sampled-mode equivalence)
+            _f, rows_k, rows_v, _lp = _prefill(
+                self.params, jnp.asarray(padded), jnp.int32(c),
+                self._dummy_key, jnp.zeros((1,), jnp.float32), self.cfg,
+                top_k=self.top_k, **lkw)
+            consumed = frontier = c
+        pad_w = self.max_len - rows_k.shape[2]
+        widen = [(0, 0)] * rows_k.ndim
+        widen[2] = (0, pad_w)
+        k_acc = jnp.pad(rows_k, widen)
+        v_acc = jnp.pad(rows_v, widen)
+        self._chunking = (req, slot, k_acc, v_acc, consumed, frontier,
+                          lkw, aidx, pref[3] if pref is not None else None)
+
+    def _chunk_step(self) -> None:
+        """Advance the in-progress chunked admission by one chunk; the
+        LAST chunk samples the first token and seats the request. The
+        accumulator stays max_len-wide: ``_prefill_suffix`` returns
+        max_len + C rows (scattered at absolute positions < max_len), and
+        the trailing pad is sliced back off."""
+        (req, slot, k_acc, v_acc, consumed, frontier,
+         lkw, aidx, pref_toks) = self._chunking
+        if req.cancelled:
+            self._chunking = None
+            req.out.put(None)
+            return
+        c = self.prefill_chunk
+        rest = len(req.prompt) - consumed
+        take = min(c, rest)
+        toks = req.prompt[consumed:consumed + take]
+        padded = np.zeros((1, c), np.int32)
+        padded[0, :take] = toks
+        last = take == rest
+        try:
+            if not last:
+                _f, k_acc, v_acc, _lp = _prefill_suffix(
+                    self.params, jnp.asarray(padded), jnp.int32(take),
+                    k_acc, v_acc, jnp.int32(frontier), self._dummy_key,
+                    jnp.zeros((1,), jnp.float32), self.cfg,
+                    top_k=self.top_k, **lkw)
+                self._chunking = (req, slot, k_acc[:, :, :self.max_len],
+                                  v_acc[:, :, :self.max_len],
+                                  consumed + take, frontier + take,
+                                  lkw, aidx, pref_toks)
+                return
+            temp, temps, tp, pkw, row = self._sampling_setup(req, pref_toks)
+            first, k_new, v_new, flp = _prefill_suffix(
+                self.params, jnp.asarray(padded), jnp.int32(take),
+                k_acc, v_acc, jnp.int32(frontier), self._next_key(),
+                temps, self.cfg, top_k=self.top_k, **lkw, **pkw)
+            self._chunking = None
+            self._finish_admission(req, slot, first, flp,
+                                   k_new[:, :, :self.max_len],
+                                   v_new[:, :, :self.max_len],
+                                   frontier + take, temp, tp, row, aidx)
+        except Exception as e:   # noqa: BLE001 — fail THIS request only
+            self._chunking = None
+            req.error = e
+            req.out.put(None)
+
+    def _resolve_prefix(self, req: _Request):
+        """Fetch the request's prefix tuple ONCE (every later use reads
+        the returned local, so an unregister racing admission can't fail
+        a request that passed the check here). An evicted AUTO-matched
+        prefix falls back to the full prompt; an evicted explicit one is
+        the caller's error."""
         pref = (self._prefixes.get(req.prefix_id)
                 if req.prefix_id is not None else None)
         if req.prefix_id is not None and pref is None:
             if req.full_prompt is not None:
-                # an AUTO-matched prefix evicted between submit and
-                # admission: the user never asked for it, so fall back to
-                # prefilling the full prompt instead of failing the request
                 req.prompt, req.full_prompt = req.full_prompt, None
                 req.prefix_id = None
             else:
                 raise KeyError(f"unknown prefix_id {req.prefix_id}")
-        t = len(req.prompt)
+        return pref
+
+    def _sampling_setup(self, req: _Request, pref_toks):
+        """Per-request sampling state for the admission prefill
+        (``pref_toks``: the request's cached-prefix token tuple, or None).
+        Returns (temp, temps (1,), tp, pkw jit-kwargs, row counts-seed)."""
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
         temps = jnp.full((1,), temp, jnp.float32)
@@ -1187,8 +1326,8 @@ class GenerationEngine:
             # neighbors neutralize any stale row by multiplying it by 0,
             # so they need no seeding at all)
             seen = list(req.prompt)
-            if req.prefix_id is not None:
-                seen += list(pref[3])
+            if pref_toks is not None:
+                seen += list(pref_toks)
             row = np.zeros(self.cfg.vocab_size, np.int32)
             np.add.at(row, np.asarray(seen, np.int64), 1)
             # penalties apply to the FIRST sampled token too (the prompt
@@ -1196,6 +1335,45 @@ class GenerationEngine:
             pkw["pen_row"] = jnp.asarray(
                 fp * row.astype(np.float32)
                 + pp * (row > 0).astype(np.float32))
+        return temp, temps, tp, pkw, row
+
+    def _finish_admission(self, req: _Request, slot: int, first, flp,
+                          k_new, v_new, start: int, temp: float, tp: float,
+                          row, aidx: int) -> None:
+        """Post-prefill slot bookkeeping shared by one-shot and chunked
+        admission: splice the K/V rows, seat the request, seed ledgers,
+        re-check the adapter mapping, emit the first sampled token."""
+        self._cache = _splice_slot(self._cache, jnp.int32(slot),
+                                   k_new, v_new)
+        first_tok = int(first[0])
+        self._slot_req[slot] = req
+        self._pos[slot] = start
+        self._tok[slot] = first_tok
+        self._temps[slot] = temp
+        self._top_ps[slot] = tp
+        self._fpen[slot] = req.frequency_penalty
+        self._ppen[slot] = req.presence_penalty
+        if row is not None:
+            row[first_tok] += 1
+            self._counts = _set_counts_row(self._counts, jnp.int32(slot),
+                                           jnp.asarray(row))
+        with self._lock:
+            # prefill ran outside the lock: if the adapter was evicted in
+            # that window (and its index possibly reused by a new tenant),
+            # pointing at the stale index would decode through the WRONG
+            # factors — re-check the mapping and fall back to base
+            if (req.adapter_id is not None
+                    and self._adapter_slots.get(req.adapter_id) != aidx):
+                aidx = 0
+            self._aidx[slot] = aidx
+        self._admitted += 1
+        self._emit(slot, first_tok, float(flp[0]))
+
+    def _admit_one(self, req: _Request, slot: int) -> None:
+        pref = self._resolve_prefix(req)
+        t = len(req.prompt)
+        temp, temps, tp, pkw, row = self._sampling_setup(
+            req, pref[3] if pref is not None else None)
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})
@@ -1226,31 +1404,8 @@ class GenerationEngine:
                 self._next_key(), temps, self.cfg, top_k=self.top_k,
                 **lkw, **pkw)
             start = t
-        self._cache = _splice_slot(self._cache, jnp.int32(slot),
-                                   k_new, v_new)
-        first_tok = int(first[0])
-        self._slot_req[slot] = req
-        self._pos[slot] = start
-        self._tok[slot] = first_tok
-        self._temps[slot] = temp
-        self._top_ps[slot] = tp
-        self._fpen[slot] = fp
-        self._ppen[slot] = pp
-        if row is not None:
-            row[first_tok] += 1
-            self._counts = _set_counts_row(self._counts, jnp.int32(slot),
-                                           jnp.asarray(row))
-        with self._lock:
-            # prefill ran outside the lock: if the adapter was evicted in
-            # that window (and its index possibly reused by a new tenant),
-            # pointing at the stale index would decode through the WRONG
-            # factors — re-check the mapping and fall back to base
-            if (req.adapter_id is not None
-                    and self._adapter_slots.get(req.adapter_id) != aidx):
-                aidx = 0
-            self._aidx[slot] = aidx
-        self._admitted += 1
-        self._emit(slot, first_tok, float(flp[0]))
+        self._finish_admission(req, slot, first, flp, k_new, v_new, start,
+                               temp, tp, row, aidx)
 
     def _emit(self, slot: int, tok: int,
               logprob: Optional[float] = None) -> None:
@@ -1347,7 +1502,8 @@ class GenerationEngine:
                                float(lps_k[i, slot]))
         with self._lock:
             queued = len(self._pending)
-        return sum(r is not None for r in self._slot_req) + queued
+        return (sum(r is not None for r in self._slot_req) + queued
+                + (1 if self._chunking is not None else 0))
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -1387,7 +1543,11 @@ class GenerationEngine:
         return EngineStats(
             slots=self.slots,
             active=sum(r is not None for r in self._slot_req),
-            queued=len(self._pending),
+            # a request mid-chunked-admission is neither seated nor in
+            # _pending; count it as queued so load gauges never read an
+            # idle engine while it prefills
+            queued=len(self._pending)
+            + (1 if self._chunking is not None else 0),
             admitted_total=self._admitted,
             finished_total=self._finished,
             tokens_generated=self._tokens,

@@ -482,6 +482,11 @@ def main(argv=None):
     parser.add_argument("--auto-prefix", action="store_true",
                         help="reuse registered prefixes (POST /v1/prefixes) "
                              "for any prompt that starts with one")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="chunked prefill: admit prompts longer than "
+                             "this C tokens at a time between decode "
+                             "blocks, so long admissions never stall "
+                             "active streams (default: one-shot)")
     parser.add_argument("--no-tokenizer", action="store_true",
                         help="token-id mode (skip AutoTokenizer)")
     args = parser.parse_args(argv)
@@ -500,7 +505,8 @@ def main(argv=None):
     engine = GenerationEngine(params, cfg, slots=args.slots,
                               max_len=args.max_len, eos_id=eos,
                               decode_block=args.decode_block,
-                              auto_prefix=args.auto_prefix).start()
+                              auto_prefix=args.auto_prefix,
+                              prefill_chunk=args.prefill_chunk).start()
     web.run_app(build_app(engine, tokenizer), port=args.port)
 
 

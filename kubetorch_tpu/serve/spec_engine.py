@@ -159,6 +159,9 @@ class SpeculativeEngine(GenerationEngine):
             raise ValueError("decode_block tunes GenerationEngine's plain "
                              "decode loop; a speculation round already "
                              "batches its device work — use spec_k")
+        if kwargs.get("prefill_chunk") is not None:
+            raise ValueError("chunked prefill is not supported with "
+                             "speculation yet — use GenerationEngine")
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         super().__init__(params, cfg, **kwargs)

@@ -1152,3 +1152,158 @@ class TestAutoPrefix:
             params, cfg, [8, 8], 3)
         assert h.result(timeout=0) == want
         assert eng._prefix_hits == 0
+
+
+class TestChunkedPrefill:
+    """prefill_chunk=C: a prompt longer than C admits over multiple engine
+    steps — one C-token chunk of prefill between decode blocks — via the
+    prefix-suffix math, so a long admission never stalls active streams
+    for more than one chunk. Contract: bit-exact vs the one-shot engine
+    for dense models, neighbors unaffected, cancel honored mid-chunk."""
+
+    def test_long_prompt_exact_with_active_neighbor(self, dense):
+        params, cfg = dense
+        long_prompt = list(range(5, 16))            # 11 tokens → 4+4+3
+        want = _reference_tokens(params, cfg, long_prompt, 6)
+        nbr_want = _reference_tokens(params, cfg, [1, 2], 8)
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 16), prefill_chunk=4,
+                               decode_block=2)
+        nbr = eng.submit([1, 2], max_new_tokens=8)
+        h = eng.submit(long_prompt, max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+        assert nbr.result(timeout=0) == nbr_want
+
+    def test_short_prompt_still_one_shot(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4,), prefill_chunk=4)
+        want = _reference_tokens(params, cfg, [7, 8], 4)
+        h = eng.submit([7, 8], max_new_tokens=4)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_chunked_behind_registered_prefix(self, dense):
+        """A cached prefix seeds the accumulator; the long suffix chunks
+        in behind it at the right positions."""
+        params, cfg = dense
+        prefix = [5, 17, 42]
+        suffix = list(range(30, 39))                 # 9 tokens → 4+4+1
+        want = _reference_tokens(params, cfg, prefix + suffix, 5)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4, 8), prefill_chunk=4,
+                               auto_prefix=True)
+        eng.register_prefix(prefix)
+        h = eng.submit(prefix + suffix, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+        assert eng._prefix_hits == 1
+
+    def test_chunked_penalties_match_one_shot(self, dense):
+        params, cfg = dense
+        long_prompt = list(range(50, 60))
+        runs = []
+        for chunk in (None, 4):
+            eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4, 16),
+                                   prefill_chunk=chunk)
+            h = eng.submit(long_prompt, max_new_tokens=8,
+                           frequency_penalty=0.7, presence_penalty=0.3)
+            while eng.step():
+                pass
+            runs.append(h.result(timeout=0))
+        assert runs[0] == runs[1]
+
+    def test_chunked_quantized_kv(self, dense):
+        params, cfg = dense
+        long_prompt = list(range(5, 14))
+        runs = []
+        for chunk in (None, 4):
+            eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4, 16),
+                                   prefill_chunk=chunk, quantize_kv=True)
+            h = eng.submit(long_prompt, max_new_tokens=6)
+            while eng.step():
+                pass
+            runs.append(h.result(timeout=0))
+        assert runs[0] == runs[1]
+
+    def test_cancel_mid_chunking(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4, 16), prefill_chunk=4)
+        nbr_want = _reference_tokens(params, cfg, [1, 2], 6)
+        nbr = eng.submit([1, 2], max_new_tokens=6)
+        h = eng.submit(list(range(5, 16)), max_new_tokens=6)
+        eng.step()                     # chunk 1 ran; admission in flight
+        assert h.cancel() is True
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == []     # stream ended, no tokens
+        assert nbr.result(timeout=0) == nbr_want
+        # the reserved slot was released: a new request admits and runs
+        w2 = _reference_tokens(params, cfg, [9], 3)
+        h2 = eng.submit([9], max_new_tokens=3)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == w2
+
+    def test_spec_engine_refuses_prefill_chunk(self, dense):
+        params, cfg = dense
+        from kubetorch_tpu.serve.spec_engine import SpeculativeEngine
+        with pytest.raises(ValueError, match="chunked prefill"):
+            SpeculativeEngine(params, cfg, params, cfg, prefill_chunk=4)
+
+    def test_chunked_sampled_mode_matches_one_shot(self, dense):
+        """Intermediate chunks use a constant dummy key, so the engine's
+        key-split stream is IDENTICAL to one-shot admission — sampled
+        requests (same seed) decode the same tokens either way."""
+        params, cfg = dense
+        long_prompt = list(range(40, 51))
+        runs = []
+        for chunk in (None, 4):
+            eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4, 16),
+                                   prefill_chunk=chunk, seed=11)
+            h = eng.submit(long_prompt, max_new_tokens=8, temperature=0.9,
+                           top_p=0.8)
+            while eng.step():
+                pass
+            runs.append(h.result(timeout=0))
+        assert runs[0] == runs[1]
+
+    def test_chunked_fills_to_exact_max_len(self, dense):
+        """A prompt whose accumulated chunks reach the max_len boundary
+        (chunk width not dividing the budget) still admits: the fixed
+        max_len-capacity accumulator makes the final splice exact."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32,
+                               prefill_buckets=(4, 32), prefill_chunk=8)
+        prompt = list(range(1, 30))          # 29 tokens; 29+1 <= 32
+        want = _reference_tokens(params, cfg, prompt, 1)
+        h = eng.submit(prompt, max_new_tokens=1)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_chunked_prefix_plus_long_suffix_at_boundary(self, dense):
+        """Registered prefix (bucket 4) + 59-token suffix at max_len=64:
+        submit validates 4+59+1 <= 64 and the chunked path must not
+        overflow the cache width."""
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,), prefill_chunk=8,
+                               auto_prefix=True)
+        prefix = [5, 17, 42]
+        eng.register_prefix(prefix)
+        suffix = list(range(100, 159))       # 59 tokens
+        h = eng.submit(prefix + suffix, max_new_tokens=1)
+        while eng.step():
+            pass
+        got = h.result(timeout=0)
+        assert len(got) == 1 and eng._prefix_hits == 1
+        assert got == _reference_tokens(params, cfg, prefix + suffix, 1)

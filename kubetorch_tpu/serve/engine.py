@@ -302,9 +302,13 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None,
 def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
                       top_k: Optional[int] = None, banks=None, aidx=None,
                       lora_scale: float = 1.0, top_ps=None,
-                      counts=None, fpen=None, ppen=None):
+                      counts=None, fpen=None, ppen=None,
+                      bias=None, bmask=None):
     """Single-step decode math shared by the jitted one-step
     :func:`_decode_step` and the scanned K-step :func:`_decode_block`.
+    ``bias`` (SLOTS, V) + ``bmask`` (SLOTS,): per-slot OpenAI logit_bias,
+    added before sampling for slots whose mask is 1 (stale rows from past
+    occupants are neutralized by the mask, like the penalty multipliers).
     Always returns the 4-tuple (cache', next_tok, logprobs, counts') —
     ``counts'`` is None when ``counts`` is."""
     from .kv_quant import QuantKVCache
@@ -351,6 +355,8 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
         # stay RAW-model (penalties steer the choice, not the score).
         logits = logits - (fpen[:, None] * counts.astype(jnp.float32)
                            + ppen[:, None] * (counts > 0))
+    if bias is not None:
+        logits = logits + bias * bmask[:, None]
     nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps,
                              lp_logits=raw_logits)
     if counts is not None:
@@ -363,7 +369,8 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
 def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
                  lora_scale: float = 1.0, top_ps=None,
-                 counts=None, fpen=None, ppen=None):
+                 counts=None, fpen=None, ppen=None,
+                 bias=None, bmask=None):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
     temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
@@ -374,7 +381,7 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
     cache, nxt, lps, counts = _decode_step_impl(
         params, cache, pos, toks, rng, temps, cfg, top_k=top_k, banks=banks,
         aidx=aidx, lora_scale=lora_scale, top_ps=top_ps, counts=counts,
-        fpen=fpen, ppen=ppen)
+        fpen=fpen, ppen=ppen, bias=bias, bmask=bmask)
     if counts is not None:
         return cache, nxt, lps, counts
     return cache, nxt, lps
@@ -385,7 +392,8 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
 def _decode_block(params, cache, pos, toks, rng, temps, cfg, n_steps: int,
                   top_k: Optional[int] = None, banks=None, aidx=None,
                   lora_scale: float = 1.0, top_ps=None,
-                  counts=None, fpen=None, ppen=None):
+                  counts=None, fpen=None, ppen=None,
+                  bias=None, bmask=None):
     """Advance every slot ``n_steps`` tokens in ONE dispatch: a ``lax.scan``
     over :func:`_decode_step_impl`, so the host pays the dispatch/sync
     overhead once per block instead of once per token — the difference
@@ -406,7 +414,7 @@ def _decode_block(params, cache, pos, toks, rng, temps, cfg, n_steps: int,
         cache, nxt, lps, counts = _decode_step_impl(
             params, cache, pos, toks, key, temps, cfg, top_k=top_k,
             banks=banks, aidx=aidx, lora_scale=lora_scale, top_ps=top_ps,
-            counts=counts, fpen=fpen, ppen=ppen)
+            counts=counts, fpen=fpen, ppen=ppen, bias=bias, bmask=bmask)
         return (cache, pos + 1, nxt, counts), (nxt, lps)
 
     (cache, pos, toks, counts), (toks_k, lps_k) = lax.scan(
@@ -573,6 +581,7 @@ class _Request:
     top_p: Optional[float] = None            # None → engine default
     frequency_penalty: float = 0.0           # OpenAI-style repetition ctl
     presence_penalty: float = 0.0
+    logit_bias: Optional[Dict[int, float]] = None  # token id → additive bias
     stop: tuple = ()                         # stop token-id sequences
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
     full_prompt: Optional[List[int]] = None  # pre-strip prompt (auto match)
@@ -767,6 +776,11 @@ class GenerationEngine:
         # request (sticky, like _nucleus): V-sized buffers and the per-step
         # scatter only exist once someone pays for them
         self._counts = None
+        # (SLOTS, V) logit_bias rows + per-slot mask, allocated on the
+        # first biased request (same sticky pattern); the mask neutralizes
+        # stale rows, so retirement never needs a device write
+        self._bias = None
+        self._bmask = np.zeros(self.slots, np.float32)
         # sticky: flips on the first nucleus request so the common
         # no-top-p engine never compiles (or pays for) the vocab sort;
         # afterwards both step variants stay in the jit cache
@@ -916,7 +930,9 @@ class GenerationEngine:
                top_p: Optional[float] = None,
                frequency_penalty: float = 0.0,
                presence_penalty: float = 0.0,
-               stop: Optional[Sequence] = None) -> RequestHandle:
+               stop: Optional[Sequence] = None,
+               logit_bias: Optional[Dict[int, float]] = None
+               ) -> RequestHandle:
         """Queue one request. ``temperature`` overrides the engine default
         for THIS request only (0 = greedy) — per-slot temperatures share the
         same compiled step. ``prefix_id`` (from :meth:`register_prefix`)
@@ -973,12 +989,20 @@ class GenerationEngine:
             raise KeyError(f"unknown adapter_id {adapter_id}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if logit_bias:
+            logit_bias = {int(t): float(b) for t, b in logit_bias.items()}
+            bad = [t for t in logit_bias
+                   if not 0 <= t < self.cfg.vocab_size]
+            if bad:
+                raise ValueError(f"logit_bias token ids out of vocab "
+                                 f"range [0, {self.cfg.vocab_size}): {bad}")
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
                        temperature=temperature, prefix_id=prefix_id,
                        adapter_id=adapter_id, top_p=top_p,
                        frequency_penalty=float(frequency_penalty),
                        presence_penalty=float(presence_penalty),
-                       stop=_normalize_stop(stop), full_prompt=full_prompt)
+                       stop=_normalize_stop(stop), full_prompt=full_prompt,
+                       logit_bias=logit_bias or None)
         with self._lock:
             self._pending.append(req)
         self._work.set()
@@ -1117,6 +1141,7 @@ class GenerationEngine:
         self._tok[slot] = 0
         self._temps[slot] = 0.0
         self._top_ps[slot] = 1.0
+        self._bmask[slot] = 0.0
         self._fpen[slot] = 0.0
         self._ppen[slot] = 0.0
         self._aidx[slot] = 0
@@ -1168,6 +1193,16 @@ class GenerationEngine:
                     return
                 req = self._pending.popleft()
             slot = free.pop(0)
+            if (self.prefill_chunk is not None and self._chunking is not None
+                    and len(req.prompt) > self.prefill_chunk):
+                # a second long prompt while the chunker is busy: requeue
+                # and stop admitting this step (FIFO preserved) rather
+                # than falling back to a one-shot prefill at the max_len
+                # bucket — a giant compile + the exact stall chunking
+                # exists to avoid. The chunker frees within a few steps.
+                with self._lock:
+                    self._pending.appendleft(req)
+                return
             if (self.prefill_chunk is not None and self._chunking is None
                     and len(req.prompt) > self.prefill_chunk):
                 # long prompt: reserve the slot and prefill one chunk per
@@ -1272,7 +1307,8 @@ class GenerationEngine:
                                   consumed + take, frontier + take,
                                   lkw, aidx, pref_toks)
                 return
-            temp, temps, tp, pkw, row = self._sampling_setup(req, pref_toks)
+            temp, temps, tp, pkw, row, bias_vec = self._sampling_setup(
+                req, pref_toks)
             first, k_new, v_new, flp = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(take),
                 k_acc, v_acc, jnp.int32(frontier), self._next_key(),
@@ -1281,7 +1317,8 @@ class GenerationEngine:
             self._finish_admission(req, slot, first, flp,
                                    k_new[:, :, :self.max_len],
                                    v_new[:, :, :self.max_len],
-                                   frontier + take, temp, tp, row, aidx)
+                                   frontier + take, temp, tp, row, aidx,
+                                   bias_vec=bias_vec)
         except Exception as e:   # noqa: BLE001 — fail THIS request only
             self._chunking = None
             req.error = e
@@ -1306,7 +1343,8 @@ class GenerationEngine:
     def _sampling_setup(self, req: _Request, pref_toks):
         """Per-request sampling state for the admission prefill
         (``pref_toks``: the request's cached-prefix token tuple, or None).
-        Returns (temp, temps (1,), tp, pkw jit-kwargs, row counts-seed)."""
+        Returns (temp, temps (1,), tp, pkw jit-kwargs, row counts-seed,
+        bias_vec (V,) float32 or None)."""
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
         temps = jnp.full((1,), temp, jnp.float32)
@@ -1335,11 +1373,21 @@ class GenerationEngine:
             pkw["pen_row"] = jnp.asarray(
                 fp * row.astype(np.float32)
                 + pp * (row > 0).astype(np.float32))
-        return temp, temps, tp, pkw, row
+        bias_vec = None
+        if req.logit_bias:
+            bias_vec = np.zeros(self.cfg.vocab_size, np.float32)
+            for tid, b in req.logit_bias.items():
+                bias_vec[tid] = b
+            # pen_row is SUBTRACTED from the prefill logits, so the bias
+            # folds in negated — the first sampled token is biased too
+            prev = pkw.get("pen_row")
+            pkw["pen_row"] = ((0.0 if prev is None else prev)
+                              - jnp.asarray(bias_vec))
+        return temp, temps, tp, pkw, row, bias_vec
 
     def _finish_admission(self, req: _Request, slot: int, first, flp,
                           k_new, v_new, start: int, temp: float, tp: float,
-                          row, aidx: int) -> None:
+                          row, aidx: int, bias_vec=None) -> None:
         """Post-prefill slot bookkeeping shared by one-shot and chunked
         admission: splice the K/V rows, seat the request, seed ledgers,
         re-check the adapter mapping, emit the first sampled token."""
@@ -1357,6 +1405,13 @@ class GenerationEngine:
             row[first_tok] += 1
             self._counts = _set_counts_row(self._counts, jnp.int32(slot),
                                            jnp.asarray(row))
+        if bias_vec is not None:
+            if self._bias is None:
+                self._bias = jnp.zeros((self.slots, self.cfg.vocab_size),
+                                       jnp.float32)
+            self._bias = _set_counts_row(self._bias, jnp.int32(slot),
+                                         jnp.asarray(bias_vec))
+            self._bmask[slot] = 1.0
         with self._lock:
             # prefill ran outside the lock: if the adapter was evicted in
             # that window (and its index possibly reused by a new tenant),
@@ -1372,7 +1427,7 @@ class GenerationEngine:
     def _admit_one(self, req: _Request, slot: int) -> None:
         pref = self._resolve_prefix(req)
         t = len(req.prompt)
-        temp, temps, tp, pkw, row = self._sampling_setup(
+        temp, temps, tp, pkw, row, bias_vec = self._sampling_setup(
             req, pref[3] if pref is not None else None)
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
@@ -1405,7 +1460,7 @@ class GenerationEngine:
                 **lkw, **pkw)
             start = t
         self._finish_admission(req, slot, first, flp, k_new, v_new, start,
-                               temp, tp, row, aidx)
+                               temp, tp, row, aidx, bias_vec=bias_vec)
 
     def _emit(self, slot: int, tok: int,
               logprob: Optional[float] = None) -> None:
@@ -1460,6 +1515,9 @@ class GenerationEngine:
                 lkw.update(counts=self._counts,
                            fpen=jnp.asarray(self._fpen),
                            ppen=jnp.asarray(self._ppen))
+            if self._bias is not None:
+                lkw.update(bias=self._bias,
+                           bmask=jnp.asarray(self._bmask))
             # always the FULL configured block — never a tail-sized one:
             # n_steps is a static argname, so a variable tail would compile
             # a fresh variant mid-serving (a multi-second stall for every
@@ -1585,7 +1643,8 @@ class GenerationEngine:
                  top_p: Optional[float] = None,
                  frequency_penalty: float = 0.0,
                  presence_penalty: float = 0.0,
-                 stop: Optional[Sequence] = None) -> List[int]:
+                 stop: Optional[Sequence] = None,
+                 logit_bias: Optional[Dict[int, float]] = None) -> List[int]:
         # timeout keeps its historical positional slot; the newer knobs are
         # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
@@ -1593,4 +1652,5 @@ class GenerationEngine:
                            prefix_id=prefix_id, adapter_id=adapter_id,
                            top_p=top_p, frequency_penalty=frequency_penalty,
                            presence_penalty=presence_penalty,
-                           stop=stop).result(timeout=timeout)
+                           stop=stop, logit_bias=logit_bias
+                           ).result(timeout=timeout)

@@ -175,8 +175,6 @@ class OpenAIApp:
         return self.tokenizer.decode(ids) if self.tokenizer else ""
 
     def _submit(self, body: Dict[str, Any], prompt_ids: List[int]):
-        if body.get("n", 1) != 1:
-            raise ValueError("n > 1 is not supported")
         lp = body.get("logprobs")
         if (isinstance(lp, int) and lp > 1) or body.get("top_logprobs"):
             raise ValueError("only the chosen token's logprob is available "
@@ -185,6 +183,9 @@ class OpenAIApp:
         text_stops, tok_stops = self._split_stops(body.get("stop"))
         temperature = float(body.get("temperature", 1.0))
         top_p = body.get("top_p")
+        # OpenAI wire shape {"token_id_string": bias_float} passes through
+        # raw: engine.submit normalizes and range-validates the dict
+        bias = body.get("logit_bias") or None
         handle = self.engine.submit(
             prompt_ids,
             max_new_tokens=int(body.get("max_tokens", 16)),
@@ -192,7 +193,7 @@ class OpenAIApp:
             top_p=None if top_p is None else float(top_p),
             frequency_penalty=float(body.get("frequency_penalty", 0.0)),
             presence_penalty=float(body.get("presence_penalty", 0.0)),
-            stop=tok_stops or None)
+            stop=tok_stops or None, logit_bias=bias)
         return handle, _TextStopCutter(text_stops), tok_stops
 
     # -- handlers -----------------------------------------------------------
@@ -267,20 +268,43 @@ class OpenAIApp:
             body = await request.json()
         except Exception:
             return _error(400, "body must be JSON")
+        raw_n = body.get("n")
+        try:
+            # null means "use the default", per OpenAI; 0 does not
+            n = 1 if raw_n is None else int(raw_n)
+        except (TypeError, ValueError):
+            return _error(400, f"n must be an integer, got {raw_n!r}")
+        if not 1 <= n <= 128:        # OpenAI's own cap
+            return _error(400, f"n must be in [1, 128], got {n}")
+        if n > 1 and body.get("stream"):
+            return _error(400, "streaming with n > 1 is not supported")
         try:
             prompt_ids = (self._chat_prompt(body.get("messages"))
                           if chat else self._encode_prompt(body.get("prompt")))
-            handle, cutter, tok_stops = self._submit(body, prompt_ids)
-        except (ValueError, KeyError) as e:
+            # n independent engine requests decode concurrently on the
+            # slot grid, each drawing its own sampling keys
+            pairs = []
+            try:
+                for _ in range(n):
+                    h, cutter, tok_stops = self._submit(body, prompt_ids)
+                    pairs.append((h, cutter))
+            except Exception:
+                for h, _c in pairs:      # don't strand earlier submissions
+                    h.cancel()
+                raise
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            # TypeError/AttributeError: malformed wire fields (a list
+            # logit_bias, a null bias value) surface from the submit
+            # normalization — client errors, not server faults
             return _error(400, str(e))
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{next(self._req_ids)}"
         want_logprobs = bool(body.get("logprobs"))
         if body.get("stream"):
+            (handle, cutter), = pairs
             return await self._stream(request, handle, cutter, rid, chat,
                                       tok_stops, want_logprobs)
-        return await self._blocking(handle, cutter, rid, chat,
-                                    len(prompt_ids), tok_stops,
-                                    want_logprobs)
+        return await self._blocking(pairs, rid, chat, len(prompt_ids),
+                                    tok_stops, want_logprobs)
 
     def _finished_by_stop(self, ids: List[int], tok_stops) -> bool:
         if (self.engine.eos_id is not None and ids
@@ -289,50 +313,57 @@ class OpenAIApp:
         return any(len(q) <= len(ids) and ids[len(ids) - len(q):] == list(q)
                    for q in tok_stops)
 
-    async def _blocking(self, handle, cutter, rid, chat, n_prompt,
+    async def _blocking(self, pairs, rid, chat, n_prompt,
                         tok_stops, want_logprobs=False):
         loop = asyncio.get_running_loop()
-        try:
-            ids = await loop.run_in_executor(None, handle.result)
-        except Exception as e:   # admission error surfaced via the handle
-            return _error(400, str(e))
-        text = None
-        finish = "stop" if self._finished_by_stop(ids, tok_stops) \
-            else "length"
-        if self.tokenizer is not None:
-            piece, matched = cutter.feed(self._decode(ids))
-            text = piece if matched else piece + cutter.flush()
-            if matched:
-                finish = "stop"
-        usage = {"prompt_tokens": n_prompt, "completion_tokens": len(ids),
-                 "total_tokens": n_prompt + len(ids)}
-        lps = handle.logprobs if want_logprobs else None
-        if chat:
-            choice = {"index": 0, "finish_reason": finish,
-                      "message": {"role": "assistant",
-                                  "content": text if text is not None
-                                  else None,
-                                  "token_ids": ids}}
-            if lps is not None:
-                choice["logprobs"] = {"content": [
-                    {"token": self._decode([t]) if self.tokenizer else str(t),
-                     "logprob": lp, "bytes": None}
-                    for t, lp in zip(ids, lps)]}
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "finish_reason": finish,
-                      "text": text if text is not None else "",
-                      "token_ids": ids}
-            if lps is not None:
-                choice["logprobs"] = {
-                    "tokens": [self._decode([t]) if self.tokenizer
-                               else str(t) for t in ids],
-                    "token_logprobs": lps,
-                    "top_logprobs": None, "text_offset": None}
-            obj = "text_completion"
+        choices = []
+        total = 0
+        for index, (handle, cutter) in enumerate(pairs):
+            try:
+                ids = await loop.run_in_executor(None, handle.result)
+            except Exception as e:  # admission error surfaced via handle
+                for h, _c in pairs[index + 1:]:
+                    h.cancel()
+                return _error(400, str(e))
+            total += len(ids)
+            text = None
+            finish = "stop" if self._finished_by_stop(ids, tok_stops) \
+                else "length"
+            if self.tokenizer is not None:
+                piece, matched = cutter.feed(self._decode(ids))
+                text = piece if matched else piece + cutter.flush()
+                if matched:
+                    finish = "stop"
+            lps = handle.logprobs if want_logprobs else None
+            if chat:
+                choice = {"index": index, "finish_reason": finish,
+                          "message": {"role": "assistant",
+                                      "content": text if text is not None
+                                      else None,
+                                      "token_ids": ids}}
+                if lps is not None:
+                    choice["logprobs"] = {"content": [
+                        {"token": self._decode([t]) if self.tokenizer
+                         else str(t),
+                         "logprob": lp, "bytes": None}
+                        for t, lp in zip(ids, lps)]}
+            else:
+                choice = {"index": index, "finish_reason": finish,
+                          "text": text if text is not None else "",
+                          "token_ids": ids}
+                if lps is not None:
+                    choice["logprobs"] = {
+                        "tokens": [self._decode([t]) if self.tokenizer
+                                   else str(t) for t in ids],
+                        "token_logprobs": lps,
+                        "top_logprobs": None, "text_offset": None}
+            choices.append(choice)
+        usage = {"prompt_tokens": n_prompt, "completion_tokens": total,
+                 "total_tokens": n_prompt + total}
+        obj = "chat.completion" if chat else "text_completion"
         return web.json_response(
             {"id": rid, "object": obj, "created": int(time.time()),
-             "model": self.model_name, "choices": [choice], "usage": usage})
+             "model": self.model_name, "choices": choices, "usage": usage})
 
     async def _stream(self, request, handle, cutter, rid, chat,
                       tok_stops, want_logprobs=False):

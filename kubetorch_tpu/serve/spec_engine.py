@@ -198,7 +198,8 @@ class SpeculativeEngine(GenerationEngine):
                top_p: Optional[float] = None,
                frequency_penalty: float = 0.0,
                presence_penalty: float = 0.0,
-               stop: Optional[Sequence] = None):
+               stop: Optional[Sequence] = None,
+               logit_bias=None):
         if temperature not in (None, 0.0):
             raise ValueError("SpeculativeEngine is greedy-only")
         if top_p is not None:
@@ -209,6 +210,10 @@ class SpeculativeEngine(GenerationEngine):
             # the exact-verification acceptance rule (target argmax is
             # computed penalty-free in the verify window)
             raise ValueError("repetition penalties are not supported with "
+                             "speculation — use GenerationEngine")
+        if logit_bias:
+            # same argmax-steering problem as penalties
+            raise ValueError("logit_bias is not supported with "
                              "speculation — use GenerationEngine")
         if prefix_id is not None or adapter_id is not None:
             raise ValueError("prefix/adapter serving is not supported with "

@@ -229,9 +229,9 @@ def test_openai_shaped_errors(dense):
         err = (await r.json())["error"]
         assert err["type"] == "invalid_request_error"
         assert "tokenizer" in err["message"]
-        # n > 1
+        # n must be positive (n > 1 itself is supported now)
         r = await client.post("/v1/completions", json={
-            "model": "tiny", "prompt": [1, 2], "max_tokens": 2, "n": 3})
+            "model": "tiny", "prompt": [1, 2], "max_tokens": 2, "n": 0})
         assert r.status == 400
         # chat without tokenizer
         r = await client.post("/v1/chat/completions", json={
@@ -385,5 +385,54 @@ def test_prefix_route_errors(dense):
         assert r.status == 400         # no tokenizer loaded
         r = await client.post("/v1/prefixes", json={"tokens": []})
         assert r.status == 400         # engine refuses an empty prefix
+
+    run_api_test(dense, body)
+
+
+def test_n_choices_and_logit_bias(dense):
+    """n>1 returns one choice per index off the shared slot grid (usage
+    sums completion tokens); logit_bias steers over the wire; stream+n>1
+    refuses."""
+    params, cfg = dense
+
+    async def body(client):
+        # greedy n=2: identical choices, indexes 0 and 1
+        r = await client.post("/v1/completions", json={
+            "prompt": [5, 17, 42], "max_tokens": 4, "temperature": 0,
+            "n": 2})
+        assert r.status == 200
+        data = await r.json()
+        ch = data["choices"]
+        assert [c["index"] for c in ch] == [0, 1]
+        assert ch[0]["token_ids"] == ch[1]["token_ids"]
+        assert data["usage"]["completion_tokens"] == 8
+        # logit_bias forces a token (OpenAI wire: string keys)
+        r = await client.post("/v1/completions", json={
+            "prompt": [5, 17, 42], "max_tokens": 3, "temperature": 0,
+            "logit_bias": {"77": 1000.0}})
+        assert (await r.json())["choices"][0]["token_ids"] == [77, 77, 77]
+        # streaming with n>1 refuses cleanly
+        r = await client.post("/v1/completions", json={
+            "prompt": [1, 2], "max_tokens": 2, "n": 2, "stream": True})
+        assert r.status == 400
+        assert "n > 1" in (await r.json())["error"]["message"]
+
+    run_api_test(dense, body, slots=4)
+
+
+def test_malformed_n_and_logit_bias_are_400s(dense):
+    async def body(client):
+        # null n means "default" (OpenAI), so it succeeds
+        r = await client.post("/v1/completions", json={
+            "prompt": [1, 2], "max_tokens": 2, "n": None})
+        assert r.status == 200
+        for payload in ({"n": "two"}, {"n": 129}, {"n": 0},
+                        {"logit_bias": [7, 1.5]},
+                        {"logit_bias": {"7": None}}):
+            r = await client.post("/v1/completions", json={
+                "prompt": [1, 2], "max_tokens": 2, **payload})
+            assert r.status == 400, (payload, r.status)
+            assert (await r.json())["error"]["type"] == \
+                "invalid_request_error"
 
     run_api_test(dense, body)

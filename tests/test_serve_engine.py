@@ -1307,3 +1307,101 @@ class TestChunkedPrefill:
         got = h.result(timeout=0)
         assert len(got) == 1 and eng._prefix_hits == 1
         assert got == _reference_tokens(params, cfg, prefix + suffix, 1)
+
+
+    def test_two_long_prompts_queue_for_the_chunker(self, dense):
+        """A second long prompt while the chunker is busy waits for it
+        (never a one-shot prefill at the max_len bucket) and both match
+        their oracles."""
+        params, cfg = dense
+        p1 = list(range(5, 16))
+        p2 = list(range(60, 73))
+        w1 = _reference_tokens(params, cfg, p1, 5)
+        w2 = _reference_tokens(params, cfg, p2, 5)
+        eng = GenerationEngine(params, cfg, slots=4, max_len=64,
+                               prefill_buckets=(4, 16), prefill_chunk=4)
+        h1 = eng.submit(p1, max_new_tokens=5)
+        h2 = eng.submit(p2, max_new_tokens=5)
+        while eng.step():
+            pass
+        assert h1.result(timeout=0) == w1
+        assert h2.result(timeout=0) == w2
+        # the ONLY compiled prefill widths are the chunk width (and none
+        # at the max_len bucket): both admissions went through the chunker
+
+
+class TestLogitBias:
+    """OpenAI logit_bias: per-request additive bias on the logits, applied
+    at the prefill sampling and every decode step. Slot-isolated (mask
+    neutralizes stale rows) and reported logprobs stay raw-model."""
+
+    def test_positive_bias_forces_token(self, dense):
+        params, cfg = dense
+        prompt = [5, 17, 42]
+        solo = _reference_tokens(params, cfg, prompt, 6)
+        forced = (solo[0] + 123) % cfg.vocab_size     # not the greedy pick
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4,))
+        h = eng.submit(prompt, max_new_tokens=6,
+                       logit_bias={forced: 1000.0})
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == [forced] * 6    # prefill + decode
+
+    def test_negative_bias_suppresses_token(self, dense):
+        params, cfg = dense
+        prompt = [5, 17, 42]
+        solo = _reference_tokens(params, cfg, prompt, 6)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        h = eng.submit(prompt, max_new_tokens=6,
+                       logit_bias={solo[0]: -1000.0})
+        while eng.step():
+            pass
+        got = h.result(timeout=0)
+        assert solo[0] not in got and got != solo
+
+    def test_bias_is_slot_isolated_and_cleared_on_reuse(self, dense):
+        params, cfg = dense
+        prompt = [5, 17, 42]
+        solo = _reference_tokens(params, cfg, prompt, 6)
+        forced = (solo[0] + 7) % cfg.vocab_size
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4,))
+        hb = eng.submit(prompt, max_new_tokens=6,
+                        logit_bias={forced: 1000.0})
+        hn = eng.submit(prompt, max_new_tokens=6)     # unbiased neighbor
+        while eng.step():
+            pass
+        assert hb.result(timeout=0) == [forced] * 6
+        assert hn.result(timeout=0) == solo
+        # slot reuse: the retired biased slot's stale row must not leak
+        h2 = eng.submit(prompt, max_new_tokens=6)
+        h3 = eng.submit(prompt, max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h2.result(timeout=0) == solo
+        assert h3.result(timeout=0) == solo
+
+    def test_bias_block_path_matches_one_step(self, dense):
+        params, cfg = dense
+        prompt = [9, 9, 9]
+        runs = []
+        for block in (1, 4):
+            eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4,),
+                                   decode_block=block)
+            h = eng.submit(prompt, max_new_tokens=7,
+                           logit_bias={3: 5.0, 11: -5.0})
+            while eng.step():
+                pass
+            runs.append(h.result(timeout=0))
+        assert runs[0] == runs[1]
+
+    def test_bias_validates_vocab_range(self, dense):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=1, max_len=32)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit([1, 2], max_new_tokens=2,
+                       logit_bias={cfg.vocab_size + 5: 1.0})
+

@@ -271,14 +271,17 @@ def _decode_layer_quant(cfg, x, lw, kq, ks, vq, vs, pos, freqs, lora=None):
 
 
 def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None,
-                  lp_logits=None):
+                  lp_logits=None, keys=None):
     """Per-slot sampling: temps (B,) — 0 means greedy for THAT slot;
     ``top_ps`` (B,) — nucleus mass per slot, 1.0 disables. Vectorized
     (traced arrays, not statics) so requests with different temperatures /
     top-p share one compiled step. ``top_ps=None`` (static) skips the
     full-vocab sort entirely — engines never pay for nucleus sampling
-    until a request asks for it. Agrees with ``sample_logits`` slot-wise:
-    argmax for temp 0, temperature/top-k/top-p categorical otherwise."""
+    until a request asks for it. ``keys`` (B, 2) uint32 draws each ROW
+    from its own key (per-request seeded streams — decode path); ``key``
+    drives the whole batch otherwise (prefill, spec drafts). Agrees with
+    ``sample_logits`` slot-wise: argmax for temp 0,
+    temperature/top-k/top-p categorical otherwise."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.where(temps > 0, temps, 1.0)[:, None]
     if top_k is not None:
@@ -287,7 +290,12 @@ def _sample_slots(logits, key, temps, top_k: Optional[int], top_ps=None,
     if top_ps is not None:
         from ..models.generate import nucleus_mask
         scaled = nucleus_mask(scaled, top_ps)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    if keys is not None:
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled) \
+            .astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(key, scaled,
+                                         axis=-1).astype(jnp.int32)
     tok = jnp.where(temps > 0, sampled, greedy)
     # raw-model (temperature-independent) logprob of the chosen token —
     # the OpenAI ``logprobs`` number; one logsumexp against the matmuls.
@@ -303,12 +311,17 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
                       top_k: Optional[int] = None, banks=None, aidx=None,
                       lora_scale: float = 1.0, top_ps=None,
                       counts=None, fpen=None, ppen=None,
-                      bias=None, bmask=None):
+                      bias=None, bmask=None, skeys=None):
     """Single-step decode math shared by the jitted one-step
     :func:`_decode_step` and the scanned K-step :func:`_decode_block`.
     ``bias`` (SLOTS, V) + ``bmask`` (SLOTS,): per-slot OpenAI logit_bias,
     added before sampling for slots whose mask is 1 (stale rows from past
     occupants are neutralized by the mask, like the penalty multipliers).
+    ``skeys`` (SLOTS, 2) uint32: per-slot sampling keys, folded with each
+    slot's position — every request's sampled stream is a pure function
+    of (its key, its positions), independent of neighbors, step batching,
+    and the engine-wide chain (what makes per-request ``seed`` exact and
+    block decode bit-equal to one-step even when sampling).
     Always returns the 4-tuple (cache', next_tok, logprobs, counts') —
     ``counts'`` is None when ``counts`` is."""
     from .kv_quant import QuantKVCache
@@ -357,8 +370,10 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
                            + ppen[:, None] * (counts > 0))
     if bias is not None:
         logits = logits + bias * bmask[:, None]
+    step_keys = (jax.vmap(jax.random.fold_in)(skeys, pos)
+                 if skeys is not None else None)
     nxt, lps = _sample_slots(logits, rng, temps, top_k, top_ps,
-                             lp_logits=raw_logits)
+                             lp_logits=raw_logits, keys=step_keys)
     if counts is not None:
         counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(1)
     return _constrain_cache(new_cache), nxt, lps, counts
@@ -370,7 +385,7 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
                  top_k: Optional[int] = None, banks=None, aidx=None,
                  lora_scale: float = 1.0, top_ps=None,
                  counts=None, fpen=None, ppen=None,
-                 bias=None, bmask=None):
+                 bias=None, bmask=None, skeys=None):
     """Advance EVERY slot one token. toks (B,) is each slot's current input
     token; pos (B,) its absolute position; temps (B,) its sampling
     temperature. ``banks`` (target → (A (L,N,D,R), B (L,N,R,O))) + ``aidx``
@@ -381,7 +396,7 @@ def _decode_step(params, cache, pos, toks, rng, temps, cfg,
     cache, nxt, lps, counts = _decode_step_impl(
         params, cache, pos, toks, rng, temps, cfg, top_k=top_k, banks=banks,
         aidx=aidx, lora_scale=lora_scale, top_ps=top_ps, counts=counts,
-        fpen=fpen, ppen=ppen, bias=bias, bmask=bmask)
+        fpen=fpen, ppen=ppen, bias=bias, bmask=bmask, skeys=skeys)
     if counts is not None:
         return cache, nxt, lps, counts
     return cache, nxt, lps
@@ -393,7 +408,7 @@ def _decode_block(params, cache, pos, toks, rng, temps, cfg, n_steps: int,
                   top_k: Optional[int] = None, banks=None, aidx=None,
                   lora_scale: float = 1.0, top_ps=None,
                   counts=None, fpen=None, ppen=None,
-                  bias=None, bmask=None):
+                  bias=None, bmask=None, skeys=None):
     """Advance every slot ``n_steps`` tokens in ONE dispatch: a ``lax.scan``
     over :func:`_decode_step_impl`, so the host pays the dispatch/sync
     overhead once per block instead of once per token — the difference
@@ -414,7 +429,8 @@ def _decode_block(params, cache, pos, toks, rng, temps, cfg, n_steps: int,
         cache, nxt, lps, counts = _decode_step_impl(
             params, cache, pos, toks, key, temps, cfg, top_k=top_k,
             banks=banks, aidx=aidx, lora_scale=lora_scale, top_ps=top_ps,
-            counts=counts, fpen=fpen, ppen=ppen, bias=bias, bmask=bmask)
+            counts=counts, fpen=fpen, ppen=ppen, bias=bias, bmask=bmask,
+            skeys=skeys)
         return (cache, pos + 1, nxt, counts), (nxt, lps)
 
     (cache, pos, toks, counts), (toks_k, lps_k) = lax.scan(
@@ -582,6 +598,7 @@ class _Request:
     frequency_penalty: float = 0.0           # OpenAI-style repetition ctl
     presence_penalty: float = 0.0
     logit_bias: Optional[Dict[int, float]] = None  # token id → additive bias
+    seed: Optional[int] = None               # reproducible sampling stream
     stop: tuple = ()                         # stop token-id sequences
     prefix_id: Optional[int] = None          # cached shared-prefix K/V
     full_prompt: Optional[List[int]] = None  # pre-strip prompt (auto match)
@@ -742,6 +759,12 @@ class GenerationEngine:
         self._chunking: Optional[tuple] = None
         # constant key for non-sampling (intermediate) prefill chunks
         self._dummy_key = jax.random.PRNGKey(0)
+        # per-slot sampling keys: each slot's stream is a pure function of
+        # (its key, its positions) — a request with seed=S decodes the
+        # same tokens whatever slot it lands in, whoever its neighbors
+        # are, and whatever decode_block is; unseeded requests draw their
+        # key from the engine chain at admission
+        self._skeys = np.zeros((self.slots, 2), np.uint32)
         # the ambient mesh is THREAD-LOCAL trace state: capture it at
         # construction and re-install it around every trace site, or an
         # engine driven by its background loop thread (start()/generate(),
@@ -931,8 +954,8 @@ class GenerationEngine:
                frequency_penalty: float = 0.0,
                presence_penalty: float = 0.0,
                stop: Optional[Sequence] = None,
-               logit_bias: Optional[Dict[int, float]] = None
-               ) -> RequestHandle:
+               logit_bias: Optional[Dict[int, float]] = None,
+               seed: Optional[int] = None) -> RequestHandle:
         """Queue one request. ``temperature`` overrides the engine default
         for THIS request only (0 = greedy) — per-slot temperatures share the
         same compiled step. ``prefix_id`` (from :meth:`register_prefix`)
@@ -990,19 +1013,28 @@ class GenerationEngine:
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if logit_bias:
+            import math
             logit_bias = {int(t): float(b) for t, b in logit_bias.items()}
             bad = [t for t in logit_bias
                    if not 0 <= t < self.cfg.vocab_size]
             if bad:
                 raise ValueError(f"logit_bias token ids out of vocab "
                                  f"range [0, {self.cfg.vocab_size}): {bad}")
+            nonfin = [t for t, b in logit_bias.items()
+                      if not math.isfinite(b)]
+            if nonfin:
+                # a single NaN/inf bias poisons the whole logits row
+                raise ValueError(
+                    f"logit_bias values must be finite; got "
+                    f"{ {t: logit_bias[t] for t in nonfin} }")
         req = _Request(next(self._rid), prompt, int(max_new_tokens),
                        temperature=temperature, prefix_id=prefix_id,
                        adapter_id=adapter_id, top_p=top_p,
                        frequency_penalty=float(frequency_penalty),
                        presence_penalty=float(presence_penalty),
                        stop=_normalize_stop(stop), full_prompt=full_prompt,
-                       logit_bias=logit_bias or None)
+                       logit_bias=logit_bias or None,
+                       seed=None if seed is None else int(seed))
         with self._lock:
             self._pending.append(req)
         self._work.set()
@@ -1205,10 +1237,9 @@ class GenerationEngine:
                 return
             if (self.prefill_chunk is not None and self._chunking is None
                     and len(req.prompt) > self.prefill_chunk):
-                # long prompt: reserve the slot and prefill one chunk per
-                # step. One chunker at a time — a second long prompt
-                # arriving mid-chunk admits one-shot (correct, just pays
-                # the single stall this machinery exists to avoid).
+                # long prompt with the chunker free: reserve the slot and
+                # prefill one chunk per step (a long prompt arriving while
+                # the chunker is BUSY requeued above and waits for it).
                 # _admitting makes the request cancellable during the
                 # first chunk's (possibly compile-long) prefill; once
                 # _chunking is set, cancel() finds it there instead.
@@ -1311,7 +1342,8 @@ class GenerationEngine:
                 req, pref_toks)
             first, k_new, v_new, flp = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(take),
-                k_acc, v_acc, jnp.int32(frontier), self._next_key(),
+                k_acc, v_acc, jnp.int32(frontier),
+                self._request_prefill_key(req, frontier + take),
                 temps, self.cfg, top_k=self.top_k, **lkw, **pkw)
             self._chunking = None
             self._finish_admission(req, slot, first, flp,
@@ -1385,6 +1417,15 @@ class GenerationEngine:
                               - jnp.asarray(bias_vec))
         return temp, temps, tp, pkw, row, bias_vec
 
+    def _request_prefill_key(self, req: _Request, start: int):
+        """Sampling key for the admission prefill (the FIRST token, placed
+        at position ``start``): seeded requests fold their own base key by
+        ``start - 1`` — disjoint from the decode folds at start, start+1,
+        … — and draw nothing from the engine chain."""
+        if req.seed is None:
+            return self._next_key()
+        return jax.random.fold_in(jax.random.PRNGKey(req.seed), start - 1)
+
     def _finish_admission(self, req: _Request, slot: int, first, flp,
                           k_new, v_new, start: int, temp: float, tp: float,
                           row, aidx: int, bias_vec=None) -> None:
@@ -1395,6 +1436,9 @@ class GenerationEngine:
                                    k_new, v_new)
         first_tok = int(first[0])
         self._slot_req[slot] = req
+        self._skeys[slot] = np.asarray(
+            jax.random.PRNGKey(req.seed) if req.seed is not None
+            else self._next_key(), np.uint32)
         self._pos[slot] = start
         self._tok[slot] = first_tok
         self._temps[slot] = temp
@@ -1444,21 +1488,21 @@ class GenerationEngine:
                 bucket = self.max_len - p_bucket
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
+            start = p_real + t
             first, k_new, v_new, flp = _prefill_suffix(
                 self.params, jnp.asarray(padded), jnp.int32(t), pk, pv,
-                jnp.int32(p_real), self._next_key(), temps, self.cfg,
-                top_k=self.top_k, **lkw, **pkw)
-            start = p_real + t
+                jnp.int32(p_real), self._request_prefill_key(req, start),
+                temps, self.cfg, top_k=self.top_k, **lkw, **pkw)
             self._prefix_hits += 1
         else:
             bucket = next(b for b in self._buckets if b >= t)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
+            start = t
             first, k_new, v_new, flp = _prefill(
                 self.params, jnp.asarray(padded), jnp.int32(t),
-                self._next_key(), temps, self.cfg, top_k=self.top_k,
-                **lkw, **pkw)
-            start = t
+                self._request_prefill_key(req, start), temps, self.cfg,
+                top_k=self.top_k, **lkw, **pkw)
         self._finish_admission(req, slot, first, flp, k_new, v_new, start,
                                temp, tp, row, aidx, bias_vec=bias_vec)
 
@@ -1518,6 +1562,7 @@ class GenerationEngine:
             if self._bias is not None:
                 lkw.update(bias=self._bias,
                            bmask=jnp.asarray(self._bmask))
+            lkw["skeys"] = jnp.asarray(self._skeys)
             # always the FULL configured block — never a tail-sized one:
             # n_steps is a static argname, so a variable tail would compile
             # a fresh variant mid-serving (a multi-second stall for every
@@ -1644,7 +1689,8 @@ class GenerationEngine:
                  frequency_penalty: float = 0.0,
                  presence_penalty: float = 0.0,
                  stop: Optional[Sequence] = None,
-                 logit_bias: Optional[Dict[int, float]] = None) -> List[int]:
+                 logit_bias: Optional[Dict[int, float]] = None,
+                 seed: Optional[int] = None) -> List[int]:
         # timeout keeps its historical positional slot; the newer knobs are
         # keyword-only so generate(tokens, 64, 30.0) still means timeout=30
         self.start()
@@ -1652,5 +1698,5 @@ class GenerationEngine:
                            prefix_id=prefix_id, adapter_id=adapter_id,
                            top_p=top_p, frequency_penalty=frequency_penalty,
                            presence_penalty=presence_penalty,
-                           stop=stop, logit_bias=logit_bias
+                           stop=stop, logit_bias=logit_bias, seed=seed
                            ).result(timeout=timeout)

@@ -174,7 +174,8 @@ class OpenAIApp:
     def _decode(self, ids: List[int]) -> str:
         return self.tokenizer.decode(ids) if self.tokenizer else ""
 
-    def _submit(self, body: Dict[str, Any], prompt_ids: List[int]):
+    def _submit(self, body: Dict[str, Any], prompt_ids: List[int],
+                choice_index: int = 0):
         lp = body.get("logprobs")
         if (isinstance(lp, int) and lp > 1) or body.get("top_logprobs"):
             raise ValueError("only the chosen token's logprob is available "
@@ -193,7 +194,12 @@ class OpenAIApp:
             top_p=None if top_p is None else float(top_p),
             frequency_penalty=float(body.get("frequency_penalty", 0.0)),
             presence_penalty=float(body.get("presence_penalty", 0.0)),
-            stop=tok_stops or None, logit_bias=bias)
+            stop=tok_stops or None, logit_bias=bias,
+            # a seeded stream is a pure function of (seed, prompt), so n>1
+            # with one seed would return n identical choices — each index
+            # gets its own derived seed, and index 0 reproduces solo calls
+            seed=(None if body.get("seed") is None
+                  else int(body["seed"]) + choice_index))
         return handle, _TextStopCutter(text_stops), tok_stops
 
     # -- handlers -----------------------------------------------------------
@@ -269,10 +275,13 @@ class OpenAIApp:
         except Exception:
             return _error(400, "body must be JSON")
         raw_n = body.get("n")
-        try:
-            # null means "use the default", per OpenAI; 0 does not
-            n = 1 if raw_n is None else int(raw_n)
-        except (TypeError, ValueError):
+        # null means "use the default", per OpenAI; bools and floats are
+        # not integers (int() would silently truncate 2.9 to 2)
+        if raw_n is None:
+            n = 1
+        elif isinstance(raw_n, int) and not isinstance(raw_n, bool):
+            n = raw_n
+        else:
             return _error(400, f"n must be an integer, got {raw_n!r}")
         if not 1 <= n <= 128:        # OpenAI's own cap
             return _error(400, f"n must be in [1, 128], got {n}")
@@ -285,8 +294,9 @@ class OpenAIApp:
             # slot grid, each drawing its own sampling keys
             pairs = []
             try:
-                for _ in range(n):
-                    h, cutter, tok_stops = self._submit(body, prompt_ids)
+                for i in range(n):
+                    h, cutter, tok_stops = self._submit(body, prompt_ids,
+                                                        choice_index=i)
                     pairs.append((h, cutter))
             except Exception:
                 for h, _c in pairs:      # don't strand earlier submissions
@@ -507,9 +517,11 @@ def main(argv=None):
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--max-len", type=int, default=2048)
     parser.add_argument("--int8", action="store_true")
-    parser.add_argument("--decode-block", type=int, default=8,
+    parser.add_argument("--decode-block", type=int, default=32,
                         help="device decode steps per dispatch (amortizes "
-                             "host/relay overhead; 1 = step-per-token)")
+                             "host/relay overhead; 1 = step-per-token; "
+                             "on-chip sweep: 8→386, 32→1081, 128→1913 "
+                             "tok/s/chip on the 0.5B model)")
     parser.add_argument("--auto-prefix", action="store_true",
                         help="reuse registered prefixes (POST /v1/prefixes) "
                              "for any prompt that starts with one")

@@ -199,7 +199,7 @@ class SpeculativeEngine(GenerationEngine):
                frequency_penalty: float = 0.0,
                presence_penalty: float = 0.0,
                stop: Optional[Sequence] = None,
-               logit_bias=None):
+               logit_bias=None, seed=None):
         if temperature not in (None, 0.0):
             raise ValueError("SpeculativeEngine is greedy-only")
         if top_p is not None:
@@ -215,6 +215,10 @@ class SpeculativeEngine(GenerationEngine):
             # same argmax-steering problem as penalties
             raise ValueError("logit_bias is not supported with "
                              "speculation — use GenerationEngine")
+        if seed is not None:
+            raise ValueError("seed is meaningless for greedy speculation "
+                             "(deterministic already) — use "
+                             "GenerationEngine for sampled serving")
         if prefix_id is not None or adapter_id is not None:
             raise ValueError("prefix/adapter serving is not supported with "
                              "speculation yet — use GenerationEngine")

@@ -68,6 +68,17 @@ def main() -> int:
     else:
         lines += ["## Flash-kernel smoke", "",
                   "_smoke log not captured in this window_", ""]
+    sweep = os.path.join(os.path.dirname(OUT), "evidence",
+                         "serve_sweep.log")
+    if os.path.exists(sweep):
+        with open(sweep) as f:
+            lines += ["## Serving sweep (scripts/tpu_serve_sweep.py)", "",
+                      "Caveat: host-dispatch measurements (admission "
+                      "stalls, TTFT) ride the axon relay's ~150 ms "
+                      "round-trip per dispatch, which swamps the on-chip "
+                      "math they try to isolate — the decode_block ladder "
+                      "is the meaningful row set.", "",
+                      "```", f.read().strip()[-2500:], "```", ""]
     with open(OUT, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {OUT}")

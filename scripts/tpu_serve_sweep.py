@@ -1,0 +1,127 @@
+"""On-chip serving sweep: decode_block ladder, chunked-admission stall
+profile, and prefix-cache TTFT on the bench-sized (~0.5B) model.
+
+Run detached (never timeout-kill a TPU-holding process):
+``nohup python scripts/tpu_serve_sweep.py > /tmp/serve_sweep.log 2>&1 &``
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, dev.device_kind, flush=True)
+    if jax.default_backend() != "tpu":
+        print("NOT TPU — aborting (sweep numbers are chip numbers)")
+        return 1
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve import GenerationEngine
+
+    cfg = LlamaConfig(vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
+                      n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
+                      attn_impl="flash", remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    slots = 8
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, size=(slots, 128))
+
+    # 1) decode_block ladder
+    for blk in (8, 32, 64, 128):
+        eng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
+                               prefill_buckets=(128,), decode_block=blk)
+        for p in prompts:
+            eng.submit(list(map(int, p)), max_new_tokens=768)
+        t0 = time.time()
+        eng.step()
+        compile_s = time.time() - t0
+        eng.step()                                  # warm
+        steps = 0
+        t0 = time.time()
+        while steps < 512:
+            eng.step()
+            steps += blk
+        dt = time.time() - t0
+        print(f"decode_block={blk:4d}: {slots * steps / dt:7.0f} tok/s/chip "
+              f"({steps} steps {dt:.2f}s; compile {compile_s:.1f}s)",
+              flush=True)
+
+    # 2) chunked admission stall profile: 6 streams decode while a
+    #    1024-token prompt admits; compare the worst single step() wall
+    #    time (the stall every active stream sees) chunked vs one-shot
+    for chunk in (None, 256):
+        eng = GenerationEngine(params, cfg, slots=slots, max_len=2048,
+                               prefill_buckets=(128, 1024),
+                               decode_block=8, prefill_chunk=chunk)
+        for p in prompts[:6]:
+            eng.submit(list(map(int, p)), max_new_tokens=512)
+        for _ in range(3):
+            eng.step()                              # streams running
+        long_prompt = list(map(int, rng.integers(1, cfg.vocab_size,
+                                                 size=1024)))
+        eng.submit(long_prompt, max_new_tokens=16)  # compiles its shapes
+        worst = 0.0
+        while True:
+            t0 = time.time()
+            n = eng.step()
+            worst = max(worst, time.time() - t0)
+            if eng.stats().active >= 7 or n == 0:
+                break
+        label = "one-shot" if chunk is None else f"chunk={chunk}"
+        print(f"admission {label:10s}: worst step stall {worst * 1e3:6.0f} ms "
+              f"(includes that shape's first compile)", flush=True)
+        # steady-state: admit a second long prompt, all shapes warm
+        eng2_prompt = list(map(int, rng.integers(1, cfg.vocab_size,
+                                                 size=1000)))
+        eng.submit(eng2_prompt, max_new_tokens=16)
+        worst = 0.0
+        while True:
+            t0 = time.time()
+            n = eng.step()
+            worst = max(worst, time.time() - t0)
+            if eng.stats().active >= 8 or n == 0:
+                break
+        print(f"admission {label:10s}: warm worst step stall "
+              f"{worst * 1e3:6.0f} ms", flush=True)
+
+    # 3) prefix cache TTFT: 512-token shared prefix + 32-token suffix
+    shared = list(map(int, rng.integers(1, cfg.vocab_size, size=512)))
+    suffix = list(map(int, rng.integers(1, cfg.vocab_size, size=32)))
+    eng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
+                           prefill_buckets=(64, 512), decode_block=8,
+                           auto_prefix=True)
+    h = eng.submit(shared + suffix, max_new_tokens=4)   # cold, no prefix
+    while eng.step():
+        pass
+    t0 = time.time()
+    h = eng.submit(shared + suffix, max_new_tokens=4)
+    while eng.step():
+        pass
+    full_ttft = h.time_to_first_token()
+    eng.register_prefix(shared)
+    h = eng.submit(shared + suffix, max_new_tokens=4)   # compiles suffix
+    while eng.step():
+        pass
+    h = eng.submit(shared + suffix, max_new_tokens=4)
+    while eng.step():
+        pass
+    hit_ttft = h.time_to_first_token()
+    print(f"prefix cache: TTFT full-prefill {full_ttft * 1e3:.0f} ms → "
+          f"cached-prefix {hit_ttft * 1e3:.0f} ms "
+          f"(x{full_ttft / max(hit_ttft, 1e-9):.1f}; hits="
+          f"{eng._prefix_hits})", flush=True)
+
+    print("SERVE SWEEP OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

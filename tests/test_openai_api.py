@@ -436,3 +436,26 @@ def test_malformed_n_and_logit_bias_are_400s(dense):
                 "invalid_request_error"
 
     run_api_test(dense, body)
+
+
+def test_seeded_n_choices_are_distinct_but_reproducible(dense):
+    """n>1 + seed: each choice index derives its own seed (distinct
+    outputs), and repeating the call reproduces every choice."""
+    async def body(client):
+        outs = []
+        for _ in range(2):
+            r = await client.post("/v1/completions", json={
+                "prompt": [5, 17, 42], "max_tokens": 6,
+                "temperature": 1.0, "n": 3, "seed": 7})
+            assert r.status == 200
+            outs.append([tuple(c["token_ids"])
+                         for c in (await r.json())["choices"]])
+        assert outs[0] == outs[1]                # reproducible per index
+        assert len(set(outs[0])) == 3            # and distinct across n
+        # float / bool n refuse instead of truncating
+        for bad in (2.9, True):
+            r = await client.post("/v1/completions", json={
+                "prompt": [1, 2], "max_tokens": 2, "n": bad})
+            assert r.status == 400
+
+    run_api_test(dense, body, slots=4)

@@ -1309,11 +1309,22 @@ class TestChunkedPrefill:
         assert got == _reference_tokens(params, cfg, prefix + suffix, 1)
 
 
-    def test_two_long_prompts_queue_for_the_chunker(self, dense):
+    def test_two_long_prompts_queue_for_the_chunker(self, dense,
+                                                    monkeypatch):
         """A second long prompt while the chunker is busy waits for it
-        (never a one-shot prefill at the max_len bucket) and both match
-        their oracles."""
+        (never a one-shot prefill at a wide bucket) and both match their
+        oracles. A width spy proves every prefill ran at the CHUNK width —
+        the regression (falling back to one-shot) would show width 16."""
+        import kubetorch_tpu.serve.engine as eng_mod
         params, cfg = dense
+        widths = []
+        real_prefill = eng_mod._prefill
+
+        def spy(params_, tokens, *a, **kw):
+            widths.append(tokens.shape[1])
+            return real_prefill(params_, tokens, *a, **kw)
+
+        monkeypatch.setattr(eng_mod, "_prefill", spy)
         p1 = list(range(5, 16))
         p2 = list(range(60, 73))
         w1 = _reference_tokens(params, cfg, p1, 5)
@@ -1326,8 +1337,7 @@ class TestChunkedPrefill:
             pass
         assert h1.result(timeout=0) == w1
         assert h2.result(timeout=0) == w2
-        # the ONLY compiled prefill widths are the chunk width (and none
-        # at the max_len bucket): both admissions went through the chunker
+        assert widths == [4, 4], widths   # first chunks only, chunk-wide
 
 
 class TestLogitBias:
@@ -1405,3 +1415,72 @@ class TestLogitBias:
             eng.submit([1, 2], max_new_tokens=2,
                        logit_bias={cfg.vocab_size + 5: 1.0})
 
+
+
+class TestPerRequestSeed:
+    """submit(..., seed=S): the sampled stream is a pure function of
+    (seed, prompt positions) — invariant to slot placement, neighbors,
+    engine seed, decode_block, and admission order."""
+
+    def _run(self, dense, engine_seed, neighbors, seed, block=1,
+             chunk=None, prompt=(3, 4)):
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=4, max_len=64,
+                               prefill_buckets=(4, 16), seed=engine_seed,
+                               decode_block=block, prefill_chunk=chunk)
+        for p in neighbors:
+            eng.submit(p, max_new_tokens=5, temperature=1.0)
+        h = eng.submit(list(prompt), max_new_tokens=6, temperature=1.0,
+                       seed=seed)
+        while eng.step():
+            pass
+        return h.result(timeout=0)
+
+    def test_seed_invariant_to_everything_else(self, dense):
+        a = self._run(dense, 0, [[1, 1]], 42)
+        b = self._run(dense, 7, [[9, 9], [2, 2]], 42)   # slot 2, new chain
+        d = self._run(dense, 0, [[1, 1]], 42, block=4)
+        ch = self._run(dense, 0, [[1, 1]], 42, chunk=4,
+                       prompt=tuple(range(3, 14)))
+        ch2 = self._run(dense, 3, [], 42, chunk=4,
+                        prompt=tuple(range(3, 14)))
+        assert a == b == d
+        assert ch == ch2                                 # chunked too
+        assert a != self._run(dense, 0, [[1, 1]], 43)    # seeds diverge
+
+    def test_greedy_ignores_seed(self, dense):
+        params, cfg = dense
+        want = _reference_tokens(params, cfg, [5, 17, 42], 6)
+        eng = GenerationEngine(params, cfg, slots=1, max_len=64,
+                               prefill_buckets=(4,))
+        h = eng.submit([5, 17, 42], max_new_tokens=6, temperature=0.0,
+                       seed=99)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_openai_seed_reproducible_over_the_wire(self, dense):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        from kubetorch_tpu.serve.openai_api import build_app
+        params, cfg = dense
+        eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4,)).start()
+
+        async def body():
+            client = TestClient(TestServer(build_app(eng)))
+            await client.start_server()
+            outs = []
+            for _ in range(2):
+                r = await client.post("/v1/completions", json={
+                    "prompt": [5, 17, 42], "max_tokens": 5,
+                    "temperature": 1.0, "seed": 1234})
+                outs.append((await r.json())["choices"][0]["token_ids"])
+            await client.close()
+            return outs
+
+        try:
+            outs = asyncio.run(body())
+        finally:
+            eng.stop()
+        assert outs[0] == outs[1]

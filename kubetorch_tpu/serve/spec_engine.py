@@ -40,7 +40,8 @@ from jax import lax
 from ..models.generate import KVCache, ffn_block, init_cache, rope_freqs
 from ..models.llama import rmsnorm
 from ..models.quant import dequant_layer, head_weight
-from .engine import (GenerationEngine, _decode_step, _prefill, _splice_slot)
+from .engine import (GenerationEngine, _decode_block, _prefill,
+                     _splice_slot)
 from .speculative import SpecStats
 
 NEG_INF = -1e30
@@ -299,11 +300,9 @@ class SpeculativeEngine(GenerationEngine):
         while s_eff // 2 >= need and s_eff > 1:
             s_eff //= 2
 
-        # draft: ingest each slot's pending block, then k-1 grid decode
-        # steps propose greedily (temps 0 ⇒ argmax in _decode_step).
-        # Proposals stay ON DEVICE through the loop — each step only needs
-        # the previous token there, and a per-step host fetch would stall
-        # dispatch k-1 times per round
+        # draft: ingest each slot's pending block, then propose greedily
+        # (temps 0 ⇒ argmax) — the first proposal from the ingest logits,
+        # the remaining k-1 from one scanned decode block below
         dblock = np.zeros((b, wd), np.int32)
         for i in active:
             dblock[i, :c[i]] = self._slot_pending[i]
@@ -314,15 +313,21 @@ class SpeculativeEngine(GenerationEngine):
         last = np.clip(c - 1, 0, wd - 1)
         tok = jnp.argmax(dlog[jnp.arange(b), last],
                          axis=-1).astype(jnp.int32)
-        props = [tok]
         zeros = jnp.zeros(b, jnp.float32)
-        for i in range(k - 1):
-            self._draft_cache, tok, _lp = _decode_step(
+        if k > 1:
+            # all k-1 remaining proposals in ONE dispatch: the scanned
+            # decode block returns the stacked per-step tokens, so the
+            # whole draft phase costs two device round-trips (ingest +
+            # block) instead of k. Greedy (temps 0) ⇒ the key is unused.
+            self._draft_cache, _fp, _ft, toks_k, _lps, _cnt = _decode_block(
                 self.draft_params, self._draft_cache,
-                jnp.asarray(start + c + i), tok, self._next_key(), zeros,
-                self.draft_cfg)
-            props.append(tok)
-        proposals = np.asarray(jnp.stack(props, axis=1))  # (B, k), one fetch
+                jnp.asarray(start + c), tok, self._dummy_key, zeros,
+                self.draft_cfg, n_steps=k - 1)
+            # (B, k) = first proposal + the block's (k-1, B) transposed
+            proposals = np.concatenate(
+                [np.asarray(tok)[:, None], np.asarray(toks_k).T], axis=1)
+        else:
+            proposals = np.asarray(tok)[:, None]          # (B, 1)
 
         # target: one forward over pending+proposals for every slot
         tblock = np.zeros((b, wt), np.int32)

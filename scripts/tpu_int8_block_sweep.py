@@ -1,0 +1,69 @@
+"""On-chip int8 × decode_block sweep: does the weight-bandwidth win
+(int8 ≈ 1.4× on the scanned path) survive into the engine once decode
+blocks amortize the dispatch overhead? Also probes block saturation.
+
+Run detached: ``nohup python scripts/tpu_int8_block_sweep.py
+> /tmp/int8_block_sweep.log 2>&1 &``
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, dev.device_kind, flush=True)
+    if jax.default_backend() != "tpu":
+        print("NOT TPU — aborting")
+        return 1
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve import GenerationEngine, quantize_params
+
+    cfg = LlamaConfig(vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
+                      n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
+                      attn_impl="flash", remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    slots = 8
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, size=(slots, 128))
+
+    def bench(tag, p, blk, quantize_kv=False, steps_target=512):
+        eng = GenerationEngine(p, cfg, slots=slots, max_len=1024,
+                               prefill_buckets=(128,), decode_block=blk,
+                               quantize_kv=quantize_kv)
+        for pr in prompts:
+            eng.submit(list(map(int, pr)), max_new_tokens=896)
+        t0 = time.time()
+        eng.step()
+        compile_s = time.time() - t0
+        eng.step()
+        steps = 0
+        t0 = time.time()
+        while steps < steps_target:
+            eng.step()
+            steps += blk
+        dt = time.time() - t0
+        print(f"{tag:24s} block={blk:4d}: {slots * steps / dt:7.0f} "
+              f"tok/s/chip ({steps} steps {dt:.2f}s; "
+              f"compile {compile_s:.1f}s)", flush=True)
+
+    for blk in (32, 128, 256):
+        bench("bf16", params, blk)
+    for blk in (32, 128, 256):
+        bench("int8", qparams, blk)
+    bench("int8 + int8 KV", qparams, 128, quantize_kv=True)
+
+    print("INT8 BLOCK SWEEP OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

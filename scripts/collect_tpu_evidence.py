@@ -79,6 +79,13 @@ def main() -> int:
                       "math they try to isolate — the decode_block ladder "
                       "is the meaningful row set.", "",
                       "```", f.read().strip()[-2500:], "```", ""]
+    isweep = os.path.join(os.path.dirname(OUT), "evidence",
+                          "int8_block_sweep.log")
+    if os.path.exists(isweep):
+        with open(isweep) as f:
+            lines += ["## int8 × decode_block sweep "
+                      "(scripts/tpu_int8_block_sweep.py)", "",
+                      "```", f.read().strip()[-2000:], "```", ""]
     with open(OUT, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {OUT}")

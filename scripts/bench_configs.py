@@ -288,7 +288,81 @@ def config6_long_context(steps=4):
             "mesh": {"seq": seq}}
 
 
+def config0_dispatch_latency():
+    """BASELINE.md north-star row: ``kt.fn`` dispatch → first result, and
+    the code-change → running iteration loop (the reference's headline
+    '1-2 s, 100x faster than a container rebuild' claim, README.md:7,33).
+    Local backend: controller + pod are real subprocesses, so the measured
+    path is deploy → WS metadata → subprocess spawn → HTTP call — the
+    same machinery the k8s backend drives, minus the cluster."""
+    import kubetorch_tpu as kt
+    from kubetorch_tpu.client import (controller_client,
+                                      shutdown_local_controller,
+                                      _read_running_local)
+    from kubetorch_tpu.config import reset_config
+
+    import importlib
+    import tempfile
+
+    prior_user = os.environ.get("KT_USERNAME")
+    prior_cwd = os.getcwd()
+    preexisting = _read_running_local() is not None
+    os.environ["KT_USERNAME"] = "t-bench0"
+    reset_config()
+
+    # a real user working dir: the payload lives in a module the pod
+    # imports by name (nested functions can't be addressed remotely)
+    workdir = tempfile.mkdtemp(prefix="kt_bench0_")
+    with open(os.path.join(workdir, "bench0_payload.py"), "w") as fh:
+        fh.write("def add(a, b):\n    return a + b\n")
+    os.chdir(workdir)
+    sys.path.insert(0, workdir)
+    payload = importlib.import_module("bench0_payload")
+
+    try:
+        f = kt.fn(payload.add)
+        t0 = time.perf_counter()
+        f.to(kt.Compute(cpus=1))
+        deploy_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        assert f(2, 40) == 42
+        first_call_s = time.perf_counter() - t0
+        # the iteration loop: a second .to() of the SAME service is the
+        # code-change → running path (hot reload, no pod restart)
+        t0 = time.perf_counter()
+        f.to(kt.Compute(cpus=1))
+        reload_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        assert f(1, 1) == 2
+        call_s = time.perf_counter() - t0
+        f.teardown()
+    finally:
+        try:
+            for w in controller_client().list_workloads():
+                if w["name"].startswith("t-bench0"):
+                    controller_client().delete_workload(w["namespace"],
+                                                        w["name"])
+        except Exception:
+            pass
+        if not preexisting:
+            shutdown_local_controller()
+        os.chdir(prior_cwd)
+        sys.path.remove(workdir)
+        sys.modules.pop("bench0_payload", None)
+        if prior_user is None:
+            os.environ.pop("KT_USERNAME", None)
+        else:
+            os.environ["KT_USERNAME"] = prior_user
+        reset_config()
+    return {"metric": "iteration_seconds", "value": reload_s,
+            "detail": {"cold_deploy_s": round(deploy_s, 2),
+                       "first_call_s": round(first_call_s, 3),
+                       "hot_reload_s": round(reload_s, 2),
+                       "warm_call_s": round(call_s, 3)}}
+
+
 CONFIGS = [
+    ("config0_dispatch_latency", config0_dispatch_latency),
     ("config1_mnist_mlp", config1_mnist_mlp),
     ("config2_resnet_dp", config2_resnet_dp),
     ("config3_llama_fsdp", config3_llama_fsdp),

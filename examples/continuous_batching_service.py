@@ -44,9 +44,14 @@ class BatchingGenerator:
                 params, cfg, draft, dcfg, spec_k=spec_k, slots=slots,
                 max_len=max_len, prefill_buckets=(16, 64, 128)).start()
         else:
+            # decode_block: 8 scanned decode steps per dispatch (on-chip
+            # 56 → 1913 tok/s/chip across the block ladder); auto_prefix:
+            # register a system prompt once and every request starting
+            # with it skips recomputing those rows
             self.engine = GenerationEngine(
                 params, cfg, slots=slots, max_len=max_len,
-                prefill_buckets=(16, 64, 128)).start()
+                prefill_buckets=(16, 64, 128), decode_block=8,
+                auto_prefix=True).start()
 
     def __kt_warmup__(self):
         # pay both compiles (bucketed prefill + the grid decode step)

@@ -46,7 +46,8 @@ class HFService:
         if int8:
             params = quantize_params(params)
         self.engine = GenerationEngine(params, cfg, slots=4, max_len=128,
-                                       prefill_buckets=(16,)).start()
+                                       prefill_buckets=(16,),
+                                       decode_block=8).start()
 
     def __kt_warmup__(self):
         self.generate([1, 2, 3], max_new_tokens=4)

@@ -135,3 +135,75 @@ def quantized_bytes(params: Dict[str, Any]) -> Dict[str, int]:
 
     _walk(params, visit)
     return sizes
+
+
+def llama_init_quantized(rng: jax.Array, cfg) -> Dict[str, Any]:
+    """Initialize a Llama-family param pytree DIRECTLY in the int8 serving
+    layout, one layer-slice at a time — peak HBM is a single (d, o) fp32
+    matrix plus the int8 stacks, never the full bf16 parameter set. This
+    is what makes 7B-class models servable on one 16 GB v5e chip: bf16
+    weights alone (~14 GB) + a transient quantize pass would OOM, while
+    the int8 set (~7 GB) fits with room for the KV grid.
+
+    Structure-identical to ``quantize_params(llama_init(rng, cfg))``
+    (same leaves, same quantized-dict format); values are self-consistent
+    per (rng, cfg) but drawn per-slice rather than per-stack, so they
+    differ numerically from the two-step path. Random-weight serving
+    benches and HBM-budget rehearsals are the use case — real checkpoints
+    arrive via ``convert_hf.load_hf`` + ``quantize_params``."""
+    from jax import lax
+
+    d, L = cfg.dim, cfg.n_layers
+    hd, nh, nkv, f = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("shape", "fan_in"))
+    def init_slice_q(key, shape, fan_in):
+        w = jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+        leaf = _quantize_leaf(w)
+        return leaf[QKEY], leaf["scale"]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def write(buf, i, v):
+        # donated: the caller's only reference is rebound to the result,
+        # so each per-layer update is in-place — no full-stack copy, which
+        # is the whole point of the slice-wise init
+        return lax.dynamic_update_index_in_dim(buf, v, i, 0)
+
+    base = jax.random.fold_in(rng, 0)
+    leaf_keys = {}
+    for j, name in enumerate(("embed", "wq", "wk", "wv", "wo", "w_gate",
+                              "w_up", "w_down", "lm_head")):
+        leaf_keys[name] = jax.random.fold_in(base, j)
+
+    def stacked(name, in_dim, out_dim):
+        q = jnp.zeros((L, in_dim, out_dim), jnp.int8)
+        s = jnp.zeros((L, 1, out_dim), jnp.float32)
+        for layer in range(L):
+            ql, sl = init_slice_q(
+                jax.random.fold_in(leaf_keys[name], layer),
+                (in_dim, out_dim), in_dim)
+            q = write(q, layer, ql)
+            s = write(s, layer, sl)
+        return {QKEY: q, "scale": s}
+
+    embed = (jax.random.normal(leaf_keys["embed"], (cfg.vocab_size, d),
+                               jnp.float32) / jnp.sqrt(d)).astype(cfg.dtype)
+    hq, hs = init_slice_q(leaf_keys["lm_head"], (d, cfg.vocab_size), d)
+    return {
+        "embed": embed,
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": stacked("wq", d, nh * hd),
+            "wk": stacked("wk", d, nkv * hd),
+            "wv": stacked("wv", d, nkv * hd),
+            "wo": stacked("wo", nh * hd, d),
+            "ffn_norm": jnp.ones((L, d), jnp.float32),
+            "w_gate": stacked("w_gate", d, f),
+            "w_up": stacked("w_up", d, f),
+            "w_down": stacked("w_down", f, d),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": {QKEY: hq, "scale": hs},
+    }

@@ -86,6 +86,16 @@ def main() -> int:
             lines += ["## int8 × decode_block sweep "
                       "(scripts/tpu_int8_block_sweep.py)", "",
                       "```", f.read().strip()[-2000:], "```", ""]
+    b7 = os.path.join(os.path.dirname(OUT), "evidence", "serve_7b.log")
+    if os.path.exists(b7):
+        with open(b7) as f:
+            lines += ["## 7B-class single-chip serving "
+                      "(scripts/tpu_7b_serve.py)", "",
+                      "A Llama-3-8B-body model (~7.25B params, 32k vocab) "
+                      "int8-initialized directly on one 16 GB v5e — bf16 "
+                      "weights alone (~14.5 GB) would not fit — decoding "
+                      "on the continuous-batching engine:", "",
+                      "```", f.read().strip()[-1500:], "```", ""]
     with open(OUT, "w") as f:
         f.write("\n".join(lines))
     print(f"wrote {OUT}")

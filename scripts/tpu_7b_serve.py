@@ -1,0 +1,78 @@
+"""7B-class Llama decode on ONE v5e chip (16 GB HBM) via int8 weights.
+
+bf16 weights alone for this config are ~14.5 GB — they don't fit beside a
+KV grid. ``llama_init_quantized`` builds the int8 set (~7.3 GB) directly,
+one layer-slice at a time, and the continuous-batching engine decodes on
+top with scanned blocks.
+
+Run detached (never timeout-kill a TPU-holding process):
+``nohup python scripts/tpu_7b_serve.py > /tmp/serve_7b.log 2>&1 &``
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, dev.device_kind, flush=True)
+    if jax.default_backend() != "tpu":
+        print("NOT TPU — aborting")
+        return 1
+
+    from kubetorch_tpu.models.llama import LlamaConfig
+    from kubetorch_tpu.models.quant import (llama_init_quantized,
+                                            quantized_bytes)
+    from kubetorch_tpu.serve import GenerationEngine
+
+    # Llama-3-8B body (dim 4096 / 32 layers / GQA 32:8 / ffn 14336) with a
+    # 32k vocab — ~7.25B params
+    cfg = LlamaConfig(vocab_size=32768, dim=4096, n_layers=32, n_heads=32,
+                      n_kv_heads=8, ffn_dim=14336, max_seq_len=1024,
+                      attn_impl="flash", remat=False)
+    t0 = time.time()
+    params = llama_init_quantized(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(params)
+    sizes = quantized_bytes(params)
+    total_q = sizes["quantized"] + sizes["full"]
+    print(f"init {time.time()-t0:.0f}s; int8+scales "
+          f"{sizes['quantized']/2**30:.2f} GiB + full-prec "
+          f"{sizes['full']/2**30:.2f} GiB = {total_q/2**30:.2f} GiB on chip",
+          flush=True)
+
+    slots = 8
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, size=(slots, 128))
+    for blk in (16, 64):
+        eng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
+                               prefill_buckets=(128,), decode_block=blk)
+        for p in prompts:
+            eng.submit(list(map(int, p)), max_new_tokens=640)
+        t0 = time.time()
+        eng.step()
+        print(f"block={blk}: first step (prefills+compiles) "
+              f"{time.time()-t0:.0f}s", flush=True)
+        eng.step()
+        steps = 0
+        t0 = time.time()
+        while steps < 256:
+            eng.step()
+            steps += blk
+        dt = time.time() - t0
+        print(f"7B-class int8 decode block={blk}: "
+              f"{slots * steps / dt:6.0f} tok/s/chip "
+              f"({steps} steps {dt:.2f}s, grid {slots})", flush=True)
+        del eng
+
+    print("7B SERVE OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

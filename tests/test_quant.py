@@ -130,3 +130,42 @@ class TestQuantization:
             return h.result(timeout=0)
 
         assert run(q) == run(dq)
+
+
+class TestInitQuantized:
+    """llama_init_quantized: the HBM-frugal direct-int8 init that makes
+    7B-class single-chip serving possible (bf16 init + quantize would OOM
+    a 16 GB chip before the int8 copy exists)."""
+
+    def test_structure_matches_two_step_path(self):
+        from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+        from kubetorch_tpu.models.quant import (llama_init_quantized,
+                                                quantize_params)
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        qp = llama_init_quantized(jax.random.PRNGKey(0), cfg)
+        ref = quantize_params(llama_init(jax.random.PRNGKey(0), cfg))
+        assert (jax.tree_util.tree_structure(qp)
+                == jax.tree_util.tree_structure(ref))
+        # deterministic per (rng, cfg)
+        qp2 = llama_init_quantized(jax.random.PRNGKey(0), cfg)
+        for a, b in zip(jax.tree_util.tree_leaves(qp),
+                        jax.tree_util.tree_leaves(qp2)):
+            assert (np.asarray(a) == np.asarray(b)).all()
+
+    def test_engine_matches_scanned_generate(self):
+        from kubetorch_tpu.models.generate import generate
+        from kubetorch_tpu.models.llama import LlamaConfig
+        from kubetorch_tpu.models.quant import llama_init_quantized
+        from kubetorch_tpu.serve import GenerationEngine
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        qp = llama_init_quantized(jax.random.PRNGKey(3), cfg)
+        want = np.asarray(generate(qp, jnp.asarray([[5, 17, 42]], jnp.int32),
+                                   cfg, max_new_tokens=6))[0, 3:].tolist()
+        eng = GenerationEngine(qp, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4,), decode_block=4)
+        h = eng.submit([5, 17, 42], max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want

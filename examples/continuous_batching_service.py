@@ -104,7 +104,10 @@ def main():
     spec = kt.cls(BatchingGenerator, name="spec-generator",
                   init_kwargs={"slots": 4, "max_len": 256,
                                "speculative": True})
-    spec.to(kt.Compute(cpus=1))
+    # the speculative warmup compiles draft ingest + grid proposals + the
+    # verify window — on a single contended CPU core (CI under full-suite
+    # load) that can exceed the default 900 s launch window
+    spec.to(kt.Compute(cpus=1, launch_timeout=1800))
     try:
         toks = spec.generate([1, 2, 3], max_new_tokens=12)
         stats = spec.stats()

@@ -364,8 +364,8 @@ def _export_leaf(x):
     from .quant import is_quantized
     if isinstance(x, dict) and is_quantized(x):
         raise ValueError(
-            "cannot export int8-quantized params — dequantize first "
-            "(serve.dequantize_params)")
+            "cannot export quantized params (int8 or int4) — "
+            "dequantize first (serve.dequantize_params)")
     # np.array (copy) rather than asarray: jax arrays export read-only
     # views, which torch.from_numpy warns about and must not mutate
     return torch.from_numpy(np.array(x, dtype=np.float32))

@@ -215,7 +215,9 @@ def ffn_block(cfg, h: jax.Array, lw: Dict[str, jax.Array],
         ffn, _ = moe_ffn(cfg, h, lw, token_mask=token_mask,
                          keep_capacity=keep_capacity, no_drop=moe_no_drop)
         return ffn
-    return (jax.nn.silu(h @ lw["w_gate"]) * (h @ lw["w_up"])) @ lw["w_down"]
+    from .quant import wdot
+    return wdot(jax.nn.silu(wdot(h, lw["w_gate"]))
+                * wdot(h, lw["w_up"]), lw["w_down"])
 
 
 def forward_with_cache(params, tokens, cache: KVCache, start_pos,
@@ -241,8 +243,8 @@ def forward_with_cache(params, tokens, cache: KVCache, start_pos,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    from .quant import head_weight
-    logits = (x[:, -1] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    from .quant import lm_head_dot
+    logits = lm_head_dot(x[:, -1], params, cfg.dtype)
     return logits, KVCache(k=new_k, v=new_v)
 
 

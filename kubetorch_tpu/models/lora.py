@@ -142,8 +142,11 @@ def lora_delta(x: jax.Array, a: jax.Array, b: jax.Array,
 def lora_proj(x: jax.Array, w: jax.Array, lora, target: str) -> jax.Array:
     """``x @ W`` plus the adapter delta when ``lora`` carries this target.
     ``lora``: None, or (adapters_by_target, scale) where adapters_by_target
-    maps target name → (a, b) in either ``lora_delta`` layout."""
-    y = x @ w
+    maps target name → (a, b) in either ``lora_delta`` layout. ``w`` may
+    be a packed-int4 leaf (``quant.wdot`` routes it through the fused
+    kernel); plain arrays multiply exactly as before."""
+    from .quant import wdot
+    y = wdot(x, w)
     if lora is not None:
         by_target, scale = lora
         ab = by_target.get(target)

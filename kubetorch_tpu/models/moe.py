@@ -213,9 +213,10 @@ def moe_ffn(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array],
         # slice dispatch/combine down to this rank's local experts BEFORE
         # the expensive routing einsums (shape through a possibly-quantized
         # leaf — shard_map training paths always pass plain arrays)
-        from .quant import QKEY as _QKEY
         wg_leaf = lw["experts"]["w_gate"]
-        e_local = (wg_leaf[_QKEY] if isinstance(wg_leaf, dict)
+        # quantized leaves (int8 or int4) are dicts whose every array
+        # keeps the leading expert dim — any value yields the count
+        e_local = (next(iter(wg_leaf.values())) if isinstance(wg_leaf, dict)
                    else wg_leaf).shape[0]
         start = lax.axis_index(ep_axis) * e_local
         disp = lax.dynamic_slice_in_dim(disp, start, e_local, axis=2)
@@ -279,7 +280,15 @@ def moe_ffn_decode(cfg: MoeConfig, x: jax.Array, lw: Dict[str, jax.Array]):
         int8 + scales FIRST and dequantize only the gathered slices — a
         full-bank dequant before the gather would materialize the bf16
         bank every step and invert the quantization bandwidth win."""
-        from .quant import QKEY, is_quantized
+        from .quant import Q4KEY, QKEY, is_quantized
+        if isinstance(leaf, dict) and Q4KEY in leaf:
+            # the nibble-packed layout can't be gather-indexed per expert
+            # without unpacking first (which would defeat the gather);
+            # quantize_params_int4 keeps experts int8 for exactly this
+            raise ValueError(
+                "int4 expert banks are not supported on the decode gather "
+                "path — quantize experts to int8 (quantize_params_int4 "
+                "does this automatically)")
         if is_quantized(leaf):
             q = leaf[QKEY][gate_idx]                         # (B,T,K,...)
             s = leaf["scale"][gate_idx]

@@ -14,7 +14,8 @@ other. Deploy it like any stateful service::
     svc = kt.cls(GenerationEngine).to(kt.Compute(tpu="v5e-4"))
 """
 
-from ..models.quant import (dequantize_params, quantize_params,
+from ..models.quant import (dequantize_params, llama_init_quantized,
+                            quantize_params, quantize_params_int4,
                             quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
 from .kv_quant import QuantKVCache, dequantize_rows, quantize_rows
@@ -22,7 +23,8 @@ from .spec_engine import SpeculativeEngine
 from .speculative import SpecStats, speculative_generate
 
 __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
-           "quantize_params", "dequantize_params", "quantized_bytes",
+           "quantize_params", "quantize_params_int4",
+           "llama_init_quantized", "dequantize_params", "quantized_bytes",
            "speculative_generate", "SpecStats", "SpeculativeEngine",
            "QuantKVCache", "quantize_rows", "dequantize_rows",
            "OpenAIApp", "build_openai_app"]

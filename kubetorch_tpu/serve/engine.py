@@ -57,7 +57,7 @@ from ..models.generate import (KVCache, _layer_step, ffn_block, init_cache,
 from ..models.llama import rmsnorm
 from ..models.lora import lora_proj
 from ..models.moe import moe_prefill_keep_capacity as _moe_keep_capacity
-from ..models.quant import dequant_layer, head_weight
+from ..models.quant import dequant_layer, lm_head_dot
 
 NEG_INF = -1e30
 
@@ -358,7 +358,7 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
                                          banks or {}))
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    logits = lm_head_dot(x[:, 0], params, cfg.dtype)
     raw_logits = logits
     if counts is not None:
         # OpenAI-style repetition control: subtract per-token penalties
@@ -474,7 +474,7 @@ def _prefill(params, tokens, true_len, rng, temps, cfg,
                                      adapter or {}))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]                 # (1, D)
-    logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    logits = lm_head_dot(h_last, params, cfg.dtype)
     raw_logits = logits
     if pen_row is not None:
         logits = logits - pen_row[None, :]
@@ -530,7 +530,7 @@ def _prefill_suffix(params, tokens, true_len, prefix_k, prefix_v, prefix_len,
                                      adapter or {}))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     h_last = x[jnp.arange(b), true_len - 1]
-    logits = (h_last @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    logits = lm_head_dot(h_last, params, cfg.dtype)
     raw_logits = logits
     if pen_row is not None:
         logits = logits - pen_row[None, :]

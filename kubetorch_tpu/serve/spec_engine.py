@@ -39,7 +39,7 @@ from jax import lax
 
 from ..models.generate import KVCache, ffn_block, init_cache, rope_freqs
 from ..models.llama import rmsnorm
-from ..models.quant import dequant_layer, head_weight
+from ..models.quant import dequant_layer, lm_head_dot, wdot
 from .engine import (GenerationEngine, _decode_block, _prefill,
                      _splice_slot)
 from .speculative import SpecStats
@@ -101,9 +101,9 @@ def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
         lw = dequant_layer(lw, cfg.dtype)
         h = carry
         hn = rmsnorm(h, lw["attn_norm"], cfg.norm_eps)
-        q = (hn @ lw["wq"]).reshape(b, w, nh, hd)
-        k = (hn @ lw["wk"]).reshape(b, w, nkv, hd)
-        v = (hn @ lw["wv"]).reshape(b, w, nkv, hd)
+        q = wdot(hn, lw["wq"]).reshape(b, w, nh, hd)
+        k = wdot(hn, lw["wk"]).reshape(b, w, nkv, hd)
+        v = wdot(hn, lw["wv"]).reshape(b, w, nkv, hd)
         q, k = _rope_grid(q, freqs), _rope_grid(k, freqs)
         ck = ck.at[bi, posm].set(k.astype(ck.dtype))
         cv = cv.at[bi, posm].set(v.astype(cv.dtype))
@@ -119,7 +119,7 @@ def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
         probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
         attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
                           cv_a).reshape(b, w, nh * hd)
-        h = h + attn @ lw["wo"]
+        h = h + wdot(attn, lw["wo"])
         hn = rmsnorm(h, lw["ffn_norm"], cfg.norm_eps)
         h = h + ffn_block(cfg, hn, lw, token_mask=token_mask,
                           moe_no_drop=True)
@@ -127,7 +127,7 @@ def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
 
     x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+    logits = lm_head_dot(x, params, cfg.dtype)
     return logits, KVCache(nk, nv)
 
 

@@ -42,7 +42,7 @@ from jax import lax
 
 from ..models.generate import (KVCache, _layer_step, init_cache, rope_freqs)
 from ..models.llama import rmsnorm
-from ..models.quant import head_weight
+from ..models.quant import lm_head_dot
 
 
 @partial(jax.jit, static_argnames=("cfg", "logits", "no_drop"),
@@ -85,9 +85,8 @@ def _ingest(params, cache: KVCache, block, start, true_len, cfg,
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     if logits == "last":
         h_last = x[jnp.arange(b), true_len - 1]
-        return ((h_last @ head_weight(params, cfg.dtype))
-                .astype(jnp.float32)), KVCache(nk, nv)
-    out = (x @ head_weight(params, cfg.dtype)).astype(jnp.float32)
+        return lm_head_dot(h_last, params, cfg.dtype), KVCache(nk, nv)
+    out = lm_head_dot(x, params, cfg.dtype)
     return out, KVCache(nk, nv)
 
 

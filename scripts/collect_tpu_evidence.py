@@ -90,7 +90,7 @@ def main() -> int:
     if os.path.exists(b7):
         with open(b7) as f:
             lines += ["## 7B-class single-chip serving "
-                      "(scripts/tpu_7b_serve.py)", "",
+                      "(scripts/tpu_big_serve.py)", "",
                       "A Llama-3-8B-body model (~7.25B params, 32k vocab) "
                       "int8-initialized directly on one 16 GB v5e — bf16 "
                       "weights alone (~14.5 GB) would not fit — decoding "

@@ -1,14 +1,18 @@
-"""7B-class Llama decode on ONE v5e chip (16 GB HBM) via int8 weights.
+"""Big-model single-chip serving proof: models whose bf16 weights do NOT
+fit a 16 GB v5e, decoded on the continuous-batching engine via direct
+quantized init (``models.quant.llama_init_quantized``).
 
-bf16 weights alone for this config are ~14.5 GB — they don't fit beside a
-KV grid. ``llama_init_quantized`` builds the int8 set (~7.3 GB) directly,
-one layer-slice at a time, and the continuous-batching engine decodes on
-top with scanned blocks.
+- ``--model 7b-int8``: Llama-3-8B body (~7.25B params), int8 ≈ 6.9 GiB
+  (bf16 ≈ 14.5 GB)
+- ``--model 13b-int4``: 13B-class body (~11.3B params), nibble-packed
+  int4 ≈ 5.7 GiB (bf16 ≈ 22.6 GB; int8 + cache + embed is already tight)
 
 Run detached (never timeout-kill a TPU-holding process):
-``nohup python scripts/tpu_7b_serve.py > /tmp/serve_7b.log 2>&1 &``
+``nohup python scripts/tpu_big_serve.py --model 13b-int4
+> /tmp/serve_13b.log 2>&1 &``
 """
 
+import argparse
 import os
 import sys
 import time
@@ -18,8 +22,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import numpy as np
 
+MODELS = {
+    # name: (cfg kwargs, bits, decode_block ladder)
+    "7b-int8": (dict(vocab_size=32768, dim=4096, n_layers=32, n_heads=32,
+                     n_kv_heads=8, ffn_dim=14336), 8, (16, 64)),
+    "13b-int4": (dict(vocab_size=32768, dim=5120, n_layers=40, n_heads=40,
+                      n_kv_heads=8, ffn_dim=13824), 4, (64,)),
+}
 
-def main():
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="7b-int8")
+    args = ap.parse_args(argv)
+
     dev = jax.devices()[0]
     print("device:", dev, dev.device_kind, flush=True)
     if jax.default_backend() != "tpu":
@@ -31,25 +47,23 @@ def main():
                                             quantized_bytes)
     from kubetorch_tpu.serve import GenerationEngine
 
-    # Llama-3-8B body (dim 4096 / 32 layers / GQA 32:8 / ffn 14336) with a
-    # 32k vocab — ~7.25B params
-    cfg = LlamaConfig(vocab_size=32768, dim=4096, n_layers=32, n_heads=32,
-                      n_kv_heads=8, ffn_dim=14336, max_seq_len=1024,
-                      attn_impl="flash", remat=False)
+    cfg_kw, bits, blocks = MODELS[args.model]
+    cfg = LlamaConfig(max_seq_len=1024, attn_impl="flash", remat=False,
+                      **cfg_kw)
     t0 = time.time()
-    params = llama_init_quantized(jax.random.PRNGKey(0), cfg)
+    params = llama_init_quantized(jax.random.PRNGKey(0), cfg, bits=bits)
     jax.block_until_ready(params)
     sizes = quantized_bytes(params)
-    total_q = sizes["quantized"] + sizes["full"]
-    print(f"init {time.time()-t0:.0f}s; int8+scales "
+    total = sizes["quantized"] + sizes["full"]
+    print(f"init {time.time()-t0:.0f}s; int{bits}+scales "
           f"{sizes['quantized']/2**30:.2f} GiB + full-prec "
-          f"{sizes['full']/2**30:.2f} GiB = {total_q/2**30:.2f} GiB on chip",
+          f"{sizes['full']/2**30:.2f} GiB = {total/2**30:.2f} GiB on chip",
           flush=True)
 
     slots = 8
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(slots, 128))
-    for blk in (16, 64):
+    for blk in blocks:
         eng = GenerationEngine(params, cfg, slots=slots, max_len=1024,
                                prefill_buckets=(128,), decode_block=blk)
         for p in prompts:
@@ -65,12 +79,12 @@ def main():
             eng.step()
             steps += blk
         dt = time.time() - t0
-        print(f"7B-class int8 decode block={blk}: "
+        print(f"{args.model} decode block={blk}: "
               f"{slots * steps / dt:6.0f} tok/s/chip "
               f"({steps} steps {dt:.2f}s, grid {slots})", flush=True)
         del eng
 
-    print("7B SERVE OK", flush=True)
+    print("BIG SERVE OK", flush=True)
     return 0
 
 

@@ -1,0 +1,87 @@
+"""Micro-probe: which int4 dequant formulation does XLA fuse on TPU?
+
+Times x @ W for one big weight under: bf16 baseline, int8 fused dequant,
+and three int4 unpack formulations. Decode-shaped x (8 rows) so the dot
+is bandwidth-bound — the number IS the weight-stream rate.
+
+nohup python scripts/tpu_int4_probe.py > /tmp/int4_probe.log 2>&1 &
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(name, fn, *args, iters=50):
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    t0 = time.time()
+    for _ in range(iters):
+        out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    dt = (time.time() - t0) / iters
+    print(f"{name:28s} {dt * 1e3:8.2f} ms/iter", flush=True)
+    return dt
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        print("NOT TPU")
+        return 1
+    din, dout = 8192, 8192
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (8, din), jnp.bfloat16)
+    wb = jax.random.normal(key, (din, dout), jnp.bfloat16)
+    w8 = jax.random.randint(key, (din, dout), -127, 127, jnp.int8)
+    s8 = jnp.ones((1, dout), jnp.float32)
+    packed = jax.random.randint(key, (din // 2, dout), -128, 127, jnp.int8)
+    s4 = jnp.ones((din // 128, dout), jnp.float32)
+
+    bench("bf16", lambda x, w: x @ w, x, wb)
+    bench("int8 fused", lambda x, w, s: x @ (w.astype(jnp.float32)
+                                             * s).astype(jnp.bfloat16),
+          x, w8, s8)
+
+    def int4_interleave(x, p, s):
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        q = jnp.stack([lo, hi], axis=-2).reshape(din, dout)
+        w = (q.astype(jnp.float32).reshape(din // 128, 128, dout)
+             * s[:, None, :]).reshape(din, dout)
+        return x @ w.astype(jnp.bfloat16)
+
+    def int4_split(x, p, s):
+        # no interleave: low nibbles are rows [0, din/2), high the rest —
+        # two dots against shift-only operands, no reshuffle
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        xl, xh = x[:, :din // 2], x[:, din // 2:]
+        sl = s[:din // 256].repeat(128, axis=0)[: din // 2]
+        sh = s[din // 256:].repeat(128, axis=0)[: din // 2]
+        yl = xl @ (lo.astype(jnp.float32)).astype(jnp.bfloat16)
+        yh = xh @ (hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        return yl + yh                  # scales folded out for probe
+
+    def int4_int8mat(x, p, s):
+        # unpack to int8, let the dot consume int8 (one materialized int8
+        # copy, half of bf16's bytes)
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        q = jnp.stack([lo, hi], axis=-2).reshape(din, dout)
+        return x @ q.astype(jnp.bfloat16)
+
+    bench("int4 interleave+f32 (ours)", int4_interleave, x, packed, s4)
+    bench("int4 split two dots", int4_split, x, packed, s4)
+    bench("int4 unpack->int8 dot", int4_int8mat, x, packed, s4)
+    print("PROBE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

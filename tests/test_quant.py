@@ -169,3 +169,118 @@ class TestInitQuantized:
         while eng.step():
             pass
         assert h.result(timeout=0) == want
+
+
+class TestInt4:
+    """Nibble-packed int4: half of int8's decode bytes again. Same
+    placement contract as int8 (dequant location never changes tokens);
+    group-wise scales bound the quantization step to amax/7 per group."""
+
+    def test_pack_unpack_and_roundtrip_error(self):
+        from kubetorch_tpu.models.quant import (_dequant_int4,
+                                                _quantize_leaf_int4)
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+        leaf = _quantize_leaf_int4(w, group=16)
+        assert leaf["__kt_q4__"].shape == (32, 32)       # packed pairs
+        assert leaf["scale"].shape == (4, 32)            # 64/16 groups
+        wd = _dequant_int4(leaf, jnp.float32)
+        err = np.asarray(jnp.abs(wd - w))
+        # per-group bound: half a quant step of that group's amax
+        wg = np.asarray(w).reshape(4, 16, 32)
+        bound = np.abs(wg).max(axis=1, keepdims=True) / 7 * 0.51 + 1e-6
+        assert (err.reshape(4, 16, 32) <= bound).all()
+
+    def test_generate_and_engine_match_dequantized_view(self):
+        from kubetorch_tpu.models.quant import (dequantize_params,
+                                                quantize_params_int4)
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        p4 = quantize_params_int4(llama_init(jax.random.PRNGKey(0), cfg),
+                                  group=16)
+        prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+        want = np.asarray(generate(p4, prompt, cfg,
+                                   max_new_tokens=6))[0, 3:].tolist()
+        dq = dequantize_params(p4, jnp.float32)
+        got = np.asarray(generate(dq, prompt, cfg,
+                                  max_new_tokens=6))[0, 3:].tolist()
+        assert got == want
+        eng = GenerationEngine(p4, cfg, slots=2, max_len=64,
+                               prefill_buckets=(4,), decode_block=4)
+        h = eng.submit([5, 17, 42], max_new_tokens=6)
+        while eng.step():
+            pass
+        assert h.result(timeout=0) == want
+
+    def test_direct_int4_init_matches_structure(self):
+        from kubetorch_tpu.models.quant import (llama_init_quantized,
+                                                quantize_params_int4,
+                                                quantized_bytes)
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        p4i = llama_init_quantized(jax.random.PRNGKey(0), cfg, bits=4)
+        ref = quantize_params_int4(llama_init(jax.random.PRNGKey(0), cfg))
+        assert (jax.tree_util.tree_structure(p4i)
+                == jax.tree_util.tree_structure(ref))
+        b4 = quantized_bytes(p4i)["quantized"]
+        from kubetorch_tpu.models.quant import llama_init_quantized as liq
+        b8 = quantized_bytes(liq(jax.random.PRNGKey(0), cfg,
+                                 bits=8))["quantized"]
+        assert b4 < 0.75 * b8                      # packed, not just typed
+
+    def test_moe_experts_stay_int8(self):
+        from kubetorch_tpu.models.moe import MoeConfig, moe_init
+        from kubetorch_tpu.models.quant import QKEY, quantize_params_int4
+        cfg = MoeConfig.tiny(n_experts=4)
+        p4 = quantize_params_int4(moe_init(jax.random.PRNGKey(0), cfg))
+        experts = p4["layers"]["experts"]
+        leaf = next(iter(v for v in experts.values()))
+        assert QKEY in leaf                        # int8, gather-indexable
+        assert "__kt_q4__" in p4["layers"]["wq"]
+
+
+class TestQ4Kernel:
+    """Fused int4 matmul (ops/quant_matmul.py): the packed nibbles are the
+    HBM stream; unpack happens in VMEM. Interpret mode here; the on-chip
+    path is exercised by scripts/tpu_big_serve.py."""
+
+    def _leaf_and_x(self, din=256, dout=512, b=8):
+        from kubetorch_tpu.models.quant import _quantize_leaf_int4
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (din, dout), jnp.float32)
+        x = jax.random.normal(jax.random.fold_in(key, 1), (b, din),
+                              jnp.float32)
+        return x, w, _quantize_leaf_int4(w, group=128)
+
+    def test_kernel_matches_xla_dequant(self):
+        from kubetorch_tpu.models.quant import _dequant_int4
+        from kubetorch_tpu.ops.quant_matmul import q4_matmul, q4_supported
+        x, _w, leaf = self._leaf_and_x()
+        assert q4_supported(x.shape, leaf["__kt_q4__"].shape,
+                            leaf["scale"].shape)
+        ref = (x.astype(jnp.bfloat16)
+               @ _dequant_int4(leaf, jnp.bfloat16)).astype(jnp.float32)
+        got = q4_matmul(x, leaf["__kt_q4__"], leaf["scale"])
+        rel = (float(jnp.max(jnp.abs(got - ref)))
+               / float(jnp.max(jnp.abs(ref))))
+        assert rel < 0.02, rel
+
+    def test_wdot_dispatches_and_fallback_agrees(self):
+        from kubetorch_tpu.models.quant import _quantize_leaf_int4, wdot
+        x, w, leaf = self._leaf_and_x()
+        via_kernel = wdot(x.astype(jnp.bfloat16), leaf)
+        # an untileable group (din 256 / group 64 → block_k 64) falls back
+        leaf_small = _quantize_leaf_int4(w, group=64)
+        via_fallback = wdot(x.astype(jnp.bfloat16), leaf_small)
+        assert via_kernel.shape == via_fallback.shape == (8, 512)
+        # both approximate the real product
+        ref = (x @ w).astype(jnp.float32)
+        for got in (via_kernel, via_fallback):
+            rel = (float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
+                   / float(jnp.max(jnp.abs(ref))))
+            assert rel < 0.2, rel          # 4-bit weights, loose bound
+
+    def test_wdot_plain_array_is_plain_matmul(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (16, 8), jnp.float32)
+        from kubetorch_tpu.models.quant import wdot
+        assert (np.asarray(wdot(x, w)) == np.asarray(x @ w)).all()

@@ -287,14 +287,36 @@ class OpenAIApp:
             return _error(400, f"n must be in [1, 128], got {n}")
         if n > 1 and body.get("stream"):
             return _error(400, "streaming with n > 1 is not supported")
+        best_of = body.get("best_of")
+        if best_of is not None:
+            if chat:
+                return _error(400, "best_of applies to /v1/completions only")
+            if not (isinstance(best_of, int)
+                    and not isinstance(best_of, bool)):
+                return _error(400, f"best_of must be an integer, "
+                                   f"got {best_of!r}")
+            if not n <= best_of <= 128:
+                return _error(400, f"best_of must be in [n, 128], "
+                                   f"got {best_of} (n={n})")
+            if body.get("stream"):
+                return _error(400, "streaming with best_of is not supported")
+        if body.get("echo"):
+            # explicit refusals mirror OpenAI: echo is a completions-only,
+            # non-streaming field — silently dropping it would hand back
+            # wrong output to a client relying on it
+            if chat:
+                return _error(400, "echo applies to /v1/completions only")
+            if body.get("stream"):
+                return _error(400, "streaming with echo is not supported")
+        n_submit = best_of if best_of is not None else n
         try:
             prompt_ids = (self._chat_prompt(body.get("messages"))
                           if chat else self._encode_prompt(body.get("prompt")))
-            # n independent engine requests decode concurrently on the
-            # slot grid, each drawing its own sampling keys
+            # the candidates decode concurrently on the slot grid, each
+            # drawing its own sampling keys
             pairs = []
             try:
-                for i in range(n):
+                for i in range(n_submit):
                     h, cutter, tok_stops = self._submit(body, prompt_ids,
                                                         choice_index=i)
                     pairs.append((h, cutter))
@@ -313,8 +335,10 @@ class OpenAIApp:
             (handle, cutter), = pairs
             return await self._stream(request, handle, cutter, rid, chat,
                                       tok_stops, want_logprobs)
-        return await self._blocking(pairs, rid, chat, len(prompt_ids),
-                                    tok_stops, want_logprobs)
+        return await self._blocking(pairs, rid, chat, prompt_ids,
+                                    tok_stops, want_logprobs, keep_n=n,
+                                    echo=bool(body.get("echo"))
+                                    and not chat)
 
     def _finished_by_stop(self, ids: List[int], tok_stops) -> bool:
         if (self.engine.eos_id is not None and ids
@@ -323,11 +347,12 @@ class OpenAIApp:
         return any(len(q) <= len(ids) and ids[len(ids) - len(q):] == list(q)
                    for q in tok_stops)
 
-    async def _blocking(self, pairs, rid, chat, n_prompt,
-                        tok_stops, want_logprobs=False):
+    async def _blocking(self, pairs, rid, chat, prompt_ids,
+                        tok_stops, want_logprobs=False, keep_n=None,
+                        echo=False):
         loop = asyncio.get_running_loop()
-        choices = []
-        total = 0
+        n_prompt = len(prompt_ids)
+        results = []
         for index, (handle, cutter) in enumerate(pairs):
             try:
                 ids = await loop.run_in_executor(None, handle.result)
@@ -335,7 +360,35 @@ class OpenAIApp:
                 for h, _c in pairs[index + 1:]:
                     h.cancel()
                 return _error(400, str(e))
-            total += len(ids)
+            results.append((ids, handle.logprobs, cutter))
+        total = sum(len(ids) for ids, _lp, _c in results)
+        if keep_n is not None and keep_n < len(results):
+            # best_of: rank candidates by mean token logprob (the OpenAI
+            # rule) over the VISIBLE tokens — a text stop hides the tail
+            # at response-build time, and scoring dropped text would let
+            # a worse visible completion win. Token stops/eos retire the
+            # request in-engine, so only text stops can leave a tail.
+            # Usage still counts EVERY candidate's tokens (all decoded).
+            def visible(ids, cutter):
+                if self.tokenizer is None or not cutter.stops:
+                    return len(ids)
+                acc = ""
+                for i, t in enumerate(ids):
+                    acc += self._decode([t])
+                    if any(s in acc for s in cutter.stops):
+                        return i + 1
+                return len(ids)
+
+            def score(r):
+                ids, lp_list, cutter = r
+                lps = [lp for lp in lp_list[:visible(ids, cutter)]
+                       if lp is not None]
+                return sum(lps) / len(lps) if lps else float("-inf")
+            results = sorted(results, key=score, reverse=True)[:keep_n]
+        echo_text = (self._decode(list(prompt_ids))
+                     if echo and self.tokenizer is not None else None)
+        choices = []
+        for index, (ids, lp_list, cutter) in enumerate(results):
             text = None
             finish = "stop" if self._finished_by_stop(ids, tok_stops) \
                 else "length"
@@ -344,7 +397,15 @@ class OpenAIApp:
                 text = piece if matched else piece + cutter.flush()
                 if matched:
                     finish = "stop"
-            lps = handle.logprobs if want_logprobs else None
+            lps = lp_list if want_logprobs else None
+            if echo:
+                # OpenAI echo: the prompt rides in front of the
+                # completion (prompt tokens carry no logprobs)
+                ids = list(prompt_ids) + ids
+                if text is not None:
+                    text = echo_text + text
+                if lps is not None:
+                    lps = [None] * n_prompt + lps
             if chat:
                 choice = {"index": index, "finish_reason": finish,
                           "message": {"role": "assistant",

@@ -459,3 +459,57 @@ def test_seeded_n_choices_are_distinct_but_reproducible(dense):
             assert r.status == 400
 
     run_api_test(dense, body, slots=4)
+
+
+def test_best_of_and_echo(dense):
+    """best_of decodes extra candidates and keeps the top n by mean
+    logprob (usage counts every candidate); echo prepends the prompt."""
+    params, cfg = dense
+
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": [5, 17, 42], "max_tokens": 4, "temperature": 1.0,
+            "n": 2, "best_of": 4, "seed": 3, "logprobs": True})
+        assert r.status == 200, await r.text()
+        d = await r.json()
+        assert len(d["choices"]) == 2
+        assert d["usage"]["completion_tokens"] == 16   # all 4 candidates
+        # ranked: choice 0's mean logprob >= choice 1's
+        def mean_lp(c):
+            lps = [l for l in c["logprobs"]["token_logprobs"]
+                   if l is not None]
+            return sum(lps) / len(lps)
+        assert mean_lp(d["choices"][0]) >= mean_lp(d["choices"][1])
+        # echo: the prompt ids lead the completion; their logprobs None
+        r = await client.post("/v1/completions", json={
+            "prompt": [5, 17, 42], "max_tokens": 3, "temperature": 0,
+            "echo": True, "logprobs": True})
+        c = (await r.json())["choices"][0]
+        assert c["token_ids"][:3] == [5, 17, 42]
+        assert len(c["token_ids"]) == 6
+        assert c["logprobs"]["token_logprobs"][:3] == [None] * 3
+        # refusals: chat best_of, best_of < n, stream+best_of
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [], "best_of": 2})
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={
+            "prompt": [1], "n": 3, "best_of": 2})
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={
+            "prompt": [1], "best_of": 2, "stream": True})
+        assert r.status == 400
+
+    run_api_test(dense, body, slots=4)
+
+
+def test_echo_refusals(dense):
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "prompt": [1, 2], "max_tokens": 2, "echo": True,
+            "stream": True})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "x"}], "echo": True})
+        assert r.status == 400
+
+    run_api_test(dense, body)

@@ -700,6 +700,9 @@ class EngineStats:
     tokens_generated: int
     decode_steps: int
     tokens_per_sec: float
+    # rolling mean time-to-first-token over the last admissions (secs);
+    # 0.0 until anything has admitted
+    ttft_avg: float = 0.0
 
 
 class GenerationEngine:
@@ -841,6 +844,7 @@ class GenerationEngine:
         # stats
         self._admitted = self._finished = 0
         self._tokens = self._steps = 0
+        self._ttfts = deque(maxlen=256)   # rolling TTFT window
         self._t0 = time.monotonic()
 
     # -- adapters -----------------------------------------------------------
@@ -1467,6 +1471,9 @@ class GenerationEngine:
             self._aidx[slot] = aidx
         self._admitted += 1
         self._emit(slot, first_tok, float(flp[0]))
+        # TTFT sample at the only place it's defined: the first emit
+        if req.first_token_at is not None:
+            self._ttfts.append(req.first_token_at - req.submitted_at)
 
     def _admit_one(self, req: _Request, slot: int) -> None:
         pref = self._resolve_prefix(req)
@@ -1655,7 +1662,9 @@ class GenerationEngine:
             finished_total=self._finished,
             tokens_generated=self._tokens,
             decode_steps=self._steps,
-            tokens_per_sec=self._tokens / dt)
+            tokens_per_sec=self._tokens / dt,
+            ttft_avg=(sum(self._ttfts) / len(self._ttfts)
+                      if self._ttfts else 0.0))
 
     def __kt_metrics__(self) -> Dict[str, float]:
         """Pod-scrape hook (``serving.process_worker`` — the
@@ -1671,6 +1680,7 @@ class GenerationEngine:
                "engine_tokens_generated": float(s.tokens_generated),
                "engine_decode_steps": float(s.decode_steps),
                "engine_tokens_per_sec": float(s.tokens_per_sec),
+               "engine_ttft_avg_seconds": float(s.ttft_avg),
                "engine_prefix_hits": float(self._prefix_hits)}
         spec = getattr(self, "spec_stats", None)
         if spec is not None:

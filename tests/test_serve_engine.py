@@ -1484,3 +1484,17 @@ class TestPerRequestSeed:
         finally:
             eng.stop()
         assert outs[0] == outs[1]
+
+
+def test_ttft_stat_populates(dense):
+    params, cfg = dense
+    eng = GenerationEngine(params, cfg, slots=2, max_len=32,
+                           prefill_buckets=(4,))
+    assert eng.stats().ttft_avg == 0.0
+    h = eng.submit([1, 2], max_new_tokens=3)
+    while eng.step():
+        pass
+    s = eng.stats()
+    assert s.ttft_avg > 0.0
+    assert abs(s.ttft_avg - h.time_to_first_token()) < 1e-6
+    assert eng.__kt_metrics__()["engine_ttft_avg_seconds"] == s.ttft_avg

@@ -215,6 +215,13 @@ class OpenAIApp:
         eng = self.engine
         if "router" in eng.params.get("layers", {}):
             raise ValueError("embeddings are not supported for MoE models")
+        from ..models.quant import is_quantized
+        if any(is_quantized(v) for v in eng.params["layers"].values()):
+            # llama_hidden is the full-precision forward; refuse cleanly
+            # instead of crashing inside its jit on a dict leaf
+            raise ValueError(
+                "embeddings need full-precision params — this engine "
+                "serves quantized weights (generation only)")
         if len(ids) > eng.max_len:
             raise ValueError(f"input ({len(ids)} tokens) exceeds max_len "
                              f"({eng.max_len})")

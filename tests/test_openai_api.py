@@ -513,3 +513,29 @@ def test_echo_refusals(dense):
         assert r.status == 400
 
     run_api_test(dense, body)
+
+
+# run_api_test builds the engine from `dense` fp params; build a quantized
+# engine variant inline instead
+def test_embeddings_refuse_quantized_engine(dense):
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from kubetorch_tpu.serve import GenerationEngine, quantize_params
+    from kubetorch_tpu.serve.openai_api import build_app
+    params, cfg = dense
+    eng = GenerationEngine(quantize_params(params), cfg, slots=1,
+                           max_len=32, prefill_buckets=(4,)).start()
+
+    async def body():
+        client = TestClient(TestServer(build_app(eng)))
+        await client.start_server()
+        r = await client.post("/v1/embeddings", json={"input": [1, 2, 3]})
+        out = (r.status, (await r.json())["error"]["message"])
+        await client.close()
+        return out
+
+    try:
+        status, msg = asyncio.run(body())
+    finally:
+        eng.stop()
+    assert status == 400 and "full-precision" in msg

@@ -82,12 +82,22 @@ def test_lora_finetune_example(capsys):
     multi-LoRA engine, on one remote service."""
     from kubetorch_tpu.client import shutdown_local_controller
     from kubetorch_tpu.config import reset_config
+    from kubetorch_tpu.exceptions import PodTerminatedError
 
     import lora_finetune
 
     reset_config()
     try:
-        lora_finetune.main()
+        # one retry on PodTerminatedError ONLY: under full-suite memory
+        # pressure the host OOM killer occasionally takes a local pod
+        # subprocess mid-call (an environment capacity flake, seen solely
+        # in parallel CI runs — the test passes standalone every time)
+        try:
+            lora_finetune.main()
+        except PodTerminatedError:
+            shutdown_local_controller()
+            reset_config()
+            lora_finetune.main()
         out = capsys.readouterr().out
         assert "finetune #1: loss" in out
         assert "serving merged+int8 model: 8 tokens" in out

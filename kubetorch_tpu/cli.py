@@ -576,6 +576,21 @@ def server_start(port, workload):
     server_main(["--port", str(port if port is not None else parse_port())])
 
 
+@cli.command("serve", context_settings={"ignore_unknown_options": True})
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def serve_cmd(args):
+    """OpenAI-compatible server for a HF checkpoint (vLLM-style UX):
+
+    \b
+      kt serve --ckpt /path/to/llama --port 8000 --int8 --decode-block 32
+
+    All flags pass through to ``kubetorch_tpu.serve.openai_api`` (run it
+    with --help for the full list: slots, max-len, auto-prefix,
+    prefill-chunk, ...)."""
+    from .serve.openai_api import main as serve_main
+    serve_main(list(args))
+
+
 # -- store -------------------------------------------------------------------
 
 

@@ -133,3 +133,17 @@ class TestClusterCliSmokes:
         assert "http://web:8888" in r.output
         assert "jupyter lab" in seen["cmd"] and seen["port"] == 8888
         assert seen["tpu"].chips == 8 and seen["tpu"].generation.name == "v5e"
+
+
+def test_serve_passthrough_help(runner):
+    """kt serve forwards to the OpenAI server's argparse (vLLM-style)."""
+    import pytest
+    with pytest.raises(SystemExit):
+        # argparse --help exits 0; click's runner doesn't catch argparse's
+        # SystemExit from the passthrough, which is exactly the proof the
+        # flags reach openai_api.main
+        from kubetorch_tpu.serve.openai_api import main as serve_main
+        serve_main(["--help"])
+    r = runner.invoke(cli, ["serve", "--help"])
+    assert r.exit_code == 0
+    assert "kt serve --ckpt" in r.output

@@ -59,7 +59,7 @@ def _rope_grid(x: jax.Array, freqs: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("cfg", "s_eff"), donate_argnums=(1,))
-def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
+def _grid_ingest(params, cache, blocks, start, true_len, cfg,
                  s_eff: Optional[int] = None):
     """Run a (B, W) token window through the model, each slot at its own
     absolute positions ``start[b] + i``, writing cache rows and returning
@@ -82,9 +82,18 @@ def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
     in this codebase: (T,) scanned generate, (B,) slot decode, (B, W)
     here) — divergence from ``generate``'s semantics is pinned by the
     bit-exactness oracles in tests/test_spec_engine.py, which fail on ANY
-    drift in norm/RoPE/cache/MoE behavior."""
+    drift in norm/RoPE/cache/MoE behavior.
+
+    ``cache`` may be a fp ``KVCache`` or an int8 ``QuantKVCache``
+    (``serve.kv_quant``) — the pytree structure keys the jit. The quant
+    branch quantizes new rows before writing and folds the row scales
+    into the attention f32 einsums (logits columns ·ks, probs ·vs) — the
+    same reference math as ``engine._decode_layer_quant``, so the verify
+    window attends bit-compatibly with the T=1 decode it must match."""
+    from .kv_quant import QuantKVCache, quantize_rows
+    quant = isinstance(cache, QuantKVCache)
     b, w = blocks.shape
-    s_max = cache.k.shape[2]
+    s_max = cache.kq.shape[2] if quant else cache.k.shape[2]
     if s_eff is None:
         s_eff = s_max
     x = params["embed"][blocks].astype(cfg.dtype)
@@ -96,49 +105,91 @@ def _grid_ingest(params, cache: KVCache, blocks, start, true_len, cfg,
     group = nh // nkv
     bi = jnp.arange(b)[:, None]
 
-    def body(carry, layer):
-        lw, ck, cv = layer
-        lw = dequant_layer(lw, cfg.dtype)
-        h = carry
+    def proj_qkv(lw, h):
         hn = rmsnorm(h, lw["attn_norm"], cfg.norm_eps)
         q = wdot(hn, lw["wq"]).reshape(b, w, nh, hd)
         k = wdot(hn, lw["wk"]).reshape(b, w, nkv, hd)
         v = wdot(hn, lw["wv"]).reshape(b, w, nkv, hd)
-        q, k = _rope_grid(q, freqs), _rope_grid(k, freqs)
-        ck = ck.at[bi, posm].set(k.astype(ck.dtype))
-        cv = cv.at[bi, posm].set(v.astype(cv.dtype))
+        return _rope_grid(q, freqs), _rope_grid(k, freqs), v
 
-        ck_a = lax.slice_in_dim(ck, 0, s_eff, axis=1)
-        cv_a = lax.slice_in_dim(cv, 0, s_eff, axis=1)
-        qg = q.reshape(b, w, nkv, group, hd)
-        logits = jnp.einsum("bwkgh,bskh->bkgws", qg,
-                            ck_a).astype(jnp.float32) * (hd ** -0.5)
-        mask = (jnp.arange(s_eff)[None, None, :]
-                <= posm[:, :, None])                         # (B, W, S_eff)
-        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
-        attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
-                          cv_a).reshape(b, w, nh * hd)
+    def finish(lw, h, attn):
         h = h + wdot(attn, lw["wo"])
         hn = rmsnorm(h, lw["ffn_norm"], cfg.norm_eps)
-        h = h + ffn_block(cfg, hn, lw, token_mask=token_mask,
-                          moe_no_drop=True)
-        return h, (ck, cv)
+        return h + ffn_block(cfg, hn, lw, token_mask=token_mask,
+                             moe_no_drop=True)
 
-    x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    def win_mask():
+        return (jnp.arange(s_eff)[None, None, :]
+                <= posm[:, :, None])                        # (B, W, S_eff)
+
+    if quant:
+        def body(carry, layer):
+            lw, kq, ks, vq, vs = layer
+            lw = dequant_layer(lw, cfg.dtype)
+            h = carry
+            q, k, v = proj_qkv(lw, h)
+            k_row, ks_row = quantize_rows(k)
+            v_row, vs_row = quantize_rows(v)
+            kq = kq.at[bi, posm].set(k_row)
+            ks = ks.at[bi, posm].set(ks_row)
+            vq = vq.at[bi, posm].set(v_row)
+            vs = vs.at[bi, posm].set(vs_row)
+            kq_a = lax.slice_in_dim(kq, 0, s_eff, axis=1)
+            ks_a = lax.slice_in_dim(ks, 0, s_eff, axis=1)
+            vq_a = lax.slice_in_dim(vq, 0, s_eff, axis=1)
+            vs_a = lax.slice_in_dim(vs, 0, s_eff, axis=1)
+            qg = q.reshape(b, w, nkv, group, hd).astype(jnp.float32)
+            logits = jnp.einsum("bwkgh,bskh->bkgws", qg,
+                                kq_a.astype(jnp.float32)) * (hd ** -0.5)
+            # fold the K row scales over the S axis: ks_a (B, S, NKV)
+            logits = logits * ks_a.transpose(0, 2, 1)[:, :, None, None, :]
+            logits = jnp.where(win_mask()[:, None, None], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1)
+            probs = probs * vs_a.transpose(0, 2, 1)[:, :, None, None, :]
+            attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
+                              vq_a.astype(jnp.float32)).reshape(
+                                  b, w, nh * hd).astype(h.dtype)
+            return finish(lw, h, attn), (kq, ks, vq, vs)
+
+        x, leaves = lax.scan(body, x, (params["layers"], cache.kq,
+                                       cache.ks, cache.vq, cache.vs))
+        new_cache = QuantKVCache(*leaves)
+    else:
+        def body(carry, layer):
+            lw, ck, cv = layer
+            lw = dequant_layer(lw, cfg.dtype)
+            h = carry
+            q, k, v = proj_qkv(lw, h)
+            ck = ck.at[bi, posm].set(k.astype(ck.dtype))
+            cv = cv.at[bi, posm].set(v.astype(cv.dtype))
+            ck_a = lax.slice_in_dim(ck, 0, s_eff, axis=1)
+            cv_a = lax.slice_in_dim(cv, 0, s_eff, axis=1)
+            qg = q.reshape(b, w, nkv, group, hd)
+            logits = jnp.einsum("bwkgh,bskh->bkgws", qg,
+                                ck_a).astype(jnp.float32) * (hd ** -0.5)
+            logits = jnp.where(win_mask()[:, None, None], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+            attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
+                              cv_a).reshape(b, w, nh * hd)
+            return finish(lw, h, attn), (ck, cv)
+
+        x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k,
+                                         cache.v))
+        new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = lm_head_dot(x, params, cfg.dtype)
-    return logits, KVCache(nk, nv)
+    return logits, new_cache
 
 
 class SpeculativeEngine(GenerationEngine):
     """Continuous batching with per-slot speculative decoding (module
     docstring has the design). Greedy-only — the exactness proof is the
     argmax acceptance rule; sampled speculation needs rejection sampling
-    and is out of scope. Prefix caching, adapters, and int8 KV are the
-    plain engine's territory for now — refused loudly rather than served
-    approximately. Tensor/data meshes work GSPMD-sharded like the plain
-    engine; a CONTEXT axis is also correct here but the window forwards
+    and is out of scope. int8 KV composes (``quantize_kv=True`` — the
+    TARGET cache quantizes; the draft stays fp, its cache is small);
+    prefix caching and adapters are the plain engine's territory for
+    now — refused loudly rather than served approximately. Tensor/data
+    meshes work GSPMD-sharded like the plain engine; a CONTEXT axis is also correct here but the window forwards
     have no per-shard combine yet, so the cache won't stay
     sequence-sharded — context-sharded serving is the plain engine's
     feature (``sp_decode_attention``)."""
@@ -153,9 +204,6 @@ class SpeculativeEngine(GenerationEngine):
         if kwargs.get("top_p") is not None:
             raise ValueError("top_p requires sampling — SpeculativeEngine "
                              "is greedy-only; use GenerationEngine")
-        if kwargs.get("quantize_kv"):
-            raise ValueError("quantize_kv is not supported with "
-                             "speculation yet — use GenerationEngine")
         if kwargs.get("decode_block", 1) != 1:
             raise ValueError("decode_block tunes GenerationEngine's plain "
                              "decode loop; a speculation round already "

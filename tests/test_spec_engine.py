@@ -141,9 +141,7 @@ class TestValidation:
         with pytest.raises(ValueError, match="greedy-only"):
             SpeculativeEngine(target, cfg, draft, dcfg, temperature=0.7,
                               max_len=64)
-        with pytest.raises(ValueError, match="quantize_kv"):
-            SpeculativeEngine(target, cfg, draft, dcfg, quantize_kv=True,
-                              max_len=64)
+        # quantize_kv is SUPPORTED now (TestInt8KvCache) — no refusal
         eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
                                 slots=2, max_len=32, prefill_buckets=(8,))
         with pytest.raises(ValueError, match="greedy-only"):
@@ -206,3 +204,40 @@ class TestFuzz:
                 want = _solo(target, cfg, prompt, n)
                 assert h.result(timeout=0) == want, (trial, k, slots,
                                                      prompt, n)
+
+
+class TestInt8KvCache:
+    """quantize_kv composes with speculation: the TARGET cache quantizes
+    (rows quantized at write, scales folded into the verify-window
+    attention), the draft stays fp. Oracle: the plain engine with the
+    same int8 cache — emitted streams must be bit-equal, since both run
+    the reference quant math over identical row values."""
+
+    def test_bit_equal_to_plain_quant_engine(self):
+        from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+        from kubetorch_tpu.serve import GenerationEngine
+        from kubetorch_tpu.serve.spec_engine import SpeculativeEngine
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32,
+                               remat=False)
+        target = llama_init(jax.random.PRNGKey(0), cfg)
+        draft = llama_init(jax.random.PRNGKey(1), cfg)
+
+        def plain(prompt, n):
+            eng = GenerationEngine(target, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4, 8),
+                                   quantize_kv=True)
+            h = eng.submit(prompt, max_new_tokens=n)
+            while eng.step():
+                pass
+            return h.result(timeout=0)
+
+        spec = SpeculativeEngine(target, cfg, draft, cfg, spec_k=3,
+                                 slots=2, max_len=64,
+                                 prefill_buckets=(4, 8), quantize_kv=True)
+        prompts = [[5, 17, 42], [1, 2]]
+        hs = [spec.submit(p, max_new_tokens=8) for p in prompts]
+        while spec.step():
+            pass
+        for h, p in zip(hs, prompts):
+            assert h.result(timeout=0) == plain(p, 8), p
+        assert spec.spec_stats.rounds > 0

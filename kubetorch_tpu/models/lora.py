@@ -153,3 +153,17 @@ def lora_proj(x: jax.Array, w: jax.Array, lora, target: str) -> jax.Array:
         if ab is not None:
             y = y + lora_delta(x, ab[0], ab[1], scale)
     return y
+
+
+def gather_slot_adapters(bank_l, aidx, lora_scale, banks):
+    """THE per-slot multi-LoRA gather, shared by the plain decode step and
+    the speculative window forwards (one definition so the bank layout /
+    zero-adapter convention can never drift between them): ``bank_l`` is
+    one layer's target → (A (N, D, R), B (N, R, O)) stacked factors,
+    ``aidx`` (SLOTS,) the per-slot bank indices (0 = the zero adapter =
+    base). Returns a ``lora_proj``-shaped (adapters_by_target, scale), or
+    None when no bank exists."""
+    if banks:
+        return ({t: (a[aidx], b_[aidx])
+                 for t, (a, b_) in bank_l.items()}, lora_scale)
+    return None

@@ -330,11 +330,10 @@ def _decode_step_impl(params, cache, pos, toks, rng, temps, cfg,
     x = params["embed"][toks[:, None]].astype(cfg.dtype)   # (B, 1, D)
     freqs = rope_freqs(cfg, s_max)[pos]                     # (B, Hd/2)
 
+    from ..models.lora import gather_slot_adapters
+
     def make_lora(bank_l):
-        if banks:
-            return ({t: (a[aidx], b_[aidx])
-                     for t, (a, b_) in bank_l.items()}, lora_scale)
-        return None
+        return gather_slot_adapters(bank_l, aidx, lora_scale, banks)
 
     if quant:
         def body(carry, layer):

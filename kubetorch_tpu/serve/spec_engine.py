@@ -58,9 +58,11 @@ def _rope_grid(x: jax.Array, freqs: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "s_eff"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "s_eff", "lora_scale"),
+         donate_argnums=(1,))
 def _grid_ingest(params, cache, blocks, start, true_len, cfg,
-                 s_eff: Optional[int] = None):
+                 s_eff: Optional[int] = None, banks=None, aidx=None,
+                 lora_scale: float = 1.0):
     """Run a (B, W) token window through the model, each slot at its own
     absolute positions ``start[b] + i``, writing cache rows and returning
     fp32 logits for EVERY window position (B, W, V).
@@ -105,15 +107,22 @@ def _grid_ingest(params, cache, blocks, start, true_len, cfg,
     group = nh // nkv
     bi = jnp.arange(b)[:, None]
 
-    def proj_qkv(lw, h):
+    from ..models.lora import gather_slot_adapters, lora_proj
+
+    def make_lora(bank_l):
+        # the SAME gather the plain decode step uses (shared helper — the
+        # bank layout / zero-adapter convention cannot drift)
+        return gather_slot_adapters(bank_l, aidx, lora_scale, banks)
+
+    def proj_qkv(lw, h, lora):
         hn = rmsnorm(h, lw["attn_norm"], cfg.norm_eps)
-        q = wdot(hn, lw["wq"]).reshape(b, w, nh, hd)
-        k = wdot(hn, lw["wk"]).reshape(b, w, nkv, hd)
-        v = wdot(hn, lw["wv"]).reshape(b, w, nkv, hd)
+        q = lora_proj(hn, lw["wq"], lora, "wq").reshape(b, w, nh, hd)
+        k = lora_proj(hn, lw["wk"], lora, "wk").reshape(b, w, nkv, hd)
+        v = lora_proj(hn, lw["wv"], lora, "wv").reshape(b, w, nkv, hd)
         return _rope_grid(q, freqs), _rope_grid(k, freqs), v
 
-    def finish(lw, h, attn):
-        h = h + wdot(attn, lw["wo"])
+    def finish(lw, h, attn, lora):
+        h = h + lora_proj(attn, lw["wo"], lora, "wo")
         hn = rmsnorm(h, lw["ffn_norm"], cfg.norm_eps)
         return h + ffn_block(cfg, hn, lw, token_mask=token_mask,
                              moe_no_drop=True)
@@ -124,10 +133,11 @@ def _grid_ingest(params, cache, blocks, start, true_len, cfg,
 
     if quant:
         def body(carry, layer):
-            lw, kq, ks, vq, vs = layer
+            lw, kq, ks, vq, vs, bank_l = layer
             lw = dequant_layer(lw, cfg.dtype)
+            lora = make_lora(bank_l)
             h = carry
-            q, k, v = proj_qkv(lw, h)
+            q, k, v = proj_qkv(lw, h, lora)
             k_row, ks_row = quantize_rows(k)
             v_row, vs_row = quantize_rows(v)
             kq = kq.at[bi, posm].set(k_row)
@@ -149,17 +159,19 @@ def _grid_ingest(params, cache, blocks, start, true_len, cfg,
             attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
                               vq_a.astype(jnp.float32)).reshape(
                                   b, w, nh * hd).astype(h.dtype)
-            return finish(lw, h, attn), (kq, ks, vq, vs)
+            return finish(lw, h, attn, lora), (kq, ks, vq, vs)
 
         x, leaves = lax.scan(body, x, (params["layers"], cache.kq,
-                                       cache.ks, cache.vq, cache.vs))
+                                       cache.ks, cache.vq, cache.vs,
+                                       banks or {}))
         new_cache = QuantKVCache(*leaves)
     else:
         def body(carry, layer):
-            lw, ck, cv = layer
+            lw, ck, cv, bank_l = layer
             lw = dequant_layer(lw, cfg.dtype)
+            lora = make_lora(bank_l)
             h = carry
-            q, k, v = proj_qkv(lw, h)
+            q, k, v = proj_qkv(lw, h, lora)
             ck = ck.at[bi, posm].set(k.astype(ck.dtype))
             cv = cv.at[bi, posm].set(v.astype(cv.dtype))
             ck_a = lax.slice_in_dim(ck, 0, s_eff, axis=1)
@@ -171,10 +183,10 @@ def _grid_ingest(params, cache, blocks, start, true_len, cfg,
             probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
             attn = jnp.einsum("bkgws,bskh->bwkgh", probs,
                               cv_a).reshape(b, w, nh * hd)
-            return finish(lw, h, attn), (ck, cv)
+            return finish(lw, h, attn, lora), (ck, cv)
 
         x, (nk, nv) = lax.scan(body, x, (params["layers"], cache.k,
-                                         cache.v))
+                                         cache.v, banks or {}))
         new_cache = KVCache(nk, nv)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = lm_head_dot(x, params, cfg.dtype)
@@ -186,10 +198,13 @@ class SpeculativeEngine(GenerationEngine):
     docstring has the design). Greedy-only — the exactness proof is the
     argmax acceptance rule; sampled speculation needs rejection sampling
     and is out of scope. int8 KV composes (``quantize_kv=True`` — the
-    TARGET cache quantizes; the draft stays fp, its cache is small);
-    prefix caching and adapters are the plain engine's territory for
-    now — refused loudly rather than served approximately. Tensor/data
-    meshes work GSPMD-sharded like the plain engine; a CONTEXT axis is also correct here but the window forwards
+    TARGET cache quantizes; the draft stays fp, its cache is small), and
+    so does multi-LoRA (per-request ``adapter_id``: the target's window
+    forwards gather each slot's adapter while the draft proposes from
+    base weights — proposal quality only, never tokens). Prefix caching
+    is the plain engine's territory for now — refused loudly rather than
+    served approximately. Tensor/data meshes work GSPMD-sharded like the
+    plain engine; a CONTEXT axis is also correct here but the window forwards
     have no per-shard combine yet, so the cache won't stay
     sequence-sharded — context-sharded serving is the plain engine's
     feature (``sp_decode_attention``)."""
@@ -229,9 +244,9 @@ class SpeculativeEngine(GenerationEngine):
     # -- unsupported registrations refused at REGISTRATION time, before
     # they commit device memory no request could ever use ------------------
 
-    def register_adapter(self, adapters, lora_cfg) -> int:
-        raise ValueError("adapter serving is not supported with "
-                         "speculation yet — use GenerationEngine")
+    # register_adapter/unregister_adapter: the BASE implementations — the
+    # bank/aidx machinery is shared; the target's window forwards gather
+    # per-slot adapters exactly like the plain decode step
 
     def register_prefix(self, tokens: Sequence[int],
                         adapter_id: Optional[int] = None) -> int:
@@ -268,8 +283,8 @@ class SpeculativeEngine(GenerationEngine):
             raise ValueError("seed is meaningless for greedy speculation "
                              "(deterministic already) — use "
                              "GenerationEngine for sampled serving")
-        if prefix_id is not None or adapter_id is not None:
-            raise ValueError("prefix/adapter serving is not supported with "
+        if prefix_id is not None:
+            raise ValueError("prefix serving is not supported with "
                              "speculation yet — use GenerationEngine")
         prompt = [int(t) for t in prompt]
         # the verify window writes up to 2k+1 rows past the last emitted
@@ -284,7 +299,8 @@ class SpeculativeEngine(GenerationEngine):
         # stop sequences work unchanged: emission goes through the shared
         # _emit suffix check, and speculation is exact-greedy so stopping
         # early never changes the tokens that were already emitted
-        return super().submit(prompt, max_new_tokens, stop=stop)
+        return super().submit(prompt, max_new_tokens, stop=stop,
+                              adapter_id=adapter_id)
 
     # -- admission ----------------------------------------------------------
 
@@ -295,9 +311,12 @@ class SpeculativeEngine(GenerationEngine):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :t] = req.prompt
         block = jnp.asarray(padded)
+        adapter, aidx = self._resolve_adapter(req.adapter_id)
+        lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
+               if adapter is not None else {})
         first, k_new, v_new, _flp = _prefill(
             self.params, block, jnp.int32(t), self._next_key(), temps,
-            self.cfg)
+            self.cfg, **lkw)
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
         # the draft prefills the same prompt into ITS grid (its first-token
@@ -308,6 +327,14 @@ class SpeculativeEngine(GenerationEngine):
                                          dk, dv)
         first_tok = int(first[0])
         self._slot_req[slot] = req
+        with self._lock:
+            # the base engine's stale-index re-check: an adapter evicted
+            # during the prefill must fall back to base, never to a
+            # reused bank index
+            if (req.adapter_id is not None
+                    and self._adapter_slots.get(req.adapter_id) != aidx):
+                aidx = 0
+            self._aidx[slot] = aidx
         self._spec_valid[slot] = t
         self._slot_pending[slot] = [first_tok]
         self._admitted += 1
@@ -384,9 +411,14 @@ class SpeculativeEngine(GenerationEngine):
             tblock[i, :c[i]] = self._slot_pending[i]
             tblock[i, c[i]:c[i] + k] = proposals[i]
             tl[i] = c[i] + k
+        with self._lock:
+            banks = self._banks
+        lkw = ({"banks": banks, "aidx": jnp.asarray(self._aidx),
+                "lora_scale": self._lora_cfg.scale} if banks else {})
         tlog, self._cache = _grid_ingest(
             self.params, self._cache, jnp.asarray(tblock),
-            jnp.asarray(start), jnp.asarray(tl), self.cfg, s_eff=s_eff)
+            jnp.asarray(start), jnp.asarray(tl), self.cfg, s_eff=s_eff,
+            **lkw)
         greedy = np.asarray(jnp.argmax(tlog, axis=-1))   # (B, WT)
         self._steps += 1
 

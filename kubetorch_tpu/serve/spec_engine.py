@@ -41,7 +41,7 @@ from ..models.generate import KVCache, ffn_block, init_cache, rope_freqs
 from ..models.llama import rmsnorm
 from ..models.quant import dequant_layer, lm_head_dot, wdot
 from .engine import (GenerationEngine, _decode_block, _prefill,
-                     _splice_slot)
+                     _prefill_suffix, _splice_slot)
 from .speculative import SpecStats
 
 NEG_INF = -1e30
@@ -201,10 +201,10 @@ class SpeculativeEngine(GenerationEngine):
     TARGET cache quantizes; the draft stays fp, its cache is small), and
     so does multi-LoRA (per-request ``adapter_id``: the target's window
     forwards gather each slot's adapter while the draft proposes from
-    base weights — proposal quality only, never tokens). Prefix caching
-    is the plain engine's territory for now — refused loudly rather than
-    served approximately. Tensor/data meshes work GSPMD-sharded like the
-    plain engine; a CONTEXT axis is also correct here but the window forwards
+    base weights — proposal quality only, never tokens), and so does
+    prefix caching (``register_prefix`` prefills BOTH models' prefixes;
+    admission splices each into its own grid). Tensor/data meshes work
+    GSPMD-sharded like the plain engine; a CONTEXT axis is also correct here but the window forwards
     have no per-shard combine yet, so the cache won't stay
     sequence-sharded — context-sharded serving is the plain engine's
     feature (``sp_decode_attention``)."""
@@ -226,6 +226,12 @@ class SpeculativeEngine(GenerationEngine):
         if kwargs.get("prefill_chunk") is not None:
             raise ValueError("chunked prefill is not supported with "
                              "speculation yet — use GenerationEngine")
+        if kwargs.get("auto_prefix"):
+            # the verify-window headroom check runs in submit() BEFORE the
+            # base engine would auto-match a prefix — an auto-matched
+            # bucket could push the speculation window past max_len
+            raise ValueError("auto_prefix is not supported with "
+                             "speculation — pass prefix_id explicitly")
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         super().__init__(params, cfg, **kwargs)
@@ -239,6 +245,9 @@ class SpeculativeEngine(GenerationEngine):
         # ledger gets its own name
         self._spec_valid = np.zeros(self.slots, np.int32)
         self._slot_pending: List[List[int]] = [[] for _ in range(self.slots)]
+        # pid → (draft prefix K, V) — the target's tuples live in the base
+        # self._prefixes; widths are trimmed to match
+        self._draft_prefixes: Dict[int, tuple] = {}
         self.spec_stats = SpecStats()
 
     # -- unsupported registrations refused at REGISTRATION time, before
@@ -250,8 +259,30 @@ class SpeculativeEngine(GenerationEngine):
 
     def register_prefix(self, tokens: Sequence[int],
                         adapter_id: Optional[int] = None) -> int:
-        raise ValueError("prefix caching is not supported with "
-                         "speculation yet — use GenerationEngine")
+        """Prefix caching under speculation: the TARGET's prefix K/V comes
+        from the base machinery; the DRAFT (its own model) prefills the
+        same tokens through its own weights — both caches splice their
+        prefix at admission, at the same bucket widths (shared bucket
+        table), so the position ledgers stay aligned."""
+        pid = super().register_prefix(tokens, adapter_id)   # validates
+        with self._mesh_scope():
+            pk = self._prefixes[pid][0]
+            t = len(tokens)
+            # pad straight to the TARGET's stored width: one source of
+            # truth for the bucket/trim policy (the base), and the two
+            # models' prefix widths cannot desynchronize
+            padded = np.zeros((1, pk.shape[2]), np.int32)
+            padded[0, :t] = [int(x) for x in tokens]
+            _f, dk, dv, _lp = _prefill(
+                self.draft_params, jnp.asarray(padded), jnp.int32(t),
+                self._next_key(), jnp.zeros((1,), jnp.float32),
+                self.draft_cfg)
+            self._draft_prefixes[pid] = (dk, dv)
+        return pid
+
+    def unregister_prefix(self, prefix_id: int) -> bool:
+        self._draft_prefixes.pop(prefix_id, None)
+        return super().unregister_prefix(prefix_id)
 
     # -- submission ---------------------------------------------------------
 
@@ -283,46 +314,82 @@ class SpeculativeEngine(GenerationEngine):
             raise ValueError("seed is meaningless for greedy speculation "
                              "(deterministic already) — use "
                              "GenerationEngine for sampled serving")
-        if prefix_id is not None:
-            raise ValueError("prefix serving is not supported with "
-                             "speculation yet — use GenerationEngine")
         prompt = [int(t) for t in prompt]
+        p_bucket = 0
+        if prefix_id is not None:
+            pref = self._prefixes.get(prefix_id)
+            if pref is None:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            p_bucket = pref[0].shape[2]
         # the verify window writes up to 2k+1 rows past the last emitted
         # token — reserve that headroom so scatter rows stay in bounds
         if (prompt and max_new_tokens >= 1
-                and len(prompt) + max_new_tokens + 2 * self.k + 1
-                > self.max_len):
+                and p_bucket + len(prompt) + max_new_tokens
+                + 2 * self.k + 1 > self.max_len):
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) + verify window ({2 * self.k + 1}) "
-                f"exceeds max_len ({self.max_len})")
+                f"prefix bucket ({p_bucket}) + prompt ({len(prompt)}) + "
+                f"max_new_tokens ({max_new_tokens}) + verify window "
+                f"({2 * self.k + 1}) exceeds max_len ({self.max_len})")
         # stop sequences work unchanged: emission goes through the shared
         # _emit suffix check, and speculation is exact-greedy so stopping
         # early never changes the tokens that were already emitted
         return super().submit(prompt, max_new_tokens, stop=stop,
-                              adapter_id=adapter_id)
+                              adapter_id=adapter_id, prefix_id=prefix_id)
 
     # -- admission ----------------------------------------------------------
 
     def _admit_one(self, req, slot: int) -> None:
+        pref = self._resolve_prefix(req)
         t = len(req.prompt)
         temps = jnp.zeros((1,), jnp.float32)
-        bucket = next(b for b in self._buckets if b >= t)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :t] = req.prompt
-        block = jnp.asarray(padded)
         adapter, aidx = self._resolve_adapter(req.adapter_id)
         lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
                if adapter is not None else {})
-        first, k_new, v_new, _flp = _prefill(
-            self.params, block, jnp.int32(t), self._next_key(), temps,
-            self.cfg, **lkw)
+        if req.prefix_id is not None:
+            # both models continue behind their OWN cached prefix, at the
+            # same widths (registration pads the draft to the target's).
+            # Fetch the draft half ONCE: an unregister racing admission
+            # must fail this request cleanly, not half-resolve
+            pk, pv, p_real, _toks, _pad = pref
+            dpref = self._draft_prefixes.get(req.prefix_id)
+            if dpref is None:
+                raise KeyError(f"unknown prefix_id {req.prefix_id}")
+            dk_p, dv_p = dpref
+            p_bucket = pk.shape[2]
+            bucket = next((b for b in self._buckets if b >= t
+                           and p_bucket + b <= self.max_len), None)
+            if bucket is None:
+                bucket = self.max_len - p_bucket
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t] = req.prompt
+            block = jnp.asarray(padded)
+            first, k_new, v_new, _flp = _prefill_suffix(
+                self.params, block, jnp.int32(t), pk, pv,
+                jnp.int32(p_real), self._next_key(), temps, self.cfg,
+                **lkw)
+            _f2, dk, dv, _dlp = _prefill_suffix(
+                self.draft_params, block, jnp.int32(t), dk_p, dv_p,
+                jnp.int32(p_real), self._next_key(), temps,
+                self.draft_cfg)
+            start = int(p_real) + t
+            self._prefix_hits += 1
+        else:
+            bucket = next(b for b in self._buckets if b >= t)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :t] = req.prompt
+            block = jnp.asarray(padded)
+            first, k_new, v_new, _flp = _prefill(
+                self.params, block, jnp.int32(t), self._next_key(), temps,
+                self.cfg, **lkw)
+            # the draft prefills the same prompt into ITS grid (its
+            # first-token sample is discarded — the target owns every
+            # emitted token)
+            _f2, dk, dv, _dlp = _prefill(
+                self.draft_params, block, jnp.int32(t), self._next_key(),
+                temps, self.draft_cfg)
+            start = t
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
-        # the draft prefills the same prompt into ITS grid (its first-token
-        # sample is discarded — the target owns every emitted token)
-        _, dk, dv, _dlp = _prefill(self.draft_params, block, jnp.int32(t),
-                             self._next_key(), temps, self.draft_cfg)
         self._draft_cache = _splice_slot(self._draft_cache, jnp.int32(slot),
                                          dk, dv)
         first_tok = int(first[0])
@@ -335,7 +402,7 @@ class SpeculativeEngine(GenerationEngine):
                     and self._adapter_slots.get(req.adapter_id) != aidx):
                 aidx = 0
             self._aidx[slot] = aidx
-        self._spec_valid[slot] = t
+        self._spec_valid[slot] = start
         self._slot_pending[slot] = [first_tok]
         self._admitted += 1
         # a retirement on this first token clears the ledgers through the

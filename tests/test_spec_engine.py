@@ -146,15 +146,13 @@ class TestValidation:
                                 slots=2, max_len=32, prefill_buckets=(8,))
         with pytest.raises(ValueError, match="greedy-only"):
             eng.submit([1, 2], max_new_tokens=3, temperature=0.5)
-        with pytest.raises(ValueError, match="prefix"):
-            eng.submit([1, 2], max_new_tokens=3, prefix_id=0)
+        with pytest.raises(KeyError, match="prefix"):
+            eng.submit([1, 2], max_new_tokens=3, prefix_id=99)
         with pytest.raises(ValueError, match="verify window"):
             # 8 + 20 + 5 > 32: the verify window headroom must be reserved
             eng.submit([1] * 8, max_new_tokens=20)
-        # refused at REGISTRATION, before any device memory is committed
-        # (adapters are SUPPORTED now — TestMultiLora; prefixes are not)
-        with pytest.raises(ValueError, match="GenerationEngine"):
-            eng.register_prefix([1, 2, 3])
+        # prefixes and adapters are both SUPPORTED now (TestPrefixCache,
+        # TestMultiLora) — an unknown id is the only registration error
 
     def test_background_loop(self, models):
         target, cfg, draft, dcfg = models
@@ -294,3 +292,56 @@ class TestMultiLora:
         while spec.step():
             pass
         assert h_c.result(timeout=0) == plain([5, 17, 42], 4)
+
+
+class TestPrefixCache:
+    """Prefix caching under speculation: both models splice their own
+    cached prefix at admission (same bucket widths), and the emitted
+    stream equals the plain engine's prefix run AND the full-prompt solo
+    run — exact for dense models."""
+
+    def test_prefix_matches_plain_and_full(self, models):
+        from kubetorch_tpu.serve import GenerationEngine
+        target, cfg, draft, dcfg = models
+        prefix, suffix = [5, 17, 42], [9, 11]
+
+        def plain(n, use_prefix):
+            eng = GenerationEngine(target, cfg, slots=1, max_len=64,
+                                   prefill_buckets=(4, 8))
+            kw, p = {}, prefix + suffix
+            if use_prefix:
+                kw["prefix_id"] = eng.register_prefix(prefix)
+                p = suffix
+            h = eng.submit(p, max_new_tokens=n, **kw)
+            while eng.step():
+                pass
+            return h.result(timeout=0)
+
+        spec = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=3,
+                                 slots=2, max_len=64,
+                                 prefill_buckets=(4, 8))
+        pid = spec.register_prefix(prefix)
+        h = spec.submit(suffix, max_new_tokens=8, prefix_id=pid)
+        h2 = spec.submit([1, 2], max_new_tokens=5)
+        _drain(spec)
+        assert h.result(timeout=0) == plain(8, True) == plain(8, False)
+        assert len(h2.result(timeout=0)) == 5
+        # eviction clears BOTH models' cached prefixes
+        assert spec.unregister_prefix(pid)
+        assert pid not in spec._draft_prefixes
+        # verify-window headroom accounts for the prefix bucket
+        pid2 = spec.register_prefix([1] * 8)
+        with pytest.raises(ValueError, match="verify window"):
+            spec.submit([2] * 8, max_new_tokens=48, prefix_id=pid2)
+
+    def test_registration_validation_and_auto_prefix_refusal(self, models):
+        target, cfg, draft, dcfg = models
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                slots=1, max_len=32, prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="empty"):
+            eng.register_prefix([])
+        with pytest.raises(ValueError, match="max_len"):
+            eng.register_prefix([1] * 32)
+        with pytest.raises(ValueError, match="auto_prefix"):
+            SpeculativeEngine(target, cfg, draft, dcfg, max_len=32,
+                              auto_prefix=True)

@@ -203,8 +203,9 @@ class SpeculativeEngine(GenerationEngine):
     forwards gather each slot's adapter while the draft proposes from
     base weights — proposal quality only, never tokens), and so does
     prefix caching (``register_prefix`` prefills BOTH models' prefixes;
-    admission splices each into its own grid). Tensor/data meshes work
-    GSPMD-sharded like the plain engine; a CONTEXT axis is also correct here but the window forwards
+    admission splices each into its own grid), and chunked prefill
+    (``prefill_chunk`` — both accumulators advance one chunk per step).
+    Tensor/data meshes work GSPMD-sharded like the plain engine; a CONTEXT axis is also correct here but the window forwards
     have no per-shard combine yet, so the cache won't stay
     sequence-sharded — context-sharded serving is the plain engine's
     feature (``sp_decode_attention``)."""
@@ -223,9 +224,6 @@ class SpeculativeEngine(GenerationEngine):
             raise ValueError("decode_block tunes GenerationEngine's plain "
                              "decode loop; a speculation round already "
                              "batches its device work — use spec_k")
-        if kwargs.get("prefill_chunk") is not None:
-            raise ValueError("chunked prefill is not supported with "
-                             "speculation yet — use GenerationEngine")
         if kwargs.get("auto_prefix"):
             # the verify-window headroom check runs in submit() BEFORE the
             # base engine would auto-match a prefix — an auto-matched
@@ -388,6 +386,13 @@ class SpeculativeEngine(GenerationEngine):
                 self.draft_params, block, jnp.int32(t), self._next_key(),
                 temps, self.draft_cfg)
             start = t
+        self._seat(req, slot, first, k_new, v_new, dk, dv, start, aidx)
+
+    def _seat(self, req, slot, first, k_new, v_new, dk, dv, start,
+              aidx) -> None:
+        """Post-prefill seating shared by one-shot and chunked admission:
+        splice BOTH caches, set the speculation ledgers, re-check the
+        adapter mapping, emit the first (target-sampled) token."""
         self._cache = _splice_slot(self._cache, jnp.int32(slot),
                                    k_new, v_new)
         self._draft_cache = _splice_slot(self._draft_cache, jnp.int32(slot),
@@ -409,6 +414,90 @@ class SpeculativeEngine(GenerationEngine):
         # shared _retire_slot → _free_slot_ledgers path
         self._emit(slot, first_tok)
 
+    # -- chunked prefill (both models) --------------------------------------
+
+    def _start_chunking(self, req, slot: int) -> None:
+        """First chunk of a long admission, for BOTH models: two
+        max_len-capacity accumulators advance in lockstep (the base
+        engine's single-accumulator scheme, doubled)."""
+        pref = self._resolve_prefix(req)
+        adapter, aidx = self._resolve_adapter(req.adapter_id)
+        lkw = ({"adapter": adapter, "lora_scale": self._lora_cfg.scale}
+               if adapter is not None else {})
+        c = self.prefill_chunk
+        zero_t = jnp.zeros((1,), jnp.float32)
+        if req.prefix_id is not None:
+            pk, pv, p_real, _toks, _pad = pref
+            dpref = self._draft_prefixes.get(req.prefix_id)
+            if dpref is None:
+                raise KeyError(f"unknown prefix_id {req.prefix_id}")
+            tk, tv = pk, pv
+            dk, dv = dpref
+            self._prefix_hits += 1
+            consumed, frontier = 0, int(p_real)
+        else:
+            toks = req.prompt[:c]
+            padded = np.zeros((1, c), np.int32)
+            padded[0, :] = toks
+            block = jnp.asarray(padded)
+            _f, tk, tv, _lp = _prefill(
+                self.params, block, jnp.int32(c), self._dummy_key, zero_t,
+                self.cfg, **lkw)
+            _f2, dk, dv, _lp2 = _prefill(
+                self.draft_params, block, jnp.int32(c), self._dummy_key,
+                zero_t, self.draft_cfg)
+            consumed = frontier = c
+
+        def widen(arr):
+            pad_w = self.max_len - arr.shape[2]
+            spec = [(0, 0)] * arr.ndim
+            spec[2] = (0, pad_w)
+            return jnp.pad(arr, spec)
+
+        self._chunking = (req, slot, widen(tk), widen(tv), widen(dk),
+                          widen(dv), consumed, frontier, lkw, aidx)
+
+    def _chunk_step(self) -> None:
+        (req, slot, tk, tv, dk, dv, consumed, frontier,
+         lkw, aidx) = self._chunking
+        if req.cancelled:
+            self._chunking = None
+            req.out.put(None)
+            return
+        c = self.prefill_chunk
+        rest = len(req.prompt) - consumed
+        take = min(c, rest)
+        padded = np.zeros((1, c), np.int32)
+        padded[0, :take] = req.prompt[consumed:consumed + take]
+        block = jnp.asarray(padded)
+        zero_t = jnp.zeros((1,), jnp.float32)
+        last = take == rest
+        try:
+            key = (self._next_key() if last else self._dummy_key)
+            first, tk, tv, _lp = _prefill_suffix(
+                self.params, block, jnp.int32(take), tk, tv,
+                jnp.int32(frontier), key, zero_t, self.cfg, **lkw)
+            _f2, dk, dv, _lp2 = _prefill_suffix(
+                self.draft_params, block, jnp.int32(take), dk, dv,
+                jnp.int32(frontier), self._dummy_key, zero_t,
+                self.draft_cfg)
+            if not last:
+                self._chunking = (req, slot, tk[:, :, :self.max_len],
+                                  tv[:, :, :self.max_len],
+                                  dk[:, :, :self.max_len],
+                                  dv[:, :, :self.max_len],
+                                  consumed + take, frontier + take,
+                                  lkw, aidx)
+                return
+            self._chunking = None
+            self._seat(req, slot, first, tk[:, :, :self.max_len],
+                       tv[:, :, :self.max_len], dk[:, :, :self.max_len],
+                       dv[:, :, :self.max_len], frontier + take, aidx)
+        except Exception as e:   # noqa: BLE001 — fail THIS request only
+            self._chunking = None
+            req.error = e
+            req.out.put(None)
+
     # -- the speculative round ----------------------------------------------
 
     def _free_slot_ledgers(self, slot: int) -> None:
@@ -425,7 +514,11 @@ class SpeculativeEngine(GenerationEngine):
                 self._round(active)
         with self._lock:
             queued = len(self._pending)
-        return sum(r is not None for r in self._slot_req) + queued
+        # a mid-chunked-admission request is neither seated nor pending —
+        # count it so drive loops don't stop with work in flight (the
+        # base _step_once has the same term)
+        return (sum(r is not None for r in self._slot_req) + queued
+                + (1 if self._chunking is not None else 0))
 
     def _round(self, active: List[int]) -> None:
         b, k = self.slots, self.k

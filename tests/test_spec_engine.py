@@ -371,3 +371,50 @@ class TestShardedSpec:
             _drain(eng)
         for h, w in zip(handles, want):
             assert h.result(timeout=0) == w
+
+
+class TestChunkedPrefill:
+    """prefill_chunk under speculation: BOTH models' accumulators advance
+    one chunk per engine step; the emitted stream equals the plain
+    engine's decode of the same prompt."""
+
+    def test_long_prompt_chunked_exact(self, models):
+        target, cfg, draft, dcfg = models
+        long_prompt = list(range(5, 16))
+        want = _solo(target, cfg, long_prompt, 8)
+        spec = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=3,
+                                 slots=2, max_len=64,
+                                 prefill_buckets=(4, 16), prefill_chunk=4)
+        h = spec.submit(long_prompt, max_new_tokens=8)
+        h2 = spec.submit([1, 2], max_new_tokens=5)       # short neighbor
+        _drain(spec)
+        assert h.result(timeout=0) == want
+        assert len(h2.result(timeout=0)) == 5
+
+    def test_chunked_behind_prefix(self, models):
+        target, cfg, draft, dcfg = models
+        prefix = [5, 17, 42]
+        suffix = list(range(30, 39))
+        want = _solo(target, cfg, prefix + suffix, 5)
+        spec = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                 slots=1, max_len=64,
+                                 prefill_buckets=(4, 8), prefill_chunk=4)
+        pid = spec.register_prefix(prefix)
+        h = spec.submit(suffix, max_new_tokens=5, prefix_id=pid)
+        _drain(spec)
+        assert h.result(timeout=0) == want
+
+    def test_cancel_mid_chunking(self, models):
+        target, cfg, draft, dcfg = models
+        spec = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                                 slots=2, max_len=64,
+                                 prefill_buckets=(4, 16), prefill_chunk=4)
+        h = spec.submit(list(range(5, 16)), max_new_tokens=6)
+        spec.step()
+        assert h.cancel() is True
+        _drain(spec)
+        assert h.result(timeout=0) == []
+        w2 = _solo(target, cfg, [9], 3)
+        h2 = spec.submit([9], max_new_tokens=3)
+        _drain(spec)
+        assert h2.result(timeout=0) == w2

@@ -75,20 +75,24 @@ def test_concurrency_scale_up_then_idle_scale_down():
         assert _pod_count(f.name) == 1
 
         results = []
-        threads = [threading.Thread(target=lambda: results.append(f(10)))
+        # the calls must HOLD their pods long enough for the autoscaler to
+        # observe 3 in-flight and boot 2 pods even on a contended CI core
+        # (pod boot alone can take ~10s there) — 25s of hold + a 40s
+        # observation window keeps the first test of the suite flake-free
+        threads = [threading.Thread(target=lambda: results.append(f(25)))
                    for _ in range(3)]
         for t in threads:
             t.start()
         # 3 in-flight calls / target 1 → 3 pods (scale-up must not disturb
         # the busy pod: the calls still complete)
-        grown = _wait_for_pods(f.name, lambda n: n >= 3, timeout=20)
+        grown = _wait_for_pods(f.name, lambda n: n >= 3, timeout=40)
         assert grown == 3, f"never scaled up (pods={grown})"
         for t in threads:
-            t.join(timeout=60)
-        assert results == [10, 10, 10]
+            t.join(timeout=120)
+        assert results == [25, 25, 25]
 
         # idle past scale_down_delay → back to min_scale
-        shrunk = _wait_for_pods(f.name, lambda n: n == 1, timeout=30)
+        shrunk = _wait_for_pods(f.name, lambda n: n == 1, timeout=45)
         assert shrunk == 1, f"never scaled down (pods={shrunk})"
     finally:
         f.teardown()

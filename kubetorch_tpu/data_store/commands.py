@@ -8,19 +8,34 @@ which is what makes *resharding on get* possible: each leaf is fetched once
 and ``jax.device_put`` with the target mesh's NamedSharding places exactly
 the shards this host needs.
 
+Data-plane hot path (the trainer→inference weight-sync loop):
+
+- Leaves fan out over a shared thread pool (``KT_STORE_CONCURRENCY``,
+  default 8; see :mod:`.netpool`), each worker on its own pooled
+  ``requests.Session``. On get, decode + ``jax.device_put`` run inside the
+  workers, so device placement pipelines behind the wire.
+- Every leaf PUT carries a ``blake2b`` content hash in ``X-KT-Meta``; before
+  uploading, the client asks ``POST /kv/diff`` which leaves the store
+  already holds current, and skips their bytes entirely. A repeated
+  identical put (LoRA-only update, re-pushed checkpoint) therefore moves
+  only the index — ``put`` returns ``{leaves, bytes, skipped}``.
+
 Directories ride the ktsync tree protocol; single files ride the KV store.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import threading
 from typing import Any, Dict, List, Optional
 
 import requests as _requests
 
 from ..config import config
 from ..exceptions import DataStoreError
+from . import netpool
 from .types import BroadcastWindow
 
 _INDEX_SUFFIX = ".__kt_index__"
@@ -140,25 +155,70 @@ def put(key: str, src: Any, store_url: Optional[str] = None,
         "path, an array, or a pytree of arrays")
 
 
+def _leaf_hash(host) -> str:
+    """blake2b-20 of the leaf's raw bytes — the content address the delta
+    protocol diffs on. Hashes the array's buffer in place (no tobytes copy
+    for the contiguous common case)."""
+    if host.flags["C_CONTIGUOUS"]:
+        buf = host.data
+    else:
+        buf = host.tobytes()
+    return hashlib.blake2b(buf, digest_size=20).hexdigest()
+
+
 def _put_pytree(url: str, key: str, tree: Any) -> Dict:
     import numpy as np
 
     leaves: Dict[str, Any] = {}
     _flatten(tree, "", leaves)
-    index = {"leaves": {}, "structure": _structure_of(tree)}
-    total = 0
-    sess = _requests.Session()
+    index: Dict[str, Any] = {"leaves": {}, "structure": _structure_of(tree)}
+
+    # Stage device → host and content-hash every leaf first: the hashes
+    # drive one /kv/diff round-trip that decides which leaves move at all.
+    hosts: Dict[str, Any] = {}
     for path, arr in leaves.items():
-        host = np.asarray(arr)  # device → host staging
+        host = np.asarray(arr)
+        if not host.flags["C_CONTIGUOUS"]:
+            host = np.ascontiguousarray(host)
+        hosts[path] = host
+        index["leaves"][path] = {"dtype": str(host.dtype),
+                                 "shape": list(host.shape),
+                                 "kind": "array",
+                                 "blake2b": _leaf_hash(host)}
+
+    current = _kv_diff(
+        url, {f"{key}/{p}": m["blake2b"] for p, m in index["leaves"].items()})
+    to_upload = [p for p in hosts if f"{key}/{p}" not in current]
+
+    def _upload(path: str) -> int:
+        host = hosts[path]
         data = host.tobytes()
-        meta = {"dtype": str(host.dtype), "shape": list(host.shape),
-                "kind": "array"}
-        _kv_put(url, f"{key}/{path}", data, meta, sess)
-        index["leaves"][path] = meta
-        total += len(data)
+        _kv_put(url, f"{key}/{path}", data, index["leaves"][path])
+        return len(data)
+
+    total = sum(netpool.map_concurrent(_upload, to_upload))
+    # index lands last: a reader that sees the new index sees complete leaves
     _kv_put(url, f"{key}{_INDEX_SUFFIX}",
-            json.dumps(index).encode(), {"kind": "index"}, sess)
-    return {"leaves": len(leaves), "bytes": total}
+            json.dumps(index).encode(), {"kind": "index"})
+    return {"leaves": len(leaves), "bytes": total,
+            "skipped": len(leaves) - len(to_upload)}
+
+
+def _kv_diff(url: str, hashes: Dict[str, str]) -> set:
+    """Ask the store which of ``hashes`` it already holds current; returns
+    the set of keys whose bytes can be skipped. Wire shape mirrors
+    ``/tree/diff``: ``{keys: {key: blake2b}} → {missing: [key, ...]}``.
+    A store without the endpoint (pre-delta build) skips nothing."""
+    if not hashes:
+        return set()
+    try:
+        r = netpool.session().post(f"{url}/kv/diff", json={"keys": hashes},
+                                   timeout=netpool.store_timeout(60))
+        if r.status_code != 200:
+            return set()
+        return set(hashes) - set(r.json()["missing"])
+    except (_requests.RequestException, ValueError, KeyError):
+        return set()
 
 
 def _flatten(tree: Any, prefix: str, out: Dict[str, Any]) -> None:
@@ -188,9 +248,10 @@ def _structure_of(tree: Any) -> Any:
 
 def _kv_put(url: str, key: str, data: bytes, meta: Dict,
             sess: Optional[_requests.Session] = None) -> Dict:
-    sess = sess or _requests
+    sess = sess or netpool.session()
     r = sess.put(f"{url}/kv/{key}", data=data,
-                 headers={"X-KT-Meta": json.dumps(meta)}, timeout=600)
+                 headers={"X-KT-Meta": json.dumps(meta)},
+                 timeout=netpool.store_timeout())
     if r.status_code != 200:
         raise DataStoreError(f"put {key!r} failed: {r.status_code} {r.text[:200]}")
     return r.json()
@@ -217,12 +278,20 @@ class _RoutedFetcher:
     Peer mode is automatic inside pods (POD_IP set: the pod server serves
     the cache) and off for laptops, which can't reach pod IPs; ``peer=``
     overrides.
+
+    Thread-safe: ``_get_pytree`` fans leaf fetches over the netpool
+    executor, so one fetcher serves many workers. Route resolution happens
+    once (under ``_lock``), the peer no-progress window is shared (progress
+    by ANY worker re-arms it; one worker's eviction is seen by all), and
+    ``/route/complete`` fires at most once.
     """
 
-    def __init__(self, store_url: str, key: str, sess, peer: Optional[bool]):
+    def __init__(self, store_url: str, key: str, peer: Optional[bool],
+                 sess: Optional[_requests.Session] = None):
         self.store_url = store_url
         self.key = key
-        self.sess = sess
+        self.sess = sess            # explicit session override (tests);
+        #                             None → per-thread pooled session
         self.enabled = (bool(os.environ.get("POD_IP"))
                         if peer is None else bool(peer))
         self.peer_url: Optional[str] = None
@@ -230,13 +299,19 @@ class _RoutedFetcher:
         self._resolved = False
         self._fetched = False
         self._deadline: Optional[float] = None
+        self._lock = threading.Lock()
+        self._complete_sent = False
+
+    def _sess(self) -> _requests.Session:
+        return self.sess if self.sess is not None else netpool.session()
 
     def head(self, subkey: str) -> bool:
         """Cheap existence probe against the STORE only (metadata-sized, like
         the reference's MDS lookup): decides the key's kind without pulling
         bulk bytes or touching peer wait windows."""
         try:
-            r = self.sess.head(f"{self.store_url}/kv/{subkey}", timeout=30)
+            r = self._sess().head(f"{self.store_url}/kv/{subkey}",
+                                  timeout=netpool.store_timeout(30))
             return r.status_code == 200
         except _requests.RequestException:
             return False
@@ -259,22 +334,29 @@ class _RoutedFetcher:
         return None
 
     def _resolve(self) -> None:
-        if self._resolved or not self.enabled:
+        if not self.enabled:
             return
-        self._resolved = True
-        try:
-            r = self.sess.post(f"{self.store_url}/route",
-                               json={"key": self.key,
-                                     "self_url": self._self_url(),
-                                     "self_blob_url": self._self_blob_url()},
-                               timeout=10)
-            if r.status_code == 200 and r.json().get("source") == "peer":
-                self.peer_url = r.json()["url"]
-                self.peer_blob_url = r.json().get("blob_url")
-        except _requests.RequestException:
-            self.peer_url = None
+        with self._lock:
+            if self._resolved:
+                return
+            # resolve INSIDE the lock: concurrent workers wait for the one
+            # routing verdict instead of racing past an unset peer_url
+            # straight to the store
+            self._resolved = True
+            try:
+                r = self._sess().post(
+                    f"{self.store_url}/route",
+                    json={"key": self.key,
+                          "self_url": self._self_url(),
+                          "self_blob_url": self._self_blob_url()},
+                    timeout=10)
+                if r.status_code == 200 and r.json().get("source") == "peer":
+                    self.peer_url = r.json()["url"]
+                    self.peer_blob_url = r.json().get("blob_url")
+            except _requests.RequestException:
+                self.peer_url = None
 
-    def fetch(self, subkey: str, timeout: float = 600):
+    def fetch(self, subkey: str, timeout: Optional[float] = None):
         """GET one subkey; returns the response (store-shaped: 200 + body +
         X-KT-Meta). Order: pod-local cache (another rank worker may already
         hold it — zero network), then the assigned peer, then the store.
@@ -283,13 +365,16 @@ class _RoutedFetcher:
         fetch (the reference's rolling join: the child "blocks until parent
         done"). A 404 from the parent therefore means *not yet* — poll until
         the deadline, then fall back. The ``KT_PEER_WAIT_S`` (default 60s)
-        budget is a NO-PROGRESS window: each successful peer fetch re-arms
-        it, so a healthy parent mid-download of a large multi-leaf get is
-        never evicted, while a parent that stops producing for one full
-        window is reported failed and everything goes to the store.
-        Connection errors evict the parent immediately."""
+        budget is a NO-PROGRESS window shared by all workers: each
+        successful peer fetch re-arms it, so a healthy parent mid-download
+        of a large multi-leaf get is never evicted, while a parent that
+        stops producing for one full window is reported failed and
+        everything goes to the store. Connection errors evict the parent
+        immediately."""
         import time as _time
 
+        if timeout is None:
+            timeout = netpool.store_timeout()
         if self.enabled:
             from .peer_cache import cache_get
             hit = cache_get(subkey)
@@ -297,38 +382,56 @@ class _RoutedFetcher:
                 self._fetched = True
                 return _CachedResponse(*hit)
         self._resolve()
-        if self.peer_url is not None:
-            if self._deadline is None:
-                self._deadline = _time.monotonic() + float(
-                    os.environ.get("KT_PEER_WAIT_S", "60"))
-            while True:
-                try:
-                    r = self._fetch_from_peer(subkey, timeout)
-                except _requests.RequestException:
-                    self._report_failed()
-                    self.peer_url = None
-                    break
-                if r.status_code == 200:
-                    # progress resets the window: a healthy parent slowly
-                    # serving a large multi-leaf checkpoint must not be
-                    # evicted mid-download; only a parent that stops
-                    # producing for a FULL window is reported failed
-                    self._deadline = None
-                    self._cache(subkey, r)
-                    return r
-                if r.status_code != 404:
-                    break            # parent errored; store covers this one
-                if _time.monotonic() >= self._deadline:
-                    # the parent's window is spent: evict it so later
-                    # joiners aren't routed to a cache that never fills
-                    self._report_failed()
-                    self.peer_url = None
-                    break
-                _time.sleep(0.25)
-        r = self.sess.get(f"{self.store_url}/kv/{subkey}", timeout=timeout)
+        while True:
+            with self._lock:
+                peer = self.peer_url
+                if peer is not None and self._deadline is None:
+                    self._deadline = _time.monotonic() + float(
+                        os.environ.get("KT_PEER_WAIT_S", "60"))
+            if peer is None:
+                break
+            try:
+                r = self._fetch_from_peer(subkey, timeout)
+            except _requests.RequestException:
+                self._evict_peer(peer)
+                break
+            if r.status_code == 200:
+                # progress resets the window: a healthy parent slowly
+                # serving a large multi-leaf checkpoint must not be
+                # evicted mid-download; only a parent that stops
+                # producing for a FULL window is reported failed
+                with self._lock:
+                    if self.peer_url == peer:
+                        self._deadline = None
+                self._cache(subkey, r)
+                return r
+            if r.status_code != 404:
+                break            # parent errored; store covers this one
+            with self._lock:
+                expired = (self.peer_url == peer
+                           and self._deadline is not None
+                           and _time.monotonic() >= self._deadline)
+            if expired:
+                # the parent's window is spent: evict it so later
+                # joiners aren't routed to a cache that never fills
+                self._evict_peer(peer)
+                break
+            _time.sleep(0.25)
+        r = self._sess().get(f"{self.store_url}/kv/{subkey}", timeout=timeout)
         if r.status_code == 200:
             self._cache(subkey, r)
         return r
+
+    def _evict_peer(self, peer: str) -> None:
+        """Drop ``peer`` as parent (first evictor wins; concurrent workers
+        that raced on the same dead parent are no-ops) and tell the store."""
+        with self._lock:
+            if self.peer_url != peer:
+                return
+            self.peer_url = None
+            self.peer_blob_url = None
+            self._deadline = None
+        self._report_failed(peer)
 
     def _fetch_from_peer(self, subkey: str, timeout: float):
         """One peer attempt. Prefers the parent's ktblobd (native
@@ -337,7 +440,9 @@ class _RoutedFetcher:
         compatibility path for pods without the native build. A blobd
         connection error only disables the FAST PATH — the parent itself is
         judged by its pod-server route."""
-        if self.peer_blob_url is not None:
+        # snapshot: a concurrent worker may evict the peer mid-attempt
+        peer_url, blob_url = self.peer_url, self.peer_blob_url
+        if blob_url is not None:
             from .peer_cache import entry_hash
             h = entry_hash(subkey)
             try:
@@ -346,13 +451,13 @@ class _RoutedFetcher:
                 # multi-GB) .bin is complete — probing .bin first would
                 # download the payload just to discard it when the entry
                 # turns out half-written
-                rm = self.sess.get(f"{self.peer_blob_url}/blob/{h}.json",
-                                   timeout=30)
+                rm = self._sess().get(f"{blob_url}/blob/{h}.json",
+                                      timeout=30)
                 if rm.status_code == 200:
                     entry = json.loads(rm.content)
                     if entry.get("key") == subkey:   # collision paranoia
-                        rb = self.sess.get(
-                            f"{self.peer_blob_url}/blob/{h}.bin",
+                        rb = self._sess().get(
+                            f"{blob_url}/blob/{h}.bin",
                             timeout=timeout)
                         if rb.status_code == 200:
                             return _CachedResponse(rb.content,
@@ -364,8 +469,8 @@ class _RoutedFetcher:
                     return rm
             except (_requests.RequestException, ValueError):
                 self.peer_blob_url = None   # fast path off; parent still ok
-        return self.sess.get(f"{self.peer_url}/_kt/data/{subkey}",
-                             timeout=timeout)
+        return self._sess().get(f"{peer_url}/_kt/data/{subkey}",
+                                timeout=timeout)
 
     def _cache(self, subkey: str, r) -> None:
         if not self.enabled or self._self_url() is None:
@@ -383,24 +488,30 @@ class _RoutedFetcher:
         except OSError:
             pass                    # cache full/unwritable: still a getter
 
-    def _report_failed(self) -> None:
+    def _report_failed(self, peer_url: str) -> None:
         try:
-            self.sess.post(f"{self.store_url}/route/failed",
-                           json={"key": self.key, "url": self.peer_url},
-                           timeout=10)
+            self._sess().post(f"{self.store_url}/route/failed",
+                              json={"key": self.key, "url": peer_url},
+                              timeout=10)
         except _requests.RequestException:
             pass
 
     def complete(self) -> None:
-        """Become a parent for later joiners (only once we hold data)."""
+        """Become a parent for later joiners (only once we hold data).
+        Idempotent: exactly one ``/route/complete`` per fetcher, however
+        many workers (or repeated callers) land here."""
         self_url = self._self_url()
         if not (self.enabled and self._fetched and self_url):
             return
+        with self._lock:
+            if self._complete_sent:
+                return
+            self._complete_sent = True
         try:
-            self.sess.post(f"{self.store_url}/route/complete",
-                           json={"key": self.key, "url": self_url,
-                                 "blob_url": self._self_blob_url()},
-                           timeout=10)
+            self._sess().post(f"{self.store_url}/route/complete",
+                              json={"key": self.key, "url": self_url,
+                                    "blob_url": self._self_blob_url()},
+                              timeout=10)
         except _requests.RequestException:
             pass
 
@@ -434,8 +545,7 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
     peer wait window polling for a pytree index that cannot exist.
     """
     url = _store_url(store_url)
-    sess = _requests.Session()
-    fetcher = _RoutedFetcher(url, key, sess, peer)
+    fetcher = _RoutedFetcher(url, key, peer)
 
     if fetcher.head(f"{key}{_INDEX_SUFFIX}"):
         r = fetcher.fetch(f"{key}{_INDEX_SUFFIX}", timeout=60)
@@ -449,12 +559,13 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         if r.status_code == 200:
             return _finish_raw(r, dest, sharding, fetcher)
 
-    r = sess.get(f"{url}/tree/{key}/manifest", timeout=60)
+    r = netpool.session().get(f"{url}/tree/{key}/manifest",
+                              timeout=netpool.store_timeout(60))
     if r.status_code == 200:
         if not dest:
             raise DataStoreError(f"get: {key!r} is a directory tree; pass dest=")
         from .sync import pull_tree
-        return pull_tree(url, key, dest, session=sess)
+        return pull_tree(url, key, dest)
 
     # The store has nothing, but peers may (key evicted from the store after
     # the first wave fetched it — the rolling-broadcast tail): probe the
@@ -486,8 +597,8 @@ def _finish_raw(r, dest, sharding, fetcher: "_RoutedFetcher") -> Any:
 
 
 def _get_pytree(key, index, fetcher: _RoutedFetcher, sharding, mesh, rules) -> Any:
-    leaves: Dict[str, Any] = {}
-    for path, meta in index["leaves"].items():
+    def _one(item):
+        path, meta = item
         r = fetcher.fetch(f"{key}/{path}")
         if r.status_code != 200:
             raise DataStoreError(f"get: missing leaf {key}/{path}")
@@ -495,20 +606,36 @@ def _get_pytree(key, index, fetcher: _RoutedFetcher, sharding, mesh, rules) -> A
         if leaf_sharding is None and mesh is not None and rules is not None:
             from jax.sharding import NamedSharding
             leaf_sharding = NamedSharding(mesh, rules.spec_for(path, mesh))
-        leaves[path] = _decode_array(r.content, meta, leaf_sharding)
-    return _unflatten(index["structure"], "", leaves)
+        # decode + device_put inside the worker: placement of leaf k
+        # pipelines behind the wire transfer of leaf k+1
+        return path, _decode_array(r.content, meta, leaf_sharding)
+
+    pairs = netpool.map_concurrent(_one, index["leaves"].items())
+    return _unflatten(index["structure"], "", dict(pairs))
+
+
+def _np_dtype(dtype: str):
+    import numpy as np
+
+    if dtype == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
 
 
 def _decode_array(data: bytes, meta: Dict, sharding: Optional[Any]) -> Any:
     import numpy as np
 
-    dtype = meta["dtype"]
-    if dtype == "bfloat16":
-        import ml_dtypes
-        np_dtype = ml_dtypes.bfloat16
-    else:
-        np_dtype = np.dtype(dtype)
-    arr = np.frombuffer(data, dtype=np_dtype).reshape(meta["shape"]).copy()
+    # decode into a preallocated writable buffer: frombuffer(...).copy()
+    # would materialize a second full-size array while the wire bytes are
+    # still alive (2× peak per leaf)
+    arr = np.empty(meta["shape"], dtype=_np_dtype(meta["dtype"]))
+    view = arr.reshape(-1).view(np.uint8)
+    if view.nbytes != len(data):
+        raise DataStoreError(
+            f"leaf byte-size mismatch: body is {len(data)}B, meta "
+            f"{meta['dtype']}{meta['shape']} needs {view.nbytes}B")
+    view[:] = np.frombuffer(data, dtype=np.uint8)
     if sharding is not None:
         import jax
         return jax.device_put(arr, sharding)
@@ -537,7 +664,7 @@ def join_broadcast(key: str, window: BroadcastWindow,
 
     url = _store_url(store_url)
     member = member or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
-    r = _requests.post(f"{url}/barrier", json={
+    r = netpool.session().post(f"{url}/barrier", json={
         "group": window.group_id or f"bcast/{key}",
         "world_size": window.world_size,
         "member": member,
@@ -568,7 +695,8 @@ def get_broadcast(key: str, window: BroadcastWindow,
 
 def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
     url = _store_url(store_url)
-    r = _requests.get(f"{url}/keys", params={"prefix": prefix}, timeout=60)
+    r = netpool.session().get(f"{url}/keys", params={"prefix": prefix},
+                              timeout=netpool.store_timeout(60))
     if r.status_code != 200:
         raise DataStoreError(f"ls failed: {r.status_code}")
     # hide internal index keys
@@ -577,16 +705,20 @@ def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
 
 def rm(key: str, store_url: Optional[str] = None) -> bool:
     url = _store_url(store_url)
+    timeout = netpool.store_timeout(60)
+    sess = netpool.session()
     existed = False
-    r = _requests.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
+    r = sess.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=timeout)
     if r.status_code == 200:
         index = json.loads(r.content)
-        for path in index["leaves"]:
-            _requests.delete(f"{url}/kv/{key}/{path}", timeout=60)
-        _requests.delete(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=60)
+        netpool.map_concurrent(
+            lambda path: netpool.session().delete(
+                f"{url}/kv/{key}/{path}", timeout=netpool.store_timeout(60)),
+            index["leaves"])
+        sess.delete(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=timeout)
         existed = True
-    rd = _requests.delete(f"{url}/kv/{key}", timeout=60)
+    rd = sess.delete(f"{url}/kv/{key}", timeout=timeout)
     existed = existed or (rd.status_code == 200 and rd.json().get("existed"))
-    rt = _requests.delete(f"{url}/tree/{key}", timeout=60)
+    rt = sess.delete(f"{url}/tree/{key}", timeout=timeout)
     existed = existed or (rt.status_code == 200 and rt.json().get("existed"))
     return existed

@@ -7,9 +7,16 @@ single aiohttp service:
 - ``/blob/{hash}``                 GET/PUT content-addressed blobs (CAS)
 - ``/tree/{key}/diff|commit|manifest``  delta-sync protocol (see sync.py)
 - ``/kv/{key}``                    GET/PUT/DELETE raw values (tensor leaves)
+- ``/kv/diff``                     content-hash delta for KV keys: which of
+                                   ``{keys: {key: blake2b}}`` are already
+                                   current (see commands._kv_diff)
 - ``/keys?prefix=``                listing for `kt ls`
 - ``/register``                    peer registry (MDS role): which pod holds
                                    which locale="local" key, for P2P gets
+
+Uploads stream: blob/KV PUT bodies are chunked straight to the ``.tmp``
+file with an incremental blake2b, so server memory stays ``O(chunk)``
+however large the checkpoint.
 
 Run: ``python -m kubetorch_tpu.data_store.store_server --port 8873 --root DIR``
 """
@@ -21,13 +28,15 @@ import hashlib
 import json
 import os
 import time
+import uuid
 from pathlib import Path
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 from urllib.parse import unquote
 
 from aiohttp import web
 
 MAX_BODY = 10 * 1024 ** 3
+UPLOAD_CHUNK = 1 << 20          # streaming read granularity for PUT bodies
 
 
 class StoreState:
@@ -59,20 +68,40 @@ def _state(request: web.Request) -> StoreState:
 # -- blobs -------------------------------------------------------------------
 
 
+async def _stream_to_tmp(request: web.Request, path: Path) -> Tuple[Path, str, int]:
+    """Stream the request body to a uniquely-named ``.tmp`` sibling of
+    ``path`` in ``UPLOAD_CHUNK`` pieces, hashing as it lands. Memory stays
+    O(chunk) regardless of body size (``await request.read()`` would buffer
+    a whole multi-GB checkpoint in server RAM). The unique tmp name keeps
+    concurrent PUTs of the same key from interleaving writes; ``os.replace``
+    stays last-wins-atomic. Returns ``(tmp, blake2b_hex, size)``."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f"{path.name}.{uuid.uuid4().hex[:8]}.tmp")
+    hasher = hashlib.blake2b(digest_size=20)
+    size = 0
+    try:
+        with tmp.open("wb") as f:
+            async for chunk in request.content.iter_chunked(UPLOAD_CHUNK):
+                f.write(chunk)
+                hasher.update(chunk)
+                size += len(chunk)
+    except Exception:
+        tmp.unlink(missing_ok=True)
+        raise
+    return tmp, hasher.hexdigest(), size
+
+
 async def put_blob(request: web.Request) -> web.Response:
     st = _state(request)
     h = request.match_info["hash"]
-    data = await request.read()
-    actual = hashlib.blake2b(data, digest_size=20).hexdigest()
+    path = st.blob_path(h)
+    tmp, actual, size = await _stream_to_tmp(request, path)
     if actual != h:
+        tmp.unlink(missing_ok=True)
         return web.json_response({"error": f"hash mismatch: {actual}"},
                                  status=400)
-    path = st.blob_path(h)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_suffix(".tmp")
-    tmp.write_bytes(data)
     os.replace(tmp, path)
-    return web.json_response({"ok": True, "size": len(data)})
+    return web.json_response({"ok": True, "size": size})
 
 
 async def get_blob(request: web.Request) -> web.Response:
@@ -135,15 +164,53 @@ async def tree_delete(request: web.Request) -> web.Response:
 async def kv_put(request: web.Request) -> web.Response:
     st = _state(request)
     path = st.kv_path(unquote(request.match_info["key"]))
-    data = await request.read()
-    tmp = path.with_name(path.name + ".tmp")
-    tmp.write_bytes(data)
-    os.replace(tmp, path)
     meta = {}
     if "X-KT-Meta" in request.headers:
-        meta = json.loads(request.headers["X-KT-Meta"])
-        path.with_name(path.name + ".meta").write_text(json.dumps(meta))
-    return web.json_response({"ok": True, "size": len(data)})
+        try:
+            meta = json.loads(request.headers["X-KT-Meta"])
+        except ValueError:
+            return web.json_response({"error": "bad X-KT-Meta"}, status=400)
+    tmp, actual, size = await _stream_to_tmp(request, path)
+    claimed = meta.get("blake2b")
+    if claimed is not None and claimed != actual:
+        # the client addressed content it didn't send — reject before the
+        # bad bytes become the delta-skip baseline for every later put
+        tmp.unlink(missing_ok=True)
+        return web.json_response(
+            {"error": f"content hash mismatch: body is {actual}"}, status=400)
+    meta["blake2b"] = actual
+    # data renames first: if we crash before the meta lands, /kv/diff sees
+    # a stale hash and reports the key missing — a wasted re-upload, never
+    # a false "current" verdict against bytes the store doesn't hold
+    os.replace(tmp, path)
+    meta_tmp = path.with_name(f"{path.name}.meta.{uuid.uuid4().hex[:8]}.tmp")
+    meta_tmp.write_text(json.dumps(meta))
+    os.replace(meta_tmp, path.with_name(path.name + ".meta"))
+    return web.json_response({"ok": True, "size": size})
+
+
+async def kv_diff(request: web.Request) -> web.Response:
+    """Delta probe for KV keys (mirrors ``/tree/diff``): body
+    ``{keys: {key: blake2b}}`` → ``{missing: [key, ...]}`` listing the keys
+    whose stored content does NOT match — those are the only ones the
+    client must upload. Unknown keys and keys stored before hashes were
+    recorded count as missing (re-upload is always safe)."""
+    st = _state(request)
+    body = await request.json()
+    keys: Dict[str, str] = body.get("keys", {})
+    missing = []
+    for key, want in keys.items():
+        path = st.kv_path(key)
+        meta_path = path.with_name(path.name + ".meta")
+        have = None
+        if path.is_file() and meta_path.is_file():
+            try:
+                have = json.loads(meta_path.read_text()).get("blake2b")
+            except (ValueError, OSError):
+                have = None
+        if have is None or have != want:
+            missing.append(key)
+    return web.json_response({"missing": sorted(missing)})
 
 
 async def kv_get(request: web.Request) -> web.Response:
@@ -357,6 +424,7 @@ def create_store_app(root: str) -> web.Application:
     r.add_post("/tree/{key:.+}/commit", tree_commit)
     r.add_get("/tree/{key:.+}/manifest", tree_manifest)
     r.add_delete("/tree/{key:.+}", tree_delete)
+    r.add_post("/kv/diff", kv_diff)
     r.add_put("/kv/{key:.+}", kv_put)
     r.add_get("/kv/{key:.+}", kv_get)
     r.add_delete("/kv/{key:.+}", kv_delete)

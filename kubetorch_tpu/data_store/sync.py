@@ -13,6 +13,11 @@ Pull mirrors it: fetch manifest, hash local files, GET only changed blobs.
 A ``.ktsync-manifest.json`` at the dest records the last-synced state so
 pulls can delete files that were removed upstream without touching
 user-created files.
+
+Missing/changed blobs move **concurrently** over the shared netpool
+executor (``KT_STORE_CONCURRENCY``, default 8), each worker on its own
+pooled session; downloads stream to the ``.ktsync-tmp`` file so client
+memory stays O(chunk) per worker.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from typing import Dict, List, Optional, Set
 import requests as _requests
 
 from ..exceptions import SyncError
+from . import netpool
 
 EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
                 "node_modules", ".venv", "venv", ".ktsync"}
@@ -123,31 +129,35 @@ def _save_hash_cache(root: str, cache: Dict[str, Dict]) -> None:
 def push_tree(store_url: str, key: str, root: str,
               session: Optional[_requests.Session] = None) -> Dict:
     """Delta-push ``root`` to the store under ``key``; returns stats."""
-    sess = session or _requests.Session()
+    sess = session or netpool.session()
     base = store_url.rstrip("/")
     manifest = build_manifest(root)
     try:
         r = sess.post(f"{base}/tree/{key}/diff", json={"files": manifest},
-                      timeout=60)
+                      timeout=netpool.store_timeout(60))
         r.raise_for_status()
         missing: List[str] = r.json()["missing"]
 
         by_hash = {}
         for rel, info in manifest.items():
             by_hash.setdefault(info["hash"], rel)
-        uploaded_bytes = 0
         for h in missing:
-            rel = by_hash.get(h)
-            if rel is None:
+            if h not in by_hash:
                 raise SyncError(f"Server requested unknown blob {h}")
-            with open(os.path.join(root, rel), "rb") as f:
+
+        def _upload(h: str) -> int:
+            # per-thread session: blob uploads fan out across workers
+            with open(os.path.join(root, by_hash[h]), "rb") as f:
                 data = f.read()
-            ru = sess.put(f"{base}/blob/{h}", data=data, timeout=600)
+            ru = netpool.session().put(f"{base}/blob/{h}", data=data,
+                                       timeout=netpool.store_timeout())
             ru.raise_for_status()
-            uploaded_bytes += len(data)
+            return len(data)
+
+        uploaded_bytes = sum(netpool.map_concurrent(_upload, missing))
 
         rc = sess.post(f"{base}/tree/{key}/commit", json={"files": manifest},
-                       timeout=60)
+                       timeout=netpool.store_timeout(60))
         rc.raise_for_status()
         return {"files": len(manifest), "uploaded": len(missing),
                 "uploaded_bytes": uploaded_bytes}
@@ -159,10 +169,11 @@ def pull_tree(store_url: str, key: str, dest: str,
               delete: bool = True,
               session: Optional[_requests.Session] = None) -> Dict:
     """Delta-pull ``key`` into ``dest``; only changed blobs are fetched."""
-    sess = session or _requests.Session()
+    sess = session or netpool.session()
     base = store_url.rstrip("/")
     try:
-        r = sess.get(f"{base}/tree/{key}/manifest", timeout=60)
+        r = sess.get(f"{base}/tree/{key}/manifest",
+                     timeout=netpool.store_timeout(60))
         if r.status_code == 404:
             raise SyncError(f"No tree {key!r} in store")
         r.raise_for_status()
@@ -170,7 +181,7 @@ def pull_tree(store_url: str, key: str, dest: str,
 
         os.makedirs(dest, exist_ok=True)
         prev = _load_prev_manifest(dest)
-        fetched = 0
+        to_fetch = []
         for rel, info in remote.items():
             target = os.path.join(dest, rel)
             if os.path.isfile(target):
@@ -180,15 +191,25 @@ def pull_tree(store_url: str, key: str, dest: str,
                     continue
                 if file_hash(target) == info["hash"]:
                     continue
-            rb = sess.get(f"{base}/blob/{info['hash']}", timeout=600)
+            to_fetch.append((rel, info))
+
+        def _download(item) -> None:
+            rel, info = item
+            target = os.path.join(dest, rel)
+            rb = netpool.session().get(f"{base}/blob/{info['hash']}",
+                                       timeout=netpool.store_timeout(),
+                                       stream=True)
             rb.raise_for_status()
             os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
             tmp = target + ".ktsync-tmp"
             with open(tmp, "wb") as f:
-                f.write(rb.content)
+                for chunk in rb.iter_content(1 << 20):
+                    f.write(chunk)
             os.chmod(tmp, info.get("mode", 0o644))
             os.replace(tmp, target)
-            fetched += 1
+
+        netpool.map_concurrent(_download, to_fetch)
+        fetched = len(to_fetch)
 
         deleted = 0
         if delete:

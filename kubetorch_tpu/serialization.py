@@ -60,18 +60,36 @@ def _encode_array(obj: Any) -> dict:
     }
 
 
-def _decode_array(spec: dict) -> Any:
+def _np_dtype(dtype: str):
     import numpy as np
 
-    raw = base64.b64decode(spec["data"])
     # bfloat16 has no numpy builtin; ml_dtypes ships with jax.
-    dtype = spec["dtype"]
     if dtype == "bfloat16":
         import ml_dtypes
-        np_dtype = ml_dtypes.bfloat16
-    else:
-        np_dtype = np.dtype(dtype)
-    return np.frombuffer(raw, dtype=np_dtype).reshape(spec["shape"]).copy()
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
+
+
+def _fill_array(raw: bytes, dtype: str, shape: list) -> Any:
+    """Decode raw bytes into a freshly allocated writable array.
+    ``frombuffer(...).copy()`` would hold the read-only view's copy AND the
+    source alive together — 2× peak per array; filling a preallocated
+    buffer keeps one allocation."""
+    import numpy as np
+
+    arr = np.empty(shape, dtype=_np_dtype(dtype))
+    view = arr.reshape(-1).view(np.uint8)
+    if view.nbytes != len(raw):
+        raise SerializationError(
+            f"array byte-size mismatch: {len(raw)}B payload for "
+            f"{dtype}{list(shape)}")
+    view[:] = np.frombuffer(raw, dtype=np.uint8)
+    return arr
+
+
+def _decode_array(spec: dict) -> Any:
+    return _fill_array(base64.b64decode(spec["data"]), spec["dtype"],
+                       spec["shape"])
 
 
 def _jsonify(obj: Any) -> Any:
@@ -176,15 +194,26 @@ def _msgpack_default(obj: Any) -> Any:
     raise SerializationError(f"msgpack cannot encode {type(obj).__name__}")
 
 
+def _msgpack_escape_key(k: Any) -> Any:
+    """'~'-stack keys that would trip the '__arr__' decode hook — the exact
+    mirror of the JSON pair (:func:`_escape_key`): escape pushes one ``~``,
+    unescape pops one, so any user key ``~*__arr__`` round-trips."""
+    if isinstance(k, str) and k.lstrip("~") == "__arr__":
+        return "~" + k
+    return k
+
+
+def _msgpack_unescape_key(k: Any) -> Any:
+    if isinstance(k, str) and k.startswith("~") and k.lstrip("~") == "__arr__":
+        return k[1:]
+    return k
+
+
 def _msgpack_escape(obj: Any) -> Any:
     """Escape user dicts whose '__arr__' key would trip the decode hook."""
     if isinstance(obj, dict):
-        out = {}
-        for k, v in obj.items():
-            if isinstance(k, str) and k.lstrip("~") == "__arr__":
-                k = "~" + k
-            out[k] = _msgpack_escape(v)
-        return out
+        return {_msgpack_escape_key(k): _msgpack_escape(v)
+                for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_msgpack_escape(v) for v in obj]
     return obj
@@ -192,14 +221,8 @@ def _msgpack_escape(obj: Any) -> Any:
 
 def _msgpack_hook(obj: dict) -> Any:
     if obj.get("__arr__"):
-        import numpy as np
-        dtype = obj["d"]
-        if dtype == "bfloat16":
-            import ml_dtypes
-            dtype = ml_dtypes.bfloat16
-        return np.frombuffer(obj["b"], dtype=dtype).reshape(obj["s"]).copy()
-    return {(k[1:] if isinstance(k, str) and k.startswith("~") and
-             k.lstrip("~") == "__arr__" else k): v for k, v in obj.items()}
+        return _fill_array(obj["b"], obj["d"], obj["s"])
+    return {_msgpack_unescape_key(k): v for k, v in obj.items()}
 
 
 def _msgpack_dumps(obj: Any) -> bytes:

@@ -292,6 +292,143 @@ def test_manifest_hash_cache(project, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Parallel data plane: content-addressed delta sync + concurrent put/get
+# (ISSUE 1: /kv/diff protocol, KT_STORE_CONCURRENCY fan-out)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_delta_sync_skips_unchanged_leaves(store):
+    """Repeated identical put moves zero leaf bytes (/kv/diff says all
+    current); mutating one leaf re-uploads exactly that leaf."""
+    import numpy as np
+    from kubetorch_tpu.data_store import commands as ds
+
+    tree = {"emb": np.arange(64, dtype=np.float32),
+            "lora": {"a": np.ones((8, 2), np.float32),
+                     "b": np.zeros((2, 8), np.float32)}}
+    cold = ds.put("delta/w", tree, store_url=store)
+    assert cold["skipped"] == 0 and cold["leaves"] == 3
+    assert cold["bytes"] == 64 * 4 + 16 * 4 + 16 * 4
+
+    warm = ds.put("delta/w", tree, store_url=store)
+    assert warm["skipped"] == warm["leaves"] == 3
+    assert warm["bytes"] == 0
+
+    # LoRA-style update: one leaf changes, only it moves
+    tree["lora"]["a"] = tree["lora"]["a"] * 2
+    partial = ds.put("delta/w", tree, store_url=store)
+    assert partial["skipped"] == 2
+    assert partial["bytes"] == 16 * 4
+    out = ds.get("delta/w", store_url=store)
+    np.testing.assert_array_equal(out["lora"]["a"], tree["lora"]["a"])
+    np.testing.assert_array_equal(out["emb"], tree["emb"])
+    ds.rm("delta/w", store_url=store)
+
+
+@pytest.mark.slow
+def test_kv_diff_endpoint_wire_shape(store):
+    """POST /kv/diff mirrors /tree/diff: {keys: {key: hash}} → {missing}.
+    Unknown keys, stale hashes, and pre-hash keys all count as missing."""
+    import hashlib
+    import requests
+
+    body = b"\x01\x02\x03"
+    h = hashlib.blake2b(body, digest_size=20).hexdigest()
+    r = requests.put(f"{store}/kv/diffkeys/a", data=body, timeout=30)
+    assert r.status_code == 200
+    r = requests.post(f"{store}/kv/diff", json={"keys": {
+        "diffkeys/a": h,                  # current
+        "diffkeys/a2": h,                 # unknown key
+    }}, timeout=30)
+    assert r.status_code == 200
+    assert r.json()["missing"] == ["diffkeys/a2"]
+    r = requests.post(f"{store}/kv/diff", json={"keys": {
+        "diffkeys/a": "f" * 40}}, timeout=30)   # stale hash
+    assert r.json()["missing"] == ["diffkeys/a"]
+    requests.delete(f"{store}/kv/diffkeys/a", timeout=30)
+
+
+@pytest.mark.slow
+def test_kv_put_rejects_hash_mismatch(store):
+    """A PUT whose X-KT-Meta blake2b doesn't match the body is rejected
+    before the bad bytes become the delta baseline."""
+    import json as _json
+    import requests
+
+    r = requests.put(f"{store}/kv/bad/leaf", data=b"\x00" * 16,
+                     headers={"X-KT-Meta": _json.dumps(
+                         {"blake2b": "0" * 40})}, timeout=30)
+    assert r.status_code == 400
+    assert requests.get(f"{store}/kv/bad/leaf", timeout=30).status_code == 404
+
+
+@pytest.mark.slow
+def test_streamed_blob_put_chunked(store):
+    """put_blob streams request bodies (no full-body buffering): a chunked
+    upload with no Content-Length lands bit-exact and hash-verified."""
+    import hashlib
+    import requests
+
+    blob = bytes(range(256)) * (1 << 12)          # 1 MiB, compressible
+    h = hashlib.blake2b(blob, digest_size=20).hexdigest()
+
+    def gen(chunk=1 << 14):
+        for i in range(0, len(blob), chunk):
+            yield blob[i:i + chunk]
+
+    r = requests.put(f"{store}/blob/{h}", data=gen(), timeout=60)
+    assert r.status_code == 200 and r.json()["size"] == len(blob)
+    assert requests.get(f"{store}/blob/{h}", timeout=60).content == blob
+    # wrong-hash upload is rejected and leaves nothing behind
+    bad = "ab" * 20
+    r = requests.put(f"{store}/blob/{bad}", data=gen(), timeout=60)
+    assert r.status_code == 400
+    assert requests.get(f"{store}/blob/{bad}", timeout=60).status_code == 404
+
+
+@pytest.mark.slow
+def test_concurrent_put_get_stress(store, monkeypatch):
+    """N client threads × M leaves hammer the store concurrently (each put
+    itself fans out over the netpool executor): every index stays
+    consistent with its leaves and no tree loses data."""
+    import threading
+
+    import numpy as np
+    from kubetorch_tpu.data_store import commands as ds
+
+    monkeypatch.setenv("KT_STORE_CONCURRENCY", "4")
+    n_threads, n_leaves = 4, 12
+    errors = []
+
+    def worker(t):
+        try:
+            rng = np.random.default_rng(t)
+            tree = {"layer": {f"w{i}": rng.standard_normal(64).astype(
+                np.float32) for i in range(n_leaves)}}
+            stats = ds.put(f"stress/t{t}", tree, store_url=store)
+            assert stats["leaves"] == n_leaves, stats
+            out = ds.get(f"stress/t{t}", store_url=store)
+            assert sorted(out["layer"]) == sorted(tree["layer"])
+            for name, arr in tree["layer"].items():
+                np.testing.assert_array_equal(out["layer"][name], arr)
+        except Exception as e:               # surface across the join
+            errors.append((t, e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert not errors, errors
+    keys = [k["key"] for k in ds.ls("stress/", store_url=store)]
+    assert len(keys) == n_threads * n_leaves   # no lost leaves
+    for t in range(n_threads):
+        ds.rm(f"stress/t{t}", store_url=store)
+
+
+# ---------------------------------------------------------------------------
 # P2P fan-out (the reference's rolling-participation tree broadcast,
 # data_store_client.py:376-688 / design.md)
 # ---------------------------------------------------------------------------
@@ -340,6 +477,61 @@ def test_route_eager_tree_assignment(store):
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.4:1"}, timeout=10).json()
     assert r.get("url") != "http://10.0.0.2:1"
+
+
+@pytest.mark.slow
+def test_route_complete_fires_once_under_parallel_fetch(store, tmp_path,
+                                                        monkeypatch):
+    """However many executor workers a pytree get fans out over, the fetcher
+    reports /route/complete exactly once — N reports would inflate this
+    pod's routing weight for later joiners."""
+    import threading
+
+    import numpy as np
+
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.data_store import netpool
+
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    monkeypatch.setenv("KT_SERVER_PORT", str(free_port()))
+    monkeypatch.setenv("KT_DATA_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("KT_STORE_CONCURRENCY", "8")
+
+    tree = {f"w{i}": np.full((32,), i, np.float32) for i in range(16)}
+    ds.put("complete/once", tree, store_url=store)
+
+    complete_posts = []
+
+    class _CountingSession:
+        def __init__(self, real):
+            self._real = real
+
+        def post(self, url, *a, **kw):
+            if url.endswith("/route/complete"):
+                complete_posts.append(url)
+            return self._real.post(url, *a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+    monkeypatch.setattr(ds._RoutedFetcher, "_sess",
+                        lambda self: _CountingSession(netpool.session()))
+
+    out = ds.get("complete/once", store_url=store, peer=True)
+    np.testing.assert_array_equal(out["w3"], tree["w3"])
+    assert len(complete_posts) == 1
+
+    # direct hammer: 8 threads racing complete() on one fetcher → one POST
+    complete_posts.clear()
+    fetcher = ds._RoutedFetcher(store, "complete/once", peer=True)
+    fetcher._fetched = True
+    threads = [threading.Thread(target=fetcher.complete) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(complete_posts) == 1
+    ds.rm("complete/once", store_url=store)
 
 
 def _spawn_cache_server(cache_dir, port):

@@ -59,3 +59,46 @@ def test_none_passthrough():
 def test_unserializable_raises():
     with pytest.raises(SerializationError):
         ser.serialize({"f": lambda: 1}, ser.JSON)
+
+
+@pytest.mark.parametrize("key", ["__arr__", "~__arr__", "~~__arr__",
+                                 "~~~__arr__"])
+def test_msgpack_sentinel_key_roundtrip(key):
+    """User keys colliding with the '__arr__' typed-leaf sentinel round-trip
+    at any '~'-stacking depth — escape pushes exactly one level, the decode
+    hook pops exactly one (symmetric with the JSON _escape_key pair)."""
+    obj = {key: [1, 2], "nested": {key: {"deeper": {key: "x"}}}}
+    out = ser.deserialize(ser.serialize(obj, ser.MSGPACK), ser.MSGPACK)
+    assert out == obj
+
+
+def test_msgpack_sentinel_key_next_to_real_array():
+    """An escaped user key and an encoder-produced array coexist in one
+    dict: the array decodes, the user key unescapes."""
+    import numpy as np
+
+    arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+    obj = {"~__arr__": "mine", "w": arr}
+    out = ser.deserialize(ser.serialize(obj, ser.MSGPACK), ser.MSGPACK)
+    assert out["~__arr__"] == "mine"
+    np.testing.assert_array_equal(out["w"], arr)
+
+
+@pytest.mark.parametrize("key", ["__kt_array__", "~__kt_array__",
+                                 "~~__kt_array__"])
+def test_json_sentinel_key_roundtrip(key):
+    obj = {key: 1, "nested": {key: [True]}}
+    out = ser.deserialize(ser.serialize(obj, ser.JSON), ser.JSON)
+    assert out == obj
+
+
+def test_decoded_arrays_are_writable():
+    """Preallocated-buffer decode must hand back writable arrays (the old
+    frombuffer view would be read-only without the extra copy)."""
+    import numpy as np
+
+    obj = {"w": np.zeros(4, np.float32)}
+    for fmt in (ser.JSON, ser.MSGPACK):
+        out = ser.deserialize(ser.serialize(obj, fmt), fmt)
+        out["w"][0] = 7.0
+        assert out["w"][0] == 7.0

@@ -155,15 +155,27 @@ def put(key: str, src: Any, store_url: Optional[str] = None,
         "path, an array, or a pytree of arrays")
 
 
+def _leaf_buffer(host):
+    """Zero-copy bytes-like view of a leaf's raw bytes. Reinterprets the
+    buffer as uint8 first: numpy refuses to export buffers for extension
+    dtypes (ml_dtypes bfloat16 raises ``ValueError: cannot include dtype
+    in a buffer``), but a uint8 view of the same memory always exports.
+    Falls back to a tobytes copy for non-contiguous or otherwise
+    unviewable arrays."""
+    import numpy as np
+
+    if host.flags["C_CONTIGUOUS"]:
+        try:
+            return host.reshape(-1).view(np.uint8).data
+        except (ValueError, TypeError):
+            pass
+    return host.tobytes()
+
+
 def _leaf_hash(host) -> str:
     """blake2b-20 of the leaf's raw bytes — the content address the delta
-    protocol diffs on. Hashes the array's buffer in place (no tobytes copy
-    for the contiguous common case)."""
-    if host.flags["C_CONTIGUOUS"]:
-        buf = host.data
-    else:
-        buf = host.tobytes()
-    return hashlib.blake2b(buf, digest_size=20).hexdigest()
+    protocol diffs on."""
+    return hashlib.blake2b(_leaf_buffer(host), digest_size=20).hexdigest()
 
 
 def _put_pytree(url: str, key: str, tree: Any) -> Dict:
@@ -173,14 +185,19 @@ def _put_pytree(url: str, key: str, tree: Any) -> Dict:
     _flatten(tree, "", leaves)
     index: Dict[str, Any] = {"leaves": {}, "structure": _structure_of(tree)}
 
-    # Stage device → host and content-hash every leaf first: the hashes
-    # drive one /kv/diff round-trip that decides which leaves move at all.
-    hosts: Dict[str, Any] = {}
-    for path, arr in leaves.items():
+    def _stage(arr):
         host = np.asarray(arr)
         if not host.flags["C_CONTIGUOUS"]:
             host = np.ascontiguousarray(host)
-        hosts[path] = host
+        return host
+
+    # Content-hash every leaf first: the hashes drive one /kv/diff
+    # round-trip that decides which leaves move at all. Host stagings are
+    # NOT retained across the pass — leaves that do need uploading are
+    # re-staged inside their worker, so peak client RAM stays
+    # O(workers × largest leaf) instead of the full checkpoint size.
+    for path, arr in leaves.items():
+        host = _stage(arr)
         index["leaves"][path] = {"dtype": str(host.dtype),
                                  "shape": list(host.shape),
                                  "kind": "array",
@@ -188,13 +205,15 @@ def _put_pytree(url: str, key: str, tree: Any) -> Dict:
 
     current = _kv_diff(
         url, {f"{key}/{p}": m["blake2b"] for p, m in index["leaves"].items()})
-    to_upload = [p for p in hosts if f"{key}/{p}" not in current]
+    to_upload = [p for p in leaves if f"{key}/{p}" not in current]
 
     def _upload(path: str) -> int:
-        host = hosts[path]
-        data = host.tobytes()
-        _kv_put(url, f"{key}/{path}", data, index["leaves"][path])
-        return len(data)
+        host = _stage(leaves[path])
+        # zero-copy uint8 view: the body streams from the array's own
+        # buffer, no tobytes duplicate per in-flight worker
+        _kv_put(url, f"{key}/{path}", _leaf_buffer(host),
+                index["leaves"][path])
+        return host.nbytes
 
     total = sum(netpool.map_concurrent(_upload, to_upload))
     # index lands last: a reader that sees the new index sees complete leaves
@@ -246,8 +265,10 @@ def _structure_of(tree: Any) -> Any:
     raise DataStoreError(f"Unsupported node {type(tree).__name__}")
 
 
-def _kv_put(url: str, key: str, data: bytes, meta: Dict,
+def _kv_put(url: str, key: str, data, meta: Dict,
             sess: Optional[_requests.Session] = None) -> Dict:
+    # data: bytes or a memoryview (requests streams either with a correct
+    # Content-Length via super_len)
     sess = sess or netpool.session()
     r = sess.put(f"{url}/kv/{key}", data=data,
                  headers={"X-KT-Meta": json.dumps(meta)},

@@ -179,9 +179,14 @@ async def kv_put(request: web.Request) -> web.Response:
         return web.json_response(
             {"error": f"content hash mismatch: body is {actual}"}, status=400)
     meta["blake2b"] = actual
-    # data renames first: if we crash before the meta lands, /kv/diff sees
-    # a stale hash and reports the key missing — a wasted re-upload, never
-    # a false "current" verdict against bytes the store doesn't hold
+    meta["size"] = size
+    # data renames first: if we crash before the meta lands, the stale
+    # meta makes /kv/diff report the key missing (hash or size mismatch)
+    # — a wasted re-upload, not a lost update. The rename pair itself is
+    # atomic w.r.t. other requests only within this event loop (no await
+    # between them); concurrent conflicting puts to one key are last-wins
+    # racy regardless, and kv_diff's size check narrows the stale-meta
+    # window it could otherwise misjudge.
     os.replace(tmp, path)
     meta_tmp = path.with_name(f"{path.name}.meta.{uuid.uuid4().hex[:8]}.tmp")
     meta_tmp.write_text(json.dumps(meta))
@@ -202,13 +207,23 @@ async def kv_diff(request: web.Request) -> web.Response:
     for key, want in keys.items():
         path = st.kv_path(key)
         meta_path = path.with_name(path.name + ".meta")
-        have = None
+        have, meta_size = None, None
         if path.is_file() and meta_path.is_file():
             try:
-                have = json.loads(meta_path.read_text()).get("blake2b")
+                stored = json.loads(meta_path.read_text())
+                have, meta_size = stored.get("blake2b"), stored.get("size")
             except (ValueError, OSError):
                 have = None
         if have is None or have != want:
+            missing.append(key)
+            continue
+        # the meta hash only vouches for the data file it was written
+        # alongside; if the data's size no longer matches (meta from an
+        # older put, or a concurrent put mid-rename), don't claim current
+        try:
+            if meta_size is None or os.path.getsize(path) != meta_size:
+                missing.append(key)
+        except OSError:
             missing.append(key)
     return web.json_response({"missing": sorted(missing)})
 

@@ -16,8 +16,8 @@ user-created files.
 
 Missing/changed blobs move **concurrently** over the shared netpool
 executor (``KT_STORE_CONCURRENCY``, default 8), each worker on its own
-pooled session; downloads stream to the ``.ktsync-tmp`` file so client
-memory stays O(chunk) per worker.
+pooled session; uploads stream from the open file and downloads stream to
+the ``.ktsync-tmp`` file, so client memory stays O(chunk) per worker.
 """
 
 from __future__ import annotations
@@ -146,13 +146,16 @@ def push_tree(store_url: str, key: str, root: str,
                 raise SyncError(f"Server requested unknown blob {h}")
 
         def _upload(h: str) -> int:
-            # per-thread session: blob uploads fan out across workers
-            with open(os.path.join(root, by_hash[h]), "rb") as f:
-                data = f.read()
-            ru = netpool.session().put(f"{base}/blob/{h}", data=data,
-                                       timeout=netpool.store_timeout())
+            # per-thread session: blob uploads fan out across workers.
+            # The open file object streams, so an in-flight worker holds
+            # O(chunk) memory, not the whole blob — with the fan-out,
+            # whole-body reads would pin CONCURRENCY full files at once.
+            fpath = os.path.join(root, by_hash[h])
+            with open(fpath, "rb") as f:
+                ru = netpool.session().put(f"{base}/blob/{h}", data=f,
+                                           timeout=netpool.store_timeout())
             ru.raise_for_status()
-            return len(data)
+            return os.path.getsize(fpath)
 
         uploaded_bytes = sum(netpool.map_concurrent(_upload, missing))
 

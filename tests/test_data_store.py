@@ -142,6 +142,32 @@ def test_pytree_put_get_roundtrip(store):
 
 
 @pytest.mark.slow
+def test_pytree_put_get_bfloat16(store):
+    """bf16 is the standard dtype of the trainer→inference weight sync;
+    ml_dtypes arrays refuse numpy buffer export, so the content-hash path
+    must go through a uint8 view (regression: put() used to crash with
+    'cannot include dtype in a buffer')."""
+    import numpy as np
+    import ml_dtypes
+    from kubetorch_tpu.data_store import commands as ds
+
+    tree = {"w": np.arange(24, dtype=np.float32).reshape(4, 6)
+            .astype(ml_dtypes.bfloat16),
+            "scale": np.asarray(np.float32(0.5)).astype(ml_dtypes.bfloat16)}
+    stats = ds.put("ckpt/bf16", tree, store_url=store)
+    assert stats["leaves"] == 2 and stats["skipped"] == 0
+
+    out = ds.get("ckpt/bf16", store_url=store)
+    assert out["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    np.testing.assert_array_equal(out["scale"], tree["scale"])
+
+    again = ds.put("ckpt/bf16", tree, store_url=store)
+    assert again["skipped"] == 2 and again["bytes"] == 0
+    ds.rm("ckpt/bf16", store_url=store)
+
+
+@pytest.mark.slow
 def test_pytree_reshard_on_get(store, cpu_mesh_devices):
     """Save from host, load sharded onto a mesh — per-leaf resharding."""
     import numpy as np

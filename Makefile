@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast bench bench-store smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos lint bench bench-store smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -17,6 +17,15 @@ test:
 # release matrix; run before every commit
 test-fast:
 	$(PY_CPU) python -m pytest tests/ -q -x --level minimal
+
+# fault-injection suite (ISSUE 2): deterministic KT_CHAOS schedules with a
+# fixed seed — kept out of the tier-1 default path (see docs/resilience.md)
+test-chaos:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m chaos
+
+# resilience lint: no raw requests.* call sites may bypass the retry layer
+lint:
+	$(PY_CPU) python scripts/check_resilience.py
 
 bench:
 	python bench.py

@@ -34,6 +34,8 @@ from .exceptions import (  # noqa: F401
     SerializationError,
     DataStoreError,
     DebuggerError,
+    DeadlineExceededError,
+    CircuitOpenError,
     PodTerminatedError,
     HbmOomError,
     WorkerMembershipChanged,
@@ -49,6 +51,9 @@ _LAZY = {
     "Volume": ".resources.volume",
     "Secret": ".resources.secret",
     "secret": ".resources.secret",
+    "RetryPolicy": ".resilience",
+    "CircuitBreaker": ".resilience",
+    "Deadline": ".resilience",
     "MetricsConfig": ".config",
     "LoggingConfig": ".config",
     "DebugConfig": ".config",

@@ -1,0 +1,244 @@
+"""Deterministic fault injection for the serving and data-plane servers.
+
+The proof layer for the resilience stack: a middleware installable into
+``serving/http_server.py`` and ``data_store/store_server.py`` (both do it
+automatically when ``KT_CHAOS`` is set — no monkeypatching) that injects
+faults from a declarative, seeded schedule, so tests can assert things like
+"2 injected resets → the call still succeeds, the handler executed exactly
+once, and the backoff sequence matches the policy".
+
+``KT_CHAOS`` grammar — comma-separated fault tokens::
+
+    token   := spec [@PATH_PREFIX] [%PROB] [*COUNT]
+    spec    := reset | truncate | pass
+             | delay:SECONDS
+             | STATUS | STATUS:RETRY_AFTER      (e.g. 503 or 503:0.2)
+             | oom | evict | preempt
+
+- Tokens **without** ``%PROB`` form the deterministic schedule: each
+  matching request consumes the first unconsumed token whose path filter
+  matches, in order. After the schedule is exhausted, requests pass through.
+- Tokens **with** ``%PROB`` are persistent: once the schedule is exhausted,
+  every matching request triggers the fault with probability PROB, drawn
+  from an RNG seeded by ``KT_CHAOS_SEED`` (default 0) — reproducible soak.
+- ``@PATH_PREFIX`` limits a token to request paths with that prefix. With
+  no filter, probe routes (``/health``, ``/ready``, ``/metrics``) are
+  exempt so injected faults hit calls, not liveness plumbing.
+- ``*COUNT`` repeats the token COUNT times.
+
+Fault kinds:
+
+- ``delay:S``   sleep S seconds, then handle normally (latency injection)
+- ``STATUS``    short-circuit with that HTTP status; 5xx carry a packaged
+  ``ControllerRequestError`` body; ``STATUS:R`` adds ``Retry-After: R``
+- ``reset``     close the TCP connection without a response (client sees a
+  connection reset — the "established, may or may not have executed" case;
+  injected *before* dispatch, so the handler provably did not run)
+- ``truncate``  advertise a Content-Length, send fewer bytes, close
+- ``oom``       503 with a packaged ``HbmOomError`` (simulated HBM OOM)
+- ``evict`` / ``preempt``  503 with a packaged ``PodTerminatedError``
+  (reason Evicted / Preempted) — the pod-termination taxonomy, injectable
+- ``pass``      explicitly no fault (spaces out a schedule)
+
+Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
+connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .exceptions import (ControllerRequestError, HbmOomError,
+                         PodTerminatedError, package_exception)
+
+CHAOS_ENV = "KT_CHAOS"
+CHAOS_SEED_ENV = "KT_CHAOS_SEED"
+
+# With no @path filter, never chaos the liveness plumbing: readiness polls
+# retry forever and would silently eat the whole schedule.
+EXEMPT_PATHS = ("/health", "/ready", "/metrics")
+
+_KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
+          "pass")
+
+
+@dataclass
+class Fault:
+    kind: str
+    seconds: float = 0.0               # delay
+    status: int = 503                  # status faults
+    retry_after: Optional[float] = None
+    path: Optional[str] = None         # path-prefix filter
+    prob: Optional[float] = None       # None → deterministic schedule token
+
+    def matches(self, path: str) -> bool:
+        if self.path is not None:
+            return path.startswith(self.path)
+        return not path.startswith(EXEMPT_PATHS)
+
+
+class ChaosError(ValueError):
+    """Malformed ``KT_CHAOS`` spec — raised at parse time so a typo fails
+    the server start loudly instead of silently injecting nothing."""
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    faults: List[Fault] = []
+    for raw in spec.split(","):
+        token = raw.strip()
+        if not token:
+            continue
+        count = 1
+        if "*" in token:
+            token, _, n = token.rpartition("*")
+            try:
+                count = int(n)
+            except ValueError:
+                raise ChaosError(f"bad repeat count in {raw!r}")
+        prob = None
+        if "%" in token:
+            token, _, p = token.partition("%")
+            try:
+                prob = float(p)
+            except ValueError:
+                raise ChaosError(f"bad probability in {raw!r}")
+        path = None
+        if "@" in token:
+            token, _, path = token.partition("@")
+        fault = _parse_one(token.strip(), raw)
+        fault.path = path or None
+        fault.prob = prob
+        faults.extend([Fault(**fault.__dict__) for _ in range(count)])
+    return faults
+
+
+def _parse_one(token: str, raw: str) -> Fault:
+    head, _, arg = token.partition(":")
+    if head == "delay":
+        try:
+            return Fault(kind="delay", seconds=float(arg))
+        except ValueError:
+            raise ChaosError(f"bad delay in {raw!r}")
+    if head.isdigit():
+        fault = Fault(kind="status", status=int(head))
+        if arg:
+            try:
+                fault.retry_after = float(arg)
+            except ValueError:
+                raise ChaosError(f"bad Retry-After in {raw!r}")
+        return fault
+    if head in ("reset", "truncate", "oom", "evict", "preempt", "pass"):
+        return Fault(kind=head)
+    raise ChaosError(f"unknown chaos fault {raw!r} "
+                     f"(kinds: {', '.join(_KINDS)})")
+
+
+class ChaosEngine:
+    """Owns the schedule state: which deterministic tokens are consumed, the
+    seeded RNG for probabilistic tokens, and counters tests assert on.
+    Thread-safe (the serving and store apps run on one loop each, but tests
+    drive engines from multiple threads)."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0):
+        self.schedule = [f for f in faults if f.prob is None]
+        self.persistent = [f for f in faults if f.prob is not None]
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected = 0            # faults actually fired (pass excluded)
+        self.requests_seen = 0
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosEngine"]:
+        spec = os.environ.get(CHAOS_ENV)
+        if not spec:
+            return None
+        seed = 0
+        try:
+            seed = int(os.environ.get(CHAOS_SEED_ENV, "0"))
+        except ValueError:
+            pass
+        return cls(parse_spec(spec), seed=seed)
+
+    def next_fault(self, path: str) -> Optional[Fault]:
+        with self._lock:
+            self.requests_seen += 1
+            for i, fault in enumerate(self.schedule):
+                if fault.matches(path):
+                    del self.schedule[i]
+                    if fault.kind == "pass":
+                        return None
+                    self.injected += 1
+                    return fault
+            for fault in self.persistent:
+                if fault.matches(path) and \
+                        self._rng.random() < (fault.prob or 0.0):
+                    if fault.kind == "pass":
+                        return None
+                    self.injected += 1
+                    return fault
+        return None
+
+
+def chaos_middleware(engine: ChaosEngine):
+    """aiohttp middleware applying ``engine``'s schedule. Faults fire before
+    the route handler, so an injected fault proves the handler did NOT run
+    for that attempt."""
+    from aiohttp import web
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        fault = engine.next_fault(request.path)
+        if fault is None:
+            return await handler(request)
+        if fault.kind == "delay":
+            await asyncio.sleep(fault.seconds)
+            return await handler(request)
+        if fault.kind == "reset":
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError("chaos: injected connection reset")
+        if fault.kind == "truncate":
+            resp = web.StreamResponse()
+            resp.content_length = 1 << 20
+            await resp.prepare(request)
+            await resp.write(b"\0" * 128)
+            if request.transport is not None:
+                request.transport.close()
+            return resp
+        if fault.kind == "oom":
+            return web.json_response(
+                package_exception(HbmOomError(
+                    "chaos: injected HBM OOM (RESOURCE_EXHAUSTED)",
+                    requested_bytes=8 << 30, available_bytes=1 << 30)),
+                status=503)
+        if fault.kind in ("evict", "preempt"):
+            reason = "Evicted" if fault.kind == "evict" else "Preempted"
+            return web.json_response(
+                package_exception(PodTerminatedError(
+                    f"chaos: injected pod termination ({reason})",
+                    reason=reason)),
+                status=503)
+        # status fault
+        headers = {}
+        if fault.retry_after is not None:
+            headers["Retry-After"] = f"{fault.retry_after:g}"
+        body = package_exception(ControllerRequestError(
+            f"chaos: injected HTTP {fault.status}",
+            status_code=fault.status))
+        return web.json_response(body, status=fault.status, headers=headers)
+
+    return middleware
+
+
+def maybe_chaos_middleware():
+    """(middleware, engine) when ``KT_CHAOS`` is set, else (None, None) —
+    the hook servers call at app assembly."""
+    engine = ChaosEngine.from_env()
+    if engine is None:
+        return None, None
+    return chaos_middleware(engine), engine

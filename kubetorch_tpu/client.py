@@ -20,7 +20,11 @@ import requests as _requests
 
 from .config import config
 from .exceptions import ControllerRequestError
+from .resilience import (RETRYABLE_STATUSES, connection_never_established,
+                         controller_policy, retry_after_seconds)
 from .utils.procs import free_port, kill_process_tree, wait_for_port
+
+_IDEMPOTENT_VERBS = ("GET", "HEAD", "DELETE")
 
 
 class ControllerClient:
@@ -32,29 +36,51 @@ class ControllerClient:
 
     def _request(self, method: str, path: str, timeout: float = 120.0,
                  **kwargs) -> Any:
-        url = f"{self.base_url}{path}"
-        try:
-            resp = self._session.request(method, url, timeout=timeout, **kwargs)
-        except _requests.ConnectionError as e:
-            # A daemon this process discovered/spawned died (e.g. kill -9).
-            # Its durable state revives under a fresh daemon, so re-resolve
-            # once and retry — a long-lived client process must not be
-            # permanently wedged on a dead local controller. User-configured
-            # URLs are never silently redirected.
-            new_url = _recover_daemon(self.base_url)
-            if new_url is None:
-                raise ControllerRequestError(
-                    f"Controller unreachable at {url}: {e}")
-            self.base_url = new_url
+        """One controller call under the control-plane retry policy:
+        idempotent verbs retry transient failures (connection errors,
+        timeouts, 502/503/504 with Retry-After honored); POSTs retry only
+        when the connection was never established — the controller may have
+        acted on an established one. A dead *local daemon* is additionally
+        re-resolved once per call (its durable state revives under a fresh
+        daemon); user-configured URLs are never silently redirected."""
+        policy = controller_policy()
+        idempotent = method in _IDEMPOTENT_VERBS
+        recovered = [False]
+
+        def _attempt(info):
             url = f"{self.base_url}{path}"
+            t = timeout if info.timeout is None else min(timeout, info.timeout)
             try:
-                resp = self._session.request(method, url, timeout=timeout,
-                                             **kwargs)
-            except _requests.RequestException as e2:
-                raise ControllerRequestError(
-                    f"Controller unreachable at {url}: {e2}")
+                return self._session.request(method, url, timeout=t, **kwargs)
+            except _requests.ConnectionError as e:
+                if not recovered[0]:
+                    recovered[0] = True
+                    new_url = _recover_daemon(self.base_url)
+                    if new_url is not None:
+                        self.base_url = new_url
+                        return self._session.request(
+                            method, f"{self.base_url}{path}", timeout=t,
+                            **kwargs)
+                raise e
+
+        def _retryable(e: BaseException) -> bool:
+            if connection_never_established(e):
+                return True
+            return idempotent and isinstance(
+                e, (_requests.ConnectionError, _requests.Timeout))
+
+        def _resp_retry(resp):
+            if not idempotent or resp.status_code not in RETRYABLE_STATUSES:
+                return None
+            ra = retry_after_seconds(resp)
+            return ra if ra is not None else True
+
+        try:
+            resp = policy.run(_attempt, retryable_exc=_retryable,
+                              response_retry_delay=_resp_retry)
         except _requests.RequestException as e:
-            raise ControllerRequestError(f"Controller unreachable at {url}: {e}")
+            raise ControllerRequestError(
+                f"Controller unreachable at {self.base_url}{path}: {e}")
         if resp.status_code >= 400:
             raise ControllerRequestError(
                 f"{method} {path} → {resp.status_code}: {resp.text[:500]}",

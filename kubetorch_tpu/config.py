@@ -44,6 +44,12 @@ class KTConfig:
     controller_port: int = 8080
     mds_port: int = 8081
     data_store_url: Optional[str] = None
+    # resilience layer (see kubetorch_tpu/resilience.py): max attempts per
+    # call layer. File/env layering as usual — KT_HTTP_RETRIES etc. —
+    # and =1 restores single-shot behavior for that layer.
+    http_retries: int = 3                    # serving calls (HTTPClient)
+    store_retries: int = 3                   # data-plane store ops
+    controller_retries: int = 3              # control-plane requests
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

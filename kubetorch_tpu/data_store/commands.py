@@ -231,8 +231,8 @@ def _kv_diff(url: str, hashes: Dict[str, str]) -> set:
     if not hashes:
         return set()
     try:
-        r = netpool.session().post(f"{url}/kv/diff", json={"keys": hashes},
-                                   timeout=netpool.store_timeout(60))
+        r = netpool.request("POST", f"{url}/kv/diff", json={"keys": hashes},
+                            timeout=netpool.store_timeout(60))
         if r.status_code != 200:
             return set()
         return set(hashes) - set(r.json()["missing"])
@@ -268,11 +268,17 @@ def _structure_of(tree: Any) -> Any:
 def _kv_put(url: str, key: str, data, meta: Dict,
             sess: Optional[_requests.Session] = None) -> Dict:
     # data: bytes or a memoryview (requests streams either with a correct
-    # Content-Length via super_len)
-    sess = sess or netpool.session()
-    r = sess.put(f"{url}/kv/{key}", data=data,
-                 headers={"X-KT-Meta": json.dumps(meta)},
-                 timeout=netpool.store_timeout())
+    # Content-Length via super_len). Both are re-sendable buffers, so the
+    # resilient wrapper can retry a transient failure safely — the PUT is
+    # content-addressed (X-KT-Meta carries the blake2b) and idempotent.
+    if sess is not None:
+        r = sess.put(f"{url}/kv/{key}", data=data,
+                     headers={"X-KT-Meta": json.dumps(meta)},
+                     timeout=netpool.store_timeout())
+    else:
+        r = netpool.request("PUT", f"{url}/kv/{key}", data=data,
+                            headers={"X-KT-Meta": json.dumps(meta)},
+                            timeout=netpool.store_timeout())
     if r.status_code != 200:
         raise DataStoreError(f"put {key!r} failed: {r.status_code} {r.text[:200]}")
     return r.json()
@@ -326,13 +332,21 @@ class _RoutedFetcher:
     def _sess(self) -> _requests.Session:
         return self.sess if self.sess is not None else netpool.session()
 
+    def _store_request(self, method: str, url: str, timeout: float):
+        """Store-directed ops ride the resilient wrapper (retries, backoff,
+        Retry-After); an explicitly injected session (tests) stays
+        single-shot so stubs observe exactly one request."""
+        if self.sess is not None:
+            return self.sess.request(method, url, timeout=timeout)
+        return netpool.request(method, url, timeout=timeout)
+
     def head(self, subkey: str) -> bool:
         """Cheap existence probe against the STORE only (metadata-sized, like
         the reference's MDS lookup): decides the key's kind without pulling
         bulk bytes or touching peer wait windows."""
         try:
-            r = self._sess().head(f"{self.store_url}/kv/{subkey}",
-                                  timeout=netpool.store_timeout(30))
+            r = self._store_request("HEAD", f"{self.store_url}/kv/{subkey}",
+                                    timeout=netpool.store_timeout(30))
             return r.status_code == 200
         except _requests.RequestException:
             return False
@@ -438,7 +452,8 @@ class _RoutedFetcher:
                 self._evict_peer(peer)
                 break
             _time.sleep(0.25)
-        r = self._sess().get(f"{self.store_url}/kv/{subkey}", timeout=timeout)
+        r = self._store_request("GET", f"{self.store_url}/kv/{subkey}",
+                                timeout=timeout)
         if r.status_code == 200:
             self._cache(subkey, r)
         return r
@@ -580,8 +595,8 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         if r.status_code == 200:
             return _finish_raw(r, dest, sharding, fetcher)
 
-    r = netpool.session().get(f"{url}/tree/{key}/manifest",
-                              timeout=netpool.store_timeout(60))
+    r = netpool.request("GET", f"{url}/tree/{key}/manifest",
+                        timeout=netpool.store_timeout(60))
     if r.status_code == 200:
         if not dest:
             raise DataStoreError(f"get: {key!r} is a directory tree; pass dest=")
@@ -685,7 +700,10 @@ def join_broadcast(key: str, window: BroadcastWindow,
 
     url = _store_url(store_url)
     member = member or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
-    r = netpool.session().post(f"{url}/barrier", json={
+    # joining is idempotent (member names are unique per joiner and re-adds
+    # are set-inserts), so transport errors retry; a 408 quorum timeout is a
+    # real verdict and passes straight through
+    r = netpool.request("POST", f"{url}/barrier", json={
         "group": window.group_id or f"bcast/{key}",
         "world_size": window.world_size,
         "member": member,
@@ -716,8 +734,8 @@ def get_broadcast(key: str, window: BroadcastWindow,
 
 def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
     url = _store_url(store_url)
-    r = netpool.session().get(f"{url}/keys", params={"prefix": prefix},
-                              timeout=netpool.store_timeout(60))
+    r = netpool.request("GET", f"{url}/keys", params={"prefix": prefix},
+                        timeout=netpool.store_timeout(60))
     if r.status_code != 200:
         raise DataStoreError(f"ls failed: {r.status_code}")
     # hide internal index keys
@@ -727,19 +745,21 @@ def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
 def rm(key: str, store_url: Optional[str] = None) -> bool:
     url = _store_url(store_url)
     timeout = netpool.store_timeout(60)
-    sess = netpool.session()
     existed = False
-    r = sess.get(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=timeout)
+    r = netpool.request("GET", f"{url}/kv/{key}{_INDEX_SUFFIX}",
+                        timeout=timeout)
     if r.status_code == 200:
         index = json.loads(r.content)
         netpool.map_concurrent(
-            lambda path: netpool.session().delete(
-                f"{url}/kv/{key}/{path}", timeout=netpool.store_timeout(60)),
+            lambda path: netpool.request(
+                "DELETE", f"{url}/kv/{key}/{path}",
+                timeout=netpool.store_timeout(60)),
             index["leaves"])
-        sess.delete(f"{url}/kv/{key}{_INDEX_SUFFIX}", timeout=timeout)
+        netpool.request("DELETE", f"{url}/kv/{key}{_INDEX_SUFFIX}",
+                        timeout=timeout)
         existed = True
-    rd = sess.delete(f"{url}/kv/{key}", timeout=timeout)
+    rd = netpool.request("DELETE", f"{url}/kv/{key}", timeout=timeout)
     existed = existed or (rd.status_code == 200 and rd.json().get("existed"))
-    rt = sess.delete(f"{url}/tree/{key}", timeout=timeout)
+    rt = netpool.request("DELETE", f"{url}/tree/{key}", timeout=timeout)
     existed = existed or (rt.status_code == 200 and rt.json().get("existed"))
     return existed

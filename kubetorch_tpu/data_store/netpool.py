@@ -24,7 +24,7 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterable, List, TypeVar
+from typing import Callable, Iterable, List, Optional, TypeVar
 
 import requests as _requests
 from requests.adapters import HTTPAdapter
@@ -99,6 +99,89 @@ def _executor(size: int) -> ThreadPoolExecutor:
                                        thread_name_prefix="kt-store")
             _EXEC_SIZE = size
         return _EXEC
+
+
+# ---------------------------------------------------------------------------
+# Resilient request wrapper — the data-plane choke point every store op rides
+# ---------------------------------------------------------------------------
+
+# per-netloc circuit breakers (opt-in: KT_STORE_BREAKER_THRESHOLD > 0). Off
+# by default because a breaker converts "slow store" into fast CircuitOpen
+# failures — right for production weight-sync loops, wrong for ad-hoc CLIs.
+_BREAKERS: dict = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def _breaker_for(url: str):
+    from ..resilience import CircuitBreaker
+
+    threshold = 0
+    try:
+        threshold = int(os.environ.get("KT_STORE_BREAKER_THRESHOLD", "0"))
+    except ValueError:
+        pass
+    if threshold <= 0:
+        return None
+    from urllib.parse import urlsplit
+    netloc = urlsplit(url).netloc
+    with _BREAKERS_LOCK:
+        br = _BREAKERS.get(netloc)
+        if br is None or br.failure_threshold != threshold:
+            br = _BREAKERS[netloc] = CircuitBreaker(
+                failure_threshold=threshold,
+                cooldown_s=float(os.environ.get("KT_STORE_BREAKER_COOLDOWN_S",
+                                                "5")))
+        return br
+
+
+def reset_breakers() -> None:
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def request(method: str, url: str, *, timeout: Optional[float] = None,
+            policy=None, retry_statuses: Optional[frozenset] = None,
+            data_factory: Optional[Callable[[], object]] = None,
+            record: Optional[List[float]] = None, **kwargs):
+    """``session().request`` with the store retry policy applied.
+
+    Every store op is content-addressed (puts are keyed by hash, gets/
+    deletes are idempotent by nature), so transient failures — connection
+    errors, timeouts, truncated bodies, 502/503/504 — retry by default with
+    exponential backoff + full jitter, honoring ``Retry-After`` on 503s.
+    Non-retryable statuses (404, 400, 409...) return immediately; callers
+    keep their existing status handling.
+
+    ``data_factory`` re-creates a streaming body per attempt (an open file
+    object is consumed by the failed attempt and cannot be re-sent).
+    """
+    from ..resilience import (ESTABLISHED_TRANSIENT_EXCS, RETRYABLE_STATUSES,
+                              retry_after_seconds, store_policy)
+
+    policy = policy or store_policy()
+    statuses = RETRYABLE_STATUSES if retry_statuses is None else retry_statuses
+    breaker = _breaker_for(url)
+
+    def _attempt(info):
+        t = timeout if timeout is not None else store_timeout()
+        if info.timeout is not None:
+            t = min(t, info.timeout)
+        if data_factory is not None:
+            kwargs["data"] = data_factory()
+        return session().request(method, url, timeout=t, **kwargs)
+
+    def _resp_retry(resp):
+        if resp.status_code not in statuses:
+            return None
+        ra = retry_after_seconds(resp)
+        return ra if ra is not None else True
+
+    return policy.run(
+        _attempt,
+        retryable_exc=lambda e: isinstance(e, ESTABLISHED_TRANSIENT_EXCS),
+        response_retry_delay=_resp_retry,
+        breaker=breaker,
+        record=record)
 
 
 def map_concurrent(fn: Callable[[T], R], items: Iterable[T]) -> List[R]:

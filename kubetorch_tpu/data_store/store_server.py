@@ -429,7 +429,13 @@ async def health(request: web.Request) -> web.Response:
 
 
 def create_store_app(root: str) -> web.Application:
-    app = web.Application(client_max_size=MAX_BODY)
+    # fault injection (KT_CHAOS, see kubetorch_tpu.chaos): lets tests prove
+    # the data plane's retry/Retry-After behavior against a real store
+    from ..chaos import maybe_chaos_middleware
+    chaos_mw, chaos_engine = maybe_chaos_middleware()
+    app = web.Application(client_max_size=MAX_BODY,
+                          middlewares=[chaos_mw] if chaos_mw else [])
+    app["chaos"] = chaos_engine
     app["store"] = StoreState(root)
     r = app.router
     r.add_get("/health", health)

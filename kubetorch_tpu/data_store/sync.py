@@ -129,12 +129,20 @@ def _save_hash_cache(root: str, cache: Dict[str, Dict]) -> None:
 def push_tree(store_url: str, key: str, root: str,
               session: Optional[_requests.Session] = None) -> Dict:
     """Delta-push ``root`` to the store under ``key``; returns stats."""
-    sess = session or netpool.session()
     base = store_url.rstrip("/")
     manifest = build_manifest(root)
+
+    def _req(method, url, **kw):
+        # explicit session (tests) stays single-shot; default path rides the
+        # resilient store wrapper (tree ops are content-addressed/idempotent)
+        if session is not None:
+            return session.request(method, url,
+                                   timeout=netpool.store_timeout(60), **kw)
+        return netpool.request(method, url,
+                               timeout=netpool.store_timeout(60), **kw)
+
     try:
-        r = sess.post(f"{base}/tree/{key}/diff", json={"files": manifest},
-                      timeout=netpool.store_timeout(60))
+        r = _req("POST", f"{base}/tree/{key}/diff", json={"files": manifest})
         r.raise_for_status()
         missing: List[str] = r.json()["missing"]
 
@@ -146,21 +154,34 @@ def push_tree(store_url: str, key: str, root: str,
                 raise SyncError(f"Server requested unknown blob {h}")
 
         def _upload(h: str) -> int:
-            # per-thread session: blob uploads fan out across workers.
-            # The open file object streams, so an in-flight worker holds
-            # O(chunk) memory, not the whole blob — with the fan-out,
-            # whole-body reads would pin CONCURRENCY full files at once.
+            # blob uploads fan out across netpool workers; the open file
+            # object streams, so an in-flight worker holds O(chunk) memory,
+            # not the whole blob. A retried attempt reopens the file
+            # (data_factory) — a consumed stream cannot be re-sent.
             fpath = os.path.join(root, by_hash[h])
-            with open(fpath, "rb") as f:
-                ru = netpool.session().put(f"{base}/blob/{h}", data=f,
-                                           timeout=netpool.store_timeout())
+            stack: List = []
+
+            def _body():
+                while stack:
+                    stack.pop().close()
+                f = open(fpath, "rb")
+                stack.append(f)
+                return f
+
+            try:
+                ru = netpool.request("PUT", f"{base}/blob/{h}",
+                                     data_factory=_body,
+                                     timeout=netpool.store_timeout())
+            finally:
+                while stack:
+                    stack.pop().close()
             ru.raise_for_status()
             return os.path.getsize(fpath)
 
         uploaded_bytes = sum(netpool.map_concurrent(_upload, missing))
 
-        rc = sess.post(f"{base}/tree/{key}/commit", json={"files": manifest},
-                       timeout=netpool.store_timeout(60))
+        rc = _req("POST", f"{base}/tree/{key}/commit",
+                  json={"files": manifest})
         rc.raise_for_status()
         return {"files": len(manifest), "uploaded": len(missing),
                 "uploaded_bytes": uploaded_bytes}
@@ -172,11 +193,14 @@ def pull_tree(store_url: str, key: str, dest: str,
               delete: bool = True,
               session: Optional[_requests.Session] = None) -> Dict:
     """Delta-pull ``key`` into ``dest``; only changed blobs are fetched."""
-    sess = session or netpool.session()
     base = store_url.rstrip("/")
     try:
-        r = sess.get(f"{base}/tree/{key}/manifest",
-                     timeout=netpool.store_timeout(60))
+        if session is not None:
+            r = session.get(f"{base}/tree/{key}/manifest",
+                            timeout=netpool.store_timeout(60))
+        else:
+            r = netpool.request("GET", f"{base}/tree/{key}/manifest",
+                                timeout=netpool.store_timeout(60))
         if r.status_code == 404:
             raise SyncError(f"No tree {key!r} in store")
         r.raise_for_status()
@@ -199,9 +223,9 @@ def pull_tree(store_url: str, key: str, dest: str,
         def _download(item) -> None:
             rel, info = item
             target = os.path.join(dest, rel)
-            rb = netpool.session().get(f"{base}/blob/{info['hash']}",
-                                       timeout=netpool.store_timeout(),
-                                       stream=True)
+            rb = netpool.request("GET", f"{base}/blob/{info['hash']}",
+                                 timeout=netpool.store_timeout(),
+                                 stream=True)
             rb.raise_for_status()
             os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
             tmp = target + ".ktsync-tmp"

@@ -114,6 +114,33 @@ class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
 
+class DeadlineExceededError(KubetorchError):
+    """The request's propagated deadline (``X-KT-Deadline``) passed.
+
+    Raised client-side when the retry budget runs out against the deadline,
+    and server-side (rehydratable) when a request arrives past — or runs
+    past — its deadline: the server refuses to burn a TPU slot on a request
+    the client already abandoned. ``deadline`` is the absolute unix time
+    that was exceeded.
+    """
+
+    def __init__(self, message: str = "Request deadline exceeded",
+                 deadline: Optional[float] = None):
+        super().__init__(message)
+        self.deadline = deadline
+
+
+class CircuitOpenError(KubetorchError):
+    """A circuit breaker is open: the target has failed repeatedly and calls
+    are being rejected locally until the cool-down elapses. ``retry_after``
+    is the seconds remaining until the breaker half-opens."""
+
+    def __init__(self, message: str = "Circuit breaker is open",
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 # ---------------------------------------------------------------------------
 # Runtime faults (reference serving/utils.py:111-264)
 # ---------------------------------------------------------------------------
@@ -233,6 +260,8 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         SerializationError,
         DataStoreError,
         DebuggerError,
+        DeadlineExceededError,
+        CircuitOpenError,
         PodTerminatedError,
         HbmOomError,
         WorkerMembershipChanged,
@@ -245,6 +274,8 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
 _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "TpuSliceUnavailableError": ["accelerator", "topology"],
     "ControllerRequestError": ["status_code"],
+    "DeadlineExceededError": ["deadline"],
+    "CircuitOpenError": ["retry_after"],
     "PodTerminatedError": ["reason", "pod_name", "exit_code"],
     "HbmOomError": ["requested_bytes", "available_bytes"],
     "WorkerMembershipChanged": ["added", "removed", "previous", "current"],

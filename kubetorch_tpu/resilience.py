@@ -1,0 +1,508 @@
+"""Unified resilience layer: retry/backoff policies, circuit breaking, and
+deadline propagation.
+
+The paper's promise is that infrastructure faults surface as *typed,
+catchable, recoverable* exceptions — but a taxonomy is only recoverable if
+the call layers actually recover. This module is the one place retry
+semantics live for all three of them:
+
+- ``serving/http_client.py`` — user calls. Safe retries only: a connection
+  that was never established is always retryable; an established POST is
+  retried *only* when the caller passed an ``idempotency_key`` (the server
+  dedupes it, see :class:`IdempotencyCache`).
+- ``data_store/netpool.py`` — store ops. Content-addressed and therefore
+  idempotent: retried by default, honoring ``Retry-After`` on 503.
+- ``client.py`` (controller) — idempotent verbs retried; POSTs only when the
+  connection was never established.
+
+Deadline propagation rides the ``X-KT-Deadline`` header (absolute unix
+seconds): the server rejects requests whose deadline already passed *before*
+dispatch and cancels dispatch when it passes *during* — a request the client
+abandoned must not burn a TPU slot. The server-side checks live in
+``serving/http_server.py``; the header/clock helpers live here.
+
+Determinism: backoff jitter is drawn from a policy-owned ``random.Random``
+seeded via ``seed=`` (or ``KT_RETRY_SEED``), so a test — or the chaos
+harness in :mod:`kubetorch_tpu.chaos` — can assert the exact backoff
+sequence with :meth:`RetryPolicy.preview_delays`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import requests as _requests
+
+from .exceptions import CircuitOpenError, DeadlineExceededError
+
+# HTTP statuses that mean "the server (or something in front of it) is
+# transiently unhappy" — safe to retry when the request itself is idempotent.
+RETRYABLE_STATUSES = frozenset({502, 503, 504})
+
+# requests exceptions that can occur AFTER the connection was established
+# (the request may have executed server-side — only idempotent retries).
+ESTABLISHED_TRANSIENT_EXCS = (
+    _requests.exceptions.ConnectionError,
+    _requests.exceptions.Timeout,
+    _requests.exceptions.ChunkedEncodingError,   # truncated body mid-stream
+    _requests.exceptions.ContentDecodingError,
+)
+
+# Substrings that prove the TCP connection was never established, so the
+# request cannot have executed server-side and is ALWAYS safe to retry
+# (same markers the scaled-to-zero proxy fallback keys on).
+_NEVER_ESTABLISHED_MARKERS = (
+    "NewConnectionError",
+    "Connection refused",
+    "Name or service not known",
+    "No route to host",
+    "Temporary failure in name resolution",
+)
+
+
+def connection_never_established(exc: BaseException) -> bool:
+    """True when a ``requests`` connection error happened before any byte hit
+    the wire — the server cannot have executed the request."""
+    return isinstance(exc, _requests.exceptions.ConnectionError) and any(
+        marker in str(exc) for marker in _NEVER_ESTABLISHED_MARKERS)
+
+
+def retry_after_seconds(resp: Any) -> Optional[float]:
+    """Parse a ``Retry-After`` header (seconds form) off a response-like
+    object; None when absent/unparseable. HTTP-date form is not worth
+    supporting on an internal data plane."""
+    raw = getattr(resp, "headers", {}).get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation
+# ---------------------------------------------------------------------------
+
+DEADLINE_HEADER = "X-KT-Deadline"
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """An absolute wall-clock deadline (unix seconds) that crosses process
+    and host boundaries via :data:`DEADLINE_HEADER`. Wall clock, not
+    monotonic, because the pod enforcing it is a different machine than the
+    client that set it; NTP-level skew is noise next to the multi-second
+    budgets this guards."""
+
+    at: float
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(at=time.time() + seconds)
+
+    @classmethod
+    def from_header(cls, value: Optional[str]) -> Optional["Deadline"]:
+        if not value:
+            return None
+        try:
+            return cls(at=float(value))
+        except (TypeError, ValueError):
+            return None
+
+    def header_value(self) -> str:
+        return f"{self.at:.6f}"
+
+    def remaining(self) -> float:
+        return self.at - time.time()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def _env_seed() -> Optional[int]:
+    raw = os.environ.get("KT_RETRY_SEED")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+@dataclass
+class AttemptInfo:
+    """Passed to the attempt callable so it can bound its own I/O."""
+
+    index: int                      # 0-based attempt number
+    timeout: Optional[float]        # per-attempt timeout, deadline-clamped
+    deadline: Optional[Deadline]    # overall deadline, for header propagation
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter, per-attempt timeout, and an
+    overall deadline.
+
+    ``run`` drives an attempt callable; classification of *what* is
+    retryable belongs to the call site (each call layer has different
+    idempotency rules), so it arrives as predicates. Delay for attempt *i*
+    is ``uniform(0, min(max_delay, base_delay * multiplier**i))`` — AWS-style
+    full jitter, deterministic under ``seed``.
+    """
+
+    max_attempts: int = 3
+    base_delay: float = 0.2
+    max_delay: float = 10.0
+    multiplier: float = 2.0
+    attempt_timeout: Optional[float] = None   # per-attempt I/O timeout
+    deadline: Optional[float] = None          # overall budget, seconds
+    jitter: bool = True
+    seed: Optional[int] = field(default_factory=_env_seed)
+
+    def _delay(self, rng: random.Random, attempt: int) -> float:
+        cap = min(self.max_delay, self.base_delay * self.multiplier ** attempt)
+        return rng.uniform(0.0, cap) if self.jitter else cap
+
+    def preview_delays(self, n: int) -> List[float]:
+        """The first ``n`` backoff delays this policy will sleep, computed
+        from a fresh RNG — with ``seed`` set this is exactly the sequence a
+        ``run`` records, which is what the deterministic chaos tests
+        assert against."""
+        rng = random.Random(self.seed)
+        return [self._delay(rng, i) for i in range(n)]
+
+    def run(
+        self,
+        fn: Callable[[AttemptInfo], Any],
+        *,
+        retryable_exc: Callable[[BaseException], bool],
+        response_retry_delay: Optional[Callable[[Any], Any]] = None,
+        breaker: Optional["CircuitBreaker"] = None,
+        deadline: Optional[Deadline] = None,
+        record: Optional[List[float]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> Any:
+        """Call ``fn`` until it succeeds, exhausts ``max_attempts``, or the
+        deadline expires.
+
+        - ``retryable_exc(exc)`` — True to retry after an exception.
+        - ``response_retry_delay(resp)`` — ``None``: accept the response;
+          ``True``: retry on the policy's backoff; a float: retry after at
+          least that many seconds (``Retry-After``). The final attempt's
+          response is returned as-is so the caller surfaces the real error.
+        - ``record`` — appended with each slept delay (test introspection).
+        """
+        if deadline is None and self.deadline is not None:
+            deadline = Deadline.after(self.deadline)
+        rng = random.Random(self.seed)
+        attempt = 0
+        while True:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceededError(
+                    f"deadline expired before attempt {attempt + 1}",
+                    deadline=deadline.at)
+            if breaker is not None:
+                breaker.allow()
+            timeout = self.attempt_timeout
+            if deadline is not None:
+                rem = max(0.001, deadline.remaining())
+                timeout = rem if timeout is None else min(timeout, rem)
+            last = attempt >= self.max_attempts - 1
+            try:
+                resp = fn(AttemptInfo(index=attempt, timeout=timeout,
+                                      deadline=deadline))
+            except BaseException as e:  # noqa: BLE001 — classify, then re-raise
+                if breaker is not None and isinstance(e, Exception):
+                    breaker.record_failure()
+                if last or not retryable_exc(e):
+                    raise
+                delay = self._delay(rng, attempt)
+            else:
+                verdict = (response_retry_delay(resp)
+                           if response_retry_delay is not None else None)
+                if verdict is None:
+                    if breaker is not None:
+                        breaker.record_success()
+                    return resp
+                if breaker is not None:
+                    breaker.record_failure()
+                if last:
+                    return resp
+                delay = self._delay(rng, attempt)
+                if verdict is not True:
+                    delay = max(delay, float(verdict))
+            if deadline is not None and deadline.remaining() <= delay:
+                raise DeadlineExceededError(
+                    f"deadline would expire during backoff after attempt "
+                    f"{attempt + 1}", deadline=deadline.at)
+            if record is not None:
+                record.append(delay)
+            sleep(delay)
+            attempt += 1
+
+    async def arun(
+        self,
+        fn: Callable[[AttemptInfo], Any],
+        *,
+        retryable_exc: Callable[[BaseException], bool],
+        response_retry_delay: Optional[Callable[[Any], Any]] = None,
+        breaker: Optional["CircuitBreaker"] = None,
+        deadline: Optional[Deadline] = None,
+        record: Optional[List[float]] = None,
+    ) -> Any:
+        """Async twin of :meth:`run` (``fn`` is awaited; backoff is
+        ``asyncio.sleep``). Kept as a parallel body rather than a shared
+        generator so both read as straight-line control flow."""
+        import asyncio
+
+        if deadline is None and self.deadline is not None:
+            deadline = Deadline.after(self.deadline)
+        rng = random.Random(self.seed)
+        attempt = 0
+        while True:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceededError(
+                    f"deadline expired before attempt {attempt + 1}",
+                    deadline=deadline.at)
+            if breaker is not None:
+                breaker.allow()
+            timeout = self.attempt_timeout
+            if deadline is not None:
+                rem = max(0.001, deadline.remaining())
+                timeout = rem if timeout is None else min(timeout, rem)
+            last = attempt >= self.max_attempts - 1
+            try:
+                resp = await fn(AttemptInfo(index=attempt, timeout=timeout,
+                                            deadline=deadline))
+            except BaseException as e:  # noqa: BLE001
+                if breaker is not None and isinstance(e, Exception):
+                    breaker.record_failure()
+                if last or not retryable_exc(e):
+                    raise
+                delay = self._delay(rng, attempt)
+            else:
+                verdict = (response_retry_delay(resp)
+                           if response_retry_delay is not None else None)
+                if verdict is None:
+                    if breaker is not None:
+                        breaker.record_success()
+                    return resp
+                if breaker is not None:
+                    breaker.record_failure()
+                if last:
+                    return resp
+                delay = self._delay(rng, attempt)
+                if verdict is not True:
+                    delay = max(delay, float(verdict))
+            if deadline is not None and deadline.remaining() <= delay:
+                raise DeadlineExceededError(
+                    f"deadline would expire during backoff after attempt "
+                    f"{attempt + 1}", deadline=deadline.at)
+            if record is not None:
+                record.append(delay)
+            await asyncio.sleep(delay)
+            attempt += 1
+
+
+def _cfg_attempts(field: str, default: int) -> int:
+    """Attempt count from the layered config (``~/.kt/config`` file under
+    ``KT_*`` env, see config.py). The env var also reaches here when the
+    config singleton was built before the var was set — tests and pods
+    mutate env at runtime."""
+    try:
+        from .config import config
+        return max(1, int(config().get(field, default)))
+    except Exception:
+        return default
+
+
+def store_policy() -> RetryPolicy:
+    """Data-plane default: every store op is content-addressed (idempotent),
+    so retries are on by default. ``KT_STORE_RETRIES=1`` restores the old
+    single-shot behavior."""
+    return RetryPolicy(
+        max_attempts=max(1, _env_int("KT_STORE_RETRIES",
+                                  _cfg_attempts("store_retries", 3))),
+        base_delay=_env_float("KT_STORE_RETRY_BASE_S", 0.2),
+        max_delay=_env_float("KT_STORE_RETRY_MAX_S", 5.0),
+    )
+
+
+def http_policy() -> RetryPolicy:
+    """Serving-path default (``HTTPClient``). The attempt count only matters
+    for the *safe* retry classes; a non-idempotent established POST is never
+    re-sent regardless."""
+    return RetryPolicy(
+        max_attempts=max(1, _env_int("KT_HTTP_RETRIES",
+                                  _cfg_attempts("http_retries", 3))),
+        base_delay=_env_float("KT_HTTP_RETRY_BASE_S", 0.2),
+        max_delay=_env_float("KT_HTTP_RETRY_MAX_S", 5.0),
+    )
+
+
+def controller_policy() -> RetryPolicy:
+    """Control-plane default: small and snappy — controller calls sit on the
+    interactive path."""
+    return RetryPolicy(
+        max_attempts=max(1, _env_int("KT_CONTROLLER_RETRIES",
+                                  _cfg_attempts("controller_retries", 3))),
+        base_delay=_env_float("KT_CONTROLLER_RETRY_BASE_S", 0.1),
+        max_delay=_env_float("KT_CONTROLLER_RETRY_MAX_S", 2.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Classic three-state breaker, thread-safe.
+
+    - *closed*: calls flow; ``failure_threshold`` consecutive failures open it.
+    - *open*: :meth:`allow` raises :class:`CircuitOpenError` (with
+      ``retry_after``) until ``cooldown_s`` elapses.
+    - *half-open*: one probe call is admitted; success closes the circuit,
+      failure re-opens it for a fresh cool-down.
+    """
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_out = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> None:
+        with self._lock:
+            if self._state == "closed":
+                return
+            if self._state == "open":
+                elapsed = self._clock() - self._opened_at
+                if elapsed < self.cooldown_s:
+                    raise CircuitOpenError(
+                        f"circuit open ({self._failures} consecutive "
+                        f"failures); retry in "
+                        f"{self.cooldown_s - elapsed:.2f}s",
+                        retry_after=self.cooldown_s - elapsed)
+                self._state = "half-open"
+                self._probe_out = False
+            # half-open: admit exactly one probe at a time
+            if self._probe_out:
+                raise CircuitOpenError(
+                    "circuit half-open; probe already in flight",
+                    retry_after=self.cooldown_s)
+            self._probe_out = True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = "closed"
+            self._failures = 0
+            self._probe_out = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == "half-open":
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_out = False
+                return
+            self._failures += 1
+            if self._state == "closed" and \
+                    self._failures >= self.failure_threshold:
+                self._state = "open"
+                self._opened_at = self._clock()
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Convenience wrapper for a single guarded call."""
+        self.allow()
+        try:
+            result = fn()
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+
+# ---------------------------------------------------------------------------
+# Server-side idempotency dedupe
+# ---------------------------------------------------------------------------
+
+
+class IdempotencyCache:
+    """TTL cache of completed responses keyed by ``X-KT-Idempotency-Key``.
+
+    The contract that makes POST retries safe: the client only re-sends a
+    non-idempotent call when it attached a key, and the server replays the
+    recorded response for a key it has already *completed* — the user
+    function never executes twice. Single-event-loop use (aiohttp), so no
+    lock; entries are (status, body, headers) tuples.
+    """
+
+    def __init__(self, ttl_s: float = 600.0, max_entries: int = 1024,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._clock = clock
+        self._done: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
+        self.inflight: Dict[str, Any] = {}   # key → asyncio.Future
+
+    def __len__(self) -> int:
+        self._purge()
+        return len(self._done)
+
+    def _purge(self) -> None:
+        now = self._clock()
+        while self._done:
+            key, (ts, _) = next(iter(self._done.items()))
+            if now - ts <= self.ttl_s:
+                break
+            self._done.popitem(last=False)
+
+    def lookup(self, key: str) -> Optional[Any]:
+        self._purge()
+        entry = self._done.get(key)
+        return entry[1] if entry is not None else None
+
+    def store(self, key: str, value: Any) -> None:
+        self._purge()
+        self._done[key] = (self._clock(), value)
+        self._done.move_to_end(key)
+        while len(self._done) > self.max_entries:
+            self._done.popitem(last=False)

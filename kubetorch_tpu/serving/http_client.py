@@ -8,6 +8,7 @@ type on the caller's side.
 
 from __future__ import annotations
 
+import asyncio
 import atexit
 import json
 import os
@@ -21,6 +22,10 @@ import requests as _requests
 from .. import serialization as ser
 from ..config import config
 from ..exceptions import ControllerRequestError, rehydrate_exception
+from ..resilience import (DEADLINE_HEADER, ESTABLISHED_TRANSIENT_EXCS,
+                          RETRYABLE_STATUSES, Deadline, RetryPolicy,
+                          connection_never_established, http_policy,
+                          retry_after_seconds)
 
 
 class CustomResponse:
@@ -41,7 +46,17 @@ class CustomResponse:
             raise ControllerRequestError(
                 f"HTTP {self.status}: {self.body[:500]!r}", status_code=self.status)
         if "error_type" in data:
-            raise rehydrate_exception(data)
+            exc = rehydrate_exception(data)
+            # keep the transport facts alongside the rehydrated type: the
+            # HTTP status and the request id the server logs are labelled
+            # with, so `except kt.PodTerminatedError as e` can actually
+            # find the failing request in the pod logs
+            if getattr(exc, "status_code", None) is None:
+                exc.status_code = self.status  # type: ignore[attr-defined]
+            rid = self.headers.get("X-Request-ID")
+            if rid and getattr(exc, "request_id", None) is None:
+                exc.request_id = rid  # type: ignore[attr-defined]
+            raise exc
         raise ControllerRequestError(f"HTTP {self.status}: {data}",
                                      status_code=self.status)
 
@@ -67,13 +82,47 @@ def _drain_pumps_at_exit() -> None:
 atexit.register(_drain_pumps_at_exit)
 
 
+def _clamp_timeout(explicit: Optional[float],
+                   policy_timeout: Optional[float]) -> Optional[float]:
+    """Per-attempt I/O timeout: the caller's explicit value bounded by the
+    policy's deadline-clamped attempt timeout (whichever is tighter)."""
+    if explicit is None:
+        return policy_timeout
+    if policy_timeout is None:
+        return explicit
+    return min(explicit, policy_timeout)
+
+
+def _retryable_exc(e: BaseException, idempotency_key: Optional[str]) -> bool:
+    """The safe-retry rule for user calls: never-established is always
+    retryable (the server can't have seen the request); established
+    transport failures only when the server dedupes our idempotency key."""
+    if connection_never_established(e):
+        return True
+    return bool(idempotency_key) and isinstance(e, ESTABLISHED_TRANSIENT_EXCS)
+
+
+def _response_retry(status: int, body: bytes, resp: Any,
+                    idempotency_key: Optional[str]):
+    """Response verdict for RetryPolicy.run/arun: retry transient 5xx only
+    under an idempotency key, honoring Retry-After; a DeadlineExceededError
+    body is terminal — the budget is gone whatever we do."""
+    if status not in RETRYABLE_STATUSES or not idempotency_key:
+        return None
+    if b"DeadlineExceededError" in body[:2048]:
+        return None
+    ra = retry_after_seconds(resp)
+    return ra if ra is not None else True
+
+
 class HTTPClient:
     """Caller for one deployed service."""
 
     def __init__(self, base_url: str, serialization: Optional[str] = None,
                  stream_logs: Optional[bool] = None,
                  proxy_url: Optional[str] = None,
-                 service: Optional[str] = None):
+                 service: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None):
         self.base_url = base_url.rstrip("/")
         self.serialization = serialization or config().serialization
         self.stream_logs = (config().stream_logs if stream_logs is None
@@ -86,6 +135,10 @@ class HTTPClient:
         self._resource_scope_dead = False   # controller said: no stack
         self._resource_scope_fails = 0      # consecutive-failure backoff
         self._session = _requests.Session()
+        self.retry = retry           # per-client default; None → http_policy()
+        self.last_retry_delays: list = []   # backoff actually slept (tests)
+        self._aio_session = None
+        self._aio_loop = None
 
     # -- calls ----------------------------------------------------------------
 
@@ -94,11 +147,22 @@ class HTTPClient:
                     workers=None, timeout: Optional[float] = None,
                     debugger=None,
                     stream_logs: Optional[bool] = None,
-                    metrics=None, logging=None) -> Any:
+                    metrics=None, logging=None,
+                    idempotency_key: Optional[str] = None,
+                    deadline: Optional[float] = None,
+                    retry: Optional[RetryPolicy] = None) -> Any:
         """``debugger``/``metrics``/``logging`` accept the typed config
         objects (``kt.DebugConfig`` / ``kt.MetricsConfig`` /
         ``kt.LoggingConfig``, reference globals.py:40-127) or plain dicts
-        with the same fields."""
+        with the same fields.
+
+        Resilience (see :mod:`kubetorch_tpu.resilience`): a connection that
+        was never established is always retried (the request can't have
+        executed); anything after the connection was established — resets,
+        timeouts, 5xx — is retried ONLY when ``idempotency_key`` is given,
+        because the server dedupes that key and a retry can never run the
+        function twice. ``deadline`` (seconds) rides ``X-KT-Deadline`` so
+        the pod refuses work the client has already abandoned."""
         from ..config import LoggingConfig, MetricsConfig
         if isinstance(metrics, dict):
             metrics = MetricsConfig(**metrics)
@@ -139,26 +203,45 @@ class HTTPClient:
             data = ser.serialize(body, self.serialization)
             headers = {"X-Serialization": self.serialization,
                        "X-Request-ID": request_id}
-            try:
-                resp = self._session.post(url, data=data, headers=headers,
-                                          timeout=timeout)
-            except _requests.exceptions.ConnectionError as e:
-                # Fall back ONLY when the connection was never established
-                # (scaled to zero / pod churn): the proxy cold-starts the
-                # service and holds the request until a pod is ready. A
-                # reset MID-request must not re-POST — the call may already
-                # be executing on the pod, and running it twice is worse
-                # than surfacing the error.
-                established = not any(
-                    marker in str(e) for marker in
-                    ("NewConnectionError", "Connection refused",
-                     "Name or service not known", "No route to host"))
-                if self.proxy_url is None or established:
-                    raise
-                resp = self._session.post(
-                    f"{self.proxy_url}/{fn_name}" +
-                    (f"/{method}" if method else ""),
-                    data=data, headers=headers, timeout=timeout)
+            policy = retry or self.retry or http_policy()
+            dl = None
+            if deadline is not None:
+                dl = Deadline.after(deadline)
+            elif policy.deadline is not None:
+                dl = Deadline.after(policy.deadline)
+            if dl is not None:
+                headers[DEADLINE_HEADER] = dl.header_value()
+            if idempotency_key:
+                headers["X-KT-Idempotency-Key"] = idempotency_key
+
+            def _attempt(info):
+                t = _clamp_timeout(timeout, info.timeout)
+                try:
+                    return self._session.post(url, data=data,
+                                              headers=headers, timeout=t)
+                except _requests.exceptions.ConnectionError as e:
+                    # Fall back ONLY when the connection was never
+                    # established (scaled to zero / pod churn): the proxy
+                    # cold-starts the service and holds the request until a
+                    # pod is ready. A reset MID-request must not re-POST —
+                    # the call may already be executing on the pod, and
+                    # running it twice is worse than surfacing the error.
+                    if (self.proxy_url is None
+                            or not connection_never_established(e)):
+                        raise
+                    return self._session.post(
+                        f"{self.proxy_url}/{fn_name}" +
+                        (f"/{method}" if method else ""),
+                        data=data, headers=headers, timeout=t)
+
+            self.last_retry_delays = []
+            resp = policy.run(
+                _attempt,
+                retryable_exc=lambda e: _retryable_exc(e, idempotency_key),
+                response_retry_delay=lambda r: _response_retry(
+                    r.status_code, r.content, r, idempotency_key),
+                deadline=dl,
+                record=self.last_retry_delays)
         finally:
             if stop_streaming:
                 stop_streaming()
@@ -167,24 +250,97 @@ class HTTPClient:
         return CustomResponse(resp.status_code, resp.content,
                               dict(resp.headers)).result()
 
+    def _async_session(self):
+        """One shared ``aiohttp.ClientSession`` per client per event loop
+        (connection keep-alive parity with the sync path's Session). A
+        session from a finished loop can't be awaited closed — it is
+        abandoned and replaced."""
+        import aiohttp
+
+        loop = asyncio.get_running_loop()
+        if (self._aio_session is None or self._aio_session.closed
+                or self._aio_loop is not loop):
+            self._aio_session = aiohttp.ClientSession()
+            self._aio_loop = loop
+        return self._aio_session
+
+    async def aclose(self) -> None:
+        if self._aio_session is not None and not self._aio_session.closed \
+                and self._aio_loop is asyncio.get_running_loop():
+            await self._aio_session.close()
+        self._aio_session = None
+        self._aio_loop = None
+
     async def call_method_async(self, fn_name: str, method: Optional[str] = None,
                                 args: tuple = (), kwargs: Optional[dict] = None,
-                                workers=None, timeout: Optional[float] = None) -> Any:
+                                workers=None, timeout: Optional[float] = None,
+                                idempotency_key: Optional[str] = None,
+                                deadline: Optional[float] = None,
+                                retry: Optional[RetryPolicy] = None) -> Any:
+        """Async twin of :meth:`call_method`: same shared-session reuse,
+        same scaled-to-zero proxy fallback, and the same
+        never-re-POST-after-established rule (retries past an established
+        connection require ``idempotency_key``)."""
         import aiohttp
 
         body: Dict[str, Any] = {"args": list(args), "kwargs": kwargs or {}}
         if workers is not None:
             body["_kt_workers"] = workers
         url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")
-        async with aiohttp.ClientSession() as sess:
-            async with sess.post(
-                url, data=ser.serialize(body, self.serialization),
-                headers={"X-Serialization": self.serialization,
-                         "X-Request-ID": uuid.uuid4().hex[:16]},
-                timeout=aiohttp.ClientTimeout(total=timeout),
-            ) as resp:
-                return CustomResponse(resp.status, await resp.read(),
-                                      dict(resp.headers)).result()
+        data = ser.serialize(body, self.serialization)
+        headers = {"X-Serialization": self.serialization,
+                   "X-Request-ID": uuid.uuid4().hex[:16]}
+        policy = retry or self.retry or http_policy()
+        dl = None
+        if deadline is not None:
+            dl = Deadline.after(deadline)
+        elif policy.deadline is not None:
+            dl = Deadline.after(policy.deadline)
+        if dl is not None:
+            headers[DEADLINE_HEADER] = dl.header_value()
+        if idempotency_key:
+            headers["X-KT-Idempotency-Key"] = idempotency_key
+        sess = self._async_session()
+
+        async def _read(resp) -> CustomResponse:
+            return CustomResponse(resp.status, await resp.read(),
+                                  dict(resp.headers))
+
+        async def _attempt(info) -> CustomResponse:
+            t = aiohttp.ClientTimeout(total=_clamp_timeout(timeout,
+                                                           info.timeout))
+            try:
+                async with sess.post(url, data=data, headers=headers,
+                                     timeout=t) as resp:
+                    return await _read(resp)
+            except aiohttp.ClientConnectorError:
+                # connector errors = never established → the proxy fallback
+                # (and retry) are safe, exactly like the sync path
+                if self.proxy_url is None:
+                    raise
+                async with sess.post(
+                        f"{self.proxy_url}/{fn_name}" +
+                        (f"/{method}" if method else ""),
+                        data=data, headers=headers, timeout=t) as resp:
+                    return await _read(resp)
+
+        def _aio_retryable(e: BaseException) -> bool:
+            if isinstance(e, aiohttp.ClientConnectorError):
+                return True          # never established
+            return bool(idempotency_key) and isinstance(
+                e, (aiohttp.ServerDisconnectedError,
+                    aiohttp.ClientPayloadError, aiohttp.ClientOSError,
+                    asyncio.TimeoutError))
+
+        self.last_retry_delays = []
+        cr = await policy.arun(
+            _attempt,
+            retryable_exc=_aio_retryable,
+            response_retry_delay=lambda r: _response_retry(
+                r.status, r.body, r, idempotency_key),
+            deadline=dl,
+            record=self.last_retry_delays)
+        return cr.result()
 
     # -- health ---------------------------------------------------------------
 

@@ -41,8 +41,10 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from .. import serialization as ser
-from ..exceptions import (KubetorchError, PodTerminatedError, SerializationError,
+from ..exceptions import (DeadlineExceededError, KubetorchError,
+                          PodTerminatedError, SerializationError,
                           package_exception)
+from ..resilience import DEADLINE_HEADER, Deadline, IdempotencyCache
 from ..parallel.mesh import DistributedConfig
 from ..resources.pointers import Pointers
 from .env_contract import (KT_ALLOWED_SERIALIZATION, KT_CALLABLE_TYPE,
@@ -82,6 +84,11 @@ class ServerState:
         self.controller_ws = None
         self.app_process = None
         self.blobd_proc = None
+        # retried-POST dedupe (see resilience.IdempotencyCache): a client
+        # that retries with X-KT-Idempotency-Key must never execute twice
+        self.idempotency = IdempotencyCache(
+            ttl_s=float(os.environ.get("KT_IDEMPOTENCY_TTL_S", "600")),
+            max_entries=int(os.environ.get("KT_IDEMPOTENCY_MAX", "1024")))
 
     # -- metadata / supervisor ------------------------------------------------
 
@@ -255,6 +262,82 @@ async def request_id_middleware(request: web.Request, handler):
     resp = await handler(request)
     resp.headers["X-Request-ID"] = rid
     return resp
+
+
+@web.middleware
+async def deadline_middleware(request: web.Request, handler):
+    """Enforce the client's propagated deadline (``X-KT-Deadline``, absolute
+    unix seconds) before AND during dispatch: a request that arrives past
+    its deadline — or runs past it — gets a rehydratable
+    ``DeadlineExceededError`` instead of burning a TPU slot on work the
+    client already abandoned."""
+    deadline = Deadline.from_header(request.headers.get(DEADLINE_HEADER))
+    if deadline is None:
+        return await handler(request)
+    if deadline.expired():
+        return _error_response(DeadlineExceededError(
+            f"request arrived {-deadline.remaining():.3f}s past its "
+            f"deadline; not dispatched", deadline=deadline.at), status=504)
+    try:
+        return await asyncio.wait_for(handler(request),
+                                      timeout=deadline.remaining())
+    except asyncio.TimeoutError:
+        return _error_response(DeadlineExceededError(
+            "deadline expired during dispatch; handler cancelled",
+            deadline=deadline.at), status=504)
+
+
+@web.middleware
+async def idempotency_middleware(request: web.Request, handler):
+    """Dedupe retried POSTs carrying ``X-KT-Idempotency-Key``: the first
+    execution's response is recorded in a TTL cache and replayed for any
+    retry of the same key, so a client-side retry never runs the user
+    function twice. Concurrent duplicates await the original execution
+    instead of racing it."""
+    key = request.headers.get("X-KT-Idempotency-Key")
+    if not key or request.method != "POST":
+        return await handler(request)
+    state: ServerState = request.app["state"]
+    cache = state.idempotency
+    entry = cache.lookup(key)
+    if entry is None and key in cache.inflight:
+        try:
+            entry = await asyncio.shield(cache.inflight[key])
+        except Exception:
+            entry = None            # original died; fall through and execute
+    if entry is not None:
+        status, body, headers = entry
+        return web.Response(status=status, body=body,
+                            headers={**headers,
+                                     "X-KT-Idempotent-Replay": "1"})
+    fut = asyncio.get_running_loop().create_future()
+    cache.inflight[key] = fut
+    try:
+        resp = await handler(request)
+        body = resp.body if isinstance(getattr(resp, "body", None), bytes) \
+            else None
+        if body is not None:
+            headers = {k: resp.headers[k]
+                       for k in ("Content-Type", "X-Serialization")
+                       if k in resp.headers}
+            entry = (resp.status, body, headers)
+            cache.store(key, entry)
+            fut.set_result(entry)
+        else:
+            # streaming/file response: not replayable — drop the claim so a
+            # retry re-executes rather than hanging on a never-set future
+            fut.set_exception(KubetorchError("response not replayable"))
+        return resp
+    except BaseException as e:
+        if not fut.done():
+            fut.set_exception(
+                KubetorchError(f"original execution failed: {e}"))
+        raise
+    finally:
+        cache.inflight.pop(key, None)
+        # a consumed exception on an unawaited future is expected noise
+        if fut.done() and fut.exception() is not None:
+            fut.exception()
 
 
 @web.middleware
@@ -540,9 +623,19 @@ async def _run_callable_inner(request: web.Request,
 
 
 def create_app(state: Optional[ServerState] = None) -> web.Application:
-    app = web.Application(middlewares=[request_id_middleware,
-                                       termination_middleware],
+    # order matters: request-id first; chaos next (faults model the network,
+    # so they hit before any server logic); deadline before the dedupe cache
+    # (an expired replay is still expired); idempotency outside termination
+    # so the cached entry is exactly what the client saw.
+    middlewares = [request_id_middleware, deadline_middleware,
+                   idempotency_middleware, termination_middleware]
+    from ..chaos import maybe_chaos_middleware
+    chaos_mw, chaos_engine = maybe_chaos_middleware()
+    if chaos_mw is not None:
+        middlewares.insert(1, chaos_mw)
+    app = web.Application(middlewares=middlewares,
                           client_max_size=1024 ** 3)
+    app["chaos"] = chaos_engine
     app["state"] = state or ServerState()
     app.router.add_get("/health", health)
     app.router.add_get("/ready", ready)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Lint: no new raw ``requests`` call sites may bypass the resilience layer.
+
+Every HTTP call in ``kubetorch_tpu/`` is supposed to ride one of the three
+resilient choke points (``netpool.request``, ``HTTPClient.call_method``'s
+policy loop, or ``ControllerClient._request``). A raw
+``requests.post(...)`` / ``session().get(...)`` call site is single-shot:
+it fails permanently on the first transient error and silently undoes the
+retry/deadline guarantees documented in docs/resilience.md.
+
+This check greps the package for raw call sites and compares the per-file
+counts against the frozen baseline below (deliberate single-shot sites:
+health probes, best-effort telemetry pumps, and the resilient wrappers'
+own internals). Adding a site fails the build until you either route it
+through the resilience layer or — for genuinely best-effort one-shot
+probes — bump the baseline here WITH a justification comment.
+
+Run: ``python scripts/check_resilience.py`` (wired into ``make lint``).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "kubetorch_tpu"
+
+CALL_RE = re.compile(
+    r"(?:_requests|requests|session\(\)|self\._session|sess|session)"
+    r"\.(?:get|post|put|delete|head|request)\(")
+
+# Files that ARE the resilience layer (their raw calls implement the
+# wrappers everyone else must use).
+WRAPPER_FILES = {"resilience.py", "netpool.py"}
+
+# path (relative to kubetorch_tpu/) → max allowed raw call sites, each one a
+# deliberate exception:
+BASELINE = {
+    # session probe + port-forward health check, both single-shot by design
+    "cli.py": 1,
+    # daemon-liveness probes in _read_running_local (must not retry: they
+    # decide whether to SPAWN a controller) + _request's internals
+    "client.py": 4,
+    # _tunnel_fallback reachability probes (a probe that retries would stall
+    # every store op behind an unreachable direct URL) + fetcher internals
+    # (peer polling has its own no-progress window; retry would fight it)
+    "data_store/commands.py": 4,
+    "data_store/sync.py": 2,      # explicit-session test escape hatches
+    # best-effort telemetry pumps (metrics/log streaming — loss is benign)
+    # + the retry loop's own attempt calls
+    "serving/http_client.py": 8,
+    "serving/log_capture.py": 1,  # fire-and-forget log push
+    "serving/metrics_push.py": 1,  # fire-and-forget gauge push
+    "resources/app.py": 1,        # local readiness poll (loop retries it)
+    "resources/module.py": 1,     # local readiness poll (loop retries it)
+    # controller-internal aiohttp fan-outs: Loki push + proxy relay +
+    # metric scrapes — supervised by their own loops; a blind retry layer
+    # here would double-forward proxied requests
+    "controller/app.py": 5,
+    # worker-pool health polls and distributed subcalls: failures are the
+    # SIGNAL (typed WorkerCallError → elastic resize), not noise to retry
+    "serving/remote_worker_pool.py": 2,
+}
+
+
+def main() -> int:
+    failures = []
+    counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in WRAPPER_FILES:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = 0
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            if CALL_RE.search(line):
+                n += 1
+        if n:
+            counts[rel] = n
+        allowed = BASELINE.get(rel, 0)
+        if n > allowed:
+            failures.append(
+                f"  {rel}: {n} raw requests call site(s), baseline allows "
+                f"{allowed}")
+    if failures:
+        print("check_resilience: raw HTTP call sites bypass the resilience "
+              "layer:\n" + "\n".join(failures))
+        print("\nRoute them through netpool.request / the HTTPClient policy "
+              "loop / ControllerClient._request, or (for deliberate "
+              "single-shot probes) update the baseline in "
+              "scripts/check_resilience.py with a justification.")
+        return 1
+    # also flag stale baseline entries so the allowlist shrinks over time
+    stale = [f for f, allowed in BASELINE.items()
+             if counts.get(f, 0) < allowed]
+    if stale:
+        print("check_resilience: OK (note: baseline is loose for: "
+              + ", ".join(sorted(stale)) + ")")
+    else:
+        print("check_resilience: OK — all HTTP call sites accounted for")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Run an aiohttp app on a real socket from sync test code.
+
+The resilience tests drive the *sync* clients (``HTTPClient``,
+``netpool.request``) against real servers — ``aiohttp.test_utils``
+only serves its own async client, so this runs the app's loop in a
+daemon thread and exposes a plain ``http://127.0.0.1:<port>`` URL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class ThreadedAiohttpServer:
+    """Context manager: ``with ThreadedAiohttpServer(create_app) as srv:``
+    serves ``app_factory()`` (called inside the server loop, so app/state
+    construction sees the right event loop and current env) at ``srv.url``;
+    the built app is at ``srv.app`` for state assertions."""
+
+    def __init__(self, app_factory):
+        self._app_factory = app_factory
+        self._loop = None
+        self._runner = None
+        self._thread = None
+        self.app = None
+        self.port = None
+        self.url = None
+
+    def __enter__(self) -> "ThreadedAiohttpServer":
+        from aiohttp import web
+
+        started = threading.Event()
+        failure = []
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def go():
+                self.app = self._app_factory()
+                self._runner = web.AppRunner(self.app)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = site._server.sockets[0].getsockname()[1]
+
+            try:
+                self._loop.run_until_complete(go())
+            except BaseException as e:  # surfaced to the entering thread
+                failure.append(e)
+                started.set()
+                return
+            started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert started.wait(60), "server thread never came up"
+        if failure:
+            raise failure[0]
+        self.url = f"http://127.0.0.1:{self.port}"
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._loop is None:
+            return
+        if self._runner is not None:
+            fut = asyncio.run_coroutine_threadsafe(self._runner.cleanup(),
+                                                   self._loop)
+            try:
+                fut.result(30)
+            except Exception:
+                pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(15)

@@ -5,6 +5,59 @@ import pytest
 
 from kubetorch_tpu import exceptions as exc
 
+# Synthetic values for every structured attr in the registry, typed to match
+# each constructor's expectation — the whole-registry round-trip below breaks
+# loudly when someone adds an attr without a sample here.
+_ATTR_SAMPLES = {
+    "accelerator": "v5p-64",
+    "topology": "4x4x4",
+    "status_code": 503,
+    "reason": "Evicted",
+    "pod_name": "pod-3",
+    "exit_code": 137,
+    "requested_bytes": 8 << 30,
+    "available_bytes": 1 << 30,
+    "added": ["10.0.0.9"],
+    "removed": ["10.0.0.3"],
+    "previous": ["10.0.0.3"],
+    "current": ["10.0.0.9"],
+    "worker": "10.0.0.7",
+    "deadline": 1722787200.25,
+    "retry_after": 2.5,
+}
+
+
+@pytest.mark.parametrize("name", sorted(exc.EXCEPTION_REGISTRY))
+def test_whole_registry_roundtrip(name):
+    """package → rehydrate preserves type, message, and every structured
+    attr, for EVERY registered exception — the wire contract the resilience
+    layer (and every `except kt.X` user) depends on."""
+    cls = exc.EXCEPTION_REGISTRY[name]
+    attrs = {a: _ATTR_SAMPLES[a] for a in exc._STRUCTURED_ATTRS.get(name, [])}
+    # HbmOomError pins reason="HbmOom" internally; its ctor has no reason kwarg
+    if name == "HbmOomError":
+        attrs.pop("reason", None)
+    original = cls(f"{name} message", **attrs)
+    out = exc.rehydrate_exception(exc.package_exception(original))
+    assert type(out) is cls
+    assert str(out) == f"{name} message"
+    for attr in exc._STRUCTURED_ATTRS.get(name, []):
+        assert getattr(out, attr) == getattr(original, attr), attr
+    assert hasattr(out, "remote_traceback")
+
+
+def test_structured_attrs_all_registered():
+    """Every _STRUCTURED_ATTRS key must name a registered type (a rename in
+    one table but not the other silently drops attrs on the wire)."""
+    assert set(exc._STRUCTURED_ATTRS) <= set(exc.EXCEPTION_REGISTRY)
+
+
+def test_deadline_exceeded_roundtrip():
+    out = exc.rehydrate_exception(exc.package_exception(
+        exc.DeadlineExceededError("too late", deadline=123.5)))
+    assert isinstance(out, exc.DeadlineExceededError)
+    assert out.deadline == 123.5
+
 
 def test_roundtrip_registered_type():
     try:

@@ -1,0 +1,352 @@
+"""Unit coverage for the resilience layer (retry policies, circuit breaker,
+deadline propagation, idempotency cache, chaos grammar) plus the data-plane
+retry semantics against a live (threaded, in-process) store."""
+
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.level("unit")
+
+from kubetorch_tpu import chaos
+from kubetorch_tpu import resilience as rz
+from kubetorch_tpu.exceptions import CircuitOpenError, DeadlineExceededError
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_is_deterministic_and_recorded():
+    policy = rz.RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=0.4,
+                            seed=1234)
+    record = []
+    attempts = []
+
+    def fn(info):
+        attempts.append(info.index)
+        if len(attempts) < 4:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = policy.run(fn, retryable_exc=lambda e: True, record=record,
+                     sleep=lambda s: None)
+    assert out == "ok"
+    assert attempts == [0, 1, 2, 3]
+    assert record == policy.preview_delays(3)
+    # full jitter stays within the exponential envelope
+    for i, d in enumerate(record):
+        assert 0.0 <= d <= min(0.4, 0.05 * 2 ** i)
+
+
+def test_non_retryable_raises_immediately():
+    calls = []
+
+    def fn(info):
+        calls.append(info.index)
+        raise ValueError("terminal")
+
+    with pytest.raises(ValueError):
+        rz.RetryPolicy(max_attempts=5).run(
+            fn, retryable_exc=lambda e: isinstance(e, ConnectionError),
+            sleep=lambda s: None)
+    assert calls == [0]
+
+
+def test_attempts_exhausted_returns_last_response():
+    """A still-failing response after the last attempt is returned as-is so
+    the caller surfaces the real error, not a retry-layer one."""
+
+    class Resp:
+        status_code = 503
+        headers = {}
+
+    policy = rz.RetryPolicy(max_attempts=3, base_delay=0.001, seed=0)
+    seen = []
+    out = policy.run(lambda info: Resp(),
+                     retryable_exc=lambda e: True,
+                     response_retry_delay=lambda r: (seen.append(r) or True))
+    assert isinstance(out, Resp)
+    assert len(seen) == 3
+
+
+def test_retry_after_floor_applies():
+    policy = rz.RetryPolicy(max_attempts=2, base_delay=0.0001,
+                            max_delay=0.001, seed=7)
+    slept = []
+
+    class Resp:
+        headers = {"Retry-After": "0.25"}
+
+    def verdict(resp):
+        return rz.retry_after_seconds(resp)
+
+    policy.run(lambda info: Resp(), retryable_exc=lambda e: False,
+               response_retry_delay=lambda r: (
+                   None if slept else verdict(r)),
+               sleep=slept.append)
+    assert slept and slept[0] >= 0.25
+
+
+def test_deadline_stops_retries():
+    policy = rz.RetryPolicy(max_attempts=50, base_delay=0.05, deadline=0.15,
+                            seed=3)
+
+    def fn(info):
+        raise ConnectionError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError) as ei:
+        policy.run(fn, retryable_exc=lambda e: True)
+    assert time.monotonic() - t0 < 2.0
+    assert ei.value.deadline is not None
+
+
+def test_deadline_clamps_attempt_timeout():
+    policy = rz.RetryPolicy(max_attempts=1, attempt_timeout=60.0)
+    seen = {}
+
+    def fn(info):
+        seen["timeout"] = info.timeout
+        return 1
+
+    policy.run(fn, retryable_exc=lambda e: False,
+               deadline=rz.Deadline.after(0.5))
+    assert seen["timeout"] <= 0.5
+
+
+def test_deadline_header_roundtrip():
+    d = rz.Deadline.after(5.0)
+    back = rz.Deadline.from_header(d.header_value())
+    assert back is not None and abs(back.at - d.at) < 1e-5
+    assert rz.Deadline.from_header(None) is None
+    assert rz.Deadline.from_header("garbage") is None
+    assert not d.expired() and rz.Deadline(at=time.time() - 1).expired()
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_opens_half_opens_and_closes():
+    now = [0.0]
+    br = rz.CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                           clock=lambda: now[0])
+
+    def boom():
+        raise RuntimeError("down")
+
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            br.call(boom)
+    assert br.state == "open"
+    with pytest.raises(CircuitOpenError) as ei:
+        br.allow()
+    assert 0 < ei.value.retry_after <= 10.0
+
+    # cool-down elapses → half-open admits exactly one probe
+    now[0] = 11.0
+    br.allow()
+    assert br.state == "half-open"
+    with pytest.raises(CircuitOpenError):
+        br.allow()          # second concurrent probe rejected
+    br.record_failure()     # probe failed → open again, fresh cool-down
+    assert br.state == "open"
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+
+    now[0] = 22.0
+    br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    br.allow()              # closed circuit flows freely
+
+
+def test_circuit_open_error_rehydrates():
+    from kubetorch_tpu.exceptions import package_exception, rehydrate_exception
+
+    out = rehydrate_exception(package_exception(
+        CircuitOpenError("open", retry_after=4.5)))
+    assert isinstance(out, CircuitOpenError) and out.retry_after == 4.5
+
+
+# ---------------------------------------------------------------------------
+# IdempotencyCache
+# ---------------------------------------------------------------------------
+
+
+def test_idempotency_cache_ttl_and_cap():
+    now = [0.0]
+    cache = rz.IdempotencyCache(ttl_s=10.0, max_entries=2,
+                                clock=lambda: now[0])
+    cache.store("a", 1)
+    assert cache.lookup("a") == 1
+    now[0] = 11.0
+    assert cache.lookup("a") is None      # expired
+    cache.store("b", 2)
+    cache.store("c", 3)
+    cache.store("d", 4)                   # evicts oldest beyond cap
+    assert cache.lookup("b") is None
+    assert cache.lookup("c") == 3 and cache.lookup("d") == 4
+    assert len(cache) == 2
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_grammar_parses_all_forms():
+    faults = chaos.parse_spec("reset*2, 503:0.2, delay:0.1@/kv, oom%0.5, pass")
+    kinds = [f.kind for f in faults]
+    assert kinds == ["reset", "reset", "status", "delay", "oom", "pass"]
+    assert faults[2].status == 503 and faults[2].retry_after == 0.2
+    assert faults[3].path == "/kv" and faults[3].seconds == 0.1
+    assert faults[4].prob == 0.5
+
+
+@pytest.mark.parametrize("bad", ["bogus", "delay:x", "503:x", "reset*x"])
+def test_chaos_grammar_rejects_typos(bad):
+    with pytest.raises(chaos.ChaosError):
+        chaos.parse_spec(bad)
+
+
+def test_chaos_schedule_consumes_in_order_and_respects_exemptions():
+    engine = chaos.ChaosEngine(chaos.parse_spec("reset,pass,503"), seed=0)
+    assert engine.next_fault("/health") is None       # exempt, not consumed
+    assert engine.next_fault("/summer").kind == "reset"
+    assert engine.next_fault("/summer") is None       # explicit pass token
+    assert engine.next_fault("/summer").kind == "status"
+    assert engine.next_fault("/summer") is None       # schedule exhausted
+    assert engine.injected == 2
+
+
+def test_chaos_probabilistic_is_seeded():
+    def draws(seed):
+        engine = chaos.ChaosEngine(chaos.parse_spec("503%0.5"), seed=seed)
+        return [engine.next_fault("/x") is not None for _ in range(32)]
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)           # astronomically unlikely to match
+    assert any(draws(7)) and not all(draws(7))
+
+
+# ---------------------------------------------------------------------------
+# netpool.request semantics against a live (in-process) server
+# ---------------------------------------------------------------------------
+
+
+def _flaky_app(calls, fail=2, status=503, retry_after=None):
+    from aiohttp import web
+
+    async def handler(request):
+        calls.append(time.monotonic())
+        if len(calls) <= fail:
+            headers = {}
+            if retry_after is not None:
+                headers["Retry-After"] = str(retry_after)
+            return web.Response(status=status, headers=headers, text="busy")
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_get("/thing", handler)
+    return app
+
+
+def test_store_retries_honor_retry_after():
+    from kubetorch_tpu.data_store import netpool
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    calls = []
+    with ThreadedAiohttpServer(
+            lambda: _flaky_app(calls, fail=2, retry_after=0.35)) as srv:
+        policy = rz.RetryPolicy(max_attempts=4, base_delay=0.001,
+                                max_delay=0.01, seed=5)
+        record = []
+        r = netpool.request("GET", f"{srv.url}/thing", policy=policy,
+                            record=record)
+    assert r.status_code == 200 and len(calls) == 3
+    # the Retry-After floor (0.35s) overrode the tiny policy backoff
+    assert all(d >= 0.35 for d in record)
+    assert all(b - a >= 0.3 for a, b in zip(calls, calls[1:]))
+
+
+def test_store_gives_up_after_max_attempts():
+    from kubetorch_tpu.data_store import netpool
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    calls = []
+    with ThreadedAiohttpServer(
+            lambda: _flaky_app(calls, fail=99)) as srv:
+        policy = rz.RetryPolicy(max_attempts=3, base_delay=0.001,
+                                max_delay=0.01, seed=5)
+        r = netpool.request("GET", f"{srv.url}/thing", policy=policy)
+    assert r.status_code == 503 and len(calls) == 3
+
+
+def test_store_does_not_retry_definitive_statuses():
+    from kubetorch_tpu.data_store import netpool
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    calls = []
+    with ThreadedAiohttpServer(
+            lambda: _flaky_app(calls, fail=99, status=404)) as srv:
+        r = netpool.request("GET", f"{srv.url}/thing",
+                            policy=rz.RetryPolicy(max_attempts=5,
+                                                  base_delay=0.001))
+    assert r.status_code == 404 and len(calls) == 1
+
+
+def test_store_breaker_opt_in(monkeypatch):
+    """KT_STORE_BREAKER_THRESHOLD>0 trips the per-netloc breaker after
+    consecutive failures and half-opens after the cool-down."""
+    from kubetorch_tpu.data_store import netpool
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    monkeypatch.setenv("KT_STORE_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("KT_STORE_BREAKER_COOLDOWN_S", "0.2")
+    netpool.reset_breakers()
+    calls = []
+    try:
+        with ThreadedAiohttpServer(
+                lambda: _flaky_app(calls, fail=2)) as srv:
+            policy = rz.RetryPolicy(max_attempts=1)
+            for _ in range(2):
+                netpool.request("GET", f"{srv.url}/thing", policy=policy)
+            with pytest.raises(CircuitOpenError):
+                netpool.request("GET", f"{srv.url}/thing", policy=policy)
+            assert len(calls) == 2        # third call never hit the wire
+            time.sleep(0.25)              # cool-down → half-open probe
+            r = netpool.request("GET", f"{srv.url}/thing", policy=policy)
+            assert r.status_code == 200
+            assert netpool.request("GET", f"{srv.url}/thing",
+                                   policy=policy).status_code == 200
+    finally:
+        netpool.reset_breakers()
+
+
+def test_half_open_admits_single_probe_across_threads():
+    br = rz.CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+    with pytest.raises(RuntimeError):
+        br.call(lambda: (_ for _ in ()).throw(RuntimeError()))
+    time.sleep(0.06)
+    admitted, rejected = [], []
+    barrier = threading.Barrier(4)
+
+    def probe():
+        barrier.wait()
+        try:
+            br.allow()
+            admitted.append(1)
+        except CircuitOpenError:
+            rejected.append(1)
+
+    threads = [threading.Thread(target=probe) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(admitted) == 1 and len(rejected) == 3

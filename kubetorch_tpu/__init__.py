@@ -40,6 +40,7 @@ from .exceptions import (  # noqa: F401
     HbmOomError,
     WorkerMembershipChanged,
     WorkerCallError,
+    WorkerDiedError,
 )
 from .config import config, KTConfig  # noqa: F401
 

@@ -14,6 +14,7 @@ once, and the backoff sequence matches the policy".
              | delay:SECONDS
              | STATUS | STATUS:RETRY_AFTER      (e.g. 503 or 503:0.2)
              | oom | evict | preempt
+             | kill-rank:SIG@OP_INDEX           (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
   matching request consumes the first unconsumed token whose path filter
@@ -39,6 +40,14 @@ Fault kinds:
 - ``evict`` / ``preempt``  503 with a packaged ``PodTerminatedError``
   (reason Evicted / Preempted) — the pod-termination taxonomy, injectable
 - ``pass``      explicitly no fault (spaces out a schedule)
+- ``kill-rank:SIG@N``  **process-level** fault: the rank subprocess kills
+  itself with signal SIG (number or name: ``9``/``KILL``/``SEGV``/``TERM``)
+  when it receives its N-th call op (0-based) — a deterministic stand-in
+  for an OOM kill or preemption landing *mid-call*. Consumed by the worker
+  loop (``serving/process_worker.py``), NOT by the HTTP middleware (for
+  ``@``-bearing kill-rank tokens the suffix is the op index, not a path);
+  the watchdog (``serving/watchdog.py``) must detect the death, fail the
+  in-flight futures typed, and drive the bounded restart.
 
 Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
 connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
@@ -49,9 +58,10 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import signal as signal_mod
 import threading
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .exceptions import (ControllerRequestError, HbmOomError,
                          PodTerminatedError, package_exception)
@@ -64,7 +74,7 @@ CHAOS_SEED_ENV = "KT_CHAOS_SEED"
 EXEMPT_PATHS = ("/health", "/ready", "/metrics")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
-          "pass")
+          "pass", "kill-rank")
 
 
 @dataclass
@@ -75,6 +85,8 @@ class Fault:
     retry_after: Optional[float] = None
     path: Optional[str] = None         # path-prefix filter
     prob: Optional[float] = None       # None → deterministic schedule token
+    signal_no: int = 9                 # kill-rank: signal to self-deliver
+    op_index: int = 0                  # kill-rank: 0-based call-op index
 
     def matches(self, path: str) -> bool:
         if self.path is not None:
@@ -111,14 +123,36 @@ def parse_spec(spec: str) -> List[Fault]:
         if "@" in token:
             token, _, path = token.partition("@")
         fault = _parse_one(token.strip(), raw)
-        fault.path = path or None
+        if fault.kind == "kill-rank":
+            # for kill-rank the @-suffix is the call-op index, not a path
+            try:
+                fault.op_index = int(path) if path else 0
+            except ValueError:
+                raise ChaosError(f"bad op index in {raw!r}")
+        else:
+            fault.path = path or None
         fault.prob = prob
         faults.extend([Fault(**fault.__dict__) for _ in range(count)])
     return faults
 
 
+def _parse_signal(arg: str, raw: str) -> int:
+    name = arg.strip().upper()
+    if name.isdigit():
+        return int(name)
+    if name and not name.startswith("SIG"):
+        name = "SIG" + name
+    sig = getattr(signal_mod, name, None)
+    if sig is None:
+        raise ChaosError(f"unknown signal in {raw!r}")
+    return int(sig)
+
+
 def _parse_one(token: str, raw: str) -> Fault:
     head, _, arg = token.partition(":")
+    if head == "kill-rank":
+        return Fault(kind="kill-rank",
+                     signal_no=_parse_signal(arg or "9", raw))
     if head == "delay":
         try:
             return Fault(kind="delay", seconds=float(arg))
@@ -145,6 +179,9 @@ class ChaosEngine:
     drive engines from multiple threads)."""
 
     def __init__(self, faults: List[Fault], seed: int = 0):
+        # kill-rank verbs are process-level: consumed by the rank worker
+        # loop via rank_kill_plan(), invisible to the HTTP middleware
+        faults = [f for f in faults if f.kind != "kill-rank"]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
@@ -182,6 +219,23 @@ class ChaosEngine:
                     self.injected += 1
                     return fault
         return None
+
+
+def rank_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{call-op index → signal}`` from ``KT_CHAOS``'s process-level
+    ``kill-rank`` verbs — the schedule a rank worker consults as it
+    dequeues call ops. Empty when no kill-rank verb is present. A malformed
+    spec is reported, not raised: dying at spawn over a typo would read as
+    the exact crash loop this machinery exists to diagnose."""
+    raw = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
+    if "kill-rank" not in (raw or ""):
+        return {}
+    try:
+        faults = parse_spec(raw)
+    except ChaosError as e:
+        print(f"[kt] chaos: ignoring malformed {CHAOS_ENV}: {e}")
+        return {}
+    return {f.op_index: f.signal_no for f in faults if f.kind == "kill-rank"}
 
 
 def chaos_middleware(engine: ChaosEngine):

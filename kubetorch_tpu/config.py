@@ -50,6 +50,14 @@ class KTConfig:
     http_retries: int = 3                    # serving calls (HTTPClient)
     store_retries: int = 3                   # data-plane store ops
     controller_retries: int = 3              # control-plane requests
+    # worker liveness watchdog (serving/watchdog.py): poll cadence for rank
+    # subprocess death, and the sliding-window auto-restart budget. Same
+    # layering (KT_WATCHDOG_INTERVAL_S / KT_RESTART_BUDGET /
+    # KT_RESTART_WINDOW_S); restart_budget=0 disables self-healing (deaths
+    # still surface typed, the pool just stays down).
+    watchdog_interval_s: float = 0.5
+    restart_budget: int = 3
+    restart_window_s: float = 300.0
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))
@@ -86,6 +94,13 @@ class KTConfig:
                         import warnings
                         warnings.warn(
                             f"Ignoring non-integer {env_key}={raw!r}", stacklevel=2)
+                elif f.type in ("float", float):
+                    try:
+                        setattr(cfg, f.name, float(raw))
+                    except ValueError:
+                        import warnings
+                        warnings.warn(
+                            f"Ignoring non-numeric {env_key}={raw!r}", stacklevel=2)
                 elif f.name not in ("extra",):
                     setattr(cfg, f.name, raw)
         if cfg.username is None:

@@ -237,6 +237,51 @@ class WorkerCallError(KubetorchError):
         self.worker = worker
 
 
+class WorkerDiedError(KubetorchError):
+    """A rank *subprocess* died while (or before) handling a call.
+
+    The process-level sibling of :class:`PodTerminatedError`: the pod is
+    fine, but the subprocess that owns the TPU chips is gone. Raised
+    fail-fast by the liveness watchdog (``serving/watchdog.py``) the moment
+    the death is observed — bounded by ``KT_WATCHDOG_INTERVAL_S``, never by
+    the call timeout — with the classified cause attached:
+
+    - ``OOMKilled``  — SIGKILL with cgroup OOM evidence (host memory)
+    - ``Evicted``    — SIGTERM while the pod is draining (kubelet eviction)
+    - ``Preempted``  — SIGTERM under a GKE spot-reclaim / maintenance marker
+    - ``Crashed``    — SIGSEGV/SIGABRT/… or a nonzero exit (user/XLA crash)
+    - ``Killed``     — SIGKILL without OOM evidence (external kill)
+    - ``Exited``     — clean exit 0 without a shutdown request
+
+    ``rank`` is the local rank index, ``exitcode`` the raw
+    ``multiprocessing.Process.exitcode`` (negative = signal number).
+    """
+
+    def __init__(self, message: str = "Rank subprocess died",
+                 cause: Optional[str] = None, rank: Optional[int] = None,
+                 exitcode: Optional[int] = None):
+        super().__init__(message)
+        self.cause = cause
+        self.rank = rank
+        self.exitcode = exitcode
+
+    @property
+    def oom_killed(self) -> bool:
+        return self.cause == "OOMKilled"
+
+    @property
+    def evicted(self) -> bool:
+        return self.cause == "Evicted"
+
+    @property
+    def preempted(self) -> bool:
+        return self.cause == "Preempted"
+
+    @property
+    def crashed(self) -> bool:
+        return self.cause == "Crashed"
+
+
 # ---------------------------------------------------------------------------
 # Cross-process rehydration (reference serving/http_client.py:87-194)
 # ---------------------------------------------------------------------------
@@ -266,6 +311,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         HbmOomError,
         WorkerMembershipChanged,
         WorkerCallError,
+        WorkerDiedError,
     )
 }
 
@@ -280,6 +326,7 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "HbmOomError": ["requested_bytes", "available_bytes"],
     "WorkerMembershipChanged": ["added", "removed", "previous", "current"],
     "WorkerCallError": ["worker"],
+    "WorkerDiedError": ["cause", "rank", "exitcode"],
 }
 
 

@@ -33,7 +33,7 @@ import os
 import random
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -375,6 +375,75 @@ def controller_policy() -> RetryPolicy:
         base_delay=_env_float("KT_CONTROLLER_RETRY_BASE_S", 0.1),
         max_delay=_env_float("KT_CONTROLLER_RETRY_MAX_S", 2.0),
     )
+
+
+def restart_policy(max_restarts: Optional[int] = None) -> RetryPolicy:
+    """Worker-watchdog default (``serving/watchdog.py``): backoff slept
+    before each rank-pool respawn, so a crash-looping worker doesn't burn
+    the whole restart budget in one watchdog tick. Deterministic under
+    ``KT_RETRY_SEED`` like every other policy — the chaos suite asserts the
+    respawn cadence with :meth:`RetryPolicy.preview_delays`."""
+    return RetryPolicy(
+        max_attempts=max(1, max_restarts if max_restarts is not None
+                         else _env_int("KT_RESTART_BUDGET",
+                                       _cfg_attempts("restart_budget", 3))),
+        base_delay=_env_float("KT_RESTART_BACKOFF_BASE_S", 0.2),
+        max_delay=_env_float("KT_RESTART_BACKOFF_MAX_S", 5.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Restart budget (sliding window)
+# ---------------------------------------------------------------------------
+
+
+class RestartBudget:
+    """Sliding-window counter bounding self-healing: at most ``budget``
+    acquisitions per ``window_s`` seconds, thread-safe.
+
+    The shape retry counters can't express: a rank pool that dies once an
+    hour should self-heal forever, while one that dies five times in a
+    minute is crash-looping (bad weights, poisoned TPU runtime, host OOM
+    pressure) and must fail *permanently and typed* rather than flap
+    ``/ready`` for eternity. Old acquisitions age out of the window, so the
+    budget regenerates on its own.
+    """
+
+    def __init__(self, budget: int, window_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.budget = max(0, int(budget))
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: deque = deque()
+
+    def _evict(self, now: float) -> None:
+        while self._events and now - self._events[0] > self.window_s:
+            self._events.popleft()
+
+    def try_acquire(self) -> bool:
+        """Consume one restart if the window has room; False = exhausted."""
+        with self._lock:
+            now = self._clock()
+            self._evict(now)
+            if len(self._events) >= self.budget:
+                return False
+            self._events.append(now)
+            return True
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            self._evict(self._clock())
+            return len(self._events)
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.budget - self.used)
+
+    def state(self) -> Dict[str, Any]:
+        return {"budget": self.budget, "window_s": self.window_s,
+                "used": self.used, "remaining": self.remaining}
 
 
 # ---------------------------------------------------------------------------

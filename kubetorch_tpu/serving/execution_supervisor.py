@@ -80,6 +80,19 @@ class ExecutionSupervisor:
         gates /ready so pods don't join the endpoint pool mid-compile."""
         return self.pool is not None and self.pool.warming
 
+    @property
+    def recovering(self) -> bool:
+        """True while the watchdog is respawning dead ranks — /ready flips
+        unhealthy for exactly this window so the endpoint pool routes
+        around a pod that is mid-self-heal."""
+        return self.pool is not None and self.pool.recovering
+
+    def restart_state(self) -> Dict[str, Any]:
+        """Watchdog restart/budget state, reported in ``/health``."""
+        if self.pool is None:
+            return {}
+        return self.pool.watchdog.state_dict()
+
     # -- calls ---------------------------------------------------------------
 
     async def call(self, method: Optional[str], args: list, kwargs: dict,
@@ -146,6 +159,10 @@ class DistributedSupervisor(ExecutionSupervisor):
             node_rank=node_rank, num_nodes=len(ips), pod_ips=ips,
             base_env=self._base_env(),
         )
+        # a coordinator-observed local rank death must cancel the whole
+        # distributed fan-out, typed — not just the local branch
+        self.pool.watchdog.on_death.append(self._on_worker_death)
+        self.pool.watchdog.on_restart.append(self._on_worker_restart)
         self.pool.start()
         self._start_monitor()
 
@@ -182,6 +199,37 @@ class DistributedSupervisor(ExecutionSupervisor):
                     # fast-fail in-flight local work; the coordinator
                     # propagates the typed error to the client for resize
                     self.pool.cancel_pending(event)
+
+    # -- worker-death translation (watchdog hooks, ISSUE 3) -------------------
+
+    def _on_worker_death(self, local_rank: int, exc) -> None:
+        """Translate a rank-subprocess death into the membership taxonomy:
+        a critical ``WorkerMembershipChanged`` with the concrete typed cause
+        (``WorkerDiedError``) chained on, queued for the next call AND
+        fanned out into every in-flight future so remote branches of a
+        distributed call cancel now instead of riding out their timeouts."""
+        my_ip = my_pod_ip()
+        event = WorkerMembershipChanged(
+            f"local rank {local_rank} died mid-call "
+            f"(cause={exc.cause}); mesh invalidated",
+            removed=[my_ip], previous=list(self._known_ips),
+            current=[ip for ip in self._known_ips if ip != my_ip])
+        event.__cause__ = exc
+        with self._events_lock:
+            self._membership_events.append(event)
+        if self.pool is not None:
+            self.pool.cancel_pending(event)
+
+    def _on_worker_restart(self) -> None:
+        """The respawned pool restores the collective: drop queued
+        death-caused events so the next call runs instead of tripping over
+        a cancellation for a mesh that no longer exists. Real membership
+        changes (pod-IP diffs) are kept — those still require a resize."""
+        from ..exceptions import WorkerDiedError
+        with self._events_lock:
+            self._membership_events = [
+                e for e in self._membership_events
+                if not isinstance(e.__cause__, WorkerDiedError)]
 
     def pop_membership_event(self) -> Optional[WorkerMembershipChanged]:
         with self._events_lock:

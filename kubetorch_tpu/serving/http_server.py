@@ -43,7 +43,7 @@ from aiohttp import web
 from .. import serialization as ser
 from ..exceptions import (DeadlineExceededError, KubetorchError,
                           PodTerminatedError, SerializationError,
-                          package_exception)
+                          WorkerDiedError, package_exception)
 from ..resilience import DEADLINE_HEADER, Deadline, IdempotencyCache
 from ..parallel.mesh import DistributedConfig
 from ..resources.pointers import Pointers
@@ -247,6 +247,10 @@ class ServerState:
     def terminate(self, reason: str) -> None:
         self.termination_reason = reason
         self.termination.set()
+        # the watchdog classifies a rank's SIGTERM during this drain window
+        # as Evicted/Preempted rather than an anonymous kill
+        from .watchdog import set_draining
+        set_draining(reason)
 
 
 # ---------------------------------------------------------------------------
@@ -376,13 +380,22 @@ def _error_response(exc: BaseException, status: int = 500) -> web.Response:
 async def health(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     sup = state.supervisor
-    return web.json_response({
+    body = {
         "status": "ok",
         "pod": state.pod_name,
         "launch_id": state.launch_id,
         "uptime_s": round(time.time() - state.started_at, 1),
         "supervisor_healthy": bool(sup and sup.healthy),
-    })
+    }
+    # watchdog restart state (ISSUE 3): deaths, budget remaining, whether
+    # the pool is mid-respawn or permanently failed — the operator's view
+    # of worker-level self-healing
+    if sup is not None and hasattr(sup, "restart_state"):
+        try:
+            body["workers"] = sup.restart_state()
+        except Exception:  # noqa: BLE001 — health must never 500 over this
+            pass
+    return web.json_response(body)
 
 
 async def ready(request: web.Request) -> web.Response:
@@ -410,10 +423,16 @@ async def ready(request: web.Request) -> web.Response:
              "error": state._prewarm_error}, status=503)
     sup = state.supervisor
     if sup is not None and (getattr(sup, "warming", False)
+                            or getattr(sup, "recovering", False)
                             or not getattr(sup, "healthy", True)):
+        # recovering: the watchdog is respawning dead ranks — readiness
+        # flips down for the recovery window and back up once healed
+        # (permanent restart-budget exhaustion keeps healthy False forever,
+        # so /ready stays down for good)
         return web.json_response(
             {"ready": False, "launch_id": state.launch_id,
              "warming": bool(getattr(sup, "warming", False)),
+             "recovering": bool(getattr(sup, "recovering", False)),
              "healthy": bool(getattr(sup, "healthy", True))}, status=503)
     return web.json_response({"ready": True, "launch_id": state.launch_id})
 
@@ -611,7 +630,9 @@ async def _run_callable_inner(request: web.Request,
                             headers={"X-Serialization": fmt},
                             content_type="application/octet-stream"
                             if fmt != ser.JSON else "application/json")
-    except PodTerminatedError as e:
+    except (PodTerminatedError, WorkerDiedError) as e:
+        # infra faults, not user errors: 503 so load balancers shed traffic
+        # while the watchdog restarts the rank pool
         return _error_response(e, status=503)
     except BaseException as e:  # noqa: BLE001
         return _error_response(e)

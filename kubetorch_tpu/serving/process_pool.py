@@ -3,6 +3,12 @@
 Reference (``serving/process_pool.py``): N ProcessWorkers + mp queues, a
 response-router thread matching req_ids to futures, ``call`` (one rank) and
 ``call_all`` (every local rank in parallel), queue draining on restart.
+
+Liveness is owned by the pool's :class:`~.watchdog.Watchdog` (ISSUE 3): a
+rank that dies *mid-call* gets its in-flight futures failed with a typed
+:class:`~..exceptions.WorkerDiedError` within the watchdog interval — not
+the call timeout — and the pool self-heals within a bounded restart budget
+(full-pool for spawn-fixed collective identity, single-rank otherwise).
 """
 
 from __future__ import annotations
@@ -13,11 +19,12 @@ import os
 import threading
 import time
 import queue as queue_mod
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..exceptions import rehydrate_exception
 from ..resources.pointers import Pointers
 from .env_contract import RankInfo
+from .watchdog import Watchdog
 
 
 class ProcessPool:
@@ -26,23 +33,36 @@ class ProcessPool:
                  node_rank: int = 0, num_nodes: int = 1,
                  pod_ips: Optional[List[str]] = None,
                  base_env: Optional[Dict[str, str]] = None):
-        from .process_worker import ProcessWorker
-
         self.num_procs = num_procs
         self.framework_name = framework_name
-        self.workers: List[ProcessWorker] = []
-        for local_rank in range(num_procs):
-            info = RankInfo(node_rank=node_rank, local_rank=local_rank,
-                            nproc_per_node=num_procs, num_nodes=num_nodes,
-                            pod_ips=pod_ips or ["127.0.0.1"])
-            self.workers.append(ProcessWorker(info, framework_name, pointers,
-                                              init_args, base_env))
-        self._futures: Dict[str, asyncio.Future] = {}
+        # spawn parameters are kept so the watchdog can respawn dead ranks
+        # with their original identity
+        self._pointers = pointers
+        self._init_args = init_args
+        self._node_rank = node_rank
+        self._num_nodes = num_nodes
+        self._pod_ips = list(pod_ips or ["127.0.0.1"])
+        self._base_env = base_env
+        self.workers: List[Any] = [self._new_worker(lr)
+                                   for lr in range(num_procs)]
+        # req_id → (future, worker index): the index is what lets a death
+        # fail exactly the dead rank's in-flight calls
+        self._futures: Dict[str, Tuple[asyncio.Future, int]] = {}
         self._futures_lock = threading.Lock()
         self._req_counter = itertools.count()
         self._router_threads: List[threading.Thread] = []
         self._stopping = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.watchdog = Watchdog(self)
+
+    def _new_worker(self, local_rank: int):
+        from .process_worker import ProcessWorker
+
+        info = RankInfo(node_rank=self._node_rank, local_rank=local_rank,
+                        nproc_per_node=self.num_procs,
+                        num_nodes=self._num_nodes, pod_ips=self._pod_ips)
+        return ProcessWorker(info, self.framework_name, self._pointers,
+                             self._init_args, self._base_env)
 
     def start(self) -> None:
         # NOTE: often called from a worker thread (asyncio.to_thread), where
@@ -50,30 +70,90 @@ class ProcessPool:
         for w in self.workers:
             w.start()
         for w in self.workers:
-            t = threading.Thread(target=self._route_responses, args=(w,), daemon=True)
-            t.start()
-            self._router_threads.append(t)
+            self._start_router(w)
+        self.watchdog.start()
+
+    def _start_router(self, worker) -> None:
+        t = threading.Thread(target=self._route_responses, args=(worker,),
+                             daemon=True)
+        t.start()
+        self._router_threads.append(t)
+
+    # -- restart hooks (driven by the watchdog thread only) -------------------
+
+    def restart_worker(self, idx: int) -> None:
+        """Respawn one dead rank in place (per-call-identity frameworks:
+        live ranks keep serving). The old router thread exits on its own
+        once the dead worker's queue is drained."""
+        old = self.workers[idx]
+        old.force_kill_if_alive()
+        fresh = self._new_worker(idx)
+        self.workers[idx] = fresh
+        fresh.start()
+        self._start_router(fresh)
+
+    def restart_all(self, exc: Optional[BaseException] = None) -> None:
+        """Full-pool respawn for spawn-fixed collective identity (JAX/TPU
+        mesh): surviving ranks hold half a broken collective, so their
+        in-flight futures fail with the dead rank's typed cause and every
+        rank restarts together."""
+        if exc is not None:
+            self.cancel_pending(exc)
+        for w in self.workers:
+            w.request_shutdown()
+        deadline = time.monotonic() + 2.0
+        while any(w.alive for w in self.workers) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        for w in self.workers:
+            w.force_kill_if_alive()
+        self.workers = [self._new_worker(lr) for lr in range(self.num_procs)]
+        for w in self.workers:
+            w.start()
+        for w in self.workers:
+            self._start_router(w)
+
+    # -- response routing -----------------------------------------------------
 
     def _route_responses(self, worker) -> None:
         while not self._stopping.is_set():
             try:
                 resp = worker.response_q.get(timeout=0.2)
             except (queue_mod.Empty, OSError, ValueError, EOFError):
-                if not worker.alive and self._stopping.is_set():
+                if not worker.alive:
+                    # dead worker: ship whatever its feeder already wrote,
+                    # then exit — spinning at 5 Hz on a queue that can never
+                    # produce again would leak one thread per death for the
+                    # pod's lifetime
+                    self._drain_dead_queue(worker)
                     return
                 continue
-            if resp.get("op") == "log":
-                self._forward_log(resp, worker)
-                continue
-            if resp.get("op") == "state":
-                # load+warmup bracket: gates /ready and shutdown escalation
-                worker.in_warmup = resp.get("warmup") == "started"
-                continue
-            req_id = resp.get("req_id")
-            with self._futures_lock:
-                fut = self._futures.pop(req_id, None)
-            if fut is not None and self._loop is not None and not fut.done():
-                self._loop.call_soon_threadsafe(self._resolve, fut, resp)
+            self._dispatch_response(resp, worker)
+
+    def _drain_dead_queue(self, worker) -> None:
+        while True:
+            try:
+                resp = worker.response_q.get(timeout=0.2)
+            except (queue_mod.Empty, OSError, ValueError, EOFError):
+                return
+            self._dispatch_response(resp, worker)
+
+    def _dispatch_response(self, resp: Dict, worker) -> None:
+        if resp.get("op") == "log":
+            self._forward_log(resp, worker)
+            return
+        if resp.get("op") == "state":
+            # load+warmup bracket: gates /ready and shutdown escalation
+            worker.in_warmup = resp.get("warmup") == "started"
+            return
+        req_id = resp.get("req_id")
+        with self._futures_lock:
+            entry = self._futures.pop(req_id, None)
+        if entry is None:
+            return
+        fut, _idx = entry
+        if self._loop is not None and not fut.done():
+            self._loop.call_soon_threadsafe(self._resolve, fut, resp)
 
     @staticmethod
     def _forward_log(resp: Dict, worker) -> None:
@@ -94,23 +174,75 @@ class ProcessPool:
         else:
             fut.set_exception(rehydrate_exception(resp["error"]))
 
+    # -- failing futures (watchdog + shutdown paths) --------------------------
+
+    def _fail_future(self, fut: asyncio.Future, exc: BaseException) -> None:
+        if fut.done():
+            return
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                lambda f=fut: (not f.done()) and f.set_exception(exc))
+        else:
+            # no loop ever served a call (pool set up but never hit):
+            # fail synchronously so shutdown never strands a waiter
+            try:
+                fut.set_exception(exc)
+            except Exception:  # noqa: BLE001 — e.g. already-cancelled
+                pass
+
+    def fail_worker_futures(self, idx: int, exc: BaseException) -> None:
+        """Fail every in-flight future registered to rank ``idx`` — the
+        watchdog's fail-fast path on observed death."""
+        with self._futures_lock:
+            doomed = [(rid, fut) for rid, (fut, i) in self._futures.items()
+                      if i == idx]
+            for rid, _ in doomed:
+                self._futures.pop(rid, None)
+        for _, fut in doomed:
+            self._fail_future(fut, exc)
+
+    def cancel_pending(self, exc: BaseException) -> None:
+        with self._futures_lock:
+            entries, self._futures = list(self._futures.values()), {}
+        for fut, _idx in entries:
+            self._fail_future(fut, exc)
+
+    def raise_if_failed(self) -> None:
+        """Raise the permanent typed failure after restart-budget
+        exhaustion — callers (and fan-out coordinators) fail immediately
+        instead of submitting into a pool that can never answer."""
+        exc = self.watchdog.permanent_error()
+        if exc is not None:
+            raise exc
+
+    # -- submission -----------------------------------------------------------
+
     async def _submit(self, idx: int, payload: Dict,
                       timeout: Optional[float]) -> Any:
         """Shared request plumbing: liveness check, future registration,
         queue submit, awaited response."""
         worker = self.workers[idx]
+        self.raise_if_failed()
         if not worker.alive:
-            raise RuntimeError(f"Rank subprocess {idx} is dead")
+            raise self.watchdog.death_error(idx, worker)
         self._loop = asyncio.get_running_loop()
         req_id = f"r{next(self._req_counter)}"
         fut = self._loop.create_future()
         with self._futures_lock:
-            self._futures[req_id] = fut
+            self._futures[req_id] = (fut, idx)
         # carry the HTTP request id across the process boundary so the
         # worker's prints stay correlated to this call in the log stream
         from .http_server import request_id_var
-        worker.submit({"req_id": req_id,
-                       "request_id": request_id_var.get(""), **payload})
+        try:
+            worker.submit({"req_id": req_id,
+                           "request_id": request_id_var.get(""), **payload})
+        except BaseException as e:  # noqa: BLE001
+            # the worker died between the liveness check and the queue put:
+            # pop the registered future (it would leak in self._futures
+            # forever) and surface the typed death, not a bare queue error
+            with self._futures_lock:
+                self._futures.pop(req_id, None)
+            raise self.watchdog.death_error(idx, worker) from e
         try:
             return await asyncio.wait_for(fut, timeout)
         except (asyncio.TimeoutError, asyncio.CancelledError):
@@ -172,23 +304,20 @@ class ProcessPool:
                  for i in range(self.num_procs)]
         return list(await asyncio.gather(*tasks))
 
-    def cancel_pending(self, exc: BaseException) -> None:
-        with self._futures_lock:
-            futs, self._futures = list(self._futures.values()), {}
-        for fut in futs:
-            if self._loop is not None and not fut.done():
-                self._loop.call_soon_threadsafe(
-                    lambda f=fut: (not f.done()) and f.set_exception(exc))
+    # -- teardown / health ----------------------------------------------------
 
     def shutdown(self) -> None:
-        """Stop every worker: shutdown ops go out to ALL workers first, one
-        shared join deadline covers them together (not per-worker serially),
-        and the response routers stay alive until the end so a worker's
-        ``warmup: done`` state op can still flip ``in_warmup`` mid-wait —
-        the flag that decides whether SIGKILL escalation is allowed (a jit
-        compile in flight must never be force-killed while it holds the
-        TPU). Workers still warming get one shared KT_WARMUP_SHUTDOWN_GRACE
-        window (default 600s) before the last-resort kill."""
+        """Stop every worker: the watchdog stops FIRST (intentional exits
+        must not classify as deaths or burn the restart budget), shutdown
+        ops go out to ALL workers, one shared join deadline covers them
+        together (not per-worker serially), and the response routers stay
+        alive until the end so a worker's ``warmup: done`` state op can
+        still flip ``in_warmup`` mid-wait — the flag that decides whether
+        SIGKILL escalation is allowed (a jit compile in flight must never
+        be force-killed while it holds the TPU). Workers still warming get
+        one shared KT_WARMUP_SHUTDOWN_GRACE window (default 600s) before
+        the last-resort kill."""
+        self.watchdog.stop()
         self.cancel_pending(RuntimeError("ProcessPool shutting down"))
         for w in self.workers:
             w.request_shutdown()
@@ -215,7 +344,15 @@ class ProcessPool:
 
     @property
     def healthy(self) -> bool:
+        if self.watchdog.failed:
+            return False
         return all(w.alive for w in self.workers)
+
+    @property
+    def recovering(self) -> bool:
+        """True while the watchdog is mid-respawn — /ready flips unhealthy
+        for exactly this window."""
+        return self.watchdog.recovering
 
     @property
     def warming(self) -> bool:

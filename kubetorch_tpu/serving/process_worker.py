@@ -93,6 +93,13 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
     executor = ThreadPoolExecutor(max_workers=_SYNC_EXECUTOR_THREADS)
     target: Any = None
     load_error: Optional[BaseException] = None
+    # process-level chaos (ISSUE 3): KT_CHAOS kill-rank verbs make THIS rank
+    # kill itself at a chosen call index — the deterministic stand-in for an
+    # OOM kill / preemption landing mid-call, which the parent's watchdog
+    # must detect and surface typed
+    from ..chaos import rank_kill_plan
+    kill_plan = rank_kill_plan()
+    call_index = 0
 
     # Eager-load the callable at spawn (reference :236-247) so first-request
     # latency excludes import cost, and failures surface in health checks.
@@ -132,6 +139,15 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
             task = asyncio.ensure_future(
                 _handle_user_metrics(item, target, response_q, executor))
         else:
+            if kill_plan:
+                sig = kill_plan.get(call_index)
+                if sig is not None:
+                    # mid-call by construction: the parent registered this
+                    # req's future at submit, and no response will ever come
+                    print(f"[kt] chaos: kill-rank sig={sig} "
+                          f"at call index {call_index}")
+                    os.kill(os.getpid(), sig)
+            call_index += 1
             task = asyncio.ensure_future(
                 _handle(item, target, load_error, response_q, executor,
                         identity_env))
@@ -336,3 +352,10 @@ class ProcessWorker:
     @property
     def alive(self) -> bool:
         return self.process.is_alive()
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        """``multiprocessing`` exitcode (negative = killed by that signal);
+        None while alive or never started — the watchdog's classification
+        input."""
+        return self.process.exitcode

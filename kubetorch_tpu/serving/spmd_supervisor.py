@@ -114,6 +114,9 @@ class SPMDSupervisor(DistributedSupervisor):
     async def _call_inner(self, method, args, kwargs, timeout, workers,
                           subtree, sel_ips, headers) -> List[Any]:
         assert self.pool is not None, "supervisor not set up"
+        # a pool whose restart budget is exhausted can never answer: fail the
+        # whole fan-out here, typed, before any remote subcall is dispatched
+        self.pool.raise_if_failed()
         my_ip = my_pod_ip()
         if subtree is not None:
             # we are an interior tree node: coordinate the given subtree;

@@ -1,0 +1,368 @@
+"""Per-pool worker liveness watchdog: fail-fast death detection, typed
+crash causes, and bounded auto-restart of the rank pool.
+
+The gap this closes: ``ProcessPool`` checked ``worker.alive`` only at
+*submit* time, so a rank that was OOM-killed or segfaulted **mid-call** left
+its future pending until the per-call timeout — or forever with
+``timeout=None`` — and nothing ever restarted the dead rank even though
+``healthy`` flipped false. On GKE TPU slices, where preemption and
+maintenance events are routine (Singularity arXiv:2202.07848 argues this
+must be a transparent layer, not per-job timeout hygiene), that is the
+difference between a 2-second typed failure plus self-heal and a wedged pod.
+
+One watchdog per :class:`~.process_pool.ProcessPool`:
+
+1. **Detect** — a monitor thread polls every rank subprocess each
+   ``KT_WATCHDOG_INTERVAL_S`` (default 0.5s). ``Process.is_alive()`` +
+   ``exitcode`` are the ground truth; no heartbeat protocol is needed
+   because the parent IS the process's parent.
+2. **Classify** — the exitcode (negative = signal), the pod's drain state,
+   preemption markers, and cgroup OOM evidence map the death to a typed
+   cause: ``OOMKilled`` / ``Evicted`` / ``Preempted`` / ``Crashed`` /
+   ``Killed`` / ``Exited``.
+3. **Fail fast** — every in-flight future registered to the dead rank is
+   failed with :class:`~..exceptions.WorkerDiedError` (cause, rank,
+   exitcode attached) immediately — bounded by the watchdog interval, never
+   the call timeout. ``on_death`` hooks let supervisors fan the cause out
+   (``DistributedSupervisor`` translates it into a critical
+   ``WorkerMembershipChanged`` that cancels the whole distributed call).
+4. **Restart** — a sliding-window budget (``KT_RESTART_BUDGET`` restarts
+   per ``KT_RESTART_WINDOW_S``, via :class:`~..resilience.RestartBudget`)
+   drives self-healing with :func:`~..resilience.restart_policy` backoff:
+   frameworks with spawn-fixed collective identity (JAX/TPU mesh) get a
+   **full-pool** restart (a compiled mesh cannot mix old and new ranks);
+   per-call-identity frameworks get a **single-rank** respawn. Budget
+   exhaustion is a *permanent* typed failure: the pool stays unhealthy,
+   ``/ready`` stays down, and every later submit raises immediately.
+
+Deterministic proof: the chaos verb ``kill-rank:<sig>@<op-index>``
+(:mod:`kubetorch_tpu.chaos`) kills a rank from inside, mid-call, so the
+suite can assert detection latency, restart cadence, and budget semantics
+without racing a real preemption.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as signal_mod
+import threading
+import time
+import traceback
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional
+
+from ..exceptions import WorkerDiedError
+from ..resilience import RestartBudget, RetryPolicy, restart_policy
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .process_pool import ProcessPool
+    from .process_worker import ProcessWorker
+
+WATCHDOG_INTERVAL_ENV = "KT_WATCHDOG_INTERVAL_S"
+RESTART_BUDGET_ENV = "KT_RESTART_BUDGET"
+RESTART_WINDOW_ENV = "KT_RESTART_WINDOW_S"
+
+# cgroup OOM-kill counters, v2 then v1. The kernel increments these when the
+# OOM killer fires inside this pod's cgroup — the evidence that turns an
+# anonymous SIGKILL into a typed OOMKilled. KT_OOM_EVENTS_PATH overrides for
+# tests (and for nonstandard cgroup mounts).
+_OOM_EVENT_PATHS = (
+    "/sys/fs/cgroup/memory.events",
+    "/sys/fs/cgroup/memory/memory.oom_control",
+)
+
+# Signals whose default disposition is a core dump: the process crashed on
+# its own (segfault, abort, bus error, FPE, illegal instruction) rather than
+# being killed from outside.
+_CRASH_SIGNALS = frozenset(
+    getattr(signal_mod, name).value
+    for name in ("SIGSEGV", "SIGABRT", "SIGBUS", "SIGFPE", "SIGILL")
+    if hasattr(signal_mod, name))
+
+# The pod-level drain flag: flipped by the server's SIGTERM handler so a
+# rank's SIGTERM death during the drain window classifies as an eviction /
+# preemption rather than an anonymous kill. Module-level because the pool
+# has no path to ServerState (and tests need to flip it without a server).
+_draining = threading.Event()
+
+
+def set_draining(reason: Optional[str] = None) -> None:
+    """Mark the pod as draining (called from the server's SIGTERM path)."""
+    _draining.set()
+
+
+def clear_draining() -> None:
+    _draining.clear()
+
+
+def is_draining() -> bool:
+    return _draining.is_set()
+
+
+def _env_or_cfg(env_key: str, cfg_field: str, default: float,
+                cast: Callable = float):
+    """Env wins over the layered config (the config singleton may predate a
+    runtime env mutation — tests and pods set these on the fly)."""
+    raw = os.environ.get(env_key)
+    if raw is not None:
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            pass
+    try:
+        from ..config import config
+        return cast(config().get(cfg_field, default))
+    except Exception:
+        return default
+
+
+def watchdog_interval() -> float:
+    return max(0.05, _env_or_cfg(WATCHDOG_INTERVAL_ENV,
+                                 "watchdog_interval_s", 0.5))
+
+
+def restart_budget() -> int:
+    return max(0, _env_or_cfg(RESTART_BUDGET_ENV, "restart_budget", 3, int))
+
+
+def restart_window() -> float:
+    return max(1.0, _env_or_cfg(RESTART_WINDOW_ENV, "restart_window_s", 300.0))
+
+
+def read_oom_kill_count() -> Optional[int]:
+    """This cgroup's cumulative ``oom_kill`` counter, or None when no
+    counter is readable (non-Linux, no cgroup controller)."""
+    paths = [os.environ["KT_OOM_EVENTS_PATH"]] \
+        if os.environ.get("KT_OOM_EVENTS_PATH") else list(_OOM_EVENT_PATHS)
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 2 and parts[0] == "oom_kill":
+                        return int(parts[1])
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def _preemption_marker() -> bool:
+    """Same markers the server's SIGTERM classifier uses
+    (``http_server._termination_reason``): spot/maintenance reclaim."""
+    return bool(os.environ.get("KT_PREEMPTIBLE")) or os.path.exists(
+        "/var/run/kubetorch/preemption")
+
+
+def classify_death(exitcode: Optional[int], draining: Optional[bool] = None,
+                   oom_evidence: Optional[bool] = None) -> str:
+    """Map a dead rank's exitcode to a typed cause.
+
+    ``exitcode`` follows ``multiprocessing.Process.exitcode``: negative is
+    the signal number, positive a ``sys.exit`` status. ``draining`` and
+    ``oom_evidence`` default to live lookups so the pure mapping stays
+    testable with explicit values.
+    """
+    if exitcode is None:
+        return "Unknown"
+    if exitcode == 0:
+        return "Exited"
+    if exitcode > 0:
+        return "Crashed"
+    sig = -exitcode
+    if sig == signal_mod.SIGKILL.value:
+        return "OOMKilled" if oom_evidence else "Killed"
+    if sig == signal_mod.SIGTERM.value:
+        if _preemption_marker():
+            return "Preempted"
+        if draining if draining is not None else is_draining():
+            return "Evicted"
+        return "Killed"
+    if sig in _CRASH_SIGNALS:
+        return "Crashed"
+    return "Killed"
+
+
+class Watchdog:
+    """Liveness monitor for one :class:`ProcessPool`.
+
+    Owned and started by the pool; all restarts run on the watchdog thread,
+    so a restart can never race another restart, and workers the watchdog
+    itself replaces are swapped out of ``pool.workers`` before the next
+    poll observes them.
+    """
+
+    def __init__(self, pool: "ProcessPool",
+                 interval_s: Optional[float] = None,
+                 budget: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 backoff: Optional[RetryPolicy] = None):
+        self.pool = pool
+        self.interval_s = interval_s if interval_s is not None \
+            else watchdog_interval()
+        n = budget if budget is not None else restart_budget()
+        self.budget = RestartBudget(
+            n, window_s if window_s is not None else restart_window())
+        self.backoff = backoff or restart_policy(max(n, 1))
+        self._delays = self.backoff.preview_delays(max(n, 1))
+        # hooks: on_death(local_rank, WorkerDiedError) fires before restart;
+        # on_restart() fires after a successful respawn (supervisors clear
+        # death-caused membership events so the healed pool serves again)
+        self.on_death: List[Callable[[int, WorkerDiedError], None]] = []
+        self.on_restart: List[Callable[[], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # keyed by worker object identity; values keep the handle referenced
+        # so a recycled id() can never alias a new worker
+        self._handled: Dict[int, "ProcessWorker"] = {}
+        self.recovering = False
+        self.restarts = 0
+        self.deaths: List[Dict] = []
+        self._failed_fields: Optional[Dict] = None
+        self._oom_baseline = read_oom_kill_count()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kt-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop BEFORE the pool tears workers down, so intentional shutdown
+        exits are never classified as deaths (and never burn the budget)."""
+        self._stop.set()
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=max(5.0, self.interval_s * 2))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_now()
+            except Exception:  # noqa: BLE001 — the watchdog must outlive bugs
+                print("[kt] watchdog check failed:\n" + traceback.format_exc())
+
+    # -- state surfaced to the pool / server --------------------------------
+
+    @property
+    def failed(self) -> bool:
+        """True after budget exhaustion: the pool is permanently down."""
+        return self._failed_fields is not None
+
+    def permanent_error(self) -> Optional[WorkerDiedError]:
+        """A FRESH exception per raise site (a shared instance would
+        accumulate tracebacks across unrelated calls)."""
+        if self._failed_fields is None:
+            return None
+        return WorkerDiedError(**self._failed_fields)
+
+    def death_error(self, idx: int, worker: "ProcessWorker") -> WorkerDiedError:
+        """Typed error for a rank observed dead at submit time."""
+        if self._failed_fields is not None:
+            return self.permanent_error()
+        exitcode = getattr(worker, "exitcode", None)
+        cause = classify_death(exitcode, oom_evidence=self._oom_evidence())
+        return WorkerDiedError(
+            f"Rank subprocess {idx} is dead (cause={cause}, "
+            f"exitcode={exitcode})", cause=cause, rank=idx, exitcode=exitcode)
+
+    def state_dict(self) -> Dict:
+        """Restart state for ``/health`` (and operators' eyeballs)."""
+        out = {"restarts": self.restarts, "recovering": self.recovering,
+               "interval_s": self.interval_s, **self.budget.state()}
+        if self._failed_fields is not None:
+            out["permanent_failure"] = dict(self._failed_fields)
+        if self.deaths:
+            out["recent_deaths"] = self.deaths[-5:]
+        return out
+
+    # -- the check ----------------------------------------------------------
+
+    def _oom_evidence(self) -> bool:
+        current = read_oom_kill_count()
+        if current is None:
+            return False
+        baseline = self._oom_baseline or 0
+        return current > baseline
+
+    def check_now(self) -> None:
+        """One poll pass; called from the monitor thread (and synchronously
+        from tests)."""
+        pool = self.pool
+        if self._stop.is_set() or pool._stopping.is_set():
+            return
+        newly_dead: List[int] = []
+        last_exc: Optional[WorkerDiedError] = None
+        for idx, worker in enumerate(list(pool.workers)):
+            if worker.alive or id(worker) in self._handled:
+                continue
+            self._handled[id(worker)] = worker
+            exc = self.death_error(idx, worker)
+            newly_dead.append(idx)
+            last_exc = exc
+            self.deaths.append({"rank": idx, "cause": exc.cause,
+                                "exitcode": exc.exitcode, "at": time.time()})
+            print(f"[kt] watchdog: rank {idx} died "
+                  f"(cause={exc.cause}, exitcode={exc.exitcode})")
+            # fail-fast: the dead rank's in-flight futures resolve NOW,
+            # bounded by the watchdog interval — not the call timeout
+            pool.fail_worker_futures(idx, exc)
+            for hook in list(self.on_death):
+                try:
+                    hook(idx, exc)
+                except Exception:  # noqa: BLE001
+                    print("[kt] watchdog on_death hook failed:\n"
+                          + traceback.format_exc())
+        if newly_dead and not pool._stopping.is_set():
+            self._maybe_restart(newly_dead, last_exc)
+
+    # -- restart policy ------------------------------------------------------
+
+    def _maybe_restart(self, dead_idxs: List[int],
+                       exc: WorkerDiedError) -> None:
+        if self.failed:
+            return
+        self.recovering = True
+        try:
+            if not self.budget.try_acquire():
+                self._failed_fields = {
+                    "message": (
+                        f"rank pool permanently failed: restart budget "
+                        f"exhausted ({self.budget.budget} restarts / "
+                        f"{self.budget.window_s:g}s window); last death: "
+                        f"rank {exc.rank} cause={exc.cause}"),
+                    "cause": exc.cause, "rank": exc.rank,
+                    "exitcode": exc.exitcode}
+                print(f"[kt] watchdog: {self._failed_fields['message']}")
+                # strand no waiter: whatever is still in flight on live
+                # ranks fails typed too — the pool will never answer
+                self.pool.cancel_pending(self.permanent_error())
+                return
+            delay = self._delays[min(self.restarts, len(self._delays) - 1)]
+            if delay > 0 and self._stop.wait(delay):
+                return          # pool shut down while we backed off
+            from .env_contract import framework_for
+            fw = framework_for(self.pool.framework_name)
+            if fw.per_call_identity:
+                # collective identity binds per call: the dead rank alone
+                # respawns, live ranks keep serving
+                for idx in dead_idxs:
+                    self.pool.restart_worker(idx)
+            else:
+                # spawn-fixed identity (JAX/TPU mesh): a compiled mesh
+                # cannot mix old and new ranks — the whole pool restarts
+                self.pool.restart_all(exc)
+            self.restarts += 1
+            print(f"[kt] watchdog: pool restarted "
+                  f"({'ranks ' + str(dead_idxs) if fw.per_call_identity else 'full pool'}; "
+                  f"restart {self.restarts}, "
+                  f"{self.budget.remaining} left in window)")
+            for hook in list(self.on_restart):
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001
+                    print("[kt] watchdog on_restart hook failed:\n"
+                          + traceback.format_exc())
+        finally:
+            self.recovering = False

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Lint: no new raw ``requests`` call sites may bypass the resilience layer.
+"""Lint: no new raw ``requests`` call sites may bypass the resilience layer,
+and no new raw ``worker.alive`` checks may bypass the liveness watchdog.
 
 Every HTTP call in ``kubetorch_tpu/`` is supposed to ride one of the three
 resilient choke points (``netpool.request``, ``HTTPClient.call_method``'s
@@ -14,6 +15,14 @@ health probes, best-effort telemetry pumps, and the resilient wrappers'
 own internals). Adding a site fails the build until you either route it
 through the resilience layer or — for genuinely best-effort one-shot
 probes — bump the baseline here WITH a justification comment.
+
+The second check (ISSUE 3) guards the worker-liveness discipline the same
+way: a raw ``.alive`` poll in ``kubetorch_tpu/serving/`` outside
+``watchdog.py`` is a point-in-time check — it tells you a rank was alive at
+submit, not that its death will ever be *noticed*. Death detection,
+classification, fail-fast future resolution, and restart policy all belong
+to the watchdog; the baseline below enumerates the deliberate exceptions
+(shutdown join loops and health aggregation in ``process_pool.py``).
 
 Run: ``python scripts/check_resilience.py`` (wired into ``make lint``).
 """
@@ -63,6 +72,27 @@ BASELINE = {
     "serving/remote_worker_pool.py": 2,
 }
 
+# Raw worker-liveness checks (``.alive``) in serving/ outside the watchdog
+# module. watchdog.py itself is exempt (it IS the liveness layer); the pool
+# keeps exactly these deliberate sites: the dead-router exit check, the
+# restart/shutdown join loops + warmup-grace gating, and the healthy/warming
+# aggregate properties. Anything new must go through the watchdog.
+ALIVE_RE = re.compile(r"\.alive\b")
+ALIVE_EXEMPT = {"watchdog.py"}
+ALIVE_BASELINE = {
+    "serving/process_pool.py": 8,
+}
+
+
+def _count_matches(path: Path, pattern: re.Pattern) -> int:
+    n = 0
+    for line in path.read_text().splitlines():
+        if line.strip().startswith("#"):
+            continue
+        if pattern.search(line):
+            n += 1
+    return n
+
 
 def main() -> int:
     failures = []
@@ -71,13 +101,7 @@ def main() -> int:
         if path.name in WRAPPER_FILES:
             continue
         rel = str(path.relative_to(PKG))
-        n = 0
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            stripped = line.strip()
-            if stripped.startswith("#"):
-                continue
-            if CALL_RE.search(line):
-                n += 1
+        n = _count_matches(path, CALL_RE)
         if n:
             counts[rel] = n
         allowed = BASELINE.get(rel, 0)
@@ -93,14 +117,42 @@ def main() -> int:
               "single-shot probes) update the baseline in "
               "scripts/check_resilience.py with a justification.")
         return 1
-    # also flag stale baseline entries so the allowlist shrinks over time
-    stale = [f for f, allowed in BASELINE.items()
-             if counts.get(f, 0) < allowed]
+
+    alive_failures = []
+    alive_counts = {}
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        if path.name in ALIVE_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, ALIVE_RE)
+        if n:
+            alive_counts[rel] = n
+        allowed = ALIVE_BASELINE.get(rel, 0)
+        if n > allowed:
+            alive_failures.append(
+                f"  {rel}: {n} raw worker-liveness check(s), baseline "
+                f"allows {allowed}")
+    if alive_failures:
+        print("check_resilience: raw worker.alive checks bypass the "
+              "liveness watchdog:\n" + "\n".join(alive_failures))
+        print("\nLiveness detection/classification/restart belongs to "
+              "serving/watchdog.py (death_error / fail_worker_futures); a "
+              "point-in-time .alive poll cannot notice a mid-call death. "
+              "For deliberate shutdown/aggregation sites update "
+              "ALIVE_BASELINE with a justification.")
+        return 1
+
+    # also flag stale baseline entries so the allowlists shrink over time
+    stale = sorted(
+        [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
+        + [f for f, allowed in ALIVE_BASELINE.items()
+           if alive_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
-              + ", ".join(sorted(stale)) + ")")
+              + ", ".join(stale) + ")")
     else:
-        print("check_resilience: OK — all HTTP call sites accounted for")
+        print("check_resilience: OK — all HTTP call sites and worker-"
+              "liveness checks accounted for")
     return 0
 
 

@@ -24,6 +24,9 @@ _ATTR_SAMPLES = {
     "worker": "10.0.0.7",
     "deadline": 1722787200.25,
     "retry_after": 2.5,
+    "cause": "OOMKilled",
+    "rank": 2,
+    "exitcode": -9,
 }
 
 

@@ -1,0 +1,433 @@
+"""Worker liveness watchdog (ISSUE 3): a rank subprocess dying mid-call
+surfaces as a typed ``WorkerDiedError`` bounded by the watchdog interval —
+not the call timeout, and not a hang with ``timeout=None`` — the pool
+self-heals within a sliding-window restart budget, and budget exhaustion is
+a permanent typed failure that keeps ``/ready`` down.
+
+Process-level deaths are injected deterministically with the chaos verb
+``kill-rank:<sig>@<op-index>`` (the rank kills itself at a chosen call
+index), so detection latency and restart cadence are assertable without
+racing a real preemption.
+"""
+
+import asyncio
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+pytestmark = pytest.mark.level("minimal")
+
+from kubetorch_tpu.chaos import ChaosEngine, parse_spec, rank_kill_plan
+from kubetorch_tpu.exceptions import (WorkerDiedError, package_exception,
+                                      rehydrate_exception)
+from kubetorch_tpu.resilience import RestartBudget
+from kubetorch_tpu.resources.pointers import Pointers
+from kubetorch_tpu.serving import watchdog as wd
+from kubetorch_tpu.serving.process_pool import ProcessPool
+from tests.assets.threaded_server import ThreadedAiohttpServer
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+def _pointers(fn="sleeper"):
+    return Pointers(project_root=ASSETS, module_name="payloads",
+                    file_path="payloads.py", cls_or_fn_name=fn)
+
+
+def _make_pool(monkeypatch, chaos, num_procs=1, framework="spmd",
+               interval="0.25", budget="3", window="300"):
+    monkeypatch.setenv("KT_CHAOS", chaos)
+    monkeypatch.setenv("KT_WATCHDOG_INTERVAL_S", interval)
+    monkeypatch.setenv("KT_RESTART_BUDGET", budget)
+    monkeypatch.setenv("KT_RESTART_WINDOW_S", window)
+    # near-zero respawn backoff: these tests assert detection latency, not
+    # backoff pacing
+    monkeypatch.setenv("KT_RESTART_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_MAX_S", "0.01")
+    return ProcessPool(num_procs, framework, _pointers(), None)
+
+
+def _wait_until(predicate, timeout=45.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Death classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_death_taxonomy():
+    assert wd.classify_death(None) == "Unknown"
+    assert wd.classify_death(0) == "Exited"
+    assert wd.classify_death(3) == "Crashed"
+    assert wd.classify_death(-11) == "Crashed"   # SIGSEGV
+    assert wd.classify_death(-6) == "Crashed"    # SIGABRT
+    assert wd.classify_death(-9) == "Killed"
+    assert wd.classify_death(-9, oom_evidence=True) == "OOMKilled"
+    assert wd.classify_death(-15, draining=True) == "Evicted"
+    assert wd.classify_death(-15, draining=False) == "Killed"
+
+
+def test_classify_sigterm_uses_drain_flag_and_preemption_marker(monkeypatch):
+    wd.set_draining()
+    try:
+        assert wd.classify_death(-15) == "Evicted"
+        # a preemption marker outranks plain eviction (GKE spot reclaim)
+        monkeypatch.setenv("KT_PREEMPTIBLE", "1")
+        assert wd.classify_death(-15) == "Preempted"
+    finally:
+        wd.clear_draining()
+
+
+def test_oom_evidence_from_cgroup_counter(tmp_path, monkeypatch):
+    events = tmp_path / "memory.events"
+    events.write_text("low 0\nhigh 4\noom 3\noom_kill 2\n")
+    monkeypatch.setenv("KT_OOM_EVENTS_PATH", str(events))
+    assert wd.read_oom_kill_count() == 2
+    # baseline snapshotted at watchdog construction; a later increment is
+    # the evidence that a SIGKILL was the kernel's OOM killer
+    pool = ProcessPool(1, "spmd", None, None)
+    events.write_text("low 0\nhigh 4\noom 5\noom_kill 3\n")
+    fake = SimpleNamespace(exitcode=-9)
+    err = pool.watchdog.death_error(0, fake)
+    assert err.cause == "OOMKilled" and err.rank == 0 and err.exitcode == -9
+
+
+def test_oom_counter_absent_is_none(monkeypatch):
+    monkeypatch.setenv("KT_OOM_EVENTS_PATH", "/nonexistent/memory.events")
+    assert wd.read_oom_kill_count() is None
+
+
+def test_worker_died_error_rehydrates():
+    out = rehydrate_exception(package_exception(WorkerDiedError(
+        "rank 2 gone", cause="Preempted", rank=2, exitcode=-15)))
+    assert isinstance(out, WorkerDiedError)
+    assert out.cause == "Preempted" and out.preempted
+    assert out.rank == 2 and out.exitcode == -15
+
+
+# ---------------------------------------------------------------------------
+# Restart budget (sliding window)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_budget_window_regenerates():
+    now = [0.0]
+    b = RestartBudget(2, window_s=10.0, clock=lambda: now[0])
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()          # exhausted inside the window
+    now[0] = 11.0                        # first acquisition ages out
+    assert b.remaining == 2
+    assert b.try_acquire()
+    assert b.state()["used"] == 1
+
+
+def test_restart_budget_zero_disables_self_heal():
+    b = RestartBudget(0, window_s=10.0)
+    assert not b.try_acquire()
+
+
+# ---------------------------------------------------------------------------
+# kill-rank chaos verb
+# ---------------------------------------------------------------------------
+
+
+def test_kill_rank_parse_and_plan():
+    faults = parse_spec("kill-rank:9@2,kill-rank:SEGV@5,kill-rank")
+    kinds = [(f.kind, f.signal_no, f.op_index) for f in faults]
+    assert kinds == [("kill-rank", 9, 2), ("kill-rank", 11, 5),
+                     ("kill-rank", 9, 0)]
+    assert rank_kill_plan("kill-rank:KILL@1,503,reset") == {1: 9}
+    assert rank_kill_plan("reset,503") == {}
+    assert rank_kill_plan("") == {}
+
+
+def test_kill_rank_invisible_to_http_engine():
+    """kill-rank is process-level: the HTTP middleware schedule must skip
+    it entirely — only the 503 remains."""
+    engine = ChaosEngine(parse_spec("kill-rank:9@0,503"))
+    assert len(engine.schedule) == 1 and engine.schedule[0].kind == "status"
+
+
+def test_malformed_kill_rank_plan_is_empty_not_fatal():
+    # a typo in the worker env must not become a spawn-time crash loop
+    assert rank_kill_plan("kill-rank:NOTASIG@x") == {}
+
+
+# ---------------------------------------------------------------------------
+# Satellite fixes: submit race + cancel_pending without a loop
+# ---------------------------------------------------------------------------
+
+
+class _RacyWorker:
+    """Claims to be alive, then fails the queue put — the race where the
+    rank dies between the liveness check and worker.submit()."""
+
+    alive = True
+    in_warmup = False
+    exitcode = -9
+
+    def submit(self, req):
+        raise OSError("handle is closed")
+
+    def force_kill_if_alive(self):
+        pass
+
+
+def test_submit_race_raises_typed_and_pops_future():
+    pool = ProcessPool(1, "spmd", None, None)
+    pool.workers[0] = _RacyWorker()
+
+    async def go():
+        with pytest.raises(WorkerDiedError) as ei:
+            await pool._submit(0, {"method": None, "args": [], "kwargs": {}},
+                               None)
+        return ei.value
+
+    err = asyncio.run(go())
+    assert err.rank == 0 and err.cause == "Killed"
+    assert isinstance(err.__cause__, OSError)
+    assert pool._futures == {}          # the registered future must not leak
+
+
+def test_submit_to_dead_worker_raises_typed():
+    pool = ProcessPool(1, "spmd", None, None)
+    pool.workers[0] = SimpleNamespace(alive=False, exitcode=-11,
+                                      in_warmup=False)
+
+    async def go():
+        with pytest.raises(WorkerDiedError) as ei:
+            await pool._submit(0, {"method": None, "args": [], "kwargs": {}},
+                               None)
+        return ei.value
+
+    assert asyncio.run(go()).cause == "Crashed"
+
+
+def test_cancel_pending_without_loop_fails_futures_synchronously():
+    """A pool that never served a call has ``_loop is None`` — shutdown must
+    still fail registered futures instead of silently dropping them."""
+    pool = ProcessPool(1, "spmd", None, None)
+    loop = asyncio.new_event_loop()
+    try:
+        fut = loop.create_future()
+        pool._futures["r0"] = (fut, 0)
+        assert pool._loop is None
+        pool.cancel_pending(RuntimeError("pool shutting down"))
+        assert fut.done()
+        assert isinstance(fut.exception(), RuntimeError)
+        assert pool._futures == {}
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# The hang regression + self-heal (chaos acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_rank_killed_mid_call_raises_typed_promptly_and_pool_self_heals(
+        monkeypatch):
+    """THE acceptance scenario: a rank SIGKILLed mid-call with
+    ``timeout=None`` raises ``WorkerDiedError`` (correct cause + rank) in
+    under 2× the watchdog interval — previously this hung forever — then the
+    pool auto-restarts within budget and the next call succeeds."""
+    interval = 0.5
+    pool = _make_pool(monkeypatch, "kill-rank:9@1", interval=str(interval))
+    pool.start()
+    try:
+        async def go():
+            assert await pool.call(0, None, [0.01], {}) == 0.01  # op 0: fine
+            t0 = time.monotonic()
+            with pytest.raises(WorkerDiedError) as ei:
+                # op 1: SIGKILL lands mid-call; timeout=None means only the
+                # watchdog can end this await
+                await pool.call(0, None, [60], {}, timeout=None)
+            detect = time.monotonic() - t0
+            assert detect < 2 * interval, \
+                f"death surfaced in {detect:.2f}s, want < {2 * interval}s"
+            err = ei.value
+            assert err.cause == "Killed" and err.rank == 0
+            assert err.exitcode == -9
+
+            # self-heal: watchdog respawns the rank within budget...
+            assert _wait_until(
+                lambda: pool.healthy and not pool.recovering), \
+                "pool never healed"
+            assert pool.watchdog.restarts == 1
+            # ...and the next call succeeds (fresh worker: op index reset)
+            assert await pool.call(0, None, [0.02], {}) == 0.02
+
+        asyncio.run(go())
+        # router hygiene: the dead worker's router thread must exit once its
+        # queue drains — exactly one live router per live worker remains
+        assert _wait_until(
+            lambda: sum(t.is_alive() for t in pool._router_threads)
+            == pool.num_procs, timeout=10), "dead worker's router still spinning"
+        state = pool.watchdog.state_dict()
+        assert state["restarts"] == 1 and not state["recovering"]
+        assert state["recent_deaths"][-1]["cause"] == "Killed"
+    finally:
+        pool.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_restart_budget_exhaustion_is_permanent_typed_failure(monkeypatch):
+    """Crash-looping rank (killed at op 0, every spawn): one budgeted
+    restart happens, the second death exhausts the budget, and the pool
+    fails permanently — healthy stays False and every later submit raises
+    the typed budget-exhaustion error immediately."""
+    pool = _make_pool(monkeypatch, "kill-rank:9@0", budget="1")
+    pool.start()
+    try:
+        async def go():
+            with pytest.raises(WorkerDiedError):
+                await pool.call(0, None, [30], {}, timeout=None)
+            # restart #1 consumes the whole budget; the respawned rank dies
+            # again at its op 0 only once something is submitted — the
+            # watchdog restarts it, we resubmit, it dies, budget exhausted
+            assert _wait_until(lambda: pool.healthy and not pool.recovering)
+            with pytest.raises(WorkerDiedError):
+                await pool.call(0, None, [30], {}, timeout=None)
+            assert _wait_until(lambda: pool.watchdog.failed), \
+                "budget exhaustion never flagged"
+            assert not pool.healthy
+            with pytest.raises(WorkerDiedError) as ei:
+                await pool.call(0, None, [0.01], {})
+            assert "restart budget exhausted" in str(ei.value)
+            assert ei.value.cause == "Killed"
+
+        asyncio.run(go())
+        assert "permanent_failure" in pool.watchdog.state_dict()
+    finally:
+        pool.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fixed_identity_framework_restarts_full_pool(monkeypatch):
+    """JAX/TPU mesh identity is fixed at spawn: one rank dying must respawn
+    EVERY rank together (a compiled mesh cannot mix old and new processes),
+    per env_contract.per_call_identity."""
+    pool = _make_pool(monkeypatch, "kill-rank:9@1", num_procs=2,
+                      framework="jax")
+    pool.start()
+    try:
+        async def go():
+            await pool.call_all(None, [0.01], {})        # op 0 both ranks
+            pids_before = [w.process.pid for w in pool.workers]
+            with pytest.raises((WorkerDiedError, Exception)):
+                await pool.call_all(None, [30], {}, timeout=None)
+            assert _wait_until(lambda: pool.healthy and not pool.recovering)
+            pids_after = [w.process.pid for w in pool.workers]
+            # full-pool restart: no old pid survives
+            assert not set(pids_before) & set(pids_after)
+            assert await pool.call_all(None, [0.02], {}) == [0.02, 0.02]
+
+        asyncio.run(go())
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /ready and /health during recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def bare_server_env(monkeypatch):
+    for key in ("KT_CLS_OR_FN_NAME", "KT_MODULE_NAME", "KT_FILE_PATH",
+                "KT_DISTRIBUTED_CONFIG", "KT_CHAOS", "POD_IP"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.setenv("KT_LAUNCH_ID", "wd-1")
+
+
+def _app():
+    from kubetorch_tpu.serving.http_server import create_app
+    return create_app()
+
+
+def test_ready_flaps_during_recovery_and_health_reports_restarts(
+        bare_server_env):
+    """/ready must be 503 exactly while the watchdog is respawning ranks
+    (and forever after permanent failure); /health carries the watchdog's
+    restart state."""
+    with ThreadedAiohttpServer(_app) as srv:
+        state = srv.app["state"]
+        stub = SimpleNamespace(
+            healthy=True, warming=False, recovering=False, pointers=None,
+            restart_state=lambda: {"restarts": 1, "recovering": False,
+                                   "budget": 3, "remaining": 2})
+        state.supervisor = stub
+
+        assert requests.get(f"{srv.url}/ready", timeout=10).status_code == 200
+
+        stub.recovering = True          # watchdog mid-respawn
+        r = requests.get(f"{srv.url}/ready", timeout=10)
+        assert r.status_code == 503 and r.json()["recovering"] is True
+
+        stub.recovering = False         # healed: back in the endpoint pool
+        assert requests.get(f"{srv.url}/ready", timeout=10).status_code == 200
+
+        stub.healthy = False            # permanent failure: down for good
+        assert requests.get(f"{srv.url}/ready", timeout=10).status_code == 503
+
+        health = requests.get(f"{srv.url}/health", timeout=10).json()
+        assert health["workers"]["restarts"] == 1
+        assert health["workers"]["remaining"] == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_http_end_to_end_kill_recover_ready(monkeypatch):
+    """Full-stack acceptance: through the pod server, a mid-call rank kill
+    returns a typed 503 WorkerDiedError, /ready goes down during recovery,
+    comes back once healed, and the next call succeeds."""
+    monkeypatch.setenv("KT_PROJECT_ROOT", ASSETS)
+    monkeypatch.setenv("KT_MODULE_NAME", "payloads")
+    monkeypatch.setenv("KT_FILE_PATH", "payloads.py")
+    monkeypatch.setenv("KT_CLS_OR_FN_NAME", "sleeper")
+    monkeypatch.setenv("KT_LAUNCH_ID", "wd-e2e")
+    monkeypatch.delenv("KT_DISTRIBUTED_CONFIG", raising=False)
+    monkeypatch.delenv("POD_IP", raising=False)
+    monkeypatch.setenv("KT_CHAOS", "kill-rank:9@1")
+    monkeypatch.setenv("KT_WATCHDOG_INTERVAL_S", "0.25")
+    monkeypatch.setenv("KT_RESTART_BUDGET", "3")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_BASE_S", "0.01")
+    with ThreadedAiohttpServer(_app) as srv:
+        r = requests.post(f"{srv.url}/sleeper",
+                          json={"args": [0.01], "kwargs": {}}, timeout=60)
+        assert r.status_code == 200, r.text
+
+        r = requests.post(f"{srv.url}/sleeper",
+                          json={"args": [60], "kwargs": {}}, timeout=30)
+        assert r.status_code == 503, r.text
+        err = r.json()
+        assert err["error_type"] == "WorkerDiedError", err
+        assert err["attrs"]["cause"] == "Killed"
+        assert err["attrs"]["exitcode"] == -9
+
+        # the kill just landed: the pod must not report ready mid-recovery
+        assert requests.get(f"{srv.url}/ready",
+                            timeout=10).status_code == 503
+
+        def ready():
+            return requests.get(f"{srv.url}/ready",
+                                timeout=10).status_code == 200
+        assert _wait_until(ready), "/ready never came back after self-heal"
+
+        r = requests.post(f"{srv.url}/sleeper",
+                          json={"args": [0.02], "kwargs": {}}, timeout=60)
+        assert r.status_code == 200, r.text
+        health = requests.get(f"{srv.url}/health", timeout=10).json()
+        assert health["workers"]["restarts"] >= 1

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos lint bench bench-store smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos lint bench bench-store smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -22,6 +22,11 @@ test-fast:
 # fixed seed — kept out of the tier-1 default path (see docs/resilience.md)
 test-chaos:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m chaos
+
+# store crash/corruption suite (ISSUE 4): torn-write SIGKILL mid-PUT,
+# corrupt-blob → scrub quarantine, disk-full → typed 507, startup recovery
+test-store-chaos:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_store_chaos.py -q
 
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:

@@ -33,6 +33,8 @@ from .exceptions import (  # noqa: F401
     SyncError,
     SerializationError,
     DataStoreError,
+    StoreFullError,
+    DataCorruptionError,
     DebuggerError,
     DeadlineExceededError,
     CircuitOpenError,

@@ -14,6 +14,9 @@ once, and the backoff sequence matches the policy".
              | delay:SECONDS
              | STATUS | STATUS:RETRY_AFTER      (e.g. 503 or 503:0.2)
              | oom | evict | preempt
+             | disk-full                        (507 + typed StoreFullError)
+             | corrupt-blob                     (store-state; see below)
+             | torn-write[:BYTES]               (store-state; see below)
              | kill-rank:SIG@OP_INDEX           (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
@@ -40,6 +43,21 @@ Fault kinds:
 - ``evict`` / ``preempt``  503 with a packaged ``PodTerminatedError``
   (reason Evicted / Preempted) — the pod-termination taxonomy, injectable
 - ``pass``      explicitly no fault (spaces out a schedule)
+- ``disk-full`` short-circuit 507 with a packaged ``StoreFullError`` — the
+  deterministic stand-in for ENOSPC mid-write (clients must treat it as
+  non-retryable and surface the typed error)
+- ``corrupt-blob``  **store-state** fault (store server only): before the
+  handler runs, flip one byte of the on-disk file behind the request's
+  ``/blob/..`` or ``/kv/..`` path, then handle normally — the response
+  carries the corrupt bytes AND the rot persists on disk, so both the
+  client-side hash verification and the scrubber's quarantine are provable
+  from one injected fault. No-op on servers without a ``store`` app key.
+- ``torn-write[:BYTES]``  **store-state, process-fatal** fault (subprocess
+  stores only): accept BYTES (default 4096) of the PUT body into the
+  handler's ``.tmp`` staging path, then SIGKILL the whole process — the
+  deterministic "node died mid-upload" case startup recovery must clean.
+  Never use against an in-process test server: the kill takes the test
+  runner with it.
 - ``kill-rank:SIG@N``  **process-level** fault: the rank subprocess kills
   itself with signal SIG (number or name: ``9``/``KILL``/``SEGV``/``TERM``)
   when it receives its N-th call op (0-based) — a deterministic stand-in
@@ -64,7 +82,8 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from .exceptions import (ControllerRequestError, HbmOomError,
-                         PodTerminatedError, package_exception)
+                         PodTerminatedError, StoreFullError,
+                         package_exception)
 
 CHAOS_ENV = "KT_CHAOS"
 CHAOS_SEED_ENV = "KT_CHAOS_SEED"
@@ -74,7 +93,7 @@ CHAOS_SEED_ENV = "KT_CHAOS_SEED"
 EXEMPT_PATHS = ("/health", "/ready", "/metrics")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
-          "pass", "kill-rank")
+          "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank")
 
 
 @dataclass
@@ -87,8 +106,17 @@ class Fault:
     prob: Optional[float] = None       # None → deterministic schedule token
     signal_no: int = 9                 # kill-rank: signal to self-deliver
     op_index: int = 0                  # kill-rank: 0-based call-op index
+    torn_bytes: int = 4096             # torn-write: body bytes staged pre-kill
 
-    def matches(self, path: str) -> bool:
+    def matches(self, path: str, method: Optional[str] = None) -> bool:
+        # the store-state verbs are method-shaped: corrupt-blob rots a file
+        # that must already exist (so it fires on reads, not the PUT that
+        # creates it), torn-write tears an in-flight upload (writes only)
+        if method is not None:
+            if self.kind == "corrupt-blob" and method not in ("GET", "HEAD"):
+                return False
+            if self.kind == "torn-write" and method not in ("PUT", "POST"):
+                return False
         if self.path is not None:
             return path.startswith(self.path)
         return not path.startswith(EXEMPT_PATHS)
@@ -158,6 +186,16 @@ def _parse_one(token: str, raw: str) -> Fault:
             return Fault(kind="delay", seconds=float(arg))
         except ValueError:
             raise ChaosError(f"bad delay in {raw!r}")
+    if head == "torn-write":
+        fault = Fault(kind="torn-write")
+        if arg:
+            try:
+                fault.torn_bytes = max(0, int(arg))
+            except ValueError:
+                raise ChaosError(f"bad torn-write byte count in {raw!r}")
+        return fault
+    if head in ("disk-full", "corrupt-blob"):
+        return Fault(kind=head)
     if head.isdigit():
         fault = Fault(kind="status", status=int(head))
         if arg:
@@ -201,18 +239,19 @@ class ChaosEngine:
             pass
         return cls(parse_spec(spec), seed=seed)
 
-    def next_fault(self, path: str) -> Optional[Fault]:
+    def next_fault(self, path: str,
+                   method: Optional[str] = None) -> Optional[Fault]:
         with self._lock:
             self.requests_seen += 1
             for i, fault in enumerate(self.schedule):
-                if fault.matches(path):
+                if fault.matches(path, method):
                     del self.schedule[i]
                     if fault.kind == "pass":
                         return None
                     self.injected += 1
                     return fault
             for fault in self.persistent:
-                if fault.matches(path) and \
+                if fault.matches(path, method) and \
                         self._rng.random() < (fault.prob or 0.0):
                     if fault.kind == "pass":
                         return None
@@ -238,20 +277,82 @@ def rank_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
     return {f.op_index: f.signal_no for f in faults if f.kind == "kill-rank"}
 
 
+def _store_target(request):
+    """On-disk file behind this request, when the app is a store server
+    (``request.app["store"]`` duck-types ``path_for_request``). None on
+    non-store apps — the store-state verbs no-op there."""
+    store = request.app.get("store")
+    resolve = getattr(store, "path_for_request", None)
+    if resolve is None:
+        return None
+    try:
+        return resolve(request.path)
+    except Exception:
+        return None
+
+
+def _flip_byte_on_disk(path) -> bool:
+    """Single-byte rot, in place: the minimal corruption every integrity
+    layer (client hash verify, startup recovery, scrubber) must catch."""
+    try:
+        with open(path, "r+b") as f:
+            b = f.read(1)
+            if not b:
+                return False
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return True
+    except OSError:
+        return False
+
+
 def chaos_middleware(engine: ChaosEngine):
     """aiohttp middleware applying ``engine``'s schedule. Faults fire before
     the route handler, so an injected fault proves the handler did NOT run
-    for that attempt."""
+    for that attempt (``corrupt-blob`` is the exception: it mutates stored
+    state, then lets the handler serve the rotten bytes)."""
+    import os as _os
+    import signal as _signal
+
     from aiohttp import web
 
     @web.middleware
     async def middleware(request: web.Request, handler):
-        fault = engine.next_fault(request.path)
+        fault = engine.next_fault(request.path, request.method)
         if fault is None:
             return await handler(request)
         if fault.kind == "delay":
             await asyncio.sleep(fault.seconds)
             return await handler(request)
+        if fault.kind == "corrupt-blob":
+            target = _store_target(request)
+            if target is not None and target.is_file():
+                _flip_byte_on_disk(target)
+            return await handler(request)
+        if fault.kind == "torn-write":
+            target = _store_target(request)
+            if target is not None:
+                # stage a partial body exactly where the handler would,
+                # then die: the classic killed-mid-upload orphan recovery
+                # must sweep. SIGKILL is deliberate — no atexit, no flush.
+                target.parent.mkdir(parents=True, exist_ok=True)
+                tmp = target.with_name(f"{target.name}.chaos-torn.tmp")
+                try:
+                    with tmp.open("wb") as f:
+                        read = 0
+                        async for chunk in request.content.iter_chunked(1 << 16):
+                            f.write(chunk)
+                            read += len(chunk)
+                            if read >= fault.torn_bytes:
+                                break
+                except OSError:
+                    pass
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+        if fault.kind == "disk-full":
+            return web.json_response(
+                package_exception(StoreFullError(
+                    "chaos: injected ENOSPC (disk full)")),
+                status=507)
         if fault.kind == "reset":
             if request.transport is not None:
                 request.transport.close()

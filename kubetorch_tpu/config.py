@@ -58,6 +58,17 @@ class KTConfig:
     watchdog_interval_s: float = 0.5
     restart_budget: int = 3
     restart_window_s: float = 300.0
+    # crash-consistent data store (data_store/durability.py + scrub.py).
+    # Same env layering (KT_STORE_FSYNC / KT_SCRUB_INTERVAL_S /
+    # KT_SCRUB_RATE_MBPS / KT_PEER_TTL_S / KT_GC_GRACE_S); store_fsync=False
+    # trades crash safety for write latency (CI/bench roots only),
+    # scrub_interval_s<=0 disables the background sweep (POST /scrub/run
+    # still works).
+    store_fsync: bool = True
+    scrub_interval_s: float = 300.0
+    scrub_rate_mbps: float = 64.0
+    peer_ttl_s: float = 3600.0
+    gc_grace_s: float = 3600.0
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

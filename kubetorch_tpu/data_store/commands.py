@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional
 import requests as _requests
 
 from ..config import config
-from ..exceptions import DataStoreError
+from ..exceptions import DataCorruptionError, DataStoreError
 from . import netpool
 from .types import BroadcastWindow
 
@@ -178,6 +178,32 @@ def _leaf_hash(host) -> str:
     return hashlib.blake2b(_leaf_buffer(host), digest_size=20).hexdigest()
 
 
+def _response_meta(r) -> Dict:
+    try:
+        return json.loads(r.headers.get("X-KT-Meta", "{}"))
+    except ValueError:
+        return {}
+
+
+def _verify_content(content: bytes, meta: Dict, expect_hash: Optional[str],
+                    key: str, source: str) -> None:
+    """End-to-end integrity check on fetched bytes. The content address is
+    free — the index records each leaf's blake2b and every kv meta carries
+    the hash the server verified at PUT — so a GET that hashes differently
+    is corruption somewhere between the store's disk and us. Raises
+    :class:`DataCorruptionError`; callers repair (evict cache entry / evict
+    peer via ``/route/failed``) or surface the typed error."""
+    want = expect_hash or (meta or {}).get("blake2b")
+    if not want:
+        return                       # pre-hash key: unverifiable
+    actual = hashlib.blake2b(content, digest_size=20).hexdigest()
+    if actual != want:
+        raise DataCorruptionError(
+            f"content hash mismatch fetching {key!r} from {source}: "
+            f"expected {want}, got {actual}",
+            key=key, expected=want, actual=actual, source=source)
+
+
 def _put_pytree(url: str, key: str, tree: Any) -> Dict:
     import numpy as np
 
@@ -272,11 +298,11 @@ def _kv_put(url: str, key: str, data, meta: Dict,
     # resilient wrapper can retry a transient failure safely — the PUT is
     # content-addressed (X-KT-Meta carries the blake2b) and idempotent.
     if sess is not None:
-        r = sess.put(f"{url}/kv/{key}", data=data,
+        r = sess.put(f"{url}/kv/{netpool.urlkey(key)}", data=data,
                      headers={"X-KT-Meta": json.dumps(meta)},
                      timeout=netpool.store_timeout())
     else:
-        r = netpool.request("PUT", f"{url}/kv/{key}", data=data,
+        r = netpool.request("PUT", f"{url}/kv/{netpool.urlkey(key)}", data=data,
                             headers={"X-KT-Meta": json.dumps(meta)},
                             timeout=netpool.store_timeout())
     if r.status_code != 200:
@@ -345,7 +371,8 @@ class _RoutedFetcher:
         the reference's MDS lookup): decides the key's kind without pulling
         bulk bytes or touching peer wait windows."""
         try:
-            r = self._store_request("HEAD", f"{self.store_url}/kv/{subkey}",
+            r = self._store_request("HEAD",
+                                    f"{self.store_url}/kv/{netpool.urlkey(subkey)}",
                                     timeout=netpool.store_timeout(30))
             return r.status_code == 200
         except _requests.RequestException:
@@ -391,10 +418,21 @@ class _RoutedFetcher:
             except _requests.RequestException:
                 self.peer_url = None
 
-    def fetch(self, subkey: str, timeout: Optional[float] = None):
+    def fetch(self, subkey: str, timeout: Optional[float] = None,
+              expect_hash: Optional[str] = None):
         """GET one subkey; returns the response (store-shaped: 200 + body +
         X-KT-Meta). Order: pod-local cache (another rank worker may already
         hold it — zero network), then the assigned peer, then the store.
+
+        Every 200 is **hash-verified** against ``expect_hash`` (the index's
+        recorded content address) or, failing that, the blake2b the
+        response meta carries. Corrupt bytes never escape this method:
+        a bad cache entry is evicted and the fetch falls through; a corrupt
+        *peer* is treated exactly like a dead one — evicted via
+        ``/route/failed`` so later joiners re-route — and the store covers
+        the fetch; only bytes the STORE itself serves corrupt surface, as a
+        typed :class:`DataCorruptionError` (the scrubber quarantines them
+        server-side so the next attempt is a clean 404 → re-upload).
 
         Parents are assigned eagerly, possibly before they finish their own
         fetch (the reference's rolling join: the child "blocks until parent
@@ -411,11 +449,19 @@ class _RoutedFetcher:
         if timeout is None:
             timeout = netpool.store_timeout()
         if self.enabled:
-            from .peer_cache import cache_get
+            from .peer_cache import cache_evict, cache_get
             hit = cache_get(subkey)
             if hit is not None:
-                self._fetched = True
-                return _CachedResponse(*hit)
+                try:
+                    _verify_content(hit[0], hit[1], expect_hash, subkey,
+                                    "pod-cache")
+                    self._fetched = True
+                    return _CachedResponse(*hit)
+                except DataCorruptionError:
+                    # self-heal the pod cache: drop the rotten entry and
+                    # fetch fresh bytes below (also stops this pod serving
+                    # the rot to its own children via /_kt/data)
+                    cache_evict(subkey)
         self._resolve()
         while True:
             with self._lock:
@@ -431,6 +477,15 @@ class _RoutedFetcher:
                 self._evict_peer(peer)
                 break
             if r.status_code == 200:
+                try:
+                    _verify_content(r.content, _response_meta(r),
+                                    expect_hash, subkey, "peer")
+                except DataCorruptionError:
+                    # a corrupt parent is as bad as an unreachable one:
+                    # evict (/route/failed) so nobody else is routed there,
+                    # then repair from the origin
+                    self._evict_peer(peer)
+                    break
                 # progress resets the window: a healthy parent slowly
                 # serving a large multi-leaf checkpoint must not be
                 # evicted mid-download; only a parent that stops
@@ -452,9 +507,14 @@ class _RoutedFetcher:
                 self._evict_peer(peer)
                 break
             _time.sleep(0.25)
-        r = self._store_request("GET", f"{self.store_url}/kv/{subkey}",
+        r = self._store_request("GET",
+                                f"{self.store_url}/kv/{netpool.urlkey(subkey)}",
                                 timeout=timeout)
         if r.status_code == 200:
+            # origin corruption has no fallback — surface it typed (never
+            # cache it: this pod must not become a parent serving rot)
+            _verify_content(r.content, _response_meta(r), expect_hash,
+                            subkey, "store")
             self._cache(subkey, r)
         return r
 
@@ -505,7 +565,7 @@ class _RoutedFetcher:
                     return rm
             except (_requests.RequestException, ValueError):
                 self.peer_blob_url = None   # fast path off; parent still ok
-        return self._sess().get(f"{peer_url}/_kt/data/{subkey}",
+        return self._sess().get(f"{peer_url}/_kt/data/{netpool.urlkey(subkey)}",
                                 timeout=timeout)
 
     def _cache(self, subkey: str, r) -> None:
@@ -595,7 +655,7 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         if r.status_code == 200:
             return _finish_raw(r, dest, sharding, fetcher)
 
-    r = netpool.request("GET", f"{url}/tree/{key}/manifest",
+    r = netpool.request("GET", f"{url}/tree/{netpool.urlkey(key)}/manifest",
                         timeout=netpool.store_timeout(60))
     if r.status_code == 200:
         if not dest:
@@ -635,7 +695,9 @@ def _finish_raw(r, dest, sharding, fetcher: "_RoutedFetcher") -> Any:
 def _get_pytree(key, index, fetcher: _RoutedFetcher, sharding, mesh, rules) -> Any:
     def _one(item):
         path, meta = item
-        r = fetcher.fetch(f"{key}/{path}")
+        # the index's recorded blake2b is the leaf's content address —
+        # fetch() verifies every source (cache/peer/store) against it
+        r = fetcher.fetch(f"{key}/{path}", expect_hash=meta.get("blake2b"))
         if r.status_code != 200:
             raise DataStoreError(f"get: missing leaf {key}/{path}")
         leaf_sharding = sharding
@@ -746,20 +808,23 @@ def rm(key: str, store_url: Optional[str] = None) -> bool:
     url = _store_url(store_url)
     timeout = netpool.store_timeout(60)
     existed = False
-    r = netpool.request("GET", f"{url}/kv/{key}{_INDEX_SUFFIX}",
+    r = netpool.request("GET", f"{url}/kv/{netpool.urlkey(key)}{_INDEX_SUFFIX}",
                         timeout=timeout)
     if r.status_code == 200:
         index = json.loads(r.content)
         netpool.map_concurrent(
             lambda path: netpool.request(
-                "DELETE", f"{url}/kv/{key}/{path}",
+                "DELETE", f"{url}/kv/{netpool.urlkey(key + '/' + path)}",
                 timeout=netpool.store_timeout(60)),
             index["leaves"])
-        netpool.request("DELETE", f"{url}/kv/{key}{_INDEX_SUFFIX}",
+        netpool.request("DELETE",
+                        f"{url}/kv/{netpool.urlkey(key)}{_INDEX_SUFFIX}",
                         timeout=timeout)
         existed = True
-    rd = netpool.request("DELETE", f"{url}/kv/{key}", timeout=timeout)
+    rd = netpool.request("DELETE", f"{url}/kv/{netpool.urlkey(key)}",
+                         timeout=timeout)
     existed = existed or (rd.status_code == 200 and rd.json().get("existed"))
-    rt = netpool.request("DELETE", f"{url}/tree/{key}", timeout=timeout)
+    rt = netpool.request("DELETE", f"{url}/tree/{netpool.urlkey(key)}",
+                         timeout=timeout)
     existed = existed or (rt.status_code == 200 and rt.json().get("existed"))
     return existed

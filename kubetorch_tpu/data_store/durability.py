@@ -1,0 +1,144 @@
+"""Durable-write and key-safety primitives for the store's on-disk state.
+
+The store is the weight-sync backbone of every training loop, so its commit
+point — the rename that makes a blob/tree/kv value visible under its final
+content-addressed name — must survive ``kill -9`` at any byte offset:
+
+- :func:`durable_replace` pairs ``os.replace`` with an fsync of the data
+  file *before* the rename and an fsync of the parent directory *after*.
+  Without the first, a node crash can persist the rename but not the bytes
+  (a truncated blob under its final name — which ``tree_diff`` then reports
+  present, so every client downloads garbage forever). Without the second,
+  the rename itself can vanish. ``KT_STORE_FSYNC=0`` turns both off for
+  throwaway stores (CI, benchmarks) where the page cache is the durability
+  domain anyway.
+- :func:`escape_key` / :func:`unescape_key` are the symmetric filesystem
+  escape for user keys (the same push/pop idiom as serialization.py's
+  ``_escape_key`` pair): ``%`` escapes first, so a key containing a literal
+  ``%2F`` can never collide with a key containing ``/``, and ``list_keys``
+  round-trips exactly. The old one-way ``key.replace("/", "%2F")`` did
+  neither, and let the key ``".."`` resolve ``root/kv/..`` to the store
+  root — :func:`validate_key` rejects traversal keys with 400.
+- :func:`is_disk_full` classifies ENOSPC/EDQUOT so a mid-stream write
+  failure surfaces as HTTP 507 + a typed, rehydratable ``StoreFullError``
+  instead of a generic 500 the client would retry forever.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import os
+import uuid
+from pathlib import Path
+from typing import Union
+
+_FALSY = ("0", "false", "no", "off")
+
+HASH_CHUNK = 1 << 20
+
+
+def fsync_enabled() -> bool:
+    """``KT_STORE_FSYNC`` (default on): pair commit renames with data +
+    parent-dir fsync. Env wins; the layered config's ``store_fsync`` field
+    is the fallback for file-configured deployments."""
+    raw = os.environ.get("KT_STORE_FSYNC")
+    if raw is not None:
+        return raw.strip().lower() not in _FALSY
+    try:
+        from ..config import config
+        return bool(config().get("store_fsync", True))
+    except Exception:
+        return True
+
+
+def _fsync_path(path: Path, flags: int = os.O_RDONLY) -> None:
+    fd = os.open(path, flags)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp: Union[str, Path], final: Union[str, Path]) -> None:
+    """Crash-safe commit rename: fsync ``tmp``'s bytes, rename it over
+    ``final``, fsync the parent directory. After this returns, a crash at
+    any later point leaves either the old or the new complete content —
+    never a truncated file under the final name."""
+    tmp, final = Path(tmp), Path(final)
+    if fsync_enabled():
+        _fsync_path(tmp)
+    os.replace(tmp, final)
+    if fsync_enabled():
+        _fsync_path(final.parent, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+
+
+def durable_write_bytes(path: Union[str, Path], data: bytes) -> None:
+    """Atomically (and durably) publish ``data`` at ``path`` via a
+    uniquely-named tmp sibling + :func:`durable_replace`."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f"{path.name}.{uuid.uuid4().hex[:8]}.tmp")
+    try:
+        tmp.write_bytes(data)
+        durable_replace(tmp, path)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+
+
+def is_disk_full(exc: BaseException) -> bool:
+    """True for the out-of-space family (ENOSPC / EDQUOT / EFBIG)."""
+    return isinstance(exc, OSError) and exc.errno in (
+        errno.ENOSPC, errno.EDQUOT, errno.EFBIG)
+
+
+def blake2b_file(path: Union[str, Path], chunk: int = HASH_CHUNK) -> str:
+    """blake2b-160 of a file's bytes, chunked (O(chunk) memory)."""
+    h = hashlib.blake2b(digest_size=20)
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def blake2b_bytes(data) -> str:
+    """blake2b-160 of an in-memory buffer — the content address the whole
+    data plane keys on (same digest the client's ``_leaf_hash`` computes)."""
+    return hashlib.blake2b(data, digest_size=20).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Key escaping — symmetric, collision-free, traversal-safe
+# ---------------------------------------------------------------------------
+
+
+def escape_key(key: str) -> str:
+    """Filesystem-safe name for a user key. ``%`` escapes before ``/`` so
+    escape∘unescape is the identity for every input: ``a/b`` → ``a%2Fb``,
+    ``a%2Fb`` → ``a%252Fb`` — distinct names, exact round-trip. (The old
+    one-way replace mapped both to ``a%2Fb``.)"""
+    return key.replace("%", "%25").replace("/", "%2F")
+
+
+def unescape_key(name: str) -> str:
+    """Inverse of :func:`escape_key` (and a superset-compatible decoder for
+    names written by the pre-PR-4 one-way escape, which never contained
+    ``%25``)."""
+    return name.replace("%2F", "/").replace("%25", "%")
+
+
+def validate_key(key: str) -> str:
+    """Reject keys that cannot be stored safely; returns the key unchanged.
+
+    After :func:`escape_key` a name contains no separator, so the only
+    dangerous names left are the directory links themselves (``"."`` /
+    ``".."`` — ``root/kv/..`` IS the store root) plus NULs and empties.
+    Raises ``ValueError``; HTTP handlers map it to 400.
+    """
+    if not key or key in (".", "..") or "\x00" in key:
+        raise ValueError(f"invalid store key {key!r}")
+    return key

@@ -36,6 +36,15 @@ DEFAULT_CONCURRENCY = 8
 DEFAULT_TIMEOUT_S = 600.0
 
 
+def urlkey(key: str) -> str:
+    """Percent-encode a store key for a URL path, keeping ``/`` as the
+    separator. The server decodes exactly once (aiohttp), so a key with a
+    literal ``%`` or space round-trips instead of being mis-decoded —
+    identity for ordinary ``ckpt/run/leaf`` keys."""
+    from urllib.parse import quote
+    return quote(key, safe="/")
+
+
 def _host_cpus() -> int:
     try:
         return len(os.sched_getaffinity(0))
@@ -154,6 +163,11 @@ def request(method: str, url: str, *, timeout: Optional[float] = None,
 
     ``data_factory`` re-creates a streaming body per attempt (an open file
     object is consumed by the failed attempt and cannot be re-sent).
+
+    A 507 response (store disk full) is NOT retryable — it raises a typed
+    :class:`~kubetorch_tpu.exceptions.StoreFullError` (rehydrated from the
+    server's packaged body when present) so every call site surfaces the
+    capacity verdict instead of hammering a full disk.
     """
     from ..resilience import (ESTABLISHED_TRANSIENT_EXCS, RETRYABLE_STATUSES,
                               retry_after_seconds, store_policy)
@@ -176,12 +190,33 @@ def request(method: str, url: str, *, timeout: Optional[float] = None,
         ra = retry_after_seconds(resp)
         return ra if ra is not None else True
 
-    return policy.run(
+    resp = policy.run(
         _attempt,
         retryable_exc=lambda e: isinstance(e, ESTABLISHED_TRANSIENT_EXCS),
         response_retry_delay=_resp_retry,
         breaker=breaker,
         record=record)
+    if getattr(resp, "status_code", None) == 507:
+        raise _store_full_error(resp, url)
+    return resp
+
+
+def _store_full_error(resp, url: str):
+    """Typed 507 mapping: rehydrate the server's packaged StoreFullError
+    when the body carries one; otherwise synthesize."""
+    from ..exceptions import StoreFullError, rehydrate_exception
+
+    exc = None
+    try:
+        data = resp.json()
+        if isinstance(data, dict) and data.get("error_type"):
+            exc = rehydrate_exception(data)
+    except ValueError:
+        pass
+    if not isinstance(exc, StoreFullError):
+        exc = StoreFullError(f"store at {url} is out of disk space (507)")
+    exc.status_code = 507        # transport fact, matching other rehydrations
+    return exc
 
 
 def map_concurrent(fn: Callable[[T], R], items: Iterable[T]) -> List[R]:

@@ -119,6 +119,12 @@ def _sweep(keep: Optional[Path] = None) -> None:
 
 
 def cache_get(key: str) -> Optional[Tuple[bytes, Dict]]:
+    """Read an entry; a read whose bytes no longer match the blake2b its
+    meta recorded is **self-evicting** — this cache is what the pod serves
+    to child pods (``/_kt/data/{key}``, ktblobd), so a rotten entry here
+    would fan corruption out across the whole broadcast tree. Unverifiable
+    entries (no recorded hash) pass through; the fetcher's own
+    ``expect_hash`` check still covers them when the index knows better."""
     data_path, meta_path = _entry_paths(key)
     if not data_path.is_file() or not meta_path.is_file():
         return None
@@ -126,7 +132,12 @@ def cache_get(key: str) -> Optional[Tuple[bytes, Dict]]:
         entry = json.loads(meta_path.read_text())
         if entry.get("key") != key:      # hash collision paranoia
             return None
-        return data_path.read_bytes(), entry.get("meta", {})
+        data = data_path.read_bytes()
+        want = (entry.get("meta") or {}).get("blake2b")
+        if want and hashlib.blake2b(data, digest_size=20).hexdigest() != want:
+            cache_evict(key)
+            return None
+        return data, entry.get("meta", {})
     except (OSError, ValueError):
         return None
 

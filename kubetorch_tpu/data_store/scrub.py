@@ -1,0 +1,416 @@
+"""Self-healing for the store's on-disk state: startup recovery, a
+rate-limited background scrubber, quarantine, and refcounted blob GC.
+
+The durable-write layer (``durability.py``) makes the *commit point*
+crash-safe; this module covers everything durability cannot: bytes that
+rotted after landing (bit flips, torn sectors, a crash that beat the
+fsync), orphaned ``.tmp`` files from killed uploads, and blobs stranded by
+``tree_delete``.
+
+The contract every piece enforces is the same: **a corrupt object must
+become a 404, never a wrong answer.** Clients already treat 404 + a
+failed ``/kv/diff`` claim as "re-upload / re-route", so moving a
+mismatched file into ``root/quarantine/`` is a complete repair protocol —
+no new client verbs needed.
+
+- :func:`recover_store` runs at startup: sweeps orphaned ``*.tmp`` files,
+  then re-verifies blobs/kv younger than the last clean-shutdown marker
+  (ALL of them after an unclean death — the crash window is unknown).
+- :class:`Scrubber` re-hashes blobs and kv values against their content
+  address in the background, paced by ``KT_SCRUB_RATE_MBPS`` so a
+  multi-TB store scrubs without starving the serving path; progress is
+  reported at ``/scrub/status`` and one sweep can be forced via
+  ``POST /scrub/run`` (what the chaos tests do).
+- :func:`gc_blobs` deletes blobs unreferenced by any tree manifest and
+  older than a grace window (in-flight uploads commit within it) —
+  today ``tree_delete`` strands its blobs forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, Optional
+
+from .durability import blake2b_file, durable_write_bytes
+
+CLEAN_MARKER = ".kt-clean-shutdown"
+QUARANTINE_DIR = "quarantine"
+PEERS_FILE = "peers.json"
+
+DEFAULT_SCRUB_INTERVAL_S = 300.0
+DEFAULT_SCRUB_RATE_MBPS = 64.0
+DEFAULT_PEER_TTL_S = 3600.0
+DEFAULT_GC_GRACE_S = 3600.0
+
+
+def _env_float(name: str, cfg_field: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    try:
+        from ..config import config
+        return float(config().get(cfg_field, default))
+    except Exception:
+        return default
+
+
+def quarantine(root: Path, path: Path, expected: str, actual: str,
+               reason: str) -> Optional[Path]:
+    """Move a mismatched file to ``root/quarantine/`` (GET then 404s and
+    the client repairs by re-upload/re-route). A ``.why`` sidecar records
+    the evidence for the operator runbook. Returns the quarantined path,
+    or None if the file vanished under us (concurrent delete — fine)."""
+    qdir = root / QUARANTINE_DIR
+    qdir.mkdir(parents=True, exist_ok=True)
+    dest = qdir / f"{path.name}.{int(time.time())}.{uuid.uuid4().hex[:6]}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        return None
+    try:
+        dest.with_name(dest.name + ".why").write_text(json.dumps({
+            "original": str(path), "expected": expected, "actual": actual,
+            "reason": reason, "at": time.time()}))
+    except OSError:
+        pass
+    return dest
+
+
+def _iter_blob_files(root: Path):
+    blobs = root / "blobs"
+    if blobs.is_dir():
+        for p in sorted(blobs.rglob("*")):
+            if p.is_file() and not p.name.endswith(".tmp"):
+                yield p
+
+
+def _iter_kv_pairs(root: Path):
+    """(data, meta) pairs under ``root/kv`` — meta may be absent (pre-hash
+    keys; those are unverifiable and already count as missing in
+    ``/kv/diff``, so recovery/scrub skip them)."""
+    kv = root / "kv"
+    if kv.is_dir():
+        for p in sorted(kv.iterdir()):
+            if not p.is_file() or p.name.endswith((".tmp", ".meta")):
+                continue
+            yield p, p.with_name(p.name + ".meta")
+
+
+def _kv_expected_hash(meta_path: Path) -> Optional[str]:
+    try:
+        return json.loads(meta_path.read_text()).get("blake2b")
+    except (OSError, ValueError):
+        return None
+
+
+def _verify_kv_pair(root: Path, data: Path, meta: Path) -> bool:
+    """Re-hash one kv value against its meta; quarantine BOTH files on a
+    confirmed mismatch (a stale meta left behind would make ``/kv/diff``
+    claim the quarantined key current forever). Double-checks before
+    quarantining: a concurrent put replaces data then meta non-atomically,
+    so one mismatched read can be a benign race. Returns True if
+    quarantined."""
+    want = _kv_expected_hash(meta)
+    if want is None:
+        return False
+    try:
+        if blake2b_file(data) == want:
+            return False
+        # re-read: the pair may have been replaced mid-hash
+        want2 = _kv_expected_hash(meta)
+        if want2 is None or blake2b_file(data) == want2:
+            return False
+        want = want2
+    except OSError:
+        return False          # deleted under us
+    actual = blake2b_file(data) if data.is_file() else "<gone>"
+    quarantine(root, data, want, actual, "kv content-hash mismatch")
+    quarantine(root, meta, want, actual, "meta of quarantined kv value")
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Startup recovery
+# ---------------------------------------------------------------------------
+
+
+def sweep_tmp_files(root: Path) -> int:
+    """Unlink orphaned ``*.tmp`` files from killed uploads — they hold no
+    committed state (the rename IS the commit) and accumulate unbounded
+    otherwise."""
+    swept = 0
+    for sub in ("blobs", "trees", "kv"):
+        d = root / sub
+        if not d.is_dir():
+            continue
+        for tmp in d.rglob("*.tmp"):
+            try:
+                tmp.unlink()
+                swept += 1
+            except OSError:
+                pass
+    return swept
+
+
+def recover_store(root: Path) -> Dict:
+    """Bring a possibly-crashed root back to a trustworthy state. Called
+    before the server accepts requests.
+
+    The clean-shutdown marker bounds the verification window: a graceful
+    stop stamps ``.kt-clean-shutdown`` with the wall time, so the next
+    start only re-hashes objects written at-or-after it (normally none).
+    No marker = the process was killed = any object could be the torn one,
+    so everything verifiable is verified. The marker is consumed (deleted)
+    at startup — a crash from here on is detectable again.
+    """
+    report = {"clean_shutdown": False, "tmp_swept": 0, "verified": 0,
+              "quarantined": 0}
+    marker = root / CLEAN_MARKER
+    clean_ts: Optional[float] = None
+    if marker.is_file():
+        try:
+            clean_ts = float(marker.read_text().strip())
+            report["clean_shutdown"] = True
+        except (OSError, ValueError):
+            clean_ts = None
+    marker.unlink(missing_ok=True)
+
+    report["tmp_swept"] = sweep_tmp_files(root)
+
+    def _suspect(path: Path) -> bool:
+        if clean_ts is None:
+            return True
+        try:
+            # 1s slack: rename preserves mtime but filesystems round
+            return path.stat().st_mtime >= clean_ts - 1.0
+        except OSError:
+            return False
+
+    for blob in _iter_blob_files(root):
+        if not _suspect(blob):
+            continue
+        report["verified"] += 1
+        try:
+            actual = blake2b_file(blob)
+        except OSError:
+            continue
+        if actual != blob.name:
+            quarantine(root, blob, blob.name, actual,
+                       "blob content-hash mismatch at startup recovery")
+            report["quarantined"] += 1
+
+    for data, meta in _iter_kv_pairs(root):
+        if not (_suspect(data) or _suspect(meta)):
+            continue
+        report["verified"] += 1
+        if _verify_kv_pair(root, data, meta):
+            report["quarantined"] += 1
+    return report
+
+
+def mark_clean_shutdown(root: Path) -> None:
+    try:
+        durable_write_bytes(root / CLEAN_MARKER, str(time.time()).encode())
+    except OSError:
+        # a failed stamp only costs the next startup a full re-verify —
+        # never block shutdown on it (read-only fs, root already gone)
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Peer-registry persistence (MDS role must survive a store restart)
+# ---------------------------------------------------------------------------
+
+
+def load_peers(root: Path, ttl_s: Optional[float] = None) -> Dict[str, Dict]:
+    """Reload the persisted peer registry, dropping TTL-expired entries —
+    a pod that registered an hour ago is more likely gone than holding."""
+    if ttl_s is None:
+        ttl_s = _env_float("KT_PEER_TTL_S", "peer_ttl_s", DEFAULT_PEER_TTL_S)
+    try:
+        raw = json.loads((root / PEERS_FILE).read_text())
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(raw, dict):
+        return {}
+    now = time.time()
+    return {k: v for k, v in raw.items()
+            if isinstance(v, dict)
+            and now - float(v.get("ts", 0)) <= ttl_s}
+
+
+def save_peers(root: Path, peers: Dict[str, Dict]) -> None:
+    """Write-through snapshot (registrations are control-plane-rare)."""
+    try:
+        durable_write_bytes(root / PEERS_FILE,
+                            json.dumps(peers).encode())
+    except OSError:
+        pass                   # registry still serves from memory
+
+
+# ---------------------------------------------------------------------------
+# Background scrubber
+# ---------------------------------------------------------------------------
+
+
+class Scrubber:
+    """Incremental integrity sweeps over blobs + kv, rate-limited so the
+    serving path keeps its disk bandwidth. One sweep = every verifiable
+    object re-hashed once; mismatches are quarantined (double-checked for
+    kv, whose data/meta pair updates non-atomically under concurrency).
+
+    Runs inside the store's event loop: files are hashed in 1 MiB chunks
+    with an ``await`` between chunks, which both paces I/O to
+    ``KT_SCRUB_RATE_MBPS`` and yields the loop to in-flight requests.
+    """
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.interval_s = _env_float("KT_SCRUB_INTERVAL_S",
+                                     "scrub_interval_s",
+                                     DEFAULT_SCRUB_INTERVAL_S)
+        self.rate_mbps = _env_float("KT_SCRUB_RATE_MBPS", "scrub_rate_mbps",
+                                    DEFAULT_SCRUB_RATE_MBPS)
+        self.stats: Dict = {"sweeps": 0, "scanned": 0, "scanned_bytes": 0,
+                            "quarantined": 0, "last_sweep_s": None,
+                            "last_sweep_at": None, "running": False,
+                            "interval_s": self.interval_s,
+                            "rate_mbps": self.rate_mbps}
+        self._sweep_lock = asyncio.Lock()
+
+    async def _hash_paced(self, path: Path) -> str:
+        import hashlib
+        h = hashlib.blake2b(digest_size=20)
+        chunk = 1 << 20
+        delay = (chunk / (self.rate_mbps * (1 << 20))
+                 if self.rate_mbps > 0 else 0.0)
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(chunk)
+                if not block:
+                    break
+                h.update(block)
+                self.stats["scanned_bytes"] += len(block)
+                await asyncio.sleep(delay)
+        return h.hexdigest()
+
+    async def sweep(self) -> Dict:
+        """One full pass; concurrent callers coalesce behind the lock."""
+        async with self._sweep_lock:
+            t0 = time.monotonic()
+            report = {"scanned": 0, "quarantined": 0, "errors": 0}
+            self.stats["running"] = True
+            try:
+                for blob in list(_iter_blob_files(self.root)):
+                    report["scanned"] += 1
+                    try:
+                        actual = await self._hash_paced(blob)
+                    except OSError:
+                        report["errors"] += 1
+                        continue
+                    if actual != blob.name and blob.is_file():
+                        # double-check: a concurrent re-PUT commits the
+                        # same content, so a second mismatch is real rot
+                        try:
+                            if blake2b_file(blob) == blob.name:
+                                continue
+                        except OSError:
+                            continue
+                        if quarantine(self.root, blob, blob.name, actual,
+                                      "blob content-hash mismatch (scrub)"):
+                            report["quarantined"] += 1
+                for data, meta in list(_iter_kv_pairs(self.root)):
+                    report["scanned"] += 1
+                    want = _kv_expected_hash(meta)
+                    if want is None:
+                        continue
+                    try:
+                        actual = await self._hash_paced(data)
+                    except OSError:
+                        report["errors"] += 1
+                        continue
+                    if actual != want:
+                        if _verify_kv_pair(self.root, data, meta):
+                            report["quarantined"] += 1
+            finally:
+                self.stats["running"] = False
+                self.stats["sweeps"] += 1
+                self.stats["scanned"] += report["scanned"]
+                self.stats["quarantined"] += report["quarantined"]
+                self.stats["last_sweep_s"] = round(time.monotonic() - t0, 4)
+                self.stats["last_sweep_at"] = time.time()
+            return report
+
+    async def run_forever(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.sweep()
+            except Exception:
+                # a scrub failure must never take the store down; the next
+                # interval retries and /scrub/status exposes staleness
+                pass
+
+    def status(self) -> Dict:
+        quarantined_files = 0
+        qdir = self.root / QUARANTINE_DIR
+        if qdir.is_dir():
+            quarantined_files = sum(1 for p in qdir.iterdir()
+                                    if not p.name.endswith(".why"))
+        return {**self.stats, "quarantine_files": quarantined_files}
+
+
+# ---------------------------------------------------------------------------
+# Refcounted blob GC
+# ---------------------------------------------------------------------------
+
+
+def gc_blobs(root: Path, grace_s: Optional[float] = None) -> Dict:
+    """Delete blobs referenced by NO tree manifest and older than
+    ``grace_s`` (default 1h — an upload wave for an in-flight commit lands
+    well within it; its blobs are young, so they survive until the commit
+    references them). This is what makes ``tree_delete`` eventually
+    reclaim space instead of stranding every blob forever."""
+    if grace_s is None:
+        grace_s = _env_float("KT_GC_GRACE_S", "gc_grace_s",
+                             DEFAULT_GC_GRACE_S)
+    referenced = set()
+    trees = root / "trees"
+    if trees.is_dir():
+        for manifest in trees.glob("*.json"):
+            try:
+                files = json.loads(manifest.read_text()).get("files", {})
+                referenced.update(info["hash"] for info in files.values()
+                                  if isinstance(info, dict) and "hash" in info)
+            except (OSError, ValueError, TypeError):
+                # an unreadable manifest must PIN everything: deleting
+                # blobs we merely failed to see referenced is data loss
+                return {"scanned": 0, "deleted": 0, "kept": 0,
+                        "bytes_freed": 0,
+                        "error": f"unreadable manifest {manifest.name}"}
+    now = time.time()
+    report = {"scanned": 0, "deleted": 0, "kept": 0, "bytes_freed": 0}
+    for blob in _iter_blob_files(root):
+        report["scanned"] += 1
+        if blob.name in referenced:
+            report["kept"] += 1
+            continue
+        try:
+            st = blob.stat()
+            if now - st.st_mtime < grace_s:
+                report["kept"] += 1
+                continue
+            blob.unlink()
+            report["deleted"] += 1
+            report["bytes_freed"] += st.st_size
+        except OSError:
+            report["kept"] += 1
+    return report

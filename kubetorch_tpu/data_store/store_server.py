@@ -13,10 +13,23 @@ single aiohttp service:
 - ``/keys?prefix=``                listing for `kt ls`
 - ``/register``                    peer registry (MDS role): which pod holds
                                    which locale="local" key, for P2P gets
+- ``/scrub/status`` / ``/scrub/run``  background integrity scrubber
+- ``/gc``                          refcounted GC of tree-unreferenced blobs
 
 Uploads stream: blob/KV PUT bodies are chunked straight to the ``.tmp``
 file with an incremental blake2b, so server memory stays ``O(chunk)``
 however large the checkpoint.
+
+Crash consistency (ISSUE 4): every commit rename rides
+``durability.durable_replace`` (data fsync + parent-dir fsync,
+``KT_STORE_FSYNC``), startup runs ``scrub.recover_store`` (orphan-tmp
+sweep + re-verification of objects younger than the last clean-shutdown
+marker), the peer registry persists to ``root/peers.json`` with TTL
+expiry, mid-stream ENOSPC surfaces as HTTP 507 + typed ``StoreFullError``,
+and a rate-limited scrubber quarantines rotted objects to
+``root/quarantine/`` so clients see 404 (re-upload/re-route), never
+wrong bytes. You can ``kill -9`` this process at any byte offset and
+trust the store after restart.
 
 Run: ``python -m kubetorch_tpu.data_store.store_server --port 8873 --root DIR``
 """
@@ -24,6 +37,7 @@ Run: ``python -m kubetorch_tpu.data_store.store_server --port 8873 --root DIR``
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import json
 import os
@@ -31,9 +45,10 @@ import time
 import uuid
 from pathlib import Path
 from typing import Dict, Optional, Tuple
-from urllib.parse import unquote
-
 from aiohttp import web
+
+from ..exceptions import StoreFullError, package_exception
+from . import durability, scrub
 
 MAX_BODY = 10 * 1024 ** 3
 UPLOAD_CHUNK = 1 << 20          # streaming read granularity for PUT bodies
@@ -45,7 +60,17 @@ class StoreState:
         (self.root / "blobs").mkdir(parents=True, exist_ok=True)
         (self.root / "trees").mkdir(parents=True, exist_ok=True)
         (self.root / "kv").mkdir(parents=True, exist_ok=True)
-        self.peers: Dict[str, Dict] = {}   # key → {ip, port, ts} for P2P
+        # crash recovery BEFORE the first request: sweep orphan tmps,
+        # re-verify anything the last run may have torn, reload peers
+        self.recovery = scrub.recover_store(self.root)
+        self.peers: Dict[str, Dict] = scrub.load_peers(self.root)
+
+    @staticmethod
+    def _safe(key: str) -> str:
+        try:
+            return durability.escape_key(durability.validate_key(key))
+        except ValueError:
+            raise web.HTTPBadRequest(text="bad key")
 
     def blob_path(self, h: str) -> Path:
         if not h.isalnum():
@@ -53,16 +78,41 @@ class StoreState:
         return self.root / "blobs" / h[:2] / h
 
     def tree_path(self, key: str) -> Path:
-        safe = key.replace("/", "%2F")
-        return self.root / "trees" / f"{safe}.json"
+        return self.root / "trees" / f"{self._safe(key)}.json"
 
     def kv_path(self, key: str) -> Path:
-        safe = key.replace("/", "%2F")
-        return self.root / "kv" / safe
+        return self.root / "kv" / self._safe(key)
+
+    def path_for_request(self, http_path: str) -> Optional[Path]:
+        """On-disk file behind a ``/blob/..`` or ``/kv/..`` request path —
+        the hook the chaos verbs (``corrupt-blob``, ``torn-write``) use to
+        fault real stored state deterministically."""
+        try:
+            if http_path.startswith("/blob/"):
+                return self.blob_path(http_path[len("/blob/"):])
+            if http_path.startswith("/kv/") and http_path != "/kv/diff":
+                return self.kv_path(http_path[len("/kv/"):])
+        except web.HTTPBadRequest:
+            return None
+        return None
+
+    def save_peers(self) -> None:
+        scrub.save_peers(self.root, self.peers)
+
+    def mark_clean_shutdown(self) -> None:
+        self.save_peers()
+        scrub.mark_clean_shutdown(self.root)
 
 
 def _state(request: web.Request) -> StoreState:
     return request.app["store"]
+
+
+def _tmp_siblings(path: Path):
+    """In-flight ``.tmp`` files for ``path`` (the unique-suffix scheme of
+    ``_stream_to_tmp`` / durable_write_bytes)."""
+    return path.parent.glob(f"{path.name}.*.tmp") if path.parent.is_dir() \
+        else ()
 
 
 # -- blobs -------------------------------------------------------------------
@@ -73,8 +123,10 @@ async def _stream_to_tmp(request: web.Request, path: Path) -> Tuple[Path, str, i
     ``path`` in ``UPLOAD_CHUNK`` pieces, hashing as it lands. Memory stays
     O(chunk) regardless of body size (``await request.read()`` would buffer
     a whole multi-GB checkpoint in server RAM). The unique tmp name keeps
-    concurrent PUTs of the same key from interleaving writes; ``os.replace``
-    stays last-wins-atomic. Returns ``(tmp, blake2b_hex, size)``."""
+    concurrent PUTs of the same key from interleaving writes; the commit
+    rename stays last-wins-atomic. A full disk mid-stream surfaces as 507 +
+    typed ``StoreFullError``, not a retry-forever 500. Returns
+    ``(tmp, blake2b_hex, size)``."""
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(f"{path.name}.{uuid.uuid4().hex[:8]}.tmp")
     hasher = hashlib.blake2b(digest_size=20)
@@ -85,10 +137,32 @@ async def _stream_to_tmp(request: web.Request, path: Path) -> Tuple[Path, str, i
                 f.write(chunk)
                 hasher.update(chunk)
                 size += len(chunk)
-    except Exception:
+    except Exception as e:
         tmp.unlink(missing_ok=True)
+        if durability.is_disk_full(e):
+            raise web.HTTPInsufficientStorage(
+                text=json.dumps(package_exception(StoreFullError(
+                    f"store out of space writing {path.name}",
+                    path=str(path)))),
+                content_type="application/json")
         raise
     return tmp, hasher.hexdigest(), size
+
+
+def _commit(tmp: Path, path: Path) -> None:
+    """Durable commit rename; ENOSPC during the fsync/rename is still a 507
+    (dirty pages can hit the wall at fsync time, not write time)."""
+    try:
+        durability.durable_replace(tmp, path)
+    except OSError as e:
+        tmp.unlink(missing_ok=True)
+        if durability.is_disk_full(e):
+            raise web.HTTPInsufficientStorage(
+                text=json.dumps(package_exception(StoreFullError(
+                    f"store out of space committing {path.name}",
+                    path=str(path)))),
+                content_type="application/json")
+        raise
 
 
 async def put_blob(request: web.Request) -> web.Response:
@@ -100,7 +174,7 @@ async def put_blob(request: web.Request) -> web.Response:
         tmp.unlink(missing_ok=True)
         return web.json_response({"error": f"hash mismatch: {actual}"},
                                  status=400)
-    os.replace(tmp, path)
+    _commit(tmp, path)
     return web.json_response({"ok": True, "size": size})
 
 
@@ -126,7 +200,7 @@ async def tree_diff(request: web.Request) -> web.Response:
 
 async def tree_commit(request: web.Request) -> web.Response:
     st = _state(request)
-    key = unquote(request.match_info["key"])
+    key = request.match_info["key"]
     body = await request.json()
     files: Dict[str, Dict] = body.get("files", {})
     still_missing = [info["hash"] for info in files.values()
@@ -135,15 +209,25 @@ async def tree_commit(request: web.Request) -> web.Response:
         return web.json_response(
             {"error": "missing blobs", "missing": still_missing}, status=409)
     path = st.tree_path(key)
-    tmp = path.with_suffix(".tmp")
-    tmp.write_text(json.dumps({"files": files, "committed_at": time.time()}))
-    os.replace(tmp, path)
+    tmp = path.with_name(f"{path.name}.{uuid.uuid4().hex[:8]}.tmp")
+    try:
+        tmp.write_text(json.dumps({"files": files,
+                                   "committed_at": time.time()}))
+    except OSError as e:
+        tmp.unlink(missing_ok=True)
+        if durability.is_disk_full(e):
+            raise web.HTTPInsufficientStorage(
+                text=json.dumps(package_exception(StoreFullError(
+                    f"store out of space writing manifest {key!r}"))),
+                content_type="application/json")
+        raise
+    _commit(tmp, path)
     return web.json_response({"ok": True, "files": len(files)})
 
 
 async def tree_manifest(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.tree_path(unquote(request.match_info["key"]))
+    path = st.tree_path(request.match_info["key"])
     if not path.is_file():
         return web.json_response({"error": "no such tree"}, status=404)
     return web.Response(body=path.read_bytes(), content_type="application/json")
@@ -151,10 +235,16 @@ async def tree_manifest(request: web.Request) -> web.Response:
 
 async def tree_delete(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.tree_path(unquote(request.match_info["key"]))
+    path = st.tree_path(request.match_info["key"])
     existed = path.is_file()
-    if existed:
-        path.unlink()
+    # idempotent under concurrent delete (missing_ok), and in-flight .tmp
+    # siblings from a racing commit go too — an orphan would resurrect as
+    # garbage on the next recovery-less scan
+    with contextlib.suppress(OSError):
+        path.unlink(missing_ok=True)
+    for tmp in _tmp_siblings(path):
+        with contextlib.suppress(OSError):
+            tmp.unlink(missing_ok=True)
     return web.json_response({"ok": True, "existed": existed})
 
 
@@ -163,7 +253,7 @@ async def tree_delete(request: web.Request) -> web.Response:
 
 async def kv_put(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.kv_path(unquote(request.match_info["key"]))
+    path = st.kv_path(request.match_info["key"])
     meta = {}
     if "X-KT-Meta" in request.headers:
         try:
@@ -187,10 +277,23 @@ async def kv_put(request: web.Request) -> web.Response:
     # between them); concurrent conflicting puts to one key are last-wins
     # racy regardless, and kv_diff's size check narrows the stale-meta
     # window it could otherwise misjudge.
-    os.replace(tmp, path)
+    _commit(tmp, path)
     meta_tmp = path.with_name(f"{path.name}.meta.{uuid.uuid4().hex[:8]}.tmp")
-    meta_tmp.write_text(json.dumps(meta))
-    os.replace(meta_tmp, path.with_name(path.name + ".meta"))
+    try:
+        meta_tmp.write_text(json.dumps(meta))
+    except OSError as e:
+        meta_tmp.unlink(missing_ok=True)
+        if durability.is_disk_full(e):
+            # data landed but the meta didn't: /kv/diff reports the key
+            # missing (stale/absent meta), so the eventual retry after
+            # freeing space re-uploads cleanly — report the truth now
+            raise web.HTTPInsufficientStorage(
+                text=json.dumps(package_exception(StoreFullError(
+                    f"store out of space writing meta for {path.name}",
+                    path=str(path)))),
+                content_type="application/json")
+        raise
+    _commit(meta_tmp, path.with_name(path.name + ".meta"))
     return web.json_response({"ok": True, "size": size})
 
 
@@ -205,7 +308,11 @@ async def kv_diff(request: web.Request) -> web.Response:
     keys: Dict[str, str] = body.get("keys", {})
     missing = []
     for key, want in keys.items():
-        path = st.kv_path(key)
+        try:
+            path = st.kv_path(key)
+        except web.HTTPBadRequest:
+            missing.append(key)
+            continue
         meta_path = path.with_name(path.name + ".meta")
         have, meta_size = None, None
         if path.is_file() and meta_path.is_file():
@@ -230,7 +337,7 @@ async def kv_diff(request: web.Request) -> web.Response:
 
 async def kv_get(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.kv_path(unquote(request.match_info["key"]))
+    path = st.kv_path(request.match_info["key"])
     if not path.is_file():
         return web.json_response({"error": "no such key"}, status=404)
     headers = {}
@@ -242,13 +349,19 @@ async def kv_get(request: web.Request) -> web.Response:
 
 async def kv_delete(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.kv_path(unquote(request.match_info["key"]))
+    path = st.kv_path(request.match_info["key"])
     existed = path.is_file()
-    if existed:
-        path.unlink()
-        meta = path.with_name(path.name + ".meta")
-        if meta.is_file():
-            meta.unlink()
+    meta = path.with_name(path.name + ".meta")
+    # each unlink is independent and missing_ok: the meta must go even if
+    # the data unlink races a concurrent delete, or a stale meta would
+    # make /kv/diff claim a re-uploaded key current against old bytes
+    with contextlib.suppress(OSError):
+        path.unlink(missing_ok=True)
+    with contextlib.suppress(OSError):
+        meta.unlink(missing_ok=True)
+    for tmp in list(_tmp_siblings(path)) + list(_tmp_siblings(meta)):
+        with contextlib.suppress(OSError):
+            tmp.unlink(missing_ok=True)
     return web.json_response({"ok": True, "existed": existed})
 
 
@@ -259,14 +372,48 @@ async def list_keys(request: web.Request) -> web.Response:
     for p in (st.root / "kv").iterdir():
         if p.name.endswith((".tmp", ".meta")):
             continue
-        key = p.name.replace("%2F", "/")
+        key = durability.unescape_key(p.name)
         if key.startswith(prefix):
             out.append({"key": key, "size": p.stat().st_size, "kind": "kv"})
     for p in (st.root / "trees").glob("*.json"):
-        key = p.stem.replace("%2F", "/")
+        if p.name.endswith(".tmp"):
+            continue
+        key = durability.unescape_key(p.stem)
         if key.startswith(prefix):
             out.append({"key": key, "kind": "tree"})
     return web.json_response({"keys": sorted(out, key=lambda x: x["key"])})
+
+
+# -- integrity: scrub / gc ----------------------------------------------------
+
+
+async def scrub_status(request: web.Request) -> web.Response:
+    return web.json_response(request.app["scrubber"].status())
+
+
+async def scrub_run(request: web.Request) -> web.Response:
+    """Force one full sweep and return its report — the deterministic hook
+    the chaos tests (and operators after an incident) use instead of
+    waiting out ``KT_SCRUB_INTERVAL_S``."""
+    report = await request.app["scrubber"].sweep()
+    return web.json_response({"ok": True, **report})
+
+
+async def gc_run(request: web.Request) -> web.Response:
+    """Refcounted blob GC: body ``{"grace_s": N}`` optionally overrides the
+    in-flight-upload grace window (default 1h / ``KT_GC_GRACE_S``)."""
+    grace_s = None
+    if request.can_read_body:
+        try:
+            body = await request.json()
+            if isinstance(body, dict) and "grace_s" in body:
+                grace_s = max(0.0, float(body["grace_s"]))
+        except (ValueError, TypeError):
+            return web.json_response({"error": "bad grace_s"}, status=400)
+    st = _state(request)
+    report = await asyncio.get_event_loop().run_in_executor(
+        None, scrub.gc_blobs, st.root, grace_s)
+    return web.json_response({"ok": True, **report})
 
 
 # -- broadcast barriers (MDS quorum role, reference WS /ws/gpu-broadcast) -----
@@ -394,8 +541,8 @@ async def route_complete(request: web.Request) -> web.Response:
 
 
 async def route_failed(request: web.Request) -> web.Response:
-    """A getter reports its assigned parent unreachable (reference
-    report_unreachable): evict so nobody else is routed there."""
+    """A getter reports its assigned parent unreachable or corrupt
+    (reference report_unreachable): evict so nobody else is routed there."""
     st = _state(request)
     body = await request.json()
     group = _route_groups(st).get(body["key"])
@@ -413,12 +560,22 @@ async def register_peer(request: web.Request) -> web.Response:
     body = await request.json()
     st.peers[body["key"]] = {"ip": body["ip"], "port": body.get("port", 8873),
                              "ts": time.time()}
+    # write-through snapshot: /register is control-plane-rare, and without
+    # it every store restart silently degrades P2P gets to origin fetches
+    st.save_peers()
     return web.json_response({"ok": True})
 
 
 async def lookup_peer(request: web.Request) -> web.Response:
     st = _state(request)
-    peer = st.peers.get(unquote(request.match_info["key"]))
+    key = request.match_info["key"]
+    peer = st.peers.get(key)
+    if peer is not None:
+        ttl = scrub._env_float("KT_PEER_TTL_S", "peer_ttl_s",
+                               scrub.DEFAULT_PEER_TTL_S)
+        if time.time() - float(peer.get("ts", 0)) > ttl:
+            st.peers.pop(key, None)
+            peer = None
     if peer is None:
         return web.json_response({"error": "no peer"}, status=404)
     return web.json_response(peer)
@@ -437,6 +594,26 @@ def create_store_app(root: str) -> web.Application:
                           middlewares=[chaos_mw] if chaos_mw else [])
     app["chaos"] = chaos_engine
     app["store"] = StoreState(root)
+    app["scrubber"] = scrub.Scrubber(app["store"].root)
+
+    async def _scrub_loop(app: web.Application):
+        task = None
+        if app["scrubber"].interval_s > 0:
+            task = asyncio.get_event_loop().create_task(
+                app["scrubber"].run_forever())
+        yield
+        if task is not None:
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+
+    async def _on_shutdown(app: web.Application):
+        # graceful stop: persist peers + stamp the clean-shutdown marker so
+        # the next startup only re-verifies objects written after it
+        app["store"].mark_clean_shutdown()
+
+    app.cleanup_ctx.append(_scrub_loop)
+    app.on_shutdown.append(_on_shutdown)
     r = app.router
     r.add_get("/health", health)
     r.add_put("/blob/{hash}", put_blob)
@@ -450,6 +627,9 @@ def create_store_app(root: str) -> web.Application:
     r.add_get("/kv/{key:.+}", kv_get)
     r.add_delete("/kv/{key:.+}", kv_delete)
     r.add_get("/keys", list_keys)
+    r.add_get("/scrub/status", scrub_status)
+    r.add_post("/scrub/run", scrub_run)
+    r.add_post("/gc", gc_run)
     r.add_post("/register", register_peer)
     r.add_get("/peer/{key:.+}", lookup_peer)
     r.add_post("/barrier", barrier_join)

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Set
 
 import requests as _requests
 
-from ..exceptions import SyncError
+from ..exceptions import DataCorruptionError, SyncError
 from . import netpool
 
 EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
@@ -142,7 +142,8 @@ def push_tree(store_url: str, key: str, root: str,
                                timeout=netpool.store_timeout(60), **kw)
 
     try:
-        r = _req("POST", f"{base}/tree/{key}/diff", json={"files": manifest})
+        r = _req("POST", f"{base}/tree/{netpool.urlkey(key)}/diff",
+                 json={"files": manifest})
         r.raise_for_status()
         missing: List[str] = r.json()["missing"]
 
@@ -180,7 +181,7 @@ def push_tree(store_url: str, key: str, root: str,
 
         uploaded_bytes = sum(netpool.map_concurrent(_upload, missing))
 
-        rc = _req("POST", f"{base}/tree/{key}/commit",
+        rc = _req("POST", f"{base}/tree/{netpool.urlkey(key)}/commit",
                   json={"files": manifest})
         rc.raise_for_status()
         return {"files": len(manifest), "uploaded": len(missing),
@@ -196,10 +197,11 @@ def pull_tree(store_url: str, key: str, dest: str,
     base = store_url.rstrip("/")
     try:
         if session is not None:
-            r = session.get(f"{base}/tree/{key}/manifest",
+            r = session.get(f"{base}/tree/{netpool.urlkey(key)}/manifest",
                             timeout=netpool.store_timeout(60))
         else:
-            r = netpool.request("GET", f"{base}/tree/{key}/manifest",
+            r = netpool.request("GET",
+                                f"{base}/tree/{netpool.urlkey(key)}/manifest",
                                 timeout=netpool.store_timeout(60))
         if r.status_code == 404:
             raise SyncError(f"No tree {key!r} in store")
@@ -229,9 +231,24 @@ def pull_tree(store_url: str, key: str, dest: str,
             rb.raise_for_status()
             os.makedirs(os.path.dirname(target) or dest, exist_ok=True)
             tmp = target + ".ktsync-tmp"
+            # blobs are content-addressed: the URL hash IS the expected
+            # blake2b, so integrity is verified for free while streaming —
+            # a mismatch (store-side rot) must never land under the final
+            # name, where the manifest would vouch for it forever
+            hasher = hashlib.blake2b(digest_size=20)
             with open(tmp, "wb") as f:
                 for chunk in rb.iter_content(1 << 20):
                     f.write(chunk)
+                    hasher.update(chunk)
+            actual = hasher.hexdigest()
+            if actual != info["hash"]:
+                os.unlink(tmp)
+                raise DataCorruptionError(
+                    f"blob {info['hash']} for {rel!r} arrived corrupt "
+                    f"(got {actual}); the store copy needs repair "
+                    "(scrub + re-push)",
+                    key=info["hash"], expected=info["hash"], actual=actual,
+                    source="store")
             os.chmod(tmp, info.get("mode", 0o644))
             os.replace(tmp, target)
 

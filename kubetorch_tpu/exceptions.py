@@ -110,6 +110,43 @@ class DataStoreError(KubetorchError):
     """Data-store operation (put/get/ls/rm/broadcast) failed."""
 
 
+class StoreFullError(DataStoreError):
+    """The data store's disk is full (ENOSPC/EDQUOT mid-write → HTTP 507).
+
+    Non-retryable by design: a 507 is a capacity verdict, not a transient
+    blip — retrying would hammer a full disk. Callers should free space
+    (``POST /gc``, ``kt.rm``) or grow the volume; see the operations
+    runbook. ``path`` is the server-side file that failed, when known.
+    """
+
+    def __init__(self, message: str = "data store is out of disk space",
+                 path: Optional[str] = None):
+        super().__init__(message)
+        self.path = path
+
+
+class DataCorruptionError(DataStoreError):
+    """Fetched bytes do not match their content address.
+
+    The data plane is content-addressed end to end (blob names and kv meta
+    both carry blake2b-160), so every GET is verifiable for free. The
+    client raises this instead of handing corrupt weights to a training
+    loop; the P2P fetcher additionally *repairs* — it evicts the corrupt
+    source (local cache entry or peer via ``/route/failed``) and re-fetches
+    from the origin before surfacing anything. Server-side, the scrubber
+    quarantines the mismatched file so the next GET is a clean 404.
+    """
+
+    def __init__(self, message: str = "content hash mismatch on fetch",
+                 key: Optional[str] = None, expected: Optional[str] = None,
+                 actual: Optional[str] = None, source: Optional[str] = None):
+        super().__init__(message)
+        self.key = key
+        self.expected = expected
+        self.actual = actual
+        self.source = source
+
+
 class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
@@ -304,6 +341,8 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         SyncError,
         SerializationError,
         DataStoreError,
+        StoreFullError,
+        DataCorruptionError,
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
@@ -320,6 +359,8 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
 _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "TpuSliceUnavailableError": ["accelerator", "topology"],
     "ControllerRequestError": ["status_code"],
+    "StoreFullError": ["path"],
+    "DataCorruptionError": ["key", "expected", "actual", "source"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
     "PodTerminatedError": ["reason", "pod_name", "exit_code"],

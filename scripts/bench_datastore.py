@@ -8,7 +8,11 @@ built for (ISSUE 1 / ROADMAP "as fast as the hardware allows"):
   one-leaf-at-a-time path, kept as the baseline);
 - **parallel**   — cold put + get at the default fan-out (8);
 - **delta**      — an identical repeated put: every leaf skipped via
-  ``/kv/diff``, only the index moves.
+  ``/kv/diff``, only the index moves;
+- **scrub**      — one full integrity sweep over the stored data (ISSUE 4)
+  plus a parallel get racing a concurrent sweep, so the steady-state
+  overhead of the background scrubber on the fetch hot path is a tracked
+  number, not a guess.
 
 Run: ``make bench-store`` or
 ``python scripts/bench_datastore.py [--leaves 64] [--mb-per-leaf 4]``.
@@ -137,6 +141,35 @@ def bench(leaves: int, mb_per_leaf: float, concurrency: int,
                     results["parallel"]["uploaded_bytes"] / dstats["bytes"], 1)
                 if dstats["bytes"] else None,
             }
+
+            # scrub overhead: one timed full sweep (pacing included), then
+            # a get racing a concurrent sweep vs the best uncontended get
+            import threading
+
+            import requests as _rq
+
+            rep, scrub_s = _timed(lambda: _rq.post(
+                f"{url}/scrub/run", timeout=600).json())
+            status = _rq.get(f"{url}/scrub/status", timeout=30).json()
+            t = threading.Thread(target=lambda: _rq.post(
+                f"{url}/scrub/run", timeout=600))
+            t.start()
+            _, get_during = _timed(
+                lambda: ds.get("bench/parallel/0", store_url=url))
+            t.join()
+            get_best = results["parallel"]["get_s"]
+            results["scrub"] = {
+                "sweep_s": round(scrub_s, 3),
+                "scanned": rep.get("scanned"),
+                "quarantined": rep.get("quarantined"),
+                "scrub_mb_s": round(
+                    status.get("scanned_bytes", 0) / max(scrub_s, 1e-9)
+                    / (1 << 20) / max(status.get("sweeps", 1), 1), 1),
+                "get_during_scrub_s": round(get_during, 3),
+                "get_overhead_pct": round(
+                    100.0 * (get_during - get_best) / get_best, 1)
+                if get_best else None,
+            }
         finally:
             kill_process_tree(proc.pid)
             os.environ.pop("KT_STORE_CONCURRENCY", None)
@@ -179,6 +212,11 @@ def main() -> None:
     print(f"\nput+get speedup: {r['speedup_put_get_x']}x "
           f"(put {r['speedup_put_x']}x, get {r['speedup_get_x']}x); "
           f"delta wire reduction: {reduction}")
+    s = r["scrub"]
+    print(f"scrub: full sweep {s['sweep_s']}s ({s['scrub_mb_s']} MB/s paced, "
+          f"{s['scanned']} objects, {s['quarantined']} quarantined); "
+          f"get during scrub {s['get_during_scrub_s']}s "
+          f"({s['get_overhead_pct']}% over uncontended)")
     if r["host_cpus"] <= 1:
         print("NOTE: this host exposes 1 CPU; the client fan-out and the "
               "store server share one core, so loopback wall-clock cannot "

@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Lint: no new raw ``requests`` call sites may bypass the resilience layer,
-and no new raw ``worker.alive`` checks may bypass the liveness watchdog.
+no new raw ``worker.alive`` checks may bypass the liveness watchdog, and no
+new raw ``os.replace`` in ``data_store/`` may bypass the durable-write
+helper.
 
 Every HTTP call in ``kubetorch_tpu/`` is supposed to ride one of the three
 resilient choke points (``netpool.request``, ``HTTPClient.call_method``'s
@@ -23,6 +25,16 @@ submit, not that its death will ever be *noticed*. Death detection,
 classification, fail-fast future resolution, and restart policy all belong
 to the watchdog; the baseline below enumerates the deliberate exceptions
 (shutdown join loops and health aggregation in ``process_pool.py``).
+
+The third check (ISSUE 4) guards crash consistency: a raw ``os.replace``
+in ``kubetorch_tpu/data_store/`` outside ``durability.py`` commits a
+rename WITHOUT the paired data + parent-dir fsync, so a node crash can
+leave a truncated blob under its final content-addressed name — visible
+to ``tree_diff``, downloaded as garbage by every client forever. Server-
+side commits must ride ``durability.durable_replace``; the baseline
+enumerates the client-side files whose targets are rebuildable from the
+store (pod cache, pull destinations) and therefore deliberately skip the
+fsync tax.
 
 Run: ``python scripts/check_resilience.py`` (wired into ``make lint``).
 """
@@ -81,6 +93,24 @@ ALIVE_RE = re.compile(r"\.alive\b")
 ALIVE_EXEMPT = {"watchdog.py"}
 ALIVE_BASELINE = {
     "serving/process_pool.py": 8,
+}
+
+# Raw commit renames in data_store/ outside the durable-write layer.
+# durability.py itself is exempt (it IS the helper). The baselined sites
+# are all CLIENT-side, where the write target is rebuildable from the
+# store on loss and the fsync tax would sit on the fetch hot path.
+REPLACE_RE = re.compile(r"\bos\.replace\(")
+REPLACE_EXEMPT = {"durability.py"}
+REPLACE_BASELINE = {
+    # the quarantine move: crash mid-move just re-detects the same
+    # mismatch on the next sweep — durability would buy nothing
+    "data_store/scrub.py": 1,
+    # pod-local P2P cache entries: re-fetchable, and cache_get self-evicts
+    # hash-mismatched entries anyway
+    "data_store/peer_cache.py": 2,
+    # pull destinations (verified against the manifest hash before the
+    # rename) + the best-effort hash cache
+    "data_store/sync.py": 2,
 }
 
 
@@ -142,17 +172,44 @@ def main() -> int:
               "ALIVE_BASELINE with a justification.")
         return 1
 
+    replace_failures = []
+    replace_counts = {}
+    for path in sorted((PKG / "data_store").rglob("*.py")):
+        if path.name in REPLACE_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, REPLACE_RE)
+        if n:
+            replace_counts[rel] = n
+        allowed = REPLACE_BASELINE.get(rel, 0)
+        if n > allowed:
+            replace_failures.append(
+                f"  {rel}: {n} raw os.replace call site(s), baseline "
+                f"allows {allowed}")
+    if replace_failures:
+        print("check_resilience: raw os.replace commits bypass the "
+              "durable-write helper:\n" + "\n".join(replace_failures))
+        print("\nServer-side commit renames must use "
+              "durability.durable_replace (data fsync + parent-dir fsync, "
+              "KT_STORE_FSYNC) or a crash can publish a truncated object "
+              "under its final content-addressed name. For client-side "
+              "rebuildable targets update REPLACE_BASELINE with a "
+              "justification.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
         + [f for f, allowed in ALIVE_BASELINE.items()
-           if alive_counts.get(f, 0) < allowed])
+           if alive_counts.get(f, 0) < allowed]
+        + [f for f, allowed in REPLACE_BASELINE.items()
+           if replace_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
     else:
-        print("check_resilience: OK — all HTTP call sites and worker-"
-              "liveness checks accounted for")
+        print("check_resilience: OK — all HTTP call sites, worker-liveness "
+              "checks, and data-store commit renames accounted for")
     return 0
 
 

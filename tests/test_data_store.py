@@ -472,6 +472,121 @@ def test_peer_cache_roundtrip(tmp_path, monkeypatch):
     assert peer_cache.cache_get("k1") is None
 
 
+# ---------------------------------------------------------------------------
+# Crash-consistent store: key escaping, delete hygiene, peer persistence
+# (ISSUE 4; the kill/corrupt/full proofs live in test_store_chaos.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_key_escaping_symmetric_and_traversal_rejected(store):
+    """Keys containing a literal ``%2F`` and keys containing ``/`` are
+    distinct entries that round-trip exactly through /keys; traversal keys
+    are rejected with 400 instead of resolving outside the store root."""
+    import requests
+
+    # the two keys the old one-way escape collided: 'esc/key' vs 'esc%2Fkey'
+    # (sent double-encoded on the wire so unquote yields the literal %2F)
+    r1 = requests.put(f"{store}/kv/esc/key", data=b"slash", timeout=30)
+    r2 = requests.put(f"{store}/kv/esc%252Fkey", data=b"percent", timeout=30)
+    assert r1.status_code == r2.status_code == 200
+    assert requests.get(f"{store}/kv/esc/key", timeout=30).content == b"slash"
+    assert requests.get(f"{store}/kv/esc%252Fkey",
+                        timeout=30).content == b"percent"
+    keys = {k["key"] for k in requests.get(
+        f"{store}/keys", params={"prefix": "esc"}, timeout=30).json()["keys"]}
+    assert {"esc/key", "esc%2Fkey"} <= keys        # exact round-trip
+    for key in ("esc/key", "esc%252Fkey"):
+        requests.delete(f"{store}/kv/{key}", timeout=30)
+
+    # '..' would resolve root/kv/.. to the store root itself
+    assert requests.put(f"{store}/kv/%2E%2E", data=b"x",
+                        timeout=30).status_code == 400
+    assert requests.get(f"{store}/kv/%2E%2E", timeout=30).status_code == 400
+    assert requests.post(f"{store}/tree/%2E%2E/commit", json={"files": {}},
+                         timeout=30).status_code == 400
+
+
+@pytest.mark.slow
+def test_kv_delete_removes_meta_and_tmp_siblings(store, tmp_path):
+    """DELETE reaps the .meta and any in-flight .tmp siblings, and is
+    idempotent under repeated delete."""
+    import requests
+
+    requests.put(f"{store}/kv/del/k", data=b"v",
+                 headers={"X-KT-Meta": "{}"}, timeout=30)
+    r = requests.delete(f"{store}/kv/del/k", timeout=30)
+    assert r.status_code == 200 and r.json()["existed"]
+    r = requests.delete(f"{store}/kv/del/k", timeout=30)
+    assert r.status_code == 200 and not r.json()["existed"]   # idempotent
+    assert requests.get(f"{store}/kv/del/k", timeout=30).status_code == 404
+    # a re-created key must not inherit a stale meta: diff says missing
+    requests.put(f"{store}/kv/del/k", data=b"v2", timeout=30)
+    requests.delete(f"{store}/kv/del/k", timeout=30)
+    import hashlib as _h
+    h = _h.blake2b(b"v2", digest_size=20).hexdigest()
+    r = requests.post(f"{store}/kv/diff", json={"keys": {"del/k": h}},
+                      timeout=30)
+    assert r.json()["missing"] == ["del/k"]
+
+
+def test_delete_sweeps_tmp_siblings_on_disk(tmp_path):
+    """Unit-level: kv/tree delete unlink in-flight .tmp siblings so killed
+    uploads can't accumulate unbounded."""
+    import asyncio
+
+    from kubetorch_tpu.data_store import store_server as ss
+
+    st = ss.StoreState(str(tmp_path / "root"))
+    kv = st.kv_path("a/b")
+    kv.write_bytes(b"v")
+    kv.with_name(kv.name + ".meta").write_text("{}")
+    kv.with_name(kv.name + ".11112222.tmp").write_bytes(b"partial")
+    kv.with_name(kv.name + ".meta.33334444.tmp").write_bytes(b"partial")
+    tree = st.tree_path("t/x")
+    tree.write_text("{}")
+    tree.with_name(tree.name + ".55556666.tmp").write_text("partial")
+
+    class _Req:
+        def __init__(self, app, key):
+            self.app, self.match_info = app, {"key": key}
+
+    app = {"store": st}
+    asyncio.run(ss.kv_delete(_Req(app, "a/b")))
+    asyncio.run(ss.tree_delete(_Req(app, "t/x")))
+    assert not list((st.root / "kv").iterdir())
+    assert not list((st.root / "trees").iterdir())
+
+
+def test_peer_registry_persists_and_ttl_expires(tmp_path, monkeypatch):
+    """/register state survives a store restart via root/peers.json;
+    TTL-stale entries are dropped on reload and on lookup."""
+    import json as _json
+    import time as _time
+
+    from kubetorch_tpu.data_store import scrub
+    from kubetorch_tpu.data_store.store_server import StoreState
+
+    root = tmp_path / "root"
+    st = StoreState(str(root))
+    st.peers["w/step1"] = {"ip": "10.0.0.1", "port": 8873, "ts": _time.time()}
+    st.save_peers()
+
+    st2 = StoreState(str(root))                     # "restart"
+    assert st2.peers["w/step1"]["ip"] == "10.0.0.1"
+
+    # stale entry (written by a long-dead run) expires on reload
+    stale = {"w/old": {"ip": "10.0.0.9", "port": 1, "ts": _time.time() - 10},
+             "w/new": {"ip": "10.0.0.2", "port": 2, "ts": _time.time()}}
+    (root / scrub.PEERS_FILE).write_text(_json.dumps(stale))
+    monkeypatch.setenv("KT_PEER_TTL_S", "5")
+    st3 = StoreState(str(root))
+    assert set(st3.peers) == {"w/new"}
+    # corrupt snapshot degrades to empty, never a crash
+    (root / scrub.PEERS_FILE).write_text("not json{")
+    assert StoreState(str(root)).peers == {}
+
+
 @pytest.mark.slow
 def test_route_eager_tree_assignment(store):
     """Routing protocol: first member roots at the store; later members are

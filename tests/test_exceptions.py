@@ -27,6 +27,11 @@ _ATTR_SAMPLES = {
     "cause": "OOMKilled",
     "rank": 2,
     "exitcode": -9,
+    "path": "/data/blobs/ab/abcdef",
+    "key": "ckpt/step100/layers/wq",
+    "expected": "aa" * 20,
+    "actual": "bb" * 20,
+    "source": "peer",
 }
 
 

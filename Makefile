@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos lint bench bench-store smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos lint bench bench-store bench-trace smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -38,6 +38,11 @@ bench:
 # data-plane microbench: pytree put/get MB/s, cold vs delta (ISSUE 1)
 bench-store:
 	$(PY_CPU) python scripts/bench_datastore.py
+
+# telemetry overhead budget (ISSUE 5): put/get hot path, tracing off vs on
+# — enforced <3% enabled, ~0% disabled (the allocation-free fast path)
+bench-trace:
+	$(PY_CPU) python scripts/bench_datastore.py --trace-overhead
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -87,6 +87,10 @@ _LAZY = {
     # module-valued: kt.models.load_hf / kt.models.LlamaConfig (the HF
     # migration surface); resolved to the module itself by __getattr__
     "models": ".models",
+    # module-valued: kt.telemetry.span / kt.telemetry.counter — the
+    # user-facing half of the tracing + metrics plane (ISSUE 5): user code
+    # can open spans inside a traced request and register its own series
+    "telemetry": ".telemetry",
 }
 
 

@@ -81,9 +81,17 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from . import telemetry
 from .exceptions import (ControllerRequestError, HbmOomError,
                          PodTerminatedError, StoreFullError,
                          package_exception)
+
+# every injected fault lands on the active request span as a "chaos.fault"
+# event (plus a counter), so chaos tests assert *through traces*: the
+# waterfall for a KT_CHAOS run shows exactly which attempts were faulted
+_CHAOS_FAULTS = telemetry.counter(
+    "kt_chaos_faults_total", "Faults injected by the chaos engine",
+    labels=("kind",))
 
 CHAOS_ENV = "KT_CHAOS"
 CHAOS_SEED_ENV = "KT_CHAOS_SEED"
@@ -321,6 +329,10 @@ def chaos_middleware(engine: ChaosEngine):
         fault = engine.next_fault(request.path, request.method)
         if fault is None:
             return await handler(request)
+        _CHAOS_FAULTS.inc(kind=fault.kind)
+        telemetry.add_event(
+            "chaos.fault", kind=fault.kind, path=request.path,
+            **({"status": fault.status} if fault.kind == "status" else {}))
         if fault.kind == "delay":
             await asyncio.sleep(fault.seconds)
             return await handler(request)

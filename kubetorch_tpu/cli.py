@@ -280,6 +280,60 @@ def run(command, name, port, cpus, tpu):
     click.echo(f"{a.name} → {a.service_url}")
 
 
+# -- trace -------------------------------------------------------------------
+
+
+@cli.command("trace")
+@click.argument("query")
+@click.option("--service", default=None,
+              help="Resolve the pod URL for this deployed service via the "
+                   "controller (default when --url is not given).")
+@click.option("--url", default=None,
+              help="Query this server's /debug/traces directly (a pod or "
+                   "store URL) — no controller needed.")
+@click.option("--namespace", default=None)
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw span dicts instead of the waterfall view.")
+def trace_cmd(query, service, url, namespace, as_json):
+    """Waterfall view of one request's trace: ``kt trace <request_id>``
+    (or a trace id). Reads the serving pod's ``/debug/traces`` flight
+    recorder, which includes rank-worker and store-fetch spans shipped
+    back across the process boundary."""
+    from . import telemetry
+
+    if url is None:
+        if service is None:
+            raise click.UsageError("pass --service (resolved via the "
+                                   "controller) or --url <pod url>")
+        from .client import controller_client
+        record = controller_client().get_workload(
+            namespace or kt_config().namespace, service)
+        url = record.get("service_url")
+        if not url:
+            raise click.ClickException(f"service {service!r} has no URL")
+    import requests as _requests
+    try:
+        r = _requests.get(f"{url.rstrip('/')}/debug/traces",
+                          params={"q": query}, timeout=10)
+    except _requests.RequestException as e:
+        raise click.ClickException(f"cannot reach {url}: {e}")
+    if r.status_code != 200:
+        raise click.ClickException(
+            f"/debug/traces → {r.status_code}: {r.text[:200]}")
+    body = r.json()
+    spans = body.get("spans", [])
+    if as_json:
+        click.echo(json.dumps(spans, indent=2, default=str))
+        return
+    if not spans:
+        state = ("" if body.get("enabled", True)
+                 else " (tracing is DISABLED on that server: KT_TRACE=0)")
+        click.echo(f"no spans for {query!r}{state} — the ring keeps the "
+                   f"last {body.get('ring_size', 0)}+ spans per process")
+        return
+    click.echo(telemetry.format_waterfall(spans))
+
+
 # -- logs --------------------------------------------------------------------
 
 

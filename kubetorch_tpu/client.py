@@ -43,9 +43,18 @@ class ControllerClient:
         acted on an established one. A dead *local daemon* is additionally
         re-resolved once per call (its durable state revives under a fresh
         daemon); user-configured URLs are never silently redirected."""
+        from . import telemetry
+
         policy = controller_policy()
         idempotent = method in _IDEMPOTENT_VERBS
         recovered = [False]
+        # control-plane hops join the active trace too: a deploy or
+        # workload lookup mid-call shows up on the same waterfall, and the
+        # controller's own downstream requests can keep propagating it
+        if telemetry.current_header() is not None:
+            hdrs = dict(kwargs.get("headers") or {})
+            telemetry.inject(hdrs)
+            kwargs["headers"] = hdrs
 
         def _attempt(info):
             url = f"{self.base_url}{path}"
@@ -76,8 +85,11 @@ class ControllerClient:
             return ra if ra is not None else True
 
         try:
-            resp = policy.run(_attempt, retryable_exc=_retryable,
-                              response_retry_delay=_resp_retry)
+            with telemetry.span("controller.request", method=method,
+                                path=path) as sp:
+                resp = policy.run(_attempt, retryable_exc=_retryable,
+                                  response_retry_delay=_resp_retry)
+                sp.set_attr("status", resp.status_code)
         except _requests.RequestException as e:
             raise ControllerRequestError(
                 f"Controller unreachable at {self.base_url}{path}: {e}")

@@ -69,6 +69,14 @@ class KTConfig:
     scrub_rate_mbps: float = 64.0
     peer_ttl_s: float = 3600.0
     gc_grace_s: float = 3600.0
+    # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
+    # recording everywhere (the fast path stays allocation-free, see `make
+    # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing
+    # /debug/traces and `kt trace`. telemetry.py reads the env vars
+    # directly (it is import-cycle-free by design); these fields document
+    # and layer them for `kt config`.
+    trace: bool = True
+    trace_ring: int = 2048
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

@@ -33,10 +33,19 @@ from typing import Any, Dict, List, Optional
 
 import requests as _requests
 
+from .. import telemetry
 from ..config import config
 from ..exceptions import DataCorruptionError, DataStoreError
 from . import netpool
 from .types import BroadcastWindow
+
+# per-blob fetch accounting by source (pod cache / peer / origin store):
+# the P2P fan-out's effectiveness as a scrapeable series, and the source
+# tag on every store-fetch span in the waterfall
+_FETCHES = telemetry.counter(
+    "kt_store_fetches_total",
+    "Blob/leaf fetches by serving source",
+    labels=("source",))
 
 _INDEX_SUFFIX = ".__kt_index__"
 
@@ -420,6 +429,27 @@ class _RoutedFetcher:
 
     def fetch(self, subkey: str, timeout: Optional[float] = None,
               expect_hash: Optional[str] = None):
+        """GET one subkey (traced): opens a ``store.fetch`` span tagged
+        with the serving source (``pod-cache`` / ``peer`` / ``store``) and
+        byte count, observes the ``store_fetch`` stage histogram, then
+        delegates to :meth:`_fetch_inner`."""
+        if telemetry.enabled():
+            sp = telemetry.span("store.fetch", key=subkey)
+        else:
+            sp = telemetry.NOOP_SPAN
+        with sp:
+            r = self._fetch_inner(subkey, timeout, expect_hash, sp)
+            if sp:
+                sp.set_attr("status", getattr(r, "status_code", None))
+                content = getattr(r, "content", None)
+                if content is not None:
+                    sp.set_attr("bytes", len(content))
+        if sp:
+            telemetry.observe_stage("store_fetch", sp.end - sp.start)
+        return r
+
+    def _fetch_inner(self, subkey: str, timeout: Optional[float],
+                     expect_hash: Optional[str], sp):
         """GET one subkey; returns the response (store-shaped: 200 + body +
         X-KT-Meta). Order: pod-local cache (another rank worker may already
         hold it — zero network), then the assigned peer, then the store.
@@ -456,6 +486,8 @@ class _RoutedFetcher:
                     _verify_content(hit[0], hit[1], expect_hash, subkey,
                                     "pod-cache")
                     self._fetched = True
+                    sp.set_attr("source", "pod-cache")
+                    _FETCHES.inc(source="pod-cache")
                     return _CachedResponse(*hit)
                 except DataCorruptionError:
                     # self-heal the pod cache: drop the rotten entry and
@@ -494,6 +526,8 @@ class _RoutedFetcher:
                     if self.peer_url == peer:
                         self._deadline = None
                 self._cache(subkey, r)
+                sp.set_attr("source", "peer")
+                _FETCHES.inc(source="peer")
                 return r
             if r.status_code != 404:
                 break            # parent errored; store covers this one
@@ -516,6 +550,8 @@ class _RoutedFetcher:
             _verify_content(r.content, _response_meta(r), expect_hash,
                             subkey, "store")
             self._cache(subkey, r)
+            _FETCHES.inc(source="store")
+        sp.set_attr("source", "store")
         return r
 
     def _evict_peer(self, peer: str) -> None:

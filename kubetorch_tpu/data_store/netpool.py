@@ -29,6 +29,8 @@ from typing import Callable, Iterable, List, Optional, TypeVar
 import requests as _requests
 from requests.adapters import HTTPAdapter
 
+from .. import telemetry
+
 T = TypeVar("T")
 R = TypeVar("R")
 
@@ -190,12 +192,32 @@ def request(method: str, url: str, *, timeout: Optional[float] = None,
         ra = retry_after_seconds(resp)
         return ra if ra is not None else True
 
-    resp = policy.run(
-        _attempt,
-        retryable_exc=lambda e: isinstance(e, ESTABLISHED_TRANSIENT_EXCS),
-        response_retry_delay=_resp_retry,
-        breaker=breaker,
-        record=record)
+    # span per store op, continuing the caller's trace over the wire (the
+    # store server parents onto X-KT-Trace) — retry/backoff events from the
+    # policy land on it. Disabled tracing → NOOP_SPAN taken without even
+    # building the attrs dict: this is the hot path the bench-trace regime
+    # holds to ~0% disabled overhead.
+    if telemetry.enabled():
+        sp = telemetry.span("store.request", method=method,
+                            path=url.split("/", 3)[-1][:120])
+    else:
+        sp = telemetry.NOOP_SPAN
+    with sp:
+        if sp:
+            hdrs = dict(kwargs.get("headers") or {})
+            telemetry.inject(hdrs)
+            kwargs["headers"] = hdrs
+        resp = policy.run(
+            _attempt,
+            retryable_exc=lambda e: isinstance(e, ESTABLISHED_TRANSIENT_EXCS),
+            response_retry_delay=_resp_retry,
+            breaker=breaker,
+            record=record)
+        if sp:
+            sp.set_attr("status", resp.status_code)
+            clen = resp.headers.get("Content-Length")
+            if clen is not None:
+                sp.set_attr("bytes", clen)
     if getattr(resp, "status_code", None) == 507:
         raise _store_full_error(resp, url)
     return resp

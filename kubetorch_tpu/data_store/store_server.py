@@ -47,11 +47,54 @@ from pathlib import Path
 from typing import Dict, Optional, Tuple
 from aiohttp import web
 
+from .. import telemetry
 from ..exceptions import StoreFullError, package_exception
 from . import durability, scrub
 
 MAX_BODY = 10 * 1024 ** 3
 UPLOAD_CHUNK = 1 << 20          # streaming read granularity for PUT bodies
+
+# untraced plumbing: probes and the observability surface itself must not
+# fill the span ring at scrape cadence
+_TRACE_EXEMPT = ("/health", "/metrics", "/debug/traces", "/scrub/status")
+
+_STORE_REQS = telemetry.counter(
+    "kt_store_requests_total",
+    "Store-server requests by route class and method",
+    labels=("route", "method"))
+_STORE_BYTES = telemetry.counter(
+    "kt_store_transfer_bytes_total",
+    "Bytes served (GET) / accepted (PUT) by the store server",
+    labels=("direction",))
+
+
+@web.middleware
+async def store_trace_middleware(request: web.Request, handler):
+    """Per-request span continuing the client's ``X-KT-Trace`` context —
+    every blob/kv/tree transfer shows up in the waterfall with its byte
+    count, and injected chaos faults annotate the active span."""
+    if request.path.startswith(_TRACE_EXEMPT):
+        return await handler(request)
+    route = request.path.split("/", 2)[1] if "/" in request.path else ""
+    _STORE_REQS.inc(route=route, method=request.method)
+    ctx = telemetry.extract(request.headers)
+    with telemetry.span("store.server", parent=ctx, path=request.path[:120],
+                        method=request.method) as sp:
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            sp.set_attr("status", e.status)
+            raise
+        if sp:
+            sp.set_attr("status", resp.status)
+            # GET: the response body IS the transfer; for PUTs the handler
+            # already recorded the accepted byte count (a PUT's tiny JSON
+            # ack must not overwrite it)
+            size = getattr(resp, "content_length", None)
+            if size and request.method == "GET":
+                sp.set_attr("bytes", size)
+                _STORE_BYTES.inc(size, direction="out")
+        return resp
 
 
 class StoreState:
@@ -146,6 +189,10 @@ async def _stream_to_tmp(request: web.Request, path: Path) -> Tuple[Path, str, i
                     path=str(path)))),
                 content_type="application/json")
         raise
+    _STORE_BYTES.inc(size, direction="in")
+    cur = telemetry.current_span()
+    if cur is not None:
+        cur.set_attr("bytes", size)
     return tmp, hasher.hexdigest(), size
 
 
@@ -585,15 +632,50 @@ async def health(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok"})
 
 
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus exposition off the shared registry: request/transfer
+    counters above plus whatever the scrubber/chaos/resilience layers
+    recorded in this process — the store side of the unified metrics
+    plane (deploy/metrics.yaml scrapes it like any pod)."""
+    st = _state(request)
+    telemetry.gauge("kt_store_uptime_seconds",
+                    "Seconds since this store process started").set(
+        time.time() - request.app["started_at"])
+    telemetry.gauge("kt_store_peers", "Registered P2P peers").set(
+        len(st.peers))
+    return web.Response(body=telemetry.REGISTRY.render().encode(),
+                        content_type="text/plain")
+
+
+async def debug_traces(request: web.Request) -> web.Response:
+    """Same flight-recorder surface as the pod server: the store's span
+    ring, queryable by trace id or request id."""
+    limit = None
+    try:
+        if request.query.get("limit"):
+            limit = max(1, int(request.query["limit"]))
+    except ValueError:
+        return web.json_response({"error": "bad limit"}, status=400)
+    return web.json_response(telemetry.debug_traces_payload(
+        request.query.get("q") or request.query.get("request_id"),
+        limit=limit))
+
+
 def create_store_app(root: str) -> web.Application:
     # fault injection (KT_CHAOS, see kubetorch_tpu.chaos): lets tests prove
     # the data plane's retry/Retry-After behavior against a real store
     from ..chaos import maybe_chaos_middleware
     chaos_mw, chaos_engine = maybe_chaos_middleware()
-    app = web.Application(client_max_size=MAX_BODY,
-                          middlewares=[chaos_mw] if chaos_mw else [])
+    # trace middleware outermost so injected chaos faults annotate the
+    # request's span (faults model the network, so chaos stays in front of
+    # all store logic)
+    middlewares = [store_trace_middleware]
+    if chaos_mw:
+        middlewares.append(chaos_mw)
+    app = web.Application(client_max_size=MAX_BODY, middlewares=middlewares)
     app["chaos"] = chaos_engine
     app["store"] = StoreState(root)
+    app["started_at"] = time.time()
     app["scrubber"] = scrub.Scrubber(app["store"].root)
 
     async def _scrub_loop(app: web.Application):
@@ -616,6 +698,8 @@ def create_store_app(root: str) -> web.Application:
     app.on_shutdown.append(_on_shutdown)
     r = app.router
     r.add_get("/health", health)
+    r.add_get("/metrics", metrics)
+    r.add_get("/debug/traces", debug_traces)
     r.add_put("/blob/{hash}", put_blob)
     r.add_get("/blob/{hash}", get_blob)
     r.add_post("/tree/{key:.+}/diff", tree_diff)

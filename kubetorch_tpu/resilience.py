@@ -39,7 +39,38 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import requests as _requests
 
+from . import telemetry
 from .exceptions import CircuitOpenError, DeadlineExceededError
+
+# Flight-recorder hooks (ISSUE 5): every retry attempt, backoff sleep,
+# breaker transition, and deadline rejection is a span event on whatever
+# request is active plus a registry counter — so a chaos test (or an
+# operator) can assert retries *through traces* instead of sleep-counting.
+_RETRIES = telemetry.counter(
+    "kt_retry_attempts_total",
+    "Retries performed by RetryPolicy.run/arun, by trigger",
+    labels=("reason",))
+_DEADLINE_REJECTED = telemetry.counter(
+    "kt_deadline_rejections_total",
+    "Calls abandoned because the propagated deadline expired",
+    labels=("where",))
+_BREAKER_TRANSITIONS = telemetry.counter(
+    "kt_breaker_transitions_total",
+    "Circuit-breaker state transitions",
+    labels=("to",))
+
+
+def _record_retry(attempt: int, delay: float, reason: str, **attrs) -> None:
+    _RETRIES.inc(reason=reason)
+    telemetry.add_event("retry", attempt=attempt,
+                        delay_s=round(delay, 6), reason=reason, **attrs)
+    telemetry.observe_stage("retry_sleep", delay)
+
+
+def _record_deadline(where: str, deadline_at: float) -> None:
+    _DEADLINE_REJECTED.inc(where=where)
+    telemetry.add_event("deadline_rejected", where=where,
+                        deadline=deadline_at)
 
 # HTTP statuses that mean "the server (or something in front of it) is
 # transiently unhappy" — safe to retry when the request itself is idempotent.
@@ -224,6 +255,7 @@ class RetryPolicy:
         attempt = 0
         while True:
             if deadline is not None and deadline.expired():
+                _record_deadline("before_attempt", deadline.at)
                 raise DeadlineExceededError(
                     f"deadline expired before attempt {attempt + 1}",
                     deadline=deadline.at)
@@ -243,6 +275,8 @@ class RetryPolicy:
                 if last or not retryable_exc(e):
                     raise
                 delay = self._delay(rng, attempt)
+                retry_info = {"reason": "exception",
+                              "error": type(e).__name__}
             else:
                 verdict = (response_retry_delay(resp)
                            if response_retry_delay is not None else None)
@@ -257,12 +291,17 @@ class RetryPolicy:
                 delay = self._delay(rng, attempt)
                 if verdict is not True:
                     delay = max(delay, float(verdict))
+                retry_info = {"reason": "status",
+                              "status": getattr(resp, "status_code", None)
+                              or getattr(resp, "status", None)}
             if deadline is not None and deadline.remaining() <= delay:
+                _record_deadline("backoff", deadline.at)
                 raise DeadlineExceededError(
                     f"deadline would expire during backoff after attempt "
                     f"{attempt + 1}", deadline=deadline.at)
             if record is not None:
                 record.append(delay)
+            _record_retry(attempt, delay, **retry_info)
             sleep(delay)
             attempt += 1
 
@@ -287,6 +326,7 @@ class RetryPolicy:
         attempt = 0
         while True:
             if deadline is not None and deadline.expired():
+                _record_deadline("before_attempt", deadline.at)
                 raise DeadlineExceededError(
                     f"deadline expired before attempt {attempt + 1}",
                     deadline=deadline.at)
@@ -306,6 +346,8 @@ class RetryPolicy:
                 if last or not retryable_exc(e):
                     raise
                 delay = self._delay(rng, attempt)
+                retry_info = {"reason": "exception",
+                              "error": type(e).__name__}
             else:
                 verdict = (response_retry_delay(resp)
                            if response_retry_delay is not None else None)
@@ -320,12 +362,17 @@ class RetryPolicy:
                 delay = self._delay(rng, attempt)
                 if verdict is not True:
                     delay = max(delay, float(verdict))
+                retry_info = {"reason": "status",
+                              "status": getattr(resp, "status_code", None)
+                              or getattr(resp, "status", None)}
             if deadline is not None and deadline.remaining() <= delay:
+                _record_deadline("backoff", deadline.at)
                 raise DeadlineExceededError(
                     f"deadline would expire during backoff after attempt "
                     f"{attempt + 1}", deadline=deadline.at)
             if record is not None:
                 record.append(delay)
+            _record_retry(attempt, delay, **retry_info)
             await asyncio.sleep(delay)
             attempt += 1
 
@@ -477,6 +524,13 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    @staticmethod
+    def _transition(to: str) -> None:
+        """Counter + span event per state change — breaker trips become
+        queryable (and assertable) instead of vanishing into fast-fails."""
+        _BREAKER_TRANSITIONS.inc(to=to)
+        telemetry.add_event("breaker_transition", to=to)
+
     def allow(self) -> None:
         with self._lock:
             if self._state == "closed":
@@ -484,6 +538,8 @@ class CircuitBreaker:
             if self._state == "open":
                 elapsed = self._clock() - self._opened_at
                 if elapsed < self.cooldown_s:
+                    telemetry.add_event("breaker_rejected",
+                                        failures=self._failures)
                     raise CircuitOpenError(
                         f"circuit open ({self._failures} consecutive "
                         f"failures); retry in "
@@ -491,8 +547,10 @@ class CircuitBreaker:
                         retry_after=self.cooldown_s - elapsed)
                 self._state = "half-open"
                 self._probe_out = False
+                self._transition("half-open")
             # half-open: admit exactly one probe at a time
             if self._probe_out:
+                telemetry.add_event("breaker_rejected", probe_in_flight=True)
                 raise CircuitOpenError(
                     "circuit half-open; probe already in flight",
                     retry_after=self.cooldown_s)
@@ -500,9 +558,12 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was = self._state
             self._state = "closed"
             self._failures = 0
             self._probe_out = False
+            if was != "closed":
+                self._transition("closed")
 
     def record_failure(self) -> None:
         with self._lock:
@@ -510,12 +571,14 @@ class CircuitBreaker:
                 self._state = "open"
                 self._opened_at = self._clock()
                 self._probe_out = False
+                self._transition("open")
                 return
             self._failures += 1
             if self._state == "closed" and \
                     self._failures >= self.failure_threshold:
                 self._state = "open"
                 self._opened_at = self._clock()
+                self._transition("open")
 
     def call(self, fn: Callable[[], Any]) -> Any:
         """Convenience wrapper for a single guarded call."""

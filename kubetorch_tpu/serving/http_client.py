@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 import requests as _requests
 
 from .. import serialization as ser
+from .. import telemetry
 from ..config import config
 from ..exceptions import ControllerRequestError, rehydrate_exception
 from ..resilience import (DEADLINE_HEADER, ESTABLISHED_TRANSIENT_EXCS,
@@ -214,6 +215,14 @@ class HTTPClient:
             if idempotency_key:
                 headers["X-KT-Idempotency-Key"] = idempotency_key
 
+            # the client-side root of the request's trace: the span context
+            # rides X-KT-Trace so the pod server (and everything behind it)
+            # parents onto it, and the retry loop's attempt/backoff events
+            # land on it (resilience.py emits into the active span)
+            client_span = telemetry.span(
+                "client.call", fn=fn_name, method=method or "",
+                request_id=request_id, url=self.base_url)
+
             def _attempt(info):
                 t = _clamp_timeout(timeout, info.timeout)
                 try:
@@ -235,13 +244,16 @@ class HTTPClient:
                         data=data, headers=headers, timeout=t)
 
             self.last_retry_delays = []
-            resp = policy.run(
-                _attempt,
-                retryable_exc=lambda e: _retryable_exc(e, idempotency_key),
-                response_retry_delay=lambda r: _response_retry(
-                    r.status_code, r.content, r, idempotency_key),
-                deadline=dl,
-                record=self.last_retry_delays)
+            with client_span as sp:
+                telemetry.inject(headers)
+                resp = policy.run(
+                    _attempt,
+                    retryable_exc=lambda e: _retryable_exc(e, idempotency_key),
+                    response_retry_delay=lambda r: _response_retry(
+                        r.status_code, r.content, r, idempotency_key),
+                    deadline=dl,
+                    record=self.last_retry_delays)
+                sp.set_attr("status", resp.status_code)
         finally:
             if stop_streaming:
                 stop_streaming()
@@ -288,8 +300,9 @@ class HTTPClient:
             body["_kt_workers"] = workers
         url = f"{self.base_url}/{fn_name}" + (f"/{method}" if method else "")
         data = ser.serialize(body, self.serialization)
+        request_id = uuid.uuid4().hex[:16]
         headers = {"X-Serialization": self.serialization,
-                   "X-Request-ID": uuid.uuid4().hex[:16]}
+                   "X-Request-ID": request_id}
         policy = retry or self.retry or http_policy()
         dl = None
         if deadline is not None:
@@ -333,13 +346,17 @@ class HTTPClient:
                     asyncio.TimeoutError))
 
         self.last_retry_delays = []
-        cr = await policy.arun(
-            _attempt,
-            retryable_exc=_aio_retryable,
-            response_retry_delay=lambda r: _response_retry(
-                r.status, r.body, r, idempotency_key),
-            deadline=dl,
-            record=self.last_retry_delays)
+        with telemetry.span("client.call", fn=fn_name, method=method or "",
+                            request_id=request_id, url=self.base_url) as sp:
+            telemetry.inject(headers)
+            cr = await policy.arun(
+                _attempt,
+                retryable_exc=_aio_retryable,
+                response_retry_delay=lambda r: _response_retry(
+                    r.status, r.body, r, idempotency_key),
+                deadline=dl,
+                record=self.last_retry_delays)
+            sp.set_attr("status", cr.status)
         return cr.result()
 
     # -- health ---------------------------------------------------------------

@@ -41,6 +41,7 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from .. import serialization as ser
+from .. import telemetry
 from ..exceptions import (DeadlineExceededError, KubetorchError,
                           PodTerminatedError, SerializationError,
                           WorkerDiedError, package_exception)
@@ -58,7 +59,12 @@ from ..constants import server_port
 request_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
     "kt_request_id", default="")
 
-RESERVED_ROUTES = {"health", "ready", "metrics", "app", "_kt"}
+RESERVED_ROUTES = {"health", "ready", "metrics", "app", "_kt", "debug"}
+
+# probes and the observability surface itself are never spanned: a 3s
+# scrape cadence would churn the whole trace ring in minutes (they still
+# get X-Request-ID — the header contract covers every response)
+TRACE_EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/debug/traces")
 
 
 class ServerState:
@@ -260,11 +266,39 @@ class ServerState:
 
 @web.middleware
 async def request_id_middleware(request: web.Request, handler):
+    """Outermost middleware: request-id binding + the server span.
+
+    Every response — success, middleware short-circuit (504 deadline
+    rejection, 503 recovering/terminating, idempotent replay), and
+    ``HTTPException`` raises — carries ``X-Request-ID`` back, so a client
+    holding only the id can always find the failing request in logs and
+    traces. The span continues the client's ``X-KT-Trace`` context when
+    present (its id is echoed in ``X-KT-Trace-Id``); chaos, deadline, and
+    idempotency middlewares all run inside it, so injected faults and
+    rejections land on the request's own span."""
     rid = request.headers.get("X-Request-ID") or uuid.uuid4().hex[:16]
     request_id_var.set(rid)
     request["kt_request_id"] = rid
-    resp = await handler(request)
-    resp.headers["X-Request-ID"] = rid
+    if request.path.startswith(TRACE_EXEMPT_PATHS):
+        sp = telemetry.NOOP_SPAN
+    else:
+        sp = telemetry.span("server.request",
+                            parent=telemetry.extract(request.headers),
+                            request_id=rid, path=request.path,
+                            method=request.method)
+    with sp:
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            # aiohttp exception-responses bypass the normal return path —
+            # they must not lose the id
+            e.headers["X-Request-ID"] = rid
+            sp.set_attr("status", e.status)
+            raise
+        resp.headers["X-Request-ID"] = rid
+        if sp:
+            sp.set_attr("status", resp.status)
+            resp.headers.setdefault("X-KT-Trace-Id", sp.trace_id)
     return resp
 
 
@@ -472,8 +506,27 @@ async def metrics(request: web.Request) -> web.Response:
         for k, v in (user or {}).items():
             safe = re.sub(r"[^a-zA-Z0-9_]", "_", str(k))
             lines[f"kt_user_{safe}"] = v
-    extra = ("".join(f"{k} {v}\n" for k, v in lines.items())).encode()
+    # TYPE-headed exposition (ISSUE 5): the registry (stage histograms,
+    # retry/death/chaos counters) + the state-derived gauge lines above,
+    # label-escaped and grouped — never hand-joined "k v" pairs.
+    extra = (telemetry.REGISTRY.render()
+             + telemetry.render_untyped_gauges(lines)).encode()
     return web.Response(body=body + extra, content_type="text/plain")
+
+
+async def debug_traces(request: web.Request) -> web.Response:
+    """``GET /debug/traces[?q=<request_id|trace_id>][&limit=N]`` — this
+    process's span ring (including rank-worker spans shipped back over the
+    response queue). The flight recorder behind ``kt trace``."""
+    limit = None
+    try:
+        if request.query.get("limit"):
+            limit = max(1, int(request.query["limit"]))
+    except ValueError:
+        return web.json_response({"error": "bad limit"}, status=400)
+    return web.json_response(telemetry.debug_traces_payload(
+        request.query.get("q") or request.query.get("request_id"),
+        limit=limit))
 
 
 async def app_status(request: web.Request) -> web.Response:
@@ -595,7 +648,9 @@ async def _run_callable_inner(request: web.Request,
     try:
         raw = await request.read()
         try:
-            body = ser.deserialize(raw, fmt, allowed=state.allowed_serialization()) or {}
+            with telemetry.stage("deserialize", bytes=len(raw), fmt=fmt):
+                body = ser.deserialize(
+                    raw, fmt, allowed=state.allowed_serialization()) or {}
         except SerializationError as e:
             return _error_response(e, status=415)
 
@@ -625,7 +680,8 @@ async def _run_callable_inner(request: web.Request,
             from .pdb_ws import arm_debugger
             arm_debugger(body["debugger"])
 
-        result = await sup.call(method, args, kwargs, **call_kwargs)
+        with telemetry.stage("execute", fn=fn_name, method=method or ""):
+            result = await sup.call(method, args, kwargs, **call_kwargs)
         return web.Response(body=ser.serialize(result, fmt),
                             headers={"X-Serialization": fmt},
                             content_type="application/octet-stream"
@@ -661,6 +717,7 @@ def create_app(state: Optional[ServerState] = None) -> web.Application:
     app.router.add_get("/health", health)
     app.router.add_get("/ready", ready)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/app/status", app_status)
     app.router.add_post("/_kt/reload", reload_route)
     app.router.add_post("/_kt/profile", profile_route)

@@ -2,8 +2,15 @@
 
 Reference (``serving/log_capture.py``): replaces sys.stdout/stderr with
 interceptors, batches 100 entries / 1s, pushes to Loki with labels
-{service, pod, namespace, level, request_id}, dual-writes to the original
-streams so ``kubectl logs`` still works.
+{service, pod, namespace, level, request_id, trace_id}, dual-writes to the
+original streams so ``kubectl logs`` still works.
+
+``request_id`` comes from the server's contextvar and ``trace_id`` from the
+active telemetry span (ISSUE 5), so every ``kt logs`` line is joinable
+against ``kt trace <request_id>`` / ``/debug/traces``; rank-subprocess
+lines arrive with their own bindings over the response queue. The buffer
+flushes on atexit (via the registered :meth:`LogCapture.stop`) so one-shot
+processes don't lose their final batch.
 
 The sink here is pluggable: a Loki push endpoint when the charts deploy Loki,
 or the controller's ``/controller/logs`` ingestion route (our controller
@@ -92,10 +99,13 @@ class LogCapture:
         atexit.register(self.stop)
 
     def add(self, line: str, source: str = "stdout", level: str = "INFO",
-            request_id: Optional[str] = None) -> None:
-        """``request_id=None`` → this process's contextvar (server-side
-        interception); an explicit value (may be "") is authoritative —
-        rank-subprocess logs arrive with their own binding."""
+            request_id: Optional[str] = None,
+            trace_id: Optional[str] = None) -> None:
+        """``request_id=None`` / ``trace_id=None`` → this process's
+        contextvars (server-side interception); an explicit value (may be
+        "") is authoritative — rank-subprocess logs arrive with their own
+        bindings over the response queue."""
+        from .. import telemetry
         from .http_server import request_id_var
 
         entry = {
@@ -105,6 +115,8 @@ class LogCapture:
             "level": level,
             "request_id": (request_id if request_id is not None
                            else request_id_var.get("")),
+            "trace_id": (trace_id if trace_id is not None
+                         else telemetry.current_trace_id() or ""),
             **self.labels,
         }
         flush_now = False

@@ -6,6 +6,13 @@ a heartbeat to a Prometheus pushgateway every 15s.
 
 TPU delta: when running on a TPU host we also export duty-cycle/HBM gauges
 read from jax's local device memory stats (the DCGM-equivalent for TPU).
+
+ISSUE 5 fixes: device labels are exposition-escaped (a hostile/odd device
+id can no longer corrupt the series name), the payload carries proper
+``# TYPE``/``# HELP`` headers plus the full telemetry registry (stage
+histograms, retry/death/chaos counters), and push failures are counted in
+``kt_metrics_push_failures_total`` and logged once per failure streak
+instead of being swallowed forever.
 """
 
 from __future__ import annotations
@@ -14,7 +21,13 @@ import threading
 import time
 from typing import Optional
 
+from .. import telemetry
+
 PUSH_INTERVAL_S = 15.0
+
+_PUSH_FAILURES = telemetry.counter(
+    "kt_metrics_push_failures_total",
+    "Pushgateway POSTs that failed (connection error or non-2xx)")
 
 
 def tpu_gauges() -> dict:
@@ -22,6 +35,10 @@ def tpu_gauges() -> dict:
     DCGM exporter's GPU_UTIL/FB_USED signal. Shared by the push loop AND the
     pod's ``/metrics`` scrape endpoint so Prometheus (deploy/metrics.yaml)
     and live client streaming see the same series.
+
+    Keys carry the ``{device="..."}`` label suffix with the label value
+    exposition-escaped (``telemetry.escape_label_value``) — never raw
+    interpolation.
 
     Reads stats only when the workload has ALREADY imported jax: an
     external scraper must never be the thing that initializes the TPU
@@ -36,9 +53,10 @@ def tpu_gauges() -> dict:
         out = {}
         for d in devs:
             stats = d.memory_stats() or {}
-            out[f"kt_tpu_hbm_bytes_in_use{{device=\"{d.id}\"}}"] = \
+            dev = telemetry.escape_label_value(d.id)
+            out[f'kt_tpu_hbm_bytes_in_use{{device="{dev}"}}'] = \
                 stats.get("bytes_in_use", 0)
-            out[f"kt_tpu_hbm_bytes_limit{{device=\"{d.id}\"}}"] = \
+            out[f'kt_tpu_hbm_bytes_limit{{device="{dev}"}}'] = \
                 stats.get("bytes_limit", 0)
         return out
     except Exception:
@@ -52,6 +70,7 @@ class MetricsPusher:
         self.interval = interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._fail_streak = 0
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -64,15 +83,37 @@ class MetricsPusher:
             "kt_heartbeat_sent": time.time(),
         }
         lines.update(tpu_gauges())
-        return "\n".join(f"{k} {v}" for k, v in lines.items()) + "\n"
+        # exposition-format body: # TYPE/# HELP-headed registry series plus
+        # the ad-hoc gauge lines above (each base name TYPE-headed too)
+        return (telemetry.REGISTRY.render()
+                + telemetry.render_untyped_gauges(lines))
 
     def _loop(self) -> None:
         import requests
         while not self._stop.wait(self.interval):
             try:
-                requests.post(self.gateway_url, data=self._payload(), timeout=5)
-            except Exception:
-                pass
+                r = requests.post(self.gateway_url, data=self._payload(),
+                                  timeout=5)
+                if r.status_code >= 400:
+                    raise requests.HTTPError(f"push → {r.status_code}")
+            except Exception as e:  # noqa: BLE001 — the pusher must survive
+                self._record_failure(e)
+            else:
+                if self._fail_streak:
+                    print(f"[kt] metrics push recovered after "
+                          f"{self._fail_streak} failure(s)")
+                self._fail_streak = 0
+
+    def _record_failure(self, exc: BaseException) -> None:
+        """Count every failure; log only the FIRST of a streak — a dead
+        gateway must neither be silent forever nor spam one line per
+        interval for days."""
+        _PUSH_FAILURES.inc()
+        self._fail_streak += 1
+        if self._fail_streak == 1:
+            print(f"[kt] metrics push to {self.gateway_url} failing "
+                  f"({type(exc).__name__}: {exc}); will keep retrying "
+                  f"every {self.interval:g}s (logged once per streak)")
 
     def stop(self) -> None:
         self._stop.set()

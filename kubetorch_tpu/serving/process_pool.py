@@ -142,6 +142,18 @@ class ProcessPool:
         if resp.get("op") == "log":
             self._forward_log(resp, worker)
             return
+        if resp.get("op") == "span":
+            # finished rank-side spans (worker.execute + everything the user
+            # code opened under it, e.g. store fetches) merge into THIS
+            # process's ring so one /debug/traces query shows the whole
+            # request; the dedup ring absorbs re-shipped trace prefixes
+            from .. import telemetry
+            span = resp.get("span") or {}
+            telemetry.ingest_span(span)
+            qwait = span.get("attrs", {}).get("queue_wait_s")
+            if isinstance(qwait, (int, float)):
+                telemetry.observe_stage("queue_wait", float(qwait))
+            return
         if resp.get("op") == "state":
             # load+warmup bracket: gates /ready and shutdown escalation
             worker.in_warmup = resp.get("warmup") == "started"
@@ -163,7 +175,8 @@ class ProcessPool:
         if cap is not None:
             cap.add(resp.get("line", ""),
                     source=f"rank{resp.get('rank', '?')}-{resp.get('source', 'stdout')}",
-                    request_id=resp.get("request_id", ""))
+                    request_id=resp.get("request_id", ""),
+                    trace_id=resp.get("trace_id", ""))
 
     @staticmethod
     def _resolve(fut: asyncio.Future, resp: Dict) -> None:
@@ -230,12 +243,17 @@ class ProcessPool:
         fut = self._loop.create_future()
         with self._futures_lock:
             self._futures[req_id] = (fut, idx)
-        # carry the HTTP request id across the process boundary so the
-        # worker's prints stay correlated to this call in the log stream
+        # carry the HTTP request id AND the trace context across the process
+        # boundary so the worker's prints stay correlated to this call in
+        # the log stream and its spans join the request's trace; submit_ts
+        # lets the worker measure queue-wait on its own clock axis
+        from .. import telemetry
         from .http_server import request_id_var
         try:
             worker.submit({"req_id": req_id,
-                           "request_id": request_id_var.get(""), **payload})
+                           "request_id": request_id_var.get(""),
+                           "trace": telemetry.current_header(),
+                           "submit_ts": time.time(), **payload})
         except BaseException as e:  # noqa: BLE001
             # the worker died between the liveness check and the queue put:
             # pop the registered future (it would leak in self._futures

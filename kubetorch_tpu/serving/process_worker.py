@@ -37,7 +37,9 @@ _SYNC_EXECUTOR_THREADS = 40  # matches the server's sync-callable concurrency
 # The HTTP X-Request-ID travels server → worker in the request item and is
 # re-bound here per handled request, so rank prints stay correlated to the
 # originating call even across the process boundary (the reference threads
-# the same label through its subprocess LogCapture queue).
+# the same label through its subprocess LogCapture queue). The trace
+# context rides the same envelope: the rank's execute span joins the
+# request's trace, and rank log lines carry its trace_id.
 _rank_request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "kt_rank_request_id", default="")
 
@@ -56,10 +58,13 @@ class _QueueTee:
         self.original.write(data)
         if data.strip():
             try:
+                from .. import telemetry
                 self.response_q.put({"op": "log", "line": data.rstrip("\n"),
                                      "source": self.source,
                                      "rank": os.environ.get("RANK", "0"),
-                                     "request_id": _rank_request_id.get("")})
+                                     "request_id": _rank_request_id.get(""),
+                                     "trace_id":
+                                         telemetry.current_trace_id() or ""})
             except Exception:
                 pass
         return len(data)
@@ -254,10 +259,52 @@ async def _handle_user_metrics(item: Dict, target: Any, response_q,
                         "error": package_exception(e)})
 
 
+def _ship_trace_spans(response_q, sp) -> None:
+    """Send every finished span of this request's trace (the execute span
+    plus whatever user code opened under it — store fetches, nested store
+    requests) back to the parent process, where the pool ingests them into
+    the server's ring. Re-shipped prefixes dedup there by span id."""
+    from .. import telemetry
+
+    d = sp.to_dict() if sp else None
+    if d is None:
+        return
+    for span_dict in telemetry.RING.find(d["trace_id"]):
+        try:
+            response_q.put({"op": "span", "span": span_dict})
+        except Exception:  # noqa: BLE001 — telemetry must not fail the call
+            pass
+
+
 async def _handle(item: Dict, target: Any, load_error, response_q, executor,
                   identity_env: Optional[Dict[str, str]] = None) -> None:
+    import time as _time
+
+    from .. import telemetry
+
     req_id = item.get("req_id")
     _rank_request_id.set(item.get("request_id", ""))
+    now = _time.time()
+    queue_wait = max(0.0, now - float(item.get("submit_ts") or now))
+    sp = telemetry.span(
+        "worker.execute", parent=telemetry.parse_trace(item.get("trace")),
+        rank=os.environ.get("RANK", "0"), method=item.get("method") or "",
+        request_id=item.get("request_id", ""),
+        queue_wait_s=round(queue_wait, 6))
+    try:
+        with sp:
+            await _handle_inner(item, target, load_error, response_q,
+                                executor, sp, identity_env)
+    finally:
+        _ship_trace_spans(response_q, sp)
+
+
+async def _handle_inner(item: Dict, target: Any, load_error, response_q,
+                        executor, sp,
+                        identity_env: Optional[Dict[str, str]] = None) -> None:
+    from .. import telemetry
+
+    req_id = item.get("req_id")
     try:
         if load_error is not None:
             raise load_error
@@ -286,10 +333,16 @@ async def _handle(item: Dict, target: Any, load_error, response_q, executor,
             ctx = contextvars.copy_context()
             result = await loop.run_in_executor(
                 executor, lambda: ctx.run(lambda: fn(*args, **kwargs)))
-        response_q.put({"req_id": req_id, "ok": True, "result": _host_view(result)})
+        with telemetry.stage("device_transfer"):
+            # pulling device arrays to host numpy is the rank's last
+            # per-request device touch — the transfer stage on the waterfall
+            host = _host_view(result)
+        response_q.put({"req_id": req_id, "ok": True, "result": host})
     except BaseException as e:  # noqa: BLE001
         oom = detect_hbm_oom(e)
         payload = package_exception(oom if oom is not None else e)
+        sp.set_status("error")
+        sp.set_attr("error", payload.get("error_type", type(e).__name__))
         response_q.put({"req_id": req_id, "ok": False, "error": payload})
 
 

@@ -50,8 +50,25 @@ import time
 import traceback
 from typing import TYPE_CHECKING, Callable, Dict, List, Optional
 
+from .. import telemetry
 from ..exceptions import WorkerDiedError
 from ..resilience import RestartBudget, RetryPolicy, restart_policy
+
+# observable self-healing (ISSUE 5): every death classification and every
+# restart decision is a counter + a root span in the trace ring, so the
+# flight recorder answers "what killed rank 3 and what did we do about it"
+# without grepping pod logs
+_DEATHS = telemetry.counter(
+    "kt_worker_deaths_total",
+    "Rank subprocess deaths observed by the watchdog, by typed cause",
+    labels=("cause",))
+_RESTARTS = telemetry.counter(
+    "kt_worker_restarts_total",
+    "Rank-pool restarts driven by the watchdog, by mode",
+    labels=("mode",))
+_BUDGET_EXHAUSTED = telemetry.counter(
+    "kt_restart_budget_exhausted_total",
+    "Permanent pool failures after restart-budget exhaustion")
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from .process_pool import ProcessPool
@@ -305,15 +322,21 @@ class Watchdog:
                                 "exitcode": exc.exitcode, "at": time.time()})
             print(f"[kt] watchdog: rank {idx} died "
                   f"(cause={exc.cause}, exitcode={exc.exitcode})")
+            _DEATHS.inc(cause=exc.cause)
             # fail-fast: the dead rank's in-flight futures resolve NOW,
-            # bounded by the watchdog interval — not the call timeout
-            pool.fail_worker_futures(idx, exc)
-            for hook in list(self.on_death):
-                try:
-                    hook(idx, exc)
-                except Exception:  # noqa: BLE001
-                    print("[kt] watchdog on_death hook failed:\n"
-                          + traceback.format_exc())
+            # bounded by the watchdog interval — not the call timeout. The
+            # span brackets detection → typed fail-fast → death hooks; it is
+            # a root span (no request context on the watchdog thread) the
+            # ring keeps for post-incident queries.
+            with telemetry.span("watchdog.death", rank=idx, cause=exc.cause,
+                                exitcode=exc.exitcode):
+                pool.fail_worker_futures(idx, exc)
+                for hook in list(self.on_death):
+                    try:
+                        hook(idx, exc)
+                    except Exception:  # noqa: BLE001
+                        print("[kt] watchdog on_death hook failed:\n"
+                              + traceback.format_exc())
         if newly_dead and not pool._stopping.is_set():
             self._maybe_restart(newly_dead, last_exc)
 
@@ -335,25 +358,35 @@ class Watchdog:
                     "cause": exc.cause, "rank": exc.rank,
                     "exitcode": exc.exitcode}
                 print(f"[kt] watchdog: {self._failed_fields['message']}")
-                # strand no waiter: whatever is still in flight on live
-                # ranks fails typed too — the pool will never answer
-                self.pool.cancel_pending(self.permanent_error())
+                _BUDGET_EXHAUSTED.inc()
+                with telemetry.span("watchdog.permanent_failure",
+                                    cause=exc.cause, rank=exc.rank,
+                                    budget=self.budget.budget):
+                    # strand no waiter: whatever is still in flight on live
+                    # ranks fails typed too — the pool will never answer
+                    self.pool.cancel_pending(self.permanent_error())
                 return
             delay = self._delays[min(self.restarts, len(self._delays) - 1)]
             if delay > 0 and self._stop.wait(delay):
                 return          # pool shut down while we backed off
             from .env_contract import framework_for
             fw = framework_for(self.pool.framework_name)
-            if fw.per_call_identity:
-                # collective identity binds per call: the dead rank alone
-                # respawns, live ranks keep serving
-                for idx in dead_idxs:
-                    self.pool.restart_worker(idx)
-            else:
-                # spawn-fixed identity (JAX/TPU mesh): a compiled mesh
-                # cannot mix old and new ranks — the whole pool restarts
-                self.pool.restart_all(exc)
-            self.restarts += 1
+            mode = "single-rank" if fw.per_call_identity else "full-pool"
+            with telemetry.span("watchdog.restart", mode=mode,
+                                cause=exc.cause, ranks=str(dead_idxs),
+                                backoff_s=round(delay, 4)) as sp:
+                if fw.per_call_identity:
+                    # collective identity binds per call: the dead rank
+                    # alone respawns, live ranks keep serving
+                    for idx in dead_idxs:
+                        self.pool.restart_worker(idx)
+                else:
+                    # spawn-fixed identity (JAX/TPU mesh): a compiled mesh
+                    # cannot mix old and new ranks — the whole pool restarts
+                    self.pool.restart_all(exc)
+                self.restarts += 1
+                _RESTARTS.inc(mode=mode)
+                sp.set_attr("budget_remaining", self.budget.remaining)
             print(f"[kt] watchdog: pool restarted "
                   f"({'ranks ' + str(dead_idxs) if fw.per_call_identity else 'full pool'}; "
                   f"restart {self.restarts}, "

@@ -1,0 +1,696 @@
+"""Dependency-free tracing + metrics core: the flight recorder every other
+layer emits into.
+
+PRs 2–4 built retries, deadlines, a watchdog, and a self-healing store —
+none of it observable end-to-end. This module is the one place telemetry
+semantics live (ISSUE 5):
+
+**Tracing** — contextvar-propagated spans carrying
+``trace_id``/``span_id``/``parent_id`` across process and network
+boundaries:
+
+- in-process: :func:`span` opens a child of the current span and binds it
+  to the task/thread via a ``ContextVar`` (async tasks and
+  ``copy_context``-run executor threads both inherit it);
+- across HTTP: :func:`current_header` / :func:`inject` put the active
+  context on the wire as ``X-KT-Trace: <trace_id>-<span_id>``;
+  :func:`parse_trace` / :func:`extract` reopen it server-side;
+- across the process-pool boundary: the call envelope carries the same
+  header string, and finished worker spans ship back over the response
+  queue into the parent's ring via :func:`ingest_span`.
+
+Finished spans land in a bounded, deduplicating per-process ring
+(:data:`RING`) that backs the servers' ``/debug/traces`` endpoints and the
+``kt trace <request_id>`` waterfall (:func:`format_waterfall`).
+
+**Metrics** — a Prometheus-exposition registry (:data:`REGISTRY`):
+counters, gauges, and histograms with proper label escaping and
+``# HELP``/``# TYPE`` headers, plus the per-stage latency histogram
+(``kt_stage_seconds``: deserialize, queue_wait, execute, device_transfer,
+store_fetch, retry_sleep) every hot-path layer observes into. It backs the
+pod and store ``/metrics`` scrape endpoints and ``MetricsPusher``.
+
+**Overhead budget** — tracing defaults on; ``KT_TRACE=0`` disables it and
+the disabled fast path is allocation-free: :func:`span` returns a shared
+no-op singleton and every event/inject helper short-circuits on one env
+lookup. ``make bench-trace`` tracks the enabled-vs-disabled put/get
+overhead so later perf PRs inherit an enforced budget, not a guess.
+
+Dependency-free by design (stdlib only, no package imports): every layer —
+client, resilience, chaos, netpool, store, watchdog — can import it
+without cycles.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+TRACE_HEADER = "X-KT-Trace"
+TRACE_ENV = "KT_TRACE"
+RING_ENV = "KT_TRACE_RING"
+
+_FALSY = ("0", "false", "off", "no", "")
+
+
+def enabled() -> bool:
+    """Tracing switch: ``KT_TRACE`` env, default on. Read per call (tests
+    and the bench toggle it at runtime); a dict lookup on ``os.environ``
+    costs nanoseconds and allocates nothing."""
+    raw = os.environ.get(TRACE_ENV)
+    if raw is None:
+        return True
+    return raw.strip().lower() not in _FALSY
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+class TraceContext:
+    """A remote parent: what crossed the wire in ``X-KT-Trace``."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+
+def parse_trace(value: Optional[str]) -> Optional[TraceContext]:
+    """``"<trace_id>-<span_id>"`` → :class:`TraceContext`; None on absent or
+    malformed input (a bad header must never fail a request)."""
+    if not value:
+        return None
+    trace_id, sep, span_id = value.partition("-")
+    if not sep or not trace_id or not span_id:
+        return None
+    return TraceContext(trace_id.strip(), span_id.strip())
+
+
+def extract(headers) -> Optional[TraceContext]:
+    """Parse the trace header off any mapping-like headers object."""
+    try:
+        return parse_trace(headers.get(TRACE_HEADER))
+    except Exception:  # noqa: BLE001 — telemetry must never fail a request
+        return None
+
+
+class Span:
+    """One timed operation. Context-manager: entering binds it as the
+    current span, exiting records the end time and ships it to the ring."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "status", "attrs", "events", "_token")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.status = "ok"
+        self.attrs = attrs
+        self.events: List[Tuple[float, str, Dict[str, Any]]] = []
+        self._token = None
+
+    def __bool__(self) -> bool:
+        return True
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def set_status(self, status: str) -> None:
+        """For call sites that swallow the exception themselves (the worker
+        loop packages errors instead of raising through ``__exit__``)."""
+        self.status = status
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        self.events.append((time.time(), name, attrs))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end if self.end is not None else time.time(),
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "events": [{"ts": ts, "name": n, "attrs": a}
+                       for ts, n, a in self.events],
+        }
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end = time.time()
+        if exc is not None:
+            self.status = "error"
+            self.attrs.setdefault("error", type(exc).__name__)
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        RING.add(self.to_dict())
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the tracing-disabled fast path: a single
+    module-level instance, so ``with span(...)`` allocates nothing."""
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def set_status(self, status: str) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def to_dict(self) -> None:
+        return None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+_current: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "kt_current_span", default=None)
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[: nbytes * 2]
+
+
+def span(name: str, parent: Optional[TraceContext] = None, **attrs: Any):
+    """Open a span. ``parent`` (a remote :class:`TraceContext`) continues a
+    wire-propagated trace; otherwise the current in-process span is the
+    parent; otherwise this is a fresh root. Returns :data:`NOOP_SPAN` when
+    tracing is disabled."""
+    if not enabled():
+        return NOOP_SPAN
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        cur = _current.get()
+        if cur is not None:
+            trace_id, parent_id = cur.trace_id, cur.span_id
+        else:
+            trace_id, parent_id = _new_id(8), None
+    return Span(name, trace_id, _new_id(4), parent_id, attrs)
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    cur = _current.get()
+    return cur.trace_id if cur is not None else None
+
+
+def current_header() -> Optional[str]:
+    """The active context's wire value, or None (disabled / no span)."""
+    cur = _current.get()
+    if cur is None or not enabled():
+        return None
+    return f"{cur.trace_id}-{cur.span_id}"
+
+
+def inject(headers: Dict[str, str]) -> None:
+    """Put the active trace context on an outgoing request's headers."""
+    value = current_header()
+    if value is not None:
+        headers[TRACE_HEADER] = value
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Record an event on the active span; silent no-op without one — call
+    sites (retry loops, chaos) never need to know whether they run inside
+    a traced request."""
+    cur = _current.get()
+    if cur is not None:
+        cur.add_event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# Trace ring buffer
+# ---------------------------------------------------------------------------
+
+
+class TraceRing:
+    """Bounded, deduplicating store of finished spans, newest-last.
+
+    Keyed by ``(trace_id, span_id)`` so a worker re-shipping a trace's
+    spans over the response queue upserts rather than duplicates. Capacity
+    from ``KT_TRACE_RING`` (default 2048 spans); oldest evict first.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._cap_override = capacity
+        self._spans: "OrderedDict[Tuple[str, str], Dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        if self._cap_override is not None:
+            return self._cap_override
+        try:
+            return max(16, int(os.environ.get(RING_ENV, "2048")))
+        except ValueError:
+            return 2048
+
+    def add(self, span_dict: Optional[Dict]) -> None:
+        if not span_dict:
+            return
+        key = (span_dict.get("trace_id", ""), span_dict.get("span_id", ""))
+        with self._lock:
+            self._spans[key] = span_dict
+            self._spans.move_to_end(key)
+            cap = self.capacity
+            while len(self._spans) > cap:
+                self._spans.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def snapshot(self, limit: Optional[int] = None) -> List[Dict]:
+        with self._lock:
+            spans = list(self._spans.values())
+        return spans[-limit:] if limit else spans
+
+    def find(self, query: str) -> List[Dict]:
+        """Spans whose ``trace_id`` — or ``request_id`` attr — equals
+        ``query``, oldest first. ``request_id`` lookup resolves to the
+        owning trace(s) first, so the whole waterfall comes back even when
+        only one span carries the request-id label."""
+        with self._lock:
+            spans = list(self._spans.values())
+        trace_ids = {s["trace_id"] for s in spans
+                     if s["trace_id"] == query
+                     or s.get("attrs", {}).get("request_id") == query}
+        return sorted((s for s in spans if s["trace_id"] in trace_ids),
+                      key=lambda s: s.get("start", 0.0))
+
+
+RING = TraceRing()
+
+
+def ingest_span(span_dict: Optional[Dict]) -> None:
+    """Feed a span finished in ANOTHER process (rank worker) into this
+    process's ring, so one ``/debug/traces`` query sees the whole request."""
+    RING.add(span_dict)
+
+
+# ---------------------------------------------------------------------------
+# Waterfall rendering (kt trace / debug tooling)
+# ---------------------------------------------------------------------------
+
+
+def format_waterfall(spans: Iterable[Dict], width: int = 40) -> str:
+    """ASCII waterfall for one trace's spans: tree-indented by parentage,
+    each line showing offset+duration bars relative to the earliest start,
+    span events (retries, chaos faults, breaker trips) nested beneath."""
+    spans = [s for s in spans if s]
+    if not spans:
+        return "(no spans)"
+    spans.sort(key=lambda s: s.get("start", 0.0))
+    t0 = spans[0]["start"]
+    t1 = max(s.get("end") or s["start"] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[Optional[str], List[Dict]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent not in by_id:
+            parent = None        # orphan (parent evicted/remote): root it
+        children.setdefault(parent, []).append(s)
+
+    lines = [f"trace {spans[0]['trace_id']}  "
+             f"({len(spans)} spans, {total * 1000:.1f}ms)"]
+
+    def _attrs(s: Dict) -> str:
+        keep = {k: v for k, v in s.get("attrs", {}).items()}
+        return " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+
+    def _bar(s: Dict) -> str:
+        off = (s["start"] - t0) / total
+        dur = ((s.get("end") or s["start"]) - s["start"]) / total
+        lo = min(int(off * width), width - 1)
+        hi = min(max(int((off + dur) * width), lo + 1), width)
+        return "·" * lo + "█" * (hi - lo) + "·" * (width - hi)
+
+    def _emit(s: Dict, depth: int) -> None:
+        start_ms = (s["start"] - t0) * 1000
+        dur_ms = ((s.get("end") or s["start"]) - s["start"]) * 1000
+        mark = " !" if s.get("status") == "error" else ""
+        lines.append(f"  [{_bar(s)}] {'  ' * depth}{s['name']}{mark}  "
+                     f"+{start_ms:.1f}ms {dur_ms:.1f}ms  {_attrs(s)}".rstrip())
+        for ev in s.get("events", []):
+            ev_ms = (ev["ts"] - t0) * 1000
+            ev_attrs = " ".join(f"{k}={v}" for k, v in
+                                sorted(ev.get("attrs", {}).items()))
+            lines.append(f"   {' ' * width} {'  ' * depth}  • {ev['name']} "
+                         f"+{ev_ms:.1f}ms  {ev_attrs}".rstrip())
+        for child in children.get(s["span_id"], []):
+            _emit(child, depth + 1)
+
+    for root in children.get(None, []):
+        _emit(root, 0)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Metrics: Prometheus-exposition registry
+# ---------------------------------------------------------------------------
+
+
+def escape_label_value(value: Any) -> str:
+    """Prometheus exposition label-value escaping: backslash, double-quote,
+    and newline (the three the format defines)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _label_str(labelnames: Tuple[str, ...], labelvalues: Tuple[str, ...],
+               extra: str = "") -> str:
+    parts = [f'{k}="{escape_label_value(v)}"'
+             for k, v in zip(labelnames, labelvalues)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"declared {sorted(self.labelnames)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def header(self) -> List[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        return out
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.name}{_label_str(self.labelnames, key)} "
+                       f"{_format_value(v)}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.name}{_label_str(self.labelnames, key)} "
+                       f"{_format_value(v)}")
+        return out
+
+
+# Default latency buckets: sub-ms (header parse), request-scale, and the
+# multi-second tail a cold jit compile or multi-GB fetch actually produces.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...],
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            entry = self._values.get(key)
+            if entry is None:
+                entry = self._values[key] = {
+                    "buckets": [0] * len(self.buckets), "sum": 0.0,
+                    "count": 0}
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    entry["buckets"][i] += 1
+            entry["sum"] += value
+            entry["count"] += 1
+
+    def count(self, **labels: Any) -> int:
+        with self._lock:
+            entry = self._values.get(self._key(labels))
+            return entry["count"] if entry else 0
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            items = sorted((k, {"buckets": list(v["buckets"]),
+                                "sum": v["sum"], "count": v["count"]})
+                           for k, v in self._values.items())
+        for key, entry in items:
+            for le, n in zip(self.buckets, entry["buckets"]):
+                lbl = _label_str(self.labelnames, key,
+                                 extra=f'le="{_format_value(le)}"')
+                out.append(f"{self.name}_bucket{lbl} {n}")
+            lbl = _label_str(self.labelnames, key, extra='le="+Inf"')
+            out.append(f"{self.name}_bucket{lbl} {entry['count']}")
+            base = _label_str(self.labelnames, key)
+            out.append(f"{self.name}_sum{base} "
+                       f"{_format_value(entry['sum'])}")
+            out.append(f"{self.name}_count{base} {entry['count']}")
+        return out
+
+
+class MetricsRegistry:
+    """Named metric registry with get-or-create semantics (call sites
+    declare inline; the first declaration wins, a kind mismatch raises)."""
+
+    def __init__(self):
+        self._metrics: "OrderedDict[str, _Metric]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Iterable[str], **kwargs) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                return m
+            m = cls(name, help, tuple(labels), **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "", labels: Iterable[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels: Iterable[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels: Iterable[str] = (),
+              buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, labels, buckets=buckets)
+
+
+def render_untyped_gauges(lines: Dict[str, Any]) -> str:
+    """Exposition text for ad-hoc gauge lines whose keys may already carry
+    a ``{label="..."}`` suffix (the TPU HBM series, ``kt_user_*`` merges):
+    one ``# TYPE <base> gauge`` header per base metric name, values as-is.
+    The one sanctioned alternative to hand-rolled ``"{k} {v}"`` joins
+    (``scripts/check_resilience.py`` lints for those)."""
+    out: List[str] = []
+    seen = set()
+    for key, value in lines.items():
+        base = key.split("{", 1)[0]
+        if base not in seen:
+            seen.add(base)
+            out.append(f"# TYPE {base} gauge")
+        out.append(f"{key} {value}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# ---------------------------------------------------------------------------
+# Per-stage latency instrumentation
+# ---------------------------------------------------------------------------
+
+# The stage taxonomy every later perf PR measures against (docs/
+# observability.md "Span taxonomy"). Free-form stages are allowed; these
+# are the named hot-path phases of one request.
+STAGES = ("deserialize", "queue_wait", "execute", "device_transfer",
+          "store_fetch", "retry_sleep")
+
+_STAGE_HIST: Optional[Histogram] = None
+
+
+def stage_histogram() -> Histogram:
+    global _STAGE_HIST
+    if _STAGE_HIST is None:
+        _STAGE_HIST = histogram(
+            "kt_stage_seconds",
+            "Per-stage request latency (deserialize, queue_wait, execute, "
+            "device_transfer, store_fetch, retry_sleep)",
+            labels=("stage",))
+    return _STAGE_HIST
+
+
+def observe_stage(stage_name: str, seconds: float) -> None:
+    stage_histogram().observe(seconds, stage=stage_name)
+
+
+class _StageTimer:
+    """``with stage("deserialize"):`` — a span (when tracing is on) plus a
+    ``kt_stage_seconds`` observation (always; one dict op, no allocation
+    churn on the disabled path)."""
+
+    __slots__ = ("stage", "attrs", "_span", "_t0")
+
+    def __init__(self, stage_name: str, attrs: Dict[str, Any]):
+        self.stage = stage_name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._span = span(f"stage.{self.stage}", **self.attrs)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        observe_stage(self.stage, time.perf_counter() - self._t0)
+        self._span.__exit__(exc_type, exc, tb)
+
+
+def stage(stage_name: str, **attrs: Any) -> _StageTimer:
+    return _StageTimer(stage_name, attrs)
+
+
+# ---------------------------------------------------------------------------
+# Debug endpoint helper (shared by pod + store servers)
+# ---------------------------------------------------------------------------
+
+
+def debug_traces_payload(query: Optional[str],
+                         limit: Optional[int] = None) -> Dict[str, Any]:
+    """Body for ``GET /debug/traces[?q=<request_id|trace_id>][&limit=N]``."""
+    if query:
+        spans = RING.find(query)
+    else:
+        spans = RING.snapshot(limit=limit or 256)
+    return {"spans": spans, "count": len(spans),
+            "ring_size": len(RING), "enabled": enabled()}

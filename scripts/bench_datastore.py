@@ -13,6 +13,12 @@ built for (ISSUE 1 / ROADMAP "as fast as the hardware allows"):
   plus a parallel get racing a concurrent sweep, so the steady-state
   overhead of the background scrubber on the fetch hot path is a tracked
   number, not a guess.
+- **trace**      — (``--trace-overhead`` / ``make bench-trace``, ISSUE 5)
+  the same put/get hot path with telemetry spans disabled (``KT_TRACE=0``,
+  the allocation-free fast path) vs enabled, on both client and store.
+  The enforced budget: <3% enabled, ~0% disabled — every later perf PR
+  measures against an instrumented data plane, so the instrument itself
+  must stay free.
 
 Run: ``make bench-store`` or
 ``python scripts/bench_datastore.py [--leaves 64] [--mb-per-leaf 4]``.
@@ -38,10 +44,12 @@ os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _start_store(root: str, port: int) -> subprocess.Popen:
+def _start_store(root: str, port: int,
+                 extra_env: dict | None = None) -> subprocess.Popen:
     from kubetorch_tpu.utils.procs import wait_for_port
 
     env = dict(os.environ)
+    env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
          "--host", "127.0.0.1", "--port", str(port), "--root", root],
@@ -182,6 +190,60 @@ def bench(leaves: int, mb_per_leaf: float, concurrency: int,
     return results
 
 
+def bench_trace(leaves: int, mb_per_leaf: float, reps: int = 5) -> dict:
+    """Tracing-overhead regime (ISSUE 5): best-of-``reps`` put+get
+    wall-clock with KT_TRACE=0 (disabled fast path — must be free) vs
+    KT_TRACE=1 (spans on the client AND a traced store server), one store
+    per mode so both sides of the wire toggle together."""
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.utils.procs import free_port, kill_process_tree
+
+    tree = _make_tree(leaves, mb_per_leaf, seed=7)
+    total_mb = leaves * mb_per_leaf
+    out = {"leaves": leaves, "mb_per_leaf": mb_per_leaf,
+           "total_mb": total_mb, "reps": reps}
+    saved = os.environ.get("KT_TRACE")
+    try:
+        for mode, flag in (("disabled", "0"), ("enabled", "1")):
+            os.environ["KT_TRACE"] = flag
+            with tempfile.TemporaryDirectory(
+                    prefix=f"kt-bench-trace-{mode}-",
+                    dir=_bench_root()) as root:
+                port = free_port()
+                proc = _start_store(root, port, extra_env={"KT_TRACE": flag})
+                url = f"http://127.0.0.1:{port}"
+                try:
+                    # warm connections + page cache before timing
+                    ds.put("bench/trace/warm", {"w": tree["layers"]["w000"]},
+                           store_url=url)
+                    ds.get("bench/trace/warm", store_url=url)
+                    best_put = best_get = float("inf")
+                    for rep in range(reps):
+                        key = f"bench/trace/{mode}/{rep}"   # cold puts
+                        _, t = _timed(
+                            lambda: ds.put(key, tree, store_url=url))
+                        best_put = min(best_put, t)
+                        _, t = _timed(lambda: ds.get(key, store_url=url))
+                        best_get = min(best_get, t)
+                    out[mode] = {
+                        "put_s": round(best_put, 4),
+                        "get_s": round(best_get, 4),
+                        "put_mb_s": round(total_mb / best_put, 1),
+                        "get_mb_s": round(total_mb / best_get, 1),
+                    }
+                finally:
+                    kill_process_tree(proc.pid)
+    finally:
+        if saved is None:
+            os.environ.pop("KT_TRACE", None)
+        else:
+            os.environ["KT_TRACE"] = saved
+    off = out["disabled"]["put_s"] + out["disabled"]["get_s"]
+    on = out["enabled"]["put_s"] + out["enabled"]["get_s"]
+    out["overhead_pct"] = round(100.0 * (on - off) / off, 2)
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--leaves", type=int, default=64)
@@ -189,7 +251,31 @@ def main() -> None:
     p.add_argument("--concurrency", type=int, default=None,
                    help="parallel-regime width (default: the store "
                         "client's own default for this host)")
+    p.add_argument("--trace-overhead", action="store_true",
+                   help="run ONLY the tracing-overhead regime "
+                        "(`make bench-trace`): put/get hot path with "
+                        "telemetry disabled vs enabled")
+    p.add_argument("--reps", type=int, default=5,
+                   help="trace-overhead regime repetitions (best-of)")
     args = p.parse_args()
+
+    if args.trace_overhead:
+        r = bench_trace(args.leaves, args.mb_per_leaf, reps=args.reps)
+        print(f"\ntracing overhead: {r['leaves']} leaves x "
+              f"{r['mb_per_leaf']} MB = {r['total_mb']:.0f} MB, "
+              f"best of {r['reps']}")
+        print(f"{'mode':<10} {'put s':>8} {'get s':>8} "
+              f"{'put MB/s':>10} {'get MB/s':>10}")
+        for mode in ("disabled", "enabled"):
+            row = r[mode]
+            print(f"{mode:<10} {row['put_s']:>8} {row['get_s']:>8} "
+                  f"{row['put_mb_s']:>10} {row['get_mb_s']:>10}")
+        budget = "within" if r["overhead_pct"] < 3.0 else "OVER"
+        print(f"\ntracing-enabled overhead on put+get: "
+              f"{r['overhead_pct']}% ({budget} the <3% budget; "
+              f"disabled path short-circuits to a shared no-op span)")
+        print("\n" + json.dumps(r))
+        return
     if args.concurrency is None:
         from kubetorch_tpu.data_store import netpool
         args.concurrency = netpool.store_concurrency()

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """Lint: no new raw ``requests`` call sites may bypass the resilience layer,
-no new raw ``worker.alive`` checks may bypass the liveness watchdog, and no
+no new raw ``worker.alive`` checks may bypass the liveness watchdog, no
 new raw ``os.replace`` in ``data_store/`` may bypass the durable-write
-helper.
+helper, and no new ad-hoc latency measurement / hand-rolled metric
+formatting may bypass the telemetry plane.
 
 Every HTTP call in ``kubetorch_tpu/`` is supposed to ride one of the three
 resilient choke points (``netpool.request``, ``HTTPClient.call_method``'s
@@ -36,6 +37,17 @@ enumerates the client-side files whose targets are rebuildable from the
 store (pod cache, pull destinations) and therefore deliberately skip the
 fsync tax.
 
+The fourth check (ISSUE 5) guards the unified metrics plane: an ad-hoc
+``time.perf_counter()`` latency measurement in ``kubetorch_tpu/`` outside
+``telemetry.py`` produces a number that dies in a local variable or a
+print — invisible to the stage histograms, the waterfall, and every later
+perf PR's regression tracking. Latency measurement belongs to
+``telemetry.stage(...)`` / spans. Likewise a hand-rolled
+``f"{k} {v}"``-style metric line skips label escaping and TYPE headers —
+exposition text belongs to ``telemetry.REGISTRY.render()`` /
+``render_untyped_gauges``. Both baselines are EMPTY on purpose: the
+package starts clean; keep it that way.
+
 Run: ``python scripts/check_resilience.py`` (wired into ``make lint``).
 """
 
@@ -58,8 +70,10 @@ WRAPPER_FILES = {"resilience.py", "netpool.py"}
 # path (relative to kubetorch_tpu/) → max allowed raw call sites, each one a
 # deliberate exception:
 BASELINE = {
-    # session probe + port-forward health check, both single-shot by design
-    "cli.py": 1,
+    # session probe + port-forward health check + the `kt trace` debug
+    # fetch — all single-shot by design (a doctor/debug command that
+    # retried would hang the very diagnosis it exists for)
+    "cli.py": 2,
     # daemon-liveness probes in _read_running_local (must not retry: they
     # decide whether to SPAWN a controller) + _request's internals
     "client.py": 4,
@@ -99,6 +113,20 @@ ALIVE_BASELINE = {
 # durability.py itself is exempt (it IS the helper). The baselined sites
 # are all CLIENT-side, where the write target is rebuildable from the
 # store on loss and the fsync tax would sit on the fetch hot path.
+# Ad-hoc telemetry (ISSUE 5): latency measured outside the telemetry
+# plane, or exposition lines formatted by hand. telemetry.py is exempt (it
+# IS the plane: stage timers and the registry renderer live there). Both
+# baselines are empty — the package is clean after the ISSUE-5 refactor
+# (http_server's and metrics_push's "{k} {v}" joins were the only sites).
+TIMING_RE = re.compile(r"\btime\.perf_counter\(\)")
+# the classic hand-rolled metric join: f-string interpolating a name and a
+# value with a bare space, the exact shape the exposition fixes removed
+METRIC_FMT_RE = re.compile(
+    r"\{k\}\s\{v\}|\{name\}\s\{value\}|\{key\}\s\{val(?:ue)?\}")
+TELEMETRY_EXEMPT = {"telemetry.py"}
+TIMING_BASELINE: dict = {}
+METRIC_FMT_BASELINE: dict = {}
+
 REPLACE_RE = re.compile(r"\bos\.replace\(")
 REPLACE_EXEMPT = {"durability.py"}
 REPLACE_BASELINE = {
@@ -197,19 +225,56 @@ def main() -> int:
               "justification.")
         return 1
 
+    telemetry_failures = []
+    timing_counts = {}
+    fmt_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in TELEMETRY_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n_t = _count_matches(path, TIMING_RE)
+        n_f = _count_matches(path, METRIC_FMT_RE)
+        if n_t:
+            timing_counts[rel] = n_t
+        if n_f:
+            fmt_counts[rel] = n_f
+        if n_t > TIMING_BASELINE.get(rel, 0):
+            telemetry_failures.append(
+                f"  {rel}: {n_t} ad-hoc time.perf_counter() latency "
+                f"site(s), baseline allows {TIMING_BASELINE.get(rel, 0)}")
+        if n_f > METRIC_FMT_BASELINE.get(rel, 0):
+            telemetry_failures.append(
+                f"  {rel}: {n_f} hand-rolled metric-format site(s), "
+                f"baseline allows {METRIC_FMT_BASELINE.get(rel, 0)}")
+    if telemetry_failures:
+        print("check_resilience: ad-hoc telemetry bypasses the unified "
+              "metrics plane:\n" + "\n".join(telemetry_failures))
+        print("\nMeasure latency with telemetry.stage(...)/span(...) so it "
+              "reaches the kt_stage_seconds histograms and the trace "
+              "waterfall; render exposition text with "
+              "telemetry.REGISTRY.render()/render_untyped_gauges (label "
+              "escaping + TYPE headers). For deliberate exceptions update "
+              "TIMING_BASELINE/METRIC_FMT_BASELINE with a justification.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
         + [f for f, allowed in ALIVE_BASELINE.items()
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
-           if replace_counts.get(f, 0) < allowed])
+           if replace_counts.get(f, 0) < allowed]
+        + [f for f, allowed in TIMING_BASELINE.items()
+           if timing_counts.get(f, 0) < allowed]
+        + [f for f, allowed in METRIC_FMT_BASELINE.items()
+           if fmt_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
-              "checks, and data-store commit renames accounted for")
+              "checks, data-store commit renames, and telemetry sites "
+              "accounted for")
     return 0
 
 

@@ -112,3 +112,12 @@ class Metered:
         return {"calls_total": self.calls,
                 "queue depth!": 1.5,      # name needs prometheus sanitizing
                 "not_a_number": "nope"}   # silently dropped
+
+
+def store_fetcher(store_url, key):
+    """Fetch a store key from inside the rank worker (ISSUE 5 trace e2e:
+    the worker-side store.fetch/store.request spans must join the HTTP
+    request's trace via the call-envelope context)."""
+    from kubetorch_tpu.data_store import commands as ds
+    arr = ds.get(key, store_url=store_url)
+    return float(arr.sum())

@@ -329,3 +329,499 @@ class TestResourceScopeLatch:
             assert c._resource_scope_dead is True
         finally:
             reset_config()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: end-to-end request tracing + the unified metrics plane
+# ---------------------------------------------------------------------------
+
+import uuid as _uuid
+
+from kubetorch_tpu import telemetry as tel
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+@pytest.fixture()
+def clean_ring():
+    tel.RING.clear()
+    yield
+    tel.RING.clear()
+
+
+@pytest.fixture()
+def pod_metadata(monkeypatch):
+    monkeypatch.setenv("KT_PROJECT_ROOT", ASSETS)
+    monkeypatch.setenv("KT_MODULE_NAME", "payloads")
+    monkeypatch.setenv("KT_FILE_PATH", "payloads.py")
+    monkeypatch.setenv("KT_LAUNCH_ID", "obs-1")
+    monkeypatch.delenv("KT_DISTRIBUTED_CONFIG", raising=False)
+    monkeypatch.delenv("POD_IP", raising=False)
+    monkeypatch.delenv("KT_CHAOS", raising=False)
+
+
+class TestTelemetrySpans:
+    def test_nesting_parenting_and_ring(self, clean_ring):
+        with tel.span("outer", request_id="req-nest") as outer:
+            with tel.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+                tel.add_event("hello", k=1)
+            # inner closed: current reverts to outer
+            assert tel.current_span() is outer
+        spans = tel.RING.find("req-nest")
+        assert {s["name"] for s in spans} == {"outer", "inner"}
+        inner_d = next(s for s in spans if s["name"] == "inner")
+        assert inner_d["events"][0]["name"] == "hello"
+        assert inner_d["events"][0]["attrs"] == {"k": 1}
+        # request_id lookup returned the WHOLE trace, not just the
+        # span carrying the attribute
+        assert tel.RING.find(outer.trace_id) == spans
+
+    def test_header_roundtrip_continues_trace(self, clean_ring):
+        with tel.span("client.call") as sp:
+            headers = {}
+            tel.inject(headers)
+            assert headers[tel.TRACE_HEADER] == f"{sp.trace_id}-{sp.span_id}"
+            ctx = tel.extract(headers)
+        with tel.span("server.request", parent=ctx) as remote:
+            assert remote.trace_id == sp.trace_id
+            assert remote.parent_id == sp.span_id
+
+    def test_malformed_header_is_none(self):
+        assert tel.parse_trace(None) is None
+        assert tel.parse_trace("") is None
+        assert tel.parse_trace("no-separator-missing") is not None  # 2 parts
+        assert tel.parse_trace("loneid") is None
+
+    def test_disabled_fast_path_is_shared_noop(self, monkeypatch):
+        monkeypatch.setenv("KT_TRACE", "0")
+        assert tel.span("x") is tel.NOOP_SPAN
+        assert tel.current_header() is None
+        with tel.span("x") as sp:
+            assert not sp
+            sp.set_attr("a", 1)
+            sp.set_status("error")
+            tel.add_event("e")      # no active span: silent no-op
+        monkeypatch.setenv("KT_TRACE", "1")
+        assert tel.span("y") is not tel.NOOP_SPAN
+
+    def test_ring_bounded_and_dedups_by_span_id(self):
+        ring = tel.TraceRing(capacity=4)
+        for i in range(10):
+            ring.add({"trace_id": "t", "span_id": str(i), "start": float(i)})
+        assert len(ring) == 4
+        # re-ingesting an existing span (worker re-ships trace prefixes)
+        # upserts instead of duplicating
+        ring.add({"trace_id": "t", "span_id": "9", "start": 99.0})
+        assert len(ring) == 4
+
+    def test_error_status_recorded(self, clean_ring):
+        with pytest.raises(ValueError):
+            with tel.span("boom", request_id="req-err"):
+                raise ValueError("zap")
+        (s,) = tel.RING.find("req-err")
+        assert s["status"] == "error" and s["attrs"]["error"] == "ValueError"
+
+
+class TestMetricsExposition:
+    def test_counter_help_type_and_label_escaping(self):
+        name = f"kt_t_{_uuid.uuid4().hex[:8]}_total"
+        c = tel.counter(name, "helptext", labels=("kind",))
+        c.inc(kind='a"b\\c\nd')
+        text = tel.REGISTRY.render()
+        assert f"# HELP {name} helptext" in text
+        assert f"# TYPE {name} counter" in text
+        assert f'{name}{{kind="a\\"b\\\\c\\nd"}} 1' in text
+
+    def test_histogram_exposition_parses_under_prometheus_client(self):
+        prom = pytest.importorskip("prometheus_client")
+        from prometheus_client.parser import text_string_to_metric_families
+
+        name = f"kt_t_{_uuid.uuid4().hex[:8]}_seconds"
+        h = tel.histogram(name, "stage latency", labels=("stage",),
+                          buckets=(0.1, 1.0))
+        h.observe(0.05, stage="execute")
+        h.observe(0.5, stage="execute")
+        fams = {f.name: f for f in
+                text_string_to_metric_families(tel.REGISTRY.render())}
+        fam = fams[name]
+        assert fam.type == "histogram"
+        samples = {(s.name, s.labels.get("le")): s.value
+                   for s in fam.samples if s.labels.get("stage") == "execute"}
+        assert samples[(f"{name}_bucket", "0.1")] == 1
+        assert samples[(f"{name}_bucket", "1")] == 2
+        assert samples[(f"{name}_bucket", "+Inf")] == 2
+        assert samples[(f"{name}_count", None)] == 2
+        assert abs(samples[(f"{name}_sum", None)] - 0.55) < 1e-9
+
+    def test_stage_timer_observes_histogram(self):
+        before = tel.stage_histogram().count(stage="deserialize")
+        with tel.stage("deserialize"):
+            pass
+        assert tel.stage_histogram().count(stage="deserialize") == before + 1
+
+    def test_render_untyped_gauges_headers(self):
+        text = tel.render_untyped_gauges({
+            'kt_tpu_hbm_bytes_in_use{device="0"}': 7,
+            'kt_tpu_hbm_bytes_in_use{device="1"}': 9,
+            "kt_heartbeat_sent": 1.5,
+        })
+        assert text.count("# TYPE kt_tpu_hbm_bytes_in_use gauge") == 1
+        assert "# TYPE kt_heartbeat_sent gauge" in text
+        assert 'kt_tpu_hbm_bytes_in_use{device="1"} 9' in text
+
+
+class TestMetricsPusherFixes:
+    class _State:
+        last_activity = 123.0
+        request_count = 7
+
+    def test_payload_has_type_headers(self):
+        from kubetorch_tpu.serving.metrics_push import MetricsPusher
+
+        p = MetricsPusher("http://gw.test", state=self._State())
+        payload = p._payload()
+        assert "# TYPE kubetorch_last_activity_timestamp gauge" in payload
+        assert "# TYPE kt_http_requests_total gauge" in payload
+        assert "kt_http_requests_total 7" in payload
+        # the registry (incl. the push-failure counter) rides along
+        assert "# TYPE kt_metrics_push_failures_total counter" in payload
+
+    def test_push_failures_counted_and_logged_once_per_streak(self, capsys):
+        from kubetorch_tpu.serving.metrics_push import (_PUSH_FAILURES,
+                                                        MetricsPusher)
+
+        p = MetricsPusher("http://gw.test", state=self._State())
+        before = _PUSH_FAILURES.value()
+        p._record_failure(ConnectionError("nope"))
+        p._record_failure(ConnectionError("nope"))
+        p._record_failure(ConnectionError("nope"))
+        assert _PUSH_FAILURES.value() == before + 3
+        out = capsys.readouterr().out
+        assert out.count("metrics push") == 1       # one log per streak
+
+    def test_device_label_escaped(self):
+        # tpu_gauges needs a live TPU; the escaping primitive it now uses
+        # is assertable directly
+        assert tel.escape_label_value('dev"0\n') == 'dev\\"0\\n'
+
+
+class TestRequestIdOnAllResponses:
+    def _run(self, coro_fn, env=None):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubetorch_tpu.serving.http_server import ServerState, create_app
+
+        async def runner():
+            state = ServerState()
+            app = create_app(state)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                await coro_fn(client, state)
+            finally:
+                await client.close()
+        asyncio.run(runner())
+
+    def test_deadline_rejection_504_carries_request_id(self, pod_metadata,
+                                                       monkeypatch):
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "summer")
+
+        async def body(client, state):
+            r = await client.post(
+                "/summer", json={"args": [1, 2], "kwargs": {}},
+                headers={"X-Request-ID": "rid-504",
+                         "X-KT-Deadline": f"{time.time() - 5:.6f}"})
+            assert r.status == 504
+            assert r.headers["X-Request-ID"] == "rid-504"
+        self._run(body)
+
+    def test_terminating_503_carries_request_id(self, pod_metadata,
+                                                monkeypatch):
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "summer")
+
+        async def body(client, state):
+            state.termination.set()
+            state.termination_reason = "Evicted"
+            r = await client.post("/summer",
+                                  json={"args": [1, 2], "kwargs": {}},
+                                  headers={"X-Request-ID": "rid-503"})
+            assert r.status == 503
+            assert r.headers["X-Request-ID"] == "rid-503"
+        self._run(body)
+
+    def test_idempotent_replay_carries_request_id(self, pod_metadata,
+                                                  monkeypatch):
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "summer")
+
+        async def body(client, state):
+            k = {"X-KT-Idempotency-Key": "obs-replay-1"}
+            r1 = await client.post("/summer",
+                                   json={"args": [4, 5], "kwargs": {}},
+                                   headers={**k, "X-Request-ID": "rid-a"})
+            assert r1.status == 200
+            r2 = await client.post("/summer",
+                                   json={"args": [4, 5], "kwargs": {}},
+                                   headers={**k, "X-Request-ID": "rid-b"})
+            assert r2.status == 200
+            assert r2.headers["X-KT-Idempotent-Replay"] == "1"
+            assert r2.headers["X-Request-ID"] == "rid-b"
+        self._run(body)
+
+
+class TestTracePropagationE2E:
+    """The acceptance waterfall: client call → pod server → rank worker →
+    store fetch is ONE trace with correctly parented spans, queryable from
+    the pod's /debug/traces flight recorder."""
+
+    def test_client_server_worker_store_single_trace(self, pod_metadata,
+                                                     clean_ring,
+                                                     monkeypatch, tmp_path):
+        import numpy as np
+
+        import requests as _rq
+
+        from kubetorch_tpu.data_store import commands as ds
+        from kubetorch_tpu.data_store.store_server import create_store_app
+        from kubetorch_tpu.serving.http_client import HTTPClient
+        from kubetorch_tpu.serving.http_server import create_app
+        from tests.assets.threaded_server import ThreadedAiohttpServer
+
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "store_fetcher")
+        arr = np.arange(64, dtype=np.float32)
+
+        with ThreadedAiohttpServer(
+                lambda: create_store_app(str(tmp_path / "store"))) as store:
+            ds.put("obs/e2e/weights", arr, store_url=store.url)
+            with ThreadedAiohttpServer(create_app) as srv:
+                client = HTTPClient(srv.url, stream_logs=False)
+                out = client.call_method(
+                    "store_fetcher", args=(store.url, "obs/e2e/weights"),
+                    timeout=120)
+                assert out == float(arr.sum())
+
+                # the client span is in OUR ring; everything else must have
+                # joined its trace
+                client_span = next(
+                    s for s in reversed(tel.RING.snapshot())
+                    if s["name"] == "client.call")
+                trace_id = client_span["trace_id"]
+
+                def spans_by_name():
+                    r = _rq.get(f"{srv.url}/debug/traces",
+                                params={"q": trace_id}, timeout=10)
+                    assert r.status == 200 if hasattr(r, "status") \
+                        else r.status_code == 200
+                    return {s["name"]: s for s in r.json()["spans"]}
+
+                # worker spans arrive over the response queue a beat after
+                # the HTTP response — poll briefly
+                deadline = time.monotonic() + 15
+                spans = spans_by_name()
+                while time.monotonic() < deadline and not (
+                        "worker.execute" in spans
+                        and "store.fetch" in spans):
+                    time.sleep(0.2)
+                    spans = spans_by_name()
+
+                assert "server.request" in spans, spans.keys()
+                assert "stage.deserialize" in spans
+                assert "stage.execute" in spans
+                assert "worker.execute" in spans, (
+                    "rank-worker spans never shipped back")
+                assert "store.fetch" in spans
+                assert "store.request" in spans
+
+                # one trace, correctly parented across every boundary
+                for s in spans.values():
+                    assert s["trace_id"] == trace_id
+                assert spans["server.request"]["parent_id"] == \
+                    client_span["span_id"]
+                assert spans["stage.execute"]["parent_id"] == \
+                    spans["server.request"]["span_id"]
+                assert spans["worker.execute"]["parent_id"] == \
+                    spans["stage.execute"]["span_id"]
+                assert spans["worker.execute"]["attrs"]["request_id"] == \
+                    client_span["attrs"]["request_id"]
+                # store fetch happened in the worker process, source-tagged
+                assert spans["store.fetch"]["attrs"]["source"] == "store"
+                assert spans["store.fetch"]["attrs"]["bytes"] == arr.nbytes
+                # queue wait was measured and shipped
+                assert "queue_wait_s" in spans["worker.execute"]["attrs"]
+
+
+class TestChaosRetryThroughTraces:
+    """KT_CHAOS=503*2 → the client span shows exactly 2 retry events with
+    the policy's backoff delays, and the server flight recorder shows the
+    faulted attempts annotated with chaos.fault events."""
+
+    def test_5xx_retries_are_span_events(self, pod_metadata, clean_ring,
+                                         monkeypatch):
+        import requests as _rq
+
+        from kubetorch_tpu.resilience import RetryPolicy
+        from kubetorch_tpu.serving.http_client import HTTPClient
+        from kubetorch_tpu.serving.http_server import create_app
+        from tests.assets.threaded_server import ThreadedAiohttpServer
+
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "summer")
+        monkeypatch.setenv("KT_CHAOS", "503:0.01*2")
+        monkeypatch.setenv("KT_CHAOS_SEED", "1234")
+
+        with ThreadedAiohttpServer(create_app) as srv:
+            client = HTTPClient(srv.url, stream_logs=False)
+            policy = RetryPolicy(max_attempts=4, base_delay=0.02,
+                                 max_delay=0.05, seed=777)
+            out = client.call_method("summer", args=(2, 3),
+                                     idempotency_key="obs-chaos-1",
+                                     retry=policy, timeout=60)
+            assert out == 5
+
+            client_span = next(s for s in reversed(tel.RING.snapshot())
+                               if s["name"] == "client.call")
+            retries = [e for e in client_span["events"]
+                       if e["name"] == "retry"]
+            assert len(retries) == 2
+            assert [e["attrs"]["delay_s"] for e in retries] == \
+                [round(d, 6) for d in client.last_retry_delays]
+            assert all(e["attrs"]["reason"] == "status"
+                       and e["attrs"]["status"] == 503 for e in retries)
+
+            # server side: 3 attempts in one trace, 2 annotated as faulted
+            r = _rq.get(f"{srv.url}/debug/traces",
+                        params={"q": client_span["trace_id"]}, timeout=10)
+            server_spans = [s for s in r.json()["spans"]
+                            if s["name"] == "server.request"]
+            assert len(server_spans) == 3
+            faulted = [s for s in server_spans
+                       if any(e["name"] == "chaos.fault"
+                              for e in s["events"])]
+            assert len(faulted) == 2
+            assert all(e["attrs"]["kind"] == "status"
+                       for s in faulted for e in s["events"]
+                       if e["name"] == "chaos.fault")
+
+
+class TestWatchdogSpans:
+    def test_death_recorded_as_span_and_counter(self, clean_ring):
+        from types import SimpleNamespace
+
+        from kubetorch_tpu.serving import watchdog as wd
+
+        dead = SimpleNamespace(alive=False, exitcode=-9, in_warmup=False)
+        pool = SimpleNamespace(
+            workers=[dead], _stopping=threading.Event(),
+            framework_name="spmd",
+            fail_worker_futures=lambda idx, exc: None,
+            cancel_pending=lambda exc: None,
+            restart_all=lambda exc=None: None,
+            restart_worker=lambda idx: None)
+        dog = wd.Watchdog(pool, interval_s=10.0, budget=1, window_s=60.0)
+        before = wd._DEATHS.value(cause="Killed")
+        dog.check_now()
+        assert wd._DEATHS.value(cause="Killed") == before + 1
+        names = {s["name"] for s in tel.RING.snapshot()}
+        assert "watchdog.death" in names
+        assert "watchdog.restart" in names
+        death = next(s for s in tel.RING.snapshot()
+                     if s["name"] == "watchdog.death")
+        assert death["attrs"]["cause"] == "Killed"
+        assert death["attrs"]["rank"] == 0
+
+
+class TestLogCaptureTraceJoin:
+    def test_add_binds_request_and_trace_ids(self, clean_ring):
+        from kubetorch_tpu.serving.http_server import request_id_var
+        from kubetorch_tpu.serving.log_capture import LogCapture
+
+        cap = LogCapture(sink_url="http://sink.test", labels={"pod": "p1"})
+        token = request_id_var.set("rid-join")
+        try:
+            with tel.span("server.request") as sp:
+                cap.add("hello from the request")
+            cap.add("rank line", request_id="rid-rank", trace_id="tr-rank")
+        finally:
+            request_id_var.reset(token)
+        a, b = cap._buffer
+        assert a["request_id"] == "rid-join"
+        assert a["trace_id"] == sp.trace_id
+        assert b["request_id"] == "rid-rank" and b["trace_id"] == "tr-rank"
+
+
+class TestWaterfallAndCLI:
+    def test_format_waterfall_tree_and_events(self):
+        t0 = 1000.0
+        spans = [
+            {"name": "client.call", "trace_id": "tr1", "span_id": "a",
+             "parent_id": None, "start": t0, "end": t0 + 0.1,
+             "status": "ok", "attrs": {"request_id": "r1"},
+             "events": [{"ts": t0 + 0.01, "name": "retry",
+                         "attrs": {"attempt": 0, "delay_s": 0.02}}]},
+            {"name": "server.request", "trace_id": "tr1", "span_id": "b",
+             "parent_id": "a", "start": t0 + 0.02, "end": t0 + 0.09,
+             "status": "ok", "attrs": {}, "events": []},
+        ]
+        out = tel.format_waterfall(spans)
+        assert "trace tr1" in out
+        assert "client.call" in out and "server.request" in out
+        assert "• retry" in out and "delay_s=0.02" in out
+        # child indented under parent
+        client_line = next(l for l in out.splitlines() if "client.call" in l)
+        server_line = next(l for l in out.splitlines()
+                           if "server.request" in l)
+        assert server_line.index("server.request") > \
+            client_line.index("client.call")
+
+    def test_kt_trace_cli_waterfall(self, pod_metadata, clean_ring,
+                                    monkeypatch):
+        from click.testing import CliRunner
+
+        from kubetorch_tpu.cli import cli
+        from kubetorch_tpu.serving.http_client import HTTPClient
+        from kubetorch_tpu.serving.http_server import create_app
+        from tests.assets.threaded_server import ThreadedAiohttpServer
+
+        monkeypatch.setenv("KT_CLS_OR_FN_NAME", "summer")
+        with ThreadedAiohttpServer(create_app) as srv:
+            client = HTTPClient(srv.url, stream_logs=False)
+            assert client.call_method("summer", args=(1, 2),
+                                      timeout=60) == 3
+            client_span = next(s for s in reversed(tel.RING.snapshot())
+                               if s["name"] == "client.call")
+            runner = CliRunner()
+            res = runner.invoke(cli, ["trace", client_span["trace_id"],
+                                      "--url", srv.url])
+            assert res.exit_code == 0, res.output
+            assert "server.request" in res.output
+            assert "trace " in res.output
+            # request-id lookup works too (the waterfall join key)
+            res2 = runner.invoke(
+                cli, ["trace", client_span["attrs"]["request_id"],
+                      "--url", srv.url])
+            assert res2.exit_code == 0, res2.output
+            assert "server.request" in res2.output
+
+    def test_store_debug_traces_endpoint(self, clean_ring, tmp_path):
+        import requests as _rq
+
+        from kubetorch_tpu.data_store import netpool
+        from kubetorch_tpu.data_store.store_server import create_store_app
+        from tests.assets.threaded_server import ThreadedAiohttpServer
+
+        with ThreadedAiohttpServer(
+                lambda: create_store_app(str(tmp_path / "s"))) as store:
+            with tel.span("client.op", request_id="rid-store") as sp:
+                r = netpool.request("PUT", f"{store.url}/kv/obs%2Fk",
+                                    data=b"hello", timeout=30)
+                assert r.status_code == 200
+            r = _rq.get(f"{store.url}/debug/traces",
+                        params={"q": sp.trace_id}, timeout=10)
+            names = {s["name"] for s in r.json()["spans"]}
+            assert "store.server" in names
+            srv_span = next(s for s in r.json()["spans"]
+                            if s["name"] == "store.server")
+            assert srv_span["attrs"]["bytes"] == 5
+            # store /metrics speaks exposition with TYPE headers
+            m = _rq.get(f"{store.url}/metrics", timeout=10)
+            assert "# TYPE kt_store_requests_total counter" in m.text

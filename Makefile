@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos lint bench bench-store bench-trace smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-elastic lint bench bench-store bench-trace bench-ckpt smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -28,6 +28,12 @@ test-chaos:
 test-store-chaos:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_store_chaos.py -q
 
+# elastic SPMD suite (ISSUE 6): kill-rank → N-1 re-mesh resume from the
+# last committed checkpoint; term-rank → drain-and-checkpoint in the grace
+# window; commit-marker torn-upload safety; split restart budgets
+test-elastic:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m elastic
+
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
@@ -43,6 +49,12 @@ bench-store:
 # — enforced <3% enabled, ~0% disabled (the allocation-free fast path)
 bench-trace:
 	$(PY_CPU) python scripts/bench_datastore.py --trace-overhead
+
+# checkpoint regime (ISSUE 6): per-step committed-checkpoint cost vs the
+# fraction of leaves that changed — the "~free suspend/resume" claim,
+# BENCH-tracked
+bench-ckpt:
+	$(PY_CPU) python scripts/bench_datastore.py --checkpoint
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

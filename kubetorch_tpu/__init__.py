@@ -84,6 +84,13 @@ _LAZY = {
     "kt_breakpoint": ".serving.pdb_ws",
     "deep_breakpoint": ".serving.pdb_ws",
     "MeshSpec": ".parallel.mesh",
+    # elastic SPMD (ISSUE 6): the policy users attach via
+    # .distribute(elastic={...}), the in-step drain poll for cooperative
+    # preemption, and the commit-marked checkpointer behind resume
+    "ElasticPolicy": ".serving.elastic",
+    "drain_requested": ".serving.elastic",
+    "batch_scale": ".serving.elastic",
+    "Checkpointer": ".train.checkpoint",
     # module-valued: kt.models.load_hf / kt.models.LlamaConfig (the HF
     # migration surface); resolved to the module itself by __getattr__
     "models": ".models",

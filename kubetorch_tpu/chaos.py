@@ -18,6 +18,7 @@ once, and the backoff sequence matches the policy".
              | corrupt-blob                     (store-state; see below)
              | torn-write[:BYTES]               (store-state; see below)
              | kill-rank:SIG@OP_INDEX           (process-level; see below)
+             | term-rank:GRACE_S@OP_INDEX       (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
   matching request consumes the first unconsumed token whose path filter
@@ -66,6 +67,20 @@ Fault kinds:
   ``@``-bearing kill-rank tokens the suffix is the op index, not a path);
   the watchdog (``serving/watchdog.py``) must detect the death, fail the
   in-flight futures typed, and drive the bounded restart.
+- ``term-rank:GRACE_S@N``  **process-level** fault, the *graceful* sibling
+  of ``kill-rank``: at its N-th call op the rank delivers SIGTERM to
+  itself (the worker's drain handler flips the cooperative drain flag, so
+  the in-flight user step can observe it and flush a checkpoint) and arms
+  a SIGKILL timer GRACE_S seconds out — exactly the GKE preemption
+  contract (SIGTERM, grace window, SIGKILL). A step loop that drains and
+  exits inside the window is never force-killed; one that ignores the
+  flag dies hard when the timer fires. This is how the elastic
+  drain-and-checkpoint path (``serving/elastic.py``) is proven
+  deterministically, not just with hard kills.
+- Both rank verbs honor ``KT_CHAOS_RANK``: when set, the plan applies only
+  to the rank whose ``RANK`` env matches — so an N-rank job can lose
+  exactly one rank (the elastic N-1 re-mesh scenario) instead of all N
+  self-killing at the same op index.
 
 Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
 connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
@@ -95,13 +110,18 @@ _CHAOS_FAULTS = telemetry.counter(
 
 CHAOS_ENV = "KT_CHAOS"
 CHAOS_SEED_ENV = "KT_CHAOS_SEED"
+CHAOS_RANK_ENV = "KT_CHAOS_RANK"
 
 # With no @path filter, never chaos the liveness plumbing: readiness polls
 # retry forever and would silently eat the whole schedule.
 EXEMPT_PATHS = ("/health", "/ready", "/metrics")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
-          "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank")
+          "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
+          "term-rank")
+
+# verbs consumed by the rank worker loop, not the HTTP middleware
+_RANK_KINDS = ("kill-rank", "term-rank")
 
 
 @dataclass
@@ -113,8 +133,9 @@ class Fault:
     path: Optional[str] = None         # path-prefix filter
     prob: Optional[float] = None       # None → deterministic schedule token
     signal_no: int = 9                 # kill-rank: signal to self-deliver
-    op_index: int = 0                  # kill-rank: 0-based call-op index
+    op_index: int = 0                  # kill/term-rank: 0-based call-op index
     torn_bytes: int = 4096             # torn-write: body bytes staged pre-kill
+    grace_s: float = 5.0               # term-rank: SIGTERM→SIGKILL window
 
     def matches(self, path: str, method: Optional[str] = None) -> bool:
         # the store-state verbs are method-shaped: corrupt-blob rots a file
@@ -159,8 +180,8 @@ def parse_spec(spec: str) -> List[Fault]:
         if "@" in token:
             token, _, path = token.partition("@")
         fault = _parse_one(token.strip(), raw)
-        if fault.kind == "kill-rank":
-            # for kill-rank the @-suffix is the call-op index, not a path
+        if fault.kind in _RANK_KINDS:
+            # for the rank verbs the @-suffix is the call-op index, not a path
             try:
                 fault.op_index = int(path) if path else 0
             except ValueError:
@@ -189,6 +210,14 @@ def _parse_one(token: str, raw: str) -> Fault:
     if head == "kill-rank":
         return Fault(kind="kill-rank",
                      signal_no=_parse_signal(arg or "9", raw))
+    if head == "term-rank":
+        fault = Fault(kind="term-rank")
+        if arg:
+            try:
+                fault.grace_s = max(0.0, float(arg))
+            except ValueError:
+                raise ChaosError(f"bad grace window in {raw!r}")
+        return fault
     if head == "delay":
         try:
             return Fault(kind="delay", seconds=float(arg))
@@ -225,9 +254,10 @@ class ChaosEngine:
     drive engines from multiple threads)."""
 
     def __init__(self, faults: List[Fault], seed: int = 0):
-        # kill-rank verbs are process-level: consumed by the rank worker
-        # loop via rank_kill_plan(), invisible to the HTTP middleware
-        faults = [f for f in faults if f.kind != "kill-rank"]
+        # kill-rank/term-rank verbs are process-level: consumed by the rank
+        # worker loop via rank_kill_plan()/rank_term_plan(), invisible to
+        # the HTTP middleware
+        faults = [f for f in faults if f.kind not in _RANK_KINDS]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
@@ -268,21 +298,48 @@ class ChaosEngine:
         return None
 
 
-def rank_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
-    """``{call-op index → signal}`` from ``KT_CHAOS``'s process-level
-    ``kill-rank`` verbs — the schedule a rank worker consults as it
-    dequeues call ops. Empty when no kill-rank verb is present. A malformed
+def _rank_in_scope() -> bool:
+    """``KT_CHAOS_RANK`` narrows the rank verbs to one global rank (so an
+    N-rank job can lose exactly one rank). Unset → every rank is in scope."""
+    want = os.environ.get(CHAOS_RANK_ENV)
+    if not want:
+        return True
+    return os.environ.get("RANK", "0") == want.strip()
+
+
+def _rank_faults(kind: str, spec: Optional[str]) -> List[Fault]:
+    """Shared plan extraction for the process-level verbs. A malformed
     spec is reported, not raised: dying at spawn over a typo would read as
     the exact crash loop this machinery exists to diagnose."""
     raw = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
-    if "kill-rank" not in (raw or ""):
-        return {}
+    if kind not in (raw or ""):
+        return []
+    if spec is None and not _rank_in_scope():
+        return []
     try:
         faults = parse_spec(raw)
     except ChaosError as e:
         print(f"[kt] chaos: ignoring malformed {CHAOS_ENV}: {e}")
-        return {}
-    return {f.op_index: f.signal_no for f in faults if f.kind == "kill-rank"}
+        return []
+    return [f for f in faults if f.kind == kind]
+
+
+def rank_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{call-op index → signal}`` from ``KT_CHAOS``'s process-level
+    ``kill-rank`` verbs — the schedule a rank worker consults as it
+    dequeues call ops. Empty when no kill-rank verb is present (or this
+    rank is out of ``KT_CHAOS_RANK`` scope)."""
+    return {f.op_index: f.signal_no
+            for f in _rank_faults("kill-rank", spec)}
+
+
+def rank_term_plan(spec: Optional[str] = None) -> Dict[int, float]:
+    """``{call-op index → grace seconds}`` from the ``term-rank`` verbs:
+    at that op the rank SIGTERMs itself (cooperative drain) and arms a
+    SIGKILL ``grace_s`` seconds out — the deterministic GKE-preemption
+    stand-in the drain-and-checkpoint path is tested with."""
+    return {f.op_index: f.grace_s
+            for f in _rank_faults("term-rank", spec)}
 
 
 def _store_target(request):

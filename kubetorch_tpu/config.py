@@ -58,6 +58,13 @@ class KTConfig:
     watchdog_interval_s: float = 0.5
     restart_budget: int = 3
     restart_window_s: float = 300.0
+    # elastic SPMD (serving/elastic.py): the resume budget is SPLIT from
+    # restart_budget above — checkpoint-resumes/re-meshes draw from this
+    # sliding window (KT_ELASTIC_MAX_RESUMES / KT_ELASTIC_RESUME_WINDOW_S)
+    # so routine preemptions never exhaust the crash-loop guard. 0 disables
+    # elastic resume (deaths fall back to the policy's hard-fail verdict).
+    elastic_max_resumes: int = 8
+    elastic_resume_window_s: float = 3600.0
     # crash-consistent data store (data_store/durability.py + scrub.py).
     # Same env layering (KT_STORE_FSYNC / KT_SCRUB_INTERVAL_S /
     # KT_SCRUB_RATE_MBPS / KT_PEER_TTL_S / KT_GC_GRACE_S); store_fsync=False

@@ -244,6 +244,12 @@ class WorkerMembershipChanged(KubetorchError):
     and criticality so the client can resize (``.distribute(workers=N-1)``)
     and redeploy — the elastic-recovery recipe. On TPU an XLA-compiled mesh
     cannot shrink in place, so this exception *is* the resize trigger.
+
+    ``resumable`` (ISSUE 6) downgrades the event from fan-out-fatal to a
+    recoverable signal: when the serving side has an elastic policy
+    attached, the supervisor re-meshes to the surviving ranks, resumes from
+    the last committed checkpoint, and retries — the client never has to
+    orchestrate the resize itself.
     """
 
     def __init__(
@@ -253,12 +259,14 @@ class WorkerMembershipChanged(KubetorchError):
         removed: Optional[List[str]] = None,
         previous: Optional[List[str]] = None,
         current: Optional[List[str]] = None,
+        resumable: bool = False,
     ):
         super().__init__(message)
         self.added = added or []
         self.removed = removed or []
         self.previous = previous or []
         self.current = current or []
+        self.resumable = resumable
 
     @property
     def is_critical(self) -> bool:
@@ -365,7 +373,8 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "CircuitOpenError": ["retry_after"],
     "PodTerminatedError": ["reason", "pod_name", "exit_code"],
     "HbmOomError": ["requested_bytes", "available_bytes"],
-    "WorkerMembershipChanged": ["added", "removed", "previous", "current"],
+    "WorkerMembershipChanged": ["added", "removed", "previous", "current",
+                                "resumable"],
     "WorkerCallError": ["worker"],
     "WorkerDiedError": ["cause", "rank", "exitcode"],
 }

@@ -91,6 +91,40 @@ class MeshSpec:
     def shape(self) -> Tuple[int, ...]:
         return tuple(getattr(self, a) for a in CANONICAL_ORDER)
 
+    def shrink_to(self, n_devices: int) -> "MeshSpec":
+        """Re-mesh for a smaller device count (elastic N-1 resume,
+        ISSUE 6 / NTP arXiv:2504.06095's degraded-but-alive mode).
+
+        Model-parallel axes (tensor/context/expert/pipe) keep their sizes —
+        they define the sharded program's shape and the checkpoint's leaf
+        layout — while the data-like axes (data, then fsdp, then dcn, in
+        shrink-preference order) absorb the loss: pure data parallelism
+        costs only throughput to shrink, fsdp additionally re-gathers
+        parameters (the resharded checkpoint load handles that), and
+        slice count moves last. Raises ``ValueError`` when ``n_devices``
+        cannot hold the model axes at all.
+        """
+        sizes = self.axis_sizes()
+        data_axes = (AXIS_DATA, AXIS_FSDP, AXIS_DCN)
+        model = math.prod(s for a, s in sizes.items() if a not in data_axes)
+        if n_devices < model or n_devices % model:
+            raise ValueError(
+                f"Cannot re-mesh to {n_devices} devices: model-parallel "
+                f"axes need a multiple of {model}")
+        for axis in data_axes:
+            trial = dict(sizes)
+            trial[axis] = -1
+            try:
+                return MeshSpec(**trial).resolve(n_devices)
+            except ValueError:
+                continue
+        # remainder doesn't factor across the kept data axes: collapse all
+        # data parallelism onto one axis (prefer fsdp if it was in use)
+        trial = dict(sizes)
+        trial.update({a: 1 for a in data_axes})
+        trial[AXIS_FSDP if sizes[AXIS_FSDP] > 1 else AXIS_DATA] = -1
+        return MeshSpec(**trial).resolve(n_devices)
+
 
 @dataclass
 class DistributedConfig:
@@ -105,6 +139,11 @@ class DistributedConfig:
     procs_per_worker: Optional[int] = None  # default: 1 per TPU host (megacore)
     mesh: Optional[Dict[str, int]] = None
     restart_procs: bool = False
+    # elastic policy knobs (serving/elastic.py ElasticPolicy.from_dict):
+    # present → rank loss resumes from the last committed checkpoint on a
+    # re-meshed N-1 world instead of cancelling the fan-out. {} opts in
+    # with every default.
+    elastic: Optional[Dict] = None
 
     def to_dict(self) -> Dict:
         return {
@@ -113,12 +152,14 @@ class DistributedConfig:
             "procs_per_worker": self.procs_per_worker,
             "mesh": self.mesh,
             "restart_procs": self.restart_procs,
+            "elastic": self.elastic,
         }
 
     @classmethod
     def from_dict(cls, d: Dict) -> "DistributedConfig":
         return cls(**{k: d.get(k) for k in (
-            "distribution_type", "workers", "procs_per_worker", "mesh", "restart_procs")
+            "distribution_type", "workers", "procs_per_worker", "mesh",
+            "restart_procs", "elastic")
             if d.get(k) is not None})
 
 

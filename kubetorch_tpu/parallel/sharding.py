@@ -86,6 +86,23 @@ def shard_pytree(tree: Any, rules: ShardingRules, mesh) -> Any:
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
+def reshard_pytree(tree: Any, rules: ShardingRules, mesh) -> Any:
+    """Re-place an already-device-resident pytree onto a *different* mesh
+    (the elastic N-1 re-mesh, ISSUE 6): leaves are staged through host and
+    ``device_put`` with the new mesh's rule-derived shardings, so the same
+    rule table that laid the N-rank world out lays the (N-1)-rank world out
+    — nothing in the layout is pinned to the original device count. The
+    store-backed twin of this path is ``kt.get(key, mesh=..., rules=...)``
+    (resharded checkpoint load); use this one when the state is already in
+    memory on a surviving host."""
+    import jax
+    import numpy as np
+
+    host = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                                  tree)
+    return shard_pytree(host, rules, mesh)
+
+
 # ---------------------------------------------------------------------------
 # Rule tables
 # ---------------------------------------------------------------------------

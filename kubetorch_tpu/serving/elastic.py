@@ -1,0 +1,275 @@
+"""Elastic SPMD policy engine: survive rank loss without losing the job.
+
+PRs 2-5 built a fail-fast substrate: a dead rank is detected within one
+watchdog interval, classified into a typed cause, and healed by a
+budget-bounded full-pool respawn — but the respawn is a restart-from-zero
+that throws away every step since launch, exactly the failure amplification
+Nonuniform-Tensor-Parallelism (arXiv:2504.06095) shows dominates scaled-up
+training cost. This module is the degraded-but-alive alternative (ROADMAP
+item 4), the Singularity (arXiv:2202.07848) checkpoint/preempt/resume loop:
+
+- **Policy** — :class:`ElasticPolicy` maps the watchdog's typed causes to
+  actions: ``Preempted``/``Evicted`` get the cooperative drain-and-checkpoint
+  path *before* death (the SIGTERM grace window); ``OOMKilled`` restarts
+  with a scaled-down per-rank batch (the job was too big for the host, not
+  broken); ``Crashed``/``Killed``/``Exited`` resume from the last committed
+  checkpoint — on the surviving N-1 ranks when survivors remain (re-mesh),
+  at full size otherwise.
+- **Budget split** — elastic resumes draw from their *own* sliding-window
+  :class:`~..resilience.RestartBudget`, never the watchdog's hard-restart
+  budget: a healthy elastic job riding out routine preemptions can't
+  exhaust the budget that guards against genuine crash loops (and vice
+  versa). ``kt_restarts_total{kind=...}`` keeps the two series distinct.
+- **Drain flag** — the cooperative half of the loop. The rank worker
+  installs a SIGTERM handler that flips a process-local drain event; a
+  training step polls :func:`drain_requested` each iteration and flushes a
+  committed checkpoint (``train/checkpoint.py``'s commit-marker protocol)
+  inside the grace window, so a graceful preemption loses **zero** steps.
+- **State** — checkpoint/restore itself lives in ``train/checkpoint.py``
+  (async sharded saves to the data store, commit marker written last, delta
+  sync making per-step cost ~bytes-changed); the re-mesh lives in
+  ``ProcessPool.restart_all(num_procs=...)`` + ``MeshSpec.shrink_to``; this
+  module only decides *what to do* and accounts for it.
+
+Deterministic proof: the ``kill-rank`` chaos verb (hard loss → N-1 resume)
+and the ``term-rank`` verb (SIGTERM + grace → drain-and-checkpoint), see
+``tests/test_elastic.py`` / ``make test-elastic``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as signal_mod
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..resilience import RestartBudget
+
+# the elastic ledger (ISSUE 6): every resume decision is a counter by typed
+# cause, so "how often does this job lose ranks, and to what" is a scrape,
+# not a log grep
+_RESUMES = telemetry.counter(
+    "kt_elastic_resumes_total",
+    "Elastic resumes (re-mesh / checkpoint-resume / batch-scaled restart) "
+    "by typed death cause",
+    labels=("cause",))
+_DRAINS = telemetry.counter(
+    "kt_elastic_drains_total",
+    "Cooperative drain requests observed (SIGTERM grace-window path)")
+
+ELASTIC_MAX_RESUMES_ENV = "KT_ELASTIC_MAX_RESUMES"
+ELASTIC_RESUME_WINDOW_ENV = "KT_ELASTIC_RESUME_WINDOW_S"
+BATCH_SCALE_ENV = "KT_ELASTIC_BATCH_SCALE"
+
+# Actions a policy can decide for an observed rank death.
+RESUME = "resume"                          # re-mesh + resume from checkpoint
+RESTART_SMALLER_BATCH = "restart-smaller-batch"   # OOM: same mesh, scaled batch
+FAIL = "fail"                              # budget/min-ranks verdict: hard-fail
+
+
+def _env_or_cfg(env_key: str, cfg_field: str, default: float, cast=float):
+    """Env wins over the layered config (same precedence as the watchdog:
+    the config singleton may predate a runtime env mutation)."""
+    raw = os.environ.get(env_key)
+    if raw is not None:
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            pass
+    try:
+        from ..config import config
+        return cast(config().get(cfg_field, default))
+    except Exception:
+        return default
+
+
+def _default_max_resumes() -> int:
+    return max(0, _env_or_cfg(ELASTIC_MAX_RESUMES_ENV,
+                              "elastic_max_resumes", 8, int))
+
+
+def _default_resume_window() -> float:
+    return max(1.0, _env_or_cfg(ELASTIC_RESUME_WINDOW_ENV,
+                                "elastic_resume_window_s", 3600.0))
+
+
+@dataclass
+class ElasticPolicy:
+    """Knobs for the cause→action mapping. Travels controller→pod inside
+    ``DistributedConfig.elastic`` (a plain dict), so ``.distribute(...,
+    elastic={...})`` turns a fail-fast deployment into an elastic one."""
+
+    min_ranks: int = 1              # below this, shrink is refused → FAIL
+    max_resumes: int = -1           # elastic budget; -1 → env/config default
+    resume_window_s: float = -1.0   # sliding window; -1 → env/config default
+    oom_batch_scale: float = 0.5    # per-OOM multiplier on the batch scale
+    min_batch_scale: float = 0.125  # floor: below this an OOM is a hard fail
+    checkpoint_every: int = 50      # advisory cadence for Checkpointer users
+    drain_grace_s: float = 20.0     # advisory: expected SIGTERM→KILL window
+
+    def __post_init__(self):
+        if self.max_resumes < 0:
+            self.max_resumes = _default_max_resumes()
+        if self.resume_window_s < 0:
+            self.resume_window_s = _default_resume_window()
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ElasticPolicy":
+        d = d or {}
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    def action_for(self, cause: Optional[str]) -> str:
+        """Typed death cause → elastic action. ``Preempted``/``Evicted``
+        deaths land here only when the drain window was missed (the
+        cooperative path checkpoints *before* death) — the remedy is the
+        same resume-from-last-commit as any other loss."""
+        if cause == "OOMKilled":
+            return RESTART_SMALLER_BATCH
+        return RESUME
+
+
+class ElasticCoordinator:
+    """Decision + accounting state for one supervisor's elastic loop.
+
+    Owned by the supervisor, consulted by the pool's watchdog on every
+    observed death (``Watchdog._maybe_restart``). Thread-safety: decisions
+    run only on the watchdog thread; ``state_dict`` reads are snapshots.
+    """
+
+    def __init__(self, policy: Optional[ElasticPolicy] = None):
+        self.policy = policy or ElasticPolicy()
+        # the SPLIT budget: elastic resumes never touch the watchdog's
+        # hard-restart budget, so routine preemptions can't eat the guard
+        # against genuine crash loops
+        self.budget = RestartBudget(self.policy.max_resumes,
+                                    self.policy.resume_window_s)
+        self.batch_scale = 1.0
+        self.resumes = 0
+        self.events: List[Dict[str, Any]] = []
+
+    def decide(self, cause: Optional[str], surviving: int,
+               num_procs: int) -> Dict[str, Any]:
+        """One death → the verdict the watchdog executes.
+
+        Returns ``{"action", "num_procs", "env"}``: the respawn size (the
+        surviving N-1 ranks when enough survive — the re-mesh — else the
+        original size, a plain resume-from-checkpoint) and the env overrides
+        the fresh ranks must see (the batch scale). ``action == FAIL`` means
+        the elastic budget is spent or the floor was hit; the watchdog turns
+        that into the permanent typed failure.
+        """
+        action = self.policy.action_for(cause)
+        if action == RESTART_SMALLER_BATCH:
+            next_scale = self.batch_scale * self.policy.oom_batch_scale
+            if next_scale < self.policy.min_batch_scale:
+                return self._verdict(FAIL, cause, num_procs,
+                                     reason="batch scale floor reached")
+        if not self.budget.try_acquire():
+            return self._verdict(FAIL, cause, num_procs,
+                                 reason="elastic resume budget exhausted")
+        if action == RESTART_SMALLER_BATCH:
+            self.batch_scale *= self.policy.oom_batch_scale
+            new_procs = num_procs          # same mesh, smaller per-rank batch
+        elif surviving >= max(1, self.policy.min_ranks):
+            new_procs = surviving          # re-mesh to the N-1 survivors
+        else:
+            new_procs = num_procs          # whole pool lost: resume full-size
+        self.resumes += 1
+        _RESUMES.inc(cause=cause or "Unknown")
+        return self._verdict(action, cause, new_procs)
+
+    def _verdict(self, action: str, cause: Optional[str], num_procs: int,
+                 reason: Optional[str] = None) -> Dict[str, Any]:
+        verdict = {"action": action, "num_procs": max(1, num_procs),
+                   "env": self.env(), "cause": cause}
+        if reason:
+            verdict["reason"] = reason
+        self.events.append({**verdict, "at": time.time()})
+        del self.events[:-8]
+        return verdict
+
+    def env(self) -> Dict[str, str]:
+        """Env overrides for respawned ranks: the batch scale a training
+        loop reads via :func:`batch_scale` (halved per OOM)."""
+        return {BATCH_SCALE_ENV: f"{self.batch_scale:g}"}
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Surfaced under ``/health``'s ``workers.elastic``."""
+        out = {"resumes": self.resumes, "batch_scale": self.batch_scale,
+               **{f"budget_{k}": v for k, v in self.budget.state().items()}}
+        if self.events:
+            out["recent"] = self.events[-3:]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Cooperative drain (the process-local half of the preemption grace window)
+# ---------------------------------------------------------------------------
+
+# Process-local by design: the pod server and each rank subprocess own one
+# flag each. The server's SIGTERM path flips the pod-level watchdog drain
+# flag (watchdog.set_draining) for death *classification*; this event is the
+# rank-local signal a training step polls to flush-and-exit cooperatively.
+_drain = threading.Event()
+_drain_reason: Optional[str] = None
+
+
+def request_drain(reason: Optional[str] = None) -> None:
+    """Mark this process as draining: the step loop should checkpoint and
+    return at the next opportunity. Idempotent."""
+    global _drain_reason
+    if not _drain.is_set():
+        _drain_reason = reason
+        _DRAINS.inc()
+        telemetry.add_event("elastic.drain", reason=reason or "")
+    _drain.set()
+
+
+def drain_requested() -> bool:
+    """Poll this from inside a training step loop (cheap: one Event read).
+    True → flush a committed checkpoint and return; the pod/rank is going
+    away inside a grace window."""
+    return _drain.is_set()
+
+
+def drain_reason() -> Optional[str]:
+    return _drain_reason
+
+
+def clear_drain() -> None:
+    global _drain_reason
+    _drain_reason = None
+    _drain.clear()
+
+
+def install_sigterm_drain() -> None:
+    """Install the cooperative SIGTERM handler (rank subprocesses call this
+    before user code loads). SIGTERM no longer kills the rank instantly —
+    it flips the drain flag so the in-flight step can flush a checkpoint;
+    the sender's grace-window SIGKILL (kubelet, or the ``term-rank`` chaos
+    verb) remains the backstop for loops that never poll the flag. Only
+    effective on the main thread; elsewhere it is a recorded no-op."""
+    def _handler(signum, frame):  # noqa: ARG001 — signal signature
+        request_drain("SIGTERM")
+
+    try:
+        signal_mod.signal(signal_mod.SIGTERM, _handler)
+    except (ValueError, OSError):   # not the main thread / unsupported
+        pass
+
+
+def batch_scale(default: float = 1.0) -> float:
+    """The per-rank batch scale the elastic layer asked for (1.0 → full
+    batch; halved on each OOM-driven restart). Training loops multiply
+    their per-rank batch size by this."""
+    try:
+        return float(os.environ.get(BATCH_SCALE_ENV, default))
+    except (TypeError, ValueError):
+        return default

@@ -11,12 +11,13 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import math
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..exceptions import WorkerMembershipChanged
+from ..exceptions import WorkerDiedError, WorkerMembershipChanged
 from ..parallel.mesh import DistributedConfig
 from ..resources.pointers import Pointers
 from .discovery import discover_ips, my_pod_ip, wait_for_quorum
@@ -40,6 +41,52 @@ class ExecutionSupervisor:
         self.pool: Optional[ProcessPool] = None
         self._served_calls = 0
         self._restart_lock: Optional[asyncio.Lock] = None
+        # elastic policy (ISSUE 6): when the distributed config carries an
+        # `elastic` dict, rank loss resolves to checkpoint-resume / N-1
+        # re-mesh instead of cancel-the-fan-out + same-size respawn
+        self.elastic = None
+        if getattr(self.config, "elastic", None) is not None:
+            from .elastic import ElasticCoordinator, ElasticPolicy
+            self.elastic = ElasticCoordinator(
+                ElasticPolicy.from_dict(self.config.elastic))
+
+    def attach_elastic(self, policy_or_coordinator) -> None:
+        """Attach an elastic policy after construction (tests, embedders).
+        Wires the live pool's watchdog too when one already exists."""
+        from .elastic import ElasticCoordinator, ElasticPolicy
+        if isinstance(policy_or_coordinator, ElasticPolicy):
+            self.elastic = ElasticCoordinator(policy_or_coordinator)
+        else:
+            self.elastic = policy_or_coordinator
+        if self.pool is not None:
+            self._wire_elastic()
+
+    def _wire_elastic(self) -> None:
+        if self.elastic is None or self.pool is None:
+            return
+        self.pool.watchdog.attach_elastic(self.elastic)
+        self.pool.remesh_env = self._remesh_env
+
+    def _remesh_env(self, world_size: int) -> Dict[str, str]:
+        """Env overrides for a resized pool: a KT_MESH shrunk to the new
+        world (model-parallel axes keep their sizes, data-like axes absorb
+        the loss — see :meth:`~..parallel.mesh.MeshSpec.shrink_to`)."""
+        if not self.config.mesh:
+            return {}
+        import json
+        from ..parallel.mesh import MeshSpec
+        spec = MeshSpec.from_dict(self.config.mesh)
+        old_total = max(1, math.prod(spec.shape))
+        old_world = max(1, self.config.workers *
+                        (self.config.procs_per_worker or 1))
+        new_total = max(1, old_total * world_size // old_world)
+        try:
+            shrunk = spec.shrink_to(new_total)
+        except ValueError:
+            from ..parallel.mesh import best_mesh_for
+            shrunk = best_mesh_for(new_total)
+        return {"KT_MESH": json.dumps(
+            {a: s for a, s in shrunk.axis_sizes().items() if s > 1})}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -56,6 +103,7 @@ class ExecutionSupervisor:
             node_rank=0, num_nodes=1, pod_ips=[my_pod_ip()],
             base_env=self._base_env(),
         )
+        self._wire_elastic()
         self.pool.start()
 
     def _base_env(self) -> Dict[str, str]:
@@ -99,7 +147,41 @@ class ExecutionSupervisor:
                    timeout: Optional[float] = None, **_ignored) -> Any:
         async with self.restart_guard():
             assert self.pool is not None, "supervisor not set up"
-            return await self.pool.call(0, method, args, kwargs, timeout)
+            while True:
+                try:
+                    return await self.pool.call(0, method, args, kwargs,
+                                                timeout)
+                except (WorkerDiedError, WorkerMembershipChanged) as e:
+                    if not await self.elastic_recover(e):
+                        raise
+
+    async def elastic_recover(self, exc: BaseException) -> bool:
+        """The resume half of the elastic loop (ISSUE 6): when a call died
+        to rank loss and an elastic policy is attached, wait (bounded) for
+        the watchdog's elastic respawn — re-meshed to the survivors, user
+        state restored from the last committed checkpoint by the reloaded
+        callable — then tell the caller to retry instead of cancelling the
+        whole fan-out. False → not elastic / not resumable / pool failed
+        permanently: surface the typed error as before."""
+        if self.elastic is None or self.pool is None:
+            return False
+        if isinstance(exc, WorkerMembershipChanged) and \
+                not getattr(exc, "resumable", False):
+            return False
+        from .. import telemetry
+        # generous bound: watchdog interval + respawn backoff + worker spawn
+        deadline = time.monotonic() + max(
+            60.0, self.pool.watchdog.interval_s * 10)
+        while time.monotonic() < deadline:
+            if self.pool.watchdog.failed:
+                return False        # budget verdict: permanent, typed
+            if self.pool.healthy and not self.pool.recovering \
+                    and not self.pool.warming:
+                telemetry.add_event("elastic.call_retry",
+                                    num_procs=self.pool.num_procs)
+                return True
+            await asyncio.sleep(0.05)
+        return False
 
     def restart_guard(self):
         """Context manager for ``.distribute(restart_procs=True)``: fresh
@@ -163,6 +245,7 @@ class DistributedSupervisor(ExecutionSupervisor):
         # distributed fan-out, typed — not just the local branch
         self.pool.watchdog.on_death.append(self._on_worker_death)
         self.pool.watchdog.on_restart.append(self._on_worker_restart)
+        self._wire_elastic()
         self.pool.start()
         self._start_monitor()
 
@@ -192,6 +275,11 @@ class DistributedSupervisor(ExecutionSupervisor):
                     removed=sorted(set(previous) - set(current)),
                     previous=previous, current=current,
                 )
+                if self.elastic is not None and event.removed:
+                    # elastic jobs treat a shrunken pod set as resumable:
+                    # the fan-out coordinator re-meshes to the survivors
+                    # and resumes instead of cancelling the job
+                    event.resumable = True
                 self._known_ips = current
                 with self._events_lock:
                     self._membership_events.append(event)
@@ -214,6 +302,11 @@ class DistributedSupervisor(ExecutionSupervisor):
             f"(cause={exc.cause}); mesh invalidated",
             removed=[my_ip], previous=list(self._known_ips),
             current=[ip for ip in self._known_ips if ip != my_ip])
+        if self.elastic is not None:
+            # downgraded from fan-out-fatal to resumable (ISSUE 6): the
+            # elastic call loop waits out the re-mesh and retries on the
+            # surviving ranks instead of cancelling the whole job
+            event.resumable = True
         event.__cause__ = exc
         with self._events_lock:
             self._membership_events.append(event)

@@ -53,6 +53,11 @@ class ProcessPool:
         self._router_threads: List[threading.Thread] = []
         self._stopping = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # elastic re-mesh hook (ISSUE 6): set by supervisors; called with the
+        # new LOCAL world size on a resizing restart and returns env
+        # overrides (a shrunken KT_MESH) so the fresh ranks rebuild a mesh
+        # that matches the surviving device count instead of the spawn-time N
+        self.remesh_env: Optional[Any] = None
         self.watchdog = Watchdog(self)
 
     def _new_worker(self, local_rank: int):
@@ -92,11 +97,20 @@ class ProcessPool:
         fresh.start()
         self._start_router(fresh)
 
-    def restart_all(self, exc: Optional[BaseException] = None) -> None:
+    def restart_all(self, exc: Optional[BaseException] = None,
+                    num_procs: Optional[int] = None,
+                    extra_env: Optional[Dict[str, str]] = None) -> None:
         """Full-pool respawn for spawn-fixed collective identity (JAX/TPU
         mesh): surviving ranks hold half a broken collective, so their
         in-flight futures fail with the dead rank's typed cause and every
-        rank restarts together."""
+        rank restarts together.
+
+        ``num_procs``/``extra_env`` are the elastic re-mesh surface
+        (ISSUE 6): a resize respawns the pool at the surviving N-1 world
+        size, folds the coordinator's env overrides (batch scale) into the
+        base env, and asks ``remesh_env`` for a mesh matching the new size
+        — the fresh ranks come up as a coherent smaller world, not a
+        truncated copy of the old one."""
         if exc is not None:
             self.cancel_pending(exc)
         for w in self.workers:
@@ -107,6 +121,19 @@ class ProcessPool:
             time.sleep(0.05)
         for w in self.workers:
             w.force_kill_if_alive()
+        resized = num_procs is not None and num_procs != self.num_procs
+        if num_procs is not None:
+            self.num_procs = max(1, num_procs)
+        if extra_env:
+            self._base_env = {**(self._base_env or {}), **extra_env}
+        if self.remesh_env is not None and (resized or extra_env):
+            try:
+                self._base_env = {**(self._base_env or {}),
+                                  **(self.remesh_env(
+                                      self.num_procs * self._num_nodes) or {})}
+            except Exception:  # noqa: BLE001 — a bad hook must not stop heal
+                import traceback as _tb
+                print("[kt] pool remesh_env hook failed:\n" + _tb.format_exc())
         self.workers = [self._new_worker(lr) for lr in range(self.num_procs)]
         for w in self.workers:
             w.start()
@@ -149,10 +176,22 @@ class ProcessPool:
             # request; the dedup ring absorbs re-shipped trace prefixes
             from .. import telemetry
             span = resp.get("span") or {}
-            telemetry.ingest_span(span)
+            fresh = telemetry.ingest_span(span)
             qwait = span.get("attrs", {}).get("queue_wait_s")
             if isinstance(qwait, (int, float)):
                 telemetry.observe_stage("queue_wait", float(qwait))
+            # kt_checkpoint_seconds is observed in the RANK process (where
+            # Checkpointer runs) but scraped from THIS one: re-derive it
+            # from the shipped span, first arrival only (prefixes re-ship)
+            if fresh and span.get("name") in ("checkpoint.save",
+                                              "checkpoint.restore"):
+                dur = (span.get("end") or 0) - (span.get("start") or 0)
+                if dur >= 0:
+                    telemetry.histogram(
+                        "kt_checkpoint_seconds",
+                        "Checkpoint commit/restore wall-clock seconds",
+                        labels=("op",),
+                    ).observe(dur, op=span["name"].split(".", 1)[1])
             return
         if resp.get("op") == "state":
             # load+warmup bracket: gates /ready and shutdown escalation

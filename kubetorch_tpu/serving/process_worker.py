@@ -85,6 +85,13 @@ def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
     os.environ.update(env)
     _sys.stdout = _QueueTee(_sys.stdout, response_q, "stdout")
     _sys.stderr = _QueueTee(_sys.stderr, response_q, "stderr")
+    # Cooperative preemption (ISSUE 6): SIGTERM no longer kills the rank
+    # mid-step — it flips the process-local drain flag, the in-flight user
+    # step observes it via elastic.drain_requested() and flushes a committed
+    # checkpoint inside the grace window, then the loop below exits cleanly.
+    # The sender's SIGKILL (kubelet / term-rank chaos) stays the backstop.
+    from .elastic import install_sigterm_drain
+    install_sigterm_drain()
     # after the tees: a failed sync must reach the rank-log channel
     from .env_contract import sync_jax_runtime_config
     sync_jax_runtime_config()
@@ -98,12 +105,15 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
     executor = ThreadPoolExecutor(max_workers=_SYNC_EXECUTOR_THREADS)
     target: Any = None
     load_error: Optional[BaseException] = None
-    # process-level chaos (ISSUE 3): KT_CHAOS kill-rank verbs make THIS rank
-    # kill itself at a chosen call index — the deterministic stand-in for an
-    # OOM kill / preemption landing mid-call, which the parent's watchdog
-    # must detect and surface typed
-    from ..chaos import rank_kill_plan
+    # process-level chaos (ISSUE 3/6): KT_CHAOS kill-rank verbs make THIS
+    # rank kill itself at a chosen call index — the deterministic stand-in
+    # for an OOM kill landing mid-call — and term-rank verbs deliver the
+    # graceful SIGTERM + grace-window SIGKILL pair (the GKE preemption
+    # contract) so the drain-and-checkpoint path is testable too
+    from ..chaos import rank_kill_plan, rank_term_plan
+    from .elastic import drain_requested
     kill_plan = rank_kill_plan()
+    term_plan = rank_term_plan()
     call_index = 0
 
     # Eager-load the callable at spawn (reference :236-247) so first-request
@@ -134,6 +144,15 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
         item = await loop.run_in_executor(None, poll)
         if item is None:
             pending = {t for t in pending if not t.done()}
+            if drain_requested() and not pending:
+                # cooperative drain completed: every in-flight step has
+                # observed the flag (and flushed its checkpoint) — exit
+                # cleanly so the parent's watchdog classifies a drained
+                # rank, not an anonymous kill, and the elastic layer can
+                # resume from the fresh commit with zero lost steps
+                print("[kt] rank draining: all in-flight work done, exiting")
+                framework_for(framework_name).worker_cleanup()
+                break
             continue
         if item.get("op") == "shutdown":
             framework_for(framework_name).worker_cleanup()
@@ -152,11 +171,37 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
                     print(f"[kt] chaos: kill-rank sig={sig} "
                           f"at call index {call_index}")
                     os.kill(os.getpid(), sig)
+            if term_plan:
+                grace = term_plan.get(call_index)
+                if grace is not None:
+                    term_plan.pop(call_index)
+                    _chaos_term_self(grace, call_index)
             call_index += 1
             task = asyncio.ensure_future(
                 _handle(item, target, load_error, response_q, executor,
                         identity_env))
         pending.add(task)
+
+
+def _chaos_term_self(grace_s: float, call_index: int) -> None:
+    """term-rank chaos: the GKE preemption contract, self-delivered —
+    SIGTERM now (the drain handler flips the flag; the op just dequeued
+    still runs and can flush a checkpoint), SIGKILL ``grace_s`` later if
+    this process is still alive. The timer thread dies with a clean exit,
+    so a loop that drains inside the window is never force-killed."""
+    import signal as _signal
+    import threading as _threading
+
+    print(f"[kt] chaos: term-rank grace={grace_s:g}s "
+          f"at call index {call_index}")
+
+    def _kill():
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+    timer = _threading.Timer(grace_s, _kill)
+    timer.daemon = True
+    timer.start()
+    os.kill(os.getpid(), _signal.SIGTERM)
 
 
 def _host_view(obj: Any) -> Any:
@@ -269,7 +314,18 @@ def _ship_trace_spans(response_q, sp) -> None:
     d = sp.to_dict() if sp else None
     if d is None:
         return
-    for span_dict in telemetry.RING.find(d["trace_id"]):
+    to_ship = telemetry.RING.find(d["trace_id"])
+    # checkpoint spans can finish OFF this trace (the drain-path sync save,
+    # an async commit whose step already returned): ship the recent ones
+    # too so the pool can derive kt_checkpoint_seconds in the process that
+    # actually serves /metrics — the parent ring dedups re-ships
+    shipped = {(s.get("trace_id"), s.get("span_id")) for s in to_ship}
+    for span_dict in telemetry.RING.snapshot(limit=32):
+        if str(span_dict.get("name", "")).startswith("checkpoint.") and \
+                (span_dict.get("trace_id"),
+                 span_dict.get("span_id")) not in shipped:
+            to_ship.append(span_dict)
+    for span_dict in to_ship:
         try:
             response_q.put({"op": "span", "span": span_dict})
         except Exception:  # noqa: BLE001 — telemetry must not fail the call

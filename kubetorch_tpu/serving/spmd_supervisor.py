@@ -26,7 +26,8 @@ import asyncio
 import os
 from typing import Any, Dict, List, Optional, Sequence, Union
 
-from ..exceptions import WorkerCallError, WorkerMembershipChanged
+from ..exceptions import (WorkerCallError, WorkerDiedError,
+                          WorkerMembershipChanged)
 from .discovery import my_pod_ip
 from .execution_supervisor import DistributedSupervisor
 from .remote_worker_pool import RemoteWorkerPool
@@ -108,8 +109,19 @@ class SPMDSupervisor(DistributedSupervisor):
                    sel_ips: Optional[List[str]] = None,
                    headers: Optional[Dict[str, str]] = None) -> List[Any]:
         async with self.restart_guard():    # each pod restarts its own ranks
-            return await self._call_inner(method, args, kwargs, timeout,
-                                          workers, subtree, sel_ips, headers)
+            while True:
+                try:
+                    return await self._call_inner(method, args, kwargs,
+                                                  timeout, workers, subtree,
+                                                  sel_ips, headers)
+                except (WorkerDiedError, WorkerMembershipChanged) as e:
+                    # elastic resume (ISSUE 6), coordinator-only: interior
+                    # tree nodes surface the typed error to THEIR
+                    # coordinator, which owns the one retry — a nested
+                    # retry would double-execute surviving subtrees
+                    if subtree is not None or \
+                            not await self.elastic_recover(e):
+                        raise
 
     async def _call_inner(self, method, args, kwargs, timeout, workers,
                           subtree, sel_ips, headers) -> List[Any]:

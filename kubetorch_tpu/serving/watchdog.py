@@ -66,6 +66,15 @@ _RESTARTS = telemetry.counter(
     "kt_worker_restarts_total",
     "Rank-pool restarts driven by the watchdog, by mode",
     labels=("mode",))
+# ISSUE 6 budget split: hard restarts (crash-loop guard, the watchdog's own
+# budget) and elastic resumes (checkpoint-resume/re-mesh, the coordinator's
+# budget) are distinct series — one healthy elastic job riding preemptions
+# must not look like a crash loop on a dashboard, or in a budget
+_RESTARTS_KIND = telemetry.counter(
+    "kt_restarts_total",
+    "Rank-pool restarts by kind: hard (full respawn, restart budget) vs "
+    "elastic (checkpoint resume / N-1 re-mesh, elastic budget)",
+    labels=("kind",))
 _BUDGET_EXHAUSTED = telemetry.counter(
     "kt_restart_budget_exhausted_total",
     "Permanent pool failures after restart-budget exhaustion")
@@ -235,6 +244,15 @@ class Watchdog:
         self.deaths: List[Dict] = []
         self._failed_fields: Optional[Dict] = None
         self._oom_baseline = read_oom_kill_count()
+        # elastic coordinator (serving/elastic.py), attached by supervisors
+        # with an elastic policy: deaths then resolve to checkpoint-resume /
+        # N-1 re-mesh on the coordinator's OWN budget instead of a same-size
+        # hard respawn on this watchdog's budget
+        self.elastic = None
+
+    def attach_elastic(self, coordinator) -> None:
+        """Route future death verdicts through an elastic coordinator."""
+        self.elastic = coordinator
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -288,6 +306,8 @@ class Watchdog:
         """Restart state for ``/health`` (and operators' eyeballs)."""
         out = {"restarts": self.restarts, "recovering": self.recovering,
                "interval_s": self.interval_s, **self.budget.state()}
+        if self.elastic is not None:
+            out["elastic"] = self.elastic.state_dict()
         if self._failed_fields is not None:
             out["permanent_failure"] = dict(self._failed_fields)
         if self.deaths:
@@ -342,29 +362,35 @@ class Watchdog:
 
     # -- restart policy ------------------------------------------------------
 
+    def _fail_permanently(self, exc: WorkerDiedError, why: str) -> None:
+        """Flip to the permanent typed failure and strand no waiter."""
+        self._failed_fields = {
+            "message": (f"rank pool permanently failed: {why}; last death: "
+                        f"rank {exc.rank} cause={exc.cause}"),
+            "cause": exc.cause, "rank": exc.rank,
+            "exitcode": exc.exitcode}
+        print(f"[kt] watchdog: {self._failed_fields['message']}")
+        _BUDGET_EXHAUSTED.inc()
+        with telemetry.span("watchdog.permanent_failure",
+                            cause=exc.cause, rank=exc.rank,
+                            budget=self.budget.budget):
+            # whatever is still in flight on live ranks fails typed too —
+            # the pool will never answer
+            self.pool.cancel_pending(self.permanent_error())
+
     def _maybe_restart(self, dead_idxs: List[int],
                        exc: WorkerDiedError) -> None:
         if self.failed:
             return
         self.recovering = True
         try:
+            if self.elastic is not None:
+                self._elastic_restart(dead_idxs, exc)
+                return
             if not self.budget.try_acquire():
-                self._failed_fields = {
-                    "message": (
-                        f"rank pool permanently failed: restart budget "
-                        f"exhausted ({self.budget.budget} restarts / "
-                        f"{self.budget.window_s:g}s window); last death: "
-                        f"rank {exc.rank} cause={exc.cause}"),
-                    "cause": exc.cause, "rank": exc.rank,
-                    "exitcode": exc.exitcode}
-                print(f"[kt] watchdog: {self._failed_fields['message']}")
-                _BUDGET_EXHAUSTED.inc()
-                with telemetry.span("watchdog.permanent_failure",
-                                    cause=exc.cause, rank=exc.rank,
-                                    budget=self.budget.budget):
-                    # strand no waiter: whatever is still in flight on live
-                    # ranks fails typed too — the pool will never answer
-                    self.pool.cancel_pending(self.permanent_error())
+                self._fail_permanently(
+                    exc, f"restart budget exhausted ({self.budget.budget} "
+                         f"restarts / {self.budget.window_s:g}s window)")
                 return
             delay = self._delays[min(self.restarts, len(self._delays) - 1)]
             if delay > 0 and self._stop.wait(delay):
@@ -386,16 +412,56 @@ class Watchdog:
                     self.pool.restart_all(exc)
                 self.restarts += 1
                 _RESTARTS.inc(mode=mode)
+                _RESTARTS_KIND.inc(kind="hard")
                 sp.set_attr("budget_remaining", self.budget.remaining)
             print(f"[kt] watchdog: pool restarted "
                   f"({'ranks ' + str(dead_idxs) if fw.per_call_identity else 'full pool'}; "
                   f"restart {self.restarts}, "
                   f"{self.budget.remaining} left in window)")
-            for hook in list(self.on_restart):
-                try:
-                    hook()
-                except Exception:  # noqa: BLE001
-                    print("[kt] watchdog on_restart hook failed:\n"
-                          + traceback.format_exc())
+            self._fire_on_restart()
         finally:
             self.recovering = False
+
+    def _elastic_restart(self, dead_idxs: List[int],
+                         exc: WorkerDiedError) -> None:
+        """Elastic path (ISSUE 6): the coordinator decides — re-mesh to the
+        survivors and resume from the last committed checkpoint, restart
+        with a scaled-down batch (OOM), or fail hard when the *elastic*
+        budget is spent. The watchdog's own hard-restart budget is never
+        touched on this path: the budgets are split by design."""
+        surviving = max(0, len(self.pool.workers) - len(dead_idxs))
+        verdict = self.elastic.decide(exc.cause, surviving,
+                                      self.pool.num_procs)
+        if verdict["action"] == "fail":
+            self._fail_permanently(
+                exc, f"elastic policy gave up "
+                     f"({verdict.get('reason', 'no resume possible')})")
+            return
+        delay = self._delays[min(self.restarts, len(self._delays) - 1)]
+        if delay > 0 and self._stop.wait(delay):
+            return              # pool shut down while we backed off
+        with telemetry.span("watchdog.elastic_resume",
+                            action=verdict["action"], cause=exc.cause,
+                            ranks=str(dead_idxs),
+                            num_procs=verdict["num_procs"],
+                            backoff_s=round(delay, 4)) as sp:
+            # a re-mesh is always a full respawn: surviving ranks hold a
+            # world-size-N collective identity that no longer exists
+            self.pool.restart_all(exc, num_procs=verdict["num_procs"],
+                                  extra_env=verdict["env"])
+            self.restarts += 1
+            _RESTARTS_KIND.inc(kind="elastic")
+            sp.set_attr("budget_remaining", self.elastic.budget.remaining)
+        print(f"[kt] watchdog: elastic {verdict['action']} "
+              f"(ranks {dead_idxs} died cause={exc.cause}; pool now "
+              f"{verdict['num_procs']} rank(s), "
+              f"{self.elastic.budget.remaining} elastic resume(s) left)")
+        self._fire_on_restart()
+
+    def _fire_on_restart(self) -> None:
+        for hook in list(self.on_restart):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001
+                print("[kt] watchdog on_restart hook failed:\n"
+                      + traceback.format_exc())

@@ -284,16 +284,21 @@ class TraceRing:
         except ValueError:
             return 2048
 
-    def add(self, span_dict: Optional[Dict]) -> None:
+    def add(self, span_dict: Optional[Dict]) -> bool:
+        """Upsert; True when this ``(trace_id, span_id)`` was NOT already
+        in the ring — the gate for observe-once metric derivation from
+        re-shipped span prefixes."""
         if not span_dict:
-            return
+            return False
         key = (span_dict.get("trace_id", ""), span_dict.get("span_id", ""))
         with self._lock:
+            fresh = key not in self._spans
             self._spans[key] = span_dict
             self._spans.move_to_end(key)
             cap = self.capacity
             while len(self._spans) > cap:
                 self._spans.popitem(last=False)
+        return fresh
 
     def __len__(self) -> int:
         with self._lock:
@@ -325,10 +330,12 @@ class TraceRing:
 RING = TraceRing()
 
 
-def ingest_span(span_dict: Optional[Dict]) -> None:
+def ingest_span(span_dict: Optional[Dict]) -> bool:
     """Feed a span finished in ANOTHER process (rank worker) into this
-    process's ring, so one ``/debug/traces`` query sees the whole request."""
-    RING.add(span_dict)
+    process's ring, so one ``/debug/traces`` query sees the whole request.
+    Returns True when the span was new to the ring (workers re-ship trace
+    prefixes; derive metrics from a span only on its first arrival)."""
+    return RING.add(span_dict)
 
 
 # ---------------------------------------------------------------------------

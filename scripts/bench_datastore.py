@@ -13,6 +13,12 @@ built for (ISSUE 1 / ROADMAP "as fast as the hardware allows"):
   plus a parallel get racing a concurrent sweep, so the steady-state
   overhead of the background scrubber on the fetch hot path is a tracked
   number, not a guess.
+- **checkpoint** — (``--checkpoint`` / ``make bench-ckpt``, ISSUE 6) the
+  commit-marker checkpoint loop (``train/checkpoint.py`` two-slot
+  ping-pong + marker): per-step committed-checkpoint wall-clock and wire
+  bytes vs. the fraction of leaves that changed since the slot's previous
+  content — the BENCH-tracked number behind the "~free suspend/resume"
+  claim (per-step cost must track bytes-changed, not checkpoint size).
 - **trace**      — (``--trace-overhead`` / ``make bench-trace``, ISSUE 5)
   the same put/get hot path with telemetry spans disabled (``KT_TRACE=0``,
   the allocation-free fast path) vs enabled, on both client and store.
@@ -244,6 +250,69 @@ def bench_trace(leaves: int, mb_per_leaf: float, reps: int = 5) -> dict:
     return out
 
 
+def bench_checkpoint(leaves: int, mb_per_leaf: float,
+                     fractions=(0.0, 0.05, 0.25, 1.0)) -> dict:
+    """Checkpoint regime (ISSUE 6): commit cost vs bytes-changed fraction.
+
+    Primes BOTH ping-pong slots (the delta baseline for slot k is the
+    content committed two saves earlier), then for each fraction mutates
+    that share of leaves and measures one full committed save (leaves +
+    index + marker). ``wire_ratio`` ≈ uploaded/changed bytes — the claim
+    under test is that it stays ~1 instead of scaling with checkpoint
+    size."""
+    import numpy as np
+
+    from kubetorch_tpu.train.checkpoint import Checkpointer, commit_info
+    from kubetorch_tpu.utils.procs import free_port, kill_process_tree
+
+    tree = _make_tree(leaves, mb_per_leaf, seed=3)
+    total_mb = leaves * mb_per_leaf
+    out = {"leaves": leaves, "mb_per_leaf": mb_per_leaf,
+           "total_mb": total_mb, "regimes": []}
+    names = sorted(tree["layers"])
+    rng = np.random.default_rng(11)
+    with tempfile.TemporaryDirectory(prefix="kt-bench-ckpt-",
+                                     dir=_bench_root()) as root:
+        port = free_port()
+        proc = _start_store(root, port)
+        url = f"http://127.0.0.1:{port}"
+        try:
+            ck = Checkpointer("bench/ckpt", store_url=url)
+            step = 1
+            _, cold_s = _timed(lambda: ck.save(tree, step))
+            out["cold"] = {"save_s": round(cold_s, 3),
+                           "mb_s": round(total_mb / cold_s, 1)}
+            step += 1
+            ck.save(tree, step)                 # prime the second slot
+            for frac in fractions:
+                n_mut = int(round(frac * leaves))
+                for name in names[:n_mut]:      # deterministic subset
+                    arr = tree["layers"][name]
+                    arr[:] = rng.standard_normal(arr.shape).astype(arr.dtype)
+                step += 1
+                stats, save_s = _timed(
+                    lambda s=step: ck.save(tree, s))
+                changed_mb = n_mut * mb_per_leaf
+                out["regimes"].append({
+                    "changed_frac": frac,
+                    "changed_mb": changed_mb,
+                    "save_s": round(save_s, 3),
+                    "uploaded_bytes": stats["bytes"],
+                    "skipped": stats["skipped"],
+                    "wire_ratio": round(
+                        stats["bytes"] / (changed_mb * (1 << 20)), 2)
+                    if changed_mb else None,
+                })
+            info = commit_info("bench/ckpt", store_url=url)
+            _, restore_s = _timed(lambda: ck.restore())
+            out["restore"] = {"restore_s": round(restore_s, 3),
+                              "mb_s": round(total_mb / restore_s, 1),
+                              "committed_step": info["step"]}
+        finally:
+            kill_process_tree(proc.pid)
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--leaves", type=int, default=64)
@@ -255,10 +324,35 @@ def main() -> None:
                    help="run ONLY the tracing-overhead regime "
                         "(`make bench-trace`): put/get hot path with "
                         "telemetry disabled vs enabled")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="run ONLY the checkpoint regime (`make bench-ckpt`):"
+                        " committed-save cost vs bytes-changed fraction")
     p.add_argument("--reps", type=int, default=5,
                    help="trace-overhead regime repetitions (best-of)")
     args = p.parse_args()
 
+    if args.checkpoint:
+        r = bench_checkpoint(args.leaves, args.mb_per_leaf)
+        print(f"\ncheckpoint regime: {r['leaves']} leaves x "
+              f"{r['mb_per_leaf']} MB = {r['total_mb']:.0f} MB "
+              f"(commit-marker protocol, two-slot ping-pong)")
+        print(f"cold committed save: {r['cold']['save_s']}s "
+              f"({r['cold']['mb_s']} MB/s)")
+        print(f"{'changed':>8} {'save s':>8} {'uploaded':>12} "
+              f"{'skipped':>8} {'wire ratio':>11}")
+        for row in r["regimes"]:
+            ratio = row["wire_ratio"] if row["wire_ratio"] is not None \
+                else "-"
+            print(f"{row['changed_frac']:>7.0%} {row['save_s']:>8} "
+                  f"{row['uploaded_bytes']:>12} {row['skipped']:>8} "
+                  f"{ratio:>11}")
+        print(f"restore (committed step {r['restore']['committed_step']}): "
+              f"{r['restore']['restore_s']}s ({r['restore']['mb_s']} MB/s)")
+        print("\nper-step commit cost tracks bytes-changed (wire ratio ~1),"
+              " not checkpoint size — the delta sync behind '~free"
+              " suspend/resume'; unchanged leaves move zero bytes.")
+        print("\n" + json.dumps(r))
+        return
     if args.trace_overhead:
         r = bench_trace(args.leaves, args.mb_per_leaf, reps=args.reps)
         print(f"\ntracing overhead: {r['leaves']} leaves x "

@@ -37,6 +37,14 @@ enumerates the client-side files whose targets are rebuildable from the
 store (pod cache, pull destinations) and therefore deliberately skip the
 fsync tax.
 
+The fifth check (ISSUE 6) guards the checkpoint commit-marker protocol: a
+raw store write of training state in ``kubetorch_tpu/train/`` outside
+``checkpoint.py`` (a bare ``ds.put``/``kt.put``/``_kv_put`` call) produces
+a checkpoint with no commit marker and no torn-upload protection — elastic
+resume would happily restore a half-uploaded pytree. All checkpoint
+traffic must ride ``train/checkpoint.py`` (``Checkpointer`` or the
+``save_state`` primitives); the baseline is EMPTY on purpose.
+
 The fourth check (ISSUE 5) guards the unified metrics plane: an ad-hoc
 ``time.perf_counter()`` latency measurement in ``kubetorch_tpu/`` outside
 ``telemetry.py`` produces a number that dies in a local variable or a
@@ -126,6 +134,14 @@ METRIC_FMT_RE = re.compile(
 TELEMETRY_EXEMPT = {"telemetry.py"}
 TIMING_BASELINE: dict = {}
 METRIC_FMT_BASELINE: dict = {}
+
+# Raw checkpoint writes in train/ outside the commit-marker layer
+# (ISSUE 6). checkpoint.py is exempt (it IS the protocol); the baseline is
+# empty — train code stores state only through Checkpointer/save_state.
+CKPT_WRITE_RE = re.compile(
+    r"\b(?:ds|commands|kt)\s*\.\s*put\(|\b_kv_put\(")
+CKPT_EXEMPT = {"checkpoint.py"}
+CKPT_BASELINE: dict = {}
 
 REPLACE_RE = re.compile(r"\bos\.replace\(")
 REPLACE_EXEMPT = {"durability.py"}
@@ -225,6 +241,30 @@ def main() -> int:
               "justification.")
         return 1
 
+    ckpt_failures = []
+    ckpt_counts = {}
+    for path in sorted((PKG / "train").rglob("*.py")):
+        if path.name in CKPT_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, CKPT_WRITE_RE)
+        if n:
+            ckpt_counts[rel] = n
+        allowed = CKPT_BASELINE.get(rel, 0)
+        if n > allowed:
+            ckpt_failures.append(
+                f"  {rel}: {n} raw checkpoint write(s), baseline allows "
+                f"{allowed}")
+    if ckpt_failures:
+        print("check_resilience: raw checkpoint writes bypass the "
+              "commit-marker protocol:\n" + "\n".join(ckpt_failures))
+        print("\nTraining state must be stored through train/checkpoint.py "
+              "(Checkpointer.save/maybe_save or save_state): a bare store "
+              "put has no commit marker, so elastic resume could restore a "
+              "torn, half-uploaded checkpoint. For deliberate exceptions "
+              "update CKPT_BASELINE with a justification.")
+        return 1
+
     telemetry_failures = []
     timing_counts = {}
     fmt_counts = {}
@@ -264,6 +304,8 @@ def main() -> int:
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
            if replace_counts.get(f, 0) < allowed]
+        + [f for f, allowed in CKPT_BASELINE.items()
+           if ckpt_counts.get(f, 0) < allowed]
         + [f for f, allowed in TIMING_BASELINE.items()
            if timing_counts.get(f, 0) < allowed]
         + [f for f, allowed in METRIC_FMT_BASELINE.items()
@@ -273,8 +315,8 @@ def main() -> int:
               + ", ".join(stale) + ")")
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
-              "checks, data-store commit renames, and telemetry sites "
-              "accounted for")
+              "checks, data-store commit renames, checkpoint writes, and "
+              "telemetry sites accounted for")
     return 0
 
 

@@ -114,6 +114,63 @@ class Metered:
                 "not_a_number": "nope"}   # silently dropped
 
 
+class ElasticTrainer:
+    """Elastic SPMD stand-in (ISSUE 6): a numpy 'training loop' whose state
+    rides the commit-marker checkpoint protocol. On construction it resumes
+    from the last committed checkpoint when one exists (what a respawned
+    rank pool does after an elastic resume); each step bumps the params and
+    rank 0 commits; a drain request (SIGTERM grace window) flushes a fresh
+    commit instead of stepping."""
+
+    def __init__(self, store_url, key, every=1):
+        import numpy as np
+
+        from kubetorch_tpu.train.checkpoint import Checkpointer
+
+        self.rank = int(os.environ.get("RANK", "0"))
+        self.ckpt = Checkpointer(key, store_url=store_url, every=every)
+        restored = self.ckpt.restore()   # every rank reads; only 0 writes
+        if restored is not None:
+            tree, step = restored
+            self.params = tree["w"]
+            self.step_no = step
+            self.resumed_from = step
+        else:
+            self.params = np.zeros(8, np.float64)
+            self.step_no = 0
+            self.resumed_from = None
+
+    def _report(self, **extra):
+        from kubetorch_tpu.serving import elastic
+        from kubetorch_tpu.train.checkpoint import tree_fingerprint
+
+        return {"rank": self.rank, "step": self.step_no,
+                "resumed_from": self.resumed_from,
+                "world": os.environ.get("WORLD_SIZE"),
+                "batch_scale": elastic.batch_scale(),
+                "fingerprint": tree_fingerprint({"w": self.params}),
+                **extra}
+
+    def step(self, sleep_s=0.0):
+        from kubetorch_tpu.serving import elastic
+
+        if elastic.drain_requested():
+            # cooperative drain: commit NOW, inside the grace window —
+            # resume must lose zero completed steps
+            if self.rank == 0:
+                self.ckpt.flush()
+                self.ckpt.save({"w": self.params}, self.step_no)
+            return self._report(drained=True)
+        if sleep_s:
+            time.sleep(sleep_s)
+        self.params = self.params + 1.0
+        self.step_no += 1
+        if self.rank == 0:
+            self.ckpt.maybe_save({"w": self.params}, self.step_no)
+            self.ckpt.flush()        # deterministic: commit lands per step
+        return self._report()
+
+
 def store_fetcher(store_url, key):
     """Fetch a store key from inside the rank worker (ISSUE 5 trace e2e:
     the worker-side store.fetch/store.request spans must join the HTTP

@@ -1,0 +1,526 @@
+"""Elastic SPMD (ISSUE 6): survive rank loss without losing the job.
+
+The fail-fast substrate (PRs 2-5) turns into actual fault *tolerance*:
+committed checkpoints written from inside the step loop (commit marker
+last, torn uploads never resumable), a policy engine mapping the
+watchdog's typed causes to actions, and an N-1 re-mesh resume that keeps
+the fan-out alive instead of cancelling it. Deterministic proofs ride the
+``kill-rank`` (hard loss) and ``term-rank`` (SIGTERM + grace window)
+chaos verbs — ``make test-elastic``.
+"""
+
+import asyncio
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.level("minimal"), pytest.mark.elastic]
+
+from kubetorch_tpu.chaos import (ChaosEngine, parse_spec, rank_kill_plan,
+                                 rank_term_plan)
+from kubetorch_tpu.exceptions import (WorkerDiedError,
+                                      WorkerMembershipChanged,
+                                      package_exception, rehydrate_exception)
+from kubetorch_tpu.parallel.mesh import DistributedConfig, MeshSpec
+from kubetorch_tpu.resources.pointers import Pointers
+from kubetorch_tpu.serving import elastic
+from kubetorch_tpu.serving.elastic import (ElasticCoordinator, ElasticPolicy,
+                                           FAIL, RESTART_SMALLER_BATCH,
+                                           RESUME)
+from kubetorch_tpu.train import checkpoint as ck
+from tests.assets.threaded_server import ThreadedAiohttpServer
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+def _store_app(root):
+    from kubetorch_tpu.data_store.store_server import create_store_app
+    return lambda: create_store_app(str(root))
+
+
+def _trainer_pointers():
+    return Pointers(project_root=ASSETS, module_name="payloads",
+                    file_path="payloads.py", cls_or_fn_name="ElasticTrainer")
+
+
+def _wait_until(predicate, timeout=60.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Policy engine
+# ---------------------------------------------------------------------------
+
+
+def test_policy_cause_to_action_mapping():
+    p = ElasticPolicy()
+    assert p.action_for("OOMKilled") == RESTART_SMALLER_BATCH
+    for cause in ("Crashed", "Killed", "Preempted", "Evicted", "Exited",
+                  None):
+        assert p.action_for(cause) == RESUME
+
+
+def test_coordinator_shrinks_to_survivors_and_halves_batch():
+    c = ElasticCoordinator(ElasticPolicy(max_resumes=10))
+    v = c.decide("Killed", surviving=3, num_procs=4)
+    assert v["action"] == RESUME and v["num_procs"] == 3
+    # whole pool lost (e.g. 1-rank job drained): resume at full size
+    v = c.decide("Exited", surviving=0, num_procs=1)
+    assert v["action"] == RESUME and v["num_procs"] == 1
+    # OOM: same mesh, halved per-rank batch, compounding per OOM
+    v = c.decide("OOMKilled", surviving=4, num_procs=4)
+    assert v["action"] == RESTART_SMALLER_BATCH and v["num_procs"] == 4
+    assert v["env"]["KT_ELASTIC_BATCH_SCALE"] == "0.5"
+    v = c.decide("OOMKilled", surviving=4, num_procs=4)
+    assert v["env"]["KT_ELASTIC_BATCH_SCALE"] == "0.25"
+    assert c.resumes == 4
+
+
+def test_coordinator_budget_exhaustion_and_batch_floor():
+    c = ElasticCoordinator(ElasticPolicy(max_resumes=1))
+    assert c.decide("Killed", 1, 2)["action"] == RESUME
+    v = c.decide("Killed", 1, 2)
+    assert v["action"] == FAIL and "budget" in v["reason"]
+    # the batch-scale floor is a hard-fail verdict too (an OOM loop that
+    # halves forever is not converging)
+    c2 = ElasticCoordinator(ElasticPolicy(max_resumes=10,
+                                          oom_batch_scale=0.5,
+                                          min_batch_scale=0.5))
+    assert c2.decide("OOMKilled", 2, 2)["action"] == RESTART_SMALLER_BATCH
+    v = c2.decide("OOMKilled", 2, 2)
+    assert v["action"] == FAIL and "floor" in v["reason"]
+
+
+def test_policy_from_distributed_config_roundtrip():
+    d = DistributedConfig(distribution_type="spmd", workers=2,
+                          elastic={"max_resumes": 5, "min_ranks": 2})
+    d2 = DistributedConfig.from_dict(json.loads(json.dumps(d.to_dict())))
+    assert d2.elastic == {"max_resumes": 5, "min_ranks": 2}
+    p = ElasticPolicy.from_dict(d2.elastic)
+    assert p.max_resumes == 5 and p.min_ranks == 2
+    # {} opts in with defaults; unknown keys are ignored, not fatal
+    assert ElasticPolicy.from_dict({"bogus": 1}).min_ranks == 1
+
+
+# ---------------------------------------------------------------------------
+# Re-mesh: MeshSpec.shrink_to
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_shrink_preserves_model_axes():
+    spec = MeshSpec(data=2, fsdp=2, tensor=2)
+    small = spec.shrink_to(4)
+    assert small.tensor == 2                    # model axis untouched
+    assert small.data * small.fsdp == 2         # data-like axes absorb
+    # odd survivor count: data parallelism degrades to 3-way
+    small = MeshSpec(data=4).shrink_to(3)
+    assert small.data == 3
+    # fsdp-heavy mesh collapses onto fsdp when data can't absorb
+    small = MeshSpec(fsdp=8).shrink_to(6)
+    assert small.fsdp == 6
+    with pytest.raises(ValueError):
+        MeshSpec(tensor=4).shrink_to(3)         # can't hold the model axes
+
+
+def test_supervisor_remesh_env_shrinks_kt_mesh():
+    from kubetorch_tpu.serving.execution_supervisor import ExecutionSupervisor
+    cfg = DistributedConfig(distribution_type="spmd", workers=2,
+                            procs_per_worker=2,
+                            mesh={"data": 4, "tensor": 2}, elastic={})
+    sup = ExecutionSupervisor(None, None, cfg)
+    env = sup._remesh_env(3)                    # 4 local ranks → 3
+    shrunk = json.loads(env["KT_MESH"])
+    assert shrunk["tensor"] == 2 and shrunk["data"] == 3
+
+
+# ---------------------------------------------------------------------------
+# term-rank chaos verb + drain plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_term_rank_parse_and_plan():
+    faults = parse_spec("term-rank:3.5@2,term-rank")
+    assert [(f.kind, f.grace_s, f.op_index) for f in faults] == [
+        ("term-rank", 3.5, 2), ("term-rank", 5.0, 0)]
+    assert rank_term_plan("term-rank:1@4,kill-rank:9@0,503") == {4: 1.0}
+    assert rank_term_plan("reset,503") == {}
+    assert rank_kill_plan("term-rank:1@4") == {}
+    # malformed grace must not crash the worker at spawn
+    assert rank_term_plan("term-rank:NOPE@1") == {}
+
+
+def test_term_rank_invisible_to_http_engine():
+    engine = ChaosEngine(parse_spec("term-rank:2@0,kill-rank:9@1,503"))
+    assert len(engine.schedule) == 1 and engine.schedule[0].kind == "status"
+
+
+def test_rank_scoping_via_kt_chaos_rank(monkeypatch):
+    monkeypatch.setenv("KT_CHAOS", "kill-rank:9@1,term-rank:2@3")
+    monkeypatch.setenv("KT_CHAOS_RANK", "1")
+    monkeypatch.setenv("RANK", "0")
+    assert rank_kill_plan() == {} and rank_term_plan() == {}
+    monkeypatch.setenv("RANK", "1")
+    assert rank_kill_plan() == {1: 9}
+    assert rank_term_plan() == {3: 2.0}
+
+
+def test_drain_flag_helpers():
+    elastic.clear_drain()
+    assert not elastic.drain_requested()
+    elastic.request_drain("SIGTERM")
+    assert elastic.drain_requested()
+    assert elastic.drain_reason() == "SIGTERM"
+    elastic.request_drain("other")              # idempotent: first wins
+    assert elastic.drain_reason() == "SIGTERM"
+    elastic.clear_drain()
+    assert not elastic.drain_requested()
+
+
+def test_batch_scale_env(monkeypatch):
+    assert elastic.batch_scale() == 1.0
+    monkeypatch.setenv("KT_ELASTIC_BATCH_SCALE", "0.25")
+    assert elastic.batch_scale() == 0.25
+    monkeypatch.setenv("KT_ELASTIC_BATCH_SCALE", "junk")
+    assert elastic.batch_scale() == 1.0
+
+
+def test_membership_event_resumable_rehydrates():
+    out = rehydrate_exception(package_exception(WorkerMembershipChanged(
+        "shrunk", removed=["10.0.0.2"], resumable=True)))
+    assert isinstance(out, WorkerMembershipChanged)
+    assert out.resumable and out.is_critical    # critical but recoverable
+
+
+# ---------------------------------------------------------------------------
+# Split budgets: elastic resumes never burn the hard-restart budget
+# ---------------------------------------------------------------------------
+
+
+class _FakeWorker:
+    def __init__(self, alive=True, exitcode=None):
+        self.alive = alive
+        self.exitcode = exitcode
+        self.in_warmup = False
+
+
+class _FakePool:
+    """Just enough pool for Watchdog: workers, futures hooks, restart_all."""
+
+    framework_name = "spmd"
+
+    def __init__(self, n=2):
+        import threading
+        self.num_procs = n
+        self.workers = [_FakeWorker() for _ in range(n)]
+        self._stopping = threading.Event()
+        self.restarts = []
+
+    def fail_worker_futures(self, idx, exc):
+        pass
+
+    def cancel_pending(self, exc):
+        pass
+
+    def restart_worker(self, idx):
+        self.restarts.append(("single", idx))
+        self.workers[idx] = _FakeWorker()
+
+    def restart_all(self, exc=None, num_procs=None, extra_env=None):
+        if num_procs is not None:
+            self.num_procs = num_procs
+        self.restarts.append(("all", self.num_procs, extra_env))
+        self.workers = [_FakeWorker() for _ in range(self.num_procs)]
+
+
+def test_watchdog_elastic_resume_uses_split_budget():
+    from kubetorch_tpu.serving.watchdog import Watchdog
+    pool = _FakePool(2)
+    wd = Watchdog(pool, interval_s=0.05, budget=1, window_s=300)
+    wd.backoff = wd.backoff.__class__(max_attempts=1, base_delay=0,
+                                      max_delay=0, jitter=False)
+    wd._delays = [0.0]
+    coord = ElasticCoordinator(ElasticPolicy(max_resumes=2))
+    wd.attach_elastic(coord)
+
+    pool.workers[1] = _FakeWorker(alive=False, exitcode=-9)
+    wd.check_now()
+    # elastic path: pool shrank to the survivor, elastic budget consumed,
+    # the HARD budget untouched — a healthy elastic job can't exhaust it
+    assert pool.num_procs == 1
+    assert coord.budget.used == 1 and coord.resumes == 1
+    assert wd.budget.used == 0 and not wd.failed
+    assert wd.state_dict()["elastic"]["resumes"] == 1
+
+    # second loss: elastic budget spent on the next one → permanent typed
+    pool.workers[0] = _FakeWorker(alive=False, exitcode=-9)
+    wd.check_now()
+    assert coord.budget.used == 2
+    pool.workers[0] = _FakeWorker(alive=False, exitcode=-9)
+    wd.check_now()
+    assert wd.failed
+    assert "elastic" in wd.permanent_error().args[0]
+    # the hard budget is STILL untouched (vice versa half of the split)
+    assert wd.budget.used == 0
+
+
+def test_watchdog_hard_path_untouched_without_elastic():
+    from kubetorch_tpu.serving.watchdog import Watchdog
+    pool = _FakePool(2)
+    wd = Watchdog(pool, interval_s=0.05, budget=2, window_s=300)
+    wd._delays = [0.0, 0.0]
+    pool.workers[1] = _FakeWorker(alive=False, exitcode=-9)
+    wd.check_now()
+    assert wd.budget.used == 1 and not wd.failed
+    assert pool.num_procs == 2                  # no shrink without a policy
+    assert ("single", 1) in pool.restarts       # spmd = per-call identity
+
+
+# ---------------------------------------------------------------------------
+# Commit-marker protocol (satellite: torn async upload mid-membership-change)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpointer_commit_restore_and_delta(tmp_path):
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        c = ck.Checkpointer("job/a", store_url=srv.url)
+        assert c.committed() is None and c.restore() is None
+        tree = {"w": np.arange(8.0), "frozen": np.ones(4)}
+        c.save(tree, 1)
+        tree["w"] = tree["w"] + 1
+        c.save(tree, 2)
+        restored, step = c.restore()
+        assert step == 2 and (restored["w"] == np.arange(8.0) + 1).all()
+        # ping-pong slot 0 again: the unchanged leaf moves zero bytes
+        stats = c.save(tree, 3)
+        assert stats["skipped"] >= 1
+        # a fresh process (respawned rank) sees the same committed state
+        c2 = ck.Checkpointer("job/a", store_url=srv.url)
+        assert c2.last_committed_step == 3
+
+
+def test_torn_async_upload_never_commits_and_falls_back(tmp_path,
+                                                        monkeypatch):
+    """THE satellite scenario: a membership change (rank death) lands while
+    an async checkpoint upload is in flight — the upload dies mid-leaf.
+    The torn slot must never be marked committed, and resume must fall
+    back to the previous committed checkpoint (PR 4's torn-write
+    discipline, one level up)."""
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        c = ck.Checkpointer("job/torn", store_url=srv.url)
+        good = {"w": np.arange(16.0)}
+        c.save(good, 5)                          # the checkpoint to fall back to
+
+        from kubetorch_tpu.data_store import commands as ds
+        orig = ds._kv_put
+        state = {"puts": 0}
+
+        def dying_mid_upload(url, key, data, meta, sess=None):
+            state["puts"] += 1
+            if state["puts"] >= 2:
+                # the rank hosting the upload just died mid-transfer
+                raise ck.DataStoreError("membership change: rank died")
+            return orig(url, key, data, meta, sess)
+
+        monkeypatch.setattr(ds, "_kv_put", dying_mid_upload)
+        fut = c.maybe_save({"w": np.zeros(16)}, 6)   # async, in flight
+        assert fut is not None
+        with pytest.raises(ck.DataStoreError):
+            c.flush()                            # drain surfaces the death
+        monkeypatch.setattr(ds, "_kv_put", orig)
+
+        # torn upload is invisible: marker still points at step 5, and the
+        # restored bytes are the intact slot's
+        assert ck.commit_info("job/torn", store_url=srv.url)["step"] == 5
+        restored, step = ck.Checkpointer("job/torn",
+                                         store_url=srv.url).restore()
+        assert step == 5 and (restored["w"] == good["w"]).all()
+        assert ck.tree_fingerprint(restored) == ck.tree_fingerprint(good)
+        # and the next clean save commits over the torn slot
+        c.save({"w": np.zeros(16)}, 7)
+        assert c.committed()["step"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Deterministic chaos e2e (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_env(monkeypatch, chaos, rank=None):
+    monkeypatch.setenv("KT_CHAOS", chaos)
+    if rank is not None:
+        monkeypatch.setenv("KT_CHAOS_RANK", str(rank))
+    else:
+        monkeypatch.delenv("KT_CHAOS_RANK", raising=False)
+    monkeypatch.setenv("KT_WATCHDOG_INTERVAL_S", "0.25")
+    monkeypatch.setenv("KT_RESTART_BUDGET", "3")
+    monkeypatch.setenv("KT_RESTART_WINDOW_S", "300")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_MAX_S", "0.01")
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_rank_resumes_on_n_minus_1_from_committed_checkpoint(
+        tmp_path, monkeypatch):
+    """THE acceptance scenario: kill-rank mid-step on a 2-rank SPMD job →
+    the job resumes on 1 rank from the last committed checkpoint within
+    the (elastic) restart budget, the fan-out call is NOT cancelled — it
+    returns the degraded world's results — and the resumed params
+    hash-match a clean reload of the committed checkpoint."""
+    from kubetorch_tpu.serving.spmd_supervisor import SPMDSupervisor
+
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        key = "elastic/kill"
+        _elastic_env(monkeypatch, "kill-rank:9@2", rank=1)
+        monkeypatch.setenv("LOCAL_IPS", "127.0.0.1")
+        monkeypatch.setenv("POD_IP", "127.0.0.1")
+        cfg = DistributedConfig(
+            distribution_type="spmd", workers=1, procs_per_worker=2,
+            elastic={"max_resumes": 2})
+        sup = SPMDSupervisor(
+            _trainer_pointers(), {"args": [srv.url, key]}, cfg,
+            service_name="t-elastic", namespace="default")
+        sup.setup()
+        try:
+            async def go():
+                r1 = await sup.call("step", [], {}, timeout=120)
+                assert len(r1) == 2 and {x["rank"] for x in r1} == {0, 1}
+                r2 = await sup.call("step", [], {}, timeout=120)
+                assert len(r2) == 2
+                # third call: rank 1 SIGKILLs itself mid-step. The elastic
+                # loop re-meshes to the survivor and RETRIES — the caller
+                # sees results, not a cancelled fan-out.
+                r3 = await sup.call("step", [], {}, timeout=None)
+                return r3
+
+            r3 = asyncio.run(go())
+            assert len(r3) == 1, "fan-out should have shrunk to 1 rank"
+            out = r3[0]
+            assert out["world"] == "1"
+            assert out["resumed_from"] is not None, \
+                "survivor should have resumed from a committed checkpoint"
+            assert out["step"] == out["resumed_from"] + 1
+
+            # accounting: exactly one elastic resume, zero hard restarts —
+            # the split-budget bugfix, observable
+            assert sup.elastic.resumes == 1
+            assert sup.pool.num_procs == 1
+            assert sup.pool.watchdog.budget.used == 0
+            assert sup.pool.watchdog.state_dict()["elastic"]["resumes"] == 1
+
+            # hash-match: the live resumed params equal a clean reload of
+            # the committed checkpoint (committed by the resumed step)
+            reloaded, step = ck.Checkpointer(key,
+                                             store_url=srv.url).restore()
+            assert step == out["step"]
+            assert ck.tree_fingerprint(reloaded) == out["fingerprint"]
+        finally:
+            sup.cleanup()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_term_rank_drains_commits_and_loses_zero_steps(tmp_path,
+                                                       monkeypatch):
+    """Graceful preemption: term-rank delivers SIGTERM at op 2 (+ SIGKILL
+    after a 10s grace window). The in-flight step observes the drain flag,
+    commits a fresh checkpoint INSIDE the window, the rank exits cleanly,
+    and the elastic resume restores it — zero completed steps lost."""
+    from kubetorch_tpu.serving.execution_supervisor import ExecutionSupervisor
+
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        key = "elastic/term"
+        _elastic_env(monkeypatch, "term-rank:10@2")
+        # every=999: periodic commits OFF, so the only commit that can
+        # exist is the drain-path one — proving the grace window worked
+        cfg = DistributedConfig(distribution_type="spmd", workers=1,
+                                procs_per_worker=1,
+                                elastic={"max_resumes": 2})
+        sup = ExecutionSupervisor(
+            _trainer_pointers(), {"args": [srv.url, key],
+                                  "kwargs": {"every": 999}}, cfg)
+        sup.setup()
+        try:
+            async def go():
+                s1 = await sup.call("step", [], {}, timeout=120)
+                s2 = await sup.call("step", [], {}, timeout=120)
+                assert s1["step"] == 1 and s2["step"] == 2
+                assert ck.commit_info(key, store_url=srv.url) is None, \
+                    "no commit should exist before the drain"
+                # op 2: SIGTERM lands as the op is dequeued → the step sees
+                # the drain flag and flushes the commit instead of stepping
+                s3 = await sup.call("step", [], {}, timeout=None)
+                return s3
+
+            s3 = asyncio.run(go())
+            assert s3.get("drained") is True and s3["step"] == 2
+            # a fresh checkpoint was committed before exit...
+            info = ck.commit_info(key, store_url=srv.url)
+            assert info is not None and info["step"] == 2
+
+            # ...the drained rank exits cleanly (next idle poll) and the
+            # watchdog resumes it elastically. Wait out the drain window —
+            # in production /ready is 503 for exactly this interval — then
+            # prove NO completed step was lost.
+            assert _wait_until(lambda: sup.elastic.resumes >= 1
+                               and sup.pool.healthy
+                               and not sup.pool.recovering), \
+                "drained rank was never elastically resumed"
+
+            async def after():
+                return await sup.call("step", [], {}, timeout=None)
+
+            s4 = asyncio.run(after())
+            assert s4["resumed_from"] == 2 and s4["step"] == 3
+            assert sup.elastic.resumes >= 1
+            assert sup.pool.watchdog.budget.used == 0
+        finally:
+            sup.cleanup()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_oom_kill_restarts_with_halved_batch_scale(tmp_path, monkeypatch):
+    """OOMKilled (SIGKILL + cgroup oom_kill evidence) must not shrink the
+    mesh — the job was too big for the host, not broken. The elastic
+    policy restarts at full size with the per-rank batch scale halved,
+    and the fresh rank reads it via kt.batch_scale()."""
+    from kubetorch_tpu.serving.execution_supervisor import ExecutionSupervisor
+
+    events = tmp_path / "memory.events"
+    events.write_text("oom 0\noom_kill 0\n")
+    monkeypatch.setenv("KT_OOM_EVENTS_PATH", str(events))
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        key = "elastic/oom"
+        _elastic_env(monkeypatch, "kill-rank:9@1")
+        cfg = DistributedConfig(distribution_type="spmd", workers=1,
+                                procs_per_worker=1,
+                                elastic={"max_resumes": 2})
+        sup = ExecutionSupervisor(
+            _trainer_pointers(), {"args": [srv.url, key]}, cfg)
+        sup.setup()
+        try:
+            async def go():
+                s1 = await sup.call("step", [], {}, timeout=120)
+                assert s1["batch_scale"] == 1.0
+                # the kernel's OOM killer "fires" before the chaos SIGKILL
+                events.write_text("oom 1\noom_kill 1\n")
+                return await sup.call("step", [], {}, timeout=None)
+
+            s2 = asyncio.run(go())
+            assert s2["batch_scale"] == 0.5, \
+                "OOM resume should halve the per-rank batch"
+            assert sup.pool.num_procs == 1          # mesh size unchanged
+            assert sup.elastic.batch_scale == 0.5
+            assert sup.elastic.resumes == 1
+        finally:
+            sup.cleanup()

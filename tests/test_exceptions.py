@@ -19,6 +19,7 @@ _ATTR_SAMPLES = {
     "available_bytes": 1 << 30,
     "added": ["10.0.0.9"],
     "removed": ["10.0.0.3"],
+    "resumable": True,
     "previous": ["10.0.0.3"],
     "current": ["10.0.0.9"],
     "worker": "10.0.0.7",

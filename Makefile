@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-elastic lint bench bench-store bench-trace bench-ckpt smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic lint bench bench-store bench-trace bench-ckpt bench-fleet smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -27,6 +27,12 @@ test-chaos:
 # corrupt-blob → scrub quarantine, disk-full → typed 507, startup recovery
 test-store-chaos:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_store_chaos.py -q
+
+# replicated-ring suite (ISSUE 7): placement stability, replica
+# forwarding at W=2, proxy reads, epoch mismatch, TTL re-replication,
+# and the SIGKILL-mid-push/pull chaos acceptance (subprocess fleets)
+test-ring:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_store_ring.py -q
 
 # elastic SPMD suite (ISSUE 6): kill-rank → N-1 re-mesh resume from the
 # last committed checkpoint; term-rank → drain-and-checkpoint in the grace
@@ -49,6 +55,11 @@ bench-store:
 # — enforced <3% enabled, ~0% disabled (the allocation-free fast path)
 bench-trace:
 	$(PY_CPU) python scripts/bench_datastore.py --trace-overhead
+
+# store-fleet regime (ISSUE 7): cold + delta sync MB/s vs ring size
+# (1/2/3 nodes, R=2 W=2) — weight distribution as the fleet grows
+bench-fleet:
+	$(PY_CPU) python scripts/bench_datastore.py --fleet 3
 
 # checkpoint regime (ISSUE 6): per-step committed-checkpoint cost vs the
 # fraction of leaves that changed — the "~free suspend/resume" claim,

@@ -19,6 +19,7 @@ once, and the backoff sequence matches the policy".
              | torn-write[:BYTES]               (store-state; see below)
              | kill-rank:SIG@OP_INDEX           (process-level; see below)
              | term-rank:GRACE_S@OP_INDEX       (process-level; see below)
+             | kill-store-node[:SIG]@OP_INDEX   (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
   matching request consumes the first unconsumed token whose path filter
@@ -81,6 +82,16 @@ Fault kinds:
   to the rank whose ``RANK`` env matches — so an N-rank job can lose
   exactly one rank (the elastic N-1 re-mesh scenario) instead of all N
   self-killing at the same op index.
+- ``kill-store-node[:SIG]@N``  **process-level, store-server** fault: the
+  store process kills itself with SIG (default 9) the moment its N-th
+  (0-based) client-origin data-plane request arrives — before the handler
+  runs. The deterministic "store node died mid-push / mid-pull" scenario
+  the replicated ring (``data_store/ring.py``) must absorb with zero
+  client-visible failures. Only sane against a *subprocess* store (e.g.
+  the ``tests/assets/store_fleet.py`` harness): in-process it kills the
+  test runner. Internal store↔store traffic (``X-KT-Replicated``) and the
+  exempt probe/ring routes never advance the op counter, so the kill
+  lands on exactly the client request the test scheduled it for.
 
 Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
 connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
@@ -113,15 +124,20 @@ CHAOS_SEED_ENV = "KT_CHAOS_SEED"
 CHAOS_RANK_ENV = "KT_CHAOS_RANK"
 
 # With no @path filter, never chaos the liveness plumbing: readiness polls
-# retry forever and would silently eat the whole schedule.
-EXEMPT_PATHS = ("/health", "/ready", "/metrics")
+# retry forever and would silently eat the whole schedule. /ring is the
+# store fleet's membership surface — chaosing it would fault the very
+# refresh that absorbs faults.
+EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
           "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
-          "term-rank")
+          "term-rank", "kill-store-node")
 
 # verbs consumed by the rank worker loop, not the HTTP middleware
 _RANK_KINDS = ("kill-rank", "term-rank")
+
+# verbs whose @-suffix is a 0-based op index rather than a path prefix
+_OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node",)
 
 
 @dataclass
@@ -180,8 +196,8 @@ def parse_spec(spec: str) -> List[Fault]:
         if "@" in token:
             token, _, path = token.partition("@")
         fault = _parse_one(token.strip(), raw)
-        if fault.kind in _RANK_KINDS:
-            # for the rank verbs the @-suffix is the call-op index, not a path
+        if fault.kind in _OP_INDEX_KINDS:
+            # for these verbs the @-suffix is the call-op index, not a path
             try:
                 fault.op_index = int(path) if path else 0
             except ValueError:
@@ -209,6 +225,9 @@ def _parse_one(token: str, raw: str) -> Fault:
     head, _, arg = token.partition(":")
     if head == "kill-rank":
         return Fault(kind="kill-rank",
+                     signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-store-node":
+        return Fault(kind="kill-store-node",
                      signal_no=_parse_signal(arg or "9", raw))
     if head == "term-rank":
         fault = Fault(kind="term-rank")
@@ -258,12 +277,18 @@ class ChaosEngine:
         # worker loop via rank_kill_plan()/rank_term_plan(), invisible to
         # the HTTP middleware
         faults = [f for f in faults if f.kind not in _RANK_KINDS]
+        # kill-store-node fires by op INDEX, not schedule order: armed
+        # separately and checked against the data-op counter every request
+        self.node_faults = [f for f in faults
+                            if f.kind == "kill-store-node"]
+        faults = [f for f in faults if f.kind != "kill-store-node"]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.injected = 0            # faults actually fired (pass excluded)
         self.requests_seen = 0
+        self.data_ops = 0            # client-origin non-exempt requests
 
     @classmethod
     def from_env(cls) -> Optional["ChaosEngine"]:
@@ -277,10 +302,25 @@ class ChaosEngine:
             pass
         return cls(parse_spec(spec), seed=seed)
 
-    def next_fault(self, path: str,
-                   method: Optional[str] = None) -> Optional[Fault]:
+    def next_fault(self, path: str, method: Optional[str] = None,
+                   internal: bool = False) -> Optional[Fault]:
+        # internal store↔store traffic (replication forwards, ring-wide
+        # probes) is chaos-exempt: the whole point of a deterministic
+        # schedule is that the N-th CLIENT request sees the N-th fault,
+        # and replication fan-out would otherwise consume tokens at an
+        # unpredictable rate
+        if internal:
+            return None
         with self._lock:
             self.requests_seen += 1
+            if not path.startswith(EXEMPT_PATHS):
+                for i, fault in enumerate(self.node_faults):
+                    if fault.op_index == self.data_ops:
+                        del self.node_faults[i]
+                        self.data_ops += 1
+                        self.injected += 1
+                        return fault
+                self.data_ops += 1
             for i, fault in enumerate(self.schedule):
                 if fault.matches(path, method):
                     del self.schedule[i]
@@ -383,13 +423,20 @@ def chaos_middleware(engine: ChaosEngine):
 
     @web.middleware
     async def middleware(request: web.Request, handler):
-        fault = engine.next_fault(request.path, request.method)
+        fault = engine.next_fault(
+            request.path, request.method,
+            internal=request.headers.get("X-KT-Replicated") is not None)
         if fault is None:
             return await handler(request)
         _CHAOS_FAULTS.inc(kind=fault.kind)
         telemetry.add_event(
             "chaos.fault", kind=fault.kind, path=request.path,
             **({"status": fault.status} if fault.kind == "status" else {}))
+        if fault.kind == "kill-store-node":
+            # the node dies mid-request, exactly like a SIGKILLed pod: no
+            # response ever leaves this process (the client sees a reset
+            # and fails over to a ring sibling)
+            _os.kill(_os.getpid(), fault.signal_no)
         if fault.kind == "delay":
             await asyncio.sleep(fault.seconds)
             return await handler(request)

@@ -656,9 +656,87 @@ def store():
 @store.command("start")
 @click.option("--port", type=int, default=8873)
 @click.option("--root", default="./kt-store")
-def store_start(port, root):
+@click.option("--nodes", default=None,
+              help="Comma-separated ring member URLs (incl. this node); "
+                   "default KT_STORE_NODES.")
+@click.option("--self-url", default=None,
+              help="This node's URL within --nodes; default "
+                   "KT_STORE_SELF_URL.")
+def store_start(port, root, nodes, self_url):
     from .data_store.store_server import main as store_main
-    store_main(["--port", str(port), "--root", root])
+    args = ["--port", str(port), "--root", root]
+    if nodes:
+        args += ["--nodes", nodes]
+    if self_url:
+        args += ["--self-url", self_url]
+    store_main(args)
+
+
+@store.command("status")
+@click.option("--url", default=None,
+              help="Any ring member (default: the configured store / "
+                   "KT_STORE_NODES).")
+@click.option("--json", "as_json", is_flag=True, help="Raw JSON per node.")
+def store_status(url, as_json):
+    """Ring health: membership + epoch, per-node capacity, scrub and
+    replication state — rendered from each member's ``/ring`` and
+    ``/scrub/status``."""
+    import requests as _requests
+
+    from .data_store import ring as ring_mod
+
+    seed = url or ring_mod.resolve_origin(None)
+    rg = ring_mod.ring_for(seed)
+    if rg.size > 1:
+        rg.refresh()
+    nodes = rg.nodes
+    rows, raw = [], {}
+    for base in nodes:
+        info: dict = {"url": base, "alive": False}
+        try:
+            # one-shot probes by design: a status command that retried
+            # would hide exactly the flakiness it exists to show
+            r = _requests.get(f"{base}/ring", timeout=5)
+            r.raise_for_status()
+            view = r.json()
+            s = _requests.get(f"{base}/scrub/status", timeout=5).json()
+            cap = view.get("capacity") or {}
+            info.update({
+                "alive": True,
+                "epoch": view.get("epoch"),
+                "members": len(view.get("nodes") or []),
+                "used_gb": round((cap.get("used_bytes") or 0) / 1e9, 2),
+                "free_gb": round((cap.get("free_bytes") or 0) / 1e9, 2),
+                "under_replicated": s.get("under_replicated"),
+                "re_replicated": s.get("re_replicated"),
+                "quarantine": s.get("quarantine_files"),
+                "down": sorted((view.get("down") or {})),
+            })
+            raw[base] = {"ring": view, "scrub": s}
+        except _requests.RequestException as e:
+            info["error"] = str(e)[:120]
+            raw[base] = {"error": str(e)}
+        rows.append(info)
+    if as_json:
+        click.echo(json.dumps(raw, indent=2, default=str))
+        return
+    head = (f"ring: {len(nodes)} node(s)"
+            f"{'' if rg.epoch is None else f', epoch {rg.epoch}'}"
+            f" · R={ring_mod.replication_factor()}"
+            f" W={ring_mod.write_quorum()}"
+            f" · node TTL {ring_mod.node_ttl_s():g}s")
+    click.echo(head)
+    for row in rows:
+        if not row["alive"]:
+            click.echo(f"  {row['url']:<28} DEAD  ({row.get('error', '?')})")
+            continue
+        down = f"  down={','.join(row['down'])}" if row["down"] else ""
+        click.echo(
+            f"  {row['url']:<28} ok    epoch={row['epoch']}"
+            f" used={row['used_gb']}G free={row['free_gb']}G"
+            f" under-repl={row['under_replicated']}"
+            f" re-repl={row['re_replicated']}"
+            f" quarantine={row['quarantine']}{down}")
 
 
 @cli.group()

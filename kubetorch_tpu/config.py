@@ -76,6 +76,16 @@ class KTConfig:
     scrub_rate_mbps: float = 64.0
     peer_ttl_s: float = 3600.0
     gc_grace_s: float = 3600.0
+    # replicated store ring (data_store/ring.py). Same env layering
+    # (KT_STORE_REPLICATION / KT_STORE_WRITE_QUORUM / KT_STORE_NODE_TTL_S;
+    # fleet membership itself rides KT_STORE_NODES + KT_STORE_SELF_URL).
+    # replication=1 turns the ring into plain sharding (no copies);
+    # write_quorum is capped at min(replication, live nodes) so a degraded
+    # ring keeps accepting writes. node_ttl_s is how long a node may stay
+    # unreachable before the scrubber re-replicates its keys elsewhere.
+    store_replication: int = 2
+    store_write_quorum: int = 2
+    store_node_ttl_s: float = 30.0
     # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
     # recording everywhere (the fast path stays allocation-free, see `make
     # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing

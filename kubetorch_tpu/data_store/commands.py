@@ -34,9 +34,13 @@ from typing import Any, Dict, List, Optional
 import requests as _requests
 
 from .. import telemetry
-from ..config import config
 from ..exceptions import DataCorruptionError, DataStoreError
-from . import netpool
+from . import netpool, ring
+# origin/fleet resolution lives in ring.py (the check_resilience lint
+# keeps any other data_store/ module from rebuilding a single-origin URL);
+# these aliases preserve the historical commands.* surface tests poke at
+from .ring import _REACHABLE_CACHE  # noqa: F401  (test introspection)
+from .ring import resolve_origin as _store_url
 from .types import BroadcastWindow
 
 # per-blob fetch accounting by source (pod cache / peer / origin store):
@@ -48,68 +52,6 @@ _FETCHES = telemetry.counter(
     labels=("source",))
 
 _INDEX_SUFFIX = ".__kt_index__"
-
-
-# per-process reachability verdicts: direct URL → (resolved URL, expiry).
-# A direct verdict is cached for the process lifetime; a TUNNEL verdict
-# expires so a store that was merely booting (deploy race) gets its direct
-# path back instead of bottlenecking the controller forever.
-_REACHABLE_CACHE: dict = {}
-_TUNNEL_VERDICT_TTL_S = 60.0
-
-
-def _tunnel_fallback(url: str) -> str:
-    """From OUTSIDE the cluster the store's service DNS doesn't resolve;
-    route through the controller's ``/controller/store`` relay instead
-    (reference ``websocket_tunnel.py`` role). In-cluster pods and local-mode
-    clients pass the direct probe and never pay the hop."""
-    import time as _time
-
-    cached = _REACHABLE_CACHE.get(url)
-    if cached and (cached[1] is None or _time.monotonic() < cached[1]):
-        return cached[0]
-    import requests as _requests
-    resolved, expires = url, None
-    try:
-        _requests.get(f"{url}/health", timeout=2).raise_for_status()
-    except _requests.RequestException:
-        api = config().api_url
-        if api:
-            tunnel = f"{api.rstrip('/')}/controller/store"
-            try:
-                r = _requests.get(f"{tunnel}/health", timeout=5)
-                if r.status_code == 200:
-                    resolved = tunnel
-                    expires = _time.monotonic() + _TUNNEL_VERDICT_TTL_S
-            except _requests.RequestException:
-                pass   # keep direct; its error is the truthful one
-    _REACHABLE_CACHE[url] = (resolved, expires)
-    return resolved
-
-
-def _store_url(explicit: Optional[str] = None) -> str:
-    if explicit:
-        # the caller NAMED a store — never silently reroute their data to a
-        # different one just because a health probe blipped
-        return explicit.rstrip("/")
-    url = config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
-    if not url and config().api_url:
-        # discover through an ALREADY-CONFIGURED controller's cluster config
-        # (the local controller runs its own store; k8s clusters publish
-        # theirs). Never auto-spawn a controller here — a misconfigured pod
-        # must get the clear error below, not a fresh empty store.
-        try:
-            from ..client import controller_client
-            url = controller_client().cluster_config().get("data_store_url")
-            if url:
-                config().data_store_url = url
-        except Exception:
-            url = None
-    if not url:
-        raise DataStoreError(
-            "No data store configured (set KT_DATA_STORE_URL or "
-            "config.data_store_url, or pass store_url=)")
-    return _tunnel_fallback(url.rstrip("/"))
 
 
 def _is_arraylike(obj: Any) -> bool:
@@ -262,16 +204,19 @@ def _kv_diff(url: str, hashes: Dict[str, str]) -> set:
     """Ask the store which of ``hashes`` it already holds current; returns
     the set of keys whose bytes can be skipped. Wire shape mirrors
     ``/tree/diff``: ``{keys: {key: blake2b}} → {missing: [key, ...]}``.
-    A store without the endpoint (pre-delta build) skips nothing."""
+    A store without the endpoint (pre-delta build) skips nothing. On a
+    fleet any live node answers (the server fans the probe ring-wide)."""
     if not hashes:
         return set()
     try:
-        r = netpool.request("POST", f"{url}/kv/diff", json={"keys": hashes},
-                            timeout=netpool.store_timeout(60))
+        r = ring.ring_for(url).request("POST", "/kv/diff",
+                                       json={"keys": hashes},
+                                       timeout=netpool.store_timeout(60))
         if r.status_code != 200:
             return set()
         return set(hashes) - set(r.json()["missing"])
-    except (_requests.RequestException, ValueError, KeyError):
+    except (_requests.RequestException, ValueError, KeyError,
+            DataStoreError):
         return set()
 
 
@@ -306,14 +251,19 @@ def _kv_put(url: str, key: str, data, meta: Dict,
     # Content-Length via super_len). Both are re-sendable buffers, so the
     # resilient wrapper can retry a transient failure safely — the PUT is
     # content-addressed (X-KT-Meta carries the blake2b) and idempotent.
+    # Ring routing hashes the RAW key: the PUT lands on the key's primary
+    # replica (which forwards to the rest at write-quorum) and fails over
+    # along the replica set when that node is down — a mid-push node loss
+    # is absorbed here, not surfaced.
     if sess is not None:
         r = sess.put(f"{url}/kv/{netpool.urlkey(key)}", data=data,
                      headers={"X-KT-Meta": json.dumps(meta)},
                      timeout=netpool.store_timeout())
     else:
-        r = netpool.request("PUT", f"{url}/kv/{netpool.urlkey(key)}", data=data,
-                            headers={"X-KT-Meta": json.dumps(meta)},
-                            timeout=netpool.store_timeout())
+        r = ring.ring_for(url).request(
+            "PUT", f"/kv/{netpool.urlkey(key)}", key=key, data=data,
+            headers={"X-KT-Meta": json.dumps(meta)},
+            timeout=netpool.store_timeout())
     if r.status_code != 200:
         raise DataStoreError(f"put {key!r} failed: {r.status_code} {r.text[:200]}")
     return r.json()
@@ -352,6 +302,7 @@ class _RoutedFetcher:
                  sess: Optional[_requests.Session] = None):
         self.store_url = store_url
         self.key = key
+        self.ring = ring.ring_for(store_url)
         self.sess = sess            # explicit session override (tests);
         #                             None → per-thread pooled session
         self.enabled = (bool(os.environ.get("POD_IP"))
@@ -367,13 +318,29 @@ class _RoutedFetcher:
     def _sess(self) -> _requests.Session:
         return self.sess if self.sess is not None else netpool.session()
 
-    def _store_request(self, method: str, url: str, timeout: float):
-        """Store-directed ops ride the resilient wrapper (retries, backoff,
-        Retry-After); an explicitly injected session (tests) stays
-        single-shot so stubs observe exactly one request."""
+    def _coord_url(self) -> str:
+        """The node that coordinates this key's P2P fan-out (``/route``
+        family): the key's primary replica, so every pod in the fleet asks
+        the SAME coordinator and the broadcast tree stays one tree."""
         if self.sess is not None:
-            return self.sess.request(method, url, timeout=timeout)
-        return netpool.request(method, url, timeout=timeout)
+            return self.store_url
+        nodes = self.ring.nodes_for(self.key)
+        return nodes[0] if nodes else self.store_url
+
+    def _store_request(self, method: str, path: str, subkey: str,
+                       timeout: float, verify=None):
+        """Store-directed ops ride the resilient wrapper (retries, backoff,
+        Retry-After) AND the ring router (replica failover, epoch refresh);
+        an explicitly injected session (tests) stays single-shot and
+        single-origin so stubs observe exactly one request."""
+        if self.sess is not None:
+            r = self.sess.request(method, f"{self.store_url}{path}",
+                                  timeout=timeout)
+            if verify is not None and r.status_code == 200:
+                verify(r)
+            return r
+        return self.ring.request(method, path, key=subkey, timeout=timeout,
+                                 verify=verify)
 
     def head(self, subkey: str) -> bool:
         """Cheap existence probe against the STORE only (metadata-sized, like
@@ -381,10 +348,10 @@ class _RoutedFetcher:
         bulk bytes or touching peer wait windows."""
         try:
             r = self._store_request("HEAD",
-                                    f"{self.store_url}/kv/{netpool.urlkey(subkey)}",
+                                    f"/kv/{netpool.urlkey(subkey)}", subkey,
                                     timeout=netpool.store_timeout(30))
             return r.status_code == 200
-        except _requests.RequestException:
+        except (_requests.RequestException, DataStoreError):
             return False
 
     def _self_url(self) -> Optional[str]:
@@ -416,7 +383,7 @@ class _RoutedFetcher:
             self._resolved = True
             try:
                 r = self._sess().post(
-                    f"{self.store_url}/route",
+                    f"{self._coord_url()}/route",
                     json={"key": self.key,
                           "self_url": self._self_url(),
                           "self_blob_url": self._self_blob_url()},
@@ -541,14 +508,17 @@ class _RoutedFetcher:
                 self._evict_peer(peer)
                 break
             _time.sleep(0.25)
-        r = self._store_request("GET",
-                                f"{self.store_url}/kv/{netpool.urlkey(subkey)}",
-                                timeout=timeout)
-        if r.status_code == 200:
-            # origin corruption has no fallback — surface it typed (never
-            # cache it: this pod must not become a parent serving rot)
-            _verify_content(r.content, _response_meta(r), expect_hash,
+        def _verify(resp):
+            # a corrupt replica is failed over like a dead one (the ring
+            # router tries the key's siblings); only bytes EVERY replica
+            # serves corrupt surface, typed — and are never cached (this
+            # pod must not become a parent serving rot)
+            _verify_content(resp.content, _response_meta(resp), expect_hash,
                             subkey, "store")
+
+        r = self._store_request("GET", f"/kv/{netpool.urlkey(subkey)}",
+                                subkey, timeout=timeout, verify=_verify)
+        if r.status_code == 200:
             self._cache(subkey, r)
             _FETCHES.inc(source="store")
         sp.set_attr("source", "store")
@@ -622,7 +592,7 @@ class _RoutedFetcher:
 
     def _report_failed(self, peer_url: str) -> None:
         try:
-            self._sess().post(f"{self.store_url}/route/failed",
+            self._sess().post(f"{self._coord_url()}/route/failed",
                               json={"key": self.key, "url": peer_url},
                               timeout=10)
         except _requests.RequestException:
@@ -640,7 +610,7 @@ class _RoutedFetcher:
                 return
             self._complete_sent = True
         try:
-            self._sess().post(f"{self.store_url}/route/complete",
+            self._sess().post(f"{self._coord_url()}/route/complete",
                               json={"key": self.key, "url": self_url,
                                     "blob_url": self._self_blob_url()},
                               timeout=10)
@@ -691,8 +661,9 @@ def get(key: str, dest: Optional[str] = None, store_url: Optional[str] = None,
         if r.status_code == 200:
             return _finish_raw(r, dest, sharding, fetcher)
 
-    r = netpool.request("GET", f"{url}/tree/{netpool.urlkey(key)}/manifest",
-                        timeout=netpool.store_timeout(60))
+    r = ring.ring_for(url).request(
+        "GET", f"/tree/{netpool.urlkey(key)}/manifest", key=key,
+        timeout=netpool.store_timeout(60))
     if r.status_code == 200:
         if not dest:
             raise DataStoreError(f"get: {key!r} is a directory tree; pass dest=")
@@ -800,8 +771,10 @@ def join_broadcast(key: str, window: BroadcastWindow,
     member = member or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
     # joining is idempotent (member names are unique per joiner and re-adds
     # are set-inserts), so transport errors retry; a 408 quorum timeout is a
-    # real verdict and passes straight through
-    r = netpool.request("POST", f"{url}/barrier", json={
+    # real verdict and passes straight through. The barrier group lives on
+    # ONE node — the key's ring primary — so every participant joins the
+    # same quorum whatever seed URL it was configured with.
+    r = ring.ring_for(url).request("POST", "/barrier", key=key, json={
         "group": window.group_id or f"bcast/{key}",
         "world_size": window.world_size,
         "member": member,
@@ -832,8 +805,10 @@ def get_broadcast(key: str, window: BroadcastWindow,
 
 def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
     url = _store_url(store_url)
-    r = netpool.request("GET", f"{url}/keys", params={"prefix": prefix},
-                        timeout=netpool.store_timeout(60))
+    # any live node answers for the whole ring (the server merges its
+    # siblings' namespaces before responding)
+    r = ring.ring_for(url).request("GET", "/keys", params={"prefix": prefix},
+                                   timeout=netpool.store_timeout(60))
     if r.status_code != 200:
         raise DataStoreError(f"ls failed: {r.status_code}")
     # hide internal index keys
@@ -842,25 +817,95 @@ def ls(prefix: str = "", store_url: Optional[str] = None) -> List[Dict]:
 
 def rm(key: str, store_url: Optional[str] = None) -> bool:
     url = _store_url(store_url)
+    rg = ring.ring_for(url)
     timeout = netpool.store_timeout(60)
     existed = False
-    r = netpool.request("GET", f"{url}/kv/{netpool.urlkey(key)}{_INDEX_SUFFIX}",
-                        timeout=timeout)
+    index_key = f"{key}{_INDEX_SUFFIX}"
+    r = rg.request("GET", f"/kv/{netpool.urlkey(index_key)}", key=index_key,
+                   timeout=timeout)
     if r.status_code == 200:
         index = json.loads(r.content)
         netpool.map_concurrent(
-            lambda path: netpool.request(
-                "DELETE", f"{url}/kv/{netpool.urlkey(key + '/' + path)}",
-                timeout=netpool.store_timeout(60)),
+            lambda path: rg.request(
+                "DELETE", f"/kv/{netpool.urlkey(key + '/' + path)}",
+                key=f"{key}/{path}", timeout=netpool.store_timeout(60)),
             index["leaves"])
-        netpool.request("DELETE",
-                        f"{url}/kv/{netpool.urlkey(key)}{_INDEX_SUFFIX}",
-                        timeout=timeout)
+        rg.request("DELETE", f"/kv/{netpool.urlkey(index_key)}",
+                   key=index_key, timeout=timeout)
         existed = True
-    rd = netpool.request("DELETE", f"{url}/kv/{netpool.urlkey(key)}",
-                         timeout=timeout)
+    rd = rg.request("DELETE", f"/kv/{netpool.urlkey(key)}", key=key,
+                    timeout=timeout)
     existed = existed or (rd.status_code == 200 and rd.json().get("existed"))
-    rt = netpool.request("DELETE", f"{url}/tree/{netpool.urlkey(key)}",
-                         timeout=timeout)
+    rt = rg.request("DELETE", f"/tree/{netpool.urlkey(key)}", key=key,
+                    timeout=timeout)
     existed = existed or (rt.status_code == 200 and rt.json().get("existed"))
     return existed
+
+
+# ---------------------------------------------------------------------------
+# Small mutable JSON values (checkpoint markers) — single-key, quorum-read
+# ---------------------------------------------------------------------------
+
+
+def put_json(key: str, obj: Any, store_url: Optional[str] = None) -> Dict:
+    """Store a small JSON document as ONE kv key (no index/leaf fan-out).
+
+    Built for *mutable* control values — checkpoint commit markers, slot
+    pointers — that are deliberately re-put in place: single-key writes
+    ride the ring's write-quorum forward, and :func:`get_json` can read
+    them back at quorum, so node loss never resurrects a stale marker."""
+    url = _store_url(store_url)
+    data = json.dumps(obj).encode()
+    meta = {"kind": "json",
+            "blake2b": hashlib.blake2b(data, digest_size=20).hexdigest()}
+    return _kv_put(url, key, data, meta)
+
+
+def get_json(key: str, store_url: Optional[str] = None,
+             quorum: bool = False, default: Any = None) -> Any:
+    """Fetch a :func:`put_json` value.
+
+    ``quorum=True`` reads the key from EVERY member of its replica set
+    (strictly-local reads, no proxying) and returns the newest copy by
+    the server-stamped ``stored_at`` — the read side of the write-quorum
+    contract: with W=2 and one node lost, at least one surviving replica
+    holds the latest marker, and a revived stale replica can never win.
+    Missing key → ``default``."""
+    url = _store_url(store_url)
+    rg = ring.ring_for(url)
+    path = f"/kv/{netpool.urlkey(key)}"
+    best: Optional[tuple] = None
+    if quorum and rg.size > 1:
+        for base in rg.nodes_for(key)[:ring.replication_factor()]:
+            try:
+                r = netpool.request(
+                    "GET", f"{base}{path}",
+                    headers={ring.REPLICATED_HEADER: "1"},
+                    timeout=netpool.store_timeout(30))
+            except (_requests.RequestException, DataStoreError):
+                rg.record_failure(base)
+                continue
+            if r.status_code != 200:
+                continue
+            meta = _response_meta(r)
+            try:
+                _verify_content(r.content, meta, None, key, "store")
+            except DataCorruptionError:
+                continue
+            at = float(meta.get("stored_at") or 0.0)
+            if best is None or at > best[0]:
+                best = (at, r.content)
+        if best is not None:
+            return json.loads(best[1])
+        return default
+    try:
+        r = rg.request("GET", path, key=key,
+                       timeout=netpool.store_timeout(30))
+    except DataStoreError:
+        return default
+    if r.status_code != 200:
+        return default
+    try:
+        return json.loads(r.content)
+    except ValueError:
+        return default

@@ -80,15 +80,28 @@ def store_timeout(default: float = DEFAULT_TIMEOUT_S) -> float:
 _TLS = threading.local()
 
 
+def _fleet_size() -> int:
+    """Distinct store origins this client talks to (``KT_STORE_NODES``);
+    1 for a single-origin deployment."""
+    raw = os.environ.get("KT_STORE_NODES", "")
+    return max(1, len([u for u in raw.split(",") if u.strip()]))
+
+
 def session() -> _requests.Session:
-    """This thread's pooled Session (created on first use, reused after)."""
+    """This thread's pooled Session (created on first use, reused after).
+
+    Multi-origin aware: ``pool_connections`` is the number of per-HOST
+    keep-alive pools urllib3 caches, so it must cover every ring replica
+    plus peer fetches — sized below the smaller cap, a 3-node fleet would
+    silently evict and re-open TCP connections on every replica
+    failover. ``pool_maxsize`` bounds sockets per host (the fan-out
+    width)."""
     sess = getattr(_TLS, "session", None)
     if sess is None:
         sess = _requests.Session()
-        # one host (the store) gets the whole pool; size past the fan-out so
-        # peer fetches don't evict store connections
-        pool = max(store_concurrency(), 10)
-        adapter = HTTPAdapter(pool_connections=pool, pool_maxsize=pool)
+        per_host = max(store_concurrency(), 10)
+        hosts = max(_fleet_size() + 4, 10)     # replicas + peers + slack
+        adapter = HTTPAdapter(pool_connections=hosts, pool_maxsize=per_host)
         sess.mount("http://", adapter)
         sess.mount("https://", adapter)
         _TLS.session = sess
@@ -119,6 +132,9 @@ def _executor(size: int) -> ThreadPoolExecutor:
 # per-netloc circuit breakers (opt-in: KT_STORE_BREAKER_THRESHOLD > 0). Off
 # by default because a breaker converts "slow store" into fast CircuitOpen
 # failures — right for production weight-sync loops, wrong for ad-hoc CLIs.
+# Strictly per-NETLOC state: on a multi-origin ring each replica trips (and
+# cools down) independently, and the ring router treats one replica's open
+# breaker as a failover signal, never as a verdict on its siblings.
 _BREAKERS: dict = {}
 _BREAKERS_LOCK = threading.Lock()
 

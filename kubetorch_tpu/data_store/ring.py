@@ -1,0 +1,495 @@
+"""Consistent-hash ring over the store fleet: placement, routing, failover.
+
+PR 4 made a single store node *durable* — kill -9 it at any byte and its
+disk stays trustworthy. This module is what makes the store *available*:
+N store nodes form a consistent-hash ring, every blob/kv key is replicated
+onto R nodes (default 2), writes are acknowledged at write-quorum W
+(default 2, commit + one synchronous replica; the rest repair
+asynchronously), and the client router fails over along the key's replica
+set so a single node loss mid-push is absorbed with **zero client-visible
+failures**.
+
+This module is the *single source of truth* for three things:
+
+- **Placement** — :class:`HashRing`: blake2b of the raw (unquoted) key →
+  a point on the ring; the key's replica set is the first R distinct
+  nodes walking clockwise from it. Both the client router and every store
+  node compute placement from the same function over the same membership
+  list, so they agree without coordination (a cross-node hash-stability
+  test pins this). Virtual nodes smooth the distribution.
+- **Membership** — versioned by a monotonically increasing *ring epoch*.
+  Servers serve their view at ``GET /ring`` and adopt newer views pushed
+  to ``POST /ring`` (controller-fed or test-fed). Clients stamp every
+  data-plane request with ``X-KT-Ring-Epoch``; a node whose epoch moved
+  on answers 409 + typed :class:`~kubetorch_tpu.exceptions.
+  RingEpochMismatch`, and :meth:`StoreRing.request` refreshes + re-routes
+  transparently.
+- **Origin resolution** — :func:`resolve_origin` (moved here from
+  ``commands.py``) is the only place in ``data_store/`` allowed to read
+  ``config().data_store_url`` / ``KT_DATA_STORE_URL``; the sixth
+  ``check_resilience`` lint keeps it that way, because a raw
+  single-origin URL built anywhere else silently opts that call out of
+  replication, failover, and epoch safety.
+
+Client fleet discovery: ``KT_STORE_NODES`` (comma-separated base URLs)
+names the fleet; the epoch is learned from the first reachable node's
+``/ring``. Without it the ring degenerates to the single configured
+origin and the wire behavior is byte-identical to the pre-ring client
+(no epoch header, no extra requests) — single-node deployments pay
+nothing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from bisect import bisect_right
+from typing import Callable, Dict, List, Optional, Tuple
+
+import requests as _requests
+
+from .. import telemetry
+from ..exceptions import (CircuitOpenError, DataCorruptionError,
+                          DataStoreError, RingEpochMismatch,
+                          rehydrate_exception)
+from . import netpool
+
+DEFAULT_REPLICATION = 2        # R: copies per blob/kv key
+DEFAULT_WRITE_QUORUM = 2       # W: acks before a PUT returns (capped at N)
+DEFAULT_NODE_TTL_S = 30.0      # dead-past-TTL ⇒ re-replicate its keys
+DEFAULT_VNODES = 64            # virtual nodes per member
+
+RING_EPOCH_HEADER = "X-KT-Ring-Epoch"
+REPLICATED_HEADER = "X-KT-Replicated"   # marks store↔store internal traffic
+
+# every time the router abandons one replica for its sibling — the
+# "zero client-visible failures" claim, observable
+_FAILOVERS = telemetry.counter(
+    "kt_store_failovers_total",
+    "Client-side failovers to a sibling store replica",
+    labels=("kind",))
+
+
+def _env_int(name: str, cfg_field: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    try:
+        from ..config import config
+        return int(config().get(cfg_field, default))
+    except Exception:
+        return default
+
+
+def _env_float(name: str, cfg_field: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    try:
+        from ..config import config
+        return float(config().get(cfg_field, default))
+    except Exception:
+        return default
+
+
+def replication_factor() -> int:
+    """R — how many nodes hold each blob/kv key (``KT_STORE_REPLICATION``)."""
+    return max(1, _env_int("KT_STORE_REPLICATION", "store_replication",
+                           DEFAULT_REPLICATION))
+
+
+def write_quorum() -> int:
+    """W — acks (local commit counts as one) before a PUT returns
+    (``KT_STORE_WRITE_QUORUM``). Effective quorum is ``min(W, R, live)``:
+    a degraded ring keeps accepting writes rather than failing the push —
+    the scrubber restores R-way replication when nodes return."""
+    return max(1, _env_int("KT_STORE_WRITE_QUORUM", "store_write_quorum",
+                           DEFAULT_WRITE_QUORUM))
+
+
+def node_ttl_s() -> float:
+    """How long a store node may stay unreachable before its keys are
+    re-replicated onto the surviving ring (``KT_STORE_NODE_TTL_S``)."""
+    return _env_float("KT_STORE_NODE_TTL_S", "store_node_ttl_s",
+                      DEFAULT_NODE_TTL_S)
+
+
+# ---------------------------------------------------------------------------
+# Placement
+# ---------------------------------------------------------------------------
+
+
+def key_point(key: str) -> int:
+    """Position of a RAW (unquoted, unescaped) key on the ring. Every
+    placement decision — client router, server forwarding, scrub
+    re-replication — hashes the same canonical form, so a key that is
+    percent-quoted on the wire (``netpool.urlkey``) or ``%``-escaped on
+    disk (``durability.escape_key``) still lands on the same replicas
+    from every vantage point."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Deterministic consistent-hash ring over node base URLs.
+
+    Membership order does not matter: points are derived from the node
+    URL itself, so two routers built from differently-ordered lists (or
+    on different hosts) produce identical replica sets — the property the
+    cross-node hash-stability test pins down.
+    """
+
+    def __init__(self, nodes: List[str], vnodes: int = DEFAULT_VNODES):
+        self.nodes = sorted({n.rstrip("/") for n in nodes if n})
+        self._points: List[Tuple[int, str]] = []
+        for node in self.nodes:
+            for v in range(vnodes):
+                h = int.from_bytes(
+                    hashlib.blake2b(f"{node}#{v}".encode(),
+                                    digest_size=8).digest(), "big")
+                self._points.append((h, node))
+        self._points.sort()
+        self._keys = [p[0] for p in self._points]
+
+    def walk(self, key: str) -> List[str]:
+        """Every node, ordered by ring distance from ``key`` — the replica
+        set is a prefix of this, and failover/handoff just walks further."""
+        if not self.nodes:
+            return []
+        if len(self.nodes) == 1:
+            return list(self.nodes)
+        start = bisect_right(self._keys, key_point(key))
+        seen: List[str] = []
+        n = len(self._points)
+        for i in range(n):
+            node = self._points[(start + i) % n][1]
+            if node not in seen:
+                seen.append(node)
+                if len(seen) == len(self.nodes):
+                    break
+        return seen
+
+    def replicas(self, key: str, r: Optional[int] = None) -> List[str]:
+        """The first ``r`` distinct nodes clockwise from the key's point."""
+        return self.walk(key)[: (r if r is not None else replication_factor())]
+
+
+# ---------------------------------------------------------------------------
+# Client-side router
+# ---------------------------------------------------------------------------
+
+
+class StoreRing:
+    """Client view of the fleet: placement + liveness-ordered failover.
+
+    One instance per (seed URL, ``KT_STORE_NODES``) pair, cached by
+    :func:`ring_for`. ``size == 1`` is the degenerate single-origin ring:
+    no epoch header, no failover candidates beyond the origin — wire
+    behavior identical to the pre-ring client.
+    """
+
+    def __init__(self, seed_url: str, nodes: Optional[List[str]] = None,
+                 epoch: Optional[int] = None):
+        self.seed_url = seed_url.rstrip("/")
+        self._lock = threading.Lock()
+        self.epoch = epoch
+        self._ring = HashRing(nodes or [self.seed_url])
+        # url → monotonic time of last observed failure; entries age out
+        # after a short cooldown so a recovered node gets traffic back
+        self._down: Dict[str, float] = {}
+        self.down_cooldown_s = min(node_ttl_s(), 5.0)
+
+    @property
+    def size(self) -> int:
+        return len(self._ring.nodes)
+
+    @property
+    def nodes(self) -> List[str]:
+        return list(self._ring.nodes)
+
+    # -- liveness ------------------------------------------------------------
+
+    def record_failure(self, url: str) -> None:
+        with self._lock:
+            self._down[url.rstrip("/")] = time.monotonic()
+
+    def record_success(self, url: str) -> None:
+        with self._lock:
+            self._down.pop(url.rstrip("/"), None)
+
+    def _suspect(self, url: str) -> bool:
+        with self._lock:
+            ts = self._down.get(url)
+            if ts is None:
+                return False
+            if time.monotonic() - ts > self.down_cooldown_s:
+                del self._down[url]
+                return False
+            return True
+
+    # -- placement -----------------------------------------------------------
+
+    def nodes_for(self, key: str) -> List[str]:
+        """The key's replica set, then the rest of the ring as handoff
+        targets — recently-failed nodes sink to the back of each segment
+        so the common case never waits on a known-dead replica."""
+        walk = self._ring.walk(key)
+        r = replication_factor()
+        primary, rest = walk[:r], walk[r:]
+        order = ([u for u in primary if not self._suspect(u)]
+                 + [u for u in primary if self._suspect(u)]
+                 + [u for u in rest if not self._suspect(u)]
+                 + [u for u in rest if self._suspect(u)])
+        return order
+
+    def ordered_nodes(self) -> List[str]:
+        """All nodes, healthy first — for key-less control ops (diff,
+        listing, scrub status)."""
+        nodes = self.nodes
+        return ([u for u in nodes if not self._suspect(u)]
+                + [u for u in nodes if self._suspect(u)])
+
+    # -- membership ----------------------------------------------------------
+
+    def adopt(self, nodes: List[str], epoch: Optional[int]) -> None:
+        with self._lock:
+            self._ring = HashRing(nodes)
+            self.epoch = epoch
+            self._down = {u: ts for u, ts in self._down.items()
+                          if u in self._ring.nodes}
+
+    def refresh(self) -> bool:
+        """Re-learn membership + epoch from any reachable node's ``/ring``.
+        Returns True when a view was adopted."""
+        for base in self.ordered_nodes():
+            try:
+                r = netpool.session().get(f"{base}/ring", timeout=5)
+            except _requests.RequestException:
+                self.record_failure(base)
+                continue
+            if r.status_code != 200:
+                continue
+            try:
+                body = r.json()
+                nodes = [str(u) for u in body.get("nodes") or []]
+                epoch = body.get("epoch")
+            except (ValueError, TypeError):
+                continue
+            if nodes:
+                self.adopt(nodes, int(epoch) if epoch is not None else None)
+                return True
+        return False
+
+    # -- the routed request --------------------------------------------------
+
+    def request(self, method: str, path: str, key: Optional[str] = None,
+                timeout: Optional[float] = None, verify=None, **kwargs):
+        """``netpool.request`` against the right replica, with failover.
+
+        ``path`` is the server-relative path (``/kv/<quoted>``, …);
+        ``key`` — when given — is the RAW key the placement hashes on.
+        Candidates are the key's replica set (then handoff targets), or
+        the liveness-ordered full ring for key-less control ops. Each
+        candidate gets the full netpool retry policy; the router moves on
+        when a candidate is (still) unreachable, circuit-broken, or
+        returns a 5xx verdict the per-node retries couldn't clear — and a
+        stale-epoch 409 triggers one transparent refresh + re-route.
+        ``verify(resp)`` — when given — runs on every 200: a
+        ``DataCorruptionError`` fails the replica over exactly like a dead
+        one (the PR 4 hash check is the detector, the ring is the repair).
+        The LAST candidate's outcome surfaces unchanged, so single-node
+        rings keep their exact pre-ring error behavior.
+        """
+        refreshes = 0
+        while True:
+            bases = self.nodes_for(key) if key is not None \
+                else self.ordered_nodes()
+            last_exc: Optional[BaseException] = None
+            resp = None
+            for i, base in enumerate(bases):
+                final = i == len(bases) - 1
+                headers = dict(kwargs.get("headers") or {})
+                if self.epoch is not None and self.size > 1:
+                    headers[RING_EPOCH_HEADER] = str(self.epoch)
+                kw = dict(kwargs, headers=headers)
+                try:
+                    resp = netpool.request(method, f"{base}{path}",
+                                           timeout=timeout, **kw)
+                except CircuitOpenError:
+                    # a tripped breaker on one replica must not gate its
+                    # siblings — that is the whole point of having them
+                    last_exc = None
+                    if final:
+                        raise
+                    self._failover("breaker", base)
+                    continue
+                except _requests.RequestException as e:
+                    self.record_failure(base)
+                    last_exc = e
+                    if final:
+                        raise
+                    self._failover("connect", base)
+                    continue
+                if resp.status_code == 409:
+                    mism = _epoch_mismatch(resp)
+                    if mism is not None:
+                        if refreshes < 2 and self.refresh():
+                            refreshes += 1
+                            self._failover("epoch", base)
+                            break   # re-route the whole call on the new view
+                        raise mism
+                if resp.status_code in (502, 503, 504) and not final:
+                    # per-node retries already ran inside netpool.request;
+                    # a still-5xx node is sick — its sibling may not be
+                    self.record_failure(base)
+                    self._failover("status", base)
+                    continue
+                if resp.status_code == 200 and verify is not None:
+                    try:
+                        verify(resp)
+                    except DataCorruptionError:
+                        if final:
+                            raise
+                        self._failover("corruption", base)
+                        continue
+                self.record_success(base)
+                return resp
+            else:
+                # exhausted every candidate without returning/raising
+                if resp is not None:
+                    return resp
+                if last_exc is not None:
+                    raise last_exc
+                raise DataStoreError(
+                    f"store ring has no reachable node for {path!r}")
+            # only reachable via the epoch-refresh `break`: loop re-routes
+
+    def _failover(self, kind: str, base: str) -> None:
+        _FAILOVERS.inc(kind=kind)
+        telemetry.add_event("store.failover", kind=kind, node=base)
+
+
+def _epoch_mismatch(resp) -> Optional[RingEpochMismatch]:
+    try:
+        data = resp.json()
+    except ValueError:
+        return None
+    if isinstance(data, dict) and data.get("error_type") == "RingEpochMismatch":
+        exc = rehydrate_exception(data)
+        if isinstance(exc, RingEpochMismatch):
+            return exc
+    return None
+
+
+# per-process router cache. Keyed by (seed, KT_STORE_NODES) so a test (or
+# redeploy) that changes the fleet env gets a fresh router without any
+# explicit invalidation hook.
+_RINGS: Dict[Tuple[str, Optional[str]], StoreRing] = {}
+_RINGS_LOCK = threading.Lock()
+
+
+def ring_for(seed_url: str) -> StoreRing:
+    """The router for ``seed_url``'s fleet. ``KT_STORE_NODES`` (comma-
+    separated base URLs) defines multi-node membership; its epoch is
+    learned lazily from ``/ring``. Unset → a single-origin ring with no
+    discovery round-trip at all."""
+    seed = seed_url.rstrip("/")
+    env = os.environ.get("KT_STORE_NODES") or None
+    cache_key = (seed, env)
+    with _RINGS_LOCK:
+        ring = _RINGS.get(cache_key)
+        if ring is not None:
+            return ring
+    if env:
+        nodes = [u.strip().rstrip("/") for u in env.split(",") if u.strip()]
+        if seed not in nodes:
+            nodes.append(seed)
+        ring = StoreRing(seed, nodes=nodes)
+        ring.refresh()          # learn the epoch; best-effort
+    else:
+        ring = StoreRing(seed)
+    with _RINGS_LOCK:
+        return _RINGS.setdefault(cache_key, ring)
+
+
+def reset_rings() -> None:
+    with _RINGS_LOCK:
+        _RINGS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Origin resolution (the ONLY config/env read of the store URL in data_store/)
+# ---------------------------------------------------------------------------
+
+# per-process reachability verdicts: direct URL → (resolved URL, expiry).
+# A direct verdict is cached for the process lifetime; a TUNNEL verdict
+# expires so a store that was merely booting (deploy race) gets its direct
+# path back instead of bottlenecking the controller forever.
+_REACHABLE_CACHE: dict = {}
+_TUNNEL_VERDICT_TTL_S = 60.0
+
+
+def _tunnel_fallback(url: str) -> str:
+    """From OUTSIDE the cluster the store's service DNS doesn't resolve;
+    route through the controller's ``/controller/store`` relay instead
+    (reference ``websocket_tunnel.py`` role). In-cluster pods and local-mode
+    clients pass the direct probe and never pay the hop."""
+    from ..config import config
+
+    cached = _REACHABLE_CACHE.get(url)
+    if cached and (cached[1] is None or time.monotonic() < cached[1]):
+        return cached[0]
+    resolved, expires = url, None
+    try:
+        _requests.get(f"{url}/health", timeout=2).raise_for_status()
+    except _requests.RequestException:
+        api = config().api_url
+        if api:
+            tunnel = f"{api.rstrip('/')}/controller/store"
+            try:
+                r = _requests.get(f"{tunnel}/health", timeout=5)
+                if r.status_code == 200:
+                    resolved = tunnel
+                    expires = time.monotonic() + _TUNNEL_VERDICT_TTL_S
+            except _requests.RequestException:
+                pass   # keep direct; its error is the truthful one
+    _REACHABLE_CACHE[url] = (resolved, expires)
+    return resolved
+
+
+def resolve_origin(explicit: Optional[str] = None) -> str:
+    """The seed store URL for this process (formerly ``commands._store_url``).
+    Explicit > ``config.data_store_url`` / ``KT_DATA_STORE_URL`` >
+    controller-discovered; with none, a typed error."""
+    from ..config import config
+
+    if explicit:
+        # the caller NAMED a store — never silently reroute their data to a
+        # different one just because a health probe blipped
+        return explicit.rstrip("/")
+    url = config().data_store_url or os.environ.get("KT_DATA_STORE_URL")
+    if not url and config().api_url:
+        # discover through an ALREADY-CONFIGURED controller's cluster config
+        # (the local controller runs its own store; k8s clusters publish
+        # theirs). Never auto-spawn a controller here — a misconfigured pod
+        # must get the clear error below, not a fresh empty store.
+        try:
+            from ..client import controller_client
+            url = controller_client().cluster_config().get("data_store_url")
+            if url:
+                config().data_store_url = url
+        except Exception:
+            url = None
+    if not url:
+        raise DataStoreError(
+            "No data store configured (set KT_DATA_STORE_URL or "
+            "config.data_store_url, or pass store_url=)")
+    return _tunnel_fallback(url.rstrip("/"))

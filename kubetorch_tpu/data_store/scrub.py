@@ -270,10 +270,23 @@ class Scrubber:
     Runs inside the store's event loop: files are hashed in 1 MiB chunks
     with an ``await`` between chunks, which both paces I/O to
     ``KT_SCRUB_RATE_MBPS`` and yields the loop to in-flight requests.
+
+    On a multi-node ring (``ring=`` a server ``RingState``, ``http=`` a
+    session factory) each sweep also runs the **re-replication pass**:
+    probe sibling liveness, then for every object this node holds, push
+    it to any member of its *live* replica set that lacks it. A node dead
+    past its TTL is excluded from that set (ownership handoff), so its
+    keys converge back to R copies on the survivors — the ring's
+    self-healing twin of the integrity quarantine. Progress lands in
+    ``/scrub/status`` as ``under_replicated`` (objects found lacking a
+    copy this sweep) and ``re_replicated`` (successful pushes,
+    cumulative).
     """
 
-    def __init__(self, root: Path):
+    def __init__(self, root: Path, ring=None, http=None):
         self.root = Path(root)
+        self.ring = ring                  # server RingState (duck-typed)
+        self.http = http                  # () → aiohttp.ClientSession
         self.interval_s = _env_float("KT_SCRUB_INTERVAL_S",
                                      "scrub_interval_s",
                                      DEFAULT_SCRUB_INTERVAL_S)
@@ -283,7 +296,8 @@ class Scrubber:
                             "quarantined": 0, "last_sweep_s": None,
                             "last_sweep_at": None, "running": False,
                             "interval_s": self.interval_s,
-                            "rate_mbps": self.rate_mbps}
+                            "rate_mbps": self.rate_mbps,
+                            "under_replicated": 0, "re_replicated": 0}
         self._sweep_lock = asyncio.Lock()
 
     async def _hash_paced(self, path: Path) -> str:
@@ -340,6 +354,9 @@ class Scrubber:
                     if actual != want:
                         if _verify_kv_pair(self.root, data, meta):
                             report["quarantined"] += 1
+                if (self.ring is not None and self.http is not None
+                        and getattr(self.ring, "multi", False)):
+                    report.update(await self._replication_sweep())
             finally:
                 self.stats["running"] = False
                 self.stats["sweeps"] += 1
@@ -348,6 +365,118 @@ class Scrubber:
                 self.stats["last_sweep_s"] = round(time.monotonic() - t0, 4)
                 self.stats["last_sweep_at"] = time.time()
             return report
+
+    # -- ring re-replication -------------------------------------------------
+
+    async def _probe_siblings(self, sess) -> None:
+        """Refresh the liveness book before deciding who is dead: a node
+        that answers ``/health`` is marked up again (its re-replicated
+        keys stay as extra copies until GC); one that doesn't starts (or
+        continues) its TTL clock."""
+        import aiohttp
+
+        for base in self.ring.siblings():
+            try:
+                async with sess.get(
+                        f"{base}/health",
+                        timeout=aiohttp.ClientTimeout(total=2)) as r:
+                    if r.status == 200:
+                        self.ring.mark_up(base)
+                    else:
+                        self.ring.mark_down(base)
+            except Exception:
+                self.ring.mark_down(base)
+
+    async def _push_object(self, sess, base: str, path: str, file: Path,
+                           meta: Optional[Dict]) -> bool:
+        import aiohttp
+
+        headers = {"X-KT-Replicated": "1"}
+        if meta is not None:
+            headers["X-KT-Meta"] = json.dumps(meta)
+        try:
+            async with sess.put(
+                    f"{base}{path}", data=file.read_bytes(), headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=120,
+                                                  connect=3)) as r:
+                ok = r.status == 200
+        except Exception:
+            self.ring.mark_down(base)
+            return False
+        if ok:
+            self.ring.mark_up(base)
+        return ok
+
+    async def _replication_sweep(self) -> Dict:
+        """Converge every local object toward R live copies. For each
+        blob/kv value this node holds, HEAD the members of its live
+        replica set (dead-past-TTL nodes excluded — their ownership is
+        handed to the next ring successor) and push where missing."""
+        import aiohttp
+        from urllib.parse import quote
+
+        from .durability import unescape_key
+
+        report = {"under_replicated": 0, "re_replicated": 0,
+                  "still_under_replicated": 0}
+        sess = self.http()
+        if sess is None:
+            return report
+        await self._probe_siblings(sess)
+
+        async def _ensure(key: str, path: str, file: Path,
+                          meta: Optional[Dict]) -> None:
+            lacking, unreachable = [], []
+            for base in self.ring.live_replicas(key):
+                if base == self.ring.self_url:
+                    continue
+                try:
+                    async with sess.head(
+                            f"{base}{path}",
+                            headers={"X-KT-Replicated": "1"},
+                            timeout=aiohttp.ClientTimeout(total=5,
+                                                          connect=3)) as r:
+                        if r.status != 200:
+                            lacking.append(base)
+                        else:
+                            self.ring.mark_up(base)
+                except Exception:
+                    # an Unreachable-but-not-yet-Dead replica still counts
+                    # as a missing live copy — its slot is only handed to
+                    # the next successor once the TTL declares it Dead, so
+                    # this object stays under_replicated (not healable
+                    # yet) rather than silently "fine"
+                    self.ring.mark_down(base)
+                    unreachable.append(base)
+            if not lacking and not unreachable:
+                return
+            report["under_replicated"] += 1
+            healed = not unreachable
+            for base in lacking:
+                if await self._push_object(sess, base, path, file, meta):
+                    report["re_replicated"] += 1
+                else:
+                    healed = False
+            if not healed:
+                report["still_under_replicated"] += 1
+            await asyncio.sleep(0)       # yield between objects
+
+        for blob in list(_iter_blob_files(self.root)):
+            await _ensure(blob.name, f"/blob/{blob.name}", blob, None)
+        for data, meta_path in list(_iter_kv_pairs(self.root)):
+            key = unescape_key(data.name)
+            meta = None
+            try:
+                meta = json.loads(meta_path.read_text())
+            except (OSError, ValueError):
+                pass
+            await _ensure(key, f"/kv/{quote(key, safe='/')}", data, meta)
+
+        self.stats["re_replicated"] += report["re_replicated"]
+        # the number an operator (and the chaos acceptance test) watches:
+        # objects STILL below R live copies after this sweep's pushes
+        self.stats["under_replicated"] = report["still_under_replicated"]
+        return report
 
     async def run_forever(self) -> None:
         while True:
@@ -365,7 +494,10 @@ class Scrubber:
         if qdir.is_dir():
             quarantined_files = sum(1 for p in qdir.iterdir()
                                     if not p.name.endswith(".why"))
-        return {**self.stats, "quarantine_files": quarantined_files}
+        out = {**self.stats, "quarantine_files": quarantined_files}
+        if self.ring is not None and getattr(self.ring, "multi", False):
+            out["ring"] = self.ring.status()
+        return out
 
 
 # ---------------------------------------------------------------------------

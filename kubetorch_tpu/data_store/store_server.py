@@ -15,10 +15,26 @@ single aiohttp service:
                                    which locale="local" key, for P2P gets
 - ``/scrub/status`` / ``/scrub/run``  background integrity scrubber
 - ``/gc``                          refcounted GC of tree-unreferenced blobs
+- ``/ring``                        GET: this node's ring view (epoch,
+                                   members, capacity); POST: adopt a newer
+                                   membership view (controller/test-fed)
 
 Uploads stream: blob/KV PUT bodies are chunked straight to the ``.tmp``
 file with an incremental blake2b, so server memory stays ``O(chunk)``
 however large the checkpoint.
+
+Replication (ISSUE 7): with ``KT_STORE_NODES`` (+ ``KT_STORE_SELF_URL``)
+set, this node is one member of a consistent-hash ring (``ring.py`` owns
+placement). A client PUT commits locally, is forwarded synchronously to
+ring successors until write-quorum W acks exist (local commit counts as
+one), and repairs the rest of the R-way replica set asynchronously; a
+dead successor is skipped in favor of the next live node (ownership
+handoff) so a single node loss never fails the write. GETs and diffs
+answer ring-wide — a node that lacks the bytes proxies its siblings — so
+any node can serve any key. Internal store↔store traffic carries
+``X-KT-Replicated`` and is strictly local (no forwarding loops, no chaos,
+no epoch checks). Stale client routers are rejected with 409 + typed
+``RingEpochMismatch`` before any disk is touched.
 
 Crash consistency (ISSUE 4): every commit rename rides
 ``durability.durable_replace`` (data fsync + parent-dir fsync,
@@ -41,22 +57,28 @@ import contextlib
 import hashlib
 import json
 import os
+import shutil
+import threading
 import time
 import uuid
 from pathlib import Path
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 from aiohttp import web
 
 from .. import telemetry
-from ..exceptions import StoreFullError, package_exception
+from ..exceptions import (RingEpochMismatch, StoreFullError,
+                          package_exception)
 from . import durability, scrub
+from . import ring as ring_mod
+from .ring import REPLICATED_HEADER, RING_EPOCH_HEADER
 
 MAX_BODY = 10 * 1024 ** 3
 UPLOAD_CHUNK = 1 << 20          # streaming read granularity for PUT bodies
 
 # untraced plumbing: probes and the observability surface itself must not
 # fill the span ring at scrape cadence
-_TRACE_EXEMPT = ("/health", "/metrics", "/debug/traces", "/scrub/status")
+_TRACE_EXEMPT = ("/health", "/metrics", "/debug/traces", "/scrub/status",
+                 "/ring")
 
 _STORE_REQS = telemetry.counter(
     "kt_store_requests_total",
@@ -66,6 +88,144 @@ _STORE_BYTES = telemetry.counter(
     "kt_store_transfer_bytes_total",
     "Bytes served (GET) / accepted (PUT) by the store server",
     labels=("direction",))
+_REPLICATION = telemetry.counter(
+    "kt_store_replication_total",
+    "Replica-forwarded commits by outcome (sync=quorum path, async=repair)",
+    labels=("mode", "result"))
+_PROXY_FETCHES = telemetry.counter(
+    "kt_store_proxy_fetches_total",
+    "GETs served by proxying a sibling store node (local miss)",
+    labels=("kind",))
+_EPOCH_REJECTS = telemetry.counter(
+    "kt_store_epoch_rejections_total",
+    "Requests rejected because the client's ring epoch was stale")
+
+_INTERNAL_TIMEOUT_S = 60.0      # store↔store forwards/probes
+
+
+def _internal(request: web.Request) -> bool:
+    """True for store↔store traffic (replication forwards, ring-wide
+    probes): strictly local semantics — never re-forward, never proxy."""
+    return request.headers.get(REPLICATED_HEADER) is not None
+
+
+class RingState:
+    """This node's view of the store ring: membership + epoch + the
+    liveness book the forwarding path and the scrubber's re-replication
+    sweep share. ``down`` records *when* a sibling first failed — the
+    watchdog-style taxonomy one level up: a node inside the TTL window is
+    ``Unreachable`` (skip, retry later), one past it is ``Dead`` (its keys
+    are re-replicated onto the survivors, ownership handed off)."""
+
+    def __init__(self, self_url: Optional[str], nodes: Optional[List[str]],
+                 epoch: Optional[int] = None,
+                 replication: Optional[int] = None,
+                 quorum: Optional[int] = None,
+                 ttl_s: Optional[float] = None):
+        self.self_url = (self_url or "").rstrip("/")
+        members = [n for n in (nodes or []) if n]
+        if self.self_url and self.self_url not in members:
+            members.append(self.self_url)
+        self._hash = ring_mod.HashRing(members)
+        self.epoch = epoch
+        self.replication = (replication if replication
+                            else ring_mod.replication_factor())
+        self.write_quorum = quorum if quorum else ring_mod.write_quorum()
+        self.ttl_s = ttl_s if ttl_s is not None else ring_mod.node_ttl_s()
+        self._lock = threading.Lock()
+        self.down: Dict[str, float] = {}      # url → first-failure wall time
+
+    @property
+    def nodes(self) -> List[str]:
+        return list(self._hash.nodes)
+
+    @property
+    def multi(self) -> bool:
+        return len(self._hash.nodes) > 1
+
+    def adopt(self, nodes: List[str], epoch: Optional[int]) -> bool:
+        """Adopt a newer membership view; stale/equal epochs are refused
+        (last-writer-wins needs a total order, and the epoch is it)."""
+        with self._lock:
+            if (self.epoch is not None and epoch is not None
+                    and epoch <= self.epoch):
+                return False
+            members = list(nodes)
+            if self.self_url and self.self_url not in members:
+                members.append(self.self_url)
+            self._hash = ring_mod.HashRing(members)
+            self.epoch = epoch
+            self.down = {u: t for u, t in self.down.items()
+                         if u in self._hash.nodes}
+            return True
+
+    def mark_down(self, url: str) -> None:
+        with self._lock:
+            self.down.setdefault(url.rstrip("/"), time.time())
+
+    def mark_up(self, url: str) -> None:
+        with self._lock:
+            self.down.pop(url.rstrip("/"), None)
+
+    def down_since(self, url: str) -> Optional[float]:
+        with self._lock:
+            return self.down.get(url.rstrip("/"))
+
+    def dead_past_ttl(self, url: str) -> bool:
+        ts = self.down_since(url)
+        return ts is not None and time.time() - ts >= self.ttl_s
+
+    def walk(self, key: str) -> List[str]:
+        return self._hash.walk(key)
+
+    def siblings(self) -> List[str]:
+        return [u for u in self._hash.nodes if u != self.self_url]
+
+    def live_replicas(self, key: str) -> List[str]:
+        """Where ``key`` SHOULD live right now: the first R nodes on its
+        walk that are not dead past the TTL — the ownership-handoff view
+        the re-replication sweep converges the disk state toward."""
+        out: List[str] = []
+        for u in self.walk(key):
+            if not self.dead_past_ttl(u):
+                out.append(u)
+            if len(out) >= self.replication:
+                break
+        return out
+
+    def status(self) -> Dict:
+        with self._lock:
+            down = dict(self.down)
+        now = time.time()
+        return {
+            "epoch": self.epoch,
+            "self": self.self_url or None,
+            "nodes": self.nodes,
+            "replication": self.replication,
+            "write_quorum": self.write_quorum,
+            "node_ttl_s": self.ttl_s,
+            "down": {u: {"down_for_s": round(now - ts, 3),
+                         "cause": "Dead" if now - ts >= self.ttl_s
+                         else "Unreachable"}
+                     for u, ts in down.items()},
+        }
+
+
+def _ring_from_env() -> RingState:
+    """Ring view from the deployment env: ``KT_STORE_NODES`` (comma-
+    separated members incl. this node) + ``KT_STORE_SELF_URL`` +
+    ``KT_STORE_RING_EPOCH`` (default 1 for multi-node rings). Unset →
+    degenerate single-node ring; every ring feature is a no-op."""
+    raw = os.environ.get("KT_STORE_NODES", "")
+    nodes = [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
+    self_url = os.environ.get("KT_STORE_SELF_URL", "").strip()
+    epoch: Optional[int] = None
+    if nodes:
+        try:
+            epoch = int(os.environ.get("KT_STORE_RING_EPOCH", "1"))
+        except ValueError:
+            epoch = 1
+    return RingState(self_url, nodes, epoch=epoch)
 
 
 @web.middleware
@@ -98,11 +258,14 @@ async def store_trace_middleware(request: web.Request, handler):
 
 
 class StoreState:
-    def __init__(self, root: str):
+    def __init__(self, root: str, ring: Optional[RingState] = None):
         self.root = Path(root)
         (self.root / "blobs").mkdir(parents=True, exist_ok=True)
         (self.root / "trees").mkdir(parents=True, exist_ok=True)
         (self.root / "kv").mkdir(parents=True, exist_ok=True)
+        # ring membership (env-fed by default; create_store_app can inject
+        # an explicit view for in-process fleets)
+        self.ring = ring if ring is not None else _ring_from_env()
         # crash recovery BEFORE the first request: sweep orphan tmps,
         # re-verify anything the last run may have torn, reload peers
         self.recovery = scrub.recover_store(self.root)
@@ -212,6 +375,214 @@ def _commit(tmp: Path, path: Path) -> None:
         raise
 
 
+# -- ring plumbing: epoch validation, replication forwards, proxy reads ------
+
+
+@web.middleware
+async def ring_epoch_middleware(request: web.Request, handler):
+    """Reject data-plane requests routed with a stale ring epoch BEFORE
+    they touch disk: a stale router may have hashed the key onto the wrong
+    replica set, and a typed 409 is cheaper to absorb (refresh + re-route)
+    than a misplaced object is to find. Internal store↔store traffic and
+    the ring/probe surface are exempt."""
+    st = request.app.get("store")
+    ring = getattr(st, "ring", None)
+    claimed = request.headers.get(RING_EPOCH_HEADER)
+    if (ring is not None and ring.multi and ring.epoch is not None
+            and claimed is not None and not _internal(request)
+            and not request.path.startswith(("/ring",) + _TRACE_EXEMPT)):
+        try:
+            actual = int(claimed)
+        except ValueError:
+            actual = None
+        if actual is not None and actual != ring.epoch:
+            _EPOCH_REJECTS.inc()
+            return web.json_response(package_exception(RingEpochMismatch(
+                f"client routed with ring epoch {actual}, this node is at "
+                f"{ring.epoch}", expected=ring.epoch, actual=actual)),
+                status=409)
+    return await handler(request)
+
+
+def _file_streamer(path: Path):
+    """Async chunk generator over a committed file — replica forwards move
+    O(chunk) per in-flight body, same budget as the upload path."""
+    async def gen():
+        loop = asyncio.get_event_loop()
+        with path.open("rb") as f:
+            while True:
+                chunk = await loop.run_in_executor(None, f.read, UPLOAD_CHUNK)
+                if not chunk:
+                    break
+                yield chunk
+    return gen()
+
+
+async def _forward(app: web.Application, base: str, method: str, path: str,
+                   file_path: Optional[Path] = None,
+                   headers: Optional[Dict[str, str]] = None,
+                   json_body: Optional[dict] = None) -> bool:
+    """One internal store→store request; False on any failure (the caller
+    decides between handoff and async repair). Marks liveness both ways."""
+    import aiohttp
+
+    st: StoreState = app["store"]
+    hdrs = {REPLICATED_HEADER: "1", **(headers or {})}
+    try:
+        kwargs: Dict = {"headers": hdrs,
+                        "timeout": aiohttp.ClientTimeout(
+                            total=_INTERNAL_TIMEOUT_S, connect=3)}
+        if file_path is not None:
+            kwargs["data"] = _file_streamer(file_path)
+        if json_body is not None:
+            kwargs["json"] = json_body
+        async with app["ring_http"].request(
+                method, f"{base}{path}", **kwargs) as r:
+            ok = r.status == 200
+    except Exception:
+        st.ring.mark_down(base)
+        return False
+    if ok:
+        st.ring.mark_up(base)
+    return ok
+
+
+async def _replicate_object(app: web.Application, key: str, path: str,
+                            file_path: Path,
+                            headers: Optional[Dict[str, str]] = None) -> None:
+    """Fan a freshly-committed object out to its replica set.
+
+    The local commit is ack #1; ring successors are forwarded to
+    synchronously until ``min(W, R)`` acks exist, skipping recently-failed
+    nodes and walking past dead ones to the next live successor (ownership
+    handoff — a single node loss mid-push must not fail the write). The
+    remaining members of the R-way set repair asynchronously. Quorum
+    shortfall on a fully-degraded ring degrades to ack-1 rather than
+    failing the client; the scrubber's re-replication sweep restores R.
+    """
+    st: StoreState = app["store"]
+    ring = st.ring
+    need_sync = min(ring.write_quorum, ring.replication) - 1
+    want_total = ring.replication - 1
+    acks = 0
+    async_targets: List[str] = []
+    for base in [u for u in ring.walk(key) if u != ring.self_url]:
+        if acks >= need_sync and acks + len(async_targets) >= want_total:
+            break
+        if ring.dead_past_ttl(base):
+            continue
+        if acks >= need_sync:
+            async_targets.append(base)
+            continue
+        if await _forward(app, base, "PUT", path, file_path=file_path,
+                          headers=headers):
+            acks += 1
+            _REPLICATION.inc(mode="sync", result="ok")
+        else:
+            _REPLICATION.inc(mode="sync", result="failed")
+    for base in async_targets:
+        async def _repair(b=base):
+            ok = await _forward(app, b, "PUT", path, file_path=file_path,
+                                headers=headers)
+            _REPLICATION.inc(mode="async", result="ok" if ok else "failed")
+        asyncio.ensure_future(_repair())
+    if acks < need_sync:
+        telemetry.add_event("store.quorum_degraded", key=key,
+                            acks=acks + 1, want=need_sync + 1)
+
+
+async def _proxy_fetch(request: web.Request, key: str, path: str,
+                       kind: str) -> Optional[web.Response]:
+    """Local miss on a multi-node ring: answer from whichever sibling
+    holds the object — any node can serve any key. Internal requests never
+    proxy (that is how the recursion terminates)."""
+    import aiohttp
+
+    st = _state(request)
+    ring = st.ring
+    if not ring.multi or _internal(request):
+        return None
+    for base in [u for u in ring.walk(key) if u != ring.self_url]:
+        try:
+            async with request.app["ring_http"].request(
+                    request.method, f"{base}{path}",
+                    headers={REPLICATED_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
+                if r.status != 200:
+                    continue
+                body = b"" if request.method == "HEAD" else await r.read()
+                ring.mark_up(base)
+                _PROXY_FETCHES.inc(kind=kind)
+                headers = {}
+                if "X-KT-Meta" in r.headers:
+                    headers["X-KT-Meta"] = r.headers["X-KT-Meta"]
+                return web.Response(body=body, headers=headers,
+                                    content_type=r.headers.get(
+                                        "Content-Type", "application/octet-stream"))
+        except Exception:
+            ring.mark_down(base)
+    return None
+
+
+async def _blobs_missing_ringwide(app: web.Application, hashes) -> set:
+    """Which of ``hashes`` exist on NO live ring member — the availability
+    check ``/tree/diff`` and ``/tree/commit`` answer with, since a blob's
+    replica set rarely includes the node coordinating the tree."""
+    st: StoreState = app["store"]
+    missing = {h for h in hashes if not st.blob_path(h).is_file()}
+    if not missing or not st.ring.multi:
+        return missing
+    import aiohttp
+
+    for base in st.ring.siblings():
+        if not missing:
+            break
+        try:
+            async with app["ring_http"].post(
+                    f"{base}/tree/__probe__/diff",
+                    json={"files": {h: {"hash": h} for h in missing}},
+                    headers={REPLICATED_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
+                if r.status == 200:
+                    remote_missing = set((await r.json())["missing"])
+                    missing &= remote_missing
+                    st.ring.mark_up(base)
+        except Exception:
+            st.ring.mark_down(base)
+    return missing
+
+
+async def ring_get(request: web.Request) -> web.Response:
+    st = _state(request)
+    try:
+        du = shutil.disk_usage(st.root)
+        capacity = {"total_bytes": du.total, "used_bytes": du.used,
+                    "free_bytes": du.free}
+    except OSError:
+        capacity = {}
+    return web.json_response({**st.ring.status(), "capacity": capacity})
+
+
+async def ring_post(request: web.Request) -> web.Response:
+    """Adopt a newer membership view (controller-fed, or a test driving a
+    deterministic membership change). Body: ``{epoch, nodes}``."""
+    st = _state(request)
+    try:
+        body = await request.json()
+        nodes = [str(u).rstrip("/") for u in body["nodes"]]
+        epoch = int(body["epoch"])
+    except (ValueError, KeyError, TypeError):
+        return web.json_response({"error": "bad ring view"}, status=400)
+    adopted = st.ring.adopt(nodes, epoch)
+    return web.json_response({"ok": True, "adopted": adopted,
+                              "epoch": st.ring.epoch})
+
+
+# -- blobs (continued) --------------------------------------------------------
+
+
 async def put_blob(request: web.Request) -> web.Response:
     st = _state(request)
     h = request.match_info["hash"]
@@ -222,13 +593,19 @@ async def put_blob(request: web.Request) -> web.Response:
         return web.json_response({"error": f"hash mismatch: {actual}"},
                                  status=400)
     _commit(tmp, path)
+    if st.ring.multi and not _internal(request):
+        await _replicate_object(request.app, h, f"/blob/{h}", path)
     return web.json_response({"ok": True, "size": size})
 
 
 async def get_blob(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.blob_path(request.match_info["hash"])
+    h = request.match_info["hash"]
+    path = st.blob_path(h)
     if not path.is_file():
+        proxied = await _proxy_fetch(request, h, f"/blob/{h}", kind="blob")
+        if proxied is not None:
+            return proxied
         return web.json_response({"error": "no such blob"}, status=404)
     return web.FileResponse(path)
 
@@ -240,9 +617,13 @@ async def tree_diff(request: web.Request) -> web.Response:
     st = _state(request)
     body = await request.json()
     files: Dict[str, Dict] = body.get("files", {})
-    missing = sorted({info["hash"] for info in files.values()
-                      if not st.blob_path(info["hash"]).is_file()})
-    return web.json_response({"missing": missing})
+    hashes = {info["hash"] for info in files.values()}
+    if _internal(request):
+        # ring-wide probe from a sibling: answer for THIS disk only
+        missing = {h for h in hashes if not st.blob_path(h).is_file()}
+    else:
+        missing = await _blobs_missing_ringwide(request.app, hashes)
+    return web.json_response({"missing": sorted(missing)})
 
 
 async def tree_commit(request: web.Request) -> web.Response:
@@ -250,8 +631,12 @@ async def tree_commit(request: web.Request) -> web.Response:
     key = request.match_info["key"]
     body = await request.json()
     files: Dict[str, Dict] = body.get("files", {})
-    still_missing = [info["hash"] for info in files.values()
-                     if not st.blob_path(info["hash"]).is_file()]
+    if _internal(request):
+        # replicated manifest: the origin node already proved availability
+        still_missing = []
+    else:
+        still_missing = sorted(await _blobs_missing_ringwide(
+            request.app, {info["hash"] for info in files.values()}))
     if still_missing:
         return web.json_response(
             {"error": "missing blobs", "missing": still_missing}, status=409)
@@ -269,21 +654,80 @@ async def tree_commit(request: web.Request) -> web.Response:
                 content_type="application/json")
         raise
     _commit(tmp, path)
+    if st.ring.multi and not _internal(request):
+        # manifests ride the same quorum protocol as the blobs they index
+        await _replicate_manifest(request.app, key, files)
     return web.json_response({"ok": True, "files": len(files)})
+
+
+async def _replicate_manifest(app: web.Application, key: str,
+                              files: Dict[str, Dict]) -> None:
+    st: StoreState = app["store"]
+    ring = st.ring
+    acks, need = 0, min(ring.write_quorum, ring.replication) - 1
+    for base in [u for u in ring.walk(key) if u != ring.self_url]:
+        if acks >= need:
+            break
+        if ring.dead_past_ttl(base):
+            continue
+        from urllib.parse import quote
+        ok = await _forward(app, base, "POST",
+                            f"/tree/{quote(key, safe='/')}/commit",
+                            json_body={"files": files})
+        _REPLICATION.inc(mode="sync", result="ok" if ok else "failed")
+        if ok:
+            acks += 1
 
 
 async def tree_manifest(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.tree_path(request.match_info["key"])
+    key = request.match_info["key"]
+    path = st.tree_path(key)
     if not path.is_file():
+        from urllib.parse import quote
+        proxied = await _proxy_fetch(
+            request, key, f"/tree/{quote(key, safe='/')}/manifest",
+            kind="manifest")
+        if proxied is not None:
+            return proxied
         return web.json_response({"error": "no such tree"}, status=404)
     return web.Response(body=path.read_bytes(), content_type="application/json")
 
 
+async def _fanout_delete(request: web.Request, path: str) -> bool:
+    """Deletes must reach every replica (and any handoff stray), or the
+    key resurrects from a sibling on the next proxied GET. Best-effort
+    fan-out to ALL live siblings; returns True if any reported existed."""
+    st = _state(request)
+    if not st.ring.multi or _internal(request):
+        return False
+    import aiohttp
+
+    existed = False
+    for base in st.ring.siblings():
+        try:
+            async with request.app["ring_http"].delete(
+                    f"{base}{path}", headers={REPLICATED_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
+                if r.status == 200:
+                    st.ring.mark_up(base)
+                    with contextlib.suppress(Exception):
+                        existed = existed or (await r.json()).get("existed",
+                                                                  False)
+        except Exception:
+            st.ring.mark_down(base)
+    return existed
+
+
 async def tree_delete(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.tree_path(request.match_info["key"])
+    key = request.match_info["key"]
+    path = st.tree_path(key)
     existed = path.is_file()
+    from urllib.parse import quote
+    existed = await _fanout_delete(
+        request, f"/tree/{quote(key, safe='/')}") or existed
     # idempotent under concurrent delete (missing_ok), and in-flight .tmp
     # siblings from a racing commit go too — an orphan would resurrect as
     # garbage on the next recovery-less scan
@@ -317,6 +761,9 @@ async def kv_put(request: web.Request) -> web.Response:
             {"error": f"content hash mismatch: body is {actual}"}, status=400)
     meta["blake2b"] = actual
     meta["size"] = size
+    # receive time, preserved verbatim on replica forwards: the ordering
+    # fact quorum reads of mutable keys (checkpoint markers) resolve on
+    meta.setdefault("stored_at", round(time.time(), 6))
     # data renames first: if we crash before the meta lands, the stale
     # meta makes /kv/diff report the key missing (hash or size mismatch)
     # — a wasted re-upload, not a lost update. The rename pair itself is
@@ -341,6 +788,12 @@ async def kv_put(request: web.Request) -> web.Response:
                 content_type="application/json")
         raise
     _commit(meta_tmp, path.with_name(path.name + ".meta"))
+    if st.ring.multi and not _internal(request):
+        key = request.match_info["key"]
+        from urllib.parse import quote
+        await _replicate_object(
+            request.app, key, f"/kv/{quote(key, safe='/')}", path,
+            headers={"X-KT-Meta": json.dumps(meta)})
     return web.json_response({"ok": True, "size": size})
 
 
@@ -349,7 +802,10 @@ async def kv_diff(request: web.Request) -> web.Response:
     ``{keys: {key: blake2b}}`` → ``{missing: [key, ...]}`` listing the keys
     whose stored content does NOT match — those are the only ones the
     client must upload. Unknown keys and keys stored before hashes were
-    recorded count as missing (re-upload is always safe)."""
+    recorded count as missing (re-upload is always safe). On a multi-node
+    ring a key counts current when ANY live member holds it current (the
+    re-replication sweep restores R-way placement; claiming missing here
+    would re-move bytes the ring already has)."""
     st = _state(request)
     body = await request.json()
     keys: Dict[str, str] = body.get("keys", {})
@@ -379,13 +835,48 @@ async def kv_diff(request: web.Request) -> web.Response:
                 missing.append(key)
         except OSError:
             missing.append(key)
+    if missing and st.ring.multi and not _internal(request):
+        missing = await _kv_missing_ringwide(request.app, missing, keys)
     return web.json_response({"missing": sorted(missing)})
+
+
+async def _kv_missing_ringwide(app: web.Application, missing: List[str],
+                               wanted: Dict[str, str]) -> List[str]:
+    """Narrow a local /kv/diff miss list by asking the live siblings: a
+    key some other member already holds current needs no bytes from the
+    client."""
+    import aiohttp
+
+    st: StoreState = app["store"]
+    unresolved = set(missing)
+    for base in st.ring.siblings():
+        if not unresolved:
+            break
+        try:
+            async with app["ring_http"].post(
+                    f"{base}/kv/diff",
+                    json={"keys": {k: wanted[k] for k in unresolved}},
+                    headers={REPLICATED_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
+                if r.status == 200:
+                    unresolved &= set((await r.json())["missing"])
+                    st.ring.mark_up(base)
+        except Exception:
+            st.ring.mark_down(base)
+    return sorted(unresolved)
 
 
 async def kv_get(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.kv_path(request.match_info["key"])
+    key = request.match_info["key"]
+    path = st.kv_path(key)
     if not path.is_file():
+        from urllib.parse import quote
+        proxied = await _proxy_fetch(request, key,
+                                     f"/kv/{quote(key, safe='/')}", kind="kv")
+        if proxied is not None:
+            return proxied
         return web.json_response({"error": "no such key"}, status=404)
     headers = {}
     meta = path.with_name(path.name + ".meta")
@@ -396,8 +887,12 @@ async def kv_get(request: web.Request) -> web.Response:
 
 async def kv_delete(request: web.Request) -> web.Response:
     st = _state(request)
-    path = st.kv_path(request.match_info["key"])
+    key = request.match_info["key"]
+    path = st.kv_path(key)
     existed = path.is_file()
+    from urllib.parse import quote
+    existed = await _fanout_delete(
+        request, f"/kv/{quote(key, safe='/')}") or existed
     meta = path.with_name(path.name + ".meta")
     # each unlink is independent and missing_ok: the meta must go even if
     # the data unlink races a concurrent delete, or a stale meta would
@@ -428,6 +923,28 @@ async def list_keys(request: web.Request) -> web.Response:
         key = durability.unescape_key(p.stem)
         if key.startswith(prefix):
             out.append({"key": key, "kind": "tree"})
+    if st.ring.multi and not _internal(request):
+        # `kt ls` against any node must see the whole ring's namespace
+        import aiohttp
+
+        seen = {(k["key"], k["kind"]) for k in out}
+        for base in st.ring.siblings():
+            try:
+                async with request.app["ring_http"].get(
+                        f"{base}/keys", params={"prefix": prefix},
+                        headers={REPLICATED_HEADER: "1"},
+                        timeout=aiohttp.ClientTimeout(
+                            total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
+                    if r.status != 200:
+                        continue
+                    st.ring.mark_up(base)
+                    for k in (await r.json()).get("keys", []):
+                        ident = (k.get("key"), k.get("kind"))
+                        if ident not in seen:
+                            seen.add(ident)
+                            out.append(k)
+            except Exception:
+                st.ring.mark_down(base)
     return web.json_response({"keys": sorted(out, key=lambda x: x["key"])})
 
 
@@ -643,6 +1160,13 @@ async def metrics(request: web.Request) -> web.Response:
         time.time() - request.app["started_at"])
     telemetry.gauge("kt_store_peers", "Registered P2P peers").set(
         len(st.peers))
+    telemetry.gauge("kt_store_ring_nodes",
+                    "Store-ring members in this node's view").set(
+        len(st.ring.nodes))
+    if st.ring.epoch is not None:
+        telemetry.gauge("kt_store_ring_epoch",
+                        "This node's ring membership epoch").set(
+            st.ring.epoch)
     return web.Response(body=telemetry.REGISTRY.render().encode(),
                         content_type="text/plain")
 
@@ -661,22 +1185,38 @@ async def debug_traces(request: web.Request) -> web.Response:
         limit=limit))
 
 
-def create_store_app(root: str) -> web.Application:
+def create_store_app(root: str,
+                     ring: Optional[RingState] = None) -> web.Application:
     # fault injection (KT_CHAOS, see kubetorch_tpu.chaos): lets tests prove
     # the data plane's retry/Retry-After behavior against a real store
     from ..chaos import maybe_chaos_middleware
     chaos_mw, chaos_engine = maybe_chaos_middleware()
     # trace middleware outermost so injected chaos faults annotate the
     # request's span (faults model the network, so chaos stays in front of
-    # all store logic)
+    # all store logic); the epoch check sits behind chaos — a stale router
+    # must be rejected by the same node a fault-injected one would be
     middlewares = [store_trace_middleware]
     if chaos_mw:
         middlewares.append(chaos_mw)
+    middlewares.append(ring_epoch_middleware)
     app = web.Application(client_max_size=MAX_BODY, middlewares=middlewares)
     app["chaos"] = chaos_engine
-    app["store"] = StoreState(root)
+    app["store"] = StoreState(root, ring=ring)
     app["started_at"] = time.time()
-    app["scrubber"] = scrub.Scrubber(app["store"].root)
+    app["scrubber"] = scrub.Scrubber(
+        app["store"].root, ring=app["store"].ring,
+        http=lambda: app.get("ring_http"))
+
+    async def _ring_client(app: web.Application):
+        # one pooled client session for all store↔store traffic
+        # (replication forwards, proxy reads, ring-wide diffs, re-repl)
+        import aiohttp
+
+        app["ring_http"] = aiohttp.ClientSession()
+        yield
+        await app["ring_http"].close()
+
+    app.cleanup_ctx.append(_ring_client)
 
     async def _scrub_loop(app: web.Application):
         task = None
@@ -700,6 +1240,8 @@ def create_store_app(root: str) -> web.Application:
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
     r.add_get("/debug/traces", debug_traces)
+    r.add_get("/ring", ring_get)
+    r.add_post("/ring", ring_post)
     r.add_put("/blob/{hash}", put_blob)
     r.add_get("/blob/{hash}", get_blob)
     r.add_post("/tree/{key:.+}/diff", tree_diff)
@@ -730,7 +1272,18 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=8873)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--root", default=os.environ.get("KT_STORE_ROOT", "/data"))
+    p.add_argument("--nodes", default=None,
+                   help="comma-separated ring member URLs (default: "
+                        "KT_STORE_NODES)")
+    p.add_argument("--self-url", default=None,
+                   help="this node's base URL within --nodes (default: "
+                        "KT_STORE_SELF_URL)")
     args = p.parse_args(argv)
+    # flags win over env, then _ring_from_env reads the merged view
+    if args.nodes is not None:
+        os.environ["KT_STORE_NODES"] = args.nodes
+    if args.self_url is not None:
+        os.environ["KT_STORE_SELF_URL"] = args.self_url
     web.run_app(create_store_app(args.root), host=args.host, port=args.port,
                 print=lambda *_: None)
 

@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Set
 import requests as _requests
 
 from ..exceptions import DataCorruptionError, SyncError
-from . import netpool
+from . import netpool, ring
 
 EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".mypy_cache",
                 "node_modules", ".venv", "venv", ".ktsync"}
@@ -128,21 +128,28 @@ def _save_hash_cache(root: str, cache: Dict[str, Dict]) -> None:
 
 def push_tree(store_url: str, key: str, root: str,
               session: Optional[_requests.Session] = None) -> Dict:
-    """Delta-push ``root`` to the store under ``key``; returns stats."""
+    """Delta-push ``root`` to the store under ``key``; returns stats.
+
+    Ring-aware: each blob is routed to ITS replica set (content hash =
+    ring key, so a multi-GB push fans out across every store NIC at
+    once), the manifest to the tree key's — and every request fails over
+    along the ring, so a store node dying mid-push costs a retry, not the
+    push."""
     base = store_url.rstrip("/")
+    rg = ring.ring_for(base)
     manifest = build_manifest(root)
 
-    def _req(method, url, **kw):
+    def _req(method, path, tree_key=None, **kw):
         # explicit session (tests) stays single-shot; default path rides the
         # resilient store wrapper (tree ops are content-addressed/idempotent)
         if session is not None:
-            return session.request(method, url,
+            return session.request(method, f"{base}{path}",
                                    timeout=netpool.store_timeout(60), **kw)
-        return netpool.request(method, url,
-                               timeout=netpool.store_timeout(60), **kw)
+        return rg.request(method, path, key=tree_key,
+                          timeout=netpool.store_timeout(60), **kw)
 
     try:
-        r = _req("POST", f"{base}/tree/{netpool.urlkey(key)}/diff",
+        r = _req("POST", f"/tree/{netpool.urlkey(key)}/diff", tree_key=key,
                  json={"files": manifest})
         r.raise_for_status()
         missing: List[str] = r.json()["missing"]
@@ -170,9 +177,9 @@ def push_tree(store_url: str, key: str, root: str,
                 return f
 
             try:
-                ru = netpool.request("PUT", f"{base}/blob/{h}",
-                                     data_factory=_body,
-                                     timeout=netpool.store_timeout())
+                ru = rg.request("PUT", f"/blob/{h}", key=h,
+                                data_factory=_body,
+                                timeout=netpool.store_timeout())
             finally:
                 while stack:
                     stack.pop().close()
@@ -181,7 +188,7 @@ def push_tree(store_url: str, key: str, root: str,
 
         uploaded_bytes = sum(netpool.map_concurrent(_upload, missing))
 
-        rc = _req("POST", f"{base}/tree/{netpool.urlkey(key)}/commit",
+        rc = _req("POST", f"/tree/{netpool.urlkey(key)}/commit", tree_key=key,
                   json={"files": manifest})
         rc.raise_for_status()
         return {"files": len(manifest), "uploaded": len(missing),
@@ -195,14 +202,14 @@ def pull_tree(store_url: str, key: str, dest: str,
               session: Optional[_requests.Session] = None) -> Dict:
     """Delta-pull ``key`` into ``dest``; only changed blobs are fetched."""
     base = store_url.rstrip("/")
+    rg = ring.ring_for(base)
     try:
         if session is not None:
             r = session.get(f"{base}/tree/{netpool.urlkey(key)}/manifest",
                             timeout=netpool.store_timeout(60))
         else:
-            r = netpool.request("GET",
-                                f"{base}/tree/{netpool.urlkey(key)}/manifest",
-                                timeout=netpool.store_timeout(60))
+            r = rg.request("GET", f"/tree/{netpool.urlkey(key)}/manifest",
+                           key=key, timeout=netpool.store_timeout(60))
         if r.status_code == 404:
             raise SyncError(f"No tree {key!r} in store")
         r.raise_for_status()
@@ -222,10 +229,9 @@ def pull_tree(store_url: str, key: str, dest: str,
                     continue
             to_fetch.append((rel, info))
 
-        def _download(item) -> None:
-            rel, info = item
-            target = os.path.join(dest, rel)
-            rb = netpool.request("GET", f"{base}/blob/{info['hash']}",
+        def _fetch_one(node_base: str, rel: str, info: Dict,
+                       target: str) -> None:
+            rb = netpool.request("GET", f"{node_base}/blob/{info['hash']}",
                                  timeout=netpool.store_timeout(),
                                  stream=True)
             rb.raise_for_status()
@@ -251,6 +257,30 @@ def pull_tree(store_url: str, key: str, dest: str,
                     source="store")
             os.chmod(tmp, info.get("mode", 0o644))
             os.replace(tmp, target)
+
+        def _download(item) -> None:
+            rel, info = item
+            target = os.path.join(dest, rel)
+            # the blob's replica set, in ring order: a node that dies (or
+            # rots) MID-STREAM surfaces here as a transport/corruption
+            # error, and the next replica covers it — the pull half of
+            # "node loss mid-transfer is absorbed, never surfaced"
+            bases = (rg.nodes_for(info["hash"]) if session is None
+                     else [base]) or [base]
+            for i, node_base in enumerate(bases):
+                try:
+                    _fetch_one(node_base, rel, info, target)
+                    rg.record_success(node_base)
+                    return
+                except _requests.RequestException:
+                    if i == len(bases) - 1:
+                        raise
+                    rg.record_failure(node_base)
+                    rg._failover("connect", node_base)
+                except DataCorruptionError:
+                    if i == len(bases) - 1:
+                        raise
+                    rg._failover("corruption", node_base)
 
         netpool.map_concurrent(_download, to_fetch)
         fetched = len(to_fetch)

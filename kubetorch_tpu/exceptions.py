@@ -125,6 +125,27 @@ class StoreFullError(DataStoreError):
         self.path = path
 
 
+class RingEpochMismatch(DataStoreError):
+    """The client's view of the store ring is stale (HTTP 409).
+
+    Every data-plane request carries the ``X-KT-Ring-Epoch`` the client
+    routed with; a store node whose membership epoch moved on rejects the
+    request *before* touching disk, because a stale router may have hashed
+    the key onto the wrong replica set. Retryable by design: the client
+    refreshes the ring from ``/ring`` and re-routes — ``ring.request``
+    absorbs the whole cycle transparently, so callers only ever see this
+    when refresh itself keeps failing. ``expected`` is the server's epoch,
+    ``actual`` the stale one the client sent.
+    """
+
+    def __init__(self, message: str = "store ring epoch mismatch",
+                 expected: Optional[int] = None,
+                 actual: Optional[int] = None):
+        super().__init__(message)
+        self.expected = expected
+        self.actual = actual
+
+
 class DataCorruptionError(DataStoreError):
     """Fetched bytes do not match their content address.
 
@@ -350,6 +371,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         SerializationError,
         DataStoreError,
         StoreFullError,
+        RingEpochMismatch,
         DataCorruptionError,
         DebuggerError,
         DeadlineExceededError,
@@ -368,6 +390,7 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "TpuSliceUnavailableError": ["accelerator", "topology"],
     "ControllerRequestError": ["status_code"],
     "StoreFullError": ["path"],
+    "RingEpochMismatch": ["expected", "actual"],
     "DataCorruptionError": ["key", "expected", "actual", "source"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],

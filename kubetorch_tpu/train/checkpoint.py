@@ -189,14 +189,25 @@ def commit_info(base_key: str, store_url: Optional[str] = None
     name and assumes immutable keys, while the marker and slot keys are
     *deliberately* re-put in place — a cached stale marker would resume an
     older step than the one committed. Checkpoint reads always hit the
-    origin store (whose own integrity layer hash-verifies every byte)."""
+    origin store (whose own integrity layer hash-verifies every byte).
+
+    On a store ring the marker is read at **quorum**: every member of its
+    replica set answers strictly locally and the newest copy wins, so a
+    replica that was dead during the last commit (and is stale now) can
+    never roll a resume back to an older step. Markers written by
+    pre-ring builds (a tiny pytree rather than a JSON value) still load
+    via the legacy fallback."""
+    marker = ds.get_json(_marker_key(base_key), store_url=store_url,
+                         quorum=True)
+    if marker is None:
+        # legacy pytree marker (pre-ring checkpoints)
+        try:
+            marker = ds.get(_marker_key(base_key), store_url=store_url,
+                            peer=False)
+        except DataStoreError:
+            return None
     try:
-        tree = ds.get(_marker_key(base_key), store_url=store_url,
-                      peer=False)
-    except DataStoreError:
-        return None
-    try:
-        return {"step": int(tree["step"]), "slot": int(tree["slot"])}
+        return {"step": int(marker["step"]), "slot": int(marker["slot"])}
     except (KeyError, TypeError, ValueError):
         return None               # unreadable marker == no commit
 
@@ -247,8 +258,6 @@ class Checkpointer:
         return self._save_host(host, step)
 
     def _save_host(self, host: Any, step: int) -> Dict[str, Any]:
-        import numpy as np
-
         target = 1 - self._slot if self._slot is not None else 0
         t0 = time.monotonic()
         with telemetry.span("checkpoint.save", key=self.base_key,
@@ -257,10 +266,11 @@ class Checkpointer:
                            store_url=self.store_url)
             # marker LAST: this PUT is the commit point. Anything torn
             # before here leaves the old marker pointing at the old slot.
-            ds.put(_marker_key(self.base_key),
-                   {"step": np.asarray(step, np.int64),
-                    "slot": np.asarray(target, np.int64)},
-                   store_url=self.store_url)
+            # One kv key (not a pytree) so the ring's write-quorum forward
+            # and commit_info's quorum read both see the marker atomically.
+            ds.put_json(_marker_key(self.base_key),
+                        {"step": int(step), "slot": int(target)},
+                        store_url=self.store_url)
             if sp:
                 sp.set_attr("bytes", stats.get("bytes"))
                 sp.set_attr("skipped", stats.get("skipped"))

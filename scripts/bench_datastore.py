@@ -313,6 +313,76 @@ def bench_checkpoint(leaves: int, mb_per_leaf: float,
     return out
 
 
+def bench_fleet(leaves: int, mb_per_leaf: float, max_nodes: int = 3,
+                reps: int = 3) -> dict:
+    """Store-fleet regime (ISSUE 7 / ``make bench-fleet``): cold and delta
+    sync MB/s vs ring size (1/2/.../N nodes, R=2 W=2).
+
+    Each size gets its own subprocess fleet; the client routes per-leaf
+    via ``KT_STORE_NODES``. The number under test: cold-put throughput
+    should HOLD (or grow, once client and nodes stop sharing cores) as
+    nodes are added even though every byte is written twice (W=2), because
+    leaves hash across every node's disk/NIC instead of one origin's —
+    and the delta path must stay ~free at any fleet size."""
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.data_store import ring as ring_mod
+    from tests.assets.store_fleet import SubprocessStoreFleet
+
+    tree = _make_tree(leaves, mb_per_leaf, seed=5)
+    total_mb = leaves * mb_per_leaf
+    out = {"leaves": leaves, "mb_per_leaf": mb_per_leaf,
+           "total_mb": total_mb, "reps": reps, "replication": 2,
+           "write_quorum": 2, "fleets": [],
+           "host_cpus": len(os.sched_getaffinity(0))
+           if hasattr(os, "sched_getaffinity") else os.cpu_count()}
+    saved = {k: os.environ.get(k) for k in
+             ("KT_STORE_NODES", "KT_STORE_REPLICATION",
+              "KT_STORE_WRITE_QUORUM", "KT_STORE_NODE_TTL_S")}
+    try:
+        for n in range(1, max_nodes + 1):
+            with tempfile.TemporaryDirectory(prefix=f"kt-bench-fleet{n}-",
+                                             dir=_bench_root()) as root:
+                with SubprocessStoreFleet(root, n=n,
+                                          replication=min(2, n),
+                                          write_quorum=min(2, n)) as fleet:
+                    for k, v in fleet.client_env().items():
+                        os.environ[k] = v
+                    ring_mod.reset_rings()
+                    url = fleet.urls[0]
+                    ds.put("bench/fleet/warm",
+                           {"w": tree["layers"]["w000"]}, store_url=url)
+                    best_put = best_get = float("inf")
+                    for rep in range(reps):
+                        key = f"bench/fleet/{n}/{rep}"      # cold puts
+                        stats, t = _timed(
+                            lambda k=key: ds.put(k, tree, store_url=url))
+                        best_put = min(best_put, t)
+                        _, t = _timed(
+                            lambda k=key: ds.get(k, store_url=url))
+                        best_get = min(best_get, t)
+                    dstats, delta_s = _timed(lambda: ds.put(
+                        f"bench/fleet/{n}/0", tree, store_url=url))
+                    out["fleets"].append({
+                        "nodes": n,
+                        "put_s": round(best_put, 3),
+                        "get_s": round(best_get, 3),
+                        "put_mb_s": round(total_mb / best_put, 1),
+                        "get_mb_s": round(total_mb / best_get, 1),
+                        "delta_put_s": round(delta_s, 3),
+                        "delta_uploaded_bytes": dstats["bytes"],
+                        "delta_skipped": dstats["skipped"],
+                    })
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from kubetorch_tpu.data_store import ring as ring_mod2
+        ring_mod2.reset_rings()
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--leaves", type=int, default=64)
@@ -327,9 +397,36 @@ def main() -> None:
     p.add_argument("--checkpoint", action="store_true",
                    help="run ONLY the checkpoint regime (`make bench-ckpt`):"
                         " committed-save cost vs bytes-changed fraction")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="run ONLY the store-fleet regime (`make "
+                        "bench-fleet`): cold + delta sync MB/s vs ring "
+                        "size 1..N (R=2, W=2)")
     p.add_argument("--reps", type=int, default=5,
                    help="trace-overhead regime repetitions (best-of)")
     args = p.parse_args()
+
+    if args.fleet:
+        r = bench_fleet(args.leaves, args.mb_per_leaf,
+                        max_nodes=args.fleet)
+        print(f"\nstore-fleet regime: {r['leaves']} leaves x "
+              f"{r['mb_per_leaf']} MB = {r['total_mb']:.0f} MB, "
+              f"R={r['replication']} W={r['write_quorum']}, "
+              f"best of {r['reps']}")
+        print(f"{'nodes':>6} {'put MB/s':>10} {'get MB/s':>10} "
+              f"{'delta s':>8} {'delta bytes':>12} {'skipped':>8}")
+        for row in r["fleets"]:
+            print(f"{row['nodes']:>6} {row['put_mb_s']:>10} "
+                  f"{row['get_mb_s']:>10} {row['delta_put_s']:>8} "
+                  f"{row['delta_uploaded_bytes']:>12} "
+                  f"{row['delta_skipped']:>8}")
+        if r["host_cpus"] <= max(f["nodes"] for f in r["fleets"]):
+            print("NOTE: client + all store nodes share "
+                  f"{r['host_cpus']} CPU(s) here, so multi-node wall-clock "
+                  "cannot beat single-node locally; the regime still "
+                  "tracks the W=2 replication tax and the fleet-size-"
+                  "independent delta path.")
+        print("\n" + json.dumps(r))
+        return
 
     if args.checkpoint:
         r = bench_checkpoint(args.leaves, args.mb_per_leaf)

@@ -72,24 +72,33 @@ CALL_RE = re.compile(
     r"\.(?:get|post|put|delete|head|request)\(")
 
 # Files that ARE the resilience layer (their raw calls implement the
-# wrappers everyone else must use).
-WRAPPER_FILES = {"resilience.py", "netpool.py"}
+# wrappers everyone else must use). ring.py is the store-fleet router:
+# its raw calls are the /ring refresh + reachability probes the routed
+# request wrapper itself is built from.
+WRAPPER_FILES = {"resilience.py", "netpool.py", "ring.py"}
 
 # path (relative to kubetorch_tpu/) → max allowed raw call sites, each one a
 # deliberate exception:
 BASELINE = {
     # session probe + port-forward health check + the `kt trace` debug
-    # fetch — all single-shot by design (a doctor/debug command that
-    # retried would hang the very diagnosis it exists for)
-    "cli.py": 2,
+    # fetch + the `kt store status` /ring + /scrub/status probes — all
+    # single-shot by design (a doctor/debug command that retried would
+    # hang or hide the very flakiness it exists to diagnose)
+    "cli.py": 4,
     # daemon-liveness probes in _read_running_local (must not retry: they
     # decide whether to SPAWN a controller) + _request's internals
     "client.py": 4,
-    # _tunnel_fallback reachability probes (a probe that retries would stall
-    # every store op behind an unreachable direct URL) + fetcher internals
-    # (peer polling has its own no-progress window; retry would fight it)
-    "data_store/commands.py": 4,
+    # explicit-session test escape hatches in _kv_put/_store_request (the
+    # injected session stays single-shot so stubs observe exactly one
+    # request); the _tunnel_fallback probes moved to ring.py with origin
+    # resolution
+    "data_store/commands.py": 2,
     "data_store/sync.py": 2,      # explicit-session test escape hatches
+    # the re-replication sweep's sibling probe/HEAD/push (aiohttp, inside
+    # the store's own event loop): each object is re-attempted every
+    # sweep, so per-request retries would only serialize the sweep behind
+    # a dead node's timeouts
+    "data_store/scrub.py": 3,
     # best-effort telemetry pumps (metrics/log streaming — loss is benign)
     # + the retry loop's own attempt calls
     "serving/http_client.py": 8,
@@ -142,6 +151,16 @@ CKPT_WRITE_RE = re.compile(
     r"\b(?:ds|commands|kt)\s*\.\s*put\(|\b_kv_put\(")
 CKPT_EXEMPT = {"checkpoint.py"}
 CKPT_BASELINE: dict = {}
+
+# Raw single-origin store-URL building in data_store/ outside the ring
+# router (ISSUE 7). ring.py owns origin/fleet resolution: a call site that
+# reads config().data_store_url / KT_DATA_STORE_URL itself produces a
+# single-origin URL that silently opts out of replica routing, failover,
+# and ring-epoch safety — every store op must resolve its origin through
+# ring.resolve_origin/ring_for. The baseline is EMPTY on purpose.
+ORIGIN_RE = re.compile(r"data_store_url|KT_DATA_STORE_URL")
+ORIGIN_EXEMPT = {"ring.py"}
+ORIGIN_BASELINE: dict = {}
 
 REPLACE_RE = re.compile(r"\bos\.replace\(")
 REPLACE_EXEMPT = {"durability.py"}
@@ -241,6 +260,29 @@ def main() -> int:
               "justification.")
         return 1
 
+    origin_failures = []
+    origin_counts = {}
+    for path in sorted((PKG / "data_store").rglob("*.py")):
+        if path.name in ORIGIN_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, ORIGIN_RE)
+        if n:
+            origin_counts[rel] = n
+        allowed = ORIGIN_BASELINE.get(rel, 0)
+        if n > allowed:
+            origin_failures.append(
+                f"  {rel}: {n} raw store-origin resolution site(s), "
+                f"baseline allows {allowed}")
+    if origin_failures:
+        print("check_resilience: raw single-origin store URLs bypass the "
+              "ring router:\n" + "\n".join(origin_failures))
+        print("\nResolve store origins through data_store/ring.py "
+              "(resolve_origin/ring_for) so every op gets replica routing, "
+              "failover, and ring-epoch validation. For deliberate "
+              "exceptions update ORIGIN_BASELINE with a justification.")
+        return 1
+
     ckpt_failures = []
     ckpt_counts = {}
     for path in sorted((PKG / "train").rglob("*.py")):
@@ -302,6 +344,8 @@ def main() -> int:
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
         + [f for f, allowed in ALIVE_BASELINE.items()
            if alive_counts.get(f, 0) < allowed]
+        + [f for f, allowed in ORIGIN_BASELINE.items()
+           if origin_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
            if replace_counts.get(f, 0) < allowed]
         + [f for f, allowed in CKPT_BASELINE.items()
@@ -315,8 +359,8 @@ def main() -> int:
               + ", ".join(stale) + ")")
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
-              "checks, data-store commit renames, checkpoint writes, and "
-              "telemetry sites accounted for")
+              "checks, store-origin resolutions, data-store commit renames, "
+              "checkpoint writes, and telemetry sites accounted for")
     return 0
 
 

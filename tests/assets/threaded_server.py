@@ -18,8 +18,12 @@ class ThreadedAiohttpServer:
     construction sees the right event loop and current env) at ``srv.url``;
     the built app is at ``srv.app`` for state assertions."""
 
-    def __init__(self, app_factory):
+    def __init__(self, app_factory, port: int = 0):
         self._app_factory = app_factory
+        self._bind_port = port          # 0 → ephemeral (the default);
+        #                                 fixed ports let a store FLEET know
+        #                                 its members' URLs before any of
+        #                                 them is actually listening
         self._loop = None
         self._runner = None
         self._thread = None
@@ -41,7 +45,7 @@ class ThreadedAiohttpServer:
                 self.app = self._app_factory()
                 self._runner = web.AppRunner(self.app)
                 await self._runner.setup()
-                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                site = web.TCPSite(self._runner, "127.0.0.1", self._bind_port)
                 await site.start()
                 self.port = site._server.sockets[0].getsockname()[1]
 

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic lint bench bench-store bench-trace bench-ckpt bench-fleet smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched lint bench bench-store bench-trace bench-ckpt bench-fleet smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -39,6 +39,12 @@ test-ring:
 # window; commit-marker torn-upload safety; split restart budgets
 test-elastic:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m elastic
+
+# scheduler suite (ISSUE 8): priority tiers, capacity book, preemption via
+# the drain path, checkpoint-commit inside the grace window, transparent
+# resume with zero lost committed steps, scheduler-state durability
+test-sched:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m sched
 
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:

@@ -382,6 +382,37 @@ def rank_term_plan(spec: Optional[str] = None) -> Dict[int, float]:
             for f in _rank_faults("term-rank", spec)}
 
 
+def deliver_term_with_grace(pid: int, grace_s: float,
+                            label: str = "") -> None:
+    """The GKE preemption contract, delivered to ``pid``: SIGTERM now (a kt
+    rank's drain handler flips the cooperative flag so the in-flight step
+    can flush a committed checkpoint), SIGKILL ``grace_s`` seconds later if
+    the process is still alive. The timer thread is a daemon and dies with
+    a clean exit, so a process that drains inside the window is never
+    force-killed.
+
+    One implementation for every sender of the contract: the ``term-rank``
+    chaos verb (a rank self-delivering it), scheduler-driven preemption
+    tests (an external sender), and anything else that needs "graceful,
+    then hard" semantics."""
+    if label:
+        print(f"[kt] chaos: term grace={grace_s:g}s {label}")
+
+    def _kill():
+        try:
+            os.kill(pid, signal_mod.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass                       # drained and exited inside the window
+
+    timer = threading.Timer(grace_s, _kill)
+    timer.daemon = True
+    timer.start()
+    try:
+        os.kill(pid, signal_mod.SIGTERM)
+    except ProcessLookupError:
+        timer.cancel()
+
+
 def _store_target(request):
     """On-disk file behind this request, when the app is a store server
     (``request.app["store"]`` duck-types ``path_for_request``). None on

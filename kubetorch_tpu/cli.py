@@ -740,6 +740,54 @@ def store_status(url, as_json):
 
 
 @cli.group()
+def queue():
+    """Scheduler queue management (priorities & preemption)."""
+
+
+@queue.command("status")
+@click.option("--json", "as_json", is_flag=True, help="Raw scheduler state.")
+def queue_status(as_json):
+    """Tiers, queue depth/order, the capacity book, and recent
+    preemptions — the controller scheduler's ``/controller/queue`` view."""
+    from .client import controller_client
+
+    snap = controller_client().queue_status()
+    if as_json:
+        click.echo(json.dumps(snap, indent=2, default=str))
+        return
+    cap = snap.get("capacity") or {}
+    click.echo(f"policy: {snap.get('policy')}"
+               f"  ·  capacity book: "
+               f"{'limited' if cap.get('limited') else 'unlimited'}")
+    for cls, row in sorted((cap.get("classes") or {}).items()):
+        total = row.get("capacity")
+        click.echo(f"  {cls:<8} used={row.get('used', 0)}"
+                   f" free={'∞' if row.get('free') is None else row['free']}"
+                   f"{'' if total is None else f' of {total}'}")
+    allocs = cap.get("allocations") or {}
+    if allocs:
+        click.echo(f"running ({len(allocs)}):")
+        for key, a in sorted(allocs.items()):
+            click.echo(f"  {key:<36} {a.get('device_class')}×{a.get('width')}"
+                       f"  tier={a.get('tier')} prio={a.get('priority')}")
+    q = snap.get("queue") or []
+    click.echo(f"queue ({len(q)}):" if q else "queue: empty")
+    for e in q:
+        flag = " (preempted, resume pending)" if e.get("preempted") else ""
+        click.echo(f"  #{e.get('position')} {e.get('key'):<30} "
+                   f"tier={e.get('tier')} prio={e.get('priority')} "
+                   f"{e.get('device_class')}×{e.get('width')} "
+                   f"waited={e.get('waiting_s')}s{flag}")
+    ledger = snap.get("ledger") or []
+    if ledger:
+        click.echo(f"recent preemptions ({len(ledger)}):")
+        for led in ledger:
+            click.echo(f"  {led.get('victim'):<30} by {led.get('preemptor')}"
+                       f"  phase={led.get('phase')}"
+                       f" grace={led.get('grace_s')}s")
+
+
+@cli.group()
 def controller():
     """Controller management."""
 

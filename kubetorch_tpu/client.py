@@ -106,13 +106,15 @@ class ControllerClient:
                inactivity_ttl: Optional[int] = None,
                expected_pods: Optional[int] = None,
                autoscaling: Optional[Dict] = None,
+               scheduling: Optional[Dict] = None,
                service_url: Optional[str] = None,
                timeout: float = 900.0) -> Dict:
         return self._request("POST", "/controller/deploy", timeout=timeout, json={
             "namespace": namespace, "name": name, "manifest": manifest,
             "metadata": metadata, "launch_id": launch_id,
             "inactivity_ttl": inactivity_ttl, "expected_pods": expected_pods,
-            "autoscaling": autoscaling, "service_url": service_url,
+            "autoscaling": autoscaling, "scheduling": scheduling,
+            "service_url": service_url,
         })
 
     def apply(self, namespace: str, name: str, manifest: Dict,
@@ -143,6 +145,11 @@ class ControllerClient:
 
     def check_ready(self, namespace: str, name: str) -> Dict:
         return self._request("GET", f"/controller/check-ready/{namespace}/{name}")
+
+    def queue_status(self) -> Dict:
+        """Scheduler snapshot (ISSUE 8): tiers + queue order, the capacity
+        book, and the recent preemption ledger (``kt queue status``)."""
+        return self._request("GET", "/controller/queue")
 
     # -- config objects (Secret / PVC / ConfigMap) ----------------------------
 

@@ -86,6 +86,15 @@ class KTConfig:
     store_replication: int = 2
     store_write_quorum: int = 2
     store_node_ttl_s: float = 30.0
+    # preemptive scheduling (controller/scheduler.py). Same env layering
+    # (KT_SCHED_CAPACITY / KT_SCHED_POLICY / KT_SCHED_DRAIN_GRACE_S).
+    # sched_capacity="" leaves the capacity book unlimited — the scheduler
+    # is pass-through until an operator declares per-device-class slots
+    # (e.g. "cpu=8,v5e=16"); sched_drain_grace_s is the SIGTERM→eviction
+    # window a preempted workload gets to flush its checkpoint.
+    sched_capacity: str = ""
+    sched_policy: str = "fifo-priority"
+    sched_drain_grace_s: float = 20.0
     # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
     # recording everywhere (the fast path stays allocation-free, see `make
     # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing

@@ -22,3 +22,5 @@ via kubectl and is the production path on GKE TPU node pools.
 """
 
 from .app import create_controller_app, ControllerState
+from .scheduler import (CapacityBook, Scheduler, SchedulingPolicy,
+                        parse_priority, tier_of)

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import time
 import uuid
@@ -49,13 +50,26 @@ class ControllerState:
         self.cluster_config: Dict[str, Any] = {}
         self._ttl_task: Optional[asyncio.Task] = None
         self._apply_locks: Dict[str, asyncio.Lock] = {}
+        self.scheduler = None
         self.persister = None
         if state_dir:
             from .persistence import DiskPersister
             self.persister = DiskPersister(state_dir)
 
+    def sched(self):
+        """The scheduling layer (ISSUE 8) — every placement/scale/release
+        in this process routes through it (``scripts/check_resilience.py``
+        lints direct backend-apply call sites). Lazily constructed so
+        unit tests touching ``ControllerState`` alone never pay for it."""
+        if self.scheduler is None:
+            from .scheduler import Scheduler
+            self.scheduler = Scheduler(self)
+            if self.persister is not None:
+                self.scheduler.restore(self.persister.load_scheduler_state())
+        return self.scheduler
+
     def apply_lock(self, service_key: str) -> asyncio.Lock:
-        """Per-service lock serializing ``backend.apply`` — a held cold-start
+        """Per-service lock serializing backend applies — a held cold-start
         request and an autoscale tick (or two simultaneous cold starts) must
         not double-spawn pods; LocalBackend.apply itself is not thread-safe."""
         return self._apply_locks.setdefault(service_key, asyncio.Lock())
@@ -86,7 +100,10 @@ class ControllerState:
                 # that are still alive — keep their addresses
                 record.pop("pod_ips", None)
                 record.pop("service_url", None)
-                record["status"] = "restored"
+                if record.get("status") not in ("queued", "preempted"):
+                    # those two wait on the SCHEDULER (durable queue), not
+                    # on the proxy's revival path — keep them distinguishable
+                    record["status"] = "restored"
             self.workloads[key] = record
         for service_key, entries in self.persister.load_logs():
             buf = self.logs.setdefault(
@@ -212,6 +229,7 @@ async def deploy(request: web.Request) -> web.Response:
             "inactivity_ttl": body.get("inactivity_ttl"),
             "expected_pods": body.get("expected_pods"),
             "autoscaling": body.get("autoscaling"),
+            "scheduling": body.get("scheduling"),
         }
         if record["autoscaling"] and isinstance(state.backend, LocalBackend):
             # the local analog of Knative's initial scale: boot with
@@ -232,15 +250,28 @@ async def deploy(request: web.Request) -> web.Response:
             record["_scaled_at"] = time.time()
 
         env = _metadata_env(record)
-        async with state.apply_lock(key):
-            apply_result = await asyncio.to_thread(
-                state.backend.apply, namespace, name, manifest, env)
-            record.update(apply_result)
-            if body.get("service_url"):
-                # custom Endpoint(url=...): route calls to the user's own
-                # Service/Ingress instead of the backend-derived address
-                record["service_url"] = body["service_url"]
-            state.workloads[key] = record
+        # the workload record must exist BEFORE admission: a queued deploy
+        # has no pods yet but `kt queue status` / check-ready must see it
+        state.workloads[key] = record
+        try:
+            apply_result = await state.sched().submit(record, manifest, env)
+        except Exception:
+            if existing is None:     # failed fresh deploy leaves no record
+                state.workloads.pop(key, None)
+            raise
+        if apply_result.get("queued"):
+            await state.persist_workload(record)
+            state.record_event(key, f"deploy queued launch_id={launch_id}")
+            return web.json_response({
+                "ok": True, "launch_id": launch_id, "queued": True,
+                "position": apply_result.get("position"),
+                "tier": apply_result.get("tier"),
+            })
+        record.update(apply_result)
+        if body.get("service_url"):
+            # custom Endpoint(url=...): route calls to the user's own
+            # Service/Ingress instead of the backend-derived address
+            record["service_url"] = body["service_url"]
         await state.persist_workload(record)
         state.record_event(key, f"deployed launch_id={launch_id}")
 
@@ -457,6 +488,9 @@ async def delete_workload(request: web.Request) -> web.Response:
     kind = (((record or {}).get("manifest") or {}).get("kind"))
     deleted = await asyncio.to_thread(state.backend.delete, ns, name, kind)
     state.forget_workload(ns, name)
+    # free the capacity-book slots and drain the admission queue into them
+    # (a preempted batch job resumes the moment its preemptor is deleted)
+    await state.sched().release(ns, name)
     state.record_event(key, "deleted")
     return web.json_response({"ok": True, "existed": record is not None or deleted})
 
@@ -514,6 +548,16 @@ async def check_ready(request: web.Request) -> web.Response:
                           if e["service"] == key
                           and e["message"].startswith("[k8s]")
                           and float(e.get("ts") or 0.0) >= since][-10:]}
+    if record.get("status") in ("queued", "preempted"):
+        # waiting on capacity, not on pods: tell the client WHY it isn't
+        # ready (and where it sits) instead of letting it stare at 0 pods
+        entry = next((e for e in state.sched().snapshot()["queue"]
+                      if e["key"] == key), None)
+        payload["scheduling"] = {
+            "status": record["status"],
+            "position": entry.get("position") if entry else None,
+            "tier": entry.get("tier") if entry else None,
+        }
     if ready:
         # the launch made it: a fatal mark (e.g. one autoscale-up pod hit
         # ImagePullBackOff after the service was already serving) must not
@@ -529,6 +573,40 @@ async def check_ready(request: web.Request) -> web.Response:
 async def cluster_config(request: web.Request) -> web.Response:
     state: ControllerState = request.app["cstate"]
     return web.json_response(state.cluster_config)
+
+
+async def queue_status(request: web.Request) -> web.Response:
+    """Scheduler surface (ISSUE 8): tiers, queue depth/order, the capacity
+    book, and the recent preemption ledger — what ``kt queue status``
+    renders."""
+    state: ControllerState = request.app["cstate"]
+    return web.json_response(state.sched().snapshot())
+
+
+async def controller_metrics(request: web.Request) -> web.Response:
+    """Prometheus exposition for the controller process itself:
+    ``kt_preemptions_total``, ``kt_sched_queue_wait_seconds``, queue depth
+    — the pod/store servers already expose /metrics; the scheduler made
+    the control plane worth scraping too."""
+    from .. import telemetry
+    return web.Response(text=telemetry.REGISTRY.render(),
+                        content_type="text/plain")
+
+
+async def controller_traces(request: web.Request) -> web.Response:
+    """``/debug/traces?q=<id>`` — the controller's flight-recorder ring,
+    so ``kt trace --url <controller>`` shows sched.preempt/sched.resume
+    spans (same shape as the pod server's endpoint)."""
+    from .. import telemetry
+    limit = None
+    try:
+        if request.query.get("limit"):
+            limit = max(1, int(request.query["limit"]))
+    except ValueError:
+        return web.json_response({"error": "bad limit"}, status=400)
+    return web.json_response(telemetry.debug_traces_payload(
+        request.query.get("q") or request.query.get("request_id"),
+        limit=limit))
 
 
 async def version(request: web.Request) -> web.Response:
@@ -659,8 +737,6 @@ async def proxy_service(request: web.Request) -> web.Response:
     """Route ``/{ns}/{service}:{port}/{path}`` into the cluster (reference
     nginx config: the single port-forward target for laptops). In local mode
     this resolves against the backend's pod IPs."""
-    import aiohttp
-
     state: ControllerState = request.app["cstate"]
     ns = request.match_info["ns"]
     svc_port = request.match_info["svc_port"]
@@ -676,7 +752,8 @@ async def proxy_service(request: web.Request) -> web.Response:
                  and (record.get("autoscaling")
                       or (record.get("manifest")
                           and isinstance(state.backend, LocalBackend))))
-    if not ips and revivable:
+
+    async def _cold_start() -> List[str]:
         # Two cases share this path: scale-to-zero cold start (the Knative
         # activator role) and revival of a workload restored from disk after
         # a controller restart — local pods died with the old process, so
@@ -690,41 +767,73 @@ async def proxy_service(request: web.Request) -> web.Response:
             replicas = max(int(record.get("expected_pods")
                                or (record.get("manifest") or {})
                                .get("spec", {}).get("replicas", 1)), 1)
-        try:
-            record["_coldstart_pin_until"] = time.time() + 30.0
-            await _scale_to(state, record, replicas, "cold start")
-            record.pop("status", None)   # no longer "restored"
-            ips = await _wait_for_serving_pod(state, ns, service, record)
-        except Exception as e:  # noqa: BLE001
-            return web.json_response(
-                {"error": f"cold start of {ns}/{service} failed: {e}"},
-                status=503)
-    resolved = state.resolve_service_url(ns, service)
-    pod_ip = request.headers.get("X-KT-Pod-IP")
-    if pod_ip:
-        # pod-targeted routing (Compute.run_bash / pip_install fan out to
-        # EACH pod, not the service load-balancer); restrict to known pods
-        # so the proxy cannot be aimed at arbitrary addresses, and pin the
-        # port to the pod's registered server port — honoring the URL port
-        # here would let any client probe arbitrary ports on pod IPs
-        if pod_ip not in ips:
-            return web.json_response(
-                {"error": f"pod {pod_ip} is not a pod of {ns}/{service}"},
-                status=404)
-        pod_port = getattr(state.backend, "server_port", DEFAULT_SERVER_PORT)
-        for conn in state.connections(ns, service):
-            if conn.info.get("pod_ip") == pod_ip:
-                pod_port = conn.info.get("server_port", DEFAULT_SERVER_PORT)
-                break
-        target = f"http://{pod_ip}:{pod_port}"
-    elif not ips and resolved:
-        target = resolved.rstrip("/")
-    elif ips:
-        target = f"http://{ips[0]}:{port}"
-    else:
-        target = f"http://{service}.{ns}.svc.cluster.local:{port}"
+        record["_coldstart_pin_until"] = time.time() + 30.0
+        await _scale_to(state, record, replicas, "cold start")
+        record.pop("status", None)   # no longer "restored"
+        return await _wait_for_serving_pod(state, ns, service, record)
 
-    return await _relay(request, f"{target}/{path}", error_label="proxy")
+    # The in-flight refcount is the autoscaler's HARD pin: unlike the
+    # timed _coldstart_pin_until (which can lapse while a slow relay is
+    # still streaming), a held/forwarding request provably exists for
+    # exactly the lifetime of this counter, so scale-down can never reap
+    # the pod out from under it (the cold-start flake's root cause).
+    if record is not None:
+        record["_activator_inflight"] = \
+            record.get("_activator_inflight", 0) + 1
+    try:
+        if not ips and revivable:
+            try:
+                ips = await _cold_start()
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"cold start of {ns}/{service} failed: {e}"},
+                    status=503)
+        resolved = state.resolve_service_url(ns, service)
+        pod_ip = request.headers.get("X-KT-Pod-IP")
+        retry_target = None
+        if pod_ip:
+            # pod-targeted routing (Compute.run_bash / pip_install fan out to
+            # EACH pod, not the service load-balancer); restrict to known pods
+            # so the proxy cannot be aimed at arbitrary addresses, and pin the
+            # port to the pod's registered server port — honoring the URL port
+            # here would let any client probe arbitrary ports on pod IPs
+            if pod_ip not in ips:
+                return web.json_response(
+                    {"error": f"pod {pod_ip} is not a pod of {ns}/{service}"},
+                    status=404)
+            pod_port = getattr(state.backend, "server_port",
+                               DEFAULT_SERVER_PORT)
+            for conn in state.connections(ns, service):
+                if conn.info.get("pod_ip") == pod_ip:
+                    pod_port = conn.info.get("server_port",
+                                             DEFAULT_SERVER_PORT)
+                    break
+            target = f"http://{pod_ip}:{pod_port}"
+        elif not ips and resolved:
+            target = resolved.rstrip("/")
+        elif ips:
+            target = f"http://{ips[0]}:{port}"
+        else:
+            target = f"http://{service}.{ns}.svc.cluster.local:{port}"
+
+        if pod_ip is None and revivable:
+            # the proxy resolved a pod that scale-to-zero may be killing
+            # RIGHT NOW (pod_ips raced the autoscaler's apply): when the
+            # connection is never established, revive through the cold-start
+            # path and retry once instead of bubbling a 502 to the client
+            async def retry_target(exc):  # noqa: F811
+                try:
+                    fresh = await _cold_start()
+                except Exception:  # noqa: BLE001
+                    return None
+                return f"http://{fresh[0]}:{port}/{path}" if fresh else None
+
+        return await _relay(request, f"{target}/{path}", error_label="proxy",
+                            retry_target=retry_target)
+    finally:
+        if record is not None:
+            record["_activator_inflight"] = \
+                max(0, record.get("_activator_inflight", 1) - 1)
 
 
 # strip hop-by-hop headers: the body is re-framed, so forwarding
@@ -739,11 +848,18 @@ _RELAY_RESP_HEADERS = ("content-type", "x-serialization", "x-request-id",
 
 
 async def _relay(request: web.Request, url: str,
-                 error_label: str) -> web.StreamResponse:
+                 error_label: str,
+                 retry_target=None) -> web.StreamResponse:
     """The ONE buffered-header/streamed-body relay behind both the service
     proxy and the store tunnel. Bodies STREAM in 1MiB chunks — a multi-GB
     checkpoint riding the tunnel must not be held in controller memory
-    (roughly 2x the blob, an OOM of the whole control plane)."""
+    (roughly 2x the blob, an OOM of the whole control plane).
+
+    ``retry_target`` (async ``exc → url | None``) is consulted exactly once
+    when the connection was NEVER established (``ClientConnectorError`` —
+    the request body is provably unread, so a replay is safe even for
+    POSTs): the proxy uses it to cold-start a service whose last pod was
+    reaped between pod-IP resolution and connect."""
     import aiohttp
 
     headers = {k: v for k, v in request.headers.items()
@@ -755,6 +871,12 @@ async def _relay(request: web.Request, url: str,
             data=request.content if request.can_read_body else None,
             headers=headers, params=request.query,
             timeout=aiohttp.ClientTimeout(total=600))
+    except aiohttp.ClientConnectorError as e:
+        new_url = await retry_target(e) if retry_target is not None else None
+        if new_url is not None:
+            return await _relay(request, new_url, error_label)
+        return web.json_response({"error": f"{error_label} to {url} "
+                                           f"failed: {e}"}, status=502)
     except (aiohttp.ClientError, asyncio.TimeoutError) as e:
         return web.json_response({"error": f"{error_label} to {url} "
                                            f"failed: {e}"}, status=502)
@@ -864,20 +986,49 @@ AUTOSCALE_INTERVAL_S = float(os.environ.get("KT_AUTOSCALE_INTERVAL_S", "5"))
 COLDSTART_TIMEOUT_S = float(os.environ.get("KT_COLDSTART_TIMEOUT_S", "120"))
 
 
-def _parse_duration_s(value, default: float = 60.0) -> float:
+# one warning per (workload, raw value): a malformed duration in an
+# autoscaling config would otherwise log every 5s tick, forever
+_warned_durations: set = set()
+
+
+def _parse_duration_s(value, default: float = 60.0,
+                      workload: Optional[str] = None) -> float:
+    """``"30s"``/``"5m"``/``"1h"``/bare seconds → seconds, clamped to ≥ 0.
+
+    A negative duration (``"-30s"``) used to pass through and turn the
+    idle check into "always idle" — instant scale-down; compound forms the
+    grammar doesn't speak (``"1h30m"``) silently became the default. Both
+    now log once per workload and fall back safely (negatives clamp to 0,
+    unparseable to ``default``)."""
     if value is None:
         return default
     s = str(value).strip()
     try:
         if s.endswith("h"):
-            return float(s[:-1]) * 3600
-        if s.endswith("m"):
-            return float(s[:-1]) * 60
-        if s.endswith("s"):
-            return float(s[:-1])
-        return float(s)
+            out = float(s[:-1]) * 3600
+        elif s.endswith("m"):
+            out = float(s[:-1]) * 60
+        elif s.endswith("s"):
+            out = float(s[:-1])
+        else:
+            out = float(s)
     except ValueError:
+        if (workload, s) not in _warned_durations:
+            _warned_durations.add((workload, s))
+            logging.getLogger("kubetorch.controller").warning(
+                "unparseable duration %r%s; using default %gs "
+                "(grammar: <float>[s|m|h] — compound forms like '1h30m' "
+                "are not supported)", s,
+                f" for {workload}" if workload else "", default)
         return default
+    if out < 0:
+        if (workload, s) not in _warned_durations:
+            _warned_durations.add((workload, s))
+            logging.getLogger("kubetorch.controller").warning(
+                "negative duration %r%s clamped to 0s", s,
+                f" for {workload}" if workload else "")
+        return 0.0
+    return out
 
 
 def _metadata_env(record: Dict) -> Dict[str, str]:
@@ -890,21 +1041,11 @@ def _metadata_env(record: Dict) -> Dict[str, str]:
 
 async def _scale_to(state: ControllerState, record: Dict, replicas: int,
                     reason: str) -> None:
-    ns, name = record["namespace"], record["name"]
-    async with state.apply_lock(f"{ns}/{name}"):
-        manifest = dict(record.get("manifest") or {})
-        manifest.setdefault("spec", {})["replicas"] = replicas
-        result = await asyncio.to_thread(
-            state.backend.apply, ns, name, manifest, _metadata_env(record))
-        record["manifest"] = manifest
-        record["_scaled_at"] = time.time()
-        # lets health checks distinguish "idle-scaled to zero" (healthy) from
-        # "pods never came up" (broken deploy)
-        record["scaled_to_zero"] = replicas == 0
-        record.update(result)
-    await state.persist_workload(record)
-    state.record_event(f"{ns}/{name}",
-                       f"autoscaled to {replicas} pods ({reason})")
+    """Resize through the scheduler (ISSUE 8): the capacity book stays
+    truthful, scale-downs kick the admission queue, and scale-ups clamp to
+    free capacity. The apply itself (and the ``_scaled_at``/
+    ``scaled_to_zero`` bookkeeping) lives in ``Scheduler._apply_scale``."""
+    await state.sched().scale(record, replicas, reason)
 
 
 async def _autoscale_one(state: ControllerState, record: Dict,
@@ -919,6 +1060,7 @@ async def _autoscale_one(state: ControllerState, record: Dict,
     current = len(ips)
     inflight = 0
     last_activity = 0.0
+    exec_sum = exec_count = 0.0
     async with aiohttp.ClientSession() as sess:
         for ip in ips:
             try:
@@ -929,8 +1071,20 @@ async def _autoscale_one(state: ControllerState, record: Dict,
                 last_activity = max(
                     last_activity,
                     _parse_metric(text, "kubetorch_last_activity_timestamp") or 0)
+                exec_sum += _parse_metric(
+                    text, 'kt_stage_seconds_sum{stage="execute"}') or 0.0
+                exec_count += _parse_metric(
+                    text, 'kt_stage_seconds_count{stage="execute"}') or 0.0
             except Exception:
                 continue            # unreachable pod counts as zero load
+    if exec_count:
+        # the measured-throughput input Gavel-style placement presupposes:
+        # fold this workload's execute histogram into the scheduler's
+        # per-device-class score (the scrape was already paid for)
+        from .scheduler import Scheduler
+        device_class, _ = Scheduler.demand_for(record)
+        state.sched().note_throughput(f"{ns}/{name}", device_class,
+                                      exec_sum, exec_count)
     target = max(int(cfg.get("target") or 1), 1)
     min_s = max(int(cfg.get("min_scale") or 0), 0)
     max_s = cfg.get("max_scale")
@@ -942,12 +1096,16 @@ async def _autoscale_one(state: ControllerState, record: Dict,
         now = time.time()
         idle_for = now - last_activity if last_activity else 0.0
         delay = _parse_duration_s(cfg.get("scale_down_delay")
-                                  or cfg.get("window"), default=60.0)
+                                  or cfg.get("window"), default=60.0,
+                                  workload=f"{ns}/{name}")
         # never reap (a) pods younger than the delay — booting pods look
-        # idle until their first request — or (b) a cold start in flight:
-        # the activator holds a request the pod hasn't seen yet
+        # idle until their first request — or (b) an activator-held request
+        # in flight: the refcount is the hard pin (provably scoped to the
+        # request's lifetime), the timed pin is the belt-and-braces for
+        # the settle after it clears
         pinned = (now - record.get("_scaled_at", 0) < delay
-                  or now < record.get("_coldstart_pin_until", 0))
+                  or now < record.get("_coldstart_pin_until", 0)
+                  or record.get("_activator_inflight", 0) > 0)
         if current == 0:
             desired = min_s
         elif idle_for > delay and not pinned:
@@ -958,7 +1116,8 @@ async def _autoscale_one(state: ControllerState, record: Dict,
                 # default 30s): a pod must survive long enough for the
                 # deploy's health-wait and first request to find it
                 retention = _parse_duration_s(
-                    cfg.get("scale_to_zero_retention"), default=30.0)
+                    cfg.get("scale_to_zero_retention"), default=30.0,
+                    workload=f"{ns}/{name}")
                 if idle_for <= max(delay, retention):
                     desired = current
         else:
@@ -1110,6 +1269,7 @@ async def _ttl_loop(state: ControllerState) -> None:
                         (record.get("manifest") or {}).get("kind"))
                     state.workloads.pop(key, None)
                     state.forget_workload(ns, name)
+                    await state.sched().release(ns, name)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -1131,8 +1291,32 @@ def _parse_metric(text: str, name: str) -> Optional[float]:
 # ---------------------------------------------------------------------------
 
 
+@web.middleware
+async def _trace_middleware(request: web.Request, handler):
+    """Continue the client's ``X-KT-Trace`` context through controller
+    handlers (ISSUE 8): a deploy that preempts parents its
+    ``sched.preempt``/``sched.resume`` spans onto the client's own trace,
+    so ``kt trace <request_id>`` shows the preemption inside the deploy's
+    waterfall instead of as an orphan root. Control-plane routes only;
+    probe-ish reads stay span-free."""
+    from .. import telemetry
+
+    if not request.path.startswith("/controller/") or \
+            request.path in ("/controller/cluster-config",
+                             "/controller/version"):
+        return await handler(request)
+    with telemetry.span("controller.handle",
+                        parent=telemetry.extract(request.headers),
+                        method=request.method, path=request.path) as sp:
+        resp = await handler(request)
+        if sp:
+            sp.set_attr("status", getattr(resp, "status", 0))
+        return resp
+
+
 def create_controller_app(state: Optional[ControllerState] = None) -> web.Application:
-    app = web.Application(client_max_size=10 * 1024 ** 3)  # 10G, like nginx cfg
+    app = web.Application(client_max_size=10 * 1024 ** 3,  # 10G, like nginx
+                          middlewares=[_trace_middleware])
     app["cstate"] = state or ControllerState()
     r = app.router
     r.add_post("/controller/deploy", deploy)
@@ -1148,6 +1332,9 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
     r.add_route("*", "/controller/store/{path:.*}", store_tunnel)
     r.add_get("/controller/metrics/query", prom_query)
     r.add_get("/controller/cluster-config", cluster_config)
+    r.add_get("/controller/queue", queue_status)
+    r.add_get("/metrics", controller_metrics)
+    r.add_get("/debug/traces", controller_traces)
     r.add_get("/controller/version", version)
     r.add_post("/controller/logs", ingest_logs)
     r.add_get("/controller/logs", query_logs)
@@ -1162,6 +1349,10 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
 async def _startup(app: web.Application) -> None:
     state: ControllerState = app["cstate"]
     state.restore()
+    # sched() restores the persisted queue/ledger/book; recover() finishes
+    # any preemption a dead controller left half-done (victim signaled but
+    # never evicted/re-queued) before new traffic can race it
+    await state.sched().recover()
     state._ttl_task = asyncio.create_task(_ttl_loop(state))
     state._autoscale_task = asyncio.create_task(_autoscale_loop(state))
     state._k8s_events_task = asyncio.create_task(_k8s_events_loop(state))

@@ -20,7 +20,8 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-from ..utils.procs import kill_process_tree, wait_for_port
+from ..utils.procs import (kill_process_tree, signal_process_tree,
+                           wait_for_port)
 
 
 class PodHandle:
@@ -386,6 +387,22 @@ class LocalBackend:
         return [h.ip for h in self.services.get(f"{namespace}/{name}", [])
                 if h.process.poll() is None]
 
+    def signal_pods(self, namespace: str, name: str, sig: int,
+                    grace_s: float = 0.0) -> int:
+        """Deliver ``sig`` to every pod's whole process tree — the local
+        analog of the kubelet's preemption SIGTERM reaching each container
+        (rank workers flip their cooperative drain flag and flush a
+        committed checkpoint; see ``serving/elastic.py``). No SIGKILL
+        escalation here: the scheduler owns the grace window, and its
+        eviction (apply replicas=0 → slot reconciliation) is the backstop
+        for pods that ignore the signal. Returns pods signaled."""
+        signaled = 0
+        for h in self.services.get(f"{namespace}/{name}", []):
+            if h.process.poll() is None:
+                if signal_process_tree(h.process.pid, sig):
+                    signaled += 1
+        return signaled
+
     def shutdown(self) -> None:
         for key in list(self.services):
             ns, name = key.split("/", 1)
@@ -568,6 +585,22 @@ class KubernetesBackend:
                         f"kubetorch.com/service={name}", "-o",
                         "jsonpath={.items[*].status.podIP}")
         return [ip for ip in out.split() if ip]
+
+    def signal_pods(self, namespace: str, name: str, sig: int,
+                    grace_s: float = 0.0) -> int:
+        """Graceful pod termination via the kubelet's own contract:
+        ``kubectl delete pods --grace-period=N --wait=false`` delivers
+        SIGTERM now and SIGKILL after the grace window — exactly the
+        sequence the scheduler's drain path expects. ``sig`` is accepted
+        for interface parity but K8s only speaks TERM-then-KILL."""
+        ips = self.pod_ips(namespace, name)
+        if not ips:
+            return 0
+        self._run("delete", "pods", "-n", namespace, "-l",
+                  f"kubetorch.com/service={name}",
+                  f"--grace-period={max(1, int(grace_s or 30))}",
+                  "--wait=false", "--ignore-not-found")
+        return len(ips)
 
     def pod_events(self, namespace: str) -> List[Dict]:
         """Recent Pod events in the namespace, normalized to

@@ -125,6 +125,8 @@ class DiskPersister:
                     self._write_workload_json(*payload)
                 elif kind == "workload_delete":
                     self.delete_workload(*payload)
+                elif kind == "scheduler":
+                    self._write_scheduler_json(payload)
                 else:
                     self._write_event(payload)
             except Exception:
@@ -200,6 +202,39 @@ class DiskPersister:
             except (json.JSONDecodeError, OSError):
                 continue
         return out
+
+    # -- scheduler state (ISSUE 8) -------------------------------------------
+
+    @property
+    def _scheduler_path(self) -> str:
+        return os.path.join(self.root, "scheduler.json")
+
+    def enqueue_scheduler_state(self, payload: Dict[str, Any]) -> None:
+        """Queue a scheduler snapshot (queue, priorities, capacity-book
+        allocations, preemption ledger) behind the writer thread. Like
+        workload writes, the dict is serialized on the CALLER's thread —
+        the string is the snapshot — and queue order is write order, so
+        the file on disk is always the newest enqueued state."""
+        self._q.put(("scheduler", json.dumps(_clean(payload), indent=1)))
+
+    def _write_scheduler_json(self, payload: str) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self._scheduler_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load_scheduler_state(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._scheduler_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
 
     # -- logs -----------------------------------------------------------------
 

@@ -47,7 +47,9 @@ class Compute:
                  namespace: Optional[str] = None,
                  selector: Optional[Dict[str, str]] = None,
                  launch_timeout: Optional[int] = None,
-                 shm_size: Optional[str] = "8Gi"):
+                 shm_size: Optional[str] = "8Gi",
+                 priority: Optional[Union[int, str]] = None,
+                 drain_grace_s: Optional[float] = None):
         self.cpus = cpus
         self.memory = memory
         self.tpu_spec = tpu
@@ -74,6 +76,14 @@ class Compute:
         self.selector = selector            # BYO mode: no manifest, just route
         self.launch_timeout = launch_timeout or config().launch_timeout
         self.shm_size = shm_size
+        # Scheduling tier (ISSUE 8): an int 0-100 or a tier name
+        # ("high"/"normal"/"batch"). Higher tiers may preempt strictly
+        # lower ones when the capacity book is full; preempted workloads
+        # drain (checkpoint) and resume automatically. None → the
+        # controller's default tier; drain_grace_s bounds the SIGTERM→
+        # eviction window a preemption grants this workload's pods.
+        self.priority = priority
+        self.drain_grace_s = drain_grace_s
         self.autoscaling: Optional[AutoscalingConfig] = None
         self.distributed: Optional[DistributedConfig] = None
         self.endpoint = None                # custom routing (from_manifest)
@@ -212,6 +222,24 @@ class Compute:
     def distributed_config_dict(self) -> Optional[Dict]:
         return self.distributed.to_dict() if self.distributed else None
 
+    def scheduling_dict(self) -> Optional[Dict[str, Any]]:
+        """The deploy body's ``scheduling`` block (ISSUE 8): priority/tier,
+        the demanded device class and width, and the drain grace. None when
+        the user set nothing — the scheduler then infers demand from the
+        manifest and uses the default tier."""
+        if self.priority is None and self.drain_grace_s is None:
+            return None
+        out: Dict[str, Any] = {
+            "device_class": (self.tpu.generation.name if self.tpu
+                             else "cpu"),
+            "width": self.replicas,
+        }
+        if self.priority is not None:
+            out["priority"] = self.priority
+        if self.drain_grace_s is not None:
+            out["drain_grace_s"] = float(self.drain_grace_s)
+        return out
+
     @property
     def deployment_mode(self) -> str:
         if self._user_manifest is not None:
@@ -337,6 +365,7 @@ class Compute:
                              launch_id, inactivity_ttl=self.inactivity_ttl,
                              expected_pods=expected,
                              autoscaling=autoscaling,
+                             scheduling=self.scheduling_dict(),
                              service_url=(self.endpoint.url
                                           if self.endpoint else None),
                              timeout=self.launch_timeout)

@@ -63,6 +63,11 @@ _DRAINS = telemetry.counter(
 ELASTIC_MAX_RESUMES_ENV = "KT_ELASTIC_MAX_RESUMES"
 ELASTIC_RESUME_WINDOW_ENV = "KT_ELASTIC_RESUME_WINDOW_S"
 BATCH_SCALE_ENV = "KT_ELASTIC_BATCH_SCALE"
+# shared with the controller scheduler (controller/scheduler.py): the
+# SIGTERM→eviction window a preempted pod gets. Policy and scheduler
+# resolving the same knob keeps "how long do I have to checkpoint" and
+# "how long do I wait before evicting" the same number.
+DRAIN_GRACE_ENV = "KT_SCHED_DRAIN_GRACE_S"
 
 # Actions a policy can decide for an observed rank death.
 RESUME = "resume"                          # re-mesh + resume from checkpoint
@@ -108,13 +113,16 @@ class ElasticPolicy:
     oom_batch_scale: float = 0.5    # per-OOM multiplier on the batch scale
     min_batch_scale: float = 0.125  # floor: below this an OOM is a hard fail
     checkpoint_every: int = 50      # advisory cadence for Checkpointer users
-    drain_grace_s: float = 20.0     # advisory: expected SIGTERM→KILL window
+    drain_grace_s: float = -1.0     # SIGTERM→KILL window; -1 → env/config
 
     def __post_init__(self):
         if self.max_resumes < 0:
             self.max_resumes = _default_max_resumes()
         if self.resume_window_s < 0:
             self.resume_window_s = _default_resume_window()
+        if self.drain_grace_s < 0:
+            self.drain_grace_s = max(0.0, _env_or_cfg(
+                DRAIN_GRACE_ENV, "sched_drain_grace_s", 20.0))
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ElasticPolicy":

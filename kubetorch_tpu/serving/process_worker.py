@@ -184,24 +184,15 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
 
 
 def _chaos_term_self(grace_s: float, call_index: int) -> None:
-    """term-rank chaos: the GKE preemption contract, self-delivered —
-    SIGTERM now (the drain handler flips the flag; the op just dequeued
-    still runs and can flush a checkpoint), SIGKILL ``grace_s`` later if
-    this process is still alive. The timer thread dies with a clean exit,
-    so a loop that drains inside the window is never force-killed."""
-    import signal as _signal
-    import threading as _threading
+    """term-rank chaos: the GKE preemption contract, self-delivered — the
+    op just dequeued still runs and can flush a checkpoint inside the
+    grace window. Delivery itself (SIGTERM + daemon SIGKILL timer) is the
+    shared :func:`~..chaos.deliver_term_with_grace` contract, the same one
+    scheduler-preemption tests use against external pids."""
+    from ..chaos import deliver_term_with_grace
 
-    print(f"[kt] chaos: term-rank grace={grace_s:g}s "
-          f"at call index {call_index}")
-
-    def _kill():
-        os.kill(os.getpid(), _signal.SIGKILL)
-
-    timer = _threading.Timer(grace_s, _kill)
-    timer.daemon = True
-    timer.start()
-    os.kill(os.getpid(), _signal.SIGTERM)
+    deliver_term_with_grace(os.getpid(), grace_s,
+                            label=f"term-rank at call index {call_index}")
 
 
 def _host_view(obj: Any) -> Any:

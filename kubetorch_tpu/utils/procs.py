@@ -30,6 +30,26 @@ def kill_process_tree(pid: int, timeout: float = 5.0) -> None:
             p.kill()
 
 
+def signal_process_tree(pid: int, sig: int) -> int:
+    """Deliver ``sig`` to a process and all descendants (children first, so
+    rank workers see SIGTERM even if the parent exits quickly). Returns the
+    number of processes signaled. The cooperative half of the preemption
+    contract — no escalation here; the caller owns the grace window and the
+    eventual hard kill (``kill_process_tree``)."""
+    import psutil
+
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return 0
+    signaled = 0
+    for p in parent.children(recursive=True) + [parent]:
+        with contextlib.suppress(psutil.NoSuchProcess):
+            p.send_signal(sig)
+            signaled += 1
+    return signaled
+
+
 def free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("127.0.0.1", 0))

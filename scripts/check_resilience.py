@@ -152,6 +152,22 @@ CKPT_WRITE_RE = re.compile(
 CKPT_EXEMPT = {"checkpoint.py"}
 CKPT_BASELINE: dict = {}
 
+# Raw placement/scale calls in controller/ outside the scheduler
+# (ISSUE 8). scheduler.py owns admission, the capacity book, and
+# preemption: a handler or loop that calls ``backend.apply`` itself
+# places pods the book never saw — invisible to `kt queue status`,
+# unpreemptable, and double-counted the moment the real scheduler places
+# into the same capacity. The one baselined site is the BYO manifest
+# passthrough (POST /controller/apply): raw kubectl-style applies of
+# user manifests are explicitly outside scheduling's contract.
+# \b not \( : the apply is usually a REFERENCE handed to
+# asyncio.to_thread, not a direct call
+SCHED_APPLY_RE = re.compile(r"backend\s*\.\s*apply\b")
+SCHED_EXEMPT = {"scheduler.py"}
+SCHED_BASELINE = {
+    "controller/app.py": 1,   # apply_manifest: BYO passthrough, unscheduled
+}
+
 # Raw single-origin store-URL building in data_store/ outside the ring
 # router (ISSUE 7). ring.py owns origin/fleet resolution: a call site that
 # reads config().data_store_url / KT_DATA_STORE_URL itself produces a
@@ -283,6 +299,30 @@ def main() -> int:
               "exceptions update ORIGIN_BASELINE with a justification.")
         return 1
 
+    sched_failures = []
+    sched_counts = {}
+    for path in sorted((PKG / "controller").rglob("*.py")):
+        if path.name in SCHED_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, SCHED_APPLY_RE)
+        if n:
+            sched_counts[rel] = n
+        allowed = SCHED_BASELINE.get(rel, 0)
+        if n > allowed:
+            sched_failures.append(
+                f"  {rel}: {n} raw backend.apply placement/scale "
+                f"site(s), baseline allows {allowed}")
+    if sched_failures:
+        print("check_resilience: raw backend.apply calls bypass the "
+              "scheduler:\n" + "\n".join(sched_failures))
+        print("\nPlacement, resize, and eviction in controller/ must route "
+              "through controller/scheduler.py (Scheduler.submit/scale/"
+              "release) so the capacity book stays truthful and the "
+              "preemption contract holds. For deliberate unscheduled "
+              "passthroughs update SCHED_BASELINE with a justification.")
+        return 1
+
     ckpt_failures = []
     ckpt_counts = {}
     for path in sorted((PKG / "train").rglob("*.py")):
@@ -346,6 +386,8 @@ def main() -> int:
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in ORIGIN_BASELINE.items()
            if origin_counts.get(f, 0) < allowed]
+        + [f for f, allowed in SCHED_BASELINE.items()
+           if sched_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
            if replace_counts.get(f, 0) < allowed]
         + [f for f, allowed in CKPT_BASELINE.items()
@@ -359,8 +401,9 @@ def main() -> int:
               + ", ".join(stale) + ")")
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
-              "checks, store-origin resolutions, data-store commit renames, "
-              "checkpoint writes, and telemetry sites accounted for")
+              "checks, store-origin resolutions, controller placements, "
+              "data-store commit renames, checkpoint writes, and telemetry "
+              "sites accounted for")
     return 0
 
 

@@ -1,0 +1,60 @@
+"""Standalone preemptible 'pod': a numpy training loop whose only exits are
+cooperative drain (SIGTERM → flush a committed checkpoint → clean exit) or a
+hard kill. The scheduler acceptance test (``tests/test_scheduler.py``) runs
+this as a real subprocess and preempts it through the real signal path —
+``install_sigterm_drain`` + ``kt.drain_requested()`` + the commit-marker
+protocol, end to end.
+
+Usage: ``python preemptible_trainer.py STORE_URL BASE_KEY [STEP_SLEEP_S]``
+
+Every step publishes ``<key>/__status__`` (step, resumed_from, fingerprint)
+through the store so the test can observe progress without sharing memory;
+the drain path publishes ``<key>/__drained__`` after its commit lands.
+Periodic commits are OFF (``every`` huge): the ONLY commit that can exist is
+the drain-path one, so a committed marker is proof the grace window worked.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from kubetorch_tpu.data_store import commands as ds
+from kubetorch_tpu.serving import elastic
+from kubetorch_tpu.train.checkpoint import Checkpointer, tree_fingerprint
+
+
+def main() -> int:
+    store_url, key = sys.argv[1], sys.argv[2]
+    sleep_s = float(sys.argv[3]) if len(sys.argv) > 3 else 0.1
+    elastic.install_sigterm_drain()
+    ckpt = Checkpointer(key, store_url=store_url, every=10 ** 9)
+    restored = ckpt.restore()
+    if restored is not None:
+        tree, step_no = restored
+        params = tree["w"]
+        resumed_from = step_no
+    else:
+        params = np.zeros(8, np.float64)
+        step_no = 0
+        resumed_from = None
+    while True:
+        if elastic.drain_requested():
+            # the preemption grace window: commit NOW, then vacate
+            ckpt.flush()
+            ckpt.save({"w": params}, step_no)
+            ds.put_json(f"{key}/__drained__",
+                        {"step": step_no, "reason": elastic.drain_reason()},
+                        store_url=store_url)
+            return 0
+        params = params + 1.0
+        step_no += 1
+        ds.put_json(f"{key}/__status__",
+                    {"step": step_no, "resumed_from": resumed_from,
+                     "fingerprint": tree_fingerprint({"w": params})},
+                    store_url=store_url)
+        time.sleep(sleep_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

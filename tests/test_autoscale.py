@@ -49,6 +49,46 @@ def autoscale_stack():
     reset_config()
 
 
+@pytest.mark.level("unit")
+def test_parse_duration_grammar_clamps_and_falls_back(caplog):
+    """ISSUE 8 satellite: ``_parse_duration_s`` used to silently swallow
+    malformed durations and pass NEGATIVE ones through — ``"-30s"`` made
+    the idle window negative, i.e. instant scale-down of a busy service."""
+    import logging
+
+    from kubetorch_tpu.controller.app import (_parse_duration_s,
+                                              _warned_durations)
+
+    assert _parse_duration_s("30s") == 30.0
+    assert _parse_duration_s("5m") == 300.0
+    assert _parse_duration_s("1.5h") == 5400.0
+    assert _parse_duration_s("45") == 45.0
+    assert _parse_duration_s(None, default=60.0) == 60.0
+
+    _warned_durations.clear()
+    with caplog.at_level(logging.WARNING, logger="kubetorch.controller"):
+        # negative → clamped to 0, never a negative idle window
+        assert _parse_duration_s("-30s", workload="ns/svc") == 0.0
+        # compound grammar ("1h30m") is unsupported → default, loudly
+        assert _parse_duration_s("1h30m", default=60.0,
+                                 workload="ns/svc") == 60.0
+        assert _parse_duration_s("junk", default=7.0,
+                                 workload="ns/svc") == 7.0
+    msgs = [r.message for r in caplog.records]
+    assert any("clamped" in m for m in msgs)
+    assert any("1h30m" in m for m in msgs)
+    # once per (workload, value): a 5s autoscale tick must not spam
+    n = len(caplog.records)
+    with caplog.at_level(logging.WARNING, logger="kubetorch.controller"):
+        _parse_duration_s("-30s", workload="ns/svc")
+        _parse_duration_s("1h30m", workload="ns/svc")
+    assert len(caplog.records) == n
+    # ...but a DIFFERENT workload with the same typo still gets its line
+    with caplog.at_level(logging.WARNING, logger="kubetorch.controller"):
+        _parse_duration_s("1h30m", workload="ns/other")
+    assert len(caplog.records) == n + 1
+
+
 def _pod_count(name: str) -> int:
     record = controller_client().get_workload("default", name)
     return len(record.get("pod_ips") or [])
@@ -63,6 +103,20 @@ def _wait_for_pods(name: str, predicate, timeout: float) -> int:
             return count
         time.sleep(0.5)
     return count
+
+
+def _wait_for_event(name: str, substring: str, timeout: float) -> bool:
+    """Deterministic completion signal: the controller records the event
+    AFTER the backend apply returns, so (unlike a pod-count poll, which
+    reads 0 while the scale-down apply is still mid-flight) a matching
+    event proves the transition finished."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(substring in e["message"]
+               for e in controller_client().events(name)):
+            return True
+        time.sleep(0.25)
+    return False
 
 
 @pytest.mark.slow
@@ -109,6 +163,14 @@ def test_scale_to_zero_and_cold_start():
         assert g(2, 3) == 5                       # warm path works
         gone = _wait_for_pods(g.name, lambda n: n == 0, timeout=30)
         assert gone == 0, f"never scaled to zero (pods={gone})"
+        # pin the cold-start race: 0 live pods is readable while the
+        # scale-down apply is still running — wait for the controller's
+        # own completion event before racing a cold start against it.
+        # (Controller-side, the activator now also holds a hard in-flight
+        # pin and retries a never-established forward through the
+        # cold-start path, closing the reap-vs-forward window for good.)
+        assert _wait_for_event(g.name, "autoscaled to 0 pods", timeout=10), \
+            "scale-to-zero apply never completed"
 
         # nothing is listening now: the call falls back to the controller
         # proxy, which cold-starts a pod, waits for ready, and forwards

@@ -80,6 +80,121 @@ def test_restore_drops_stale_local_addresses(tmp_path):
     assert state.events[-1]["message"] == "deployed"
 
 
+# ---------------------------------------------------------------------------
+# Scheduler-state durability (ISSUE 8): queue, priorities, and half-finished
+# preemptions survive a controller SIGKILL
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.level("unit")
+@pytest.mark.sched
+def test_scheduler_queue_and_priorities_survive_restart(tmp_path):
+    import asyncio
+
+    from kubetorch_tpu.controller.scheduler import Scheduler
+    from tests.test_scheduler import FakeBackend, _rec, _state, _submit
+
+    state = _state(FakeBackend(), capacity={"cpu": 1},
+                   state_dir=str(tmp_path))
+
+    async def fill():
+        await _submit(state, _rec(state, "running", 1, priority="batch"))
+        # same tier as the running job: they queue (never preempt)
+        assert (await _submit(state, _rec(state, "waiting-hi", 1,
+                                          priority=30)))["queued"]
+        assert (await _submit(state, _rec(state, "waiting-lo", 1,
+                                          priority=25)))["queued"]
+        for rec in state.workloads.values():
+            await state.persist_workload(rec)
+
+    asyncio.run(fill())
+    state.persister.flush()
+
+    # "restart": fresh state + scheduler over the same state dir
+    state2 = ControllerState(backend=FakeBackend(),
+                             state_dir=str(tmp_path))
+    state2.restore()
+    sched2 = Scheduler(state2, capacity={"cpu": 1})
+    sched2.restore(state2.persister.load_scheduler_state())
+    state2.scheduler = sched2
+    assert [(e["key"], e["priority"]) for e in
+            sched2.policy.order(sched2.queue, sched2)] == \
+        [("default/waiting-hi", 30), ("default/waiting-lo", 25)]
+    assert sched2.book.allocations["default/running"]["width"] == 1
+    assert state2.workloads["default/waiting-hi"]["status"] == "queued"
+
+
+@pytest.mark.level("unit")
+@pytest.mark.sched
+def test_sigkill_mid_preemption_recovers_and_resumes(tmp_path):
+    """THE durability scenario: the controller dies (nothing after the
+    persisted 'draining' ledger entry ever runs) between signaling the
+    victim and evicting it. The restarted controller must finish the
+    eviction, re-queue the victim at its priority, and place it once
+    capacity frees — from ``persistence.py`` state alone."""
+    import asyncio
+
+    from kubetorch_tpu.controller.scheduler import Scheduler
+    from tests.test_scheduler import FakeBackend, _rec, _state, _submit
+
+    fb = FakeBackend(cooperative=False)      # victim pods never exit
+    state = _state(fb, capacity={"cpu": 1}, state_dir=str(tmp_path))
+
+    async def crash_mid_preemption():
+        victim = _rec(state, "victim", 1, priority="batch",
+                      drain_grace_s=30.0)
+        await _submit(state, victim)
+        await state.persist_workload(victim)
+        vip = _rec(state, "vip", 1, priority="high")
+        await state.persist_workload(vip)
+        task = asyncio.get_running_loop().create_task(
+            _submit(state, vip))
+        # let the preemption reach the drain wait (ledger: "draining")
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if state.sched().ledger and \
+                    state.sched().ledger[-1]["phase"] == "draining":
+                break
+        assert state.sched().ledger[-1]["phase"] == "draining"
+        task.cancel()                        # the SIGKILL: nothing after
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(crash_mid_preemption())
+    state.persister.flush()
+
+    # restart: fresh process over the same state dir
+    fb2 = FakeBackend()
+    state2 = ControllerState(backend=fb2, state_dir=str(tmp_path))
+    state2.restore()
+    sched2 = Scheduler(state2, capacity={"cpu": 1})
+    sched2.restore(state2.persister.load_scheduler_state())
+    state2.scheduler = sched2
+    led = sched2.ledger[-1]
+    assert led["victim"] == "default/victim" and led["phase"] == "draining"
+
+    async def recover_and_drain():
+        await sched2.recover()
+        # half-finished preemption completed: victim evicted + re-queued
+        assert sched2.ledger[-1]["phase"] == "evicted"
+        [entry] = [e for e in sched2.queue
+                   if e["key"] == "default/victim"]
+        assert entry["preempted"] and entry["priority"] == 20
+        assert "default/victim" not in sched2.book.allocations
+        # capacity is free (the vip deploy died with the old controller):
+        # the victim resumes automatically on the next queue drain
+        await sched2.kick()
+        assert sched2.book.allocations["default/victim"]["width"] == 1
+        assert not [e for e in sched2.queue
+                    if e["key"] == "default/victim"]
+        assert ("default/victim", 1) in [(k, r)
+                                         for k, r, _ in fb2.applies]
+
+    asyncio.run(recover_and_drain())
+
+
 @pytest.mark.level("minimal")
 @pytest.mark.slow
 def test_kill_dash_nine_controller_restart_keeps_workloads_and_logs():

@@ -226,10 +226,16 @@ def test_tripped_breaker_on_one_replica_does_not_gate_siblings(
         monkeypatch.setenv("KT_STORE_BREAKER_THRESHOLD", "1")
         monkeypatch.setenv("KT_STORE_RETRIES", "1")
         val = np.ones(16, np.float32)
-        ds.put("brk/ckpt", {"w": val}, store_url=fleet.urls[0])
+        rg = ring.ring_for(fleet.urls[0])
+        # placement depends on the fleet's EPHEMERAL ports: pick a base key
+        # whose leaf provably places node0 FIRST, so killing node0 puts a
+        # refused connection (→ tripped breaker) on the request path every
+        # run instead of only when the port hash happens to land that way
+        base = next(f"brk/ckpt{i}" for i in range(64)
+                    if rg.nodes_for(f"brk/ckpt{i}/w")[0] == fleet.urls[0])
+        ds.put(base, {"w": val}, store_url=fleet.urls[0])
         fleet.stop_node(0)
         before = ring._FAILOVERS.value(kind="breaker")
-        rg = ring.ring_for(fleet.urls[0])
         # repeated ops: first trips node0's breaker (refused), later ones
         # hit the open breaker and must STILL succeed via node1. Clearing
         # the router's own down-marking between ops forces each retry back
@@ -237,7 +243,7 @@ def test_tripped_breaker_on_one_replica_does_not_gate_siblings(
         # ordering) is what the failover absorbs.
         for _ in range(3):
             rg.record_success(fleet.urls[0])
-            out = ds.get("brk/ckpt", store_url=fleet.urls[0])
+            out = ds.get(base, store_url=fleet.urls[0])
             np.testing.assert_array_equal(out["w"], val)
         from urllib.parse import urlsplit
         dead = urlsplit(fleet.urls[0]).netloc

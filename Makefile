@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched lint bench bench-store bench-trace bench-ckpt bench-fleet smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate
 test:
@@ -46,9 +46,21 @@ test-elastic:
 test-sched:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m sched
 
+# serving front-door suite (ISSUE 9): router packing/affinity/admission,
+# shed-before-prefill (no execute span for shed requests), health TTL
+# cache, session glue, queue-wait autoscale parsing
+test-serve:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m serve
+
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
+
+# per-stage perf regression gate (ISSUE 9 satellite / ROADMAP item 5):
+# deserialize + queue_wait p50 through the real pod-server path vs the
+# committed baseline (scripts/perf_baseline.json); >10%+floor fails
+perf-gate:
+	$(PY_CPU) python scripts/check_perf_gate.py
 
 bench:
 	python bench.py
@@ -72,6 +84,12 @@ bench-fleet:
 # BENCH-tracked
 bench-ckpt:
 	$(PY_CPU) python scripts/bench_datastore.py --checkpoint
+
+# serving front-door bench (ISSUE 9): 1200 open-loop sessions through the
+# REAL router — TTFT p50/p99, tokens/s, shed rate, affinity hit rate,
+# rr-vs-affinity on the same seeded arrival schedule
+bench-serve:
+	$(PY_CPU) python scripts/bench_serve.py
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -14,6 +14,7 @@ once, and the backoff sequence matches the policy".
              | delay:SECONDS
              | STATUS | STATUS:RETRY_AFTER      (e.g. 503 or 503:0.2)
              | oom | evict | preempt
+             | shed[:RETRY_AFTER]               (429 + typed AdmissionShedError)
              | disk-full                        (507 + typed StoreFullError)
              | corrupt-blob                     (store-state; see below)
              | torn-write[:BYTES]               (store-state; see below)
@@ -44,6 +45,9 @@ Fault kinds:
 - ``oom``       503 with a packaged ``HbmOomError`` (simulated HBM OOM)
 - ``evict`` / ``preempt``  503 with a packaged ``PodTerminatedError``
   (reason Evicted / Preempted) — the pod-termination taxonomy, injectable
+- ``shed[:R]``  429 with a packaged ``AdmissionShedError`` (+ optional
+  ``Retry-After: R``) — the serving front door's admission refusal
+  (ISSUE 9), injectable without building real overload
 - ``pass``      explicitly no fault (spaces out a schedule)
 - ``disk-full`` short-circuit 507 with a packaged ``StoreFullError`` — the
   deterministic stand-in for ENOSPC mid-write (clients must treat it as
@@ -131,7 +135,7 @@ EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
           "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
-          "term-rank", "kill-store-node")
+          "term-rank", "kill-store-node", "shed")
 
 # verbs consumed by the rank worker loop, not the HTTP middleware
 _RANK_KINDS = ("kill-rank", "term-rank")
@@ -259,6 +263,14 @@ def _parse_one(token: str, raw: str) -> Fault:
                 fault.retry_after = float(arg)
             except ValueError:
                 raise ChaosError(f"bad Retry-After in {raw!r}")
+        return fault
+    if head == "shed":
+        fault = Fault(kind="shed")
+        if arg:
+            try:
+                fault.retry_after = float(arg)
+            except ValueError:
+                raise ChaosError(f"bad shed Retry-After in {raw!r}")
         return fault
     if head in ("reset", "truncate", "oom", "evict", "preempt", "pass"):
         return Fault(kind=head)
@@ -525,6 +537,20 @@ def chaos_middleware(engine: ChaosEngine):
                     f"chaos: injected pod termination ({reason})",
                     reason=reason)),
                 status=503)
+        if fault.kind == "shed":
+            # deterministic stand-in for the serving front door refusing a
+            # request at admission (ISSUE 9): typed 429 + Retry-After, so
+            # client backoff against shedding is provable without building
+            # real overload
+            from .exceptions import AdmissionShedError
+            headers = {}
+            if fault.retry_after is not None:
+                headers["Retry-After"] = f"{fault.retry_after:g}"
+            return web.json_response(
+                package_exception(AdmissionShedError(
+                    "chaos: injected admission shed", reason="queue_full",
+                    retry_after=fault.retry_after)),
+                status=429, headers=headers)
         # status fault
         headers = {}
         if fault.retry_after is not None:

@@ -640,9 +640,93 @@ def serve_cmd(args):
 
     All flags pass through to ``kubetorch_tpu.serve.openai_api`` (run it
     with --help for the full list: slots, max-len, auto-prefix,
-    prefill-chunk, ...)."""
+    prefill-chunk, ...).
+
+    \b
+      kt serve status [--service NAME | --url URL] [--json]
+
+    shows the serving front door (ISSUE 9): admission/shed counters,
+    affinity hit rate, replica batch depth, and engine occupancy."""
+    if args and args[0] == "status":
+        _serve_status(list(args[1:]))
+        return
     from .serve.openai_api import main as serve_main
     serve_main(list(args))
+
+
+def _serve_status(argv):
+    """``kt serve status``: one pod's ``/health`` router block +
+    ``/metrics`` serve/engine series, rendered for the operator."""
+    import argparse
+
+    import requests as _requests
+
+    p = argparse.ArgumentParser(prog="kt serve status")
+    p.add_argument("--service", default=None,
+                   help="Resolve the service URL via the controller.")
+    p.add_argument("--url", default=None,
+                   help="Query this pod/service URL directly.")
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--json", dest="as_json", action="store_true")
+    ns = p.parse_args(argv)
+    url = ns.url
+    if url is None:
+        if ns.service is None:
+            raise click.UsageError("pass --service (resolved via the "
+                                   "controller) or --url <pod url>")
+        from .client import controller_client
+        record = controller_client().get_workload(
+            ns.namespace or kt_config().namespace, ns.service)
+        url = record.get("service_url")
+        if not url:
+            raise click.ClickException(f"service {ns.service!r} has no URL")
+    url = url.rstrip("/")
+    try:
+        # one-shot probes by design (like `kt store status`): a status
+        # command that retried would hide the flakiness it exists to show
+        health = _requests.get(f"{url}/health", timeout=5).json()
+        text = _requests.get(f"{url}/metrics", timeout=5).text
+    except _requests.RequestException as e:
+        raise click.ClickException(f"cannot reach {url}: {e}")
+
+    def metric_lines(prefix):
+        out = {}
+        for line in text.splitlines():
+            if line.startswith(prefix) and not line.startswith("#"):
+                try:
+                    out[line.rsplit(" ", 1)[0]] = float(line.split()[-1])
+                except (ValueError, IndexError):
+                    continue
+        return out
+
+    serve_series = {k: v for name in
+                    ("kt_serve_", "kt_user_engine_", "kt_user_session")
+                    for k, v in metric_lines(name).items()}
+    router = health.get("router") or {}
+    if ns.as_json:
+        click.echo(json.dumps({"url": url, "router": router,
+                               "metrics": serve_series},
+                              indent=2, default=str))
+        return
+    click.echo(f"pod {health.get('pod', '?')}  "
+               f"supervisor_healthy={health.get('supervisor_healthy')}")
+    if router:
+        click.echo(
+            f"front door: capacity={router.get('capacity')} "
+            f"active={router.get('active')} "
+            f"queued={router.get('queued')}/{router.get('queue_max')} "
+            f"sessions={router.get('sessions')} "
+            f"affinity-hit-rate={router.get('affinity_hit_rate', 0):.1%} "
+            f"est-wait={router.get('estimated_wait_s')}s")
+        inflight = router.get("inflight") or {}
+        for ip, n in sorted(inflight.items()):
+            click.echo(f"  {ip:<20} inflight={n}")
+    else:
+        click.echo("front door: (not a load_balanced service — no router)")
+    if serve_series:
+        click.echo("series:")
+        for k, v in sorted(serve_series.items()):
+            click.echo(f"  {k} {v:g}")
 
 
 # -- store -------------------------------------------------------------------

@@ -95,6 +95,18 @@ class KTConfig:
     sched_capacity: str = ""
     sched_policy: str = "fifo-priority"
     sched_drain_grace_s: float = 20.0
+    # serving front door (serving/router.py, ISSUE 9). Same env layering
+    # (KT_SERVE_SLOTS / KT_SERVE_QUEUE_MAX / KT_SERVE_HEALTH_TTL_S /
+    # KT_SERVE_SESSION_TTL_S / KT_SERVE_SLO_MS). serve_slots mirrors the
+    # engine's slot grid (per-replica decode batch size the router packs
+    # against); serve_queue_max bounds the admission queue (lowest tier
+    # sheds first past it); serve_slo_ms=0 leaves the controller's
+    # queue-wait autoscaler disabled until an operator sets a target.
+    serve_slots: int = 8
+    serve_queue_max: int = 256
+    serve_health_ttl_s: float = 2.0
+    serve_session_ttl_s: float = 600.0
+    serve_slo_ms: float = 0.0
     # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
     # recording everywhere (the fast path stays allocation-free, see `make
     # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing

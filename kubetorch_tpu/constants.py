@@ -4,6 +4,14 @@ and client config can never drift apart."""
 
 DEFAULT_SERVER_PORT = 32300
 
+# Serving front-door headers (ISSUE 9). Defined here — not in
+# serving/router.py or serve/sessions.py — because the two halves of
+# affinity routing live in DIFFERENT processes (the pod HTTP server routes;
+# the rank worker's engine holds the resident prefixes) and must agree on
+# the wire names without importing each other's runtimes.
+SESSION_HEADER = "X-KT-Session"
+PRIORITY_HEADER = "X-KT-Priority"
+
 
 def server_port(value: "str | int | None" = None) -> int:
     """The ONE tolerant KT_SERVER_PORT parse, shared by the pod server, the

@@ -986,6 +986,20 @@ AUTOSCALE_INTERVAL_S = float(os.environ.get("KT_AUTOSCALE_INTERVAL_S", "5"))
 COLDSTART_TIMEOUT_S = float(os.environ.get("KT_COLDSTART_TIMEOUT_S", "120"))
 
 
+def _serve_slo_s(cfg: Dict) -> float:
+    """The workload's queue-wait SLO in seconds: per-service ``slo_ms`` in
+    its autoscaling config, else the fleet-wide ``KT_SERVE_SLO_MS``. 0 (the
+    default) disables SLO-driven sizing — the loop then scales purely on
+    concurrency/idleness, the pre-ISSUE-9 behavior."""
+    raw = cfg.get("slo_ms")
+    if raw is None:
+        raw = os.environ.get("KT_SERVE_SLO_MS", "0")
+    try:
+        return max(float(raw or 0), 0.0) / 1000.0
+    except (TypeError, ValueError):
+        return 0.0
+
+
 # one warning per (workload, raw value): a malformed duration in an
 # autoscaling config would otherwise log every 5s tick, forever
 _warned_durations: set = set()
@@ -1061,6 +1075,7 @@ async def _autoscale_one(state: ControllerState, record: Dict,
     inflight = 0
     last_activity = 0.0
     exec_sum = exec_count = 0.0
+    qw_now: Dict[str, float] = {}
     async with aiohttp.ClientSession() as sess:
         for ip in ips:
             try:
@@ -1075,6 +1090,10 @@ async def _autoscale_one(state: ControllerState, record: Dict,
                     text, 'kt_stage_seconds_sum{stage="execute"}') or 0.0
                 exec_count += _parse_metric(
                     text, 'kt_stage_seconds_count{stage="execute"}') or 0.0
+                for le, n in _parse_histogram_buckets(
+                        text, "kt_stage_seconds",
+                        'stage="queue_wait"').items():
+                    qw_now[le] = qw_now.get(le, 0.0) + n
             except Exception:
                 continue            # unreachable pod counts as zero load
     if exec_count:
@@ -1122,11 +1141,31 @@ async def _autoscale_one(state: ControllerState, record: Dict,
                     desired = current
         else:
             desired = current
+    # SLO-driven sizing (ISSUE 9): the fleet's p90 queue-wait THIS interval
+    # (delta of the cumulative kt_stage_seconds{stage="queue_wait"} buckets
+    # vs the previous tick) against the service's latency target. Queue
+    # wait — not CPU — is the signal that actually tracks user-visible
+    # saturation on a slot-limited decode fleet: a full grid queues first.
+    # Scale-UP only (and at most 2× per tick); scale-down stays with the
+    # idle logic above, so a quiet fleet still drains conservatively.
+    reason = f"inflight={inflight} target={target}"
+    slo_s = _serve_slo_s(cfg)
+    if slo_s > 0 and current > 0:
+        prev = record.get("_qw_buckets") or {}
+        delta = {le: max(0.0, n - float(prev.get(le, 0.0)))
+                 for le, n in qw_now.items()}
+        record["_qw_buckets"] = qw_now
+        p90 = _quantile_from_buckets(delta, 0.9)
+        if p90 is not None and p90 > slo_s:
+            from_slo = min(math.ceil(current * p90 / slo_s), current * 2)
+            if from_slo > desired:
+                desired = from_slo
+                reason = (f"queue_wait p90={p90 * 1000:.0f}ms > "
+                          f"SLO {slo_s * 1000:.0f}ms")
     if max_s is not None:
         desired = min(desired, int(max_s))
     if desired != current:
-        await _scale_to(state, record, desired,
-                        f"inflight={inflight} target={target}")
+        await _scale_to(state, record, desired, reason)
 
 
 async def _autoscale_loop(state: ControllerState) -> None:
@@ -1286,6 +1325,60 @@ def _parse_metric(text: str, name: str) -> Optional[float]:
             except ValueError:
                 return None
     return None
+
+
+def _parse_histogram_buckets(text: str, name: str,
+                             label_filter: str = "") -> Dict[str, float]:
+    """Cumulative ``<name>_bucket`` counts from exposition text, keyed by
+    the ``le`` label (string form, ``"+Inf"`` included), summed across any
+    other label combinations that contain ``label_filter``. The input for
+    the SLO autoscaler's fleet-wide queue-wait quantile (ISSUE 9)."""
+    out: Dict[str, float] = {}
+    prefix = f"{name}_bucket{{"
+    for line in text.splitlines():
+        if not line.startswith(prefix) or label_filter not in line:
+            continue
+        try:
+            labels = line[line.index("{") + 1:line.rindex("}")]
+            le = None
+            for part in labels.split(","):
+                k, _, v = part.partition("=")
+                if k.strip() == "le":
+                    le = v.strip().strip('"')
+            if le is None:
+                continue
+            out[le] = out.get(le, 0.0) + float(line.split()[-1])
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def _quantile_from_buckets(buckets: Dict[str, float],
+                           q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile over cumulative bucket counts
+    (linear interpolation within a bucket; the +Inf bucket resolves to the
+    last finite edge). None when the histogram is empty."""
+    if not buckets:
+        return None
+
+    def edge(le: str) -> float:
+        return float("inf") if le in ("+Inf", "inf") else float(le)
+
+    items = sorted(((edge(le), n) for le, n in buckets.items()))
+    total = items[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_n = 0.0, 0.0
+    for le, n in items:
+        if n >= rank:
+            if le == float("inf"):
+                return prev_le
+            span = n - prev_n
+            frac = (rank - prev_n) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_n = le, n
+    return items[-1][0]
 
 
 # ---------------------------------------------------------------------------

@@ -199,6 +199,29 @@ class CircuitOpenError(KubetorchError):
         self.retry_after = retry_after
 
 
+class AdmissionShedError(KubetorchError):
+    """The serving front door shed this request at admission (HTTP 429).
+
+    Raised by ``serving/router.py`` BEFORE any prefill compute runs: the
+    bounded admission queue was full (lowest priority tier sheds first) or
+    the request's propagated ``X-KT-Deadline`` cannot be met by the
+    estimated queue wait — a doomed request is refused at the door instead
+    of burning a decode slot on an answer the client will never read.
+    ``reason`` is ``queue_full`` or ``doomed``; ``retry_after`` is the
+    router's backpressure hint in seconds.
+    """
+
+    def __init__(self, message: str = "Request shed at admission",
+                 reason: Optional[str] = None, tier: Optional[str] = None,
+                 queue_depth: Optional[int] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.tier = tier
+        self.queue_depth = queue_depth
+        self.retry_after = retry_after
+
+
 # ---------------------------------------------------------------------------
 # Runtime faults (reference serving/utils.py:111-264)
 # ---------------------------------------------------------------------------
@@ -376,6 +399,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
+        AdmissionShedError,
         PodTerminatedError,
         HbmOomError,
         WorkerMembershipChanged,
@@ -394,6 +418,7 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "DataCorruptionError": ["key", "expected", "actual", "source"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
+    "AdmissionShedError": ["reason", "tier", "queue_depth", "retry_after"],
     "PodTerminatedError": ["reason", "pod_name", "exit_code"],
     "HbmOomError": ["requested_bytes", "available_bytes"],
     "WorkerMembershipChanged": ["added", "removed", "previous", "current",

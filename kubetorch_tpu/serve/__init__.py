@@ -19,10 +19,12 @@ from ..models.quant import (dequantize_params, llama_init_quantized,
                             quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
 from .kv_quant import QuantKVCache, dequantize_rows, quantize_rows
+from .sessions import EngineSessionBinder, SessionStats, session_key
 from .spec_engine import SpeculativeEngine
 from .speculative import SpecStats, speculative_generate
 
 __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
+           "EngineSessionBinder", "SessionStats", "session_key",
            "quantize_params", "quantize_params_int4",
            "llama_init_quantized", "dequantize_params", "quantized_bytes",
            "speculative_generate", "SpecStats", "SpeculativeEngine",

@@ -1673,6 +1673,9 @@ class GenerationEngine:
         s = self.stats()
         out = {"engine_slots": float(s.slots),
                "engine_active": float(s.active),
+               # the router packs against free slots: exported so `kt
+               # serve status` and the bench can see per-replica headroom
+               "engine_slots_free": float(s.slots - s.active),
                "engine_queued": float(s.queued),
                "engine_admitted_total": float(s.admitted_total),
                "engine_finished_total": float(s.finished_total),

@@ -42,9 +42,10 @@ from aiohttp import web
 
 from .. import serialization as ser
 from .. import telemetry
-from ..exceptions import (DeadlineExceededError, KubetorchError,
-                          PodTerminatedError, SerializationError,
-                          WorkerDiedError, package_exception)
+from ..exceptions import (AdmissionShedError, DeadlineExceededError,
+                          KubetorchError, PodTerminatedError,
+                          SerializationError, WorkerDiedError,
+                          package_exception)
 from ..resilience import DEADLINE_HEADER, Deadline, IdempotencyCache
 from ..parallel.mesh import DistributedConfig
 from ..resources.pointers import Pointers
@@ -429,6 +430,13 @@ async def health(request: web.Request) -> web.Response:
             body["workers"] = sup.restart_state()
         except Exception:  # noqa: BLE001 — health must never 500 over this
             pass
+    # serving front door (ISSUE 9): admission/affinity/batching accounting
+    # for load_balanced services — the operator's `kt serve status` source
+    if sup is not None and hasattr(sup, "router_state"):
+        try:
+            body["router"] = sup.router_state()
+        except Exception:  # noqa: BLE001 — health must never 500 over this
+            pass
     return web.json_response(body)
 
 
@@ -672,9 +680,16 @@ async def _run_callable_inner(request: web.Request,
         elif "_kt_workers" in body:
             call_kwargs["workers"] = body.pop("_kt_workers")
         if hasattr(sup, "server_port"):
-            call_kwargs.setdefault(
-                "headers", {"X-Request-ID": request["kt_request_id"],
-                            "X-Serialization": ser.JSON})
+            fwd = {"X-Request-ID": request["kt_request_id"],
+                   "X-Serialization": ser.JSON}
+            # the front-door vocabulary must survive the hop: the router
+            # sheds on the deadline and tier, and the peer pod re-enforces
+            # the deadline on the forwarded leg (ISSUE 9)
+            from ..constants import PRIORITY_HEADER, SESSION_HEADER
+            for h in (DEADLINE_HEADER, PRIORITY_HEADER, SESSION_HEADER):
+                if request.headers.get(h):
+                    fwd[h] = request.headers[h]
+            call_kwargs.setdefault("headers", fwd)
 
         if body.get("debugger"):
             from .pdb_ws import arm_debugger
@@ -690,6 +705,17 @@ async def _run_callable_inner(request: web.Request,
         # infra faults, not user errors: 503 so load balancers shed traffic
         # while the watchdog restarts the rank pool
         return _error_response(e, status=503)
+    except AdmissionShedError as e:
+        # the front door refused before prefill: typed 429 + the router's
+        # backpressure hint, so clients back off instead of hammering
+        resp = _error_response(e, status=429)
+        if e.retry_after is not None:
+            resp.headers["Retry-After"] = f"{max(e.retry_after, 0.0):.3f}"
+        return resp
+    except DeadlineExceededError as e:
+        # router-level shed of an expired deadline (the middleware catches
+        # arrivals; this catches expiry inside the admission queue)
+        return _error_response(e, status=504)
     except BaseException as e:  # noqa: BLE001
         return _error_response(e)
 

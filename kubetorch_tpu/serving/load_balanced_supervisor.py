@@ -1,11 +1,13 @@
-"""Load-balanced dispatch: one call → one pod, rotated.
+"""Load-balanced dispatch: one call → the front door's chosen pod.
 
 The third dispatch mode of the reference's CRD enum (``regular | spmd |
 load_balanced``, charts/.../kubetorchworkload-crd.yaml:80-86). In k8s the
 Service's ClusterIP already spreads *connections*; this supervisor spreads
-*calls* — deterministic round-robin with health skipping, which matters for
-long-lived clients holding keep-alive connections to one pod and for the
-local backend (whose service_url always points at pod 0).
+*calls* — but the policy is no longer a blind round-robin: replica
+selection, continuous batching, affinity, and admission control all live in
+:class:`serving.router.Router` (ISSUE 9), the only module allowed to make
+that decision. This class is the thin seam between the supervisor hierarchy
+(membership, rank pool, restart guard) and the router.
 
 Unlike SPMD, the result is a single value (the chosen pod's), not a
 per-rank list.
@@ -13,13 +15,12 @@ per-rank list.
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Dict, List, Optional
 
-from ..exceptions import WorkerCallError
 from .discovery import my_pod_ip
 from .execution_supervisor import DistributedSupervisor
 from .remote_worker_pool import RemoteWorkerPool
+from .router import Router
 
 
 class LoadBalancedSupervisor(DistributedSupervisor):
@@ -28,7 +29,7 @@ class LoadBalancedSupervisor(DistributedSupervisor):
         super().__init__(*args, **kwargs)
         self.server_port = server_port
         self.fn_name = fn_name
-        self._rr = itertools.count()
+        self.router = Router(server_port=server_port, fn_name=fn_name)
 
     async def _call_local(self, method, args, kwargs, timeout) -> Any:
         # the restart guard wraps ONLY local execution: forwarded calls must
@@ -45,31 +46,12 @@ class LoadBalancedSupervisor(DistributedSupervisor):
         if subtree is not None:
             # we are the chosen pod for a forwarded call: run locally
             return await self._call_local(method, args, kwargs, timeout)
-
         ips = sorted(self.pod_ips() or [my_pod_ip()])
-        my_ip = my_pod_ip()
-        pool = RemoteWorkerPool.shared(self.server_port)
-        # try up to len(ips) pods starting at the round-robin cursor,
-        # skipping unhealthy ones (elastic by default)
-        start = next(self._rr)
-        last_err: Optional[BaseException] = None
-        for offset in range(len(ips)):
-            target = ips[(start + offset) % len(ips)]
-            if target == my_ip:
-                return await self._call_local(method, args, kwargs, timeout)
-            if not await pool.check_health(target):
-                continue
-            try:
-                return await pool.call_worker(
-                    target, self.fn_name, method,
-                    {"args": args, "kwargs": kwargs}, headers or {},
-                    timeout, subtree=[])
-            except WorkerCallError as e:
-                # failover ONLY on transport failure — an application
-                # exception from the peer must propagate, never re-run a
-                # (possibly non-idempotent) call on another pod
-                last_err = e
-        if last_err is not None:
-            raise last_err
-        # no healthy peer: serve locally
-        return await self._call_local(method, args, kwargs, timeout)
+        return await self.router.dispatch(
+            pool=RemoteWorkerPool.shared(self.server_port), ips=ips,
+            my_ip=my_pod_ip(), method=method, args=args, kwargs=kwargs,
+            headers=headers, timeout=timeout, local_call=self._call_local)
+
+    def router_state(self) -> Dict[str, Any]:
+        """Front-door accounting for ``/health`` and ``kt serve status``."""
+        return self.router.state_dict()

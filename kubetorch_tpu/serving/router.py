@@ -1,0 +1,514 @@
+"""The serving front door: continuous-batching, affinity-aware replica
+routing with deadline shedding (ISSUE 9).
+
+``load_balanced`` dispatch used to be a 75-line round-robin: one call → one
+pod, an extra health-probe RTT per call, no admission control, no memory of
+where a session's state lives. This module is the real inference router
+that replaces its selection loop — and the ONLY place in ``serving/`` that
+may decide which replica a call lands on (``scripts/check_resilience.py``
+lints for strays):
+
+- **Continuous batching across replicas.** The router keeps per-replica
+  in-flight/slot accounting (``KT_SERVE_SLOTS`` mirrors the engine's slot
+  grid) and packs keyless requests onto the replica with the FULLEST
+  partially-full decode batch, so fleets run few hot batches instead of
+  many one-deep ones — the cross-replica twin of the engine's slot-grid
+  admission. Idle replicas are used round-robin; depth is measured
+  (``kt_serve_batch_depth``).
+- **Affinity routing.** A session/adapter key (``X-KT-Session`` header or
+  well-known kwargs — see :func:`affinity_key`) routes to the replica
+  where its prefix K/V or adapter bank is already resident
+  (:class:`SessionTable`), falling back to a consistent hash over the
+  current replica set when cold — so residency builds deterministically
+  instead of smearing across the fleet. Hit/miss counters
+  (``kt_serve_affinity_total``) prove the win; the engine-side half is
+  ``serve/sessions.py``.
+- **Deadline-aware admission + load shedding.** ``X-KT-Deadline`` (on the
+  wire since the resilience layer) is checked at the door: already-expired
+  → typed 504 without touching a replica; unmeetable against the measured
+  queue-wait estimate → typed 429 ``AdmissionShedError``. The admission
+  queue is bounded (``KT_SERVE_QUEUE_MAX``); when full, the lowest
+  priority tier sheds first (``X-KT-Priority``, the scheduler's bands).
+- **Queue-wait telemetry the autoscaler spends.** Time spent in the
+  admission queue lands in the ``kt_stage_seconds{stage="queue_wait"}``
+  histogram — the series the controller's SLO loop scrapes to size the
+  fleet (``KT_SERVE_SLO_MS``).
+
+Health is cached with a short TTL (:class:`HealthCache`) instead of
+probed per dispatch — the per-call RTT the old supervisor paid — and
+invalidated the moment a transport error proves a replica dead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..constants import PRIORITY_HEADER, SESSION_HEADER
+from ..exceptions import (AdmissionShedError, DeadlineExceededError,
+                          WorkerCallError)
+from ..resilience import DEADLINE_HEADER, Deadline
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def request_priority(headers: Optional[Dict[str, str]]) -> Tuple[int, str]:
+    """(priority, tier) from ``X-KT-Priority`` — the scheduler's bands
+    (≥70 high / 40-69 normal / <40 batch), so one priority vocabulary
+    covers both placement and request shedding."""
+    from ..controller.scheduler import parse_priority, tier_of
+    raw = None
+    if headers:
+        raw = headers.get(PRIORITY_HEADER) or headers.get(
+            PRIORITY_HEADER.lower())
+    prio = parse_priority(raw)
+    return prio, tier_of(prio)
+
+
+def affinity_key(headers: Optional[Dict[str, str]],
+                 kwargs: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The routing key one call carries: the explicit session header wins;
+    else well-known kwargs (``session_id``, ``session``, ``prefix_id``,
+    ``adapter_id``) — a request pinned to a cached prefix or LoRA adapter
+    benefits from landing where that state is resident even when the
+    caller never named a session. Mirrors ``serve.sessions.session_key``
+    (kept import-free of the engine side on purpose)."""
+    if headers:
+        val = headers.get(SESSION_HEADER) or headers.get(
+            SESSION_HEADER.lower())
+        if val:
+            return str(val)
+    if kwargs:
+        for field in ("session_id", "session", "prefix_id", "adapter_id"):
+            val = kwargs.get(field)
+            if val is not None:
+                return f"{field}:{val}"
+    return None
+
+
+class HealthCache:
+    """TTL-cached replica health (ISSUE 9 satellite: the old supervisor
+    awaited ``pool.check_health(target)`` on EVERY dispatch — an extra RTT
+    per call). A probe result is trusted for ``ttl_s``; a transport error
+    on an actual call is stronger evidence than any probe and marks the
+    replica down immediately (:meth:`mark_down`), so failover never waits
+    out a stale "healthy". Avoided probes are counted."""
+
+    def __init__(self, ttl_s: Optional[float] = None):
+        self.ttl_s = (ttl_s if ttl_s is not None
+                      else _env_float("KT_SERVE_HEALTH_TTL_S", 2.0))
+        self._cache: Dict[str, Tuple[bool, float]] = {}
+        self._lock = threading.Lock()
+
+    async def healthy(self, pool, ip: str) -> bool:
+        m = telemetry.serve_metrics()
+        now = time.monotonic()
+        with self._lock:
+            entry = self._cache.get(ip)
+        if entry is not None and now - entry[1] < self.ttl_s:
+            m["probes_avoided"].inc()
+            return entry[0]
+        ok = await pool.check_health(ip)
+        m["probes"].inc()
+        with self._lock:
+            self._cache[ip] = (ok, time.monotonic())
+        return ok
+
+    def mark_down(self, ip: str) -> None:
+        with self._lock:
+            self._cache[ip] = (False, time.monotonic())
+
+    def invalidate(self, ip: str) -> None:
+        with self._lock:
+            self._cache.pop(ip, None)
+
+
+class SessionTable:
+    """Router-side residency map: affinity key → the replica last serving
+    it. LRU + TTL bounded — an abandoned session must not pin a replica
+    forever, and the table must stay O(active sessions) at million-user
+    scale. The engine-side prefix residency this map points at is
+    ``serve.sessions.EngineSessionBinder``."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 ttl_s: Optional[float] = None):
+        self.capacity = (capacity if capacity is not None
+                         else _env_int("KT_SERVE_SESSIONS", 65536))
+        self.ttl_s = (ttl_s if ttl_s is not None
+                      else _env_float("KT_SERVE_SESSION_TTL_S", 600.0))
+        self._entries: "OrderedDict[str, Tuple[str, float]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def lookup(self, key: str) -> Optional[str]:
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            ip, seen = entry
+            if now - seen > self.ttl_s:
+                del self._entries[key]
+                return None
+            self._entries.move_to_end(key)   # a lookup IS recency
+            return ip
+
+    def touch(self, key: str, replica: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = (replica, now)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def evict_replica(self, replica: str) -> int:
+        """Forget every session resident on a dead replica — their prefix
+        K/V died with it; the next turn should hash to a fresh home, not
+        chase a ghost."""
+        with self._lock:
+            dead = [k for k, (ip, _t) in self._entries.items()
+                    if ip == replica]
+            for k in dead:
+                del self._entries[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class _Waiter:
+    """One queued admission: woken in priority order, shed when the queue
+    overflows or its deadline lapses."""
+
+    __slots__ = ("priority", "tier", "seq", "future", "enqueued_at")
+
+    def __init__(self, priority: int, tier: str, seq: int,
+                 future: "asyncio.Future[None]"):
+        self.priority = priority
+        self.tier = tier
+        self.seq = seq
+        self.future = future
+        self.enqueued_at = time.monotonic()
+
+    def sort_key(self) -> Tuple[int, int]:
+        # highest priority first; FIFO within a band
+        return (-self.priority, self.seq)
+
+    def __lt__(self, other: "_Waiter") -> bool:
+        return self.sort_key() < other.sort_key()
+
+
+class Router:
+    """One per ``LoadBalancedSupervisor`` (i.e. per pod per service). Every
+    pod routes with the same policy over the same membership and the same
+    consistent hash, so any pod's front door sends a session to the same
+    home — no coordination needed, exactly the store ring's trick."""
+
+    def __init__(self, server_port: int = 32300, fn_name: str = "", *,
+                 slots_per_replica: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 health_ttl_s: Optional[float] = None,
+                 session_capacity: Optional[int] = None,
+                 session_ttl_s: Optional[float] = None):
+        self.server_port = server_port
+        self.fn_name = fn_name
+        self.slots = (slots_per_replica if slots_per_replica is not None
+                      else _env_int("KT_SERVE_SLOTS", 8))
+        self.queue_max = (queue_max if queue_max is not None
+                          else _env_int("KT_SERVE_QUEUE_MAX", 256))
+        self.health = HealthCache(ttl_s=health_ttl_s)
+        self.sessions = SessionTable(capacity=session_capacity,
+                                     ttl_s=session_ttl_s)
+        self._inflight: Dict[str, int] = {}
+        self._active = 0              # total in-flight through this router
+        self._capacity = self.slots   # refreshed per dispatch (elastic fleet)
+        self._waiters: List[_Waiter] = []
+        self._rr = itertools.count()
+        self._seq = itertools.count()
+        # EWMA of per-request service seconds: the doomed-request estimator.
+        # None until the first completion — the router never sheds on a
+        # guess it hasn't measured.
+        self._ewma_s: Optional[float] = None
+        # consistent-hash ring cached per membership: building one is
+        # O(nodes × vnodes) blake2b hashes — far too hot to pay per miss
+        self._ring: Tuple[Tuple[str, ...], Any] = ((), None)
+
+    # -- admission ----------------------------------------------------------
+
+    def estimated_wait_s(self) -> float:
+        """Expected queue wait for a request arriving NOW: queued requests
+        drain at (capacity / service-time) per second. 0 until a service
+        time has been measured."""
+        if self._ewma_s is None or not self._waiters:
+            return 0.0
+        return len(self._waiters) * self._ewma_s / max(self._capacity, 1)
+
+    def _shed(self, reason: str, tier: str,
+              retry_after: Optional[float] = None,
+              deadline: Optional[Deadline] = None) -> None:
+        m = telemetry.serve_metrics()
+        m["shed"].inc(reason=reason, tier=tier)
+        telemetry.add_event("router.shed", reason=reason, tier=tier)
+        if reason == "deadline_expired":
+            raise DeadlineExceededError(
+                "request arrived past its deadline; shed at the front door "
+                "before prefill", deadline=deadline.at if deadline else None)
+        depth = len(self._waiters)
+        raise AdmissionShedError(
+            f"shed at admission ({reason}): queue depth {depth}, "
+            f"estimated wait {self.estimated_wait_s():.3f}s",
+            reason=reason, tier=tier, queue_depth=depth,
+            retry_after=retry_after)
+
+    def _check_deadline(self, deadline: Optional[Deadline],
+                        tier: str) -> None:
+        if deadline is None:
+            return
+        if deadline.expired():
+            self._shed("deadline_expired", tier, deadline=deadline)
+        est = self.estimated_wait_s()
+        if est > 0 and deadline.remaining() < est:
+            # doomed: it would expire in the queue — refuse now, while the
+            # client's retry budget can still go somewhere useful
+            self._shed("doomed", tier, retry_after=est, deadline=deadline)
+
+    async def _admit(self, priority: int, tier: str,
+                     deadline: Optional[Deadline]) -> None:
+        """Block until a fleet slot frees (priority order), shedding on
+        overflow. Runs on the server's event loop — single-threaded, so
+        the counters need no lock."""
+        m = telemetry.serve_metrics()
+        if self._active < self._capacity and not self._waiters:
+            self._active += 1
+            m["admitted"].inc(tier=tier)
+            return
+        if len(self._waiters) >= self.queue_max:
+            # queue full: the lowest band sheds first. If that's the
+            # arrival, shed it; otherwise evict the queue's worst waiter
+            # to make room for the better-tiered arrival.
+            worst = max(self._waiters)
+            if (-priority, next(self._seq)) >= worst.sort_key():
+                self._shed("queue_full", tier,
+                           retry_after=self.estimated_wait_s())
+            self._waiters.remove(worst)
+            heapq.heapify(self._waiters)
+            m["queue_depth"].set(len(self._waiters))
+            if not worst.future.done():
+                worst.future.set_exception(AdmissionShedError(
+                    "shed from the admission queue by a higher-priority "
+                    "arrival", reason="queue_full", tier=worst.tier,
+                    queue_depth=len(self._waiters),
+                    retry_after=self.estimated_wait_s()))
+                m["shed"].inc(reason="queue_full", tier=worst.tier)
+        waiter = _Waiter(priority, tier, next(self._seq),
+                         asyncio.get_running_loop().create_future())
+        heapq.heappush(self._waiters, waiter)
+        m["queue_depth"].set(len(self._waiters))
+        timeout = deadline.remaining() if deadline is not None else None
+        try:
+            with telemetry.stage("queue_wait", source="router"):
+                await asyncio.wait_for(waiter.future, timeout=timeout)
+        except asyncio.TimeoutError:
+            self._forget(waiter)
+            self._shed("deadline_expired", tier, deadline=deadline)
+        except asyncio.CancelledError:
+            # the handler task was cancelled (deadline middleware, client
+            # gone). If the wake-up raced the cancellation and the slot
+            # was already granted, hand it straight to the next waiter —
+            # otherwise it leaks and capacity shrinks forever.
+            granted = (waiter.future.done()
+                       and not waiter.future.cancelled()
+                       and waiter.future.exception() is None)
+            self._forget(waiter)
+            if granted:
+                self._active -= 1
+                self._wake()
+            raise
+        # woken by _release: the slot is already accounted to us
+        m["admitted"].inc(tier=tier)
+
+    def _forget(self, waiter: _Waiter) -> None:
+        if waiter in self._waiters:
+            self._waiters.remove(waiter)
+            heapq.heapify(self._waiters)
+            telemetry.serve_metrics()["queue_depth"].set(len(self._waiters))
+
+    def _release(self, started_at: float) -> None:
+        dt = time.monotonic() - started_at
+        self._ewma_s = (dt if self._ewma_s is None
+                        else 0.2 * dt + 0.8 * self._ewma_s)
+        self._active -= 1
+        self._wake()
+
+    def _wake(self) -> None:
+        while self._waiters and self._active < self._capacity:
+            waiter = heapq.heappop(self._waiters)
+            if waiter.future.done():
+                continue            # already shed/cancelled
+            self._active += 1
+            waiter.future.set_result(None)
+        telemetry.serve_metrics()["queue_depth"].set(len(self._waiters))
+
+    # -- selection ----------------------------------------------------------
+
+    def _free(self, ip: str) -> int:
+        return self.slots - self._inflight.get(ip, 0)
+
+    def _pack_order(self, ips: List[str]) -> List[str]:
+        """Continuous-batching order for keyless traffic: partially-full
+        replicas first (fullest first — join an existing decode batch),
+        then idle replicas round-robin, then saturated ones (failover of
+        last resort). Sequential traffic on an idle fleet degenerates to
+        exactly the old round-robin."""
+        start = next(self._rr) % max(len(ips), 1)
+        rotated = ips[start:] + ips[:start]
+        partial = sorted((ip for ip in rotated
+                          if 0 < self._inflight.get(ip, 0) < self.slots),
+                         key=lambda ip: -self._inflight.get(ip, 0))
+        idle = [ip for ip in rotated if self._inflight.get(ip, 0) == 0]
+        full = [ip for ip in rotated
+                if self._inflight.get(ip, 0) >= self.slots]
+        return partial + idle + full
+
+    def _hash_order(self, key: str, ips: List[str]) -> List[str]:
+        """Deterministic cold placement: every pod's router hashes the
+        session to the same home replica, so residency accretes in one
+        place. Reuses the store ring's membership-order-independent
+        consistent hash, rebuilt only when membership changes."""
+        tkey = tuple(ips)
+        if self._ring[0] != tkey:
+            from ..data_store.ring import HashRing
+            self._ring = (tkey, HashRing(list(tkey)))
+        return self._ring[1].walk(key)
+
+    def select(self, ips: List[str], key: Optional[str]
+               ) -> Tuple[List[str], str]:
+        """(ordered candidate list, affinity outcome). ``hit`` = resident
+        replica first; ``miss`` = consistent-hash placement (cold or the
+        resident replica is gone/full); ``none`` = keyless packing."""
+        if not key:
+            return self._pack_order(ips), "none"
+        resident = self.sessions.lookup(key)
+        if resident in ips and self._free(resident) > 0:
+            rest = [ip for ip in self._pack_order(ips) if ip != resident]
+            return [resident] + rest, "hit"
+        order = self._hash_order(key, ips)
+        # a full home replica falls through to the next ring member rather
+        # than queueing behind its own batch
+        ready = [ip for ip in order if self._free(ip) > 0]
+        starved = [ip for ip in order if self._free(ip) <= 0]
+        return ready + starved, "miss"
+
+    # -- dispatch -----------------------------------------------------------
+
+    async def dispatch(self, *, pool, ips: List[str], my_ip: str,
+                       method: Optional[str], args: list, kwargs: dict,
+                       headers: Optional[Dict[str, str]],
+                       timeout: Optional[float],
+                       local_call: Callable[..., Awaitable[Any]]) -> Any:
+        """The whole front-door path for one call: admission (deadline
+        check + bounded priority queue) → affinity/pack selection →
+        health-cached forwarding with transport-only failover → slot
+        release. Raises typed errors for shed requests; application
+        exceptions from the chosen replica propagate un-retried (never
+        re-run a possibly non-idempotent call elsewhere)."""
+        headers = dict(headers or {})
+        deadline = Deadline.from_header(headers.get(DEADLINE_HEADER))
+        priority, tier = request_priority(headers)
+        key = affinity_key(headers, kwargs)
+        m = telemetry.serve_metrics()
+        self._capacity = max(len(ips), 1) * self.slots
+        attrs = {"tier": tier}
+        if key:
+            attrs["session"] = key
+        with telemetry.span("router.route", **attrs) as sp:
+            self._check_deadline(deadline, tier)
+            await self._admit(priority, tier, deadline)
+            started = time.monotonic()
+            try:
+                order, affinity = self.select(ips, key)
+                m["affinity"].inc(result=affinity)
+                sp.set_attr("affinity", affinity)
+                last_err: Optional[BaseException] = None
+                for target in order:
+                    if target != my_ip and not await self.health.healthy(
+                            pool, target):
+                        continue
+                    depth = self._inflight.get(target, 0) + 1
+                    self._inflight[target] = depth
+                    m["batch_depth"].observe(float(depth))
+                    sp.set_attr("replica", target)
+                    sp.set_attr("batch_depth", depth)
+                    try:
+                        if target == my_ip:
+                            result = await local_call(method, args, kwargs,
+                                                      timeout)
+                        else:
+                            result = await pool.call_worker(
+                                target, self.fn_name, method,
+                                {"args": args, "kwargs": kwargs}, headers,
+                                timeout, subtree=[])
+                    except WorkerCallError as e:
+                        # transport failure: this replica is dead to us —
+                        # down-cache it, forget its sessions, try the next.
+                        # Application exceptions propagate (never re-run a
+                        # possibly non-idempotent call on another pod).
+                        self.health.mark_down(target)
+                        self.sessions.evict_replica(target)
+                        telemetry.add_event("router.failover",
+                                            replica=target)
+                        last_err = e
+                        continue
+                    finally:
+                        self._inflight[target] = \
+                            max(0, self._inflight.get(target, 1) - 1)
+                    if key:
+                        self.sessions.touch(key, target)
+                    return result
+                if last_err is not None:
+                    raise last_err
+                # no healthy peer at all: serve locally rather than fail
+                sp.set_attr("replica", "local-fallback")
+                return await local_call(method, args, kwargs, timeout)
+            finally:
+                self._release(started)
+
+    # -- introspection ------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Router state for ``/health`` and ``kt serve status``."""
+        m = telemetry.serve_metrics()
+        hits = m["affinity"].value(result="hit")
+        misses = m["affinity"].value(result="miss")
+        return {
+            "slots_per_replica": self.slots,
+            "capacity": self._capacity,
+            "active": self._active,
+            "queued": len(self._waiters),
+            "queue_max": self.queue_max,
+            "sessions": len(self.sessions),
+            "ewma_service_s": self._ewma_s,
+            "estimated_wait_s": round(self.estimated_wait_s(), 4),
+            "inflight": {ip: n for ip, n in self._inflight.items() if n},
+            "affinity_hit_rate": (hits / (hits + misses)
+                                  if hits + misses else 0.0),
+        }

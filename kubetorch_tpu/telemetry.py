@@ -688,6 +688,63 @@ def stage(stage_name: str, **attrs: Any) -> _StageTimer:
 
 
 # ---------------------------------------------------------------------------
+# Serving front-door metrics (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+# Replica-packing depth buckets: how full the chosen replica's decode batch
+# was at dispatch (1 = the request opened a fresh batch; higher = it joined
+# a partially-full one — the continuous-batching win, measured).
+BATCH_DEPTH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                       32.0, 48.0, 64.0)
+
+_SERVE_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def serve_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the ``kt_serve_*`` family the inference front door
+    (``serving/router.py``) emits into: admission/shed accounting, affinity
+    routing outcomes, replica batch-packing depth, and the health-probe
+    cache's savings. One place so the series names, labels, and HELP text
+    stay consistent between the router, ``/metrics``, ``kt serve status``,
+    and the bench/gate tooling that parses them."""
+    global _SERVE_METRICS
+    if _SERVE_METRICS is None:
+        _SERVE_METRICS = {
+            "admitted": counter(
+                "kt_serve_admitted_total",
+                "Requests admitted through the serving front door",
+                labels=("tier",)),
+            "shed": counter(
+                "kt_serve_shed_total",
+                "Requests shed at the front door before any prefill "
+                "compute (reason: deadline_expired, doomed, queue_full)",
+                labels=("reason", "tier")),
+            "affinity": counter(
+                "kt_serve_affinity_total",
+                "Affinity routing outcomes (hit = routed to the replica "
+                "where the session's prefix KV / adapter is resident, "
+                "miss = consistent-hash cold placement, none = keyless)",
+                labels=("result",)),
+            "batch_depth": histogram(
+                "kt_serve_batch_depth",
+                "In-flight depth of the chosen replica's decode batch at "
+                "dispatch (continuous batching across replicas)",
+                labels=(), buckets=BATCH_DEPTH_BUCKETS),
+            "queue_depth": gauge(
+                "kt_serve_queue_depth",
+                "Requests waiting in the front door's admission queue"),
+            "probes": counter(
+                "kt_serve_health_probes_total",
+                "Health probes actually sent by the router"),
+            "probes_avoided": counter(
+                "kt_serve_health_probes_avoided_total",
+                "Health probes skipped thanks to the TTL cache "
+                "(the per-dispatch RTT the old supervisor paid)"),
+        }
+    return _SERVE_METRICS
+
+
+# ---------------------------------------------------------------------------
 # Debug endpoint helper (shared by pod + store servers)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Serving front-door bench: open-loop traffic through the REAL router
+(ISSUE 9 / ROADMAP item 3 — the first traffic-shaped benchmark, without
+which "millions of users" is unfalsifiable).
+
+Drives ``serving.router.Router`` — the actual production selection,
+admission, affinity, and shedding code — over an in-process simulated
+replica fleet, with BOTH policies on the SAME seeded arrival schedule:
+
+- **rr**        the pre-ISSUE-9 baseline: blind round-robin, no admission
+  control, no affinity (requests queue unboundedly at replica slots);
+- **affinity**  the front door: continuous batching (slot-packed),
+  session→replica affinity with consistent-hash cold placement, bounded
+  admission queue, deadline/queue shedding.
+
+Each simulated replica models what the engine bench already measures
+per-pod: a slot-limited decode batch, prefill cost ∝ *uncached* prompt
+tokens (an LRU per-replica prefix cache — ``serve/sessions.py``'s
+residency), decode cost ∝ generated tokens. The numbers this bench owns
+are the FLEET-path ones: TTFT p50/p99 under load, shed rate, affinity
+hit rate, aggregate tokens/s. Device-side truths (per-token ms) are
+inputs, not outputs — measured by bench.py / the TPU sweeps.
+
+Defaults: 1200 open-loop sessions × 3 turns (3600 requests), 8 replicas
+× 8 slots, with a mid-run arrival burst that exceeds fleet capacity so
+admission control has something to prove. Run: ``make bench-serve`` or
+``python scripts/bench_serve.py [--sessions 1200] [--replicas 8] ...``.
+Prints a table plus a JSON blob (same convention as bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-only, no TPU relay (see Makefile PY_CPU)
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kubetorch_tpu import telemetry  # noqa: E402
+from kubetorch_tpu.constants import SESSION_HEADER  # noqa: E402
+from kubetorch_tpu.exceptions import (AdmissionShedError,  # noqa: E402
+                                      DeadlineExceededError)
+from kubetorch_tpu.resilience import DEADLINE_HEADER  # noqa: E402
+from kubetorch_tpu.serving.router import Router  # noqa: E402
+
+
+class SimReplica:
+    """One serving pod: a slot-limited continuous-batching engine with an
+    LRU prefix cache. Implements the transport surface the router
+    dispatches through (``check_health`` / ``call_worker`` via
+    :class:`SimPool`)."""
+
+    def __init__(self, ip: str, slots: int, prefill_s_per_tok: float,
+                 decode_s_per_tok: float, resident_cap: int = 256):
+        self.ip = ip
+        self.slots = slots
+        self.prefill_s_per_tok = prefill_s_per_tok
+        self.decode_s_per_tok = decode_s_per_tok
+        self._slots = asyncio.Semaphore(slots)
+        self.resident: "OrderedDict[str, int]" = OrderedDict()
+        self.resident_cap = resident_cap
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.tokens = 0
+
+    async def serve(self, session: Optional[str], prompt_len: int,
+                    new_tokens: int) -> Dict[str, float]:
+        async with self._slots:
+            cached = self.resident.get(session, 0) if session else 0
+            if cached:
+                self.resident.move_to_end(session)
+                self.prefix_hits += 1
+            elif session:
+                self.prefix_misses += 1
+            suffix = max(prompt_len - cached, 1)
+            await asyncio.sleep(suffix * self.prefill_s_per_tok
+                                + self.decode_s_per_tok)
+            ttft_at = time.monotonic()    # first token leaves the slot here
+            await asyncio.sleep((new_tokens - 1) * self.decode_s_per_tok)
+            if session:
+                self.resident.pop(session, None)
+                self.resident[session] = prompt_len
+                while len(self.resident) > self.resident_cap:
+                    self.resident.popitem(last=False)
+            self.tokens += new_tokens
+            return {"ttft_at": ttft_at, "tokens": new_tokens}
+
+
+class SimPool:
+    """The ``RemoteWorkerPool`` surface over the simulated fleet."""
+
+    def __init__(self, replicas: Dict[str, SimReplica]):
+        self.replicas = replicas
+        self.health_probes = 0
+
+    async def check_health(self, ip: str, timeout: float = 2.0) -> bool:
+        self.health_probes += 1
+        return ip in self.replicas
+
+    async def call_worker(self, ip, fn_name, method, body, headers,
+                          timeout=None, subtree=None, sel_ips=None):
+        kw = body["kwargs"]
+        return await self.replicas[ip].serve(
+            headers.get(SESSION_HEADER), kw["prompt_len"], kw["new_tokens"])
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return float("nan")
+    vs = sorted(values)
+    idx = min(int(q * (len(vs) - 1) + 0.5), len(vs) - 1)
+    return vs[idx]
+
+
+def _schedule(args) -> List[Dict]:
+    """The seeded open-loop arrival plan, shared verbatim by both policy
+    runs: every session's turn arrivals are fixed timestamps — completions
+    never gate arrivals (open loop). A burst cohort's first turns land
+    inside a short window to push offered load past fleet capacity."""
+    rng = random.Random(args.seed)
+    plan = []
+    burst = int(args.sessions * args.burst_frac)
+    for s in range(args.sessions):
+        sid = f"sess-{s:05d}"
+        if s < burst:
+            t0 = args.burst_at + rng.random() * args.burst_window
+        else:
+            t0 = rng.random() * args.spread_s
+        for turn in range(args.turns):
+            # think-time variance decorrelates a cohort's follow-up turns
+            # (real users don't reply in lockstep; without this the burst
+            # cohort re-arrives as one wave every turn)
+            plan.append({
+                "session": sid,
+                "at": t0 + turn * args.turn_gap_s * (0.7 + 0.6
+                                                     * rng.random()),
+                "prompt_len": args.header_tokens
+                + (turn + 1) * args.turn_tokens,
+                "new_tokens": args.new_tokens,
+            })
+    plan.sort(key=lambda r: r["at"])
+    return plan
+
+
+async def _run_policy(policy: str, plan: List[Dict], args) -> Dict:
+    ips = [f"10.0.0.{i + 1}" for i in range(args.replicas)]
+    fleet = {ip: SimReplica(ip, args.slots,
+                            args.prefill_us_per_tok / 1e6,
+                            args.decode_us_per_tok / 1e6,
+                            resident_cap=args.resident_cap)
+             for ip in ips}
+    pool = SimPool(fleet)
+    router = Router(fn_name="generate", slots_per_replica=args.slots,
+                    queue_max=args.queue_max, health_ttl_s=5.0)
+    rr_state = {"i": 0}
+    ttfts: List[float] = []
+    shed: Dict[str, int] = {}
+    errors = 0
+
+    async def local_call(method, a, kw, timeout):
+        raise RuntimeError("bench client is not a replica")
+
+    async def one(req: Dict, t_bench0: float) -> None:
+        nonlocal errors
+        arrival = t_bench0 + req["at"]
+        delay = arrival - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        headers = {SESSION_HEADER: req["session"]}
+        if args.deadline_s > 0:
+            headers[DEADLINE_HEADER] = f"{time.time() + args.deadline_s:.6f}"
+        kwargs = {"prompt_len": req["prompt_len"],
+                  "new_tokens": req["new_tokens"]}
+        try:
+            if policy == "affinity":
+                out = await router.dispatch(
+                    pool=pool, ips=ips, my_ip="bench-client", method=None,
+                    args=[], kwargs=kwargs, headers=headers, timeout=None,
+                    local_call=local_call)
+            else:
+                # the pre-front-door baseline: rotate, no admission control
+                ip = ips[rr_state["i"] % len(ips)]
+                rr_state["i"] += 1
+                out = await pool.call_worker(
+                    ip, "generate", None, {"args": [], "kwargs": kwargs},
+                    headers)
+            ttfts.append(out["ttft_at"] - arrival)
+        except (AdmissionShedError, DeadlineExceededError) as e:
+            reason = getattr(e, "reason", None) or "deadline_expired"
+            shed[reason] = shed.get(reason, 0) + 1
+        except Exception:  # noqa: BLE001 — count, don't kill the bench
+            errors += 1
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one(r, t0) for r in plan))
+    wall = time.monotonic() - t0
+    hits = sum(r.prefix_hits for r in fleet.values())
+    misses = sum(r.prefix_misses for r in fleet.values())
+    total_tokens = sum(r.tokens for r in fleet.values())
+    n_shed = sum(shed.values())
+    return {
+        "policy": policy,
+        "requests": len(plan),
+        "completed": len(ttfts),
+        "shed": n_shed,
+        "shed_by_reason": shed,
+        "shed_rate": round(n_shed / len(plan), 4),
+        "errors": errors,
+        "prefix_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else 0.0,
+        "ttft_p50_ms": round(_percentile(ttfts, 0.50) * 1000, 1),
+        "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1000, 1),
+        "tokens_per_s": round(total_tokens / wall, 1),
+        "wall_s": round(wall, 2),
+        "health_probes": pool.health_probes,
+        "router": router.state_dict() if policy == "affinity" else None,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sessions", type=int, default=1200)
+    p.add_argument("--turns", type=int, default=3)
+    p.add_argument("--replicas", type=int, default=8)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--queue-max", type=int, default=256)
+    p.add_argument("--header-tokens", type=int, default=192,
+                   help="shared conversation header (the prefix-cache win)")
+    p.add_argument("--turn-tokens", type=int, default=48)
+    p.add_argument("--new-tokens", type=int, default=24)
+    p.add_argument("--prefill-us-per-tok", type=float, default=400.0)
+    p.add_argument("--decode-us-per-tok", type=float, default=1500.0)
+    p.add_argument("--resident-cap", type=int, default=256,
+                   help="per-replica prefix-cache sessions (engine K/V cap)")
+    p.add_argument("--spread-s", type=float, default=8.0,
+                   help="window over which non-burst sessions start")
+    p.add_argument("--turn-gap-s", type=float, default=2.5)
+    p.add_argument("--burst-frac", type=float, default=0.5,
+                   help="fraction of sessions arriving in the burst")
+    p.add_argument("--burst-at", type=float, default=3.0)
+    p.add_argument("--burst-window", type=float, default=0.4)
+    p.add_argument("--deadline-s", type=float, default=1.5,
+                   help="per-request X-KT-Deadline; 0 disables")
+    p.add_argument("--seed", type=int, default=1234)
+    args = p.parse_args()
+
+    plan = _schedule(args)
+    cap_rps = (args.replicas * args.slots
+               / ((args.header_tokens + args.turn_tokens)
+                  * args.prefill_us_per_tok / 1e6
+                  + args.new_tokens * args.decode_us_per_tok / 1e6))
+    print(f"serve front-door bench: {args.sessions} sessions x "
+          f"{args.turns} turns = {len(plan)} requests, open-loop, "
+          f"{args.replicas} replicas x {args.slots} slots "
+          f"(~{cap_rps:.0f} rps cold capacity), burst "
+          f"{args.burst_frac:.0%} @ t={args.burst_at}s")
+
+    results = {}
+    for policy in ("rr", "affinity"):
+        results[policy] = asyncio.run(_run_policy(policy, plan, args))
+
+    print(f"\n{'policy':<10} {'reqs':>6} {'shed%':>7} {'hit%':>6} "
+          f"{'ttft p50':>10} {'ttft p99':>10} {'tokens/s':>10}")
+    for policy in ("rr", "affinity"):
+        r = results[policy]
+        print(f"{policy:<10} {r['requests']:>6} "
+              f"{r['shed_rate'] * 100:>6.1f}% "
+              f"{r['prefix_hit_rate'] * 100:>5.1f}% "
+              f"{r['ttft_p50_ms']:>8.1f}ms {r['ttft_p99_ms']:>8.1f}ms "
+              f"{r['tokens_per_s']:>10}")
+    rr, aff = results["rr"], results["affinity"]
+    p50_win = (rr["ttft_p50_ms"] / aff["ttft_p50_ms"]
+               if aff["ttft_p50_ms"] else float("nan"))
+    shed_detail = ", ".join(
+        f"{k}={v}" for k, v in sorted(aff["shed_by_reason"].items()))
+    print(f"\naffinity vs round-robin: prefix hit rate "
+          f"{rr['prefix_hit_rate']:.0%} -> {aff['prefix_hit_rate']:.0%}, "
+          f"ttft p50 {p50_win:.2f}x better; admission shed "
+          f"{aff['shed']}/{aff['requests']} ({shed_detail or 'none'}) "
+          f"where rr queued unboundedly (p99 "
+          f"{rr['ttft_p99_ms']:.0f}ms vs {aff['ttft_p99_ms']:.0f}ms)")
+    probes_avoided = telemetry.serve_metrics()["probes_avoided"].value()
+    print(f"health probes actually sent by the router: "
+          f"{aff['health_probes']} for {aff['requests']} dispatches "
+          f"({probes_avoided:.0f} avoided by the TTL cache — the old "
+          f"per-call probe RTT)")
+
+    out = {
+        "metric": "serve_ttft_p99_ms",
+        "value": aff["ttft_p99_ms"],
+        "unit": "ms",
+        "detail": {
+            "requests": len(plan),
+            "concurrent_sessions": args.sessions,
+            "ttft_p50_win_x": round(p50_win, 2),
+            "rr": rr,
+            "affinity": aff,
+        },
+    }
+    print("\n" + json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

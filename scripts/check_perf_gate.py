@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Per-stage perf regression gate (ISSUE 9 satellite / ROADMAP item 5).
+
+``make bench-trace`` proved the telemetry plane itself is ~free; this gate
+spends that instrumentation: it drives the REAL pod-server hot path
+in-process (HTTP POST → deserialize → process-pool submit → rank worker
+echo → response) and compares the measured ``kt_stage_seconds`` p50 for
+the ``deserialize`` and ``queue_wait`` stages against a committed baseline
+(``scripts/perf_baseline.json``). CI fails when either regresses more than
+the tolerance — so this PR and every later one can't silently eat the
+dispatch hot path.
+
+Gate rule (per stage)::
+
+    p50 <= baseline_p50 * (1 + tolerance) + abs_floor_s
+
+``tolerance`` defaults to 0.10 (the ISSUE's >10% rule;
+``KT_PERF_GATE_TOLERANCE`` / ``--tolerance`` override). ``abs_floor_s``
+(default 2ms, ``--abs-floor-ms``) absorbs shared-CI scheduling noise:
+10% of a sub-millisecond p50 is jitter, not a regression — the gate
+exists to catch real ones.
+
+Run: ``make perf-gate``; ``--update`` re-baselines after a DELIBERATE
+hot-path change (commit the JSON with the PR that explains it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU-only, no TPU relay (see Makefile PY_CPU)
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BASELINE_PATH = os.path.join(REPO, "scripts", "perf_baseline.json")
+GATED_STAGES = ("deserialize", "queue_wait")
+
+PAYLOAD_MODULE = textwrap.dedent("""
+    def echo(x):
+        return x
+""")
+
+
+async def _drive(calls: int, payload_kb: int) -> None:
+    """N real calls through the in-process pod server: each one pays the
+    deserialize stage in the server and the queue_wait stage in the
+    process pool — exactly the counters the autoscaler and this gate
+    read."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubetorch_tpu.serving.http_server import ServerState, create_app
+
+    state = ServerState()
+    app = create_app(state)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        # wait out the load+warmup window (worker spawn + module import)
+        for _ in range(600):
+            r = await client.get("/ready")
+            if r.status == 200:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("pod server never became ready")
+        body = json.dumps(
+            {"args": [[1.0] * (payload_kb * 128)], "kwargs": {}})
+        for _ in range(calls):
+            r = await client.post("/echo", data=body,
+                                  headers={"Content-Type":
+                                           "application/json"})
+            assert r.status == 200, await r.text()
+    finally:
+        await client.close()
+
+
+def measure(calls: int, payload_kb: int) -> dict:
+    """{stage: p50 seconds} measured from a fresh registry."""
+    from kubetorch_tpu import telemetry
+    from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
+                                              _quantile_from_buckets)
+    from kubetorch_tpu.serving.env_contract import (
+        KT_CLS_OR_FN_NAME, KT_FILE_PATH, KT_LAUNCH_ID, KT_MODULE_NAME,
+        KT_PROJECT_ROOT)
+
+    with tempfile.TemporaryDirectory() as root:
+        with open(os.path.join(root, "perf_gate_payload.py"), "w") as f:
+            f.write(PAYLOAD_MODULE)
+        os.environ.update({
+            KT_PROJECT_ROOT: root,
+            KT_MODULE_NAME: "perf_gate_payload",
+            KT_FILE_PATH: "perf_gate_payload.py",
+            KT_CLS_OR_FN_NAME: "echo",
+            KT_LAUNCH_ID: "perf-gate",
+        })
+        asyncio.run(_drive(calls, payload_kb))
+    text = telemetry.REGISTRY.render()
+    out = {}
+    for stage in GATED_STAGES:
+        buckets = _parse_histogram_buckets(text, "kt_stage_seconds",
+                                           f'stage="{stage}"')
+        p50 = _quantile_from_buckets(buckets, 0.5)
+        if p50 is None:
+            raise RuntimeError(
+                f"stage {stage!r} recorded no observations — the hot path "
+                "lost its instrumentation (that IS a gate failure)")
+        out[stage] = p50
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--calls", type=int, default=80)
+    p.add_argument("--payload-kb", type=int, default=64)
+    p.add_argument("--tolerance", type=float, default=float(
+        os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
+    p.add_argument("--abs-floor-ms", type=float, default=2.0)
+    p.add_argument("--update", action="store_true",
+                   help="re-baseline (deliberate hot-path changes only; "
+                        "commit the JSON with the explaining PR)")
+    args = p.parse_args()
+
+    measured = measure(args.calls, args.payload_kb)
+
+    if args.update or not os.path.exists(BASELINE_PATH):
+        baseline = {
+            "stages": {s: round(v, 6) for s, v in measured.items()},
+            "calls": args.calls,
+            "payload_kb": args.payload_kb,
+            "note": "p50 seconds per stage from scripts/check_perf_gate.py"
+                    " --update; gate = p50 <= baseline*(1+tol) + floor",
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(f"perf-gate: baseline written to {BASELINE_PATH}: "
+              + ", ".join(f"{s}={v * 1000:.3f}ms"
+                          for s, v in measured.items()))
+        return 0
+
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)["stages"]
+    floor_s = args.abs_floor_ms / 1000.0
+    failures = []
+    for stage in GATED_STAGES:
+        base = float(baseline[stage])
+        limit = base * (1.0 + args.tolerance) + floor_s
+        got = measured[stage]
+        verdict = "ok" if got <= limit else "REGRESSED"
+        print(f"perf-gate: {stage:<12} p50 {got * 1000:8.3f}ms  "
+              f"baseline {base * 1000:8.3f}ms  "
+              f"limit {limit * 1000:8.3f}ms  {verdict}")
+        if got > limit:
+            failures.append(stage)
+    if failures:
+        print(f"\nperf-gate: FAIL — {', '.join(failures)} p50 regressed "
+              f"past baseline*(1+{args.tolerance:g}) + "
+              f"{args.abs_floor_ms:g}ms. Either fix the hot path or, for "
+              "a deliberate trade, re-baseline with --update and justify "
+              "it in the PR.")
+        return 1
+    print("perf-gate: OK — dispatch hot path within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,10 +81,11 @@ WRAPPER_FILES = {"resilience.py", "netpool.py", "ring.py"}
 # deliberate exception:
 BASELINE = {
     # session probe + port-forward health check + the `kt trace` debug
-    # fetch + the `kt store status` /ring + /scrub/status probes — all
-    # single-shot by design (a doctor/debug command that retried would
-    # hang or hide the very flakiness it exists to diagnose)
-    "cli.py": 4,
+    # fetch + the `kt store status` /ring + /scrub/status probes + the
+    # `kt serve status` /health + /metrics probes — all single-shot by
+    # design (a doctor/debug command that retried would hang or hide the
+    # very flakiness it exists to diagnose)
+    "cli.py": 6,
     # daemon-liveness probes in _read_running_local (must not retry: they
     # decide whether to SPAWN a controller) + _request's internals
     "client.py": 4,
@@ -166,6 +167,21 @@ SCHED_APPLY_RE = re.compile(r"backend\s*\.\s*apply\b")
 SCHED_EXEMPT = {"scheduler.py"}
 SCHED_BASELINE = {
     "controller/app.py": 1,   # apply_manifest: BYO passthrough, unscheduled
+}
+
+# Replica-selection decisions in serving/ outside the front-door router
+# (ISSUE 9). router.py owns which replica a call lands on — continuous
+# batching, affinity, admission control, health caching, and failover all
+# live there; a supervisor that calls ``check_health``/``call_worker``
+# itself re-grows the blind per-call-probe round-robin this PR removed
+# (no slot accounting, no shed, an extra RTT per dispatch).
+# remote_worker_pool.py is exempt (it IS the transport the router rides);
+# the baselined sites are SPMD's rank-identity tree fan-out — every
+# selected worker is called, so there is no selection decision to make.
+ROUTE_RE = re.compile(r"\.call_worker\(|\bcheck_health\(")
+ROUTE_EXEMPT = {"router.py", "remote_worker_pool.py"}
+ROUTE_BASELINE = {
+    "serving/spmd_supervisor.py": 3,   # tree fan-out + quorum health gate
 }
 
 # Raw single-origin store-URL building in data_store/ outside the ring
@@ -274,6 +290,30 @@ def main() -> int:
               "under its final content-addressed name. For client-side "
               "rebuildable targets update REPLACE_BASELINE with a "
               "justification.")
+        return 1
+
+    route_failures = []
+    route_counts = {}
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        if path.name in ROUTE_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, ROUTE_RE)
+        if n:
+            route_counts[rel] = n
+        allowed = ROUTE_BASELINE.get(rel, 0)
+        if n > allowed:
+            route_failures.append(
+                f"  {rel}: {n} raw replica-selection site(s), baseline "
+                f"allows {allowed}")
+    if route_failures:
+        print("check_resilience: raw replica selection bypasses the "
+              "serving front door:\n" + "\n".join(route_failures))
+        print("\nWhich replica a call lands on is decided ONLY in "
+              "serving/router.py (continuous batching, affinity, admission "
+              "control, cached health, failover). Route dispatches through "
+              "Router.dispatch; for deliberate fan-out sites update "
+              "ROUTE_BASELINE with a justification.")
         return 1
 
     origin_failures = []
@@ -386,6 +426,8 @@ def main() -> int:
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in ORIGIN_BASELINE.items()
            if origin_counts.get(f, 0) < allowed]
+        + [f for f, allowed in ROUTE_BASELINE.items()
+           if route_counts.get(f, 0) < allowed]
         + [f for f, allowed in SCHED_BASELINE.items()
            if sched_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
@@ -401,9 +443,9 @@ def main() -> int:
               + ", ".join(stale) + ")")
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
-              "checks, store-origin resolutions, controller placements, "
-              "data-store commit renames, checkpoint writes, and telemetry "
-              "sites accounted for")
+              "checks, replica selections, store-origin resolutions, "
+              "controller placements, data-store commit renames, "
+              "checkpoint writes, and telemetry sites accounted for")
     return 0
 
 

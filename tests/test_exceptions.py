@@ -25,6 +25,8 @@ _ATTR_SAMPLES = {
     "worker": "10.0.0.7",
     "deadline": 1722787200.25,
     "retry_after": 2.5,
+    "tier": "batch",
+    "queue_depth": 17,
     "cause": "OOMKilled",
     "rank": 2,
     "exitcode": -9,

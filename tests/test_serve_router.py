@@ -1,0 +1,526 @@
+"""Serving front-door suite (ISSUE 9): router packing/affinity/admission,
+deadline shedding BEFORE prefill, the health TTL cache, session glue, and
+the queue-wait autoscaler's histogram math. ``make test-serve``."""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from kubetorch_tpu import telemetry
+from kubetorch_tpu.constants import (PRIORITY_HEADER, SESSION_HEADER)
+from kubetorch_tpu.exceptions import (AdmissionShedError,
+                                      DeadlineExceededError, WorkerCallError,
+                                      package_exception, rehydrate_exception)
+from kubetorch_tpu.resilience import DEADLINE_HEADER
+from kubetorch_tpu.serving.router import (HealthCache, Router, SessionTable,
+                                          affinity_key)
+
+pytestmark = pytest.mark.serve
+
+IPS = ["10.1.0.1", "10.1.0.2", "10.1.0.3"]
+MY_IP = "9.9.9.9"          # the router host itself is not a replica here
+
+
+class FakePool:
+    """The RemoteWorkerPool surface, scripted: per-ip health, per-ip
+    transport failure, optional per-ip blocking (to hold slots busy)."""
+
+    def __init__(self):
+        self.health = {}              # ip -> bool (default True)
+        self.fail = set()             # ips that raise WorkerCallError
+        self.block = {}               # ip -> asyncio.Event gating return
+        self.app_error = set()        # ips that raise an app exception
+        self.health_calls = []
+        self.calls = []
+
+    async def check_health(self, ip, timeout=2.0):
+        self.health_calls.append(ip)
+        return self.health.get(ip, True)
+
+    async def call_worker(self, ip, fn_name, method, body, headers,
+                          timeout=None, subtree=None, sel_ips=None):
+        self.calls.append(ip)
+        if ip in self.fail:
+            raise WorkerCallError(f"worker {ip} unreachable", worker=ip)
+        if ip in self.app_error:
+            raise ValueError("application failure from the replica")
+        ev = self.block.get(ip)
+        if ev is not None:
+            await ev.wait()
+        return {"served_by": ip}
+
+
+async def _local_call(method, args, kwargs, timeout):
+    return {"served_by": "local"}
+
+
+def _dispatch(router, pool, headers=None, kwargs=None, ips=None,
+              my_ip=MY_IP):
+    return router.dispatch(pool=pool, ips=ips or IPS, my_ip=my_ip,
+                           method=None, args=[], kwargs=kwargs or {},
+                           headers=headers, timeout=None,
+                           local_call=_local_call)
+
+
+def _counter(key, **labels):
+    # through serve_metrics() so the labeled family exists before any
+    # read (a bare REGISTRY.counter(name) would declare it label-less)
+    return telemetry.serve_metrics()[key].value(**labels)
+
+
+# ---------------------------------------------------------------------------
+# selection: packing + affinity
+# ---------------------------------------------------------------------------
+
+
+def test_idle_fleet_rotates_round_robin():
+    """Sequential keyless traffic on an idle fleet degenerates to the old
+    round-robin — every replica sees work."""
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        for _ in range(len(IPS) * 2):
+            await _dispatch(router, pool)
+        return pool.calls
+    calls = asyncio.run(body())
+    assert set(calls) == set(IPS)
+
+
+def test_concurrent_keyless_requests_pack_into_partial_batches():
+    """Continuous batching across replicas: while a replica has a
+    partially-full batch, new keyless requests join IT rather than
+    spreading one-deep everywhere."""
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        for ip in IPS:
+            pool.block[ip] = asyncio.Event()
+        t1 = asyncio.ensure_future(_dispatch(router, pool))
+        await asyncio.sleep(0.01)
+        first = pool.calls[0]
+        t2 = asyncio.ensure_future(_dispatch(router, pool))
+        t3 = asyncio.ensure_future(_dispatch(router, pool))
+        await asyncio.sleep(0.01)
+        for ev in pool.block.values():
+            ev.set()
+        await asyncio.gather(t1, t2, t3)
+        return first, pool.calls
+    first, calls = asyncio.run(body())
+    assert calls == [first] * 3, \
+        f"requests spread instead of packing: {calls}"
+
+
+def test_packed_replica_overflows_to_next_when_full():
+    async def body():
+        router = Router(slots_per_replica=2, health_ttl_s=60)
+        pool = FakePool()
+        for ip in IPS:
+            pool.block[ip] = asyncio.Event()
+        tasks = [asyncio.ensure_future(_dispatch(router, pool))
+                 for _ in range(3)]
+        await asyncio.sleep(0.02)
+        seen = list(pool.calls)
+        for ev in pool.block.values():
+            ev.set()
+        await asyncio.gather(*tasks)
+        return seen
+    seen = asyncio.run(body())
+    # 2 pack into the first replica's batch, the 3rd overflows elsewhere
+    assert len(seen) == 3 and seen[0] == seen[1] and seen[2] != seen[0]
+
+
+def test_affinity_session_sticks_and_counts():
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        h = {SESSION_HEADER: "sess-A"}
+        hit0 = _counter("affinity", result="hit")
+        miss0 = _counter("affinity", result="miss")
+        first = await _dispatch(router, pool, headers=h)
+        out = [await _dispatch(router, pool, headers=h) for _ in range(3)]
+        hits = _counter("affinity", result="hit") - hit0
+        misses = _counter("affinity", result="miss") - miss0
+        return first, out, hits, misses
+    first, out, hits, misses = asyncio.run(body())
+    assert all(o == first for o in out), "session moved between replicas"
+    assert misses == 1 and hits == 3    # cold placement once, then resident
+
+
+def test_cold_placement_is_consistent_hash_across_routers():
+    """Two independent routers (different pods' front doors) place the
+    same cold session on the same replica — residency accretes in one
+    place with zero coordination."""
+    async def body():
+        pool = FakePool()
+        homes = []
+        for _ in range(2):
+            router = Router(slots_per_replica=4, health_ttl_s=60)
+            out = await _dispatch(router, pool,
+                                  headers={SESSION_HEADER: "sess-X"})
+            homes.append(out["served_by"])
+        return homes
+    homes = asyncio.run(body())
+    assert homes[0] == homes[1]
+
+
+def test_failover_on_transport_error_evicts_sessions():
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        h = {SESSION_HEADER: "sess-B"}
+        first = (await _dispatch(router, pool, headers=h))["served_by"]
+        pool.fail.add(first)
+        second = (await _dispatch(router, pool, headers=h))["served_by"]
+        # the dead replica's residency is forgotten; the session now lives
+        # on the failover target and stays there
+        third = (await _dispatch(router, pool, headers=h))["served_by"]
+        return first, second, third
+    first, second, third = asyncio.run(body())
+    assert second != first and third == second
+
+
+def test_application_errors_propagate_without_failover():
+    """An app exception from the chosen replica must surface, never re-run
+    a (possibly non-idempotent) call on another pod."""
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        pool.app_error = set(IPS)
+        with pytest.raises(ValueError):
+            await _dispatch(router, pool)
+        return pool.calls
+    calls = asyncio.run(body())
+    assert len(calls) == 1
+
+
+def test_all_replicas_dead_falls_back_to_local():
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        pool.health = {ip: False for ip in IPS}
+        return await _dispatch(router, pool)
+    assert asyncio.run(body())["served_by"] == "local"
+
+
+# ---------------------------------------------------------------------------
+# health TTL cache (satellite: the per-dispatch probe RTT fix)
+# ---------------------------------------------------------------------------
+
+
+def test_health_cache_avoids_per_dispatch_probes():
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        avoided0 = _counter("probes_avoided")
+        for _ in range(6):
+            await _dispatch(router, pool)
+        avoided = _counter("probes_avoided") - avoided0
+        return pool.health_calls, avoided
+    health_calls, avoided = asyncio.run(body())
+    # one real probe per replica; everything else served from the cache
+    assert len(health_calls) <= len(IPS)
+    assert avoided >= 3
+
+
+def test_health_cache_ttl_expires_and_error_marks_down():
+    async def body():
+        cache = HealthCache(ttl_s=0.05)
+        pool = FakePool()
+        assert await cache.healthy(pool, "10.0.0.9")
+        assert await cache.healthy(pool, "10.0.0.9")   # cached
+        n_cached = len(pool.health_calls)
+        await asyncio.sleep(0.06)
+        assert await cache.healthy(pool, "10.0.0.9")   # TTL lapsed: probe
+        n_expired = len(pool.health_calls)
+        cache.mark_down("10.0.0.9")
+        # a failed CALL is stronger evidence than any probe: down without
+        # probing, for a full TTL
+        assert not await cache.healthy(pool, "10.0.0.9")
+        return n_cached, n_expired, len(pool.health_calls)
+    n_cached, n_expired, n_final = asyncio.run(body())
+    assert n_cached == 1 and n_expired == 2 and n_final == 2
+
+
+# ---------------------------------------------------------------------------
+# admission control + shedding
+# ---------------------------------------------------------------------------
+
+
+def test_expired_deadline_shed_at_door_without_touching_replicas():
+    async def body():
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        pool = FakePool()
+        with pytest.raises(DeadlineExceededError):
+            await _dispatch(router, pool, headers={
+                DEADLINE_HEADER: f"{time.time() - 1.0:.6f}"})
+        return pool.calls, pool.health_calls
+    calls, health_calls = asyncio.run(body())
+    assert calls == [] and health_calls == []
+
+
+def test_doomed_request_sheds_with_429_semantics():
+    async def body():
+        ips = [IPS[0]]
+        router = Router(slots_per_replica=1, health_ttl_s=60)
+        pool = FakePool()
+        pool.block[ips[0]] = asyncio.Event()
+        t1 = asyncio.ensure_future(
+            _dispatch(router, pool, ips=ips))           # holds the slot
+        await asyncio.sleep(0.01)
+        t2 = asyncio.ensure_future(
+            _dispatch(router, pool, ips=ips))           # queues
+        await asyncio.sleep(0.01)
+        router._ewma_s = 5.0          # measured service time: 5s/request
+        with pytest.raises(AdmissionShedError) as ei:
+            await _dispatch(router, pool, ips=ips, headers={
+                DEADLINE_HEADER: f"{time.time() + 0.5:.6f}"})
+        pool.block[ips[0]].set()
+        await asyncio.gather(t1, t2)
+        return ei.value
+    err = asyncio.run(body())
+    assert err.reason == "doomed" and err.retry_after > 0.5
+    # and it round-trips typed through the exception registry (what the
+    # HTTP 429 body carries)
+    back = rehydrate_exception(package_exception(err))
+    assert isinstance(back, AdmissionShedError)
+    assert back.reason == "doomed" and back.retry_after == err.retry_after
+
+
+def test_queue_full_sheds_lowest_tier_first():
+    async def body():
+        ips = [IPS[0]]
+        router = Router(slots_per_replica=1, queue_max=1, health_ttl_s=60)
+        pool = FakePool()
+        pool.block[ips[0]] = asyncio.Event()
+        holder = asyncio.ensure_future(_dispatch(router, pool, ips=ips))
+        await asyncio.sleep(0.01)
+        batch = asyncio.ensure_future(_dispatch(
+            router, pool, ips=ips, headers={PRIORITY_HEADER: "batch"}))
+        await asyncio.sleep(0.01)
+        # a batch-tier arrival against a full queue sheds ITSELF
+        with pytest.raises(AdmissionShedError) as low:
+            await _dispatch(router, pool, ips=ips,
+                            headers={PRIORITY_HEADER: "batch"})
+        # a high-tier arrival evicts the queued batch request instead
+        high = asyncio.ensure_future(_dispatch(
+            router, pool, ips=ips, headers={PRIORITY_HEADER: "high"}))
+        await asyncio.sleep(0.01)
+        with pytest.raises(AdmissionShedError) as evicted:
+            await batch
+        pool.block[ips[0]].set()
+        await asyncio.gather(holder, high)
+        return low.value, evicted.value
+    low, evicted = asyncio.run(body())
+    assert low.reason == "queue_full" and low.tier == "batch"
+    assert evicted.reason == "queue_full" and evicted.tier == "batch"
+
+
+def test_admission_queue_observes_queue_wait_stage():
+    async def body():
+        ips = [IPS[0]]
+        router = Router(slots_per_replica=1, health_ttl_s=60)
+        pool = FakePool()
+        pool.block[ips[0]] = asyncio.Event()
+        before = telemetry.stage_histogram().count(stage="queue_wait")
+        holder = asyncio.ensure_future(_dispatch(router, pool, ips=ips))
+        await asyncio.sleep(0.01)
+        queued = asyncio.ensure_future(_dispatch(router, pool, ips=ips))
+        await asyncio.sleep(0.01)
+        pool.block[ips[0]].set()
+        await asyncio.gather(holder, queued)
+        return telemetry.stage_histogram().count(stage="queue_wait") - before
+    assert asyncio.run(body()) >= 1
+
+
+# ---------------------------------------------------------------------------
+# SessionTable
+# ---------------------------------------------------------------------------
+
+
+def test_session_table_lru_ttl_and_replica_eviction():
+    t = SessionTable(capacity=2, ttl_s=0.05)
+    t.touch("a", "ip1")
+    t.touch("b", "ip2")
+    assert t.lookup("a") == "ip1"
+    t.touch("c", "ip1")                   # capacity 2: LRU "b" evicted
+    assert t.lookup("b") is None
+    assert t.evict_replica("ip1") == 2    # a + c forgotten with the pod
+    t.touch("d", "ip3")
+    time.sleep(0.06)
+    assert t.lookup("d") is None          # TTL lapsed
+
+
+def test_affinity_key_extraction():
+    assert affinity_key({SESSION_HEADER: "s1"}, {}) == "s1"
+    assert affinity_key({}, {"session_id": 7}) == "session_id:7"
+    assert affinity_key({}, {"adapter_id": 3}) == "adapter_id:3"
+    assert affinity_key({}, {"x": 1}) is None
+    # header wins over kwargs
+    assert affinity_key({SESSION_HEADER: "s1"},
+                        {"session_id": 7}) == "s1"
+
+
+# ---------------------------------------------------------------------------
+# serve/sessions.py — the engine-side glue
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    def __init__(self):
+        self.next_pid = 0
+        self.registered = {}          # pid -> (tokens, adapter)
+        self.submits = []             # (prompt, prefix_id, adapter_id)
+
+    def register_prefix(self, tokens, adapter_id=None):
+        pid = self.next_pid
+        self.next_pid += 1
+        self.registered[pid] = (list(tokens), adapter_id)
+        return pid
+
+    def unregister_prefix(self, pid):
+        return self.registered.pop(pid, None) is not None
+
+    def submit(self, prompt, prefix_id=None, adapter_id=None, **kw):
+        self.submits.append((list(prompt), prefix_id, adapter_id))
+        return f"handle-{len(self.submits)}"
+
+
+def test_binder_reuses_session_prefix_for_later_turns():
+    from kubetorch_tpu.serve.sessions import EngineSessionBinder
+    eng = FakeEngine()
+    b = EngineSessionBinder(eng, capacity=4, min_prefix_tokens=2)
+    turn1 = list(range(20))
+    b.submit("s1", turn1)
+    assert eng.submits[-1] == (turn1, None, None)      # cold: full prefill
+    assert len(eng.registered) == 1                    # turn 1 now resident
+    turn2 = turn1 + [100, 101, 102]
+    b.submit("s1", turn2)
+    # only the suffix prefills, against the resident prefix
+    assert eng.submits[-1] == ([100, 101, 102], 0, None)
+    s = b.stats()
+    assert s.hits == 1 and s.misses == 1 and s.sessions == 1
+
+
+def test_binder_adapter_mismatch_is_a_miss():
+    from kubetorch_tpu.serve.sessions import EngineSessionBinder
+    eng = FakeEngine()
+    b = EngineSessionBinder(eng, capacity=4, min_prefix_tokens=2)
+    prompt = list(range(10))
+    b.submit("s1", prompt, adapter_id=None)
+    b.submit("s1", prompt + [99], adapter_id=7)        # different adapter
+    assert eng.submits[-1][1] is None                  # no prefix reuse
+    assert b.stats().misses == 2
+
+
+def test_binder_lru_eviction_unregisters_device_state():
+    from kubetorch_tpu.serve.sessions import EngineSessionBinder
+    eng = FakeEngine()
+    b = EngineSessionBinder(eng, capacity=2, min_prefix_tokens=2)
+    for i in range(3):
+        b.submit(f"s{i}", list(range(10 + i)))
+    assert len(eng.registered) == 2                    # LRU evicted + freed
+    assert b.stats().evictions == 1
+    assert b.release("s2") and len(eng.registered) == 1
+    metrics = b.__kt_metrics__()
+    assert metrics["sessions_resident"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# queue-wait autoscaler math (controller)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_parse_and_quantile():
+    from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
+                                              _quantile_from_buckets)
+    h = telemetry.Histogram("t_qw", "", ("stage",),
+                            buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.05, 0.3, 0.3, 0.3, 0.7, 0.7, 0.9, 2.0, 2.0):
+        h.observe(v, stage="queue_wait")
+    text = "\n".join(h.render()) + "\n"
+    buckets = _parse_histogram_buckets(text, "t_qw", 'stage="queue_wait"')
+    assert buckets["+Inf"] == 10 and buckets["0.1"] == 2
+    p50 = _quantile_from_buckets(buckets, 0.5)
+    assert 0.1 < p50 <= 0.5
+    # p90 falls in the +Inf bucket: clamps to the last finite edge
+    assert _quantile_from_buckets(buckets, 0.95) == 1.0
+    assert _quantile_from_buckets({}, 0.9) is None
+
+
+def test_serve_slo_resolution():
+    from kubetorch_tpu.controller.app import _serve_slo_s
+    assert _serve_slo_s({}) == 0.0                     # default: disabled
+    assert _serve_slo_s({"slo_ms": 250}) == 0.25
+    os.environ["KT_SERVE_SLO_MS"] = "100"
+    try:
+        assert _serve_slo_s({}) == 0.1
+        assert _serve_slo_s({"slo_ms": 500}) == 0.5    # per-service wins
+    finally:
+        del os.environ["KT_SERVE_SLO_MS"]
+    assert _serve_slo_s({"slo_ms": "junk"}) == 0.0
+
+
+def test_chaos_shed_verb_parses():
+    from kubetorch_tpu.chaos import parse_spec
+    faults = parse_spec("shed:0.5,shed")
+    assert [f.kind for f in faults] == ["shed", "shed"]
+    assert faults[0].retry_after == 0.5 and faults[1].retry_after is None
+
+
+# ---------------------------------------------------------------------------
+# shed-before-prefill, end to end through the pod server (satellite 3):
+# chaos delays the request past its deadline BEFORE dispatch; the typed
+# error rehydrates client-side and NO execute stage span exists.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_shed_before_prefill_no_execute_span():
+    from kubetorch_tpu.serving.env_contract import METADATA_KEYS
+
+    from .test_http_server import run_server_test, set_fn_metadata
+
+    saved = {k: os.environ.get(k) for k in METADATA_KEYS}
+    os.environ["KT_CHAOS"] = "delay:0.25"
+    try:
+        async def body(client, state):
+            set_fn_metadata("summer")
+            state.launch_id = "launch-1"
+            state.prewarm_supervisor()
+            telemetry.RING.clear()
+            # expires DURING the injected pre-dispatch delay: the deadline
+            # middleware sheds it before run_callable ever runs
+            r = await client.post(
+                "/summer", json={"args": [1, 2], "kwargs": {}},
+                headers={DEADLINE_HEADER: f"{time.time() + 0.05:.6f}"})
+            assert r.status == 504
+            rid = r.headers["X-Request-ID"]
+            err = rehydrate_exception(json.loads(await r.text()))
+            assert isinstance(err, DeadlineExceededError)
+            spans = telemetry.RING.find(rid)
+            names = [s["name"] for s in spans]
+            assert "server.request" in names, names
+            assert "stage.execute" not in names, \
+                f"shed request still burned prefill compute: {names}"
+            assert "stage.deserialize" not in names
+
+            # control: the schedule is exhausted, so the next request runs
+            # normally — and DOES emit the execute span (the assertion
+            # above is not vacuous)
+            r = await client.post("/summer",
+                                  json={"args": [1, 2], "kwargs": {}})
+            assert r.status == 200 and await r.json() == 3
+            rid2 = r.headers["X-Request-ID"]
+            names2 = [s["name"] for s in telemetry.RING.find(rid2)]
+            assert "stage.execute" in names2, names2
+        run_server_test(body)
+    finally:
+        del os.environ["KT_CHAOS"]
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

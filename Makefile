@@ -7,11 +7,15 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath smoke-tpu dryrun native clean
 
-# full matrix (everything but the real-chip tier) — the release gate
+# full matrix (everything but the real-chip tier) — the release gate.
+# perf-gate rides along (ISSUE 10): the full five-stage dispatch budget
+# (deserialize/queue_wait/execute/store_fetch/shm_copy) is enforced on
+# every release-gate run, not just when someone remembers to ask.
 test:
 	$(PY_CPU) python -m pytest tests/ -q
+	$(PY_CPU) python scripts/check_perf_gate.py
 
 # fast default tier (<3 min): skips the jit-heavy pipeline/parallel/model
 # release matrix; run before every commit
@@ -56,11 +60,17 @@ test-serve:
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
 
-# per-stage perf regression gate (ISSUE 9 satellite / ROADMAP item 5):
-# deserialize + queue_wait p50 through the real pod-server path vs the
+# per-stage perf regression gate (ISSUE 9, expanded in ISSUE 10 to the
+# full stage set): deserialize/queue_wait/execute/store_fetch/shm_copy
+# p50 through the real pod-server + store + shm-envelope paths vs the
 # committed baseline (scripts/perf_baseline.json); >10%+floor fails
 perf-gate:
 	$(PY_CPU) python scripts/check_perf_gate.py
+
+# zero-copy envelope suite (ISSUE 10): ring protocol units, e2e pool
+# round trips, chaos shm-corrupt -> typed fallback, /dev/shm lifecycle
+test-shm:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_shm_ring.py -q
 
 bench:
 	python bench.py
@@ -90,6 +100,12 @@ bench-ckpt:
 # rr-vs-affinity on the same seeded arrival schedule
 bench-serve:
 	$(PY_CPU) python scripts/bench_serve.py
+
+# dispatch hot-path bench (ISSUE 10): shm envelopes vs the mp-queue path
+# through the REAL process pool — p50/p99 per stage-size, MB/s, and the
+# msgpack-vs-shm crossover + 2x points, BENCH-tracked
+bench-hotpath:
+	$(PY_CPU) python scripts/bench_hotpath.py
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -21,6 +21,7 @@ once, and the backoff sequence matches the policy".
              | kill-rank:SIG@OP_INDEX           (process-level; see below)
              | term-rank:GRACE_S@OP_INDEX       (process-level; see below)
              | kill-store-node[:SIG]@OP_INDEX   (process-level; see below)
+             | shm-corrupt                      (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
   matching request consumes the first unconsumed token whose path filter
@@ -97,6 +98,15 @@ Fault kinds:
   exempt probe/ring routes never advance the op counter, so the kill
   lands on exactly the client request the test scheduled it for.
 
+- ``shm-corrupt``  **process-level** fault (zero-copy envelope path,
+  ISSUE 10): the next shared-memory array envelope this process encodes
+  (``serving/shm_ring.py``) gets one byte flipped in the ring *after* the
+  write and *before* the header is queued. The decode side's blake2b
+  check must raise a typed ``DataCorruptionError(source="shm")`` and the
+  pool must retry the call once over the classic queue path — garbage
+  never reaches ``device_put``. ``*COUNT`` corrupts the first COUNT
+  envelopes. Consumed by the encoder, invisible to the HTTP middleware.
+
 Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
 connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
 """
@@ -135,10 +145,14 @@ EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
           "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
-          "term-rank", "kill-store-node", "shed")
+          "term-rank", "kill-store-node", "shed", "shm-corrupt")
 
-# verbs consumed by the rank worker loop, not the HTTP middleware
-_RANK_KINDS = ("kill-rank", "term-rank")
+# verbs consumed outside the HTTP middleware: the rank worker loop
+# (kill/term-rank) and the shared-memory envelope encoder (shm-corrupt,
+# serving/shm_ring.py — flips a byte of a written envelope before its
+# header is queued, proving the decode-side blake2b check + the
+# fall-back-to-queue-path retry)
+_RANK_KINDS = ("kill-rank", "term-rank", "shm-corrupt")
 
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
 _OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node",)
@@ -254,7 +268,7 @@ def _parse_one(token: str, raw: str) -> Fault:
             except ValueError:
                 raise ChaosError(f"bad torn-write byte count in {raw!r}")
         return fault
-    if head in ("disk-full", "corrupt-blob"):
+    if head in ("disk-full", "corrupt-blob", "shm-corrupt"):
         return Fault(kind=head)
     if head.isdigit():
         fault = Fault(kind="status", status=int(head))
@@ -392,6 +406,17 @@ def rank_term_plan(spec: Optional[str] = None) -> Dict[int, float]:
     stand-in the drain-and-checkpoint path is tested with."""
     return {f.op_index: f.grace_s
             for f in _rank_faults("term-rank", spec)}
+
+
+def shm_corrupt_plan(spec: Optional[str] = None) -> int:
+    """How many shared-memory envelopes this process should corrupt (one
+    flipped byte each, write-side, before the header is queued) — the
+    count of ``shm-corrupt`` tokens in ``KT_CHAOS``. Consumed by
+    ``serving/shm_ring.py``'s encoder; proves the decode-side blake2b
+    check raises a typed ``DataCorruptionError`` and the call falls back
+    to the msgpack/queue path instead of feeding garbage to
+    ``device_put``."""
+    return len(_rank_faults("shm-corrupt", spec))
 
 
 def deliver_term_with_grace(pid: int, grace_s: float,

@@ -107,6 +107,17 @@ class KTConfig:
     serve_health_ttl_s: float = 2.0
     serve_session_ttl_s: float = 600.0
     serve_slo_ms: float = 0.0
+    # zero-copy dispatch envelopes (serving/shm_ring.py, ISSUE 10). Same
+    # env layering (KT_SHM_THRESHOLD / KT_SHM_RING_BYTES). shm_threshold
+    # is the minimum array byte size that rides a shared-memory ring
+    # between the pod server and its rank workers instead of the mp
+    # queue; 0 (the default) disables the path byte-identically — opt-in
+    # because it spends /dev/shm, a sized resource in pods (see
+    # docs/operations.md "/dev/shm sizing"). shm_ring_bytes is the
+    # per-direction per-worker segment size; arrays larger than the ring
+    # (or arriving while it is full) fall back to the queue path.
+    shm_threshold: int = 0
+    shm_ring_bytes: int = 64 * 1024 * 1024
     # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
     # recording everywhere (the fast path stays allocation-free, see `make
     # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing

@@ -205,16 +205,32 @@ def _kv_diff(url: str, hashes: Dict[str, str]) -> set:
     the set of keys whose bytes can be skipped. Wire shape mirrors
     ``/tree/diff``: ``{keys: {key: blake2b}} → {missing: [key, ...]}``.
     A store without the endpoint (pre-delta build) skips nothing. On a
-    fleet any live node answers (the server fans the probe ring-wide)."""
+    fleet any live node answers (the server fans the probe ring-wide).
+
+    Delta bodies compress past ``COMPRESS_MIN_BYTES`` (ISSUE 10): pure
+    hash tables shrink 2-3x and this probe precedes every put. Negotiated
+    per request — ``Content-Encoding`` on the way out, ``Accept-Encoding``
+    for the reply — so either side can be a build without the codec."""
     if not hashes:
         return set()
     try:
+        payload = json.dumps({"keys": hashes}).encode()
+        headers = {"Content-Type": "application/json",
+                   "Accept-Encoding": netpool.offered_codings()}
+        coding = netpool.best_coding(netpool.offered_codings())
+        if coding and len(payload) >= netpool.COMPRESS_MIN_BYTES:
+            payload = netpool.compress_body(payload, coding)
+            headers["Content-Encoding"] = coding
         r = ring.ring_for(url).request("POST", "/kv/diff",
-                                       json={"keys": hashes},
+                                       data=payload, headers=headers,
                                        timeout=netpool.store_timeout(60))
         if r.status_code != 200:
             return set()
-        return set(hashes) - set(r.json()["missing"])
+        body = r.content
+        resp_coding = (r.headers.get("Content-Encoding") or "").lower()
+        if resp_coding in ("zstd", "zlib"):
+            body = netpool.decompress_body(body, resp_coding)
+        return set(hashes) - set(json.loads(body)["missing"])
     except (_requests.RequestException, ValueError, KeyError,
             DataStoreError):
         return set()

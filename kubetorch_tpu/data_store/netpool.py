@@ -38,6 +38,64 @@ DEFAULT_CONCURRENCY = 8
 DEFAULT_TIMEOUT_S = 600.0
 
 
+# -- delta-body compression (ISSUE 10) ---------------------------------------
+# /kv/diff bodies are pure hash tables ({key: blake2b} in, {missing} out):
+# thousands of hex strings compress 2-3x, and at fleet scale the diff probe
+# runs before EVERY put. Negotiated via Accept-Encoding/Content-Encoding with
+# deliberately non-transport tokens — "zstd" when the optional zstandard
+# module exists, stdlib "zlib" otherwise — so urllib3/aiohttp transport
+# layers never auto-decode behind our back and both sides stay symmetric.
+
+COMPRESS_MIN_BYTES = 1024
+
+
+def _zstd():
+    try:
+        import zstandard
+        return zstandard
+    except ImportError:
+        return None
+
+
+def offered_codings() -> str:
+    """The ``Accept-Encoding`` value this client offers."""
+    return "zstd, zlib" if _zstd() is not None else "zlib"
+
+
+def best_coding(accept: Optional[str]) -> Optional[str]:
+    """Pick the best body coding both sides speak, or None."""
+    tokens = {t.split(";")[0].strip().lower()
+              for t in (accept or "").split(",")}
+    if "zstd" in tokens and _zstd() is not None:
+        return "zstd"
+    if "zlib" in tokens:
+        return "zlib"
+    return None
+
+
+def compress_body(data: bytes, coding: str) -> bytes:
+    if coding == "zstd":
+        return _zstd().ZstdCompressor().compress(data)
+    if coding == "zlib":
+        import zlib
+        return zlib.compress(data, level=3)
+    raise ValueError(f"unknown body coding {coding!r}")
+
+
+def decompress_body(data: bytes, coding: Optional[str]) -> bytes:
+    if not coding:
+        return data
+    if coding == "zstd":
+        z = _zstd()
+        if z is None:
+            raise ValueError("zstd body but no zstandard module")
+        return z.ZstdDecompressor().decompress(data)
+    if coding == "zlib":
+        import zlib
+        return zlib.decompress(data)
+    raise ValueError(f"unknown body coding {coding!r}")
+
+
 def urlkey(key: str) -> str:
     """Percent-encode a store key for a URL path, keeping ``/`` as the
     separator. The server decodes exactly once (aiohttp), so a key with a

@@ -491,11 +491,23 @@ async def _replicate_object(app: web.Application, key: str, path: str,
                             acks=acks + 1, want=need_sync + 1)
 
 
+PROXY_CHUNK = 1 << 20           # streamed proxy-relay granularity
+
+
 async def _proxy_fetch(request: web.Request, key: str, path: str,
-                       kind: str) -> Optional[web.Response]:
+                       kind: str) -> Optional[web.StreamResponse]:
     """Local miss on a multi-node ring: answer from whichever sibling
     holds the object — any node can serve any key. Internal requests never
-    proxy (that is how the recursion terminates)."""
+    proxy (that is how the recursion terminates).
+
+    The relay STREAMS (ISSUE 10): each upstream chunk is written to the
+    client as it arrives, so a ring-wide proxy read of a multi-GB blob
+    holds O(chunk) RSS on this node — the same discipline streaming PUTs
+    have had since ISSUE 1 — instead of buffering the whole body. A
+    sibling that dies mid-stream can no longer be papered over (bytes
+    already left for the client); the truncated body fails the client's
+    blake2b verification and its routed retry lands on a live replica.
+    """
     import aiohttp
 
     st = _state(request)
@@ -503,6 +515,7 @@ async def _proxy_fetch(request: web.Request, key: str, path: str,
     if not ring.multi or _internal(request):
         return None
     for base in [u for u in ring.walk(key) if u != ring.self_url]:
+        resp: Optional[web.StreamResponse] = None
         try:
             async with request.app["ring_http"].request(
                     request.method, f"{base}{path}",
@@ -511,17 +524,33 @@ async def _proxy_fetch(request: web.Request, key: str, path: str,
                         total=_INTERNAL_TIMEOUT_S, connect=3)) as r:
                 if r.status != 200:
                     continue
-                body = b"" if request.method == "HEAD" else await r.read()
                 ring.mark_up(base)
                 _PROXY_FETCHES.inc(kind=kind)
                 headers = {}
                 if "X-KT-Meta" in r.headers:
                     headers["X-KT-Meta"] = r.headers["X-KT-Meta"]
-                return web.Response(body=body, headers=headers,
-                                    content_type=r.headers.get(
-                                        "Content-Type", "application/octet-stream"))
+                ctype = r.headers.get("Content-Type",
+                                      "application/octet-stream")
+                if request.method == "HEAD":
+                    return web.Response(headers=headers, content_type=ctype)
+                resp = web.StreamResponse()
+                resp.content_type = ctype
+                for k, v in headers.items():
+                    resp.headers[k] = v
+                if r.content_length is not None:
+                    resp.content_length = r.content_length
+                await resp.prepare(request)
+                async for chunk in r.content.iter_chunked(PROXY_CHUNK):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
         except Exception:
             ring.mark_down(base)
+            if resp is not None and resp.prepared:
+                # bytes already left for the client: abort THIS response
+                # (truncation the client's hash check converts into a
+                # routed retry) rather than silently trying a sibling
+                raise
     return None
 
 
@@ -805,9 +834,29 @@ async def kv_diff(request: web.Request) -> web.Response:
     recorded count as missing (re-upload is always safe). On a multi-node
     ring a key counts current when ANY live member holds it current (the
     re-replication sweep restores R-way placement; claiming missing here
-    would re-move bytes the ring already has)."""
+    would re-move bytes the ring already has).
+
+    Delta bodies compress (ISSUE 10): both directions are pure hash
+    tables that shrink 2-3x, negotiated via ``Content-Encoding`` (request)
+    and ``Accept-Encoding`` (response) with the ``zstd``/``zlib`` tokens
+    from :mod:`..data_store.netpool` — an old client that sends neither
+    header gets the exact pre-compression wire behavior."""
+    from . import netpool
+
     st = _state(request)
-    body = await request.json()
+    raw = await request.read()
+    coding = (request.headers.get("Content-Encoding") or "").lower() or None
+    if coding in ("zstd", "zlib"):
+        try:
+            raw = netpool.decompress_body(raw, coding)
+        except Exception as e:  # noqa: BLE001 — any codec error is a 400
+            return web.json_response(
+                {"error": f"bad {coding} body: {e}"}, status=400)
+    _STORE_BYTES.inc(len(raw), direction="in")
+    try:
+        body = json.loads(raw) if raw else {}
+    except ValueError:
+        return web.json_response({"error": "bad json"}, status=400)
     keys: Dict[str, str] = body.get("keys", {})
     missing = []
     for key, want in keys.items():
@@ -837,7 +886,15 @@ async def kv_diff(request: web.Request) -> web.Response:
             missing.append(key)
     if missing and st.ring.multi and not _internal(request):
         missing = await _kv_missing_ringwide(request.app, missing, keys)
-    return web.json_response({"missing": sorted(missing)})
+    payload = json.dumps({"missing": sorted(missing)}).encode()
+    out_coding = netpool.best_coding(request.headers.get("Accept-Encoding"))
+    if out_coding and len(payload) >= netpool.COMPRESS_MIN_BYTES:
+        payload = netpool.compress_body(payload, out_coding)
+        _STORE_BYTES.inc(len(payload), direction="out")
+        return web.Response(body=payload, content_type="application/json",
+                            headers={"Content-Encoding": out_coding})
+    _STORE_BYTES.inc(len(payload), direction="out")
+    return web.Response(body=payload, content_type="application/json")
 
 
 async def _kv_missing_ringwide(app: web.Application, missing: List[str],

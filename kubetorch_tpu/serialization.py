@@ -209,13 +209,43 @@ def _msgpack_unescape_key(k: Any) -> Any:
     return k
 
 
-def _msgpack_escape(obj: Any) -> Any:
-    """Escape user dicts whose '__arr__' key would trip the decode hook."""
+def _msgpack_needs_escape(obj: Any) -> bool:
+    """Scan-only pass: does any dict key in the tree need '~'-escaping?
+    Most payloads never touch the ``__arr__`` sentinel, so the common case
+    is a cheap read-only walk instead of a full container rebuild."""
     if isinstance(obj, dict):
-        return {_msgpack_escape_key(k): _msgpack_escape(v)
+        for k, v in obj.items():
+            if isinstance(k, str) and k.lstrip("~") == "__arr__":
+                return True
+            if _msgpack_needs_escape(v):
+                return True
+        return False
+    if isinstance(obj, (list, tuple)):
+        # tuples still rebuild (msgpack encodes them as lists anyway), but
+        # only the rebuild pass pays for that — scanning stays read-only
+        return any(_msgpack_needs_escape(v) for v in obj)
+    return False
+
+
+def _msgpack_escape(obj: Any) -> Any:
+    """Escape user dicts whose '__arr__' key would trip the decode hook.
+
+    Fast path (ISSUE 10): when the scan finds nothing to escape, the
+    ORIGINAL object is returned untouched — no container rebuild, and
+    large ``bytes``/array leaves pass through by reference instead of
+    riding a freshly allocated tree. Only payloads that actually use the
+    sentinel key pay the rebuild."""
+    if not _msgpack_needs_escape(obj):
+        return obj
+    return _msgpack_escape_rebuild(obj)
+
+
+def _msgpack_escape_rebuild(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {_msgpack_escape_key(k): _msgpack_escape_rebuild(v)
                 for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [_msgpack_escape(v) for v in obj]
+        return [_msgpack_escape_rebuild(v) for v in obj]
     return obj
 
 

@@ -21,8 +21,9 @@ import time
 import queue as queue_mod
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..exceptions import rehydrate_exception
+from ..exceptions import DataCorruptionError, rehydrate_exception
 from ..resources.pointers import Pointers
+from . import shm_ring
 from .env_contract import RankInfo
 from .watchdog import Watchdog
 
@@ -53,6 +54,12 @@ class ProcessPool:
         self._router_threads: List[threading.Thread] = []
         self._stopping = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # router wake pipe (ISSUE 10): response routers BLOCK on the
+        # queue's pipe instead of polling at 5 Hz; state changes that a
+        # queue read can't observe (shutdown, a rank death noticed by the
+        # watchdog) write a byte here to wake every router immediately
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
         # elastic re-mesh hook (ISSUE 6): set by supervisors; called with the
         # new LOCAL world size on a resizing restart and returns env
         # overrides (a shrunken KT_MESH) so the fresh ranks rebuild a mesh
@@ -92,6 +99,7 @@ class ProcessPool:
         once the dead worker's queue is drained."""
         old = self.workers[idx]
         old.force_kill_if_alive()
+        self.wake_routers()            # the old router exits now, not later
         fresh = self._new_worker(idx)
         self.workers[idx] = fresh
         fresh.start()
@@ -121,6 +129,7 @@ class ProcessPool:
             time.sleep(0.05)
         for w in self.workers:
             w.force_kill_if_alive()
+        self.wake_routers()            # retired routers exit now
         resized = num_procs is not None and num_procs != self.num_procs
         if num_procs is not None:
             self.num_procs = max(1, num_procs)
@@ -142,15 +151,52 @@ class ProcessPool:
 
     # -- response routing -----------------------------------------------------
 
+    def wake_routers(self) -> None:
+        """Write the wake byte: every blocked router re-checks stop/death
+        state immediately instead of on its next (late) poll tick."""
+        try:
+            os.write(self._wake_w, b"w")
+        except OSError:
+            pass
+
+    def _drain_wake(self) -> None:
+        try:
+            while os.read(self._wake_r, 4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
     def _route_responses(self, worker) -> None:
+        """Poll-free response router (ISSUE 10): blocks on the queue's
+        underlying pipe AND the pool wake pipe via
+        ``multiprocessing.connection.wait`` — a response wakes it the
+        instant the feeder writes it, with no 5 Hz poll burning a wakeup
+        (and no 0–200 ms artificial tail when a get/timeout raced the
+        arrival). The 1 s timeout is a belt-and-braces heartbeat only."""
+        from multiprocessing.connection import wait as mpc_wait
+
+        reader = worker.response_q._reader
         while not self._stopping.is_set():
             try:
-                resp = worker.response_q.get(timeout=0.2)
+                if not reader.poll(0):
+                    try:
+                        ready = mpc_wait([reader, self._wake_r],
+                                         timeout=1.0)
+                    except OSError:      # wake fd reclaimed mid-teardown
+                        ready = []
+                    if self._wake_r in ready:
+                        self._drain_wake()
+                    if reader not in ready:
+                        if not worker.alive:
+                            self._drain_dead_queue(worker)
+                            return
+                        continue
+                resp = worker.response_q.get_nowait()
             except (queue_mod.Empty, OSError, ValueError, EOFError):
                 if not worker.alive:
                     # dead worker: ship whatever its feeder already wrote,
-                    # then exit — spinning at 5 Hz on a queue that can never
-                    # produce again would leak one thread per death for the
+                    # then exit — a router thread pinned to a queue that
+                    # can never produce again would leak per death for the
                     # pod's lifetime
                     self._drain_dead_queue(worker)
                     return
@@ -198,11 +244,26 @@ class ProcessPool:
             worker.in_warmup = resp.get("warmup") == "started"
             return
         req_id = resp.get("req_id")
+        decode_error: Optional[BaseException] = None
+        if resp.get("_kt_shm"):
+            # decode BEFORE the future lookup: ring slots must free in
+            # queue order even when the waiter already timed out/cancelled
+            from .. import telemetry
+            try:
+                with telemetry.stage("shm_copy", dir="resp"):
+                    shm_ring.decode_item_fields(
+                        resp, getattr(worker, "shm_resp", None),
+                        ("result",), "resp")
+            except BaseException as e:  # noqa: BLE001
+                decode_error = e
         with self._futures_lock:
             entry = self._futures.pop(req_id, None)
         if entry is None:
             return
         fut, _idx = entry
+        if decode_error is not None:
+            self._fail_future(fut, decode_error)
+            return
         if self._loop is not None and not fut.done():
             self._loop.call_soon_threadsafe(self._resolve, fut, resp)
 
@@ -278,6 +339,21 @@ class ProcessPool:
         if not worker.alive:
             raise self.watchdog.death_error(idx, worker)
         self._loop = asyncio.get_running_loop()
+        # zero-copy envelope encode (ISSUE 10): large arrays in
+        # args/kwargs move through the worker's request ring; the queue
+        # item carries only {pos, len, dtype, shape, hash} headers. Done
+        # BEFORE future registration so an encode failure leaks nothing.
+        if getattr(worker, "shm_req", None) is not None \
+                and not payload.get("no_shm"):
+            threshold = shm_ring.shm_threshold()
+            if threshold > 0:
+                from .. import telemetry
+                with telemetry.stage("shm_copy", dir="req"):
+                    n_env = shm_ring.encode_item_fields(
+                        payload, worker.shm_req, ("args", "kwargs"),
+                        threshold, "req")
+                if n_env:
+                    payload["_kt_shm"] = n_env
         req_id = f"r{next(self._req_counter)}"
         fut = self._loop.create_future()
         with self._futures_lock:
@@ -313,11 +389,34 @@ class ProcessPool:
     async def call(self, idx: int, method: Optional[str], args: list,
                    kwargs: dict, timeout: Optional[float] = None,
                    dist_env: Optional[Dict[str, str]] = None) -> Any:
-        payload: Dict[str, Any] = {"method": method, "args": args,
-                                   "kwargs": kwargs}
-        if dist_env:
-            payload["dist_env"] = dist_env
-        return await self._submit(idx, payload, timeout)
+        def _payload(no_shm: bool = False) -> Dict[str, Any]:
+            p: Dict[str, Any] = {"method": method, "args": args,
+                                 "kwargs": kwargs}
+            if dist_env:
+                p["dist_env"] = dist_env
+            if no_shm:
+                p["no_shm"] = True
+            return p
+
+        try:
+            return await self._submit(idx, _payload(), timeout)
+        except DataCorruptionError as e:
+            if getattr(e, "source", None) != "shm" \
+                    or getattr(e, "key", None) != "req":
+                # response-direction corruption means the call already
+                # EXECUTED — blind re-execution would violate the
+                # never-replay-established discipline, so it surfaces
+                # typed instead
+                raise
+            # a request envelope failed its blake2b check in the worker
+            # BEFORE any user code ran (flipped bit in the segment, chaos
+            # shm-corrupt): the original arrays are intact on this side, so
+            # retry ONCE over the classic queue path — garbage never
+            # reaches device_put, and a persistently bad segment degrades
+            # to pre-envelope behavior
+            print(f"[kt] shm envelope corruption on rank {idx} "
+                  f"({e}); retrying over the queue path")
+            return await self._submit(idx, _payload(no_shm=True), timeout)
 
     def subset_env(self, local_rank: int, sel_ips: List[str],
                    sel_node_rank: int) -> Optional[Dict[str, str]]:
@@ -396,8 +495,20 @@ class ProcessPool:
             # stragglers past warmup get the normal short window
             join_all(time.monotonic() + 5.0)
         self._stopping.set()
+        self.wake_routers()
         for w in self.workers:
             w.force_kill_if_alive()
+        # reclaim the wake pipe once every router thread has actually
+        # exited — closing an fd a selector still waits on invites reuse
+        # races, so a straggler (bounded dead-queue drain) keeps it open
+        for t in self._router_threads:
+            t.join(timeout=2.0)
+        if not any(t.is_alive() for t in self._router_threads):
+            for fd in (self._wake_r, self._wake_w):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     @property
     def healthy(self) -> bool:

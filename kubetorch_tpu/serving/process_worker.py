@@ -79,7 +79,8 @@ class _QueueTee:
 def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
                  env: Dict[str, str], pointers_dict: Optional[Dict],
                  init_args: Optional[Dict], framework_name: str,
-                 identity_env: Optional[Dict[str, str]] = None) -> None:
+                 identity_env: Optional[Dict[str, str]] = None,
+                 shm_spec: Optional[Dict[str, str]] = None) -> None:
     import sys as _sys
 
     os.environ.update(env)
@@ -96,15 +97,32 @@ def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
     from .env_contract import sync_jax_runtime_config
     sync_jax_runtime_config()
     asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
-                             framework_name, identity_env))
+                             framework_name, identity_env, shm_spec))
 
 
 async def _worker_loop(request_q, response_q, pointers_dict, init_args,
-                       framework_name, identity_env=None) -> None:
+                       framework_name, identity_env=None,
+                       shm_spec=None) -> None:
     loop = asyncio.get_running_loop()
     executor = ThreadPoolExecutor(max_workers=_SYNC_EXECUTOR_THREADS)
     target: Any = None
     load_error: Optional[BaseException] = None
+    # zero-copy envelope rings (ISSUE 10): the parent created one segment
+    # per direction; attach both (req: parent writes / this rank reads,
+    # resp: this rank writes / parent reads). Attach failure downgrades to
+    # the classic queue path — never a dead rank.
+    rings: Dict[str, Any] = {}
+    if shm_spec:
+        from . import shm_ring
+        try:
+            rings["req"] = shm_ring.ShmRing(shm_spec["req"])
+            rings["resp"] = shm_ring.ShmRing(shm_spec["resp"])
+        except Exception:  # noqa: BLE001 — degrade, don't die
+            for r in rings.values():
+                r.close()
+            rings = {}
+            print("[kt] shm ring attach failed; falling back to queue "
+                  "path:\n" + traceback.format_exc())
     # process-level chaos (ISSUE 3/6): KT_CHAOS kill-rank verbs make THIS
     # rank kill itself at a chosen call index — the deterministic stand-in
     # for an OOM kill landing mid-call — and term-rank verbs deliver the
@@ -177,10 +195,30 @@ async def _worker_loop(request_q, response_q, pointers_dict, init_args,
                     term_plan.pop(call_index)
                     _chaos_term_self(grace, call_index)
             call_index += 1
+            if item.get("_kt_shm"):
+                # envelopes decode IMMEDIATELY at dequeue (queue order ==
+                # ring order, so slots free in allocation order); a hash
+                # mismatch answers this req_id with the typed corruption
+                # error — the parent pool retries once over the queue path
+                from .. import telemetry
+                from . import shm_ring
+                try:
+                    with telemetry.stage("shm_copy", dir="req"):
+                        shm_ring.decode_item_fields(
+                            item, rings.get("req"), ("args", "kwargs"),
+                            "req")
+                except BaseException as e:  # noqa: BLE001
+                    from ..exceptions import package_exception
+                    response_q.put({"req_id": item.get("req_id"),
+                                    "ok": False,
+                                    "error": package_exception(e)})
+                    continue
             task = asyncio.ensure_future(
                 _handle(item, target, load_error, response_q, executor,
-                        identity_env))
+                        identity_env, rings.get("resp")))
         pending.add(task)
+    for r in rings.values():
+        r.close()
 
 
 def _chaos_term_self(grace_s: float, call_index: int) -> None:
@@ -324,7 +362,8 @@ def _ship_trace_spans(response_q, sp) -> None:
 
 
 async def _handle(item: Dict, target: Any, load_error, response_q, executor,
-                  identity_env: Optional[Dict[str, str]] = None) -> None:
+                  identity_env: Optional[Dict[str, str]] = None,
+                  resp_ring=None) -> None:
     import time as _time
 
     from .. import telemetry
@@ -341,14 +380,15 @@ async def _handle(item: Dict, target: Any, load_error, response_q, executor,
     try:
         with sp:
             await _handle_inner(item, target, load_error, response_q,
-                                executor, sp, identity_env)
+                                executor, sp, identity_env, resp_ring)
     finally:
         _ship_trace_spans(response_q, sp)
 
 
 async def _handle_inner(item: Dict, target: Any, load_error, response_q,
                         executor, sp,
-                        identity_env: Optional[Dict[str, str]] = None) -> None:
+                        identity_env: Optional[Dict[str, str]] = None,
+                        resp_ring=None) -> None:
     from .. import telemetry
 
     req_id = item.get("req_id")
@@ -384,7 +424,20 @@ async def _handle_inner(item: Dict, target: Any, load_error, response_q,
             # pulling device arrays to host numpy is the rank's last
             # per-request device touch — the transfer stage on the waterfall
             host = _host_view(result)
-        response_q.put({"req_id": req_id, "ok": True, "result": host})
+        resp = {"req_id": req_id, "ok": True, "result": host}
+        if resp_ring is not None and not item.get("no_shm"):
+            # result arrays ride the response ring the same way args rode
+            # the request ring; encode and enqueue with no await between
+            # them so queue order stays ring-allocation order
+            from . import shm_ring
+            threshold = shm_ring.shm_threshold()
+            if threshold > 0:
+                with telemetry.stage("shm_copy", dir="resp"):
+                    n = shm_ring.encode_item_fields(
+                        resp, resp_ring, ("result",), threshold, "resp")
+                if n:
+                    resp["_kt_shm"] = n
+        response_q.put(resp)
     except BaseException as e:  # noqa: BLE001
         oom = detect_hbm_oom(e)
         payload = package_exception(oom if oom is not None else e)
@@ -416,11 +469,33 @@ class ProcessWorker:
         identity_env = fw_env if fw.per_call_identity else None
         # flipped by ProcessPool._route_responses from the worker's state ops
         self.in_warmup = True
+        # zero-copy envelope rings (ISSUE 10): one segment per direction,
+        # created by THIS side (which owns their lifecycle — see
+        # cleanup_shm) and attached by name in the child. Only built when
+        # KT_SHM_THRESHOLD opts the deployment in; creation failure (tiny
+        # /dev/shm, exotic platform) downgrades to the queue path.
+        self.shm_req = self.shm_resp = None
+        shm_spec = None
+        from . import shm_ring
+        if shm_ring.enabled():
+            try:
+                size = shm_ring.ring_bytes()
+                tag = f"r{rank_info.local_rank}"
+                self.shm_req = shm_ring.ShmRing(
+                    shm_ring.make_name(f"{tag}-req"), size=size, create=True)
+                self.shm_resp = shm_ring.ShmRing(
+                    shm_ring.make_name(f"{tag}-resp"), size=size, create=True)
+                shm_spec = {"req": self.shm_req.name,
+                            "resp": self.shm_resp.name}
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                self.cleanup_shm()
+                print(f"[kt] shm ring create failed ({e}); "
+                      "using queue path")
         self.process = ctx.Process(
             target=_worker_main,
             args=(self.request_q, self.response_q, env,
                   pointers.to_dict() if pointers else None, init_args,
-                  framework_name, identity_env),
+                  framework_name, identity_env, shm_spec),
             daemon=True,
         )
 
@@ -441,13 +516,29 @@ class ProcessWorker:
     def force_kill_if_alive(self) -> None:
         """Last-resort SIGKILL. Callers (ProcessPool.shutdown) must have
         already granted the warmup grace — a process killed mid-jit-compile
-        while holding the TPU can wedge the runtime for every successor."""
+        while holding the TPU can wedge the runtime for every successor.
+        Always reclaims this worker's shared-memory rings afterwards: a
+        rank retired by ANY path (watchdog restart, elastic re-mesh,
+        shutdown) must never leak ``/dev/shm`` segments."""
         if self.process.is_alive():
             from ..utils.procs import kill_process_tree
             if self.in_warmup:
                 print(f"[kt] rank {self.rank_info.rank} still in warmup at "
                       "kill escalation; TPU runtime may need a reset")
             kill_process_tree(self.process.pid)
+        self.cleanup_shm()
+
+    def cleanup_shm(self) -> None:
+        """Close + unlink both envelope rings (idempotent). The creating
+        side owns segment lifecycle; the watchdog and every restart path
+        land here, so a dead rank's segments are reclaimed within one
+        watchdog interval."""
+        for attr in ("shm_req", "shm_resp"):
+            ring = getattr(self, attr, None)
+            if ring is not None:
+                setattr(self, attr, None)
+                ring.unlink()
+                ring.close()
 
     @property
     def alive(self) -> bool:

@@ -357,6 +357,15 @@ class Watchdog:
                     except Exception:  # noqa: BLE001
                         print("[kt] watchdog on_death hook failed:\n"
                               + traceback.format_exc())
+        if newly_dead:
+            # wake blocked response routers so the dead rank's drain (and
+            # router exit) happens NOW; the restart path then reclaims its
+            # shared-memory ring segments (ISSUE 10) — a dead rank must
+            # never leak /dev/shm across worker generations
+            try:
+                pool.wake_routers()
+            except Exception:  # noqa: BLE001 — test doubles without pipes
+                pass
         if newly_dead and not pool._stopping.is_set():
             self._maybe_restart(newly_dead, last_exc)
 
@@ -377,6 +386,13 @@ class Watchdog:
             # whatever is still in flight on live ranks fails typed too —
             # the pool will never answer
             self.pool.cancel_pending(self.permanent_error())
+        # no restart will ever run: reclaim the dead ranks' shm rings here
+        # (live ranks keep theirs until shutdown force-kills them)
+        for worker in list(self.pool.workers):
+            if not worker.alive:
+                cleanup = getattr(worker, "cleanup_shm", None)
+                if cleanup is not None:
+                    cleanup()
 
     def _maybe_restart(self, dead_idxs: List[int],
                        exc: WorkerDiedError) -> None:

@@ -27,7 +27,7 @@ Finished spans land in a bounded, deduplicating per-process ring
 counters, gauges, and histograms with proper label escaping and
 ``# HELP``/``# TYPE`` headers, plus the per-stage latency histogram
 (``kt_stage_seconds``: deserialize, queue_wait, execute, device_transfer,
-store_fetch, retry_sleep) every hot-path layer observes into. It backs the
+store_fetch, retry_sleep, shm_copy) every hot-path layer observes into. It backs the
 pod and store ``/metrics`` scrape endpoints and ``MetricsPusher``.
 
 **Overhead budget** — tracing defaults on; ``KT_TRACE=0`` disables it and
@@ -641,7 +641,7 @@ def render_untyped_gauges(lines: Dict[str, Any]) -> str:
 # observability.md "Span taxonomy"). Free-form stages are allowed; these
 # are the named hot-path phases of one request.
 STAGES = ("deserialize", "queue_wait", "execute", "device_transfer",
-          "store_fetch", "retry_sleep")
+          "store_fetch", "retry_sleep", "shm_copy")
 
 _STAGE_HIST: Optional[Histogram] = None
 
@@ -652,7 +652,7 @@ def stage_histogram() -> Histogram:
         _STAGE_HIST = histogram(
             "kt_stage_seconds",
             "Per-stage request latency (deserialize, queue_wait, execute, "
-            "device_transfer, store_fetch, retry_sleep)",
+            "device_transfer, store_fetch, retry_sleep, shm_copy)",
             labels=("stage",))
     return _STAGE_HIST
 

@@ -1,14 +1,27 @@
 #!/usr/bin/env python
-"""Per-stage perf regression gate (ISSUE 9 satellite / ROADMAP item 5).
+"""Per-stage perf regression gate (ISSUE 9 satellite, expanded in ISSUE 10
+to the full hot-path stage set / ROADMAP item 5).
 
 ``make bench-trace`` proved the telemetry plane itself is ~free; this gate
-spends that instrumentation: it drives the REAL pod-server hot path
-in-process (HTTP POST → deserialize → process-pool submit → rank worker
-echo → response) and compares the measured ``kt_stage_seconds`` p50 for
-the ``deserialize`` and ``queue_wait`` stages against a committed baseline
-(``scripts/perf_baseline.json``). CI fails when either regresses more than
-the tolerance — so this PR and every later one can't silently eat the
-dispatch hot path.
+spends that instrumentation: it drives the REAL hot paths in-process and
+compares the measured ``kt_stage_seconds`` p50 per stage against a
+committed baseline (``scripts/perf_baseline.json``). CI fails when any
+gated stage regresses more than the tolerance — so this PR and every later
+one can't silently re-fatten the dispatch path.
+
+Gated stages and how each is driven:
+
+- ``deserialize`` / ``queue_wait`` / ``execute`` — JSON echo calls through
+  the in-process pod server (HTTP POST → deserialize → process-pool
+  submit → rank-worker echo → response). ``execute`` on an echo payload IS
+  dispatch overhead: the user fn is a no-op return.
+- ``shm_copy`` — msgpack echo calls carrying arrays above
+  ``KT_SHM_THRESHOLD`` through the same server, so the zero-copy envelope
+  encode/decode (``serving/shm_ring.py``) is exercised and measured where
+  /metrics scrapes it (the parent process: request-encode + response-
+  decode).
+- ``store_fetch`` — pytree get against a real store-server subprocess
+  (the ``_RoutedFetcher`` client path that observes the stage).
 
 Gate rule (per stage)::
 
@@ -20,8 +33,9 @@ Gate rule (per stage)::
 10% of a sub-millisecond p50 is jitter, not a regression — the gate
 exists to catch real ones.
 
-Run: ``make perf-gate``; ``--update`` re-baselines after a DELIBERATE
-hot-path change (commit the JSON with the PR that explains it).
+Run: ``make perf-gate`` (also part of ``make test``); ``--update``
+re-baselines after a DELIBERATE hot-path change (commit the JSON with the
+PR that explains it).
 """
 
 from __future__ import annotations
@@ -30,6 +44,7 @@ import argparse
 import asyncio
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import textwrap
@@ -40,9 +55,13 @@ sys.path.insert(0, REPO)
 # CPU-only, no TPU relay (see Makefile PY_CPU)
 os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the shm_copy stage needs the envelope path armed for the driver's pod
+# server (64 KiB threshold, well under the driver's array payloads)
+os.environ.setdefault("KT_SHM_THRESHOLD", "65536")
 
 BASELINE_PATH = os.path.join(REPO, "scripts", "perf_baseline.json")
-GATED_STAGES = ("deserialize", "queue_wait")
+GATED_STAGES = ("deserialize", "queue_wait", "execute", "store_fetch",
+                "shm_copy")
 
 PAYLOAD_MODULE = textwrap.dedent("""
     def echo(x):
@@ -50,13 +69,16 @@ PAYLOAD_MODULE = textwrap.dedent("""
 """)
 
 
-async def _drive(calls: int, payload_kb: int) -> None:
-    """N real calls through the in-process pod server: each one pays the
-    deserialize stage in the server and the queue_wait stage in the
-    process pool — exactly the counters the autoscaler and this gate
-    read."""
+async def _drive(calls: int, payload_kb: int, shm_calls: int,
+                 shm_kb: int) -> None:
+    """Real calls through the in-process pod server: JSON echoes pay the
+    deserialize/queue_wait/execute stages; msgpack array echoes above the
+    shm threshold pay shm_copy on top — exactly the counters the
+    autoscaler and this gate read."""
+    import numpy as np
     from aiohttp.test_utils import TestClient, TestServer
 
+    from kubetorch_tpu import serialization as ser
     from kubetorch_tpu.serving.http_server import ServerState, create_app
 
     state = ServerState()
@@ -79,11 +101,51 @@ async def _drive(calls: int, payload_kb: int) -> None:
                                   headers={"Content-Type":
                                            "application/json"})
             assert r.status == 200, await r.text()
+        arr = np.arange(shm_kb * 256, dtype=np.float32)   # shm_kb KiB
+        mp_body = ser.serialize({"args": [arr], "kwargs": {}}, ser.MSGPACK)
+        for _ in range(shm_calls):
+            r = await client.post("/echo", data=mp_body,
+                                  headers={"X-Serialization": ser.MSGPACK})
+            assert r.status == 200, await r.text()
     finally:
         await client.close()
 
 
-def measure(calls: int, payload_kb: int) -> dict:
+def _drive_store(gets: int) -> None:
+    """Pytree put + repeated gets against a real store-server subprocess:
+    every leaf fetch observes the ``store_fetch`` stage in THIS process
+    (the client side, where the gate reads the registry)."""
+    import numpy as np
+
+    from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.utils.procs import (free_port, kill_process_tree,
+                                           wait_for_port)
+
+    port = free_port()
+    with tempfile.TemporaryDirectory() as root:
+        env = dict(os.environ)
+        env["KT_STORE_FSYNC"] = "0"
+        env["KT_SCRUB_INTERVAL_S"] = "0"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+             "--host", "127.0.0.1", "--port", str(port), "--root", root],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            assert wait_for_port("127.0.0.1", port, timeout=30), \
+                "store did not start"
+            url = f"http://127.0.0.1:{port}"
+            rng = np.random.default_rng(0)
+            tree = {"w": {f"l{i}": rng.standard_normal(1 << 14).astype(
+                np.float32) for i in range(4)}}
+            ds.put("perf-gate/w", tree, store_url=url)
+            for _ in range(gets):
+                ds.get("perf-gate/w", store_url=url)
+        finally:
+            kill_process_tree(proc.pid)
+
+
+def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
+            store_gets: int) -> dict:
     """{stage: p50 seconds} measured from a fresh registry."""
     from kubetorch_tpu import telemetry
     from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
@@ -102,7 +164,8 @@ def measure(calls: int, payload_kb: int) -> dict:
             KT_CLS_OR_FN_NAME: "echo",
             KT_LAUNCH_ID: "perf-gate",
         })
-        asyncio.run(_drive(calls, payload_kb))
+        asyncio.run(_drive(calls, payload_kb, shm_calls, shm_kb))
+    _drive_store(store_gets)
     text = telemetry.REGISTRY.render()
     out = {}
     for stage in GATED_STAGES:
@@ -121,6 +184,9 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--calls", type=int, default=80)
     p.add_argument("--payload-kb", type=int, default=64)
+    p.add_argument("--shm-calls", type=int, default=40)
+    p.add_argument("--shm-kb", type=int, default=512)
+    p.add_argument("--store-gets", type=int, default=20)
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
@@ -129,13 +195,17 @@ def main() -> int:
                         "commit the JSON with the explaining PR)")
     args = p.parse_args()
 
-    measured = measure(args.calls, args.payload_kb)
+    measured = measure(args.calls, args.payload_kb, args.shm_calls,
+                       args.shm_kb, args.store_gets)
 
     if args.update or not os.path.exists(BASELINE_PATH):
         baseline = {
             "stages": {s: round(v, 6) for s, v in measured.items()},
             "calls": args.calls,
             "payload_kb": args.payload_kb,
+            "shm_calls": args.shm_calls,
+            "shm_kb": args.shm_kb,
+            "store_gets": args.store_gets,
             "note": "p50 seconds per stage from scripts/check_perf_gate.py"
                     " --update; gate = p50 <= baseline*(1+tol) + floor",
         }

@@ -124,7 +124,10 @@ BASELINE = {
 ALIVE_RE = re.compile(r"\.alive\b")
 ALIVE_EXEMPT = {"watchdog.py"}
 ALIVE_BASELINE = {
-    "serving/process_pool.py": 8,
+    # +1 in ISSUE 10: the poll-free router checks liveness in BOTH its
+    # wake-timeout branch and its queue-error branch (same dead-router
+    # exit semantics as before, now event-driven)
+    "serving/process_pool.py": 9,
 }
 
 # Raw commit renames in data_store/ outside the durable-write layer.
@@ -183,6 +186,17 @@ ROUTE_EXEMPT = {"router.py", "remote_worker_pool.py"}
 ROUTE_BASELINE = {
     "serving/spmd_supervisor.py": 3,   # tree fan-out + quorum health gate
 }
+
+# Raw shared-memory segments outside the envelope-ring layer (ISSUE 10).
+# serving/shm_ring.py owns SharedMemory end to end: segment naming (the
+# greppable kt-shm-<pid> convention leak audits rely on), the shared-
+# resource-tracker lifecycle contract, watchdog-driven cleanup, and the
+# SPSC ring discipline. A raw SharedMemory( call site anywhere else
+# creates a segment no restart path unlinks — a /dev/shm leak per worker
+# generation. The baseline is EMPTY on purpose.
+SHM_RE = re.compile(r"\bSharedMemory\(")
+SHM_EXEMPT = {"shm_ring.py"}
+SHM_BASELINE: dict = {}
 
 # Raw single-origin store-URL building in data_store/ outside the ring
 # router (ISSUE 7). ring.py owns origin/fleet resolution: a call site that
@@ -316,6 +330,30 @@ def main() -> int:
               "ROUTE_BASELINE with a justification.")
         return 1
 
+    shm_failures = []
+    shm_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in SHM_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, SHM_RE)
+        if n:
+            shm_counts[rel] = n
+        allowed = SHM_BASELINE.get(rel, 0)
+        if n > allowed:
+            shm_failures.append(
+                f"  {rel}: {n} raw SharedMemory call site(s), baseline "
+                f"allows {allowed}")
+    if shm_failures:
+        print("check_resilience: raw SharedMemory segments bypass the "
+              "envelope-ring layer:\n" + "\n".join(shm_failures))
+        print("\nShared-memory segments must be created/attached through "
+              "serving/shm_ring.py (ShmRing) so naming, tracker lifecycle, "
+              "and watchdog cleanup hold — a raw segment is a /dev/shm "
+              "leak per worker generation. For deliberate exceptions "
+              "update SHM_BASELINE with a justification.")
+        return 1
+
     origin_failures = []
     origin_counts = {}
     for path in sorted((PKG / "data_store").rglob("*.py")):
@@ -426,6 +464,8 @@ def main() -> int:
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in ORIGIN_BASELINE.items()
            if origin_counts.get(f, 0) < allowed]
+        + [f for f, allowed in SHM_BASELINE.items()
+           if shm_counts.get(f, 0) < allowed]
         + [f for f, allowed in ROUTE_BASELINE.items()
            if route_counts.get(f, 0) < allowed]
         + [f for f, allowed in SCHED_BASELINE.items()
@@ -445,7 +485,8 @@ def main() -> int:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
               "checks, replica selections, store-origin resolutions, "
               "controller placements, data-store commit renames, "
-              "checkpoint writes, and telemetry sites accounted for")
+              "checkpoint writes, shared-memory segments, and telemetry "
+              "sites accounted for")
     return 0
 
 

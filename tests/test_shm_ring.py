@@ -1,0 +1,404 @@
+"""Zero-copy shared-memory envelopes (ISSUE 10): ring protocol, envelope
+encode/decode with sampled blake2b verification, the chaos ``shm-corrupt``
+drill (decode raises typed ``DataCorruptionError(source="shm")`` and the
+pool falls back to the queue path), and the /dev/shm lifecycle contract —
+a dead rank leaks no segments, ring-full degrades to the queue path, and
+``KT_SHM_THRESHOLD`` unset/0 disables the path byte-identically.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.level("minimal")
+
+from kubetorch_tpu.chaos import ChaosEngine, parse_spec, shm_corrupt_plan
+from kubetorch_tpu.exceptions import DataCorruptionError
+from kubetorch_tpu.resources.pointers import Pointers
+from kubetorch_tpu.serving import shm_ring
+from kubetorch_tpu.serving.process_pool import ProcessPool
+from kubetorch_tpu.serving.shm_ring import SHM_KEY, ShmRing
+
+ASSETS = os.path.join(os.path.dirname(__file__), "assets")
+
+
+def _pointers(fn="summer"):
+    return Pointers(project_root=ASSETS, module_name="payloads",
+                    file_path="payloads.py", cls_or_fn_name=fn)
+
+
+def _segments():
+    try:
+        return {f for f in os.listdir("/dev/shm") if f.startswith("kt-shm-")}
+    except OSError:
+        return set()
+
+
+def _wait_until(predicate, timeout=45.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing(shm_ring.make_name("test"), size=1 << 20, create=True)
+    yield r
+    r.close()
+    r.unlink()
+
+
+# ---------------------------------------------------------------------------
+# Ring protocol units
+# ---------------------------------------------------------------------------
+
+
+def test_ring_put_view_free_roundtrip(ring):
+    data = np.arange(256, dtype=np.uint8)
+    pos = ring.try_put(data)
+    assert pos == 0
+    np.testing.assert_array_equal(np.asarray(ring.view(pos, 256)), data)
+    assert ring.used() == 256
+    ring.free(pos, 256)
+    assert ring.used() == 0
+
+
+def test_ring_full_returns_none(ring):
+    cap = ring.data_size
+    big = np.zeros(cap, dtype=np.uint8)
+    pos = ring.try_put(big)
+    assert pos is not None
+    # unconsumed window is full: the next allocation must fail cleanly
+    assert ring.try_put(np.zeros(1, dtype=np.uint8)) is None
+    ring.free(pos, cap)
+    assert ring.try_put(np.zeros(1, dtype=np.uint8)) is not None
+
+
+def test_ring_oversized_block_rejected(ring):
+    assert ring.try_put(np.zeros(ring.data_size + 1, dtype=np.uint8)) is None
+
+
+def test_ring_blocks_never_wrap(ring):
+    """An allocation that would straddle the end skips to the next lap;
+    the monotonic free jumps the gap implicitly."""
+    cap = ring.data_size
+    a = np.ones(cap - 100, dtype=np.uint8)
+    p1 = ring.try_put(a)
+    ring.free(p1, a.nbytes)
+    b = np.full(400, 7, dtype=np.uint8)
+    p2 = ring.try_put(b)                 # only 100B left before the edge
+    assert p2 == cap                     # skipped to the next lap
+    np.testing.assert_array_equal(np.asarray(ring.view(p2, 400)), b)
+    ring.free(p2, 400)
+    assert ring.used() == 0
+
+
+def test_ring_attach_sees_writes(ring):
+    data = np.frombuffer(b"hello shm ring", dtype=np.uint8)
+    pos = ring.try_put(data)
+    peer = ShmRing(ring.name)            # attach by name, same process
+    try:
+        assert bytes(np.asarray(peer.view(pos, len(data)))) == bytes(data)
+    finally:
+        peer.close()
+
+
+# ---------------------------------------------------------------------------
+# Envelope encode/decode
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_roundtrip(ring, monkeypatch):
+    monkeypatch.setenv("KT_SHM_VERIFY", "all")
+    arr = np.random.default_rng(0).standard_normal(4096).astype(np.float32)
+    item = {"args": [arr, 5, "x"], "kwargs": {"w": {"deep": arr * 2}}}
+    n = shm_ring.encode_item_fields(item, ring, ("args", "kwargs"),
+                                    1024, "req")
+    assert n == 2
+    assert SHM_KEY in item["args"][0]
+    assert item["args"][1] == 5          # scalars stay inline
+    assert shm_ring.decode_item_fields(item, ring, ("args", "kwargs"),
+                                       "req") == 2
+    np.testing.assert_array_equal(item["args"][0], arr)
+    np.testing.assert_array_equal(item["kwargs"]["w"]["deep"], arr * 2)
+    assert item["args"][0].dtype == np.float32
+    assert ring.used() == 0              # every slot freed on decode
+
+
+def test_encode_below_threshold_is_identity(ring):
+    arr = np.zeros(16, dtype=np.float32)
+    args = [arr]
+    item = {"args": args}
+    assert shm_ring.encode_item_fields(item, ring, ("args",),
+                                       1 << 20, "req") == 0
+    assert item["args"] is args          # untouched, not rebuilt
+    assert ring.used() == 0
+
+
+def test_encode_no_shm_flag_short_circuits(ring):
+    item = {"args": [np.zeros(4096, dtype=np.float32)], "no_shm": True}
+    assert shm_ring.encode_item_fields(item, ring, ("args",), 16, "req") == 0
+
+
+def test_encode_ring_full_falls_back_inline(ring):
+    """An array bigger than the ring stays inline on the queue — the call
+    still works, nothing raises."""
+    arr = np.zeros(ring.data_size + 64, dtype=np.uint8)
+    item = {"args": [arr]}
+    assert shm_ring.encode_item_fields(item, ring, ("args",), 16, "req") == 0
+    assert item["args"][0] is arr
+
+
+def test_decode_hash_mismatch_raises_typed(ring, monkeypatch):
+    monkeypatch.setenv("KT_SHM_VERIFY", "all")
+    arr = np.arange(1024, dtype=np.float32)
+    item = {"args": [arr]}
+    assert shm_ring.encode_item_fields(item, ring, ("args",), 16,
+                                       "req") == 1
+    spec = item["args"][0][SHM_KEY]
+    off = ring.DATA_OFF + (spec["pos"] % ring.data_size)
+    ring.shm.buf[off] ^= 0xFF            # rot one byte in the segment
+    with pytest.raises(DataCorruptionError) as ei:
+        shm_ring.decode_item_fields(item, ring, ("args",), "req")
+    assert ei.value.source == "shm" and ei.value.key == "req"
+    assert ring.used() == 0              # slot freed even on corruption
+
+
+def test_bfloat16_envelope_roundtrip(ring, monkeypatch):
+    monkeypatch.setenv("KT_SHM_VERIFY", "all")
+    import ml_dtypes
+    arr = np.arange(2048, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    item = {"result": arr}
+    assert shm_ring.encode_item_fields(item, ring, ("result",), 16,
+                                       "resp") == 1
+    assert shm_ring.decode_item_fields(item, ring, ("result",),
+                                       "resp") == 1
+    assert item["result"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        item["result"].astype(np.float32), arr.astype(np.float32))
+
+
+def test_verify_policy_parsing(monkeypatch):
+    monkeypatch.delenv("KT_SHM_VERIFY", raising=False)
+    assert shm_ring.verify_policy() == 8
+    monkeypatch.setenv("KT_SHM_VERIFY", "all")
+    assert shm_ring.verify_policy() == 1
+    monkeypatch.setenv("KT_SHM_VERIFY", "0")
+    assert shm_ring.verify_policy() == 0
+    monkeypatch.setenv("KT_SHM_VERIFY", "32")
+    assert shm_ring.verify_policy() == 32
+    monkeypatch.setenv("KT_SHM_VERIFY", "junk")
+    assert shm_ring.verify_policy() == 8
+
+
+def test_sampled_verification_covers_first_envelope(ring, monkeypatch):
+    monkeypatch.delenv("KT_SHM_VERIFY", raising=False)
+    arrs = [np.full(2048, i, dtype=np.float32) for i in range(3)]
+    item = {"args": arrs}
+    shm_ring.encode_item_fields(item, ring, ("args",), 16, "req")
+    hashed = ["hash" in e[SHM_KEY] for e in item["args"]]
+    assert hashed[0] is True             # first envelope always verified
+    assert hashed[1] is False and hashed[2] is False   # sampled (1/8)
+
+
+# ---------------------------------------------------------------------------
+# Chaos verb: shm-corrupt
+# ---------------------------------------------------------------------------
+
+
+def test_shm_corrupt_parse_and_plan():
+    faults = parse_spec("shm-corrupt*2,503")
+    assert [f.kind for f in faults] == ["shm-corrupt", "shm-corrupt",
+                                       "status"]
+    assert shm_corrupt_plan("shm-corrupt*3") == 3
+    assert shm_corrupt_plan("reset,503") == 0
+    assert shm_corrupt_plan("") == 0
+
+
+def test_shm_corrupt_invisible_to_http_engine():
+    engine = ChaosEngine(parse_spec("shm-corrupt,503"))
+    assert len(engine.schedule) == 1 and engine.schedule[0].kind == "status"
+
+
+def test_shm_corrupt_flips_byte_and_decode_catches(ring, monkeypatch):
+    """The full drill at module level: the armed token corrupts the next
+    envelope AFTER its hash is recorded, so decode must raise typed."""
+    monkeypatch.setenv("KT_CHAOS", "shm-corrupt")
+    monkeypatch.setenv("KT_SHM_VERIFY", "0")   # chaos forces the hash anyway
+    shm_ring.reset_chaos()
+    try:
+        arr = np.arange(512, dtype=np.float32)
+        item = {"args": [arr]}
+        shm_ring.encode_item_fields(item, ring, ("args",), 16, "req")
+        assert "hash" in item["args"][0][SHM_KEY]
+        with pytest.raises(DataCorruptionError) as ei:
+            shm_ring.decode_item_fields(item, ring, ("args",), "req")
+        assert ei.value.source == "shm"
+    finally:
+        shm_ring.reset_chaos()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the process pool
+# ---------------------------------------------------------------------------
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+def test_pool_shm_roundtrip_byte_exact(monkeypatch):
+    monkeypatch.setenv("KT_SHM_THRESHOLD", "65536")
+    monkeypatch.setenv("KT_SHM_RING_BYTES", str(8 << 20))
+    pool = ProcessPool(1, "spmd", _pointers(), None)
+    pool.start()
+
+    async def go():
+        a = np.random.default_rng(1).standard_normal(1 << 18).astype(
+            np.float32)                  # 1 MB
+        b = np.ones(1 << 18, dtype=np.float32)
+        out = await pool.call(0, None, [a, b], {}, timeout=90)
+        np.testing.assert_array_equal(out, a + b)
+        # below-threshold call stays on the queue path, same pool
+        assert await pool.call(0, None, [2, 3], {}, timeout=90) == 5
+
+    try:
+        _run(go())
+        assert pool.workers[0].shm_req is not None
+    finally:
+        pool.shutdown()
+    assert pool.workers[0].shm_req is None      # shutdown reclaimed rings
+
+
+@pytest.mark.slow
+def test_pool_threshold_unset_disables_byte_identically(monkeypatch):
+    """KT_SHM_THRESHOLD unset: no segments are created, no envelope
+    counters move, and results are identical to the array path."""
+    monkeypatch.delenv("KT_SHM_THRESHOLD", raising=False)
+    before = _segments()
+    pool = ProcessPool(1, "spmd", _pointers(), None)
+    pool.start()
+
+    async def go():
+        a = np.random.default_rng(2).standard_normal(1 << 17).astype(
+            np.float32)
+        out = await pool.call(0, None, [a, a], {}, timeout=90)
+        np.testing.assert_array_equal(out, a + a)
+
+    try:
+        assert pool.workers[0].shm_req is None
+        assert pool.workers[0].shm_resp is None
+        _run(go())
+        assert _segments() == before     # nothing created
+    finally:
+        pool.shutdown()
+
+
+@pytest.mark.slow
+def test_pool_ring_full_fallback_under_concurrent_large_calls(monkeypatch):
+    """A ring far smaller than the traffic: large-array calls race, some
+    envelopes fall back inline, every result stays byte-exact."""
+    monkeypatch.setenv("KT_SHM_THRESHOLD", "65536")
+    monkeypatch.setenv("KT_SHM_RING_BYTES", str(1 << 20))   # 1 MB ring
+    pool = ProcessPool(1, "spmd", _pointers(), None)
+    pool.start()
+
+    async def go():
+        rng = np.random.default_rng(3)
+        arrs = [rng.standard_normal(3 << 16).astype(np.float32)  # 768 KB
+                for _ in range(6)]
+        outs = await asyncio.gather(*[
+            pool.call(0, None, [a, a], {}, timeout=120) for a in arrs])
+        for a, out in zip(arrs, outs):
+            np.testing.assert_array_equal(out, a + a)
+
+    try:
+        _run(go())
+        from kubetorch_tpu import telemetry
+        text = telemetry.REGISTRY.render()
+        # the parent encodes 12 arrays of 768KB into a 1MB ring while six
+        # calls are in flight: fallbacks are structurally guaranteed
+        assert 'kt_shm_ring_fallbacks_total{reason="ring_full"}' in text
+    finally:
+        pool.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_shm_corrupt_falls_back_to_queue_path(monkeypatch):
+    """The acceptance drill: a corrupted envelope must NOT reach the user
+    callable — the worker's decode raises typed, the pool retries once
+    over the queue path, and the call still returns the right bytes."""
+    monkeypatch.setenv("KT_SHM_THRESHOLD", "65536")
+    monkeypatch.setenv("KT_CHAOS", "shm-corrupt")
+    shm_ring.reset_chaos()
+    pool = ProcessPool(1, "spmd", _pointers(), None)
+    pool.start()
+
+    async def go():
+        a = np.arange(1 << 17, dtype=np.float32)
+        out = await pool.call(0, None, [a, a], {}, timeout=90)
+        np.testing.assert_array_equal(out, a + a)
+
+    try:
+        _run(go())
+    finally:
+        pool.shutdown()
+        shm_ring.reset_chaos()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_worker_killed_mid_call_leaks_no_segments(monkeypatch):
+    """Lifecycle acceptance: kill a rank mid-call (kill-rank chaos), let
+    the watchdog restart the pool, and assert the dead generation's
+    /dev/shm segments are gone while the fresh generation serves."""
+    monkeypatch.setenv("KT_SHM_THRESHOLD", "65536")
+    monkeypatch.setenv("KT_CHAOS", "kill-rank:9@0")
+    monkeypatch.setenv("KT_WATCHDOG_INTERVAL_S", "0.25")
+    monkeypatch.setenv("KT_RESTART_BUDGET", "3")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("KT_RESTART_BACKOFF_MAX_S", "0.01")
+    pool = ProcessPool(1, "spmd", _pointers(), None)
+    pool.start()
+    first_gen = {pool.workers[0].shm_req.name, pool.workers[0].shm_resp.name}
+    assert first_gen <= _segments()
+
+    async def doomed():
+        from kubetorch_tpu.exceptions import WorkerDiedError
+        a = np.arange(1 << 17, dtype=np.float32)
+        with pytest.raises(WorkerDiedError):
+            await pool.call(0, None, [a, a], {}, timeout=30)
+
+    try:
+        _run(doomed())
+        # watchdog respawns the pool; the dead generation's segments are
+        # unlinked by the restart path's cleanup
+        assert _wait_until(lambda: not (first_gen & _segments()))
+        assert _wait_until(lambda: all(w.alive for w in pool.workers))
+        # disarm chaos (the watchdog's replacement inherited the armed
+        # env at spawn) and respawn once more: the fresh generation gets
+        # fresh rings and serves — the old generation's cleanup already
+        # ran through the same force-kill path this exercises again
+        monkeypatch.delenv("KT_CHAOS")
+        pool.restart_all()
+
+        async def again():
+            a = np.arange(1 << 16, dtype=np.float32)
+            out = await pool.call(0, None, [a, a], {}, timeout=90)
+            np.testing.assert_array_equal(out, a + a)
+
+        _run(again())
+        second_gen = {pool.workers[0].shm_req.name,
+                      pool.workers[0].shm_resp.name}
+        assert second_gen <= _segments() and not (first_gen & second_gen)
+    finally:
+        pool.shutdown()
+    assert not (_segments() & (first_gen | second_gen))

@@ -617,3 +617,128 @@ def test_elastic_resume_with_checkpoint_on_ring_and_dead_replica(
             assert ck.tree_fingerprint(reloaded) == out["fingerprint"]
         finally:
             sup.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: streamed proxy relay (O(chunk) RSS) + /kv/diff compression
+# ---------------------------------------------------------------------------
+
+
+def _vmrss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    raise RuntimeError("no VmRSS")
+
+
+@pytest.mark.slow
+def test_proxied_get_streams_with_o_chunk_rss(tmp_path):
+    """A ring-wide proxy GET must RELAY, not buffer (ISSUE 10): node B
+    serving a blob that lives only on node A holds O(chunk) RSS while the
+    whole blob moves — the PR 1 streaming-PUT discipline, now symmetric.
+    Before the StreamResponse relay, this held the full body in RAM
+    (``await r.read()``), so the assertion below failed by ~blob size."""
+    size = 64 << 20
+    blob = os.urandom(1 << 20) * 64          # 64 MB, two nodes, R=1
+    h = hashlib.blake2b(blob, digest_size=20).hexdigest()
+    with SubprocessStoreFleet(tmp_path, n=2, replication=1,
+                              write_quorum=1) as fleet:
+        # land the blob on node 0 ONLY (internal header: no replication)
+        r = requests.put(f"{fleet.urls[0]}/blob/{h}", data=blob,
+                         headers={"X-KT-Replicated": "1"}, timeout=120)
+        assert r.status_code == 200
+        proxy_pid = fleet.procs[1].pid
+        base_kb = _vmrss_kb(proxy_pid)
+        # GET via node 1 → local miss → streamed relay from node 0
+        peak_kb, got = base_kb, hashlib.blake2b(digest_size=20)
+        read = 0
+        with requests.get(f"{fleet.urls[1]}/blob/{h}", stream=True,
+                          timeout=120) as resp:
+            assert resp.status_code == 200
+            for chunk in resp.iter_content(1 << 20):
+                got.update(chunk)
+                read += len(chunk)
+                peak_kb = max(peak_kb, _vmrss_kb(proxy_pid))
+        assert read == size and got.hexdigest() == h   # bit-exact relay
+        delta_mb = (peak_kb - base_kb) / 1024.0
+        assert delta_mb < size / (1 << 20) / 2, \
+            f"proxy node RSS grew {delta_mb:.0f} MB during a " \
+            f"{size >> 20} MB proxied GET — the relay is buffering"
+
+
+def test_kv_diff_body_compression_negotiated(tmp_path):
+    """/kv/diff speaks zlib (zstd when available) both ways, negotiated
+    per request; clients that send no codec headers get the exact legacy
+    wire shape."""
+    import zlib
+
+    from kubetorch_tpu.data_store.store_server import create_store_app
+
+    with ThreadedAiohttpServer(
+            lambda: create_store_app(str(tmp_path / "s"))) as srv:
+        url = srv.url
+        body = b"\x01\x02\x03"
+        h = hashlib.blake2b(body, digest_size=20).hexdigest()
+        assert requests.put(f"{url}/kv/comp/a", data=body,
+                            timeout=30).status_code == 200
+        # big key table: compresses on the way in, reply compresses too
+        keys = {f"comp/missing-{i:04d}": "f" * 40 for i in range(200)}
+        keys["comp/a"] = h
+        payload = json.dumps({"keys": keys}).encode()
+        comp = zlib.compress(payload, 3)
+        assert len(comp) < len(payload) // 2
+        r = requests.post(
+            f"{url}/kv/diff", data=comp,
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "zlib",
+                     "Accept-Encoding": "zlib"}, timeout=30)
+        assert r.status_code == 200
+        assert r.headers.get("Content-Encoding") == "zlib"
+        missing = json.loads(zlib.decompress(r.content))["missing"]
+        assert "comp/a" not in missing and len(missing) == 200
+        # legacy client: no codec headers → plain JSON both ways
+        r = requests.post(f"{url}/kv/diff",
+                          json={"keys": {"comp/a": h, "comp/nope": h}},
+                          headers={"Accept-Encoding": "identity"},
+                          timeout=30)
+        assert r.status_code == 200
+        assert "Content-Encoding" not in r.headers
+        assert r.json()["missing"] == ["comp/nope"]
+        # garbage compressed body → clean 400, not a 500
+        r = requests.post(f"{url}/kv/diff", data=b"not zlib",
+                          headers={"Content-Encoding": "zlib"}, timeout=30)
+        assert r.status_code == 400
+
+
+def test_kv_diff_client_helper_round_trips_compressed(tmp_path):
+    """The put/delta client path itself negotiates compression: a warm
+    re-put over a >COMPRESS_MIN_BYTES key table still skips every leaf."""
+    from kubetorch_tpu.data_store.store_server import create_store_app
+
+    with ThreadedAiohttpServer(
+            lambda: create_store_app(str(tmp_path / "s2"))) as srv:
+        rng = np.random.default_rng(7)
+        tree = {"layer": {f"w{i:03d}": rng.standard_normal(16).astype(
+            np.float32) for i in range(40)}}    # 40 keys → >1 KB table
+        cold = ds.put("comptree/w", tree, store_url=srv.url)
+        assert cold["skipped"] == 0
+        warm = ds.put("comptree/w", tree, store_url=srv.url)
+        assert warm["skipped"] == warm["leaves"] == 40
+        assert warm["bytes"] == 0
+        out = ds.get("comptree/w", store_url=srv.url)
+        np.testing.assert_array_equal(out["layer"]["w000"],
+                                      tree["layer"]["w000"])
+
+
+def test_netpool_body_codecs_round_trip():
+    data = json.dumps({"keys": {str(i): "a" * 40
+                                for i in range(100)}}).encode()
+    for coding in ("zlib",) + (("zstd",) if netpool._zstd() else ()):
+        comp = netpool.compress_body(data, coding)
+        assert len(comp) < len(data)
+        assert netpool.decompress_body(comp, coding) == data
+    assert netpool.decompress_body(data, None) == data
+    assert netpool.best_coding("zlib, gzip") == "zlib"
+    assert netpool.best_coding("identity") is None
+    assert netpool.best_coding(None) is None

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath bench-rollout smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10): the full five-stage dispatch budget
@@ -72,6 +72,12 @@ perf-gate:
 test-shm:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_shm_ring.py -q
 
+# live weight rollout suite (ISSUE 11): broadcast-tree protocol units,
+# delta apply/fingerprint gate/rollback, canary pinning + auto-rollback,
+# kill-peer chaos parse/scoping, mid-broadcast SIGKILL acceptance
+test-rollout:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_rollout.py -q
+
 bench:
 	python bench.py
 
@@ -106,6 +112,13 @@ bench-serve:
 # msgpack-vs-shm crossover + 2x points, BENCH-tracked
 bench-hotpath:
 	$(PY_CPU) python scripts/bench_hotpath.py
+
+# live-rollout bench (ISSUE 11): fleet-wide rollout latency + origin
+# egress vs replica count (3/6/12 subprocess replicas) and delta size,
+# broadcast tree vs star baseline, with an open-loop load proving zero
+# dropped requests across the swap
+bench-rollout:
+	$(PY_CPU) python scripts/bench_rollout.py
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

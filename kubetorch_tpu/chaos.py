@@ -21,6 +21,7 @@ once, and the backoff sequence matches the policy".
              | kill-rank:SIG@OP_INDEX           (process-level; see below)
              | term-rank:GRACE_S@OP_INDEX       (process-level; see below)
              | kill-store-node[:SIG]@OP_INDEX   (process-level; see below)
+             | kill-peer[:SIG]@OP_INDEX         (process-level; see below)
              | shm-corrupt                      (process-level; see below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
@@ -98,6 +99,19 @@ Fault kinds:
   exempt probe/ring routes never advance the op counter, so the kill
   lands on exactly the client request the test scheduled it for.
 
+- ``kill-peer[:SIG]@N``  **process-level, broadcast-tree** fault
+  (ISSUE 11): the process (store node or pod) kills itself with SIG
+  (default 9) the moment its N-th (0-based) *broadcast-window transfer*
+  arrives — method-aware like ``kill-store-node``, but the counter
+  advances ONLY on client-origin ``GET``/``HEAD`` requests against the
+  data-transfer surface (``/_kt/data/`` pod-cache serves, ``/kv/`` and
+  ``/blob/`` store serves); PUTs, control POSTs (``/route``, ``/kv/diff``),
+  probe routes, and internal store↔store traffic never advance it. The
+  deterministic "interior broadcast peer died mid-transfer" scenario the
+  rollout tree's re-parenting (``/route/failed`` + client re-resolve)
+  must absorb with zero client-visible failures. Only sane against a
+  subprocess — in-process it kills the test runner.
+
 - ``shm-corrupt``  **process-level** fault (zero-copy envelope path,
   ISSUE 10): the next shared-memory array envelope this process encodes
   (``serving/shm_ring.py``) gets one byte flipped in the ring *after* the
@@ -145,7 +159,8 @@ EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
           "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
-          "term-rank", "kill-store-node", "shed", "shm-corrupt")
+          "term-rank", "kill-store-node", "kill-peer", "shed",
+          "shm-corrupt")
 
 # verbs consumed outside the HTTP middleware: the rank worker loop
 # (kill/term-rank) and the shared-memory envelope encoder (shm-corrupt,
@@ -155,7 +170,12 @@ _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
 _RANK_KINDS = ("kill-rank", "term-rank", "shm-corrupt")
 
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
-_OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node",)
+_OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node", "kill-peer")
+
+# the broadcast-window transfer surface the kill-peer op counter watches:
+# bulk GETs a parent serves to its children (pod cache route) or the
+# origin serves to the tree's roots (kv leaves / blobs)
+PEER_TRANSFER_PATHS = ("/_kt/data/", "/kv/", "/blob/")
 
 
 @dataclass
@@ -247,6 +267,9 @@ def _parse_one(token: str, raw: str) -> Fault:
     if head == "kill-store-node":
         return Fault(kind="kill-store-node",
                      signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-peer":
+        return Fault(kind="kill-peer",
+                     signal_no=_parse_signal(arg or "9", raw))
     if head == "term-rank":
         fault = Fault(kind="term-rank")
         if arg:
@@ -303,11 +326,15 @@ class ChaosEngine:
         # worker loop via rank_kill_plan()/rank_term_plan(), invisible to
         # the HTTP middleware
         faults = [f for f in faults if f.kind not in _RANK_KINDS]
-        # kill-store-node fires by op INDEX, not schedule order: armed
-        # separately and checked against the data-op counter every request
+        # kill-store-node/kill-peer fire by op INDEX, not schedule order:
+        # armed separately and checked against their own op counters every
+        # request (kill-store-node: every client-origin data op; kill-peer:
+        # only broadcast-window transfers — GET/HEAD on the transfer paths)
         self.node_faults = [f for f in faults
                             if f.kind == "kill-store-node"]
-        faults = [f for f in faults if f.kind != "kill-store-node"]
+        self.peer_faults = [f for f in faults if f.kind == "kill-peer"]
+        faults = [f for f in faults
+                  if f.kind not in ("kill-store-node", "kill-peer")]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
@@ -315,6 +342,7 @@ class ChaosEngine:
         self.injected = 0            # faults actually fired (pass excluded)
         self.requests_seen = 0
         self.data_ops = 0            # client-origin non-exempt requests
+        self.peer_ops = 0            # client-origin broadcast transfers
 
     @classmethod
     def from_env(cls) -> Optional["ChaosEngine"]:
@@ -339,6 +367,18 @@ class ChaosEngine:
             return None
         with self._lock:
             self.requests_seen += 1
+            if (method in ("GET", "HEAD")
+                    and path.startswith(PEER_TRANSFER_PATHS)):
+                # broadcast-window transfer: the kill-peer schedule is
+                # method-aware — writes and control POSTs never advance it,
+                # so the kill lands on exactly the Nth bytes-serving request
+                for i, fault in enumerate(self.peer_faults):
+                    if fault.op_index == self.peer_ops:
+                        del self.peer_faults[i]
+                        self.peer_ops += 1
+                        self.injected += 1
+                        return fault
+                self.peer_ops += 1
             if not path.startswith(EXEMPT_PATHS):
                 for i, fault in enumerate(self.node_faults):
                     if fault.op_index == self.data_ops:
@@ -500,10 +540,11 @@ def chaos_middleware(engine: ChaosEngine):
         telemetry.add_event(
             "chaos.fault", kind=fault.kind, path=request.path,
             **({"status": fault.status} if fault.kind == "status" else {}))
-        if fault.kind == "kill-store-node":
+        if fault.kind in ("kill-store-node", "kill-peer"):
             # the node dies mid-request, exactly like a SIGKILLed pod: no
             # response ever leaves this process (the client sees a reset
-            # and fails over to a ring sibling)
+            # and fails over — ring sibling for a store node, re-parent
+            # via /route/failed for a broadcast peer)
             _os.kill(_os.getpid(), fault.signal_no)
         if fault.kind == "delay":
             await asyncio.sleep(fault.seconds)

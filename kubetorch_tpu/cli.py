@@ -824,6 +824,113 @@ def store_status(url, as_json):
 
 
 @cli.group()
+def rollout():
+    """Live weight rollout management (ISSUE 11)."""
+
+
+@rollout.command("status")
+@click.option("--service", default=None,
+              help="Service name: reads its rollout manifest from the "
+                   "store and resolves replica URLs via the controller.")
+@click.option("--url", "urls", multiple=True,
+              help="Query these pod URLs directly (repeatable).")
+@click.option("--store-url", default=None,
+              help="Any store ring member (default: the configured store).")
+@click.option("--namespace", default=None)
+@click.option("--json", "as_json", is_flag=True)
+def rollout_status(service, urls, store_url, namespace, as_json):
+    """Fleet rollout view: the current manifest (version/phase/canary/
+    fingerprint from the quorum ``put_json`` path), each replica's applied
+    version + fingerprint, and bytes moved by source — rendered from the
+    store manifest plus each pod's ``/rollout/status`` and the
+    ``kt_rollout_*`` series on its ``/metrics``."""
+    import requests as _requests
+
+    from .data_store import commands as ds
+
+    manifest = None
+    if service:
+        # key shape owned by serve/rollout.py (manifest_key) — inlined here
+        # so a status command never imports the jax-heavy serve package
+        manifest = ds.get_json(f"rollout/{service}/manifest",
+                               store_url=store_url, quorum=True)
+    replica_urls = list(urls)
+    if service and not replica_urls:
+        try:
+            from .client import controller_client
+            record = controller_client().get_workload(
+                namespace or kt_config().namespace, service)
+            for pod in record.get("connected_pods", []) or []:
+                ip = pod.get("ip") if isinstance(pod, dict) else pod
+                if ip:
+                    from .constants import server_port
+                    replica_urls.append(f"http://{ip}:{server_port()}")
+        except Exception:
+            pass                      # store-only view is still useful
+    replicas, raw = [], {}
+    for base in replica_urls:
+        base = base.rstrip("/")
+        row = {"url": base, "alive": False}
+        try:
+            # one-shot probes by design (like `kt store status`): a status
+            # command that retried would hide the flakiness it shows
+            st = _requests.get(f"{base}/rollout/status", timeout=5).json()
+            text = _requests.get(f"{base}/metrics", timeout=5).text
+            series = {}
+            for line in text.splitlines():
+                if line.startswith("kt_rollout_") and not line.startswith("#"):
+                    try:
+                        series[line.rsplit(" ", 1)[0]] = float(
+                            line.split()[-1])
+                    except (ValueError, IndexError):
+                        continue
+            row.update({"alive": True,
+                        "rollouts": st.get("rollouts", []),
+                        "series": series})
+        except (_requests.RequestException, ValueError) as e:
+            row["error"] = str(e)[:120]
+        replicas.append(row)
+        raw[base] = row
+    if as_json:
+        click.echo(json.dumps({"manifest": manifest, "replicas": raw},
+                              indent=2, default=str))
+        return
+    if manifest:
+        fp = manifest.get("fingerprint") or "?"
+        click.echo(
+            f"manifest: v{manifest.get('version')} "
+            f"phase={manifest.get('phase')} step={manifest.get('step')} "
+            f"key={manifest.get('key')}")
+        click.echo(f"  fingerprint {fp}"
+                   + (f"  canary={manifest['canary']}"
+                      if manifest.get("canary") else "")
+                   + (f"  reason={manifest['reason']}"
+                      if manifest.get("reason") else ""))
+    elif service:
+        click.echo(f"no rollout manifest published for {service!r}")
+    for row in replicas:
+        if not row["alive"]:
+            click.echo(f"  {row['url']:<28} DEAD  ({row.get('error', '?')})")
+            continue
+        entries = row.get("rollouts") or []
+        if not entries:
+            click.echo(f"  {row['url']:<28} (no in-process rollout)")
+        for st in entries:
+            b = st.get("bytes") or {}
+            match = (manifest is not None
+                     and st.get("fingerprint") == manifest.get("fingerprint"))
+            click.echo(
+                f"  {row['url']:<28} v{st.get('version')} "
+                f"phase={st.get('phase')} "
+                f"{'swapping ' if st.get('swapping') else ''}"
+                f"origin={b.get('origin', 0)}B peer={b.get('peer', 0)}B "
+                f"rollbacks={st.get('rollbacks', 0)}"
+                f"{'  IN-SYNC' if match else ''}"
+                + (f"  err={st['last_error']}" if st.get("last_error")
+                   else ""))
+
+
+@cli.group()
 def queue():
     """Scheduler queue management (priorities & preemption)."""
 

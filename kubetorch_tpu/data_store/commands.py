@@ -129,6 +129,21 @@ def _leaf_hash(host) -> str:
     return hashlib.blake2b(_leaf_buffer(host), digest_size=20).hexdigest()
 
 
+def tree_fingerprint_of_hashes(leaf_hashes: Dict[str, str]) -> str:
+    """Compose per-leaf content hashes into ONE pytree fingerprint:
+    blake2b over the sorted (path, leaf-blake2b) pairs. The single
+    definition every fingerprint comparer shares — a trainer's
+    ``train.checkpoint.tree_fingerprint`` of its live state, a rollout
+    manifest's claimed fingerprint, and a serving replica's ledger of
+    already-verified leaf hashes (``serve/rollout.py``) are bit-comparable
+    *because* they all compose through here."""
+    h = hashlib.blake2b(digest_size=20)
+    for path in sorted(leaf_hashes):
+        h.update(path.encode())
+        h.update(leaf_hashes[path].encode())
+    return h.hexdigest()
+
+
 def _response_meta(r) -> Dict:
     try:
         return json.loads(r.headers.get("X-KT-Meta", "{}"))
@@ -194,10 +209,16 @@ def _put_pytree(url: str, key: str, tree: Any) -> Dict:
 
     total = sum(netpool.map_concurrent(_upload, to_upload))
     # index lands last: a reader that sees the new index sees complete leaves
-    _kv_put(url, f"{key}{_INDEX_SUFFIX}",
-            json.dumps(index).encode(), {"kind": "index"})
+    index_bytes = json.dumps(index).encode()
+    index_hash = hashlib.blake2b(index_bytes, digest_size=20).hexdigest()
+    _kv_put(url, f"{key}{_INDEX_SUFFIX}", index_bytes, {"kind": "index"})
+    # index_blake2b: the content address of THIS version's index — what a
+    # rollout manifest carries so replicas can fetch a re-put-in-place key
+    # content-addressed (stale pod caches become clean misses, never wrong
+    # bytes; see _RoutedFetcher(content_alias=True))
     return {"leaves": len(leaves), "bytes": total,
-            "skipped": len(leaves) - len(to_upload)}
+            "skipped": len(leaves) - len(to_upload),
+            "index_blake2b": index_hash}
 
 
 def _kv_diff(url: str, hashes: Dict[str, str]) -> set:
@@ -301,7 +322,15 @@ class _RoutedFetcher:
       parents (``/route/failed``, reference report_unreachable);
     - cache every fetched subkey locally and report ``/route/complete`` so
       THIS pod becomes a parent for later joiners — rolling participation,
-      O(1) store load for N-pod weight sync.
+      O(1) store load for N-pod weight sync;
+    - RE-PARENT on a dead/corrupt parent (ISSUE 11): after reporting
+      ``/route/failed`` the fetcher re-asks the coordinator for a fresh
+      parent (up to ``KT_ROUTE_RETRIES`` times) instead of falling all the
+      way back to the origin — a mid-broadcast peer SIGKILL moves this
+      pod's remaining bytes to a surviving peer, keeping origin egress
+      O(delta) through the failure. Per-source byte totals are kept on
+      ``bytes_by_source`` (the rollout coordinator's
+      ``kt_rollout_bytes_total{source}`` feed).
 
     Peer mode is automatic inside pods (POD_IP set: the pod server serves
     the cache) and off for laptops, which can't reach pod IPs; ``peer=``
@@ -315,9 +344,19 @@ class _RoutedFetcher:
     """
 
     def __init__(self, store_url: str, key: str, peer: Optional[bool],
-                 sess: Optional[_requests.Session] = None):
+                 sess: Optional[_requests.Session] = None,
+                 content_alias: bool = False):
         self.store_url = store_url
         self.key = key
+        # content-addressed peer exchange for MUTABLE keys (ISSUE 11): the
+        # pod cache and the parent data route are keyed by
+        # ``subkey@hash12`` instead of the bare subkey, so a rollout that
+        # re-puts ``rollout/svc/weights`` in place every version can still
+        # ride the broadcast tree — a parent still holding the PREVIOUS
+        # version's bytes is a clean 404 (the rolling-join poll covers
+        # it), never a stale serve. Store-directed requests keep the raw
+        # subkey (the origin is always current).
+        self.content_alias = bool(content_alias)
         self.ring = ring.ring_for(store_url)
         self.sess = sess            # explicit session override (tests);
         #                             None → per-thread pooled session
@@ -330,6 +369,17 @@ class _RoutedFetcher:
         self._deadline: Optional[float] = None
         self._lock = threading.Lock()
         self._complete_sent = False
+        # re-parenting budget: how many fresh /route resolutions a failed
+        # parent may trigger before this fetcher stops asking and lets the
+        # origin cover the rest (cycles/cascades must terminate)
+        self._reroutes = 0
+        try:
+            self._max_reroutes = int(os.environ.get("KT_ROUTE_RETRIES", "2"))
+        except ValueError:
+            self._max_reroutes = 2
+        # per-source byte totals across this fetcher's lifetime — read by
+        # serve/rollout.py to attribute a rollout's bytes to origin vs peer
+        self.bytes_by_source: Dict[str, int] = {}
 
     def _sess(self) -> _requests.Session:
         return self.sess if self.sess is not None else netpool.session()
@@ -461,9 +511,13 @@ class _RoutedFetcher:
 
         if timeout is None:
             timeout = netpool.store_timeout()
+        # ck: the peer-exchange key (content-aliased for mutable rollout
+        # keys, the bare subkey otherwise); the STORE is always asked for
+        # the raw subkey
+        ck = self._peer_key(subkey, expect_hash)
         if self.enabled:
             from .peer_cache import cache_evict, cache_get
-            hit = cache_get(subkey)
+            hit = cache_get(ck)
             if hit is not None:
                 try:
                     _verify_content(hit[0], hit[1], expect_hash, subkey,
@@ -471,14 +525,19 @@ class _RoutedFetcher:
                     self._fetched = True
                     sp.set_attr("source", "pod-cache")
                     _FETCHES.inc(source="pod-cache")
+                    self._account("pod-cache", hit[0])
                     return _CachedResponse(*hit)
                 except DataCorruptionError:
                     # self-heal the pod cache: drop the rotten entry and
                     # fetch fresh bytes below (also stops this pod serving
                     # the rot to its own children via /_kt/data)
-                    cache_evict(subkey)
-        self._resolve()
+                    cache_evict(ck)
         while True:
+            # resolve INSIDE the loop: an eviction that armed a re-route
+            # (_evict_peer) cleared _resolved, so the next pass re-asks the
+            # coordinator for a fresh parent — the tree re-parents around a
+            # dead interior peer instead of stampeding the origin
+            self._resolve()
             with self._lock:
                 peer = self.peer_url
                 if peer is not None and self._deadline is None:
@@ -487,9 +546,10 @@ class _RoutedFetcher:
             if peer is None:
                 break
             try:
-                r = self._fetch_from_peer(subkey, timeout)
+                r = self._fetch_from_peer(ck, timeout)
             except _requests.RequestException:
-                self._evict_peer(peer)
+                if self._evict_peer(peer):
+                    continue
                 break
             if r.status_code == 200:
                 try:
@@ -498,8 +558,9 @@ class _RoutedFetcher:
                 except DataCorruptionError:
                     # a corrupt parent is as bad as an unreachable one:
                     # evict (/route/failed) so nobody else is routed there,
-                    # then repair from the origin
-                    self._evict_peer(peer)
+                    # then repair from a fresh parent or the origin
+                    if self._evict_peer(peer):
+                        continue
                     break
                 # progress resets the window: a healthy parent slowly
                 # serving a large multi-leaf checkpoint must not be
@@ -508,9 +569,10 @@ class _RoutedFetcher:
                 with self._lock:
                     if self.peer_url == peer:
                         self._deadline = None
-                self._cache(subkey, r)
+                self._cache(ck, r)
                 sp.set_attr("source", "peer")
                 _FETCHES.inc(source="peer")
+                self._account("peer", r.content)
                 return r
             if r.status_code != 404:
                 break            # parent errored; store covers this one
@@ -521,7 +583,8 @@ class _RoutedFetcher:
             if expired:
                 # the parent's window is spent: evict it so later
                 # joiners aren't routed to a cache that never fills
-                self._evict_peer(peer)
+                if self._evict_peer(peer):
+                    continue
                 break
             _time.sleep(0.25)
         def _verify(resp):
@@ -535,21 +598,42 @@ class _RoutedFetcher:
         r = self._store_request("GET", f"/kv/{netpool.urlkey(subkey)}",
                                 subkey, timeout=timeout, verify=_verify)
         if r.status_code == 200:
-            self._cache(subkey, r)
+            self._cache(ck, r)
             _FETCHES.inc(source="store")
+            self._account("store", r.content)
         sp.set_attr("source", "store")
         return r
 
-    def _evict_peer(self, peer: str) -> None:
+    def _peer_key(self, subkey: str, expect_hash: Optional[str]) -> str:
+        if self.content_alias and expect_hash:
+            return f"{subkey}@{expect_hash[:12]}"
+        return subkey
+
+    def _account(self, source: str, content) -> None:
+        with self._lock:
+            self.bytes_by_source[source] = (
+                self.bytes_by_source.get(source, 0) + len(content))
+
+    def _evict_peer(self, peer: str) -> bool:
         """Drop ``peer`` as parent (first evictor wins; concurrent workers
-        that raced on the same dead parent are no-ops) and tell the store."""
+        that raced on the same dead parent are no-ops), tell the
+        coordinator (``/route/failed``), and — when the ``KT_ROUTE_RETRIES``
+        budget allows — arm a fresh ``/route`` resolution so the NEXT fetch
+        re-parents onto a surviving peer instead of falling back to the
+        origin. Returns True when a re-route was armed (the caller should
+        loop); False sends the caller to the store."""
         with self._lock:
             if self.peer_url != peer:
-                return
+                return False
             self.peer_url = None
             self.peer_blob_url = None
             self._deadline = None
+            reroute = self._reroutes < self._max_reroutes
+            if reroute:
+                self._reroutes += 1
+                self._resolved = False
         self._report_failed(peer)
+        return reroute
 
     def _fetch_from_peer(self, subkey: str, timeout: float):
         """One peer attempt. Prefers the parent's ktblobd (native

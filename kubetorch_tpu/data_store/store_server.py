@@ -1079,21 +1079,49 @@ async def barrier_join(request: web.Request) -> web.Response:
 # -- P2P fan-out routing (MDS broadcast-coordination role) --------------------
 #
 # The reference's rolling-participation tree broadcast (design.md, client
-# :376-688): N pods fetching one key produce O(1) store load. Each getter
-# asks /route for a source; the store answers "store" (tree root) or a peer
-# assigned EAGERLY in arrival order (fanout-capped), which may still be
-# fetching — the child polls the parent's cache until it fills (the
-# reference's "block until parent done" rolling join). Pods also register
-# on completion so late joiners fan out from finished holders, and
-# /route/failed evicts unreachable parents so their children re-route.
+# :376-688), finished into a REAL fan-out tree (ISSUE 11): N pods fetching
+# one key produce O(1) store load AND bounded per-NIC load. Each getter
+# asks /route for a source; the store answers "store" (tree root, depth 0)
+# or a peer assigned EAGERLY in arrival order, which may still be fetching
+# — the child polls the parent's cache until it fills (the reference's
+# "block until parent done" rolling join). Parent assignment is
+# depth-aware and out-degree-bounded: the shallowest member with a free
+# child slot wins, so the tree fills breadth-first and a multi-GB rollout
+# push leaves the origin's NIC exactly once per fanout'd child while every
+# interior node serves at most ``KT_ROUTE_FANOUT`` children. Pods also
+# register on completion so late joiners fan out from finished holders,
+# and /route/failed evicts unreachable parents, frees their slot on THEIR
+# parent, and orphans their children — who re-route on the next /route
+# call (client-side re-parenting in commands._RoutedFetcher).
 
-ROUTE_FANOUT = 50          # children per parent (reference FS fanout)
 ROUTE_STALE_S = 3600.0     # forget members after an hour
+_DEFAULT_ROUTE_FANOUT = 4  # children per parent (tensor-tree shape: every
+#                            hop is a full-bandwidth transfer, so a small
+#                            out-degree keeps each NIC O(fanout × delta)
+#                            and depth O(log_fanout N))
+
+
+def route_fanout() -> int:
+    """Max children per broadcast-tree member (``KT_ROUTE_FANOUT``)."""
+    try:
+        return max(1, int(os.environ.get("KT_ROUTE_FANOUT",
+                                         str(_DEFAULT_ROUTE_FANOUT))))
+    except ValueError:
+        return _DEFAULT_ROUTE_FANOUT
+
+
+_ROUTE_EVENTS = telemetry.counter(
+    "kt_store_route_events_total",
+    "Broadcast-tree membership events (evict: parent reported failed; "
+    "orphan: child of an evicted parent, re-routes on next /route; "
+    "reparent: a previously-orphaned/evicted member re-assigned)",
+    labels=("event",))
 
 
 class _RouteGroup:
+    # url → {ts, children, depth, parent, blob_url, complete}
     def __init__(self):
-        self.members: Dict[str, Dict] = {}   # url → {ts, children}
+        self.members: Dict[str, Dict] = {}
 
 
 def _route_groups(st: StoreState) -> Dict[str, _RouteGroup]:
@@ -1115,6 +1143,30 @@ def _gc_route_groups(groups: Dict[str, _RouteGroup]) -> None:
         del groups[key]
 
 
+def _is_ancestor(group: _RouteGroup, candidate: str, url: str) -> bool:
+    """True when ``url`` appears on ``candidate``'s parent chain — a
+    re-routing member must never be handed one of its own descendants
+    (A→B→A would deadlock both until the peer-wait window expires)."""
+    seen = set()
+    cur: Optional[str] = candidate
+    while cur is not None and cur not in seen:
+        if cur == url:
+            return True
+        seen.add(cur)
+        member = group.members.get(cur)
+        cur = member.get("parent") if member else None
+    return False
+
+
+def _free_parent_slot(group: _RouteGroup, url: str) -> None:
+    member = group.members.get(url)
+    parent = member.get("parent") if member else None
+    if parent:
+        p = group.members.get(parent)
+        if p is not None:
+            p["children"] = max(0, p.get("children", 0) - 1)
+
+
 async def route_get(request: web.Request) -> web.Response:
     st = _state(request)
     body = await request.json()
@@ -1127,34 +1179,61 @@ async def route_get(request: web.Request) -> web.Response:
     for url in [u for u, m in group.members.items()
                 if now - m["ts"] > ROUTE_STALE_S]:
         del group.members[url]
-    # least-loaded member with a free child slot — assigned before the caller
-    # registers, so it can never be its own parent
-    candidates = [(m["children"], url) for url, m in group.members.items()
-                  if m["children"] < ROUTE_FANOUT and url != self_url]
-    if self_url and self_url not in group.members:
-        group.members[self_url] = {"children": 0, "ts": now,
-                                   # ktblobd address: children stream bulk
-                                   # bytes from the native daemon when the
-                                   # parent runs one
-                                   "blob_url": body.get("self_blob_url")}
+    fanout = route_fanout()
+    existing = group.members.get(self_url) if self_url else None
+    if existing is not None and existing.get("parent"):
+        # a RE-route replaces the caller's edge: free the old parent's
+        # child slot first, or re-routing members double-book the fanout
+        _free_parent_slot(group, self_url)
+        existing["parent"] = None
+    # shallowest member with a free child slot wins (ties: fewest children,
+    # then url for determinism) — breadth-first tree fill, so depth stays
+    # O(log_fanout N) and no member ever serves more than ``fanout``
+    # children. Assigned before the caller registers, so it can never be
+    # its own parent; on RE-route (caller already registered) its own
+    # descendants are excluded too, or the tree would cycle.
+    candidates = [(m.get("depth", 1), m.get("children", 0), url)
+                  for url, m in group.members.items()
+                  if m.get("children", 0) < fanout and url != self_url
+                  and not (self_url and _is_ancestor(group, url, self_url))]
+    chosen: Optional[str] = None
     if candidates:
-        _, url = min(candidates)
-        member = group.members[url]
-        member["children"] += 1
-        return web.json_response({"source": "peer", "url": url,
-                                  "blob_url": member.get("blob_url")})
-    return web.json_response({"source": "store"})
+        _, _, chosen = min(candidates)
+    depth = (group.members[chosen].get("depth", 1) + 1) if chosen else 1
+    if self_url:
+        member = group.members.setdefault(self_url, {"children": 0})
+        member["ts"] = now
+        member["depth"] = depth
+        member["parent"] = chosen
+        if body.get("self_blob_url"):
+            # ktblobd address: children stream bulk bytes from the native
+            # daemon when the parent runs one
+            member["blob_url"] = body.get("self_blob_url")
+        else:
+            member.setdefault("blob_url", None)
+        if existing is not None:
+            # a re-route: this member had (or lost) a parent before
+            _ROUTE_EVENTS.inc(event="reparent")
+    if chosen:
+        member = group.members[chosen]
+        member["children"] = member.get("children", 0) + 1
+        return web.json_response({"source": "peer", "url": chosen,
+                                  "blob_url": member.get("blob_url"),
+                                  "depth": depth})
+    return web.json_response({"source": "store", "depth": depth})
 
 
 async def route_complete(request: web.Request) -> web.Response:
     """A pod finished fetching ``key`` (it can now serve every subkey):
-    (re-)register it fresh so late joiners prefer finished holders."""
+    (re-)register it fresh so late joiners fan out from finished holders."""
     st = _state(request)
     body = await request.json()
     groups = _route_groups(st)
     group = groups.setdefault(body["key"], _RouteGroup())
     member = group.members.setdefault(body["url"], {"children": 0})
     member["ts"] = time.time()
+    member["complete"] = True
+    member.setdefault("depth", 1)
     if body.get("blob_url"):
         member["blob_url"] = body["blob_url"]
     _gc_route_groups(groups)
@@ -1163,14 +1242,33 @@ async def route_complete(request: web.Request) -> web.Response:
 
 async def route_failed(request: web.Request) -> web.Response:
     """A getter reports its assigned parent unreachable or corrupt
-    (reference report_unreachable): evict so nobody else is routed there."""
+    (reference report_unreachable): evict so nobody else is routed there,
+    free the evicted member's slot on ITS parent, and orphan its children
+    — each child re-parents itself on its next /route call (the
+    re-parenting half lives in commands._RoutedFetcher, which re-resolves
+    after reporting). Returns how many children were orphaned so tests and
+    ``kt rollout status`` can see the tree heal."""
     st = _state(request)
     body = await request.json()
     group = _route_groups(st).get(body["key"])
     evicted = False
+    orphans = 0
     if group is not None:
-        evicted = group.members.pop(body["url"], None) is not None
-    return web.json_response({"ok": True, "evicted": evicted})
+        url = body["url"]
+        member = group.members.get(url)
+        if member is not None:
+            _free_parent_slot(group, url)
+            del group.members[url]
+            evicted = True
+            _ROUTE_EVENTS.inc(event="evict")
+            for child in group.members.values():
+                if child.get("parent") == url:
+                    child["parent"] = None
+                    orphans += 1
+            if orphans:
+                _ROUTE_EVENTS.inc(orphans, event="orphan")
+    return web.json_response({"ok": True, "evicted": evicted,
+                              "orphans": orphans})
 
 
 # -- peer registry (MDS role) -------------------------------------------------

@@ -168,6 +168,29 @@ class DataCorruptionError(DataStoreError):
         self.source = source
 
 
+class RolloutError(KubetorchError):
+    """A live weight rollout refused to swap (ISSUE 11).
+
+    Raised by ``serve/rollout.py`` — the only weight-swap site — when a
+    staged delta fails its bit-equality gate (index/manifest fingerprint
+    mismatch, a leaf whose shape/dtype no longer matches the engine's
+    compiled step, or a manifest pointing at weights the store no longer
+    holds). The engine's live params are untouched whenever this raises:
+    every check runs BEFORE the batch-boundary swap, so a bad manifest
+    can never leave a replica mixed-version."""
+
+    def __init__(self, message: str = "weight rollout refused",
+                 reason: Optional[str] = None,
+                 version: Optional[int] = None,
+                 expected: Optional[str] = None,
+                 actual: Optional[str] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.version = version
+        self.expected = expected
+        self.actual = actual
+
+
 class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
@@ -396,6 +419,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         StoreFullError,
         RingEpochMismatch,
         DataCorruptionError,
+        RolloutError,
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
@@ -416,6 +440,7 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "StoreFullError": ["path"],
     "RingEpochMismatch": ["expected", "actual"],
     "DataCorruptionError": ["key", "expected", "actual", "source"],
+    "RolloutError": ["reason", "version", "expected", "actual"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
     "AdmissionShedError": ["reason", "tier", "queue_depth", "retry_after"],

@@ -19,6 +19,7 @@ from ..models.quant import (dequantize_params, llama_init_quantized,
                             quantized_bytes)
 from .engine import EngineStats, GenerationEngine, RequestHandle
 from .kv_quant import QuantKVCache, dequantize_rows, quantize_rows
+from .rollout import CanaryRollout, WeightRollout
 from .sessions import EngineSessionBinder, SessionStats, session_key
 from .spec_engine import SpeculativeEngine
 from .speculative import SpecStats, speculative_generate
@@ -29,6 +30,7 @@ __all__ = ["GenerationEngine", "RequestHandle", "EngineStats",
            "llama_init_quantized", "dequantize_params", "quantized_bytes",
            "speculative_generate", "SpecStats", "SpeculativeEngine",
            "QuantKVCache", "quantize_rows", "dequantize_rows",
+           "WeightRollout", "CanaryRollout",
            "OpenAIApp", "build_openai_app"]
 
 

@@ -840,6 +840,10 @@ class GenerationEngine:
         # loop thread may ever exist — two would interleave _decode_step on
         # the same donated cache
         self._lifecycle = threading.Lock()
+        # callables queued for the next batch boundary (weight hot swap —
+        # serve/rollout.py is the only assigner of self.params after
+        # construction; see at_batch_boundary)
+        self._boundary_hooks: "deque[tuple]" = deque()
         # stats
         self._admitted = self._finished = 0
         self._tokens = self._steps = 0
@@ -1192,6 +1196,48 @@ class GenerationEngine:
 
     def _free_slot_ledgers(self, slot: int) -> None:
         """Subclass hook: extra per-slot state to clear on retirement."""
+
+    # -- batch-boundary scheduling ------------------------------------------
+
+    def at_batch_boundary(self, fn, timeout: Optional[float] = None):
+        """Run ``fn()`` between decode batches, on the stepping thread.
+
+        THE safe point for anything that mutates engine-wide device state
+        — above all the live weight hot swap (``serve/rollout.py``, the
+        only sanctioned ``engine.params`` writer after construction): no
+        decode dispatch is in flight when the hook runs, so donated
+        buffers can be freed and replaced without racing a jit. Blocks the
+        CALLER until the hook has run (the decode loop itself never
+        blocks on anything but the device); with no loop thread running,
+        runs inline under the engine's mesh scope — the caller is the
+        de-facto stepping thread. Exceptions propagate to the caller,
+        never into the decode loop. Returns ``fn()``'s result."""
+        with self._lifecycle:
+            thread = self._thread
+        running = thread is not None and thread.is_alive()
+        if not running or threading.current_thread() is thread:
+            with self._mesh_scope():
+                return fn()
+        box: Dict[str, Any] = {"done": threading.Event()}
+        self._boundary_hooks.append((fn, box))
+        self._work.set()
+        if not box["done"].wait(timeout):
+            raise TimeoutError(
+                "engine did not reach a batch boundary in time")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _run_boundary_hooks(self) -> None:
+        """Drain queued boundary hooks (stepping thread, between batches)."""
+        while self._boundary_hooks:
+            fn, box = self._boundary_hooks.popleft()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — hand to the waiter
+                box["error"] = e
+            finally:
+                box["done"].set()
 
     # -- engine loop --------------------------------------------------------
 
@@ -1548,6 +1594,11 @@ class GenerationEngine:
             return self._step_once()
 
     def _step_once(self) -> int:
+        # boundary hooks first: we are BETWEEN decode batches here (the
+        # previous dispatch retired at the end of the last _step_once), so
+        # a weight swap scheduled via at_batch_boundary never overlaps a
+        # decode dispatch on the old params
+        self._run_boundary_hooks()
         self._reap_cancelled()
         self._admit()
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
@@ -1644,6 +1695,10 @@ class GenerationEngine:
             if self._thread is thread and (thread is None
                                            or not thread.is_alive()):
                 self._thread = None
+        # hooks enqueued in the stop race would otherwise strand their
+        # waiters: with the loop gone, this thread is the stepping thread
+        with self._mesh_scope():
+            self._run_boundary_hooks()
 
     # -- introspection ------------------------------------------------------
 

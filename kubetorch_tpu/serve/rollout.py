@@ -1,0 +1,749 @@
+"""Live weight rollout: zero-downtime engine hot swap (ISSUE 11).
+
+THE weight-swap site. A running :class:`~kubetorch_tpu.serve.engine.
+GenerationEngine` never has its parameter tree assigned from anywhere but
+this module — ``scripts/check_resilience.py`` lints for strays — because a
+swap that skips this path silently skips every guarantee the online-
+learning loop rests on:
+
+- **Delta fetch over the broadcast tree, off the decode thread.** The
+  trainer pushes a checkpoint through the content-addressed delta path
+  (only changed leaves move bytes at all) and publishes a *rollout
+  manifest* via the ring's write-quorum ``put_json``
+  (``train.checkpoint.publish_rollout``). Each replica's
+  :class:`WeightRollout` polls the manifest, diffs the index's per-leaf
+  blake2b hashes against its own verified ledger, and prefetches exactly
+  the changed leaves through the P2P broadcast tree
+  (``data_store/store_server.py`` ``/route``) — so a fleet-wide multi-GB
+  rollout leaves the origin's NIC O(delta), not O(replicas × delta), and
+  the decode loop never blocks on the network.
+- **Bit-equality gate before any swap.** The staged tree's composed
+  fingerprint (:func:`~kubetorch_tpu.data_store.commands.
+  tree_fingerprint_of_hashes` over already-verified leaf hashes) must
+  equal the manifest's ``tree_fingerprint`` — the same value the trainer
+  computed from its live state. Mismatch → typed
+  :class:`~kubetorch_tpu.exceptions.RolloutError`, engine untouched. A
+  replica is ALWAYS either fully on version N or fully on version M,
+  never silently mixed.
+- **Swap between decode batches, with buffer donation.** The actual
+  assignment runs on the engine's stepping thread via
+  ``engine.at_batch_boundary`` — no decode dispatch is in flight — and
+  proceeds leaf-by-leaf: the old device buffer is freed *before* its
+  replacement lands, so peak HBM overhead is one leaf, never 2× the
+  model. In a deployed pod the staged host arrays reach the rank worker
+  as ordinary call args — i.e. over the ISSUE-10 shared-memory envelope
+  path — before this module applies them.
+- **Canary-first, auto-rollback.** A ``phase="canary"`` manifest swaps
+  ONLY the named replica; :class:`CanaryRollout` pins a router traffic
+  slice to it and watches error-rate/latency against the pre-swap EWMA
+  (``serving.router.Router.set_canary``), then promotes
+  (``phase="fleet"``) or publishes a typed rollback. The pre-swap leaves
+  are stashed host-side (delta-sized), so rollback is a local batch-
+  boundary swap — no refetch.
+
+Telemetry: ``kt_rollout_seconds{phase}``, ``kt_rollout_bytes_total
+{source}``, ``kt_rollout_rollbacks_total{reason}``, plus a
+``rollout.swap`` span parented onto the trainer's push trace (the
+manifest carries the trace context). Rows in docs/observability.md;
+runbook in docs/operations.md "Live weight rollout".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..data_store import commands as ds
+from ..data_store import netpool
+from ..exceptions import RolloutError
+
+_ROLLOUT_SECONDS = telemetry.histogram(
+    "kt_rollout_seconds",
+    "Live weight rollout wall-clock per phase "
+    "(fetch: delta over the broadcast tree; stage: host staging + "
+    "fingerprint gate; swap: batch-boundary donation swap; verify: "
+    "post-swap ledger/fingerprint check)",
+    labels=("phase",))
+_ROLLOUT_BYTES = telemetry.counter(
+    "kt_rollout_bytes_total",
+    "Rollout delta bytes moved, by serving source (origin: the store "
+    "ring; peer: the P2P broadcast tree / pod cache)",
+    labels=("source",))
+_ROLLBACKS = telemetry.counter(
+    "kt_rollout_rollbacks_total",
+    "Weight rollbacks applied, by reason",
+    labels=("reason",))
+_ROLLOUT_VERSION = telemetry.gauge(
+    "kt_rollout_version",
+    "Rollout manifest version this process's engine is serving")
+
+# live WeightRollout instances in this process — the /rollout/status and
+# `kt rollout status` surface
+_LOCAL: "weakref.WeakSet[WeightRollout]" = weakref.WeakSet()
+
+
+def manifest_key(service: str) -> str:
+    return f"rollout/{service}/manifest"
+
+
+def weights_key(service: str) -> str:
+    return f"rollout/{service}/weights"
+
+
+def read_manifest(service: str,
+                  store_url: Optional[str] = None) -> Optional[Dict]:
+    """The fleet's current rollout manifest, read at QUORUM (every member
+    of its replica set answers; newest ``stored_at`` wins) — a store-node
+    loss mid-rollout can never resurrect a stale version. None when no
+    rollout has ever been published for ``service``."""
+    m = ds.get_json(manifest_key(service), store_url=store_url, quorum=True)
+    return m if isinstance(m, dict) else None
+
+
+def publish_manifest(service: str, *, key: str, step: Optional[int] = None,
+                     fingerprint: Optional[str] = None,
+                     phase: str = "fleet", canary: Optional[str] = None,
+                     reason: Optional[str] = None,
+                     store_url: Optional[str] = None,
+                     version: Optional[int] = None,
+                     index_blake2b: Optional[str] = None) -> Dict:
+    """Write the rollout manifest through the ring's write-quorum
+    ``put_json`` path (the PUT is the commit point — replicas act only on
+    what this publishes). ``version`` auto-increments over the previous
+    manifest; the active trace context rides along so every replica's
+    ``rollout.swap`` span parents onto the trainer's push trace."""
+    if phase not in ("canary", "fleet", "rollback"):
+        raise ValueError(f"unknown rollout phase {phase!r}")
+    prev = read_manifest(service, store_url=store_url)
+    if version is None:
+        version = (int(prev.get("version", 0)) + 1) if prev else 1
+    manifest = {
+        "service": service,
+        "version": int(version),
+        "key": key,
+        "step": None if step is None else int(step),
+        "fingerprint": fingerprint,
+        "phase": phase,
+        "canary": canary,
+        "reason": reason,
+        # content address of this version's pytree index: what lets
+        # replicas fetch a re-put-in-place key over the broadcast tree
+        # content-addressed (stale pod caches miss cleanly)
+        "index_blake2b": index_blake2b,
+        "published_at": round(time.time(), 6),
+        "trace": telemetry.current_header(),
+    }
+    ds.put_json(manifest_key(service), manifest, store_url=store_url)
+    return manifest
+
+
+def local_status() -> List[Dict]:
+    """Status of every live rollout coordinator in THIS process (the pod
+    ``/rollout/status`` payload)."""
+    return [w.status() for w in list(_LOCAL)]
+
+
+# ---------------------------------------------------------------------------
+# pytree path helpers (paths are commands._flatten's "a/b/0/c" shape)
+# ---------------------------------------------------------------------------
+
+
+def _get_leaf(tree: Any, path: str) -> Any:
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, dict):
+            node = node[part]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            raise KeyError(path)
+    return node
+
+
+def _set_leaf(tree: Any, path: str, value: Any) -> None:
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        if isinstance(node, dict):
+            node = node[part]
+        elif isinstance(node, list):
+            node = node[int(part)]
+        else:
+            raise RolloutError(
+                f"cannot swap into immutable container at {path!r}",
+                reason="immutable_container")
+    last = parts[-1]
+    if isinstance(node, dict):
+        node[last] = value
+    elif isinstance(node, list):
+        node[int(last)] = value
+    else:
+        raise RolloutError(
+            f"cannot swap into immutable container at {path!r}",
+            reason="immutable_container")
+
+
+def _host_leaf(arr: Any) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(arr))
+
+
+def _is_device_array(arr: Any) -> bool:
+    # jax.Array has .delete()/.sharding; numpy has neither
+    return hasattr(arr, "delete") and hasattr(arr, "sharding")
+
+
+# ---------------------------------------------------------------------------
+# the per-replica coordinator
+# ---------------------------------------------------------------------------
+
+
+class WeightRollout:
+    """One engine's live-rollout coordinator.
+
+    ``engine`` is any object with a mutable ``params`` pytree and the
+    ``at_batch_boundary(fn, timeout=)`` contract —
+    :class:`~kubetorch_tpu.serve.engine.GenerationEngine` in production,
+    :class:`HostEngine` as the CPU proxy in benches/tests. ``replica_id``
+    is how canary manifests name this replica (defaults to ``POD_IP``,
+    falling back to the hostname).
+
+    Drive it with :meth:`poll_once` (deterministic — what the tests call)
+    or :meth:`start` the background manifest-poll thread. All swap state
+    transitions are serialized by an internal lock: one apply at a time,
+    and ``status()`` is safe from any thread.
+    """
+
+    def __init__(self, engine: Any, service: str, *,
+                 replica_id: Optional[str] = None,
+                 store_url: Optional[str] = None,
+                 poll_s: float = 2.0, peer: Optional[bool] = None,
+                 swap_timeout_s: float = 120.0):
+        import socket
+
+        self.engine = engine
+        self.service = service
+        self.replica_id = (replica_id or os.environ.get("POD_IP")
+                           or socket.gethostname())
+        self.store_url = store_url
+        self.poll_s = float(poll_s)
+        self.peer = peer
+        self.swap_timeout_s = float(swap_timeout_s)
+        self.version = 0
+        self.step: Optional[int] = None
+        self.phase: Optional[str] = None
+        self.fingerprint: Optional[str] = None
+        self.applied_at: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self.bytes_moved = {"origin": 0, "peer": 0}
+        self.swaps = 0
+        self.rollbacks = 0
+        self._leaf_hashes: Optional[Dict[str, str]] = None
+        # pre-swap stash of the LAST swap's replaced leaves (host, delta-
+        # sized): what makes rollback a local batch-boundary swap
+        self._undo: Optional[Dict[str, Any]] = None
+        self._apply_lock = threading.Lock()
+        self._swapping = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        _LOCAL.add(self)
+
+    # -- ledger --------------------------------------------------------------
+
+    def _ensure_ledger(self) -> None:
+        """Per-leaf content hashes of the engine's CURRENT params. Computed
+        once (full host pull + hash — the price of joining the verified
+        world from unverified initial weights); every later apply updates
+        it incrementally from already-verified index hashes."""
+        if self._leaf_hashes is not None:
+            return
+        leaves: Dict[str, Any] = {}
+        ds._flatten(self.engine.params, "", leaves)
+        self._leaf_hashes = {p: ds._leaf_hash(_host_leaf(a))
+                             for p, a in leaves.items()}
+        self.fingerprint = ds.tree_fingerprint_of_hashes(self._leaf_hashes)
+
+    # -- polling -------------------------------------------------------------
+
+    def poll_once(self) -> Optional[Dict]:
+        """Read the manifest and converge toward it. Returns the apply/
+        rollback summary when something changed, None otherwise. Never
+        raises on transport problems (the poll loop must survive a store
+        blip); RolloutError from a bad manifest is recorded on
+        ``last_error`` and re-raised for deterministic callers."""
+        manifest = read_manifest(self.service, store_url=self.store_url)
+        if manifest is None:
+            return None
+        try:
+            version = int(manifest.get("version", 0))
+        except (TypeError, ValueError):
+            return None
+        if version <= self.version:
+            return None
+        phase = manifest.get("phase", "fleet")
+        if phase == "canary" and manifest.get("canary") != self.replica_id:
+            # non-canary replicas NEVER swap on a canary manifest — they
+            # wait for the fleet promotion (or absorb the rollback bump)
+            return None
+        try:
+            if phase == "rollback":
+                return self._apply_rollback(manifest)
+            return self.apply(manifest)
+        except RolloutError as e:
+            self.last_error = str(e)
+            raise
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except RolloutError:
+                pass                     # recorded on last_error
+            except Exception as e:       # noqa: BLE001 — poll must survive
+                self.last_error = str(e)
+            self._stop.wait(self.poll_s)
+
+    def start(self) -> "WeightRollout":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="kt-weight-rollout")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- apply ---------------------------------------------------------------
+
+    def apply(self, manifest: Dict) -> Dict:
+        with self._apply_lock:
+            return self._apply_locked(manifest)
+
+    def _span_parent(self, manifest: Dict):
+        tr = manifest.get("trace")
+        if not tr:
+            return None
+        return telemetry.extract({telemetry.TRACE_HEADER: tr})
+
+    def _apply_locked(self, manifest: Dict) -> Dict:
+        version = int(manifest["version"])
+        key = manifest.get("key") or weights_key(self.service)
+        m_fp = manifest.get("fingerprint")
+        url = ds._store_url(self.store_url)
+
+        # ---- fetch: delta over the broadcast tree, off the decode thread.
+        # content_alias keys the peer exchange by subkey@hash — mutable
+        # rollout keys ride the tree without stale-cache hazards
+        t0 = time.monotonic()
+        fetcher = ds._RoutedFetcher(url, key, self.peer, content_alias=True)
+        index_hash = manifest.get("index_blake2b")
+        r = fetcher.fetch(f"{key}{ds._INDEX_SUFFIX}", timeout=60,
+                          expect_hash=index_hash)
+        if r.status_code != 200:
+            raise RolloutError(
+                f"rollout v{version}: weights index {key!r} not in the "
+                "store", reason="missing_index", version=version)
+        index = json.loads(r.content)
+        target = {p: m["blake2b"] for p, m in index["leaves"].items()}
+        want_fp = ds.tree_fingerprint_of_hashes(target)
+        if m_fp is not None and want_fp != m_fp and index_hash is None:
+            # legacy manifest without the index content address: a pod
+            # cache may have served the PREVIOUS version's index — evict
+            # it and retry once straight from the origin
+            try:
+                from ..data_store.peer_cache import cache_evict
+                cache_evict(f"{key}{ds._INDEX_SUFFIX}")
+            except Exception:     # noqa: BLE001 — cache-less environment
+                pass
+            r = ds._RoutedFetcher(url, key, False).fetch(
+                f"{key}{ds._INDEX_SUFFIX}", timeout=60)
+            if r.status_code == 200:
+                index = json.loads(r.content)
+                target = {p: m["blake2b"]
+                          for p, m in index["leaves"].items()}
+                want_fp = ds.tree_fingerprint_of_hashes(target)
+        if m_fp is not None and want_fp != m_fp:
+            # the index does not add up to what the trainer committed —
+            # refuse BEFORE moving bulk bytes or touching the engine
+            raise RolloutError(
+                f"rollout v{version}: index fingerprint {want_fp} != "
+                f"manifest {m_fp}", reason="fingerprint_mismatch",
+                version=version, expected=m_fp, actual=want_fp)
+        self._ensure_ledger()
+        if set(target) != set(self._leaf_hashes):
+            raise RolloutError(
+                f"rollout v{version}: weight tree structure changed "
+                f"({len(target)} leaves vs engine's "
+                f"{len(self._leaf_hashes)}) — a live engine cannot change "
+                "compiled shapes; redeploy instead",
+                reason="structure_mismatch", version=version)
+        changed = [p for p in target if target[p] != self._leaf_hashes[p]]
+
+        def _one(path):
+            meta = index["leaves"][path]
+            rr = fetcher.fetch(f"{key}/{path}",
+                               expect_hash=meta.get("blake2b"))
+            if rr.status_code != 200:
+                raise RolloutError(
+                    f"rollout v{version}: missing leaf {key}/{path}",
+                    reason="missing_leaf", version=version)
+            return path, ds._decode_array(rr.content, meta, None)
+
+        staged = dict(netpool.map_concurrent(_one, changed))
+        fetcher.complete()      # become a broadcast parent for later joiners
+        for src, n in fetcher.bytes_by_source.items():
+            bucket = "origin" if src == "store" else "peer"
+            self.bytes_moved[bucket] += n
+            _ROLLOUT_BYTES.inc(n, source=bucket)
+        _ROLLOUT_SECONDS.observe(time.monotonic() - t0, phase="fetch")
+
+        with telemetry.span("rollout.swap", parent=self._span_parent(manifest),
+                            service=self.service, version=version,
+                            leaves=len(changed)) as sp:
+            with telemetry.stage("rollout_apply"):
+                # ---- stage: shape/dtype gate against the compiled step
+                t0 = time.monotonic()
+                for path, arr in staged.items():
+                    cur = _get_leaf(self.engine.params, path)
+                    if (tuple(arr.shape) != tuple(cur.shape)
+                            or str(arr.dtype) != str(cur.dtype)):
+                        raise RolloutError(
+                            f"rollout v{version}: leaf {path!r} is "
+                            f"{arr.dtype}{tuple(arr.shape)}, engine holds "
+                            f"{cur.dtype}{tuple(cur.shape)} — the compiled "
+                            "step's shapes are frozen",
+                            reason="shape_mismatch", version=version)
+                _ROLLOUT_SECONDS.observe(time.monotonic() - t0,
+                                         phase="stage")
+
+                # ---- swap: between decode batches, donated leaf-by-leaf
+                t0 = time.monotonic()
+                self._swapping = True
+                try:
+                    undo = self.engine.at_batch_boundary(
+                        lambda: self._swap_leaves(staged),
+                        timeout=self.swap_timeout_s)
+                finally:
+                    self._swapping = False
+                _ROLLOUT_SECONDS.observe(time.monotonic() - t0, phase="swap")
+
+                # ---- verify: ledger + composed fingerprint bit-equality
+                t0 = time.monotonic()
+                old_hashes = {p: self._leaf_hashes[p] for p in changed}
+                self._undo = {"version": self.version,
+                              "fingerprint": self.fingerprint,
+                              "leaves": undo, "hashes": old_hashes}
+                self._leaf_hashes.update({p: target[p] for p in changed})
+                got_fp = ds.tree_fingerprint_of_hashes(self._leaf_hashes)
+                if m_fp is not None and got_fp != m_fp:
+                    raise RolloutError(
+                        f"rollout v{version}: post-swap fingerprint "
+                        f"{got_fp} != manifest {m_fp}",
+                        reason="verify_failed", version=version,
+                        expected=m_fp, actual=got_fp)
+                self.fingerprint = got_fp
+                self.version = version
+                self.step = manifest.get("step")
+                self.phase = manifest.get("phase", "fleet")
+                self.applied_at = time.time()
+                self.swaps += 1
+                self.last_error = None
+                _ROLLOUT_VERSION.set(version)
+                _ROLLOUT_SECONDS.observe(time.monotonic() - t0,
+                                         phase="verify")
+            if sp:
+                sp.set_attr("fingerprint", got_fp)
+                sp.set_attr("bytes", sum(fetcher.bytes_by_source.values()))
+        return {"version": version, "leaves_changed": len(changed),
+                "fingerprint": got_fp,
+                "bytes": dict(fetcher.bytes_by_source)}
+
+    def _swap_leaves(self, staged: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """The donation swap (stepping thread, between batches): per leaf,
+        stash the old bytes host-side for rollback, FREE the old device
+        buffer, then land the replacement with the old sharding — peak
+        extra HBM is one leaf, never a second model."""
+        params = self.engine.params
+        undo: Dict[str, Any] = {}
+        for path, host_new in staged.items():
+            cur = _get_leaf(params, path)
+            undo[path] = np.array(_host_leaf(cur), copy=True)
+            on_device = _is_device_array(cur)
+            sharding = cur.sharding if on_device else None
+            _set_leaf(params, path, None)   # drop the tree's reference
+            if on_device:
+                try:
+                    cur.delete()            # donation: free BEFORE landing
+                except Exception:           # noqa: BLE001 — already freed
+                    pass
+            del cur
+            if on_device:
+                import jax
+                new_leaf = jax.device_put(host_new, sharding)
+            else:
+                new_leaf = host_new
+            _set_leaf(params, path, new_leaf)
+        return undo
+
+    # -- rollback ------------------------------------------------------------
+
+    def _apply_rollback(self, manifest: Dict) -> Dict:
+        with self._apply_lock:
+            version = int(manifest["version"])
+            reason = manifest.get("reason") or "manifest"
+            target_fp = manifest.get("fingerprint")
+            self._ensure_ledger()
+            if target_fp is not None and target_fp == self.fingerprint:
+                # never swapped to the bad version (non-canary replica, or
+                # a replica that already rolled back): adopt the version
+                # number, touch nothing
+                self.version = version
+                self.phase = "rollback"
+                _ROLLOUT_VERSION.set(version)
+                return {"version": version, "rolled_back": False,
+                        "fingerprint": self.fingerprint}
+            undo = self._undo
+            if undo is None or (target_fp is not None
+                                and undo["fingerprint"] != target_fp):
+                if manifest.get("key") and target_fp is not None:
+                    # no matching local stash (e.g. replica restarted):
+                    # converge by an ordinary verified apply toward the
+                    # good version the manifest names
+                    out = self._apply_locked(manifest)
+                    self.rollbacks += 1
+                    _ROLLBACKS.inc(reason=reason)
+                    return out
+                raise RolloutError(
+                    f"rollback v{version}: no pre-swap stash and no "
+                    "target weights to refetch", reason="no_undo",
+                    version=version)
+            t0 = time.monotonic()
+            self._swapping = True
+            try:
+                self.engine.at_batch_boundary(
+                    lambda: self._swap_leaves(undo["leaves"]),
+                    timeout=self.swap_timeout_s)
+            finally:
+                self._swapping = False
+            _ROLLOUT_SECONDS.observe(time.monotonic() - t0, phase="swap")
+            self._leaf_hashes.update(undo["hashes"])
+            self.fingerprint = ds.tree_fingerprint_of_hashes(
+                self._leaf_hashes)
+            self.version = version
+            self.step = manifest.get("step")
+            self.phase = "rollback"
+            self.applied_at = time.time()
+            self.rollbacks += 1
+            self._undo = None
+            _ROLLBACKS.inc(reason=reason)
+            _ROLLOUT_VERSION.set(version)
+            telemetry.add_event("rollout.rollback", reason=reason,
+                                version=version)
+            return {"version": version, "rolled_back": True,
+                    "fingerprint": self.fingerprint}
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> Dict:
+        return {
+            "service": self.service,
+            "replica": self.replica_id,
+            "version": self.version,
+            "step": self.step,
+            "phase": self.phase,
+            "fingerprint": self.fingerprint,
+            "applied_at": self.applied_at,
+            "swaps": self.swaps,
+            "rollbacks": self.rollbacks,
+            "swapping": self._swapping,
+            "bytes": dict(self.bytes_moved),
+            "last_error": self.last_error,
+            "polling": self._thread is not None and self._thread.is_alive(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# canary-first control
+# ---------------------------------------------------------------------------
+
+
+class CanaryRollout:
+    """Fleet-level canary-first driver.
+
+    Publishes the new version as a canary manifest (only the named replica
+    swaps), pins a slice of router traffic to it
+    (``Router.set_canary``), and bakes: a regression verdict — error rate
+    or latency blown out against the router's pre-swap EWMA — publishes a
+    typed rollback manifest; a clean bake promotes to ``phase="fleet"``.
+    Non-canary replicas swap only on the promotion, by construction of
+    :meth:`WeightRollout.poll_once`.
+    """
+
+    def __init__(self, service: str, router: Any, *,
+                 store_url: Optional[str] = None,
+                 slice_fraction: float = 0.1, bake_s: float = 10.0,
+                 min_requests: int = 20, ttft_factor: float = 2.0,
+                 err_threshold: float = 0.05, poll_s: float = 0.25):
+        self.service = service
+        self.router = router
+        self.store_url = store_url
+        self.slice_fraction = slice_fraction
+        self.bake_s = bake_s
+        self.min_requests = min_requests
+        self.ttft_factor = ttft_factor
+        self.err_threshold = err_threshold
+        self.poll_s = poll_s
+
+    def run(self, publish, canary_replica: str) -> str:
+        """Drive one canary-first rollout. ``publish(phase=..., canary=...)``
+        is the trainer-side publisher (typically a partial of
+        ``train.checkpoint.publish_rollout`` over the new tree) — called
+        once for the canary manifest and, on a clean bake, once more for
+        the fleet promotion. Returns ``"promoted"`` or ``"rolled_back"``.
+
+        A first-ever rollout (no previous manifest) promotes directly:
+        there is no good version to regress against or roll back to."""
+        prev = read_manifest(self.service, store_url=self.store_url)
+        if prev is None or not prev.get("fingerprint"):
+            publish(phase="fleet")
+            return "promoted"
+        canary_m = publish(phase="canary", canary=canary_replica)
+        self.router.set_canary(canary_replica,
+                               fraction=self.slice_fraction)
+        verdict = "warming"
+        deadline = time.monotonic() + self.bake_s
+        try:
+            while time.monotonic() < deadline:
+                verdict = self.router.canary_verdict(
+                    min_requests=self.min_requests,
+                    ttft_factor=self.ttft_factor,
+                    err_threshold=self.err_threshold)
+                if verdict == "regressed":
+                    break
+                time.sleep(self.poll_s)
+        finally:
+            self.router.clear_canary()
+        if verdict == "regressed":
+            publish_manifest(
+                self.service, key=prev["key"], step=prev.get("step"),
+                fingerprint=prev["fingerprint"], phase="rollback",
+                reason="canary_regression", store_url=self.store_url)
+            telemetry.add_event("rollout.canary_regressed",
+                                canary=canary_replica,
+                                version=canary_m.get("version"))
+            return "rolled_back"
+        publish(phase="fleet")
+        return "promoted"
+
+
+# ---------------------------------------------------------------------------
+# CPU-proxy engine (benches / tests)
+# ---------------------------------------------------------------------------
+
+
+class HostEngine:
+    """Host-side engine stand-in with the exact swap contract
+    ``WeightRollout`` needs — a mutable ``params`` pytree, a stepping
+    loop, and ``at_batch_boundary`` — so the rollout path (fetch, stage,
+    fingerprint gate, boundary swap, rollback) is drivable on a 1-core CI
+    box and in ``scripts/bench_rollout.py``'s subprocess replicas without
+    compiling a model. Each "decode batch" advances every in-flight
+    request one token and touches a param leaf, so a torn swap would be
+    observable as an exception or a dropped request."""
+
+    def __init__(self, params: Dict[str, Any], step_s: float = 0.001):
+        self.params = params
+        self.step_s = float(step_s)
+        self.steps = 0
+        self._reqs: List[Dict[str, Any]] = []
+        self._hooks: "deque[tuple]" = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, n_tokens: int = 4) -> Dict[str, Any]:
+        req = {"left": int(n_tokens), "done": threading.Event(),
+               "error": None}
+        with self._lock:
+            self._reqs.append(req)
+        self._work.set()
+        return req
+
+    def at_batch_boundary(self, fn, timeout: Optional[float] = None):
+        thread = self._thread
+        if (thread is None or not thread.is_alive()
+                or threading.current_thread() is thread):
+            return fn()
+        box: Dict[str, Any] = {"done": threading.Event()}
+        self._hooks.append((fn, box))
+        self._work.set()
+        if not box["done"].wait(timeout):
+            raise TimeoutError("HostEngine batch boundary not reached")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _step_once(self) -> int:
+        while self._hooks:
+            fn, box = self._hooks.popleft()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                box["done"].set()
+        with self._lock:
+            active = list(self._reqs)
+        for req in active:
+            try:
+                # touch a leaf: a half-swapped tree (missing leaf, None
+                # placeholder) would throw here and fail the request
+                leaves: Dict[str, Any] = {}
+                ds._flatten(self.params, "", leaves)
+                next(iter(leaves.values())).ravel()[0]
+                req["left"] -= 1
+            except Exception as e:      # noqa: BLE001
+                req["error"] = e
+                req["left"] = 0
+            if req["left"] <= 0:
+                with self._lock:
+                    if req in self._reqs:
+                        self._reqs.remove(req)
+                req["done"].set()
+        self.steps += 1
+        if self.step_s:
+            time.sleep(self.step_s)
+        with self._lock:
+            return len(self._reqs)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            n = self._step_once()
+            if n == 0 and not self._hooks:
+                self._work.clear()
+                self._work.wait(timeout=0.1)
+
+    def start(self) -> "HostEngine":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="kt-host-engine")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -546,6 +546,24 @@ async def app_status(request: web.Request) -> web.Response:
     return web.json_response({"running": running, "returncode": proc.returncode})
 
 
+async def rollout_status(request: web.Request) -> web.Response:
+    """Live weight-rollout state of every engine coordinator in THIS
+    process (ISSUE 11): per-replica manifest version, fingerprint, canary
+    phase, bytes moved by source — the rows ``kt rollout status``
+    aggregates across the fleet. Engines whose coordinator runs in a rank
+    worker surface through the pod's ``/metrics`` (``kt_rollout_*``)
+    instead; an empty list here just means no in-process rollout."""
+    def _collect():
+        try:
+            from ..serve.rollout import local_status
+            return local_status()
+        except Exception:       # noqa: BLE001 — serve/ absent or jax-less
+            return []
+
+    rollouts = await asyncio.to_thread(_collect)
+    return web.json_response({"rollouts": rollouts})
+
+
 async def reload_route(request: web.Request) -> web.Response:
     """HTTP reload path (controller WS push calls state.reload directly)."""
     state: ServerState = request.app["state"]
@@ -745,6 +763,7 @@ def create_app(state: Optional[ServerState] = None) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/app/status", app_status)
+    app.router.add_get("/rollout/status", rollout_status)
     app.router.add_post("/_kt/reload", reload_route)
     app.router.add_post("/_kt/profile", profile_route)
     app.router.add_post("/_kt/exec", exec_route)

@@ -33,6 +33,13 @@ lints for strays):
   admission queue lands in the ``kt_stage_seconds{stage="queue_wait"}``
   histogram — the series the controller's SLO loop scrapes to size the
   fleet (``KT_SERVE_SLO_MS``).
+- **Canary traffic pinning (ISSUE 11).** During a live weight rollout the
+  canary replica gets exactly a configured slice of keyless traffic
+  (:meth:`Router.set_canary`) while everything else avoids it; per-call
+  error/latency lands on the canary ledger and
+  :meth:`Router.canary_verdict` judges it against the PRE-SWAP service
+  EWMA — the signal ``serve.rollout.CanaryRollout`` turns into an
+  automatic promote-or-rollback decision.
 
 Health is cached with a short TTL (:class:`HealthCache`) instead of
 probed per dispatch — the per-call RTT the old supervisor paid — and
@@ -55,6 +62,12 @@ from ..constants import PRIORITY_HEADER, SESSION_HEADER
 from ..exceptions import (AdmissionShedError, DeadlineExceededError,
                           WorkerCallError)
 from ..resilience import DEADLINE_HEADER, Deadline
+
+
+_CANARY_REQS = telemetry.counter(
+    "kt_serve_canary_requests_total",
+    "Requests routed to the live-rollout canary replica, by outcome",
+    labels=("result",))
 
 
 def _env_float(name: str, default: float) -> float:
@@ -251,6 +264,85 @@ class Router:
         # consistent-hash ring cached per membership: building one is
         # O(nodes × vnodes) blake2b hashes — far too hot to pay per miss
         self._ring: Tuple[Tuple[str, ...], Any] = ((), None)
+        # live-rollout canary state (set_canary/clear_canary); None when no
+        # canary bake is in flight
+        self._canary: Optional[Dict[str, Any]] = None
+
+    # -- canary --------------------------------------------------------------
+
+    def set_canary(self, replica: str, fraction: float = 0.1) -> None:
+        """Pin a slice of keyless traffic to ``replica`` for a rollout
+        bake. The pre-swap service-time EWMA is snapshotted HERE — it is
+        the regression baseline; measuring it after the swap would let a
+        slow canary poison its own yardstick."""
+        self._canary = {
+            "replica": replica,
+            "fraction": max(0.0, min(1.0, float(fraction))),
+            "baseline_ewma_s": self._ewma_s,
+            "started_at": time.monotonic(),
+            "requests": 0,
+            "errors": 0,
+            "lat_ewma_s": None,
+            "pick": itertools.count(),
+        }
+
+    def clear_canary(self) -> None:
+        self._canary = None
+
+    def canary_state(self) -> Optional[Dict[str, Any]]:
+        c = self._canary
+        if c is None:
+            return None
+        return {k: c[k] for k in ("replica", "fraction", "baseline_ewma_s",
+                                  "requests", "errors", "lat_ewma_s")}
+
+    def canary_verdict(self, min_requests: int = 20,
+                       ttft_factor: float = 2.0,
+                       err_threshold: float = 0.05) -> str:
+        """``"none"`` (no canary), ``"warming"`` (not enough traffic yet),
+        ``"regressed"`` (error rate past ``err_threshold`` or latency EWMA
+        past ``ttft_factor`` × the pre-swap baseline), else ``"ok"``."""
+        c = self._canary
+        if c is None:
+            return "none"
+        if c["requests"] < max(1, min_requests):
+            return "warming"
+        if c["errors"] / c["requests"] >= err_threshold:
+            return "regressed"
+        base, lat = c["baseline_ewma_s"], c["lat_ewma_s"]
+        if base and lat and lat > base * ttft_factor:
+            return "regressed"
+        return "ok"
+
+    def _canary_order(self, order: List[str]) -> List[str]:
+        """Apply the canary pin to a selection order: the configured slice
+        of traffic gets the canary FIRST; everything else gets it LAST
+        (failover of last resort only) — non-canary traffic must not
+        bake on unpromoted weights."""
+        c = self._canary
+        if c is None or c["replica"] not in order:
+            return order
+        rest = [ip for ip in order if ip != c["replica"]]
+        frac = c["fraction"]
+        every = int(round(1.0 / frac)) if frac > 0 else 0
+        if every and next(c["pick"]) % every == 0:
+            return [c["replica"]] + rest
+        return rest + [c["replica"]]
+
+    def _canary_record(self, target: str, started: float,
+                       ok: bool) -> None:
+        c = self._canary
+        if c is None or target != c["replica"]:
+            return
+        c["requests"] += 1
+        if not ok:
+            c["errors"] += 1
+            _CANARY_REQS.inc(result="error")
+            return
+        dt = time.monotonic() - started
+        c["lat_ewma_s"] = (dt if c["lat_ewma_s"] is None
+                           else 0.3 * dt + 0.7 * c["lat_ewma_s"])
+        _CANARY_REQS.inc(result="ok")
 
     # -- admission ----------------------------------------------------------
 
@@ -446,6 +538,7 @@ class Router:
             started = time.monotonic()
             try:
                 order, affinity = self.select(ips, key)
+                order = self._canary_order(order)
                 m["affinity"].inc(result=affinity)
                 sp.set_attr("affinity", affinity)
                 last_err: Optional[BaseException] = None
@@ -458,6 +551,7 @@ class Router:
                     m["batch_depth"].observe(float(depth))
                     sp.set_attr("replica", target)
                     sp.set_attr("batch_depth", depth)
+                    attempt_started = time.monotonic()
                     try:
                         if target == my_ip:
                             result = await local_call(method, args, kwargs,
@@ -476,11 +570,22 @@ class Router:
                         self.sessions.evict_replica(target)
                         telemetry.add_event("router.failover",
                                             replica=target)
+                        self._canary_record(target, attempt_started,
+                                            ok=False)
                         last_err = e
                         continue
+                    except Exception:
+                        # application failure: propagates untried-elsewhere,
+                        # but it still counts against a baking canary —
+                        # injected chaos errors on the canary are exactly
+                        # the regression signal auto-rollback fires on
+                        self._canary_record(target, attempt_started,
+                                            ok=False)
+                        raise
                     finally:
                         self._inflight[target] = \
                             max(0, self._inflight.get(target, 1) - 1)
+                    self._canary_record(target, attempt_started, ok=True)
                     if key:
                         self.sessions.touch(key, target)
                     return result
@@ -511,4 +616,5 @@ class Router:
             "inflight": {ip: n for ip, n in self._inflight.items() if n},
             "affinity_hit_rate": (hits / (hits + misses)
                                   if hits + misses else 0.0),
+            "canary": self.canary_state(),
         }

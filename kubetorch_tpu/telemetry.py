@@ -641,7 +641,7 @@ def render_untyped_gauges(lines: Dict[str, Any]) -> str:
 # observability.md "Span taxonomy"). Free-form stages are allowed; these
 # are the named hot-path phases of one request.
 STAGES = ("deserialize", "queue_wait", "execute", "device_transfer",
-          "store_fetch", "retry_sleep", "shm_copy")
+          "store_fetch", "retry_sleep", "shm_copy", "rollout_apply")
 
 _STAGE_HIST: Optional[Histogram] = None
 
@@ -652,7 +652,8 @@ def stage_histogram() -> Histogram:
         _STAGE_HIST = histogram(
             "kt_stage_seconds",
             "Per-stage request latency (deserialize, queue_wait, execute, "
-            "device_transfer, store_fetch, retry_sleep, shm_copy)",
+            "device_transfer, store_fetch, retry_sleep, shm_copy, "
+            "rollout_apply)",
             labels=("stage",))
     return _STAGE_HIST
 

@@ -164,19 +164,21 @@ def tree_fingerprint(tree: Any) -> str:
     """Content fingerprint of a pytree: blake2b over the sorted per-leaf
     (path, content-hash) pairs — the value the elastic acceptance test
     compares between a resumed job's live state and a clean reload of the
-    checkpoint it claims to have resumed from."""
-    import hashlib
-
+    checkpoint it claims to have resumed from, and the bit-equality gate
+    every live weight swap (``serve/rollout.py``) verifies against the
+    trainer's rollout manifest. Composed through
+    :func:`~kubetorch_tpu.data_store.commands.tree_fingerprint_of_hashes`
+    so per-leaf hashes recorded in a pytree index can be compared without
+    re-pulling the bytes."""
     import numpy as np
 
     leaves: Dict[str, Any] = {}
     ds._flatten(tree, "", leaves)
-    h = hashlib.blake2b(digest_size=20)
-    for path in sorted(leaves):
-        host = np.ascontiguousarray(np.asarray(leaves[path]))
-        h.update(path.encode())
-        h.update(ds._leaf_hash(host).encode())
-    return h.hexdigest()
+    hashes = {}
+    for path, leaf in leaves.items():
+        host = np.ascontiguousarray(np.asarray(leaf))
+        hashes[path] = ds._leaf_hash(host)
+    return ds.tree_fingerprint_of_hashes(hashes)
 
 
 def commit_info(base_key: str, store_url: Optional[str] = None
@@ -336,6 +338,56 @@ class Checkpointer:
         self._slot = info["slot"]
         self.last_committed_step = info["step"]
         return tree, info["step"]
+
+
+# ---------------------------------------------------------------------------
+# Live weight rollout publishing (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def publish_rollout(service: str, tree: Any, step: int,
+                    store_url: Optional[str] = None, *,
+                    phase: str = "fleet", canary: Optional[str] = None,
+                    key: Optional[str] = None) -> Dict[str, Any]:
+    """Trainer side of the online-learning loop: push the serving weight
+    tree and flip the fleet's rollout manifest.
+
+    The weights ride the content-addressed delta path (``kt.put`` —
+    only leaves that changed since the last push move any bytes; the
+    fleet's fetch side fans them out over the broadcast tree), and the
+    manifest rides the ring's write-quorum ``put_json`` path, exactly like
+    the checkpoint commit marker: the manifest PUT is the commit point,
+    anything torn before it leaves the previous rollout fully intact, and
+    replicas read it back at quorum so a store-node loss never resurrects
+    a stale version. ``phase="canary"`` + ``canary=<replica-id>`` starts a
+    canary-first rollout (only that replica swaps until a later
+    ``phase="fleet"`` publish promotes it; ``serve.rollout`` owns the
+    serving side). Returns ``{**put_stats, "manifest": manifest}``.
+    """
+    host = _host_tree(tree)
+    fingerprint = tree_fingerprint(host)
+    from ..serve import rollout as _rollout
+
+    weights_key = key or _rollout.weights_key(service)
+    t0 = time.monotonic()
+    with telemetry.span("rollout.publish", service=service, step=step,
+                        phase=phase) as sp:
+        stats = ds.put(weights_key, host, store_url=store_url)
+        # manifest LAST — the commit point (see the commit-marker protocol
+        # above): a trainer SIGKILLed mid-push leaves the fleet on the old
+        # manifest, and the half-pushed leaves are simply overwritten by
+        # the next publish's delta sync
+        manifest = _rollout.publish_manifest(
+            service, key=weights_key, step=int(step),
+            fingerprint=fingerprint, phase=phase, canary=canary,
+            store_url=store_url,
+            index_blake2b=stats.get("index_blake2b"))
+        if sp:
+            sp.set_attr("bytes", stats.get("bytes"))
+            sp.set_attr("skipped", stats.get("skipped"))
+            sp.set_attr("version", manifest.get("version"))
+    _CKPT_SECONDS.observe(time.monotonic() - t0, op="rollout_publish")
+    return {**stats, "manifest": manifest, "fingerprint": fingerprint}
 
 
 def local_save(path: str, state: TrainState) -> None:

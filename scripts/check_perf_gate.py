@@ -22,6 +22,11 @@ Gated stages and how each is driven:
   decode).
 - ``store_fetch`` — pytree get against a real store-server subprocess
   (the ``_RoutedFetcher`` client path that observes the stage).
+- ``rollout_apply`` — host-staged weight-delta apply + per-leaf blake2b
+  fingerprint verify in the rank worker, with the delta array arriving
+  over the real shm envelope path (ISSUE 11, CPU-proxy sized): the
+  end-to-end cost of landing one rollout leaf, gated so roadmap items
+  can't silently eat the live-swap time.
 
 Gate rule (per stage)::
 
@@ -61,11 +66,28 @@ os.environ.setdefault("KT_SHM_THRESHOLD", "65536")
 
 BASELINE_PATH = os.path.join(REPO, "scripts", "perf_baseline.json")
 GATED_STAGES = ("deserialize", "queue_wait", "execute", "store_fetch",
-                "shm_copy")
+                "shm_copy", "rollout_apply")
 
 PAYLOAD_MODULE = textwrap.dedent("""
     def echo(x):
         return x
+""")
+
+ROLLOUT_MODULE = textwrap.dedent("""
+    import hashlib
+
+    import numpy as np
+
+    _PARAMS = {}
+
+    def rollout_apply(arr, path, want):
+        # the worker half of a live weight swap: verify the staged leaf's
+        # content address, then land it in the host param tree
+        a = np.ascontiguousarray(arr)
+        got = hashlib.blake2b(a.tobytes(), digest_size=20).hexdigest()
+        assert got == want, f"leaf hash mismatch: {got} != {want}"
+        _PARAMS[path] = a
+        return {"applied": path, "bytes": int(a.nbytes)}
 """)
 
 
@@ -111,6 +133,50 @@ async def _drive(calls: int, payload_kb: int, shm_calls: int,
         await client.close()
 
 
+async def _drive_rollout(calls: int, leaf_kb: int) -> None:
+    """Real rollout-leaf applies through the in-process pod server: each
+    call carries one delta leaf above the shm threshold (so it rides the
+    zero-copy envelope path), the worker verifies its blake2b and lands it
+    in a host param tree, and the DRIVER wraps the round trip in the
+    ``rollout_apply`` stage — the number ``serve/rollout.py`` also
+    observes around its stage+swap+verify in production."""
+    import hashlib
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubetorch_tpu import serialization as ser
+    from kubetorch_tpu import telemetry
+    from kubetorch_tpu.serving.http_server import ServerState, create_app
+
+    state = ServerState()
+    app = create_app(state)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        for _ in range(600):
+            r = await client.get("/ready")
+            if r.status == 200:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("pod server never became ready")
+        arr = np.arange(leaf_kb * 256, dtype=np.float32)   # leaf_kb KiB
+        want = hashlib.blake2b(np.ascontiguousarray(arr).tobytes(),
+                               digest_size=20).hexdigest()
+        bodies = [ser.serialize({"args": [arr, f"leaf{i}", want],
+                                 "kwargs": {}}, ser.MSGPACK)
+                  for i in range(calls)]
+        for body in bodies:
+            with telemetry.stage("rollout_apply"):
+                r = await client.post("/rollout_apply", data=body,
+                                      headers={"X-Serialization":
+                                               ser.MSGPACK})
+                assert r.status == 200, await r.text()
+    finally:
+        await client.close()
+
+
 def _drive_store(gets: int) -> None:
     """Pytree put + repeated gets against a real store-server subprocess:
     every leaf fetch observes the ``store_fetch`` stage in THIS process
@@ -145,7 +211,7 @@ def _drive_store(gets: int) -> None:
 
 
 def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
-            store_gets: int) -> dict:
+            store_gets: int, rollout_calls: int, rollout_kb: int) -> dict:
     """{stage: p50 seconds} measured from a fresh registry."""
     from kubetorch_tpu import telemetry
     from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
@@ -165,6 +231,17 @@ def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
             KT_LAUNCH_ID: "perf-gate",
         })
         asyncio.run(_drive(calls, payload_kb, shm_calls, shm_kb))
+    with tempfile.TemporaryDirectory() as root:
+        with open(os.path.join(root, "rollout_gate_payload.py"), "w") as f:
+            f.write(ROLLOUT_MODULE)
+        os.environ.update({
+            KT_PROJECT_ROOT: root,
+            KT_MODULE_NAME: "rollout_gate_payload",
+            KT_FILE_PATH: "rollout_gate_payload.py",
+            KT_CLS_OR_FN_NAME: "rollout_apply",
+            KT_LAUNCH_ID: "perf-gate-rollout",
+        })
+        asyncio.run(_drive_rollout(rollout_calls, rollout_kb))
     _drive_store(store_gets)
     text = telemetry.REGISTRY.render()
     out = {}
@@ -187,6 +264,8 @@ def main() -> int:
     p.add_argument("--shm-calls", type=int, default=40)
     p.add_argument("--shm-kb", type=int, default=512)
     p.add_argument("--store-gets", type=int, default=20)
+    p.add_argument("--rollout-calls", type=int, default=30)
+    p.add_argument("--rollout-kb", type=int, default=512)
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
@@ -196,7 +275,8 @@ def main() -> int:
     args = p.parse_args()
 
     measured = measure(args.calls, args.payload_kb, args.shm_calls,
-                       args.shm_kb, args.store_gets)
+                       args.shm_kb, args.store_gets, args.rollout_calls,
+                       args.rollout_kb)
 
     if args.update or not os.path.exists(BASELINE_PATH):
         baseline = {
@@ -206,6 +286,8 @@ def main() -> int:
             "shm_calls": args.shm_calls,
             "shm_kb": args.shm_kb,
             "store_gets": args.store_gets,
+            "rollout_calls": args.rollout_calls,
+            "rollout_kb": args.rollout_kb,
             "note": "p50 seconds per stage from scripts/check_perf_gate.py"
                     " --update; gate = p50 <= baseline*(1+tol) + floor",
         }

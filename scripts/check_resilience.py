@@ -82,10 +82,11 @@ WRAPPER_FILES = {"resilience.py", "netpool.py", "ring.py"}
 BASELINE = {
     # session probe + port-forward health check + the `kt trace` debug
     # fetch + the `kt store status` /ring + /scrub/status probes + the
-    # `kt serve status` /health + /metrics probes — all single-shot by
+    # `kt serve status` /health + /metrics probes + the `kt rollout
+    # status` /rollout/status + /metrics probes — all single-shot by
     # design (a doctor/debug command that retried would hang or hide the
     # very flakiness it exists to diagnose)
-    "cli.py": 6,
+    "cli.py": 8,
     # daemon-liveness probes in _read_running_local (must not retry: they
     # decide whether to SPAWN a controller) + _request's internals
     "client.py": 4,
@@ -207,6 +208,22 @@ SHM_BASELINE: dict = {}
 ORIGIN_RE = re.compile(r"data_store_url|KT_DATA_STORE_URL")
 ORIGIN_EXEMPT = {"ring.py"}
 ORIGIN_BASELINE: dict = {}
+
+# Raw param-tree assignment into a live engine outside the rollout
+# coordinator (ISSUE 11). serve/rollout.py is THE weight-swap site: it
+# fingerprint-gates every staged delta (bit-equality against the
+# trainer's manifest), sequences the swap onto the engine's batch
+# boundary via at_batch_boundary, donates the old buffers (no 2x HBM
+# spike), and stashes the pre-swap leaves for typed rollback. Any other
+# `<engine>.params = ...` (or subscripted assignment) silently opts out
+# of ALL of that — a mixed-version or mid-batch swap waiting to happen.
+# ``self.params = params`` in a constructor is fine (not a live engine);
+# the lookbehind exempts self-assignment. The baseline is EMPTY on
+# purpose.
+PARAM_SWAP_RE = re.compile(
+    r"(?<!self)\.params\s*=[^=]|(?<!self)\.params\s*\[[^\]]*\]\s*=[^=]")
+PARAM_SWAP_EXEMPT = {"rollout.py"}
+PARAM_SWAP_BASELINE: dict = {}
 
 REPLACE_RE = re.compile(r"\bos\.replace\(")
 REPLACE_EXEMPT = {"durability.py"}
@@ -377,6 +394,31 @@ def main() -> int:
               "exceptions update ORIGIN_BASELINE with a justification.")
         return 1
 
+    swap_failures = []
+    swap_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in PARAM_SWAP_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, PARAM_SWAP_RE)
+        if n:
+            swap_counts[rel] = n
+        allowed = PARAM_SWAP_BASELINE.get(rel, 0)
+        if n > allowed:
+            swap_failures.append(
+                f"  {rel}: {n} raw engine param-tree assignment(s), "
+                f"baseline allows {allowed}")
+    if swap_failures:
+        print("check_resilience: raw param-tree assignment bypasses the "
+              "rollout coordinator:\n" + "\n".join(swap_failures))
+        print("\nLive engine weights are swapped ONLY through "
+              "serve/rollout.py (WeightRollout): fingerprint bit-equality "
+              "vs the trainer's manifest, batch-boundary sequencing via "
+              "at_batch_boundary, buffer donation, and typed rollback. A "
+              "raw assignment skips all four. For deliberate exceptions "
+              "update PARAM_SWAP_BASELINE with a justification.")
+        return 1
+
     sched_failures = []
     sched_counts = {}
     for path in sorted((PKG / "controller").rglob("*.py")):
@@ -470,6 +512,8 @@ def main() -> int:
            if route_counts.get(f, 0) < allowed]
         + [f for f, allowed in SCHED_BASELINE.items()
            if sched_counts.get(f, 0) < allowed]
+        + [f for f, allowed in PARAM_SWAP_BASELINE.items()
+           if swap_counts.get(f, 0) < allowed]
         + [f for f, allowed in REPLACE_BASELINE.items()
            if replace_counts.get(f, 0) < allowed]
         + [f for f, allowed in CKPT_BASELINE.items()
@@ -485,8 +529,8 @@ def main() -> int:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
               "checks, replica selections, store-origin resolutions, "
               "controller placements, data-store commit renames, "
-              "checkpoint writes, shared-memory segments, and telemetry "
-              "sites accounted for")
+              "checkpoint writes, shared-memory segments, engine "
+              "param-tree assignments, and telemetry sites accounted for")
     return 0
 
 

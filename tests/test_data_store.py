@@ -589,32 +589,35 @@ def test_peer_registry_persists_and_ttl_expires(tmp_path, monkeypatch):
 
 @pytest.mark.slow
 def test_route_eager_tree_assignment(store):
-    """Routing protocol: first member roots at the store; later members are
-    assigned the least-loaded registered member EAGERLY (before it
-    completes); failed parents are evicted."""
+    """Routing protocol (ISSUE 11 tree shape): first member roots at the
+    store (depth 1); later members are assigned the SHALLOWEST member with
+    a free child slot EAGERLY (before it completes) — breadth-first fill;
+    failed parents are evicted and their children orphaned."""
     import requests
 
     key = "route/proto"
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.1:1"}, timeout=10).json()
-    assert r == {"source": "store"}
+    assert (r["source"], r["depth"]) == ("store", 1)
     # B arrives while A is still fetching: assigned A (eager rolling join)
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()
-    assert r == {"source": "peer", "url": "http://10.0.0.1:1",
-                 "blob_url": None}
-    # C arrives: least-loaded member is B (0 children vs A's 1)
+    assert (r["source"], r["url"], r["depth"]) == (
+        "peer", "http://10.0.0.1:1", 2)
+    # C arrives: depth-aware — A (depth 1, free slot) still wins over the
+    # deeper B, filling the tree breadth-first
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.3:1"}, timeout=10).json()
-    assert r == {"source": "peer", "url": "http://10.0.0.2:1",
-                 "blob_url": None}
+    assert (r["source"], r["url"], r["depth"]) == (
+        "peer", "http://10.0.0.1:1", 2)
     # a member is never its own parent
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.2:1"}, timeout=10).json()
     assert r["url"] != "http://10.0.0.2:1"
     # B reported unreachable → evicted; D re-routes elsewhere
-    requests.post(f"{store}/route/failed", json={
-        "key": key, "url": "http://10.0.0.2:1"}, timeout=10)
+    out = requests.post(f"{store}/route/failed", json={
+        "key": key, "url": "http://10.0.0.2:1"}, timeout=10).json()
+    assert out["evicted"] is True
     r = requests.post(f"{store}/route", json={
         "key": key, "self_url": "http://10.0.0.4:1"}, timeout=10).json()
     assert r.get("url") != "http://10.0.0.2:1"

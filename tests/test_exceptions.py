@@ -32,6 +32,7 @@ _ATTR_SAMPLES = {
     "exitcode": -9,
     "path": "/data/blobs/ab/abcdef",
     "key": "ckpt/step100/layers/wq",
+    "version": 7,
     "expected": "aa" * 20,
     "actual": "bb" * 20,
     "source": "peer",

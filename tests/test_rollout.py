@@ -1,0 +1,730 @@
+"""Live weight rollout suite (ISSUE 11): broadcast-tree routing protocol,
+delta fetch + fingerprint-gated hot swap + rollback, canary pinning with
+auto-rollback, the kill-peer chaos verb, and the mid-broadcast SIGKILL
+acceptance drill. ``make test-rollout``."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from kubetorch_tpu import telemetry
+from kubetorch_tpu.chaos import ChaosEngine, ChaosError, parse_spec
+from kubetorch_tpu.data_store import commands as ds
+from kubetorch_tpu.data_store import ring as ring_mod
+from kubetorch_tpu.exceptions import RolloutError
+from kubetorch_tpu.serve import rollout as ro
+from kubetorch_tpu.train import checkpoint as ck
+from tests.assets.threaded_server import ThreadedAiohttpServer
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_STORE_FSYNC", "0")
+    monkeypatch.setenv("KT_SCRUB_INTERVAL_S", "0")
+    from kubetorch_tpu.data_store.store_server import create_store_app
+    ring_mod.reset_rings()
+    with ThreadedAiohttpServer(
+            lambda: create_store_app(str(tmp_path / "store"))) as srv:
+        yield srv.url
+    ring_mod.reset_rings()
+
+
+def _route(url, key, self_url):
+    return requests.post(f"{url}/route", json={
+        "key": key, "self_url": self_url}, timeout=10).json()
+
+
+def _fail(url, key, victim):
+    return requests.post(f"{url}/route/failed", json={
+        "key": key, "url": victim}, timeout=10).json()
+
+
+def _tree():
+    return {"layers": {"w1": np.arange(64, dtype=np.float32).reshape(8, 8),
+                       "w2": np.ones((4, 4), np.float32)},
+            "norm": np.full((8,), 2.0, np.float32)}
+
+
+def _zeros_like_tree():
+    return {"layers": {"w1": np.zeros((8, 8), np.float32),
+                       "w2": np.zeros((4, 4), np.float32)},
+            "norm": np.zeros((8,), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# broadcast-tree routing protocol
+# ---------------------------------------------------------------------------
+
+
+def test_route_depth_aware_breadth_first(store, monkeypatch):
+    """With fanout 2: the tree fills breadth-first (shallowest free parent
+    wins) and no member ever exceeds its out-degree."""
+    monkeypatch.setenv("KT_ROUTE_FANOUT", "2")
+    key = "bt/k"
+    r = _route(store, key, "http://a")
+    assert (r["source"], r["depth"]) == ("store", 1)
+    assert (_route(store, key, "http://b")["url"],
+            _route(store, key, "http://c")["url"]) == ("http://a",) * 2
+    # A is full (fanout 2): D lands at depth 3 under B or C
+    r = _route(store, key, "http://d")
+    assert r["url"] in ("http://b", "http://c") and r["depth"] == 3
+    # E prefers the other depth-2 member (fewest children tie-break)
+    r2 = _route(store, key, "http://e")
+    assert r2["url"] in ("http://b", "http://c") and r2["url"] != r["url"]
+
+
+def test_route_failed_frees_slot_and_orphans_children(store, monkeypatch):
+    monkeypatch.setenv("KT_ROUTE_FANOUT", "2")
+    key = "bt/fail"
+    _route(store, key, "http://a")                  # root
+    assert _route(store, key, "http://b")["url"] == "http://a"
+    assert _route(store, key, "http://c")["url"] == "http://a"
+    assert _route(store, key, "http://d")["url"] in ("http://b", "http://c")
+    parent_of_d = "http://b"
+    out = _fail(store, key, parent_of_d)
+    assert out["evicted"] is True
+    # D was orphaned iff its parent was B; either way the eviction frees
+    # A's child slot, so the next joiner lands back at depth 2
+    r = _route(store, key, "http://e")
+    assert r["url"] != parent_of_d
+    assert r["depth"] == 2
+
+
+def test_route_reroute_replaces_edge_not_double_books(store, monkeypatch):
+    monkeypatch.setenv("KT_ROUTE_FANOUT", "2")
+    key = "bt/rebook"
+    _route(store, key, "http://a")
+    for _ in range(3):                  # B re-asks: edge replaced, not added
+        assert _route(store, key, "http://b")["url"] == "http://a"
+    # A must still have exactly one slot free (B counts once)
+    assert _route(store, key, "http://c")["url"] == "http://a"
+    assert _route(store, key, "http://d")["depth"] == 3
+
+
+def test_route_never_assigns_own_descendant(store, monkeypatch):
+    """A re-routing member must not be handed its own child (cycle)."""
+    monkeypatch.setenv("KT_ROUTE_FANOUT", "1")
+    key = "bt/cycle"
+    _route(store, key, "http://a")                      # root, depth 1
+    assert _route(store, key, "http://b")["url"] == "http://a"
+    assert _route(store, key, "http://c")["url"] == "http://b"
+    _fail(store, key, "http://a")                       # B orphaned
+    r = _route(store, key, "http://b")
+    # the only registered member with a free slot is C — B's descendant:
+    # must be refused, B roots at the store instead
+    assert r["source"] == "store"
+
+
+def test_fetcher_reparents_after_dead_peer(store, monkeypatch):
+    """A dead parent triggers /route/failed AND a fresh /route resolution
+    (client-side re-parenting) before the origin covers the fetch."""
+    monkeypatch.setenv("KT_ROUTE_RETRIES", "2")
+    key = "bt/reparent"
+    ds.put(key, np.arange(16, dtype=np.int32), store_url=store)
+    # a dead peer is registered as the sole broadcast parent
+    _route(store, key, "http://127.0.0.1:9")
+    fetcher = ds._RoutedFetcher(store, key, peer=True)
+    r = fetcher.fetch(f"{key}{ds._INDEX_SUFFIX}")
+    assert r.status_code == 200
+    assert fetcher._reroutes == 1          # evict → re-route → store root
+    assert fetcher.bytes_by_source.get("store", 0) > 0
+    # the dead parent was evicted server-side
+    group = requests.post(f"{store}/route", json={
+        "key": key, "self_url": None}, timeout=10).json()
+    assert group.get("url") != "http://127.0.0.1:9"
+
+
+def test_content_alias_skips_stale_cache(store, tmp_path, monkeypatch):
+    """content_alias=True keys the peer cache by subkey@hash: a stale
+    bare-key (or old-hash) entry is a clean miss, and the fresh bytes are
+    re-cached under the aliased key for later joiners."""
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    monkeypatch.setenv("KT_SERVER_PORT", "1")
+    monkeypatch.setenv("KT_DATA_CACHE_DIR", str(tmp_path / "cache"))
+    from kubetorch_tpu.data_store import peer_cache
+
+    key = "bt/alias"
+    new = np.arange(8, dtype=np.int32)
+    ds.put(key, new, store_url=store)      # pytree: leaf at {key}/value
+    subkey = f"{key}/value"
+    want = ds._leaf_hash(new)
+    # poison the bare-key cache with stale bytes (the pre-alias hazard)
+    stale = np.zeros(8, np.int32)
+    peer_cache.cache_put(subkey, stale.tobytes(),
+                         {"dtype": "int32", "shape": [8], "kind": "array"})
+    fetcher = ds._RoutedFetcher(store, key, peer=True, content_alias=True)
+    r = fetcher.fetch(subkey, expect_hash=want)
+    assert r.status_code == 200
+    got = np.frombuffer(r.content, dtype=np.int32)
+    np.testing.assert_array_equal(got, new)
+    assert peer_cache.cache_get(f"{subkey}@{want[:12]}") is not None
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + manifests
+# ---------------------------------------------------------------------------
+
+
+def test_tree_fingerprint_composes_from_leaf_hashes():
+    tree = _tree()
+    leaves = {}
+    ds._flatten(tree, "", leaves)
+    hashes = {p: ds._leaf_hash(np.ascontiguousarray(np.asarray(a)))
+              for p, a in leaves.items()}
+    assert ck.tree_fingerprint(tree) == ds.tree_fingerprint_of_hashes(hashes)
+
+
+def test_publish_rollout_manifest_quorum_roundtrip(store):
+    out = ck.publish_rollout("svc", _tree(), step=7, store_url=store)
+    assert out["leaves"] == 3 and out["manifest"]["version"] == 1
+    assert out["manifest"]["index_blake2b"]
+    m = ro.read_manifest("svc", store_url=store)
+    assert m["version"] == 1 and m["phase"] == "fleet"
+    assert m["fingerprint"] == out["fingerprint"]
+    # versions auto-increment; identical re-push moves no leaf bytes
+    out2 = ck.publish_rollout("svc", _tree(), step=8, store_url=store)
+    assert out2["manifest"]["version"] == 2
+    assert out2["skipped"] == 3 and out2["bytes"] == 0
+
+
+def test_publish_manifest_rejects_unknown_phase(store):
+    with pytest.raises(ValueError):
+        ro.publish_manifest("svc", key="k", phase="yolo", store_url=store)
+
+
+# ---------------------------------------------------------------------------
+# WeightRollout: apply / delta / gate / rollback / canary scoping
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def engine():
+    eng = ro.HostEngine(_zeros_like_tree(), step_s=0.0005).start()
+    yield eng
+    eng.stop()
+
+
+def test_apply_and_delta_swap(store, engine):
+    tree1 = _tree()
+    out1 = ck.publish_rollout("svc", tree1, step=1, store_url=store)
+    wr = ro.WeightRollout(engine, "svc", store_url=store, replica_id="r1",
+                          peer=False)
+    req = engine.submit(50)            # decode stream across the swap
+    res = wr.poll_once()
+    assert res["version"] == 1 and res["leaves_changed"] == 3
+    assert wr.fingerprint == out1["fingerprint"]
+    np.testing.assert_array_equal(engine.params["layers"]["w1"],
+                                  tree1["layers"]["w1"])
+    assert req["done"].wait(10) and req["error"] is None
+    # delta push: only the changed leaf moves/swaps
+    tree2 = _tree()
+    tree2["layers"]["w2"] = np.full((4, 4), 5.0, np.float32)
+    ck.publish_rollout("svc", tree2, step=2, store_url=store)
+    res2 = wr.poll_once()
+    assert res2["leaves_changed"] == 1
+    np.testing.assert_array_equal(engine.params["layers"]["w2"],
+                                  tree2["layers"]["w2"])
+    assert wr.poll_once() is None      # already converged
+
+
+def test_fingerprint_gate_refuses_before_touching_engine(store, engine):
+    ds.put(ro.weights_key("svc"), _tree(), store_url=store)
+    ro.publish_manifest("svc", key=ro.weights_key("svc"),
+                        fingerprint="deadbeef" * 5, store_url=store)
+    wr = ro.WeightRollout(engine, "svc", store_url=store, peer=False)
+    with pytest.raises(RolloutError) as ei:
+        wr.poll_once()
+    assert ei.value.reason == "fingerprint_mismatch"
+    assert wr.version == 0 and wr.swaps == 0
+    np.testing.assert_array_equal(engine.params["layers"]["w1"],
+                                  np.zeros((8, 8), np.float32))
+    assert wr.status()["last_error"]
+
+
+def test_structure_change_is_typed_refusal(store, engine):
+    bad = {"layers": {"w1": np.ones((8, 8), np.float32)}}   # missing leaves
+    ck.publish_rollout("svc", bad, step=1, store_url=store)
+    wr = ro.WeightRollout(engine, "svc", store_url=store, peer=False)
+    with pytest.raises(RolloutError) as ei:
+        wr.poll_once()
+    assert ei.value.reason == "structure_mismatch"
+    assert wr.swaps == 0
+
+
+def test_shape_change_is_typed_refusal(store, engine):
+    bad = _zeros_like_tree()
+    bad["layers"]["w2"] = np.ones((2, 2), np.float32)       # wrong shape
+    ck.publish_rollout("svc", bad, step=1, store_url=store)
+    wr = ro.WeightRollout(engine, "svc", store_url=store, peer=False)
+    with pytest.raises(RolloutError) as ei:
+        wr.poll_once()
+    assert ei.value.reason == "shape_mismatch"
+    assert wr.swaps == 0
+
+
+def test_canary_scoping_and_rollback(store):
+    """Canary manifests swap ONLY the named replica; the rollback manifest
+    rolls the canary back from its pre-swap stash and bumps everyone
+    else's version without touching their weights."""
+    eng1 = ro.HostEngine(_zeros_like_tree(), step_s=0.0).start()
+    eng2 = ro.HostEngine(_zeros_like_tree(), step_s=0.0).start()
+    try:
+        wr1 = ro.WeightRollout(eng1, "svc", store_url=store,
+                               replica_id="r1", peer=False)
+        wr2 = ro.WeightRollout(eng2, "svc", store_url=store,
+                               replica_id="r2", peer=False)
+        tree1 = _tree()
+        out1 = ck.publish_rollout("svc", tree1, step=1, store_url=store)
+        assert wr1.poll_once()["version"] == 1
+        assert wr2.poll_once()["version"] == 1
+        # v2 canary-first: only r1 swaps
+        tree2 = _tree()
+        tree2["norm"] = np.full((8,), 9.0, np.float32)
+        out2 = ck.publish_rollout("svc", tree2, step=2, store_url=store,
+                                  phase="canary", canary="r1")
+        assert wr1.poll_once()["version"] == 2
+        assert wr2.poll_once() is None          # non-canary never swaps
+        assert wr2.fingerprint == out1["fingerprint"]
+        before = telemetry.REGISTRY.counter(
+            "kt_rollout_rollbacks_total",
+            labels=("reason",)).value(reason="canary_regression")
+        # canary regressed: typed rollback toward the v1 fingerprint
+        ro.publish_manifest("svc", key=out2["manifest"]["key"], step=1,
+                            fingerprint=out1["fingerprint"],
+                            phase="rollback", reason="canary_regression",
+                            store_url=store)
+        res = wr1.poll_once()
+        assert res["rolled_back"] is True
+        np.testing.assert_array_equal(eng1.params["norm"], tree1["norm"])
+        assert wr1.fingerprint == out1["fingerprint"]
+        res2 = wr2.poll_once()
+        assert res2["rolled_back"] is False and wr2.swaps == 1
+        assert wr1.version == wr2.version == 3
+        after = telemetry.REGISTRY.counter(
+            "kt_rollout_rollbacks_total",
+            labels=("reason",)).value(reason="canary_regression")
+        assert after == before + 1
+        assert any(s["replica"] == "r1" for s in ro.local_status())
+    finally:
+        eng1.stop()
+        eng2.stop()
+
+
+def test_trainer_killed_before_manifest_leaves_fleet_on_old_version(
+        store, engine):
+    """The manifest PUT is the commit point: weights pushed without a
+    manifest (trainer SIGKILLed mid-publish) change NOTHING fleet-side."""
+    out1 = ck.publish_rollout("svc", _tree(), step=1, store_url=store)
+    wr = ro.WeightRollout(engine, "svc", store_url=store, peer=False)
+    wr.poll_once()
+    # "trainer dies" after the weight push, before publish_manifest
+    torn = _tree()
+    torn["layers"]["w1"] = np.full((8, 8), 123.0, np.float32)
+    ds.put(ro.weights_key("svc"), torn, store_url=store)
+    assert wr.poll_once() is None
+    assert wr.version == 1 and wr.fingerprint == out1["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine batch-boundary hook (the real engine's swap site)
+# ---------------------------------------------------------------------------
+
+
+def test_generation_engine_at_batch_boundary_runs_on_step_thread():
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve.engine import GenerationEngine
+    import jax
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = GenerationEngine(params, cfg, slots=2, max_len=64)
+    # no loop thread: runs inline on the caller
+    assert eng.at_batch_boundary(lambda: threading.get_ident()) \
+        == threading.get_ident()
+    eng.start()
+    try:
+        h = eng.submit([1, 2, 3], max_new_tokens=8)
+        seen = {}
+
+        def hook():
+            seen["thread"] = threading.current_thread().name
+            return 42
+
+        assert eng.at_batch_boundary(hook, timeout=60) == 42
+        assert seen["thread"] == "kt-gen-engine"
+        assert len(h.result(timeout=60)) == 8
+        # an erroring hook propagates to the CALLER, loop survives
+        with pytest.raises(RuntimeError, match="boom"):
+            eng.at_batch_boundary(
+                lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                timeout=60)
+        h2 = eng.submit([4, 5], max_new_tokens=4)
+        assert len(h2.result(timeout=60)) == 4
+    finally:
+        eng.stop()
+
+
+def test_weight_rollout_swaps_live_generation_engine(store):
+    """The production path end to end: a REAL GenerationEngine decoding on
+    its loop thread hot-swaps a trainer-published delta between batches —
+    streams keep decoding, the fingerprint matches the trainer's, and the
+    swapped leaf is live on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve.engine import GenerationEngine
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    eng = GenerationEngine(params, cfg, slots=2, max_len=64)
+    eng.start()
+    try:
+        h = eng.submit([1, 2, 3], max_new_tokens=16)
+        # trainer: same tree with one leaf perturbed, pushed + published
+        host = jax.tree_util.tree_map(
+            lambda x: np.array(np.asarray(x), copy=True), params)
+        host["final_norm"] = host["final_norm"] * 1.5
+        out = ck.publish_rollout("llm", host, step=1, store_url=store)
+        wr = ro.WeightRollout(eng, "llm", store_url=store, peer=False)
+        res = wr.poll_once()
+        assert res["version"] == 1 and res["leaves_changed"] == 1
+        assert wr.fingerprint == out["fingerprint"]
+        np.testing.assert_allclose(np.asarray(eng.params["final_norm"]),
+                                   host["final_norm"], rtol=1e-6)
+        # the in-flight stream survived the swap
+        assert len(h.result(timeout=120)) == 16
+        h2 = eng.submit([4, 5], max_new_tokens=4)
+        assert len(h2.result(timeout=120)) == 4
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill-peer parse + scoping
+# ---------------------------------------------------------------------------
+
+
+def test_kill_peer_parse():
+    import signal
+
+    f = parse_spec("kill-peer@2")[0]
+    assert (f.kind, f.op_index, f.signal_no) == ("kill-peer", 2, 9)
+    f = parse_spec("kill-peer:TERM@1")[0]
+    assert (f.kind, f.op_index, f.signal_no) == (
+        "kill-peer", 1, int(signal.SIGTERM))
+    with pytest.raises(ChaosError):
+        parse_spec("kill-peer@notanumber")
+
+
+def test_kill_peer_counts_only_broadcast_transfers():
+    """Method-aware scoping: only client-origin GET/HEAD on the transfer
+    surface advance the kill-peer op counter — PUTs, control POSTs,
+    probe routes, and internal traffic never do."""
+    eng = ChaosEngine(parse_spec("kill-peer@1"))
+    assert eng.next_fault("/kv/diff", "POST") is None       # control POST
+    assert eng.next_fault("/kv/a", "PUT") is None           # write
+    assert eng.next_fault("/health", "GET") is None         # probe
+    assert eng.next_fault("/route", "POST") is None         # coordinator
+    assert eng.peer_ops == 0
+    assert eng.next_fault("/_kt/data/x", "GET") is None     # transfer #0
+    assert eng.peer_ops == 1
+    internal = eng.next_fault("/kv/b", "GET", internal=True)
+    assert internal is None and eng.peer_ops == 1           # internal exempt
+    fault = eng.next_fault("/blob/abc", "GET")              # transfer #1
+    assert fault is not None and fault.kind == "kill-peer"
+
+
+def test_kill_peer_and_kill_store_node_schedules_are_independent():
+    eng = ChaosEngine(parse_spec("kill-peer@0,kill-store-node@1"))
+    # a PUT is data-op #0 (node schedule) but NOT a peer transfer
+    assert eng.next_fault("/kv/a", "PUT") is None
+    # the first GET transfer fires kill-peer (peer op #0) even though it
+    # would also have been data-op #1 for the node schedule
+    fault = eng.next_fault("/kv/a", "GET")
+    assert fault is not None and fault.kind == "kill-peer"
+
+
+# ---------------------------------------------------------------------------
+# router canary pinning + verdict
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestRouterCanary:
+    IPS = ["10.1.0.1", "10.1.0.2", "10.1.0.3"]
+
+    def _dispatch(self, router, pool, n=1):
+        import asyncio
+
+        async def go():
+            out = []
+            for _ in range(n):
+                out.append(await router.dispatch(
+                    pool=pool, ips=self.IPS, my_ip="9.9.9.9", method=None,
+                    args=[], kwargs={}, headers=None, timeout=None,
+                    local_call=None))
+            return out
+        return asyncio.run(go())
+
+    def _pool(self):
+        from tests.test_serve_router import FakePool
+        return FakePool()
+
+    def test_full_slice_pins_canary_first(self):
+        from kubetorch_tpu.serving.router import Router
+        router = Router(fn_name="f")
+        pool = self._pool()
+        router.set_canary("10.1.0.2", fraction=1.0)
+        self._dispatch(router, pool, n=6)
+        assert set(pool.calls) == {"10.1.0.2"}
+        st = router.canary_state()
+        assert st["requests"] == 6 and st["errors"] == 0
+        assert router.canary_verdict(min_requests=6) == "ok"
+
+    def test_fractional_slice_and_avoidance(self):
+        from kubetorch_tpu.serving.router import Router
+        router = Router(fn_name="f")
+        pool = self._pool()
+        router.set_canary("10.1.0.2", fraction=0.25)
+        self._dispatch(router, pool, n=8)
+        canary_hits = sum(1 for ip in pool.calls if ip == "10.1.0.2")
+        assert canary_hits == 2            # exactly the slice
+        router.clear_canary()
+        assert router.canary_state() is None
+
+    def test_error_rate_regression(self):
+        from kubetorch_tpu.serving.router import Router
+        router = Router(fn_name="f")
+        pool = self._pool()
+        pool.app_error.add("10.1.0.2")
+        router.set_canary("10.1.0.2", fraction=1.0)
+        for _ in range(5):
+            with pytest.raises(ValueError):
+                self._dispatch(router, pool, n=1)
+        assert router.canary_verdict(min_requests=5,
+                                     err_threshold=0.05) == "regressed"
+
+    def test_latency_regression_vs_preswap_ewma(self):
+        import asyncio
+
+        from kubetorch_tpu.serving.router import Router
+        from tests.test_serve_router import FakePool
+
+        class SlowPool(FakePool):
+            async def call_worker(self, ip, *a, **kw):
+                if ip == "10.1.0.2":
+                    await asyncio.sleep(0.05)
+                return await super().call_worker(ip, *a, **kw)
+
+        router = Router(fn_name="f")
+        router._ewma_s = 0.001             # the pre-swap baseline
+        pool = SlowPool()
+        router.set_canary("10.1.0.2", fraction=1.0)
+        self._dispatch(router, pool, n=4)
+        assert router.canary_verdict(min_requests=3,
+                                     ttft_factor=2.0) == "regressed"
+        assert router.state_dict()["canary"]["lat_ewma_s"] > 0.01
+
+    def test_warming_until_min_requests(self):
+        from kubetorch_tpu.serving.router import Router
+        router = Router(fn_name="f")
+        router.set_canary("10.1.0.2", fraction=1.0)
+        assert router.canary_verdict(min_requests=5) == "warming"
+        assert router.canary_verdict() != "regressed"
+
+
+def test_canary_rollout_controller_promote_and_rollback(store):
+    """CanaryRollout drives publish→bake→promote (clean) or
+    publish→bake→typed rollback manifest (regressed verdict)."""
+
+    class ScriptedRouter:
+        def __init__(self, verdict):
+            self.verdict = verdict
+            self.pinned = None
+
+        def set_canary(self, replica, fraction=0.1):
+            self.pinned = (replica, fraction)
+
+        def clear_canary(self):
+            self.pinned = None
+
+        def canary_verdict(self, **kw):
+            return self.verdict
+
+    calls = []
+
+    def publish(phase, canary=None):
+        calls.append(phase)
+        return ck.publish_rollout("svc", _tree(), step=len(calls),
+                                  store_url=store, phase=phase,
+                                  canary=canary)["manifest"]
+
+    # first-ever rollout: no baseline to regress from → straight to fleet
+    ctl = ro.CanaryRollout("svc", ScriptedRouter("ok"), store_url=store,
+                           bake_s=0.3, min_requests=1)
+    assert ctl.run(publish, "r1") == "promoted"
+    assert calls == ["fleet"]
+    # clean bake: canary then fleet
+    assert ctl.run(publish, "r1") == "promoted"
+    assert calls == ["fleet", "canary", "fleet"]
+    assert ro.read_manifest("svc", store_url=store)["phase"] == "fleet"
+    # regression: canary then a typed rollback manifest to the PREVIOUS
+    # fingerprint, never a fleet promote
+    prev = ro.read_manifest("svc", store_url=store)
+    ctl_bad = ro.CanaryRollout("svc", ScriptedRouter("regressed"),
+                               store_url=store, bake_s=2.0, min_requests=1)
+    assert ctl_bad.run(publish, "r1") == "rolled_back"
+    assert calls == ["fleet", "canary", "fleet", "canary"]
+    m = ro.read_manifest("svc", store_url=store)
+    assert m["phase"] == "rollback"
+    assert m["reason"] == "canary_regression"
+    assert m["fingerprint"] == prev["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_rollout_status_json(store):
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import cli
+
+    ck.publish_rollout("svc", _tree(), step=3, store_url=store)
+    r = CliRunner().invoke(cli, ["rollout", "status", "--service", "svc",
+                                 "--store-url", store, "--json"])
+    assert r.exit_code == 0, r.output
+    payload = json.loads(r.output)
+    assert payload["manifest"]["version"] == 1
+    assert payload["manifest"]["phase"] == "fleet"
+    # human rendering too
+    r = CliRunner().invoke(cli, ["rollout", "status", "--service", "svc",
+                                 "--store-url", store])
+    assert r.exit_code == 0, r.output
+    assert "manifest: v1" in r.output
+
+
+def test_cli_rollout_status_no_manifest(store):
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import cli
+
+    r = CliRunner().invoke(cli, ["rollout", "status", "--service", "ghost",
+                                 "--store-url", store])
+    assert r.exit_code == 0, r.output
+    assert "no rollout manifest" in r.output
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: SIGKILL an interior peer + the trainer mid-broadcast
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_interior_peer_and_trainer_mid_broadcast(tmp_path):
+    """The ISSUE-11 acceptance drill on a real subprocess fleet.
+
+    Deterministic setup: the VICTIM replica converges to v1 alone first,
+    so when the two survivors join they are both routed to it (the sole
+    completed broadcast parent). It is armed with ``kill-peer@0`` — it
+    SIGKILLs itself serving its FIRST transfer, i.e. mid-broadcast as an
+    interior tree parent. The survivors must report ``/route/failed``,
+    re-parent, and converge to the one v1 fingerprint with zero failed
+    ``/generate`` calls. Then the trainer 'dies' after pushing v2 bytes
+    but before the manifest commit — the fleet must stay on v1, never
+    mixed-version — and a real v2 publish converges everyone."""
+    import importlib.util
+
+    from kubetorch_tpu.utils.procs import kill_process_tree
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_rollout", os.path.join(os.path.dirname(__file__), "..",
+                                      "scripts", "bench_rollout.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    class Args:
+        leaves, leaf_kb, step_ms = 6, 8, 0.5
+
+    rng = np.random.default_rng(1)
+    elems = Args.leaf_kb * 256
+    service = "chaos-accept"
+    procs = []
+    ring_mod.reset_rings()
+    try:
+        store_proc, store_url = bench._spawn_store(str(tmp_path / "store"))
+        procs.append(store_proc)
+        # the victim first, armed: kill-peer@0 = die on the 1st served
+        # broadcast transfer (its own outbound fetch doesn't count — the
+        # schedule is method/path-scoped to incoming GET transfers)
+        os.environ["KT_CHAOS"] = "kill-peer@0"
+        victim_proc, victim_url = bench._spawn_replica(
+            0, str(tmp_path), store_url, service, True, Args)
+        os.environ.pop("KT_CHAOS", None)
+        procs.append(victim_proc)
+        bench._wait_all_healthy([victim_url])
+
+        tree = {"layers": {f"l{i}": rng.standard_normal(elems).astype(
+            np.float32) for i in range(Args.leaves)}}
+        out1 = ck.publish_rollout(service, tree, step=1,
+                                  store_url=store_url)
+        # victim converges alone → registers as the completed parent
+        bench._wait_converged([victim_url], 1, out1["fingerprint"],
+                              timeout=60)
+
+        survivors = []
+        for i in (1, 2):
+            p, u = bench._spawn_replica(i, str(tmp_path), store_url,
+                                        service, True, Args)
+            procs.append(p)
+            survivors.append(u)
+        bench._wait_all_healthy(survivors)
+        load = bench._OpenLoopLoad(survivors, qps=20).start()
+        try:
+            # the survivors' first fetch routes to the victim, whose first
+            # served transfer kills it — the tree must re-parent
+            bench._wait_converged(survivors, 1, out1["fingerprint"],
+                                  timeout=90)
+        finally:
+            load.stop()
+        assert load.dropped == 0, f"{load.dropped}/{load.sent} dropped"
+        # the kill provably fired: the interior parent is DEAD
+        assert victim_proc.poll() is not None, \
+            "victim replica survived — the drill was vacuous"
+        # re-parenting visible in the byte accounting: the survivors
+        # covered the delta from the origin after losing their parent
+        st = bench._fleet_status(survivors)
+        assert sum(r.get("bytes", {}).get("origin", 0)
+                   for r in st.values()) > 0
+
+        # trainer SIGKILLed mid-publish: v2 bytes land, the manifest (the
+        # commit point) never does — fleet must stay converged on v1
+        torn = {"layers": dict(tree["layers"])}
+        torn["layers"]["l0"] = rng.standard_normal(elems).astype(np.float32)
+        ds.put(ro.weights_key(service), torn, store_url=store_url)
+        time.sleep(1.0)
+        st = bench._fleet_status(survivors)
+        assert all(r.get("version") == 1
+                   and r.get("fingerprint") == out1["fingerprint"]
+                   for r in st.values()), st
+
+        # a real publish of the same delta now converges everyone to ONE
+        # fingerprint — never silently mixed
+        out2 = ck.publish_rollout(service, torn, step=2,
+                                  store_url=store_url)
+        bench._wait_converged(survivors, 2, out2["fingerprint"], timeout=90)
+    finally:
+        os.environ.pop("KT_CHAOS", None)
+        for p in procs:
+            kill_process_tree(p.pid)
+        ring_mod.reset_rings()

@@ -7,12 +7,13 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath bench-rollout smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
-# perf-gate rides along (ISSUE 10): the full five-stage dispatch budget
-# (deserialize/queue_wait/execute/store_fetch/shm_copy) is enforced on
-# every release-gate run, not just when someone remembers to ask.
+# perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
+# (deserialize/queue_wait/execute/store_fetch/shm_copy/rollout_apply/
+# train_step/snapshot_stall) is enforced on every release-gate run, not
+# just when someone remembers to ask.
 test:
 	$(PY_CPU) python -m pytest tests/ -q
 	$(PY_CPU) python scripts/check_perf_gate.py
@@ -60,9 +61,9 @@ test-serve:
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
 
-# per-stage perf regression gate (ISSUE 9, expanded in ISSUE 10 to the
-# full stage set): deserialize/queue_wait/execute/store_fetch/shm_copy
-# p50 through the real pod-server + store + shm-envelope paths vs the
+# per-stage perf regression gate (ISSUE 9, expanded in 10–12): dispatch,
+# store, shm, rollout, train_step, and snapshot_stall p50 through the
+# real pod-server + store + shm-envelope + jitted-step paths vs the
 # committed baseline (scripts/perf_baseline.json); >10%+floor fails
 perf-gate:
 	$(PY_CPU) python scripts/check_perf_gate.py
@@ -119,6 +120,13 @@ bench-hotpath:
 # dropped requests across the swap
 bench-rollout:
 	$(PY_CPU) python scripts/bench_rollout.py
+
+# step-anatomy A/B (ISSUE 12): overlapped grad reduction vs plain accum
+# on the forced 8-device host mesh (bit-comparability, accumulator shard
+# fraction, compiled temp bytes) + the blocking-vs-async snapshot stall
+# for a >=64MB state (>=10x required) — bench-convention JSON
+bench-step:
+	python bench.py --step-overlap
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -979,6 +979,73 @@ def queue_status(as_json):
 
 
 @cli.group()
+def hbm():
+    """Training-step HBM tooling (ISSUE 12)."""
+
+
+@hbm.command("audit")
+@click.option("--model", default="tiny",
+              type=click.Choice(["tiny", "1b", "8b"]),
+              help="Llama preset to audit")
+@click.option("--batch", type=int, default=8)
+@click.option("--seq", type=int, default=128)
+@click.option("--accum", "accum_steps", type=int, default=1,
+              help="gradient-accumulation microbatches")
+@click.option("--remat-policy", default=None,
+              type=click.Choice(["none", "dots", "nothing_saveable"]),
+              help="named jax.checkpoint policy for the layer stack")
+@click.option("--overlap/--no-overlap", "overlap_grads", default=False,
+              help="overlapped per-microbatch grad reduction (needs --mesh)")
+@click.option("--mesh", "mesh_spec", default=None,
+              help='mesh axes, e.g. "fsdp=8" or "data=2,fsdp=2,tensor=2"')
+@click.option("--no-donate", is_flag=True,
+              help="audit the donation-off worst case")
+@click.option("--host-devices", type=int, default=None,
+              help="force N virtual CPU devices (sets XLA_FLAGS; lets a "
+                   "1-core box audit an 8-way mesh)")
+@click.option("--json", "as_json", is_flag=True)
+def hbm_audit(model, batch, seq, accum_steps, remat_policy, overlap_grads,
+              mesh_spec, no_donate, host_devices, as_json):
+    """Report live-buffer HBM per train step (params/opt/activations from
+    the compiled program's memory analysis) and flag undonated buffers —
+    the numbers that decide accum vs remat vs smaller batch
+    (docs/operations.md "Step-time anatomy"). No weights are materialized:
+    auditing an 8B config on a laptop is fine."""
+    import sys as _sys
+
+    if host_devices:
+        if "jax" in _sys.modules:
+            raise click.ClickException(
+                "--host-devices must be set before jax initializes; run "
+                "`kt hbm audit` in a fresh process")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{host_devices}").strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    axes = None
+    if mesh_spec:
+        try:
+            axes = {k.strip(): int(v) for k, _, v in
+                    (part.partition("=") for part in mesh_spec.split(","))}
+        except ValueError:
+            raise click.ClickException(
+                f'bad --mesh {mesh_spec!r}; expected "axis=N[,axis=N...]"')
+    from .train.hbm_audit import audit_llama, format_audit
+
+    report = audit_llama(model, batch=batch, seq=seq, mesh_axes=axes,
+                         accum_steps=accum_steps,
+                         overlap_grads=overlap_grads,
+                         remat_policy=remat_policy, donate=not no_donate)
+    if as_json:
+        click.echo(json.dumps(report, indent=2))
+    else:
+        click.echo(format_audit(report))
+
+
+@cli.group()
 def controller():
     """Controller management."""
 

@@ -12,3 +12,43 @@ def config_from_dict(cls: Type, d: Dict[str, Any]):
     knows). One definition for every model family."""
     fields = {f.name for f in dataclasses.fields(cls)}
     return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+# Named jax.checkpoint policies (ISSUE 12 remat audit surface). One table
+# shared by the model layer stacks (cfg.remat_policy), make_train_step
+# (remat_policy=), and `kt hbm audit` — so the names mean the same thing
+# at every layer:
+#
+#   "none"              — no rematerialization (save everything)
+#   "dots"              — save matmul outputs, recompute the rest
+#                         (dots_with_no_batch_dims_saveable — the default
+#                         the llama scan body has always used)
+#   "nothing_saveable"  — full remat: recompute the whole forward in the
+#                         backward (minimum HBM, maximum recompute FLOPs)
+#
+# A callable passes through untouched (custom jax.checkpoint policy).
+REMAT_POLICY_NAMES = ("none", "dots", "nothing_saveable")
+
+
+def resolve_remat_policy(policy: Any):
+    """Name → jax.checkpoint policy callable; ``None`` means "don't remat"
+    (callers skip the ``jax.checkpoint`` wrap entirely). Raises on unknown
+    names so a typo'd policy fails at build time, not as a silent
+    save-everything."""
+    if policy is None or policy == "none":
+        return None
+    if callable(policy):
+        return policy
+    import jax
+
+    table = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    }
+    try:
+        return table[policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; expected one of "
+            f"{REMAT_POLICY_NAMES} or a jax.checkpoint policy callable"
+        ) from None

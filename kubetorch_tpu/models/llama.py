@@ -42,6 +42,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Named jax.checkpoint policy for the scanned layer stack (ISSUE 12):
+    # "none" | "dots" | "nothing_saveable" | a policy callable. None keeps
+    # the legacy behavior (remat=True → "dots"). `kt hbm audit` is the
+    # tool that decides which one a config should run.
+    remat_policy: Any = None
     # auto | xla | flash | ring | ulysses; "ring_local"/"ulysses_local" are
     # pipeline-internal (already-inside-shard_map dispatch, set only by
     # llama_forward_pipelined)
@@ -259,7 +264,17 @@ def llama_hidden(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig) ->
     def body(carry, lw):
         return _layer(cfg, carry, lw, freqs), None
 
-    if cfg.remat:
+    from .common import resolve_remat_policy
+
+    # remat_policy (named) wins over the legacy bool; remat=True with no
+    # policy keeps the historical dots-saveable behavior. getattr: MoE and
+    # pipeline configs ride through here without the field.
+    policy = getattr(cfg, "remat_policy", None)
+    if policy is not None:
+        policy = resolve_remat_policy(policy)
+        if policy is not None:
+            body = jax.checkpoint(body, policy=policy)
+    elif cfg.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     x, _ = lax.scan(body, x, params["layers"])
     return rmsnorm(x, params["final_norm"], cfg.norm_eps)

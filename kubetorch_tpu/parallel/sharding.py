@@ -72,6 +72,26 @@ class ShardingRules:
         return jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec), self.tree_specs(tree, mesh))
 
+    def constrain_tree(self, tree: Any, mesh) -> Any:
+        """``with_sharding_constraint`` every leaf per the rules — the
+        trace-time twin of :func:`shard_pytree`, usable INSIDE a jitted
+        function to steer GSPMD at a specific program point.
+
+        This is the lever behind overlapped gradient reduction
+        (``make_train_step(overlap_grads=True)``): constraining each
+        microbatch's gradients to the parameter layout forces the
+        reduce-scatter to be emitted *there*, inside the accumulation
+        scan, where XLA's latency-hiding scheduler can overlap it with
+        the next microbatch's compute — instead of one bulk reduction
+        after the scan. It also pins the fp32 accumulator itself to one
+        fsdp shard per device rather than a full replicated copy.
+        """
+        import jax
+
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, tree,
+            self.tree_shardings(tree, mesh))
+
 
 def named_sharding(mesh, *axes):
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1743,6 +1743,9 @@ class GenerationEngine:
         if spec is not None:
             out["engine_spec_rounds"] = float(spec.rounds)
             out["engine_spec_acceptance_rate"] = float(spec.acceptance_rate)
+            # adaptive draft length (ISSUE 12): the k the EWMA controller
+            # currently bets per round
+            out["engine_spec_draft_len"] = float(getattr(self, "k", 0))
         return out
 
     # remote-service surface: a deployed engine (kt.cls) exposes a blocking

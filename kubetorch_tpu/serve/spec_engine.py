@@ -29,6 +29,7 @@ Reference analog: none — beyond-parity serving, docs/serving.md.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -36,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from .. import telemetry
 
 from ..models.generate import KVCache, ffn_block, init_cache, rope_freqs
 from ..models.llama import rmsnorm
@@ -212,7 +215,9 @@ class SpeculativeEngine(GenerationEngine):
 
     def __init__(self, params: Dict[str, Any], cfg,
                  draft_params: Dict[str, Any], draft_cfg, *, spec_k: int = 4,
-                 **kwargs):
+                 spec_k_min: Optional[int] = None,
+                 spec_k_max: Optional[int] = None,
+                 spec_adapt_every: int = 4, **kwargs):
         if kwargs.get("temperature", 0.0) != 0.0:
             raise ValueError("SpeculativeEngine is greedy-only "
                              "(temperature=0); use GenerationEngine for "
@@ -236,6 +241,28 @@ class SpeculativeEngine(GenerationEngine):
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k = int(spec_k)
+        # Adaptive draft length (ISSUE 12 satellite): `k` is a *bet* on the
+        # draft's acceptance rate, and a static bet is wrong in both
+        # directions — a well-aligned draft wastes target weight-streams on
+        # too-short windows, a misaligned one burns k draft decodes per
+        # emitted token. An acceptance-rate EWMA shrinks/grows k within
+        # [k_min, k_max] (env KT_SPEC_K_MIN/KT_SPEC_K_MAX or kwargs; both
+        # default to spec_k, i.e. adaptation off unless bounds are widened).
+        # Each distinct k is its own compile of the window forwards — the
+        # bounds cap that to a handful, like the s_eff buckets.
+        env_min = os.environ.get("KT_SPEC_K_MIN")
+        env_max = os.environ.get("KT_SPEC_K_MAX")
+        self.k_min = int(spec_k_min if spec_k_min is not None
+                         else (env_min or self.k))
+        self.k_max = int(spec_k_max if spec_k_max is not None
+                         else (env_max or self.k))
+        if not (1 <= self.k_min <= self.k <= self.k_max):
+            raise ValueError(
+                f"need 1 <= k_min ({self.k_min}) <= spec_k ({self.k}) <= "
+                f"k_max ({self.k_max})")
+        self._adapt_every = max(1, int(spec_adapt_every))
+        self._rounds_since_adapt = 0
+        self._accept_ewma: Optional[float] = None
         self._draft_cache = init_cache(draft_cfg, self.slots, self.max_len)
         # per-slot ledgers: rows both caches validly cover, and the tokens
         # emitted but not yet ingested (1..k+1 long while active).
@@ -320,14 +347,15 @@ class SpeculativeEngine(GenerationEngine):
                 raise KeyError(f"unknown prefix_id {prefix_id}")
             p_bucket = pref[0].shape[2]
         # the verify window writes up to 2k+1 rows past the last emitted
-        # token — reserve that headroom so scatter rows stay in bounds
+        # token — reserve headroom for the LARGEST k adaptation may pick,
+        # so a later grow can never push a seated request out of bounds
         if (prompt and max_new_tokens >= 1
                 and p_bucket + len(prompt) + max_new_tokens
-                + 2 * self.k + 1 > self.max_len):
+                + 2 * self.k_max + 1 > self.max_len):
             raise ValueError(
                 f"prefix bucket ({p_bucket}) + prompt ({len(prompt)}) + "
                 f"max_new_tokens ({max_new_tokens}) + verify window "
-                f"({2 * self.k + 1}) exceeds max_len ({self.max_len})")
+                f"({2 * self.k_max + 1}) exceeds max_len ({self.max_len})")
         # stop sequences work unchanged: emission goes through the shared
         # _emit suffix check, and speculation is exact-greedy so stopping
         # early never changes the tokens that were already emitted
@@ -582,6 +610,7 @@ class SpeculativeEngine(GenerationEngine):
         greedy = np.asarray(jnp.argmax(tlog, axis=-1))   # (B, WT)
         self._steps += 1
 
+        round_accepted = 0
         for i in active:
             ci = int(c[i])
             accepted = 0
@@ -603,8 +632,38 @@ class SpeculativeEngine(GenerationEngine):
             # target's post-stream continuation, and counting them would
             # flatter acceptance_rate for exactly the requests that end
             self.spec_stats.accepted += min(accepted, sent)
+            round_accepted += min(accepted, sent)
             # a slot retired during emission had its ledgers cleared by
             # _retire_slot → _free_slot_ledgers; only live slots advance
             if self._slot_req[i] is not None:
                 self._spec_valid[i] = start[i] + ci
                 self._slot_pending[i] = emitted
+        self._note_round(round_accepted, len(active) * k)
+
+    def _note_round(self, accepted: int, proposed: int) -> None:
+        """Acceptance-rate EWMA → draft-length adaptation (ISSUE 12
+        satellite). Grows ``k`` while the draft keeps earning its windows
+        (EWMA ≥ 0.8), shrinks it when more than half the proposals are
+        wasted draft decodes (EWMA ≤ 0.5); the 0.5–0.8 band is hysteresis.
+        At most one ±1 move per ``spec_adapt_every`` rounds, bounded by
+        [k_min, k_max] — the bounds also cap how many window-shape
+        compiles adaptation can ever trigger."""
+        if not proposed:
+            return
+        rate = accepted / proposed
+        self._accept_ewma = (rate if self._accept_ewma is None
+                             else 0.8 * self._accept_ewma + 0.2 * rate)
+        gauges = telemetry.spec_metrics()
+        gauges["accept_rate"].set(self._accept_ewma)
+        gauges["draft_len"].set(self.k)
+        if self.k_min == self.k_max:
+            return
+        self._rounds_since_adapt += 1
+        if self._rounds_since_adapt < self._adapt_every:
+            return
+        self._rounds_since_adapt = 0
+        if self._accept_ewma >= 0.8 and self.k < self.k_max:
+            self.k += 1
+        elif self._accept_ewma <= 0.5 and self.k > self.k_min:
+            self.k -= 1
+        gauges["draft_len"].set(self.k)

@@ -688,6 +688,95 @@ def stage(stage_name: str, **attrs: Any) -> _StageTimer:
     return _StageTimer(stage_name, attrs)
 
 
+class _HistTimer:
+    """``with timed(hist, phase="compute"):`` — observe wall-clock into an
+    arbitrary histogram. The span-free sibling of :func:`stage` for
+    per-iteration hot loops (a train step fires thousands of times; a Span
+    per step would churn the ring for no diagnostic value)."""
+
+    __slots__ = ("hist", "labels", "_t0")
+
+    def __init__(self, hist: Histogram, labels: Dict[str, Any]):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self) -> "_HistTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.hist.observe(time.perf_counter() - self._t0, **self.labels)
+
+
+def timed(hist: Histogram, **labels: Any) -> _HistTimer:
+    """Time a block into ``hist`` (no span). The sanctioned way for code
+    outside this module to measure latency when a ``kt_stage_seconds``
+    stage is the wrong shape (e.g. phase-labelled step anatomy)."""
+    return _HistTimer(hist, labels)
+
+
+# ---------------------------------------------------------------------------
+# Train-step anatomy metrics (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_TRAIN_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def train_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the step-time anatomy family (ISSUE 12):
+
+    - ``kt_train_step_seconds{phase=...}`` — where one training step's
+      wall-clock goes. Phases: ``compute`` (the jitted step call, observed
+      by ``make_train_step``'s wrapper — dispatch-to-return; on an async
+      backend this is dispatch cost unless the caller syncs), ``grad_sync``
+      (host-visible wait for the step's metrics/grads to materialize,
+      observed by loops/benches that fetch them), ``snapshot_stall`` (the
+      inline portion of ``Checkpointer.maybe_save`` — the time the step
+      loop is actually blocked by a checkpoint snapshot).
+    - ``kt_train_mfu`` — achieved model-FLOPs utilization, set by the
+      bench/train loops that know the model's FLOPs-per-token.
+    """
+    global _TRAIN_METRICS
+    if _TRAIN_METRICS is None:
+        _TRAIN_METRICS = {
+            "step_seconds": histogram(
+                "kt_train_step_seconds",
+                "Train-step wall-clock anatomy (phase: compute, grad_sync, "
+                "snapshot_stall)",
+                labels=("phase",)),
+            "mfu": gauge(
+                "kt_train_mfu",
+                "Achieved model-FLOPs utilization of the training step"),
+        }
+    return _TRAIN_METRICS
+
+
+# ---------------------------------------------------------------------------
+# Speculative-decode adaptation metrics (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+_SPEC_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def spec_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the speculative-decode gauges the adaptive draft
+    length controller (``serve/spec_engine.py``) exports: the acceptance
+    EWMA it steers by and the draft length it chose."""
+    global _SPEC_METRICS
+    if _SPEC_METRICS is None:
+        _SPEC_METRICS = {
+            "accept_rate": gauge(
+                "kt_spec_accept_rate",
+                "EWMA of the speculative-decode acceptance rate "
+                "(accepted/proposed per round)"),
+            "draft_len": gauge(
+                "kt_spec_draft_len",
+                "Current speculative draft length k (adaptive within "
+                "KT_SPEC_K_MIN..KT_SPEC_K_MAX)"),
+        }
+    return _SPEC_METRICS
+
+
 # ---------------------------------------------------------------------------
 # Serving front-door metrics (ISSUE 9)
 # ---------------------------------------------------------------------------

@@ -66,14 +66,14 @@ def save_state(key: str, state: TrainState, store_url: Optional[str] = None) -> 
 
 def async_save_state(key: str, state: TrainState,
                      store_url: Optional[str] = None) -> "Future[dict]":
-    """Non-blocking checkpoint: the device→host snapshot happens NOW (so the
-    training loop may donate/overwrite the live state immediately), the store
-    IO happens on a background thread. Returns a Future — ``.result()``
-    confirms durability before e.g. preemption-exit."""
-    import jax
-
-    host_state = jax.tree_util.tree_map(lambda x: jax.device_get(x), state)
-    return _CKPT_EXECUTOR.submit(save_state, key, host_state, store_url)
+    """Non-blocking checkpoint: the device→host copies are *started* NOW
+    (``copy_to_host_async`` fan-out — O(dispatch) inline, see
+    :func:`_snapshot_async` for the donation caveat), gathered and uploaded
+    on the background IO thread. Returns a Future — ``.result()`` confirms
+    durability before e.g. preemption-exit."""
+    gather = _snapshot_async(state)
+    return _CKPT_EXECUTOR.submit(
+        lambda: save_state(key, gather(), store_url))
 
 
 def restore_state(key: str, like: TrainState, store_url: Optional[str] = None,
@@ -150,14 +150,81 @@ def _slot_key(base_key: str, slot: int) -> str:
 
 
 def _host_tree(tree: Any) -> Any:
-    """Snapshot device arrays to host NOW (so the training loop may donate
-    the live buffers immediately); a pure-numpy tree passes through."""
+    """Snapshot device arrays to host NOW (blocking; the training loop may
+    donate the live buffers immediately after); a pure-numpy tree passes
+    through. Uses the same fan-out-then-gather as the async path, so even
+    the blocking snapshot pays max(leaf transfer), not the sum a
+    sequential per-leaf ``device_get`` pays."""
+    return _snapshot_async(tree)()
+
+
+def _leaf_has_device_copy(x: Any) -> bool:
+    # jax.Array and any proxy modeling one (the bench's transfer fakes)
+    # expose copy_to_host_async; numpy/python leaves pass through untouched
+    return callable(getattr(x, "copy_to_host_async", None))
+
+
+def _snapshot_async(tree: Any):
+    """Two-phase device→host snapshot (ISSUE 12).
+
+    Phase 1 (inline, **O(dispatch)**): start every device leaf's
+    device→host copy via ``copy_to_host_async()`` — all transfers DMA
+    concurrently while the step loop keeps running. Phase 2 (the returned
+    zero-arg ``gather()``, run on the checkpoint IO thread): materialize
+    each leaf as numpy, which merely awaits the already-in-flight copies.
+    The old inline ``tree_map(jax.device_get)`` stalled the step for
+    O(state bytes), serially per leaf; this stalls it for the dispatch
+    loop only.
+
+    **Donation caveat**: the gather holds references to the device arrays.
+    A jitted step with ``donate=True`` that consumes the same state before
+    the IO thread gathers deletes those buffers and the gather raises (the
+    copy being in flight does not survive python-side deletion). In
+    practice the window is microseconds — ``maybe_save`` only submits when
+    the IO thread is idle, and gathering is its first action — but loops
+    that save every step at very small step times should either call
+    ``flush()`` before re-entering the step with the saved state, or set
+    ``KT_CKPT_INLINE_GATHER=1`` to restore the fully-blocking snapshot.
+    """
+    import os
     import sys
 
     if "jax" not in sys.modules:
-        return tree
+        return lambda: tree            # pure-host tree: nothing to move
     import jax
-    return jax.tree_util.tree_map(jax.device_get, tree)
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    device_leaves = [x for x in leaves if _leaf_has_device_copy(x)]
+    if not device_leaves:
+        return lambda: tree
+    for x in device_leaves:           # phase 1: concurrent D2H fan-out
+        x.copy_to_host_async()
+
+    def _gather_leaf(x):
+        if not _leaf_has_device_copy(x):
+            return x
+        try:
+            return np.asarray(x)
+        except RuntimeError as e:
+            if "deleted" in str(e).lower():
+                raise RuntimeError(
+                    "checkpoint snapshot raced buffer donation: a leaf was "
+                    "donated into the train step before the IO thread "
+                    "gathered it. Call Checkpointer.flush() before reusing "
+                    "the saved state with a donating step, or set "
+                    "KT_CKPT_INLINE_GATHER=1 (docs/operations.md "
+                    "'Step-time anatomy')") from e
+            raise
+
+    def gather():
+        return jax.tree_util.tree_map(_gather_leaf, tree)
+
+    if os.environ.get("KT_CKPT_INLINE_GATHER", "").strip().lower() in (
+            "1", "true", "on"):
+        host = gather()
+        return lambda: host
+    return gather
 
 
 def tree_fingerprint(tree: Any) -> str:
@@ -219,7 +286,9 @@ class Checkpointer:
 
     One instance per training process (rank 0 of the job usually owns it).
     ``maybe_save`` is the periodic in-step hook (async: the device→host
-    snapshot happens inline, the store IO on the background thread);
+    copies are *dispatched* inline — ``copy_to_host_async`` fan-out, an
+    O(dispatch) stall — and gathered with the store IO on the background
+    thread);
     ``save`` is the synchronous commit used on drain (the SIGTERM grace
     window) and by tests; ``restore`` reshards the last *committed*
     checkpoint onto the current mesh — never a torn one, by construction
@@ -284,25 +353,36 @@ class Checkpointer:
                 "seconds": round(seconds, 4)}
 
     def maybe_save(self, tree: Any, step: int) -> Optional["Future[Dict]"]:
-        """The in-step periodic hook: every ``every``-th step, snapshot to
-        host inline and commit on the background IO thread. At most one
-        upload is in flight (the single-thread executor serializes); a
-        still-running save just skips this step's snapshot rather than
-        queueing an unbounded backlog."""
+        """The in-step periodic hook: every ``every``-th step, fan out the
+        device→host copies inline (**O(dispatch)** — see
+        :func:`_snapshot_async`; the old inline per-leaf ``device_get``
+        stalled the step for O(state bytes)) and gather + commit on the
+        background IO thread. At most one upload is in flight (the
+        single-thread executor serializes); a still-running save just
+        skips this step's snapshot rather than queueing an unbounded
+        backlog."""
         if step % self.every:
             return None
         if self._pending is not None and not self._pending.done():
             return None
-        host = _host_tree(tree)
         # carry the caller's trace context onto the IO thread: the
         # checkpoint.save span parents onto the in-flight step's execute
         # span, so a resume's saves show up in `kt trace` (and ship back
         # to the pool's /metrics) instead of starting orphan traces
         import contextvars
-        ctx = contextvars.copy_context()
-        self._pending = _CKPT_EXECUTOR.submit(
-            ctx.run, self._save_host, host, step)
+
+        with telemetry.timed(telemetry.train_metrics()["step_seconds"],
+                             phase="snapshot_stall"):
+            gather = _snapshot_async(tree)
+            ctx = contextvars.copy_context()
+            self._pending = _CKPT_EXECUTOR.submit(
+                ctx.run, self._save_gathered, gather, step)
         return self._pending
+
+    def _save_gathered(self, gather, step: int) -> Dict[str, Any]:
+        # IO-thread half of maybe_save: await the in-flight D2H copies
+        # (phase 2 of the snapshot), then run the normal commit protocol
+        return self._save_host(gather(), step)
 
     def flush(self, timeout: Optional[float] = None) -> Optional[int]:
         """Drain path: wait for the in-flight async save (if any) and

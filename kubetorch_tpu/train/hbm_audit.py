@@ -1,0 +1,275 @@
+"""HBM audit for the training step (ISSUE 12): the tool that decides
+accum vs remat instead of guessing.
+
+``audit_train_step`` lowers a *fully abstract* train step (ShapeDtypeStructs
+with rule-derived shardings — no weights are ever materialized, so auditing
+an 8B config on a laptop is fine) and reads the compiled program's memory
+picture:
+
+- **live state** per device: params / optimizer-state / batch bytes, from
+  each leaf's sharded shard shape (``NamedSharding.shard_shape``) — what a
+  resident training job pins in HBM between steps;
+- **activations**: the compiled executable's temp allocation
+  (``compiled.memory_analysis().temp_size_in_bytes``) — the scratch the
+  step itself needs, which ``accum_steps`` and ``remat_policy`` trade
+  against recompute FLOPs;
+- **donation**: the ``input_output_alias`` map XLA actually committed to.
+  A state leaf that did NOT alias an output is double-buffered for the
+  whole step — one silent extra copy of that leaf in HBM every step. The
+  audit flags each one by pytree path.
+
+CLI: ``kt hbm audit`` (see ``cli.py``); docs/operations.md "Step-time
+anatomy" explains how to read the numbers.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+# header-line alias entries: "{out_idx}: (param_number, {...}, kind)"
+_ALIAS_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
+
+
+def _leaf_paths(tree: Any) -> List[str]:
+    import jax
+
+    paths = []
+    for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = []
+        for p in path:
+            for attr in ("key", "idx", "name"):
+                if hasattr(p, attr):
+                    parts.append(str(getattr(p, attr)))
+                    break
+            else:
+                parts.append(str(p))
+        paths.append("/".join(parts))
+    return paths
+
+
+def _sharded_bytes(tree: Any, shardings: Any) -> int:
+    """Per-device resident bytes of an abstract tree under ``shardings``."""
+    import math
+
+    import jax
+
+    total = 0
+    sh_leaves = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: x is None or hasattr(x, "shard_shape"))
+    for leaf, sh in zip(jax.tree_util.tree_leaves(tree), sh_leaves):
+        shard = (sh.shard_shape(tuple(leaf.shape))
+                 if hasattr(sh, "shard_shape") else tuple(leaf.shape))
+        total += math.prod(shard) * leaf.dtype.itemsize
+    return total
+
+
+def parse_donated_params(compiled_text_head: str) -> set:
+    """Input parameter numbers that alias an output, from the compiled
+    HloModule header's ``input_output_alias={...}`` map."""
+    start = compiled_text_head.find("input_output_alias={")
+    if start < 0:
+        return set()
+    # entries themselves contain "{}" — walk to the map's own closing brace
+    depth = 0
+    end = None
+    for i in range(start + len("input_output_alias="),
+                   len(compiled_text_head)):
+        ch = compiled_text_head[i]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    seg = compiled_text_head[start:end]
+    return {int(m.group(1)) for m in _ALIAS_RE.finditer(seg)}
+
+
+def audit_train_step(loss_fn, cfg_params_init, optimizer=None, *,
+                     mesh=None, rules=None, batch: int = 8, seq: int = 128,
+                     accum_steps: int = 1, overlap_grads: bool = False,
+                     remat_policy: Any = None, donate: bool = True,
+                     extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Compile the step abstractly and report the HBM anatomy.
+
+    ``cfg_params_init()`` must return the *abstract* param tree (use
+    ``jax.eval_shape`` around the model's init). Returns a dict with
+    per-device byte counts, the donation report, and a verdict hint.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import ShardingRules  # noqa: F401 (typing)
+    from .train_step import (TrainState, _opt_shardings, default_optimizer,
+                             make_train_step)
+
+    optimizer = optimizer or default_optimizer()
+    params_s = cfg_params_init()
+    opt_s = jax.eval_shape(optimizer.init, params_s)
+
+    if mesh is not None:
+        param_sh = rules.tree_shardings(params_s, mesh)
+        opt_sh = _opt_shardings(opt_s, params_s, param_sh, mesh)
+        step_sh = NamedSharding(mesh, P())
+    else:
+        param_sh = jax.tree_util.tree_map(lambda _: None, params_s)
+        opt_sh = jax.tree_util.tree_map(lambda _: None, opt_s)
+        step_sh = None
+
+    def sds(aval, sh):
+        if sh is None:
+            return jax.ShapeDtypeStruct(aval.shape, aval.dtype)
+        return jax.ShapeDtypeStruct(aval.shape, aval.dtype, sharding=sh)
+
+    state_s = TrainState(
+        params=jax.tree_util.tree_map(sds, params_s, param_sh),
+        opt_state=jax.tree_util.tree_map(sds, opt_s, opt_sh),
+        step=sds(jax.ShapeDtypeStruct((), jnp.int32), step_sh))
+
+    step = make_train_step(loss_fn, optimizer=optimizer, mesh=mesh,
+                           rules=rules, donate=donate,
+                           accum_steps=accum_steps,
+                           overlap_grads=overlap_grads,
+                           remat_policy=remat_policy)
+    bsh = getattr(step, "batch_sharding", None)
+    batch_s = {
+        "tokens": sds(jax.ShapeDtypeStruct((batch, seq), jnp.int32), bsh),
+        "targets": sds(jax.ShapeDtypeStruct((batch, seq), jnp.int32), bsh)}
+
+    compiled = step.jitted.lower(state_s, batch_s).compile()
+    ma = compiled.memory_analysis()
+    # the alias map lives on the HloModule header line — never scan the body
+    head = compiled.as_text().split("\n", 1)[0]
+    donated = parse_donated_params(head)
+
+    state_paths = _leaf_paths(state_s)
+    n_state = len(state_paths)
+    undonated = [state_paths[i] for i in range(n_state) if i not in donated]
+    params_bytes = _sharded_bytes(params_s, param_sh)
+    opt_bytes = _sharded_bytes(opt_s, opt_sh)
+    import math
+    batch_bytes = sum(
+        math.prod((bsh.shard_shape((batch, seq)) if bsh is not None
+                   else (batch, seq))) * 4 for _ in range(2))
+    temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+
+    state_bytes = params_bytes + opt_bytes
+    if undonated and donate:
+        hint = ("donation broken for some state leaves — each one is "
+                "double-buffered every step; check for dtype/sharding "
+                "changes between input and output state")
+    elif not donate:
+        hint = ("donation disabled: the whole state is double-buffered — "
+                "pass donate=True unless you need the pre-step state")
+    elif temp > state_bytes:
+        hint = ("activation-bound: raise accum_steps (linear activation "
+                "shrink, no extra FLOPs) before reaching for a stronger "
+                "remat_policy (nothing_saveable recomputes the forward)")
+    else:
+        hint = ("state-bound: activations fit under params+optimizer — "
+                "prefer remat_policy='none'/'dots' and spend HBM headroom "
+                "on a larger batch before adding accum/remat")
+
+    return {
+        "per_device_bytes": {
+            "params": params_bytes,
+            "opt_state": opt_bytes,
+            "batch": batch_bytes,
+            "activations_temp": temp,
+            "donated_alias": alias,
+            "live_total": state_bytes + batch_bytes + temp,
+        },
+        "donation": {
+            "enabled": bool(donate),
+            "state_leaves": n_state,
+            "donated_leaves": len([i for i in donated if i < n_state]),
+            "undonated_paths": undonated,
+        },
+        "config": {
+            "batch": batch, "seq": seq, "accum_steps": accum_steps,
+            "overlap_grads": overlap_grads,
+            "remat_policy": (remat_policy if isinstance(remat_policy, str)
+                             or remat_policy is None else "custom"),
+            "mesh": (dict(zip(mesh.axis_names, mesh.devices.shape))
+                     if mesh is not None else None),
+            **(extra or {}),
+        },
+        "hint": hint,
+    }
+
+
+def audit_llama(model: str = "tiny", *, batch: int = 8, seq: int = 128,
+                mesh_axes: Optional[Dict[str, int]] = None,
+                accum_steps: int = 1, overlap_grads: bool = False,
+                remat_policy: Any = None, donate: bool = True,
+                optimizer=None) -> Dict[str, Any]:
+    """Convenience wrapper: audit a named Llama preset on the current
+    devices (``mesh_axes`` e.g. ``{"fsdp": 8}``)."""
+    import jax
+
+    from ..models.llama import LlamaConfig, llama_init, llama_loss_chunked
+    from ..parallel.mesh import build_mesh
+    from ..parallel.sharding import LLAMA_RULES
+
+    presets = {
+        "tiny": LlamaConfig.tiny,
+        "1b": LlamaConfig.llama3_1b,
+        "8b": LlamaConfig.llama3_8b,
+    }
+    try:
+        cfg = presets[model](max_seq_len=max(seq, 128),
+                             remat_policy=remat_policy)
+    except KeyError:
+        raise ValueError(f"unknown model {model!r}; expected one of "
+                         f"{sorted(presets)}") from None
+    mesh = rules = None
+    if mesh_axes:
+        mesh = build_mesh(mesh_axes)
+        rules = LLAMA_RULES
+    report = audit_train_step(
+        lambda p, t, y: llama_loss_chunked(p, t, y, cfg, chunk=min(seq, 256)),
+        lambda: jax.eval_shape(functools.partial(llama_init, cfg=cfg),
+                               jax.random.PRNGKey(0)),
+        optimizer, mesh=mesh, rules=rules, batch=batch, seq=seq,
+        accum_steps=accum_steps, overlap_grads=overlap_grads,
+        remat_policy=remat_policy, donate=donate,
+        extra={"model": model, "param_count": cfg.param_count()})
+    return report
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def format_audit(report: Dict[str, Any]) -> str:
+    """Human table for ``kt hbm audit``."""
+    b = report["per_device_bytes"]
+    d = report["donation"]
+    c = report["config"]
+    lines = [
+        "hbm audit (per device)",
+        f"  config        : {c}",
+        f"  params        : {_fmt_bytes(b['params'])}",
+        f"  opt_state     : {_fmt_bytes(b['opt_state'])}",
+        f"  batch         : {_fmt_bytes(b['batch'])}",
+        f"  activations   : {_fmt_bytes(b['activations_temp'])} "
+        "(compiled temp)",
+        f"  live total    : {_fmt_bytes(b['live_total'])}",
+        f"  donation      : {d['donated_leaves']}/{d['state_leaves']} "
+        f"state leaves aliased ({'on' if d['enabled'] else 'OFF'})",
+    ]
+    for path in d["undonated_paths"][:12]:
+        lines.append(f"    UNDONATED   : {path}")
+    if len(d["undonated_paths"]) > 12:
+        lines.append(f"    ... and {len(d['undonated_paths']) - 12} more")
+    lines.append(f"  hint          : {report['hint']}")
+    return "\n".join(lines)

@@ -4,17 +4,44 @@ GSPMD style: the step is a pure function jit-compiled once with NamedSharding
 constraints on params/opt-state/batch; XLA inserts all collectives
 (reduce-scatter over fsdp, psum over data, all-to-all for expert routing).
 Buffers are donated so params update in place in HBM.
+
+**Step-time anatomy (ISSUE 12).** Three knobs decide where one step's
+milliseconds and HBM go, and `kt hbm audit` is the tool that picks between
+them instead of guessing:
+
+- ``accum_steps`` — microbatched fwd+bwd inside a scan: peak activation
+  memory is one microbatch's, at no extra FLOPs.
+- ``overlap_grads`` — per-microbatch bucketed gradient reduction: each
+  microbatch's grads are sharding-constrained to the parameter layout
+  *inside* the scan (each leaf is one bucket), so GSPMD emits the fsdp
+  reduce-scatter there and XLA's latency-hiding scheduler overlaps it with
+  the next microbatch's compute. The fp32 accumulator holds one fsdp shard
+  per device instead of a full replicated gradient. Numerics: the same
+  per-element sums in a different association order — bit-comparable to the
+  plain path (pinned by tests on the 8-device forced-host mesh).
+- ``remat_policy`` — named ``jax.checkpoint`` policy
+  (``none``/``dots``/``nothing_saveable``/callable) applied around the loss
+  per microbatch, trading recompute FLOPs for activation HBM. The model's
+  own layer stack takes the same names via ``LlamaConfig.remat_policy``.
+
+The wrapper observes ``kt_train_step_seconds{phase="compute"}`` per call —
+the number the perf gate's ``train_step`` stage regresses against.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from .. import telemetry
+from ..models.common import resolve_remat_policy
 from ..parallel.sharding import ShardingRules, batch_sharding
+
+# metric names the step can compute; "step" always rides along
+STEP_METRICS = ("loss", "grad_norm")
 
 
 class TrainState(NamedTuple):
@@ -43,7 +70,10 @@ def init_train_state(params: Any, optimizer=None) -> TrainState:
 
 def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
                     rules: Optional[ShardingRules] = None,
-                    donate: bool = True, accum_steps: int = 1) -> Callable:
+                    donate: bool = True, accum_steps: int = 1,
+                    overlap_grads: bool = False,
+                    remat_policy: Any = None,
+                    metrics: Sequence[str] = STEP_METRICS) -> Callable:
     """Build ``step(state, batch) -> (state, metrics)``, jit-sharded on ``mesh``.
 
     ``loss_fn(params, tokens, targets) -> scalar``. When ``mesh`` is given the
@@ -55,17 +85,51 @@ def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
     ``lax.scan`` (peak activation memory is one microbatch's), grads are
     averaged, and ONE optimizer update applies — numerically the full-batch
     step for mean-reduced losses, at a fraction of the memory.
+
+    ``overlap_grads=True`` (requires ``mesh``) turns the end-of-scan bulk
+    reduction into per-microbatch bucketed reduce-scatters (one bucket per
+    grad leaf, steered with ``with_sharding_constraint``) that overlap the
+    next microbatch's fwd+bwd, and shrinks the fp32 accumulator to one fsdp
+    shard per device. See the module docstring.
+
+    ``remat_policy`` ("none"/"dots"/"nothing_saveable"/callable) wraps the
+    loss in ``jax.checkpoint`` with that policy per microbatch.
+
+    ``metrics`` selects what the step computes beyond ``step``: drop
+    ``"grad_norm"`` (``metrics=("loss",)``) to remove a full-tree reduction
+    from the hot path when nothing scrapes it.
     """
     optimizer = optimizer or default_optimizer()
     if mesh is not None and rules is None:
         raise ValueError("make_train_step: a mesh requires sharding `rules`")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if overlap_grads and mesh is None:
+        raise ValueError("make_train_step: overlap_grads steers collectives "
+                         "onto a mesh — pass mesh= and rules=")
+    unknown = set(metrics) - set(STEP_METRICS)
+    if unknown:
+        raise ValueError(f"unknown step metrics {sorted(unknown)}; "
+                         f"expected a subset of {STEP_METRICS}")
+    metrics = tuple(metrics)
+
+    policy = resolve_remat_policy(remat_policy)
+    if policy is not None:
+        loss_fn = jax.checkpoint(loss_fn, policy=policy)
+
+    def _bucketed(tree):
+        # each grad leaf is one bucket: constraining it to the param layout
+        # HERE makes GSPMD emit that leaf's reduce-scatter at this program
+        # point (inside the scan) instead of one bulk reduce after it
+        return rules.constrain_tree(tree, mesh)
 
     def loss_and_grads(params, batch):
         if accum_steps == 1:
-            return jax.value_and_grad(loss_fn)(params, batch["tokens"],
-                                               batch["targets"])
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch["tokens"],
+                                                      batch["targets"])
+            if overlap_grads:
+                grads = _bucketed(grads)
+            return loss, grads
         b = batch["tokens"].shape[0]
         if b % accum_steps:
             raise ValueError(f"batch={b} not divisible by "
@@ -77,12 +141,21 @@ def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
             loss_sum, grad_sum = carry
             loss, grads = jax.value_and_grad(loss_fn)(params, mb["tokens"],
                                                       mb["targets"])
+            if overlap_grads:
+                grads = _bucketed(grads)
             grad_sum = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(a.dtype), grad_sum, grads)
+            if overlap_grads:
+                # keep the accumulator itself pinned to one fsdp shard per
+                # device — without this the carry is free to widen back to
+                # a full replicated fp32 gradient
+                grad_sum = _bucketed(grad_sum)
             return (loss_sum + loss, grad_sum), None
 
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        if overlap_grads:
+            zeros = _bucketed(zeros)
         (loss_sum, grad_sum), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32), zeros), micro)
         inv = 1.0 / accum_steps
         return loss_sum * inv, jax.tree_util.tree_map(
@@ -103,36 +176,52 @@ def make_train_step(loss_fn: Callable, optimizer=None, mesh=None,
             new_opt = jax.tree_util.tree_map(
                 jax.lax.with_sharding_constraint, new_opt,
                 _opt_shardings(new_opt, new_params, param_sh, mesh))
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), "step": state.step}
-        return TrainState(new_params, new_opt, state.step + 1), metrics
-
-    if mesh is None:
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
-
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    def shard_state(state: TrainState) -> TrainState:
-        """Place an (unsharded) TrainState onto the mesh per the rules."""
-        param_sh = rules.tree_shardings(state.params, mesh)
-        opt_sh = _opt_shardings(state.opt_state, state.params, param_sh, mesh)
-        return TrainState(
-            params=jax.tree_util.tree_map(jax.device_put, state.params, param_sh),
-            opt_state=jax.tree_util.tree_map(jax.device_put, state.opt_state, opt_sh),
-            step=jax.device_put(state.step, NamedSharding(mesh, P())),
-        )
+        m = {"step": state.step}
+        if "loss" in metrics:
+            m["loss"] = loss
+        if "grad_norm" in metrics:
+            # an extra full-tree reduction — opt out via metrics=("loss",)
+            # when nothing reads it (docs/operations.md "Step-time anatomy")
+            m["grad_norm"] = optax.global_norm(grads)
+        return TrainState(new_params, new_opt, state.step + 1), m
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    step_hist = telemetry.train_metrics()["step_seconds"]
 
-    def wrapper(state, batch):
-        # Install the ambient mesh for mesh-aware ops (ring attention) — read
-        # at trace time, so it only matters on the first (tracing) call.
-        from ..parallel.mesh_context import use_mesh
-        with use_mesh(mesh):
-            return jitted(state, batch)
+    if mesh is None:
+        def wrapper(state, batch):
+            with telemetry.timed(step_hist, phase="compute"):
+                return jitted(state, batch)
+    else:
+        def wrapper(state, batch):
+            # Install the ambient mesh for mesh-aware ops (ring attention) —
+            # read at trace time, so it only matters on the first (tracing)
+            # call.
+            from ..parallel.mesh_context import use_mesh
+            with telemetry.timed(step_hist, phase="compute"), use_mesh(mesh):
+                return jitted(state, batch)
 
-    wrapper.shard_state = shard_state  # type: ignore[attr-defined]
-    wrapper.batch_sharding = batch_sharding(mesh)  # type: ignore[attr-defined]
+        def shard_state(state: TrainState) -> TrainState:
+            """Place an (unsharded) TrainState onto the mesh per the rules."""
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            param_sh = rules.tree_shardings(state.params, mesh)
+            opt_sh = _opt_shardings(state.opt_state, state.params, param_sh, mesh)
+            return TrainState(
+                params=jax.tree_util.tree_map(jax.device_put, state.params, param_sh),
+                opt_state=jax.tree_util.tree_map(jax.device_put, state.opt_state, opt_sh),
+                step=jax.device_put(state.step, NamedSharding(mesh, P())),
+            )
+
+        wrapper.shard_state = shard_state  # type: ignore[attr-defined]
+        wrapper.batch_sharding = batch_sharding(mesh)  # type: ignore[attr-defined]
+
     wrapper.jitted = jitted  # type: ignore[attr-defined]
+    # the bare accumulation path, jitted without the optimizer: what the
+    # overlap-equivalence tests and `bench.py --step-overlap` compare and
+    # whose output sharding *is* the accumulator's (one fsdp shard per
+    # device when overlap_grads is on)
+    wrapper.grads_fn = jax.jit(loss_and_grads)  # type: ignore[attr-defined]
     return wrapper
 
 

@@ -27,6 +27,13 @@ Gated stages and how each is driven:
   over the real shm envelope path (ISSUE 11, CPU-proxy sized): the
   end-to-end cost of landing one rollout leaf, gated so roadmap items
   can't silently eat the live-swap time.
+- ``train_step`` — real jitted tiny-llama train steps (accum_steps=2,
+  CPU proxy) through ``make_train_step``'s wrapper; reads the
+  ``kt_train_step_seconds{phase="compute"}`` histogram (ISSUE 12).
+- ``snapshot_stall`` — the inline portion of ``Checkpointer.maybe_save``
+  (``copy_to_host_async`` fan-out + IO-thread handoff) against a real
+  store subprocess; gated so the async snapshot path can never quietly
+  regress back to blocking on a full host copy.
 
 Gate rule (per stage)::
 
@@ -66,7 +73,15 @@ os.environ.setdefault("KT_SHM_THRESHOLD", "65536")
 
 BASELINE_PATH = os.path.join(REPO, "scripts", "perf_baseline.json")
 GATED_STAGES = ("deserialize", "queue_wait", "execute", "store_fetch",
-                "shm_copy", "rollout_apply")
+                "shm_copy", "rollout_apply", "train_step", "snapshot_stall")
+
+# most stages read the kt_stage_seconds histogram; the two train-loop
+# stages (ISSUE 12) read the step-anatomy histogram the train wrapper and
+# Checkpointer.maybe_save observe into
+STAGE_SOURCES = {
+    "train_step": ("kt_train_step_seconds", 'phase="compute"'),
+    "snapshot_stall": ("kt_train_step_seconds", 'phase="snapshot_stall"'),
+}
 
 PAYLOAD_MODULE = textwrap.dedent("""
     def echo(x):
@@ -177,13 +192,17 @@ async def _drive_rollout(calls: int, leaf_kb: int) -> None:
         await client.close()
 
 
-def _drive_store(gets: int) -> None:
+def _drive_store(gets: int, snapshot_saves: int) -> None:
     """Pytree put + repeated gets against a real store-server subprocess:
     every leaf fetch observes the ``store_fetch`` stage in THIS process
-    (the client side, where the gate reads the registry)."""
+    (the client side, where the gate reads the registry). While the store
+    is up, ``snapshot_saves`` real ``Checkpointer.maybe_save`` calls
+    observe the ``snapshot_stall`` phase — the inline cost the async
+    snapshot path (ISSUE 12) promises stays O(dispatch)."""
     import numpy as np
 
     from kubetorch_tpu.data_store import commands as ds
+    from kubetorch_tpu.train.checkpoint import Checkpointer
     from kubetorch_tpu.utils.procs import (free_port, kill_process_tree,
                                            wait_for_port)
 
@@ -206,12 +225,50 @@ def _drive_store(gets: int) -> None:
             ds.put("perf-gate/w", tree, store_url=url)
             for _ in range(gets):
                 ds.get("perf-gate/w", store_url=url)
+            import jax.numpy as jnp
+            ck = Checkpointer("perf-gate/ckpt", store_url=url, every=1)
+            state = {"w": jnp.asarray(
+                rng.standard_normal(1 << 16).astype(np.float32))}
+            for i in range(snapshot_saves):
+                fut = ck.maybe_save(state, i + 1)
+                assert fut is not None
+                ck.flush(timeout=60)
         finally:
             kill_process_tree(proc.pid)
 
 
+def _drive_train_step(steps: int) -> None:
+    """Real jitted tiny-llama train steps (CPU proxy) through
+    ``make_train_step``'s wrapper — each call observes
+    ``kt_train_step_seconds{phase="compute"}``, the wall-time the
+    ``train_step`` stage gates so roadmap items can't silently eat the
+    step (ISSUE 12)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.llama import (LlamaConfig, llama_init,
+                                            llama_loss)
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    opt = optax.adam(1e-3)
+    step = make_train_step(lambda p, t, y: llama_loss(p, t, y, cfg),
+                           optimizer=opt, accum_steps=2)
+    state = init_train_state(llama_init(jax.random.PRNGKey(0), cfg), opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    state, m = step(state, batch)        # compile (observed, but p50-safe
+    float(m["loss"])                     # across `steps` warm calls)
+    for _ in range(steps):
+        state, m = step(state, batch)
+    float(m["loss"])
+
+
 def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
-            store_gets: int, rollout_calls: int, rollout_kb: int) -> dict:
+            store_gets: int, rollout_calls: int, rollout_kb: int,
+            train_steps: int, snapshot_saves: int) -> dict:
     """{stage: p50 seconds} measured from a fresh registry."""
     from kubetorch_tpu import telemetry
     from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
@@ -242,12 +299,14 @@ def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
             KT_LAUNCH_ID: "perf-gate-rollout",
         })
         asyncio.run(_drive_rollout(rollout_calls, rollout_kb))
-    _drive_store(store_gets)
+    _drive_store(store_gets, snapshot_saves)
+    _drive_train_step(train_steps)
     text = telemetry.REGISTRY.render()
     out = {}
     for stage in GATED_STAGES:
-        buckets = _parse_histogram_buckets(text, "kt_stage_seconds",
-                                           f'stage="{stage}"')
+        metric, selector = STAGE_SOURCES.get(
+            stage, ("kt_stage_seconds", f'stage="{stage}"'))
+        buckets = _parse_histogram_buckets(text, metric, selector)
         p50 = _quantile_from_buckets(buckets, 0.5)
         if p50 is None:
             raise RuntimeError(
@@ -266,6 +325,8 @@ def main() -> int:
     p.add_argument("--store-gets", type=int, default=20)
     p.add_argument("--rollout-calls", type=int, default=30)
     p.add_argument("--rollout-kb", type=int, default=512)
+    p.add_argument("--train-steps", type=int, default=20)
+    p.add_argument("--snapshot-saves", type=int, default=20)
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
@@ -276,7 +337,8 @@ def main() -> int:
 
     measured = measure(args.calls, args.payload_kb, args.shm_calls,
                        args.shm_kb, args.store_gets, args.rollout_calls,
-                       args.rollout_kb)
+                       args.rollout_kb, args.train_steps,
+                       args.snapshot_saves)
 
     if args.update or not os.path.exists(BASELINE_PATH):
         baseline = {
@@ -288,6 +350,8 @@ def main() -> int:
             "store_gets": args.store_gets,
             "rollout_calls": args.rollout_calls,
             "rollout_kb": args.rollout_kb,
+            "train_steps": args.train_steps,
+            "snapshot_saves": args.snapshot_saves,
             "note": "p50 seconds per stage from scripts/check_perf_gate.py"
                     " --update; gate = p50 <= baseline*(1+tol) + floor",
         }

@@ -157,6 +157,18 @@ CKPT_WRITE_RE = re.compile(
 CKPT_EXEMPT = {"checkpoint.py"}
 CKPT_BASELINE: dict = {}
 
+# Raw device→host gathers on the step path (ISSUE 12). A bare
+# ``jax.device_get`` in ``kubetorch_tpu/train/`` outside ``checkpoint.py``
+# blocks the training loop for O(bytes) of serial transfer — exactly the
+# snapshot stall the async two-phase snapshot (``_snapshot_async``:
+# ``copy_to_host_async`` fan-out inline, gather on the IO thread) removed.
+# Host staging of training state must ride checkpoint.py's sanctioned
+# helpers so the stall stays gated (the perf gate's ``snapshot_stall``
+# stage). The baseline is EMPTY on purpose.
+DEVICE_GET_RE = re.compile(r"\bdevice_get\s*\(")
+DEVICE_GET_EXEMPT = {"checkpoint.py"}
+DEVICE_GET_BASELINE: dict = {}
+
 # Raw placement/scale calls in controller/ outside the scheduler
 # (ISSUE 8). scheduler.py owns admission, the capacity book, and
 # preemption: a handler or loop that calls ``backend.apply`` itself
@@ -467,6 +479,31 @@ def main() -> int:
               "update CKPT_BASELINE with a justification.")
         return 1
 
+    dget_failures = []
+    dget_counts = {}
+    for path in sorted((PKG / "train").rglob("*.py")):
+        if path.name in DEVICE_GET_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, DEVICE_GET_RE)
+        if n:
+            dget_counts[rel] = n
+        allowed = DEVICE_GET_BASELINE.get(rel, 0)
+        if n > allowed:
+            dget_failures.append(
+                f"  {rel}: {n} raw device_get site(s) on the step path, "
+                f"baseline allows {allowed}")
+    if dget_failures:
+        print("check_resilience: raw device_get stalls the step path:\n"
+              + "\n".join(dget_failures))
+        print("\nHost staging of training state belongs to "
+              "train/checkpoint.py (_snapshot_async / _host_tree): a bare "
+              "jax.device_get blocks the step loop for O(bytes) of serial "
+              "transfer instead of the O(dispatch) two-phase snapshot. For "
+              "deliberate exceptions update DEVICE_GET_BASELINE with a "
+              "justification.")
+        return 1
+
     telemetry_failures = []
     timing_counts = {}
     fmt_counts = {}
@@ -518,6 +555,8 @@ def main() -> int:
            if replace_counts.get(f, 0) < allowed]
         + [f for f, allowed in CKPT_BASELINE.items()
            if ckpt_counts.get(f, 0) < allowed]
+        + [f for f, allowed in DEVICE_GET_BASELINE.items()
+           if dget_counts.get(f, 0) < allowed]
         + [f for f, allowed in TIMING_BASELINE.items()
            if timing_counts.get(f, 0) < allowed]
         + [f for f, allowed in METRIC_FMT_BASELINE.items()
@@ -529,8 +568,9 @@ def main() -> int:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
               "checks, replica selections, store-origin resolutions, "
               "controller placements, data-store commit renames, "
-              "checkpoint writes, shared-memory segments, engine "
-              "param-tree assignments, and telemetry sites accounted for")
+              "checkpoint writes, step-path device_get sites, "
+              "shared-memory segments, engine param-tree assignments, and "
+              "telemetry sites accounted for")
     return 0
 
 

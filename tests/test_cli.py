@@ -19,7 +19,7 @@ def test_help_lists_commands(runner):
     assert r.exit_code == 0
     for cmd in ("check", "deploy", "call", "list", "teardown", "logs", "put",
                 "get", "ls", "rm", "secrets", "volumes", "run", "apply",
-                "describe", "server", "store", "controller", "debug"):
+                "describe", "server", "store", "controller", "debug", "hbm"):
         assert cmd in r.output, f"missing command {cmd}"
 
 

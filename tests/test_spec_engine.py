@@ -418,3 +418,84 @@ class TestChunkedPrefill:
         h2 = spec.submit([9], max_new_tokens=3)
         _drain(spec)
         assert h2.result(timeout=0) == w2
+
+
+class TestAdaptiveDraftLength:
+    """Acceptance-rate EWMA → draft length (ISSUE 12 satellite): k grows
+    while the draft earns its windows, shrinks when it doesn't, stays
+    static when the bounds are not widened — and exactness holds at every
+    k along the way (greedy verification is k-independent)."""
+
+    def test_bounds_default_to_static(self, models):
+        target, cfg, draft, dcfg = models
+        eng = SpeculativeEngine(target, cfg, target, cfg, spec_k=3,
+                                slots=1, max_len=64, prefill_buckets=(8,),
+                                spec_adapt_every=1)
+        h = eng.submit([3, 4, 5], max_new_tokens=16)
+        _drain(eng)
+        h.result(timeout=0)
+        assert eng.k == eng.k_min == eng.k_max == 3   # adaptation off
+
+    def test_perfect_draft_grows_k(self, models):
+        target, cfg, _, _ = models
+        prompt, n = [3, 4, 5], 24
+        want = _solo(target, cfg, prompt, n)
+        eng = SpeculativeEngine(target, cfg, target, cfg, spec_k=2,
+                                spec_k_min=1, spec_k_max=4,
+                                spec_adapt_every=1, slots=1, max_len=128,
+                                prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=n)
+        _drain(eng)
+        assert h.result(timeout=0) == want            # exact at every k
+        assert eng.k == 4, "self-draft (EWMA 1.0) must grow to k_max"
+
+    def test_bad_draft_shrinks_k(self, models):
+        target, cfg, draft, dcfg = models
+        prompt, n = [7, 8, 9], 24
+        want = _solo(target, cfg, prompt, n)
+        # the random tiny draft agrees with the target ~never (1/512)
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=3,
+                                spec_k_min=1, spec_k_max=3,
+                                spec_adapt_every=1, slots=1, max_len=128,
+                                prefill_buckets=(8,))
+        h = eng.submit(prompt, max_new_tokens=n)
+        _drain(eng)
+        assert h.result(timeout=0) == want
+        assert eng.k == 1, "near-zero acceptance must shrink to k_min"
+
+    def test_env_bounds_and_gauges(self, models, monkeypatch):
+        from kubetorch_tpu import telemetry
+
+        target, cfg, _, _ = models
+        monkeypatch.setenv("KT_SPEC_K_MIN", "1")
+        monkeypatch.setenv("KT_SPEC_K_MAX", "5")
+        eng = SpeculativeEngine(target, cfg, target, cfg, spec_k=2,
+                                spec_adapt_every=1, slots=1, max_len=128,
+                                prefill_buckets=(8,))
+        assert (eng.k_min, eng.k_max) == (1, 5)
+        h = eng.submit([1, 2], max_new_tokens=12)
+        _drain(eng)
+        h.result(timeout=0)
+        gauges = telemetry.spec_metrics()
+        assert gauges["draft_len"].value() == eng.k
+        assert gauges["accept_rate"].value() > 0.9    # self-draft
+        # __kt_metrics__ exports the adaptive k for the pod scrape
+        assert eng.__kt_metrics__()["engine_spec_draft_len"] == float(eng.k)
+
+    def test_invalid_bounds_refused(self, models):
+        target, cfg, draft, dcfg = models
+        with pytest.raises(ValueError, match="k_min"):
+            SpeculativeEngine(target, cfg, draft, dcfg, spec_k=2,
+                              spec_k_min=3, spec_k_max=4, slots=1,
+                              max_len=64)
+
+    def test_headroom_reserved_for_k_max(self, models):
+        """submit() must reserve the verify window of the LARGEST k
+        adaptation may pick, so a later grow can't scatter out of
+        bounds."""
+        target, cfg, draft, dcfg = models
+        eng = SpeculativeEngine(target, cfg, draft, dcfg, spec_k=1,
+                                spec_k_min=1, spec_k_max=8, slots=1,
+                                max_len=32, prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="verify window"):
+            eng.submit([1, 2, 3], max_new_tokens=15)  # 3+15+(2*8+1) > 32

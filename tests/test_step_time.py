@@ -1,0 +1,380 @@
+"""Step-time burn-down (ISSUE 12): overlapped gradient reduction, the
+async two-phase checkpoint snapshot, the remat/donation audit surface, and
+the step-anatomy metrics knob.
+
+The overlap claims are pinned on the conftest's forced 8-device host mesh:
+bucketed per-microbatch reduce-scatter must be *bit-comparable* to the
+plain accumulation path, and the fp32 accumulator must hold one fsdp shard
+per device. The snapshot claims are pinned against a sleep-leaf transfer
+fake: ``maybe_save`` must return in O(dispatch), never blocking a full
+host copy.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.level("minimal")
+
+
+# ---------------------------------------------------------------------------
+# Overlapped gradient reduction (tentpole 1)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_setup():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    opt = optax.adam(1e-2)
+    loss = lambda p, t, y: llama_loss(p, t, y, cfg)  # noqa: E731
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    # a FACTORY, not a tree: the donating step consumes (or buffer-aliases)
+    # its input state, so every step invocation needs a fresh init
+    make_params = lambda: llama_init(jax.random.PRNGKey(0), cfg)  # noqa: E731
+    return cfg, opt, loss, make_params, batch
+
+
+@pytest.mark.level("release")
+def test_overlap_bit_comparable_to_plain_accum(cpu_mesh_devices):
+    """Bucketed per-microbatch reduction must produce the SAME numbers as
+    the end-of-scan bulk reduce — loss, grad_norm, accumulated grads, and
+    the post-update params, on the 8-device forced-host mesh."""
+    import jax
+
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import LLAMA_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg, opt, loss, make_params, batch = _tiny_setup()
+    mesh = build_mesh({"data": 2, "fsdp": 4})
+    states, metrics, grads = {}, {}, {}
+    for overlap in (False, True):
+        step = make_train_step(loss, optimizer=opt, mesh=mesh,
+                               rules=LLAMA_RULES, accum_steps=4,
+                               overlap_grads=overlap)
+        state = step.shard_state(init_train_state(make_params(), opt))
+        b = {k: jax.device_put(v, step.batch_sharding)
+             for k, v in batch.items()}
+        _, g = step.grads_fn(state.params, b)
+        grads[overlap] = jax.device_get(g)
+        state, m = step(state, b)
+        states[overlap] = jax.device_get(state.params)
+        metrics[overlap] = {k: float(v) for k, v in m.items()}
+
+    assert metrics[False]["loss"] == metrics[True]["loss"]
+    assert metrics[False]["grad_norm"] == metrics[True]["grad_norm"]
+    for a, b2 in zip(jax.tree_util.tree_leaves(grads[False]),
+                     jax.tree_util.tree_leaves(grads[True])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
+    for a, b2 in zip(jax.tree_util.tree_leaves(states[False]),
+                     jax.tree_util.tree_leaves(states[True])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
+
+
+@pytest.mark.level("release")
+def test_overlap_accumulator_is_one_fsdp_shard(cpu_mesh_devices):
+    """With overlap on, every fsdp-sharded grad leaf's per-device bytes =
+    leaf/8 (the fsdp shard), and the specs match the param rules — the
+    accumulator constraint, observable on ``grads_fn``'s output."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.sharding import LLAMA_RULES
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg, opt, loss, make_params, batch = _tiny_setup()
+    mesh = build_mesh({"fsdp": 8})
+    step = make_train_step(loss, optimizer=opt, mesh=mesh,
+                           rules=LLAMA_RULES, accum_steps=4,
+                           overlap_grads=True)
+    state = step.shard_state(init_train_state(make_params(), opt))
+    b = {k: jax.device_put(v, step.batch_sharding)
+         for k, v in batch.items()}
+    _, g = step.grads_fn(state.params, b)
+    assert g["layers"]["wq"].sharding.spec == P(None, "fsdp")
+    assert g["embed"].sharding.spec == P(None, "fsdp")
+    for leaf in (g["layers"]["wq"], g["layers"]["w_down"], g["embed"],
+                 g["lm_head"]):
+        assert leaf.addressable_shards[0].data.size * 8 == leaf.size
+
+
+def test_overlap_requires_mesh():
+    from kubetorch_tpu.train import make_train_step
+
+    with pytest.raises(ValueError, match="overlap_grads"):
+        make_train_step(lambda p, t, y: 0.0, overlap_grads=True)
+
+
+# ---------------------------------------------------------------------------
+# Metrics knob (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.level("release")
+def test_metrics_opt_in():
+    """metrics=("loss",) drops the grad_norm full-tree reduction from the
+    hot path; default keeps current behavior; unknown names refuse."""
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg, opt, loss, make_params, batch = _tiny_setup()
+    with pytest.raises(ValueError, match="unknown step metrics"):
+        make_train_step(loss, metrics=("loss", "learning_rate"))
+
+    lean = make_train_step(loss, optimizer=opt, metrics=("loss",))
+    _, m = lean(init_train_state(make_params(), opt), batch)
+    assert "grad_norm" not in m and "loss" in m and "step" in m
+
+    full = make_train_step(loss, optimizer=opt)
+    _, m2 = full(init_train_state(make_params(), opt), batch)
+    assert "grad_norm" in m2 and "loss" in m2
+
+
+@pytest.mark.level("release")
+def test_step_compute_phase_observed():
+    """Every wrapper call lands one kt_train_step_seconds{phase=compute}
+    observation — the series the perf gate's train_step stage reads."""
+    from kubetorch_tpu import telemetry
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    cfg, opt, loss, make_params, batch = _tiny_setup()
+    hist = telemetry.train_metrics()["step_seconds"]
+    before = hist.count(phase="compute")
+    step = make_train_step(loss, optimizer=opt)
+    state = init_train_state(make_params(), opt)
+    state, _ = step(state, batch)
+    state, _ = step(state, batch)
+    assert hist.count(phase="compute") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# Remat policy threading (tentpole 3)
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_remat_policy_names():
+    from kubetorch_tpu.models.common import resolve_remat_policy
+
+    assert resolve_remat_policy(None) is None
+    assert resolve_remat_policy("none") is None
+    assert callable(resolve_remat_policy("dots"))
+    assert callable(resolve_remat_policy("nothing_saveable"))
+    custom = lambda *a, **k: True  # noqa: E731
+    assert resolve_remat_policy(custom) is custom
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        resolve_remat_policy("dotz")
+
+
+@pytest.mark.level("release")
+def test_remat_policy_same_numbers_less_memory_pressure():
+    """Named policies change WHERE activations are saved, never the math:
+    loss/grads identical across none/dots/nothing_saveable, both via the
+    model config and via make_train_step's wrap."""
+    import jax
+
+    from kubetorch_tpu.models.llama import (LlamaConfig, llama_init,
+                                            llama_loss)
+    from kubetorch_tpu.train import init_train_state, make_train_step
+
+    import optax
+
+    losses, norms = [], []
+    for policy in (None, "none", "dots", "nothing_saveable"):
+        cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jax.numpy.float32,
+                               remat=False, remat_policy=policy)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        opt = optax.adam(1e-2)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab_size)
+        batch = {"tokens": tokens, "targets": jax.numpy.roll(tokens, -1, 1)}
+        step = make_train_step(
+            lambda p, t, y, c=cfg: llama_loss(p, t, y, c), optimizer=opt,
+            remat_policy=policy)
+        _, m = step(init_train_state(params, opt), batch)
+        losses.append(float(m["loss"]))
+        norms.append(float(m["grad_norm"]))
+    assert len(set(losses)) == 1, losses
+    assert max(norms) - min(norms) < 1e-5, norms
+
+
+# ---------------------------------------------------------------------------
+# _opt_shardings recursion (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.level("release")
+def test_opt_shardings_namedtuple_and_dict_recursion(cpu_mesh_devices):
+    """The structural matcher must recurse through namedtuples, dicts, and
+    lists, replicate scalar leaves, and hand the param shardings to every
+    subtree that mirrors the param structure — never shape-matching."""
+    import collections
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.train.train_step import _opt_shardings
+
+    mesh = build_mesh({"fsdp": 8})
+    params = {"a": jnp.zeros((8, 8)), "b": jnp.zeros((8,))}
+    param_sh = {"a": NamedSharding(mesh, P("fsdp", None)),
+                "b": NamedSharding(mesh, P())}
+    Adam = collections.namedtuple("Adam", ["mu", "nu", "count"])
+    opt_state = (Adam(mu={"a": jnp.zeros((8, 8)), "b": jnp.zeros((8,))},
+                      nu={"a": jnp.zeros((8, 8)), "b": jnp.zeros((8,))},
+                      count=jnp.zeros(())),
+                 [{"a": jnp.ones((8, 8)), "b": jnp.ones((8,))},
+                  jnp.zeros((3,))])
+    sh = _opt_shardings(opt_state, params, param_sh, mesh)
+    assert isinstance(sh[0], Adam)                       # namedtuple kept
+    assert sh[0].mu == param_sh and sh[0].nu == param_sh  # structural match
+    assert sh[0].count.spec == P()                       # scalar replicated
+    assert isinstance(sh[1], list)
+    assert sh[1][0] == param_sh                          # dict subtree match
+    assert sh[1][1].spec == P()                          # stray leaf
+
+
+# ---------------------------------------------------------------------------
+# Async snapshot (tentpole 2)
+# ---------------------------------------------------------------------------
+
+
+class _SleepLeaf:
+    """Transfer fake: materializing the value costs ``delay`` seconds (a
+    modeled D2H copy); dispatching the async copy costs nothing."""
+
+    def __init__(self, arr, delay=0.3):
+        self.arr = arr
+        self.delay = delay
+        self.async_copies = 0
+
+    def copy_to_host_async(self):
+        self.async_copies += 1
+
+    def __array__(self, dtype=None):
+        time.sleep(self.delay)
+        return self.arr if dtype is None else self.arr.astype(dtype)
+
+
+def _store_app(root):
+    from kubetorch_tpu.data_store.store_server import create_store_app
+    return lambda: create_store_app(str(root))
+
+
+def test_maybe_save_never_blocks_a_host_copy(tmp_path):
+    """THE regression test: ``maybe_save`` must return in O(dispatch) —
+    against a tree whose every leaf takes 0.3s to copy, the inline stall
+    must be far below ONE leaf's copy, the async copies must have been
+    fanned out inline, and the committed bytes must still be exact."""
+    import jax  # noqa: F401  (activates the device-leaf snapshot path)
+
+    from kubetorch_tpu.train import checkpoint as ck
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    leaves = {f"w{i}": _SleepLeaf(np.full(64, float(i), np.float32))
+              for i in range(4)}
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        c = ck.Checkpointer("job/async-snap", store_url=srv.url, every=1)
+        t0 = time.perf_counter()
+        fut = c.maybe_save(leaves, 1)
+        inline = time.perf_counter() - t0
+        assert fut is not None
+        assert inline < 0.15, \
+            f"maybe_save blocked {inline:.3f}s >= one 0.3s host copy"
+        assert all(leaf.async_copies == 1 for leaf in leaves.values()), \
+            "D2H fan-out must be dispatched inline"
+        assert c.flush(timeout=30) == 1
+        restored, step = c.restore()
+        assert step == 1
+        assert (restored["w3"] == 3.0).all()
+
+
+def test_maybe_save_inline_gather_escape_hatch(tmp_path, monkeypatch):
+    """KT_CKPT_INLINE_GATHER=1 restores the fully-blocking snapshot for
+    donated training loops (docs/operations.md)."""
+    import jax  # noqa: F401
+
+    from kubetorch_tpu.train import checkpoint as ck
+    from tests.assets.threaded_server import ThreadedAiohttpServer
+
+    monkeypatch.setenv("KT_CKPT_INLINE_GATHER", "1")
+    leaves = {"w": _SleepLeaf(np.ones(8, np.float32), delay=0.2)}
+    with ThreadedAiohttpServer(_store_app(tmp_path / "store")) as srv:
+        c = ck.Checkpointer("job/inline-snap", store_url=srv.url, every=1)
+        t0 = time.perf_counter()
+        fut = c.maybe_save(leaves, 1)
+        inline = time.perf_counter() - t0
+        assert inline >= 0.2, "inline-gather mode must block the host copy"
+        fut.result(timeout=30)
+
+
+def test_snapshot_donation_race_is_typed():
+    """A leaf donated before the IO thread gathers must fail with the
+    explanatory error, not a bare 'Array has been deleted'."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.train.checkpoint import _snapshot_async
+
+    x = jnp.arange(1024.0)
+    gather = _snapshot_async({"w": x})
+    x.delete()                       # what a donating step call does
+    with pytest.raises(RuntimeError, match="raced buffer donation"):
+        gather()
+
+
+def test_snapshot_pure_numpy_passthrough():
+    """A host tree never copies — same objects, zero gather cost (the
+    elastic tests' numpy states keep their pre-ISSUE-12 semantics)."""
+    from kubetorch_tpu.train.checkpoint import _host_tree, _snapshot_async
+
+    tree = {"a": np.arange(4), "b": {"c": np.ones(2)}}
+    gathered = _snapshot_async(tree)()
+    assert gathered["a"] is tree["a"] and gathered["b"]["c"] is tree["b"]["c"]
+    assert _host_tree(tree)["a"] is tree["a"]
+
+
+# ---------------------------------------------------------------------------
+# HBM audit (tentpole 3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.level("release")
+def test_hbm_audit_reports_and_flags_donation(cpu_mesh_devices):
+    from kubetorch_tpu.train.hbm_audit import audit_llama, format_audit
+
+    r = audit_llama("tiny", batch=8, seq=64, mesh_axes={"fsdp": 8},
+                    accum_steps=2, remat_policy="dots")
+    b = r["per_device_bytes"]
+    assert b["params"] > 0 and b["opt_state"] > b["params"]  # adam 2x fp32
+    assert b["activations_temp"] > 0
+    assert r["donation"]["enabled"]
+    # the overwhelming majority of state leaves must alias in place
+    assert r["donation"]["donated_leaves"] >= r["donation"]["state_leaves"] - 5
+    assert "hbm audit" in format_audit(r)
+
+    r_off = audit_llama("tiny", batch=8, seq=64, donate=False)
+    assert r_off["donation"]["donated_leaves"] == 0
+    assert len(r_off["donation"]["undonated_paths"]) == \
+        r_off["donation"]["state_leaves"]
+    assert "double-buffered" in r_off["hint"]
+
+
+def test_hbm_audit_alias_parse():
+    from kubetorch_tpu.train.hbm_audit import parse_donated_params
+
+    head = ('HloModule jit_step, is_scheduled=true, input_output_alias='
+            '{ {0}: (0, {}, may-alias), {1}: (3, {}, may-alias), '
+            '{2,1}: (17, {}, must-alias) }, entry_computation_layout='
+            '{(f32[8]{0})->f32[8]{0}}')
+    assert parse_donated_params(head) == {0, 3, 17}
+    assert parse_donated_params("HloModule bare") == set()

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
+.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -56,6 +56,13 @@ test-sched:
 # cache, session glue, queue-wait autoscale parsing
 test-serve:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m serve
+
+# planet-scale federation suite (ISSUE 13): region taxonomy, lease/epoch
+# fencing, cross-region anti-entropy + checkpoint fallback, geo spill
+# with typed shedding, the kill-region/partition verbs, and the
+# whole-region-death acceptance drill (slow+chaos)
+test-federation:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_federation.py -q
 
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
@@ -107,6 +114,13 @@ bench-ckpt:
 # rr-vs-affinity on the same seeded arrival schedule
 bench-serve:
 	$(PY_CPU) python scripts/bench_serve.py
+
+# cross-region failover bench (ISSUE 13): subprocess CPU-proxy regions
+# behind the geo front door, the primary SIGKILLed mid-run — failover
+# time + spillover TTFT p50/p99 + typed-shed accounting (raw errors
+# reaching the client must be zero)
+bench-federation:
+	$(PY_CPU) python scripts/bench_serve.py --regions 2
 
 # dispatch hot-path bench (ISSUE 10): shm envelopes vs the mp-queue path
 # through the REAL process pool — p50/p99 per stage-size, MB/s, and the

@@ -23,6 +23,8 @@ once, and the backoff sequence matches the policy".
              | kill-store-node[:SIG]@OP_INDEX   (process-level; see below)
              | kill-peer[:SIG]@OP_INDEX         (process-level; see below)
              | shm-corrupt                      (process-level; see below)
+             | kill-region[:OP_INDEX]@NAME      (region-scoped; see below)
+             | partition[:PCT]                  (client-side netpool; below)
 
 - Tokens **without** ``%PROB`` form the deterministic schedule: each
   matching request consumes the first unconsumed token whose path filter
@@ -121,6 +123,32 @@ Fault kinds:
   never reaches ``device_put``. ``*COUNT`` corrupts the first COUNT
   envelopes. Consumed by the encoder, invisible to the HTTP middleware.
 
+- ``kill-region[:OP_INDEX]@NAME``  **region-scoped, process-fatal** fault
+  (ISSUE 13): SIGKILL every pod/store/controller process *tagged* with
+  region NAME — the whole-region-death drill the federation layer
+  (``kubetorch_tpu/federation/``) must absorb with migrate-and-resume.
+  A process's region tag is its ``KT_REGION`` env (set by the region
+  harnesses); NAME empty matches any tagged process. Two consumption
+  sites, one schedule: server processes die in the HTTP middleware at
+  their OP_INDEX-th (default 0) client-origin data op, exactly like
+  ``kill-store-node``; loop-driven processes (trainers, rank workers)
+  consult :func:`region_kill_plan` — ``{op index → signal}`` — at each
+  step and self-SIGKILL mid-step. The signal is always SIGKILL: a dying
+  region does not say goodbye.
+- ``partition[:PCT]``  **client-side** fault consumed by
+  ``data_store/netpool.py`` (never the server middleware): every request
+  to a CROSS-REGION host is dropped (black-holed as an immediate
+  connection error) with probability PCT (default 1.0 — a full
+  partition; values > 1 are read as percentages). Local hosts —
+  requests that must keep working — are named by
+  ``KT_CHAOS_REGION_HOSTS`` (comma-separated base URLs or host:port
+  netlocs); with it unset every request counts as cross-region. The
+  deterministic stand-in for an inter-region network partition: the
+  cross-region replication tier must report growing lag (not crash),
+  the geo front door must spill with typed shedding only, and a
+  partitioned region's stale controller must be fenced by its lease
+  epoch when the partition heals.
+
 Example: ``KT_CHAOS="reset*2,503:0.1"`` — first two matching requests get
 connection resets, the third a 503 with ``Retry-After: 0.1``, the rest pass.
 """
@@ -150,6 +178,11 @@ _CHAOS_FAULTS = telemetry.counter(
 CHAOS_ENV = "KT_CHAOS"
 CHAOS_SEED_ENV = "KT_CHAOS_SEED"
 CHAOS_RANK_ENV = "KT_CHAOS_RANK"
+# region scoping (ISSUE 13): REGION_ENV tags a process with the region it
+# belongs to (the kill-region verb's blast radius); REGION_HOSTS_ENV names
+# the hosts the partition verb treats as LOCAL (never dropped)
+REGION_ENV = "KT_REGION"
+REGION_HOSTS_ENV = "KT_CHAOS_REGION_HOSTS"
 
 # With no @path filter, never chaos the liveness plumbing: readiness polls
 # retry forever and would silently eat the whole schedule. /ring is the
@@ -160,7 +193,7 @@ EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 _KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
           "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
           "term-rank", "kill-store-node", "kill-peer", "shed",
-          "shm-corrupt")
+          "shm-corrupt", "kill-region", "partition")
 
 # verbs consumed outside the HTTP middleware: the rank worker loop
 # (kill/term-rank) and the shared-memory envelope encoder (shm-corrupt,
@@ -171,6 +204,10 @@ _RANK_KINDS = ("kill-rank", "term-rank", "shm-corrupt")
 
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
 _OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node", "kill-peer")
+
+# verbs whose @-suffix is a REGION NAME (the kill-region blast radius; its
+# op index rides the :ARG slot instead, since @ is taken)
+_REGION_KINDS = ("kill-region",)
 
 # the broadcast-window transfer surface the kill-peer op counter watches:
 # bulk GETs a parent serves to its children (pod cache route) or the
@@ -190,6 +227,8 @@ class Fault:
     op_index: int = 0                  # kill/term-rank: 0-based call-op index
     torn_bytes: int = 4096             # torn-write: body bytes staged pre-kill
     grace_s: float = 5.0               # term-rank: SIGTERM→SIGKILL window
+    region: Optional[str] = None       # kill-region: the doomed region tag
+    pct: float = 1.0                   # partition: cross-region drop fraction
 
     def matches(self, path: str, method: Optional[str] = None) -> bool:
         # the store-state verbs are method-shaped: corrupt-blob rots a file
@@ -240,6 +279,9 @@ def parse_spec(spec: str) -> List[Fault]:
                 fault.op_index = int(path) if path else 0
             except ValueError:
                 raise ChaosError(f"bad op index in {raw!r}")
+        elif fault.kind in _REGION_KINDS:
+            # @-suffix names the doomed REGION (empty = any tagged process)
+            fault.region = (path or "").strip() or None
         else:
             fault.path = path or None
         fault.prob = prob
@@ -291,6 +333,28 @@ def _parse_one(token: str, raw: str) -> Fault:
             except ValueError:
                 raise ChaosError(f"bad torn-write byte count in {raw!r}")
         return fault
+    if head == "kill-region":
+        # the :ARG slot is the op index (@ names the region); the signal
+        # is always SIGKILL — a dying region does not say goodbye
+        fault = Fault(kind="kill-region", signal_no=9)
+        if arg:
+            try:
+                fault.op_index = max(0, int(arg))
+            except ValueError:
+                raise ChaosError(f"bad kill-region op index in {raw!r}")
+        return fault
+    if head == "partition":
+        fault = Fault(kind="partition")
+        if arg:
+            try:
+                fault.pct = float(arg)
+            except ValueError:
+                raise ChaosError(f"bad partition fraction in {raw!r}")
+            if fault.pct > 1.0:       # "partition:50" reads as 50%
+                fault.pct = fault.pct / 100.0
+            if not 0.0 <= fault.pct <= 1.0:
+                raise ChaosError(f"bad partition fraction in {raw!r}")
+        return fault
     if head in ("disk-full", "corrupt-blob", "shm-corrupt"):
         return Fault(kind=head)
     if head.isdigit():
@@ -324,8 +388,9 @@ class ChaosEngine:
     def __init__(self, faults: List[Fault], seed: int = 0):
         # kill-rank/term-rank verbs are process-level: consumed by the rank
         # worker loop via rank_kill_plan()/rank_term_plan(), invisible to
-        # the HTTP middleware
-        faults = [f for f in faults if f.kind not in _RANK_KINDS]
+        # the HTTP middleware; partition is client-side (netpool)
+        faults = [f for f in faults
+                  if f.kind not in _RANK_KINDS and f.kind != "partition"]
         # kill-store-node/kill-peer fire by op INDEX, not schedule order:
         # armed separately and checked against their own op counters every
         # request (kill-store-node: every client-origin data op; kill-peer:
@@ -333,8 +398,13 @@ class ChaosEngine:
         self.node_faults = [f for f in faults
                             if f.kind == "kill-store-node"]
         self.peer_faults = [f for f in faults if f.kind == "kill-peer"]
+        # kill-region rides the same data-op counter as kill-store-node,
+        # but only on processes whose KT_REGION tag is in the blast radius
+        self.region_faults = [f for f in faults if f.kind == "kill-region"
+                              and _region_in_scope(f.region)]
         faults = [f for f in faults
-                  if f.kind not in ("kill-store-node", "kill-peer")]
+                  if f.kind not in ("kill-store-node", "kill-peer",
+                                    "kill-region")]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
@@ -386,6 +456,12 @@ class ChaosEngine:
                         self.data_ops += 1
                         self.injected += 1
                         return fault
+                for i, fault in enumerate(self.region_faults):
+                    if fault.op_index == self.data_ops:
+                        del self.region_faults[i]
+                        self.data_ops += 1
+                        self.injected += 1
+                        return fault
                 self.data_ops += 1
             for i, fault in enumerate(self.schedule):
                 if fault.matches(path, method):
@@ -411,6 +487,128 @@ def _rank_in_scope() -> bool:
     if not want:
         return True
     return os.environ.get("RANK", "0") == want.strip()
+
+
+def _region_in_scope(region: Optional[str]) -> bool:
+    """A kill-region fault hits this process when its ``KT_REGION`` tag
+    matches the fault's region (fault region None = any TAGGED process;
+    an untagged process is never in any region's blast radius)."""
+    mine = (os.environ.get(REGION_ENV) or "").strip()
+    if not mine:
+        return False
+    return region is None or region == mine
+
+
+def region_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{op index → signal}`` from the ``kill-region`` verbs whose region
+    matches this process's ``KT_REGION`` tag — the loop-driven half of the
+    verb (trainers and other non-server processes consult it per step and
+    self-SIGKILL mid-step; server processes consume the same schedule in
+    the HTTP middleware). Empty when untagged or out of blast radius."""
+    raw = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
+    if "kill-region" not in (raw or ""):
+        return {}
+    try:
+        faults = parse_spec(raw)
+    except ChaosError as e:
+        print(f"[kt] chaos: ignoring malformed {CHAOS_ENV}: {e}")
+        return {}
+    return {f.op_index: f.signal_no for f in faults
+            if f.kind == "kill-region" and _region_in_scope(f.region)}
+
+
+# ---------------------------------------------------------------------------
+# partition — the client-side cross-region black hole (netpool consumes it)
+# ---------------------------------------------------------------------------
+
+# parse cache keyed by the raw spec string so the per-request check stays a
+# dict probe; the RNG is module-level and seeded so probabilistic
+# partitions (partition:0.5) replay identically under KT_CHAOS_SEED
+_PARTITION_CACHE: Dict[str, List[Fault]] = {}
+_PARTITION_RNG: Optional[random.Random] = None
+_PARTITION_LOCK = threading.Lock()
+
+
+def _partition_faults(raw: str) -> List[Fault]:
+    with _PARTITION_LOCK:
+        cached = _PARTITION_CACHE.get(raw)
+        if cached is None:
+            try:
+                cached = [f for f in parse_spec(raw)
+                          if f.kind == "partition"]
+            except ChaosError as e:
+                print(f"[kt] chaos: ignoring malformed {CHAOS_ENV}: {e}")
+                cached = []
+            _PARTITION_CACHE[raw] = cached
+        return cached
+
+
+def _local_netlocs() -> set:
+    """Hosts the partition verb must NEVER drop: ``KT_CHAOS_REGION_HOSTS``
+    (base URLs or bare host:port netlocs, comma-separated)."""
+    from urllib.parse import urlsplit
+
+    out = set()
+    for token in (os.environ.get(REGION_HOSTS_ENV) or "").split(","):
+        token = token.strip()
+        if not token:
+            continue
+        if "//" in token:
+            token = urlsplit(token).netloc
+        out.add(token.rstrip("/"))
+    return out
+
+
+def partitioned(url: str, spec: Optional[str] = None) -> bool:
+    """Should this request be black-holed by an armed ``partition`` verb?
+    True when a partition token is present AND ``url``'s host is
+    cross-region (not in ``KT_CHAOS_REGION_HOSTS``) AND the seeded coin
+    lands inside the token's PCT. Cheap when ``KT_CHAOS`` is unset."""
+    global _PARTITION_RNG
+    raw = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
+    if "partition" not in (raw or ""):
+        return False
+    faults = _partition_faults(raw)
+    if not faults:
+        return False
+    from urllib.parse import urlsplit
+    if urlsplit(url).netloc in _local_netlocs():
+        return False
+    pct = max(f.pct for f in faults)
+    if pct >= 1.0:
+        return True
+    with _PARTITION_LOCK:
+        if _PARTITION_RNG is None:
+            try:
+                seed = int(os.environ.get(CHAOS_SEED_ENV, "0"))
+            except ValueError:
+                seed = 0
+            _PARTITION_RNG = random.Random(seed)
+        return _PARTITION_RNG.random() < pct
+
+
+def reset_partition_state() -> None:
+    """Drop the parse cache and re-seed the partition RNG (test hook —
+    deterministic soak runs re-seed between cases)."""
+    global _PARTITION_RNG
+    with _PARTITION_LOCK:
+        _PARTITION_CACHE.clear()
+        _PARTITION_RNG = None
+
+
+def maybe_partition(url: str) -> None:
+    """The netpool hook: raise an immediate connection error for a
+    partitioned cross-region request — a black hole, indistinguishable on
+    the wire from the inter-region link being down. Raised BEFORE the
+    retry policy runs, so the caller's failover (ring sibling, geo spill)
+    fires at once instead of burning the whole backoff budget against a
+    link that is provably dark for the run."""
+    if partitioned(url):
+        import requests as _requests
+        _CHAOS_FAULTS.inc(kind="partition")
+        telemetry.add_event("chaos.fault", kind="partition", url=url[:120])
+        raise _requests.exceptions.ConnectionError(
+            f"chaos: cross-region partition (black hole) for {url}")
 
 
 def _rank_faults(kind: str, spec: Optional[str]) -> List[Fault]:
@@ -540,7 +738,7 @@ def chaos_middleware(engine: ChaosEngine):
         telemetry.add_event(
             "chaos.fault", kind=fault.kind, path=request.path,
             **({"status": fault.status} if fault.kind == "status" else {}))
-        if fault.kind in ("kill-store-node", "kill-peer"):
+        if fault.kind in ("kill-store-node", "kill-peer", "kill-region"):
             # the node dies mid-request, exactly like a SIGKILLed pod: no
             # response ever leaves this process (the client sees a reset
             # and fails over — ring sibling for a store node, re-parent

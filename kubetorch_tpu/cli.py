@@ -979,6 +979,84 @@ def queue_status(as_json):
 
 
 @cli.group()
+def fleet():
+    """Planet-scale federation management (ISSUE 13)."""
+
+
+@fleet.command("status")
+@click.option("--url", default=None,
+              help="A federation coordinator's base URL (default "
+                   "KT_FED_URL; without one, regions are probed directly "
+                   "from the KT_FED_REGIONS/KT_FED_STORES topology).")
+@click.option("--json", "as_json", is_flag=True, help="Raw JSON.")
+def fleet_status_cmd(url, as_json):
+    """Per-region health (Alive/Unreachable/Dead), capacity books, queue
+    depth, cross-region replication lag, and the global placement map —
+    the federation's ``kt store status``/``kt queue status`` sibling."""
+    from .federation import fleet_status
+
+    try:
+        snap = fleet_status(fed_url=url)
+    except Exception as e:  # noqa: BLE001 — a doctor command reports, not dies
+        raise click.ClickException(f"fleet status failed: {e}")
+    if as_json:
+        click.echo(json.dumps(snap, indent=2, default=str))
+        return
+    regions = snap.get("regions") or {}
+    src = snap.get("source") or ("coordinator" if snap.get("leases")
+                                 is not None else "probe")
+    head = f"federation: {len(regions)} region(s) · source={src}"
+    if snap.get("heartbeat_s") is not None:
+        head += (f" · heartbeat {snap['heartbeat_s']:g}s"
+                 f" · region TTL {snap.get('region_ttl_s'):g}s")
+    click.echo(head)
+    for name, info in sorted(regions.items()):
+        state = info.get("state", "Alive")
+        flag = {"Alive": "ok  ", "Unreachable": "UNRCH",
+                "Dead": "DEAD "}.get(state, state[:5])
+        down = (f" down={info['down_for_s']}s"
+                if info.get("down_for_s") is not None else "")
+        qd = info.get("queue_depth")
+        lag = info.get("xregion_lag_s")
+        store = info.get("store") or {}
+        cap = info.get("capacity") or {}
+        cap_str = " ".join(
+            f"{cls}:{row.get('used', 0)}/"
+            f"{'∞' if row.get('capacity') is None else row['capacity']}"
+            for cls, row in sorted(cap.items())) if cap else ""
+        parts = [f"  {name:<16} {flag}{down}"]
+        if qd is not None:
+            parts.append(f"queue={qd}")
+        if cap_str:
+            parts.append(cap_str)
+        if store:
+            parts.append(f"store={store.get('alive')}/"
+                         f"{store.get('nodes')} alive"
+                         + (f" epoch={store['epoch']}"
+                            if store.get("epoch") is not None else ""))
+        if lag is not None:
+            parts.append(f"xregion-lag={lag}s")
+        if info.get("error"):
+            parts.append(f"({info['error']})")
+        click.echo(" ".join(parts))
+    placements = snap.get("placements")
+    if placements:
+        click.echo(f"placements ({len(placements)}):")
+        for w, p in sorted(placements.items()):
+            extra = (f" migrations={p['migrations']}"
+                     if p.get("migrations") else "")
+            frm = (f" (from {p['migrated_from']})"
+                   if p.get("migrated_from") else "")
+            click.echo(f"  {w:<36} region={p.get('region')}"
+                       f" epoch={p.get('epoch')}{extra}{frm}")
+    elif placements is not None:
+        click.echo("placements: none")
+    else:
+        click.echo("placements: unknown (probe mode — point --url/"
+                   "KT_FED_URL at a coordinator)")
+
+
+@cli.group()
 def hbm():
     """Training-step HBM tooling (ISSUE 12)."""
 

@@ -86,6 +86,24 @@ class KTConfig:
     store_replication: int = 2
     store_write_quorum: int = 2
     store_node_ttl_s: float = 30.0
+    # suspect-node cooldown (ISSUE 13 satellite): how long the CLIENT ring
+    # router keeps a recently-failed replica demoted to the back of every
+    # candidate list before probing it again. Was hardcoded to
+    # min(node_ttl_s, 5.0); lifted here (+ KT_STORE_SUSPECT_COOLDOWN_S) so
+    # chaos tests and operators can tune failover-detection latency
+    # without monkeypatching. <= 0 keeps the legacy auto value.
+    store_suspect_cooldown_s: float = 0.0
+    # planet-scale federation (kubetorch_tpu/federation/, ISSUE 13). Same
+    # env layering (KT_FED_HEARTBEAT_S / KT_FED_REGION_TTL_S; the region
+    # topology itself rides KT_FED_REGIONS / KT_FED_STORES /
+    # KT_FED_SELF_REGION — parsed only inside federation/topology.py, the
+    # 12th check_resilience lint keeps it that way). fed_heartbeat_s is
+    # the global scheduler's leaf-poll cadence — every interval each
+    # region reports its CapacityBook + queue depth + throughput scores;
+    # fed_region_ttl_s is how long a region may stay Unreachable before it
+    # is declared Dead and its placements migrate-and-resume elsewhere.
+    fed_heartbeat_s: float = 2.0
+    fed_region_ttl_s: float = 30.0
     # preemptive scheduling (controller/scheduler.py). Same env layering
     # (KT_SCHED_CAPACITY / KT_SCHED_POLICY / KT_SCHED_DRAIN_GRACE_S).
     # sched_capacity="" leaves the capacity book unlimited — the scheduler

@@ -880,6 +880,10 @@ class Scheduler:
                  "waiting_s": round(time.time() - e["enqueued_at"], 1)}
                 for i, e in enumerate(ordered)],
             "ledger": [dict(e) for e in self.ledger[-16:]],
+            # measured per-workload/per-class ops/s EWMAs — the scores a
+            # federation leaf reports upward on every heartbeat (ISSUE 13)
+            # so global placement ranks regions on observed throughput
+            "throughput": {k: dict(v) for k, v in self.throughput.items()},
         }
 
 

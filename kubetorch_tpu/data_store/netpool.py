@@ -248,6 +248,15 @@ def request(method: str, url: str, *, timeout: Optional[float] = None,
     from ..resilience import (ESTABLISHED_TRANSIENT_EXCS, RETRYABLE_STATUSES,
                               retry_after_seconds, store_policy)
 
+    # the partition chaos verb (ISSUE 13) black-holes cross-region
+    # requests HERE — before the retry policy, so a provably-dark link
+    # surfaces as one immediate connection error the caller's failover
+    # (ring sibling, geo spill, anti-entropy lag accounting) absorbs
+    # instead of a full backoff budget. No-op unless KT_CHAOS arms it.
+    if os.environ.get("KT_CHAOS"):
+        from .. import chaos
+        chaos.maybe_partition(url)
+
     policy = policy or store_policy()
     statuses = RETRYABLE_STATUSES if retry_statuses is None else retry_statuses
     breaker = _breaker_for(url)

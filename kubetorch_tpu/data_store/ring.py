@@ -122,6 +122,19 @@ def node_ttl_s() -> float:
                       DEFAULT_NODE_TTL_S)
 
 
+def suspect_cooldown_s() -> float:
+    """How long the client router keeps a recently-failed node demoted
+    before routing to it again (``KT_STORE_SUSPECT_COOLDOWN_S``, ISSUE 13
+    satellite — was a hardcoded ``min(node_ttl, 5.0)``). <= 0 (the
+    default) keeps the legacy auto value, so existing deployments see no
+    change until an operator or chaos test opts in."""
+    v = _env_float("KT_STORE_SUSPECT_COOLDOWN_S",
+                   "store_suspect_cooldown_s", 0.0)
+    if v > 0:
+        return v
+    return min(node_ttl_s(), 5.0)
+
+
 # ---------------------------------------------------------------------------
 # Placement
 # ---------------------------------------------------------------------------
@@ -205,7 +218,7 @@ class StoreRing:
         # url → monotonic time of last observed failure; entries age out
         # after a short cooldown so a recovered node gets traffic back
         self._down: Dict[str, float] = {}
-        self.down_cooldown_s = min(node_ttl_s(), 5.0)
+        self.down_cooldown_s = suspect_cooldown_s()
 
     @property
     def size(self) -> int:
@@ -400,8 +413,26 @@ def ring_for(seed_url: str) -> StoreRing:
     """The router for ``seed_url``'s fleet. ``KT_STORE_NODES`` (comma-
     separated base URLs) defines multi-node membership; its epoch is
     learned lazily from ``/ring``. Unset → a single-origin ring with no
-    discovery round-trip at all."""
+    discovery round-trip at all.
+
+    A ``seed_url`` that is ITSELF a comma-separated list names an explicit
+    fleet and bypasses ``KT_STORE_NODES`` entirely — the federation tier
+    (ISSUE 13) routes cross-region reads/writes over a *remote* region's
+    ring this way, without ever mixing that region's members into the
+    local fleet's placement."""
     seed = seed_url.rstrip("/")
+    if "," in seed_url:
+        fleet = [u.strip().rstrip("/")
+                 for u in seed_url.split(",") if u.strip()]
+        cache_key = (seed_url, "__explicit_fleet__")
+        with _RINGS_LOCK:
+            ring = _RINGS.get(cache_key)
+            if ring is not None:
+                return ring
+        ring = StoreRing(fleet[0], nodes=fleet)
+        ring.refresh()          # learn the epoch; best-effort
+        with _RINGS_LOCK:
+            return _RINGS.setdefault(cache_key, ring)
     env = os.environ.get("KT_STORE_NODES") or None
     cache_key = (seed, env)
     with _RINGS_LOCK:

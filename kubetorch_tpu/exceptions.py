@@ -191,6 +191,35 @@ class RolloutError(KubetorchError):
         self.actual = actual
 
 
+class StaleLeaseError(KubetorchError):
+    """A placement attempt carried a fenced-off lease epoch (ISSUE 13).
+
+    The federation's global scheduler (``federation/scheduler.py``) grants
+    every cross-region placement a ``(region, epoch)`` lease and bumps the
+    epoch on every re-grant — including the automatic migrate-and-resume
+    that follows a region death. A controller that was partitioned away
+    while its region was declared Dead still *believes* it holds the
+    workload; when the partition heals and it tries to confirm or act on
+    that placement, its stale epoch is rejected with this error instead of
+    silently double-placing the workload next to the migrated copy. The
+    stale side's only valid move is to tear its local placement down.
+    ``current_epoch``/``current_region`` name the lease that actually
+    holds."""
+
+    def __init__(self, message: str = "placement lease epoch is stale",
+                 workload: Optional[str] = None,
+                 region: Optional[str] = None,
+                 epoch: Optional[int] = None,
+                 current_epoch: Optional[int] = None,
+                 current_region: Optional[str] = None):
+        super().__init__(message)
+        self.workload = workload
+        self.region = region
+        self.epoch = epoch
+        self.current_epoch = current_epoch
+        self.current_region = current_region
+
+
 class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
@@ -420,6 +449,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         RingEpochMismatch,
         DataCorruptionError,
         RolloutError,
+        StaleLeaseError,
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
@@ -441,6 +471,8 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "RingEpochMismatch": ["expected", "actual"],
     "DataCorruptionError": ["key", "expected", "actual", "source"],
     "RolloutError": ["reason", "version", "expected", "actual"],
+    "StaleLeaseError": ["workload", "region", "epoch", "current_epoch",
+                        "current_region"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
     "AdmissionShedError": ["reason", "tier", "queue_depth", "retry_after"],

@@ -1,0 +1,37 @@
+"""Planet-scale federation: a global control plane over N regions
+(ISSUE 13; Singularity, arXiv:2202.07848).
+
+One region == one complete PR 6-12 stack (controller+scheduler, store
+ring, serving router, elastic SPMD). This package adds the layer that
+makes killing an entire region a recoverable, *typed* event:
+
+- :mod:`.topology`    — region/controller/store maps (``KT_FED_*``; the
+  ONLY module allowed to read them — 12th ``check_resilience`` lint)
+- :mod:`.regions`     — the Alive→Unreachable→Dead region book
+- :mod:`.lease`       — placement leases with epoch fencing
+  (:class:`~kubetorch_tpu.exceptions.StaleLeaseError`)
+- :mod:`.scheduler`   — the global scheduler: regional schedulers as
+  leaves, heartbeat-fed capacity/throughput, migrate-and-resume
+- :mod:`.replication` — async cross-region store anti-entropy with
+  bounded, observable lag + the checkpoint fallback read
+- :mod:`.geo`         — the geo front door spilling serve traffic
+  between regional routers, typed shedding preserved
+- :mod:`.sim_region`  — CPU-proxy region gateway for benches/drills
+- :mod:`.status`      — ``kt fleet status`` probe/coordinator views
+"""
+
+from .geo import GeoFrontDoor, HttpRegionTarget, LocalRegionTarget
+from .lease import LeaseTable
+from .regions import ALIVE, DEAD, UNREACHABLE, RegionBook
+from .replication import XRegionReplicator, fallback_commit
+from .scheduler import (GlobalScheduler, HttpRegionLeaf, LocalRegionLeaf,
+                        RegionLeaf)
+from .status import fed_app, fleet_status
+
+__all__ = [
+    "ALIVE", "UNREACHABLE", "DEAD", "RegionBook", "LeaseTable",
+    "GlobalScheduler", "RegionLeaf", "LocalRegionLeaf", "HttpRegionLeaf",
+    "XRegionReplicator", "fallback_commit",
+    "GeoFrontDoor", "LocalRegionTarget", "HttpRegionTarget",
+    "fed_app", "fleet_status",
+]

@@ -1,0 +1,253 @@
+"""The geo front door: spill serving traffic between regional routers.
+
+Each region already has a real front door — the PR 9 ``serving/router.py``
+with continuous batching, affinity, and typed admission shedding. This
+layer sits above N of them and decides *which region* a request enters,
+with three rules:
+
+- **Keyless traffic stays home until home hurts.** The local region is
+  always first; it is demoted only on an SLO breach — a typed
+  ``AdmissionShedError`` from its router, or a latency EWMA past the
+  configured target — and then only for the spill, never torn down.
+  Spilling on the *typed* shed signal (not on guesswork) means the geo
+  layer inherits exactly the regional router's deadline- and tier-aware
+  admission judgment.
+- **Affinity keys hash over the ALIVE region set.** A session's home
+  region comes from the same membership-order-independent consistent
+  hash the store ring and the regional router already use; when its home
+  region dies, the key's walk lands on the next surviving region — every
+  front-door instance re-homes it identically, with zero coordination.
+- **Shedding stays typed, always.** A transport error against a region
+  marks it Unreachable in the :class:`~.regions.RegionBook` and the
+  request spills onward; when every region is dead or shedding, the
+  client gets a typed ``AdmissionShedError``/``DeadlineExceededError`` —
+  never a raw connection error. (The acceptance drill kills a whole
+  region mid-request and asserts exactly this.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import requests as _requests
+
+from .. import telemetry
+from ..data_store import netpool
+from ..data_store.ring import HashRing
+from ..exceptions import (AdmissionShedError, DeadlineExceededError,
+                          rehydrate_exception)
+from ..resilience import DEADLINE_HEADER, Deadline
+from ..serving.router import affinity_key, request_priority
+from .regions import RegionBook
+
+_SPILLS = telemetry.counter(
+    "kt_fed_spill_total",
+    "Requests spilled away from their first-choice region",
+    labels=("reason",))
+_GEO_REQS = telemetry.counter(
+    "kt_fed_requests_total",
+    "Geo front-door dispatches by serving region and outcome",
+    labels=("region", "outcome"))
+
+
+class RegionTarget:
+    """One region's serve surface. ``call`` either returns the region's
+    answer, raises a TYPED error the region's own router produced
+    (``AdmissionShedError`` / ``DeadlineExceededError`` / an application
+    error), or raises a transport error (``requests.RequestException`` /
+    ``ConnectionError``) that means "this region is dark"."""
+
+    name: str = "region"
+
+    async def call(self, payload: Dict[str, Any],
+                   headers: Dict[str, str],
+                   timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+
+class LocalRegionTarget(RegionTarget):
+    """Async-callable-backed target for tests/benches."""
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self._fn = fn
+
+    async def call(self, payload, headers, timeout=None):
+        return await self._fn(payload, headers, timeout)
+
+
+class HttpRegionTarget(RegionTarget):
+    """A region's serve gateway over HTTP (``federation/sim_region.py``
+    in benches/drills; any router-fronted pod in production). Rides
+    ``netpool.request`` so the partition chaos verb and the resilient
+    wrapper both apply; typed error bodies rehydrate client-side."""
+
+    def __init__(self, name: str, url: str, path: str = "/generate"):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.path = path
+
+    def _call_sync(self, payload, headers, timeout):
+        r = netpool.request(
+            "POST", f"{self.url}{self.path}", json=payload,
+            headers=headers, timeout=timeout or netpool.store_timeout(30),
+            # single-shot: the geo layer's spill IS the retry policy, and
+            # a generate call is not idempotent enough to blind-repeat
+            policy=_single_shot_policy())
+        if r.status_code == 200:
+            return r.json()
+        try:
+            body = r.json()
+        except ValueError:
+            body = None
+        if isinstance(body, dict) and body.get("error_type"):
+            raise rehydrate_exception(body)
+        raise _requests.exceptions.ConnectionError(
+            f"region {self.name}: HTTP {r.status_code}")
+
+
+    async def call(self, payload, headers, timeout=None):
+        return await asyncio.to_thread(self._call_sync, payload, headers,
+                                       timeout)
+
+
+def _single_shot_policy():
+    from ..resilience import RetryPolicy
+    return RetryPolicy(max_attempts=1)
+
+
+class GeoFrontDoor:
+    """N regional serve targets + the region liveness book = one global
+    door. One instance per edge/gateway process; every instance routes
+    identically from shared facts (alive set + consistent hash), the
+    store ring's no-coordination trick a third time."""
+
+    def __init__(self, targets: List[RegionTarget],
+                 local_region: Optional[str] = None,
+                 book: Optional[RegionBook] = None,
+                 slo_ms: float = 0.0):
+        self.targets: Dict[str, RegionTarget] = {t.name: t for t in targets}
+        self.local_region = local_region
+        self.book = book if book is not None \
+            else RegionBook(list(self.targets))
+        self.slo_ms = slo_ms
+        # per-region service-latency EWMA — the SLO-breach detector for
+        # keyless traffic (typed sheds are the other, sharper signal)
+        self._lat_ewma_s: Dict[str, float] = {}
+        self._ring: Tuple[Tuple[str, ...], Any] = ((), None)
+
+    # -- ordering -------------------------------------------------------------
+
+    def _breaching(self, region: str) -> bool:
+        if self.slo_ms <= 0:
+            return False
+        ewma = self._lat_ewma_s.get(region)
+        return ewma is not None and ewma * 1000.0 > self.slo_ms
+
+    def _hash_order(self, key: str, regions: List[str]) -> List[str]:
+        tkey = tuple(regions)
+        if self._ring[0] != tkey:
+            self._ring = (tkey, HashRing(list(tkey)))
+        return self._ring[1].walk(key)
+
+    def order(self, key: Optional[str]) -> List[str]:
+        """Candidate regions for one request. Keyed: the consistent-hash
+        walk over ALIVE regions (dead homes re-hash to survivors
+        automatically), with Unreachable regions appended as a last
+        resort. Keyless: local-first unless breaching its SLO, then
+        healthy regions by latency EWMA."""
+        usable = self.book.usable_regions()
+        alive = [r for r in usable if self.book.alive(r)]
+        suspect = [r for r in usable if r not in alive]
+        if key:
+            return self._hash_order(key, alive) + suspect if alive \
+                else suspect
+        ordered = sorted(
+            alive, key=lambda r: (
+                0 if (r == self.local_region and not self._breaching(r))
+                else 1,
+                1 if self._breaching(r) else 0,
+                self._lat_ewma_s.get(r, 0.0)))
+        return ordered + suspect
+
+    # -- dispatch -------------------------------------------------------------
+
+    async def dispatch(self, payload: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None,
+                       timeout: Optional[float] = None) -> Any:
+        headers = dict(headers or {})
+        deadline = Deadline.from_header(headers.get(DEADLINE_HEADER))
+        _, tier = request_priority(headers)
+        key = affinity_key(headers, payload.get("kwargs")
+                           if "kwargs" in payload else payload)
+        order = self.order(key)
+        last_shed: Optional[BaseException] = None
+        with telemetry.span("fed.route", tier=tier,
+                            **({"session": key} if key else {})) as sp:
+            for i, region in enumerate(order):
+                if deadline is not None and deadline.expired():
+                    raise DeadlineExceededError(
+                        "request expired while spilling between regions",
+                        deadline=deadline.at)
+                target = self.targets[region]
+                started = time.monotonic()
+                try:
+                    result = await target.call(payload, headers, timeout)
+                except (AdmissionShedError,) as e:
+                    # a typed shed from the region's own router: the SLO-
+                    # breach signal. Spill onward; if everyone sheds the
+                    # LAST typed verdict surfaces (deadline-aware: the
+                    # loop head re-checks before every hop).
+                    last_shed = e
+                    _GEO_REQS.inc(region=region, outcome="shed")
+                    if i + 1 < len(order):
+                        _SPILLS.inc(reason="slo_breach")
+                        telemetry.add_event("fed.spill", reason="slo_breach",
+                                            source=region)
+                    continue
+                except DeadlineExceededError:
+                    # final: no region can un-expire a deadline
+                    _GEO_REQS.inc(region=region, outcome="deadline")
+                    raise
+                except (_requests.RequestException, ConnectionError,
+                        OSError) as e:
+                    # transport: the region is dark — book it, spill on
+                    self.book.mark_failure(region)
+                    last_shed = last_shed or e
+                    _GEO_REQS.inc(region=region, outcome="transport_error")
+                    if i + 1 < len(order):
+                        _SPILLS.inc(reason="region_down")
+                        telemetry.add_event("fed.spill",
+                                            reason="region_down",
+                                            source=region)
+                    continue
+                dt = time.monotonic() - started
+                self.book.mark_ok(region)
+                prev = self._lat_ewma_s.get(region)
+                self._lat_ewma_s[region] = dt if prev is None \
+                    else 0.3 * dt + 0.7 * prev
+                _GEO_REQS.inc(region=region, outcome="ok")
+                if sp:
+                    sp.set_attr("region", region)
+                    sp.set_attr("spilled", i > 0)
+                return result
+            # exhausted: ALWAYS typed — a raw connection error must never
+            # reach the client (the drill's core assertion)
+            if isinstance(last_shed, AdmissionShedError):
+                raise last_shed
+            raise AdmissionShedError(
+                "no region could serve the request "
+                f"({len(order)} candidates, all dark or shedding)",
+                reason="region_down", tier=tier,
+                queue_depth=0, retry_after=1.0)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "local_region": self.local_region,
+            "regions": self.book.status(),
+            "latency_ewma_ms": {r: round(v * 1000.0, 2)
+                                for r, v in self._lat_ewma_s.items()},
+            "slo_ms": self.slo_ms,
+        }

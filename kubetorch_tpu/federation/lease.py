@@ -1,0 +1,87 @@
+"""Placement leases with epoch fencing.
+
+Singularity's planet-scale scheduler can only migrate a workload safely
+because placement is *exclusive*: at any instant exactly one region may
+run it. Heartbeats cannot guarantee that — a partitioned region's
+controller keeps running its local placement in good faith long after the
+global scheduler declared the region Dead and resumed the workload
+elsewhere. The classic answer (and ours) is a fencing token: every grant
+carries a monotonically increasing epoch, every re-grant bumps it, and
+any action stamped with an older epoch is rejected with a typed
+:class:`~kubetorch_tpu.exceptions.StaleLeaseError` — the stale side
+learns it lost the workload the moment the partition heals, *before* it
+can double-place. The same shape as the store ring's ``X-KT-Ring-Epoch``
+409 protocol, one level up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import telemetry
+from ..exceptions import StaleLeaseError
+
+_STALE_REJECTIONS = telemetry.counter(
+    "kt_fed_stale_lease_rejections_total",
+    "Placement attempts fenced off by a newer lease epoch",
+    labels=("region",))
+
+
+class LeaseTable:
+    """workload → (holder region, epoch). Epochs are per-workload and only
+    ever move forward; ``grant`` is the ONLY writer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases: Dict[str, Dict[str, Any]] = {}
+
+    def grant(self, workload: str, region: str) -> int:
+        """Grant (or re-grant) the workload's lease to ``region``; returns
+        the new fencing epoch. Every grant bumps the epoch even when the
+        holder is unchanged — a re-place after a controller restart must
+        fence the pre-restart pods too."""
+        with self._lock:
+            entry = self._leases.get(workload)
+            epoch = (entry["epoch"] + 1) if entry else 1
+            self._leases[workload] = {"region": region, "epoch": epoch,
+                                      "granted_at": time.time()}
+            telemetry.add_event("fed.lease_grant", workload=workload,
+                                region=region, epoch=epoch)
+            return epoch
+
+    def holder(self, workload: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._leases.get(workload)
+            return dict(entry) if entry else None
+
+    def validate(self, workload: str, region: str, epoch: int) -> None:
+        """Fencing check: raises :class:`StaleLeaseError` unless
+        ``(region, epoch)`` IS the current lease. Called by a regional
+        controller before it activates (or keeps acting on) a placement;
+        the raise is the signal to tear the local copy down."""
+        with self._lock:
+            entry = self._leases.get(workload)
+        current_epoch = entry["epoch"] if entry else None
+        current_region = entry["region"] if entry else None
+        if entry is None or epoch != current_epoch \
+                or region != current_region:
+            _STALE_REJECTIONS.inc(region=region)
+            telemetry.add_event("fed.lease_rejected", workload=workload,
+                                region=region, epoch=epoch)
+            raise StaleLeaseError(
+                f"lease for {workload!r} is held by "
+                f"{current_region!r}@epoch {current_epoch}; "
+                f"{region!r}@epoch {epoch} is fenced off",
+                workload=workload, region=region, epoch=epoch,
+                current_epoch=current_epoch,
+                current_region=current_region)
+
+    def revoke(self, workload: str) -> None:
+        with self._lock:
+            self._leases.pop(workload, None)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._leases.items()}

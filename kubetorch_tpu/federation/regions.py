@@ -1,0 +1,142 @@
+"""The region liveness book: Alive → Unreachable → Dead, with a TTL.
+
+The federation-level twin of the store ring's ``RingState.down`` taxonomy
+(PR 7), one level up: a *region* that misses heartbeats is ``Unreachable``
+(skip it, keep probing — partitions heal), and one that stays dark past
+``fed_region_ttl_s`` is ``Dead`` — the verdict that triggers automatic
+migrate-and-resume of every placement it held and re-hashes its affinity
+keys onto the survivors. The asymmetry is deliberate and identical to the
+ring's: declaring death early double-places workloads when the partition
+heals (the lease fence catches it, but migration isn't free), declaring
+it late extends the outage — the TTL is the knob, and it is config-lifted
+(``KT_FED_REGION_TTL_S``) so chaos drills can compress it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import telemetry
+
+ALIVE = "Alive"
+UNREACHABLE = "Unreachable"
+DEAD = "Dead"
+
+DEFAULT_REGION_TTL_S = 30.0
+
+_REGION_UP = telemetry.gauge(
+    "kt_fed_region_up",
+    "1 while the region answers heartbeats, 0 once Unreachable/Dead",
+    labels=("region",))
+_TRANSITIONS = telemetry.counter(
+    "kt_fed_region_transitions_total",
+    "Region liveness transitions observed by the federation book",
+    labels=("region", "to"))
+
+
+def region_ttl_s() -> float:
+    """How long a region may stay Unreachable before it is Dead
+    (``KT_FED_REGION_TTL_S`` / config ``fed_region_ttl_s``)."""
+    raw = os.environ.get("KT_FED_REGION_TTL_S")
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    try:
+        from ..config import config
+        return float(config().get("fed_region_ttl_s",
+                                  DEFAULT_REGION_TTL_S))
+    except Exception:
+        return DEFAULT_REGION_TTL_S
+
+
+class RegionBook:
+    """Liveness bookkeeping for a fixed set of region names. Thread-safe:
+    the heartbeat thread writes, request paths (geo front door) read."""
+
+    def __init__(self, regions: List[str],
+                 ttl_s: Optional[float] = None):
+        self.regions = list(regions)
+        self.ttl_s = ttl_s if ttl_s is not None else region_ttl_s()
+        self._lock = threading.Lock()
+        self._down: Dict[str, float] = {}    # region → first-failure wall
+        self._last: Dict[str, str] = {}      # region → last reported state
+        for r in self.regions:
+            _REGION_UP.set(1.0, region=r)
+
+    def add(self, region: str) -> None:
+        with self._lock:
+            if region not in self.regions:
+                self.regions.append(region)
+                _REGION_UP.set(1.0, region=region)
+
+    def mark_ok(self, region: str) -> None:
+        with self._lock:
+            self._down.pop(region, None)
+        self._note(region)
+
+    def mark_failure(self, region: str) -> None:
+        with self._lock:
+            self._down.setdefault(region, time.time())
+        self._note(region)
+
+    def down_since(self, region: str) -> Optional[float]:
+        with self._lock:
+            return self._down.get(region)
+
+    def state(self, region: str) -> str:
+        ts = self.down_since(region)
+        if ts is None:
+            return ALIVE
+        if time.time() - ts >= self.ttl_s:
+            return DEAD
+        return UNREACHABLE
+
+    def alive(self, region: str) -> bool:
+        return self.state(region) == ALIVE
+
+    def usable(self, region: str) -> bool:
+        """Worth attempting a request against: Alive or merely suspect —
+        the front door still tries an Unreachable region LAST (a single
+        missed heartbeat must not black-hole it), but never a Dead one."""
+        return self.state(region) != DEAD
+
+    def alive_regions(self) -> List[str]:
+        return [r for r in self.regions if self.alive(r)]
+
+    def usable_regions(self) -> List[str]:
+        """Alive regions first, then Unreachable ones — the candidate
+        order a dispatcher should walk."""
+        return ([r for r in self.regions if self.alive(r)]
+                + [r for r in self.regions
+                   if self.state(r) == UNREACHABLE])
+
+    def _note(self, region: str) -> None:
+        state = self.state(region)
+        prev = self._last.get(region)
+        if prev != state:
+            self._last[region] = state
+            _TRANSITIONS.inc(region=region, to=state)
+            telemetry.add_event("fed.region_state", region=region,
+                                state=state)
+        _REGION_UP.set(1.0 if state == ALIVE else 0.0, region=region)
+
+    def status(self) -> Dict[str, Dict]:
+        now = time.time()
+        with self._lock:
+            down = dict(self._down)
+        out: Dict[str, Dict] = {}
+        for r in self.regions:
+            ts = down.get(r)
+            if ts is None:
+                out[r] = {"state": ALIVE}
+            else:
+                age = now - ts
+                out[r] = {"state": DEAD if age >= self.ttl_s
+                          else UNREACHABLE,
+                          "down_for_s": round(age, 3)}
+        return out

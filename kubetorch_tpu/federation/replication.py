@@ -1,0 +1,287 @@
+"""Cross-region store replication: async anti-entropy with bounded lag.
+
+The PR 7 ring keeps every key R=2 *inside* a region at write-quorum —
+synchronous, because intra-region RTTs are sub-millisecond and a lost
+node must never lose an acked write. Stretching that quorum across an
+ocean would put a WAN RTT inside every checkpoint commit, so the
+cross-region tier is deliberately a different consistency class
+(Singularity's tiered replication, arXiv:2202.07848): writes stay
+region-local, and this pump copies them to the other regions' rings
+*asynchronously*, scrub-style — list, diff, push what's missing — with
+the lag exposed as ``kt_store_xregion_lag_seconds`` instead of hidden.
+
+Two invariants make the laggy copy *resumable* rather than merely
+present:
+
+- **Markers land last.** Within a sweep, plain data keys push first,
+  pytree indexes (``.__kt_index__``) second, commit markers
+  (``__kt_commit__``) and other mutable control values last — the same
+  ordering discipline as the commit protocol itself, so a remote reader
+  that sees a marker always finds the complete slot it points at. A
+  partition mid-sweep leaves the remote region on its previous committed
+  checkpoint, never a torn one.
+- **Newest wins, never newest loses.** Mutable keys are only pushed when
+  the source copy's ``stored_at`` is newer than the target's — a
+  workload that already migrated and is *writing* in the target region
+  cannot be rolled back by a stale sweep from its old home.
+
+The read side — a resume in region B looking for region A's last
+committed marker — is :func:`fallback_commit`, which
+``train/checkpoint.py`` consults when the local/configured ring has no
+answer (see the cross-region fallback in ``commit_info`` /
+``Checkpointer.restore``).
+
+Scope: kv-surface keys (pytree leaves + indexes + json control values —
+everything ``ds.put``/``put_json`` produce, which is everything the
+checkpoint and rollout protocols write). ``push_tree`` blob manifests
+ride ``sync.py``'s own transfer path and are out of this pump's remit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import requests as _requests
+
+from .. import telemetry
+from ..data_store import commands as ds
+from ..data_store import netpool, ring
+from ..exceptions import DataStoreError
+from . import topology
+
+_XREGION_LAG = telemetry.gauge(
+    "kt_store_xregion_lag_seconds",
+    "Age of the oldest local commit not yet replicated to the region",
+    labels=("region",))
+_XREGION_PENDING = telemetry.gauge(
+    "kt_store_xregion_pending_keys",
+    "Keys awaiting cross-region replication to the region",
+    labels=("region",))
+_XREGION_PUSHED = telemetry.counter(
+    "kt_store_xregion_pushed_total",
+    "Keys replicated cross-region, by target region",
+    labels=("region",))
+_XREGION_ERRORS = telemetry.counter(
+    "kt_store_xregion_errors_total",
+    "Cross-region replication attempts that failed (partition, node loss)",
+    labels=("region",))
+
+_INDEX_SUFFIX = ".__kt_index__"
+_MARKER_NAME = "__kt_commit__"
+
+
+def _key_tier(key: str) -> int:
+    """Push order within a sweep: data leaves (0) < pytree indexes (1) <
+    commit markers / mutable control values (2) — a remote marker must
+    never outrun the slot it points at."""
+    if key.endswith(f"/{_MARKER_NAME}") or key == _MARKER_NAME:
+        return 2
+    if key.endswith(_INDEX_SUFFIX):
+        return 1
+    return 0
+
+
+class XRegionReplicator:
+    """One pump per (source region ring → target region rings) pair set.
+
+    ``source`` and each target value are store-ring seeds — single URLs
+    or comma-joined explicit fleets (``topology.store_spec`` renders
+    them). ``prefixes`` bounds the sweep to the key namespaces worth
+    shipping cross-region (checkpoint bases, rollout manifests); empty
+    means everything on the kv surface.
+    """
+
+    def __init__(self, source: str, targets: Dict[str, str],
+                 prefixes: Tuple[str, ...] = (),
+                 interval_s: float = 5.0):
+        self.source = source
+        self.targets = dict(targets)
+        self.prefixes = tuple(prefixes)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # region → seconds of replication lag at the last sweep
+        self.lag_s: Dict[str, float] = {r: 0.0 for r in targets}
+
+    # -- source inventory ----------------------------------------------------
+
+    def _source_keys(self) -> List[Dict[str, Any]]:
+        rg = ring.ring_for(self.source)
+        r = rg.request("GET", "/keys",
+                       timeout=netpool.store_timeout(30))
+        if r.status_code != 200:
+            raise DataStoreError(
+                f"xregion sweep: /keys failed ({r.status_code})")
+        keys = [k for k in (r.json().get("keys") or [])
+                if k.get("kind") == "kv"]
+        if self.prefixes:
+            keys = [k for k in keys
+                    if any(k["key"].startswith(p) for p in self.prefixes)]
+        return keys
+
+    def _head_meta(self, spec: str, key: str) -> Optional[Dict[str, Any]]:
+        try:
+            r = ring.ring_for(spec).request(
+                "HEAD", f"/kv/{netpool.urlkey(key)}", key=key,
+                timeout=netpool.store_timeout(15))
+        except (_requests.RequestException, DataStoreError):
+            return None
+        if r.status_code != 200:
+            return None
+        return ds._response_meta(r)
+
+    # -- the sweep -----------------------------------------------------------
+
+    def sweep(self) -> Dict[str, Any]:
+        """One anti-entropy round against every target region. Partition
+        or node loss on a target degrades to recorded lag for that region
+        (and an error counter), never an exception — the pump's whole job
+        is to keep trying."""
+        keys = sorted(self._source_keys(),
+                      key=lambda k: _key_tier(k["key"]))
+        source_meta: Dict[str, Dict[str, Any]] = {}
+        for entry in keys:
+            meta = self._head_meta(self.source, entry["key"])
+            if meta and meta.get("blake2b"):
+                source_meta[entry["key"]] = meta
+        report: Dict[str, Any] = {"keys": len(source_meta), "targets": {}}
+        for region, spec in self.targets.items():
+            report["targets"][region] = self._sync_target(
+                region, spec, keys, source_meta)
+        return report
+
+    def _sync_target(self, region: str, spec: str,
+                     keys: List[Dict[str, Any]],
+                     source_meta: Dict[str, Dict[str, Any]]
+                     ) -> Dict[str, Any]:
+        now = time.time()
+        pushed, skipped, failed = 0, 0, []
+        with telemetry.span("fed.xregion_sweep", region=region,
+                            keys=len(source_meta)):
+            try:
+                current = ds._kv_diff(
+                    spec, {k: m["blake2b"]
+                           for k, m in source_meta.items()})
+            except Exception:  # noqa: BLE001 — diff probe best-effort
+                current = set()
+            for entry in keys:           # tier order: data < index < marker
+                key = entry["key"]
+                meta = source_meta.get(key)
+                if meta is None:
+                    continue
+                if key in current:
+                    skipped += 1
+                    continue
+                if _key_tier(key) > 0:
+                    # mutable control value: never roll the target back
+                    tmeta = self._head_meta(spec, key)
+                    if tmeta and float(tmeta.get("stored_at") or 0.0) \
+                            > float(meta.get("stored_at") or 0.0):
+                        skipped += 1
+                        continue
+                try:
+                    self._push(spec, key, meta)
+                    pushed += 1
+                    _XREGION_PUSHED.inc(region=region)
+                except (_requests.RequestException, DataStoreError):
+                    _XREGION_ERRORS.inc(region=region)
+                    failed.append(key)
+        # bounded lag, made visible: age of the oldest commit the target
+        # still lacks (0 when fully converged)
+        pending_ts = [float(source_meta[k].get("stored_at") or now)
+                      for k in failed]
+        lag = (now - min(pending_ts)) if pending_ts else 0.0
+        self.lag_s[region] = lag
+        _XREGION_LAG.set(lag, region=region)
+        _XREGION_PENDING.set(float(len(failed)), region=region)
+        return {"pushed": pushed, "skipped": skipped,
+                "failed": len(failed), "lag_s": round(lag, 3)}
+
+    def _push(self, spec: str, key: str, meta: Dict[str, Any]) -> None:
+        r = ring.ring_for(self.source).request(
+            "GET", f"/kv/{netpool.urlkey(key)}", key=key,
+            timeout=netpool.store_timeout())
+        if r.status_code != 200:
+            raise DataStoreError(
+                f"xregion push: source GET {key!r} → {r.status_code}")
+        # stored_at travels verbatim (kv_put setdefaults, never overwrites)
+        # so newest-wins comparisons stay anchored to the ORIGINAL write
+        push_meta = {k: v for k, v in ds._response_meta(r).items()
+                     if k != "size"}
+        push_meta.setdefault("stored_at", meta.get("stored_at"))
+        ds._kv_put(spec, key, r.content, push_meta)
+
+    # -- background pump -----------------------------------------------------
+
+    def start(self) -> "XRegionReplicator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kt-fed-xregion")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sweep()
+            except Exception as e:  # noqa: BLE001 — the pump never dies
+                telemetry.add_event("fed.xregion_sweep_failed",
+                                    error=str(e)[:200])
+            self._stop.wait(self.interval_s)
+
+    def status(self) -> Dict[str, Any]:
+        return {"source": self.source,
+                "targets": {r: {"lag_s": round(self.lag_s.get(r, 0.0), 3)}
+                            for r in self.targets}}
+
+
+# ---------------------------------------------------------------------------
+# cross-region fallback reads (the checkpoint-resume half, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def fallback_commit(base_key: str, exclude: Optional[str] = None
+                    ) -> Optional[Tuple[Dict[str, int], str]]:
+    """Find ``base_key``'s commit marker in ANOTHER region's ring.
+
+    Walks every fed-declared region store (minus ``exclude`` — the ring
+    the caller already asked — and minus this process's own region when
+    tagged), quorum-reads each marker, and returns ``(marker, store
+    spec)`` for the NEWEST committed step found, or None. The read side
+    of the async tier: a resume in region B finds region A's last
+    *replicated* commit even with every node of A dark. Requires the
+    ``KT_FED_STORES`` topology; unfederated processes get None and keep
+    their exact single-region semantics (including "a dead store is an
+    error, not a fresh run")."""
+    from ..train import checkpoint as ckpt
+
+    best: Optional[Tuple[Dict[str, int], str]] = None
+    for region, spec in topology.fallback_store_specs(exclude).items():
+        try:
+            marker = ds.get_json(ckpt._marker_key(base_key),
+                                 store_url=spec, quorum=True)
+        except (_requests.RequestException, DataStoreError):
+            continue
+        if marker is None:
+            continue
+        try:
+            info = {"step": int(marker["step"]),
+                    "slot": int(marker["slot"])}
+        except (KeyError, TypeError, ValueError):
+            continue
+        if best is None or info["step"] > best[0]["step"]:
+            best = (info, spec)
+    if best is not None:
+        telemetry.add_event("fed.fallback_commit", key=base_key,
+                            step=best[0]["step"], origin=best[1][:120])
+    return best

@@ -1,0 +1,498 @@
+"""The global scheduler: N regional schedulers as leaves of one book.
+
+Singularity's core claim (arXiv:2202.07848) is that once
+checkpoint-preempt-resume is cheap — which PRs 6/8 made true inside a
+region — the scheduler itself can be *planet-scale*: workloads become
+region-mobile, so one global control plane can place, migrate, and
+recover them across regions. This module is that plane, deliberately
+thin: every region keeps its own PR 8 ``controller/scheduler.py``
+(admission queue, capacity book, preemption, durability) as the **leaf**,
+and the global layer only decides *which region* — from CapacityBook
+snapshots and measured ``kt_stage_seconds``-derived throughput scores
+that flow up on every heartbeat.
+
+The migrate-resume loop between regions is exactly the intra-region one,
+stretched: drain in region A (the leaf's SIGTERM-grace path commits a
+checkpoint through the marker protocol), release A's slots, re-admit in
+region B — where the workload's ranks restore from the last committed
+checkpoint (found via the cross-region replication tier or the fallback
+read in ``train/checkpoint.py``) and re-mesh to whatever width B granted
+via ``MeshSpec.shrink_to``. Region death (the ``RegionBook``'s
+Unreachable→Dead verdict) drives the same loop automatically, minus the
+drain nobody can deliver to a dead fleet — Nonuniform-Tensor-Parallelism's
+degrade-don't-die stance (arXiv:2504.06095) applied at region
+granularity: the job continues narrower/elsewhere rather than failing.
+
+Exclusivity across partitions is the :class:`~.lease.LeaseTable`'s epoch
+fence — see ``lease.py`` for why heartbeats alone cannot provide it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import telemetry
+from ..data_store import netpool
+from ..exceptions import DataStoreError
+from . import topology
+from .lease import LeaseTable
+from .regions import DEAD, RegionBook
+
+_HEARTBEATS = telemetry.counter(
+    "kt_fed_heartbeats_total",
+    "Leaf heartbeat polls by region and outcome",
+    labels=("region", "outcome"))
+_MIGRATIONS = telemetry.counter(
+    "kt_fed_migrations_total",
+    "Cross-region migrate-and-resume runs by trigger",
+    labels=("reason", "outcome"))
+_PLACEMENTS = telemetry.gauge(
+    "kt_fed_placements", "Workloads currently placed in the region",
+    labels=("region",))
+
+
+def heartbeat_s() -> float:
+    """Leaf-poll cadence (``KT_FED_HEARTBEAT_S`` / config
+    ``fed_heartbeat_s`` — ISSUE 13 satellite: was destined to be a
+    hardcoded constant; config-lifted so chaos drills can compress
+    detection latency)."""
+    raw = os.environ.get("KT_FED_HEARTBEAT_S")
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    try:
+        from ..config import config
+        return float(config().get("fed_heartbeat_s", 2.0))
+    except Exception:
+        return 2.0
+
+
+class RegionLeaf:
+    """One region, as the global scheduler sees it. Four hooks:
+
+    - ``heartbeat()`` — liveness + the region's current CapacityBook
+      snapshot, queue depth, and throughput scores; raises when the
+      region is unreachable (that raise IS the liveness signal).
+    - ``place(workload, spec, epoch)`` — admit the workload in this
+      region, stamped with its fencing epoch; returns the leaf's verdict
+      (granted width etc.).
+    - ``drain(workload)`` — the cooperative preempt half of a migration:
+      SIGTERM-grace the workload's pods so the in-flight step commits a
+      checkpoint; returns the committed step when known.
+    - ``release(workload)`` — free the region's slots/queue entry.
+    """
+
+    name: str = "region"
+
+    def heartbeat(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def place(self, workload: str, spec: Dict[str, Any],
+              epoch: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def drain(self, workload: str) -> Optional[int]:
+        raise NotImplementedError
+
+    def release(self, workload: str) -> None:
+        raise NotImplementedError
+
+
+class LocalRegionLeaf(RegionLeaf):
+    """In-process leaf for tests, benches, and the chaos drill: capacity
+    is a plain ``{device_class: free}`` dict (or a callable returning the
+    heartbeat payload), and the placement hooks are injectable callables
+    (the drill's ``place`` spawns a real trainer subprocess)."""
+
+    def __init__(self, name: str,
+                 capacity: Optional[Dict[str, int]] = None,
+                 throughput: Optional[Dict[str, float]] = None,
+                 heartbeat_fn: Optional[Callable[[], Dict]] = None,
+                 place_fn: Optional[Callable[..., Dict]] = None,
+                 drain_fn: Optional[Callable[[str], Optional[int]]] = None,
+                 release_fn: Optional[Callable[[str], None]] = None):
+        self.name = name
+        self.capacity = dict(capacity or {})
+        self.throughput = dict(throughput or {})
+        self._heartbeat_fn = heartbeat_fn
+        self._place_fn = place_fn
+        self._drain_fn = drain_fn
+        self._release_fn = release_fn
+        self.placed: Dict[str, Dict[str, Any]] = {}
+
+    def heartbeat(self) -> Dict[str, Any]:
+        if self._heartbeat_fn is not None:
+            return self._heartbeat_fn()
+        return {"capacity": {c: {"free": f}
+                             for c, f in self.capacity.items()},
+                "queue_depth": 0, "throughput": dict(self.throughput)}
+
+    def place(self, workload: str, spec: Dict[str, Any],
+              epoch: int) -> Dict[str, Any]:
+        if self._place_fn is not None:
+            result = self._place_fn(workload, spec, epoch) or {}
+        else:
+            result = {"placed": True}
+        self.placed[workload] = {"spec": dict(spec), "epoch": epoch}
+        width = int(spec.get("width", 1))
+        cls = spec.get("device_class", "cpu")
+        if cls in self.capacity:
+            self.capacity[cls] = max(0, self.capacity[cls] - width)
+        return result
+
+    def drain(self, workload: str) -> Optional[int]:
+        if self._drain_fn is not None:
+            return self._drain_fn(workload)
+        return None
+
+    def release(self, workload: str) -> None:
+        entry = self.placed.pop(workload, None)
+        if entry and entry["spec"].get("device_class") in self.capacity:
+            self.capacity[entry["spec"]["device_class"]] += \
+                int(entry["spec"].get("width", 1))
+        if self._release_fn is not None:
+            self._release_fn(workload)
+
+
+class HttpRegionLeaf(RegionLeaf):
+    """A real regional controller as a leaf. Heartbeats ride the
+    controller's existing ``GET /controller/queue`` surface (the PR 8
+    ``Scheduler.snapshot()`` — capacity book, queue, and the measured
+    throughput EWMAs it now exports); placement/release map onto the
+    deploy/delete endpoints, with the fencing epoch carried in the
+    record's scheduling block so the leaf can echo it back to
+    ``GlobalScheduler.confirm``."""
+
+    def __init__(self, name: str, url: str, namespace: str = "default"):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.namespace = namespace
+
+    def heartbeat(self) -> Dict[str, Any]:
+        r = netpool.request("GET", f"{self.url}/controller/queue",
+                            timeout=netpool.store_timeout(10))
+        if r.status_code != 200:
+            raise DataStoreError(
+                f"region {self.name}: /controller/queue → {r.status_code}")
+        snap = r.json()
+        cap = (snap.get("capacity") or {}).get("classes") or {}
+        return {"capacity": cap,
+                "queue_depth": len(snap.get("queue") or []),
+                "throughput": snap.get("throughput") or {},
+                "policy": snap.get("policy")}
+
+    def place(self, workload: str, spec: Dict[str, Any],
+              epoch: int) -> Dict[str, Any]:
+        record = dict(spec.get("record") or {})
+        record.setdefault("namespace", self.namespace)
+        record.setdefault("name", workload.rsplit("/", 1)[-1])
+        sched = dict(record.get("scheduling") or {})
+        sched["fed_epoch"] = epoch
+        sched["fed_region"] = self.name
+        record["scheduling"] = sched
+        r = netpool.request("POST", f"{self.url}/controller/deploy",
+                            json=record,
+                            timeout=netpool.store_timeout(60))
+        if r.status_code != 200:
+            raise DataStoreError(
+                f"region {self.name}: deploy {workload!r} → "
+                f"{r.status_code} {r.text[:200]}")
+        return r.json()
+
+    def drain(self, workload: str) -> Optional[int]:
+        # the leaf's delete path routes through Scheduler.release → the
+        # cooperative SIGTERM-grace drain; the committed step surfaces in
+        # the workload's own checkpoint marker, not this response
+        self.release(workload)
+        return None
+
+    def release(self, workload: str) -> None:
+        ns, _, name = workload.rpartition("/")
+        r = netpool.request(
+            "DELETE",
+            f"{self.url}/controller/workload/{ns or self.namespace}/{name}",
+            timeout=netpool.store_timeout(30))
+        if r.status_code not in (200, 404):
+            raise DataStoreError(
+                f"region {self.name}: release {workload!r} → "
+                f"{r.status_code}")
+
+
+class GlobalScheduler:
+    """The control plane over the leaves: heartbeat-fed region book,
+    lease-fenced placement map, and the automatic migrate-and-resume that
+    fires when a region goes Dead. In-memory by design — it is
+    reconstructible from the leaves' durable state (each regional
+    scheduler persists its own book), and a restarted global scheduler
+    re-learns the world on its first heartbeat round."""
+
+    def __init__(self, leaves: List[RegionLeaf],
+                 ttl_s: Optional[float] = None,
+                 heartbeat_interval_s: Optional[float] = None,
+                 replicator=None):
+        self.leaves: Dict[str, RegionLeaf] = {lf.name: lf for lf in leaves}
+        self.book = RegionBook(list(self.leaves), ttl_s=ttl_s)
+        self.leases = LeaseTable()
+        self.interval_s = (heartbeat_interval_s
+                           if heartbeat_interval_s is not None
+                           else heartbeat_s())
+        self.replicator = replicator
+        self.snapshots: Dict[str, Dict[str, Any]] = {}
+        # last state each region was SEEN in — death is declared by TTL
+        # expiry between polls, so "newly dead" is a comparison against
+        # this, not against the pre-poll instant
+        self._seen_state: Dict[str, str] = {}
+        # workload → {"region", "epoch", "spec", "migrations"}
+        self.placements: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- heartbeats -----------------------------------------------------------
+
+    def heartbeat_once(self) -> Dict[str, str]:
+        """One poll round over every leaf; returns {region: state}. A leaf
+        whose heartbeat raises is marked failed; a region crossing into
+        Dead triggers migration of everything it held."""
+        newly_dead: List[str] = []
+        for name, leaf in self.leaves.items():
+            try:
+                snap = leaf.heartbeat()
+            except Exception as e:  # noqa: BLE001 — the raise IS the signal
+                self.book.mark_failure(name)
+                _HEARTBEATS.inc(region=name, outcome="failed")
+                telemetry.add_event("fed.heartbeat_failed", region=name,
+                                    error=str(e)[:160])
+            else:
+                self.book.mark_ok(name)
+                self.snapshots[name] = snap
+                _HEARTBEATS.inc(region=name, outcome="ok")
+            state = self.book.state(name)
+            if state == DEAD and self._seen_state.get(name) != DEAD:
+                newly_dead.append(name)
+            self._seen_state[name] = state
+        for name in newly_dead:
+            self._migrate_from(name, reason="region_death")
+        return {name: self.book.state(name) for name in self.leaves}
+
+    def start(self) -> "GlobalScheduler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kt-fed-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.heartbeat_once()
+            except Exception as e:  # noqa: BLE001
+                telemetry.add_event("fed.heartbeat_loop_error",
+                                    error=str(e)[:200])
+            self._stop.wait(self.interval_s)
+
+    # -- placement ------------------------------------------------------------
+
+    def _free_width(self, region: str, device_class: str) -> Optional[int]:
+        cap = (self.snapshots.get(region) or {}).get("capacity") or {}
+        entry = cap.get(device_class)
+        if entry is None:
+            # class absent from a limited snapshot ⇒ 0; no snapshot yet
+            # (or pass-through book) ⇒ unlimited
+            return 0 if cap else None
+        free = entry.get("free")
+        return None if free is None else int(free)
+
+    def _throughput(self, region: str, workload: str,
+                    device_class: str) -> float:
+        tp = (self.snapshots.get(region) or {}).get("throughput") or {}
+        by_class = tp.get(workload) or {}
+        try:
+            return float(by_class.get(device_class, 0.0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    def choose_region(self, workload: str,
+                      spec: Dict[str, Any]) -> Optional[str]:
+        """Best ALIVE region for the demand: regions that fit at full
+        width outrank ones that do not; ties break on measured throughput
+        for this workload (the ``kt_stage_seconds`` scores flowing up on
+        heartbeats), then on absolute free capacity."""
+        device_class = spec.get("device_class", "cpu")
+        width = int(spec.get("width", 1))
+        best, best_key = None, None
+        for region in self.book.alive_regions():
+            free = self._free_width(region, device_class)
+            fits = free is None or free >= width
+            if free is not None and free <= 0:
+                continue
+            key = (1 if fits else 0,
+                   self._throughput(region, workload, device_class),
+                   free if free is not None else float("inf"))
+            if best_key is None or key > best_key:
+                best, best_key = region, key
+        return best
+
+    def place(self, workload: str, spec: Dict[str, Any],
+              region: Optional[str] = None) -> Dict[str, Any]:
+        """Admit a workload somewhere: choose (or honor) a region, grant
+        the fencing lease, and hand the placement to the leaf. Returns
+        ``{"region", "epoch", **leaf verdict}``."""
+        with self._lock:
+            target = region or self.choose_region(workload, spec)
+            if target is None or not self.book.alive(target):
+                raise DataStoreError(
+                    f"no alive region can place {workload!r} "
+                    f"({spec.get('device_class', 'cpu')}"
+                    f"×{spec.get('width', 1)})")
+            epoch = self.leases.grant(workload, target)
+            result = self.leaves[target].place(workload, spec, epoch)
+            prev = self.placements.get(workload)
+            self.placements[workload] = {
+                "region": target, "epoch": epoch, "spec": dict(spec),
+                "migrations": (prev or {}).get("migrations", 0),
+                "placed_at": time.time()}
+            self._update_placement_gauges()
+            telemetry.add_event("fed.place", workload=workload,
+                                region=target, epoch=epoch)
+            return {"region": target, "epoch": epoch, **(result or {})}
+
+    def confirm(self, workload: str, region: str, epoch: int) -> None:
+        """The fencing gate regional controllers call before activating
+        (or continuing to act on) a placement — raises a typed
+        :class:`~kubetorch_tpu.exceptions.StaleLeaseError` when the lease
+        moved on (see ``lease.py``)."""
+        self.leases.validate(workload, region, epoch)
+
+    def release(self, workload: str) -> None:
+        with self._lock:
+            entry = self.placements.pop(workload, None)
+            self.leases.revoke(workload)
+            if entry is not None:
+                leaf = self.leaves.get(entry["region"])
+                if leaf is not None and self.book.usable(entry["region"]):
+                    try:
+                        leaf.release(workload)
+                    except Exception:  # noqa: BLE001 — region may be dying
+                        pass
+            self._update_placement_gauges()
+
+    # -- migrate-and-resume ---------------------------------------------------
+
+    def migrate(self, workload: str, reason: str = "operator",
+                target: Optional[str] = None) -> Dict[str, Any]:
+        """Move one placement between regions via the checkpoint loop:
+        drain in the source (when it is still reachable — a Dead region
+        gets no goodbye), release its slots, grant a NEW lease epoch
+        (fencing off every pod the old region may still be running), and
+        re-admit in the target. The workload's own restore path finds the
+        last committed checkpoint through the replication tier /
+        cross-region fallback read."""
+        with self._lock:
+            entry = self.placements.get(workload)
+            if entry is None:
+                raise KeyError(f"no placement for {workload!r}")
+            source = entry["region"]
+            spec = dict(entry["spec"])
+            committed: Optional[int] = None
+            src_leaf = self.leaves.get(source)
+            if src_leaf is not None and self.book.usable(source):
+                with telemetry.span("fed.drain", workload=workload,
+                                    region=source):
+                    try:
+                        committed = src_leaf.drain(workload)
+                    except Exception:  # noqa: BLE001 — mid-death drains fail
+                        pass
+            candidates = [r for r in self.book.alive_regions()
+                          if r != source]
+            dest = target if target is not None \
+                else self.choose_region(workload, spec)
+            if dest == source or dest is None \
+                    or not self.book.alive(dest):
+                dest = candidates[0] if candidates else None
+            if dest is None:
+                _MIGRATIONS.inc(reason=reason, outcome="failed")
+                raise DataStoreError(
+                    f"no surviving region to migrate {workload!r} to")
+            epoch = self.leases.grant(workload, dest)
+            with telemetry.span("fed.migrate", workload=workload,
+                                source=source, dest=dest, epoch=epoch,
+                                reason=reason):
+                result = self.leaves[dest].place(workload, spec, epoch)
+            self.placements[workload] = {
+                "region": dest, "epoch": epoch, "spec": spec,
+                "migrations": entry.get("migrations", 0) + 1,
+                "migrated_from": source, "placed_at": time.time(),
+                "committed_step": committed}
+            self._update_placement_gauges()
+            _MIGRATIONS.inc(reason=reason, outcome="ok")
+            telemetry.add_event("fed.migrate", workload=workload,
+                                source=source, dest=dest, epoch=epoch,
+                                reason=reason)
+            return {"region": dest, "epoch": epoch,
+                    "committed_step": committed, **(result or {})}
+
+    def _migrate_from(self, region: str, reason: str) -> None:
+        victims = [w for w, e in self.placements.items()
+                   if e["region"] == region]
+        for workload in victims:
+            try:
+                self.migrate(workload, reason=reason)
+            except Exception as e:  # noqa: BLE001 — keep migrating the rest
+                telemetry.add_event("fed.migrate_failed",
+                                    workload=workload, error=str(e)[:160])
+
+    def _update_placement_gauges(self) -> None:
+        counts: Dict[str, int] = {r: 0 for r in self.leaves}
+        for entry in self.placements.values():
+            counts[entry["region"]] = counts.get(entry["region"], 0) + 1
+        for region, n in counts.items():
+            _PLACEMENTS.set(float(n), region=region)
+
+    # -- surfacing ------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The ``kt fleet status`` payload: per-region taxonomy + book
+        snapshot + queue depth + replication lag, and the global
+        placement/lease map."""
+        regions: Dict[str, Any] = {}
+        liveness = self.book.status()
+        repl = self.replicator.status() if self.replicator else None
+        for name in self.leaves:
+            snap = self.snapshots.get(name) or {}
+            regions[name] = {
+                **liveness.get(name, {"state": "Alive"}),
+                "capacity": snap.get("capacity"),
+                "queue_depth": snap.get("queue_depth"),
+            }
+            if repl and name in (repl.get("targets") or {}):
+                regions[name]["xregion_lag_s"] = \
+                    repl["targets"][name]["lag_s"]
+        return {
+            "regions": regions,
+            "placements": {w: {k: v for k, v in e.items() if k != "spec"}
+                           for w, e in self.placements.items()},
+            "leases": self.leases.snapshot(),
+            "heartbeat_s": self.interval_s,
+            "region_ttl_s": self.book.ttl_s,
+        }
+
+
+def leaves_from_topology(namespace: str = "default") -> List[HttpRegionLeaf]:
+    """HTTP leaves for every region named in ``KT_FED_REGIONS`` — the
+    zero-config way a coordinator process builds its world."""
+    return [HttpRegionLeaf(name, url, namespace=namespace)
+            for name, url in topology.fed_regions().items()]

@@ -1,0 +1,188 @@
+"""A CPU-proxy serving region: one subprocess = one region's front door.
+
+The bench/drill stand-in for "a region full of serving pods": an aiohttp
+gateway that drives the REAL ``serving/router.py`` — actual admission,
+deadline shedding, affinity, slot packing — over an in-process fleet of
+simulated decode engines (slot-limited, prefill ∝ uncached prompt tokens
+with an LRU prefix cache, decode ∝ generated tokens: the same replica
+model ``scripts/bench_serve.py`` calibrated in PR 9). What is fake is
+only the arithmetic the device would do; every control-plane behavior the
+federation layer depends on — typed 429/504 bodies, mid-request death
+under ``kill-region``, queue growth under burst — is the production code
+path.
+
+Run one per region::
+
+    python -m kubetorch_tpu.federation.sim_region \
+        --port 8931 --region iowa --replicas 4 --slots 8
+
+Surface:
+
+- ``POST /generate``  {"prompt_len": int, "new_tokens": int} + the usual
+  headers (``X-KT-Session``/``X-KT-Deadline``/``X-KT-Priority``) →
+  ``{"region", "replica", "ttft_s", "service_s", "tokens"}``; typed
+  ``AdmissionShedError`` → 429 and ``DeadlineExceededError`` → 504 with
+  packaged bodies the geo front door rehydrates.
+- ``GET /health``     {"region", "router": Router.state_dict()}.
+
+``KT_CHAOS`` arms the standard middleware (the ``kill-region`` drill
+SIGKILLs the gateway mid-``/generate``, exactly like a real pod).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from .. import telemetry
+from ..chaos import maybe_chaos_middleware
+from ..constants import SESSION_HEADER
+from ..exceptions import (AdmissionShedError, DeadlineExceededError,
+                          package_exception)
+from ..serving.router import Router
+
+
+class SimEngine:
+    """One simulated serving pod (see ``scripts/bench_serve.py``'s
+    SimReplica — this is the same model, packaged for the region
+    gateway)."""
+
+    def __init__(self, ip: str, slots: int, prefill_s_per_tok: float,
+                 decode_s_per_tok: float, resident_cap: int = 256):
+        self.ip = ip
+        self.slots = slots
+        self.prefill_s_per_tok = prefill_s_per_tok
+        self.decode_s_per_tok = decode_s_per_tok
+        self._slots = asyncio.Semaphore(slots)
+        self.resident: "OrderedDict[str, int]" = OrderedDict()
+        self.resident_cap = resident_cap
+        self.tokens = 0
+
+    async def serve(self, session: Optional[str], prompt_len: int,
+                    new_tokens: int) -> Dict[str, float]:
+        t0 = time.monotonic()
+        async with self._slots:
+            cached = self.resident.get(session, 0) if session else 0
+            if cached:
+                self.resident.move_to_end(session)
+            suffix = max(prompt_len - cached, 1)
+            await asyncio.sleep(suffix * self.prefill_s_per_tok
+                                + self.decode_s_per_tok)
+            ttft_s = time.monotonic() - t0
+            await asyncio.sleep(max(new_tokens - 1, 0)
+                                * self.decode_s_per_tok)
+            if session:
+                self.resident.pop(session, None)
+                self.resident[session] = prompt_len
+                while len(self.resident) > self.resident_cap:
+                    self.resident.popitem(last=False)
+            self.tokens += new_tokens
+            return {"ttft_s": round(ttft_s, 6),
+                    "service_s": round(time.monotonic() - t0, 6),
+                    "tokens": new_tokens}
+
+
+class _SimPool:
+    """The transport surface ``Router.dispatch`` expects, over the
+    in-process engines."""
+
+    def __init__(self, engines: Dict[str, SimEngine]):
+        self.engines = engines
+
+    async def check_health(self, ip: str, timeout: float = 2.0) -> bool:
+        return ip in self.engines
+
+    async def call_worker(self, ip, fn_name, method, body, headers,
+                          timeout=None, subtree=None, sel_ips=None):
+        kw = body["kwargs"]
+        session = (headers or {}).get(SESSION_HEADER)
+        out = await self.engines[ip].serve(
+            session, int(kw["prompt_len"]), int(kw["new_tokens"]))
+        return {**out, "replica": ip}
+
+
+def create_sim_region_app(region: str, replicas: int = 4, slots: int = 8,
+                          prefill_us_per_tok: float = 400.0,
+                          decode_us_per_tok: float = 1500.0,
+                          queue_max: int = 256):
+    from aiohttp import web
+
+    ips = [f"sim-{region}-{i}" for i in range(replicas)]
+    engines = {ip: SimEngine(ip, slots, prefill_us_per_tok / 1e6,
+                             decode_us_per_tok / 1e6) for ip in ips}
+    pool = _SimPool(engines)
+    router = Router(fn_name="generate", slots_per_replica=slots,
+                    queue_max=queue_max, health_ttl_s=5.0)
+
+    async def local_call(method, args, kwargs, timeout):
+        raise RuntimeError("the region gateway is not a replica")
+
+    async def generate(request: web.Request) -> web.Response:
+        payload = await request.json()
+        headers = {k: v for k, v in request.headers.items()}
+        try:
+            out = await router.dispatch(
+                pool=pool, ips=ips, my_ip="__gateway__", method=None,
+                args=[], kwargs=dict(payload), headers=headers,
+                timeout=None, local_call=local_call)
+        except AdmissionShedError as e:
+            hdrs = {}
+            if e.retry_after is not None:
+                hdrs["Retry-After"] = f"{e.retry_after:g}"
+            return web.json_response(package_exception(e), status=429,
+                                     headers=hdrs)
+        except DeadlineExceededError as e:
+            return web.json_response(package_exception(e), status=504)
+        return web.json_response({"region": region, **out})
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"region": region, "replicas": len(ips),
+                                  "router": router.state_dict()})
+
+    middlewares = []
+    chaos_mw, chaos_engine = maybe_chaos_middleware()
+    if chaos_mw is not None:
+        middlewares.append(chaos_mw)
+    app = web.Application(middlewares=middlewares)
+    app["region"] = region
+    app["router"] = router
+    if chaos_engine is not None:
+        app["chaos"] = chaos_engine
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/health", health)
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=telemetry.REGISTRY.render(),
+                            content_type="text/plain")
+
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None) -> int:
+    from aiohttp import web
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--region", default=os.environ.get("KT_REGION", "local"))
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--prefill-us-per-tok", type=float, default=400.0)
+    p.add_argument("--decode-us-per-tok", type=float, default=1500.0)
+    p.add_argument("--queue-max", type=int, default=256)
+    args = p.parse_args(argv)
+    app = create_sim_region_app(
+        args.region, replicas=args.replicas, slots=args.slots,
+        prefill_us_per_tok=args.prefill_us_per_tok,
+        decode_us_per_tok=args.decode_us_per_tok,
+        queue_max=args.queue_max)
+    web.run_app(app, host="127.0.0.1", port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

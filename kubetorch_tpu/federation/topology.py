@@ -1,0 +1,103 @@
+"""Federation topology: which regions exist, and where their doors are.
+
+The ONLY module in the package tree allowed to read the ``KT_FED_*``
+environment (the 12th ``check_resilience`` lint pins this): a call site
+that parses ``KT_FED_REGIONS`` itself builds a private region map that
+silently diverges from the one the global scheduler, the replication
+tier, the geo front door, and ``kt fleet status`` all share — the
+cross-region twin of the single-origin-URL bug the ring lint exists for.
+
+Three env surfaces, all optional (unset ⇒ the process is single-region
+and every federation feature is a no-op):
+
+- ``KT_FED_REGIONS``  — ``name=controller_url`` pairs, comma-separated:
+  ``"iowa=http://10.0.0.1:8080,oregon=http://10.1.0.1:8080"``. Names the
+  regions and their controller front doors (each one a PR 8 scheduler
+  leaf).
+- ``KT_FED_STORES``   — ``name=url|url`` pairs (``|`` separates a
+  region's ring members so ``,`` can keep separating regions):
+  ``"iowa=http://s1|http://s2,oregon=http://s3"``. Each value is a
+  region's store-ring membership; :func:`store_spec` renders it as the
+  comma-joined explicit-fleet seed ``data_store/ring.py`` routes on.
+- ``KT_FED_SELF_REGION`` — which region THIS process lives in (falls
+  back to the generic ``KT_REGION`` tag the chaos verbs scope by), so
+  fallback reads skip the local ring they just failed against.
+
+Heartbeat cadence and the Unreachable→Dead TTL ride the config plane
+(``fed_heartbeat_s`` / ``fed_region_ttl_s`` + their ``KT_`` envs, layered
+by ``config.py`` like every other knob).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+REGIONS_ENV = "KT_FED_REGIONS"
+STORES_ENV = "KT_FED_STORES"
+SELF_REGION_ENV = "KT_FED_SELF_REGION"
+
+
+def _parse_map(raw: Optional[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for token in (raw or "").split(","):
+        token = token.strip()
+        if not token or "=" not in token:
+            continue
+        name, _, value = token.partition("=")
+        name, value = name.strip(), value.strip()
+        if name and value:
+            out[name] = value
+    return out
+
+
+def fed_regions() -> Dict[str, str]:
+    """``{region name → controller base URL}`` from ``KT_FED_REGIONS``;
+    empty when unfederated."""
+    return _parse_map(os.environ.get(REGIONS_ENV))
+
+
+def fed_stores() -> Dict[str, List[str]]:
+    """``{region name → [store node URLs]}`` from ``KT_FED_STORES``."""
+    return {name: [u.strip().rstrip("/") for u in value.split("|")
+                   if u.strip()]
+            for name, value in _parse_map(
+                os.environ.get(STORES_ENV)).items()}
+
+
+def store_spec(region: str) -> Optional[str]:
+    """The explicit-fleet seed (comma-joined node URLs) for ``region``'s
+    store ring — the form ``ring.ring_for`` routes over WITHOUT mixing in
+    the local ``KT_STORE_NODES`` fleet. None when the region has no
+    declared stores."""
+    nodes = fed_stores().get(region)
+    return ",".join(nodes) if nodes else None
+
+
+def self_region() -> Optional[str]:
+    """This process's region (``KT_FED_SELF_REGION``, falling back to the
+    ``KT_REGION`` chaos tag)."""
+    return (os.environ.get(SELF_REGION_ENV)
+            or os.environ.get("KT_REGION") or "").strip() or None
+
+
+def fallback_store_specs(exclude: Optional[str] = None) -> Dict[str, str]:
+    """Every OTHER region's store-ring seed, for cross-region fallback
+    reads: the declared fleets minus ``exclude`` (a region name or a
+    store spec/URL) and minus this process's own region."""
+    mine = self_region()
+    out: Dict[str, str] = {}
+    excluded_urls = {u.strip().rstrip("/")
+                     for u in (exclude or "").split(",") if u.strip()}
+    for region, nodes in fed_stores().items():
+        if region == exclude or region == mine:
+            continue
+        if excluded_urls and excluded_urls.intersection(nodes):
+            continue
+        if nodes:
+            out[region] = ",".join(nodes)
+    return out
+
+
+def federated() -> bool:
+    return bool(fed_regions() or fed_stores())

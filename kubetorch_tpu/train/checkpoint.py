@@ -252,7 +252,8 @@ def commit_info(base_key: str, store_url: Optional[str] = None
                 ) -> Optional[Dict[str, int]]:
     """The committed-checkpoint marker: ``{"step": n, "slot": k}``, or None
     when no checkpoint has ever been committed under ``base_key`` (a torn
-    first upload counts as never).
+    first upload counts as never. Federated processes additionally fall
+    back cross-region — see :func:`commit_info_ex`).
 
     ``peer=False`` throughout this module: the P2P pod cache is keyed by
     name and assumes immutable keys, while the marker and slot keys are
@@ -266,19 +267,59 @@ def commit_info(base_key: str, store_url: Optional[str] = None
     never roll a resume back to an older step. Markers written by
     pre-ring builds (a tiny pytree rather than a JSON value) still load
     via the legacy fallback."""
-    marker = ds.get_json(_marker_key(base_key), store_url=store_url,
-                         quorum=True)
-    if marker is None:
-        # legacy pytree marker (pre-ring checkpoints)
-        try:
-            marker = ds.get(_marker_key(base_key), store_url=store_url,
-                            peer=False)
-        except DataStoreError:
-            return None
+    info, _origin = commit_info_ex(base_key, store_url=store_url)
+    return info
+
+
+def commit_info_ex(base_key: str, store_url: Optional[str] = None
+                   ) -> Tuple[Optional[Dict[str, int]], Optional[str]]:
+    """:func:`commit_info` plus the origin that actually answered.
+
+    The cross-region fallback read (ISSUE 13): when the configured ring
+    has no marker — because the workload just migrated and its local ring
+    never held this job, or because its home region's fleet is dark — and
+    a federation store topology is declared (``KT_FED_STORES``), the
+    OTHER regions' rings are quorum-read through
+    ``federation.replication.fallback_commit`` and the newest committed
+    step wins. Returns ``(marker, origin store spec)``; origin None means
+    the configured/default ring answered (or nothing did). Unfederated
+    processes keep their exact single-region semantics, including "a dead
+    store raises, it does not mean a fresh run"."""
+    local_error: Optional[BaseException] = None
+    marker = None
     try:
-        return {"step": int(marker["step"]), "slot": int(marker["slot"])}
-    except (KeyError, TypeError, ValueError):
-        return None               # unreadable marker == no commit
+        marker = ds.get_json(_marker_key(base_key), store_url=store_url,
+                             quorum=True)
+        if marker is None:
+            # legacy pytree marker (pre-ring checkpoints)
+            try:
+                marker = ds.get(_marker_key(base_key), store_url=store_url,
+                                peer=False)
+            except DataStoreError:
+                marker = None
+    except Exception as e:  # noqa: BLE001 — ring unreachable / region dead
+        local_error = e
+        marker = None
+    info: Optional[Dict[str, int]] = None
+    if marker is not None:
+        try:
+            info = {"step": int(marker["step"]),
+                    "slot": int(marker["slot"])}
+        except (KeyError, TypeError, ValueError):
+            info = None           # unreadable marker == no commit
+    if info is not None:
+        return info, None
+    from ..federation import replication as _fed_rep
+    from ..federation import topology as _fed_topo
+    if _fed_topo.federated():
+        fb = _fed_rep.fallback_commit(base_key, exclude=store_url)
+        if fb is not None:
+            return fb[0], fb[1]
+    if local_error is not None:
+        # nothing answered anywhere: surface the truthful transport error
+        # rather than a None that reads as "start from step 0"
+        raise local_error
+    return None, None
 
 
 class Checkpointer:
@@ -404,15 +445,25 @@ class Checkpointer:
         """(tree, step) from the last *committed* checkpoint, resharded
         onto ``mesh`` per ``rules`` when given — the device-count-agnostic
         load path: the same call restores onto the original N-rank mesh or
-        the post-loss (N-1)-rank one. None when nothing is committed."""
-        info = self.committed()
+        the post-loss (N-1)-rank one. None when nothing is committed.
+
+        Cross-region fallback (ISSUE 13): when the marker was found on
+        ANOTHER region's ring (see :func:`commit_info_ex`), the slot is
+        fetched from that same origin — a resume in region B after region
+        A's death restores the last checkpoint the async replication tier
+        delivered, marker and slot from one consistent source."""
+        info, origin = commit_info_ex(self.base_key,
+                                      store_url=self.store_url)
         if info is None:
             return None
+        source = origin if origin is not None else self.store_url
         t0 = time.monotonic()
         with telemetry.span("checkpoint.restore", key=self.base_key,
-                            step=info["step"], slot=info["slot"]):
+                            step=info["step"], slot=info["slot"],
+                            **({"xregion_origin": origin[:120]}
+                               if origin else {})):
             tree = ds.get(_slot_key(self.base_key, info["slot"]),
-                          store_url=self.store_url, mesh=mesh, rules=rules,
+                          store_url=source, mesh=mesh, rules=rules,
                           sharding=sharding, peer=False)
         _CKPT_SECONDS.observe(time.monotonic() - t0, op="restore")
         self._slot = info["slot"]

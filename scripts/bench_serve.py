@@ -228,8 +228,163 @@ async def _run_policy(policy: str, plan: List[Dict], args) -> Dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# --regions: cross-region failover + spillover TTFT (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_region(region: str, port: int, args) -> "subprocess.Popen":
+    import subprocess
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["KT_REGION"] = region
+    env.pop("KT_CHAOS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.federation.sim_region",
+         "--port", str(port), "--region", region,
+         "--replicas", str(args.replicas), "--slots", str(args.slots),
+         "--prefill-us-per-tok", str(args.prefill_us_per_tok),
+         "--decode-us-per-tok", str(args.decode_us_per_tok),
+         "--queue-max", str(args.queue_max)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+async def _run_regions(plan: List[Dict], args) -> Dict:
+    """Open-loop traffic through the REAL GeoFrontDoor over N subprocess
+    CPU-proxy regions; region 0 (the client's local region) is SIGKILLed
+    mid-run. Measures failover time (last pre-kill success in the dead
+    region → first spilled success in a survivor), spillover TTFT, and
+    the typed-vs-raw shed split (raw must be 0)."""
+    import signal as signal_mod
+    import subprocess  # noqa: F401  (type for _spawn_region)
+
+    from kubetorch_tpu.federation import (GeoFrontDoor, HttpRegionTarget,
+                                          RegionBook)
+    from kubetorch_tpu.utils.procs import free_port, wait_for_port
+
+    names = [f"region-{i}" for i in range(args.regions)]
+    ports = [free_port() for _ in names]
+    procs = {n: _spawn_region(n, p, args) for n, p in zip(names, ports)}
+    for n, p in zip(names, ports):
+        assert wait_for_port("127.0.0.1", p, timeout=30), f"{n} not up"
+    door = GeoFrontDoor(
+        [HttpRegionTarget(n, f"http://127.0.0.1:{p}")
+         for n, p in zip(names, ports)],
+        local_region=names[0],
+        book=RegionBook(names, ttl_s=max(args.kill_at, 1.0)))
+
+    ttft_pre: List[float] = []
+    ttft_post: List[float] = []      # spillover: successes after the kill
+    shed: Dict[str, int] = {}
+    raw_errors = 0
+    by_region: Dict[str, int] = {}
+    marks = {"killed_at": None, "last_dead_ok": None, "first_spill_ok": None}
+
+    async def one(req: Dict, t0: float) -> None:
+        nonlocal raw_errors
+        arrival = t0 + req["at"]
+        delay = arrival - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        headers = {SESSION_HEADER: req["session"]}
+        if args.deadline_s > 0:
+            headers[DEADLINE_HEADER] = f"{time.time() + args.deadline_s:.6f}"
+        try:
+            out = await door.dispatch(
+                {"prompt_len": req["prompt_len"],
+                 "new_tokens": req["new_tokens"]}, headers)
+        except (AdmissionShedError, DeadlineExceededError) as e:
+            reason = getattr(e, "reason", None) or "deadline_expired"
+            shed[reason] = shed.get(reason, 0) + 1
+            return
+        except Exception:  # noqa: BLE001 — the forbidden bucket
+            raw_errors += 1
+            return
+        now = time.monotonic()
+        region = out.get("region")
+        by_region[region] = by_region.get(region, 0) + 1
+        # client-observed TTFT: wall latency minus the decode tail the
+        # region reports (service_s - ttft_s)
+        ttft = (now - arrival) - (out["service_s"] - out["ttft_s"])
+        if marks["killed_at"] is None:
+            if region == names[0]:
+                marks["last_dead_ok"] = now
+            ttft_pre.append(ttft)
+        else:
+            if region != names[0] and marks["first_spill_ok"] is None:
+                marks["first_spill_ok"] = now
+            ttft_post.append(ttft)
+
+    async def killer(t0: float) -> None:
+        await asyncio.sleep(args.kill_at)
+        marks["killed_at"] = time.monotonic()
+        procs[names[0]].send_signal(signal_mod.SIGKILL)
+
+    t0 = time.monotonic()
+    try:
+        await asyncio.gather(killer(t0), *(one(r, t0) for r in plan))
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+    wall = time.monotonic() - t0
+    failover_s = None
+    if marks["first_spill_ok"] is not None:
+        anchor = marks["last_dead_ok"] or marks["killed_at"]
+        failover_s = marks["first_spill_ok"] - max(anchor,
+                                                   marks["killed_at"])
+    n_shed = sum(shed.values())
+    return {
+        "regions": args.regions,
+        "requests": len(plan),
+        "completed": len(ttft_pre) + len(ttft_post),
+        "by_region": by_region,
+        "shed_by_reason": shed,
+        "shed": n_shed,
+        "raw_errors": raw_errors,
+        "failover_s": round(failover_s, 3) if failover_s is not None
+        else None,
+        "ttft_pre_kill_p50_ms": round(_percentile(ttft_pre, 0.5) * 1000, 1),
+        "ttft_spill_p50_ms": round(_percentile(ttft_post, 0.5) * 1000, 1),
+        "ttft_spill_p99_ms": round(_percentile(ttft_post, 0.99) * 1000, 1),
+        "wall_s": round(wall, 2),
+        "door": door.state_dict(),
+    }
+
+
+def _regions_main(args) -> int:
+    plan = _schedule(args)
+    print(f"federation failover bench: {args.regions} subprocess regions x "
+          f"{args.replicas} replicas x {args.slots} slots, "
+          f"{len(plan)} open-loop requests, kill-region @ t="
+          f"{args.kill_at}s (SIGKILL {'region-0'})")
+    out = asyncio.run(_run_regions(plan, args))
+    print(f"\ncompleted {out['completed']}/{out['requests']} "
+          f"(by region: {out['by_region']}); typed shed {out['shed']} "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(out['shed_by_reason'].items())) or 'none'}); "
+          f"raw errors reaching the client: {out['raw_errors']}")
+    print(f"failover: {out['failover_s']}s from region death to the first "
+          f"spilled success; spillover ttft p50 {out['ttft_spill_p50_ms']}ms "
+          f"p99 {out['ttft_spill_p99_ms']}ms "
+          f"(pre-kill p50 {out['ttft_pre_kill_p50_ms']}ms)")
+    if out["raw_errors"]:
+        print("FAIL: raw connection errors reached the client — the geo "
+              "front door must shed typed only")
+    blob = {"metric": "fed_failover_s", "value": out["failover_s"],
+            "unit": "s", "detail": out}
+    print("\n" + json.dumps(blob))
+    return 1 if out["raw_errors"] else 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--regions", type=int, default=0,
+                   help="N>0: cross-region failover mode — N subprocess "
+                        "CPU-proxy regions behind the geo front door, "
+                        "region-0 SIGKILLed at --kill-at (ISSUE 13)")
+    p.add_argument("--kill-at", type=float, default=4.0,
+                   help="seconds into the run to SIGKILL region-0")
     p.add_argument("--sessions", type=int, default=1200)
     p.add_argument("--turns", type=int, default=3)
     p.add_argument("--replicas", type=int, default=8)
@@ -254,6 +409,19 @@ def main() -> int:
                    help="per-request X-KT-Deadline; 0 disables")
     p.add_argument("--seed", type=int, default=1234)
     args = p.parse_args()
+
+    if args.regions > 0:
+        # region mode defaults: a lighter schedule (every request crosses
+        # a real HTTP hop into a subprocess) unless explicitly overridden
+        if "--sessions" not in sys.argv:
+            args.sessions = 240
+        if "--turns" not in sys.argv:
+            args.turns = 2
+        if "--replicas" not in sys.argv:
+            args.replicas = 4
+        if "--spread-s" not in sys.argv:
+            args.spread_s = 10.0
+        return _regions_main(args)
 
     plan = _schedule(args)
     cap_rps = (args.replicas * args.slots

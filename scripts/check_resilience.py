@@ -237,6 +237,23 @@ PARAM_SWAP_RE = re.compile(
 PARAM_SWAP_EXEMPT = {"rollout.py"}
 PARAM_SWAP_BASELINE: dict = {}
 
+# Raw federation-topology reads outside federation/ (ISSUE 13).
+# federation/topology.py is the ONLY parser of the KT_FED_* environment
+# (region → controller map, region → store-fleet map, self-region): a
+# call site that reads KT_FED_REGIONS itself builds a private region map
+# that silently diverges from the one the global scheduler, the
+# replication tier, the geo front door, and `kt fleet status` share —
+# its cross-region dispatch then bypasses the lease fence, the region
+# book, and the typed-shed contract. The cross-region twin of the
+# single-origin-URL lint above. The baseline is EMPTY on purpose
+# (cli.py routes through federation.fleet_status; checkpoint.py's
+# fallback read imports federation.replication). The pattern matches
+# actual environment READS — docstrings/help text may still NAME the
+# envs for operators.
+FED_RE = re.compile(r"(?:environ|getenv)[^#\n]*KT_FED_")
+FED_EXEMPT_DIR = "federation"
+FED_BASELINE: dict = {}
+
 REPLACE_RE = re.compile(r"\bos\.replace\(")
 REPLACE_EXEMPT = {"durability.py"}
 REPLACE_BASELINE = {
@@ -381,6 +398,32 @@ def main() -> int:
               "and watchdog cleanup hold — a raw segment is a /dev/shm "
               "leak per worker generation. For deliberate exceptions "
               "update SHM_BASELINE with a justification.")
+        return 1
+
+    fed_failures = []
+    fed_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG))
+        if FED_EXEMPT_DIR in path.relative_to(PKG).parts:
+            continue
+        n = _count_matches(path, FED_RE)
+        if n:
+            fed_counts[rel] = n
+        allowed = FED_BASELINE.get(rel, 0)
+        if n > allowed:
+            fed_failures.append(
+                f"  {rel}: {n} raw KT_FED_* topology read(s), baseline "
+                f"allows {allowed}")
+    if fed_failures:
+        print("check_resilience: raw federation-topology reads bypass "
+              "federation/:\n" + "\n".join(fed_failures))
+        print("\nCross-region dispatch — region maps, store fleets, "
+              "fallback origins — belongs to kubetorch_tpu/federation/ "
+              "(topology.fed_regions/fed_stores, replication."
+              "fallback_commit, GeoFrontDoor, fleet_status) so the lease "
+              "fence, region book, and typed-shed contract apply. For "
+              "deliberate exceptions update FED_BASELINE with a "
+              "justification.")
         return 1
 
     origin_failures = []
@@ -543,6 +586,8 @@ def main() -> int:
            if alive_counts.get(f, 0) < allowed]
         + [f for f, allowed in ORIGIN_BASELINE.items()
            if origin_counts.get(f, 0) < allowed]
+        + [f for f, allowed in FED_BASELINE.items()
+           if fed_counts.get(f, 0) < allowed]
         + [f for f, allowed in SHM_BASELINE.items()
            if shm_counts.get(f, 0) < allowed]
         + [f for f, allowed in ROUTE_BASELINE.items()
@@ -567,10 +612,10 @@ def main() -> int:
     else:
         print("check_resilience: OK — all HTTP call sites, worker-liveness "
               "checks, replica selections, store-origin resolutions, "
-              "controller placements, data-store commit renames, "
-              "checkpoint writes, step-path device_get sites, "
-              "shared-memory segments, engine param-tree assignments, and "
-              "telemetry sites accounted for")
+              "federation-topology reads, controller placements, "
+              "data-store commit renames, checkpoint writes, step-path "
+              "device_get sites, shared-memory segments, engine "
+              "param-tree assignments, and telemetry sites accounted for")
     return 0
 
 

@@ -1,0 +1,118 @@
+"""A region-tagged training job for the federation chaos drill.
+
+Deterministic numpy "training" with the REAL ``Checkpointer`` (two-slot
+ping-pong + commit marker on the region's store ring): step ``s``
+transforms the state with a fixed recurrence, commits it, and appends a
+JSON line ``{"committed": s, "fingerprint": ...}`` to ``--result`` — the
+ledger the drill compares across regions.
+
+Region-death wiring:
+
+- ``KT_REGION`` + a ``kill-region[:STEP]@NAME`` token in ``KT_CHAOS``
+  arm the chaos plan (``chaos.region_kill_plan``): the trainer consults
+  it at the TOP of each step and, when the step index is in the plan,
+  SIGKILLs itself **mid-step** — after the previous step's commit, before
+  this one's. Zero committed steps are lost by construction; the drill
+  verifies that end to end.
+- ``--gate-step N --gate-file PATH`` parks the trainer after committing
+  step N until PATH exists — the drill's choreography point: it waits
+  for the cross-region replication pump to reach parity on commit N
+  before letting the doomed step begin.
+- ``--resume`` restores from the last committed checkpoint first
+  (cross-region fallback applies when ``KT_FED_STORES`` is set and the
+  configured ring is dark) and logs ``{"restored": step,
+  "fingerprint": ...}`` before continuing from there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from kubetorch_tpu import chaos  # noqa: E402
+from kubetorch_tpu.train.checkpoint import (Checkpointer,  # noqa: E402
+                                            tree_fingerprint)
+
+
+def initial_state() -> dict:
+    rng = np.random.default_rng(7)
+    return {"layers": {f"w{i}": rng.standard_normal(32).astype(np.float32)
+                       for i in range(4)},
+            "bias": np.zeros(8, dtype=np.float32)}
+
+
+def apply_step(state: dict, step: int) -> dict:
+    # a fixed, step-indexed recurrence: any two trainers that agree on
+    # the starting state and the step index produce bit-identical trees
+    out = {"layers": {}, "bias": state["bias"] + np.float32(step)}
+    for name, w in state["layers"].items():
+        out["layers"][name] = (w * np.float32(0.9)
+                               + np.float32(step) * np.float32(0.01))
+    return out
+
+
+def emit(path: str, record: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base-key", required=True)
+    p.add_argument("--store", required=True,
+                   help="store ring seed (URL or comma-joined fleet)")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--result", required=True)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--gate-step", type=int, default=-1)
+    p.add_argument("--gate-file", default=None)
+    p.add_argument("--step-sleep", type=float, default=0.05)
+    args = p.parse_args()
+
+    kill_plan = chaos.region_kill_plan()
+    ckpt = Checkpointer(args.base_key, store_url=args.store, every=1)
+    state = initial_state()
+    start = 0
+    if args.resume:
+        restored = ckpt.restore()
+        if restored is not None:
+            state, start = restored
+            emit(args.result, {"restored": start,
+                               "fingerprint": tree_fingerprint(state)})
+        else:
+            emit(args.result, {"restored": None})
+
+    for step in range(start + 1, args.steps + 1):
+        if step in kill_plan:
+            # mid-step death: the previous commit is the last committed
+            # state — the drill's zero-lost-committed-steps anchor
+            emit(args.result, {"dying_at_step": step})
+            os.kill(os.getpid(), kill_plan[step])
+        state = apply_step(state, step)
+        ckpt.save(state, step)
+        emit(args.result, {"committed": step,
+                           "fingerprint": tree_fingerprint(state)})
+        if step == args.gate_step and args.gate_file:
+            while not os.path.exists(args.gate_file):
+                time.sleep(0.05)
+        time.sleep(args.step_sleep)
+    emit(args.result, {"done": True, "final_step": args.steps,
+                       "fingerprint": tree_fingerprint(state)})
+    return 0
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    sys.exit(main())

@@ -36,6 +36,12 @@ _ATTR_SAMPLES = {
     "expected": "aa" * 20,
     "actual": "bb" * 20,
     "source": "peer",
+    # StaleLeaseError (ISSUE 13 federation lease fencing)
+    "workload": "ns/train-llama",
+    "region": "iowa",
+    "epoch": 3,
+    "current_epoch": 4,
+    "current_region": "oregon",
 }
 
 

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
+.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -17,6 +17,7 @@ PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 test:
 	$(PY_CPU) python -m pytest tests/ -q
 	$(PY_CPU) python scripts/check_perf_gate.py
+	$(MAKE) soak-smoke
 
 # fast default tier (<3 min): skips the jit-heavy pipeline/parallel/model
 # release matrix; run before every commit
@@ -67,6 +68,18 @@ test-federation:
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
+
+# seeded chaos-conductor soak (ISSUE 15). soak-smoke is the CI tier: a
+# fixed-seed ~60s store+train schedule whose invariant verdict gates
+# `make test`; `make soak` is the long operator run over every profile.
+soak-smoke:
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 6 --profile train
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 3 --profile store
+
+soak:
+	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 42 --duration 60 --profile all
+	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 43 --duration 60 --profile federation
+	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 44 --duration 60 --profile store
 
 # per-stage perf regression gate (ISSUE 9, expanded in 10–12): dispatch,
 # store, shm, rollout, train_step, and snapshot_stall p50 through the

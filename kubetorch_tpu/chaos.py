@@ -190,10 +190,128 @@ REGION_HOSTS_ENV = "KT_CHAOS_REGION_HOSTS"
 # refresh that absorbs faults.
 EXEMPT_PATHS = ("/health", "/ready", "/metrics", "/ring", "/scrub/status")
 
-_KINDS = ("delay", "status", "reset", "truncate", "oom", "evict", "preempt",
-          "pass", "disk-full", "corrupt-blob", "torn-write", "kill-rank",
-          "term-rank", "kill-store-node", "kill-peer", "shed",
-          "shm-corrupt", "kill-region", "partition")
+@dataclass(frozen=True)
+class VerbSpec:
+    """One chaos verb, introspectable: the soak-schedule generator, the
+    ``kt chaos verbs`` CLI, and the docs grammar table all enumerate THIS
+    registry instead of hand-maintaining parallel lists (which is how the
+    ``resilience.md`` table drifted from the parser before ISSUE 15)."""
+
+    name: str          # parser kind ("status" covers bare numeric tokens)
+    scope: str         # "http" | "store" | "process" | "ring" | "region"
+    grammar: str       # token shape, e.g. "kill-store-node[:SIG]@OP_INDEX"
+    consumer: str      # where the verb fires (middleware, worker loop, ...)
+    methods: tuple     # HTTP methods it is method-aware about; () = all
+    summary: str       # one line for operators
+    example: str       # a token parse_spec() accepts verbatim
+    process_fatal: bool = False   # the faulted process dies (SIGKILL/SIG)
+
+
+VERB_REGISTRY: tuple = (
+    VerbSpec("delay", "http", "delay:SECONDS", "middleware", (),
+             "sleep SECONDS, then handle normally (latency injection)",
+             "delay:0.2"),
+    VerbSpec("status", "http", "STATUS[:RETRY_AFTER]", "middleware", (),
+             "short-circuit with that HTTP status; 5xx carry a packaged "
+             "ControllerRequestError body, :R adds Retry-After", "503:0.1"),
+    VerbSpec("reset", "http", "reset", "middleware", (),
+             "close the TCP connection without a response (handler "
+             "provably did not run)", "reset"),
+    VerbSpec("truncate", "http", "truncate", "middleware", (),
+             "advertise a Content-Length, send fewer bytes, close",
+             "truncate"),
+    VerbSpec("oom", "http", "oom", "middleware", (),
+             "503 with a packaged HbmOomError (simulated HBM OOM)", "oom"),
+    VerbSpec("evict", "http", "evict", "middleware", (),
+             "503 with a packaged PodTerminatedError (reason Evicted)",
+             "evict"),
+    VerbSpec("preempt", "http", "preempt", "middleware", (),
+             "503 with a packaged PodTerminatedError (reason Preempted)",
+             "preempt"),
+    VerbSpec("shed", "http", "shed[:RETRY_AFTER]", "middleware", (),
+             "429 with a packaged AdmissionShedError (+ optional "
+             "Retry-After) — injectable admission refusal", "shed:0.1"),
+    VerbSpec("disk-full", "http", "disk-full", "middleware", (),
+             "507 with a packaged StoreFullError — deterministic ENOSPC",
+             "disk-full"),
+    VerbSpec("pass", "http", "pass", "middleware", (),
+             "explicitly no fault (spaces out a schedule)", "pass"),
+    VerbSpec("corrupt-blob", "store", "corrupt-blob", "middleware",
+             ("GET", "HEAD"),
+             "flip one byte of the on-disk file behind the request, then "
+             "serve the rot (store servers only)", "corrupt-blob"),
+    VerbSpec("torn-write", "store", "torn-write[:BYTES]", "middleware",
+             ("PUT", "POST"),
+             "stage BYTES of the PUT body into the .tmp path, then SIGKILL "
+             "the process — died-mid-upload (subprocess stores only)",
+             "torn-write:4096", process_fatal=True),
+    VerbSpec("kill-rank", "process", "kill-rank:SIG@OP_INDEX",
+             "rank worker loop", (),
+             "the rank self-delivers SIG at its N-th call op (mid-call "
+             "OOM-kill/preemption stand-in; honors KT_CHAOS_RANK)",
+             "kill-rank:9@1", process_fatal=True),
+    VerbSpec("term-rank", "process", "term-rank:GRACE_S@OP_INDEX",
+             "rank worker loop", (),
+             "SIGTERM at the N-th call op + SIGKILL timer GRACE_S out — "
+             "the GKE preemption contract (cooperative drain window)",
+             "term-rank:5@1", process_fatal=True),
+    VerbSpec("shm-corrupt", "process", "shm-corrupt", "shm encoder", (),
+             "flip one byte of the next shared-memory envelope after the "
+             "write, before the header queues (decode must catch it)",
+             "shm-corrupt"),
+    VerbSpec("kill-store-node", "ring", "kill-store-node[:SIG]@OP_INDEX",
+             "middleware", (),
+             "the store process self-delivers SIG at its N-th client-origin "
+             "data op, before the handler (subprocess fleets only)",
+             "kill-store-node:9@3", process_fatal=True),
+    VerbSpec("kill-peer", "ring", "kill-peer[:SIG]@OP_INDEX", "middleware",
+             ("GET", "HEAD"),
+             "self-SIGKILL at the N-th broadcast-window transfer (GET/HEAD "
+             "on the data-transfer surface) — mid-transfer peer death",
+             "kill-peer@1", process_fatal=True),
+    VerbSpec("kill-region", "region", "kill-region[:OP_INDEX]@NAME",
+             "middleware + step loop", (),
+             "SIGKILL every process tagged KT_REGION=NAME at the op index "
+             "(servers) / step index (trainers) — whole-region death",
+             "kill-region:1@iowa", process_fatal=True),
+    VerbSpec("partition", "region", "partition[:PCT]", "client netpool", (),
+             "black-hole cross-region requests (hosts outside "
+             "KT_CHAOS_REGION_HOSTS) with probability PCT",
+             "partition:0.5"),
+)
+
+_KINDS = tuple(v.name for v in VERB_REGISTRY)
+
+
+def verb_registry() -> tuple:
+    """The structured verb registry (immutable). One source of truth for
+    the parser's kinds, the soak generator, ``kt chaos verbs``, and the
+    ``resilience.md`` grammar table."""
+    return VERB_REGISTRY
+
+
+def registry_as_dicts() -> List[Dict]:
+    """JSON-friendly registry view (``kt chaos verbs --json``)."""
+    return [{"name": v.name, "scope": v.scope, "grammar": v.grammar,
+             "consumer": v.consumer, "methods": list(v.methods),
+             "process_fatal": v.process_fatal, "summary": v.summary,
+             "example": v.example}
+            for v in VERB_REGISTRY]
+
+
+def grammar_markdown() -> str:
+    """The ``KT_CHAOS`` verb table as markdown, rendered FROM the registry
+    — ``docs/resilience.md`` embeds this output (a drift test pins it), so
+    adding a verb updates the operator docs by construction."""
+    lines = ["| verb | scope | consumer | grammar | summary |",
+             "|---|---|---|---|---|"]
+    for v in VERB_REGISTRY:
+        methods = f" ({'/'.join(v.methods)} only)" if v.methods else ""
+        fatal = " **process-fatal.**" if v.process_fatal else ""
+        lines.append(f"| `{v.name}` | {v.scope} | {v.consumer} | "
+                     f"`{v.grammar}` | {v.summary}{methods}{fatal} |")
+    return "\n".join(lines) + "\n"
+
 
 # verbs consumed outside the HTTP middleware: the rank worker loop
 # (kill/term-rank) and the shared-memory envelope encoder (shm-corrupt,
@@ -413,6 +531,14 @@ class ChaosEngine:
         self.requests_seen = 0
         self.data_ops = 0            # client-origin non-exempt requests
         self.peer_ops = 0            # client-origin broadcast transfers
+        # independent op counters per ARMED verb class (ISSUE 15): before
+        # this, a kill-peer firing returned early and swallowed the data-op
+        # increment, so `kill-peer@1,kill-store-node@2` shifted the node
+        # kill to the 4th request — composed schedules raced on whichever
+        # class fired first. Every class now advances its own counter on
+        # every qualifying op, fired or not.
+        self.node_ops = 0            # kill-store-node schedule position
+        self.region_ops = 0          # kill-region schedule position
 
     @classmethod
     def from_env(cls) -> Optional["ChaosEngine"]:
@@ -426,6 +552,17 @@ class ChaosEngine:
             pass
         return cls(parse_spec(spec), seed=seed)
 
+    @staticmethod
+    def _pop_due(faults: List[Fault], ops: int) -> Optional[Fault]:
+        """Pop the first armed fault whose op index is due. ``<=`` not
+        ``==``: a fault that misses its exact index (a higher-priority
+        class fired on that op, or duplicate indexes in one class) fires
+        on the next qualifying op instead of silently never."""
+        for i, fault in enumerate(faults):
+            if fault.op_index <= ops:
+                return faults.pop(i)
+        return None
+
     def next_fault(self, path: str, method: Optional[str] = None,
                    internal: bool = False) -> Optional[Fault]:
         # internal store↔store traffic (replication forwards, ring-wide
@@ -437,32 +574,30 @@ class ChaosEngine:
             return None
         with self._lock:
             self.requests_seen += 1
+            hit: Optional[Fault] = None
             if (method in ("GET", "HEAD")
                     and path.startswith(PEER_TRANSFER_PATHS)):
                 # broadcast-window transfer: the kill-peer schedule is
                 # method-aware — writes and control POSTs never advance it,
                 # so the kill lands on exactly the Nth bytes-serving request
-                for i, fault in enumerate(self.peer_faults):
-                    if fault.op_index == self.peer_ops:
-                        del self.peer_faults[i]
-                        self.peer_ops += 1
-                        self.injected += 1
-                        return fault
+                hit = self._pop_due(self.peer_faults, self.peer_ops)
                 self.peer_ops += 1
             if not path.startswith(EXEMPT_PATHS):
-                for i, fault in enumerate(self.node_faults):
-                    if fault.op_index == self.data_ops:
-                        del self.node_faults[i]
-                        self.data_ops += 1
-                        self.injected += 1
-                        return fault
-                for i, fault in enumerate(self.region_faults):
-                    if fault.op_index == self.data_ops:
-                        del self.region_faults[i]
-                        self.data_ops += 1
-                        self.injected += 1
-                        return fault
+                # each armed class advances its OWN counter on every
+                # qualifying op, fired or not (see the counter note in
+                # __init__); at most one fault fires per request — the
+                # classes here are all process-fatal, so firing two would
+                # be indistinguishable anyway
+                if hit is None:
+                    hit = self._pop_due(self.node_faults, self.node_ops)
+                self.node_ops += 1
+                if hit is None:
+                    hit = self._pop_due(self.region_faults, self.region_ops)
+                self.region_ops += 1
                 self.data_ops += 1
+            if hit is not None:
+                self.injected += 1
+                return hit
             for i, fault in enumerate(self.schedule):
                 if fault.matches(path, method):
                     del self.schedule[i]

@@ -1145,6 +1145,139 @@ def controller_stop():
     click.echo("local controller stopped")
 
 
+@cli.group()
+def chaos():
+    """Fault-injection tooling (the KT_CHAOS grammar)."""
+
+
+@chaos.command("verbs")
+@click.option("--json", "as_json", is_flag=True)
+def chaos_verbs(as_json):
+    """List the chaos-verb registry: every verb the KT_CHAOS grammar
+    accepts, with its scope, consumer, grammar, and an example token.
+    docs/resilience.md's grammar table is generated from the same
+    registry, so this list and the docs cannot drift apart."""
+    from .chaos import registry_as_dicts
+
+    verbs = registry_as_dicts()
+    if as_json:
+        click.echo(json.dumps(verbs, indent=2))
+        return
+    w = max(len(v["name"]) for v in verbs)
+    for v in verbs:
+        flags = "  [process-fatal]" if v["process_fatal"] else ""
+        methods = (f" ({'/'.join(v['methods'])} only)"
+                   if v["methods"] else "")
+        click.echo(f"{v['name']:<{w}}  [{v['scope']}] "
+                   f"{v['summary']}{methods}{flags}")
+        click.echo(f"{'':<{w}}  grammar: {v['grammar']}   "
+                   f"e.g. {v['example']}")
+
+
+@cli.group()
+def soak():
+    """Seeded whole-stack chaos soak with invariant checking (ISSUE 15)."""
+
+
+@soak.command("run")
+@click.option("--seed", type=int, default=0,
+              help="schedule seed (same seed → byte-identical schedule)")
+@click.option("--duration", type=float, default=60.0,
+              help="approximate run seconds; divided by the op interval "
+                   "to get the op-indexed schedule length")
+@click.option("--profile", default="all",
+              type=click.Choice(["store", "train", "serve", "federation",
+                                 "all"]))
+@click.option("--shrink/--no-shrink", "do_shrink", default=True,
+              help="on violation, ddmin the schedule to a minimal repro")
+@click.option("--out", default=None,
+              help="replay-file path (default: <base-dir>/repro.json)")
+@click.option("--base-dir", default=None,
+              help="work dir for fleet roots + history (default: a fresh "
+                   "temp dir, kept on violation)")
+@click.option("--json", "as_json", is_flag=True)
+def soak_run(seed, duration, profile, do_shrink, out, base_dir, as_json):
+    """Generate a seeded fault schedule, conduct it against a real
+    subprocess fleet, check the Jepsen-style invariants over the recorded
+    history, and (on violation) shrink to a minimal replayable repro.
+    Exit 0 green, 1 on any violation."""
+    import tempfile
+
+    from .config import config
+    from .soak import generate
+    from .soak.conductor import run_soak, shrink_violation, write_replay
+
+    cfg = config()
+    interval = cfg.soak_op_interval_s
+    n_ops = max(8, int(duration / max(interval, 0.01)))
+    sched = generate(seed, profile, n_ops,
+                     store_nodes=cfg.soak_store_nodes)
+    base_dir = base_dir or tempfile.mkdtemp(prefix="kt-soak-")
+    os.makedirs(base_dir, exist_ok=True)
+    history_path = os.path.join(base_dir, "history.jsonl")
+    log = (lambda m: None) if as_json else \
+        (lambda m: click.echo(m, err=True))
+    res = run_soak(sched, base_dir, op_interval_s=interval,
+                   settle_timeout_s=cfg.soak_settle_timeout_s,
+                   history_path=history_path, log=log)
+    report = res.to_dict()
+    if not res.ok:
+        repro = sched
+        if do_shrink:
+            repro = shrink_violation(
+                sched, base_dir, res.violations[0].invariant,
+                op_interval_s=interval,
+                settle_timeout_s=cfg.soak_settle_timeout_s, log=log)
+        out = out or os.path.join(base_dir, "repro.json")
+        write_replay(repro, out, res.violations)
+        report["replay"] = out
+        report["replay_events"] = len(repro.events)
+    if as_json:
+        click.echo(json.dumps(report, indent=2))
+    elif res.ok:
+        click.echo(f"soak OK: seed={seed} profile={profile} "
+                   f"ops={res.ops} events={res.events_fired} "
+                   f"({res.duration_s:.1f}s)")
+    else:
+        for v in res.violations:
+            click.echo(f"VIOLATION [{v.invariant}] {v.detail}", err=True)
+        click.echo(f"replay file: {report['replay']} "
+                   f"({report['replay_events']} event(s)) — refire with "
+                   f"`kt soak replay {report['replay']}`", err=True)
+    sys.exit(0 if res.ok else 1)
+
+
+@soak.command("replay")
+@click.argument("replay_file")
+@click.option("--base-dir", default=None)
+@click.option("--json", "as_json", is_flag=True)
+def soak_replay(replay_file, base_dir, as_json):
+    """Refire a (shrunk) replay file deterministically: same seed, same
+    boot chaos, same op stream, only the recorded events. Exit 1 if the
+    violation reproduces (it is a repro — that is the expected verdict)."""
+    import tempfile
+
+    from .config import config
+    from .soak.conductor import load_replay, run_soak
+
+    cfg = config()
+    sched = load_replay(replay_file)
+    base_dir = base_dir or tempfile.mkdtemp(prefix="kt-soak-replay-")
+    log = (lambda m: None) if as_json else \
+        (lambda m: click.echo(m, err=True))
+    res = run_soak(sched, base_dir, op_interval_s=cfg.soak_op_interval_s,
+                   settle_timeout_s=cfg.soak_settle_timeout_s,
+                   events_override=sched.events, log=log)
+    if as_json:
+        click.echo(json.dumps(res.to_dict(), indent=2))
+    elif res.ok:
+        click.echo("replay did NOT reproduce any violation")
+    else:
+        for v in res.violations:
+            click.echo(f"VIOLATION [{v.invariant}] {v.detail}")
+    sys.exit(0 if res.ok else 1)
+
+
 def main():
     from .exceptions import KubetorchError
 

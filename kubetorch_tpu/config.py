@@ -144,6 +144,19 @@ class KTConfig:
     # and layer them for `kt config`.
     trace: bool = True
     trace_ring: int = 2048
+    # chaos-conductor soak (kubetorch_tpu/soak/, ISSUE 15). Same env
+    # layering (KT_SOAK_OP_INTERVAL_S / KT_SOAK_STORE_NODES /
+    # KT_SOAK_SETTLE_TIMEOUT_S). soak_op_interval_s paces the conducted
+    # workload (op-indexed fault timing divides the --duration by it to
+    # get the op count); soak_store_nodes sizes the subprocess ring the
+    # store-touching profiles boot; soak_settle_timeout_s bounds each
+    # settle stage (trainer drain, scrub convergence) before the run is
+    # declared un-converged. KT_SOAK_BREAK is deliberately NOT a field:
+    # it arms the broken-build acceptance path and must never be layered
+    # in from a config file.
+    soak_op_interval_s: float = 0.25
+    soak_store_nodes: int = 3
+    soak_settle_timeout_s: float = 60.0
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

@@ -793,6 +793,31 @@ async def kv_put(request: web.Request) -> web.Response:
     # receive time, preserved verbatim on replica forwards: the ordering
     # fact quorum reads of mutable keys (checkpoint markers) resolve on
     meta.setdefault("stored_at", round(time.time(), 6))
+    if os.environ.get("KT_SOAK_BREAK") == "ack-before-commit":
+        # DELIBERATELY BROKEN build, reachable only via this env flag: ack
+        # the write before the durable commit, deferring both renames (and
+        # the quorum forward) to a delayed task. A kill landing inside the
+        # window loses an ACKNOWLEDGED write — the soak's durability
+        # invariant must catch exactly this, and the shrinker must reduce
+        # the schedule to the kill that did it. Never set outside tests.
+        async def _commit_later(app=request.app, st=st, path=path, tmp=tmp,
+                                meta=dict(meta),
+                                internal=_internal(request),
+                                key=request.match_info["key"]):
+            await asyncio.sleep(float(
+                os.environ.get("KT_SOAK_BREAK_DELAY_S", "0.3")))
+            _commit(tmp, path)
+            meta_tmp = path.with_name(
+                f"{path.name}.meta.{uuid.uuid4().hex[:8]}.tmp")
+            meta_tmp.write_text(json.dumps(meta))
+            _commit(meta_tmp, path.with_name(path.name + ".meta"))
+            if st.ring.multi and not internal:
+                from urllib.parse import quote
+                await _replicate_object(
+                    app, key, f"/kv/{quote(key, safe='/')}", path,
+                    headers={"X-KT-Meta": json.dumps(meta)})
+        asyncio.get_running_loop().create_task(_commit_later())
+        return web.json_response({"ok": True, "size": size})
     # data renames first: if we crash before the meta lands, the stale
     # meta makes /kv/diff report the key missing (hash or size mismatch)
     # — a wasted re-upload, not a lost update. The rename pair itself is

@@ -216,12 +216,47 @@ def normalize_batch_axes(live: Dict[str, int],
 
 def shard_map_fn():
     """jax.shard_map across the JAX versions this image may carry (the
-    experimental path is the fallback)."""
+    experimental path is the fallback).
+
+    Newer JAX renamed the replication-check kwarg ``check_rep`` →
+    ``check_vma``; callers here use the new name. When the installed
+    shard_map predates the rename, translate ``check_vma`` to
+    ``check_rep`` (same semantics: disable the static replication
+    checker) so one call site works on both sides of the rename."""
+    import functools
+    import inspect
+
     import jax
     sm = getattr(jax, "shard_map", None)
     if sm is None:
         from jax.experimental.shard_map import shard_map as sm
-    return sm
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):
+        return sm
+    if "check_vma" in params:
+        return sm
+
+    @functools.wraps(sm)
+    def _compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            val = kwargs.pop("check_vma")
+            if "check_rep" in params:
+                kwargs["check_rep"] = val
+        return sm(*args, **kwargs)
+
+    return _compat
+
+
+def lax_axis_size(axis):
+    """Static mesh-axis size from inside a shard_map body, across the JAX
+    API gap: ``lax.axis_size`` where it exists, else the older
+    ``core.axis_frame`` lookup (same static int on 0.4.x)."""
+    import jax
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.core.axis_frame(axis)
 
 
 def best_mesh_for(n_devices: int, prefer: str = "fsdp") -> MeshSpec:

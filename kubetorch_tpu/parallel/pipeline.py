@@ -25,16 +25,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from .mesh import (AXIS_CONTEXT, AXIS_EXPERT, AXIS_FSDP, AXIS_PIPE,
-                   AXIS_TENSOR, live_axes as _live_axes)
+                   AXIS_TENSOR, lax_axis_size as _lax_axis_size,
+                   live_axes as _live_axes)
 from .sharding import (BATCH_AXES as _BATCH_AXES, LLAMA_RULES, VIT_RULES,
                        ShardingRules)
 
 
 def _shard_map():
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        from jax.experimental.shard_map import shard_map as sm
-    return sm
+    # version-compat (check_vma ↔ check_rep) lives in one place: mesh.py
+    from .mesh import shard_map_fn
+    return shard_map_fn()
 
 
 def _reduce_stage_aux(aux_acc, mesh, axis):
@@ -75,7 +75,7 @@ def gpipe(stage_fn: Callable, mesh, *, axis: str = "pipe",
 
         def per_device(local_params, x_local):
             p = lax.axis_index(axis)
-            n_stages = lax.axis_size(axis)
+            n_stages = _lax_axis_size(axis)
             xs = x_local.reshape(M, x_local.shape[0] // M, *x_local.shape[1:])
 
             def timestep(carry, t):
@@ -170,7 +170,7 @@ def gpipe_interleaved(chunk_fn: Callable, mesh, *, axis: str = "pipe",
 
         def per_device(local_params, x_local):
             p = lax.axis_index(axis)
-            n_stages = lax.axis_size(axis)
+            n_stages = _lax_axis_size(axis)
             xs = x_local.reshape(M, x_local.shape[0] // M, *x_local.shape[1:])
             # (V, 1, ...) local leaves → (V, ...): drop the sharded pipe dim
             chunks = jax.tree_util.tree_map(

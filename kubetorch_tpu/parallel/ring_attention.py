@@ -71,7 +71,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if scale is None:
         scale = hd ** -0.5
 
-    ring = lax.axis_size(axis_name)
+    from .mesh import lax_axis_size
+    ring = lax_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     q_offset = my * sq
 

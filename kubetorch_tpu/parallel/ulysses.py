@@ -41,7 +41,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Per-shard Ulysses attention. Local shapes: (B, S/C, N, Hd); requires
     C | N and C | NKV. Must run inside shard_map with ``axis_name`` bound."""
     n, nkv = q.shape[2], k.shape[2]
-    c = lax.axis_size(axis_name)
+    from .mesh import lax_axis_size
+    c = lax_axis_size(axis_name)
     if n % c or nkv % c:
         raise ValueError(
             f"ulysses degree {c} must divide n_heads={n} and n_kv_heads={nkv}")

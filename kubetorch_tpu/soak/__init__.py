@@ -1,0 +1,31 @@
+"""The chaos conductor (ISSUE 15): seeded whole-stack fault-schedule soak.
+
+PRs 2-13 each shipped a hand-written chaos drill — one subsystem, one
+scripted fault, one scripted moment. This package is the composition
+harness: a **seeded, weighted fault schedule** over the chaos-verb
+grammar (:mod:`kubetorch_tpu.chaos` exports the registry it enumerates),
+delivered against a REAL subprocess fleet (store ring + elastic trainer +
+serving gateway + lease-fenced placements), with every client-visible
+operation recorded into an append-only history that Jepsen-style global
+invariants are checked over after the dust settles. On a violation, the
+schedule is shrunk by delta-debugging replay to a minimal repro and
+written to a replay file ``kt soak replay`` refires deterministically.
+
+Modules:
+
+- :mod:`.schedule`  — ``FaultEvent``/``Schedule`` + the seeded generator
+  (same seed → byte-identical schedule, the replayability anchor)
+- :mod:`.history`   — the op/result history + pure invariant checkers
+- :mod:`.conductor` — boots the fleet, interleaves workload ops with due
+  fault events, settles, and runs the checkers
+- :mod:`.shrink`    — ddmin minimization of a violating schedule
+
+Every random draw in this package comes from an explicitly seeded
+``random.Random`` (the 13th ``check_resilience`` lint keeps it that way);
+an unseeded draw anywhere would silently break replay.
+"""
+
+from .history import (INVARIANTS, History, Violation,  # noqa: F401
+                      check_all)
+from .schedule import FaultEvent, Schedule, generate  # noqa: F401
+from .shrink import ddmin  # noqa: F401

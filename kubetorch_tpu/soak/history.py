@@ -1,0 +1,310 @@
+"""Op/result history + the global invariants checked over it.
+
+The conductor records every client-visible operation (store puts/gets,
+trainer ledger events, serving calls, lease placements) into an
+append-only list of JSON records; after the run settles, the checkers
+here are run over the COMPLETE history. Each checker is a pure function
+``records -> [Violation]`` — no fleet, no clock, no I/O — so every
+invariant is unit-testable with a hand-built *violating* history
+(tests/test_soak.py feeds each one a lost write, a stale-lease double
+placement, a raw-error leak, a fingerprint mismatch... and asserts the
+checker actually fires).
+
+Record shapes (all plain dicts; ``index`` is assigned on append):
+
+- ``{"kind": "op", "op": "put|get|rm|ls|generate|lease-tick", "ok": bool,
+  "key": ..., "error": type-name, "typed": bool, ...}`` — one client op.
+  ``acked: true`` on a put marks it durability-checked at settle.
+- ``{"kind": "trainer", "event": "committed|restored|dying|done",
+  "step": int, "fingerprint": str}`` — the trainer's ledger, imported.
+- ``{"kind": "lease", "event": "grant", "workload": w, "region": r,
+  "epoch": e}`` and ``{"kind": "placement", "event": "start|stop|
+  confirmed", "workload": w, "region": r, "epoch": e}`` — the fencing
+  dance.
+- ``{"kind": "verify", "key": k, "ok": bool, "match": bool}`` — the
+  settle-phase read-back of an acked write.
+- ``{"kind": "ring-status", "under_replicated": n, "nodes_down": m}`` —
+  the final scrub verdict.
+- ``{"kind": "leak-scan", "shm": [...], "tmp": [...]}`` — leftover
+  /dev/shm segments and orphan .tmp files after teardown.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..exceptions import EXCEPTION_REGISTRY, KubetorchError
+
+
+@dataclass
+class Violation:
+    """One invariant breach, pointing back at the implicated records."""
+
+    invariant: str
+    detail: str
+    records: List[int] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"invariant": self.invariant, "detail": self.detail,
+                "records": list(self.records)}
+
+
+class History:
+    """Append-only op/result history. Thread-safe appends (the conductor's
+    main loop and the trainer-ledger importer may interleave); optionally
+    mirrored to a JSONL file as it grows, so a soak that wedges still
+    leaves its history on disk for the post-mortem."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._records: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._path = path
+
+    def record(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        rec = {"kind": kind, **fields}
+        with self._lock:
+            rec["index"] = len(self._records)
+            self._records.append(rec)
+            if self._path:
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+def classify_error(exc: BaseException) -> Tuple[str, bool]:
+    """``(type name, typed?)`` for an exception a client op surfaced.
+
+    "Typed" means the error rode the exception taxonomy clients are
+    supposed to see — a :class:`KubetorchError` subclass (equivalently,
+    a registered rehydratable type). A raw ``ConnectionError`` /
+    ``KeyError`` / ``JSONDecodeError`` reaching the history is exactly
+    the leak the typed-errors invariant exists to catch."""
+    name = type(exc).__name__
+    typed = isinstance(exc, KubetorchError) or name in EXCEPTION_REGISTRY
+    return name, typed
+
+
+# ---------------------------------------------------------------------------
+# Invariant checkers — pure functions over the record list
+# ---------------------------------------------------------------------------
+
+
+def check_durability(records: List[Dict]) -> List[Violation]:
+    """No acknowledged quorum write may ever become unreadable: every
+    ``op=put`` the client saw ``ok`` (and marked ``acked``) must have a
+    settle-phase ``verify`` read that succeeded AND matched the written
+    content. A missing verify counts too — silently skipping the read-back
+    must not pass the gate. An acknowledged ``rm`` releases the obligation
+    (the client asked for the data to go away); a later re-put restores
+    it."""
+    out: List[Violation] = []
+    acked: Dict[str, int] = {}
+    verified: Dict[str, Dict] = {}
+    for r in records:
+        if r.get("kind") == "op" and r.get("op") == "put" and r.get("ok") \
+                and r.get("acked"):
+            acked[r["key"]] = r["index"]
+        elif r.get("kind") == "op" and r.get("op") == "rm" and r.get("ok"):
+            acked.pop(r.get("key"), None)
+        elif r.get("kind") == "verify":
+            verified[r["key"]] = r
+    for key, idx in sorted(acked.items()):
+        v = verified.get(key)
+        if v is None:
+            out.append(Violation(
+                "durability", f"acked write {key!r} was never verified "
+                "at settle", [idx]))
+        elif not v.get("ok") or not v.get("match", True):
+            why = "unreadable" if not v.get("ok") else "content mismatch"
+            out.append(Violation(
+                "durability", f"acked write {key!r} is {why} after the "
+                f"dust settled ({v.get('error', '')})".rstrip(" ()"),
+                [idx, v["index"]]))
+    return out
+
+
+def check_commits(records: List[Dict]) -> List[Violation]:
+    """No lost committed checkpoint step: the trainer's restored step must
+    never fall below the highest step it previously committed (commit-
+    marker monotonicity), and any step committed twice (across deaths)
+    or restored must reproduce the SAME ``tree_fingerprint`` — the
+    deterministic recurrence makes bit-drift a real corruption signal."""
+    out: List[Violation] = []
+    fingerprints: Dict[int, Tuple[str, int]] = {}
+    high = 0
+    high_idx: Optional[int] = None
+    for r in records:
+        if r.get("kind") != "trainer":
+            continue
+        step = r.get("step")
+        fp = r.get("fingerprint")
+        if r.get("event") == "committed" and step is not None:
+            seen = fingerprints.get(step)
+            if seen is not None and fp is not None and seen[0] != fp:
+                out.append(Violation(
+                    "commit-fingerprint",
+                    f"step {step} re-committed with a different "
+                    f"fingerprint ({seen[0][:12]}… vs {fp[:12]}…)",
+                    [seen[1], r["index"]]))
+            if fp is not None:
+                fingerprints.setdefault(step, (fp, r["index"]))
+            if step > high:
+                high, high_idx = step, r["index"]
+        elif r.get("event") == "restored":
+            if step is None:
+                if high:
+                    out.append(Violation(
+                        "commit-monotonic",
+                        f"trainer restored from scratch although step "
+                        f"{high} was committed",
+                        [i for i in (high_idx, r["index"]) if i is not None]))
+                continue
+            if step < high:
+                out.append(Violation(
+                    "commit-monotonic",
+                    f"trainer restored step {step} but step {high} was "
+                    f"already committed — committed work was lost",
+                    [i for i in (high_idx, r["index"]) if i is not None]))
+            seen = fingerprints.get(step)
+            if seen is not None and fp is not None and seen[0] != fp:
+                out.append(Violation(
+                    "commit-fingerprint",
+                    f"restored step {step} does not reproduce the "
+                    f"committed fingerprint ({seen[0][:12]}… vs {fp[:12]}…)",
+                    [seen[1], r["index"]]))
+    return out
+
+
+def check_lease_fencing(records: List[Dict]) -> List[Violation]:
+    """At most one live placement per workload, and every placement must
+    carry the CURRENT lease epoch: a ``placement`` start/confirm stamped
+    with an epoch older than the newest grant for that workload means a
+    fenced-off region kept running — the split-brain the epoch fence
+    exists to prevent."""
+    out: List[Violation] = []
+    granted: Dict[str, Tuple[int, int]] = {}
+    live: Dict[str, Dict] = {}
+    for r in records:
+        if r.get("kind") == "lease" and r.get("event") == "grant":
+            granted[r["workload"]] = (r["epoch"], r["index"])
+        elif r.get("kind") == "placement":
+            w = r.get("workload")
+            if r.get("event") in ("start", "confirmed"):
+                cur = granted.get(w)
+                if cur is not None and r.get("epoch", 0) < cur[0]:
+                    out.append(Violation(
+                        "lease-fencing",
+                        f"workload {w!r} placement in {r.get('region')!r} "
+                        f"ran at stale epoch {r.get('epoch')} (current "
+                        f"{cur[0]}) — fenced region kept the placement",
+                        [cur[1], r["index"]]))
+                prev = live.get(w)
+                if prev is not None and prev.get("region") != r.get("region"):
+                    out.append(Violation(
+                        "lease-fencing",
+                        f"workload {w!r} live in BOTH "
+                        f"{prev.get('region')!r} (epoch {prev.get('epoch')})"
+                        f" and {r.get('region')!r} (epoch {r.get('epoch')})",
+                        [prev["index"], r["index"]]))
+                if r.get("event") == "start":
+                    live[w] = r
+            elif r.get("event") == "stop":
+                prev = live.get(w)
+                if prev is not None and prev.get("region") == r.get("region"):
+                    live.pop(w, None)
+    return out
+
+
+def check_typed_errors(records: List[Dict]) -> List[Violation]:
+    """Clients see typed errors ONLY: any failed op whose exception was
+    not a :class:`KubetorchError` (``typed: false`` in the record) is a
+    contract breach — a raw ``ConnectionError``/``KeyError`` escaped the
+    resilience layer into user code."""
+    out: List[Violation] = []
+    for r in records:
+        if r.get("kind") == "op" and r.get("ok") is False \
+                and not r.get("typed", False):
+            out.append(Violation(
+                "typed-errors",
+                f"raw {r.get('error', '?')} escaped to the client on "
+                f"{r.get('op')} {r.get('key', r.get('target', ''))!r}",
+                [r["index"]]))
+    return out
+
+
+def check_ring_converged(records: List[Dict]) -> List[Violation]:
+    """The ring must re-converge to full replication after the faults: the
+    final ``ring-status`` record (post-restart, post-scrub) must report
+    zero under-replicated objects and zero dead members. No record at all
+    counts as a violation when store ops ran — the settle phase skipped
+    its own verdict."""
+    out: List[Violation] = []
+    last = None
+    store_ops = False
+    for r in records:
+        if r.get("kind") == "ring-status":
+            last = r
+        elif r.get("kind") == "op" and r.get("op") in ("put", "get", "rm"):
+            store_ops = True
+    if last is None:
+        if store_ops:
+            out.append(Violation(
+                "ring-convergence",
+                "store ops ran but no final ring-status was recorded", []))
+        return out
+    if last.get("under_replicated", 0) or last.get("nodes_down", 0):
+        out.append(Violation(
+            "ring-convergence",
+            f"ring did not re-converge: under_replicated="
+            f"{last.get('under_replicated')} nodes_down="
+            f"{last.get('nodes_down')}", [last["index"]]))
+    return out
+
+
+def check_no_leaks(records: List[Dict]) -> List[Violation]:
+    """Zero leaked /dev/shm segments and zero orphan ``.tmp`` files after
+    teardown: the leak-scan record's lists must be empty. Restart paths
+    that forget their cleanup show up here, not in a full disk weeks
+    later."""
+    out: List[Violation] = []
+    for r in records:
+        if r.get("kind") != "leak-scan":
+            continue
+        if r.get("shm"):
+            out.append(Violation(
+                "no-leaks", f"leaked /dev/shm segments: {r['shm']}",
+                [r["index"]]))
+        if r.get("tmp"):
+            out.append(Violation(
+                "no-leaks", f"orphan .tmp files: {r['tmp']}",
+                [r["index"]]))
+    return out
+
+
+INVARIANTS = {
+    "durability": check_durability,
+    "commits": check_commits,
+    "lease-fencing": check_lease_fencing,
+    "typed-errors": check_typed_errors,
+    "ring-convergence": check_ring_converged,
+    "no-leaks": check_no_leaks,
+}
+
+
+def check_all(records: List[Dict]) -> List[Violation]:
+    """Run every invariant checker over the history; the soak's verdict."""
+    out: List[Violation] = []
+    for checker in INVARIANTS.values():
+        out.extend(checker(records))
+    return out

@@ -1,0 +1,238 @@
+"""Seeded fault-schedule generation over the chaos-verb registry.
+
+One ``random.Random(seed)`` draws EVERYTHING — which verbs arm at boot,
+which processes die, when they die, when they come back, when the
+cross-region link partitions, when the lease fails over — so the same
+``(seed, profile, n_ops)`` triple produces a byte-identical schedule
+(``Schedule.to_json`` is canonical: sorted keys, no whitespace), and a
+replay file is just a schedule with the generator cut out.
+
+Timing is **op-indexed**, not wall-clock: every event carries ``at_op``,
+the workload-op index it fires before. The conductor's main loop is
+single-threaded (fire due events, run one op, repeat), so the
+event/op interleaving replays exactly regardless of machine speed — the
+property the ddmin shrinker (:mod:`.shrink`) depends on.
+
+The verb WEIGHTS live here, but the verb LIST comes from
+:func:`kubetorch_tpu.chaos.verb_registry` — adding a verb to the grammar
+automatically puts it in the soak lottery (or fails loudly in the
+weights table below, which is the point)."""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List
+
+from ..chaos import verb_registry
+
+PROFILES = ("store", "train", "serve", "federation", "all")
+
+# Boot-armed persistent HTTP faults (the %PROB half of the grammar): verb
+# name → (token template, weight). Only retryable-by-contract verbs arm
+# persistently — the client resilience layer must absorb them typed, which
+# is exactly what the typed-errors invariant then checks. Store-state and
+# process-fatal verbs are scheduled as explicit events instead (they need
+# a matching restart).
+_PERSISTENT_TOKENS = {
+    "delay": ("delay:0.05%{p}", 3.0),
+    "status": ("503:0.05%{p}", 3.0),
+    "reset": ("reset%{p}", 2.0),
+    "shed": ("shed:0.05%{p}", 1.0),
+    "oom": ("oom%{p}", 1.0),
+    "evict": ("evict%{p}", 0.5),
+    "preempt": ("preempt%{p}", 0.5),
+}
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One conductor-delivered fault, op-indexed.
+
+    Actions (the conductor's dispatch table):
+
+    - ``kill-node`` / ``restart-node``    — SIGKILL / revive store node
+      ``target="store:i"`` (restart re-arms nothing: recovery must clean)
+    - ``kill-trainer`` / ``resume-trainer`` — SIGKILL the trainer /
+      restart it with ``--resume`` (elastic resume under fire)
+    - ``kill-gateway`` / ``restart-gateway`` — the serving region's front
+      door dies mid-traffic and comes back
+    - ``partition-start`` / ``partition-stop`` — arm / clear the
+      client-side ``partition`` verb (cross-region black hole)
+    - ``lease-failover`` — re-grant the workload's lease to the standby
+      region (epoch bump); the old holder must fence off
+    """
+
+    at_op: int
+    action: str
+    target: str = ""
+    verb: str = ""       # registry verb this event exercises
+    token: str = ""      # KT_CHAOS token, when the event arms one
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultEvent":
+        return cls(at_op=int(d["at_op"]), action=d["action"],
+                   target=d.get("target", ""), verb=d.get("verb", ""),
+                   token=d.get("token", ""))
+
+
+@dataclass
+class Schedule:
+    """A complete, replayable soak plan: boot-time chaos arming + the
+    op-indexed event list + the workload dimensions."""
+
+    seed: int
+    profile: str
+    n_ops: int
+    store_nodes: int = 3
+    boot_chaos: Dict[str, str] = field(default_factory=dict)
+    events: List[FaultEvent] = field(default_factory=list)
+
+    def to_dict(self) -> Dict:
+        return {"seed": self.seed, "profile": self.profile,
+                "n_ops": self.n_ops, "store_nodes": self.store_nodes,
+                "boot_chaos": dict(sorted(self.boot_chaos.items())),
+                "events": [e.to_dict() for e in
+                           sorted(self.events,
+                                  key=lambda e: (e.at_op, e.action,
+                                                 e.target))]}
+
+    def to_json(self) -> str:
+        """Canonical serialization — the byte-identical determinism test
+        compares exactly this string across two same-seed generations."""
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Schedule":
+        return cls(seed=int(d["seed"]), profile=d["profile"],
+                   n_ops=int(d["n_ops"]),
+                   store_nodes=int(d.get("store_nodes", 3)),
+                   boot_chaos=dict(d.get("boot_chaos", {})),
+                   events=[FaultEvent.from_dict(e)
+                           for e in d.get("events", [])])
+
+    @classmethod
+    def from_json(cls, s: str) -> "Schedule":
+        return cls.from_dict(json.loads(s))
+
+
+def _weighted_choice(rng: random.Random, weighted: List[tuple]):
+    total = sum(w for _, w in weighted)
+    x = rng.random() * total
+    for item, w in weighted:
+        x -= w
+        if x <= 0:
+            return item
+    return weighted[-1][0]
+
+
+def generate(seed: int, profile: str, n_ops: int,
+             store_nodes: int = 3) -> Schedule:
+    """The seeded generator. Draw order is fixed and documented inline —
+    reordering draws is a schedule-format break (same seed would produce
+    a different schedule), which the determinism test turns into a
+    loud failure instead of a silent replay mismatch."""
+    if profile not in PROFILES:
+        raise ValueError(f"unknown soak profile {profile!r} "
+                         f"(one of {', '.join(PROFILES)})")
+    rng = random.Random(seed)
+    registry = {v.name: v for v in verb_registry()}
+    has_store = profile in ("store", "train", "federation", "all")
+    has_trainer = profile in ("train", "federation", "all")
+    has_gateway = profile in ("serve", "federation", "all")
+    has_regions = profile in ("federation", "all")
+
+    sched = Schedule(seed=seed, profile=profile, n_ops=n_ops,
+                     store_nodes=store_nodes if has_store else 0)
+    events: List[FaultEvent] = []
+
+    # draw 1: boot-armed persistent HTTP faults, one lottery per server
+    # process (each store node + the gateway), from the registry-backed
+    # weights table
+    weighted = [(name, w) for name, (_, w) in _PERSISTENT_TOKENS.items()
+                if name in registry]
+    targets = ([f"store:{i}" for i in range(store_nodes)] if has_store
+               else [])
+    if has_gateway:
+        targets.append("gateway:0")
+    for target in targets:
+        if rng.random() < 0.6:
+            verb = _weighted_choice(rng, weighted)
+            prob = round(rng.uniform(0.01, 0.05), 3)
+            token = _PERSISTENT_TOKENS[verb][0].format(p=prob)
+            sched.boot_chaos[target] = token
+
+    # draws 2-3: store-node death episodes, DISJOINT by construction. A
+    # 3-node R=2/W=2 ring tolerates exactly one dead member with full
+    # quorum availability, so the green path (typed errors only, zero
+    # lost acks) stays provable; overlapping deaths would make quorum
+    # loss a scheduled outcome instead of a found bug.
+    third = n_ops // 3
+    # episode A (first third): one node boot-armed with the grammar's own
+    # op-index verb — the middleware consumption path — revived mid-run.
+    # The index counts THAT node's requests, so keep it small enough that
+    # the death lands well before the scheduled revival.
+    if has_store and third >= 4 and rng.random() < 0.7:
+        node = rng.randrange(store_nodes)
+        op_idx = rng.randrange(2, max(3, min(8, third)))
+        tok = f"kill-store-node:9@{op_idx}"
+        key = f"store:{node}"
+        sched.boot_chaos[key] = (sched.boot_chaos[key] + "," + tok
+                                 if key in sched.boot_chaos else tok)
+        back = rng.randrange(third, 2 * third)
+        events.append(FaultEvent(back, "restart-node", key,
+                                 verb="kill-store-node", token=tok))
+    # episode B (final third): a signal-delivered SIGKILL/restart pair —
+    # the conductor's delivery path
+    if has_store and third >= 4:
+        node = rng.randrange(store_nodes)
+        at = rng.randrange(2 * third, n_ops - 2)
+        back = rng.randrange(at + 1, n_ops)
+        events.append(FaultEvent(at, "kill-node", f"store:{node}",
+                                 verb="kill-store-node"))
+        events.append(FaultEvent(back, "restart-node", f"store:{node}",
+                                 verb="kill-store-node"))
+
+    # draw 4: trainer death + elastic resume
+    if has_trainer:
+        for _ in range(rng.randrange(1, 3)):
+            at = rng.randrange(2, max(3, n_ops - 6))
+            back = min(n_ops - 1, at + rng.randrange(2, max(3, n_ops // 4)))
+            events.append(FaultEvent(at, "kill-trainer", "trainer",
+                                     verb="kill-region"))
+            events.append(FaultEvent(back, "resume-trainer", "trainer",
+                                     verb="kill-region"))
+
+    # draw 5: gateway death + restart (the serving front door)
+    if has_gateway and rng.random() < 0.7:
+        at = rng.randrange(1, max(2, n_ops - 4))
+        back = min(n_ops - 1, at + rng.randrange(2, max(3, n_ops // 4)))
+        events.append(FaultEvent(at, "kill-gateway", "gateway:0",
+                                 verb="kill-region"))
+        events.append(FaultEvent(back, "restart-gateway", "gateway:0",
+                                 verb="kill-region"))
+
+    # draw 6: a cross-region partition window + the lease failover it
+    # forces — the fencing invariant's main course
+    if has_regions:
+        a = rng.randrange(1, max(2, n_ops // 2))
+        b = min(n_ops - 1, a + rng.randrange(3, max(4, n_ops // 2)))
+        pct = rng.choice([1.0, 1.0, 0.5])
+        events.append(FaultEvent(a, "partition-start", "client",
+                                 verb="partition",
+                                 token=f"partition:{pct:g}"))
+        events.append(FaultEvent(b, "partition-stop", "client",
+                                 verb="partition"))
+        if rng.random() < 0.8:
+            mid = min(b, a + max(1, (b - a) // 2))
+            events.append(FaultEvent(mid, "lease-failover", "job-0",
+                                     verb="partition"))
+
+    sched.events = sorted(events, key=lambda e: (e.at_op, e.action,
+                                                 e.target))
+    return sched

@@ -1,0 +1,89 @@
+"""Minimal-repro shrinking: ddmin over a violating fault schedule.
+
+Classic Zeller/Hildebrandt delta debugging specialized to our event
+lists. The predicate re-runs the conductor with a candidate subset of
+the original events (same seed, same boot chaos, same op stream — only
+the conductor-delivered events vary) and answers "does the SAME
+invariant still break?". Because schedules are op-indexed and every
+draw is seeded, the predicate is deterministic, which is the property
+ddmin's 1-minimality guarantee actually requires.
+
+``ddmin`` itself is pure — it knows nothing about fleets or invariants,
+just a list and a black-box test — so the convergence test in
+tests/test_soak.py drives it with a fake predicate and asserts it finds
+the known-minimal core exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def ddmin(items: Sequence[T], test: Callable[[List[T]], bool],
+          max_tests: int = 512) -> List[T]:
+    """Return a 1-minimal sublist of ``items`` still satisfying ``test``.
+
+    ``test(subset)`` must return True for the full list (the violation
+    reproduces) and is assumed deterministic. 1-minimal means removing
+    any single remaining element makes the test pass — the Jepsen-style
+    "these N events, in this order, are each necessary" repro.
+
+    ``max_tests`` caps predicate invocations (each one is a full soak
+    replay); on cap we return the best-so-far reduction, which is still
+    a valid repro, just possibly not 1-minimal. Results are memoized on
+    the subset's identity so ddmin's re-visits are free.
+    """
+    items = list(items)
+    if not items:
+        return items
+    cache = {}
+    calls = [0]
+
+    def run(subset: List[T]) -> bool:
+        key = tuple(id(x) if not isinstance(x, (str, int, float, tuple))
+                    else x for x in subset)
+        # dataclass events are hashable only if frozen; fall back to ids
+        try:
+            key = tuple(subset)
+            hash(key)
+        except TypeError:
+            pass
+        if key in cache:
+            return cache[key]
+        if calls[0] >= max_tests:
+            return False
+        calls[0] += 1
+        result = bool(test(subset))
+        cache[key] = result
+        return result
+
+    if not run(items):
+        raise ValueError("ddmin: the full input does not satisfy the test "
+                         "— nothing to shrink")
+
+    n = 2
+    while len(items) >= 2:
+        chunk = max(1, len(items) // n)
+        subsets = [items[i:i + chunk] for i in range(0, len(items), chunk)]
+        reduced = False
+        # try each subset alone, then each complement
+        for sub in subsets:
+            if run(sub):
+                items, n, reduced = sub, 2, True
+                break
+        if not reduced:
+            for i in range(len(subsets)):
+                comp = [x for j, s in enumerate(subsets) if j != i
+                        for x in s]
+                if comp and run(comp):
+                    items, n, reduced = comp, max(n - 1, 2), True
+                    break
+        if not reduced:
+            if n >= len(items):
+                break
+            n = min(len(items), n * 2)
+        if calls[0] >= max_tests:
+            break
+    return items

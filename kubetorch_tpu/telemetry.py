@@ -834,6 +834,39 @@ def serve_metrics() -> Dict[str, "_Metric"]:
     return _SERVE_METRICS
 
 
+_SOAK_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def soak_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the ``kt_soak_*`` family the chaos conductor
+    (``soak/conductor.py``) emits into: schedule events delivered,
+    workload ops by outcome, invariant violations, and run verdicts. One
+    place so ``kt soak run --json`` output and the CI smoke gate read the
+    same series."""
+    global _SOAK_METRICS
+    if _SOAK_METRICS is None:
+        _SOAK_METRICS = {
+            "events": counter(
+                "kt_soak_events_total",
+                "Fault-schedule events delivered by the conductor",
+                labels=("action",)),
+            "ops": counter(
+                "kt_soak_ops_total",
+                "Soak workload operations by outcome (ok, typed-error, "
+                "raw-error)",
+                labels=("op", "outcome")),
+            "violations": counter(
+                "kt_soak_violations_total",
+                "Invariant violations found when checking the history",
+                labels=("invariant",)),
+            "runs": counter(
+                "kt_soak_runs_total",
+                "Completed soak runs by verdict",
+                labels=("outcome",)),
+        }
+    return _SOAK_METRICS
+
+
 # ---------------------------------------------------------------------------
 # Debug endpoint helper (shared by pod + store servers)
 # ---------------------------------------------------------------------------

@@ -269,6 +269,22 @@ REPLACE_BASELINE = {
 }
 
 
+# Unseeded randomness inside the soak package (ISSUE 15). The chaos
+# conductor's whole contract is replayability: the same (seed, profile,
+# n_ops) triple must produce a byte-identical schedule and op stream, or
+# shrunk repro files stop reproducing. Every draw in kubetorch_tpu/soak/
+# must therefore come from an explicitly seeded ``random.Random(seed)``
+# instance — a bare module-level ``random.choice(...)`` or an argless
+# ``random.Random()`` is a silent replay break. The baseline is EMPTY on
+# purpose and must stay that way.
+SOAK_RNG_RE = re.compile(
+    r"\brandom\.(?:random|betavariate|choice|choices|gauss|getrandbits|"
+    r"randint|randbytes|randrange|sample|shuffle|triangular|uniform)\s*\(|"
+    r"\brandom\.Random\(\s*\)")
+SOAK_DIR = "soak"
+SOAK_RNG_BASELINE: dict = {}
+
+
 def _count_matches(path: Path, pattern: re.Pattern) -> int:
     n = 0
     for line in path.read_text().splitlines():
@@ -579,6 +595,29 @@ def main() -> int:
               "TIMING_BASELINE/METRIC_FMT_BASELINE with a justification.")
         return 1
 
+    soak_rng_failures = []
+    soak_rng_counts = {}
+    soak_dir = PKG / SOAK_DIR
+    if soak_dir.is_dir():
+        for path in sorted(soak_dir.rglob("*.py")):
+            rel = str(path.relative_to(PKG))
+            n = _count_matches(path, SOAK_RNG_RE)
+            if n:
+                soak_rng_counts[rel] = n
+            if n > SOAK_RNG_BASELINE.get(rel, 0):
+                soak_rng_failures.append(
+                    f"  {rel}: {n} unseeded random draw(s), baseline "
+                    f"allows {SOAK_RNG_BASELINE.get(rel, 0)}")
+    if soak_rng_failures:
+        print("check_resilience: unseeded randomness breaks soak replay:\n"
+              + "\n".join(soak_rng_failures))
+        print("\nEvery draw in kubetorch_tpu/soak/ must come from an "
+              "explicitly seeded random.Random(seed) — module-level "
+              "random.* calls (or an argless random.Random()) make the "
+              "schedule, op stream, and shrunk repro files "
+              "non-reproducible. The baseline is empty on purpose.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
@@ -605,7 +644,9 @@ def main() -> int:
         + [f for f, allowed in TIMING_BASELINE.items()
            if timing_counts.get(f, 0) < allowed]
         + [f for f, allowed in METRIC_FMT_BASELINE.items()
-           if fmt_counts.get(f, 0) < allowed])
+           if fmt_counts.get(f, 0) < allowed]
+        + [f for f, allowed in SOAK_RNG_BASELINE.items()
+           if soak_rng_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
@@ -615,7 +656,8 @@ def main() -> int:
               "federation-topology reads, controller placements, "
               "data-store commit renames, checkpoint writes, step-path "
               "device_get sites, shared-memory segments, engine "
-              "param-tree assignments, and telemetry sites accounted for")
+              "param-tree assignments, telemetry sites, and soak RNG "
+              "draws accounted for")
     return 0
 
 

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
+.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -127,6 +127,14 @@ bench-ckpt:
 # rr-vs-affinity on the same seeded arrival schedule
 bench-serve:
 	$(PY_CPU) python scripts/bench_serve.py
+
+# fleet cold-start burn-down (ISSUE 16): 0->N replicas cold (fresh
+# interpreter, empty AOT cache) vs warm (pre-warmed template fork + shm
+# weight attach + persistent AOT executable cache) — p50/p99
+# time-to-first-token-served with per-phase anatomy — plus 0->16 joiners
+# pulling weights over the /route broadcast tree (~1x origin egress)
+bench-scale-out:
+	$(PY_CPU) python scripts/bench_serve.py --scale-out
 
 # cross-region failover bench (ISSUE 13): subprocess CPU-proxy regions
 # behind the geo front door, the primary SIGKILLed mid-run — failover

@@ -269,6 +269,16 @@ VERB_REGISTRY: tuple = (
              "self-SIGKILL at the N-th broadcast-window transfer (GET/HEAD "
              "on the data-transfer surface) — mid-transfer peer death",
              "kill-peer@1", process_fatal=True),
+    VerbSpec("kill-template", "process", "kill-template[:SIG]@OP_INDEX",
+             "template fork server", (),
+             "the pre-warmed template self-delivers SIG at its N-th fork "
+             "request, before forking — the supervisor must respawn it and "
+             "the joiner re-fork", "kill-template@0", process_fatal=True),
+    VerbSpec("kill-joiner", "process", "kill-joiner[:SIG]@OP_INDEX",
+             "forked replica boot", (),
+             "the N-th forked replica self-delivers SIG mid-boot (after "
+             "the weight attach, before serving) — the fleet must still "
+             "converge to N", "kill-joiner:9@1", process_fatal=True),
     VerbSpec("kill-region", "region", "kill-region[:OP_INDEX]@NAME",
              "middleware + step loop", (),
              "SIGKILL every process tagged KT_REGION=NAME at the op index "
@@ -320,8 +330,14 @@ def grammar_markdown() -> str:
 # fall-back-to-queue-path retry)
 _RANK_KINDS = ("kill-rank", "term-rank", "shm-corrupt")
 
+# verbs consumed by the cold-start machinery (ISSUE 16): the template
+# fork server counts fork requests, a forked replica counts its own boot
+# — both invisible to the HTTP middleware, like the rank verbs
+_TEMPLATE_KINDS = ("kill-template", "kill-joiner")
+
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
-_OP_INDEX_KINDS = _RANK_KINDS + ("kill-store-node", "kill-peer")
+_OP_INDEX_KINDS = (_RANK_KINDS + ("kill-store-node", "kill-peer")
+                   + _TEMPLATE_KINDS)
 
 # verbs whose @-suffix is a REGION NAME (the kill-region blast radius; its
 # op index rides the :ARG slot instead, since @ is taken)
@@ -430,6 +446,12 @@ def _parse_one(token: str, raw: str) -> Fault:
     if head == "kill-peer":
         return Fault(kind="kill-peer",
                      signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-template":
+        return Fault(kind="kill-template",
+                     signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-joiner":
+        return Fault(kind="kill-joiner",
+                     signal_no=_parse_signal(arg or "9", raw))
     if head == "term-rank":
         fault = Fault(kind="term-rank")
         if arg:
@@ -508,7 +530,9 @@ class ChaosEngine:
         # worker loop via rank_kill_plan()/rank_term_plan(), invisible to
         # the HTTP middleware; partition is client-side (netpool)
         faults = [f for f in faults
-                  if f.kind not in _RANK_KINDS and f.kind != "partition"]
+                  if f.kind not in _RANK_KINDS
+                  and f.kind not in _TEMPLATE_KINDS
+                  and f.kind != "partition"]
         # kill-store-node/kill-peer fire by op INDEX, not schedule order:
         # armed separately and checked against their own op counters every
         # request (kill-store-node: every client-origin data op; kill-peer:
@@ -790,6 +814,25 @@ def shm_corrupt_plan(spec: Optional[str] = None) -> int:
     to the msgpack/queue path instead of feeding garbage to
     ``device_put``."""
     return len(_rank_faults("shm-corrupt", spec))
+
+
+def template_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{fork-op index → signal}`` from the ``kill-template`` verbs: the
+    pre-warmed template (``serving/warm_template.py``) consults this as
+    fork requests arrive and self-delivers the signal BEFORE forking —
+    the deterministic template-death-mid-cold-burst drill. Honors
+    ``KT_CHAOS_RANK`` scoping like the rank verbs."""
+    return {f.op_index: f.signal_no
+            for f in _rank_faults("kill-template", spec)}
+
+
+def joiner_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{fork index → signal}`` from the ``kill-joiner`` verbs: a forked
+    replica whose index is in the plan self-delivers the signal mid-boot
+    (after the weight attach, before it reports ready) — a joiner dying
+    mid-fork. The supervisor must re-fork and the fleet still converge."""
+    return {f.op_index: f.signal_no
+            for f in _rank_faults("kill-joiner", spec)}
 
 
 def deliver_term_with_grace(pid: int, grace_s: float,

@@ -136,6 +136,22 @@ class KTConfig:
     # (or arriving while it is full) fall back to the queue path.
     shm_threshold: int = 0
     shm_ring_bytes: int = 64 * 1024 * 1024
+    # fleet cold-start burn-down (ISSUE 16). Same env layering
+    # (KT_AOT_CACHE / KT_AOT_CACHE_DIR / KT_SERVE_COLD_FAST_S /
+    # KT_SERVE_FAST_SCALE_FACTOR). aot_cache opts the serving engine into
+    # the persistent AOT compile cache (serve/aot_cache.py — serialized
+    # executables keyed by model/mesh/bucket/jax-version, so a fleet
+    # compiles once ever); aot_cache_dir overrides its on-disk root
+    # (default ~/.cache/kubetorch_tpu/aot). serve_cold_fast_s is the
+    # fast-scale gate: once a replica's MEASURED cold start
+    # (kt_cold_start_total_seconds) is at or below it, the SLO
+    # autoscaler's ≤2×/tick growth cap relaxes to
+    # serve_fast_scale_factor× (0.0, the default, keeps the 2× status
+    # quo — the gate needs both configuration AND evidence).
+    aot_cache: bool = False
+    aot_cache_dir: str = ""
+    serve_cold_fast_s: float = 0.0
+    serve_fast_scale_factor: int = 8
     # telemetry (kubetorch_tpu/telemetry.py): KT_TRACE=0 disables span
     # recording everywhere (the fast path stays allocation-free, see `make
     # bench-trace`); KT_TRACE_RING bounds the per-process span ring backing

@@ -1000,6 +1000,35 @@ def _serve_slo_s(cfg: Dict) -> float:
         return 0.0
 
 
+def _growth_cap(current: int, cold_start_s: float,
+                fast_s: Optional[float] = None,
+                factor: Optional[int] = None) -> int:
+    """Max replicas one SLO tick may grow an N-pod fleet to (ISSUE 16).
+
+    The historical cap is ≤2× per tick — conservative because a cold
+    replica used to take minutes to become useful, so over-scaling burnt
+    quota on pods that arrived after the burst. Once the fleet's
+    MEASURED cold start (the ``kt_cold_start_total_seconds`` gauge a
+    booted replica exports) drops below ``serve_cold_fast_s``, new
+    capacity is cheap and the cap relaxes to ``serve_fast_scale_factor``×
+    (config-gated: ``serve_cold_fast_s`` 0 = the 2× status quo). An
+    UNmeasured cold start (gauge 0/absent) never relaxes — the gate
+    trusts evidence, not configuration optimism."""
+    if fast_s is None or factor is None:
+        try:
+            from ..config import config
+            kcfg = config()
+            if fast_s is None:
+                fast_s = float(kcfg.get("serve_cold_fast_s", 0.0) or 0.0)
+            if factor is None:
+                factor = int(kcfg.get("serve_fast_scale_factor", 8) or 8)
+        except Exception:
+            fast_s, factor = fast_s or 0.0, factor or 8
+    if fast_s > 0 and 0 < cold_start_s <= fast_s:
+        return current * max(int(factor), 2)
+    return current * 2
+
+
 # one warning per (workload, raw value): a malformed duration in an
 # autoscaling config would otherwise log every 5s tick, forever
 _warned_durations: set = set()
@@ -1076,12 +1105,19 @@ async def _autoscale_one(state: ControllerState, record: Dict,
     last_activity = 0.0
     exec_sum = exec_count = 0.0
     qw_now: Dict[str, float] = {}
+    cold_starts: List[float] = []
     async with aiohttp.ClientSession() as sess:
         for ip in ips:
             try:
                 async with sess.get(f"http://{ip}:{port}/metrics",
                                     timeout=aiohttp.ClientTimeout(total=3)) as r:
                     text = await r.text()
+                # measured replica boot time (ISSUE 16): feeds the
+                # fast-scale gate below — 0/absent means never measured
+                cold = _parse_metric(
+                    text, "kt_cold_start_total_seconds") or 0.0
+                if cold > 0:
+                    cold_starts.append(cold)
                 inflight += int(_parse_metric(text, "kt_inflight_requests") or 0)
                 last_activity = max(
                     last_activity,
@@ -1157,11 +1193,19 @@ async def _autoscale_one(state: ControllerState, record: Dict,
         record["_qw_buckets"] = qw_now
         p90 = _quantile_from_buckets(delta, 0.9)
         if p90 is not None and p90 > slo_s:
-            from_slo = min(math.ceil(current * p90 / slo_s), current * 2)
+            # ≤2× per tick, unless the fleet's measured cold start says
+            # new capacity arrives in seconds (ISSUE 16 fast-scale gate);
+            # the most recently booted replica is the best evidence, so
+            # take the fleet minimum
+            cold_s = min(cold_starts) if cold_starts else 0.0
+            cap = _growth_cap(current, cold_s)
+            from_slo = min(math.ceil(current * p90 / slo_s), cap)
             if from_slo > desired:
                 desired = from_slo
                 reason = (f"queue_wait p90={p90 * 1000:.0f}ms > "
                           f"SLO {slo_s * 1000:.0f}ms")
+                if cap > current * 2:
+                    reason += f" fast-scale(cold={cold_s:.1f}s)"
     if max_s is not None:
         desired = min(desired, int(max_s))
     if desired != current:

@@ -191,6 +191,49 @@ class RolloutError(KubetorchError):
         self.actual = actual
 
 
+class AOTCacheMissError(KubetorchError):
+    """The persistent AOT compile cache holds no entry for this key
+    (ISSUE 16).
+
+    Raised by ``serve/aot_cache.py`` — the only compile-path entry in
+    ``serve/`` — when an engine asks for a serialized executable the cache
+    has never seen: a genuinely new ``(model config, mesh shape, bucket
+    set, jax/backend version)`` tuple, or a key component that moved
+    (version upgrade, mesh reshape, bucket change). Always recoverable:
+    the caller traces + compiles fresh and publishes the result, so the
+    fleet pays the compile exactly once per distinct key. ``reason``
+    distinguishes ``absent`` (never compiled) from ``incompatible``
+    (an entry exists for the name but under a different key digest)."""
+
+    def __init__(self, message: str = "AOT compile cache miss",
+                 key: Optional[str] = None, name: Optional[str] = None,
+                 reason: str = "absent"):
+        super().__init__(message)
+        self.key = key
+        self.name = name
+        self.reason = reason
+
+
+class AOTCacheCorruptError(AOTCacheMissError):
+    """A cached AOT executable failed its content check (ISSUE 16).
+
+    The payload's blake2b did not match the digest recorded at publish
+    time, or deserialization itself refused the bytes. Semantically a
+    MISS — the caller falls back to a fresh trace + compile and republishes
+    — but counted separately (``kt_aot_cache_total{result="corrupt"}``)
+    because a corrupt entry means bit-rot or a torn write, never a
+    version skew. A wrong or stale executable is never returned: the hash
+    gate runs before ``deserialize_and_load`` ever sees the bytes."""
+
+    def __init__(self, message: str = "AOT cache entry corrupt",
+                 key: Optional[str] = None, name: Optional[str] = None,
+                 expected: Optional[str] = None,
+                 actual: Optional[str] = None):
+        super().__init__(message, key=key, name=name, reason="corrupt")
+        self.expected = expected
+        self.actual = actual
+
+
 class StaleLeaseError(KubetorchError):
     """A placement attempt carried a fenced-off lease epoch (ISSUE 13).
 

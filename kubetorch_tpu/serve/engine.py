@@ -721,7 +721,7 @@ class GenerationEngine:
                  prefill_buckets: Sequence[int] = (128, 256, 512, 1024),
                  quantize_kv: bool = False, seed: int = 0,
                  decode_block: int = 1, auto_prefix: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None, aot_cache=None):
         self.params = params
         self.cfg = cfg
         self.slots = int(slots)
@@ -849,6 +849,16 @@ class GenerationEngine:
         self._tokens = self._steps = 0
         self._ttfts = deque(maxlen=256)   # rolling TTFT window
         self._t0 = time.monotonic()
+        # persistent AOT compile cache (ISSUE 16): pre-load the
+        # common-signature executables (prefill per bucket + the decode
+        # step) so a warm replica skips tracing entirely. Mesh-sharded
+        # engines keep the traced-jit path: serialized executables bake
+        # device assignments, which don't survive a different pod's mesh.
+        self._aot_cache = aot_cache
+        self._aot_exec: Dict[tuple, Any] = {}
+        if aot_cache is not None and self._mesh is None:
+            from .aot_cache import warm_engine
+            self._aot_exec = warm_engine(self, aot_cache)
 
     # -- adapters -----------------------------------------------------------
 
@@ -1551,10 +1561,20 @@ class GenerationEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :t] = req.prompt
             start = t
-            first, k_new, v_new, flp = _prefill(
-                self.params, jnp.asarray(padded), jnp.int32(t),
-                self._request_prefill_key(req, start), temps, self.cfg,
-                top_k=self.top_k, **lkw, **pkw)
+            # common signature (no adapter/nucleus/penalty kwargs): use
+            # the pre-loaded AOT executable when the cache warmed one —
+            # statics (cfg, top_k) are baked in, so only dynamic args pass
+            exe = (self._aot_exec.get(("prefill", bucket))
+                   if not lkw and not pkw else None)
+            if exe is not None:
+                first, k_new, v_new, flp = exe(
+                    self.params, jnp.asarray(padded), jnp.int32(t),
+                    self._request_prefill_key(req, start), temps)
+            else:
+                first, k_new, v_new, flp = _prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(t),
+                    self._request_prefill_key(req, start), temps, self.cfg,
+                    top_k=self.top_k, **lkw, **pkw)
         self._finish_admission(req, slot, first, flp, k_new, v_new, start,
                                temp, tp, row, aidx, bias_vec=bias_vec)
 
@@ -1626,21 +1646,39 @@ class GenerationEngine:
             # concurrent stream) to save at most K-1 ~ms-scale garbage
             # steps on the final dispatch of a draining backlog
             k = self.decode_block
+            # common decode signature (lkw is exactly {skeys}: no banks,
+            # nucleus, penalties, or bias): the warm AOT executable takes
+            # the dispatch; sticky features fall back to the traced jits
+            aot = (self._aot_exec.get(("decode", k))
+                   if set(lkw) == {"skeys"} else None)
             if k > 1:
-                (self._cache, _fp, _ft, toks_k, lps_k,
-                 counts) = _decode_block(
-                    self.params, self._cache, jnp.asarray(self._pos),
-                    jnp.asarray(self._tok), self._next_key(),
-                    jnp.asarray(self._temps), self.cfg, n_steps=k,
-                    top_k=self.top_k, **lkw)
+                if aot is not None:
+                    (self._cache, _fp, _ft, toks_k, lps_k,
+                     counts) = aot(
+                        self.params, self._cache, jnp.asarray(self._pos),
+                        jnp.asarray(self._tok), self._next_key(),
+                        jnp.asarray(self._temps), skeys=lkw["skeys"])
+                else:
+                    (self._cache, _fp, _ft, toks_k, lps_k,
+                     counts) = _decode_block(
+                        self.params, self._cache, jnp.asarray(self._pos),
+                        jnp.asarray(self._tok), self._next_key(),
+                        jnp.asarray(self._temps), self.cfg, n_steps=k,
+                        top_k=self.top_k, **lkw)
                 if self._counts is not None:
                     self._counts = counts
             else:
-                out = _decode_step(
-                    self.params, self._cache, jnp.asarray(self._pos),
-                    jnp.asarray(self._tok), self._next_key(),
-                    jnp.asarray(self._temps), self.cfg, top_k=self.top_k,
-                    **lkw)
+                if aot is not None:
+                    out = aot(
+                        self.params, self._cache, jnp.asarray(self._pos),
+                        jnp.asarray(self._tok), self._next_key(),
+                        jnp.asarray(self._temps), skeys=lkw["skeys"])
+                else:
+                    out = _decode_step(
+                        self.params, self._cache, jnp.asarray(self._pos),
+                        jnp.asarray(self._tok), self._next_key(),
+                        jnp.asarray(self._temps), self.cfg, top_k=self.top_k,
+                        **lkw)
                 if self._counts is not None:
                     self._cache, nxt, lps, self._counts = out
                 else:
@@ -1701,6 +1739,16 @@ class GenerationEngine:
             self._run_boundary_hooks()
 
     # -- introspection ------------------------------------------------------
+
+    def aot_stats(self) -> Dict[str, int]:
+        """AOT compile-cache lookup counts for THIS engine's warm-up
+        (``hit``/``miss``/``incompatible``/``corrupt``/``publish``…, the
+        local mirror of ``kt_aot_cache_total``), plus the number of
+        executables the dispatch sites can consult. Empty counts when the
+        engine was built without a cache."""
+        out = dict(self._aot_cache.counts) if self._aot_cache else {}
+        out["executables"] = len(self._aot_exec)
+        return out
 
     def stats(self) -> EngineStats:
         dt = max(time.monotonic() - self._t0, 1e-9)

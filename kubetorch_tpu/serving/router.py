@@ -267,6 +267,48 @@ class Router:
         # live-rollout canary state (set_canary/clear_canary); None when no
         # canary bake is in flight
         self._canary: Optional[Dict[str, Any]] = None
+        # readiness fence (ISSUE 16): replicas the aggressive autoscaler
+        # admitted before their cold start finished. A warming replica is
+        # ordered LAST and must pass a FRESH health probe before its
+        # first dispatch — the fast-scale path may add capacity early,
+        # but a request is never the thing that discovers a dead boot.
+        self._warming: Dict[str, float] = {}
+        self.warming_ttl_s = _env_float("KT_SERVE_WARMING_TTL_S", 120.0)
+
+    # -- readiness fence ------------------------------------------------------
+
+    def mark_warming(self, ip: str) -> None:
+        """Admit a still-booting replica behind the fence. Invalidates
+        any cached health for it — a stale "healthy" from a previous
+        generation at this ip must not leak through the fence."""
+        self._warming[ip] = time.monotonic()
+        self.health.invalidate(ip)
+
+    def fence_ready(self, ip: str) -> None:
+        """Clear the fence (a fresh probe succeeded): the replica now
+        takes normal traffic on the cached-health path."""
+        if self._warming.pop(ip, None) is not None:
+            telemetry.cold_start_metrics()["fence"].inc(result="admitted")
+
+    def _is_warming(self, ip: str) -> bool:
+        t = self._warming.get(ip)
+        if t is None:
+            return False
+        if time.monotonic() - t > self.warming_ttl_s:
+            # a boot that never came up: stop deprioritizing the ip (the
+            # controller has its own replace-or-retry loop) and count it
+            self._warming.pop(ip, None)
+            telemetry.cold_start_metrics()["fence"].inc(result="expired")
+            return False
+        return True
+
+    def _warming_last(self, order: List[str]) -> List[str]:
+        if not self._warming:
+            return order
+        warm = [ip for ip in order if self._is_warming(ip)]
+        if not warm:
+            return order
+        return [ip for ip in order if ip not in warm] + warm
 
     # -- canary --------------------------------------------------------------
 
@@ -538,14 +580,23 @@ class Router:
             started = time.monotonic()
             try:
                 order, affinity = self.select(ips, key)
-                order = self._canary_order(order)
+                order = self._warming_last(self._canary_order(order))
                 m["affinity"].inc(result=affinity)
                 sp.set_attr("affinity", affinity)
                 last_err: Optional[BaseException] = None
                 for target in order:
-                    if target != my_ip and not await self.health.healthy(
-                            pool, target):
-                        continue
+                    if target != my_ip:
+                        if self._is_warming(target):
+                            # fence: a warming replica takes its FIRST
+                            # request only after a fresh (uncached) probe
+                            self.health.invalidate(target)
+                            if not await self.health.healthy(pool, target):
+                                telemetry.cold_start_metrics()["fence"].inc(
+                                    result="blocked")
+                                continue
+                            self.fence_ready(target)
+                        elif not await self.health.healthy(pool, target):
+                            continue
                     depth = self._inflight.get(target, 0) + 1
                     self._inflight[target] = depth
                     m["batch_depth"].observe(float(depth))
@@ -616,5 +667,6 @@ class Router:
             "inflight": {ip: n for ip, n in self._inflight.items() if n},
             "affinity_hit_rate": (hits / (hits + misses)
                                   if hits + misses else 0.0),
+            "warming": sorted(self._warming),
             "canary": self.canary_state(),
         }

@@ -476,3 +476,185 @@ def decode_item_fields(item: Dict, ring: Optional[ShmRing],
 
 def has_envelopes(item: Dict) -> bool:
     return bool(item.get("_kt_shm"))
+
+
+# ---------------------------------------------------------------------------
+# Weight segments (ISSUE 16): template-fork weight residency
+# ---------------------------------------------------------------------------
+#
+# The pre-warmed template process stages the model's weights into ONE
+# shared segment; every forked replica attaches and materializes its
+# params with one memcpy per leaf and zero pickle. Same module as the
+# rings on purpose: segment naming (make_name → leak audits), the
+# attach-side resource-tracker discipline, and unlink ownership are one
+# policy, and lint #9 keeps every SharedMemory touch in this file.
+
+_TUPLE_KEY = "__kt_tuple__"
+
+
+def _flatten_weights(obj: Any, path: str, leaves: List) -> Any:
+    """JSON-able skeleton of the params tree with leaves replaced by
+    their index into ``leaves`` (appended in walk order). Tuples are
+    tagged so the attach side can rebuild them exactly."""
+    if _is_np_array(obj) or type(obj).__module__.startswith("jax"):
+        import numpy as np
+        arr = np.asarray(obj)
+        leaves.append((path, arr))
+        return len(leaves) - 1
+    if isinstance(obj, dict):
+        return {str(k): _flatten_weights(v, f"{path}/{k}", leaves)
+                for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {_TUPLE_KEY: [_flatten_weights(v, f"{path}/{i}", leaves)
+                             for i, v in enumerate(obj)]}
+    if isinstance(obj, list):
+        return [_flatten_weights(v, f"{path}/{i}", leaves)
+                for i, v in enumerate(obj)]
+    raise TypeError(
+        f"weight segment: unsupported leaf {type(obj).__name__} at {path!r}")
+
+
+def _unflatten_weights(skel: Any, arrays: List) -> Any:
+    if isinstance(skel, int):
+        return arrays[skel]
+    if isinstance(skel, dict):
+        if _TUPLE_KEY in skel and len(skel) == 1:
+            return tuple(_unflatten_weights(v, arrays)
+                         for v in skel[_TUPLE_KEY])
+        return {k: _unflatten_weights(v, arrays) for k, v in skel.items()}
+    if isinstance(skel, list):
+        return [_unflatten_weights(v, arrays) for v in skel]
+    raise TypeError(f"weight manifest: bad skeleton node {type(skel)}")
+
+
+class WeightSegment:
+    """A created-or-attached weight segment. The CREATOR (the template)
+    owns the lifetime: it holds the mapping for its whole life and
+    unlinks on close; attachers (forked replicas) close their mapping
+    after materializing params and never unlink. ``unlink_by_name``
+    covers the crash path — a supervisor that outlives a SIGKILLed
+    template removes the segment by its manifest name, so kills leak
+    nothing."""
+
+    def __init__(self, shm, manifest: Dict, owner: bool):
+        self.shm = shm
+        self.manifest = manifest
+        self.name = manifest["name"]
+        self._owner = owner
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        do_unlink = self._owner if unlink is None else unlink
+        try:
+            self.shm.close()
+        except Exception:  # noqa: BLE001 — idempotent teardown
+            pass
+        if do_unlink:
+            try:
+                self.shm.unlink()
+            except Exception:  # noqa: BLE001 — already gone is fine
+                pass
+
+    def __del__(self):
+        try:
+            self.shm.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def create_weight_segment(params: Any, tag: str = "weights") -> WeightSegment:
+    """Stage a params pytree (numpy/jax leaves under dict/list/tuple
+    containers) into one shared segment. Returns the owning
+    :class:`WeightSegment`; its ``manifest`` (JSON-able: segment name,
+    skeleton, per-leaf dtype/shape/offset, full-segment blake2b) is the
+    only thing a forked replica needs to attach."""
+    from multiprocessing import shared_memory
+    import numpy as np
+
+    leaves: List = []
+    skel = _flatten_weights(params, "", leaves)
+    metas, offset = [], 0
+    for path, arr in leaves:
+        nbytes = int(arr.nbytes)
+        metas.append({"path": path.lstrip("/"), "dtype": str(arr.dtype),
+                      "shape": list(arr.shape), "offset": offset,
+                      "nbytes": nbytes})
+        offset += nbytes
+    total = max(offset, 1)
+    name = make_name(tag)
+    shm = shared_memory.SharedMemory(name=name, create=True, size=total)
+    buf = np.frombuffer(shm.buf, dtype=np.uint8)
+    h = hashlib.blake2b(digest_size=16)
+    for meta, (path, arr) in zip(metas, leaves):
+        u8 = _u8_buffer(arr)
+        dst = buf[meta["offset"]:meta["offset"] + meta["nbytes"]]
+        dst[:] = u8
+        h.update(u8)
+    del buf                       # release the export before any close()
+    manifest = {"name": name, "total_bytes": offset, "tree": skel,
+                "leaves": metas, "blake2b": h.hexdigest()}
+    return WeightSegment(shm, manifest, owner=True)
+
+
+def attach_weight_segment(manifest: Dict, *, verify: bool = True) -> Any:
+    """Materialize a params pytree from a weight segment: attach by
+    name, optionally verify the full-segment blake2b (a corrupt segment
+    raises the typed :class:`DataCorruptionError`, never silently wrong
+    weights), then one memcpy per leaf into freshly allocated arrays.
+    The mapping is closed before returning — the returned tree owns its
+    memory, so the template can die without invalidating it."""
+    from multiprocessing import shared_memory
+    import numpy as np
+    from ..serialization import _np_dtype
+
+    # same tracker-sharing situation as ShmRing attach (see __init__):
+    # replicas are forked/spawned by the template, so the attach-side
+    # register is an idempotent set-add in the shared tracker
+    shm = shared_memory.SharedMemory(name=manifest["name"])
+    src = None
+    try:
+        src = np.frombuffer(shm.buf, dtype=np.uint8)
+        total = int(manifest["total_bytes"])
+        if verify:
+            actual = hashlib.blake2b(src[:total],
+                                     digest_size=16).hexdigest()
+            if actual != manifest["blake2b"]:
+                raise DataCorruptionError(
+                    f"weight segment {manifest['name']} hash mismatch",
+                    key=manifest["name"], expected=manifest["blake2b"],
+                    actual=actual, source="shm")
+        arrays = []
+        for meta in manifest["leaves"]:
+            arr = np.empty(meta["shape"], dtype=_np_dtype(meta["dtype"]))
+            dst = arr.reshape(-1).view(np.uint8)
+            dst[:] = src[meta["offset"]:meta["offset"] + meta["nbytes"]]
+            arrays.append(arr)
+        return _unflatten_weights(manifest["tree"], arrays)
+    finally:
+        src = None                # release the export before close()
+        try:
+            shm.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def unlink_weight_segment(name: str) -> bool:
+    """Best-effort unlink by name — the supervisor's crash-cleanup path
+    for a SIGKILLed template (no destructor ran). Returns whether a
+    segment was actually removed."""
+    from multiprocessing import shared_memory
+
+    try:
+        shm = shared_memory.SharedMemory(name=name)
+    except FileNotFoundError:
+        return False
+    except Exception:  # noqa: BLE001 — unreadable == nothing to free
+        return False
+    try:
+        shm.close()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        shm.unlink()
+        return True
+    except Exception:  # noqa: BLE001
+        return False

@@ -312,6 +312,16 @@ def run_soak(sched: Schedule, base_dir: str,
             gateway.kill()
         elif ev.action == "restart-gateway" and gateway is not None:
             gateway.start(chaos=False)
+        elif ev.action == "scale-to-zero" and gateway is not None:
+            # deliberate drain, delivered the way a scale-down lands on a
+            # pod: SIGKILL, no goodbye. The workload keeps firing into
+            # the zero-replica window — typed errors only, per invariant.
+            gateway.kill()
+        elif ev.action == "cold-burst" and gateway is not None:
+            # burst back under load; recovery runs clean (no chaos
+            # re-arm). The end-of-run leak scan owns the "no shm/tmp
+            # segments left behind" half of this episode's contract.
+            gateway.start(chaos=False)
         elif ev.action == "partition-start":
             os.environ["KT_CHAOS"] = ev.token
             os.environ["KT_CHAOS_SEED"] = str(sched.seed)

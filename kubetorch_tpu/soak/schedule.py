@@ -62,6 +62,11 @@ class FaultEvent:
       client-side ``partition`` verb (cross-region black hole)
     - ``lease-failover`` — re-grant the workload's lease to the standby
       region (epoch bump); the old holder must fence off
+    - ``scale-to-zero`` / ``cold-burst`` — drain the serving fleet to
+      zero replicas (SIGKILL, a scaled-down pod doesn't say goodbye),
+      then burst it back while the workload keeps firing — the cold
+      path under load; the leak scan then asserts the burst left no
+      shm/tmp segments behind
     """
 
     at_op: int
@@ -232,6 +237,21 @@ def generate(seed: int, profile: str, n_ops: int,
             mid = min(b, a + max(1, (b - a) // 2))
             events.append(FaultEvent(mid, "lease-failover", "job-0",
                                      verb="partition"))
+
+    # draw 7: scale-to-zero → cold-burst. Distinct from draw 5's
+    # kill/restart pair on purpose: this episode models a DELIBERATE
+    # drain (autoscaler took the fleet to zero) followed by a burst back
+    # under sustained load — the cold-start path, not the crash path —
+    # and carries the fork-server verbs so replays exercise template
+    # death during the re-warm. Appended after draw 6 so every earlier
+    # same-seed schedule keeps its draws (draw order is the format).
+    if has_gateway and third >= 4 and rng.random() < 0.6:
+        at = rng.randrange(1, max(2, n_ops // 2))
+        back = min(n_ops - 1, at + rng.randrange(2, max(3, n_ops // 3)))
+        events.append(FaultEvent(at, "scale-to-zero", "gateway:0",
+                                 verb="kill-template"))
+        events.append(FaultEvent(back, "cold-burst", "gateway:0",
+                                 verb="kill-joiner"))
 
     sched.events = sorted(events, key=lambda e: (e.at_op, e.action,
                                                  e.target))

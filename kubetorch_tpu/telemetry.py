@@ -834,6 +834,63 @@ def serve_metrics() -> Dict[str, "_Metric"]:
     return _SERVE_METRICS
 
 
+# ---------------------------------------------------------------------------
+# Fleet cold-start metrics (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+# Replica-boot phase taxonomy: where the 0→N seconds go. ``import`` =
+# python/module import, ``weight_fetch`` = pulling weights over the
+# broadcast tree, ``weight_attach`` = shm attach + device_put,
+# ``compile_or_cache`` = AOT cache probe + (on miss) trace/compile,
+# ``engine_init`` = engine construction end to end, ``first_token`` =
+# submit→first sampled token on the fresh replica.
+COLD_START_PHASES = ("import", "weight_fetch", "weight_attach",
+                     "compile_or_cache", "engine_init", "first_token")
+
+_COLD_START_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def cold_start_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the replica cold-start family (ISSUE 16): the
+    per-phase boot anatomy (``kt_cold_start_seconds{phase=...}``, phases
+    in :data:`COLD_START_PHASES`), the last full boot as a gauge the
+    controller's aggressive-autoscale gate scrapes, the AOT compile
+    cache's hit/miss/corrupt accounting, template fork outcomes, and the
+    router's readiness-fence decisions. One place so the bench, the perf
+    gate, the autoscaler scrape, and the docs stay on the same names."""
+    global _COLD_START_METRICS
+    if _COLD_START_METRICS is None:
+        _COLD_START_METRICS = {
+            "phase_seconds": histogram(
+                "kt_cold_start_seconds",
+                "Replica cold-start anatomy by phase (import, weight_fetch, "
+                "weight_attach, compile_or_cache, engine_init, first_token)",
+                labels=("phase",)),
+            "total": gauge(
+                "kt_cold_start_total_seconds",
+                "Wall-clock of this replica's last full cold start "
+                "(0 until one has been measured) — the signal the "
+                "controller's fast-scale gate reads"),
+            "aot": counter(
+                "kt_aot_cache_total",
+                "AOT compile-cache lookups by result (hit, miss, "
+                "incompatible, corrupt, publish, store_hit, store_publish)",
+                labels=("result",)),
+            "forks": counter(
+                "kt_template_forks_total",
+                "Template-process fork requests by outcome (ok, error, "
+                "template_dead)",
+                labels=("outcome",)),
+            "fence": counter(
+                "kt_serve_readiness_fence_total",
+                "Router readiness-fence decisions for still-warming "
+                "replicas (admitted = fence passed and cleared, blocked = "
+                "probe refused, expired = stale warming mark aged out)",
+                labels=("result",)),
+        }
+    return _COLD_START_METRICS
+
+
 _SOAK_METRICS: Optional[Dict[str, _Metric]] = None
 
 

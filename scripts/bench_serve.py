@@ -377,6 +377,374 @@ def _regions_main(args) -> int:
     return 1 if out["raw_errors"] else 0
 
 
+# ---------------------------------------------------------------------------
+# --scale-out: fleet cold-start burn-down (ISSUE 16)
+# ---------------------------------------------------------------------------
+#
+# Two claims, one run:
+#
+# A/B  0→N replicas COLD (fresh interpreters, empty AOT cache: pay
+#      import + weight pickle + XLA compile serially, the pre-ISSUE-16
+#      baseline) vs WARM (pre-warmed template fork + shm weight attach +
+#      persistent AOT executable cache). Reports per-arm p50/p99
+#      time-to-first-token-served plus the per-phase anatomy
+#      (import / weight_fetch|attach / compile_or_cache / first_token).
+#
+# egress  0→J joiners pull the SAME weights from one store through the
+#      /route broadcast tree (content-aliased subkeys): origin egress
+#      must stay ~1× the weight bytes however many replicas join —
+#      joiner subprocesses serve /_kt/data to each other exactly like
+#      pods do.
+
+
+def run_joiner(args) -> None:
+    """One joining replica (subprocess): serve the pod peer surface,
+    pull the weights key over the broadcast tree, report bytes by
+    source, keep serving so later joiners can fan out from us."""
+    import threading
+
+    from aiohttp import web
+
+    from kubetorch_tpu.data_store import commands as dsc
+    from kubetorch_tpu.data_store import netpool
+    from kubetorch_tpu.data_store.peer_cache import cache_get
+
+    def do_fetch() -> None:
+        t0 = time.monotonic()
+        out: Dict = {"idx": args.replica_id, "ok": False}
+        try:
+            fetcher = dsc._RoutedFetcher(args.store, args.key, True,
+                                         content_alias=True)
+            r = fetcher.fetch(f"{args.key}{dsc._INDEX_SUFFIX}", timeout=120,
+                              expect_hash=args.index_hash or None)
+            assert r.status_code == 200, f"index fetch {r.status_code}"
+            index = json.loads(r.content)
+
+            def one(item):
+                path, meta = item
+                rr = fetcher.fetch(f"{args.key}/{path}",
+                                   expect_hash=meta.get("blake2b"))
+                assert rr.status_code == 200, f"leaf {path} {rr.status_code}"
+                return len(rr.content)
+
+            nbytes = sum(netpool.map_concurrent(
+                one, index["leaves"].items()))
+            fetcher.complete()
+            out.update(ok=True, seconds=round(time.monotonic() - t0, 3),
+                       leaves=len(index["leaves"]), bytes=nbytes,
+                       bytes_by_source=dict(fetcher.bytes_by_source))
+        except BaseException as e:  # noqa: BLE001 — report, don't vanish
+            out["error"] = f"{type(e).__name__}: {e}"
+        tmp = f"{args.result}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, args.result)
+
+    async def serve_cached(request):
+        entry = await asyncio.get_event_loop().run_in_executor(
+            None, cache_get, request.match_info["key"])
+        if entry is None:
+            return web.json_response({"error": "not cached"}, status=404)
+        data, meta = entry
+        return web.Response(body=data,
+                            content_type="application/octet-stream",
+                            headers={"X-KT-Meta": json.dumps(meta)})
+
+    async def health(request):
+        return web.json_response({"status": "ok"})
+
+    async def on_startup(app):
+        threading.Thread(target=do_fetch, daemon=True).start()
+
+    app = web.Application(client_max_size=1 << 30)
+    app.router.add_get("/health", health)
+    app.router.add_get("/_kt/data/{key:.+}", serve_cached)
+    app.on_startup.append(on_startup)
+    web.run_app(app, host="127.0.0.1", port=args.port,
+                print=lambda *_: None)
+
+
+def _spawn_store(root: str) -> tuple:
+    import subprocess
+
+    from kubetorch_tpu.utils.procs import free_port, wait_for_port
+
+    port = free_port()
+    env = dict(os.environ)
+    env.update({"KT_STORE_FSYNC": "0", "KT_SCRUB_INTERVAL_S": "0"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.data_store.store_server",
+         "--host", "127.0.0.1", "--port", str(port), "--root", root],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    assert wait_for_port("127.0.0.1", port, timeout=30), "store not up"
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _phase_means(rows: List[Dict]) -> Dict[str, float]:
+    sums: Dict[str, float] = {}
+    for r in rows:
+        for k, v in (r.get("phases") or {}).items():
+            sums[k] = sums.get(k, 0.0) + v
+    return {k: round(v / max(len(rows), 1), 3)
+            for k, v in sorted(sums.items())}
+
+
+def _collect_results(result_dir: str, names: List[str],
+                     timeout: float) -> List[Dict]:
+    deadline = time.monotonic() + timeout
+    rows: List[Dict] = []
+    pending = list(names)
+    while pending and time.monotonic() < deadline:
+        still = []
+        for n in pending:
+            path = os.path.join(result_dir, n)
+            if os.path.exists(path):
+                with open(path) as f:
+                    rows.append(json.load(f))
+            else:
+                still.append(n)
+        pending = still
+        if pending:
+            time.sleep(0.25)
+    if pending:
+        raise RuntimeError(f"replicas never reported: {pending}")
+    return rows
+
+
+def _make_weights(weights_path: str):
+    """Driver-side model init: the tiny bench model, saved numpy-only so
+    cold boots / the template load it without this process's jax state."""
+    import jax
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serving.warm_template import save_weights
+
+    import jax.numpy as jnp
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attn_impl="xla", remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    save_weights(weights_path, params)
+    import numpy as np
+    params_np = jax.tree_util.tree_map(np.asarray, params)
+    return params_np
+
+
+def _cold_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    env.pop("KT_CHAOS", None)
+    return env
+
+
+def _run_cold_arm(spec: Dict, base: str, n: int, tag: str,
+                  timeout: float) -> List[Dict]:
+    """N fresh interpreters booting concurrently — the 0→N cold burst."""
+    import subprocess
+
+    spec_file = os.path.join(base, f"spec_{tag}.json")
+    with open(spec_file, "w") as f:
+        json.dump(spec, f)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "kubetorch_tpu.serving.warm_template",
+         "--cold", spec_file, str(i), str(time.time())],
+        env=_cold_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL) for i in range(n)]
+    try:
+        return _collect_results(spec["result_dir"],
+                                [f"cold_{i}.json" for i in range(n)],
+                                timeout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _run_warm_arm(spec: Dict, n: int, timeout: float) -> tuple:
+    """Template fork burst: one pre-warmed template, N forked replicas
+    attaching weights over shm and compiling through the seeded AOT
+    cache."""
+    from kubetorch_tpu.serving.warm_template import TemplateSupervisor
+
+    t0 = time.monotonic()
+    with TemplateSupervisor(spec) as sup:
+        template_ready_s = time.monotonic() - t0
+        for i in range(n):
+            sup.fork(i)
+        rows = _collect_results(spec["result_dir"],
+                                [f"replica_{i}.json" for i in range(n)],
+                                timeout)
+    return rows, template_ready_s
+
+
+def _scaleout_egress(params_np, args) -> Dict:
+    """0→J joiners over the broadcast tree: origin egress vs weight
+    bytes."""
+    import subprocess
+    import tempfile
+
+    from kubetorch_tpu.data_store import commands as dsc
+    from kubetorch_tpu.utils.procs import free_port, kill_process_tree
+
+    key = "serve/scaleout/weights"
+    procs = []
+    with tempfile.TemporaryDirectory(prefix="kt-scaleout-") as base:
+        try:
+            store_proc, store_url = _spawn_store(os.path.join(base, "store"))
+            procs.append(store_proc)
+            pushed = dsc.put(key, params_np, store_url=store_url)
+            weight_bytes = pushed["bytes"]
+            results = []
+            for i in range(args.joiners):
+                port = free_port()
+                result = os.path.join(base, f"join_{i}.json")
+                results.append(result)
+                env = _cold_env()
+                env.update({
+                    "POD_IP": "127.0.0.1",
+                    "KT_SERVER_PORT": str(port),
+                    "KT_DATA_CACHE_DIR": os.path.join(base, f"cache-{i}"),
+                    "KT_PEER_WAIT_S": "60",
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--joiner",
+                     "--port", str(port), "--store", store_url,
+                     "--key", key,
+                     "--index-hash", pushed.get("index_blake2b") or "",
+                     "--replica-id", str(i), "--result", result],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            deadline = time.monotonic() + args.timeout
+            rows: List[Dict] = []
+            pending = list(results)
+            while pending and time.monotonic() < deadline:
+                still = []
+                for path in pending:
+                    if os.path.exists(path):
+                        with open(path) as f:
+                            rows.append(json.load(f))
+                    else:
+                        still.append(path)
+                pending = still
+                if pending:
+                    time.sleep(0.25)
+            if pending:
+                raise RuntimeError(
+                    f"joiners never finished: {len(pending)}/{args.joiners}")
+            bad = [r for r in rows if not r.get("ok")]
+            if bad:
+                raise RuntimeError(f"joiner failed: {bad[0].get('error')}")
+            by_source: Dict[str, int] = {}
+            for r in rows:
+                for src, b in (r.get("bytes_by_source") or {}).items():
+                    by_source[src] = by_source.get(src, 0) + b
+            origin = by_source.get("store", 0)
+            return {
+                "joiners": args.joiners,
+                "weight_bytes": weight_bytes,
+                "bytes_by_source": by_source,
+                "origin_egress_x": round(origin / max(weight_bytes, 1), 2),
+                "join_p50_s": round(_percentile(
+                    [r["seconds"] for r in rows], 0.5), 2),
+                "join_p99_s": round(_percentile(
+                    [r["seconds"] for r in rows], 0.99), 2),
+            }
+        finally:
+            for p in procs:
+                kill_process_tree(p.pid)
+
+
+def _scaleout_main(args) -> int:
+    import tempfile
+
+    print(f"fleet cold-start bench: 0->{args.n} replicas, cold "
+          f"(fresh interpreter + empty AOT cache) vs warm (template fork "
+          f"+ shm weights + AOT cache); egress: 0->{args.joiners} joiners "
+          f"over the broadcast tree")
+    with tempfile.TemporaryDirectory(prefix="kt-coldstart-") as base:
+        weights = os.path.join(base, "weights.npy")
+        params_np = _make_weights(weights)
+        spec_base = {
+            "weights": weights,
+            "model": {"kind": "llama-tiny"},
+            "engine": {"slots": 2, "max_len": 64,
+                       "prefill_buckets": [8, 16, 32]},
+            "probe_prompt": [1, 2, 3],
+            "probe_tokens": 2,
+            "chaos": "",
+        }
+
+        # arm 1: cold — every replica pays import + pickle + compile
+        cold_spec = dict(spec_base,
+                         result_dir=os.path.join(base, "cold"),
+                         aot_root=os.path.join(base, "aot-cold"))
+        cold = _run_cold_arm(cold_spec, base, args.n, "cold", args.timeout)
+
+        # seed the persistent AOT cache once (the first-ever boot of this
+        # model/mesh/bucket key — every later boot, pod, and fork hits it)
+        warm_aot = os.path.join(base, "aot-warm")
+        seed_spec = dict(spec_base,
+                         result_dir=os.path.join(base, "seed"),
+                         aot_root=warm_aot)
+        t0 = time.monotonic()
+        _run_cold_arm(seed_spec, base, 1, "seed", args.timeout)
+        seed_s = time.monotonic() - t0
+
+        # arm 2: warm — template fork + shm attach + AOT cache hits
+        warm_spec = dict(spec_base,
+                         result_dir=os.path.join(base, "warm"),
+                         aot_root=warm_aot)
+        warm, template_ready_s = _run_warm_arm(warm_spec, args.n,
+                                               args.timeout)
+
+        egress = (None if args.skip_egress
+                  else _scaleout_egress(params_np, args))
+
+    cold_t = [r["total_s"] for r in cold]
+    warm_t = [r["total_s"] for r in warm]
+    arms = {
+        "cold": {"n": args.n,
+                 "p50_s": round(_percentile(cold_t, 0.5), 2),
+                 "p99_s": round(_percentile(cold_t, 0.99), 2),
+                 "phases_mean_s": _phase_means(cold)},
+        "warm": {"n": args.n,
+                 "p50_s": round(_percentile(warm_t, 0.5), 2),
+                 "p99_s": round(_percentile(warm_t, 0.99), 2),
+                 "phases_mean_s": _phase_means(warm),
+                 "aot": (warm[0].get("aot") or {}),
+                 "template_ready_s": round(template_ready_s, 2),
+                 "aot_seed_s": round(seed_s, 2)},
+    }
+    speedup = (arms["cold"]["p50_s"] / arms["warm"]["p50_s"]
+               if arms["warm"]["p50_s"] else float("inf"))
+
+    print(f"\n{'arm':<6} {'p50':>8} {'p99':>8}   phase anatomy (mean s)")
+    for name in ("cold", "warm"):
+        a = arms[name]
+        anatomy = " ".join(f"{k}={v}" for k, v in a["phases_mean_s"].items())
+        print(f"{name:<6} {a['p50_s']:>7.2f}s {a['p99_s']:>7.2f}s   "
+              f"{anatomy}")
+    print(f"\nwarm vs cold: p50 {speedup:.1f}x faster "
+          f"(template ready in {arms['warm']['template_ready_s']}s, "
+          f"one-time AOT seed {arms['warm']['aot_seed_s']}s, "
+          f"fork-side AOT counts {arms['warm']['aot']})")
+    acceptance = {"warm_speedup_x": round(speedup, 1),
+                  "warm_speedup_ge_5x": speedup >= 5.0}
+    if egress is not None:
+        print(f"egress: {egress['joiners']} joiners pulled "
+              f"{egress['weight_bytes'] / 1e6:.1f}MB weights with "
+              f"{egress['origin_egress_x']}x origin egress "
+              f"(by source: {egress['bytes_by_source']}; join p50 "
+              f"{egress['join_p50_s']}s p99 {egress['join_p99_s']}s)")
+        acceptance["origin_egress_x"] = egress["origin_egress_x"]
+        acceptance["origin_egress_le_2x"] = egress["origin_egress_x"] <= 2.0
+    out = {"metric": "cold_start_speedup_x", "value": round(speedup, 1),
+           "unit": "x",
+           "detail": {"arms": arms, "egress": egress,
+                      "acceptance": acceptance}}
+    print("\n" + json.dumps(out))
+    return 0 if all(v for k, v in acceptance.items()
+                    if isinstance(v, bool)) else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--regions", type=int, default=0,
@@ -385,6 +753,26 @@ def main() -> int:
                         "region-0 SIGKILLed at --kill-at (ISSUE 13)")
     p.add_argument("--kill-at", type=float, default=4.0,
                    help="seconds into the run to SIGKILL region-0")
+    p.add_argument("--scale-out", action="store_true",
+                   help="fleet cold-start burn-down: 0->N replicas cold "
+                        "vs template-fork warm, plus broadcast-tree "
+                        "joiner egress (ISSUE 16)")
+    p.add_argument("--n", type=int, default=4,
+                   help="scale-out A/B replica count per arm")
+    p.add_argument("--joiners", type=int, default=16,
+                   help="scale-out egress joiner count")
+    p.add_argument("--skip-egress", action="store_true",
+                   help="scale-out: A/B arms only")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="scale-out per-phase wait budget")
+    # internal: scale-out joiner subprocess mode
+    p.add_argument("--joiner", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--store", default="", help=argparse.SUPPRESS)
+    p.add_argument("--key", default="", help=argparse.SUPPRESS)
+    p.add_argument("--index-hash", default="", help=argparse.SUPPRESS)
+    p.add_argument("--replica-id", default="", help=argparse.SUPPRESS)
+    p.add_argument("--result", default="", help=argparse.SUPPRESS)
     p.add_argument("--sessions", type=int, default=1200)
     p.add_argument("--turns", type=int, default=3)
     p.add_argument("--replicas", type=int, default=8)
@@ -410,6 +798,11 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=1234)
     args = p.parse_args()
 
+    if args.joiner:
+        run_joiner(args)
+        return 0
+    if args.scale_out:
+        return _scaleout_main(args)
     if args.regions > 0:
         # region mode defaults: a lighter schedule (every request crosses
         # a real HTTP hop into a subprocess) unless explicitly overridden

@@ -34,6 +34,11 @@ Gated stages and how each is driven:
   (``copy_to_host_async`` fan-out + IO-thread handoff) against a real
   store subprocess; gated so the async snapshot path can never quietly
   regress back to blocking on a full host copy.
+- ``cold_start`` — AOT-cache-warmed engine inits against one persistent
+  cache dir (first boot seeds, the rest must HIT): reads
+  ``kt_cold_start_seconds{phase="compile_or_cache"}`` so a broken cache
+  key or serialize path (silent fallback to full XLA compiles) fails the
+  gate instead of slowing every fleet scale-out (ISSUE 16).
 
 Gate rule (per stage)::
 
@@ -73,14 +78,17 @@ os.environ.setdefault("KT_SHM_THRESHOLD", "65536")
 
 BASELINE_PATH = os.path.join(REPO, "scripts", "perf_baseline.json")
 GATED_STAGES = ("deserialize", "queue_wait", "execute", "store_fetch",
-                "shm_copy", "rollout_apply", "train_step", "snapshot_stall")
+                "shm_copy", "rollout_apply", "train_step", "snapshot_stall",
+                "cold_start")
 
 # most stages read the kt_stage_seconds histogram; the two train-loop
 # stages (ISSUE 12) read the step-anatomy histogram the train wrapper and
-# Checkpointer.maybe_save observe into
+# Checkpointer.maybe_save observe into, and cold_start (ISSUE 16) reads
+# the boot-anatomy histogram the AOT-cached engine init observes
 STAGE_SOURCES = {
     "train_step": ("kt_train_step_seconds", 'phase="compute"'),
     "snapshot_stall": ("kt_train_step_seconds", 'phase="snapshot_stall"'),
+    "cold_start": ("kt_cold_start_seconds", 'phase="compile_or_cache"'),
 }
 
 PAYLOAD_MODULE = textwrap.dedent("""
@@ -266,9 +274,35 @@ def _drive_train_step(steps: int) -> None:
     float(m["loss"])
 
 
+def _drive_cold_start(boots: int) -> None:
+    """Real AOT-cached engine inits against one persistent cache dir: the
+    first boot seeds (compiles + publishes — observed too, but p50-safe
+    across ``boots`` warm inits), every later boot must be a cache HIT.
+    Each init observes ``kt_cold_start_seconds{phase="compile_or_cache"}``
+    — the stage this gate pins so a broken cache key or a lost serialize
+    path (which silently falls back to full XLA compiles) shows up as a
+    p50 cliff, not a slow fleet rollout (ISSUE 16)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init
+    from kubetorch_tpu.serve.aot_cache import AOTCompileCache
+    from kubetorch_tpu.serve.engine import GenerationEngine
+
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32, remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    with tempfile.TemporaryDirectory() as root:
+        for _ in range(boots + 1):
+            eng = GenerationEngine(params, cfg, slots=2, max_len=64,
+                                   prefill_buckets=(8,),
+                                   aot_cache=AOTCompileCache(root))
+            eng.stop()
+
+
 def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
             store_gets: int, rollout_calls: int, rollout_kb: int,
-            train_steps: int, snapshot_saves: int) -> dict:
+            train_steps: int, snapshot_saves: int,
+            cold_boots: int) -> dict:
     """{stage: p50 seconds} measured from a fresh registry."""
     from kubetorch_tpu import telemetry
     from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
@@ -301,6 +335,7 @@ def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
         asyncio.run(_drive_rollout(rollout_calls, rollout_kb))
     _drive_store(store_gets, snapshot_saves)
     _drive_train_step(train_steps)
+    _drive_cold_start(cold_boots)
     text = telemetry.REGISTRY.render()
     out = {}
     for stage in GATED_STAGES:
@@ -327,6 +362,7 @@ def main() -> int:
     p.add_argument("--rollout-kb", type=int, default=512)
     p.add_argument("--train-steps", type=int, default=20)
     p.add_argument("--snapshot-saves", type=int, default=20)
+    p.add_argument("--cold-boots", type=int, default=6)
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
@@ -338,7 +374,7 @@ def main() -> int:
     measured = measure(args.calls, args.payload_kb, args.shm_calls,
                        args.shm_kb, args.store_gets, args.rollout_calls,
                        args.rollout_kb, args.train_steps,
-                       args.snapshot_saves)
+                       args.snapshot_saves, args.cold_boots)
 
     if args.update or not os.path.exists(BASELINE_PATH):
         baseline = {
@@ -352,6 +388,7 @@ def main() -> int:
             "rollout_kb": args.rollout_kb,
             "train_steps": args.train_steps,
             "snapshot_saves": args.snapshot_saves,
+            "cold_boots": args.cold_boots,
             "note": "p50 seconds per stage from scripts/check_perf_gate.py"
                     " --update; gate = p50 <= baseline*(1+tol) + floor",
         }

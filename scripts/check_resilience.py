@@ -285,6 +285,22 @@ SOAK_DIR = "soak"
 SOAK_RNG_BASELINE: dict = {}
 
 
+# AOT compile-path containment (ISSUE 16). Every executable a serving
+# engine runs must come through serve/aot_cache.py: the cache keys the
+# compile by (model arch, mesh, buckets, flags, jax version), verifies
+# serialized entries by content hash, and counts hit/miss/corrupt — an
+# ad-hoc ``fn.lower(...).compile()`` or a raw ``serialize_executable``
+# call elsewhere in serve/ silently re-introduces the cold-compile bill
+# on a path the fleet bench and the cold_start perf gate never see.
+# (``\.lower\([^)]`` needs an argument so ``str.lower()`` never trips
+# it; AOT lowering always passes example args.) The baseline is EMPTY on
+# purpose and must stay that way.
+AOT_RE = re.compile(
+    r"serialize_executable|deserialize_and_load|\.lower\([^)]")
+AOT_EXEMPT = {"aot_cache.py"}
+AOT_BASELINE: dict = {}
+
+
 def _count_matches(path: Path, pattern: re.Pattern) -> int:
     n = 0
     for line in path.read_text().splitlines():
@@ -618,6 +634,32 @@ def main() -> int:
               "non-reproducible. The baseline is empty on purpose.")
         return 1
 
+    aot_failures = []
+    aot_counts = {}
+    for path in sorted((PKG / "serve").rglob("*.py")):
+        if path.name in AOT_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, AOT_RE)
+        if n:
+            aot_counts[rel] = n
+        allowed = AOT_BASELINE.get(rel, 0)
+        if n > allowed:
+            aot_failures.append(
+                f"  {rel}: {n} raw compile-path entry point(s), baseline "
+                f"allows {allowed}")
+    if aot_failures:
+        print("check_resilience: raw AOT compile-path entries bypass the "
+              "executable cache:\n" + "\n".join(aot_failures))
+        print("\nServing executables are lowered, serialized, and "
+              "deserialized ONLY in serve/aot_cache.py (AOTCompileCache/"
+              "warm_engine): the cache key pins model/mesh/buckets/jax "
+              "version, entries are hash-verified, and hits/misses/"
+              "corruption are counted — an ad-hoc .lower().compile() "
+              "re-introduces the cold-compile bill invisibly. The "
+              "baseline is empty on purpose.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
@@ -646,7 +688,9 @@ def main() -> int:
         + [f for f, allowed in METRIC_FMT_BASELINE.items()
            if fmt_counts.get(f, 0) < allowed]
         + [f for f, allowed in SOAK_RNG_BASELINE.items()
-           if soak_rng_counts.get(f, 0) < allowed])
+           if soak_rng_counts.get(f, 0) < allowed]
+        + [f for f, allowed in AOT_BASELINE.items()
+           if aot_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
@@ -656,8 +700,8 @@ def main() -> int:
               "federation-topology reads, controller placements, "
               "data-store commit renames, checkpoint writes, step-path "
               "device_get sites, shared-memory segments, engine "
-              "param-tree assignments, telemetry sites, and soak RNG "
-              "draws accounted for")
+              "param-tree assignments, telemetry sites, soak RNG "
+              "draws, and AOT compile-path entries accounted for")
     return 0
 
 

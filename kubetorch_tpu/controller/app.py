@@ -9,7 +9,7 @@ import os
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from aiohttp import web, WSMsgType
 
@@ -1000,6 +1000,21 @@ def _serve_slo_s(cfg: Dict) -> float:
         return 0.0
 
 
+def _freshest_cold_start(measurements: List[Tuple[float, float]]) -> float:
+    """The fleet cold-start the fast-scale gate should trust, from
+    ``(boot_timestamp, seconds)`` pairs scraped off the replicas: the most
+    RECENTLY booted replica's measurement. One historic fast boot (warm
+    AOT cache, template alive) must not keep the relaxed cap after
+    conditions regress (template dead, cache wiped) — recency, not the
+    fleet minimum, is the evidence. Replicas that predate the timestamp
+    gauge report ts=0 and lose to any timestamped boot; among themselves
+    (and on timestamp ties) the SLOWEST measurement wins, so missing
+    recency degrades toward the conservative 2× cap, never away from it."""
+    if not measurements:
+        return 0.0
+    return max(measurements)[1]
+
+
 def _growth_cap(current: int, cold_start_s: float,
                 fast_s: Optional[float] = None,
                 factor: Optional[int] = None) -> int:
@@ -1105,7 +1120,7 @@ async def _autoscale_one(state: ControllerState, record: Dict,
     last_activity = 0.0
     exec_sum = exec_count = 0.0
     qw_now: Dict[str, float] = {}
-    cold_starts: List[float] = []
+    cold_starts: List[Tuple[float, float]] = []   # (boot_ts, seconds)
     async with aiohttp.ClientSession() as sess:
         for ip in ips:
             try:
@@ -1113,11 +1128,15 @@ async def _autoscale_one(state: ControllerState, record: Dict,
                                     timeout=aiohttp.ClientTimeout(total=3)) as r:
                     text = await r.text()
                 # measured replica boot time (ISSUE 16): feeds the
-                # fast-scale gate below — 0/absent means never measured
+                # fast-scale gate below — 0/absent means never measured.
+                # The boot timestamp rides along so the gate can rank by
+                # recency instead of trusting a historic fast boot.
                 cold = _parse_metric(
                     text, "kt_cold_start_total_seconds") or 0.0
                 if cold > 0:
-                    cold_starts.append(cold)
+                    ts = _parse_metric(
+                        text, "kt_cold_start_timestamp_seconds") or 0.0
+                    cold_starts.append((ts, cold))
                 inflight += int(_parse_metric(text, "kt_inflight_requests") or 0)
                 last_activity = max(
                     last_activity,
@@ -1195,9 +1214,9 @@ async def _autoscale_one(state: ControllerState, record: Dict,
         if p90 is not None and p90 > slo_s:
             # ≤2× per tick, unless the fleet's measured cold start says
             # new capacity arrives in seconds (ISSUE 16 fast-scale gate);
-            # the most recently booted replica is the best evidence, so
-            # take the fleet minimum
-            cold_s = min(cold_starts) if cold_starts else 0.0
+            # the most recently booted replica is the best evidence —
+            # ranked by boot timestamp, pessimistic on ties/absence
+            cold_s = _freshest_cold_start(cold_starts)
             cap = _growth_cap(current, cold_s)
             from_slo = min(math.ceil(current * p90 / slo_s), cap)
             if from_slo > desired:

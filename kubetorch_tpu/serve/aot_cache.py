@@ -74,6 +74,12 @@ class AOTKey:
     max_len: int
     quantize_kv: bool
     decode_block: int
+    # top_k is an engine constructor knob independent of cfg, baked into
+    # every executable as a lower-time static (engine.py dispatch sites
+    # pass top_k=self.top_k) — it MUST participate in the digest or two
+    # engines differing only in top_k would swap executables and sample
+    # wrong
+    top_k: Optional[int] = None
     jax_version: str = ""
     jaxlib_version: str = ""
     backend: str = ""
@@ -94,6 +100,7 @@ class AOTKey:
             max_len=engine.max_len,
             quantize_kv=engine.quantize_kv,
             decode_block=engine.decode_block,
+            top_k=engine.top_k,
             jax_version=jax.__version__,
             jaxlib_version=getattr(jaxlib, "__version__", ""),
             backend=jax.default_backend(),
@@ -165,28 +172,63 @@ class AOTCompileCache:
     def entry_dir(self, key: AOTKey) -> Path:
         return self.root / key.digest()
 
-    def _store_key(self, key: AOTKey, name: str) -> str:
-        return f"aot/{key.digest()}/{name}"
+    def _store_key(self, key: AOTKey, name: str, content_hash: str) -> str:
+        # the payload key is CONTENT-ADDRESSED: the blake2b of the bytes
+        # is part of the name, so a fetched payload is verifiable against
+        # its own key before anything deserializes it
+        return f"aot/{key.digest()}/{name}/{content_hash}"
+
+    def _store_ptr_key(self, key: AOTKey, name: str) -> str:
+        return f"aot/{key.digest()}/{name}.ptr"
 
     # -- store ring layer ---------------------------------------------------
+    #
+    # Trust model: the executable payload rides pickle + XLA's loader, so
+    # loading one is code execution. The content-addressed key pins the
+    # payload to the hash its publisher named — a torn copy, a partial
+    # overwrite, or a blob swapped under an existing key is rejected
+    # before pickle ever sees it. What it cannot provide is provenance: a
+    # writer who controls BOTH the pointer and the payload can still name
+    # its own hash. Enabling ``store=True`` therefore asserts that every
+    # principal with write access to the ``aot/`` prefix (and to the
+    # local cache dir) is trusted to run code on this fleet — the same
+    # trust the weight-distribution path already extends to the ring.
 
     def _store_fetch(self, key: AOTKey, name: str, bin_path: Path) -> bool:
         """Pull ``name`` from the store ring into the local layer. Any
-        failure (store down, key absent) is a plain miss — the store is
-        an accelerator, never a correctness dependency."""
+        failure (store down, key absent, content-address mismatch) is a
+        plain miss — the store is an accelerator, never a correctness
+        dependency."""
         if not self.store:
             return False
+        tmp = bin_path.with_name(f"{bin_path.name}.fetch.tmp")
         try:
             from ..data_store import commands as ds
-            tmp = bin_path.with_name(f"{bin_path.name}.fetch.tmp")
-            ds.get(self._store_key(key, name), dest=str(tmp),
+            tmp.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                ds.get(self._store_ptr_key(key, name), dest=str(tmp),
+                       store_url=self.store_url)
+                want = tmp.read_bytes().decode("ascii").strip()
+            finally:
+                tmp.unlink(missing_ok=True)
+            if len(want) != 32 or not all(c in "0123456789abcdef"
+                                          for c in want):
+                self._count("store_corrupt")
+                return False
+            ds.get(self._store_key(key, name, want), dest=str(tmp),
                    store_url=self.store_url)
             data = tmp.read_bytes()
             tmp.unlink(missing_ok=True)
+            if _blake2b(data) != want:
+                # the payload does not match the hash its own key names:
+                # never let it near pickle, never cache it locally
+                self._count("store_corrupt")
+                return False
             self._write_entry(key, name, data)
             self._count("store_hit")
             return True
         except Exception:
+            tmp.unlink(missing_ok=True)
             return False
 
     def _store_publish(self, key: AOTKey, name: str, bin_path: Path) -> None:
@@ -194,8 +236,18 @@ class AOTCompileCache:
             return
         try:
             from ..data_store import commands as ds
-            ds.put(self._store_key(key, name), str(bin_path),
+            content_hash = _blake2b(bin_path.read_bytes())
+            # payload first, pointer last: a reader that wins the race
+            # sees either a complete pair or a plain miss
+            ds.put(self._store_key(key, name, content_hash), str(bin_path),
                    store_url=self.store_url)
+            ptr = bin_path.with_name(f"{bin_path.name}.ptr.tmp")
+            ptr.write_text(content_hash)
+            try:
+                ds.put(self._store_ptr_key(key, name), str(ptr),
+                       store_url=self.store_url)
+            finally:
+                ptr.unlink(missing_ok=True)
             self._count("store_publish")
         except Exception:
             pass

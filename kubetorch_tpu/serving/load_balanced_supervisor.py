@@ -47,8 +47,13 @@ class LoadBalancedSupervisor(DistributedSupervisor):
             # we are the chosen pod for a forwarded call: run locally
             return await self._call_local(method, args, kwargs, timeout)
         ips = sorted(self.pod_ips() or [my_pod_ip()])
+        pool = RemoteWorkerPool.shared(self.server_port)
+        # readiness fence wiring (ISSUE 16): ips that just appeared in the
+        # membership are still-booting replicas — fence them and let the
+        # router's background prober admit each one when its probe passes
+        self.router.observe_membership(ips, pool)
         return await self.router.dispatch(
-            pool=RemoteWorkerPool.shared(self.server_port), ips=ips,
+            pool=pool, ips=ips,
             my_ip=my_pod_ip(), method=method, args=args, kwargs=kwargs,
             headers=headers, timeout=timeout, local_call=self._call_local)
 

@@ -271,18 +271,86 @@ class Router:
         # admitted before their cold start finished. A warming replica is
         # ordered LAST and must pass a FRESH health probe before its
         # first dispatch — the fast-scale path may add capacity early,
-        # but a request is never the thing that discovers a dead boot.
+        # but a request is never the thing that discovers a dead boot. A
+        # background prober (started when a pool is known) clears the
+        # fence the moment a boot completes, so new capacity takes
+        # traffic in one probe interval even while the rest of the fleet
+        # stays healthy; the in-dispatch probe is the last resort, not
+        # the admission path.
         self._warming: Dict[str, float] = {}
         self.warming_ttl_s = _env_float("KT_SERVE_WARMING_TTL_S", 120.0)
+        self.warming_probe_s = _env_float("KT_SERVE_WARMING_PROBE_S", 0.5)
+        self._members: Optional[set] = None
+        self._prober_task: Optional["asyncio.Task"] = None
 
     # -- readiness fence ------------------------------------------------------
 
-    def mark_warming(self, ip: str) -> None:
+    def mark_warming(self, ip: str, pool=None) -> None:
         """Admit a still-booting replica behind the fence. Invalidates
         any cached health for it — a stale "healthy" from a previous
-        generation at this ip must not leak through the fence."""
+        generation at this ip must not leak through the fence. With a
+        ``pool`` (the production path — :meth:`observe_membership`), a
+        background prober starts immediately so the fence clears on the
+        replica's own readiness, not on the next request's failover."""
         self._warming[ip] = time.monotonic()
         self.health.invalidate(ip)
+        if pool is not None:
+            self._ensure_warming_prober(pool)
+
+    def observe_membership(self, ips: List[str], pool=None) -> None:
+        """The membership seam the fence is wired from: every dispatch
+        hands the current replica set through here (the supervisor's
+        ``pod_ips``), and any ip that was not in the previous set is a
+        freshly admitted replica — fenced until a probe passes. The first
+        observation is the baseline fleet (this pod is already serving
+        through it) and fences nothing; departed ips drop their warming
+        mark so a scale-down never leaves ghosts behind the fence."""
+        current = set(ips)
+        if self._members is None:
+            self._members = current
+            return
+        for ip in current - self._members:
+            self.mark_warming(ip, pool=pool)
+        for ip in set(self._warming) - current:
+            self._warming.pop(ip, None)
+            telemetry.cold_start_metrics()["fence"].inc(result="departed")
+        self._members = current
+        if self._warming and pool is not None:
+            self._ensure_warming_prober(pool)
+
+    def _ensure_warming_prober(self, pool) -> None:
+        if self._prober_task is not None and not self._prober_task.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return      # sync context (tests): the dispatch fence still holds
+        self._prober_task = loop.create_task(self._probe_warming(pool))
+
+    async def _probe_warming(self, pool) -> None:
+        """Proactively probe every warming replica until the fence set
+        drains: a passing probe admits the replica (``fence_ready``) so
+        fast-scale capacity starts taking traffic the moment it is ready
+        — NOT only when every settled replica has already failed. Probes
+        bypass the health cache (a warming replica's state changes faster
+        than the TTL) and a failed probe keeps the fence up for the next
+        round; the warming TTL still bounds a boot that never comes up."""
+        try:
+            while self._warming:
+                for ip in list(self._warming):
+                    if not self._is_warming(ip):      # TTL expiry pops it
+                        continue
+                    self.health.invalidate(ip)
+                    try:
+                        ok = await self.health.healthy(pool, ip)
+                    except Exception:  # noqa: BLE001 — probe error = not ready
+                        ok = False
+                    if ok:
+                        self.fence_ready(ip)
+                if self._warming:
+                    await asyncio.sleep(self.warming_probe_s)
+        finally:
+            self._prober_task = None
 
     def fence_ready(self, ip: str) -> None:
         """Clear the fence (a fresh probe succeeded): the replica now

@@ -144,6 +144,7 @@ def _observe_phases(phases: Dict[str, float], total: float) -> None:
         for phase, dt in phases.items():
             fam["phase_seconds"].observe(dt, phase=phase)
         fam["total"].set(total)
+        fam["boot_ts"].set(time.time())
     except Exception:
         pass
 
@@ -323,6 +324,8 @@ class TemplateSupervisor:
     # -- lifecycle ----------------------------------------------------------
 
     def _spawn(self) -> None:
+        import select
+
         spec_file = self._tmp / f"spec_{self.respawns}.json"
         spec_file.write_text(json.dumps(self.spec))
         self.proc = subprocess.Popen(
@@ -330,16 +333,41 @@ class TemplateSupervisor:
              str(spec_file)],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
         deadline = time.monotonic() + self.timeout
+        # select() on the stdout fd so the deadline holds even while
+        # nothing is printed — a template that wedges before READY (alive
+        # but silent; its stderr is DEVNULL) must time out and die, not
+        # hang the supervisor on a blocking readline
         while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.proc.kill()
+                try:
+                    self.proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+                raise TimeoutError("template not READY in time")
+            readable, _, _ = select.select([self.proc.stdout], [], [],
+                                           min(remaining, 1.0))
+            if not readable:
+                if self.proc.poll() is not None:
+                    raise RuntimeError("template died before READY")
+                continue
+            # READY is one short flush()ed print — an atomic pipe write,
+            # so a readable fd means the full line arrives without
+            # blocking past the deadline
             line = self.proc.stdout.readline()
             if line.startswith(READY_PREFIX):
                 self.segment_name = json.loads(
                     line[len(READY_PREFIX):])["segment"]
                 break
-            if not line and self.proc.poll() is not None:
+            if not line:
+                # EOF before READY: the template is dead (or severed its
+                # stdout, which is the same thing to us) — reap it
+                try:
+                    self.proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    self.proc.kill()
                 raise RuntimeError("template died before READY")
-            if time.monotonic() > deadline:
-                raise TimeoutError("template not READY in time")
 
     def _respawn(self) -> None:
         old = self.segment_name

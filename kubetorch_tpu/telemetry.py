@@ -871,10 +871,17 @@ def cold_start_metrics() -> Dict[str, "_Metric"]:
                 "Wall-clock of this replica's last full cold start "
                 "(0 until one has been measured) — the signal the "
                 "controller's fast-scale gate reads"),
+            "boot_ts": gauge(
+                "kt_cold_start_timestamp_seconds",
+                "Unix time this replica last completed a measured cold "
+                "start — the recency the fast-scale gate ranks "
+                "measurements by (the newest boot is the evidence, not "
+                "the fastest-ever one)"),
             "aot": counter(
                 "kt_aot_cache_total",
                 "AOT compile-cache lookups by result (hit, miss, "
-                "incompatible, corrupt, publish, store_hit, store_publish)",
+                "incompatible, corrupt, publish, store_hit, "
+                "store_publish, store_corrupt)",
                 labels=("result",)),
             "forks": counter(
                 "kt_template_forks_total",
@@ -885,7 +892,8 @@ def cold_start_metrics() -> Dict[str, "_Metric"]:
                 "kt_serve_readiness_fence_total",
                 "Router readiness-fence decisions for still-warming "
                 "replicas (admitted = fence passed and cleared, blocked = "
-                "probe refused, expired = stale warming mark aged out)",
+                "probe refused, expired = stale warming mark aged out, "
+                "departed = warming ip left the membership)",
                 labels=("result",)),
         }
     return _COLD_START_METRICS

@@ -140,6 +140,89 @@ class TestAOTCache:
         assert _key().digest() == _key().digest()
         assert _key().digest() != _key(buckets=(8, 16)).digest()
         assert _key().digest() != _key(jax_version="99.0").digest()
+        # top_k is baked into every warmed executable as a static: two
+        # engines differing only in top_k must not share a cache line
+        assert _key().digest() != _key(top_k=40).digest()
+        assert _key(top_k=5).digest() != _key(top_k=40).digest()
+
+    def test_engine_key_carries_top_k(self):
+        class _Eng:
+            cfg = {"kind": "probe"}
+            _mesh = None
+            _buckets = [8]
+            slots, max_len = 2, 64
+            quantize_kv, decode_block = False, 1
+            top_k = 7
+        assert AOTKey.for_engine(_Eng()).top_k == 7
+
+
+class _FakeStore:
+    """In-memory stand-in for data_store.commands put/get (path-based)."""
+
+    def __init__(self):
+        self.blobs = {}
+
+    def put(self, key, src, store_url=None, **kw):
+        self.blobs[key] = Path(src).read_bytes()
+
+    def get(self, key, dest=None, store_url=None, **kw):
+        if key not in self.blobs:
+            raise KeyError(key)
+        Path(dest).write_bytes(self.blobs[key])
+
+
+class TestAOTStoreLayer:
+    def _fake(self, monkeypatch):
+        store = _FakeStore()
+        from kubetorch_tpu.data_store import commands as ds
+        monkeypatch.setattr(ds, "put", store.put)
+        monkeypatch.setattr(ds, "get", store.get)
+        return store
+
+    def test_publish_is_content_addressed_and_second_node_hits(
+            self, tmp_path, monkeypatch):
+        store = self._fake(monkeypatch)
+        c1 = AOTCompileCache(tmp_path / "node1", store=True)
+        c1.get_or_compile(_key(), "probe", _build)
+        ptr_key = [k for k in store.blobs if k.endswith(".ptr")]
+        assert len(ptr_key) == 1
+        want = store.blobs[ptr_key[0]].decode()
+        # the payload's own key names its blake2b — self-verifying fetch
+        payload_keys = [k for k in store.blobs if not k.endswith(".ptr")]
+        assert payload_keys == [ptr_key[0][:-len(".ptr")] + "/" + want]
+        c2 = AOTCompileCache(tmp_path / "node2", store=True)
+        exe, tag = c2.get_or_compile(_key(), "probe", _build)
+        assert tag == "hit"
+        assert c2.counts.get("store_hit") == 1
+        np.testing.assert_allclose(
+            np.asarray(exe(jnp.ones((4,), jnp.float32))),
+            np.full((4,), 2.0, np.float32))
+
+    def test_tampered_store_payload_never_reaches_pickle(
+            self, tmp_path, monkeypatch):
+        store = self._fake(monkeypatch)
+        c1 = AOTCompileCache(tmp_path / "node1", store=True)
+        c1.get_or_compile(_key(), "probe", _build)
+        for k in store.blobs:
+            if not k.endswith(".ptr"):
+                store.blobs[k] = b"swapped blob, arbitrary pickle inside"
+        c2 = AOTCompileCache(tmp_path / "node2", store=True)
+        _, tag = c2.get_or_compile(_key(), "probe", _build)
+        assert tag == "miss"                # typed, counted fallback
+        assert c2.counts.get("store_corrupt") == 1
+        assert "store_hit" not in c2.counts
+
+    def test_tampered_pointer_is_rejected(self, tmp_path, monkeypatch):
+        store = self._fake(monkeypatch)
+        c1 = AOTCompileCache(tmp_path / "node1", store=True)
+        c1.get_or_compile(_key(), "probe", _build)
+        for k in list(store.blobs):
+            if k.endswith(".ptr"):
+                store.blobs[k] = b"../../etc/not-a-hash"
+        c2 = AOTCompileCache(tmp_path / "node2", store=True)
+        _, tag = c2.get_or_compile(_key(), "probe", _build)
+        assert tag == "miss"
+        assert c2.counts.get("store_corrupt") == 1
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +402,56 @@ class TestReadinessFence:
         assert _fence("expired") - before == 1
         assert IPS[0] not in router._warming
 
+    def test_membership_growth_fences_and_prober_admits_without_traffic(
+            self):
+        # the production wiring: a new ip in the membership is fenced,
+        # and the BACKGROUND prober clears the fence — no request (and no
+        # failover of the settled fleet) is needed for the new capacity
+        # to become admissible
+        async def body():
+            router = Router(slots_per_replica=4, health_ttl_s=60)
+            router.warming_probe_s = 0.01
+            pool = _FencePool()
+            router.observe_membership(IPS[:2], pool)      # baseline fleet
+            assert not router._warming
+            router.observe_membership(IPS, pool)          # scale-out
+            assert router._is_warming(IPS[2])
+            for _ in range(100):
+                if not router._warming:
+                    break
+                await asyncio.sleep(0.01)
+            return router, pool
+        router, pool = asyncio.run(body())
+        assert not router._warming, \
+            "the background prober never admitted the warming replica"
+        assert IPS[2] in pool.health_calls
+        assert pool.calls == [], \
+            "clearing the fence must not require routing a request"
+
+    def test_prober_keeps_dead_boot_fenced(self):
+        async def body():
+            router = Router(slots_per_replica=4, health_ttl_s=60)
+            router.warming_probe_s = 0.01
+            pool = _FencePool()
+            pool.health[IPS[2]] = False
+            router.observe_membership(IPS[:2], pool)
+            router.observe_membership(IPS, pool)
+            await asyncio.sleep(0.05)
+            return router
+        router = asyncio.run(body())
+        assert router._is_warming(IPS[2]), \
+            "a failing probe must keep the fence up"
+
+    def test_departed_warming_ip_drops_fence(self):
+        router = Router(slots_per_replica=4, health_ttl_s=60)
+        router.observe_membership(IPS[:2])
+        router.observe_membership(IPS)
+        assert router._is_warming(IPS[2])
+        before = _fence("departed")
+        router.observe_membership(IPS[:2])      # scaled back down
+        assert not router._warming
+        assert _fence("departed") - before == 1
+
 
 # ---------------------------------------------------------------------------
 # autoscaler growth cap
@@ -344,6 +477,29 @@ class TestGrowthCap:
     def test_factor_floor_is_2x(self):
         from kubetorch_tpu.controller.app import _growth_cap
         assert _growth_cap(4, 1.0, fast_s=5.0, factor=1) == 8
+
+
+class TestFreshestColdStart:
+    """The gate's fleet aggregate: recency beats optimism — one historic
+    fast boot (warm cache, live template) must not keep the relaxed cap
+    after current boots turn slow again."""
+
+    def _f(self, pairs):
+        from kubetorch_tpu.controller.app import _freshest_cold_start
+        return _freshest_cold_start(pairs)
+
+    def test_newest_boot_wins_over_historic_fast_one(self):
+        assert self._f([(100.0, 1.5), (200.0, 45.0)]) == 45.0
+        assert self._f([(200.0, 1.5), (100.0, 45.0)]) == 1.5
+
+    def test_untimestamped_fleet_aggregates_pessimistically(self):
+        assert self._f([(0.0, 3.0), (0.0, 9.0), (0.0, 4.0)]) == 9.0
+
+    def test_timestamped_measurement_beats_untimestamped(self):
+        assert self._f([(0.0, 1.0), (50.0, 7.0)]) == 7.0
+
+    def test_empty_means_unmeasured(self):
+        assert self._f([]) == 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -378,6 +534,58 @@ class TestColdBurstEpisode:
         for seed in range(20):
             s = soak_schedule.generate(seed, "store", 24)
             assert not any(e.action in self.ACTIONS for e in s.events)
+
+
+# ---------------------------------------------------------------------------
+# supervisor spawn deadline: a silent template must time out, not hang
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisorSpawnDeadline:
+    def _patch_template_cmd(self, monkeypatch, code):
+        """Make TemplateSupervisor._spawn launch ``python -c code`` in
+        place of the real template module."""
+        import subprocess as sp
+        import sys
+        import types
+
+        from kubetorch_tpu.serving import warm_template as wt
+        procs = []
+
+        def fake_popen(cmd, **kw):
+            p = sp.Popen([sys.executable, "-c", code], stdout=sp.PIPE,
+                         stderr=sp.DEVNULL, text=True)
+            procs.append(p)
+            return p
+
+        monkeypatch.setattr(
+            wt, "subprocess",
+            types.SimpleNamespace(Popen=fake_popen, PIPE=sp.PIPE,
+                                  DEVNULL=sp.DEVNULL))
+        return wt, procs
+
+    def test_silent_wedged_template_times_out_and_is_killed(
+            self, tmp_path, monkeypatch):
+        # alive but never prints READY (e.g. wedged before the announce,
+        # stderr-only failure): the deadline must fire while the reader
+        # is blocked, and the child must not outlive the TimeoutError
+        wt, procs = self._patch_template_cmd(
+            monkeypatch, "import time; time.sleep(60)")
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            wt.TemplateSupervisor({"weights": str(tmp_path / "w.npy"),
+                                   "result_dir": str(tmp_path)},
+                                  timeout=1.0)
+        assert time.monotonic() - t0 < 10
+        procs[0].wait(timeout=10)
+        assert procs[0].poll() is not None, "wedged template leaked"
+
+    def test_dead_template_raises_promptly(self, tmp_path, monkeypatch):
+        wt, procs = self._patch_template_cmd(monkeypatch, "pass")
+        with pytest.raises(RuntimeError, match="died before READY"):
+            wt.TemplateSupervisor({"weights": str(tmp_path / "w.npy"),
+                                   "result_dir": str(tmp_path)},
+                                  timeout=30.0)
 
 
 # ---------------------------------------------------------------------------

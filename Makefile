@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step smoke-tpu dryrun native clean
+.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout test-pipeline lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step bench-pipeline smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -65,6 +65,12 @@ test-serve:
 test-federation:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/test_federation.py -q
 
+# elastic pipeline suite (ISSUE 17): membership/re-group/epoch-fence units,
+# stage-gang admission + partial preemption, the generic-schedule
+# bit-identity pins, and the real-subprocess stage-SIGKILL/stall drills
+test-pipeline:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m pipeline --level release
+
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
@@ -75,6 +81,8 @@ lint:
 soak-smoke:
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 6 --profile train
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 3 --profile store
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 8 --profile pipeline
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 43 --duration 8 --profile pipeline
 
 soak:
 	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 42 --duration 60 --profile all
@@ -162,6 +170,13 @@ bench-rollout:
 # for a >=64MB state (>=10x required) — bench-convention JSON
 bench-step:
 	python bench.py --step-overlap
+
+# elastic-pipeline regime (ISSUE 17): pipelined-vs-SPMD tokens/s at equal
+# chips + analytic/measured bubble fraction on the forced 8-device host
+# mesh, then a real stage-SIGKILL drill measuring the re-group stall
+# (fault detected -> first post-re-group committed step) — bench JSON
+bench-pipeline:
+	python bench.py --pipeline
 
 dryrun:
 	$(PY_MESH) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -116,8 +116,22 @@ def main() -> int:
         return probe_worker()
     if mode == "step-overlap":
         return step_overlap_worker()
+    if mode == "pipeline":
+        return pipeline_worker()
     if mode:
         return bench_worker(force_cpu=bool(os.environ.get("KT_BENCH_FORCE_CPU")))
+    if "--pipeline" in sys.argv:
+        # elastic pipeline regime (ISSUE 17): pipelined-vs-SPMD A/B plus a
+        # real stage-SIGKILL re-group drill, on the forced 8-device host
+        # mesh in a fresh subprocess (flags must precede jax init)
+        env = {**os.environ, "KT_BENCH_WORKER": "pipeline",
+               "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=900).returncode
     if "--step-overlap" in sys.argv:
         # step-anatomy A/B regime (ISSUE 12): runs on a forced 8-device
         # host mesh in a fresh subprocess (the env flags must be set
@@ -526,6 +540,161 @@ def step_overlap_worker() -> int:
     if ratio < 10:
         print(f"step-overlap: FAIL — snapshot stall ratio {ratio:.1f}x < "
               "10x (async path is blocking on the host copy again?)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def pipeline_worker() -> int:
+    """`bench.py --pipeline`: the ISSUE 17 elastic-pipeline regime. Two
+    phases, ONE bench-convention JSON line:
+
+    A. pipelined llama loss (pipe=4) vs pure-SPMD (data=4) at EQUAL chips
+       on the forced-host mesh: tokens/s for both, plus the analytic
+       bubble fraction (from the elastic membership math) and the measured
+       one (throughput deficit vs SPMD — folds in ppermute overhead, so
+       it upper-bounds the schedule bubble).
+    B. re-group cost: SIGKILL stage 1 of the real 4-subprocess trainer
+       (tests/assets/pipeline_trainer.py) and read the stall from fault
+       detection to the first post-re-group committed step.
+
+    Exits nonzero when the drill loses a committed step or the stall is
+    not a finite positive number.
+    """
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+    from kubetorch_tpu.parallel.mesh import build_mesh
+    from kubetorch_tpu.parallel.pipeline import llama_loss_pipelined
+    from kubetorch_tpu.parallel.pipeline_elastic import ElasticPipeline
+
+    assert len(jax.devices()) >= 8, "needs the forced 8-device host mesh"
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = LlamaConfig.tiny(n_layers=4, attn_impl="xla", dtype=jnp.float32,
+                           remat=False)
+    chips, batch_n, seq, M, steps, warmup = 4, 8, 64, 8, 10, 3
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch_n, seq), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, 1)
+
+    def timed(fn, *args):
+        out = fn(*args)
+        float(out)                       # compile + first run
+        for _ in range(warmup):
+            float(fn(*args))
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            float(fn(*args))             # host fetch = hard sync
+            times.append(time.perf_counter() - t0)
+        return float(out), statistics.median(times)
+
+    # -- A1: pipelined on pipe=4 --------------------------------------------
+    pipe_mesh = Mesh(np.asarray(jax.devices()[:chips]).reshape(chips),
+                     ("pipe",))
+
+    def place(leaf, is_layer):
+        spec = P("pipe") if is_layer else P()
+        return jax.device_put(leaf, NamedSharding(pipe_mesh, spec))
+
+    sharded = {
+        "embed": place(params["embed"], False),
+        "layers": jax.tree_util.tree_map(lambda l: place(l, True),
+                                         params["layers"]),
+        "final_norm": place(params["final_norm"], False),
+        "lm_head": place(params["lm_head"], False),
+    }
+    pipe_fn = jax.jit(lambda p, t, y: llama_loss_pipelined(
+        p, t, y, cfg, pipe_mesh, n_microbatches=M))
+    loss_pipe, dt_pipe = timed(pipe_fn, sharded, tokens, targets)
+
+    # -- A2: SPMD (data=4) at the same chip count ---------------------------
+    spmd_mesh = build_mesh({"data": chips}, devices=jax.devices()[:chips])
+    spmd_tokens = jax.device_put(
+        tokens, NamedSharding(spmd_mesh, P("data")))
+    spmd_targets = jax.device_put(
+        targets, NamedSharding(spmd_mesh, P("data")))
+    spmd_fn = jax.jit(lambda p, t, y: llama_loss(p, t, y, cfg))
+    loss_spmd, dt_spmd = timed(spmd_fn, params, spmd_tokens, spmd_targets)
+
+    tps_pipe = batch_n * seq / dt_pipe
+    tps_spmd = batch_n * seq / dt_spmd
+    # the membership math IS the analytic model: (P-1)/(M+P-1) at width 1
+    analytic = ElasticPipeline(n_layers=cfg.n_layers, n_stages=chips,
+                               n_microbatches=M,
+                               job="bench").membership.bubble_fraction
+    measured = max(0.0, 1.0 - dt_spmd / dt_pipe)
+
+    # -- B: stage-SIGKILL re-group drill (real subprocesses) ----------------
+    trainer = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests", "assets", "pipeline_trainer.py")
+    drill_steps = 4
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("KT_CHAOS")}
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "KT_CHAOS": "kill-stage:9@1", "KT_CHAOS_STAGE": "1",
+                "KT_CHAOS_SEED": "7"})
+    with tempfile.TemporaryDirectory() as root:
+        result = os.path.join(root, "result.jsonl")
+        proc = subprocess.run(
+            [sys.executable, trainer, "--steps", str(drill_steps),
+             "--stages", "4", "--result", result,
+             "--workdir", os.path.join(root, "wd")],
+            env=env, timeout=180, capture_output=True, text=True)
+        if proc.returncode != 0:
+            print(f"pipeline drill failed rc={proc.returncode}:\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return 1
+        recs = [json.loads(line)
+                for line in open(result, encoding="utf-8")]
+    committed = sorted(r["step"] for r in recs if r["event"] == "committed")
+    regroups = [r for r in recs if r["event"] == "regroup"]
+    done = [r for r in recs if r["event"] == "regroup-done"]
+    stall_s = done[0]["stall_s"] if done else float("nan")
+    lost = [s for s in range(1, drill_steps + 1) if s not in committed]
+
+    from kubetorch_tpu import telemetry
+    telemetry.train_metrics()["mfu"].set(0.0)   # CPU proxy: no real MFU
+    print(json.dumps({
+        "metric": "pipeline_elastic_ab",
+        "value": round(tps_pipe, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_pipe / max(tps_spmd, 1e-9), 4),
+        "detail": {
+            "mfu": 0.0,
+            "device": f"cpu-proxy (8 forced host devices; pipe={chips} "
+                      f"vs data={chips})",
+            "chips": chips,
+            "n_microbatches": M,
+            "pipeline_tokens_per_sec": round(tps_pipe, 1),
+            "spmd_tokens_per_sec": round(tps_spmd, 1),
+            "bubble_fraction_analytic": round(analytic, 4),
+            "bubble_fraction_measured": round(measured, 4),
+            "loss_abs_diff": abs(loss_pipe - loss_spmd),
+            "regroup": {
+                "cause": regroups[0].get("cause") if regroups else None,
+                "mode": regroups[0].get("mode") if regroups else None,
+                "stall_s": round(stall_s, 3)
+                if stall_s == stall_s else None,
+                "steps_committed": len(committed),
+                "lost_steps": lost,
+            },
+        },
+    }))
+    if lost or not regroups:
+        print(f"pipeline: FAIL — lost steps {lost} / regroups "
+              f"{len(regroups)} (drill must re-group and commit every "
+              "step)", file=sys.stderr)
+        return 1
+    if not (stall_s == stall_s and 0 < stall_s < float("inf")):
+        print(f"pipeline: FAIL — re-group stall {stall_s} not finite",
               file=sys.stderr)
         return 1
     return 0

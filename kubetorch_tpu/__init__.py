@@ -43,6 +43,7 @@ from .exceptions import (  # noqa: F401
     WorkerMembershipChanged,
     WorkerCallError,
     WorkerDiedError,
+    StaleStageEpochError,
 )
 from .config import config, KTConfig  # noqa: F401
 
@@ -91,6 +92,13 @@ _LAZY = {
     "drain_requested": ".serving.elastic",
     "batch_scale": ".serving.elastic",
     "Checkpointer": ".train.checkpoint",
+    # elastic pipeline parallelism (ISSUE 17): the membership authority a
+    # multi-pod pipeline job shares with its supervisor — stage spans,
+    # epoch-fenced re-grouping, activation keys
+    "ElasticPipeline": ".parallel.pipeline_elastic",
+    "PipelineMembership": ".parallel.pipeline_elastic",
+    "StageAssignment": ".parallel.pipeline_elastic",
+    "PipelineSupervisor": ".serving.pipeline_supervisor",
     # module-valued: kt.models.load_hf / kt.models.LlamaConfig (the HF
     # migration surface); resolved to the module itself by __getattr__
     "models": ".models",

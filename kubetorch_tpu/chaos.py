@@ -22,6 +22,8 @@ once, and the backoff sequence matches the policy".
              | term-rank:GRACE_S@OP_INDEX       (process-level; see below)
              | kill-store-node[:SIG]@OP_INDEX   (process-level; see below)
              | kill-peer[:SIG]@OP_INDEX         (process-level; see below)
+             | kill-stage[:SIG]@OP_INDEX        (process-level; see below)
+             | stall-stage:SECONDS@OP_INDEX     (process-level; see below)
              | shm-corrupt                      (process-level; see below)
              | kill-region[:OP_INDEX]@NAME      (region-scoped; see below)
              | partition[:PCT]                  (client-side netpool; below)
@@ -90,6 +92,21 @@ Fault kinds:
   to the rank whose ``RANK`` env matches — so an N-rank job can lose
   exactly one rank (the elastic N-1 re-mesh scenario) instead of all N
   self-killing at the same op index.
+- ``kill-stage[:SIG]@N``  **process-level** fault (ISSUE 17): the pipeline
+  stage worker self-delivers SIG (default 9) at its N-th (0-based) step op
+  — a stage dying mid-pipe. Consumed by the stage worker loop via
+  :func:`stage_kill_plan`, never the HTTP middleware. Honors
+  ``KT_CHAOS_STAGE``: when set, only the process whose ``KT_STAGE`` env
+  matches consults the plan, so a P-stage pipeline loses exactly one stage
+  and the elastic re-grouper (``parallel/pipeline_elastic.py``) must
+  absorb it — never the whole gang self-killing at the same op.
+- ``stall-stage:SECONDS@N``  **process-level** fault, the straggler
+  sibling of ``kill-stage``: at its N-th step op the stage sleeps SECONDS
+  and then continues. The process is alive the whole time, so the
+  pipeline supervisor must classify it by heartbeat age as ``Slow`` — not
+  as a death — and re-group the pipe around it instead of pacing every
+  tick at the straggler's speed. Same ``KT_CHAOS_STAGE`` scoping; consult
+  :func:`stage_stall_plan`.
 - ``kill-store-node[:SIG]@N``  **process-level, store-server** fault: the
   store process kills itself with SIG (default 9) the moment its N-th
   (0-based) client-origin data-plane request arrives — before the handler
@@ -178,6 +195,11 @@ _CHAOS_FAULTS = telemetry.counter(
 CHAOS_ENV = "KT_CHAOS"
 CHAOS_SEED_ENV = "KT_CHAOS_SEED"
 CHAOS_RANK_ENV = "KT_CHAOS_RANK"
+# stage scoping (ISSUE 17): STAGE_ENV tags a pipeline stage worker with
+# its stage index; CHAOS_STAGE_ENV narrows the stage verbs to one stage,
+# the way CHAOS_RANK_ENV narrows the rank verbs to one rank
+CHAOS_STAGE_ENV = "KT_CHAOS_STAGE"
+STAGE_ENV = "KT_STAGE"
 # region scoping (ISSUE 13): REGION_ENV tags a process with the region it
 # belongs to (the kill-region verb's blast radius); REGION_HOSTS_ENV names
 # the hosts the partition verb treats as LOCAL (never dropped)
@@ -279,6 +301,18 @@ VERB_REGISTRY: tuple = (
              "the N-th forked replica self-delivers SIG mid-boot (after "
              "the weight attach, before serving) — the fleet must still "
              "converge to N", "kill-joiner:9@1", process_fatal=True),
+    VerbSpec("kill-stage", "process", "kill-stage[:SIG]@OP_INDEX",
+             "stage worker loop", (),
+             "the pipeline stage self-delivers SIG at its N-th step op "
+             "(stage death mid-pipe; honors KT_CHAOS_STAGE — the elastic "
+             "re-grouper must absorb it)",
+             "kill-stage:9@2", process_fatal=True),
+    VerbSpec("stall-stage", "process", "stall-stage:SECONDS@OP_INDEX",
+             "stage worker loop", (),
+             "the pipeline stage sleeps SECONDS at its N-th step op — a "
+             "straggler the supervisor must classify as Slow (heartbeat "
+             "age, not death) and re-group around",
+             "stall-stage:2.5@1"),
     VerbSpec("kill-region", "region", "kill-region[:OP_INDEX]@NAME",
              "middleware + step loop", (),
              "SIGKILL every process tagged KT_REGION=NAME at the op index "
@@ -335,9 +369,14 @@ _RANK_KINDS = ("kill-rank", "term-rank", "shm-corrupt")
 # — both invisible to the HTTP middleware, like the rank verbs
 _TEMPLATE_KINDS = ("kill-template", "kill-joiner")
 
+# verbs consumed by the pipeline stage worker loop (ISSUE 17): the stage
+# consults stage_kill_plan()/stage_stall_plan() per step op, scoped by
+# KT_CHAOS_STAGE/KT_STAGE — invisible to the HTTP middleware
+_STAGE_KINDS = ("kill-stage", "stall-stage")
+
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
 _OP_INDEX_KINDS = (_RANK_KINDS + ("kill-store-node", "kill-peer")
-                   + _TEMPLATE_KINDS)
+                   + _TEMPLATE_KINDS + _STAGE_KINDS)
 
 # verbs whose @-suffix is a REGION NAME (the kill-region blast radius; its
 # op index rides the :ARG slot instead, since @ is taken)
@@ -452,6 +491,16 @@ def _parse_one(token: str, raw: str) -> Fault:
     if head == "kill-joiner":
         return Fault(kind="kill-joiner",
                      signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-stage":
+        return Fault(kind="kill-stage",
+                     signal_no=_parse_signal(arg or "9", raw))
+    if head == "stall-stage":
+        if not arg:
+            raise ChaosError(f"stall-stage needs SECONDS in {raw!r}")
+        try:
+            return Fault(kind="stall-stage", seconds=float(arg))
+        except ValueError:
+            raise ChaosError(f"bad stall-stage seconds in {raw!r}")
     if head == "term-rank":
         fault = Fault(kind="term-rank")
         if arg:
@@ -532,6 +581,7 @@ class ChaosEngine:
         faults = [f for f in faults
                   if f.kind not in _RANK_KINDS
                   and f.kind not in _TEMPLATE_KINDS
+                  and f.kind not in _STAGE_KINDS
                   and f.kind != "partition"]
         # kill-store-node/kill-peer fire by op INDEX, not schedule order:
         # armed separately and checked against their own op counters every
@@ -833,6 +883,53 @@ def joiner_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
     mid-fork. The supervisor must re-fork and the fleet still converge."""
     return {f.op_index: f.signal_no
             for f in _rank_faults("kill-joiner", spec)}
+
+
+def _stage_in_scope() -> bool:
+    """``KT_CHAOS_STAGE`` narrows the stage verbs to one pipeline stage
+    (so a P-stage pipe loses exactly one stage — the elastic re-group
+    scenario — instead of every stage self-killing at the same op index).
+    Unset → every stage is in scope."""
+    want = os.environ.get(CHAOS_STAGE_ENV)
+    if not want:
+        return True
+    return os.environ.get(STAGE_ENV, "0") == want.strip()
+
+
+def _stage_faults(kind: str, spec: Optional[str]) -> List[Fault]:
+    """Plan extraction for the stage verbs — ``_rank_faults`` with stage
+    scoping (``KT_CHAOS_STAGE``/``KT_STAGE``) instead of rank scoping."""
+    raw = spec if spec is not None else os.environ.get(CHAOS_ENV, "")
+    if kind not in (raw or ""):
+        return []
+    if spec is None and not _stage_in_scope():
+        return []
+    try:
+        faults = parse_spec(raw)
+    except ChaosError as e:
+        print(f"[kt] chaos: ignoring malformed {CHAOS_ENV}: {e}")
+        return []
+    return [f for f in faults if f.kind == kind]
+
+
+def stage_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{step-op index → signal}`` from ``KT_CHAOS``'s ``kill-stage``
+    verbs — the schedule a pipeline stage worker consults at each step op
+    and self-delivers the signal mid-step (ISSUE 17). Empty when no
+    kill-stage verb is present or this stage is out of ``KT_CHAOS_STAGE``
+    scope. The elastic re-grouper (``parallel/pipeline_elastic.py``) must
+    absorb the death without stalling the pipe."""
+    return {f.op_index: f.signal_no
+            for f in _stage_faults("kill-stage", spec)}
+
+
+def stage_stall_plan(spec: Optional[str] = None) -> Dict[int, float]:
+    """``{step-op index → stall seconds}`` from the ``stall-stage`` verbs:
+    at that op the stage sleeps — alive, just slow — so the supervisor's
+    heartbeat check must classify it ``Slow`` and re-group, proving the
+    straggler path separately from the death path."""
+    return {f.op_index: f.seconds
+            for f in _stage_faults("stall-stage", spec)}
 
 
 def deliver_term_with_grace(pid: int, grace_s: float,

@@ -1187,7 +1187,7 @@ def soak():
                    "to get the op-indexed schedule length")
 @click.option("--profile", default="all",
               type=click.Choice(["store", "train", "serve", "federation",
-                                 "all"]))
+                                 "all", "pipeline"]))
 @click.option("--shrink/--no-shrink", "do_shrink", default=True,
               help="on violation, ddmin the schedule to a minimal repro")
 @click.option("--out", default=None,

@@ -73,7 +73,8 @@ _PREEMPTIONS = telemetry.counter(
     "kt_preemptions_total",
     "Workload preemptions by victim tier and outcome "
     "(drained=exited inside the grace window, forced=evicted at the "
-    "deadline, resumed=re-placed from the queue, failed=eviction error)",
+    "deadline, resumed=re-placed from the queue, failed=eviction error, "
+    "regrouped=gang stage evicted and the pipeline re-grouped around it)",
     labels=("tier", "outcome"))
 _QUEUE_WAIT = telemetry.histogram(
     "kt_sched_queue_wait_seconds",
@@ -337,6 +338,11 @@ class Scheduler:
         self.policy = resolve_policy(policy)
         self.queue: List[Dict[str, Any]] = []
         self.ledger: List[Dict[str, Any]] = []   # preemption ledger
+        # multi-pod gangs (ISSUE 17): queued all-or-nothing admissions and
+        # the per-gang partial-preemption callbacks (the elastic pipeline's
+        # regroup hook) — see the "gangs" section below
+        self.gang_queue: List[Dict[str, Any]] = []
+        self._gang_watchers: Dict[str, Any] = {}
         self.throughput: Dict[str, Dict[str, float]] = {}  # key→class→ops/s
         self._service: Dict[str, float] = {}     # key → width×seconds served
         self._seq = 0
@@ -692,6 +698,140 @@ class Scheduler:
         self.state.record_event(f"{ns}/{name}",
                                 f"autoscaled to {replicas} pods ({reason})")
 
+    # -- gangs (ISSUE 17: the pipeline's multi-pod tenancy) -------------------
+    #
+    # A pipelined job is a GANG of stage slots: it runs with every stage
+    # placed or not at all (a pipe missing one stage computes nothing), so
+    # admission is atomic — all stages allocate in one book transaction or
+    # the whole gang queues. Preemption is the inverse asymmetry: evicting
+    # ONE stage does not kill the job, because the elastic re-grouper
+    # (``parallel/pipeline_elastic.py``) absorbs the lost stage's layers
+    # into the survivors — so the scheduler's partial-gang policy evicts
+    # the gang's lowest-cost stage first and notifies the gang's watcher
+    # (cause="Preempted") instead of draining the whole workload. These
+    # methods are synchronous book operations: gang tenants are stage
+    # supervisors, not k8s records, so the async submit/record machinery
+    # does not apply.
+
+    @staticmethod
+    def _gang_key(gang: str, stage: int) -> str:
+        return f"gang/{gang}/stage{stage}"
+
+    def admit_gang(self, gang: str, stages: List[Dict[str, Any]],
+                   priority: Optional[Any] = None,
+                   on_preempt=None) -> Dict[str, Any]:
+        """All-or-nothing admission for a stage gang. ``stages`` rows are
+        ``{"stage", "device_class", "width"}`` (``ElasticPipeline.
+        gang_request()`` emits them). Every stage fits → every stage
+        allocates; otherwise nothing allocates and the gang queues as ONE
+        entry, re-tried by :meth:`kick_gangs` when capacity frees.
+        ``on_preempt(stage=..., width=..., cause="Preempted")`` is the
+        partial-preemption hook — the supervisor's regroup trigger."""
+        prio = parse_priority(priority)
+        demand: Dict[str, int] = {}
+        for row in stages:
+            demand[row["device_class"]] = (demand.get(row["device_class"], 0)
+                                           + int(row["width"]))
+        if on_preempt is not None:
+            self._gang_watchers[gang] = on_preempt
+        if all(self.book.fits(cls, width) for cls, width in demand.items()):
+            for row in stages:
+                key = self._gang_key(gang, int(row["stage"]))
+                self.book.allocate(key, row["device_class"],
+                                   int(row["width"]), prio)
+                self.book.allocations[key]["gang"] = gang
+                self.book.allocations[key]["stage"] = int(row["stage"])
+            self._persist()
+            return {"admitted": True, "gang": gang,
+                    "stages": len(stages), "tier": tier_of(prio)}
+        self._seq += 1
+        entry = {"gang": gang, "stages": [dict(r) for r in stages],
+                 "priority": prio, "tier": tier_of(prio),
+                 "preempted": False, "enqueued_at": time.time(),
+                 "seq": self._seq, "key": f"gang/{gang}"}
+        # one queue entry for the whole gang — a half-admitted pipe would
+        # squat capacity while computing nothing
+        self.gang_queue = [e for e in self.gang_queue
+                           if e["gang"] != gang] + [entry]
+        self._persist()
+        return {"queued": True, "gang": gang, "tier": tier_of(prio)}
+
+    def release_gang(self, gang: str) -> int:
+        """Free every stage slot of ``gang`` (job finished or killed) and
+        drop any queued entry. Returns the number of slots released."""
+        keys = [k for k, a in self.book.allocations.items()
+                if a.get("gang") == gang]
+        for k in keys:
+            self._bank_service(k, self.book.release(k))
+        self.gang_queue = [e for e in self.gang_queue if e["gang"] != gang]
+        self._gang_watchers.pop(gang, None)
+        if keys:
+            self._persist()
+        return len(keys)
+
+    def kick_gangs(self) -> int:
+        """Re-try queued gangs in policy order against freed capacity.
+        Returns the number of gangs admitted."""
+        admitted = 0
+        for entry in self.policy.order(list(self.gang_queue), self):
+            result = self.admit_gang(entry["gang"], entry["stages"],
+                                     entry["priority"])
+            if result.get("admitted"):
+                self.gang_queue = [e for e in self.gang_queue
+                                   if e["gang"] != entry["gang"]]
+                admitted += 1
+            else:
+                # keep the ORIGINAL entry (admit_gang re-enqueued a fresh
+                # one) so seq/enqueued_at — the FIFO position — survive
+                self.gang_queue = [e for e in self.gang_queue
+                                   if e["gang"] != entry["gang"]] + [entry]
+        if admitted:
+            self._persist()
+        return admitted
+
+    def _gang_cheapest(self, gang: str) -> Optional[Tuple[str, Dict]]:
+        """The gang's lowest-cost stage allocation: smallest width first
+        (least capacity recovered per job disruption is the wrong axis —
+        smallest width is the CHEAPEST disruption for the capacity it
+        frees), latest stage on ties (tail stages hold fewer downstream
+        activations to re-materialize)."""
+        rows = [(k, a) for k, a in self.book.allocations.items()
+                if a.get("gang") == gang]
+        if not rows:
+            return None
+        return min(rows, key=lambda ka: (ka[1]["width"], -ka[1]["stage"]))
+
+    def preempt_gang_stage(self, gang: str,
+                           preemptor_key: str = "") -> Optional[Dict]:
+        """Partial-gang preemption: evict the gang's lowest-cost stage and
+        tell the gang's watcher to re-group — the job degrades, it does
+        not die. Returns ``{"stage", "width"}`` or None when the gang has
+        no allocations."""
+        cheapest = self._gang_cheapest(gang)
+        if cheapest is None:
+            return None
+        key, alloc = cheapest
+        self._bank_service(key, self.book.release(key))
+        led = {"victim": key, "preemptor": preemptor_key or "(capacity)",
+               "phase": "regrouped", "tier": alloc["tier"],
+               "gang": gang, "stage": alloc["stage"],
+               "width": alloc["width"],
+               "device_class": alloc["device_class"],
+               "priority": alloc["priority"], "started_at": time.time(),
+               "evicted_at": time.time()}
+        self.ledger.append(led)
+        del self.ledger[:-64]
+        _PREEMPTIONS.inc(tier=alloc["tier"], outcome="regrouped")
+        self._persist()
+        watcher = self._gang_watchers.get(gang)
+        if watcher is not None:
+            try:
+                watcher(stage=alloc["stage"], width=alloc["width"],
+                        cause="Preempted")
+            except Exception as e:  # noqa: BLE001
+                log.warning("gang %s preempt watcher failed: %s", gang, e)
+        return {"stage": alloc["stage"], "width": alloc["width"]}
+
     # -- preemption -----------------------------------------------------------
 
     def _select_victims(self, preemptor_key: str, device_class: str,
@@ -704,10 +844,18 @@ class Scheduler:
         free = self.book.free(device_class)
         deficit = needed - (free or 0)
         victims: List[str] = []
+        # gang-aware: of a gang's stage allocations only its CHEAPEST
+        # stage is ever a candidate per pass — evicting two stages of one
+        # pipe in a single preemption would degrade it twice before the
+        # first re-group even lands
+        gang_ok = {self._gang_cheapest(a["gang"])[0]
+                   for a in self.book.allocations.values()
+                   if a.get("gang")}
         candidates = sorted(
             ((k, a) for k, a in self.book.allocations.items()
              if a["device_class"] == device_class and k != preemptor_key
-             and _TIER_RANK[a["tier"]] < tier_rank),
+             and _TIER_RANK[a["tier"]] < tier_rank
+             and (not a.get("gang") or k in gang_ok)),
             key=lambda ka: (_TIER_RANK[ka[1]["tier"]], ka[1]["priority"],
                             -ka[1]["since"]))
         for key, alloc in candidates:
@@ -724,7 +872,14 @@ class Scheduler:
         if not victims:
             return False
         for victim in victims:
-            await self._preempt_one(victim, preemptor_key)
+            alloc = self.book.allocations.get(victim) or {}
+            if alloc.get("gang"):
+                # a gang stage is not drained like a workload: evict the
+                # slot and let the pipe re-group around it (the watcher
+                # fires the regroup); the job keeps running degraded
+                self.preempt_gang_stage(alloc["gang"], preemptor_key)
+            else:
+                await self._preempt_one(victim, preemptor_key)
         return True
 
     async def _preempt_one(self, victim_key: str,
@@ -818,6 +973,7 @@ class Scheduler:
     def state_dict(self) -> Dict[str, Any]:
         return {
             "queue": [dict(e) for e in self.queue],
+            "gang_queue": [dict(e) for e in self.gang_queue],
             "ledger": [dict(e) for e in self.ledger],
             "allocations": {k: dict(v)
                             for k, v in self.book.allocations.items()},
@@ -839,6 +995,7 @@ class Scheduler:
         if not payload:
             return
         self.queue = [dict(e) for e in payload.get("queue", [])]
+        self.gang_queue = [dict(e) for e in payload.get("gang_queue", [])]
         for e in self.queue:
             _QUEUE_DEPTH.inc(tier=e.get("tier", TIER_NORMAL))
         self.ledger = [dict(e) for e in payload.get("ledger", [])]
@@ -879,6 +1036,7 @@ class Scheduler:
                 {**e, "position": i,
                  "waiting_s": round(time.time() - e["enqueued_at"], 1)}
                 for i, e in enumerate(ordered)],
+            "gang_queue": [dict(e) for e in self.gang_queue],
             "ledger": [dict(e) for e in self.ledger[-16:]],
             # measured per-workload/per-class ops/s EWMAs — the scores a
             # federation leaf reports upward on every heartbeat (ISSUE 13)

@@ -263,6 +263,32 @@ class StaleLeaseError(KubetorchError):
         self.current_region = current_region
 
 
+class StaleStageEpochError(KubetorchError):
+    """A pipeline stage acted under a fenced-off membership epoch (ISSUE 17).
+
+    Elastic pipeline parallelism (``parallel/pipeline_elastic.py``) stamps
+    every stage gang with a membership epoch and bumps it on every
+    re-group — a stage death, a straggler demotion, a partial-gang
+    preemption. A zombie stage from before the re-group (SIGSTOPped, GC
+    paused, or just slow) that wakes up and tries to confirm its
+    assignment or publish a boundary activation is refused with this
+    error instead of silently double-driving layers the survivors already
+    absorbed. The stale side's only valid move is to exit; the membership
+    brain has already re-placed its layer shard. ``current_epoch`` names
+    the membership that actually holds."""
+
+    def __init__(self, message: str = "stage membership epoch is stale",
+                 job: Optional[str] = None,
+                 stage: Optional[int] = None,
+                 epoch: Optional[int] = None,
+                 current_epoch: Optional[int] = None):
+        super().__init__(message)
+        self.job = job
+        self.stage = stage
+        self.epoch = epoch
+        self.current_epoch = current_epoch
+
+
 class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
@@ -493,6 +519,7 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         DataCorruptionError,
         RolloutError,
         StaleLeaseError,
+        StaleStageEpochError,
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
@@ -516,6 +543,7 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "RolloutError": ["reason", "version", "expected", "actual"],
     "StaleLeaseError": ["workload", "region", "epoch", "current_epoch",
                         "current_region"],
+    "StaleStageEpochError": ["job", "stage", "epoch", "current_epoch"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
     "AdmissionShedError": ["reason", "tier", "queue_depth", "retry_after"],

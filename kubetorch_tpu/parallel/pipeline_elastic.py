@@ -1,0 +1,401 @@
+"""Elastic pipeline parallelism: stage membership that survives stage loss.
+
+The ONLY stage-membership / re-grouping site in the tree (the 15th
+``scripts/check_resilience.py`` lint keeps it that way): everything that
+maps pipeline stages to pod gangs, re-derives the schedule after a fault,
+or fences a zombie stage goes through :class:`ElasticPipeline`.
+
+``parallel/pipeline.py`` is the in-XLA half — one compiled GPipe program
+over a ``pipe`` mesh axis, which by construction cannot lose a stage
+mid-program. This module is the between-programs half, the robustness
+layer ROADMAP item 1 asks for:
+
+- **Membership** — :class:`PipelineMembership` is an immutable snapshot:
+  an epoch, one :class:`StageAssignment` (contiguous layer shard + slot
+  width) per stage, and the microbatch count. The GPipe tick schedule is
+  *derived* from it (:meth:`PipelineMembership.schedule`), so re-deriving
+  the schedule after a re-group is free and provably consistent with the
+  membership that produced it.
+- **Re-grouping** (Ada-Grouper, arXiv:2303.01675) — when a stage dies or
+  straggles (cause classified by ``serving/watchdog.py``), the pipe is
+  NOT stalled at the bubble waiting for a replacement: the dead stage's
+  layer shard is absorbed by its neighbors (``regroup()`` with no
+  replacement slot) and the microbatch count is re-derived so the bubble
+  fraction of the new, shorter pipe stays at or below the pre-fault
+  value. Surviving stages restore the absorbed layers from the last
+  committed checkpoint (``llama_pipeline_place`` and friends re-place
+  the param tree on the shrunk mesh).
+- **Nonuniform degraded mode** (NTP, arXiv:2504.06095, generalizing
+  ``MeshSpec.shrink_to``) — when a *smaller* slot is available for the
+  lost stage, ``regroup(slot_width=...)`` keeps the stage count and runs
+  the re-placed stage narrower than its peers; the microbatch count is
+  re-derived against the slowdown factor so the straggling stage's
+  service time is amortized instead of pacing the whole pipe.
+- **Epoch fence** — every re-group bumps the membership epoch. A zombie
+  stage from before the re-group that wakes up and calls ``confirm()``
+  (or publishes a boundary activation under its old epoch's keys) is
+  refused with a typed :class:`~..exceptions.StaleStageEpochError`; the
+  activation keys themselves carry the epoch, so a stale publish can
+  never be consumed by the current membership.
+- **Data plane** — boundary activations move over the PR 10 shm/store
+  data plane under :meth:`activation_key` — content keys scoped by
+  ``(job, epoch, step, boundary, microbatch)``.
+
+Scheduler integration (the PR 8 scheduler's first multi-pod-gang tenant)
+lives in ``controller/scheduler.py``: :meth:`gang_request` emits the
+per-stage demand rows ``Scheduler.admit_gang`` admits atomically (all
+stages or queued), and a partial-gang preemption calls back into
+``regroup(cause="Preempted")`` instead of killing the job.
+
+Everything here is host-side bookkeeping — no jax imports — so the soak
+trainer asset and the scheduler can use it without paying an XLA
+interpreter start.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..exceptions import StaleStageEpochError
+
+# causes a re-group may carry: the watchdog's death taxonomy
+# (serving/watchdog.py classify_death) plus the straggler verdict "Slow",
+# which only the pipeline supervisor's heartbeat check produces
+REGROUP_CAUSES = ("Crashed", "Killed", "OOMKilled", "Preempted", "Evicted",
+                  "Exited", "Slow")
+
+# cap on microbatch re-derivation: re-grouping may grow M to amortize a
+# bubble or a slow stage, but never beyond 4x the original draw — past
+# that the per-microbatch batch slice is too small to be worth the
+# schedule length (Ada-Grouper's diminishing-returns knee)
+_MAX_MICROBATCH_GROWTH = 4
+
+
+@dataclass(frozen=True)
+class StageAssignment:
+    """One stage's slice of the pipe: which contiguous layers it owns and
+    how wide its pod slot is. ``width`` is in chips/slots — nonuniform
+    widths are legal (NTP degraded mode) and feed the slowdown-adjusted
+    bubble fraction."""
+
+    stage: int
+    layers: Tuple[int, ...]
+    width: int = 1
+
+    def __post_init__(self):
+        if not self.layers:
+            raise ValueError(f"stage {self.stage} owns no layers")
+        if list(self.layers) != list(range(self.layers[0],
+                                           self.layers[-1] + 1)):
+            raise ValueError(
+                f"stage {self.stage} layers {self.layers} not contiguous")
+        if self.width < 1:
+            raise ValueError(f"stage {self.stage} width {self.width} < 1")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"stage": self.stage, "layers": list(self.layers),
+                "width": self.width}
+
+
+@dataclass(frozen=True)
+class PipelineMembership:
+    """An immutable stage-membership snapshot at one epoch. The schedule,
+    the bubble fraction, and the activation-key namespace are all derived
+    from it — there is no second copy of "who owns which layers" to
+    drift."""
+
+    epoch: int
+    assignments: Tuple[StageAssignment, ...]
+    n_microbatches: int
+
+    def __post_init__(self):
+        if not self.assignments:
+            raise ValueError("membership needs at least one stage")
+        if self.n_microbatches < 1:
+            raise ValueError(f"n_microbatches={self.n_microbatches} < 1")
+        covered: List[int] = []
+        for i, a in enumerate(self.assignments):
+            if a.stage != i:
+                raise ValueError(f"assignment {i} carries stage {a.stage}")
+            covered.extend(a.layers)
+        if covered != list(range(covered[0], covered[0] + len(covered))):
+            raise ValueError(f"stages do not tile the layer range: {covered}")
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.assignments)
+
+    @property
+    def n_layers(self) -> int:
+        return sum(len(a.layers) for a in self.assignments)
+
+    @property
+    def slowdown(self) -> float:
+        """Pace factor of the slowest stage vs. a full-width peer: GPipe
+        ticks are lockstep, so one narrow stage paces every tick. 1.0 for
+        a uniform membership."""
+        full = max(a.width for a in self.assignments)
+        return max(full / a.width for a in self.assignments)
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Fraction of the schedule's wall-clock lost to non-useful work:
+        the classic GPipe ``(P-1)/(M+P-1)`` bubble, slowdown-adjusted for
+        nonuniform widths (a narrow stage stretches every tick, so useful
+        throughput shrinks by the pace factor too)."""
+        P, M = self.n_stages, self.n_microbatches
+        return 1.0 - M / ((M + P - 1) * self.slowdown)
+
+    def layer_owner(self, layer: int) -> int:
+        for a in self.assignments:
+            if a.layers[0] <= layer <= a.layers[-1]:
+                return a.stage
+        raise ValueError(f"layer {layer} not in any stage")
+
+    def schedule(self) -> List[List[Tuple[int, int]]]:
+        """The GPipe tick schedule derived from this membership: for each
+        of the ``M + P - 1`` ticks, the list of ``(stage, microbatch)``
+        pairs doing useful work. Bubble ticks are the gaps. Re-deriving
+        this after a re-group IS the schedule re-computation — there is
+        nothing else to update."""
+        P, M = self.n_stages, self.n_microbatches
+        return [[(p, t - p) for p in range(P) if 0 <= t - p < M]
+                for t in range(M + P - 1)]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch,
+                "n_microbatches": self.n_microbatches,
+                "bubble_fraction": round(self.bubble_fraction, 6),
+                "assignments": [a.to_dict() for a in self.assignments]}
+
+
+def _derive_microbatches(m_original: int, n_stages: int,
+                         slowdown: float, bubble_budget: float) -> int:
+    """Ada-Grouper's microbatch re-grouping, closed-form: the smallest
+    ``M >= m_original`` whose slowdown-adjusted bubble fraction fits the
+    budget, capped at ``_MAX_MICROBATCH_GROWTH x`` (past which the bubble
+    asymptote ``1 - 1/slowdown`` is as close as M can buy)."""
+    cap = m_original * _MAX_MICROBATCH_GROWTH
+    m = m_original
+    while m < cap:
+        bubble = 1.0 - m / ((m + n_stages - 1) * slowdown)
+        if bubble <= bubble_budget + 1e-9:
+            break
+        m += 1
+    return m
+
+
+class ElasticPipeline:
+    """The stage-membership brain for one pipelined job: owns the current
+    :class:`PipelineMembership`, performs every re-group, and enforces the
+    epoch fence. Thread-safe — the supervisor's poll thread re-groups
+    while stage RPCs confirm.
+
+    ``on_regroup`` (optional) is called with the NEW membership and the
+    regroup event dict after every successful re-group — the supervisor
+    hook that re-places params (``llama_pipeline_place`` from the last
+    committed checkpoint) and re-tasks the surviving stages.
+    """
+
+    def __init__(self, n_layers: int, n_stages: int, *,
+                 n_microbatches: Optional[int] = None, stage_width: int = 1,
+                 job: str = "pipeline", device_class: str = "cpu",
+                 policy=None,
+                 on_regroup: Optional[Callable[..., None]] = None):
+        if n_layers < n_stages:
+            raise ValueError(f"n_layers={n_layers} < n_stages={n_stages}")
+        if policy is None:
+            from ..serving.elastic import ElasticPolicy
+            policy = ElasticPolicy()
+        from ..resilience import RestartBudget
+        self.job = job
+        self.device_class = device_class
+        self.policy = policy
+        # the SPLIT budget, same shape as the SPMD elastic coordinator's:
+        # re-groups draw from the elastic resume budget/window, so "how
+        # often may this job degrade per hour" is one knob for both the
+        # rank-loss and the stage-loss paths
+        self.budget = RestartBudget(policy.max_resumes,
+                                    policy.resume_window_s)
+        self.on_regroup = on_regroup
+        self._lock = threading.Lock()
+        self._m_original = n_microbatches or n_stages
+        base = n_layers // n_stages
+        extra = n_layers % n_stages
+        start = 0
+        assignments = []
+        for s in range(n_stages):
+            size = base + (1 if s < extra else 0)
+            assignments.append(StageAssignment(
+                s, tuple(range(start, start + size)), stage_width))
+            start += size
+        self._membership = PipelineMembership(
+            0, tuple(assignments), self._m_original)
+        self.regroups: List[Dict[str, Any]] = []
+        self.stale_refusals = 0
+        self._publish_gauges()
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def membership(self) -> PipelineMembership:
+        with self._lock:
+            return self._membership
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._membership.epoch
+
+    def confirm(self, stage: int, epoch: int) -> StageAssignment:
+        """A stage confirms it is acting under ``epoch``. Returns its
+        current assignment; raises the typed fence error when the epoch
+        is stale — the zombie's signal to tear itself down."""
+        with self._lock:
+            current = self._membership
+            if epoch != current.epoch:
+                self.stale_refusals += 1
+                telemetry.pipeline_metrics()["stale"].inc()
+                raise StaleStageEpochError(
+                    f"stage {stage} of {self.job!r} confirmed at epoch "
+                    f"{epoch} but membership moved to {current.epoch}",
+                    job=self.job, stage=stage, epoch=epoch,
+                    current_epoch=current.epoch)
+            if not 0 <= stage < current.n_stages:
+                raise StaleStageEpochError(
+                    f"stage {stage} is not in the epoch-{current.epoch} "
+                    f"membership of {self.job!r} (stages "
+                    f"0..{current.n_stages - 1})",
+                    job=self.job, stage=stage, epoch=epoch,
+                    current_epoch=current.epoch)
+            return current.assignments[stage]
+
+    # -- re-grouping (the ONLY membership mutation in the tree) --------------
+
+    def regroup(self, lost_stage: int, cause: str,
+                slot_width: Optional[int] = None) -> PipelineMembership:
+        """React to the loss/slowdown of ``lost_stage``:
+
+        - ``slot_width=None`` — no replacement slot: the lost stage's
+          layer shard is absorbed by its neighbors (front half to the
+          previous stage, back half to the next), the pipe shortens to
+          P-1, and M is re-derived against the old bubble budget.
+        - ``slot_width=w`` — a narrower slot is available (NTP degraded
+          mode): the stage keeps its layers but runs at width ``w``; M is
+          re-derived against the resulting pace factor.
+
+        Bumps the epoch, records the event, updates ``kt_pipeline_*``,
+        and invokes ``on_regroup``. Raises ``RuntimeError`` when the
+        re-group budget is spent or the pipe cannot shrink further.
+        """
+        if cause not in REGROUP_CAUSES:
+            raise ValueError(f"unknown regroup cause {cause!r} "
+                             f"(one of {', '.join(REGROUP_CAUSES)})")
+        with self._lock:
+            old = self._membership
+            if not 0 <= lost_stage < old.n_stages:
+                raise ValueError(f"lost_stage={lost_stage} not in "
+                                 f"0..{old.n_stages - 1}")
+            if slot_width is None and old.n_stages == 1:
+                raise RuntimeError(
+                    f"{self.job!r} lost its only stage; nothing to absorb "
+                    "into")
+            if not self.budget.try_acquire():
+                raise RuntimeError(
+                    f"{self.job!r} re-group budget exhausted "
+                    f"({self.policy.max_resumes} per "
+                    f"{self.policy.resume_window_s:g}s)")
+            bubble_budget = max(old.bubble_fraction,
+                                (old.n_stages - 1)
+                                / (old.n_microbatches + old.n_stages - 1))
+            if slot_width is not None:
+                mode = "narrow"
+                assignments = tuple(
+                    a if a.stage != lost_stage
+                    else StageAssignment(a.stage, a.layers,
+                                         max(1, slot_width))
+                    for a in old.assignments)
+            else:
+                mode = "absorb"
+                lost = old.assignments[lost_stage]
+                front = len(lost.layers) // 2 if lost_stage > 0 else 0
+                if lost_stage == old.n_stages - 1:
+                    front = len(lost.layers)
+                assignments_l: List[StageAssignment] = []
+                for a in old.assignments:
+                    if a.stage == lost_stage:
+                        continue
+                    layers = a.layers
+                    if a.stage == lost_stage - 1 and front:
+                        layers = layers + lost.layers[:front]
+                    elif a.stage == lost_stage + 1 and front < len(lost.layers):
+                        layers = lost.layers[front:] + layers
+                    stage = a.stage if a.stage < lost_stage else a.stage - 1
+                    assignments_l.append(
+                        StageAssignment(stage, layers, a.width))
+                assignments = tuple(assignments_l)
+            slowdown = (max(a.width for a in assignments)
+                        / min(a.width for a in assignments))
+            m = _derive_microbatches(self._m_original, len(assignments),
+                                     slowdown, bubble_budget)
+            new = PipelineMembership(old.epoch + 1, assignments, m)
+            event = {"epoch": new.epoch, "cause": cause, "mode": mode,
+                     "lost_stage": lost_stage, "n_stages": new.n_stages,
+                     "n_microbatches": m,
+                     "bubble_fraction": round(new.bubble_fraction, 6),
+                     "at": time.time()}
+            self._membership = new
+            self.regroups.append(event)
+            del self.regroups[:-16]
+            telemetry.pipeline_metrics()["regroups"].inc(cause=cause)
+            self._publish_gauges()
+            telemetry.add_event("pipeline.regroup", job=self.job,
+                                cause=cause, mode=mode, epoch=new.epoch,
+                                lost_stage=lost_stage)
+        if self.on_regroup is not None:
+            self.on_regroup(new, event)
+        return new
+
+    def _publish_gauges(self) -> None:
+        m = telemetry.pipeline_metrics()
+        m["epoch"].set(self._membership.epoch)
+        m["stages"].set(self._membership.n_stages)
+        m["bubble"].set(self._membership.bubble_fraction)
+
+    # -- data plane ----------------------------------------------------------
+
+    def activation_key(self, step: int, boundary: int, microbatch: int,
+                       epoch: Optional[int] = None) -> str:
+        """Store/shm data-plane key for the boundary activation leaving
+        stage ``boundary`` into stage ``boundary + 1`` (boundary 0 =
+        the pipe input, boundary P = the pipe output). Epoch-scoped, so a
+        zombie stage's stale publish lands in a namespace nobody reads."""
+        e = self._membership.epoch if epoch is None else epoch
+        return (f"pipeline/{self.job}/e{e}/step{step}"
+                f"/b{boundary}/mb{microbatch}")
+
+    # -- scheduler integration ----------------------------------------------
+
+    def gang_request(self) -> List[Dict[str, Any]]:
+        """Per-stage demand rows for ``Scheduler.admit_gang`` — the gang
+        is admitted atomically (every stage or none)."""
+        with self._lock:
+            return [{"stage": a.stage, "device_class": self.device_class,
+                     "width": a.width}
+                    for a in self._membership.assignments]
+
+    # -- surfacing -----------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Surfaced under ``/health``'s ``pipeline`` key."""
+        with self._lock:
+            return {"job": self.job,
+                    "membership": self._membership.to_dict(),
+                    "regroups": list(self.regroups[-4:]),
+                    "stale_refusals": self.stale_refusals,
+                    **{f"budget_{k}": v
+                       for k, v in self.budget.state().items()}}

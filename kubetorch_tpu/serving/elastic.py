@@ -137,7 +137,14 @@ class ElasticPolicy:
         """Typed death cause → elastic action. ``Preempted``/``Evicted``
         deaths land here only when the drain window was missed (the
         cooperative path checkpoints *before* death) — the remedy is the
-        same resume-from-last-commit as any other loss."""
+        same resume-from-last-commit as any other loss.
+
+        The pipeline supervisor (ISSUE 17) feeds the same taxonomy plus
+        the straggler cause ``Slow`` (``watchdog.CAUSE_SLOW`` — alive but
+        stalled, so there is no exitcode to classify): for a pipelined
+        job every RESUME-class cause maps to a stage RE-GROUP under the
+        same resume budget/window, so "how often may this job degrade"
+        stays one knob for both distribution shapes."""
         if cause == "OOMKilled":
             return RESTART_SMALLER_BATCH
         return RESUME

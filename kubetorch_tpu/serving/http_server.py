@@ -437,6 +437,14 @@ async def health(request: web.Request) -> web.Response:
             body["router"] = sup.router_state()
         except Exception:  # noqa: BLE001 — health must never 500 over this
             pass
+    # elastic pipeline parallelism (ISSUE 17): stage membership epoch,
+    # bubble fraction, and recent re-groups — the operator's view of a
+    # pipe that degraded around a lost stage instead of stalling
+    if sup is not None and hasattr(sup, "pipeline_state"):
+        try:
+            body["pipeline"] = sup.pipeline_state()
+        except Exception:  # noqa: BLE001 — health must never 500 over this
+            pass
     return web.json_response(body)
 
 

@@ -1,0 +1,162 @@
+"""Stage-gang supervision for elastic pipeline parallelism (ISSUE 17).
+
+The host-side loop that keeps a pipelined job's stage gang alive:
+
+- watches one subprocess per stage (anything with ``poll()``/``kill()`` —
+  ``subprocess.Popen`` or a test double),
+- classifies a dead stage with the watchdog's taxonomy
+  (:func:`~.watchdog.classify_death`) and a *live but stalled* stage with
+  :func:`~.watchdog.classify_straggler` (heartbeat age → ``Slow``),
+- drives the membership re-group through the ONLY site allowed to do it
+  (:class:`~..parallel.pipeline_elastic.ElasticPipeline`) and relaunches
+  the new membership's stages — old-epoch processes are killed, not
+  reasoned with; a zombie that survives the kill is fenced by
+  ``StaleStageEpochError`` at its next confirm,
+- measures the re-group stall (fault detected → first post-re-group step
+  committed) into ``kt_pipeline_regroup_seconds`` and checks it against
+  the elastic resume window — the acceptance bar is "progress resumes
+  within ONE window, never a full-pipeline stall".
+
+The supervisor never touches membership state itself — it asks the
+``ElasticPipeline`` and relaunches whatever comes back. ``launch`` is the
+embedder's factory: ``launch(assignment, epoch, resume)`` → process
+handle. The trainer assets and ``bench.py --pipeline`` embed this class
+directly; a serving supervisor exposes :meth:`pipeline_state` and the
+``/health`` handler picks it up by duck type (``body["pipeline"]``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .. import telemetry
+from .watchdog import CAUSE_SLOW, classify_death, classify_straggler
+
+
+class PipelineSupervisor:
+    """Supervise one stage gang. Single-threaded by design: the embedder
+    owns the loop and calls :meth:`poll` between steps (the trainer
+    drivers) or from a timer (a serving pod)."""
+
+    def __init__(self, pipe, launch: Callable[..., Any], *,
+                 stall_after_s: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.pipe = pipe
+        self.launch = launch
+        self.stall_after_s = float(stall_after_s)
+        self.clock = clock
+        self.procs: Dict[int, Any] = {}
+        self._beats: Dict[int, float] = {}
+        # a re-group in flight: t0 is fault-detection time; cleared (and
+        # observed) when the first post-re-group step commits
+        self._regroup_t0: Optional[float] = None
+        self.last_regroup_stall_s: Optional[float] = None
+        self.regroups_over_window = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        membership = self.pipe.membership
+        for a in membership.assignments:
+            self.procs[a.stage] = self.launch(a, membership.epoch,
+                                              resume=False)
+            self._beats[a.stage] = self.clock()
+
+    def beat(self, stage: int) -> None:
+        """Heartbeat from a stage (the driver calls this when it sees any
+        output/activation from the stage) — feeds the straggler check."""
+        self._beats[stage] = self.clock()
+
+    def stop(self) -> None:
+        for proc in self.procs.values():
+            try:
+                proc.kill()
+            except (OSError, AttributeError):
+                pass
+        self.procs.clear()
+
+    # -- fault detection -----------------------------------------------------
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """One supervision pass: find at most one dead/stalled stage and
+        re-group around it. Returns the re-group event dict, or None when
+        every stage is healthy. One fault per pass — a second casualty is
+        found on the next poll, against the already-re-grouped membership
+        (its stage numbering, not the old one)."""
+        now = self.clock()
+        for stage, proc in list(self.procs.items()):
+            exitcode = proc.poll()
+            if exitcode is not None and exitcode != 0:
+                return self._regroup(stage, classify_death(exitcode))
+        if self.stall_after_s > 0:
+            for stage, proc in list(self.procs.items()):
+                if proc.poll() is not None:
+                    continue    # exited 0 = done, not a straggler
+                age = now - self._beats.get(stage, now)
+                if classify_straggler(age, self.stall_after_s) is not None:
+                    return self._regroup(stage, CAUSE_SLOW, stall_age=age)
+        return None
+
+    def _regroup(self, lost_stage: int, cause: str,
+                 stall_age: Optional[float] = None) -> Dict[str, Any]:
+        t0 = self.clock()
+        # the lost stage's process first: a Slow stage is still alive and
+        # would otherwise keep publishing under the old epoch until its
+        # next confirm bounces off the fence
+        doomed = self.procs.pop(lost_stage, None)
+        if doomed is not None:
+            try:
+                doomed.kill()
+            except (OSError, AttributeError):
+                pass
+        membership = self.pipe.regroup(lost_stage, cause)
+        # stage↔layer ownership changed for the survivors too (absorbed
+        # shards, renumbered stages): relaunch the whole new membership
+        # from the last committed checkpoint rather than guessing which
+        # old process maps to which new assignment
+        for proc in self.procs.values():
+            try:
+                proc.kill()
+            except (OSError, AttributeError):
+                pass
+        self.procs.clear()
+        for a in membership.assignments:
+            self.procs[a.stage] = self.launch(a, membership.epoch,
+                                              resume=True)
+            self._beats[a.stage] = self.clock()
+        self._regroup_t0 = t0
+        event = dict(self.pipe.regroups[-1])
+        if stall_age is not None:
+            event["stall_age_s"] = round(stall_age, 3)
+        return event
+
+    def note_committed_step(self, step: int) -> Optional[float]:
+        """The driver reports a committed step. The first one after a
+        re-group closes the stall clock: observe it, compare against the
+        elastic resume window, and return the stall seconds (None when no
+        re-group was pending)."""
+        if self._regroup_t0 is None:
+            return None
+        stall = self.clock() - self._regroup_t0
+        self._regroup_t0 = None
+        self.last_regroup_stall_s = stall
+        telemetry.pipeline_metrics()["regroup_seconds"].observe(stall)
+        window = getattr(self.pipe.policy, "resume_window_s", 0.0)
+        if window and stall > window:
+            self.regroups_over_window += 1
+        return stall
+
+    # -- surfacing -----------------------------------------------------------
+
+    def pipeline_state(self) -> Dict[str, Any]:
+        """``/health``'s ``pipeline`` section (duck-typed hook)."""
+        state = self.pipe.state_dict()
+        state["stages_live"] = sum(
+            1 for p in self.procs.values() if p.poll() is None)
+        state["regroup_pending"] = self._regroup_t0 is not None
+        if self.last_regroup_stall_s is not None:
+            state["last_regroup_stall_s"] = round(
+                self.last_regroup_stall_s, 3)
+        state["regroups_over_window"] = self.regroups_over_window
+        return state

@@ -207,6 +207,27 @@ def classify_death(exitcode: Optional[int], draining: Optional[bool] = None,
     return "Killed"
 
 
+# the straggler cause (ISSUE 17): a pipeline stage that is ALIVE but has
+# stopped making progress. classify_death can never produce it (there is
+# no exitcode), so the elastic layers treat it as a distinct member of the
+# cause taxonomy — a Slow stage is re-grouped around, not restarted
+CAUSE_SLOW = "Slow"
+
+
+def classify_straggler(heartbeat_age_s: float,
+                       stall_after_s: float) -> Optional[str]:
+    """``CAUSE_SLOW`` when a live process's last heartbeat is older than
+    the stall threshold, else None. Pure (ages are passed in, not
+    sampled) so the pipeline supervisor's stall detection is testable
+    without real clocks — the dead/slow distinction matters because a
+    GPipe tick is lockstep: one straggling stage paces every tick, so
+    waiting it out costs the whole pipe while re-grouping costs one
+    stage's layers."""
+    if stall_after_s > 0 and heartbeat_age_s > stall_after_s:
+        return CAUSE_SLOW
+    return None
+
+
 class Watchdog:
     """Liveness monitor for one :class:`ProcessPool`.
 

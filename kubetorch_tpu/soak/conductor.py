@@ -59,6 +59,8 @@ _MUTATED_ENV = ("KT_STORE_NODES", "KT_STORE_REPLICATION",
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _TRAINER = os.path.join(_REPO_ROOT, "tests", "assets", "fed_trainer.py")
+_PIPELINE_TRAINER = os.path.join(_REPO_ROOT, "tests", "assets",
+                                 "pipeline_trainer.py")
 
 
 @dataclass
@@ -178,6 +180,96 @@ class _Trainer:
         return out
 
 
+class _PipelineTrainer:
+    """The 4-stage pipelined trainer under fire (ISSUE 17):
+    ``pipeline_trainer.py`` drives real stage subprocesses over the soak's
+    store ring. The schedule's ``stage:N`` boot-chaos token rides
+    ``KT_CHAOS`` + ``KT_CHAOS_STAGE`` into the driver's environment, so
+    exactly one stage self-faults mid-step (kill or stall) and the
+    driver's embedded supervisor must re-group. Settle waits the driver
+    out, then runs the unpartitioned ``--replay`` pass whose fingerprints
+    the pipeline-progress invariant bit-compares against the committed
+    steps."""
+
+    def __init__(self, store: str, base_dir: str, steps: int, seed: int,
+                 boot_chaos: Dict[str, str]):
+        self.store = store
+        self.steps = steps
+        self.seed = seed
+        self.result = os.path.join(base_dir, "pipeline-ledger.jsonl")
+        self.replay_result = os.path.join(base_dir,
+                                          "pipeline-replay.jsonl")
+        self.stage_token = ""
+        self.stage_index = ""
+        for target, tok in sorted(boot_chaos.items()):
+            if target.startswith("stage:"):
+                self.stage_index = target.split(":")[1]
+                self.stage_token = tok
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> None:
+        if not os.path.exists(_PIPELINE_TRAINER):
+            raise RuntimeError(
+                f"pipeline trainer asset missing: {_PIPELINE_TRAINER}")
+        env = _clean_child_env()
+        if self.stage_token:
+            env["KT_CHAOS"] = self.stage_token
+            env["KT_CHAOS_STAGE"] = self.stage_index
+            env["KT_CHAOS_SEED"] = str(self.seed)
+        self.proc = subprocess.Popen(
+            [sys.executable, _PIPELINE_TRAINER, "--store", self.store,
+             "--steps", str(self.steps), "--stages", "4",
+             "--result", self.result],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def replay(self, timeout: float) -> None:
+        """The bit-identity oracle: recompute the same steps in ONE
+        process with no pipeline partitioning, chaos-free."""
+        try:
+            subprocess.run(
+                [sys.executable, _PIPELINE_TRAINER, "--replay",
+                 "--steps", str(self.steps), "--stages", "4",
+                 "--result", self.replay_result],
+                env=_clean_child_env(), timeout=timeout,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                check=False)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            kill_process_tree(self.proc.pid)
+        self.proc = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def ledger(self) -> List[Dict]:
+        out: List[Dict] = []
+        for path in (self.result, self.replay_result):
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            out.append(json.loads(line))
+                        except ValueError:
+                            out.append({"corrupt_line": line[:120]})
+        return out
+
+
+def _import_pipeline_ledger(history: History,
+                            ptrainer: Optional["_PipelineTrainer"]) -> None:
+    for rec in ptrainer.ledger() if ptrainer is not None else []:
+        event = rec.get("event")
+        if not event:
+            continue
+        history.record("pipeline", **{k: v for k, v in rec.items()
+                                      if k != "kind"})
+
+
 def _record_op(history: History, op: str, key: str, fn) -> Any:
     """Run one client op, record its client-visible outcome (typed or
     raw), never let the exception escape the soak loop."""
@@ -262,6 +354,7 @@ def run_soak(sched: Schedule, base_dir: str,
     has_trainer = sched.profile in ("train", "federation", "all")
     has_gateway = sched.profile in ("serve", "federation", "all")
     has_regions = sched.profile in ("federation", "all")
+    has_pipeline = sched.profile == "pipeline"
 
     saved_env = {k: os.environ.get(k) for k in _MUTATED_ENV}
     # fleet/gateway/trainer children spawn with `python -m kubetorch_tpu...`
@@ -277,6 +370,7 @@ def run_soak(sched: Schedule, base_dir: str,
     fleet = None
     gateway: Optional[_Gateway] = None
     trainer: Optional[_Trainer] = None
+    ptrainer: Optional[_PipelineTrainer] = None
     door = None
     lease: Optional[LeaseTable] = None
     holder: Dict[str, Any] = {}
@@ -429,6 +523,15 @@ def run_soak(sched: Schedule, base_dir: str,
             trainer = _Trainer(",".join(fleet.urls), base_dir,
                                steps=max(6, sched.n_ops // 3))
             trainer.start(resume=False)
+        if has_pipeline and fleet is not None:
+            # the driver supervises its own stage gang and re-groups
+            # in-process; the conductor only arms the stage-scoped chaos
+            # and, at settle, runs the unpartitioned replay oracle
+            ptrainer = _PipelineTrainer(",".join(fleet.urls), base_dir,
+                                        steps=max(6, sched.n_ops // 2),
+                                        seed=sched.seed,
+                                        boot_chaos=sched.boot_chaos)
+            ptrainer.start()
         if has_regions:
             lease = LeaseTable()
             epoch = lease.grant("job-0", "region-a")
@@ -516,15 +619,24 @@ def run_soak(sched: Schedule, base_dir: str,
                     time.sleep(0.2)
                 history.record("verify", key=key, ok=got is not None,
                                match=(got == expected[key]), error=err)
+        if ptrainer is not None:
+            try:
+                ptrainer.proc.wait(timeout=settle_timeout_s)
+            except subprocess.TimeoutExpired:
+                ptrainer.kill()
+            ptrainer.replay(timeout=settle_timeout_s)
         if holder:
             history.record("placement", event="stop",
                            workload=holder["workload"],
                            region=holder["region"],
                            epoch=holder["epoch"])
         _import_ledger(history, trainer)
+        _import_pipeline_ledger(history, ptrainer)
     finally:
         if trainer is not None:
             trainer.kill()
+        if ptrainer is not None:
+            ptrainer.kill()
         if gateway is not None:
             gateway.kill()
         roots = list(fleet.roots) if fleet is not None else []

@@ -27,6 +27,13 @@ Record shapes (all plain dicts; ``index`` is assigned on append):
   the final scrub verdict.
 - ``{"kind": "leak-scan", "shm": [...], "tmp": [...]}`` — leftover
   /dev/shm segments and orphan .tmp files after teardown.
+- ``{"kind": "pipeline", "event": "committed|regroup|placed|
+  stale-refused|replay", ...}`` — the pipelined trainer's ledger
+  (ISSUE 17): ``committed`` carries ``step``/``epoch``/``fingerprint``,
+  ``regroup`` carries ``epoch``/``cause``/``lost_stage``, ``placed``
+  carries ``stage``/``epoch`` (a stage taking up an assignment),
+  ``stale-refused`` a zombie confirm bounced by the epoch fence, and
+  ``replay`` the unpartitioned re-run's ``step``/``fingerprint``.
 """
 
 from __future__ import annotations
@@ -292,6 +299,82 @@ def check_no_leaks(records: List[Dict]) -> List[Violation]:
     return out
 
 
+def check_pipeline_progress(records: List[Dict]) -> List[Violation]:
+    """Re-grouped forward progress, epoch-fenced placement, and replay
+    bit-identity for the pipelined trainer (ISSUE 17):
+
+    - every ``regroup`` must be followed by a ``committed`` step strictly
+      greater than the highest step committed before it — the pipe
+      re-grouped and MOVED, it did not stall;
+    - every ``placed`` record must carry the membership epoch current at
+      that point in the history (the latest ``regroup``'s epoch, 0 before
+      any) — a placement at an older epoch means a zombie stage took up
+      an assignment the fence should have refused;
+    - when an unpartitioned ``replay`` ran, every committed step it
+      covers must bit-match its fingerprint, and the highest committed
+      step must be covered — partitioning the layers across a re-group
+      changed nothing about the math.
+    """
+    out: List[Violation] = []
+    high = 0
+    epoch = 0
+    pending: Optional[Dict] = None       # last regroup awaiting progress
+    committed: Dict[int, Tuple[str, int]] = {}
+    replays: Dict[int, Tuple[str, int]] = {}
+    for r in records:
+        if r.get("kind") != "pipeline":
+            continue
+        event = r.get("event")
+        if event == "committed" and r.get("step") is not None:
+            step = r["step"]
+            if r.get("fingerprint"):
+                committed.setdefault(step, (r["fingerprint"], r["index"]))
+            if pending is not None and step > high:
+                pending = None
+            high = max(high, step)
+        elif event == "regroup":
+            if pending is not None:
+                out.append(Violation(
+                    "pipeline-progress",
+                    f"regroup to epoch {pending.get('epoch')} was never "
+                    f"followed by a committed step > {high} before the "
+                    f"next regroup — the pipe stalled",
+                    [pending["index"], r["index"]]))
+            pending = r
+            epoch = max(epoch, int(r.get("epoch", epoch)))
+        elif event == "placed":
+            if int(r.get("epoch", 0)) < epoch:
+                out.append(Violation(
+                    "pipeline-progress",
+                    f"stage {r.get('stage')} placed at stale epoch "
+                    f"{r.get('epoch')} (current {epoch}) — the membership "
+                    f"fence should have refused it", [r["index"]]))
+        elif event == "replay" and r.get("step") is not None \
+                and r.get("fingerprint"):
+            replays.setdefault(r["step"], (r["fingerprint"], r["index"]))
+    if pending is not None:
+        out.append(Violation(
+            "pipeline-progress",
+            f"regroup to epoch {pending.get('epoch')} was never followed "
+            f"by a committed step > {high} — the pipe stalled",
+            [pending["index"]]))
+    if replays:
+        for step, (fp, idx) in sorted(committed.items()):
+            seen = replays.get(step)
+            if seen is not None and seen[0] != fp:
+                out.append(Violation(
+                    "pipeline-progress",
+                    f"committed step {step} does not bit-match the "
+                    f"unpartitioned replay ({fp[:12]}… vs {seen[0][:12]}…)",
+                    [idx, seen[1]]))
+        if high and high in committed and high not in replays:
+            out.append(Violation(
+                "pipeline-progress",
+                f"replay ran but never covered the highest committed "
+                f"step {high}", [committed[high][1]]))
+    return out
+
+
 INVARIANTS = {
     "durability": check_durability,
     "commits": check_commits,
@@ -299,6 +382,7 @@ INVARIANTS = {
     "typed-errors": check_typed_errors,
     "ring-convergence": check_ring_converged,
     "no-leaks": check_no_leaks,
+    "pipeline-progress": check_pipeline_progress,
 }
 
 

@@ -27,7 +27,7 @@ from typing import Dict, List
 
 from ..chaos import verb_registry
 
-PROFILES = ("store", "train", "serve", "federation", "all")
+PROFILES = ("store", "train", "serve", "federation", "all", "pipeline")
 
 # Boot-armed persistent HTTP faults (the %PROB half of the grammar): verb
 # name → (token template, weight). Only retryable-by-contract verbs arm
@@ -147,7 +147,11 @@ def generate(seed: int, profile: str, n_ops: int,
                          f"(one of {', '.join(PROFILES)})")
     rng = random.Random(seed)
     registry = {v.name: v for v in verb_registry()}
-    has_store = profile in ("store", "train", "federation", "all")
+    # the pipeline profile keeps the store ring up: boundary activations
+    # and committed checkpoints ride it, and the ring absorbing a store
+    # death MID-re-group is exactly the compound failure worth soaking
+    has_store = profile in ("store", "train", "federation", "all",
+                            "pipeline")
     has_trainer = profile in ("train", "federation", "all")
     has_gateway = profile in ("serve", "federation", "all")
     has_regions = profile in ("federation", "all")
@@ -252,6 +256,19 @@ def generate(seed: int, profile: str, n_ops: int,
                                  verb="kill-template"))
         events.append(FaultEvent(back, "cold-burst", "gateway:0",
                                  verb="kill-joiner"))
+
+    # draw 8: the pipeline profile's stage-loss episode (ISSUE 17),
+    # boot-armed into ONE stage worker's KT_CHAOS (the conductor exports
+    # KT_CHAOS_STAGE so only that stage consults the plan): 70% a hard
+    # SIGKILL mid-step (the death path the re-grouper absorbs), else a
+    # stall (the straggler path the supervisor must classify Slow, not
+    # dead). Appended after draw 7 — draw order is the format.
+    if profile == "pipeline":
+        stage = rng.randrange(1, 4)
+        op_idx = rng.randrange(1, 4)
+        tok = (f"kill-stage:9@{op_idx}" if rng.random() < 0.7
+               else f"stall-stage:2.5@{op_idx}")
+        sched.boot_chaos[f"stage:{stage}"] = tok
 
     sched.events = sorted(events, key=lambda e: (e.at_op, e.action,
                                                  e.target))

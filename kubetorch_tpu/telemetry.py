@@ -932,6 +932,46 @@ def soak_metrics() -> Dict[str, "_Metric"]:
     return _SOAK_METRICS
 
 
+_PIPELINE_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def pipeline_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the ``kt_pipeline_*`` family (ISSUE 17): elastic
+    pipeline-parallel health. ``parallel/pipeline_elastic.py`` (the only
+    stage-membership site) sets the gauges and counts re-groups; the
+    pipeline supervisor observes re-group stall wall-clock. One place so
+    ``/health``, ``/metrics``, and ``bench.py --pipeline`` read the same
+    series."""
+    global _PIPELINE_METRICS
+    if _PIPELINE_METRICS is None:
+        _PIPELINE_METRICS = {
+            "regroups": counter(
+                "kt_pipeline_regroups_total",
+                "Pipeline stage re-groups by watchdog-classified cause "
+                "(Crashed, Killed, OOMKilled, Preempted, Evicted, Slow)",
+                labels=("cause",)),
+            "stale": counter(
+                "kt_pipeline_stale_epoch_total",
+                "Zombie-stage confirms/publishes refused with "
+                "StaleStageEpochError",),
+            "epoch": gauge(
+                "kt_pipeline_stage_epoch",
+                "Current stage-membership epoch (bumped on every re-group)"),
+            "stages": gauge(
+                "kt_pipeline_stages",
+                "Live pipeline stages in the current membership"),
+            "bubble": gauge(
+                "kt_pipeline_bubble_fraction",
+                "Pipeline bubble fraction of the current schedule, "
+                "slowdown-adjusted for nonuniform stage widths"),
+            "regroup_seconds": histogram(
+                "kt_pipeline_regroup_seconds",
+                "Stage loss detected -> first post-re-group step committed",
+                buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)),
+        }
+    return _PIPELINE_METRICS
+
+
 # ---------------------------------------------------------------------------
 # Debug endpoint helper (shared by pod + store servers)
 # ---------------------------------------------------------------------------

@@ -301,6 +301,22 @@ AOT_EXEMPT = {"aot_cache.py"}
 AOT_BASELINE: dict = {}
 
 
+# Stage-membership containment (ISSUE 17). parallel/pipeline_elastic.py is
+# the ONLY site that builds or mutates pipeline stage membership:
+# ``ElasticPipeline`` owns the epoch counter, the re-group budget, the
+# absorb/narrow layer math, and the telemetry — a ``PipelineMembership(``
+# or ``StageAssignment(`` constructed anywhere else in the package is a
+# membership the epoch fence never fenced: its stages would accept
+# confirms under a stale epoch and its layers could overlap or leave gaps
+# the validator in pipeline_elastic.py exists to reject. Supervisors and
+# trainers receive membership objects FROM the pipe (``pipe.membership``,
+# ``pipe.regroup(...)``); they never assemble their own. The baseline is
+# EMPTY on purpose and must stay that way.
+MEMBERSHIP_RE = re.compile(r"\b(?:PipelineMembership|StageAssignment)\s*\(")
+MEMBERSHIP_EXEMPT = {"pipeline_elastic.py"}
+MEMBERSHIP_BASELINE: dict = {}
+
+
 def _count_matches(path: Path, pattern: re.Pattern) -> int:
     n = 0
     for line in path.read_text().splitlines():
@@ -660,6 +676,32 @@ def main() -> int:
               "baseline is empty on purpose.")
         return 1
 
+    membership_failures = []
+    membership_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in MEMBERSHIP_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, MEMBERSHIP_RE)
+        if n:
+            membership_counts[rel] = n
+        allowed = MEMBERSHIP_BASELINE.get(rel, 0)
+        if n > allowed:
+            membership_failures.append(
+                f"  {rel}: {n} raw stage-membership construction(s), "
+                f"baseline allows {allowed}")
+    if membership_failures:
+        print("check_resilience: raw stage-membership construction bypasses "
+              "the elastic pipeline:\n" + "\n".join(membership_failures))
+        print("\nPipeline stage membership is built and re-grouped ONLY in "
+              "parallel/pipeline_elastic.py (ElasticPipeline): the epoch "
+              "fence, re-group budget, layer-tiling validation, and "
+              "kt_pipeline_* telemetry all live there. Take memberships "
+              "from pipe.membership / pipe.regroup(...); never assemble "
+              "PipelineMembership/StageAssignment elsewhere. The baseline "
+              "is empty on purpose.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
@@ -690,7 +732,9 @@ def main() -> int:
         + [f for f, allowed in SOAK_RNG_BASELINE.items()
            if soak_rng_counts.get(f, 0) < allowed]
         + [f for f, allowed in AOT_BASELINE.items()
-           if aot_counts.get(f, 0) < allowed])
+           if aot_counts.get(f, 0) < allowed]
+        + [f for f, allowed in MEMBERSHIP_BASELINE.items()
+           if membership_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
@@ -701,7 +745,8 @@ def main() -> int:
               "data-store commit renames, checkpoint writes, step-path "
               "device_get sites, shared-memory segments, engine "
               "param-tree assignments, telemetry sites, soak RNG "
-              "draws, and AOT compile-path entries accounted for")
+              "draws, AOT compile-path entries, and stage-membership "
+              "constructions accounted for")
     return 0
 
 

@@ -42,6 +42,9 @@ _ATTR_SAMPLES = {
     "epoch": 3,
     "current_epoch": 4,
     "current_region": "oregon",
+    # StaleStageEpochError (ISSUE 17 pipeline membership fencing)
+    "job": "train-llama",
+    "stage": 2,
 }
 
 

@@ -1,0 +1,229 @@
+"""Bit-identity pins for the seed's generic pipeline schedules.
+
+``gpipe``'s bubble-masked ``stage_aux`` channel and the interleaved
+(virtual-stage) schedule shipped with the seed but had no direct tests —
+only the llama/MoE wrappers exercised them. The elastic pipeline work
+(ISSUE 17) builds on these paths, so this module pins them hard:
+
+- the generic ``gpipe`` fold is BIT-identical to the sequential fold
+  (same elementwise ops in the same order; any schedule bug that
+  reorders/duplicates a microbatch flips bytes, not just tolerances),
+- ``stage_aux`` counts exactly M*P real executions — the (M+P-1)*P - M*P
+  bubble ticks run garbage and must be masked out of the sum,
+- ``gpipe_interleaved`` with V virtual chunks reproduces the same bytes
+  and rejects microbatch counts that don't advance in blocks of P,
+- the full ``llama_loss_pipelined`` equals unpipelined ``llama_loss``
+  byte-for-byte on a forced-host pipe mesh (fp32, no remat).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.level("release"), pytest.mark.pipeline]
+
+from kubetorch_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+from kubetorch_tpu.parallel.pipeline import gpipe, gpipe_interleaved
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh(cpu_mesh_devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("pipe",))
+
+
+# ---------------------------------------------------------------------------
+# Generic gpipe: a 4-layer elementwise fold, one layer per stage
+# ---------------------------------------------------------------------------
+
+# layer weights (L, D) and batch (B, D); layer l maps h -> tanh(h * w[l] + 0.1)
+_L, _D, _B, _M = 4, 8, 8, 4
+
+
+def _weights():
+    return jax.random.normal(jax.random.PRNGKey(7), (_L, _D), jnp.float32)
+
+
+def _batch():
+    return jax.random.normal(jax.random.PRNGKey(8), (_B, _D), jnp.float32)
+
+
+def _layer(h, w_row):
+    return jnp.tanh(h * w_row + 0.1)
+
+
+def _sequential(w, x):
+    for l in range(_L):
+        x = _layer(x, w[l])
+    return x
+
+
+def _stage_fn(w_local, h):
+    # one stage = one layer here ((1, D) local shard)
+    return _layer(h, w_local[0])
+
+
+def test_gpipe_bit_identical_to_sequential(pipe_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w, x = _weights(), _batch()
+    ref = jax.jit(_sequential)(w, x)
+    w_sharded = jax.device_put(w, NamedSharding(pipe_mesh, P("pipe")))
+    fn = gpipe(_stage_fn, pipe_mesh, n_microbatches=_M,
+               in_specs=P(), params_specs=P("pipe"))
+    out = jax.jit(fn)(w_sharded, x)
+    # bytes, not tolerances: same elementwise ops in the same order
+    assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_gpipe_stage_aux_masks_bubble_ticks(pipe_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w, x = _weights(), _batch()
+    ref = jax.jit(_sequential)(w, x)
+
+    def stage_aux_fn(w_local, h):
+        # constant aux of 1.0 per execution makes the sum a pure counter
+        return _layer(h, w_local[0]), jnp.float32(1.0)
+
+    w_sharded = jax.device_put(w, NamedSharding(pipe_mesh, P("pipe")))
+    fn = gpipe(stage_aux_fn, pipe_mesh, n_microbatches=_M,
+               in_specs=P(), params_specs=P("pipe"), stage_aux=True)
+    out, aux = jax.jit(fn)(w_sharded, x)
+    assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()
+    # exactly M*P real (stage, microbatch) executions; the unmasked
+    # schedule would count (M+P-1)*P = 28 ticks instead of 16
+    assert float(aux) == float(_M * 4)
+
+
+def test_gpipe_stage_aux_data_dependent(pipe_mesh):
+    """A data-dependent aux (the MoE-router shape) sums only real ticks:
+    equals the sequential per-layer sum over the same microbatching."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w, x = _weights(), _batch()
+
+    def stage_aux_fn(w_local, h):
+        y = _layer(h, w_local[0])
+        return y, jnp.sum(y).astype(jnp.float32)
+
+    # sequential reference: per-microbatch, per-layer output sums
+    ref_aux = jnp.float32(0.0)
+    mb_size = _B // _M
+    for m in range(_M):
+        h = x[m * mb_size:(m + 1) * mb_size]
+        for l in range(_L):
+            h = _layer(h, w[l])
+            ref_aux = ref_aux + jnp.sum(h)
+
+    w_sharded = jax.device_put(w, NamedSharding(pipe_mesh, P("pipe")))
+    fn = gpipe(stage_aux_fn, pipe_mesh, n_microbatches=_M,
+               in_specs=P(), params_specs=P("pipe"), stage_aux=True)
+    _, aux = jax.jit(fn)(w_sharded, x)
+    np.testing.assert_allclose(float(aux), float(ref_aux),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved schedule: V=2 virtual chunks per device
+# ---------------------------------------------------------------------------
+
+
+def test_gpipe_interleaved_bit_identical(pipe_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    V, P_size = 2, 4
+    L8 = V * P_size            # 8 layers, one per chunk
+    w8 = jax.random.normal(jax.random.PRNGKey(9), (L8, _D), jnp.float32)
+    x = _batch()
+
+    def seq(w, h):
+        for l in range(L8):
+            h = _layer(h, w[l])
+        return h
+
+    ref = jax.jit(seq)(w8, x)
+
+    # chunk c = v*P + p lives on device p with virtual index v: host layout
+    # (V, P, D) where [v, p] holds layer v*P + p
+    w_host = w8.reshape(V, P_size, _D)
+    w_sharded = jax.device_put(
+        w_host, NamedSharding(pipe_mesh, P(None, "pipe")))
+
+    def chunk_fn(w_local, h):
+        return _layer(h, w_local)
+
+    fn = gpipe_interleaved(chunk_fn, pipe_mesh, n_microbatches=_M,
+                           n_virtual=V, in_specs=P(),
+                           params_specs=P(None, "pipe"))
+    out = jax.jit(fn)(w_sharded, x)
+    assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_gpipe_interleaved_stage_aux_counts_chunk_executions(pipe_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    V, P_size = 2, 4
+    w_host = jax.random.normal(jax.random.PRNGKey(9),
+                               (V, P_size, _D), jnp.float32)
+    w_sharded = jax.device_put(
+        w_host, NamedSharding(pipe_mesh, P(None, "pipe")))
+
+    def chunk_fn(w_local, h):
+        return _layer(h, w_local), jnp.float32(1.0)
+
+    fn = gpipe_interleaved(chunk_fn, pipe_mesh, n_microbatches=_M,
+                           n_virtual=V, in_specs=P(),
+                           params_specs=P(None, "pipe"), stage_aux=True)
+    _, aux = jax.jit(fn)(w_sharded, _batch())
+    # every (chunk, microbatch) pair runs exactly once: M*V per device,
+    # psummed over P devices; bubbles add (P-1)*P ticks if unmasked
+    assert float(aux) == float(_M * V * P_size)
+
+
+def test_gpipe_interleaved_rejects_unaligned_microbatches(pipe_mesh):
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="divisible by pipe"):
+        gpipe_interleaved(lambda w, h: h, pipe_mesh, n_microbatches=3,
+                          n_virtual=2, in_specs=P(),
+                          params_specs=P(None, "pipe"))
+
+
+# ---------------------------------------------------------------------------
+# Full-model pin: pipelined llama loss == unpipelined, byte-for-byte
+# ---------------------------------------------------------------------------
+
+
+def test_llama_pipelined_loss_bit_identical(pipe_mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kubetorch_tpu.parallel.pipeline import llama_loss_pipelined
+
+    cfg = LlamaConfig.tiny(n_layers=4, attn_impl="xla", dtype=jnp.float32,
+                           remat=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, 1)
+    ref = jax.jit(lambda p, t, y: llama_loss(p, t, y, cfg))(
+        params, tokens, targets)
+
+    def place(leaf, is_layer):
+        spec = P("pipe") if is_layer else P()
+        return jax.device_put(leaf, NamedSharding(pipe_mesh, spec))
+
+    sharded = {
+        "embed": place(params["embed"], False),
+        "layers": jax.tree_util.tree_map(lambda l: place(l, True),
+                                         params["layers"]),
+        "final_norm": place(params["final_norm"], False),
+        "lm_head": place(params["lm_head"], False),
+    }
+    out = jax.jit(lambda p, t, y: llama_loss_pipelined(
+        p, t, y, cfg, pipe_mesh, n_microbatches=4))(sharded, tokens, targets)
+    # the elastic work (ISSUE 17) treats the in-XLA pipe as ground truth:
+    # pin bytes so schedule regressions can't hide inside a tolerance
+    assert np.asarray(out).tobytes() == np.asarray(ref).tobytes()
+    assert np.isfinite(float(out))

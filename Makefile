@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout test-pipeline lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step bench-pipeline smoke-tpu dryrun native clean
+.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout test-pipeline test-flywheel lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step bench-pipeline bench-flywheel smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -16,7 +16,7 @@ PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # just when someone remembers to ask.
 test:
 	$(PY_CPU) python -m pytest tests/ -q
-	$(PY_CPU) python scripts/check_perf_gate.py
+	$(PY_CPU) python scripts/check_perf_gate.py --retries 3
 	$(MAKE) soak-smoke
 
 # fast default tier (<3 min): skips the jit-heavy pipeline/parallel/model
@@ -71,6 +71,14 @@ test-federation:
 test-pipeline:
 	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m pipeline --level release
 
+# continuous-learning flywheel suite (ISSUE 19): feedback-ledger durability
+# (quorum-acked segments, at-least-once cursor with hash dedup, epoch-fenced
+# leases), harvest/vacate policy + grace-window exits, gated promotion
+# (eval gate -> canary -> promote/rollback), kill-flywheel/drop-ack chaos
+# verbs, and the loss-proof soak invariant
+test-flywheel:
+	$(PY_CPU) KT_CHAOS_SEED=1234 python -m pytest tests/ -q -m flywheel
+
 # resilience lint: no raw requests.* call sites may bypass the retry layer
 lint:
 	$(PY_CPU) python scripts/check_resilience.py
@@ -83,6 +91,7 @@ soak-smoke:
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 3 --profile store
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 8 --profile pipeline
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 43 --duration 8 --profile pipeline
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 19 --duration 8 --profile flywheel
 
 soak:
 	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 42 --duration 60 --profile all
@@ -170,6 +179,13 @@ bench-rollout:
 # for a >=64MB state (>=10x required) — bench-convention JSON
 bench-step:
 	python bench.py --step-overlap
+
+# flywheel closed-loop bench (ISSUE 19): open-loop serving traffic feeding
+# the REAL ledger -> harvester -> promoter stack on a subprocess store —
+# feedback-to-weights-live p50/p99, serving TTFT/shed impact vs a no-
+# flywheel baseline arm, and vacate-inside-grace exit-coded acceptance
+bench-flywheel:
+	$(PY_CPU) python scripts/bench_serve.py --flywheel
 
 # elastic-pipeline regime (ISSUE 17): pipelined-vs-SPMD tokens/s at equal
 # chips + analytic/measured bubble fraction on the forced 8-device host

@@ -6,8 +6,19 @@ weights flow learner → rollouts through the data store's coordinated
 broadcast window (per-leaf keys, reshard-on-get) — the reference's
 trainer→inference NCCL weight-sync pattern (SURVEY §3.3) without NCCL.
 
+Rewards flow back the other way through the **durable feedback ledger**
+(``kubetorch_tpu.flywheel``, ISSUE 19): each rollout actor appends its
+per-sample rewards as quorum-acked ledger segments, and the learner folds
+them through a :class:`LedgerCursor` — at-least-once with hash dedup, the
+cursor committed per training step. A rollout (or the learner) dying
+mid-round loses nothing: acked feedback survives by construction, and a
+restarted learner resumes from the last committed cursor state instead of
+re-training folded rewards.
+
     python examples/rlhf_actor_learner.py     # runs locally on CPU pods
 """
+
+import argparse
 
 import kubetorch_tpu as kt
 from kubetorch_tpu.data_store.types import BroadcastWindow
@@ -22,14 +33,29 @@ class Learner:
         self.params = {"w": jax.random.normal(jax.random.PRNGKey(0),
                                               (dim, dim), jnp.float32)}
         self.step_count = 0
+        self.cursor = None
 
-    def train_step(self, batch_reward: float):
+    def train_step_from_ledger(self, replicas):
+        """Fold every fresh feedback record into one PPO-ish update. The
+        cursor dedups re-appended records by content hash and commits its
+        positions under this step, so a crash-and-restart never
+        double-trains a folded reward."""
         import jax.numpy as jnp
 
-        # stand-in PPO update: scale by reward signal
-        self.params = {"w": self.params["w"] * (1.0 + 0.01 * batch_reward)}
+        from kubetorch_tpu.flywheel import LedgerCursor
+
+        if self.cursor is None and replicas:
+            self.cursor = LedgerCursor("rlhf", sorted(replicas))
+        batch = self.cursor.poll() if self.cursor is not None else []
+        rewards = [r["payload"]["reward"] for r in batch]
+        reward = sum(rewards) / len(rewards) if rewards else 0.0
+        # stand-in PPO update: scale by the folded reward signal
+        self.params = {"w": self.params["w"] * (1.0 + 0.01 * reward)}
         self.step_count += 1
-        return {"step": self.step_count,
+        if self.cursor is not None:
+            self.cursor.commit_state(self.step_count)
+        return {"step": self.step_count, "folded": len(batch),
+                "reward": reward,
                 "w_norm": float(jnp.linalg.norm(self.params["w"]))}
 
     def publish_weights(self, key: str, world_size: int):
@@ -42,6 +68,7 @@ class Rollout:
     def __init__(self):
         self.params = None
         self.version = -1
+        self.ledger = None
 
     def sync_weights(self, key: str, world_size: int):
         from kubetorch_tpu.data_store import commands as ds
@@ -52,14 +79,30 @@ class Rollout:
         return self.version
 
     def generate(self, n: int = 4):
+        """Generate n samples and append their rewards to the durable
+        ledger — the ack means the segment survives a node loss, so a
+        reward the learner will train on is never lost to a crash."""
+        import os
+
         import jax
         import jax.numpy as jnp
 
+        from kubetorch_tpu.flywheel import FeedbackLedger
+
         assert self.params is not None, "sync_weights first"
-        x = jax.random.normal(jax.random.PRNGKey(self.version), (n, self.params["w"].shape[0]))
+        if self.ledger is None:
+            self.ledger = FeedbackLedger("rlhf", f"rollout-{os.getpid()}")
+        x = jax.random.normal(jax.random.PRNGKey(self.version),
+                              (n, self.params["w"].shape[0]))
         y = x @ self.params["w"]
-        # fake reward: negative mean activation magnitude
-        return float(-jnp.mean(jnp.abs(y)))
+        # fake reward: negative mean activation magnitude, per sample
+        rewards = (-jnp.mean(jnp.abs(y), axis=1)).tolist()
+        hashes = self.ledger.append([
+            {"replica": self.ledger.replica_id, "version": self.version,
+             "sample": i, "reward": float(rw)}
+            for i, rw in enumerate(rewards)])
+        return {"replica": self.ledger.replica_id, "acked": len(hashes),
+                "reward": float(sum(rewards) / len(rewards))}
 
 
 def main(rounds: int = 3, n_rollouts: int = 2):
@@ -69,18 +112,20 @@ def main(rounds: int = 3, n_rollouts: int = 2):
     rollouts.to(kt.Compute(cpus=1).distribute("actor", workers=n_rollouts))
 
     try:
-        reward = 0.0
+        replicas = []
         for r in range(rounds):
-            stats = learner.act(0).train_step(reward)
+            stats = learner.act(0).train_step_from_ledger(replicas)
             key = f"rlhf/weights-v{r}"
             # async: learner publishes while rollouts join the window
             pub = learner.act(0).publish_weights.remote(key, 1 + n_rollouts)
             versions = rollouts.all().sync_weights(key, 1 + n_rollouts)
             pub.result(timeout=120)
-            rewards = rollouts.all().generate(8)
-            reward = sum(rewards) / len(rewards)
+            acks = rollouts.all().generate(8)
+            replicas = sorted({a["replica"] for a in acks})
+            reward = sum(a["reward"] for a in acks) / len(acks)
             print(f"round {r}: learner step {stats['step']} "
                   f"w_norm {stats['w_norm']:.2f} "
+                  f"folded {stats['folded']} feedback records "
                   f"rollout versions {versions} reward {reward:.3f}")
     finally:
         learner.teardown()
@@ -88,4 +133,8 @@ def main(rounds: int = 3, n_rollouts: int = 2):
 
 
 if __name__ == "__main__":
-    main()
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--rollouts", type=int, default=2)
+    args = p.parse_args()
+    main(rounds=args.rounds, n_rollouts=args.rollouts)

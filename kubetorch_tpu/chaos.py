@@ -24,6 +24,8 @@ once, and the backoff sequence matches the policy".
              | kill-peer[:SIG]@OP_INDEX         (process-level; see below)
              | kill-stage[:SIG]@OP_INDEX        (process-level; see below)
              | stall-stage:SECONDS@OP_INDEX     (process-level; see below)
+             | kill-flywheel[:SIG]@OP_INDEX     (process-level; see below)
+             | drop-ack@OP_INDEX                (store-side; see below)
              | shm-corrupt                      (process-level; see below)
              | kill-region[:OP_INDEX]@NAME      (region-scoped; see below)
              | partition[:PCT]                  (client-side netpool; below)
@@ -117,6 +119,26 @@ Fault kinds:
   test runner. Internal store↔store traffic (``X-KT-Replicated``) and the
   exempt probe/ring routes never advance the op counter, so the kill
   lands on exactly the client request the test scheduled it for.
+
+- ``kill-flywheel[:SIG]@N``  **process-level** fault (ISSUE 19): the
+  flywheel trainer self-delivers SIG (default 9) at its N-th (0-based)
+  ledger-consume op — the trainer dying mid-harvest, between a batch
+  poll and its checkpoint commit. Consumed by the trainer loop via
+  :func:`flywheel_kill_plan`, never the HTTP middleware. The resumed
+  trainer must adopt the cursor state its last COMMITTED checkpoint
+  names, so the un-committed batch re-polls and nothing double-trains —
+  the exactly-once-into-a-committed-step invariant the flywheel soak
+  profile pins.
+
+- ``drop-ack@N``  **store-side** fault (ISSUE 19): at the store's N-th
+  (0-based) client-origin *mutating* op (PUT/POST; reads, probes and
+  internal store↔store traffic never advance the counter), the handler
+  RUNS — the write commits durably — and then the chaos layer closes
+  the transport instead of sending the response. The client sees a
+  reset on a write that actually landed: the classic ack-dropped
+  window. The at-least-once appender must retry idempotently (same
+  key, same content) and the consumer's hash dedup must absorb any
+  duplicate — provable without racing a real netsplit.
 
 - ``kill-peer[:SIG]@N``  **process-level, broadcast-tree** fault
   (ISSUE 11): the process (store node or pod) kills itself with SIG
@@ -313,6 +335,17 @@ VERB_REGISTRY: tuple = (
              "straggler the supervisor must classify as Slow (heartbeat "
              "age, not death) and re-group around",
              "stall-stage:2.5@1"),
+    VerbSpec("kill-flywheel", "process", "kill-flywheel[:SIG]@OP_INDEX",
+             "flywheel trainer loop", (),
+             "the flywheel trainer self-delivers SIG at its N-th "
+             "ledger-consume op (death mid-harvest; the resumed trainer "
+             "must re-poll the un-committed batch, never double-train)",
+             "kill-flywheel:9@2", process_fatal=True),
+    VerbSpec("drop-ack", "store", "drop-ack@OP_INDEX", "middleware",
+             ("PUT", "POST"),
+             "run the handler (the write commits), then close the "
+             "transport instead of acking — the at-least-once appender "
+             "must re-put idempotently", "drop-ack@1"),
     VerbSpec("kill-region", "region", "kill-region[:OP_INDEX]@NAME",
              "middleware + step loop", (),
              "SIGKILL every process tagged KT_REGION=NAME at the op index "
@@ -374,9 +407,17 @@ _TEMPLATE_KINDS = ("kill-template", "kill-joiner")
 # KT_CHAOS_STAGE/KT_STAGE — invisible to the HTTP middleware
 _STAGE_KINDS = ("kill-stage", "stall-stage")
 
+# verbs consumed by the flywheel trainer loop (ISSUE 19): the trainer
+# consults flywheel_kill_plan() at each ledger-consume op — invisible to
+# the HTTP middleware, like the stage verbs
+_FLYWHEEL_KINDS = ("kill-flywheel",)
+
 # verbs whose @-suffix is a 0-based op index rather than a path prefix
-_OP_INDEX_KINDS = (_RANK_KINDS + ("kill-store-node", "kill-peer")
-                   + _TEMPLATE_KINDS + _STAGE_KINDS)
+# (drop-ack is middleware-consumed but its @ is an op index too — the
+# store's N-th mutating client op, not a path)
+_OP_INDEX_KINDS = (_RANK_KINDS + ("kill-store-node", "kill-peer",
+                                  "drop-ack")
+                   + _TEMPLATE_KINDS + _STAGE_KINDS + _FLYWHEEL_KINDS)
 
 # verbs whose @-suffix is a REGION NAME (the kill-region blast radius; its
 # op index rides the :ARG slot instead, since @ is taken)
@@ -494,6 +535,15 @@ def _parse_one(token: str, raw: str) -> Fault:
     if head == "kill-stage":
         return Fault(kind="kill-stage",
                      signal_no=_parse_signal(arg or "9", raw))
+    if head == "kill-flywheel":
+        return Fault(kind="kill-flywheel",
+                     signal_no=_parse_signal(arg or "9", raw))
+    if head == "drop-ack":
+        if arg:
+            raise ChaosError(
+                f"drop-ack takes no :ARG in {raw!r} (the @-suffix is "
+                f"the mutating-op index)")
+        return Fault(kind="drop-ack")
     if head == "stall-stage":
         if not arg:
             raise ChaosError(f"stall-stage needs SECONDS in {raw!r}")
@@ -582,6 +632,7 @@ class ChaosEngine:
                   if f.kind not in _RANK_KINDS
                   and f.kind not in _TEMPLATE_KINDS
                   and f.kind not in _STAGE_KINDS
+                  and f.kind not in _FLYWHEEL_KINDS
                   and f.kind != "partition"]
         # kill-store-node/kill-peer fire by op INDEX, not schedule order:
         # armed separately and checked against their own op counters every
@@ -594,9 +645,12 @@ class ChaosEngine:
         # but only on processes whose KT_REGION tag is in the blast radius
         self.region_faults = [f for f in faults if f.kind == "kill-region"
                               and _region_in_scope(f.region)]
+        # drop-ack fires by op index against its own MUTATING-op counter
+        # (PUT/POST only): the handler runs, the ack never leaves
+        self.drop_faults = [f for f in faults if f.kind == "drop-ack"]
         faults = [f for f in faults
                   if f.kind not in ("kill-store-node", "kill-peer",
-                                    "kill-region")]
+                                    "kill-region", "drop-ack")]
         self.schedule = [f for f in faults if f.prob is None]
         self.persistent = [f for f in faults if f.prob is not None]
         self._rng = random.Random(seed)
@@ -613,6 +667,7 @@ class ChaosEngine:
         # every qualifying op, fired or not.
         self.node_ops = 0            # kill-store-node schedule position
         self.region_ops = 0          # kill-region schedule position
+        self.drop_ops = 0            # drop-ack schedule position (PUT/POST)
 
     @classmethod
     def from_env(cls) -> Optional["ChaosEngine"]:
@@ -668,6 +723,14 @@ class ChaosEngine:
                 if hit is None:
                     hit = self._pop_due(self.region_faults, self.region_ops)
                 self.region_ops += 1
+                if method in ("PUT", "POST"):
+                    # drop-ack is method-aware: only mutating client ops
+                    # advance its counter, so the N-th suppressed ack
+                    # lands on exactly the N-th write the test scheduled
+                    if hit is None:
+                        hit = self._pop_due(self.drop_faults,
+                                            self.drop_ops)
+                    self.drop_ops += 1
                 self.data_ops += 1
             if hit is not None:
                 self.injected += 1
@@ -932,6 +995,18 @@ def stage_stall_plan(spec: Optional[str] = None) -> Dict[int, float]:
             for f in _stage_faults("stall-stage", spec)}
 
 
+def flywheel_kill_plan(spec: Optional[str] = None) -> Dict[int, int]:
+    """``{ledger-consume-op index → signal}`` from ``KT_CHAOS``'s
+    ``kill-flywheel`` verbs — the schedule the flywheel trainer consults
+    before each cursor poll and self-delivers the signal mid-harvest
+    (ISSUE 19). Honors ``KT_CHAOS_RANK`` scoping like the rank verbs.
+    The resumed trainer must restore the cursor state named by its last
+    committed checkpoint, so the batch that died un-committed re-polls
+    and nothing double-trains."""
+    return {f.op_index: f.signal_no
+            for f in _rank_faults("kill-flywheel", spec)}
+
+
 def deliver_term_with_grace(pid: int, grace_s: float,
                             label: str = "") -> None:
     """The GKE preemption contract, delivered to ``pid``: SIGTERM now (a kt
@@ -1013,6 +1088,17 @@ def chaos_middleware(engine: ChaosEngine):
         telemetry.add_event(
             "chaos.fault", kind=fault.kind, path=request.path,
             **({"status": fault.status} if fault.kind == "status" else {}))
+        if fault.kind == "drop-ack":
+            # the OPPOSITE order from every other verb: the handler runs
+            # first — the write durably commits — and only the response
+            # is suppressed. The client-visible reset on a landed write
+            # is the ack-dropped window the at-least-once appender's
+            # idempotent re-put must absorb.
+            await handler(request)
+            if request.transport is not None:
+                request.transport.close()
+            raise ConnectionResetError(
+                "chaos: injected ack drop (write committed)")
         if fault.kind in ("kill-store-node", "kill-peer", "kill-region"):
             # the node dies mid-request, exactly like a SIGKILLed pod: no
             # response ever leaves this process (the client sees a reset

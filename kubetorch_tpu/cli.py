@@ -931,6 +931,65 @@ def rollout_status(service, urls, store_url, namespace, as_json):
 
 
 @cli.group()
+def flywheel():
+    """Continuous-learning flywheel: ledger, harvest, gated promotion
+    (ISSUE 19)."""
+
+
+@flywheel.command("status")
+@click.option("--service", required=True)
+@click.option("--replica", "replicas", multiple=True,
+              help="Serving replica ids feeding the ledger (repeatable; "
+                   "default: replica-0).")
+@click.option("--store-url", default=None,
+              help="Any store ring member (default: the configured store).")
+@click.option("--json", "as_json", is_flag=True)
+def flywheel_status_cmd(service, replicas, store_url, as_json):
+    """One freshness snapshot of the whole loop — ledger heads, cursor,
+    trainer lease, rollout manifest, eval baseline, and the per-stage
+    ``kt_flywheel_lag_seconds`` (collect/train/publish/promote) that a
+    stalled stage shows up in first."""
+    from .flywheel.promoter import flywheel_status
+
+    out = flywheel_status(service, list(replicas) or ["replica-0"],
+                          store_url=store_url)
+    if as_json:
+        click.echo(json.dumps(out, indent=2, default=str))
+        return
+    click.echo(f"flywheel: {service}")
+    for replica, head in sorted(out["replicas"].items()):
+        if head:
+            click.echo(f"  ledger {replica:<12} seq={head.get('seq')} "
+                       f"records={head.get('records', '?')}")
+        else:
+            click.echo(f"  ledger {replica:<12} (no appends yet)")
+    cursor = out.get("cursor")
+    click.echo(f"  cursor step={cursor.get('step')}" if cursor
+               else "  cursor (never committed)")
+    lease = out.get("lease")
+    if lease:
+        click.echo(f"  trainer lease epoch={lease.get('epoch')} "
+                   f"owner={lease.get('owner', '?')}")
+    manifest = out.get("manifest")
+    if manifest:
+        click.echo(f"  manifest v{manifest.get('version')} "
+                   f"phase={manifest.get('phase')} "
+                   f"step={manifest.get('step')} "
+                   f"fingerprint={manifest.get('fingerprint')}")
+    else:
+        click.echo("  manifest (nothing published)")
+    base = out.get("eval_baseline")
+    if base:
+        click.echo(f"  eval baseline loss={base.get('loss'):.6g} "
+                   f"step={base.get('step')}")
+    lag_bits = []
+    for stage in ("collect", "train", "publish", "promote"):
+        lag = out["lag_seconds"].get(stage)
+        lag_bits.append(f"{stage}={'-' if lag is None else f'{lag:.1f}s'}")
+    click.echo("  lag " + "  ".join(lag_bits))
+
+
+@cli.group()
 def queue():
     """Scheduler queue management (priorities & preemption)."""
 
@@ -1187,7 +1246,7 @@ def soak():
                    "to get the op-indexed schedule length")
 @click.option("--profile", default="all",
               type=click.Choice(["store", "train", "serve", "federation",
-                                 "all", "pipeline"]))
+                                 "all", "pipeline", "flywheel"]))
 @click.option("--shrink/--no-shrink", "do_shrink", default=True,
               help="on violation, ddmin the schedule to a minimal repro")
 @click.option("--out", default=None,

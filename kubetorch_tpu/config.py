@@ -173,6 +173,23 @@ class KTConfig:
     soak_op_interval_s: float = 0.25
     soak_store_nodes: int = 3
     soak_settle_timeout_s: float = 60.0
+    # continuous-learning flywheel (kubetorch_tpu/flywheel/, ISSUE 19).
+    # Same env layering (KT_FLYWHEEL_SAMPLE_RATE / KT_FLYWHEEL_EVAL_GATE /
+    # KT_HARVEST_HEADROOM). flywheel_sample_rate is the fraction of
+    # finished serving requests the engine feedback hook appends to the
+    # durable ledger (1.0 = every request, 0 disables collection);
+    # flywheel_eval_gate is the relative held-out-loss regression a
+    # candidate delta may show vs the promoted baseline before the
+    # promoter rejects it WITHOUT publishing a canary (0.02 = 2%);
+    # harvest_headroom is the fraction of the queue-wait SLO that must
+    # stay free for the harvester to keep training on trough capacity
+    # (0.25 → vacate once queue wait crosses 75% of serve_slo_ms).
+    # KT_FLYWHEEL_BREAK is deliberately NOT a field: it blinds the eval
+    # gate for canary drills and must never be layered in from a config
+    # file.
+    flywheel_sample_rate: float = 1.0
+    flywheel_eval_gate: float = 0.02
+    harvest_headroom: float = 0.25
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

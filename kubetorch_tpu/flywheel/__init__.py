@@ -1,0 +1,32 @@
+"""The flywheel: collect → train → publish → canary → promote, as one
+crash-safe loop on harvested capacity (ISSUE 19).
+
+Three layers, each the ONLY site for its side effect:
+
+- :mod:`.ledger` — the durable feedback ledger (the only
+  feedback-append site): quorum-acked content-hashed segments in,
+  at-least-once hash-deduped batches out, cursor committed under the
+  trainer's own checkpoint marker.
+- :mod:`.harvester` — batch-tier harvest/vacate over serving-trough
+  capacity, vacating inside ``drain_grace_s`` via the drain contract.
+- :mod:`.promoter` — the only production caller of
+  ``publish_rollout``/``CanaryRollout``: eval gate → canary bake →
+  promote or typed rollback.
+"""
+
+from .harvester import (HARVEST, IDLE, VACATE, Harvester, HarvestPolicy,
+                        harvest_record)
+from .ledger import (FeedbackLedger, LedgerCursor, engine_feedback_hook,
+                     read_all_hashes, record_hash)
+from .promoter import (BREAK_ENV, BREAK_PROMOTE_BAD, GATE_REJECTED,
+                       PROMOTED, ROLLED_BACK, Promoter, flywheel_status)
+
+__all__ = [
+    "FeedbackLedger", "LedgerCursor", "engine_feedback_hook",
+    "read_all_hashes", "record_hash",
+    "Harvester", "HarvestPolicy", "harvest_record",
+    "HARVEST", "VACATE", "IDLE",
+    "Promoter", "flywheel_status",
+    "BREAK_ENV", "BREAK_PROMOTE_BAD",
+    "GATE_REJECTED", "PROMOTED", "ROLLED_BACK",
+]

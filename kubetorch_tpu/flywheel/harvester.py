@@ -1,0 +1,203 @@
+"""Harvest/vacate: continuous training on serving-trough capacity
+(ISSUE 19, tentpole half (b)).
+
+The flywheel trainer is a **batch-tier** workload — it rides the PR 8
+scheduler's lowest tier (``priority="batch"``, see
+:func:`harvest_record`), so any serving or train-tier deploy preempts
+it, and preemption is delivered as the PR 6 drain contract: SIGTERM →
+:func:`~kubetorch_tpu.serving.elastic.drain_requested` flips → the loop
+flushes a committed checkpoint inside ``drain_grace_s`` → exit. A
+harvest cycle that ends mid-step therefore resumes at exactly the last
+committed step — the Singularity (arXiv 2202.07848) preempt/resume
+loop, closed over live feedback instead of a fixed dataset.
+
+:class:`HarvestPolicy` is the *decision*: harvest only while the
+serving plane has SLO headroom (scraped queue-wait vs the configured
+SLO), vacate the moment it doesn't. :class:`Harvester` is the *loop*:
+consume → train → commit, phase-timed into
+``kt_flywheel_harvest_seconds{phase=harvest|vacate|idle}`` so "how much
+trough capacity did we actually harvest, and how fast do we give it
+back" is a scrape, not a guess.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .. import telemetry
+from ..serving import elastic
+
+HARVEST = "harvest"
+VACATE = "vacate"
+IDLE = "idle"
+
+
+def _cfg(field: str, default: float) -> float:
+    try:
+        from ..config import config
+        return float(config().get(field, default))
+    except Exception:
+        return default
+
+
+def harvest_record(service: str, *, width: int = 1,
+                   device_class: str = "cpu") -> Dict[str, Any]:
+    """The scheduler submission record for a flywheel harvester — the
+    shape :meth:`controller.scheduler.Scheduler`'s admission path reads.
+    ``priority="batch"`` is the whole contract: the harvester never
+    outranks serving, and the scheduler's preemption sweep reclaims it
+    first, delivered through the drain-grace window the vacate path
+    honors."""
+    return {
+        "name": f"flywheel-{service}",
+        "device_class": device_class,
+        "replicas": width,
+        "scheduling": {"priority": "batch",
+                       "preemptible": True},
+    }
+
+
+@dataclass
+class HarvestPolicy:
+    """Harvest/vacate verdicts from scraped serving headroom.
+
+    ``headroom`` is the fraction of the queue-wait SLO that must remain
+    free for the policy to call (or keep calling) HARVEST: with
+    ``slo_ms=100`` and ``headroom=0.25``, harvesting is allowed while
+    queue wait p50 stays under 75ms and a vacate fires the moment it
+    crosses. ``min_headroom_ms`` keeps a zero/unset SLO from reading as
+    "harvest forever"."""
+
+    slo_ms: float = 0.0                  # 0 → resolve from config
+    headroom: float = -1.0               # -1 → config harvest_headroom
+    min_headroom_ms: float = 1.0
+
+    def __post_init__(self):
+        if self.headroom < 0:
+            self.headroom = max(0.0, min(1.0,
+                                         _cfg("harvest_headroom", 0.25)))
+        if self.slo_ms <= 0:
+            self.slo_ms = max(0.0, _cfg("serve_slo_ms", 0.0))
+
+    def decide(self, queue_wait_ms: float,
+               harvesting: bool = False) -> str:
+        """One scrape → HARVEST / VACATE / IDLE. VACATE only means
+        something while harvesting; an idle harvester under pressure
+        just stays idle."""
+        if self.slo_ms <= 0:
+            # no SLO configured: harvest whenever the queue is quiet
+            quiet = queue_wait_ms <= self.min_headroom_ms
+            return HARVEST if quiet else (VACATE if harvesting else IDLE)
+        limit = self.slo_ms * (1.0 - self.headroom)
+        if queue_wait_ms <= limit:
+            return HARVEST
+        return VACATE if harvesting else IDLE
+
+
+class Harvester:
+    """The consume→train→commit loop over harvested capacity.
+
+    ``scrape()`` returns the serving queue-wait p50 in ms (the SLO
+    autoscaler's own signal); ``train_step(batch) -> step`` folds one
+    polled batch and returns the new step number; ``flush()`` blocks
+    until the step's checkpoint is durably committed (the
+    ``Checkpointer.flush`` the vacate path spends its grace window on).
+    The loop itself polls :func:`elastic.drain_requested` every
+    iteration — the cooperative half of the preemption contract — and
+    exits through :meth:`vacate` when the flag flips or the policy
+    calls time."""
+
+    def __init__(self, policy: HarvestPolicy,
+                 scrape: Callable[[], float],
+                 train_step: Callable[[], Optional[int]],
+                 flush: Callable[[], None],
+                 drain_grace_s: Optional[float] = None,
+                 idle_s: float = 0.2):
+        self.policy = policy
+        self.scrape = scrape
+        self.train_step = train_step
+        self.flush = flush
+        if drain_grace_s is None:
+            try:
+                drain_grace_s = float(os.environ.get(
+                    elastic.DRAIN_GRACE_ENV,
+                    _cfg("sched_drain_grace_s", 20.0)))
+            except (TypeError, ValueError):
+                drain_grace_s = 20.0
+        self.drain_grace_s = max(0.0, drain_grace_s)
+        self.idle_s = idle_s
+        self.harvested_steps = 0
+        self.vacates = 0
+        self.last_vacate_s: Optional[float] = None
+
+    def vacate(self) -> float:
+        """Give the chips back: flush the in-flight checkpoint to a
+        committed state, timed — the whole vacate MUST land inside
+        ``drain_grace_s`` (the bench gates on it; past the window the
+        sender's SIGKILL backstop wins and the cycle resumes from the
+        previous commit instead)."""
+        m = telemetry.flywheel_metrics()
+        t0 = time.monotonic()
+        self.flush()
+        took = time.monotonic() - t0
+        m["harvest"].observe(took, phase=VACATE)
+        self.vacates += 1
+        self.last_vacate_s = took
+        telemetry.add_event("flywheel.vacate", seconds=round(took, 4),
+                            grace_s=self.drain_grace_s,
+                            within_grace=took <= self.drain_grace_s)
+        return took
+
+    def run_cycle(self, max_steps: int = 0,
+                  deadline_s: float = 0.0) -> Dict[str, Any]:
+        """One harvest cycle: step while the policy allows and no drain
+        is requested, then vacate. ``max_steps``/``deadline_s`` bound
+        the cycle for tests and benches (0 = unbounded). Returns the
+        cycle summary the bench prints."""
+        m = telemetry.flywheel_metrics()
+        steps = 0
+        harvesting = False
+        t_start = time.monotonic()
+        reason = "policy"
+        while True:
+            if elastic.drain_requested():
+                reason = "drain"
+                break
+            if max_steps and steps >= max_steps:
+                reason = "max-steps"
+                break
+            if deadline_s and time.monotonic() - t_start >= deadline_s:
+                reason = "deadline"
+                break
+            verdict = self.policy.decide(self.scrape(),
+                                         harvesting=harvesting)
+            if verdict == VACATE:
+                reason = "policy"
+                break
+            if verdict == IDLE:
+                harvesting = False
+                t0 = time.monotonic()
+                time.sleep(self.idle_s)
+                m["harvest"].observe(time.monotonic() - t0, phase=IDLE)
+                continue
+            harvesting = True
+            t0 = time.monotonic()
+            stepped = self.train_step()
+            m["harvest"].observe(time.monotonic() - t0, phase=HARVEST)
+            if stepped is None:          # ledger drained
+                reason = "drained"
+                break
+            steps += 1
+            self.harvested_steps += 1
+        vacate_s = self.vacate() if harvesting or steps else 0.0
+        return {"steps": steps, "reason": reason,
+                "vacate_s": round(vacate_s, 4),
+                "within_grace": vacate_s <= self.drain_grace_s,
+                "cycle_s": round(time.monotonic() - t_start, 4)}
+
+
+__all__ = ["HarvestPolicy", "Harvester", "harvest_record",
+           "HARVEST", "VACATE", "IDLE"]

@@ -1,0 +1,418 @@
+"""The durable feedback ledger: serving traffic → training batches,
+loss-proof (ISSUE 19, tentpole half (a)).
+
+This module is the ONLY feedback-append site in the package (a
+``check_resilience`` lint pins that): serving replicas hand sampled
+request/response/feedback payloads to :class:`FeedbackLedger`, which
+batches them into content-hashed, sequence-numbered **segments** on the
+store ring via :func:`~kubetorch_tpu.data_store.commands.put_json` —
+single-key quorum writes, so the ack :meth:`FeedbackLedger.append`
+returns means the segment survives one node loss by construction. The
+trainer side reads through :class:`LedgerCursor`, at-least-once with
+idempotent dedup by record hash.
+
+Why every crash window is closed:
+
+- **Replica dies between quorum-commit and client ack** (or the chaos
+  ``drop-ack`` verb swallows the ack): the segment is already durable.
+  The replica's retry re-puts the SAME key with the SAME content (the
+  segment is content-addressed by ``(replica, seq)`` and the records are
+  content-hashed), so the re-append is absorbed — and if a restarted
+  replica re-samples the same payload into a *new* segment, the cursor's
+  hash dedup drops the duplicate at consume time.
+- **Store node dies mid-append**: ``put_json`` rides the ring's
+  write-quorum forward; the client retries against the surviving
+  members. An append that never acked is not owed durability; one that
+  acked is readable at settle (the soak's ``flywheel-ledger`` invariant
+  reads every acked hash back).
+- **Trainer dies between consume and checkpoint**: cursor positions are
+  committed *per training step* under the trainer's own commit marker
+  (see :meth:`LedgerCursor.commit_state` — the cursor state for step N
+  is written BEFORE the step-N checkpoint commits, and adopted on
+  restore only when that checkpoint committed). A batch that died
+  un-committed is simply re-polled; a batch folded into a committed
+  checkpoint is never re-trained, because restoring that checkpoint
+  restores the positions that already skip it.
+- **Two trainers race one cursor**: :meth:`LedgerCursor.acquire` bumps a
+  store-held fencing epoch; every poll/commit re-validates it and the
+  stale side dies with a typed
+  :class:`~kubetorch_tpu.exceptions.StaleLeaseError` (the federation's
+  fencing contract, reused).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..data_store import commands as ds
+from ..exceptions import (DataCorruptionError, DataStoreError,
+                          StaleLeaseError)
+
+# one segment per append call keeps the ack latency one quorum write;
+# the cap only guards against a pathological single append
+MAX_SEGMENT_RECORDS = 256
+
+
+def record_hash(payload: Any) -> str:
+    """Content hash of one feedback payload — canonical JSON, blake2b.
+    The dedup identity for the whole at-least-once pipeline: a retried
+    append, a re-sampled request, and a re-polled segment all collapse
+    onto this one digest."""
+    data = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.blake2b(data, digest_size=20).hexdigest()
+
+
+def _ledger_prefix(service: str) -> str:
+    return f"flywheel/{service}/ledger"
+
+
+def segment_key(service: str, replica: str, seq: int) -> str:
+    return f"{_ledger_prefix(service)}/{replica}/seg-{seq:08d}"
+
+
+def head_key(service: str, replica: str) -> str:
+    return f"{_ledger_prefix(service)}/{replica}/head"
+
+
+def cursor_state_key(service: str, step: int) -> str:
+    return f"flywheel/{service}/cursor/state-{step:08d}"
+
+
+def cursor_lease_key(service: str) -> str:
+    return f"flywheel/{service}/cursor/lease"
+
+
+def _state_checksum(positions: Dict[str, int], seen: List[str],
+                    step: int) -> str:
+    body = json.dumps({"positions": positions, "seen": seen,
+                       "step": step}, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.blake2b(body, digest_size=20).hexdigest()
+
+
+class FeedbackLedger:
+    """The replica-side appender: one instance per serving replica.
+
+    ``append`` is the durability boundary — it returns the appended
+    record hashes (the ack a serving engine hands back to its feedback
+    hook) only after the segment's quorum write succeeded, and it
+    retries transport failures by re-putting the *same* segment, which
+    is idempotent by construction (same key, same content hash).
+    """
+
+    def __init__(self, service: str, replica_id: str,
+                 store_url: Optional[str] = None,
+                 sample_rate: Optional[float] = None,
+                 retries: int = 2):
+        self.service = service
+        self.replica_id = replica_id
+        self.store_url = store_url
+        self.retries = max(0, int(retries))
+        if sample_rate is None:
+            try:
+                from ..config import config
+                sample_rate = float(config().get("flywheel_sample_rate",
+                                                 1.0))
+            except Exception:
+                sample_rate = 1.0
+        self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
+        # resume after replica death: the head names the last seq this
+        # replica committed; probe forward from there in case the crash
+        # landed between the segment commit and the head update
+        head = ds.get_json(head_key(service, replica_id), quorum=True,
+                           default=None, store_url=store_url)
+        seq = int(head["seq"]) + 1 if head else 0
+        while ds.get_json(segment_key(service, replica_id, seq),
+                          store_url=store_url, default=None) is not None:
+            seq += 1
+        self._seq = seq
+
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    def append(self, payloads: List[Any]) -> List[str]:
+        """Durably append one segment of feedback payloads; returns the
+        record hashes once (and only once) the quorum write committed.
+        Raises the store's typed error when the ring cannot ack."""
+        if not payloads:
+            return []
+        if len(payloads) > MAX_SEGMENT_RECORDS:
+            raise ValueError(
+                f"segment too large ({len(payloads)} > "
+                f"{MAX_SEGMENT_RECORDS}); split the append")
+        records = [{"hash": record_hash(p), "payload": p}
+                   for p in payloads]
+        seq = self._seq
+        segment = {"replica": self.replica_id, "seq": seq,
+                   "records": records, "at": time.time()}
+        key = segment_key(self.service, self.replica_id, seq)
+        last: Optional[BaseException] = None
+        for _ in range(self.retries + 1):
+            try:
+                ds.put_json(key, segment, store_url=self.store_url)
+                last = None
+                break
+            except DataStoreError as e:
+                # the ack may have been dropped AFTER the store
+                # committed (the drop-ack chaos verb, a replica netsplit)
+                # — re-putting the same content is the idempotent
+                # at-least-once retry, never a duplicate record
+                last = e
+        if last is not None:
+            raise last
+        self._seq = seq + 1
+        try:
+            ds.put_json(head_key(self.service, self.replica_id),
+                        {"seq": seq, "at": time.time()},
+                        store_url=self.store_url)
+        except DataStoreError:
+            pass    # advisory only: the cursor probes past the head
+        m = telemetry.flywheel_metrics()
+        m["appended"].inc(len(records), service=self.service)
+        return [r["hash"] for r in records]
+
+    def sample(self, payload: Any,
+               coin: Optional[float] = None) -> Optional[List[str]]:
+        """The sampled single-record append the engine feedback hook
+        uses. ``coin`` is an injected uniform [0,1) draw (tests and the
+        deterministic soak pass one); default derives it from the
+        payload hash so sampling is reproducible, not clock-seeded."""
+        if self.sample_rate <= 0.0:
+            return None
+        if coin is None:
+            coin = int(record_hash(payload)[:8], 16) / float(1 << 32)
+        if coin >= self.sample_rate:
+            return None
+        return self.append([payload])
+
+
+class LedgerCursor:
+    """The trainer-side reader: polls every replica's segment stream in
+    order, dedups by record hash, and commits its positions under the
+    training loop's own checkpoint commit (see module docstring for the
+    crash-window analysis)."""
+
+    def __init__(self, service: str, replicas: List[str],
+                 store_url: Optional[str] = None,
+                 owner: str = "trainer-0", seen_cap: int = 8192):
+        self.service = service
+        self.replicas = list(replicas)
+        self.store_url = store_url
+        self.owner = owner
+        self.seen_cap = int(seen_cap)
+        self.positions: Dict[str, int] = {r: 0 for r in self.replicas}
+        self.seen: List[str] = []          # insertion-ordered, capped
+        self._seen_set = set()
+        self.step = 0
+        self.epoch = 0                     # 0 = fence not acquired
+        self._pending_positions: Dict[str, int] = {}
+        self._pending_hashes: List[str] = []
+
+    # -- fencing -------------------------------------------------------------
+
+    def acquire(self) -> int:
+        """Take (or take over) the cursor: bump the store-held fencing
+        epoch. The previous holder's next poll/commit dies with
+        :class:`StaleLeaseError` — at most one trainer folds records."""
+        cur = ds.get_json(cursor_lease_key(self.service), quorum=True,
+                          default=None, store_url=self.store_url)
+        epoch = int(cur["epoch"]) + 1 if cur else 1
+        ds.put_json(cursor_lease_key(self.service),
+                    {"epoch": epoch, "owner": self.owner,
+                     "at": time.time()},
+                    store_url=self.store_url)
+        self.epoch = epoch
+        telemetry.add_event("flywheel.cursor_acquire",
+                            service=self.service, epoch=epoch)
+        return epoch
+
+    def _validate_fence(self) -> None:
+        if self.epoch <= 0:
+            return                      # unfenced single-trainer mode
+        cur = ds.get_json(cursor_lease_key(self.service), quorum=True,
+                          default=None, store_url=self.store_url)
+        held = int(cur["epoch"]) if cur else 0
+        if held != self.epoch:
+            raise StaleLeaseError(
+                f"flywheel cursor for {self.service!r} is held at epoch "
+                f"{held}; this trainer's epoch {self.epoch} is fenced "
+                f"off — stop training",
+                workload=f"flywheel/{self.service}",
+                epoch=self.epoch, current_epoch=held)
+
+    # -- consume -------------------------------------------------------------
+
+    def _remember(self, h: str) -> None:
+        self._seen_set.add(h)
+        self.seen.append(h)
+        while len(self.seen) > self.seen_cap:
+            self._seen_set.discard(self.seen.pop(0))
+
+    def poll(self, max_records: int = 256) -> List[Dict[str, Any]]:
+        """One at-least-once read: fresh records across every replica's
+        stream, hash-deduped. Positions advance only in memory until
+        :meth:`commit_state` folds them under a committed step."""
+        self._validate_fence()
+        m = telemetry.flywheel_metrics()
+        batch: List[Dict[str, Any]] = []
+        pending_hashes: List[str] = []
+        pending_pos: Dict[str, int] = {}
+        for replica in self.replicas:
+            seq = self.positions[replica]
+            while len(batch) < max_records:
+                seg = ds.get_json(
+                    segment_key(self.service, replica, seq),
+                    quorum=True, default=None, store_url=self.store_url)
+                if seg is None:
+                    break
+                for rec in seg.get("records", []):
+                    h = rec.get("hash")
+                    if h in self._seen_set or h in pending_hashes:
+                        m["deduped"].inc(service=self.service)
+                        continue
+                    batch.append(rec)
+                    pending_hashes.append(h)
+                seq += 1
+            pending_pos[replica] = seq
+        self._pending_positions = pending_pos
+        self._pending_hashes = pending_hashes
+        if batch:
+            m["consumed"].inc(len(batch), service=self.service)
+        return batch
+
+    # -- commit / restore ----------------------------------------------------
+
+    def commit_state(self, step: int) -> Dict[str, Any]:
+        """Fold the last poll into the durable cursor state for ``step``.
+
+        MUST be called BEFORE the step-``step`` checkpoint commits: the
+        state doc is content-checksummed and keyed by step, and restore
+        adopts exactly the doc named by the last *committed* checkpoint
+        — so a crash between this write and the checkpoint commit
+        leaves the previous state authoritative (the batch re-polls),
+        while a torn copy of the doc itself is screened out by the
+        store's per-copy blake2b at quorum read plus the embedded
+        checksum here."""
+        self._validate_fence()
+        self.positions.update(self._pending_positions)
+        for h in self._pending_hashes:
+            self._remember(h)
+        self._pending_positions = {}
+        self._pending_hashes = []
+        self.step = int(step)
+        state = {"positions": dict(self.positions),
+                 "seen": list(self.seen), "step": self.step,
+                 "epoch": self.epoch, "at": time.time(),
+                 "checksum": _state_checksum(self.positions, self.seen,
+                                             self.step)}
+        ds.put_json(cursor_state_key(self.service, self.step), state,
+                    store_url=self.store_url)
+        try:
+            # advisory freshness pointer (lag gauges / `kt flywheel
+            # status`); never consulted by restore, which trusts only
+            # the step the checkpoint commit names
+            ds.put_json(f"flywheel/{self.service}/cursor/last",
+                        {"step": self.step, "at": state["at"],
+                         "epoch": self.epoch},
+                        store_url=self.store_url)
+        except DataStoreError:
+            pass
+        return state
+
+    def restore(self, committed_step: Optional[int]) -> bool:
+        """Adopt the cursor state the last *committed* checkpoint names.
+        ``committed_step`` comes from the trainer's own restore
+        (``Checkpointer.restore()``'s step / ``commit_info``). ``None``
+        (no checkpoint ever committed) resets to the stream heads —
+        nothing was folded, everything re-trains, nothing doubles.
+        Raises :class:`DataCorruptionError` when the named state exists
+        but fails its checksum on every replica copy."""
+        if committed_step is None:
+            self.positions = {r: 0 for r in self.replicas}
+            self.seen = []
+            self._seen_set = set()
+            self.step = 0
+            return False
+        state = ds.get_json(
+            cursor_state_key(self.service, int(committed_step)),
+            quorum=True, default=None, store_url=self.store_url)
+        if state is None:
+            raise DataCorruptionError(
+                f"flywheel cursor state for committed step "
+                f"{committed_step} is missing — the ledger cannot prove "
+                f"which records were folded; refusing to re-train blind")
+        want = _state_checksum(state.get("positions", {}),
+                               state.get("seen", []),
+                               int(state.get("step", -1)))
+        if state.get("checksum") != want:
+            raise DataCorruptionError(
+                f"flywheel cursor state for step {committed_step} failed "
+                f"its checksum (torn write?) — refusing to adopt it")
+        self.positions = {r: int(state["positions"].get(r, 0))
+                          for r in self.replicas}
+        self.seen = list(state.get("seen", []))
+        self._seen_set = set(self.seen)
+        self.step = int(state["step"])
+        self._pending_positions = {}
+        self._pending_hashes = []
+        return True
+
+    def lag_records(self) -> int:
+        """How many committed segments sit unconsumed ahead of the
+        cursor (collect→train lag, in segments) — cheap: one head read
+        per replica."""
+        lag = 0
+        for replica in self.replicas:
+            head = ds.get_json(head_key(self.service, replica),
+                               quorum=True, default=None,
+                               store_url=self.store_url)
+            if head is not None:
+                lag += max(0, int(head["seq"]) + 1
+                           - self.positions.get(replica, 0))
+        return lag
+
+
+def read_all_hashes(service: str, replicas: List[str],
+                    store_url: Optional[str] = None) -> List[str]:
+    """Settle-phase oracle: every record hash currently readable from
+    the ledger, across all replicas' full streams. The soak conductor
+    compares this against the acked hashes — zero acked-record loss."""
+    out: List[str] = []
+    for replica in replicas:
+        seq = 0
+        while True:
+            seg = ds.get_json(segment_key(service, replica, seq),
+                              quorum=True, default=None,
+                              store_url=store_url)
+            if seg is None:
+                break
+            out.extend(r.get("hash") for r in seg.get("records", []))
+            seq += 1
+    return out
+
+
+def engine_feedback_hook(ledger: FeedbackLedger):
+    """Adapter for :attr:`GenerationEngine.feedback_sink` /
+    :attr:`HostEngine.feedback_sink`: a callable taking one finished-
+    request payload and sampling it into ``ledger``. Errors never
+    propagate into the engine's retire path — losing a sample is fine,
+    stalling the decode loop is not (the DURABILITY promise starts at
+    the ack, and an append that never happened was never acked)."""
+    def _sink(payload: Dict[str, Any]) -> None:
+        try:
+            ledger.sample(payload)
+        except Exception:  # noqa: BLE001 — sampling must never stall decode
+            pass
+    return _sink
+
+
+__all__ = ["FeedbackLedger", "LedgerCursor", "record_hash",
+           "segment_key", "head_key", "cursor_state_key",
+           "cursor_lease_key", "read_all_hashes", "engine_feedback_hook",
+           "MAX_SEGMENT_RECORDS"]

@@ -1,0 +1,228 @@
+"""Gated promotion: finished deltas → live fleet, never a bad one
+(ISSUE 19, tentpole half (c)).
+
+This module is the ONLY production caller of
+``train.checkpoint.publish_rollout`` and ``serve.rollout.CanaryRollout``
+(the 16th ``check_resilience`` lint pins that): every delta the flywheel
+trains reaches the fleet through exactly one path —
+
+    held-out eval gate → publish (canary) → bake → verdict
+        → promote, or typed rollback
+
+The **eval gate** runs BEFORE the canary: the candidate tree is scored
+on a replayed held-out batch and compared against the promoted
+baseline's score; a delta that regresses past ``flywheel_eval_gate``
+never even becomes a canary manifest (``kt_flywheel_gate_total{
+verdict="gate_rejected"}``). The canary layer stays the backstop for
+everything an offline eval can't see (serving-path regressions, torn
+weights) — and the break-glass ``KT_FLYWHEEL_BREAK=promote-bad-delta``
+env skips the eval gate on purpose, so soak/chaos drills can prove the
+canary still catches a bad delta when the first gate is blinded. The
+break-glass is deliberately NOT a config field: it must be armed
+per-process, never layered in from a config file.
+
+Per-stage freshness rides ``kt_flywheel_lag_seconds{stage=collect|
+train|publish|promote}`` (set by :func:`flywheel_status`, which also
+backs ``kt flywheel status``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import telemetry
+from ..data_store import commands as ds
+from ..serve import rollout as ro
+from ..train import checkpoint as ck
+from . import ledger as fl
+
+BREAK_ENV = "KT_FLYWHEEL_BREAK"
+BREAK_PROMOTE_BAD = "promote-bad-delta"
+
+GATE_REJECTED = "gate_rejected"
+PROMOTED = "promoted"
+ROLLED_BACK = "rolled_back"
+
+LAG_STAGES = ("collect", "train", "publish", "promote")
+
+
+def eval_baseline_key(service: str) -> str:
+    return f"flywheel/{service}/eval-baseline"
+
+
+def _gate_tolerance() -> float:
+    try:
+        from ..config import config
+        return max(0.0, float(config().get("flywheel_eval_gate", 0.02)))
+    except Exception:
+        return 0.02
+
+
+class Promoter:
+    """One service's publish→bake→promote driver.
+
+    ``eval_fn(tree) -> float`` scores a candidate on the held-out batch
+    (lower is better — a loss). ``router`` is the serving router the
+    canary bake reads (``set_canary``/``clear_canary``/
+    ``canary_verdict``, the :class:`~..serve.rollout.CanaryRollout`
+    contract). Canary knobs pass straight through."""
+
+    def __init__(self, service: str, router: Any, *,
+                 store_url: Optional[str] = None,
+                 eval_fn: Optional[Callable[[Any], float]] = None,
+                 gate_tolerance: Optional[float] = None,
+                 slice_fraction: float = 0.1, bake_s: float = 10.0,
+                 min_requests: int = 20, ttft_factor: float = 2.0,
+                 err_threshold: float = 0.05, poll_s: float = 0.25):
+        self.service = service
+        self.router = router
+        self.store_url = store_url
+        self.eval_fn = eval_fn
+        self.gate_tolerance = (_gate_tolerance() if gate_tolerance is None
+                               else max(0.0, gate_tolerance))
+        self._canary_kw = dict(slice_fraction=slice_fraction,
+                               bake_s=bake_s, min_requests=min_requests,
+                               ttft_factor=ttft_factor,
+                               err_threshold=err_threshold, poll_s=poll_s)
+        self.history: List[Dict[str, Any]] = []
+
+    # -- the eval gate -------------------------------------------------------
+
+    def _gate(self, tree: Any, step: int) -> Optional[Dict[str, Any]]:
+        """Score the candidate; a regression verdict (dict) stops the
+        promotion before any manifest exists. ``None`` = pass."""
+        if self.eval_fn is None:
+            return None
+        if os.environ.get(BREAK_ENV, "") == BREAK_PROMOTE_BAD:
+            # break-glass: blind the offline gate so drills can prove
+            # the canary layer catches what slips past it
+            telemetry.add_event("flywheel.gate_bypassed",
+                                service=self.service, step=step)
+            return None
+        loss = float(self.eval_fn(tree))
+        base = ds.get_json(eval_baseline_key(self.service), quorum=True,
+                           default=None, store_url=self.store_url)
+        if base is not None:
+            limit = float(base["loss"]) * (1.0 + self.gate_tolerance)
+            if loss > limit:
+                return {"loss": loss, "baseline": float(base["loss"]),
+                        "limit": limit}
+        self._candidate_loss = loss
+        return None
+
+    def _commit_baseline(self, step: int) -> None:
+        loss = getattr(self, "_candidate_loss", None)
+        if loss is None:
+            return
+        ds.put_json(eval_baseline_key(self.service),
+                    {"loss": float(loss), "step": int(step),
+                     "at": time.time()}, store_url=self.store_url)
+        self._candidate_loss = None
+
+    # -- the one promotion path ----------------------------------------------
+
+    def promote(self, tree: Any, step: int,
+                canary_replica: str = "canary") -> str:
+        """Drive one delta through the whole gate. Returns the verdict
+        (``promoted`` / ``rolled_back`` / ``gate_rejected``) and counts
+        it into ``kt_flywheel_gate_total{verdict=...}``. Rollback is the
+        typed manifest path — the fleet version the replicas act on is
+        unchanged or restored, never half-new."""
+        m = telemetry.flywheel_metrics()
+        self._candidate_loss = None
+        t0 = time.monotonic()
+        rejected = self._gate(tree, step)
+        if rejected is not None:
+            m["gate"].inc(verdict=GATE_REJECTED)
+            telemetry.add_event("flywheel.gate_rejected",
+                                service=self.service, step=step,
+                                **{k: round(v, 6)
+                                   for k, v in rejected.items()})
+            self.history.append({"verdict": GATE_REJECTED, "step": step,
+                                 **rejected, "at": time.time()})
+            return GATE_REJECTED
+
+        def publish(phase: str, canary: Optional[str] = None) -> Dict:
+            out = ck.publish_rollout(self.service, tree, step,
+                                     store_url=self.store_url,
+                                     phase=phase, canary=canary)
+            return out["manifest"]
+
+        verdict = ro.CanaryRollout(
+            self.service, self.router, store_url=self.store_url,
+            **self._canary_kw).run(publish, canary_replica)
+        m["gate"].inc(verdict=verdict)
+        if verdict == PROMOTED:
+            self._commit_baseline(step)
+        m["lag"].set(0.0, stage="promote" if verdict == PROMOTED
+                     else "publish")
+        telemetry.add_event("flywheel.promotion", service=self.service,
+                            step=step, verdict=verdict,
+                            seconds=round(time.monotonic() - t0, 4))
+        self.history.append({"verdict": verdict, "step": step,
+                             "at": time.time()})
+        return verdict
+
+
+def flywheel_status(service: str, replicas: List[str],
+                    store_url: Optional[str] = None) -> Dict[str, Any]:
+    """One snapshot of the whole loop's freshness — the payload behind
+    ``kt flywheel status``. Also SETS the ``kt_flywheel_lag_seconds``
+    gauges, so scraping a process that calls this periodically (the
+    harvester does, per cycle) alarms on a stalled stage:
+
+    - ``collect`` — age of the newest acked ledger append
+    - ``train``   — age of the newest committed cursor state
+    - ``publish`` — age of the newest rollout manifest (any phase)
+    - ``promote`` — age of the newest *fleet-phase* promotion
+    """
+    now = time.time()
+    m = telemetry.flywheel_metrics()
+    out: Dict[str, Any] = {"service": service, "replicas": {},
+                           "lag_seconds": {}}
+    newest_append: Optional[float] = None
+    for replica in replicas:
+        head = ds.get_json(fl.head_key(service, replica), quorum=True,
+                           default=None, store_url=store_url)
+        out["replicas"][replica] = head
+        if head and head.get("at"):
+            at = float(head["at"])
+            newest_append = max(newest_append or at, at)
+    cursor = ds.get_json(f"flywheel/{service}/cursor/last", quorum=True,
+                         default=None, store_url=store_url)
+    out["cursor"] = cursor
+    lease = ds.get_json(fl.cursor_lease_key(service), quorum=True,
+                        default=None, store_url=store_url)
+    out["lease"] = lease
+    manifest = ro.read_manifest(service, store_url=store_url)
+    out["manifest"] = manifest
+    baseline = ds.get_json(eval_baseline_key(service), quorum=True,
+                           default=None, store_url=store_url)
+    out["eval_baseline"] = baseline
+
+    lags: Dict[str, Optional[float]] = {
+        "collect": (now - newest_append) if newest_append else None,
+        "train": (now - float(cursor["at"])) if cursor else None,
+        "publish": ((now - float(manifest["published_at"]))
+                    if manifest and manifest.get("published_at")
+                    else None),
+        # a rollback manifest is a PUBLISH, not a promotion: promote lag
+        # keeps aging until a fleet-phase manifest lands
+        "promote": ((now - float(manifest["published_at"]))
+                    if manifest and manifest.get("phase") == "fleet"
+                    and manifest.get("published_at") else None),
+    }
+    for stage in LAG_STAGES:
+        lag = lags.get(stage)
+        out["lag_seconds"][stage] = (None if lag is None
+                                     else round(lag, 3))
+        if lag is not None:
+            m["lag"].set(lag, stage=stage)
+    return out
+
+
+__all__ = ["Promoter", "flywheel_status", "eval_baseline_key",
+           "BREAK_ENV", "BREAK_PROMOTE_BAD", "GATE_REJECTED", "PROMOTED",
+           "ROLLED_BACK", "LAG_STAGES"]

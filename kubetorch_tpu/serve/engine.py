@@ -844,6 +844,13 @@ class GenerationEngine:
         # serve/rollout.py is the only assigner of self.params after
         # construction; see at_batch_boundary)
         self._boundary_hooks: "deque[tuple]" = deque()
+        # continuous-learning tap (flywheel/ledger.py): when set — e.g. to
+        # flywheel.ledger.engine_feedback_hook(ledger) — every retired
+        # request's summary passes through it once, on the step thread.
+        # The sink owns sampling and MUST swallow its own errors; the
+        # retire path still guards, because a raised sink would wedge the
+        # decode loop for every live slot, not just the sampled one.
+        self.feedback_sink = None
         # stats
         self._admitted = self._finished = 0
         self._tokens = self._steps = 0
@@ -1184,6 +1191,21 @@ class GenerationEngine:
         req = self._slot_req[slot]
         if req is None:
             return
+        if self.feedback_sink is not None:
+            # snapshot BEFORE state clears: after this method the slot's
+            # ledgers are gone and the request object is unreachable
+            try:
+                self.feedback_sink({
+                    "request_id": req.rid,
+                    "prompt": list(req.full_prompt or req.prompt),
+                    "generated": int(req.generated),
+                    "cancelled": bool(req.cancelled),
+                    "ttft_s": (req.first_token_at - req.submitted_at
+                               if req.first_token_at is not None else None),
+                    "latency_s": time.monotonic() - req.submitted_at,
+                })
+            except Exception:  # noqa: BLE001 — never wedge the step thread
+                pass
         req.out.put(None)
         self._slot_req[slot] = None
         self._pos[slot] = 0

@@ -665,6 +665,9 @@ class HostEngine:
         self.params = params
         self.step_s = float(step_s)
         self.steps = 0
+        # same continuous-learning tap as GenerationEngine.feedback_sink,
+        # so bench_serve's host-mode replicas can feed the flywheel ledger
+        self.feedback_sink = None
         self._reqs: List[Dict[str, Any]] = []
         self._hooks: "deque[tuple]" = deque()
         self._lock = threading.Lock()
@@ -720,6 +723,15 @@ class HostEngine:
                 with self._lock:
                     if req in self._reqs:
                         self._reqs.remove(req)
+                if self.feedback_sink is not None:
+                    try:
+                        self.feedback_sink({
+                            "generated": int(req.get("tokens", 0)),
+                            "error": (str(req["error"])[:120]
+                                      if req.get("error") else None),
+                            "step": self.steps})
+                    except Exception:  # noqa: BLE001 — never stall stepping
+                        pass
                 req["done"].set()
         self.steps += 1
         if self.step_s:

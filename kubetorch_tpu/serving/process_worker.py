@@ -72,6 +72,12 @@ class _QueueTee:
     def flush(self):
         self.original.flush()
 
+    def fileno(self):
+        # libraries probing the stream (absl/jax logging, subprocess
+        # stdout= pass-through) need the REAL descriptor; without this the
+        # first fileno() call kills the rank worker mid-request
+        return self.original.fileno()
+
     def isatty(self):
         return False
 

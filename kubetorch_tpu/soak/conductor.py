@@ -61,6 +61,10 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _TRAINER = os.path.join(_REPO_ROOT, "tests", "assets", "fed_trainer.py")
 _PIPELINE_TRAINER = os.path.join(_REPO_ROOT, "tests", "assets",
                                  "pipeline_trainer.py")
+_FLYWHEEL_TRAINER = os.path.join(_REPO_ROOT, "tests", "assets",
+                                 "flywheel_trainer.py")
+_FLYWHEEL_SERVICE = "soak-fly"
+_FLYWHEEL_REPLICA = "replica-0"
 
 
 @dataclass
@@ -260,6 +264,153 @@ class _PipelineTrainer:
         return out
 
 
+class _FlywheelTrainer:
+    """The harvest trainer under fire (ISSUE 19): flywheel_trainer.py
+    consumes the soak's feedback ledger through the real cursor +
+    Checkpointer. The schedule's ``flywheel-trainer`` boot-chaos token
+    (``kill-flywheel:SIG@N``) rides ``KT_CHAOS`` into the FIRST spawn
+    only — the ``resume-flywheel`` event and the settle pass run clean,
+    the way recovery always runs clean in this conductor."""
+
+    def __init__(self, store: str, base_dir: str, seed: int,
+                 chaos_token: str = ""):
+        self.store = store
+        self.seed = seed
+        self.chaos_token = chaos_token
+        self.result = os.path.join(base_dir, "flywheel-ledger.jsonl")
+        self.base_key = "soak/flywheel/ckpt"
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self, resume: bool, chaos: bool = False,
+              idle_polls: int = 400) -> None:
+        if not os.path.exists(_FLYWHEEL_TRAINER):
+            raise RuntimeError(
+                f"flywheel trainer asset missing: {_FLYWHEEL_TRAINER}")
+        env = _clean_child_env()
+        if chaos and self.chaos_token:
+            env["KT_CHAOS"] = self.chaos_token
+            env["KT_CHAOS_SEED"] = str(self.seed)
+        args = [sys.executable, _FLYWHEEL_TRAINER,
+                "--service", _FLYWHEEL_SERVICE,
+                "--replicas", _FLYWHEEL_REPLICA,
+                "--store", self.store, "--base-key", self.base_key,
+                "--result", self.result, "--poll-sleep", "0.1",
+                "--idle-polls", str(idle_polls)]
+        if resume:
+            args.append("--resume")
+        self.proc = subprocess.Popen(args, env=env,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def ledger(self) -> List[Dict]:
+        out: List[Dict] = []
+        if os.path.exists(self.result):
+            with open(self.result) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            out.append(json.loads(line))
+                        except ValueError:
+                            out.append({"corrupt_line": line[:120]})
+        return out
+
+
+def _import_flywheel_ledger(history: History,
+                            ftrainer: Optional["_FlywheelTrainer"]) -> None:
+    """Trainer JSONL → history records: checkpoint lines feed the commits
+    invariant (kind=trainer), cursor/consume lines feed the
+    flywheel-ledger invariant (kind=flywheel)."""
+    for rec in ftrainer.ledger() if ftrainer is not None else []:
+        if "committed" in rec:
+            history.record("trainer", event="committed",
+                           step=rec["committed"],
+                           fingerprint=rec.get("fingerprint"))
+        elif "restored" in rec:
+            history.record("trainer", event="restored",
+                           step=rec["restored"],
+                           fingerprint=rec.get("fingerprint"))
+        elif "consumed" in rec:
+            history.record("flywheel", event="consumed",
+                           hashes=rec["consumed"], step=rec.get("step"))
+        elif "cursor_committed" in rec:
+            history.record("flywheel", event="cursor-committed",
+                           step=rec["cursor_committed"])
+        elif "cursor_restored" in rec:
+            history.record("flywheel", event="cursor-restored",
+                           step=rec["cursor_restored"])
+        elif "dying_at_op" in rec:
+            history.record("flywheel", event="dying",
+                           op=rec["dying_at_op"])
+        elif "done" in rec or "drained" in rec:
+            history.record("trainer", event="done",
+                           step=rec.get("final_step", rec.get("drained")),
+                           fingerprint=rec.get("fingerprint"))
+
+
+def _promote_drill(history: History, store_url: str) -> None:
+    """Settle-phase gated-promotion closure (ISSUE 19 acceptance): promote
+    a good delta through the real publish→canary path on the soak's store
+    ring, then drive the deliberately-bad delta with the break-glass env
+    blinding the eval gate AND a canary that dies mid-bake (a dead canary
+    yields no healthy evidence — the verdict is ``regressed``). The bad
+    delta must roll back with the fleet fingerprint unchanged; the
+    flywheel-ledger invariant's gate clause certifies it from the
+    history."""
+    import numpy as np
+
+    from ..flywheel.promoter import Promoter
+    from ..serve import rollout as ro
+
+    class _Router:
+        verdict = "ok"
+
+        def set_canary(self, replica, fraction=0.1):
+            pass
+
+        def clear_canary(self):
+            pass
+
+        def canary_verdict(self, **kw):
+            return self.verdict
+
+    router = _Router()
+    promoter = Promoter(
+        _FLYWHEEL_SERVICE, router, store_url=store_url,
+        eval_fn=lambda t: float(np.abs(t["w"]).mean()),
+        bake_s=0.5, min_requests=1, poll_s=0.05)
+    good = {"w": np.full(8, 1.0, dtype=np.float32)}
+    v1 = promoter.promote(good, step=1)
+    history.record("flywheel", event="gate", verdict=v1, bad=False)
+    # second good delta so a previous manifest exists and the bad delta
+    # takes the canary path, not the first-ever fast path
+    v2 = promoter.promote(good, step=2)
+    history.record("flywheel", event="gate", verdict=v2, bad=False)
+    before = ro.read_manifest(_FLYWHEEL_SERVICE, store_url=store_url)
+    router.verdict = "regressed"      # canary SIGKILLed mid-bake: no
+    os.environ["KT_FLYWHEEL_BREAK"] = "promote-bad-delta"
+    try:
+        bad = {"w": np.full(8, 100.0, dtype=np.float32)}
+        v3 = promoter.promote(bad, step=3)
+    finally:
+        os.environ.pop("KT_FLYWHEEL_BREAK", None)
+    after = ro.read_manifest(_FLYWHEEL_SERVICE, store_url=store_url)
+    unchanged = bool(before and after
+                     and after.get("fingerprint") == before.get(
+                         "fingerprint"))
+    if not unchanged:
+        v3 = "promoted" if v3 == "promoted" else f"{v3}-but-fleet-moved"
+    history.record("flywheel", event="gate", verdict=v3, bad=True)
+
+
 def _import_pipeline_ledger(history: History,
                             ptrainer: Optional["_PipelineTrainer"]) -> None:
     for rec in ptrainer.ledger() if ptrainer is not None else []:
@@ -355,6 +506,7 @@ def run_soak(sched: Schedule, base_dir: str,
     has_gateway = sched.profile in ("serve", "federation", "all")
     has_regions = sched.profile in ("federation", "all")
     has_pipeline = sched.profile == "pipeline"
+    has_flywheel = sched.profile == "flywheel"
 
     saved_env = {k: os.environ.get(k) for k in _MUTATED_ENV}
     # fleet/gateway/trainer children spawn with `python -m kubetorch_tpu...`
@@ -371,6 +523,8 @@ def run_soak(sched: Schedule, base_dir: str,
     gateway: Optional[_Gateway] = None
     trainer: Optional[_Trainer] = None
     ptrainer: Optional[_PipelineTrainer] = None
+    ftrainer: Optional[_FlywheelTrainer] = None
+    fly_ledger = None  # conductor-side appender (the "serving replica")
     door = None
     lease: Optional[LeaseTable] = None
     holder: Dict[str, Any] = {}
@@ -402,6 +556,12 @@ def run_soak(sched: Schedule, base_dir: str,
         elif ev.action == "resume-trainer" and trainer is not None:
             if not trainer.alive():
                 trainer.start(resume=True)
+        elif ev.action == "resume-flywheel" and ftrainer is not None:
+            # the boot-chaos kill-flywheel token already fired (or never
+            # will); recovery runs clean and must adopt the committed
+            # cursor state — the flywheel-ledger invariant checks it
+            if not ftrainer.alive():
+                ftrainer.start(resume=True, chaos=False)
         elif ev.action == "kill-gateway" and gateway is not None:
             gateway.kill()
         elif ev.action == "restart-gateway" and gateway is not None:
@@ -456,6 +616,8 @@ def run_soak(sched: Schedule, base_dir: str,
             choices += ["generate"] * 2
         if has_regions:
             choices += ["lease-tick"]
+        if has_flywheel:
+            choices += ["fly-append"] * 3
         op = choices[ops_rng.randrange(len(choices))]
         key = f"soak/k{ops_rng.randrange(key_space)}"
         if op == "put":
@@ -478,6 +640,16 @@ def run_soak(sched: Schedule, base_dir: str,
                        "new_tokens": 1 + ops_rng.randrange(4)}
             _record_op(history, "generate", "gateway",
                        lambda: asyncio.run(door.dispatch(payload, {})))
+        elif op == "fly-append" and fly_ledger is not None:
+            # live-traffic feedback: the ack the client sees is the
+            # at-least-once anchor — only records the conductor saw
+            # acked are owed back by the settle-read
+            payload = {"op": op_i, "prompt": ops_rng.randrange(1 << 30),
+                       "reward": round(ops_rng.random(), 6)}
+            hashes = _record_op(history, "fly-append", _FLYWHEEL_REPLICA,
+                                lambda: fly_ledger.append([payload]))
+            if hashes:
+                history.record("flywheel", event="acked", hashes=hashes)
         elif op == "lease-tick" and holder:
             def _tick():
                 lease.validate(holder["workload"], holder["region"],
@@ -532,6 +704,15 @@ def run_soak(sched: Schedule, base_dir: str,
                                         seed=sched.seed,
                                         boot_chaos=sched.boot_chaos)
             ptrainer.start()
+        if has_flywheel and fleet is not None:
+            from ..flywheel.ledger import FeedbackLedger
+            fly_ledger = FeedbackLedger(_FLYWHEEL_SERVICE,
+                                        _FLYWHEEL_REPLICA,
+                                        store_url=fleet.urls[0])
+            ftrainer = _FlywheelTrainer(
+                ",".join(fleet.urls), base_dir, seed=sched.seed,
+                chaos_token=sched.boot_chaos.get("flywheel-trainer", ""))
+            ftrainer.start(resume=False, chaos=True)
         if has_regions:
             lease = LeaseTable()
             epoch = lease.grant("job-0", "region-a")
@@ -625,6 +806,42 @@ def run_soak(sched: Schedule, base_dir: str,
             except subprocess.TimeoutExpired:
                 ptrainer.kill()
             ptrainer.replay(timeout=settle_timeout_s)
+        if ftrainer is not None:
+            # drain the live run over SIGTERM (the PR 6 contract), then a
+            # clean --resume sweep consumes whatever the chaos kill
+            # orphaned; only then does the settle-read take its census
+            if ftrainer.alive():
+                ftrainer.proc.send_signal(signal.SIGTERM)
+                try:
+                    ftrainer.proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    ftrainer.kill()
+            ftrainer.start(resume=True, chaos=False, idle_polls=5)
+            try:
+                ftrainer.proc.wait(timeout=settle_timeout_s)
+            except subprocess.TimeoutExpired:
+                ftrainer.kill()
+            from ..flywheel.ledger import read_all_hashes
+            settle_hashes: List[str] = []
+            if fleet is not None:
+                try:
+                    settle_hashes = read_all_hashes(
+                        _FLYWHEEL_SERVICE, [_FLYWHEEL_REPLICA],
+                        store_url=fleet.urls[0])
+                except Exception as e:  # noqa: BLE001 — census best-effort
+                    history.record("flywheel", event="settle-read-error",
+                                   error=classify_error(e)[0])
+                else:
+                    history.record("flywheel", event="settle-read",
+                                   hashes=settle_hashes)
+            if fleet is not None:
+                try:
+                    _promote_drill(history, fleet.urls[0])
+                except Exception as e:  # noqa: BLE001 — verdict, not crash
+                    history.record(
+                        "flywheel", event="gate",
+                        verdict=f"drill-error:{type(e).__name__}",
+                        bad=True)
         if holder:
             history.record("placement", event="stop",
                            workload=holder["workload"],
@@ -632,11 +849,14 @@ def run_soak(sched: Schedule, base_dir: str,
                            epoch=holder["epoch"])
         _import_ledger(history, trainer)
         _import_pipeline_ledger(history, ptrainer)
+        _import_flywheel_ledger(history, ftrainer)
     finally:
         if trainer is not None:
             trainer.kill()
         if ptrainer is not None:
             ptrainer.kill()
+        if ftrainer is not None:
+            ftrainer.kill()
         if gateway is not None:
             gateway.kill()
         roots = list(fleet.roots) if fleet is not None else []

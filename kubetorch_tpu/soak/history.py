@@ -34,6 +34,15 @@ Record shapes (all plain dicts; ``index`` is assigned on append):
   carries ``stage``/``epoch`` (a stage taking up an assignment),
   ``stale-refused`` a zombie confirm bounced by the epoch fence, and
   ``replay`` the unpartitioned re-run's ``step``/``fingerprint``.
+- ``{"kind": "flywheel", "event": "acked|consumed|cursor-committed|
+  cursor-restored|settle-read|gate", ...}`` — the feedback-ledger loop
+  (ISSUE 19): ``acked`` carries the record ``hashes`` a replica's
+  append durably acked, ``consumed`` the ``hashes`` + the ``step`` the
+  trainer folded them into, ``cursor-committed``/``cursor-restored``
+  the cursor's durable ``step``, ``settle-read`` every hash the settle
+  oracle read back from the ledger, and ``gate`` a promotion verdict
+  (``verdict`` + ``bad: true`` when the delta was the deliberately-bad
+  break-glass one).
 """
 
 from __future__ import annotations
@@ -375,6 +384,107 @@ def check_pipeline_progress(records: List[Dict]) -> List[Violation]:
     return out
 
 
+def check_flywheel_ledger(records: List[Dict]) -> List[Violation]:
+    """Loss-proof feedback flow (ISSUE 19), in four clauses:
+
+    - **zero acked-record loss**: every hash an ``acked`` record carries
+      must appear in a ``settle-read`` record — the ledger still serves
+      it after the dust settles (checked only when a settle-read ran);
+    - **consumed exactly once**: a hash folded into a *committed* step
+      (a ``consumed`` record whose ``step`` later shows up in a
+      ``cursor-committed`` record) must never be folded into a second
+      committed step — the no-double-train half of at-least-once; and
+      every acked hash must reach SOME committed step by settle (the
+      conductor drains the ledger before checking);
+    - **cursor monotonicity**: a ``cursor-restored`` step may never fall
+      below the highest ``cursor-committed`` step before it — restoring
+      past a committed checkpoint would re-train folded records;
+    - **bad deltas never promote**: a ``gate`` record with ``bad: true``
+      must carry verdict ``rolled_back`` or ``gate_rejected``.
+    """
+    out: List[Violation] = []
+    acked: Dict[str, int] = {}
+    consumed: Dict[int, List[Tuple[str, int]]] = {}   # step → [(hash, idx)]
+    committed_steps: Dict[int, int] = {}              # step → record index
+    settle_hashes: Optional[set] = None
+    settle_idx: Optional[int] = None
+    high_committed = 0
+    high_idx: Optional[int] = None
+    for r in records:
+        if r.get("kind") != "flywheel":
+            continue
+        event = r.get("event")
+        if event == "acked":
+            for h in r.get("hashes", []):
+                acked.setdefault(h, r["index"])
+        elif event == "consumed" and r.get("step") is not None:
+            consumed.setdefault(int(r["step"]), []).extend(
+                (h, r["index"]) for h in r.get("hashes", []))
+        elif event == "cursor-committed" and r.get("step") is not None:
+            step = int(r["step"])
+            committed_steps.setdefault(step, r["index"])
+            if step > high_committed:
+                high_committed, high_idx = step, r["index"]
+        elif event == "cursor-restored":
+            step = r.get("step")
+            if step is not None and int(step) < high_committed:
+                out.append(Violation(
+                    "flywheel-ledger",
+                    f"cursor restored step {step} but step "
+                    f"{high_committed} was already committed — folded "
+                    f"records would re-train",
+                    [i for i in (high_idx, r["index"]) if i is not None]))
+            if step is not None:
+                # a restore only succeeds from a COMMITTED checkpoint, so
+                # it is commit evidence too — covers a death in the tiny
+                # window between the store commit and the ledger line
+                committed_steps.setdefault(int(step), r["index"])
+                if int(step) > high_committed:
+                    high_committed, high_idx = int(step), r["index"]
+        elif event == "settle-read":
+            if settle_hashes is None:
+                settle_hashes = set()
+                settle_idx = r["index"]
+            settle_hashes.update(r.get("hashes", []))
+        elif event == "gate":
+            if r.get("bad") and r.get("verdict") not in ("rolled_back",
+                                                         "gate_rejected"):
+                out.append(Violation(
+                    "flywheel-ledger",
+                    f"deliberately-bad delta ended "
+                    f"{r.get('verdict')!r} — it must be gate_rejected "
+                    f"or rolled_back, never promoted", [r["index"]]))
+    if settle_hashes is not None:
+        for h, idx in sorted(acked.items()):
+            if h not in settle_hashes:
+                out.append(Violation(
+                    "flywheel-ledger",
+                    f"acked feedback record {h[:12]}… is gone from the "
+                    f"ledger at settle — an acked append was lost",
+                    [i for i in (idx, settle_idx) if i is not None]))
+    folded: Dict[str, Tuple[int, int]] = {}           # hash → (step, idx)
+    for step in sorted(consumed):
+        if step not in committed_steps:
+            continue                  # died un-committed: re-polls, fine
+        for h, idx in consumed[step]:
+            prev = folded.get(h)
+            if prev is not None and prev[0] != step:
+                out.append(Violation(
+                    "flywheel-ledger",
+                    f"record {h[:12]}… was folded into committed step "
+                    f"{prev[0]} AND committed step {step} — "
+                    f"double-trained", [prev[1], idx]))
+            folded.setdefault(h, (step, idx))
+    if committed_steps:
+        for h, idx in sorted(acked.items()):
+            if h not in folded:
+                out.append(Violation(
+                    "flywheel-ledger",
+                    f"acked record {h[:12]}… never reached a committed "
+                    f"training step by settle", [idx]))
+    return out
+
+
 INVARIANTS = {
     "durability": check_durability,
     "commits": check_commits,
@@ -383,6 +493,7 @@ INVARIANTS = {
     "ring-convergence": check_ring_converged,
     "no-leaks": check_no_leaks,
     "pipeline-progress": check_pipeline_progress,
+    "flywheel-ledger": check_flywheel_ledger,
 }
 
 

@@ -27,7 +27,8 @@ from typing import Dict, List
 
 from ..chaos import verb_registry
 
-PROFILES = ("store", "train", "serve", "federation", "all", "pipeline")
+PROFILES = ("store", "train", "serve", "federation", "all", "pipeline",
+            "flywheel")
 
 # Boot-armed persistent HTTP faults (the %PROB half of the grammar): verb
 # name → (token template, weight). Only retryable-by-contract verbs arm
@@ -151,7 +152,7 @@ def generate(seed: int, profile: str, n_ops: int,
     # and committed checkpoints ride it, and the ring absorbing a store
     # death MID-re-group is exactly the compound failure worth soaking
     has_store = profile in ("store", "train", "federation", "all",
-                            "pipeline")
+                            "pipeline", "flywheel")
     has_trainer = profile in ("train", "federation", "all")
     has_gateway = profile in ("serve", "federation", "all")
     has_regions = profile in ("federation", "all")
@@ -269,6 +270,31 @@ def generate(seed: int, profile: str, n_ops: int,
         tok = (f"kill-stage:9@{op_idx}" if rng.random() < 0.7
                else f"stall-stage:2.5@{op_idx}")
         sched.boot_chaos[f"stage:{stage}"] = tok
+
+    # draw 9: the flywheel profile's closure episode (ISSUE 19) — three
+    # compound faults against the collect→train→promote loop. (a) The
+    # trainer is boot-armed to self-SIGKILL at its N-th ledger-consume op
+    # (kill-flywheel, consumed by the trainer loop) and the conductor
+    # resumes it later: the resumed trainer must adopt its last committed
+    # cursor state and re-poll, never double-train. (b) One store node is
+    # boot-armed with drop-ack at its N-th mutating op: a ledger append
+    # commits but the ack never reaches the replica — the idempotent
+    # re-append must absorb it. Appended after draw 8 — draw order is the
+    # format.
+    if profile == "flywheel":
+        op_idx = rng.randrange(1, 4)
+        sched.boot_chaos["flywheel-trainer"] = f"kill-flywheel:9@{op_idx}"
+        back = rng.randrange(max(2, n_ops // 3), max(3, 2 * n_ops // 3))
+        events.append(FaultEvent(back, "resume-flywheel",
+                                 "flywheel-trainer",
+                                 verb="kill-flywheel",
+                                 token=f"kill-flywheel:9@{op_idx}"))
+        node = rng.randrange(store_nodes)
+        drop_idx = rng.randrange(1, 4)
+        tok = f"drop-ack@{drop_idx}"
+        key = f"store:{node}"
+        sched.boot_chaos[key] = (sched.boot_chaos[key] + "," + tok
+                                 if key in sched.boot_chaos else tok)
 
     sched.events = sorted(events, key=lambda e: (e.at_op, e.action,
                                                  e.target))

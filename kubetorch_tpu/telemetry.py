@@ -972,6 +972,59 @@ def pipeline_metrics() -> Dict[str, "_Metric"]:
     return _PIPELINE_METRICS
 
 
+_FLYWHEEL_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def flywheel_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the ``kt_flywheel_*`` family (ISSUE 19): the
+    continuous-learning loop. ``flywheel/ledger.py`` (the only
+    feedback-append site) counts appends/consumes/dedups, the harvester
+    phase-times its cycle, and the promoter (the only
+    publish/canary caller) counts gate verdicts and sets per-stage lag.
+    One place so ``kt flywheel status``, ``/metrics``, and
+    ``bench_serve.py --flywheel`` read the same series."""
+    global _FLYWHEEL_METRICS
+    if _FLYWHEEL_METRICS is None:
+        _FLYWHEEL_METRICS = {
+            "appended": counter(
+                "kt_flywheel_appended_total",
+                "Feedback records durably acked into the ledger "
+                "(counted only after the segment's quorum write)",
+                labels=("service",)),
+            "consumed": counter(
+                "kt_flywheel_consumed_total",
+                "Fresh feedback records handed to the trainer by the "
+                "cursor (post-dedup)",
+                labels=("service",)),
+            "deduped": counter(
+                "kt_flywheel_deduped_total",
+                "Duplicate records dropped by the cursor's hash dedup "
+                "(at-least-once retries, re-polled segments)",
+                labels=("service",)),
+            "gate": counter(
+                "kt_flywheel_gate_total",
+                "Promotion-gate verdicts (promoted, rolled_back, "
+                "gate_rejected)",
+                labels=("verdict",)),
+            "harvest": histogram(
+                "kt_flywheel_harvest_seconds",
+                "Harvester wall-clock by phase (harvest = one training "
+                "step on harvested capacity, vacate = flush-and-yield, "
+                "idle = waiting for SLO headroom)",
+                labels=("phase",),
+                buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5,
+                         10, 30)),
+            "lag": gauge(
+                "kt_flywheel_lag_seconds",
+                "Freshness of each flywheel stage (collect = newest "
+                "acked append, train = newest committed cursor state, "
+                "publish = newest rollout manifest, promote = newest "
+                "fleet-phase promotion)",
+                labels=("stage",)),
+        }
+    return _FLYWHEEL_METRICS
+
+
 # ---------------------------------------------------------------------------
 # Debug endpoint helper (shared by pod + store servers)
 # ---------------------------------------------------------------------------

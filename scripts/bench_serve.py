@@ -153,7 +153,8 @@ def _schedule(args) -> List[Dict]:
     return plan
 
 
-async def _run_policy(policy: str, plan: List[Dict], args) -> Dict:
+async def _run_policy(policy: str, plan: List[Dict], args,
+                      on_complete=None) -> Dict:
     ips = [f"10.0.0.{i + 1}" for i in range(args.replicas)]
     fleet = {ip: SimReplica(ip, args.slots,
                             args.prefill_us_per_tok / 1e6,
@@ -196,6 +197,11 @@ async def _run_policy(policy: str, plan: List[Dict], args) -> Dict:
                     ip, "generate", None, {"args": [], "kwargs": kwargs},
                     headers)
             ttfts.append(out["ttft_at"] - arrival)
+            if on_complete is not None:
+                # the flywheel tap (--flywheel): finished-request feedback
+                # leaves the serving loop here, exactly where a real
+                # engine's feedback_sink fires on slot retirement
+                on_complete(req, out["ttft_at"] - arrival)
         except (AdmissionShedError, DeadlineExceededError) as e:
             reason = getattr(e, "reason", None) or "deadline_expired"
             shed[reason] = shed.get(reason, 0) + 1
@@ -745,6 +751,228 @@ def _scaleout_main(args) -> int:
                     if isinstance(v, bool)) else 1
 
 
+# ---------------------------------------------------------------------------
+# --flywheel: feedback-to-weights-live + harvest/vacate impact (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _flywheel_main(args) -> int:
+    """Close the loop under load: the SAME open-loop arrival plan runs
+    twice through the real router — once bare (baseline), once with the
+    whole flywheel live against a real store subprocess (feedback sink →
+    durable ledger → harvest trainer on a background thread → gated
+    promotion). Reports:
+
+    - **feedback-to-weights-live p50/p99** — ack of a feedback record to
+      the PROMOTED manifest that contains its fold;
+    - **serving impact** — TTFT p99 / shed-rate delta vs the bare arm
+      (the harvester is supposed to be invisible: it trains in the
+      trough and vacates when the burst eats the SLO headroom);
+    - **vacate-inside-grace** — every vacate's flush must land inside
+      the drain grace window; exit-coded, like the scale-out bench.
+    """
+    import collections
+    import queue as _q
+    import statistics
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from kubetorch_tpu.flywheel.harvester import Harvester, HarvestPolicy
+    from kubetorch_tpu.flywheel.ledger import FeedbackLedger, LedgerCursor
+    from kubetorch_tpu.flywheel.promoter import Promoter
+    from kubetorch_tpu.train.checkpoint import Checkpointer
+    from kubetorch_tpu.utils.procs import kill_process_tree
+
+    service, replica = "bench-fly", "bench"
+    plan = _schedule(args)
+    print(f"flywheel bench: {len(plan)} requests open-loop, "
+          f"{args.replicas} replicas x {args.slots} slots, burst "
+          f"{args.burst_frac:.0%} @ t={args.burst_at}s; harvest SLO "
+          f"{args.fly_slo_ms:.0f}ms, drain grace {args.fly_grace_s:.1f}s")
+
+    baseline = asyncio.run(_run_policy("affinity", plan, args))
+
+    with tempfile.TemporaryDirectory() as root:
+        store_proc, url = _spawn_store(root)
+        try:
+            ledger = FeedbackLedger(service, replica, store_url=url)
+            fb_q: "_q.Queue" = _q.Queue()
+            ack_times: Dict[str, float] = {}
+            recent = collections.deque(maxlen=32)
+            serve_done = threading.Event()
+
+            def sink_loop() -> None:
+                # the durable half of the feedback sink: batch-drain the
+                # queue so one quorum append acks many requests
+                while True:
+                    item = fb_q.get()
+                    stop = item is None
+                    batch = [] if stop else [item]
+                    while True:
+                        try:
+                            nxt = fb_q.get_nowait()
+                        except _q.Empty:
+                            break
+                        if nxt is None:
+                            stop = True
+                        else:
+                            batch.append(nxt)
+                    if batch:
+                        hashes = ledger.append(batch)
+                        now = time.monotonic()
+                        for h in hashes:
+                            ack_times.setdefault(h, now)
+                    if stop:
+                        return
+
+            n_fb = {"i": 0}
+
+            def on_complete(req: Dict, ttft_s: float) -> None:
+                recent.append(ttft_s * 1000.0)
+                n_fb["i"] += 1
+                fb_q.put({"i": n_fb["i"], "session": req["session"],
+                          "prompt_len": req["prompt_len"],
+                          "new_tokens": req["new_tokens"],
+                          "ttft_ms": round(ttft_s * 1000.0, 3)})
+
+            def scrape() -> float:
+                vals = list(recent)
+                return statistics.median(vals) if vals else 0.0
+
+            cursor = LedgerCursor(service, [replica], store_url=url)
+            cursor.acquire()
+            ckpt = Checkpointer(f"bench/{service}/ckpt", store_url=url,
+                                every=1)
+            state = {"w": np.zeros(64, dtype=np.float32)}
+            fold = {"step": 0, "pending": []}
+
+            def train_step():
+                batch = cursor.poll(max_records=64)
+                if not batch:
+                    return None
+                fold["step"] += 1
+                w = state["w"] * np.float32(0.99)
+                for rec in batch:
+                    h = rec.get("hash") or ""
+                    w = w + np.float32(int(h[:8] or "0", 16)
+                                       / float(1 << 33))
+                state["w"] = w
+                cursor.commit_state(fold["step"])
+                ckpt.save(state, fold["step"])
+                fold["pending"].extend(r.get("hash") for r in batch)
+                return fold["step"]
+
+            class _Router:
+                def set_canary(self, r, fraction=0.1):
+                    pass
+
+                def clear_canary(self):
+                    pass
+
+                def canary_verdict(self, **kw):
+                    return "ok"
+
+            promoter = Promoter(service, _Router(), store_url=url,
+                                bake_s=0.05, min_requests=1, poll_s=0.01)
+            harv = Harvester(HarvestPolicy(slo_ms=args.fly_slo_ms),
+                             scrape, train_step,
+                             lambda: ckpt.flush(timeout=args.fly_grace_s),
+                             drain_grace_s=args.fly_grace_s, idle_s=0.05)
+            cycles: List[Dict] = []
+            live_lat: List[float] = []
+            promotes = {"n": 0}
+
+            def promote_pending() -> None:
+                if not fold["pending"]:
+                    return
+                verdict = promoter.promote(
+                    {k: np.copy(v) for k, v in state.items()},
+                    fold["step"])
+                if verdict == "promoted":
+                    promotes["n"] += 1
+                    now = time.monotonic()
+                    for h in fold["pending"]:
+                        if h in ack_times:
+                            live_lat.append(now - ack_times[h])
+                    fold["pending"].clear()
+
+            def trainer_loop() -> None:
+                dry = 0
+                while dry < 2:
+                    summary = harv.run_cycle(deadline_s=2.0)
+                    cycles.append(summary)
+                    promote_pending()
+                    if summary["reason"] == "drained" and summary[
+                            "steps"] == 0:
+                        dry = dry + 1 if serve_done.is_set() else 0
+                        time.sleep(0.1)
+                    else:
+                        dry = 0
+
+            sink_t = threading.Thread(target=sink_loop, daemon=True)
+            trainer_t = threading.Thread(target=trainer_loop, daemon=True)
+            sink_t.start()
+            trainer_t.start()
+            flywheel = asyncio.run(_run_policy("affinity", plan, args,
+                                               on_complete=on_complete))
+            serve_done.set()
+            fb_q.put(None)
+            sink_t.join(timeout=60)
+            trainer_t.join(timeout=120)
+        finally:
+            kill_process_tree(store_proc.pid)
+
+    vacates = [c for c in cycles if c["vacate_s"] > 0]
+    all_within = all(c["within_grace"] for c in vacates)
+    lat_p50 = _percentile(live_lat, 0.50)
+    lat_p99 = _percentile(live_lat, 0.99)
+    p99_delta = flywheel["ttft_p99_ms"] - baseline["ttft_p99_ms"]
+    shed_delta = flywheel["shed_rate"] - baseline["shed_rate"]
+
+    print(f"\n{'arm':<12} {'shed%':>7} {'ttft p50':>10} {'ttft p99':>10} "
+          f"{'tokens/s':>10}")
+    for name, r in (("baseline", baseline), ("flywheel", flywheel)):
+        print(f"{name:<12} {r['shed_rate'] * 100:>6.1f}% "
+              f"{r['ttft_p50_ms']:>8.1f}ms {r['ttft_p99_ms']:>8.1f}ms "
+              f"{r['tokens_per_s']:>10}")
+    steps = sum(c["steps"] for c in cycles)
+    print(f"\nfeedback-to-weights-live: p50 {lat_p50:.2f}s "
+          f"p99 {lat_p99:.2f}s over {len(live_lat)} records "
+          f"({promotes['n']} promotion(s), {steps} harvested step(s))")
+    print(f"serving impact: ttft p99 {p99_delta:+.1f}ms, shed rate "
+          f"{shed_delta * 100:+.2f}pp vs baseline")
+    print(f"vacates: {len(vacates)}, max "
+          f"{max((c['vacate_s'] for c in vacates), default=0.0):.3f}s vs "
+          f"grace {args.fly_grace_s:.1f}s -> "
+          f"{'all inside grace' if all_within else 'GRACE EXCEEDED'}")
+
+    acceptance = {
+        "promoted_at_least_once": promotes["n"] >= 1,
+        "latency_measured": len(live_lat) > 0,
+        "vacates_within_grace": all_within,
+    }
+    out = {"metric": "flywheel_feedback_to_live_p50_s",
+           "value": round(lat_p50, 3), "unit": "s",
+           "detail": {"p99_s": round(lat_p99, 3),
+                      "records": len(live_lat),
+                      "promotions": promotes["n"],
+                      "harvested_steps": steps,
+                      "cycles": {"count": len(cycles),
+                                 "vacates": len(vacates),
+                                 "max_vacate_s": round(max(
+                                     (c["vacate_s"] for c in vacates),
+                                     default=0.0), 4),
+                                 "grace_s": args.fly_grace_s},
+                      "ttft_p99_delta_ms": round(p99_delta, 1),
+                      "shed_rate_delta": round(shed_delta, 4),
+                      "baseline": baseline, "flywheel": flywheel,
+                      "acceptance": acceptance}}
+    print("\n" + json.dumps(out))
+    return 0 if all(acceptance.values()) else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--regions", type=int, default=0,
@@ -757,6 +985,16 @@ def main() -> int:
                    help="fleet cold-start burn-down: 0->N replicas cold "
                         "vs template-fork warm, plus broadcast-tree "
                         "joiner egress (ISSUE 16)")
+    p.add_argument("--flywheel", action="store_true",
+                   help="continuous-learning loop under load: feedback-"
+                        "to-weights-live p50/p99 through a real store + "
+                        "ledger + harvest trainer + gated promotion, and "
+                        "the harvest/vacate impact on serving p99/shed "
+                        "(ISSUE 19); exit-coded on vacate-inside-grace")
+    p.add_argument("--fly-slo-ms", type=float, default=400.0,
+                   help="flywheel harvest policy queue-wait SLO (ms)")
+    p.add_argument("--fly-grace-s", type=float, default=5.0,
+                   help="flywheel vacate drain-grace window (s)")
     p.add_argument("--n", type=int, default=4,
                    help="scale-out A/B replica count per arm")
     p.add_argument("--joiners", type=int, default=16,
@@ -803,6 +1041,14 @@ def main() -> int:
         return 0
     if args.scale_out:
         return _scaleout_main(args)
+    if args.flywheel:
+        # lighter default schedule: every feedback batch and every
+        # checkpoint crosses a real HTTP hop into the store subprocess
+        if "--sessions" not in sys.argv:
+            args.sessions = 300
+        if "--turns" not in sys.argv:
+            args.turns = 2
+        return _flywheel_main(args)
     if args.regions > 0:
         # region mode defaults: a lighter schedule (every request crosses
         # a real HTTP hop into a subprocess) unless explicitly overridden

@@ -50,6 +50,14 @@ Gate rule (per stage)::
 10% of a sub-millisecond p50 is jitter, not a regression — the gate
 exists to catch real ones.
 
+``--retries N`` (default 1; ``make test`` passes 3) re-measures FAILING
+stages up to N total attempts and judges the median of the per-attempt
+p50s against the SAME limit: the tolerance and floor never loosen, the
+gate just refuses to flunk a stage on a single scheduler burst a second
+and third measurement both contradict. Each attempt's p50 is isolated by
+diffing the cumulative histogram buckets, so a bad first attempt cannot
+pollute the retries.
+
 Run: ``make perf-gate`` (also part of ``make test``); ``--update``
 re-baselines after a DELIBERATE hot-path change (commit the JSON with the
 PR that explains it).
@@ -302,8 +310,13 @@ def _drive_cold_start(boots: int) -> None:
 def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
             store_gets: int, rollout_calls: int, rollout_kb: int,
             train_steps: int, snapshot_saves: int,
-            cold_boots: int) -> dict:
-    """{stage: p50 seconds} measured from a fresh registry."""
+            cold_boots: int, prev: dict = None) -> tuple:
+    """({stage: p50 seconds}, bucket snapshot) for THIS attempt only.
+
+    The registry is process-global and histograms only accumulate, so a
+    re-measure (``--retries``) diffs the cumulative bucket counts against
+    the ``prev`` snapshot — each attempt's p50 covers exactly its own
+    observations, never a blend with the attempt that failed."""
     from kubetorch_tpu import telemetry
     from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
                                               _quantile_from_buckets)
@@ -337,18 +350,21 @@ def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
     _drive_train_step(train_steps)
     _drive_cold_start(cold_boots)
     text = telemetry.REGISTRY.render()
-    out = {}
+    out, snap = {}, {}
     for stage in GATED_STAGES:
         metric, selector = STAGE_SOURCES.get(
             stage, ("kt_stage_seconds", f'stage="{stage}"'))
         buckets = _parse_histogram_buckets(text, metric, selector)
-        p50 = _quantile_from_buckets(buckets, 0.5)
+        snap[stage] = dict(buckets)
+        before = (prev or {}).get(stage, {})
+        delta = {le: n - before.get(le, 0.0) for le, n in buckets.items()}
+        p50 = _quantile_from_buckets(delta, 0.5)
         if p50 is None:
             raise RuntimeError(
                 f"stage {stage!r} recorded no observations — the hot path "
                 "lost its instrumentation (that IS a gate failure)")
         out[stage] = p50
-    return out
+    return out, snap
 
 
 def main() -> int:
@@ -366,15 +382,23 @@ def main() -> int:
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
+    p.add_argument("--retries", type=int, default=1,
+                   help="total measurement attempts for FAILING stages: a "
+                        "stage only fails if the MEDIAN of its per-attempt "
+                        "p50s exceeds the unchanged limit — shared-CI "
+                        "scheduling bursts wash out, a real regression "
+                        "(present in every attempt) still fails (make "
+                        "test uses 3)")
     p.add_argument("--update", action="store_true",
                    help="re-baseline (deliberate hot-path changes only; "
                         "commit the JSON with the explaining PR)")
     args = p.parse_args()
 
-    measured = measure(args.calls, args.payload_kb, args.shm_calls,
-                       args.shm_kb, args.store_gets, args.rollout_calls,
-                       args.rollout_kb, args.train_steps,
-                       args.snapshot_saves, args.cold_boots)
+    measured, snap = measure(args.calls, args.payload_kb, args.shm_calls,
+                             args.shm_kb, args.store_gets,
+                             args.rollout_calls, args.rollout_kb,
+                             args.train_steps, args.snapshot_saves,
+                             args.cold_boots)
 
     if args.update or not os.path.exists(BASELINE_PATH):
         baseline = {
@@ -403,17 +427,48 @@ def main() -> int:
     with open(BASELINE_PATH) as f:
         baseline = json.load(f)["stages"]
     floor_s = args.abs_floor_ms / 1000.0
+    limits = {s: float(baseline[s]) * (1.0 + args.tolerance) + floor_s
+              for s in GATED_STAGES}
     failures = []
     for stage in GATED_STAGES:
-        base = float(baseline[stage])
-        limit = base * (1.0 + args.tolerance) + floor_s
         got = measured[stage]
-        verdict = "ok" if got <= limit else "REGRESSED"
+        verdict = "ok" if got <= limits[stage] else "REGRESSED"
         print(f"perf-gate: {stage:<12} p50 {got * 1000:8.3f}ms  "
-              f"baseline {base * 1000:8.3f}ms  "
-              f"limit {limit * 1000:8.3f}ms  {verdict}")
-        if got > limit:
+              f"baseline {float(baseline[stage]) * 1000:8.3f}ms  "
+              f"limit {limits[stage] * 1000:8.3f}ms  {verdict}")
+        if got > limits[stage]:
             failures.append(stage)
+
+    # median-of-N re-measure (ISSUE 19 satellite): failing stages get up
+    # to --retries total attempts; the verdict compares the MEDIAN of the
+    # per-attempt p50s against the SAME limit — the gate never loosens,
+    # it just refuses to fail on one scheduling burst. Each attempt
+    # re-drives the full workload (stages share drivers) but only the
+    # stages that failed are re-judged.
+    import statistics
+    attempts = {s: [measured[s]] for s in GATED_STAGES}
+    for attempt in range(2, max(1, args.retries) + 1):
+        if not failures:
+            break
+        print(f"perf-gate: re-measuring {len(failures)} failing stage(s) "
+              f"(attempt {attempt}/{args.retries}): {', '.join(failures)}")
+        remeasured, snap = measure(
+            args.calls, args.payload_kb, args.shm_calls, args.shm_kb,
+            args.store_gets, args.rollout_calls, args.rollout_kb,
+            args.train_steps, args.snapshot_saves, args.cold_boots,
+            prev=snap)
+        for stage in GATED_STAGES:
+            attempts[stage].append(remeasured[stage])
+        still = []
+        for stage in failures:
+            med = statistics.median(attempts[stage])
+            verdict = "ok" if med <= limits[stage] else "REGRESSED"
+            print(f"perf-gate: {stage:<12} median-of-{attempt} "
+                  f"{med * 1000:8.3f}ms  "
+                  f"limit {limits[stage] * 1000:8.3f}ms  {verdict}")
+            if med > limits[stage]:
+                still.append(stage)
+        failures = still
     if failures:
         print(f"\nperf-gate: FAIL — {', '.join(failures)} p50 regressed "
               f"past baseline*(1+{args.tolerance:g}) + "

@@ -317,6 +317,37 @@ MEMBERSHIP_EXEMPT = {"pipeline_elastic.py"}
 MEMBERSHIP_BASELINE: dict = {}
 
 
+# Promotion-path containment (ISSUE 19). flywheel/promoter.py is the ONLY
+# production path from a trained delta to the live fleet: the held-out
+# eval gate runs BEFORE any manifest exists, the canary bake backs it up,
+# a regression rolls back typed, and every verdict lands in
+# ``kt_flywheel_gate_total``. A raw ``publish_rollout(...)`` or
+# ``CanaryRollout(...)`` anywhere else in the package is an ungated
+# promotion — weights the eval gate never scored reaching replicas the
+# canary never baked. ``train/checkpoint.py`` (defines publish_rollout)
+# and ``serve/rollout.py`` (defines CanaryRollout + its internal use) are
+# the definition sites; everything else goes through
+# ``flywheel.Promoter.promote``. The baseline is EMPTY on purpose and
+# must stay that way.
+PROMOTE_RE = re.compile(r"\b(?:publish_rollout|CanaryRollout)\s*\(")
+PROMOTE_EXEMPT = {"promoter.py", "checkpoint.py", "rollout.py"}
+PROMOTE_BASELINE: dict = {}
+
+
+# Feedback-append containment (ISSUE 19, same PR). The durability story
+# of the flywheel starts at the ack: ``flywheel/ledger.py`` is the ONLY
+# site that appends feedback segments (content-hashed records, quorum
+# ack, head advance) — a raw ``put_json("flywheel/...segment...")``
+# elsewhere would mint records with no hash/dedup identity, invisible to
+# the cursor's exactly-once fold and the soak's settle-read census. The
+# baseline is EMPTY on purpose and must stay that way.
+FEEDBACK_RE = re.compile(
+    r"put_json\(\s*(?:f?[\"'][^\"']*flywheel/[^\"']*segment|"
+    r"segment_key\()")
+FEEDBACK_EXEMPT = {"ledger.py"}
+FEEDBACK_BASELINE: dict = {}
+
+
 def _count_matches(path: Path, pattern: re.Pattern) -> int:
     n = 0
     for line in path.read_text().splitlines():
@@ -702,6 +733,54 @@ def main() -> int:
               "is empty on purpose.")
         return 1
 
+    promote_failures = []
+    promote_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in PROMOTE_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, PROMOTE_RE)
+        if n:
+            promote_counts[rel] = n
+        allowed = PROMOTE_BASELINE.get(rel, 0)
+        if n > allowed:
+            promote_failures.append(
+                f"  {rel}: {n} raw promotion call site(s), baseline "
+                f"allows {allowed}")
+    if promote_failures:
+        print("check_resilience: raw publish/canary calls bypass the "
+              "flywheel promotion gate:\n" + "\n".join(promote_failures))
+        print("\nTrained deltas reach the fleet ONLY through "
+              "flywheel/promoter.py (Promoter.promote): held-out eval "
+              "gate, canary bake, typed rollback, kt_flywheel_gate_total. "
+              "A direct publish_rollout/CanaryRollout call is an ungated "
+              "promotion. The baseline is empty on purpose.")
+        return 1
+
+    feedback_failures = []
+    feedback_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in FEEDBACK_EXEMPT:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, FEEDBACK_RE)
+        if n:
+            feedback_counts[rel] = n
+        allowed = FEEDBACK_BASELINE.get(rel, 0)
+        if n > allowed:
+            feedback_failures.append(
+                f"  {rel}: {n} raw feedback-segment write(s), baseline "
+                f"allows {allowed}")
+    if feedback_failures:
+        print("check_resilience: raw feedback-segment writes bypass the "
+              "flywheel ledger:\n" + "\n".join(feedback_failures))
+        print("\nFeedback records are appended ONLY in flywheel/ledger.py "
+              "(FeedbackLedger.append): content hashing, quorum ack, and "
+              "the head advance happen there or the cursor's exactly-once "
+              "fold and the soak settle-read census cannot see the "
+              "records. The baseline is empty on purpose.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
@@ -734,7 +813,11 @@ def main() -> int:
         + [f for f, allowed in AOT_BASELINE.items()
            if aot_counts.get(f, 0) < allowed]
         + [f for f, allowed in MEMBERSHIP_BASELINE.items()
-           if membership_counts.get(f, 0) < allowed])
+           if membership_counts.get(f, 0) < allowed]
+        + [f for f, allowed in PROMOTE_BASELINE.items()
+           if promote_counts.get(f, 0) < allowed]
+        + [f for f, allowed in FEEDBACK_BASELINE.items()
+           if feedback_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
@@ -745,8 +828,9 @@ def main() -> int:
               "data-store commit renames, checkpoint writes, step-path "
               "device_get sites, shared-memory segments, engine "
               "param-tree assignments, telemetry sites, soak RNG "
-              "draws, AOT compile-path entries, and stage-membership "
-              "constructions accounted for")
+              "draws, AOT compile-path entries, stage-membership "
+              "constructions, flywheel promotions, and feedback-segment "
+              "writes accounted for")
     return 0
 
 

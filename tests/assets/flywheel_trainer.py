@@ -1,0 +1,165 @@
+"""The flywheel harvest trainer under chaos (ISSUE 19).
+
+Consumes the durable feedback ledger through the REAL
+:class:`~kubetorch_tpu.flywheel.ledger.LedgerCursor` and commits with the
+REAL two-slot ``Checkpointer`` against the soak's store ring: each cycle
+polls one batch, folds it into the state with a fixed recurrence keyed by
+the record hashes (bit-reproducible), writes the cursor state for the new
+step, and THEN commits the checkpoint — the checkpoint marker is the
+single commit point for tree + cursor, exactly the protocol the flywheel
+ledger's crash-window analysis depends on.
+
+Chaos wiring:
+
+- a ``kill-flywheel[:SIG]@N`` token in ``KT_CHAOS`` arms
+  ``chaos.flywheel_kill_plan()``: the trainer consults it before its N-th
+  (0-based) ledger-consume op and SIGKILLs itself mid-harvest — after the
+  previous step's commit, before this batch commits. The resumed run
+  (``--resume``) restores the committed checkpoint, adopts the cursor
+  state that step names, and re-polls the orphaned batch; the
+  ``flywheel-ledger`` invariant verifies nothing was lost or doubled.
+- SIGTERM flips the cooperative drain flag (the PR 6 contract): the loop
+  finishes the in-flight step, flushes, and exits inside the grace
+  window.
+
+JSONL ledger lines (``--result``; the conductor imports them into the
+history): ``{"restored": step|null, "fingerprint": ...}``,
+``{"cursor_restored": step}``, ``{"dying_at_op": n}``,
+``{"consumed": [hashes], "step": n}``, ``{"cursor_committed": n}``,
+``{"committed": n, "fingerprint": ...}``, ``{"drained"|"done": ...}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from kubetorch_tpu import chaos  # noqa: E402
+from kubetorch_tpu.flywheel.ledger import LedgerCursor  # noqa: E402
+from kubetorch_tpu.train.checkpoint import (Checkpointer,  # noqa: E402
+                                            tree_fingerprint)
+
+_DRAIN = {"flag": False}
+
+
+def _on_term(signum, frame):  # noqa: ARG001 — signal signature
+    _DRAIN["flag"] = True
+
+
+def initial_state() -> dict:
+    rng = np.random.default_rng(19)
+    return {"w": rng.standard_normal(64).astype(np.float32),
+            "b": np.zeros(16, dtype=np.float32)}
+
+
+def fold_batch(state: dict, records: list, step: int) -> dict:
+    # fold each record by a delta derived from its content hash: any two
+    # trainers that agree on the committed prefix and the batch contents
+    # produce bit-identical trees — fingerprint drift is a real signal
+    out = {"w": state["w"] * np.float32(0.95),
+           "b": state["b"] + np.float32(step)}
+    for rec in records:
+        h = rec.get("hash") or ""
+        delta = np.float32(int(h[:8] or "0", 16) / float(1 << 32))
+        out["w"] = out["w"] + delta
+    return out
+
+
+def emit(path: str, record: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--service", required=True)
+    p.add_argument("--replicas", required=True,
+                   help="comma-joined serving replica ids feeding the ledger")
+    p.add_argument("--store", required=True)
+    p.add_argument("--base-key", required=True)
+    p.add_argument("--result", required=True)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--max-steps", type=int, default=0,
+                   help="stop after N committed steps (0 = until drained)")
+    p.add_argument("--idle-polls", type=int, default=8,
+                   help="consecutive empty polls before exiting drained")
+    p.add_argument("--poll-sleep", type=float, default=0.1)
+    p.add_argument("--batch-records", type=int, default=64)
+    args = p.parse_args()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    kill_plan = chaos.flywheel_kill_plan()
+    replicas = [r for r in args.replicas.split(",") if r]
+    ckpt = Checkpointer(args.base_key, store_url=args.store, every=1)
+    cursor = LedgerCursor(args.service, replicas, store_url=args.store)
+    state = initial_state()
+    step = 0
+    if args.resume:
+        restored = ckpt.restore()
+        if restored is not None:
+            state, step = restored
+            emit(args.result, {"restored": step,
+                               "fingerprint": tree_fingerprint(state)})
+        else:
+            emit(args.result, {"restored": None})
+        # the cursor adopts exactly the state the COMMITTED step names:
+        # a batch that died between cursor-state write and checkpoint
+        # commit re-polls, one folded under a committed step never does
+        cursor.restore(step if restored is not None else None)
+        emit(args.result, {"cursor_restored": step if restored else None})
+
+    consume_op = 0
+    idle = 0
+    steps_done = 0
+    while True:
+        if _DRAIN["flag"]:
+            emit(args.result, {"drained": step,
+                               "fingerprint": tree_fingerprint(state)})
+            return 0
+        if args.max_steps and steps_done >= args.max_steps:
+            break
+        if consume_op in kill_plan:
+            # mid-harvest death: the previous commit is the last durable
+            # state — the zero-double-train anchor the soak verifies
+            emit(args.result, {"dying_at_op": consume_op})
+            os.kill(os.getpid(), kill_plan[consume_op])
+        batch = cursor.poll(max_records=args.batch_records)
+        consume_op += 1
+        if not batch:
+            idle += 1
+            if idle >= args.idle_polls:
+                break
+            time.sleep(args.poll_sleep)
+            continue
+        idle = 0
+        step += 1
+        state = fold_batch(state, batch, step)
+        hashes = [r.get("hash") for r in batch]
+        emit(args.result, {"consumed": hashes, "step": step})
+        # cursor state FIRST, checkpoint commit SECOND: the marker is the
+        # one commit point for both (see ledger.py's crash-window notes)
+        cursor.commit_state(step)
+        ckpt.save(state, step)
+        emit(args.result, {"cursor_committed": step})
+        emit(args.result, {"committed": step,
+                           "fingerprint": tree_fingerprint(state)})
+        steps_done += 1
+    emit(args.result, {"done": True, "final_step": step,
+                       "fingerprint": tree_fingerprint(state)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,22 +14,41 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(__file__)),
 
 
 @pytest.mark.slow
-def test_rlhf_actor_learner_example(capsys):
-    from kubetorch_tpu.client import shutdown_local_controller
-    from kubetorch_tpu.config import reset_config
+def test_rlhf_actor_learner_example():
+    """Runs the example as a subprocess under a HARD timeout (ISSUE 19
+    deflake): the recipe spawns its own controller + pods, and a wedged
+    broadcast window used to hang the whole suite — now a hang fails
+    loudly inside the window and the process tree is reaped. The ported
+    example also exercises the flywheel feedback-ledger surface: rollout
+    rewards travel as durably-acked ledger segments and the learner folds
+    them through a committed cursor."""
+    import subprocess
 
-    reset_config()
-    import rlhf_actor_learner
+    from kubetorch_tpu.utils.procs import kill_process_tree
 
+    repo = os.path.dirname(os.path.dirname(__file__))
+    script = os.path.join(repo, "examples", "rlhf_actor_learner.py")
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        [sys.executable, script, "--rounds", "2", "--rollouts", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo, start_new_session=True)
     try:
-        rlhf_actor_learner.main(rounds=2, n_rollouts=2)
-        out = capsys.readouterr().out
-        assert "round 0" in out and "round 1" in out
-        assert "rollout versions [0, 0]" in out
-        assert "rollout versions [1, 1]" in out
-    finally:
-        shutdown_local_controller()
-        reset_config()
+        out, _ = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        kill_process_tree(proc.pid)
+        out, _ = proc.communicate(timeout=30)
+        pytest.fail("rlhf example hung past the 240s hard timeout "
+                    f"(deflake backstop); tail:\n{(out or '')[-4000:]}")
+    assert proc.returncode == 0, (out or "")[-4000:]
+    assert "round 0" in out and "round 1" in out
+    assert "rollout versions [0, 0]" in out
+    assert "rollout versions [1, 1]" in out
+    # the ledger surface carried the rewards: nothing folded before the
+    # first generate, 16 deduped records (2 rollouts x 8) on round 1
+    assert "folded 0 feedback records" in out
+    assert "folded 16 feedback records" in out
 
 
 @pytest.mark.slow

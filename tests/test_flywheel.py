@@ -1,0 +1,545 @@
+"""Continuous-learning flywheel suite (ISSUE 19): feedback-ledger
+durability + at-least-once dedup, every crash window in the commit
+protocol (ack-dropped re-append, torn cursor state, trainer races,
+death-between-state-put-and-checkpoint), harvest/vacate policy, gated
+promotion (eval gate → canary → promote/rollback), the
+kill-flywheel/drop-ack chaos verbs, the flywheel soak profile + ledger
+invariant, and the slow-tier chaos acceptance drill.
+``make test-flywheel``."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubetorch_tpu import chaos
+from kubetorch_tpu.data_store import commands as ds
+from kubetorch_tpu.data_store import ring as ring_mod
+from kubetorch_tpu.exceptions import DataCorruptionError, StaleLeaseError
+from kubetorch_tpu.flywheel import harvester as hv
+from kubetorch_tpu.flywheel import ledger as fl
+from kubetorch_tpu.flywheel import promoter as pm
+from kubetorch_tpu.serve import rollout as ro
+from kubetorch_tpu.serving import elastic
+from kubetorch_tpu.soak import generate
+from kubetorch_tpu.soak import history as H
+from tests.assets.threaded_server import ThreadedAiohttpServer
+
+pytestmark = pytest.mark.flywheel
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_STORE_FSYNC", "0")
+    monkeypatch.setenv("KT_SCRUB_INTERVAL_S", "0")
+    from kubetorch_tpu.data_store.store_server import create_store_app
+    ring_mod.reset_rings()
+    with ThreadedAiohttpServer(
+            lambda: create_store_app(str(tmp_path / "store"))) as srv:
+        yield srv.url
+    ring_mod.reset_rings()
+
+
+def _tree(scale=1.0):
+    return {"w": np.arange(16, dtype=np.float32) * scale,
+            "b": np.ones((4,), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# FeedbackLedger: the append/durability boundary
+# ---------------------------------------------------------------------------
+
+
+def test_append_returns_hashes_and_roundtrips(store):
+    led = fl.FeedbackLedger("svc", "r1", store_url=store)
+    p1, p2 = {"prompt": 1, "reward": 0.5}, {"prompt": 2, "reward": 0.9}
+    hashes = led.append([p1, p2])
+    assert hashes == [fl.record_hash(p1), fl.record_hash(p2)]
+    assert led.next_seq == 1
+    assert fl.read_all_hashes("svc", ["r1"], store_url=store) == hashes
+    head = ds.get_json(fl.head_key("svc", "r1"), store_url=store)
+    assert head["seq"] == 0
+
+
+def test_append_rejects_an_oversized_segment(store):
+    led = fl.FeedbackLedger("svc", "r1", store_url=store)
+    with pytest.raises(ValueError):
+        led.append([{"i": i} for i in range(fl.MAX_SEGMENT_RECORDS + 1)])
+
+
+def test_restarted_replica_probes_past_a_torn_head(store):
+    """A crash between the segment commit and the (advisory) head update
+    must not let the restarted replica overwrite the orphan segment."""
+    led = fl.FeedbackLedger("svc", "r1", store_url=store)
+    led.append([{"i": 0}])
+    # simulate the crash window: a committed segment the head never saw
+    ds.put_json(fl.segment_key("svc", "r1", 1),
+                {"replica": "r1", "seq": 1,
+                 "records": [{"hash": fl.record_hash({"i": 1}),
+                              "payload": {"i": 1}}], "at": 0.0},
+                store_url=store)
+    led2 = fl.FeedbackLedger("svc", "r1", store_url=store)
+    assert led2.next_seq == 2
+    led2.append([{"i": 2}])
+    assert len(fl.read_all_hashes("svc", ["r1"], store_url=store)) == 3
+
+
+def test_sample_rate_gates_and_coin_is_deterministic(store):
+    led = fl.FeedbackLedger("svc", "r1", store_url=store, sample_rate=0.5)
+    assert led.sample({"i": 1}, coin=0.9) is None
+    assert led.sample({"i": 1}, coin=0.1) == [fl.record_hash({"i": 1})]
+    off = fl.FeedbackLedger("svc", "r2", store_url=store, sample_rate=0.0)
+    assert off.sample({"i": 2}) is None
+    assert fl.read_all_hashes("svc", ["r2"], store_url=store) == []
+
+
+def test_engine_feedback_hook_never_raises():
+    # a ledger pointed at a dead store: the sink swallows the failure —
+    # losing a sample is fine, stalling the engine's retire path is not
+    led = fl.FeedbackLedger.__new__(fl.FeedbackLedger)
+    led.service, led.replica_id = "svc", "r1"
+    led.store_url, led.retries = "http://127.0.0.1:9", 0
+    led.sample_rate, led._seq = 1.0, 0
+    sink = fl.engine_feedback_hook(led)
+    sink({"request_id": "x"})           # must not raise
+
+
+# ---------------------------------------------------------------------------
+# crash-window edge cases (satellite: ledger edge-case tests)
+# ---------------------------------------------------------------------------
+
+
+def test_ack_dropped_append_commits_once(tmp_path, monkeypatch):
+    """drop-ack: the segment PUT commits server-side but the ack never
+    leaves. The at-least-once re-put must absorb it — the record exists
+    exactly once and append still returns its hash."""
+    monkeypatch.setenv("KT_STORE_FSYNC", "0")
+    monkeypatch.setenv("KT_SCRUB_INTERVAL_S", "0")
+    monkeypatch.setenv("KT_CHAOS", "drop-ack@0")
+    from kubetorch_tpu.data_store.store_server import create_store_app
+    ring_mod.reset_rings()
+    with ThreadedAiohttpServer(
+            lambda: create_store_app(str(tmp_path / "store"))) as srv:
+        led = fl.FeedbackLedger("svc", "r1", store_url=srv.url)
+        hashes = led.append([{"i": 1}])
+        assert hashes == [fl.record_hash({"i": 1})]
+        assert fl.read_all_hashes("svc", ["r1"],
+                                  store_url=srv.url) == hashes
+    ring_mod.reset_rings()
+
+
+def test_replica_death_after_commit_before_ack_dedups_at_consume(store):
+    """The SIGKILL-between-quorum-commit-and-ack window: the restarted
+    replica re-samples the same payload into a NEW segment; the cursor's
+    hash dedup folds it exactly once."""
+    payload = {"prompt": 7, "reward": 0.25}
+    fl.FeedbackLedger("svc", "r1", store_url=store).append([payload])
+    # restarted replica: fresh instance, same payload, new segment
+    fl.FeedbackLedger("svc", "r1", store_url=store).append([payload])
+    assert len(fl.read_all_hashes("svc", ["r1"], store_url=store)) == 2
+    cur = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    batch = cur.poll()
+    assert [r["hash"] for r in batch] == [fl.record_hash(payload)]
+    cur.commit_state(1)
+    assert cur.poll() == []
+
+
+def test_torn_cursor_state_refuses_restore(store):
+    cur = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    fl.FeedbackLedger("svc", "r1", store_url=store).append([{"i": 1}])
+    cur.poll()
+    state = cur.commit_state(1)
+    # tamper: positions change but the embedded checksum does not
+    torn = dict(state)
+    torn["positions"] = {"r1": 99}
+    ds.put_json(fl.cursor_state_key("svc", 1), torn, store_url=store)
+    fresh = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    with pytest.raises(DataCorruptionError):
+        fresh.restore(1)
+    # a checkpoint naming a step whose state doc is GONE is equally
+    # unprovable — never re-train blind
+    with pytest.raises(DataCorruptionError):
+        fl.LedgerCursor("svc", ["r1"], store_url=store).restore(42)
+
+
+def test_crash_between_state_put_and_checkpoint_commit_repolls(store):
+    """The cursor state for step N lands BEFORE the step-N checkpoint
+    commit. Die in between → the trainer restores the PREVIOUS committed
+    step (or scratch) and the batch re-polls; restore(N) after the
+    commit skips it. Both sides, no loss, no double-train."""
+    fl.FeedbackLedger("svc", "r1", store_url=store).append([{"i": 1}])
+    cur = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    batch = cur.poll()
+    assert len(batch) == 1
+    cur.commit_state(1)                 # state put... then "crash" here
+    # checkpoint never committed: restore from scratch re-polls the batch
+    redo = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    assert redo.restore(None) is False
+    assert [r["hash"] for r in redo.poll()] == [b["hash"] for b in batch]
+    # checkpoint DID commit: the restored positions already skip it
+    done = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    assert done.restore(1) is True
+    assert done.step == 1 and done.poll() == []
+
+
+def test_two_trainers_racing_one_cursor_epoch_fence(store):
+    c1 = fl.LedgerCursor("svc", ["r1"], store_url=store, owner="t1")
+    assert c1.acquire() == 1
+    fl.FeedbackLedger("svc", "r1", store_url=store).append([{"i": 1}])
+    c1.poll()
+    c2 = fl.LedgerCursor("svc", ["r1"], store_url=store, owner="t2")
+    assert c2.acquire() == 2            # takeover bumps the epoch
+    with pytest.raises(StaleLeaseError):
+        c1.poll()                       # the fenced side dies typed...
+    with pytest.raises(StaleLeaseError):
+        c1.commit_state(1)              # ...on commit too
+    c2.poll()
+    c2.commit_state(1)                  # the holder is unaffected
+
+
+def test_cursor_lag_counts_unconsumed_segments(store):
+    led = fl.FeedbackLedger("svc", "r1", store_url=store)
+    cur = fl.LedgerCursor("svc", ["r1"], store_url=store)
+    assert cur.lag_records() == 0
+    led.append([{"i": 1}])
+    led.append([{"i": 2}])
+    assert cur.lag_records() == 2
+    cur.poll()
+    cur.commit_state(1)
+    assert cur.lag_records() == 0
+
+
+# ---------------------------------------------------------------------------
+# HarvestPolicy / Harvester
+# ---------------------------------------------------------------------------
+
+
+def test_harvest_policy_headroom_matrix():
+    pol = hv.HarvestPolicy(slo_ms=100.0, headroom=0.25)
+    assert pol.decide(50.0) == hv.HARVEST
+    assert pol.decide(75.0) == hv.HARVEST           # exactly at the limit
+    assert pol.decide(80.0, harvesting=True) == hv.VACATE
+    assert pol.decide(80.0, harvesting=False) == hv.IDLE
+    # no SLO configured: harvest only while the queue is quiet
+    quiet = hv.HarvestPolicy(slo_ms=-1.0, headroom=0.25,
+                             min_headroom_ms=1.0)
+    quiet.slo_ms = 0.0
+    assert quiet.decide(0.5) == hv.HARVEST
+    assert quiet.decide(10.0, harvesting=True) == hv.VACATE
+
+
+def test_harvester_trains_until_drained_and_vacates_in_grace():
+    stepped = []
+
+    def train_step():
+        if len(stepped) >= 3:
+            return None                 # ledger dry
+        stepped.append(1)
+        return len(stepped)
+
+    flushed = []
+    harv = hv.Harvester(hv.HarvestPolicy(slo_ms=100.0, headroom=0.25),
+                        scrape=lambda: 10.0, train_step=train_step,
+                        flush=lambda: flushed.append(1),
+                        drain_grace_s=5.0, idle_s=0.01)
+    out = harv.run_cycle()
+    assert out["reason"] == "drained" and out["steps"] == 3
+    assert out["within_grace"] and flushed == [1]
+    assert harv.harvested_steps == 3 and harv.vacates == 1
+
+
+def test_harvester_exits_on_drain_request():
+    elastic.clear_drain()
+    try:
+        harv = hv.Harvester(hv.HarvestPolicy(slo_ms=100.0),
+                            scrape=lambda: 0.0,
+                            train_step=lambda: 1,
+                            flush=lambda: None, drain_grace_s=5.0)
+        elastic.request_drain("preempted")
+        out = harv.run_cycle(max_steps=100)
+        assert out["reason"] == "drain" and out["steps"] == 0
+    finally:
+        elastic.clear_drain()
+
+
+def test_harvester_policy_vacate_mid_cycle():
+    waits = iter([10.0, 10.0, 90.0, 90.0])
+    harv = hv.Harvester(hv.HarvestPolicy(slo_ms=100.0, headroom=0.25),
+                        scrape=lambda: next(waits),
+                        train_step=lambda: 1,
+                        flush=lambda: None, drain_grace_s=5.0)
+    out = harv.run_cycle(max_steps=100)
+    assert out["reason"] == "policy" and out["steps"] == 2
+
+
+def test_harvest_record_is_batch_tier_preemptible():
+    rec = hv.harvest_record("svc")
+    assert rec["scheduling"] == {"priority": "batch", "preemptible": True}
+    assert rec["name"] == "flywheel-svc"
+
+
+# ---------------------------------------------------------------------------
+# Promoter: eval gate → canary → promote / typed rollback
+# ---------------------------------------------------------------------------
+
+
+class ScriptedRouter:
+    def __init__(self, verdict="ok"):
+        self.verdict = verdict
+        self.pinned = None
+
+    def set_canary(self, replica, fraction=0.1):
+        self.pinned = (replica, fraction)
+
+    def clear_canary(self):
+        self.pinned = None
+
+    def canary_verdict(self, **kw):
+        return self.verdict
+
+
+def _promoter(store, verdict="ok", eval_fn=None, tol=0.05):
+    return pm.Promoter("svc", ScriptedRouter(verdict), store_url=store,
+                       eval_fn=eval_fn, gate_tolerance=tol,
+                       bake_s=0.2, min_requests=1, poll_s=0.02)
+
+
+def test_promoter_good_delta_promotes_and_commits_baseline(store):
+    p = _promoter(store, eval_fn=lambda t: float(np.abs(t["w"]).mean()))
+    assert p.promote(_tree(), step=1) == pm.PROMOTED
+    m = ro.read_manifest("svc", store_url=store)
+    assert m["phase"] == "fleet" and m["step"] == 1
+    base = ds.get_json(pm.eval_baseline_key("svc"), store_url=store)
+    assert base is not None and base["step"] == 1
+
+
+def test_promoter_eval_gate_rejects_before_any_manifest(store):
+    p = _promoter(store, eval_fn=lambda t: float(np.abs(t["w"]).mean()))
+    assert p.promote(_tree(), step=1) == pm.PROMOTED
+    before = ro.read_manifest("svc", store_url=store)["version"]
+    # 100x the loss: rejected by the offline gate, no canary, no publish
+    assert p.promote(_tree(scale=100.0), step=2) == pm.GATE_REJECTED
+    assert ro.read_manifest("svc", store_url=store)["version"] == before
+    assert p.history[-1]["verdict"] == pm.GATE_REJECTED
+
+
+def test_promoter_break_glass_bad_delta_rolled_back(store, monkeypatch):
+    p = _promoter(store, eval_fn=lambda t: float(np.abs(t["w"]).mean()))
+    assert p.promote(_tree(), step=1) == pm.PROMOTED
+    assert p.promote(_tree(scale=0.5), step=2) == pm.PROMOTED
+    prev = ro.read_manifest("svc", store_url=store)
+    # blind the eval gate, regress the canary: the backstop must catch it
+    monkeypatch.setenv(pm.BREAK_ENV, pm.BREAK_PROMOTE_BAD)
+    bad = _promoter(store, verdict="regressed",
+                    eval_fn=lambda t: float(np.abs(t["w"]).mean()))
+    assert bad.promote(_tree(scale=100.0), step=3) == pm.ROLLED_BACK
+    m = ro.read_manifest("svc", store_url=store)
+    assert m["phase"] == "rollback"
+    assert m["fingerprint"] == prev["fingerprint"]
+    # the bad loss never became the baseline
+    base = ds.get_json(pm.eval_baseline_key("svc"), store_url=store)
+    assert base["step"] == 2
+
+
+def test_flywheel_status_snapshot_and_cli(store):
+    led = fl.FeedbackLedger("svc", "r1", store_url=store)
+    led.append([{"i": 1}])
+    cur = fl.LedgerCursor("svc", ["r1"], store_url=store, owner="t1")
+    cur.acquire()
+    cur.poll()
+    cur.commit_state(1)
+    p = _promoter(store)
+    assert p.promote(_tree(), step=1) == pm.PROMOTED
+    out = pm.flywheel_status("svc", ["r1"], store_url=store)
+    assert set(out["lag_seconds"]) == set(pm.LAG_STAGES)
+    for stage in pm.LAG_STAGES:
+        assert out["lag_seconds"][stage] is not None
+    assert out["lease"]["epoch"] == 1 and out["cursor"]["step"] == 1
+    assert out["manifest"]["phase"] == "fleet"
+
+    from click.testing import CliRunner
+
+    from kubetorch_tpu.cli import cli
+
+    r = CliRunner().invoke(cli, ["flywheel", "status", "--service", "svc",
+                                 "--replica", "r1",
+                                 "--store-url", store, "--json"])
+    assert r.exit_code == 0, r.output
+    payload = json.loads(r.output)
+    assert payload["manifest"]["phase"] == "fleet"
+    r = CliRunner().invoke(cli, ["flywheel", "status", "--service", "svc",
+                                 "--replica", "r1", "--store-url", store])
+    assert r.exit_code == 0, r.output
+    assert "manifest v" in r.output and "lag " in r.output
+
+
+# ---------------------------------------------------------------------------
+# chaos verbs: kill-flywheel / drop-ack
+# ---------------------------------------------------------------------------
+
+
+def test_flywheel_verbs_parse_and_registry():
+    f = chaos.parse_spec("kill-flywheel:15@2")[0]
+    assert (f.kind, f.signal_no, f.op_index) == ("kill-flywheel", 15, 2)
+    f = chaos.parse_spec("kill-flywheel@1")[0]
+    assert (f.signal_no, f.op_index) == (9, 1)      # default SIGKILL
+    f = chaos.parse_spec("drop-ack@3")[0]
+    assert (f.kind, f.op_index) == ("drop-ack", 3)
+    with pytest.raises(chaos.ChaosError):
+        chaos.parse_spec("drop-ack:5@1")            # @ carries the index
+    names = {v.name for v in chaos.verb_registry()}
+    assert {"kill-flywheel", "drop-ack"} <= names
+    md = chaos.grammar_markdown()
+    assert "`kill-flywheel`" in md and "`drop-ack`" in md
+
+
+def test_flywheel_kill_plan_reads_spec_and_env(monkeypatch):
+    assert chaos.flywheel_kill_plan("kill-flywheel:9@2") == {2: 9}
+    assert chaos.flywheel_kill_plan("kill-rank:9@2") == {}
+    monkeypatch.setenv("KT_CHAOS", "kill-flywheel:15@1,delay:0.1")
+    assert chaos.flywheel_kill_plan() == {1: 15}
+    monkeypatch.delenv("KT_CHAOS")
+    assert chaos.flywheel_kill_plan() == {}
+
+
+def test_kill_flywheel_is_invisible_to_the_middleware():
+    eng = chaos.ChaosEngine(chaos.parse_spec("kill-flywheel:9@0"))
+    assert all(eng.next_fault("/kv/x", method="PUT") is None
+               for _ in range(3))
+
+
+def test_drop_ack_counter_advances_on_mutating_ops_only():
+    # drop-ack@1 = the SECOND mutating op; the GET in between must not
+    # advance its counter (the method-aware schedule position)
+    eng = chaos.ChaosEngine(chaos.parse_spec("drop-ack@1"))
+    hits = [eng.next_fault("/kv/a", method="PUT"),
+            eng.next_fault("/kv/b", method="GET"),
+            eng.next_fault("/kv/c", method="PUT"),
+            eng.next_fault("/kv/d", method="PUT")]
+    assert [h.kind if h else None for h in hits] == \
+        [None, None, "drop-ack", None]
+
+
+def test_drop_ack_skips_exempt_paths():
+    eng = chaos.ChaosEngine(chaos.parse_spec("drop-ack@0"))
+    assert eng.next_fault("/health", method="POST") is None
+    hit = eng.next_fault("/kv/x", method="PUT")
+    assert hit is not None and hit.kind == "drop-ack"
+
+
+# ---------------------------------------------------------------------------
+# soak: the flywheel profile + the flywheel-ledger invariant
+# ---------------------------------------------------------------------------
+
+
+def test_flywheel_profile_schedule_deterministic_and_armed():
+    a, b = (generate(5, "flywheel", 24).to_json() for _ in range(2))
+    assert a == b
+    sched = generate(5, "flywheel", 24)
+    assert "kill-flywheel" in sched.boot_chaos.get("flywheel-trainer", "")
+    assert any("drop-ack" in tok for key, tok in sched.boot_chaos.items()
+               if key.startswith("store:"))
+    assert any(e.action == "resume-flywheel" for e in sched.events)
+    assert sched.store_nodes > 0        # the ledger needs its ring
+
+
+def _fly(i, event, **kw):
+    return {"kind": "flywheel", "event": event, "index": i, **kw}
+
+
+def test_invariant_catches_a_lost_acked_record():
+    out = H.check_flywheel_ledger([
+        _fly(0, "acked", hashes=["aaa", "bbb"]),
+        _fly(1, "settle-read", hashes=["bbb"]),
+    ])
+    assert any("acked append was lost" in v.detail for v in out)
+
+
+def test_invariant_catches_a_double_train():
+    out = H.check_flywheel_ledger([
+        _fly(0, "acked", hashes=["aaa"]),
+        _fly(1, "consumed", hashes=["aaa"], step=1),
+        _fly(2, "cursor-committed", step=1),
+        _fly(3, "consumed", hashes=["aaa"], step=2),
+        _fly(4, "cursor-committed", step=2),
+        _fly(5, "settle-read", hashes=["aaa"]),
+    ])
+    assert any("double-trained" in v.detail for v in out)
+
+
+def test_invariant_uncommitted_batch_repoll_is_not_a_double_train():
+    assert H.check_flywheel_ledger([
+        _fly(0, "acked", hashes=["aaa"]),
+        _fly(1, "consumed", hashes=["aaa"], step=1),   # died un-committed
+        _fly(2, "consumed", hashes=["aaa"], step=2),   # the re-poll
+        _fly(3, "cursor-committed", step=2),
+        _fly(4, "settle-read", hashes=["aaa"]),
+    ]) == []
+
+
+def test_invariant_catches_a_cursor_regression():
+    out = H.check_flywheel_ledger([
+        _fly(0, "cursor-committed", step=3),
+        _fly(1, "cursor-restored", step=1),
+    ])
+    assert any("would re-train" in v.detail for v in out)
+
+
+def test_invariant_catches_a_promoted_bad_delta_and_stranded_ack():
+    out = H.check_flywheel_ledger([
+        _fly(0, "acked", hashes=["aaa"]),
+        _fly(1, "cursor-committed", step=1),
+        _fly(2, "gate", verdict="promoted", bad=True),
+        _fly(3, "settle-read", hashes=["aaa"]),
+    ])
+    assert any("never promoted" in v.detail for v in out)
+    assert any("never reached a committed" in v.detail for v in out)
+
+
+def test_invariant_green_path():
+    assert H.check_flywheel_ledger([
+        _fly(0, "acked", hashes=["aaa"]),
+        _fly(1, "consumed", hashes=["aaa"], step=1),
+        _fly(2, "cursor-committed", step=1),
+        _fly(3, "cursor-restored", step=1),
+        _fly(4, "gate", verdict="rolled_back", bad=True),
+        _fly(5, "gate", verdict="promoted", bad=False),
+        _fly(6, "settle-read", hashes=["aaa"]),
+    ]) == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow + chaos): the full loop on the real subprocess stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_flywheel_soak_closes_the_loop_loss_proof(tmp_path):
+    """THE closure drill: a seeded flywheel soak — serving-side appends,
+    the subprocess trainer SIGKILLed mid-harvest and resumed, a store
+    node dropping an ack, a bad delta pushed through the blinded eval
+    gate — ends green with every acked record consumed exactly once and
+    the bad delta rolled back, fleet version unchanged."""
+    from kubetorch_tpu.soak.conductor import run_soak
+
+    sched = generate(19, "flywheel", 24)
+    res = run_soak(sched, str(tmp_path), op_interval_s=0.1,
+                   settle_timeout_s=60)
+    assert res.ok, [v.to_dict() for v in res.violations]
+    recs = [r for r in res.records if r.get("kind") == "flywheel"]
+    acked = {h for r in recs if r["event"] == "acked"
+             for h in r.get("hashes", [])}
+    assert acked, "no feedback was ever acked — the drill proved nothing"
+    settle = {h for r in recs if r["event"] == "settle-read"
+              for h in r.get("hashes", [])}
+    assert acked <= settle
+    # the mid-harvest SIGKILL actually fired and the trainer came back
+    assert any(r["event"] == "dying" for r in recs)
+    assert any(r["event"] == "cursor-restored" for r in recs)
+    # the promote drill ran: two clean promotes, one bad delta caught
+    gates = [r for r in recs if r["event"] == "gate"]
+    assert [g["verdict"] for g in gates] == \
+        ["promoted", "promoted", "rolled_back"]
+    assert gates[-1]["bad"] is True

@@ -264,7 +264,8 @@ def test_no_leaks_catches_shm_and_tmp():
 def test_check_all_runs_every_invariant():
     assert set(H.INVARIANTS) == {"durability", "commits", "lease-fencing",
                                  "typed-errors", "ring-convergence",
-                                 "no-leaks", "pipeline-progress"}
+                                 "no-leaks", "pipeline-progress",
+                                 "flywheel-ledger"}
     assert H.check_all([]) == []
 
 

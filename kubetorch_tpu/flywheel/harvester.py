@@ -101,8 +101,10 @@ class Harvester:
     """The consume→train→commit loop over harvested capacity.
 
     ``scrape()`` returns the serving queue-wait p50 in ms (the SLO
-    autoscaler's own signal); ``train_step(batch) -> step`` folds one
-    polled batch and returns the new step number; ``flush()`` blocks
+    autoscaler's own signal); ``train_step() -> step`` takes no
+    arguments — it polls the cursor itself, folds one batch, and
+    returns the new step number (or ``None`` when the ledger is
+    drained); ``flush()`` blocks
     until the step's checkpoint is durably committed (the
     ``Checkpointer.flush`` the vacate path spends its grace window on).
     The loop itself polls :func:`elastic.drain_requested` every

@@ -127,8 +127,12 @@ class FeedbackLedger:
         head = ds.get_json(head_key(service, replica_id), quorum=True,
                            default=None, store_url=store_url)
         seq = int(head["seq"]) + 1 if head else 0
+        # quorum here too: after a crash between segment commit and head
+        # update, a stale single-replica read would end the probe early
+        # and the re-put would overwrite an acked segment
         while ds.get_json(segment_key(service, replica_id, seq),
-                          store_url=store_url, default=None) is not None:
+                          quorum=True, store_url=store_url,
+                          default=None) is not None:
             seq += 1
         self._seq = seq
 
@@ -257,11 +261,17 @@ class LedgerCursor:
     def poll(self, max_records: int = 256) -> List[Dict[str, Any]]:
         """One at-least-once read: fresh records across every replica's
         stream, hash-deduped. Positions advance only in memory until
-        :meth:`commit_state` folds them under a committed step."""
+        :meth:`commit_state` folds them under a committed step.
+
+        Segments are consumed whole (position granularity is the
+        segment), so ``max_records`` is checked only at segment
+        boundaries and one poll can return up to ``max_records +
+        MAX_SEGMENT_RECORDS - 1`` records."""
         self._validate_fence()
         m = telemetry.flywheel_metrics()
         batch: List[Dict[str, Any]] = []
         pending_hashes: List[str] = []
+        pending_set: set = set()
         pending_pos: Dict[str, int] = {}
         for replica in self.replicas:
             seq = self.positions[replica]
@@ -273,11 +283,12 @@ class LedgerCursor:
                     break
                 for rec in seg.get("records", []):
                     h = rec.get("hash")
-                    if h in self._seen_set or h in pending_hashes:
+                    if h in self._seen_set or h in pending_set:
                         m["deduped"].inc(service=self.service)
                         continue
                     batch.append(rec)
                     pending_hashes.append(h)
+                    pending_set.add(h)
                 seq += 1
             pending_pos[replica] = seq
         self._pending_positions = pending_pos

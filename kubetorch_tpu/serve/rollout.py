@@ -668,6 +668,7 @@ class HostEngine:
         # same continuous-learning tap as GenerationEngine.feedback_sink,
         # so bench_serve's host-mode replicas can feed the flywheel ledger
         self.feedback_sink = None
+        self._req_seq = 0
         self._reqs: List[Dict[str, Any]] = []
         self._hooks: "deque[tuple]" = deque()
         self._lock = threading.Lock()
@@ -679,6 +680,11 @@ class HostEngine:
         req = {"left": int(n_tokens), "done": threading.Event(),
                "error": None}
         with self._lock:
+            # unique per-engine id: the flywheel ledger dedups feedback
+            # records by content hash, so two requests retiring in the
+            # same step with equal token counts must not hash alike
+            self._req_seq += 1
+            req["id"] = self._req_seq
             self._reqs.append(req)
         self._work.set()
         return req
@@ -726,6 +732,7 @@ class HostEngine:
                 if self.feedback_sink is not None:
                     try:
                         self.feedback_sink({
+                            "request_id": req.get("id"),
                             "generated": int(req.get("tokens", 0)),
                             "error": (str(req["error"])[:120]
                                       if req.get("error") else None),

@@ -7,7 +7,7 @@
 PY_CPU := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 PY_MESH := $(PY_CPU) XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout test-pipeline test-flywheel lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step bench-pipeline bench-flywheel smoke-tpu dryrun native clean
+.PHONY: test test-fast soak soak-smoke test-chaos test-store-chaos test-ring test-elastic test-sched test-serve test-federation test-shm test-rollout test-pipeline test-flywheel lint perf-gate bench bench-store bench-trace bench-ckpt bench-fleet bench-serve bench-scale-out bench-federation bench-hotpath bench-rollout bench-step bench-pipeline bench-flywheel bench-obs smoke-tpu dryrun native clean
 
 # full matrix (everything but the real-chip tier) — the release gate.
 # perf-gate rides along (ISSUE 10, grown in 11/12): the full stage budget
@@ -86,12 +86,18 @@ lint:
 # seeded chaos-conductor soak (ISSUE 15). soak-smoke is the CI tier: a
 # fixed-seed ~60s store+train schedule whose invariant verdict gates
 # `make test`; `make soak` is the long operator run over every profile.
+# Every run arms the flight recorder (ISSUE 20): the seed-20 store line
+# is the black-box drill — kill-store-node SIGKILLs under an armed
+# spool, and check_blackbox hash-verifies every dead child's spool in
+# the post-teardown census (the rank-SIGKILL recovery drill is the
+# subprocess test in tests/test_obs.py).
 soak-smoke:
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 6 --profile train
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 3 --profile store
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 42 --duration 8 --profile pipeline
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 43 --duration 8 --profile pipeline
 	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 19 --duration 8 --profile flywheel
+	$(PY_CPU) KT_SOAK_OP_INTERVAL_S=0.1 python -m kubetorch_tpu.cli soak run --seed 20 --duration 5 --profile store
 
 soak:
 	$(PY_CPU) python -m kubetorch_tpu.cli soak run --seed 42 --duration 60 --profile all
@@ -179,6 +185,14 @@ bench-rollout:
 # for a >=64MB state (>=10x required) — bench-convention JSON
 bench-step:
 	python bench.py --step-overlap
+
+# fleet-aggregator demo (ISSUE 20): multi-replica pod /metrics scrapes
+# merged into the kt_fleet_* rollup — merged p50/p99 must match a
+# single-scrape reference within tolerance, and an injected delay breach
+# must trip the fast-window SLO burn alert within one scrape interval —
+# exit-coded acceptance
+bench-obs:
+	$(PY_CPU) python scripts/bench_serve.py --obs
 
 # flywheel closed-loop bench (ISSUE 19): open-loop serving traffic feeding
 # the REAL ledger -> harvester -> promoter stack on a subprocess store —

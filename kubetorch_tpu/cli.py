@@ -316,7 +316,19 @@ def trace_cmd(query, service, url, namespace, as_json):
         r = _requests.get(f"{url.rstrip('/')}/debug/traces",
                           params={"q": query}, timeout=10)
     except _requests.RequestException as e:
-        raise click.ClickException(f"cannot reach {url}: {e}")
+        # dead pod: its trace ring died with it, but the flight recorder's
+        # spool survives — point at the black box instead of shrugging
+        from .exceptions import PodUnreachableError
+        spool = kt_config().obs_spool
+        hint = (f"kt blackbox {spool}" if spool
+                else "set KT_OBS_SPOOL to arm the flight recorder for "
+                     "next time")
+        err = PodUnreachableError(
+            f"{type(e).__name__}: cannot reach {url} — the pod is dead, "
+            f"restarting, or partitioned; its in-memory trace ring is "
+            f"gone. Last recorded interval: {hint}",
+            url=url, spool_hint=spool or None)
+        raise click.ClickException(str(err))
     if r.status_code != 200:
         raise click.ClickException(
             f"/debug/traces → {r.status_code}: {r.text[:200]}")
@@ -1231,6 +1243,107 @@ def chaos_verbs(as_json):
                    f"{v['summary']}{methods}{flags}")
         click.echo(f"{'':<{w}}  grammar: {v['grammar']}   "
                    f"e.g. {v['example']}")
+
+
+@cli.group()
+def obs():
+    """Fleet flight recorder & SLO burn rollups (ISSUE 20)."""
+
+
+@obs.command("top")
+@click.option("--url", default=None,
+              help="Controller base URL (default: the configured / local "
+                   "controller).")
+@click.option("--json", "as_json", is_flag=True, help="Raw JSON.")
+def obs_top(url, as_json):
+    """Live fleet dashboard: merged per-stage latency histograms across
+    every pod, SLO error-budget burn rates (fast 5m / slow 1h windows),
+    and any standing burn alerts — rendered from the controller's
+    ``/fleet/status`` rollup."""
+    import requests as _requests
+
+    if url is None:
+        from .client import controller_client
+        url = controller_client().base_url
+    try:
+        # single-shot dashboard probe by design: a top that retried would
+        # smooth over exactly the instability it exists to surface
+        r = _requests.get(f"{url.rstrip('/')}/fleet/status", timeout=5)
+        r.raise_for_status()
+    except _requests.RequestException as e:
+        raise click.ClickException(f"cannot reach controller {url}: {e}")
+    snap = r.json()
+    if as_json:
+        click.echo(json.dumps(snap, indent=2, default=str))
+        return
+    slo = snap.get("slo") or {}
+    pods = snap.get("pods") or {}
+    up = sum(1 for s in pods.values() if s.get("up"))
+    click.echo(f"fleet: {up} pod(s) up, {len(pods) - up} down · "
+               f"SLO {slo.get('slo_s')}s @ {slo.get('target')} · "
+               f"burn pages at x{slo.get('burn_threshold')}")
+    stages = snap.get("stages") or {}
+    if not stages:
+        click.echo("no stage samples yet (is the scrape loop running "
+                   "against live pods?)")
+    else:
+        click.echo(f"{'stage':<22} {'count':>8} {'p50':>9} {'p99':>9} "
+                   f"{'bad%':>6} {'burn-5m':>8} {'burn-1h':>8}")
+        for stage, row in sorted(stages.items()):
+            burn = row.get("burn") or {}
+
+            def _fmt(x, spec=".3f"):
+                return "-" if x is None else format(x, spec)
+
+            click.echo(
+                f"{stage:<22} {int(row.get('count') or 0):>8} "
+                f"{_fmt(row.get('p50')):>9} {_fmt(row.get('p99')):>9} "
+                f"{_fmt(100.0 * (row.get('bad_frac') or 0.0), '.2f'):>6} "
+                f"{_fmt(burn.get('fast'), '.2f'):>8} "
+                f"{_fmt(burn.get('slow'), '.2f'):>8}")
+    alerts = snap.get("alerts") or []
+    if alerts:
+        click.echo(f"ALERTS ({len(alerts)}):")
+        for a in alerts:
+            click.echo(f"  ! {a.get('message', a)}")
+
+
+@cli.command("blackbox")
+@click.argument("spool")
+@click.option("--width", type=int, default=40,
+              help="Waterfall bar width in characters.")
+@click.option("--json", "as_json", is_flag=True, help="Raw JSON.")
+def blackbox_cmd(spool, width, as_json):
+    """Crash forensics: reconstruct a dead process's last telemetry
+    interval from its flight-recorder spool — final metric snapshot,
+    metric movement over the last record, and the in-flight span
+    waterfall at the moment of death. SPOOL is a spool root
+    (``KT_OBS_SPOOL``) or a single ``<name>-<pid>`` spool directory."""
+    from pathlib import Path as _Path
+
+    from .obs import format_blackbox, reconstruct, spool_dirs
+
+    root = _Path(spool)
+    dirs = spool_dirs(root)
+    if not dirs and list(root.glob("segment-*.jsonl")):
+        dirs = [root]
+    if not dirs:
+        raise click.ClickException(
+            f"no flight-recorder spools under {spool!r} (expected "
+            f"<name>-<pid>/segment-*.jsonl; is KT_OBS_SPOOL armed?)")
+    recons = [reconstruct(d) for d in dirs]
+    if as_json:
+        click.echo(json.dumps(recons, indent=2, default=str))
+        return
+    bad = 0
+    for i, recon in enumerate(recons):
+        if i:
+            click.echo("")
+        click.echo(format_blackbox(recon, width=width))
+        bad += 1 if recon.get("errors") else 0
+    if bad:
+        raise click.ClickException(
+            f"{bad} spool(s) failed hash-chain/sequence verification")
 
 
 @cli.group()

@@ -190,6 +190,32 @@ class KTConfig:
     flywheel_sample_rate: float = 1.0
     flywheel_eval_gate: float = 0.02
     harvest_headroom: float = 0.25
+    # fleet flight recorder + SLO rollup (kubetorch_tpu/obs/, ISSUE 20).
+    # Same env layering (KT_OBS_SPOOL / KT_OBS_INTERVAL_S /
+    # KT_OBS_SPOOL_MAX_BYTES / KT_OBS_SPOOL_MAX_AGE_S /
+    # KT_OBS_SCRAPE_INTERVAL_S / KT_OBS_SLO_FAST_S / KT_OBS_SLO_SLOW_S /
+    # KT_OBS_SLO_TARGET / KT_OBS_BURN_THRESHOLD). obs_spool="" (the
+    # default) leaves the flight recorder off; pointing it at a directory
+    # arms the per-process background recorder (each process spools under
+    # <obs_spool>/<name>-<pid>/). obs_interval_s paces snapshot appends;
+    # the two spool caps bound the on-disk history (size-capped rotation +
+    # age-capped segment expiry). obs_scrape_interval_s paces the
+    # controller-side fleet aggregator; the SLO windows/target/threshold
+    # drive the multi-window burn-rate alerts (fast/slow windows in
+    # seconds, target as an availability fraction, threshold as the
+    # burn-rate multiple that emits an SloBurnAlert on the fast window).
+    # obs_slo_s (KT_OBS_SLO_S) is the latency SLO itself: a stage
+    # observation slower than this burns error budget.
+    obs_spool: str = ""
+    obs_interval_s: float = 1.0
+    obs_spool_max_bytes: int = 8 * 1024 * 1024
+    obs_spool_max_age_s: float = 3600.0
+    obs_scrape_interval_s: float = 3.0
+    obs_slo_s: float = 1.0
+    obs_slo_fast_s: float = 300.0
+    obs_slo_slow_s: float = 3600.0
+    obs_slo_target: float = 0.99
+    obs_burn_threshold: float = 14.4
     local_mode: bool = False                 # run pods as local subprocesses (no k8s)
     tpu_default_runtime: str = "jax"
     config_dir: str = field(default_factory=lambda: os.path.expanduser("~/.kt"))

@@ -52,6 +52,7 @@ class ControllerState:
         self._apply_locks: Dict[str, asyncio.Lock] = {}
         self.scheduler = None
         self.persister = None
+        self.fleet = None            # FleetAggregator (ISSUE 20), lazy
         if state_dir:
             from .persistence import DiskPersister
             self.persister = DiskPersister(state_dir)
@@ -67,6 +68,16 @@ class ControllerState:
             if self.persister is not None:
                 self.scheduler.restore(self.persister.load_scheduler_state())
         return self.scheduler
+
+    def fleet_agg(self):
+        """The fleet aggregator (ISSUE 20): merges per-pod histograms into
+        ``kt_fleet_*`` rollups and computes SLO burn rates. Lazy for the
+        same reason as :meth:`sched` — plain ControllerState tests never
+        pay for it."""
+        if self.fleet is None:
+            from ..obs import FleetAggregator
+            self.fleet = FleetAggregator.from_config()
+        return self.fleet
 
     def apply_lock(self, service_key: str) -> asyncio.Lock:
         """Per-service lock serializing backend applies — a held cold-start
@@ -589,8 +600,33 @@ async def controller_metrics(request: web.Request) -> web.Response:
     — the pod/store servers already expose /metrics; the scheduler made
     the control plane worth scraping too."""
     from .. import telemetry
-    return web.Response(text=telemetry.REGISTRY.render(),
-                        content_type="text/plain")
+    state: ControllerState = request.app["cstate"]
+    text = telemetry.REGISTRY.render()
+    if state.fleet is not None:
+        # fleet rollups (ISSUE 20) ride the same endpoint, rendered from
+        # the aggregator's private registry — NOT the global one, or a
+        # self-scrape would double-count the merged series
+        text += state.fleet.render()
+    return web.Response(text=text, content_type="text/plain")
+
+
+async def fleet_status(request: web.Request) -> web.Response:
+    """``/fleet/status`` — the fleet aggregator's merged view: per-stage
+    p50/p99, multi-window burn rates, pod health, recent alerts. What
+    ``kt obs top`` renders."""
+    state: ControllerState = request.app["cstate"]
+    return web.json_response(state.fleet_agg().status())
+
+
+async def fleet_alerts(request: web.Request) -> web.Response:
+    """``/fleet/alerts`` — recent :class:`SloBurnAlert` records, packaged
+    with :func:`package_exception` so consumers rehydrate the same typed
+    exception the aggregator raised."""
+    state: ControllerState = request.app["cstate"]
+    agg = state.fleet_agg()
+    return web.json_response(
+        {"alerts": [package_exception(a) for a in agg.alerts],
+         "count": len(agg.alerts)})
 
 
 async def controller_traces(request: web.Request) -> web.Response:
@@ -1248,6 +1284,51 @@ async def _autoscale_loop(state: ControllerState) -> None:
                 state.record_event(key, "autoscale pass failed; will retry")
 
 
+async def _fleet_scrape_loop(state: ControllerState) -> None:
+    """Fleet aggregator pump (ISSUE 20): every ``obs_scrape_interval_s``
+    scrape every known pod's ``/metrics``, fold the texts into the
+    aggregator (unreachable pods ingest as down — their corrected history
+    survives), and close the round so burn rates and alerts update within
+    one scrape interval of a breach."""
+    if state.backend is None:
+        return
+    import aiohttp
+
+    from ..config import config as _cfg
+
+    interval = max(0.25, float(_cfg().obs_scrape_interval_s))
+    port = getattr(state.backend, "server_port", DEFAULT_SERVER_PORT)
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            agg = state.fleet_agg()
+            targets: Dict[str, str] = {}
+            for key, record in list(state.workloads.items()):
+                try:
+                    ips = state.backend.pod_ips(
+                        record["namespace"], record["name"])
+                except Exception:  # noqa: BLE001 — backend mid-reconcile
+                    continue
+                for ip in ips:
+                    targets[f"{key}@{ip}"] = f"http://{ip}:{port}/metrics"
+            async with aiohttp.ClientSession() as sess:
+                for pod, url in targets.items():
+                    text = None
+                    try:
+                        async with sess.get(
+                                url,
+                                timeout=aiohttp.ClientTimeout(total=3)) as r:
+                            text = await r.text()
+                    except Exception:  # noqa: BLE001 — down pod: ingest None
+                        text = None
+                    agg.ingest(pod, text)
+            agg.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the rollup must never die
+            pass
+
+
 # -- K8s event watcher (reference: chart eventWatcher + live launch events,
 #    http_client.py:576) --------------------------------------------------------
 
@@ -1490,6 +1571,8 @@ def create_controller_app(state: Optional[ControllerState] = None) -> web.Applic
     r.add_get("/controller/cluster-config", cluster_config)
     r.add_get("/controller/queue", queue_status)
     r.add_get("/metrics", controller_metrics)
+    r.add_get("/fleet/status", fleet_status)
+    r.add_get("/fleet/alerts", fleet_alerts)
     r.add_get("/debug/traces", controller_traces)
     r.add_get("/controller/version", version)
     r.add_post("/controller/logs", ingest_logs)
@@ -1512,6 +1595,7 @@ async def _startup(app: web.Application) -> None:
     state._ttl_task = asyncio.create_task(_ttl_loop(state))
     state._autoscale_task = asyncio.create_task(_autoscale_loop(state))
     state._k8s_events_task = asyncio.create_task(_k8s_events_loop(state))
+    state._fleet_task = asyncio.create_task(_fleet_scrape_loop(state))
 
 
 async def _cleanup(app: web.Application) -> None:
@@ -1525,6 +1609,8 @@ async def _cleanup(app: web.Application) -> None:
         state._autoscale_task.cancel()
     if getattr(state, "_k8s_events_task", None):
         state._k8s_events_task.cancel()
+    if getattr(state, "_fleet_task", None):
+        state._fleet_task.cancel()
     if state.backend is not None:
         await asyncio.to_thread(state.backend.shutdown)
     if state.persister is not None:

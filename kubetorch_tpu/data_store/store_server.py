@@ -1464,6 +1464,10 @@ def main(argv=None) -> None:
         os.environ["KT_STORE_NODES"] = args.nodes
     if args.self_url is not None:
         os.environ["KT_STORE_SELF_URL"] = args.self_url
+    # flight recorder (ISSUE 20): armed only when KT_OBS_SPOOL is set —
+    # a chaos kill-store-node then leaves a readable black box
+    from ..obs import maybe_start_recorder
+    maybe_start_recorder("store")
     web.run_app(create_store_app(args.root), host=args.host, port=args.port,
                 print=lambda *_: None)
 

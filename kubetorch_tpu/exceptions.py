@@ -289,6 +289,53 @@ class StaleStageEpochError(KubetorchError):
         self.current_epoch = current_epoch
 
 
+class SloBurnAlert(KubetorchError):
+    """A fleet stage is burning its SLO error budget too fast (ISSUE 20).
+
+    Emitted by the fleet aggregator (``obs/fleet.py``) — the only
+    burn-rate computation site — when a stage's multi-window burn rate
+    crosses the alert threshold: ``burn_rate`` is the rate at which the
+    error budget is being spent (1.0 = exactly sustainable; 14.4 on the
+    fast window is the classic page-now rate), ``window`` names which
+    window tripped (``fast``/``slow``), ``slo_s`` the latency threshold
+    that defines a "bad" request and ``target`` the availability
+    objective. Registered + rehydratable so ``/fleet/alerts`` consumers
+    get the same type the controller raised, not a dict."""
+
+    def __init__(self, message: str = "SLO burn-rate alert",
+                 stage: Optional[str] = None, window: Optional[str] = None,
+                 burn_rate: Optional[float] = None,
+                 threshold: Optional[float] = None,
+                 slo_s: Optional[float] = None,
+                 target: Optional[float] = None,
+                 at: Optional[float] = None):
+        super().__init__(message)
+        self.stage = stage
+        self.window = window
+        self.burn_rate = burn_rate
+        self.threshold = threshold
+        self.slo_s = slo_s
+        self.target = target
+        self.at = at
+
+
+class PodUnreachableError(KubetorchError):
+    """A pod that should be serving did not answer (ISSUE 20 satellite).
+
+    Raised by surfaces that query a live pod (``kt trace``) when the
+    connection itself fails — the pod is dead, restarting, or partitioned.
+    Carries the black-box spool hint: a dead pod's last telemetry interval
+    survives in its flight-recorder spool (``KT_OBS_SPOOL``), so the
+    actionable next step is ``kt blackbox <spool_dir>``, not a retry."""
+
+    def __init__(self, message: str = "pod is unreachable",
+                 url: Optional[str] = None,
+                 spool_hint: Optional[str] = None):
+        super().__init__(message)
+        self.url = url
+        self.spool_hint = spool_hint
+
+
 class DebuggerError(KubetorchError):
     """Remote debugger attach/session failure."""
 
@@ -520,6 +567,8 @@ EXCEPTION_REGISTRY: Dict[str, type] = {
         RolloutError,
         StaleLeaseError,
         StaleStageEpochError,
+        SloBurnAlert,
+        PodUnreachableError,
         DebuggerError,
         DeadlineExceededError,
         CircuitOpenError,
@@ -544,6 +593,9 @@ _STRUCTURED_ATTRS: Dict[str, List[str]] = {
     "StaleLeaseError": ["workload", "region", "epoch", "current_epoch",
                         "current_region"],
     "StaleStageEpochError": ["job", "stage", "epoch", "current_epoch"],
+    "SloBurnAlert": ["stage", "window", "burn_rate", "threshold", "slo_s",
+                     "target", "at"],
+    "PodUnreachableError": ["url", "spool_hint"],
     "DeadlineExceededError": ["deadline"],
     "CircuitOpenError": ["retry_after"],
     "AdmissionShedError": ["reason", "tier", "queue_depth", "retry_after"],

@@ -1,0 +1,272 @@
+"""The read side of the flight recorder: verify a spool, reconstruct the
+dead process's last interval, render the ``kt blackbox`` report.
+
+Verification is two-layer: each segment's per-record hash chain (blake2b
+over previous hash + canonical JSON, restarting at the segment boundary)
+proves no record was altered or truncated, and the spool-wide ``seq``
+continuity proves no retained record is missing — rotation only ever
+deletes whole segments from the OLD end, so surviving records must be
+strictly consecutive.
+
+Reconstruction folds the delta-encoded metric payloads forward
+(:func:`recorder.apply_delta`) into the process's final snapshot, keeps
+the snapshot one record earlier for the metric diff, and pulls the final
+record's in-flight spans — the work the process was doing when it died —
+for the waterfall.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from .recorder import SEGMENT_GLOB, apply_delta, chain_hash
+
+# how many completed spans reconstruction keeps (newest win): enough for
+# any one trace's waterfall without holding a long run's whole history
+_SPAN_KEEP = 512
+
+
+def spool_dirs(root: str) -> List[Path]:
+    """Per-process spool directories under a spool root, sorted by name."""
+    base = Path(root)
+    if not base.is_dir():
+        return []
+    return sorted(p for p in base.iterdir()
+                  if p.is_dir() and list(p.glob(SEGMENT_GLOB)))
+
+
+def spool_identity(spool_dir) -> Tuple[str, Optional[int]]:
+    """``(process name, pid)`` parsed from a spool directory's
+    ``<name>-<pid>`` naming; pid None when the suffix isn't numeric."""
+    stem = Path(spool_dir).name
+    name, _, pid = stem.rpartition("-")
+    try:
+        return (name or stem), int(pid)
+    except ValueError:
+        return stem, None
+
+
+def pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe (EPERM counts as alive)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def read_spool(spool_dir) -> Dict[str, Any]:
+    """Parse and verify every committed segment of one spool. Returns
+    ``{"dir", "records", "errors", "segments", "torn_tail"}`` —
+    ``errors`` holds one human line per broken chain link, truncated
+    record, or seq gap, and is EMPTY for a hash-clean spool (what the
+    soak invariant asserts). The writer appends one kernel-buffered
+    line per record, so a SIGKILL can tear exactly one place: the final
+    line of the final segment. That tear is the expected crash artifact
+    — reported as ``torn_tail``, not an error; every earlier record was
+    committed whole."""
+    spool_dir = Path(spool_dir)
+    records: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    torn_tail = False
+    segments = sorted(spool_dir.glob(SEGMENT_GLOB))
+    if not segments:
+        errors.append(f"{spool_dir}: no committed segments")
+    prev_seq: Optional[int] = None
+    for seg_i, seg in enumerate(segments):
+        prev_hash = ""
+        try:
+            lines = seg.read_text("utf-8").splitlines()
+        except OSError as exc:
+            errors.append(f"{seg.name}: unreadable ({exc})")
+            continue
+        for lineno, line in enumerate(lines, start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if seg_i == len(segments) - 1 and lineno == len(lines):
+                    torn_tail = True
+                else:
+                    errors.append(f"{seg.name}:{lineno}: truncated or "
+                                  f"corrupt record")
+                break
+            if rec.get("h") != chain_hash(prev_hash, rec):
+                errors.append(f"{seg.name}:{lineno}: hash chain broken")
+                break
+            prev_hash = rec["h"]
+            seq = rec.get("seq")
+            if prev_seq is not None and seq != prev_seq + 1:
+                errors.append(f"{seg.name}:{lineno}: seq {seq} follows "
+                              f"{prev_seq} (records missing)")
+            if isinstance(seq, int):
+                prev_seq = seq
+            records.append(rec)
+    return {"dir": str(spool_dir), "records": records, "errors": errors,
+            "segments": len(segments), "torn_tail": torn_tail}
+
+
+def verify_spool(spool_dir) -> List[str]:
+    """Just the error lines — the soak invariant's yes/no input."""
+    return read_spool(spool_dir)["errors"]
+
+
+def reconstruct(spool_dir) -> Dict[str, Any]:
+    """Fold a spool into the dead process's story: its final metric
+    snapshot, the snapshot one record earlier (for the last-interval
+    diff), its in-flight spans at the last record, and the most recent
+    completed spans."""
+    data = read_spool(spool_dir)
+    records = data["records"]
+    running: Dict[str, Dict] = {}
+    previous: Dict[str, Dict] = {}
+    span_by_id: Dict[Tuple[str, str], Dict] = {}
+    for i, rec in enumerate(records):
+        if i == len(records) - 1:
+            previous = json.loads(json.dumps(running))
+        running = apply_delta(running, rec.get("metrics", {}),
+                              full=bool(rec.get("full")))
+        for span_dict in rec.get("spans", []):
+            key = (span_dict.get("trace_id", ""),
+                   span_dict.get("span_id", ""))
+            span_by_id[key] = span_dict
+        while len(span_by_id) > _SPAN_KEEP:
+            span_by_id.pop(next(iter(span_by_id)))
+    last = records[-1] if records else {}
+    name, pid = spool_identity(spool_dir)
+    return {
+        "dir": data["dir"],
+        "name": name,
+        "pid": pid,
+        "errors": data["errors"],
+        "segments": data["segments"],
+        "torn_tail": data["torn_tail"],
+        "records": len(records),
+        "first_ts": records[0].get("ts") if records else None,
+        "last_ts": last.get("ts"),
+        "last_kind": last.get("kind"),
+        "note": last.get("note"),
+        "metrics": running,
+        "metrics_prev": previous,
+        "spans": list(span_by_id.values()),
+        "inflight": last.get("inflight", []),
+    }
+
+
+def _flatten(snapshot: Dict[str, Dict]) -> Dict[str, Any]:
+    """One scalar per exposed series line: counters/gauges as-is,
+    histograms as their ``_count``/``_sum``."""
+    flat: Dict[str, Any] = {}
+    for series, entry in snapshot.items():
+        labelnames = entry.get("labels", [])
+        for lkey, lval in entry.get("values", {}).items():
+            labelvalues = lkey.split("\x1f") if lkey else []
+            suffix = ""
+            if labelnames and labelvalues:
+                pairs = ",".join(f'{ln}="{lv}"' for ln, lv
+                                 in zip(labelnames, labelvalues))
+                suffix = "{" + pairs + "}"
+            if isinstance(lval, dict):
+                flat[f"{series}_count{suffix}"] = lval.get("count", 0)
+                flat[f"{series}_sum{suffix}"] = round(lval.get("sum", 0.0), 6)
+            else:
+                flat[f"{series}{suffix}"] = lval
+    return flat
+
+
+def metric_diff(prev: Dict[str, Dict], cur: Dict[str, Dict]) -> List[str]:
+    """Human lines for every series whose value changed between two
+    snapshots — the black box's 'what moved in the last interval'."""
+    before, after = _flatten(prev), _flatten(cur)
+    out = []
+    for series_line in sorted(set(before) | set(after)):
+        old = before.get(series_line, 0)
+        new = after.get(series_line, 0)
+        if old == new:
+            continue
+        try:
+            step = round(new - old, 6)
+            arrow = f"{old} -> {new}  ({'+' if step >= 0 else ''}{step})"
+        except TypeError:
+            arrow = f"{old} -> {new}"
+        out.append(f"{series_line}  {arrow}")
+    return out
+
+
+def _death_waterfall(recon: Dict[str, Any], width: int) -> str:
+    """Waterfall of the dead process's last trace: the in-flight spans
+    (extended to the moment of the final record and marked) plus the
+    completed spans of the same trace(s); falls back to the newest
+    completed trace when nothing was in flight."""
+    last_ts = recon.get("last_ts") or 0.0
+    picked: List[Dict] = []
+    for span_dict in recon.get("inflight", []):
+        open_span = dict(span_dict)
+        start = open_span.get("start", last_ts)
+        if open_span.get("end") is None:
+            open_span["end"] = max(last_ts, start)
+        open_span["attrs"] = dict(open_span.get("attrs", {}), inflight=True)
+        picked.append(open_span)
+    traces = {s.get("trace_id") for s in picked}
+    completed = recon.get("spans", [])
+    if traces:
+        picked += [s for s in completed if s.get("trace_id") in traces]
+    elif completed:
+        newest = max(completed, key=lambda s: s.get("start", 0.0))
+        picked = [s for s in completed
+                  if s.get("trace_id") == newest.get("trace_id")]
+    if not picked:
+        return "(no spans recorded)"
+    return telemetry.format_waterfall(picked, width=width)
+
+
+def format_blackbox(recon: Dict[str, Any], width: int = 40) -> str:
+    """The ``kt blackbox`` report for one reconstructed spool."""
+    pid = recon.get("pid")
+    state = "unknown"
+    if pid is not None:
+        state = "STILL RUNNING" if pid_alive(pid) else "dead"
+    lines = [f"black box: {recon['dir']}",
+             f"process: {recon['name']} (pid {pid}, {state})"]
+    for err in recon["errors"]:
+        lines.append(f"  ! {err}")
+    last_ts = recon.get("last_ts")
+    when = (time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(last_ts))
+            if last_ts else "never")
+    lines.append(f"records: {recon['records']} across "
+                 f"{recon['segments']} segment(s); last record "
+                 f"kind={recon.get('last_kind')} at {when}")
+    if recon.get("torn_tail"):
+        lines.append("  (final line torn mid-append — the process died "
+                     "writing it; every shown record committed whole)")
+    note = recon.get("note")
+    if note:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(note.items()))
+        lines.append(f"final note: {detail}")
+    first_ts = recon.get("first_ts")
+    if first_ts and last_ts:
+        lines.append(f"history covers {last_ts - first_ts:.1f}s")
+    lines.append("")
+    lines.append(f"in-flight at last record "
+                 f"({len(recon.get('inflight', []))} span(s)):")
+    lines.append(_death_waterfall(recon, width))
+    lines.append("")
+    diff = metric_diff(recon.get("metrics_prev", {}),
+                       recon.get("metrics", {}))
+    lines.append(f"metric movement over the final interval "
+                 f"({len(diff)} series):")
+    if diff:
+        lines.extend(f"  {d}" for d in diff)
+    else:
+        lines.append("  (no movement)")
+    return "\n".join(lines)

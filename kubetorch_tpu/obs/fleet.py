@@ -1,0 +1,352 @@
+"""The fleet aggregator: merge per-pod telemetry into ``kt_fleet_*``
+rollups and compute multi-window SLO burn rates.
+
+Transport-free on purpose: callers (the controller's scrape loop, the
+``--obs`` bench, tests) fetch ``/metrics`` text however they like and
+feed it to :meth:`FleetAggregator.ingest`; :meth:`FleetAggregator.tick`
+closes a scrape round. That keeps the merge math — the part with real
+failure modes — importable and testable without an event loop.
+
+Failure modes handled here:
+
+- **mismatched bucket sets** — pods on different builds expose different
+  edges; :func:`merge_histograms` merges onto the UNION of edges, reading
+  each pod's cumulative count at the largest of its own edges ≤ the union
+  edge (cumulative histograms are step functions; flooring is the
+  conservative reading) and taking ``+Inf`` as the pod's total;
+- **counter resets** — a scraped cumulative value that went DOWN means
+  the pod restarted: :class:`CounterEpochs` opens a new epoch and counts
+  the fresh value as the delta, never producing a negative;
+- **dead pods** — an unreachable pod contributes its last corrected
+  totals (history survives) and is reported ``down``.
+
+Burn rates follow the SRE multi-window recipe: over each window, the
+fraction of stage observations slower than the latency SLO, divided by
+the error budget ``1 - target``. 1.0 burns the budget exactly at the
+sustainable rate; the classic fast-window page threshold is 14.4.
+Crossing the threshold emits a typed, rehydratable
+:class:`~kubetorch_tpu.exceptions.SloBurnAlert`.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..exceptions import SloBurnAlert, package_exception
+
+_STAGE_LABEL_RE = re.compile(r'kt_stage_seconds_bucket\{[^}]*stage="([^"]+)"')
+_BUILD_INFO_RE = re.compile(r'^kt_build_info\{([^}]*)\}', re.MULTILINE)
+_LABEL_PAIR_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+_SERIES_SEP = "\x1f"
+
+
+def _edge(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def merge_histograms(
+        per_pod: Dict[str, Dict[str, float]]) -> Dict[str, float]:
+    """Merge per-pod cumulative bucket maps (``le string → count``, the
+    ``_parse_histogram_buckets`` shape) onto the union of bucket edges.
+    See the module docstring for the mismatched-edge semantics."""
+    edge_str: Dict[float, str] = {}
+    for buckets in per_pod.values():
+        for le in buckets:
+            edge_str.setdefault(_edge(le), le)
+    merged: Dict[str, float] = {}
+    for union_edge in sorted(edge_str):
+        total = 0.0
+        for buckets in per_pod.values():
+            floor: Optional[Tuple[float, float]] = None
+            for le, count in buckets.items():
+                fe = _edge(le)
+                if fe <= union_edge and (floor is None or fe > floor[0]):
+                    floor = (fe, count)
+            if floor is not None:
+                total += floor[1]
+        merged[edge_str[union_edge]] = total
+    return merged
+
+
+class CounterEpochs:
+    """Reset-aware accumulator for one pod's cumulative series.
+
+    ``update(key, raw)`` folds a freshly-scraped cumulative bucket map
+    into a corrected running total: normally the per-edge delta since the
+    last scrape (clamped at 0 so a bucket-set change can't go negative),
+    but when the series' total (``+Inf``) DECREASED the pod restarted —
+    a new epoch begins and the raw values themselves are the delta.
+    ``resets`` counts epochs opened."""
+
+    def __init__(self) -> None:
+        self._last: Dict[str, Dict[str, float]] = {}
+        self._corrected: Dict[str, Dict[str, float]] = {}
+        self.resets = 0
+
+    @staticmethod
+    def _total(buckets: Dict[str, float]) -> float:
+        return buckets.get("+Inf", max(buckets.values(), default=0.0))
+
+    def update(self, key: str, raw: Dict[str, float]) -> Dict[str, float]:
+        last = self._last.get(key)
+        corrected = self._corrected.setdefault(key, {})
+        reset = last is not None and self._total(raw) < self._total(last)
+        if reset:
+            self.resets += 1
+        for le, count in raw.items():
+            if last is None or reset:
+                delta = count
+            else:
+                delta = max(0.0, count - last.get(le, 0.0))
+            corrected[le] = corrected.get(le, 0.0) + delta
+        self._last[key] = dict(raw)
+        return dict(corrected)
+
+    def corrected(self, key: str) -> Dict[str, float]:
+        return dict(self._corrected.get(key, {}))
+
+    def keys(self) -> List[str]:
+        return list(self._corrected)
+
+
+class FleetAggregator:
+    """Controller-side rollup of per-pod ``/metrics`` scrapes.
+
+    One :meth:`ingest` per pod per round, one :meth:`tick` to close the
+    round (returns the :class:`SloBurnAlert` records it raised). The
+    merged rollups render from a PRIVATE registry (:meth:`render`) —
+    re-aggregated scrapes observed into the global registry would
+    double-count the moment the controller scrapes itself.
+    """
+
+    def __init__(self, slo_s: float = 1.0, target: float = 0.99,
+                 burn_threshold: float = 14.4,
+                 fast_window_s: float = 300.0,
+                 slow_window_s: float = 3600.0,
+                 max_alerts: int = 64):
+        self.slo_s = float(slo_s)
+        self.target = min(float(target), 1.0 - 1e-9)
+        self.burn_threshold = float(burn_threshold)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = max(float(slow_window_s), self.fast_window_s)
+        self._epochs: Dict[str, CounterEpochs] = {}
+        self._pods: Dict[str, Dict[str, Any]] = {}
+        self._window: Deque[
+            Tuple[float, Dict[str, Tuple[float, float]]]] = deque()
+        self.alerts: Deque[SloBurnAlert] = deque(maxlen=max_alerts)
+        self._last_alert: Dict[Tuple[str, str], float] = {}
+        self._rollup = telemetry.MetricsRegistry()
+
+    @classmethod
+    def from_config(cls) -> "FleetAggregator":
+        from ..config import config
+        cfg = config()
+        return cls(slo_s=cfg.obs_slo_s, target=cfg.obs_slo_target,
+                   burn_threshold=cfg.obs_burn_threshold,
+                   fast_window_s=cfg.obs_slo_fast_s,
+                   slow_window_s=cfg.obs_slo_slow_s)
+
+    # -- scrape round --------------------------------------------------
+
+    def ingest(self, pod: str, text: Optional[str],
+               now: Optional[float] = None) -> None:
+        """Fold one pod's ``/metrics`` exposition text into the fleet
+        state; ``text=None`` marks the pod unreachable this round."""
+        family = telemetry.fleet_metrics()
+        now = time.time() if now is None else now
+        state = self._pods.setdefault(
+            pod, {"up": False, "last_ts": 0.0, "build": {}})
+        if not text:
+            state["up"] = False
+            family["scrapes"].inc(outcome="error")
+            return
+        from ..controller.app import _parse_histogram_buckets
+        epochs = self._epochs.setdefault(pod, CounterEpochs())
+        resets_before = epochs.resets
+        for stage in sorted(set(_STAGE_LABEL_RE.findall(text))):
+            raw = _parse_histogram_buckets(
+                text, "kt_stage_seconds", f'stage="{stage}"')
+            if raw:
+                epochs.update(f"stage{_SERIES_SEP}{stage}", raw)
+        if epochs.resets > resets_before:
+            family["resets"].inc(epochs.resets - resets_before)
+        build = _BUILD_INFO_RE.search(text)
+        if build:
+            state["build"] = dict(_LABEL_PAIR_RE.findall(build.group(1)))
+        state["up"] = True
+        state["last_ts"] = now
+        family["scrapes"].inc(outcome="ok")
+
+    def tick(self, now: Optional[float] = None) -> List[SloBurnAlert]:
+        """Close a scrape round: sample the merged good/total counts into
+        the burn windows, publish gauges + rollups, and return any alerts
+        this round raised."""
+        now = time.time() if now is None else now
+        family = telemetry.fleet_metrics()
+        up = sum(1 for s in self._pods.values() if s["up"])
+        family["pods"].set(up, state="up")
+        family["pods"].set(len(self._pods) - up, state="down")
+
+        merged = self.merged_stages()
+        sample: Dict[str, Tuple[float, float]] = {}
+        for stage, buckets in merged.items():
+            total = buckets.get("+Inf", max(buckets.values(), default=0.0))
+            sample[stage] = (self._good_count(buckets, total), total)
+        self._window.append((now, sample))
+        horizon = now - self.slow_window_s - 1.0
+        while len(self._window) > 1 and self._window[0][0] < horizon:
+            self._window.popleft()
+
+        raised: List[SloBurnAlert] = []
+        for stage in sorted(sample):
+            for window, length in (("fast", self.fast_window_s),
+                                   ("slow", self.slow_window_s)):
+                burn = self._burn(stage, length, now)
+                family["slo_burn"].set(burn, stage=stage, window=window)
+                if burn <= self.burn_threshold:
+                    continue
+                last = self._last_alert.get((stage, window), float("-inf"))
+                if now - last < length:
+                    continue     # one page per ongoing breach per window
+                alert = SloBurnAlert(
+                    f"stage {stage!r} burns error budget at {burn:.1f}x "
+                    f"the sustainable rate over the {window} window "
+                    f"(threshold {self.burn_threshold:g}x, SLO "
+                    f"{self.slo_s:g}s at {self.target:.3%})",
+                    stage=stage, window=window, burn_rate=round(burn, 3),
+                    threshold=self.burn_threshold, slo_s=self.slo_s,
+                    target=self.target, at=now)
+                self.alerts.append(alert)
+                raised.append(alert)
+                self._last_alert[(stage, window)] = now
+                family["alerts"].inc(stage=stage, window=window)
+        self._update_rollup(merged)
+        return raised
+
+    # -- merge + burn math ---------------------------------------------
+
+    def merged_stages(self) -> Dict[str, Dict[str, float]]:
+        """Fleet-merged corrected cumulative buckets per stage."""
+        per_stage: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for pod, epochs in self._epochs.items():
+            for key in epochs.keys():
+                kind, _, stage = key.partition(_SERIES_SEP)
+                if kind == "stage":
+                    per_stage.setdefault(stage, {})[pod] = \
+                        epochs.corrected(key)
+        return {stage: merge_histograms(pods)
+                for stage, pods in per_stage.items()}
+
+    def _good_count(self, buckets: Dict[str, float], total: float) -> float:
+        """Observations within the latency SLO: the cumulative count at
+        the smallest edge ≥ ``slo_s``. With no finite edge that high the
+        histogram can't distinguish — read as all-good rather than
+        inventing badness the data can't support."""
+        candidates = [(_edge(le), count) for le, count in buckets.items()
+                      if _edge(le) != float("inf")
+                      and _edge(le) >= self.slo_s]
+        if not candidates:
+            return total
+        return min(candidates)[1]
+
+    def _burn(self, stage: str, window_s: float, now: float) -> float:
+        """Burn rate over one window: the bad fraction of observations in
+        the window divided by the error budget. The baseline is the
+        newest sample at or before the window start; with history shorter
+        than the window the oldest sample stands in (the burn since
+        scraping began)."""
+        if not self._window:
+            return 0.0
+        current = self._window[-1][1].get(stage, (0.0, 0.0))
+        baseline: Optional[Tuple[float, float]] = None
+        for ts, sample in self._window:
+            if ts <= now - window_s:
+                baseline = sample.get(stage, (0.0, 0.0))
+            else:
+                break
+        if baseline is None:
+            baseline = self._window[0][1].get(stage, (0.0, 0.0))
+        d_total = current[1] - baseline[1]
+        if d_total <= 0:
+            return 0.0
+        d_bad = (current[1] - current[0]) - (baseline[1] - baseline[0])
+        bad_frac = min(max(d_bad / d_total, 0.0), 1.0)
+        return bad_frac / (1.0 - self.target)
+
+    def quantile(self, stage: str, q: float) -> Optional[float]:
+        """Merged fleet quantile for one stage (None without data)."""
+        from ..controller.app import _quantile_from_buckets
+        buckets = self.merged_stages().get(stage)
+        if not buckets:
+            return None
+        return _quantile_from_buckets(buckets, q)
+
+    # -- surfaces ------------------------------------------------------
+
+    def _update_rollup(self, merged: Dict[str, Dict[str, float]]) -> None:
+        bucket_gauge = self._rollup.gauge(
+            "kt_fleet_stage_seconds_bucket",
+            "Fleet-merged cumulative kt_stage_seconds buckets "
+            "(counter-reset corrected; gauge because it is a "
+            "re-aggregated scrape, not a process-local histogram)",
+            labels=("stage", "le"))
+        count_gauge = self._rollup.gauge(
+            "kt_fleet_stage_seconds_count",
+            "Fleet-merged kt_stage_seconds observation totals",
+            labels=("stage",))
+        quantile_gauge = self._rollup.gauge(
+            "kt_fleet_stage_quantile_seconds",
+            "Fleet-merged per-stage latency quantiles",
+            labels=("stage", "q"))
+        from ..controller.app import _quantile_from_buckets
+        for stage, buckets in merged.items():
+            for le, count in buckets.items():
+                bucket_gauge.set(count, stage=stage, le=le)
+            count_gauge.set(
+                buckets.get("+Inf", max(buckets.values(), default=0.0)),
+                stage=stage)
+            for q in (0.5, 0.99):
+                value = _quantile_from_buckets(buckets, q)
+                if value is not None:
+                    quantile_gauge.set(value, stage=stage, q=q)
+
+    def render(self) -> str:
+        """Exposition text of the merged rollups — appended to the
+        controller's ``/metrics`` after the global registry."""
+        return self._rollup.render()
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/fleet/status`` body ``kt obs top`` renders."""
+        stages: Dict[str, Any] = {}
+        merged = self.merged_stages()
+        latest = self._window[-1][1] if self._window else {}
+        # anchor at the last sample's clock, not wall time — ticks may run
+        # on an injected timeline (tests, replayed scrapes)
+        burn_now = self._window[-1][0] if self._window else time.time()
+        for stage, buckets in sorted(merged.items()):
+            good, total = latest.get(stage, (0.0, 0.0))
+            stages[stage] = {
+                "count": total,
+                "p50": self.quantile(stage, 0.5),
+                "p99": self.quantile(stage, 0.99),
+                "bad_frac": ((total - good) / total) if total else 0.0,
+                "burn": {
+                    "fast": self._burn(stage, self.fast_window_s, burn_now),
+                    "slow": self._burn(stage, self.slow_window_s, burn_now),
+                },
+            }
+        return {
+            "slo": {"slo_s": self.slo_s, "target": self.target,
+                    "burn_threshold": self.burn_threshold,
+                    "fast_window_s": self.fast_window_s,
+                    "slow_window_s": self.slow_window_s},
+            "pods": {pod: dict(state)
+                     for pod, state in sorted(self._pods.items())},
+            "stages": stages,
+            "alerts": [package_exception(a) for a in self.alerts],
+        }

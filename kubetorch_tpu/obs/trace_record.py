@@ -1,0 +1,159 @@
+"""Trace recording for the policy lab (ROADMAP item 4).
+
+The scheduling-policy simulator wants production-shaped workloads; this
+module is the seam that captures them. Same house style as
+``soak/schedule.py``: records are **op-indexed** (``op`` 0..n-1 in
+recording order) with timestamps RELATIVE to the header's ``t0``, the
+header carries an explicit ``seed`` plus the recording process's build
+identity, and the file is canonical JSONL (sorted keys) — so a recorded
+trace replays deterministically through a seeded simulator regardless of
+machine speed, and two recordings of the same run diff cleanly.
+
+File layout (``kt-trace-v1``): one header line, then one line per op::
+
+    {"schema": "kt-trace-v1", "v": 1, "seed": 7, "t0": ..., "meta": {...},
+     "build": {...}}
+    {"op": 0, "t": 0.0131, "name": "stage.execute", "dur_s": 0.021, ...}
+
+:class:`TraceRecorder` feeds from completed spans (hand it span dicts,
+or let :meth:`drain_ring` pull the trace ring) and commits the whole
+file durably on :meth:`close`. :class:`TraceReader` validates the schema
+and op-index continuity, then hands back ops in recorded or replay
+(time-sorted) order.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import telemetry
+from ..data_store.durability import durable_write_bytes
+
+TRACE_SCHEMA = "kt-trace-v1"
+
+
+class TraceRecorder:
+    """Accumulate spans as op records; durably commit on close.
+
+    The file appears atomically at :meth:`close` (tmp sibling + fsynced
+    rename) — a reader never sees a half-written trace, and a recorder
+    killed mid-run simply leaves no file (the flight-recorder spool is
+    the crash-forensics surface; this one is the curated dataset)."""
+
+    def __init__(self, path, seed: int = 0,
+                 meta: Optional[Dict[str, Any]] = None,
+                 t0: Optional[float] = None):
+        self.path = Path(path)
+        self.t0 = float(t0) if t0 is not None else time.time()
+        self.header: Dict[str, Any] = {
+            "schema": TRACE_SCHEMA,
+            "v": 1,
+            "seed": int(seed),
+            "t0": self.t0,
+            "meta": dict(meta or {}),
+            "build": dict(telemetry.build_info()),
+        }
+        self._ops: List[Dict[str, Any]] = []
+        self._seen: Set[Tuple[str, str]] = set()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def record_span(self, span_dict: Dict[str, Any]) -> Optional[int]:
+        """Append one completed span as an op record; returns its op
+        index, or None when this ``(trace_id, span_id)`` was already
+        recorded (re-shipped prefixes dedup away, same as the ring)."""
+        key = (str(span_dict.get("trace_id", "")),
+               str(span_dict.get("span_id", "")))
+        if key in self._seen or self._closed:
+            return None
+        self._seen.add(key)
+        start = float(span_dict.get("start", self.t0))
+        end = span_dict.get("end")
+        op = {
+            "op": len(self._ops),
+            "t": round(start - self.t0, 9),
+            "name": span_dict.get("name", ""),
+            "dur_s": (round(float(end) - start, 9)
+                      if isinstance(end, (int, float)) else None),
+            "status": span_dict.get("status", "ok"),
+            "trace_id": key[0],
+            "span_id": key[1],
+            "parent_id": span_dict.get("parent_id"),
+            "attrs": dict(span_dict.get("attrs", {})),
+        }
+        self._ops.append(op)
+        return op["op"]
+
+    def record_spans(self, spans: Iterable[Dict[str, Any]]) -> int:
+        return sum(1 for s in spans if self.record_span(s) is not None)
+
+    def drain_ring(self, limit: Optional[int] = None) -> int:
+        """Record every completed span currently in the trace ring that
+        this recorder hasn't seen yet; returns how many were new."""
+        return self.record_spans(telemetry.RING.snapshot(limit=limit))
+
+    def close(self) -> Path:
+        if not self._closed:
+            lines = [json.dumps(self.header, sort_keys=True,
+                                separators=(",", ":"))]
+            lines += [json.dumps(op, sort_keys=True, separators=(",", ":"))
+                      for op in self._ops]
+            durable_write_bytes(
+                self.path, ("\n".join(lines) + "\n").encode("utf-8"))
+            self._closed = True
+        return self.path
+
+    def __enter__(self) -> "TraceRecorder":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+class TraceReader:
+    """Parse + validate one recorded trace file.
+
+    Raises ``ValueError`` on a wrong/missing schema marker or an op-index
+    gap — a trace with holes would silently skew any policy scored
+    against it, so drift fails loudly at load, not at analysis."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        lines = [ln for ln in
+                 self.path.read_text("utf-8").splitlines() if ln.strip()]
+        if not lines:
+            raise ValueError(f"{self.path}: empty trace file")
+        self.header: Dict[str, Any] = json.loads(lines[0])
+        if self.header.get("schema") != TRACE_SCHEMA:
+            raise ValueError(
+                f"{self.path}: schema {self.header.get('schema')!r}, "
+                f"expected {TRACE_SCHEMA!r}")
+        self.ops: List[Dict[str, Any]] = [json.loads(ln)
+                                          for ln in lines[1:]]
+        for index, op in enumerate(self.ops):
+            if op.get("op") != index:
+                raise ValueError(
+                    f"{self.path}: op index {op.get('op')!r} at "
+                    f"position {index} (records missing or reordered)")
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    @property
+    def t0(self) -> float:
+        return float(self.header.get("t0", 0.0))
+
+    @property
+    def seed(self) -> int:
+        return int(self.header.get("seed", 0))
+
+    def replay(self) -> List[Dict[str, Any]]:
+        """Ops in simulator feed order: by relative start time, op index
+        breaking ties — deterministic for any recorded file."""
+        return sorted(self.ops,
+                      key=lambda op: (op.get("t", 0.0), op["op"]))

@@ -889,6 +889,10 @@ def main(argv: Optional[list] = None) -> None:
     # regardless of how the server was launched (CLI, -m, embedder). A
     # --port flag alone must not leave them pointing at the default.
     os.environ["KT_SERVER_PORT"] = str(args.port)
+    # flight recorder (ISSUE 20): armed only when KT_OBS_SPOOL is set —
+    # then this pod's telemetry history survives its own SIGKILL
+    from ..obs import maybe_start_recorder
+    maybe_start_recorder("pod")
     asyncio.run(_serve(create_app(), args.host, args.port))
 
 

@@ -102,6 +102,12 @@ def _worker_main(request_q: mp.Queue, response_q: mp.Queue,
     # after the tees: a failed sync must reach the rank-log channel
     from .env_contract import sync_jax_runtime_config
     sync_jax_runtime_config()
+    # flight recorder (ISSUE 20): armed only when KT_OBS_SPOOL is set —
+    # a kill-rank SIGKILL mid-call then leaves this rank's in-flight span
+    # and final metric snapshot in its own spool
+    from ..obs import maybe_start_recorder
+    rank = (identity_env or {}).get("RANK", os.environ.get("RANK", ""))
+    maybe_start_recorder(f"rank{rank}" if rank != "" else "rank")
     asyncio.run(_worker_loop(request_q, response_q, pointers_dict, init_args,
                              framework_name, identity_env, shm_spec))
 

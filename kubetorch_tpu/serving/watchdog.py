@@ -372,6 +372,11 @@ class Watchdog:
             with telemetry.span("watchdog.death", rank=idx, cause=exc.cause,
                                 exitcode=exc.exitcode):
                 pool.fail_worker_futures(idx, exc)
+                # flight-recorder black box (ISSUE 20): commit the death to
+                # this supervisor's spool NOW — if the whole pod goes next,
+                # the rank's demise is already on disk
+                from ..obs import note_death
+                note_death(idx, exc.cause, exc.exitcode)
                 for hook in list(self.on_death):
                     try:
                         hook(idx, exc)

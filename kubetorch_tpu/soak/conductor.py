@@ -54,7 +54,8 @@ from .shrink import ddmin
 _MUTATED_ENV = ("KT_STORE_NODES", "KT_STORE_REPLICATION",
                 "KT_STORE_WRITE_QUORUM", "KT_STORE_NODE_TTL_S",
                 "KT_DATA_STORE_URL", "KT_CHAOS", "KT_CHAOS_SEED",
-                "KT_CHAOS_REGION_HOSTS", "PYTHONPATH")
+                "KT_CHAOS_REGION_HOSTS", "PYTHONPATH",
+                "KT_OBS_SPOOL", "KT_OBS_INTERVAL_S")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -474,6 +475,26 @@ def _scan_leaks(store_roots: List[str]) -> Dict[str, List[str]]:
     return {"shm": shm, "tmp": sorted(tmp)}
 
 
+def _scan_spools(spool_root: str, kills: int) -> Dict[str, Any]:
+    """Flight-recorder census after teardown (ISSUE 20): hash-verify
+    every child's spool. Run AFTER the fleet is dead, so each spool is
+    final — a surviving writer would race the read."""
+    from ..obs import read_spool, spool_dirs, spool_identity
+    from ..obs.blackbox import pid_alive
+
+    spools: List[Dict[str, Any]] = []
+    for d in spool_dirs(spool_root):
+        name, pid = spool_identity(d)
+        loaded = read_spool(d)
+        spools.append({
+            "dir": str(d), "name": name, "pid": pid,
+            "alive": bool(pid is not None and pid_alive(pid)),
+            "records": len(loaded["records"]),
+            "errors": loaded["errors"],
+        })
+    return {"armed": True, "kills": kills, "spools": spools}
+
+
 def run_soak(sched: Schedule, base_dir: str,
              op_interval_s: float = 0.25,
              settle_timeout_s: float = 60.0,
@@ -516,6 +537,13 @@ def run_soak(sched: Schedule, base_dir: str,
     if _REPO_ROOT not in pp.split(os.pathsep):
         os.environ["PYTHONPATH"] = (_REPO_ROOT + os.pathsep + pp if pp
                                     else _REPO_ROOT)
+    # arm the flight recorder in every fleet child (ISSUE 20): each
+    # subprocess spools delta-encoded telemetry under the run dir at a
+    # fast cadence, so a SIGKILLed store node/rank leaves a black box
+    # the post-teardown census can hash-verify (check_blackbox)
+    spool_root = os.path.join(base_dir, "obs-spool")
+    os.environ["KT_OBS_SPOOL"] = spool_root
+    os.environ["KT_OBS_INTERVAL_S"] = "0.05"
     from ..config import config
     cfg = config()
     saved_cfg_url = cfg.data_store_url
@@ -873,6 +901,13 @@ def run_soak(sched: Schedule, base_dir: str,
 
     time.sleep(0.2)  # give SIGKILLed children a beat to release segments
     history.record("leak-scan", **_scan_leaks(roots))
+    kill_events = sum(
+        1 for r in history.records()
+        if r.get("kind") == "event"
+        and (str(r.get("action", "")).startswith("kill")
+             or r.get("action") == "scale-to-zero"
+             or str(r.get("verb", "")).startswith("kill")))
+    history.record("blackbox", **_scan_spools(spool_root, kill_events))
 
     violations = check_all(history.records())
     for v in violations:

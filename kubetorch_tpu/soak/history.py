@@ -27,6 +27,11 @@ Record shapes (all plain dicts; ``index`` is assigned on append):
   the final scrub verdict.
 - ``{"kind": "leak-scan", "shm": [...], "tmp": [...]}`` — leftover
   /dev/shm segments and orphan .tmp files after teardown.
+- ``{"kind": "blackbox", "armed": bool, "kills": n, "spools":
+  [{"dir", "name", "pid", "alive", "records", "errors": [...]}]}`` —
+  the flight-recorder census (ISSUE 20): after teardown every child's
+  spool is hash-chain-verified; ``kills`` counts the process-fatal
+  events the conductor fired.
 - ``{"kind": "pipeline", "event": "committed|regroup|placed|
   stale-refused|replay", ...}`` — the pipelined trainer's ledger
   (ISSUE 17): ``committed`` carries ``step``/``epoch``/``fingerprint``,
@@ -308,6 +313,35 @@ def check_no_leaks(records: List[Dict]) -> List[Violation]:
     return out
 
 
+def check_blackbox(records: List[Dict]) -> List[Violation]:
+    """Crash forensics must survive the crash (ISSUE 20): when the run
+    armed the flight recorder, every child spool found after teardown
+    must verify — hash chain intact per segment, sequence numbers
+    contiguous — or the black box lied about the death it recorded. And
+    if the conductor SIGKILLed recorder-bearing processes, at least one
+    spool must EXIST: kills with no black boxes means the recorder never
+    committed a record before dying, i.e. the loss window is unbounded."""
+    out: List[Violation] = []
+    for r in records:
+        if r.get("kind") != "blackbox":
+            continue
+        if not r.get("armed"):
+            continue
+        spools = r.get("spools") or []
+        for sp in spools:
+            if sp.get("errors"):
+                out.append(Violation(
+                    "blackbox",
+                    f"spool {sp.get('dir')} failed verification: "
+                    f"{'; '.join(sp['errors'])}", [r["index"]]))
+        if r.get("kills", 0) > 0 and not spools:
+            out.append(Violation(
+                "blackbox",
+                f"{r['kills']} process kill(s) fired but no flight-"
+                f"recorder spools survived teardown", [r["index"]]))
+    return out
+
+
 def check_pipeline_progress(records: List[Dict]) -> List[Violation]:
     """Re-grouped forward progress, epoch-fenced placement, and replay
     bit-identity for the pipelined trainer (ISSUE 17):
@@ -492,6 +526,7 @@ INVARIANTS = {
     "typed-errors": check_typed_errors,
     "ring-convergence": check_ring_converged,
     "no-leaks": check_no_leaks,
+    "blackbox": check_blackbox,
     "pipeline-progress": check_pipeline_progress,
     "flywheel-ledger": check_flywheel_ledger,
 }

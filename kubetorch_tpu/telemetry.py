@@ -105,6 +105,32 @@ def extract(headers) -> Optional[TraceContext]:
         return None
 
 
+# In-flight span registry (ISSUE 20): the flight recorder's crash black
+# box must capture what a process was DOING when it died, not just what it
+# had finished — a SIGKILL mid-call leaves the interesting span open, and
+# the ring only ever sees closed ones. Keyed by id(span); entering
+# registers, exiting removes. One dict op per span on top of the
+# allocation the span already paid; the disabled fast path (NOOP_SPAN)
+# never touches it.
+_ACTIVE_SPANS: Dict[int, "Span"] = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_spans() -> List[Dict]:
+    """Dicts for every span currently open in this process, oldest first.
+    The crash-forensics input: ``obs/`` persists these with each snapshot
+    so ``kt blackbox`` can show the in-flight work of a dead process."""
+    with _ACTIVE_LOCK:
+        spans = list(_ACTIVE_SPANS.values())
+    out = []
+    for s in spans:
+        d = s.to_dict()
+        if s.end is None:
+            d["end"] = None          # still open — to_dict stamps "now"
+        out.append(d)
+    return sorted(out, key=lambda d: d.get("start", 0.0))
+
+
 class Span:
     """One timed operation. Context-manager: entering binds it as the
     current span, exiting records the end time and ships it to the ring."""
@@ -155,6 +181,8 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _current.set(self)
+        with _ACTIVE_LOCK:
+            _ACTIVE_SPANS[id(self)] = self
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -165,6 +193,8 @@ class Span:
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
+        with _ACTIVE_LOCK:
+            _ACTIVE_SPANS.pop(id(self), None)
         RING.add(self.to_dict())
 
 
@@ -592,12 +622,51 @@ class MetricsRegistry:
                                    buckets=buckets)
 
     def render(self) -> str:
+        if self is REGISTRY:
+            # every /metrics endpoint renders the global registry, so the
+            # build-identity gauge (ISSUE 20) rides along by construction —
+            # a future endpoint cannot forget to export it
+            build_info_metrics()
         with self._lock:
             metrics = list(self._metrics.values())
         lines: List[str] = []
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """JSON-safe structural dump of every registered metric: the flight
+        recorder's (ISSUE 20) input. Label tuples become ``\\x1f``-joined
+        string keys (label values never contain the unit separator);
+        histogram entries keep their cumulative bucket lists so a reader
+        can diff two snapshots bucket-by-bucket."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, Dict] = {}
+        for m in metrics:
+            with m._lock:
+                items = list(m._values.items())
+            entry: Dict[str, Any] = {"kind": m.kind,
+                                     "labels": list(m.labelnames)}
+            if isinstance(m, Histogram):
+                entry["le"] = [_format_value(b) for b in m.buckets]
+                entry["values"] = {
+                    "\x1f".join(k): {"buckets": list(v["buckets"]),
+                                     "sum": v["sum"], "count": v["count"]}
+                    for k, v in items}
+            else:
+                entry["values"] = {"\x1f".join(k): v for k, v in items}
+            out[m.name] = entry
+        return out
+
+    def catalog(self) -> List[Tuple[str, str, str]]:
+        """``(series, type, labels)`` rows for every registered metric,
+        registration order — the source the observability docs' metrics
+        table is generated from (and drift-tested against)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [(m.name, m.kind, ", ".join(m.labelnames) or "—")
+                for m in metrics]
 
 
 REGISTRY = MetricsRegistry()
@@ -1023,6 +1092,147 @@ def flywheel_metrics() -> Dict[str, "_Metric"]:
                 labels=("stage",)),
         }
     return _FLYWHEEL_METRICS
+
+
+# ---------------------------------------------------------------------------
+# Build identity (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+_BUILD_INFO: Optional[Dict[str, str]] = None
+_BUILD_INFO_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def build_info() -> Dict[str, str]:
+    """What code this process runs: package version, jax/jaxlib versions,
+    backend, host. Computed once (importlib.metadata walks the filesystem);
+    never imports jax — the backend comes from ``JAX_PLATFORMS``/
+    ``jax.default_backend()`` only if jax is ALREADY loaded, so the
+    dependency-free contract of this module holds."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        import socket
+        import sys as _sys
+
+        def _dist_version(name: str) -> str:
+            try:
+                from importlib import metadata
+                return metadata.version(name)
+            except Exception:  # noqa: BLE001 — absent/unmetadata'd dist
+                return "unknown"
+
+        try:
+            from . import __version__ as pkg_version
+        except Exception:  # noqa: BLE001
+            pkg_version = "unknown"
+        backend = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+        jax_mod = _sys.modules.get("jax")
+        if not backend and jax_mod is not None:
+            try:
+                backend = jax_mod.default_backend()
+            except Exception:  # noqa: BLE001 — no devices yet
+                backend = ""
+        _BUILD_INFO = {
+            "version": str(pkg_version),
+            "jax": _dist_version("jax"),
+            "jaxlib": _dist_version("jaxlib"),
+            "backend": backend or "unknown",
+            "host": socket.gethostname(),
+        }
+    return _BUILD_INFO
+
+
+def build_info_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create ``kt_build_info`` — the constant-1 identity gauge
+    every ``/metrics`` endpoint exports (``MetricsRegistry.render`` ensures
+    it on the global registry), so fleet rollups and bench JSON can key
+    scraped numbers by the build that produced them."""
+    global _BUILD_INFO_METRICS
+    if _BUILD_INFO_METRICS is None:
+        info = build_info()
+        g = gauge(
+            "kt_build_info",
+            "Build identity of this process (constant 1; the labels are "
+            "the payload: package/jax/jaxlib versions, backend, host)",
+            labels=("version", "jax", "jaxlib", "backend", "host"))
+        g.set(1, **info)
+        _BUILD_INFO_METRICS = {"build_info": g}
+    return _BUILD_INFO_METRICS
+
+
+# ---------------------------------------------------------------------------
+# Fleet rollup + flight-recorder metrics (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+# Multi-window burn-rate taxonomy (SRE workbook): the fast window catches
+# a cliff within minutes, the slow window keeps a smolder from paging
+# forever. Window lengths are config (obs_slo_*); these are the labels.
+SLO_WINDOWS = ("fast", "slow")
+
+_FLEET_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def fleet_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the ``kt_fleet_*`` family the controller-side fleet
+    aggregator (``obs/fleet.py``, the only histogram-merge site) emits
+    into: scrape outcomes, counter-reset epochs detected, per-stage SLO
+    burn rates by window, and alert counts. The merged per-stage rollup
+    histograms themselves are rendered by the aggregator (they are
+    re-aggregated scrapes, not process-local observations — observing
+    them into this registry would double-count on self-scrape)."""
+    global _FLEET_METRICS
+    if _FLEET_METRICS is None:
+        _FLEET_METRICS = {
+            "scrapes": counter(
+                "kt_fleet_scrapes_total",
+                "Fleet aggregator scrape attempts by outcome (ok, error)",
+                labels=("outcome",)),
+            "resets": counter(
+                "kt_fleet_counter_resets_total",
+                "Per-pod counter resets detected while merging (a scraped "
+                "cumulative value went DOWN ⇒ the pod restarted ⇒ new "
+                "epoch, never a negative delta)"),
+            "pods": gauge(
+                "kt_fleet_pods",
+                "Pods in the fleet aggregator's last scrape round",
+                labels=("state",)),
+            "slo_burn": gauge(
+                "kt_fleet_slo_burn",
+                "Multi-window SLO burn rate per stage (1.0 = burning the "
+                "error budget exactly at the sustainable rate; window: "
+                "fast, slow)",
+                labels=("stage", "window")),
+            "alerts": counter(
+                "kt_fleet_alerts_total",
+                "SloBurnAlert records emitted by the fleet aggregator",
+                labels=("stage", "window")),
+        }
+    return _FLEET_METRICS
+
+
+_OBS_METRICS: Optional[Dict[str, _Metric]] = None
+
+
+def obs_metrics() -> Dict[str, "_Metric"]:
+    """Get-or-create the flight recorder's own accounting (``obs/``, the
+    only telemetry-persistence site): snapshots appended by kind, spool
+    rotations, and the spool's current on-disk size — the boundedness the
+    soak asserts."""
+    global _OBS_METRICS
+    if _OBS_METRICS is None:
+        _OBS_METRICS = {
+            "snapshots": counter(
+                "kt_obs_snapshots_total",
+                "Flight-recorder records appended to the spool by kind "
+                "(snapshot, final, event)",
+                labels=("kind",)),
+            "rotations": counter(
+                "kt_obs_rotations_total",
+                "Spool segment rotations (size- or age-capped)"),
+            "spool_bytes": gauge(
+                "kt_obs_spool_bytes",
+                "Current on-disk size of this process's spool directory"),
+        }
+    return _OBS_METRICS
 
 
 # ---------------------------------------------------------------------------

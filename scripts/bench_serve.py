@@ -973,6 +973,204 @@ def _flywheel_main(args) -> int:
     return 0 if all(acceptance.values()) else 1
 
 
+# ---------------------------------------------------------------------------
+# --obs: fleet aggregator under load (ISSUE 20)
+# ---------------------------------------------------------------------------
+#
+# Two claims, one run, exit-coded:
+#
+# merge   the controller-side FleetAggregator's merged p50/p99 for a stage
+#         must match the single-scrape reference (raw bucket sums over the
+#         same final exposition texts) within tolerance — the epoch
+#         correction and union-edge merge must be invisible when pods
+#         share a build and never restarted;
+# alert   an injected latency breach (every pod's synthetic load turns
+#         slower than the SLO at a known moment) must trip the
+#         fast-window SloBurnAlert within ONE scrape round of the breach
+#         becoming visible in a scrape.
+
+
+def run_obs_pod(args) -> None:
+    """One fleet pod for ``--obs``: the real registry behind a real
+    ``/metrics`` endpoint, plus a seeded synthetic load loop observing
+    ``kt_stage_seconds{stage="bench_obs"}`` — fast (well under the SLO)
+    until ``--breach-at`` seconds in, then slow (over it). The breach
+    flips a ``kt_bench_obs_breach`` gauge in the SAME loop iteration as
+    the first slow observation, so the driver can pin exactly which
+    scrape round first saw the breach."""
+    import random as _random
+    import threading
+
+    from aiohttp import web
+
+    rng = _random.Random(args.seed * 1000 + int(args.replica_id or 0))
+    telemetry.build_info_metrics()       # kt_build_info on this scrape too
+    breach_gauge = telemetry.REGISTRY.gauge(
+        "kt_bench_obs_breach",
+        "1 once this bench pod's injected latency breach is live")
+    breach_gauge.set(0)
+    slo_s = args.obs_slo_ms / 1000.0
+    t0 = time.monotonic()
+
+    def load() -> None:
+        while True:
+            if (args.breach_at > 0
+                    and time.monotonic() - t0 >= args.breach_at):
+                breach_gauge.set(1)
+                lat = slo_s * (2.0 + rng.random())
+            else:
+                lat = slo_s * (0.1 + 0.4 * rng.random())
+            telemetry.observe_stage("bench_obs", lat)
+            time.sleep(0.002)
+
+    async def metrics_route(request):
+        return web.Response(text=telemetry.REGISTRY.render(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics_route)
+    threading.Thread(target=load, daemon=True).start()
+    web.run_app(app, host="127.0.0.1", port=args.port,
+                print=lambda *_: None)
+
+
+def _obs_main(args) -> int:
+    import re as _re
+    import subprocess
+
+    import requests
+
+    from kubetorch_tpu.controller.app import (_parse_histogram_buckets,
+                                              _quantile_from_buckets)
+    from kubetorch_tpu.exceptions import package_exception
+    from kubetorch_tpu.obs import FleetAggregator
+    from kubetorch_tpu.utils.procs import (free_port, kill_process_tree,
+                                           wait_for_port)
+
+    interval = args.obs_interval
+    slo_s = args.obs_slo_ms / 1000.0
+    # bench-scale windows: fast = 3 rounds, slow = 10 — same multi-window
+    # shape as production (5m/1h), compressed so the run fits in seconds
+    agg = FleetAggregator(slo_s=slo_s, target=0.99, burn_threshold=14.4,
+                          fast_window_s=3 * interval,
+                          slow_window_s=10 * interval)
+    print(f"fleet aggregator bench: {args.obs_pods} subprocess pods, "
+          f"scrape every {interval}s, SLO {args.obs_slo_ms:.0f}ms @ 99%, "
+          f"latency breach injected at t={args.breach_at}s per pod")
+
+    ports = [free_port() for _ in range(args.obs_pods)]
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--obs-pod",
+         "--port", str(port), "--replica-id", str(i),
+         "--breach-at", str(args.breach_at),
+         "--obs-slo-ms", str(args.obs_slo_ms), "--seed", str(args.seed)],
+        env=_cold_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL) for i, port in enumerate(ports)]
+    texts: Dict[str, Optional[str]] = {}
+    first_breach_round: Optional[int] = None
+    first_alert_round: Optional[int] = None
+    alert = None
+    try:
+        for port in ports:
+            assert wait_for_port("127.0.0.1", port, timeout=30), \
+                "obs pod never came up"
+        for rnd in range(args.obs_rounds):
+            round_texts: Dict[str, Optional[str]] = {}
+            for i, port in enumerate(ports):
+                try:
+                    round_texts[f"pod-{i}"] = requests.get(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2).text
+                except requests.RequestException:
+                    round_texts[f"pod-{i}"] = None
+                agg.ingest(f"pod-{i}", round_texts[f"pod-{i}"])
+            raised = agg.tick()
+            # keep each pod's LAST successful text: the reference must
+            # cover exactly the history the aggregator folded in
+            texts.update({k: v for k, v in round_texts.items() if v})
+            if first_breach_round is None and any(
+                    v and _re.search(r"^kt_bench_obs_breach(?:\{[^}]*\})?"
+                                     r"\s+1(?:\.0)?\s*$", v, _re.M)
+                    for v in round_texts.values()):
+                first_breach_round = rnd
+            fast = [a for a in raised
+                    if a.window == "fast" and a.stage == "bench_obs"]
+            if fast and first_alert_round is None:
+                first_alert_round = rnd
+                alert = fast[0]
+            if first_alert_round is not None:
+                break
+            time.sleep(interval)
+    finally:
+        for proc in procs:
+            kill_process_tree(proc.pid)
+
+    per_pod = {}
+    for pod, text in texts.items():
+        raw = _parse_histogram_buckets(text, "kt_stage_seconds",
+                                       'stage="bench_obs"')
+        if raw:
+            per_pod[pod] = raw
+    ref: Dict[str, float] = {}
+    for raw in per_pod.values():
+        for le, count in raw.items():
+            ref[le] = ref.get(le, 0.0) + count
+    ref_p50 = _quantile_from_buckets(ref, 0.5)
+    ref_p99 = _quantile_from_buckets(ref, 0.99)
+    agg_p50 = agg.quantile("bench_obs", 0.5)
+    agg_p99 = agg.quantile("bench_obs", 0.99)
+
+    def _rel_err(a: Optional[float], b: Optional[float]) -> float:
+        if not a or not b:
+            return float("inf")
+        return abs(a - b) / b
+
+    status = agg.status()
+    stage_row = status["stages"].get("bench_obs", {})
+    print(f"\nmerged vs single-scrape reference "
+          f"({len(per_pod)} pods, {stage_row.get('count', 0):.0f} obs): "
+          f"p50 {1000 * (agg_p50 or 0):.1f}ms vs "
+          f"{1000 * (ref_p50 or 0):.1f}ms, "
+          f"p99 {1000 * (agg_p99 or 0):.1f}ms vs "
+          f"{1000 * (ref_p99 or 0):.1f}ms")
+    if first_alert_round is not None and alert is not None:
+        rounds_late = (first_alert_round - first_breach_round
+                       if first_breach_round is not None else None)
+        print(f"breach first visible in scrape round {first_breach_round}; "
+              f"fast-window alert in round {first_alert_round} "
+              f"({rounds_late} round(s) later): {alert}")
+    else:
+        print("breach never tripped the fast-window alert "
+              f"(breach round: {first_breach_round})")
+    acceptance = {
+        "merged_p50_matches_reference": _rel_err(agg_p50, ref_p50) <= 0.05,
+        "merged_p99_matches_reference": _rel_err(agg_p99, ref_p99) <= 0.05,
+        "alert_within_one_round": (
+            first_alert_round is not None
+            and first_breach_round is not None
+            and first_alert_round <= first_breach_round + 1),
+    }
+    out = {
+        "metric": "fleet_obs_alert_rounds",
+        "value": (first_alert_round - first_breach_round
+                  if first_alert_round is not None
+                  and first_breach_round is not None else None),
+        "unit": "rounds",
+        "detail": {
+            "pods": args.obs_pods,
+            "scrape_interval_s": interval,
+            "merged": {"p50_s": agg_p50, "p99_s": agg_p99},
+            "reference": {"p50_s": ref_p50, "p99_s": ref_p99},
+            "breach_round": first_breach_round,
+            "alert_round": first_alert_round,
+            "alert": package_exception(alert) if alert else None,
+            "status": stage_row,
+            "acceptance": acceptance,
+        },
+    }
+    print("\n" + json.dumps(out))
+    return 0 if all(acceptance.values()) else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--regions", type=int, default=0,
@@ -991,6 +1189,24 @@ def main() -> int:
                         "ledger + harvest trainer + gated promotion, and "
                         "the harvest/vacate impact on serving p99/shed "
                         "(ISSUE 19); exit-coded on vacate-inside-grace")
+    p.add_argument("--obs", action="store_true",
+                   help="fleet aggregator under load: subprocess pods "
+                        "scraped into the real FleetAggregator — merged "
+                        "p50/p99 vs single-scrape reference, and an "
+                        "injected latency breach must trip the fast-"
+                        "window SloBurnAlert within one scrape round "
+                        "(ISSUE 20); exit-coded")
+    p.add_argument("--obs-pods", type=int, default=4,
+                   help="obs: subprocess pod count")
+    p.add_argument("--obs-rounds", type=int, default=40,
+                   help="obs: max scrape rounds before giving up")
+    p.add_argument("--obs-interval", type=float, default=0.5,
+                   help="obs: scrape interval (s)")
+    p.add_argument("--obs-slo-ms", type=float, default=100.0,
+                   help="obs: per-stage latency SLO (ms)")
+    p.add_argument("--breach-at", type=float, default=4.0,
+                   help="obs: seconds after pod start to turn its "
+                        "synthetic load slower than the SLO")
     p.add_argument("--fly-slo-ms", type=float, default=400.0,
                    help="flywheel harvest policy queue-wait SLO (ms)")
     p.add_argument("--fly-grace-s", type=float, default=5.0,
@@ -1003,8 +1219,9 @@ def main() -> int:
                    help="scale-out: A/B arms only")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="scale-out per-phase wait budget")
-    # internal: scale-out joiner subprocess mode
+    # internal: scale-out joiner / obs pod subprocess modes
     p.add_argument("--joiner", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--obs-pod", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--store", default="", help=argparse.SUPPRESS)
     p.add_argument("--key", default="", help=argparse.SUPPRESS)
@@ -1039,6 +1256,11 @@ def main() -> int:
     if args.joiner:
         run_joiner(args)
         return 0
+    if args.obs_pod:
+        run_obs_pod(args)
+        return 0
+    if args.obs:
+        return _obs_main(args)
     if args.scale_out:
         return _scaleout_main(args)
     if args.flywheel:

@@ -39,6 +39,14 @@ Gated stages and how each is driven:
   ``kt_cold_start_seconds{phase="compile_or_cache"}`` so a broken cache
   key or serialize path (silent fallback to full XLA compiles) fails the
   gate instead of slowing every fleet scale-out (ISSUE 16).
+- ``recorder_overhead`` — the one RATIO stage (ISSUE 20): the flight
+  recorder's steady-state per-flush cost (ring refilled with real stage
+  spans between flushes, flush timed inline) over the flush interval —
+  the fraction of a busy single core the recorder steals at 10x the
+  production cadence. Judged against an ABSOLUTE budget (<3%,
+  ``--recorder-budget``), not the baseline rule — "always-on" is only
+  true if the recorder's price stays a rounding error no matter what
+  the baseline drifted to.
 
 Gate rule (per stage)::
 
@@ -307,6 +315,46 @@ def _drive_cold_start(boots: int) -> None:
             eng.stop()
 
 
+def _measure_recorder_overhead(batches: int, ops: int) -> float:
+    """The flight recorder's foreground price as a fraction: median
+    per-flush wall cost at steady state, divided by the flush interval
+    (0.1s — 10x the production default cadence, so the quotient is a
+    deliberate overestimate of always-on).
+
+    Each round refills the trace ring with ``ops`` real
+    ``telemetry.stage`` spans — the exact state a busy pod's flush must
+    drain — then times ONE ``flush()`` inline. cost/interval is the
+    single-busy-core worst case: a foreground that never idles pays
+    every flush millisecond (GIL + IO); any real deployment (idle gaps,
+    spare cores) pays less. Inline timing is deterministic where the
+    obvious paired on/off wall-clock design is not: a 3% signal sits
+    below this host's scheduler jitter, and that design flapped between
+    0% and 25% on the same build."""
+    import statistics
+    import time
+
+    from kubetorch_tpu import telemetry
+    from kubetorch_tpu.obs import FlightRecorder
+
+    interval_s = 0.1
+    with tempfile.TemporaryDirectory() as root:
+        rec = FlightRecorder(os.path.join(root, "spool"),
+                             name="perf-gate", interval_s=interval_s)
+        rec.dir.mkdir(parents=True, exist_ok=True)
+        costs = []
+        for _ in range(batches + 1):
+            for _ in range(ops):
+                with telemetry.stage("recorder_probe"):
+                    pass
+            t0 = time.perf_counter()
+            rec.flush()
+            costs.append(time.perf_counter() - t0)
+        rec.stop(final=False)
+    # the first flush writes the full (not delta) snapshot — steady
+    # state starts at the second
+    return statistics.median(costs[1:]) / interval_s
+
+
 def measure(calls: int, payload_kb: int, shm_calls: int, shm_kb: int,
             store_gets: int, rollout_calls: int, rollout_kb: int,
             train_steps: int, snapshot_saves: int,
@@ -379,6 +427,12 @@ def main() -> int:
     p.add_argument("--train-steps", type=int, default=20)
     p.add_argument("--snapshot-saves", type=int, default=20)
     p.add_argument("--cold-boots", type=int, default=6)
+    p.add_argument("--recorder-batches", type=int, default=12)
+    p.add_argument("--recorder-ops", type=int, default=2000)
+    p.add_argument("--recorder-budget", type=float, default=float(
+        os.environ.get("KT_RECORDER_OVERHEAD_BUDGET", "0.03")),
+        help="absolute cap on the recorder_overhead ratio (fraction; the "
+             "ISSUE-20 always-on promise is <3%%)")
     p.add_argument("--tolerance", type=float, default=float(
         os.environ.get("KT_PERF_GATE_TOLERANCE", "0.10")))
     p.add_argument("--abs-floor-ms", type=float, default=2.0)
@@ -393,6 +447,12 @@ def main() -> int:
                    help="re-baseline (deliberate hot-path changes only; "
                         "commit the JSON with the explaining PR)")
     args = p.parse_args()
+
+    # the ratio stage runs FIRST, while the registry is small and the
+    # process quiet — the recorder's price is measured, not the other
+    # drivers' cache pollution
+    recorder_ratio = _measure_recorder_overhead(args.recorder_batches,
+                                                args.recorder_ops)
 
     measured, snap = measure(args.calls, args.payload_kb, args.shm_calls,
                              args.shm_kb, args.store_gets,
@@ -413,6 +473,9 @@ def main() -> int:
             "train_steps": args.train_steps,
             "snapshot_saves": args.snapshot_saves,
             "cold_boots": args.cold_boots,
+            # informational only: recorder_overhead is judged against the
+            # ABSOLUTE --recorder-budget, never against this snapshot
+            "recorder_overhead": round(recorder_ratio, 6),
             "note": "p50 seconds per stage from scripts/check_perf_gate.py"
                     " --update; gate = p50 <= baseline*(1+tol) + floor",
         }
@@ -446,6 +509,28 @@ def main() -> int:
     # re-drives the full workload (stages share drivers) but only the
     # stages that failed are re-judged.
     import statistics
+
+    # recorder_overhead (ISSUE 20): absolute-budget ratio stage, its own
+    # median-of-N retries (same ethos: the budget never loosens, one
+    # scheduling burst doesn't flunk an always-on promise that holds)
+    rec_attempts = [recorder_ratio]
+    rec_verdict = "ok" if recorder_ratio <= args.recorder_budget \
+        else "REGRESSED"
+    print(f"perf-gate: recorder_overhead ratio {recorder_ratio * 100:6.2f}%"
+          f"  budget {args.recorder_budget * 100:.1f}%  {rec_verdict}")
+    for attempt in range(2, max(1, args.retries) + 1):
+        if statistics.median(rec_attempts) <= args.recorder_budget:
+            break
+        print(f"perf-gate: re-measuring recorder_overhead "
+              f"(attempt {attempt}/{args.retries})")
+        rec_attempts.append(_measure_recorder_overhead(
+            args.recorder_batches, args.recorder_ops))
+    rec_median = statistics.median(rec_attempts)
+    if rec_median > args.recorder_budget:
+        print(f"perf-gate: recorder_overhead median-of-"
+              f"{len(rec_attempts)} {rec_median * 100:6.2f}%  budget "
+              f"{args.recorder_budget * 100:.1f}%  REGRESSED")
+
     attempts = {s: [measured[s]] for s in GATED_STAGES}
     for attempt in range(2, max(1, args.retries) + 1):
         if not failures:
@@ -469,6 +554,8 @@ def main() -> int:
             if med > limits[stage]:
                 still.append(stage)
         failures = still
+    if rec_median > args.recorder_budget:
+        failures.append("recorder_overhead")
     if failures:
         print(f"\nperf-gate: FAIL — {', '.join(failures)} p50 regressed "
               f"past baseline*(1+{args.tolerance:g}) + "

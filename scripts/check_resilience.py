@@ -83,10 +83,11 @@ BASELINE = {
     # session probe + port-forward health check + the `kt trace` debug
     # fetch + the `kt store status` /ring + /scrub/status probes + the
     # `kt serve status` /health + /metrics probes + the `kt rollout
-    # status` /rollout/status + /metrics probes — all single-shot by
-    # design (a doctor/debug command that retried would hang or hide the
-    # very flakiness it exists to diagnose)
-    "cli.py": 8,
+    # status` /rollout/status + /metrics probes + the `kt obs top`
+    # /fleet/status probe (ISSUE 20) — all single-shot by design (a
+    # doctor/debug command that retried would hang or hide the very
+    # flakiness it exists to diagnose)
+    "cli.py": 9,
     # daemon-liveness probes in _read_running_local (must not retry: they
     # decide whether to SPAWN a controller) + _request's internals
     "client.py": 4,
@@ -109,9 +110,11 @@ BASELINE = {
     "resources/app.py": 1,        # local readiness poll (loop retries it)
     "resources/module.py": 1,     # local readiness poll (loop retries it)
     # controller-internal aiohttp fan-outs: Loki push + proxy relay +
-    # metric scrapes — supervised by their own loops; a blind retry layer
-    # here would double-forward proxied requests
-    "controller/app.py": 5,
+    # metric scrapes + the fleet-aggregator /metrics sweep (ISSUE 20) —
+    # supervised by their own loops; a blind retry layer here would
+    # double-forward proxied requests, and a scrape that fails IS the
+    # pod-down signal the aggregator records
+    "controller/app.py": 6,
     # worker-pool health polls and distributed subcalls: failures are the
     # SIGNAL (typed WorkerCallError → elastic resize), not noise to retry
     "serving/remote_worker_pool.py": 2,
@@ -346,6 +349,21 @@ FEEDBACK_RE = re.compile(
     r"segment_key\()")
 FEEDBACK_EXEMPT = {"ledger.py"}
 FEEDBACK_BASELINE: dict = {}
+
+
+# Telemetry-state persistence containment (ISSUE 20). ``obs/`` is the
+# ONLY site that persists raw telemetry state: the flight recorder
+# delta-encodes registry snapshots into hash-chained spool segments, and
+# the black-box reader verifies those chains on recovery. A bare
+# ``REGISTRY.snapshot(`` or ``active_spans(`` call elsewhere is a
+# shadow telemetry dump — unchained, unbounded, invisible to ``kt
+# blackbox`` and the soak's spool census. telemetry.py itself is exempt
+# (it DEFINES the snapshot/span surface); everything else reads
+# telemetry through the obs package. The baseline is EMPTY on purpose.
+TELEM_PERSIST_RE = re.compile(r"REGISTRY\.snapshot\(|\bactive_spans\(")
+TELEM_PERSIST_EXEMPT = {"telemetry.py"}
+TELEM_PERSIST_EXEMPT_DIR = "obs"
+TELEM_PERSIST_BASELINE: dict = {}
 
 
 def _count_matches(path: Path, pattern: re.Pattern) -> int:
@@ -781,6 +799,33 @@ def main() -> int:
               "records. The baseline is empty on purpose.")
         return 1
 
+    telem_persist_failures = []
+    telem_persist_counts = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path.name in TELEM_PERSIST_EXEMPT:
+            continue
+        if TELEM_PERSIST_EXEMPT_DIR in path.relative_to(PKG).parts:
+            continue
+        rel = str(path.relative_to(PKG))
+        n = _count_matches(path, TELEM_PERSIST_RE)
+        if n:
+            telem_persist_counts[rel] = n
+        allowed = TELEM_PERSIST_BASELINE.get(rel, 0)
+        if n > allowed:
+            telem_persist_failures.append(
+                f"  {rel}: {n} raw telemetry-state read(s), baseline "
+                f"allows {allowed}")
+    if telem_persist_failures:
+        print("check_resilience: raw telemetry-state reads bypass the "
+              "flight recorder:\n" + "\n".join(telem_persist_failures))
+        print("\nTelemetry history is persisted ONLY through obs/ "
+              "(FlightRecorder → hash-chained spool segments, blackbox → "
+              "verified recovery). A bare REGISTRY.snapshot()/"
+              "active_spans() elsewhere mints an unchained shadow dump "
+              "that kt blackbox and the soak spool census cannot see. "
+              "The baseline is empty on purpose.")
+        return 1
+
     # also flag stale baseline entries so the allowlists shrink over time
     stale = sorted(
         [f for f, allowed in BASELINE.items() if counts.get(f, 0) < allowed]
@@ -817,7 +862,9 @@ def main() -> int:
         + [f for f, allowed in PROMOTE_BASELINE.items()
            if promote_counts.get(f, 0) < allowed]
         + [f for f, allowed in FEEDBACK_BASELINE.items()
-           if feedback_counts.get(f, 0) < allowed])
+           if feedback_counts.get(f, 0) < allowed]
+        + [f for f, allowed in TELEM_PERSIST_BASELINE.items()
+           if telem_persist_counts.get(f, 0) < allowed])
     if stale:
         print("check_resilience: OK (note: baseline is loose for: "
               + ", ".join(stale) + ")")
@@ -829,8 +876,9 @@ def main() -> int:
               "device_get sites, shared-memory segments, engine "
               "param-tree assignments, telemetry sites, soak RNG "
               "draws, AOT compile-path entries, stage-membership "
-              "constructions, flywheel promotions, and feedback-segment "
-              "writes accounted for")
+              "constructions, flywheel promotions, feedback-segment "
+              "writes, and telemetry-state persistence sites accounted "
+              "for")
     return 0
 
 

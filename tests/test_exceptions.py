@@ -45,6 +45,16 @@ _ATTR_SAMPLES = {
     # StaleStageEpochError (ISSUE 17 pipeline membership fencing)
     "job": "train-llama",
     "stage": 2,
+    # SloBurnAlert (ISSUE 20 fleet SLO burn rollup)
+    "window": "fast",
+    "burn_rate": 16.2,
+    "threshold": 14.4,
+    "slo_s": 0.25,
+    "target": 0.99,
+    "at": 1722787200.25,
+    # PodUnreachableError (ISSUE 20 dead-pod surfaces)
+    "url": "http://10.0.0.7:8080",
+    "spool_hint": "/var/kt/spool/rank-123",
 }
 
 

@@ -265,7 +265,7 @@ def test_check_all_runs_every_invariant():
     assert set(H.INVARIANTS) == {"durability", "commits", "lease-fencing",
                                  "typed-errors", "ring-convergence",
                                  "no-leaks", "pipeline-progress",
-                                 "flywheel-ledger"}
+                                 "flywheel-ledger", "blackbox"}
     assert H.check_all([]) == []
 
 
